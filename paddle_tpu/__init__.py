@@ -1,0 +1,25 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the capability
+surface of PaddlePaddle ~2.0 (reference: /root/reference), rebuilt on
+JAX/XLA/Pallas/pjit. See SURVEY.md for the blueprint.
+
+Public API mirrors `import paddle`: tensors + ops at top level, `nn`,
+`optimizer`, `amp`, `metric`, `io`, `vision`, `jit`, `static`, `distributed`,
+and the high-level `Model`.
+"""
+from .core import dtype as _dtype_mod
+from .core.dtype import (bfloat16, bool_, complex64, complex128, float16,
+                         float32, float64, get_default_dtype, int8, int16,
+                         int32, int64, set_default_dtype, uint8)
+from .core.errors import enforce
+from .core.flags import get_flags, set_flags
+from .core.place import (CPUPlace, CUDAPlace, TPUPlace, TPUPinnedPlace,
+                         device_count, get_device, is_compiled_with_cuda,
+                         is_compiled_with_tpu, set_device)
+from .core.random import get_rng_state, seed, set_rng_state
+from .core.tensor import Tensor, enable_grad, no_grad, set_grad_enabled, to_tensor
+from .core.autograd import grad
+
+from .ops import *  # noqa: F401,F403  — tensor function library
+from .ops import einsum  # noqa: F401
+
+__version__ = "0.1.0"

@@ -1,0 +1,4 @@
+from . import dtype, errors, flags, place, random
+from .autograd import grad
+from .tensor import (Tensor, apply, enable_grad, is_grad_enabled, no_grad,
+                     set_grad_enabled, to_tensor)

@@ -1,0 +1,252 @@
+"""Backward engines over the eager tape.
+
+`run_backward`  — BasicEngine analog (/root/reference/paddle/fluid/imperative/
+                  basic_engine.cc:39 Init, :278 Execute): seeds the root
+                  cotangent, sweeps reachable TapeNodes in reverse creation
+                  order (a valid topological order for an eager tape), calls
+                  each node's vjp, accumulates into leaf .grad.
+`grad`          — partial_grad_engine.cc analog (paddle.grad API): cotangents
+                  for selected inputs only, optional create_graph.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .errors import InvalidArgumentError, enforce
+from .tensor import TapeNode, Tensor, apply, is_grad_enabled, no_grad
+
+
+def _collect_nodes(roots: Sequence[Tensor]):
+    """All TapeNodes reachable from roots, sorted by descending creation id."""
+    seen = {}
+    stack = [t._node for t in roots if t._node is not None]
+    while stack:
+        n = stack.pop()
+        if n.id in seen:
+            continue
+        seen[n.id] = n
+        for inp in n.inputs:
+            if inp._node is not None and inp._node.id not in seen:
+                stack.append(inp._node)
+    return sorted(seen.values(), key=lambda n: -n.id)
+
+
+def _accum(store: dict, key, value):
+    prev = store.get(key)
+    store[key] = value if prev is None else prev + value
+
+
+def _run_hooks(t: Tensor, g):
+    for hook in t._hooks:
+        out = hook(Tensor(g, stop_gradient=True))
+        if out is not None:
+            g = out._data if isinstance(out, Tensor) else jnp.asarray(out)
+    return g
+
+
+def _sweep(roots, root_grads, retain_graph, wanted=None, accumulate_leaf=True):
+    """Shared reverse sweep. Returns {id(tensor): cotangent} for `wanted`."""
+    nodes = _collect_nodes(roots)
+    # cotangents keyed per (node_id, out_idx) for intermediates and id(tensor)
+    # for requested/leaf tensors.
+    node_cots = {}      # (node_id, out_idx) -> array
+    tensor_cots = {}    # id(tensor) -> array (wanted/leaf results)
+    wanted_ids = {id(t) for t in (wanted or [])}
+    # Map (node_id, out_idx) -> live output tensors (for retain_grads/hooks).
+    out_tensors = {}
+    for t in _live_outputs(roots, nodes):
+        out_tensors.setdefault((t._node.id, t._out_idx), []).append(t)
+
+    for root, g in zip(roots, root_grads):
+        if root._node is None:
+            if id(root) in wanted_ids:
+                _accum(tensor_cots, id(root), g)
+            elif accumulate_leaf and not root.stop_gradient:
+                _leaf_accum(root, g)
+        else:
+            _accum(node_cots, (root._node.id, root._out_idx), g)
+
+    for node in nodes:
+        cots = []
+        has_any = False
+        for i, (shape, dt) in enumerate(node.out_avals):
+            c = node_cots.pop((node.id, i), None)
+            if c is None:
+                c = jnp.zeros(shape, dt)
+            else:
+                has_any = True
+                for t in out_tensors.get((node.id, i), []):
+                    c = _run_hooks(t, c)
+                    if t._retain_grads:
+                        _leaf_accum(t, c)
+            cots.append(c)
+        if not has_any:
+            continue
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                "Trying to backward through the graph a second time; "
+                "specify retain_graph=True on the first backward call.")
+        seed = tuple(cots) if node.tuple_out else cots[0]
+        in_cots = node.vjp_fn(seed)
+        if not retain_graph:
+            node.vjp_fn = None
+        for inp, g in zip(node.inputs, in_cots):
+            if isinstance(g, jax.Array) and g.dtype == jax.dtypes.float0:
+                continue
+            if inp._node is not None:
+                _accum(node_cots, (inp._node.id, inp._out_idx), g)
+            else:
+                g = _run_hooks(inp, g)
+                if id(inp) in wanted_ids:
+                    _accum(tensor_cots, id(inp), g)
+                if accumulate_leaf and not inp.stop_gradient:
+                    _leaf_accum(inp, g)
+            if inp._node is None and id(inp) not in wanted_ids and inp.stop_gradient:
+                continue
+    return tensor_cots
+
+
+def _leaf_accum(t: Tensor, g):
+    if t.grad is None:
+        t.grad = Tensor(g, stop_gradient=True, name=t.name + "@GRAD")
+    else:
+        t.grad.set_value(t.grad._data + g)
+
+
+def _live_outputs(roots, nodes):
+    """Tensors we know about that are outputs of reachable nodes: the roots
+    plus all node inputs (covers hook/retain_grads on intermediates that are
+    themselves inputs to later ops — the common case)."""
+    out = [t for t in roots if t._node is not None]
+    for n in nodes:
+        for inp in n.inputs:
+            if inp._node is not None:
+                out.append(inp)
+    return out
+
+
+def run_backward(root: Tensor, grad_tensor=None, retain_graph=False):
+    enforce(root._node is not None or not root.stop_gradient,
+            "Tensor has no grad graph (stop_gradient=True and no history)",
+            InvalidArgumentError)
+    if grad_tensor is None:
+        g = jnp.ones(root._data.shape, root._data.dtype)
+    else:
+        g = grad_tensor._data if isinstance(grad_tensor, Tensor) else jnp.asarray(grad_tensor)
+    with no_grad():
+        _sweep([root], [g], retain_graph)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """paddle.grad parity (partial_grad_engine.cc analog).
+
+    create_graph=True is implemented functionally: we re-trace through
+    jax.vjp of a replayed closure is not available on an eager tape, so we
+    instead run the sweep *with grad recording enabled*, which records the
+    vjp computations themselves onto the tape (double-backward works because
+    every vjp is built from jax ops executed through `apply`-free raw jnp —
+    so for create_graph we wrap cotangent math in Tensors).
+    """
+    outputs = _as_list(outputs)
+    inputs = _as_list(inputs)
+    if retain_graph is None:
+        retain_graph = create_graph
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    seeds = []
+    for o, go in zip(outputs, grad_outputs):
+        if go is None:
+            seeds.append(jnp.ones(o._data.shape, o._data.dtype))
+        else:
+            seeds.append(go._data if isinstance(go, Tensor) else jnp.asarray(go))
+
+    if create_graph:
+        # Functional double-backward: build a pure function of the inputs and
+        # use jax.vjp so the returned grads carry a fresh tape.
+        return _grad_create_graph(outputs, inputs, seeds, allow_unused)
+
+    with no_grad():
+        cots = _sweep(outputs, seeds, retain_graph, wanted=inputs,
+                      accumulate_leaf=False)
+    results = []
+    for t in inputs:
+        c = cots.get(id(t))
+        if c is None:
+            if not allow_unused:
+                raise InvalidArgumentError(
+                    f"Input tensor {t.name} is unused in the graph "
+                    "(pass allow_unused=True to get None)")
+            results.append(None)
+        else:
+            results.append(Tensor(c, stop_gradient=True))
+    return results
+
+
+def _grad_create_graph(outputs, inputs, seeds, allow_unused):
+    """Higher-order grad via functional replay of the recorded tape region.
+
+    The tape alone cannot express d(grad)/d(input) because jax.vjp hides the
+    input dependence inside its closure. Instead we reconstruct the pure
+    function F(inputs) -> outputs from the stored primal closures (node.call)
+    and differentiate it with jax.vjp *through `apply`*, so the returned
+    gradients carry a fresh tape and support further .backward()/grad().
+    """
+    from .tensor import apply as _apply
+
+    nodes = _collect_nodes(outputs)
+    fwd_nodes = list(reversed(nodes))          # ascending id = forward order
+    input_pos = {id(t): i for i, t in enumerate(inputs)}
+
+    # Usedness check (paddle raises on structurally-unused inputs).
+    used = set()
+    for n in fwd_nodes:
+        for inp in n.inputs:
+            if id(inp) in input_pos:
+                used.add(id(inp))
+    for o in outputs:
+        if id(o) in input_pos:
+            used.add(id(o))
+    if not allow_unused:
+        for t in inputs:
+            if id(t) not in used:
+                raise InvalidArgumentError(
+                    f"Input tensor {t.name} is unused in the graph "
+                    "(pass allow_unused=True to get None)")
+
+    def replay(*in_arrays):
+        env = {}
+
+        def val(t):
+            if id(t) in input_pos:
+                return in_arrays[input_pos[id(t)]]
+            if t._node is not None and (t._node.id, t._out_idx) in env:
+                return env[(t._node.id, t._out_idx)]
+            return t._data
+
+        for n in fwd_nodes:
+            out = n.call(*[val(i) for i in n.inputs])
+            leaves = out if isinstance(out, (tuple, list)) else (out,)
+            for i, leaf in enumerate(leaves):
+                env[(n.id, i)] = leaf
+        return tuple(val(o) for o in outputs)
+
+    def pullback(*in_arrays):
+        _, vjp_fn = jax.vjp(replay, *in_arrays)
+        return tuple(vjp_fn(tuple(seeds)))
+
+    grads = _apply(pullback, *inputs, op_name="grad")
+    if not isinstance(grads, tuple):
+        grads = (grads,)
+    return [g if id(t) in used else None
+            for t, g in zip(inputs, grads)]
+
+
+def _as_list(x):
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]

@@ -1,0 +1,83 @@
+"""Enforce-style error machinery.
+
+TPU-native analog of PADDLE_ENFORCE* and the error-code taxonomy in
+/root/reference/paddle/fluid/platform/{enforce.h,errors.h,error_codes.proto}.
+Python-level because the hot path on TPU is compiled by XLA — shape/type
+validation happens at trace time, where Python exceptions are idiomatic.
+"""
+from __future__ import annotations
+
+
+class EnforceNotMet(RuntimeError):
+    """Base error, mirrors platform::EnforceNotMet."""
+
+    code = "LEGACY"
+
+
+class InvalidArgumentError(EnforceNotMet, ValueError):
+    code = "INVALID_ARGUMENT"
+
+
+class NotFoundError(EnforceNotMet, KeyError):
+    code = "NOT_FOUND"
+
+
+class OutOfRangeError(EnforceNotMet, IndexError):
+    code = "OUT_OF_RANGE"
+
+
+class AlreadyExistsError(EnforceNotMet):
+    code = "ALREADY_EXISTS"
+
+
+class ResourceExhaustedError(EnforceNotMet, MemoryError):
+    code = "RESOURCE_EXHAUSTED"
+
+
+class PreconditionNotMetError(EnforceNotMet):
+    code = "PRECONDITION_NOT_MET"
+
+
+class PermissionDeniedError(EnforceNotMet, PermissionError):
+    code = "PERMISSION_DENIED"
+
+
+class ExecutionTimeoutError(EnforceNotMet, TimeoutError):
+    code = "EXECUTION_TIMEOUT"
+
+
+class UnimplementedError(EnforceNotMet, NotImplementedError):
+    code = "UNIMPLEMENTED"
+
+
+class UnavailableError(EnforceNotMet):
+    code = "UNAVAILABLE"
+
+
+class FatalError(EnforceNotMet):
+    code = "FATAL"
+
+
+class ExternalError(EnforceNotMet):
+    code = "EXTERNAL"
+
+
+def enforce(cond, msg="", exc=InvalidArgumentError):
+    """PADDLE_ENFORCE equivalent: raise `exc` with `msg` when cond is falsy."""
+    if not cond:
+        raise exc(msg)
+
+
+def enforce_eq(a, b, msg="", exc=InvalidArgumentError):
+    if a != b:
+        raise exc(f"{msg} (expected {a!r} == {b!r})")
+
+
+def enforce_gt(a, b, msg="", exc=InvalidArgumentError):
+    if not a > b:
+        raise exc(f"{msg} (expected {a!r} > {b!r})")
+
+
+def enforce_ge(a, b, msg="", exc=InvalidArgumentError):
+    if not a >= b:
+        raise exc(f"{msg} (expected {a!r} >= {b!r})")

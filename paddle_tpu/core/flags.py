@@ -1,0 +1,114 @@
+"""Global flag registry.
+
+TPU-native analog of the gflags tier in
+/root/reference/paddle/fluid/platform/flags.cc (33 DEFINE sites) and the
+python getter/setter bridge /root/reference/paddle/fluid/pybind/
+global_value_getter_setter.cc. A single-process registry: flags are defined
+with a default + doc, overridable from the environment (FLAGS_xxx) and from
+`set_flags`, read with `get_flags`.
+
+XLA-level knobs are forwarded by appending to XLA_FLAGS before first device
+use; everything else is framework-local.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+from .errors import NotFoundError
+
+
+@dataclass
+class _Flag:
+    name: str
+    default: Any
+    doc: str
+    parser: Callable[[str], Any]
+    value: Any = None
+    on_change: Optional[Callable[[Any], None]] = None
+
+
+_REGISTRY: Dict[str, _Flag] = {}
+_LOCK = threading.Lock()
+
+
+def _parse_bool(s):
+    if isinstance(s, bool):
+        return s
+    return str(s).lower() in ("1", "true", "yes", "on")
+
+
+def define_flag(name, default, doc="", parser=None, on_change=None):
+    """DEFINE_xxx equivalent. Environment FLAGS_<name> overrides the default."""
+    if parser is None:
+        if isinstance(default, bool):
+            parser = _parse_bool
+        elif isinstance(default, int):
+            parser = int
+        elif isinstance(default, float):
+            parser = float
+        else:
+            parser = str
+    value = default
+    env = os.environ.get("FLAGS_" + name)
+    if env is not None:
+        value = parser(env)
+    with _LOCK:
+        _REGISTRY[name] = _Flag(name, default, doc, parser, value, on_change)
+    return value
+
+
+def get_flags(flags):
+    """paddle.get_flags parity: accepts a name or list of names."""
+    single = isinstance(flags, str)
+    names = [flags] if single else list(flags)
+    out = {}
+    for n in names:
+        key = n[6:] if n.startswith("FLAGS_") else n
+        if key not in _REGISTRY:
+            raise NotFoundError(f"Flag {n!r} is not defined")
+        out[n] = _REGISTRY[key].value
+    return out[flags] if single else out
+
+
+def set_flags(flags: Dict[str, Any]):
+    """paddle.set_flags parity."""
+    for n, v in flags.items():
+        key = n[6:] if n.startswith("FLAGS_") else n
+        if key not in _REGISTRY:
+            raise NotFoundError(f"Flag {n!r} is not defined")
+        f = _REGISTRY[key]
+        f.value = f.parser(v) if isinstance(v, str) else v
+        if f.on_change is not None:
+            f.on_change(f.value)
+
+
+def all_flags():
+    return {n: f.value for n, f in _REGISTRY.items()}
+
+
+# ---------------------------------------------------------------------------
+# Framework flags (the subset of platform/flags.cc that is meaningful on TPU,
+# plus TPU-specific ones).
+# ---------------------------------------------------------------------------
+define_flag("check_nan_inf", False,
+            "Scan op outputs for NaN/Inf in eager mode (flags.cc:44 analog).")
+define_flag("benchmark", False, "Sync + time every eager op.")
+define_flag("eager_delete_tensor_gb", 0.0,
+            "Kept for API parity; XLA owns buffer lifetime on TPU.")
+define_flag("allocator_strategy", "xla",
+            "Kept for API parity; PJRT/XLA own device memory on TPU.")
+define_flag("fraction_of_gpu_memory_to_use", 0.92,
+            "Parity alias for per-chip HBM headroom fraction.")
+define_flag("use_pallas_attention", True,
+            "Use the Pallas flash-attention kernel when applicable.")
+define_flag("amp_dtype", "bfloat16",
+            "Reduced precision dtype for AMP (bf16 is MXU native).")
+define_flag("cudnn_deterministic", False,
+            "Parity alias: forces deterministic reductions where we control them.")
+define_flag("max_inplace_grad_add", 0,
+            "Parity flag from flags.cc; unused (functional grads).")
+define_flag("tpu_matmul_precision", "default",
+            "jax.lax matmul precision: default|high|highest.")

@@ -1,0 +1,80 @@
+"""Seeded RNG built on jax's splittable PRNG.
+
+TPU-native analog of /root/reference/paddle/fluid/framework/generator.cc and
+pybind/generator_py.cc (global + per-device generators). The reference keeps
+stateful Philox generators per device; on TPU the idiomatic design is a
+*splittable functional* key — we keep a small stateful wrapper so eager code
+gets fresh randomness per call (dygraph parity) while jitted code threads keys
+explicitly (`split_key`).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import numpy as np
+
+
+class Generator:
+    """Stateful wrapper over a jax PRNG key chain."""
+
+    def __init__(self, seed: int = 0):
+        self._lock = threading.Lock()
+        self.manual_seed(seed)
+
+    def manual_seed(self, seed: int):
+        self._seed = int(seed)
+        self._key = jax.random.key(self._seed)
+        self._offset = 0
+        return self
+
+    @property
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def next_key(self):
+        """Return a fresh key; advances internal state (eager use only)."""
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+            self._offset += 1
+            return sub
+
+    def get_state(self):
+        return {"seed": self._seed, "offset": self._offset}
+
+    def set_state(self, state):
+        self.manual_seed(state["seed"])
+        # Replay the chain to the recorded offset.
+        for _ in range(state["offset"]):
+            self._key, _ = jax.random.split(self._key)
+        self._offset = state["offset"]
+
+
+_default_generator = Generator(np.random.randint(0, 2**31 - 1))
+
+
+def seed(s: int):
+    """paddle.seed parity: reseed the global generator (and numpy for loaders)."""
+    _default_generator.manual_seed(s)
+    np.random.seed(s % (2**32))
+    return _default_generator
+
+
+def default_generator() -> Generator:
+    return _default_generator
+
+
+def next_key():
+    return _default_generator.next_key()
+
+
+def split_key(key, num: int = 2):
+    return jax.random.split(key, num)
+
+
+def get_rng_state():
+    return _default_generator.get_state()
+
+
+def set_rng_state(state):
+    _default_generator.set_state(state)

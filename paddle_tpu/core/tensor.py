@@ -1,0 +1,446 @@
+"""Eager Tensor + tape autograd on a functional substrate.
+
+TPU-native redesign of the reference's imperative engine:
+  * VarBase / VariableWrapper       -> Tensor (wraps an immutable jax.Array)
+  * Tracer::TraceOp + GradOpMaker   -> `apply()` records a TapeNode holding the
+    op's vjp closure obtained from jax.vjp at forward time
+    (/root/reference/paddle/fluid/imperative/tracer.cc:132 created grad *descs*;
+    here jax gives us the exact cotangent function directly)
+  * BasicEngine (basic_engine.cc:39,:278) -> `backward()`: reverse-creation-order
+    sweep over reachable TapeNodes with cotangent accumulation
+    (gradient_accumulator.cc analog is a jnp add)
+  * partial_grad_engine.cc          -> `grad()` in autograd.py
+  * hooks.h                         -> Tensor.register_hook
+
+Design note (why this is not a port): the reference needs per-op grad kernels
+and a C++ engine because torch-style eager is its only fast path. Here eager
+is the *debug/UX* path; the fast path is functional (`functional_call` +
+jax.grad + jit), so the tape only has to be correct, not fast. Everything the
+tape does is jax-traceable, so eager code also works inside `jax.jit`.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtype as dtype_mod
+from . import place as place_mod
+from .errors import InvalidArgumentError, enforce
+from .flags import get_flags
+
+# ---------------------------------------------------------------------------
+# Grad mode (thread-local), paddle.no_grad parity.
+# ---------------------------------------------------------------------------
+_state = threading.local()
+
+
+def is_grad_enabled() -> bool:
+    return getattr(_state, "grad_enabled", True)
+
+
+def set_grad_enabled(mode: bool):
+    _state.grad_enabled = bool(mode)
+
+
+class no_grad:
+    """Context manager & decorator disabling tape recording."""
+
+    def __enter__(self):
+        self._prev = is_grad_enabled()
+        set_grad_enabled(False)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._prev)
+        return False
+
+    def __call__(self, fn):
+        def wrapper(*args, **kwargs):
+            with no_grad():
+                return fn(*args, **kwargs)
+
+        wrapper.__name__ = getattr(fn, "__name__", "wrapped")
+        return wrapper
+
+
+class enable_grad(no_grad):
+    def __enter__(self):
+        self._prev = is_grad_enabled()
+        set_grad_enabled(True)
+        return self
+
+
+# ---------------------------------------------------------------------------
+# Tape
+# ---------------------------------------------------------------------------
+_node_counter = [0]
+_node_lock = threading.Lock()
+
+
+class TapeNode:
+    """One recorded op: holds the vjp closure, the primal closure (for
+    higher-order grad via functional replay), and graph edges."""
+
+    __slots__ = ("id", "vjp_fn", "call", "inputs", "out_avals", "n_outputs",
+                 "tuple_out", "name")
+
+    def __init__(self, vjp_fn, call, inputs, out_avals, name="", tuple_out=False):
+        with _node_lock:
+            _node_counter[0] += 1
+            self.id = _node_counter[0]
+        self.vjp_fn = vjp_fn
+        self.call = call                # primal: (*diff_arrays) -> out
+        self.inputs = inputs            # list[Tensor] — differentiable inputs
+        self.out_avals = out_avals      # list[(shape, dtype)]
+        self.n_outputs = len(out_avals)
+        self.tuple_out = tuple_out
+        self.name = name
+
+
+_tensor_counter = [0]
+
+
+def _next_name(prefix="tensor"):
+    _tensor_counter[0] += 1
+    return f"{prefix}_{_tensor_counter[0]}"
+
+
+class Tensor:
+    """Eager tensor: immutable jax.Array value + mutable framework metadata."""
+
+    __slots__ = ("_data", "_stop_gradient", "grad", "_node", "_out_idx",
+                 "name", "persistable", "_hooks", "_retain_grads", "trainable",
+                 "__weakref__")
+
+    def __init__(self, data, dtype=None, place=None, stop_gradient=True,
+                 name=None, persistable=False):
+        if isinstance(data, Tensor):
+            data = data._data
+        if not isinstance(data, jax.Array) or dtype is not None:
+            np_dtype = dtype_mod.convert_dtype(dtype)
+            if isinstance(data, (bool, int)) and np_dtype is None:
+                data = jnp.asarray(data)
+            elif isinstance(data, float) and np_dtype is None:
+                data = jnp.asarray(data, dtype_mod.get_default_dtype())
+            else:
+                if (np_dtype is None and isinstance(data, np.ndarray)
+                        and data.dtype == np.float64):
+                    np_dtype = dtype_mod.get_default_dtype()
+                data = jnp.asarray(data, np_dtype)
+        dev = place_mod._place_to_jax_device(place)
+        if dev is not None and not _is_tracer(data):
+            data = jax.device_put(data, dev)
+        self._data = data
+        self._stop_gradient = bool(stop_gradient)
+        self.grad = None
+        self._node = None
+        self._out_idx = 0
+        self.name = name or _next_name()
+        self.persistable = persistable
+        self._hooks = []
+        self._retain_grads = False
+        self.trainable = True
+
+    # -- value access -------------------------------------------------------
+    @property
+    def data(self):
+        return self
+
+    @property
+    def value(self):
+        return self._data
+
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def place(self):
+        if _is_tracer(self._data):
+            return place_mod.get_default_place()
+        d = self._data.devices().pop()
+        return place_mod.CPUPlace() if d.platform == "cpu" else place_mod.TPUPlace(d.id)
+
+    @property
+    def stop_gradient(self):
+        return self._stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, v):
+        self._stop_gradient = bool(v)
+
+    def numpy(self):
+        return np.asarray(self._data)
+
+    def item(self, *args):
+        if args:
+            return self.numpy().item(*args)
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def astype(self, dtype):
+        from .. import ops
+        return ops.cast(self, dtype)
+
+    cast = astype
+
+    def clone(self):
+        return apply(lambda x: x + 0, self)
+
+    def detach(self):
+        t = Tensor(self._data, stop_gradient=True, name=self.name + ".detach")
+        return t
+
+    def detach_(self):
+        self._node = None
+        self._stop_gradient = True
+        return self
+
+    def to(self, place=None, dtype=None):
+        t = self
+        if dtype is not None:
+            t = t.astype(dtype)
+        if place is not None:
+            dev = place_mod._place_to_jax_device(place)
+            t = Tensor(jax.device_put(t._data, dev), stop_gradient=t.stop_gradient)
+        return t
+
+    def cpu(self):
+        return self.to(place_mod.CPUPlace())
+
+    def tpu(self, idx=0):
+        return self.to(place_mod.TPUPlace(idx))
+
+    cuda = tpu
+
+    def pin_memory(self):
+        return self
+
+    # -- mutation-looking API (framework metadata only; value swap) ---------
+    def set_value(self, value):
+        """In-place value replacement (parameters/optimizer use this)."""
+        if isinstance(value, Tensor):
+            value = value._data
+        value = jnp.asarray(value, self._data.dtype)
+        enforce(tuple(value.shape) == tuple(self._data.shape),
+                f"set_value shape mismatch {value.shape} vs {self._data.shape}")
+        self._data = value
+        return self
+
+    def copy_(self, other):
+        return self.set_value(other)
+
+    def fill_(self, v):
+        self._data = jnp.full_like(self._data, v)
+        return self
+
+    def zero_(self):
+        return self.fill_(0)
+
+    def scale_(self, v):
+        self._data = self._data * v
+        return self
+
+    # -- autograd -----------------------------------------------------------
+    @property
+    def is_leaf(self):
+        return self._node is None
+
+    def retain_grads(self):
+        self._retain_grads = True
+
+    def register_hook(self, hook: Callable):
+        self._hooks.append(hook)
+
+        class _Handle:
+            def remove(_s):
+                if hook in self._hooks:
+                    self._hooks.remove(hook)
+
+        return _Handle()
+
+    def clear_grad(self):
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    def backward(self, grad_tensor=None, retain_graph=False):
+        from .autograd import run_backward
+        run_backward(self, grad_tensor, retain_graph)
+
+    @property
+    def gradient(self):
+        return None if self.grad is None else self.grad.numpy()
+
+    # -- python protocol ----------------------------------------------------
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __bool__(self):
+        return bool(self._data)
+
+    def __int__(self):
+        return int(self._data)
+
+    def __float__(self):
+        return float(self._data)
+
+    def __index__(self):
+        return int(self._data)
+
+    def __format__(self, spec):
+        if self.ndim == 0:
+            return format(self.item(), spec)
+        return str(self)
+
+    def __repr__(self):
+        grad_s = "" if self._stop_gradient else ", stop_gradient=False"
+        return (f"Tensor(shape={self.shape}, dtype={dtype_mod.dtype_name(self.dtype)}"
+                f"{grad_s},\n       {np.asarray(self._data) if not _is_tracer(self._data) else self._data!r})")
+
+    def __getitem__(self, idx):
+        idx = _convert_index(idx)
+        return apply(lambda x: x[idx], self, op_name="slice")
+
+    def __setitem__(self, idx, value):
+        idx = _convert_index(idx)
+        if isinstance(value, Tensor):
+            value = value._data
+        self._data = self._data.at[idx].set(value)
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._data)
+        return a.astype(dtype) if dtype is not None else a
+
+    def __dlpack__(self, *a, **k):
+        return self._data.__dlpack__(*a, **k)
+
+    # dim/rank parity helpers
+    def dim(self):
+        return self.ndim
+
+    def rank(self):
+        return self.ndim
+
+    def numel(self):
+        return self.size
+
+    def element_size(self):
+        return np.dtype(self.dtype).itemsize
+
+    # arithmetic dunders are attached by ops._bind to avoid circular imports
+
+
+def _is_tracer(x):
+    return isinstance(x, jax.core.Tracer)
+
+
+def _convert_index(idx):
+    def conv(i):
+        if isinstance(i, Tensor):
+            return i._data
+        return i
+    if isinstance(idx, tuple):
+        return tuple(conv(i) for i in idx)
+    return conv(idx)
+
+
+def _differentiable(t: Tensor) -> bool:
+    return (not t._stop_gradient
+            and jnp.issubdtype(t.dtype, jnp.inexact))
+
+
+# ---------------------------------------------------------------------------
+# Op dispatch: the Tracer::TraceOp analog.
+# ---------------------------------------------------------------------------
+
+def apply(fn, *args, op_name: str = None, n_outputs: int = None, **kwargs):
+    """Run `fn` on raw arrays, wrapping outputs as Tensors and recording a
+    TapeNode when grad is required.
+
+    `fn` is called as fn(*raw_args, **kwargs) where Tensor args are replaced
+    by their jax.Array payloads. Differentiation is w.r.t. inexact-dtype
+    Tensor args with stop_gradient=False.
+    """
+    raw = [a._data if isinstance(a, Tensor) else a for a in args]
+    diff_pos = [i for i, a in enumerate(args)
+                if isinstance(a, Tensor) and _differentiable(a)] \
+        if is_grad_enabled() else []
+
+    if not diff_pos:
+        out = fn(*raw, **kwargs)
+        return _wrap_outputs(out, None)
+
+    def call(*diff_arrays):
+        full = list(raw)
+        for p, arr in zip(diff_pos, diff_arrays):
+            full[p] = arr
+        return fn(*full, **kwargs)
+
+    out, vjp_fn = jax.vjp(call, *[raw[p] for p in diff_pos])
+
+    leaves = out if isinstance(out, (tuple, list)) else (out,)
+    out_avals = [(tuple(l.shape), l.dtype) for l in leaves]
+    node = TapeNode(vjp_fn, call, [args[p] for p in diff_pos], out_avals,
+                    name=op_name or getattr(fn, "__name__", "op"),
+                    tuple_out=isinstance(out, (tuple, list)))
+    result = _wrap_outputs(out, node)
+
+    if get_flags("check_nan_inf"):
+        _check_nan_inf(result, node.name)
+    return result
+
+
+def _wrap_outputs(out, node):
+    if isinstance(out, (tuple, list)):
+        ts = []
+        for i, leaf in enumerate(out):
+            t = Tensor(leaf, stop_gradient=(node is None))
+            t._node = node
+            t._out_idx = i
+            ts.append(t)
+        return tuple(ts)
+    t = Tensor(out, stop_gradient=(node is None))
+    t._node = node
+    t._out_idx = 0
+    return t
+
+
+def _check_nan_inf(result, name):
+    ts = result if isinstance(result, tuple) else (result,)
+    for t in ts:
+        if _is_tracer(t._data):
+            return
+        if jnp.issubdtype(t.dtype, jnp.inexact) and not bool(jnp.isfinite(t._data).all()):
+            raise FloatingPointError(
+                f"NaN/Inf detected in output of op '{name}' "
+                f"(FLAGS_check_nan_inf, nan_inf_utils_detail analog)")
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor parity."""
+    return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)

@@ -1,0 +1,22 @@
+"""Functional tensor-op library.
+
+TPU-native analog of /root/reference/python/paddle/tensor/ (~170 public
+functions: math/linalg/manipulation/creation/random/search/stat/logic). The
+reference routes each through a registered C++ op + CUDA kernel; here each op
+is a jnp/lax expression dispatched through the eager tape (`core.tensor.apply`)
+— XLA owns fusion and kernel selection, which subsumes the reference's
+operators/math functor library (SURVEY.md rows 57/58).
+"""
+from ..core.tensor import Tensor, to_tensor, apply, no_grad, enable_grad, is_grad_enabled
+
+from .creation import *       # noqa: F401,F403
+from .math import *           # noqa: F401,F403
+from .manipulation import *   # noqa: F401,F403
+from .linalg import *         # noqa: F401,F403
+from .logic import *          # noqa: F401,F403
+from .random import *         # noqa: F401,F403
+from .search import *         # noqa: F401,F403
+from .stat import *           # noqa: F401,F403
+from .einsum import einsum    # noqa: F401
+
+from . import _bind           # noqa: F401  (attaches Tensor methods/dunders)

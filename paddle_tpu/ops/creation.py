@@ -1,0 +1,158 @@
+"""Creation ops (reference: python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtype_mod
+from ..core.tensor import Tensor, apply
+
+__all__ = [
+    "zeros", "ones", "full", "zeros_like", "ones_like", "full_like",
+    "arange", "linspace", "logspace", "eye", "empty", "empty_like", "tril",
+    "triu", "diag", "diagflat", "meshgrid", "assign", "clone", "numel",
+    "complex_", "as_tensor",
+]
+
+
+def _dt(dtype, default=None):
+    d = dtype_mod.convert_dtype(dtype)
+    if d is None:
+        d = default if default is not None else dtype_mod.get_default_dtype()
+    return d
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+def zeros(shape, dtype=None):
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None):
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None and isinstance(fill_value, (bool, int)):
+        return Tensor(jnp.full(_shape(shape), fill_value))
+    return Tensor(jnp.full(_shape(shape), fill_value, _dt(dtype)))
+
+
+def zeros_like(x, dtype=None):
+    return apply(lambda a: jnp.zeros_like(a, dtype=dtype_mod.convert_dtype(dtype)), x)
+
+
+def ones_like(x, dtype=None):
+    return apply(lambda a: jnp.ones_like(a, dtype=dtype_mod.convert_dtype(dtype)), x)
+
+
+def full_like(x, fill_value, dtype=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    return apply(lambda a: jnp.full_like(a, fill_value, dtype=dtype_mod.convert_dtype(dtype)), x)
+
+
+def empty(shape, dtype=None):
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None):
+    def val(v):
+        return v.item() if isinstance(v, Tensor) else v
+    start, end, step = val(start), val(end), val(step)
+    if end is None:
+        start, end = 0, start
+    d = dtype_mod.convert_dtype(dtype)
+    if d is None:
+        if all(isinstance(v, (int, np.integer)) for v in (start, end, step)):
+            d = jnp.int64
+        else:
+            d = dtype_mod.get_default_dtype()
+    return Tensor(jnp.arange(start, end, step, dtype=d))
+
+
+def linspace(start, stop, num, dtype=None):
+    def val(v):
+        return v.item() if isinstance(v, Tensor) else v
+    return Tensor(jnp.linspace(val(start), val(stop), int(val(num)),
+                               dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None):
+    return Tensor(jnp.logspace(start, stop, int(num), base=base, dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None):
+    return Tensor(jnp.eye(int(num_rows),
+                          None if num_columns is None else int(num_columns),
+                          dtype=_dt(dtype)))
+
+
+def tril(x, diagonal=0):
+    return apply(lambda a: jnp.tril(a, k=diagonal), x)
+
+
+def triu(x, diagonal=0):
+    return apply(lambda a: jnp.triu(a, k=diagonal), x)
+
+
+def diag(x, offset=0, padding_value=0):
+    def f(a):
+        if a.ndim == 1 and padding_value != 0:
+            d = jnp.diag(a, k=offset)
+            mask = jnp.eye(d.shape[0], dtype=bool) if offset == 0 else \
+                jnp.diag(jnp.ones_like(a, dtype=bool), k=offset)
+            return jnp.where(mask, d, padding_value)
+        return jnp.diag(a, k=offset)
+    return apply(f, x)
+
+
+def diagflat(x, offset=0):
+    return apply(lambda a: jnp.diagflat(a, k=offset), x)
+
+
+def meshgrid(*args):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    return apply(lambda *xs: tuple(jnp.meshgrid(*xs, indexing="ij")), *args)
+
+
+def assign(x, output=None):
+    src = x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+    out = apply(lambda a: a + 0 if jnp.issubdtype(a.dtype, jnp.inexact) else a,
+                src, op_name="assign")
+    if output is not None:
+        output.set_value(out._data)
+        return output
+    return out
+
+
+def clone(x):
+    return x.clone()
+
+
+def numel(x):
+    return Tensor(jnp.asarray(x.size, jnp.int64))
+
+
+def complex_(real, imag):
+    return apply(lambda r, i: jax.lax.complex(r, i), real, imag)
+
+
+def as_tensor(data, dtype=None, place=None):
+    return Tensor(data, dtype=dtype, place=place)
+
+
+import jax  # noqa: E402  (used by complex_)

@@ -1,0 +1,12 @@
+"""einsum (reference: python/paddle/tensor/einsum.py) — direct jnp lowering,
+MXU-friendly via dot_general."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import apply
+
+
+def einsum(equation, *operands):
+    return apply(lambda *xs: jnp.einsum(equation, *xs), *operands,
+                 op_name="einsum")

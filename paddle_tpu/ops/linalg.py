@@ -1,0 +1,233 @@
+"""Linear-algebra ops (reference: python/paddle/tensor/linalg.py; kernels in
+paddle/fluid/operators/{matmul_op.*,math/blas.h}). Matmuls feed the MXU: we
+keep them batched and let `tpu_matmul_precision` control lax precision."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.flags import get_flags
+from ..core.tensor import Tensor, apply
+
+__all__ = [
+    "matmul", "mm", "bmm", "dot", "t", "norm", "dist", "cholesky", "inv",
+    "det", "slogdet", "svd", "qr", "eig", "eigh", "eigvals", "eigvalsh",
+    "solve", "triangular_solve", "cholesky_solve", "matrix_power", "pinv",
+    "cross", "histogram", "bincount", "mv", "matrix_rank", "lu", "lstsq",
+    "multi_dot", "cov", "corrcoef", "rank",
+]
+
+
+def _precision():
+    p = get_flags("tpu_matmul_precision")
+    return None if p == "default" else p
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b, precision=_precision())
+    return apply(f, x, y, op_name="matmul")
+
+
+def mm(input, mat2, name=None):
+    return matmul(input, mat2)
+
+
+def bmm(x, y, name=None):
+    return matmul(x, y)
+
+
+def mv(x, vec, name=None):
+    return apply(lambda a, b: jnp.matmul(a, b, precision=_precision()), x, vec)
+
+
+def dot(x, y, name=None):
+    def f(a, b):
+        return jnp.sum(a * b, axis=-1)
+    return apply(f, x, y, op_name="dot")
+
+
+def t(input, name=None):
+    return apply(lambda a: a.T if a.ndim >= 2 else a, input)
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    def f(a):
+        if p == "fro" and (axis is None or isinstance(axis, (list, tuple))):
+            ax = tuple(axis) if axis is not None else None
+            return jnp.sqrt(jnp.sum(a * a, axis=ax, keepdims=keepdim))
+        if p == "nuc":
+            return jnp.sum(jnp.linalg.svd(a, compute_uv=False), axis=-1)
+        pp = float("inf") if p == "inf" else (float("-inf") if p == "-inf" else p)
+        if axis is None:
+            return jnp.linalg.norm(a.reshape(-1), ord=pp, keepdims=keepdim)
+        if isinstance(axis, (list, tuple)):
+            return jnp.linalg.norm(a, ord=pp, axis=tuple(axis), keepdims=keepdim)
+        if pp == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=axis, keepdims=keepdim)
+        if pp == float("inf"):
+            return jnp.max(jnp.abs(a), axis=axis, keepdims=keepdim)
+        if pp == float("-inf"):
+            return jnp.min(jnp.abs(a), axis=axis, keepdims=keepdim)
+        return jnp.sum(jnp.abs(a) ** pp, axis=axis, keepdims=keepdim) ** (1.0 / pp)
+    return apply(f, x, op_name="norm")
+
+
+def dist(x, y, p=2, name=None):
+    def f(a, b):
+        d = (a - b).reshape(-1)
+        if p == 0:
+            return jnp.sum((d != 0).astype(d.dtype))
+        if p == float("inf"):
+            return jnp.max(jnp.abs(d))
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(d))
+        return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+    return apply(f, x, y, op_name="dist")
+
+
+def cholesky(x, upper=False, name=None):
+    def f(a):
+        L = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(L, -1, -2) if upper else L
+    return apply(f, x)
+
+
+def inv(x, name=None):
+    return apply(jnp.linalg.inv, x)
+
+
+def det(x, name=None):
+    return apply(jnp.linalg.det, x)
+
+
+def slogdet(x, name=None):
+    def f(a):
+        sign, logdet = jnp.linalg.slogdet(a)
+        return jnp.stack([sign, logdet], axis=0)
+    return apply(f, x)
+
+
+def svd(x, full_matrices=False, name=None):
+    return apply(lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)), x,
+                 op_name="svd")
+
+
+def qr(x, mode="reduced", name=None):
+    out = apply(lambda a: tuple(jnp.linalg.qr(a, mode=mode)) if mode != "r"
+                else (jnp.linalg.qr(a, mode="r"),), x, op_name="qr")
+    return out if isinstance(out, tuple) and len(out) > 1 else out[0]
+
+
+def eig(x, name=None):
+    import numpy as np
+    w, v = np.linalg.eig(x.numpy())
+    return Tensor(w), Tensor(v)
+
+
+def eigvals(x, name=None):
+    import numpy as np
+    return Tensor(np.linalg.eigvals(x.numpy()))
+
+
+def eigh(x, UPLO="L", name=None):
+    return apply(lambda a: tuple(jnp.linalg.eigh(a, symmetrize_input=False)), x,
+                 op_name="eigh")
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply(lambda a: jnp.linalg.eigvalsh(a), x)
+
+
+def solve(x, y, name=None):
+    return apply(jnp.linalg.solve, x, y)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def f(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+    return apply(f, x, y, op_name="triangular_solve")
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def f(b, L):
+        return jax.scipy.linalg.cho_solve((L, not upper), b)
+    return apply(f, x, y, op_name="cholesky_solve")
+
+
+def matrix_power(x, n, name=None):
+    return apply(lambda a: jnp.linalg.matrix_power(a, n), x)
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply(lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian), x)
+
+
+def cross(x, y, axis=9, name=None):
+    def f(a, b):
+        ax = axis
+        if ax == 9:  # paddle default: first axis with dim 3
+            ax = next(i for i, s in enumerate(a.shape) if s == 3)
+        return jnp.cross(a, b, axis=ax)
+    return apply(f, x, y, op_name="cross")
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    a = input.numpy().reshape(-1)
+    lo, hi = (min, max) if (min != 0 or max != 0) else (a.min(), a.max())
+    import numpy as np
+    hist, _ = np.histogram(a, bins=bins, range=(lo, hi))
+    return Tensor(hist.astype(np.int64))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    if weights is None:
+        return apply(lambda a: jnp.bincount(a, minlength=minlength,
+                                            length=max(minlength, int(a.max()) + 1)), x)
+    return apply(lambda a, w: jnp.bincount(a, w, minlength=minlength,
+                                           length=max(minlength, int(a.max()) + 1)),
+                 x, weights, op_name="bincount")
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return apply(lambda a: jnp.linalg.matrix_rank(a, tol=tol), x)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def f(a):
+        lu_mat, piv = jax.scipy.linalg.lu_factor(a)
+        return lu_mat, piv
+    lu_mat, piv = apply(f, x, op_name="lu")
+    if get_infos:
+        from .creation import zeros
+        return lu_mat, piv, zeros([1], dtype="int32")
+    return lu_mat, piv
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def f(a, b):
+        sol, res, rk, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rk, sv
+    return apply(f, x, y, op_name="lstsq")
+
+
+def multi_dot(x, name=None):
+    return apply(lambda *xs: jnp.linalg.multi_dot(xs), *x, op_name="multi_dot")
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return apply(lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0), x)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply(lambda a: jnp.corrcoef(a, rowvar=rowvar), x)
+
+
+def rank(input, name=None):
+    return Tensor(jnp.asarray(input.ndim, jnp.int32))

@@ -1,0 +1,411 @@
+"""Shape/layout manipulation ops (reference: python/paddle/tensor/manipulation.py)."""
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+py_slice = builtins.slice  # the module defines a paddle-style `slice` op below
+
+from ..core import dtype as dtype_mod
+from ..core.tensor import Tensor, apply
+
+__all__ = [
+    "reshape", "reshape_", "flatten", "squeeze", "unsqueeze", "transpose",
+    "concat", "stack", "split", "chunk", "tile", "expand", "expand_as",
+    "broadcast_to", "broadcast_shape", "flip", "reverse", "roll", "gather",
+    "gather_nd", "scatter", "scatter_nd", "scatter_nd_add", "index_select",
+    "index_add", "slice", "strided_slice", "unique", "unique_consecutive",
+    "unbind", "cast", "pad", "repeat_interleave", "take_along_axis",
+    "put_along_axis", "rot90", "unstack", "moveaxis", "swapaxes", "tensordot",
+    "as_real", "as_complex", "view", "view_as", "crop", "tolist",
+    "atleast_1d", "atleast_2d", "atleast_3d", "stride_check",
+]
+
+
+def _shape_arg(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    out = []
+    for s in shape:
+        out.append(int(s.item()) if isinstance(s, Tensor) else int(s))
+    return tuple(out)
+
+
+def reshape(x, shape, name=None):
+    shape = _shape_arg(shape)
+    return apply(lambda a: jnp.reshape(a, shape), x)
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    x._data = out._data
+    x._node = out._node
+    x._out_idx = out._out_idx
+    return x
+
+
+view = reshape
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def f(a):
+        nd = a.ndim
+        s = start_axis % nd if nd else 0
+        e = stop_axis % nd if nd else 0
+        new_shape = a.shape[:s] + (-1,) + a.shape[e + 1:]
+        return jnp.reshape(a, new_shape)
+    return apply(f, x)
+
+
+def squeeze(x, axis=None, name=None):
+    def f(a):
+        if axis is None:
+            return jnp.squeeze(a)
+        ax = axis if isinstance(axis, (list, tuple)) else [axis]
+        ax = tuple(i % a.ndim for i in ax if a.shape[i % a.ndim] == 1)
+        return jnp.squeeze(a, axis=ax) if ax else a
+    return apply(f, x)
+
+
+def unsqueeze(x, axis, name=None):
+    def f(a):
+        ax = axis if isinstance(axis, (list, tuple)) else [axis]
+        ax = [int(i.item()) if isinstance(i, Tensor) else int(i) for i in ax]
+        return jnp.expand_dims(a, axis=tuple(ax))
+    return apply(f, x)
+
+
+def transpose(x, perm=None, name=None):
+    if perm is not None:
+        perm = tuple(int(p) for p in perm)
+    return apply(lambda a: jnp.transpose(a, perm), x)
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply(lambda a: jnp.moveaxis(a, source, destination), x)
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return apply(lambda a: jnp.swapaxes(a, axis0, axis1), x)
+
+
+def concat(x, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return apply(lambda *xs: jnp.concatenate(xs, axis=axis), *x, op_name="concat")
+
+
+def stack(x, axis=0, name=None):
+    return apply(lambda *xs: jnp.stack(xs, axis=axis), *x, op_name="stack")
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    dim = x.shape[axis]
+    if isinstance(num_or_sections, int):
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = [int(s) if not isinstance(s, Tensor) else int(s.item())
+                 for s in num_or_sections]
+        residual = dim - sum(s for s in sizes if s >= 0)
+        sizes = [residual if s < 0 else s for s in sizes]
+    offsets = np.cumsum([0] + sizes[:-1]).tolist()
+
+    def f(a):
+        return tuple(jax.lax.dynamic_slice_in_dim(a, o, s, axis)
+                     for o, s in zip(offsets, sizes))
+    return list(apply(f, x, op_name="split"))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0, name=None):
+    n = x.shape[axis]
+
+    def f(a):
+        return tuple(jnp.squeeze(s, axis=axis)
+                     for s in jnp.split(a, n, axis=axis))
+    return list(apply(f, x, op_name="unbind"))
+
+
+unstack = unbind
+
+
+def tile(x, repeat_times, name=None):
+    reps = _shape_arg(repeat_times)
+    return apply(lambda a: jnp.tile(a, reps), x)
+
+
+def expand(x, shape, name=None):
+    shape = _shape_arg(shape)
+
+    def f(a):
+        tgt = list(shape)
+        src = list(a.shape)
+        # paddle expand: -1 keeps the original dim
+        off = len(tgt) - len(src)
+        for i in range(len(tgt)):
+            if tgt[i] == -1:
+                tgt[i] = src[i - off]
+        return jnp.broadcast_to(a, tuple(tgt))
+    return apply(f, x)
+
+
+def expand_as(x, y, name=None):
+    return expand(x, y.shape)
+
+
+def broadcast_to(x, shape, name=None):
+    return apply(lambda a: jnp.broadcast_to(a, _shape_arg(shape)), x)
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def flip(x, axis, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    return apply(lambda a: jnp.flip(a, axis=ax), x)
+
+
+def reverse(x, axis, name=None):
+    return flip(x, axis)
+
+
+def roll(x, shifts, axis=None, name=None):
+    return apply(lambda a: jnp.roll(a, shifts, axis=axis), x)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply(lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), x)
+
+
+def cast(x, dtype):
+    d = dtype_mod.convert_dtype(dtype)
+    return apply(lambda a: a.astype(d), x, op_name="cast")
+
+
+def gather(x, index, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+
+    def f(a, idx):
+        return jnp.take(a, idx.reshape(-1) if idx.ndim > 1 else idx, axis=axis)
+    return apply(f, x, index, op_name="gather")
+
+
+def gather_nd(x, index, name=None):
+    def f(a, idx):
+        ndim = idx.shape[-1]
+        idx_t = tuple(jnp.moveaxis(idx, -1, 0))
+        return a[idx_t] if ndim == a.ndim else a[idx_t + (Ellipsis,)]
+    return apply(f, x, index, op_name="gather_nd")
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def f(a, idx, upd):
+        if overwrite:
+            return a.at[idx].set(upd)
+        # paddle semantics: zero destination rows then accumulate
+        zeroed = a.at[idx].set(jnp.zeros_like(upd))
+        return zeroed.at[idx].add(upd)
+    return apply(f, x, index, updates, op_name="scatter")
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def f(a, idx, upd):
+        idx_t = tuple(jnp.moveaxis(idx, -1, 0))
+        return a.at[idx_t].add(upd)
+    return apply(f, x, index, updates, op_name="scatter_nd_add")
+
+
+def scatter_nd(index, updates, shape, name=None):
+    from .creation import zeros
+    z = zeros(shape, dtype=updates.dtype)
+    return scatter_nd_add(z, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    return apply(lambda a, idx: jnp.take(a, idx, axis=axis), x, index,
+                 op_name="index_select")
+
+
+def index_add(x, index, axis, value, name=None):
+    def f(a, idx, v):
+        return jnp.moveaxis(jnp.moveaxis(a, axis, 0).at[idx].add(
+            jnp.moveaxis(v, axis, 0)), 0, axis)
+    return apply(f, x, index, value, op_name="index_add")
+
+
+def slice(x, axes, starts, ends, name=None):
+    def val(v):
+        return int(v.item()) if isinstance(v, Tensor) else int(v)
+    axes = [val(a) for a in axes]
+    starts = [val(s) for s in starts]
+    ends = [val(e) for e in ends]
+
+    def f(a):
+        index = [py_slice(None)] * a.ndim
+        for ax, s, e in zip(axes, starts, ends):
+            index[ax] = py_slice(s, e)
+        return a[tuple(index)]
+    return apply(f, x, op_name="slice")
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    def f(a):
+        index = [py_slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            index[ax] = py_slice(s, e, st)
+        return a[tuple(index)]
+    return apply(f, x, op_name="strided_slice")
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    vals, idx, inv, cnt = np.unique(x.numpy(), return_index=True,
+                                    return_inverse=True, return_counts=True,
+                                    axis=axis)
+    out = [Tensor(vals)]
+    if return_index:
+        out.append(Tensor(idx.astype(np.int64)))
+    if return_inverse:
+        out.append(Tensor(inv.astype(np.int64)))
+    if return_counts:
+        out.append(Tensor(cnt.astype(np.int64)))
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    a = x.numpy()
+    if axis is None:
+        a = a.reshape(-1)
+    keep = np.ones(a.shape[0], dtype=bool)
+    keep[1:] = np.any(a[1:] != a[:-1], axis=tuple(range(1, a.ndim))) if a.ndim > 1 \
+        else a[1:] != a[:-1]
+    vals = a[keep]
+    out = [Tensor(vals)]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        out.append(Tensor(inv.astype(np.int64)))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        cnt = np.diff(np.append(idx, a.shape[0]))
+        out.append(Tensor(cnt.astype(np.int64)))
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    if isinstance(pad, Tensor):
+        pad = pad.tolist()
+    pad = [int(p) for p in pad]
+
+    def f(a):
+        nd = a.ndim
+        if len(pad) == 2 * nd:
+            widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+        else:
+            # paddle NCHW/NCL/NCDHW convention: pad applies to spatial dims,
+            # listed from the last dim backwards in (before, after) pairs.
+            n_spatial = len(pad) // 2
+            widths = [(0, 0)] * (nd - n_spatial)
+            spatial = [(pad[2 * i], pad[2 * i + 1]) for i in range(n_spatial)]
+            if data_format.startswith("NC"):
+                widths += spatial
+            else:  # channels-last: spatial dims precede C
+                widths = [(0, 0)] + spatial + [(0, 0)]
+        jmode = {"constant": "constant", "reflect": "reflect",
+                 "replicate": "edge", "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(a, widths, mode=jmode, constant_values=value)
+        return jnp.pad(a, widths, mode=jmode)
+    return apply(f, x)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        reps = repeats._data
+        return apply(lambda a, r: jnp.repeat(a, r, axis=axis,
+                                             total_repeat_length=int(reps.sum())),
+                     x, repeats, op_name="repeat_interleave")
+    return apply(lambda a: jnp.repeat(a, repeats, axis=axis), x)
+
+
+def take_along_axis(arr, indices, axis, name=None):
+    return apply(lambda a, i: jnp.take_along_axis(a, i, axis=axis), arr, indices,
+                 op_name="take_along_axis")
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    def f(a, i, v):
+        v = jnp.broadcast_to(v, i.shape).astype(a.dtype)
+        idx = [jnp.arange(s).reshape([-1 if d == k else 1 for d in range(i.ndim)])
+               for k, s in enumerate(i.shape)]
+        idx[axis] = i
+        if reduce == "assign":
+            return a.at[tuple(idx)].set(v)
+        if reduce == "add":
+            return a.at[tuple(idx)].add(v)
+        if reduce == "multiply":
+            return a.at[tuple(idx)].multiply(v)
+        raise ValueError(f"unknown reduce {reduce}")
+    return apply(f, arr, indices, values, op_name="put_along_axis")
+
+
+def tensordot(x, y, axes=2, name=None):
+    return apply(lambda a, b: jnp.tensordot(a, b, axes=axes), x, y)
+
+
+def as_real(x, name=None):
+    def f(a):
+        return jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1)
+    return apply(f, x)
+
+
+def as_complex(x, name=None):
+    return apply(lambda a: jax.lax.complex(a[..., 0], a[..., 1]), x)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    shape = _shape_arg(shape)
+    offsets = [0] * len(shape) if offsets is None else \
+        [int(o.item()) if isinstance(o, Tensor) else int(o) for o in offsets]
+
+    def f(a):
+        index = tuple(py_slice(o, o + s) for o, s in zip(offsets, shape))
+        return a[index]
+    return apply(f, x)
+
+
+def tolist(x):
+    return x.tolist()
+
+
+def atleast_1d(*xs):
+    out = [apply(jnp.atleast_1d, x) for x in xs]
+    return out[0] if len(out) == 1 else out
+
+
+def atleast_2d(*xs):
+    out = [apply(jnp.atleast_2d, x) for x in xs]
+    return out[0] if len(out) == 1 else out
+
+
+def atleast_3d(*xs):
+    out = [apply(jnp.atleast_3d, x) for x in xs]
+    return out[0] if len(out) == 1 else out
+
+
+def stride_check(*_a, **_k):
+    raise NotImplementedError("strides are not observable under XLA")

@@ -1,0 +1,267 @@
+"""Elementwise + reduction math ops (reference: python/paddle/tensor/math.py,
+kernels in paddle/fluid/operators/elementwise/ and reduce_ops/)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply
+
+__all__ = [
+    "add", "subtract", "multiply", "divide", "floor_divide", "remainder",
+    "mod", "floor_mod", "pow", "sqrt", "rsqrt", "square", "exp", "expm1",
+    "log", "log2", "log10", "log1p", "abs", "neg", "sign", "floor", "ceil",
+    "round", "trunc", "sin", "cos", "tan", "asin", "acos", "atan", "sinh",
+    "cosh", "tanh", "asinh", "acosh", "atanh", "reciprocal", "clip",
+    "maximum", "minimum", "fmax", "fmin", "max", "min", "amax", "amin",
+    "sum", "nansum", "mean", "nanmean", "prod", "cumsum", "cumprod",
+    "logsumexp", "logcumsumexp", "add_n", "scale", "stanh", "erf", "erfinv",
+    "lgamma", "digamma", "atan2", "isnan", "isinf", "isfinite", "nan_to_num",
+    "kron", "inner", "outer", "trace", "increment", "multiplex", "lerp",
+    "rad2deg", "deg2rad", "gcd", "lcm", "angle", "conj", "real", "imag",
+    "heaviside", "frac", "sgn", "diff", "count_nonzero",
+]
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _unary(jfn):
+    def op(x, name=None):
+        return apply(jfn, x)
+    return op
+
+
+def _binary(jfn):
+    def op(x, y, name=None):
+        return apply(jfn, x, y)
+    return op
+
+
+add = _binary(jnp.add)
+subtract = _binary(jnp.subtract)
+multiply = _binary(jnp.multiply)
+divide = _binary(jnp.true_divide)
+floor_divide = _binary(jnp.floor_divide)
+remainder = _binary(jnp.remainder)
+mod = remainder
+floor_mod = remainder
+pow = _binary(jnp.power)
+maximum = _binary(jnp.maximum)
+minimum = _binary(jnp.minimum)
+fmax = _binary(jnp.fmax)
+fmin = _binary(jnp.fmin)
+atan2 = _binary(jnp.arctan2)
+kron = _binary(jnp.kron)
+heaviside = _binary(jnp.heaviside)
+gcd = _binary(jnp.gcd)
+lcm = _binary(jnp.lcm)
+
+sqrt = _unary(jnp.sqrt)
+rsqrt = _unary(jax.lax.rsqrt)
+square = _unary(jnp.square)
+exp = _unary(jnp.exp)
+expm1 = _unary(jnp.expm1)
+log = _unary(jnp.log)
+log2 = _unary(jnp.log2)
+log10 = _unary(jnp.log10)
+log1p = _unary(jnp.log1p)
+abs = _unary(jnp.abs)
+neg = _unary(jnp.negative)
+sign = _unary(jnp.sign)
+floor = _unary(jnp.floor)
+ceil = _unary(jnp.ceil)
+round = _unary(jnp.round)
+trunc = _unary(jnp.trunc)
+sin = _unary(jnp.sin)
+cos = _unary(jnp.cos)
+tan = _unary(jnp.tan)
+asin = _unary(jnp.arcsin)
+acos = _unary(jnp.arccos)
+atan = _unary(jnp.arctan)
+sinh = _unary(jnp.sinh)
+cosh = _unary(jnp.cosh)
+tanh = _unary(jnp.tanh)
+asinh = _unary(jnp.arcsinh)
+acosh = _unary(jnp.arccosh)
+atanh = _unary(jnp.arctanh)
+reciprocal = _unary(jnp.reciprocal)
+erf = _unary(jax.lax.erf)
+erfinv = _unary(jax.lax.erf_inv)
+lgamma = _unary(jax.lax.lgamma)
+digamma = _unary(jax.lax.digamma)
+isnan = _unary(jnp.isnan)
+isinf = _unary(jnp.isinf)
+isfinite = _unary(jnp.isfinite)
+angle = _unary(jnp.angle)
+conj = _unary(jnp.conj)
+real = _unary(jnp.real)
+imag = _unary(jnp.imag)
+rad2deg = _unary(jnp.rad2deg)
+deg2rad = _unary(jnp.deg2rad)
+frac = _unary(lambda a: a - jnp.trunc(a))
+sgn = _unary(jnp.sign)
+
+
+def clip(x, min=None, max=None, name=None):
+    lo = min.item() if isinstance(min, Tensor) else min
+    hi = max.item() if isinstance(max, Tensor) else max
+    return apply(lambda a: jnp.clip(a, lo, hi), x)
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.max(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.min(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+amax = max
+amin = min
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    from ..core.dtype import convert_dtype
+    dt = convert_dtype(dtype)
+
+    def f(a):
+        out = jnp.sum(a, axis=_axis(axis), keepdims=keepdim, dtype=dt)
+        if dt is None and jnp.issubdtype(a.dtype, jnp.bool_):
+            out = out.astype(jnp.int64)
+        return out
+    return apply(f, x)
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.nansum(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.mean(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.nanmean(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    return apply(lambda a: jnp.prod(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    def f(a):
+        if axis is None:
+            return jnp.cumsum(a.reshape(-1))
+        return jnp.cumsum(a, axis=int(axis))
+    return apply(f, x)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    return apply(lambda a: jnp.cumprod(a, axis=dim), x)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jax.scipy.special.logsumexp(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def logcumsumexp(x, axis=None, name=None):
+    def f(a):
+        if axis is None:
+            b = a.reshape(-1)
+            ax = 0
+        else:
+            b, ax = a, int(axis)
+        m = jnp.max(b, axis=ax, keepdims=True)
+        return jnp.log(jnp.cumsum(jnp.exp(b - m), axis=ax)) + m
+    return apply(f, x)
+
+
+def add_n(inputs, name=None):
+    if isinstance(inputs, Tensor):
+        return inputs
+    return apply(lambda *xs: sum_arrays(xs), *inputs, op_name="add_n")
+
+
+def sum_arrays(xs):
+    out = xs[0]
+    for a in xs[1:]:
+        out = out + a
+    return out
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s = scale.item() if isinstance(scale, Tensor) else scale
+
+    def f(a):
+        out = a * s + bias if bias_after_scale else (a + bias) * s
+        return out
+    out = apply(f, x)
+    if act is not None:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply(lambda a: scale_b * jnp.tanh(scale_a * a), x)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply(lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf), x)
+
+
+def inner(x, y, name=None):
+    return apply(lambda a, b: jnp.inner(a, b), x, y)
+
+
+def outer(x, y, name=None):
+    return apply(lambda a, b: jnp.outer(a, b), x, y)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2), x)
+
+
+def increment(x, value=1.0, name=None):
+    x.set_value(x._data + value)
+    return x
+
+
+def multiplex(inputs, index, name=None):
+    def f(idx, *xs):
+        stacked = jnp.stack(xs, axis=0)          # (n, batch, ...)
+        rows = jnp.arange(stacked.shape[1])
+        return stacked[idx.reshape(-1), rows]
+    return apply(f, index, *inputs, op_name="multiplex")
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, Tensor):
+        return apply(lambda a, b, w: a + w * (b - a), x, y, weight)
+    return apply(lambda a, b: a + weight * (b - a), x, y)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    args = [x]
+    if prepend is not None:
+        args.append(prepend)
+    if append is not None:
+        args.append(append)
+
+    def f(a, *rest):
+        pre = rest[0] if prepend is not None else None
+        app = rest[-1] if append is not None else None
+        return jnp.diff(a, n=n, axis=axis, prepend=pre, append=app)
+    return apply(f, *args, op_name="diff")
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.count_nonzero(a, axis=_axis(axis), keepdims=keepdim).astype(jnp.int64), x)

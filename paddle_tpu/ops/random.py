@@ -1,0 +1,123 @@
+"""Random ops (reference: python/paddle/tensor/random.py; generator.cc RNG).
+
+Eager calls draw fresh keys from the global splittable generator
+(core.random). Inside jit-traced code use the `key=` argument to stay
+functional — the fit-loop fast path threads keys explicitly.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtype_mod
+from ..core import random as random_mod
+from ..core.tensor import Tensor, apply
+
+__all__ = [
+    "rand", "randn", "randint", "randint_like", "randperm", "uniform",
+    "uniform_", "normal", "standard_normal", "bernoulli", "multinomial",
+    "poisson", "exponential_",
+]
+
+
+def _key(key):
+    return key if key is not None else random_mod.next_key()
+
+
+def _dt(dtype):
+    d = dtype_mod.convert_dtype(dtype)
+    return d if d is not None else dtype_mod.get_default_dtype()
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None, key=None):
+    k = jax.random.key(seed) if seed else _key(key)
+    return Tensor(jax.random.uniform(k, _shape(shape), _dt(dtype), min, max))
+
+
+def rand(shape, dtype=None, name=None, key=None):
+    return uniform(shape, dtype, 0.0, 1.0, key=key)
+
+
+def randn(shape, dtype=None, name=None, key=None):
+    return Tensor(jax.random.normal(_key(key), _shape(shape), _dt(dtype)))
+
+
+standard_normal = randn
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None, key=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._data if isinstance(mean, Tensor) else mean
+        s = std._data if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        return Tensor(jax.random.normal(_key(key), shp,
+                                        dtype_mod.get_default_dtype()) * s + m)
+    return Tensor(jax.random.normal(_key(key), _shape(shape),
+                                    dtype_mod.get_default_dtype()) * std + mean)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None, key=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(_key(key), _shape(shape), low, high,
+                                     dtype_mod.convert_dtype(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None, key=None):
+    d = dtype_mod.convert_dtype(dtype) or x.dtype
+    if high is None:
+        low, high = 0, low
+    out = jax.random.randint(_key(key), tuple(x.shape), low, high, jnp.int64)
+    return Tensor(out.astype(d))
+
+
+def randperm(n, dtype="int64", name=None, key=None):
+    return Tensor(jax.random.permutation(_key(key), n).astype(
+        dtype_mod.convert_dtype(dtype)))
+
+
+def bernoulli(x, name=None, key=None):
+    k = _key(key)
+    return apply(lambda a: jax.random.bernoulli(k, a).astype(a.dtype), x,
+                 op_name="bernoulli")
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None, key=None):
+    k = _key(key)
+
+    def f(a):
+        logits = jnp.log(jnp.maximum(a, 1e-30))
+        if replacement:
+            return jax.random.categorical(k, logits, axis=-1,
+                                          shape=a.shape[:-1] + (num_samples,))
+        # without replacement: Gumbel top-k trick
+        g = jax.random.gumbel(k, a.shape, dtype=logits.dtype)
+        _, idx = jax.lax.top_k(logits + g, num_samples)
+        return idx
+    return Tensor(f(x._data).astype(jnp.int64))
+
+
+def poisson(x, name=None, key=None):
+    k = _key(key)
+    return apply(lambda a: jax.random.poisson(k, a).astype(a.dtype), x,
+                 op_name="poisson")
+
+
+def exponential_(x, lam=1.0, name=None, key=None):
+    out = jax.random.exponential(_key(key), tuple(x.shape), x.dtype) / lam
+    x.set_value(out)
+    return x
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None, key=None):
+    k = jax.random.key(seed) if seed else _key(key)
+    x.set_value(jax.random.uniform(k, tuple(x.shape), x.dtype, min, max))
+    return x

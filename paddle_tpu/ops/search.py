@@ -1,0 +1,127 @@
+"""Search / sort / index ops (reference: python/paddle/tensor/search.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtype_mod
+from ..core.tensor import Tensor, apply
+
+__all__ = [
+    "argmax", "argmin", "argsort", "sort", "topk", "where", "nonzero",
+    "masked_select", "index_sample", "searchsorted", "kthvalue", "mode",
+]
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    d = dtype_mod.convert_dtype(dtype)
+
+    def f(a):
+        out = jnp.argmax(a.reshape(-1) if axis is None else a,
+                         axis=None if axis is None else int(axis),
+                         keepdims=keepdim if axis is not None else False)
+        return out.astype(d)
+    return apply(f, x)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    d = dtype_mod.convert_dtype(dtype)
+
+    def f(a):
+        out = jnp.argmin(a.reshape(-1) if axis is None else a,
+                         axis=None if axis is None else int(axis),
+                         keepdims=keepdim if axis is not None else False)
+        return out.astype(d)
+    return apply(f, x)
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    def f(a):
+        idx = jnp.argsort(a, axis=axis, descending=descending)
+        return idx.astype(jnp.int64)
+    return apply(f, x)
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    def f(a):
+        out = jnp.sort(a, axis=axis, descending=descending)
+        return out
+    return apply(f, x)
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    if isinstance(k, Tensor):
+        k = int(k.item())
+
+    def f(a):
+        ax = -1 if axis is None else int(axis)
+        moved = jnp.moveaxis(a, ax, -1)
+        if largest:
+            vals, idx = jax.lax.top_k(moved, k)
+        else:
+            vals, idx = jax.lax.top_k(-moved, k)
+            vals = -vals
+        return (jnp.moveaxis(vals, -1, ax),
+                jnp.moveaxis(idx, -1, ax).astype(jnp.int64))
+    return apply(f, x, op_name="topk")
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return apply(lambda c, a, b: jnp.where(c, a, b), condition, x, y,
+                 op_name="where")
+
+
+def nonzero(x, as_tuple=False):
+    idx = np.nonzero(x.numpy())
+    if as_tuple:
+        return tuple(Tensor(i.astype(np.int64).reshape(-1, 1)) for i in idx)
+    return Tensor(np.stack(idx, axis=1).astype(np.int64))
+
+
+def masked_select(x, mask, name=None):
+    return Tensor(x.numpy()[np.asarray(mask.numpy(), bool)])
+
+
+def index_sample(x, index, name=None):
+    def f(a, idx):
+        rows = jnp.arange(a.shape[0])[:, None]
+        return a[rows, idx]
+    return apply(f, x, index, op_name="index_sample")
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    def f(seq, v):
+        side = "right" if right else "left"
+        if seq.ndim == 1:
+            out = jnp.searchsorted(seq, v, side=side)
+        else:
+            out = jax.vmap(lambda s, vv: jnp.searchsorted(s, vv, side=side))(
+                seq.reshape(-1, seq.shape[-1]), v.reshape(-1, v.shape[-1])
+            ).reshape(v.shape)
+        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+    return apply(f, sorted_sequence, values, op_name="searchsorted")
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def f(a):
+        ax = int(axis)
+        vals = jnp.sort(a, axis=ax)
+        idxs = jnp.argsort(a, axis=ax)
+        v = jnp.take(vals, k - 1, axis=ax)
+        i = jnp.take(idxs, k - 1, axis=ax)
+        if keepdim:
+            v = jnp.expand_dims(v, ax)
+            i = jnp.expand_dims(i, ax)
+        return v, i.astype(jnp.int64)
+    return apply(f, x, op_name="kthvalue")
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    a = x.numpy()
+    from scipy import stats  # available via jax's scipy dep
+
+    m = stats.mode(a, axis=axis, keepdims=keepdim)
+    return Tensor(m.mode.astype(a.dtype)), Tensor(np.asarray(m.count))

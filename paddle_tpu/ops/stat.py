@@ -1,0 +1,46 @@
+"""Statistics ops (reference: python/paddle/tensor/stat.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import apply
+
+__all__ = ["std", "var", "median", "nanmedian", "quantile", "nanquantile"]
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply(lambda a: jnp.var(a, axis=_axis(axis),
+                                   ddof=1 if unbiased else 0,
+                                   keepdims=keepdim), x)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply(lambda a: jnp.std(a, axis=_axis(axis),
+                                   ddof=1 if unbiased else 0,
+                                   keepdims=keepdim), x)
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.median(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.nanmedian(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    return apply(lambda a: jnp.quantile(a, jnp.asarray(q), axis=_axis(axis),
+                                        keepdims=keepdim, method=interpolation), x)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    return apply(lambda a: jnp.nanquantile(a, jnp.asarray(q), axis=_axis(axis),
+                                           keepdims=keepdim, method=interpolation), x)
