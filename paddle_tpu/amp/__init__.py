@@ -1,0 +1,217 @@
+"""Automatic mixed precision.
+
+Reference analogs: dygraph autocast (imperative/amp_auto_cast.cc + fluid/
+dygraph/amp/loss_scaler.py:27 AmpScaler), static rewrite
+(contrib/mixed_precision/decorator.py:36, fp16_lists.py), amp ops
+(operators/amp/check_finite_and_unscale_op, update_loss_scaling_op).
+
+TPU-native design: bf16 is the native reduced precision — same exponent
+range as fp32, so loss scaling is a no-op for bf16 (GradScaler becomes
+pass-through but keeps the fp16 dynamic-scaling logic for API parity and for
+fp16 runs). Autocast wraps the eager dispatcher: ops on the white list cast
+inputs to the amp dtype before execution; black-list ops force fp32.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtype_mod
+from ..core.flags import get_flags
+from ..core.tensor import Tensor, no_grad
+
+__all__ = ["auto_cast", "amp_guard", "GradScaler", "decorate",
+           "WHITE_LIST", "BLACK_LIST", "amp_state"]
+
+# fp16_lists.py analog: ops that are numerically safe/beneficial in low
+# precision (matmul-class feeds the MXU) vs ops that must stay fp32.
+WHITE_LIST = {"matmul", "linear", "conv1d", "conv2d", "conv3d", "einsum",
+              "flash_attention", "sdpa", "mm", "bmm"}
+BLACK_LIST = {"softmax", "log_softmax", "cross_entropy", "layer_norm",
+              "batch_norm", "norm", "mean", "sum", "exp", "log", "logsumexp",
+              "cumsum", "softmax_with_cross_entropy", "kl_div", "nll_loss"}
+
+_state = threading.local()
+
+
+def amp_state():
+    return getattr(_state, "amp", None)
+
+
+class auto_cast:
+    """Context manager: `with paddle.amp.auto_cast(): ...`"""
+
+    def __init__(self, enable=True, custom_white_list=None,
+                 custom_black_list=None, level="O1", dtype=None):
+        self.enable = enable
+        self.level = level
+        self.dtype = dtype_mod.convert_dtype(dtype or get_flags("amp_dtype"))
+        self.white = set(WHITE_LIST)
+        self.black = set(BLACK_LIST)
+        if custom_white_list:
+            self.white |= set(custom_white_list)
+            self.black -= set(custom_white_list)
+        if custom_black_list:
+            self.black |= set(custom_black_list)
+            self.white -= set(custom_black_list)
+
+    def __enter__(self):
+        self._prev = amp_state()
+        _state.amp = self if self.enable else None
+        return self
+
+    def __exit__(self, *exc):
+        _state.amp = self._prev
+        return False
+
+
+amp_guard = auto_cast
+
+
+from ..core import tensor as _tensor_mod
+
+
+def maybe_cast_inputs(op_name, arrays):
+    """Called by the eager dispatcher: cast op inputs per AMP lists."""
+    st = amp_state()
+    if st is None:
+        return arrays
+    def is_float(a):
+        return hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)
+    if op_name in st.white or (st.level == "O2" and op_name not in st.black):
+        return [a.astype(st.dtype) if is_float(a) and a.dtype != st.dtype
+                else a for a in arrays]
+    if op_name in st.black:
+        return [a.astype(jnp.float32)
+                if is_float(a) and a.dtype in (jnp.float16, jnp.bfloat16)
+                else a for a in arrays]
+    # gray: promote to widest floating dtype among inputs
+    dtypes = {a.dtype for a in arrays if is_float(a)}
+    if len(dtypes) > 1:
+        tgt = jnp.float32 if jnp.float32 in dtypes else st.dtype
+        return [a.astype(tgt) if is_float(a) else a for a in arrays]
+    return arrays
+
+
+_tensor_mod._amp_hook[0] = maybe_cast_inputs
+
+
+class GradScaler:
+    """Dynamic loss scaling (reference: amp/grad_scaler.py:20 wrapping
+    AmpScaler loss_scaler.py:27; kernels update_loss_scaling_op,
+    check_finite_and_unscale_op)."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_loss_scaling(self):
+        return self._scale
+
+    def scale(self, loss):
+        if not self._enable:
+            return loss
+        return loss * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        params = optimizer._parameter_list or []
+        inv = 1.0 / self._scale
+        found = False
+        for p in params:
+            if p.grad is None:
+                continue
+            g = p.grad._data.astype(jnp.float32) * inv
+            if not bool(jnp.isfinite(g).all()):
+                found = True
+            p.grad.set_value(g.astype(p.grad.dtype)
+                             if p.grad.dtype != jnp.float32 else g)
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every_n:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every_n_steps:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio,
+                "incr_every_n_steps": self._incr_every_n_steps,
+                "decr_every_n_nan_or_inf": self._decr_every_n,
+                "good_steps": self._good_steps, "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, state):
+        self._scale = state["scale"]
+        self._good_steps = state.get("good_steps", 0)
+        self._bad_steps = state.get("bad_steps", 0)
+
+
+def decorate(models=None, optimizers=None, level="O2", dtype=None,
+             master_weight=None, save_dtype=None):
+    """O2 decoration: cast model params to the amp dtype, keep fp32 masters
+    in the optimizer (reference: amp 'pure fp16' cast_model_to_fp16)."""
+    dt = dtype_mod.convert_dtype(dtype or get_flags("amp_dtype"))
+    single_model = not isinstance(models, (list, tuple))
+    ms = [models] if single_model else list(models)
+    for m in ms:
+        if m is None:
+            continue
+        for p in m.parameters():
+            if jnp.issubdtype(p.dtype, jnp.floating):
+                p._data = p._data.astype(dt)
+    if optimizers is not None:
+        single_opt = not isinstance(optimizers, (list, tuple))
+        opts = [optimizers] if single_opt else list(optimizers)
+        for o in opts:
+            o._multi_precision = True
+        if models is None:
+            return optimizers
+        return (ms[0] if single_model else ms,
+                opts[0] if single_opt else opts)
+    return ms[0] if single_model else ms

@@ -52,6 +52,31 @@ class Generator:
 
 _default_generator = Generator(np.random.randint(0, 2**31 - 1))
 
+# Functional key scope: inside jit-traced code (functional_call / train step)
+# randomness must derive from an explicit traced key, not the eager global
+# generator (which would bake a constant into the compiled program). A scope
+# holds a mutable key cell that next_key() splits from while active.
+_scope = threading.local()
+
+
+class key_scope:
+    """`with key_scope(step_key): ...` — eager random ops inside draw
+    deterministic splits of `step_key` (thread each step's key explicitly)."""
+
+    def __init__(self, key):
+        self._cell = [key]
+
+    def __enter__(self):
+        stack = getattr(_scope, "stack", None)
+        if stack is None:
+            stack = _scope.stack = []
+        stack.append(self._cell)
+        return self
+
+    def __exit__(self, *exc):
+        _scope.stack.pop()
+        return False
+
 
 def seed(s: int):
     """paddle.seed parity: reseed the global generator (and numpy for loaders)."""
@@ -65,6 +90,11 @@ def default_generator() -> Generator:
 
 
 def next_key():
+    stack = getattr(_scope, "stack", None)
+    if stack:
+        cell = stack[-1]
+        cell[0], sub = jax.random.split(cell[0])
+        return sub
     return _default_generator.next_key()
 
 

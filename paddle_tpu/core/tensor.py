@@ -377,6 +377,8 @@ def _differentiable(t: Tensor) -> bool:
 # ---------------------------------------------------------------------------
 # Op dispatch: the Tracer::TraceOp analog.
 # ---------------------------------------------------------------------------
+_amp_hook = [None]  # paddle_tpu.amp installs maybe_cast_inputs here
+
 
 def apply(fn, *args, op_name: str = None, n_outputs: int = None, **kwargs):
     """Run `fn` on raw arrays, wrapping outputs as Tensors and recording a
@@ -387,6 +389,8 @@ def apply(fn, *args, op_name: str = None, n_outputs: int = None, **kwargs):
     Tensor args with stop_gradient=False.
     """
     raw = [a._data if isinstance(a, Tensor) else a for a in args]
+    if _amp_hook[0] is not None:  # autocast (set by paddle_tpu.amp on import)
+        raw = _amp_hook[0](op_name or getattr(fn, "__name__", "op"), raw)
     diff_pos = [i for i, a in enumerate(args)
                 if isinstance(a, Tensor) and _differentiable(a)] \
         if is_grad_enabled() else []

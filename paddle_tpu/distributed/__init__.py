@@ -1,0 +1,5 @@
+"""paddle.distributed parity surface — built out in stages:
+env/collective/parallel (DP) first, fleet strategy layer, sharding,
+pipeline, launcher, PS. See SURVEY.md §2 rows 26-38."""
+from . import env  # noqa: F401
+from .env import ParallelEnv, get_rank, get_world_size  # noqa: F401

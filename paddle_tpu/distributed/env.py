@@ -1,0 +1,81 @@
+"""Process-group environment (reference: ParallelEnv in
+python/paddle/fluid/dygraph/parallel.py + PADDLE_TRAINER_* env protocol in
+fleet/launch_utils.py).
+
+On TPU the multi-host runtime is jax.distributed (the gen_comm_id_helper
+analog): one process per host, all chips visible collectively. Environment
+variables keep the reference names so launch scripts port over.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+_initialized = False
+
+
+def get_rank() -> int:
+    """Global process rank (PADDLE_TRAINER_ID analog)."""
+    if _initialized or "PADDLE_TRAINER_ID" in os.environ:
+        return int(os.environ.get("PADDLE_TRAINER_ID", jax.process_index()))
+    return 0
+
+
+def get_world_size() -> int:
+    """Number of processes (PADDLE_TRAINERS_NUM analog)."""
+    if _initialized or "PADDLE_TRAINERS_NUM" in os.environ:
+        return int(os.environ.get("PADDLE_TRAINERS_NUM", jax.process_count()))
+    return 1
+
+
+def init_distributed(coordinator_address=None, num_processes=None,
+                     process_id=None):
+    """jax.distributed.initialize wrapper — the TCP comm-id bootstrap analog
+    (gen_comm_id_helper.cc:286 SendBroadCastCommID)."""
+    global _initialized
+    if _initialized:
+        return
+    addr = coordinator_address or os.environ.get("PADDLE_MASTER_ENDPOINT")
+    if addr is None:
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        addr = eps.split(",")[0] if eps else None
+    n = num_processes or int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    pid = process_id if process_id is not None else \
+        int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if addr and n > 1:
+        jax.distributed.initialize(coordinator_address=addr,
+                                   num_processes=n, process_id=pid)
+    _initialized = True
+
+
+class ParallelEnv:
+    """reference: fluid/dygraph/parallel.py ParallelEnv."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def local_rank(self):
+        return int(os.environ.get("PADDLE_LOCAL_RANK", "0"))
+
+    @property
+    def dev_id(self):
+        return self.local_rank
+
+    @property
+    def trainer_endpoints(self):
+        return os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+
+    @property
+    def nranks(self):
+        return self.world_size

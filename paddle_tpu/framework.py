@@ -1,0 +1,194 @@
+"""Framework-level glue: Parameter, ParamAttr, save/load, functional bridge.
+
+Reference analogs:
+  * ParamBase / ParamAttr — python/paddle/fluid/framework.py, param_attr.py
+  * paddle.save/paddle.load — fluid/dygraph/checkpoint.py:56,128 (pickle of
+    state_dict); the sharded/distributed variant lives in io/checkpoint.py
+    (orbax-style), this is the single-process path.
+  * functional_call — no reference analog: it is the TPU-native bridge that
+    turns a mutable Layer tree into a pure params->outputs function so the
+    hot path can be jax.jit + jax.grad instead of an op-at-a-time tape.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import pickle
+from typing import Any, Dict
+
+import jax
+import numpy as np
+
+from .core import dtype as dtype_mod
+from .core.tensor import Tensor, no_grad
+
+
+class Parameter(Tensor):
+    """Trainable tensor (ParamBase analog): stop_gradient=False by default."""
+
+    def __init__(self, data, dtype=None, name=None, trainable=True):
+        super().__init__(data, dtype=dtype, stop_gradient=not trainable,
+                         name=name or _unique_param_name(), persistable=True)
+        self.trainable = trainable
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+_param_counter = [0]
+
+
+def _unique_param_name():
+    _param_counter[0] += 1
+    return f"param_{_param_counter[0]}"
+
+
+class ParamAttr:
+    """Parameter attribute bundle (reference: python/paddle/fluid/param_attr.py).
+
+    Carries name / initializer / learning-rate scale / regularizer /
+    trainable — consumed by Layer.create_parameter.
+    """
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=False,
+                 need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.do_model_average = do_model_average
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(attr):
+        if attr is None:
+            return ParamAttr()
+        if isinstance(attr, ParamAttr):
+            return attr
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        if attr is False:
+            return False
+        if callable(attr):  # bare initializer
+            return ParamAttr(initializer=attr)
+        raise TypeError(f"Cannot convert {attr!r} to ParamAttr")
+
+
+# ---------------------------------------------------------------------------
+# save / load
+# ---------------------------------------------------------------------------
+
+def _to_saveable(obj):
+    if isinstance(obj, Tensor):
+        return np.asarray(obj._data)
+    if isinstance(obj, dict):
+        return {k: _to_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_saveable(v) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4):
+    """paddle.save parity: pickle a (possibly nested) state dict.
+
+    Tensors are converted to host numpy arrays (device→host transfer)."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False):
+    """paddle.load parity."""
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    if return_numpy:
+        return obj
+    return _from_saved(obj)
+
+
+def _from_saved(obj):
+    if isinstance(obj, np.ndarray):
+        return Tensor(obj)
+    if isinstance(obj, dict):
+        return {k: _from_saved(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_from_saved(v) for v in obj)
+    return obj
+
+
+# ---------------------------------------------------------------------------
+# Functional bridge (the jit fast path)
+# ---------------------------------------------------------------------------
+
+def param_arrays(layer) -> Dict[str, jax.Array]:
+    """Trainable parameter payloads keyed by qualified name."""
+    return {n: p._data for n, p in layer.named_parameters()
+            if not p.stop_gradient}
+
+
+def state_arrays(layer) -> Dict[str, jax.Array]:
+    """Non-trainable state: buffers + frozen params."""
+    out = {n: b._data for n, b in layer.named_buffers()}
+    out.update({n: p._data for n, p in layer.named_parameters()
+                if p.stop_gradient})
+    return out
+
+
+@contextlib.contextmanager
+def _swapped(layer, arrays: Dict[str, jax.Array]):
+    """Temporarily replace named param/buffer payloads with `arrays`."""
+    lookup = dict(layer.named_parameters())
+    lookup.update(dict(layer.named_buffers()))
+    saved = {}
+    try:
+        for name, arr in arrays.items():
+            t = lookup[name]
+            saved[name] = t._data
+            t._data = arr
+        yield lookup
+    finally:
+        for name, old in saved.items():
+            lookup[name]._data = old
+
+
+def functional_call(layer, params: Dict[str, jax.Array],
+                    state: Dict[str, jax.Array], *args,
+                    mutable_state: bool = True, **kwargs):
+    """Run `layer(*args, **kwargs)` as a pure function of (params, state).
+
+    Returns (outputs, new_state). `outputs` has Tensors unwrapped to raw
+    jax arrays (pytree). Tape recording is disabled — differentiate with
+    jax.grad around this call.
+    """
+    merged = {**params, **state}
+    with _swapped(layer, merged) as lookup:
+        with no_grad():
+            out = layer(*args, **kwargs)
+        new_state = {n: lookup[n]._data for n in state} if mutable_state else state
+    return unwrap(out), new_state
+
+
+def unwrap(obj):
+    """Tensor pytree -> raw jax array pytree."""
+    if isinstance(obj, Tensor):
+        return obj._data
+    if isinstance(obj, dict):
+        return {k: unwrap(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(unwrap(v) for v in obj)
+    return obj
+
+
+def wrap(obj):
+    """Raw array pytree -> Tensor pytree."""
+    if isinstance(obj, jax.Array):
+        return Tensor(obj)
+    if isinstance(obj, dict):
+        return {k: wrap(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(wrap(v) for v in obj)
+    return obj

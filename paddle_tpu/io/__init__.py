@@ -1,0 +1,8 @@
+"""paddle.io parity surface."""
+from .dataloader import DataLoader, default_collate_fn, get_worker_info  # noqa: F401
+from .dataset import (ChainDataset, ComposeDataset, ConcatDataset, Dataset,
+                      IterableDataset, Subset, TensorDataset,
+                      random_split)  # noqa: F401
+from .sampler import (BatchSampler, DistributedBatchSampler, RandomSampler,
+                      Sampler, SequenceSampler, SubsetRandomSampler,
+                      WeightedRandomSampler)  # noqa: F401

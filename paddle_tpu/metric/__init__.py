@@ -1,0 +1,186 @@
+"""Metrics (reference: python/paddle/metric/metrics.py — Metric base,
+Accuracy, Precision, Recall, Auc)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc", "accuracy"]
+
+
+def _np(x):
+    return x.numpy() if isinstance(x, Tensor) else np.asarray(x)
+
+
+class Metric:
+    def __init__(self):
+        pass
+
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def name(self):
+        raise NotImplementedError
+
+    def compute(self, *args):
+        return args
+
+
+class Accuracy(Metric):
+    def __init__(self, topk=(1,), name=None):
+        super().__init__()
+        self.topk = topk if isinstance(topk, (list, tuple)) else (topk,)
+        self.maxk = max(self.topk)
+        self._name = name or "acc"
+        self.reset()
+
+    def compute(self, pred, label, *args):
+        pred = _np(pred)
+        label = _np(label)
+        order = np.argsort(-pred, axis=-1)[..., :self.maxk]
+        if label.ndim == pred.ndim:  # one-hot or column label
+            if label.shape[-1] == 1:
+                label = label.squeeze(-1)
+            else:
+                label = np.argmax(label, axis=-1)
+        correct = (order == label[..., None]).astype(np.float32)
+        return Tensor(correct)
+
+    def update(self, correct, *args):
+        correct = _np(correct)
+        accs = []
+        for k in self.topk:
+            num = correct[..., :k].sum()
+            tot = int(np.prod(correct.shape[:-1]))
+            self.total[self.topk.index(k)] += num
+            self.count[self.topk.index(k)] += tot
+            accs.append(num / max(tot, 1))
+        return np.array(accs[0] if len(accs) == 1 else accs)
+
+    def reset(self):
+        self.total = [0.0] * len(self.topk)
+        self.count = [0] * len(self.topk)
+
+    def accumulate(self):
+        res = [t / max(c, 1) for t, c in zip(self.total, self.count)]
+        return res[0] if len(res) == 1 else res
+
+    def name(self):
+        if len(self.topk) == 1:
+            return [self._name]
+        return [f"{self._name}_top{k}" for k in self.topk]
+
+
+class Precision(Metric):
+    def __init__(self, name="precision"):
+        super().__init__()
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = np.rint(_np(preds)).astype(np.int32).reshape(-1)
+        labels = _np(labels).astype(np.int32).reshape(-1)
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fp += int(((preds == 1) & (labels == 0)).sum())
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return self.tp / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    def __init__(self, name="recall"):
+        super().__init__()
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = np.rint(_np(preds)).astype(np.int32).reshape(-1)
+        labels = _np(labels).astype(np.int32).reshape(-1)
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fn += int(((preds == 0) & (labels == 1)).sum())
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return self.tp / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Auc(Metric):
+    """Thresholded-histogram AUC (reference: metrics.py Auc / auc_op)."""
+
+    def __init__(self, curve="ROC", num_thresholds=4095, name="auc"):
+        super().__init__()
+        self._num_thresholds = num_thresholds
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = _np(preds)
+        labels = _np(labels).reshape(-1)
+        if preds.ndim == 2 and preds.shape[1] == 2:
+            pos_prob = preds[:, 1]
+        else:
+            pos_prob = preds.reshape(-1)
+        idx = np.minimum((pos_prob * self._num_thresholds).astype(np.int64),
+                         self._num_thresholds - 1)
+        for i, lbl in zip(idx, labels):
+            if lbl:
+                self._stat_pos[i] += 1
+            else:
+                self._stat_neg[i] += 1
+
+    def reset(self):
+        self._stat_pos = np.zeros(self._num_thresholds, np.int64)
+        self._stat_neg = np.zeros(self._num_thresholds, np.int64)
+
+    def accumulate(self):
+        tot_pos = float(self._stat_pos.sum())
+        tot_neg = float(self._stat_neg.sum())
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        # integrate TPR over FPR from the high-score end
+        pos_cum = np.cumsum(self._stat_pos[::-1])
+        neg_cum = np.cumsum(self._stat_neg[::-1])
+        tpr = pos_cum / tot_pos
+        fpr = neg_cum / tot_neg
+        trapezoid = getattr(np, "trapezoid", None) or np.trapz
+        return float(trapezoid(tpr, fpr))
+
+    def name(self):
+        return self._name
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Functional accuracy (reference: metric/metrics.py accuracy)."""
+    import jax.numpy as jnp
+
+    from ..core.tensor import apply
+
+    def f(pred, lbl):
+        topk_idx = jnp.argsort(-pred, axis=-1)[..., :k]
+        if lbl.ndim == pred.ndim:
+            lbl = lbl[..., 0] if lbl.shape[-1] == 1 else jnp.argmax(lbl, -1)
+        hit = (topk_idx == lbl[..., None]).any(axis=-1)
+        return jnp.mean(hit.astype(jnp.float32))
+    return apply(f, input, label, op_name="accuracy")

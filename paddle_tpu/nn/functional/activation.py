@@ -1,0 +1,189 @@
+"""Activation functionals (reference: python/paddle/nn/functional/activation.py).
+All lower to single XLA HLO ops or small fusable expressions."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, apply
+
+__all__ = [
+    "relu", "relu6", "relu_", "elu", "selu", "celu", "gelu", "sigmoid",
+    "log_sigmoid", "softmax", "log_softmax", "tanh", "tanh_", "leaky_relu",
+    "prelu", "hardshrink", "hardtanh", "hardsigmoid", "hardswish", "silu",
+    "swish", "mish", "softplus", "softshrink", "softsign", "tanhshrink",
+    "thresholded_relu", "glu", "gumbel_softmax", "maxout", "rrelu",
+]
+
+
+def relu(x, name=None):
+    return apply(jax.nn.relu, x)
+
+
+def relu_(x, name=None):
+    out = relu(x)
+    x._data, x._node, x._out_idx = out._data, out._node, out._out_idx
+    return x
+
+
+def relu6(x, name=None):
+    return apply(jax.nn.relu6, x)
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply(lambda a: jax.nn.elu(a, alpha=alpha), x)
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply(lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), x)
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply(lambda a: jax.nn.celu(a, alpha=alpha), x)
+
+
+def gelu(x, approximate=False, name=None):
+    return apply(lambda a: jax.nn.gelu(a, approximate=approximate), x)
+
+
+def sigmoid(x, name=None):
+    return apply(jax.nn.sigmoid, x)
+
+
+def log_sigmoid(x, name=None):
+    return apply(jax.nn.log_sigmoid, x)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    def f(a):
+        if dtype is not None:
+            from ...core.dtype import convert_dtype
+            a = a.astype(convert_dtype(dtype))
+        return jax.nn.softmax(a, axis=axis)
+    return apply(f, x, op_name="softmax")
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    def f(a):
+        if dtype is not None:
+            from ...core.dtype import convert_dtype
+            a = a.astype(convert_dtype(dtype))
+        return jax.nn.log_softmax(a, axis=axis)
+    return apply(f, x, op_name="log_softmax")
+
+
+def tanh(x, name=None):
+    return apply(jnp.tanh, x)
+
+
+def tanh_(x, name=None):
+    out = tanh(x)
+    x._data, x._node, x._out_idx = out._data, out._node, out._out_idx
+    return x
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply(lambda a: jax.nn.leaky_relu(a, negative_slope=negative_slope), x)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def f(a, w):
+        if w.size == 1:
+            return jnp.where(a > 0, a, w.reshape(()) * a)
+        shape = [1] * a.ndim
+        ch_axis = 1 if data_format.startswith("NC") else a.ndim - 1
+        shape[ch_axis] = w.size
+        return jnp.where(a > 0, a, w.reshape(shape) * a)
+    return apply(f, x, weight, op_name="prelu")
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply(lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), x)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply(lambda a: jnp.clip(a, min, max), x)
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return apply(lambda a: jnp.clip(a * slope + offset, 0.0, 1.0), x)
+
+
+def hardswish(x, name=None):
+    return apply(lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0, x)
+
+
+def silu(x, name=None):
+    return apply(jax.nn.silu, x)
+
+
+def swish(x, name=None):
+    return silu(x)
+
+
+def mish(x, name=None):
+    return apply(lambda a: a * jnp.tanh(jax.nn.softplus(a)), x)
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply(lambda a: jnp.where(a * beta > threshold, a,
+                                     jax.nn.softplus(a * beta) / beta), x)
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply(lambda a: jnp.sign(a) * jnp.maximum(jnp.abs(a) - threshold, 0.0), x)
+
+
+def softsign(x, name=None):
+    return apply(jax.nn.soft_sign, x)
+
+
+def tanhshrink(x, name=None):
+    return apply(lambda a: a - jnp.tanh(a), x)
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return apply(lambda a: jnp.where(a > threshold, a, 0.0), x)
+
+
+def glu(x, axis=-1, name=None):
+    return apply(lambda a: jax.nn.glu(a, axis=axis), x)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None, key=None):
+    from ...core import random as random_mod
+    k = key if key is not None else random_mod.next_key()
+
+    def f(a):
+        g = jax.random.gumbel(k, a.shape, a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            onehot = jnp.zeros_like(y)
+            onehot = jnp.put_along_axis(onehot, idx, 1.0, axis=axis,
+                                        inplace=False)
+            y = onehot + y - jax.lax.stop_gradient(y)
+        return y
+    return apply(f, x, op_name="gumbel_softmax")
+
+
+def maxout(x, groups, axis=1, name=None):
+    def f(a):
+        c = a.shape[axis]
+        new_shape = list(a.shape)
+        new_shape[axis] = c // groups
+        new_shape.insert(axis + 1, groups)
+        return jnp.max(a.reshape(new_shape), axis=axis + 1)
+    return apply(f, x)
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, name=None, key=None):
+    from ...core import random as random_mod
+    if not training:
+        return leaky_relu(x, (lower + upper) / 2.0)
+    k = key if key is not None else random_mod.next_key()
+
+    def f(a):
+        slope = jax.random.uniform(k, a.shape, a.dtype, lower, upper)
+        return jnp.where(a >= 0, a, slope * a)
+    return apply(f, x, op_name="rrelu")

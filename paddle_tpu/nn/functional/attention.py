@@ -1,0 +1,77 @@
+"""Attention functionals.
+
+The reference only has fused inference attention kernels
+(operators/fused/multihead_matmul_op.cu); training attention is composed
+from matmul/softmax ops. Here scaled_dot_product_attention is first-class:
+it dispatches to the Pallas flash-attention kernel on TPU when shapes
+qualify (paddle_tpu/ops/pallas/flash_attention.py), else an XLA composition.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.flags import get_flags
+from ...core.tensor import Tensor, apply
+
+__all__ = ["scaled_dot_product_attention"]
+
+
+def _sdpa_xla(q, k, v, mask, dropout_p, causal, scale, key=None):
+    # q,k,v: [B, S, H, D] (paddle convention)
+    qt = jnp.swapaxes(q, 1, 2)  # [B,H,S,D]
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bhsd,bhtd->bhst", qt, kt) * s
+    logits = logits.astype(jnp.float32)
+    if causal:
+        S, T = logits.shape[-2], logits.shape[-1]
+        causal_mask = jnp.tril(jnp.ones((S, T), bool))
+        logits = jnp.where(causal_mask, logits, -1e30)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, -1e30)
+        else:
+            logits = logits + mask.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and key is not None:
+        keep = jax.random.bernoulli(key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0).astype(q.dtype)
+    out = jnp.einsum("bhst,bhtd->bhsd", probs, vt)
+    return jnp.swapaxes(out, 1, 2)  # back to [B,S,H,D]
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False, scale=None,
+                                 training=True, name=None, rng_key=None):
+    """query/key/value: [batch, seq, heads, head_dim]."""
+    if not training:
+        dropout_p = 0.0
+    if dropout_p > 0.0 and rng_key is None:
+        from ...core import random as random_mod
+        rng_key = random_mod.next_key()
+
+    use_pallas = (get_flags("use_pallas_attention") and attn_mask is None
+                  and dropout_p == 0.0)
+    if use_pallas:
+        try:
+            from ...ops.pallas.flash_attention import flash_attention
+            args = [query, key, value]
+            return apply(
+                lambda q, k, v: flash_attention(q, k, v, causal=is_causal,
+                                                scale=scale),
+                *args, op_name="flash_attention")
+        except Exception:
+            pass  # fall back to XLA composition
+
+    args = [query, key, value]
+    if attn_mask is not None:
+        return apply(lambda q, k, v, m: _sdpa_xla(q, k, v, m, dropout_p,
+                                                  is_causal, scale, rng_key),
+                     *args, attn_mask, op_name="sdpa")
+    return apply(lambda q, k, v: _sdpa_xla(q, k, v, None, dropout_p,
+                                           is_causal, scale, rng_key),
+                 *args, op_name="sdpa")

@@ -1,0 +1,294 @@
+"""Common functionals: linear/dropout/embedding/interpolate/...
+(reference: python/paddle/nn/functional/common.py, input.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core import random as random_mod
+from ...core.flags import get_flags
+from ...core.tensor import Tensor, apply
+
+__all__ = [
+    "linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout",
+    "embedding", "one_hot", "cosine_similarity", "label_smooth",
+    "pixel_shuffle", "pixel_unshuffle", "channel_shuffle", "unfold", "fold",
+    "bilinear", "interpolate", "upsample", "class_center_sample",
+]
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b with W shaped [in, out] (reference convention,
+    nn/functional/common.py linear). Feeds the MXU directly."""
+    if bias is None:
+        return apply(lambda a, w: jnp.matmul(a, w), x, weight, op_name="linear")
+    return apply(lambda a, w, b: jnp.matmul(a, w) + b, x, weight, bias,
+                 op_name="linear")
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None, key=None):
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return apply(lambda a: a * (1.0 - p), x)
+        return x
+    if p == 1.0:
+        return apply(lambda a: jnp.zeros_like(a), x)
+    k = key if key is not None else random_mod.next_key()
+
+    def f(a):
+        shape = list(a.shape)
+        if axis is not None:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(k, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
+        return jnp.where(keep, a, 0.0).astype(a.dtype)
+    return apply(f, x, op_name="dropout")
+
+
+def _dropout_nd(x, p, training, data_format, spatial_ndim, name=None, key=None):
+    if not training or p == 0.0:
+        return x
+    k = key if key is not None else random_mod.next_key()
+
+    def f(a):
+        if data_format.startswith("NC"):
+            mask_shape = a.shape[:2] + (1,) * spatial_ndim
+        else:
+            mask_shape = (a.shape[0],) + (1,) * spatial_ndim + (a.shape[-1],)
+        keep = jax.random.bernoulli(k, 1.0 - p, mask_shape)
+        return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
+    return apply(f, x, op_name="dropout_nd")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None, key=None):
+    return _dropout_nd(x, p, training, data_format, 2, key=key)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None, key=None):
+    return _dropout_nd(x, p, training, data_format, 3, key=key)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None, key=None):
+    if not training or p == 0.0:
+        return x
+    k = key if key is not None else random_mod.next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def f(a):
+        keep = jax.random.bernoulli(k, 1.0 - p, a.shape)
+        q = 1.0 - p
+        a_coef = (q + alpha_p ** 2 * q * p) ** -0.5
+        b_coef = -a_coef * alpha_p * p
+        return (a_coef * jnp.where(keep, a, alpha_p) + b_coef).astype(a.dtype)
+    return apply(f, x, op_name="alpha_dropout")
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Embedding lookup. On TPU this is a dense gather lowered by XLA; the
+    reference's SelectedRows sparse-grad path (selected_rows.h:41) is
+    unnecessary because XLA emits a scatter-add for the gather's vjp."""
+    def f(idx, w):
+        out = jnp.take(w, idx, axis=0)
+        if padding_idx is not None:
+            mask = (idx == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+    return apply(lambda idx, w: f(idx, w), x, weight, op_name="embedding")
+
+
+def one_hot(x, num_classes, name=None):
+    return apply(lambda idx: jax.nn.one_hot(idx, num_classes, dtype=jnp.float32),
+                 x, op_name="one_hot")
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def f(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return dot / jnp.maximum(na * nb, eps)
+    return apply(f, x1, x2, op_name="cosine_similarity")
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def f(lbl, *rest):
+        k = lbl.shape[-1]
+        if rest:
+            return (1 - epsilon) * lbl + epsilon * rest[0]
+        return (1 - epsilon) * lbl + epsilon / k
+    if prior_dist is not None:
+        return apply(f, label, prior_dist, op_name="label_smooth")
+    return apply(f, label, op_name="label_smooth")
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c // (r * r), r, r, h, w)
+            a = a.transpose(0, 1, 4, 2, 5, 3)
+            return a.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h, w, r, r, c // (r * r))
+        a = a.transpose(0, 1, 3, 2, 4, 5)
+        return a.reshape(n, h * r, w * r, c // (r * r))
+    return apply(f, x)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c, h // r, r, w // r, r)
+            a = a.transpose(0, 1, 3, 5, 2, 4)
+            return a.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h // r, r, w // r, r, c)
+        a = a.transpose(0, 1, 3, 2, 4, 5)
+        return a.reshape(n, h // r, w // r, c * r * r)
+    return apply(f, x)
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            return a.reshape(n, groups, c // groups, h, w) \
+                    .transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+        n, h, w, c = a.shape
+        return a.reshape(n, h, w, groups, c // groups) \
+                .transpose(0, 1, 2, 4, 3).reshape(n, h, w, c)
+    return apply(f, x)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (reference: operators/math/im2col.*) via XLA patch extraction."""
+    def pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    kh, kw = pair(kernel_sizes)
+    sh, sw = pair(strides)
+    dh, dw = pair(dilations)
+    pads = paddings
+    if isinstance(pads, int):
+        pads = [pads] * 4
+    if len(pads) == 2:
+        pads = [pads[0], pads[1], pads[0], pads[1]]
+
+    def f(a):
+        n, c, h, w = a.shape
+        a = jnp.pad(a, ((0, 0), (0, 0), (pads[0], pads[2]), (pads[1], pads[3])))
+        patches = jax.lax.conv_general_dilated_patches(
+            a, (kh, kw), (sh, sw), "VALID", rhs_dilation=(dh, dw),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        # patches: [n, c*kh*kw, oh, ow] -> [n, c*kh*kw, oh*ow]
+        return patches.reshape(n, c * kh * kw, -1)
+    return apply(f, x)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    def pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    oh, ow = pair(output_sizes)
+    kh, kw = pair(kernel_sizes)
+    sh, sw = pair(strides)
+    dh, dw = pair(dilations)
+    ph, pw = pair(paddings) if not isinstance(paddings, int) else (paddings, paddings)
+
+    def f(a):
+        n, ckk, L = a.shape
+        c = ckk // (kh * kw)
+        out = jnp.zeros((n, c, oh + 2 * ph, ow + 2 * pw), a.dtype)
+        cols = a.reshape(n, c, kh, kw, L)
+        n_w = (ow + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+        for i in range(kh):
+            for j in range(kw):
+                rows = jnp.arange(L) // n_w * sh + i * dh
+                colsx = jnp.arange(L) % n_w * sw + j * dw
+                out = out.at[:, :, rows, colsx].add(cols[:, :, i, j, :])
+        return out[:, :, ph:ph + oh, pw:pw + ow]
+    return apply(f, x)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def f(a, b, w, *rest):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if rest:
+            out = out + rest[0]
+        return out
+    if bias is not None:
+        return apply(f, x1, x2, weight, bias, op_name="bilinear")
+    return apply(f, x1, x2, weight, op_name="bilinear")
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    def to_list(v, n):
+        if v is None:
+            return None
+        if isinstance(v, Tensor):
+            v = v.tolist()
+        if isinstance(v, (int, float)):
+            return [v] * n
+        return [int(i.item()) if isinstance(i, Tensor) else i for i in v]
+
+    channels_last = not data_format.startswith("NC")
+    spatial_ndim = len(x.shape) - 2
+    out_size = to_list(size, spatial_ndim)
+    scales = to_list(scale_factor, spatial_ndim)
+
+    def f(a):
+        if channels_last:
+            spatial = a.shape[1:-1]
+        else:
+            spatial = a.shape[2:]
+        tgt = out_size or [int(round(s * f_)) for s, f_ in zip(spatial, scales)]
+        if channels_last:
+            new_shape = (a.shape[0],) + tuple(tgt) + (a.shape[-1],)
+        else:
+            new_shape = a.shape[:2] + tuple(tgt)
+        method = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+                  "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+        if method == "nearest" or not align_corners:
+            return jax.image.resize(a, new_shape, method=method).astype(a.dtype)
+        # align_corners=True path: build index grids explicitly.
+        out = a
+        sp_axes = range(1, 1 + spatial_ndim) if channels_last else \
+            range(2, 2 + spatial_ndim)
+        for ax, t in zip(sp_axes, tgt):
+            s = out.shape[ax]
+            if t == 1 or s == 1:
+                idx = jnp.zeros(t)
+            else:
+                idx = jnp.linspace(0.0, s - 1.0, t)
+            i0 = jnp.floor(idx).astype(jnp.int32)
+            i1 = jnp.minimum(i0 + 1, s - 1)
+            frac = (idx - i0).reshape([-1 if d == ax else 1
+                                       for d in range(out.ndim)])
+            g0 = jnp.take(out, i0, axis=ax)
+            g1 = jnp.take(out, i1, axis=ax)
+            out = g0 * (1 - frac) + g1 * frac
+        return out.astype(a.dtype)
+    return apply(f, x, op_name="interpolate")
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format, name)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    raise NotImplementedError(
+        "class_center_sample requires the PS sparse path; planned with the "
+        "parameter-server component")

@@ -1,0 +1,164 @@
+"""Convolution functionals (reference: python/paddle/nn/functional/conv.py;
+CUDA kernels conv_op.cu/cudnn). On TPU these lower to XLA convolution HLOs
+that tile directly onto the MXU — no cuDNN-style algo selection needed."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, apply
+
+__all__ = ["conv1d", "conv2d", "conv3d", "conv1d_transpose",
+           "conv2d_transpose", "conv3d_transpose"]
+
+
+def _tuplize(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(int(i) for i in v)
+
+
+def _padding(padding, n):
+    """Paddle padding: int, list of ints, pairs, or SAME/VALID."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    return [tuple(p) for p in padding]
+
+
+def _dn(n, channels_last):
+    if n == 1:
+        return ("NWC", "WIO", "NWC") if channels_last else ("NCW", "OIW", "NCW")
+    if n == 2:
+        return ("NHWC", "HWIO", "NHWC") if channels_last else ("NCHW", "OIHW", "NCHW")
+    return ("NDHWC", "DHWIO", "NDHWC") if channels_last else ("NCDHW", "OIDHW", "NCDHW")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n, data_format):
+    channels_last = not data_format.startswith("NC")
+    stride = _tuplize(stride, n)
+    dilation = _tuplize(dilation, n)
+    pad = _padding(padding, n)
+    dn = _dn(n, channels_last)
+
+    def f(a, w, *rest):
+        # weight layout from the reference is [out_c, in_c/groups, *k]
+        if channels_last:
+            w = jnp.moveaxis(w, (0, 1), (-1, -2))  # -> [*k, in/g, out]
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=stride, padding=pad,
+            rhs_dilation=dilation, dimension_numbers=dn,
+            feature_group_count=groups)
+        if rest:
+            b = rest[0]
+            shape = [1] * out.ndim
+            shape[1 if not channels_last else -1] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+    args = (x, weight) if bias is None else (x, weight, bias)
+    return apply(f, *args, op_name=f"conv{n}d")
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1,
+                 "NCW" if data_format == "NCL" else "NWC")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2,
+                 data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3,
+                 data_format)
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                    dilation, groups, n, data_format, output_size):
+    channels_last = not data_format.startswith("NC")
+    stride = _tuplize(stride, n)
+    dilation = _tuplize(dilation, n)
+    opad = _tuplize(output_padding, n) if output_padding else (0,) * n
+    pad = _padding(padding, n)
+    dn = _dn(n, channels_last)
+
+    def one_group(a, w):
+        # reference weight layout for transpose conv: [in_c, out_c, *k].
+        # Transposed conv = conv with lhs (input) dilation, flipped kernel.
+        kdims = [(w.shape[2 + i] - 1) * dilation[i] for i in range(n)]
+        if isinstance(pad, str):
+            pads = [(kd, kd) for kd in kdims] if pad == "VALID" else pad
+        else:
+            pads = [(kd - p[0], kd - p[1] + op)
+                    for kd, p, op in zip(kdims, pad, opad)]
+        wt = jnp.swapaxes(w, 0, 1)                       # [out, in, *k]
+        wt = jnp.flip(wt, axis=tuple(range(2, 2 + n)))
+        if channels_last:
+            wt = jnp.moveaxis(wt, (0, 1), (-1, -2))
+        return jax.lax.conv_general_dilated(
+            a, wt, window_strides=(1,) * n, padding=pads,
+            lhs_dilation=stride, rhs_dilation=dilation,
+            dimension_numbers=dn)
+
+    ch_axis = -1 if channels_last else 1
+
+    def f(a, w, *rest):
+        if groups == 1:
+            out = one_group(a, w)
+        else:
+            a_parts = jnp.split(a, groups, axis=ch_axis)
+            w_parts = jnp.split(w, groups, axis=0)
+            out = jnp.concatenate(
+                [one_group(ap, wp) for ap, wp in zip(a_parts, w_parts)],
+                axis=ch_axis)
+        if rest:
+            b = rest[0]
+            shape = [1] * out.ndim
+            shape[ch_axis] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+    args = (x, weight) if bias is None else (x, weight, bias)
+    out = apply(f, *args, op_name=f"conv{n}d_transpose")
+    if output_size is not None:
+        tgt = _tuplize(output_size, n)
+        cur = out.shape[2:] if not channels_last else out.shape[1:-1]
+        if tuple(cur) != tgt:
+            from ...ops.manipulation import pad as pad_op
+            extra = []
+            for c, t in zip(cur, tgt):
+                extra += [0, t - c]
+            out = pad_op(out, extra, data_format="NCHW" if not channels_last
+                         else "NHWC")
+    return out
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCL", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 1,
+                           "NCW" if data_format == "NCL" else "NWC", output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 2, data_format, output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 3, data_format, output_size)

@@ -1,0 +1,275 @@
+"""Loss functionals (reference: python/paddle/nn/functional/loss.py; CUDA
+kernels cross_entropy_op.*, softmax_with_cross_entropy_op.*)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, apply
+
+__all__ = [
+    "cross_entropy", "softmax_with_cross_entropy", "binary_cross_entropy",
+    "binary_cross_entropy_with_logits", "nll_loss", "mse_loss", "l1_loss",
+    "kl_div", "smooth_l1_loss", "margin_ranking_loss", "square_error_cost",
+    "log_loss", "hinge_embedding_loss", "cosine_embedding_loss", "ctc_loss",
+    "sigmoid_focal_loss", "triplet_margin_loss", "soft_margin_loss",
+]
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, name=None):
+    def f(logits, lbl, *w):
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
+        else:
+            logp = jnp.log(jnp.maximum(logits.astype(jnp.float32), 1e-30))
+        if soft_label:
+            loss = -jnp.sum(lbl * logp, axis=axis)
+        else:
+            lbl_idx = lbl
+            if lbl_idx.ndim == logp.ndim:
+                lbl_idx = jnp.squeeze(lbl_idx, axis=axis)
+            loss = -jnp.take_along_axis(
+                logp, jnp.expand_dims(lbl_idx, axis).astype(jnp.int32),
+                axis=axis).squeeze(axis)
+            valid = lbl_idx != ignore_index
+            loss = jnp.where(valid, loss, 0.0)
+            if w:
+                wt = jnp.take(w[0], lbl_idx.astype(jnp.int32))
+                wt = jnp.where(valid, wt, 0.0)
+                loss = loss * wt
+                if reduction == "mean":
+                    return jnp.sum(loss) / jnp.maximum(jnp.sum(wt), 1e-12)
+            elif reduction == "mean":
+                denom = jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+                return jnp.sum(loss) / denom
+        return _reduce(loss, reduction)
+
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply(f, *args, op_name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    from .activation import softmax as softmax_fn
+    loss = apply(lambda l: jnp.expand_dims(l, axis), loss)
+    if return_softmax:
+        return loss, softmax_fn(logits, axis=axis)
+    return loss
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def f(p, y, *w):
+        p32 = jnp.clip(p.astype(jnp.float32), 1e-12, 1.0 - 1e-7)
+        loss = -(y * jnp.log(p32) + (1 - y) * jnp.log1p(-p32))
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply(f, *args, op_name="bce")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    def f(z, y, *rest):
+        z32 = z.astype(jnp.float32)
+        y32 = y.astype(jnp.float32)
+        i = 0
+        w = pw = None
+        if weight is not None:
+            w = rest[i]; i += 1
+        if pos_weight is not None:
+            pw = rest[i]
+        log_sig = jax.nn.log_sigmoid(z32)
+        log_one_minus = jax.nn.log_sigmoid(-z32)
+        if pw is not None:
+            loss = -(pw * y32 * log_sig + (1 - y32) * log_one_minus)
+        else:
+            loss = -(y32 * log_sig + (1 - y32) * log_one_minus)
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+    args = [logit, label]
+    if weight is not None:
+        args.append(weight)
+    if pos_weight is not None:
+        args.append(pos_weight)
+    return apply(f, *args, op_name="bce_with_logits")
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    def f(logp, lbl, *w):
+        loss = -jnp.take_along_axis(
+            logp, jnp.expand_dims(lbl, 1).astype(jnp.int32), axis=1).squeeze(1)
+        valid = lbl != ignore_index
+        loss = jnp.where(valid, loss, 0.0)
+        if w:
+            wt = jnp.take(w[0], lbl.astype(jnp.int32)) * valid
+            loss = loss * wt
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(wt), 1e-12)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(
+                jnp.sum(valid.astype(jnp.float32)), 1.0)
+        return _reduce(loss, reduction)
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply(f, *args, op_name="nll_loss")
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply(lambda a, b: _reduce(jnp.square(a - b), reduction),
+                 input, label, op_name="mse_loss")
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply(lambda a, b: _reduce(jnp.abs(a - b), reduction),
+                 input, label, op_name="l1_loss")
+
+
+def square_error_cost(input, label):
+    return apply(lambda a, b: jnp.square(a - b), input, label)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    def f(p, y):
+        return -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon)
+    return apply(f, input, label, op_name="log_loss")
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    def f(logp, y):
+        loss = y * (jnp.log(jnp.maximum(y, 1e-12)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+    return apply(f, input, label, op_name="kl_div")
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def f(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        return _reduce(loss * delta, reduction)
+    return apply(f, input, label, op_name="smooth_l1")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    def f(a, b, y):
+        return _reduce(jnp.maximum(0.0, -y * (a - b) + margin), reduction)
+    return apply(f, input, other, label, op_name="margin_ranking")
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    def f(a, y):
+        loss = jnp.where(y == 1, a, jnp.maximum(0.0, margin - a))
+        return _reduce(loss, reduction)
+    return apply(f, input, label, op_name="hinge_embedding")
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean",
+                          name=None):
+    def f(a, b, y):
+        cos = jnp.sum(a * b, -1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+    return apply(f, input1, input2, label, op_name="cosine_embedding")
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    def f(a, y):
+        return _reduce(jnp.log1p(jnp.exp(-y * a)), reduction)
+    return apply(f, input, label, op_name="soft_margin")
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def f(z, y, *nrm):
+        p = jax.nn.sigmoid(z)
+        ce = -(y * jax.nn.log_sigmoid(z) + (1 - y) * jax.nn.log_sigmoid(-z))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * ((1 - p_t) ** gamma) * ce
+        if nrm:
+            loss = loss / nrm[0]
+        return _reduce(loss, reduction)
+    args = [logit, label] + ([normalizer] if normalizer is not None else [])
+    return apply(f, *args, op_name="sigmoid_focal_loss")
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def f(a, pos, neg):
+        def dist(u, v):
+            return jnp.power(jnp.sum(jnp.abs(u - v) ** p, axis=-1) + epsilon,
+                             1.0 / p)
+        d_pos = dist(a, pos)
+        d_neg = dist(a, neg)
+        if swap:
+            d_neg = jnp.minimum(d_neg, dist(pos, neg))
+        return _reduce(jnp.maximum(0.0, d_pos - d_neg + margin), reduction)
+    return apply(f, input, positive, negative, op_name="triplet_margin")
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via the standard forward algorithm in log space (reference:
+    warpctc dynload). Expects log_probs [T, B, C]."""
+    def f(lp, lbl, in_len, lbl_len):
+        lp = jax.nn.log_softmax(lp.astype(jnp.float32), axis=-1)
+        T, B, C = lp.shape
+        S = lbl.shape[1]
+        ext = jnp.full((B, 2 * S + 1), blank, dtype=lbl.dtype)
+        ext = ext.at[:, 1::2].set(lbl)
+        ext_len = 2 * lbl_len + 1
+        neg_inf = -1e30
+        alpha = jnp.full((B, 2 * S + 1), neg_inf)
+        alpha = alpha.at[:, 0].set(lp[0, :, blank])
+        alpha = alpha.at[:, 1].set(
+            jnp.take_along_axis(lp[0], ext[:, 1:2], axis=1)[:, 0])
+
+        def step(alpha, lp_t):
+            prev1 = jnp.pad(alpha[:, :-1], ((0, 0), (1, 0)),
+                            constant_values=neg_inf)
+            prev2 = jnp.pad(alpha[:, :-2], ((0, 0), (2, 0)),
+                            constant_values=neg_inf)
+            can_skip = jnp.logical_and(
+                ext != blank,
+                jnp.pad(ext[:, :-2], ((0, 0), (2, 0)),
+                        constant_values=-1) != ext)
+            prev2 = jnp.where(can_skip, prev2, neg_inf)
+            new = jnp.logaddexp(jnp.logaddexp(alpha, prev1), prev2)
+            emit = jnp.take_along_axis(lp_t, ext, axis=1)
+            return new + emit, None
+
+        def scan_body(carry, t):
+            alpha = carry
+            new_alpha, _ = step(alpha, lp[t])
+            alpha = jnp.where((t < in_len)[:, None], new_alpha, alpha)
+            return alpha, None
+
+        alpha, _ = jax.lax.scan(scan_body, alpha, jnp.arange(1, T))
+        idx_last = ext_len - 1
+        end1 = jnp.take_along_axis(alpha, idx_last[:, None], axis=1)[:, 0]
+        end2 = jnp.take_along_axis(alpha, (idx_last - 1)[:, None], axis=1)[:, 0]
+        loss = -jnp.logaddexp(end1, end2)
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(lbl_len.astype(jnp.float32), 1.0))
+        return _reduce(loss, reduction)
+    return apply(f, log_probs, labels, input_lengths, label_lengths,
+                 op_name="ctc_loss")

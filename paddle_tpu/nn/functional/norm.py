@@ -1,0 +1,193 @@
+"""Normalization functionals (reference: python/paddle/nn/functional/norm.py;
+CUDA kernels batch_norm_op.cu, layer_norm_op.cu). XLA fuses these into the
+surrounding elementwise graph; a Pallas fused layer_norm is used for the
+transformer hot path when shapes qualify (ops/pallas)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, apply
+
+__all__ = ["batch_norm", "layer_norm", "instance_norm", "group_norm",
+           "local_response_norm", "normalize"]
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def f(a):
+        if p == 2:
+            n = jnp.sqrt(jnp.sum(a * a, axis=axis, keepdims=True))
+        else:
+            n = jnp.sum(jnp.abs(a) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return a / jnp.maximum(n, epsilon)
+    return apply(f, x)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5, data_format="NCHW",
+               use_global_stats=None, name=None):
+    """Training mode updates running stats in place on the passed Tensors
+    (functional_call captures the new values into the returned state)."""
+    channels_last = data_format in ("NHWC", "NLC", "NDHWC")
+    use_batch_stats = training and not use_global_stats
+
+    ch_axis = -1 if channels_last else (1 if x.ndim > 1 else 0)
+    reduce_axes = tuple(i for i in range(x.ndim) if i != (ch_axis % x.ndim))
+
+    if use_batch_stats:
+        # compute in fp32 for stability regardless of activation dtype
+        mean_new = apply(lambda a: jnp.mean(a.astype(jnp.float32),
+                                            axis=reduce_axes), x)
+        var_new = apply(lambda a: jnp.var(a.astype(jnp.float32),
+                                          axis=reduce_axes), x)
+        with_stats_mean, with_stats_var = mean_new, var_new
+        # running-stat update (reference: batch_norm_op momentum convention:
+        # running = momentum * running + (1-momentum) * batch)
+        if running_mean is not None:
+            running_mean.set_value(
+                momentum * running_mean._data.astype(jnp.float32)
+                + (1.0 - momentum) * mean_new._data)
+        if running_var is not None:
+            n = 1
+            for i in reduce_axes:
+                n *= x.shape[i]
+            unbiased = var_new._data * (n / max(n - 1, 1))
+            running_var.set_value(
+                momentum * running_var._data.astype(jnp.float32)
+                + (1.0 - momentum) * unbiased)
+    else:
+        with_stats_mean, with_stats_var = running_mean, running_var
+
+    def f(a, m, v, *wb):
+        shape = [1] * a.ndim
+        shape[ch_axis] = a.shape[ch_axis]
+        m = m.reshape(shape).astype(jnp.float32)
+        v = v.reshape(shape).astype(jnp.float32)
+        out = (a.astype(jnp.float32) - m) * jax.lax.rsqrt(v + epsilon)
+        if wb:
+            w = wb[0].reshape(shape).astype(jnp.float32)
+            out = out * w
+            if len(wb) > 1:
+                out = out + wb[1].reshape(shape).astype(jnp.float32)
+        return out.astype(a.dtype)
+
+    args = [x, with_stats_mean, with_stats_var]
+    if weight is not None:
+        args.append(weight)
+        if bias is not None:
+            args.append(bias)
+    return apply(f, *args, op_name="batch_norm")
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n_axes = len(normalized_shape)
+
+    def f(a, *wb):
+        axes = tuple(range(a.ndim - n_axes, a.ndim))
+        x32 = a.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=axes, keepdims=True)
+        var = jnp.mean(jnp.square(x32 - mean), axis=axes, keepdims=True)
+        out = (x32 - mean) * jax.lax.rsqrt(var + epsilon)
+        if wb:
+            out = out * wb[0].astype(jnp.float32)
+            if len(wb) > 1:
+                out = out + wb[1].astype(jnp.float32)
+        return out.astype(a.dtype)
+
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+        if bias is not None:
+            args.append(bias)
+    return apply(f, *args, op_name="layer_norm")
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW", name=None):
+    channels_last = not data_format.startswith("NC")
+    ch_axis = -1 if channels_last else 1
+
+    def f(a, *wb):
+        sp_axes = tuple(range(2, a.ndim)) if not channels_last else \
+            tuple(range(1, a.ndim - 1))
+        x32 = a.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=sp_axes, keepdims=True)
+        var = jnp.var(x32, axis=sp_axes, keepdims=True)
+        out = (x32 - mean) * jax.lax.rsqrt(var + eps)
+        if wb:
+            shape = [1] * a.ndim
+            shape[ch_axis] = a.shape[ch_axis]
+            out = out * wb[0].reshape(shape).astype(jnp.float32)
+            if len(wb) > 1:
+                out = out + wb[1].reshape(shape).astype(jnp.float32)
+        return out.astype(a.dtype)
+
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+        if bias is not None:
+            args.append(bias)
+    return apply(f, *args, op_name="instance_norm")
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    channels_last = not data_format.startswith("NC")
+
+    def f(a, *wb):
+        if channels_last:
+            a_nc = jnp.moveaxis(a, -1, 1)
+        else:
+            a_nc = a
+        n, c = a_nc.shape[:2]
+        spatial = a_nc.shape[2:]
+        g = a_nc.reshape((n, num_groups, c // num_groups) + spatial)
+        axes = tuple(range(2, g.ndim))
+        g32 = g.astype(jnp.float32)
+        mean = jnp.mean(g32, axis=axes, keepdims=True)
+        var = jnp.var(g32, axis=axes, keepdims=True)
+        out = ((g32 - mean) * jax.lax.rsqrt(var + epsilon)).reshape(a_nc.shape)
+        if wb:
+            shape = [1] * a_nc.ndim
+            shape[1] = c
+            out = out * wb[0].reshape(shape).astype(jnp.float32)
+            if len(wb) > 1:
+                out = out + wb[1].reshape(shape).astype(jnp.float32)
+        out = out.astype(a.dtype)
+        if channels_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+        if bias is not None:
+            args.append(bias)
+    return apply(f, *args, op_name="group_norm")
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    def f(a):
+        channels_last = not data_format.startswith("NC")
+        if channels_last:
+            a_nc = jnp.moveaxis(a, -1, 1)
+        else:
+            a_nc = a
+        sq = jnp.square(a_nc)
+        c = a_nc.shape[1]
+        half = size // 2
+        padded = jnp.pad(sq, [(0, 0), (half, size - 1 - half)] +
+                         [(0, 0)] * (a_nc.ndim - 2))
+        acc = jnp.zeros_like(a_nc)
+        for i in range(size):
+            acc = acc + jax.lax.dynamic_slice_in_dim(padded, i, c, axis=1)
+        out = a_nc / jnp.power(k + alpha * acc / size, beta)
+        if channels_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+    return apply(f, x)

@@ -1,0 +1,198 @@
+"""Pooling functionals (reference: python/paddle/nn/functional/pooling.py).
+Lowered to lax.reduce_window — XLA's native windowed reduction."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, apply
+
+__all__ = [
+    "avg_pool1d", "avg_pool2d", "avg_pool3d", "max_pool1d", "max_pool2d",
+    "max_pool3d", "adaptive_avg_pool1d", "adaptive_avg_pool2d",
+    "adaptive_avg_pool3d", "adaptive_max_pool1d", "adaptive_max_pool2d",
+    "adaptive_max_pool3d",
+]
+
+
+def _tuplize(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(int(i) for i in v)
+
+
+def _pads(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    return [tuple(p) for p in padding]
+
+
+def _pool(x, kernel, stride, padding, n, data_format, reducer, init,
+          ceil_mode=False, exclusive=True, divisor_override=None):
+    channels_last = not data_format.startswith("NC")
+    kernel = _tuplize(kernel, n)
+    stride = _tuplize(stride if stride is not None else kernel, n)
+    pads = _pads(padding, n)
+
+    if channels_last:
+        window = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+        full_pads = [(0, 0)] + (pads if not isinstance(pads, str) else pads) + [(0, 0)] \
+            if not isinstance(pads, str) else pads
+    else:
+        window = (1, 1) + kernel
+        strides = (1, 1) + stride
+        full_pads = [(0, 0), (0, 0)] + pads if not isinstance(pads, str) else pads
+
+    def f(a):
+        p = full_pads
+        if ceil_mode and not isinstance(p, str):
+            p = _ceil_pads(a, p, kernel, stride, n, channels_last)
+        out = jax.lax.reduce_window(a, init(a.dtype), reducer, window,
+                                    strides, p)
+        if reducer is jax.lax.add:  # average pooling: divide by window count
+            if divisor_override:
+                return out / divisor_override
+            padded = isinstance(p, str) or any(q != (0, 0) for q in p)
+            if exclusive and padded:
+                # count only in-bounds elements per window
+                cnt = jax.lax.reduce_window(jnp.ones_like(a), init(a.dtype),
+                                            jax.lax.add, window, strides, p)
+                return out / cnt
+            return out / np.prod(kernel)
+        return out
+    return apply(f, x, op_name="pool")
+
+
+def _ceil_pads(a, pads, kernel, stride, n, channels_last):
+    if isinstance(pads, str):
+        return pads
+    pads = [list(p) for p in pads]
+    sp_axes = list(range(1, 1 + n)) if channels_last else list(range(2, 2 + n))
+    for i, ax in enumerate(sp_axes):
+        pi = pads[ax]
+        size = a.shape[ax] + pi[0] + pi[1]
+        rem = (size - kernel[i]) % stride[i]
+        if rem != 0:
+            pi[1] += stride[i] - rem
+    return [tuple(p) for p in pads]
+
+
+def _neg_inf(dtype):
+    return jnp.asarray(-jnp.inf, dtype) if jnp.issubdtype(dtype, jnp.floating) \
+        else jnp.iinfo(dtype).min
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    out = _pool(x, kernel_size, stride, padding, 1,
+                "NCW" if data_format == "NCL" else "NWC",
+                jax.lax.max, _neg_inf, ceil_mode)
+    return (out, None) if return_mask else out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 2, data_format,
+                jax.lax.max, _neg_inf, ceil_mode)
+    return (out, None) if return_mask else out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 3, data_format,
+                jax.lax.max, _neg_inf, ceil_mode)
+    return (out, None) if return_mask else out
+
+
+def _zero(dtype):
+    return jnp.zeros((), dtype)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1,
+                 "NCW" if data_format == "NCL" else "NWC",
+                 jax.lax.add, _zero, ceil_mode, exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 2, data_format,
+                 jax.lax.add, _zero, ceil_mode, exclusive, divisor_override)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format,
+                 jax.lax.add, _zero, ceil_mode, exclusive, divisor_override)
+
+
+def _adaptive(x, output_size, n, data_format, is_max):
+    channels_last = not data_format.startswith("NC")
+    out_sizes = _tuplize(output_size, n)
+
+    def f(a):
+        sp_axes = list(range(1, 1 + n)) if channels_last else \
+            list(range(2, 2 + n))
+        out = a
+        for ax, osz in zip(sp_axes, out_sizes):
+            if osz is None:
+                continue
+            isz = out.shape[ax]
+            if isz % osz == 0:
+                k = isz // osz
+                shape = list(out.shape)
+                shape[ax:ax + 1] = [osz, k]
+                r = out.reshape(shape)
+                out = (jnp.max if is_max else jnp.mean)(r, axis=ax + 1)
+            else:
+                # general case: per-output-bin segments
+                starts = (np.arange(osz) * isz) // osz
+                ends = ((np.arange(osz) + 1) * isz + osz - 1) // osz
+                pieces = []
+                for s, e in zip(starts, ends):
+                    seg = jax.lax.slice_in_dim(out, int(s), int(e), axis=ax)
+                    pieces.append((jnp.max if is_max else jnp.mean)(
+                        seg, axis=ax, keepdims=True))
+                out = jnp.concatenate(pieces, axis=ax)
+        return out
+    return apply(f, x, op_name="adaptive_pool")
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive(x, output_size, 1, "NCW", False)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive(x, output_size, 2, data_format, False)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive(x, output_size, 3, data_format, False)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    out = _adaptive(x, output_size, 1, "NCW", True)
+    return (out, None) if return_mask else out
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    out = _adaptive(x, output_size, 2, "NCHW", True)
+    return (out, None) if return_mask else out
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    out = _adaptive(x, output_size, 3, "NCDHW", True)
+    return (out, None) if return_mask else out

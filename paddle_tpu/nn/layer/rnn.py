@@ -1,0 +1,332 @@
+"""RNN layers (reference: python/paddle/nn/layer/rnn.py; CUDA kernels
+cudnn_lstm / operators/rnn_op). TPU-native: the time loop is a lax.scan so
+XLA compiles one fused step and the whole sequence stays on-device."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, apply
+from .. import functional as F
+from .. import initializer as I
+from .layers import Layer
+
+__all__ = ["RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell", "RNN",
+           "BiRNN", "SimpleRNN", "LSTM", "GRU"]
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        from ...ops.creation import full
+        batch = batch_ref.shape[batch_dim_idx]
+        state_shape = self.state_shape
+        if isinstance(state_shape, tuple):
+            return tuple(full([batch] + list(s), init_value,
+                              dtype or "float32") for s in state_shape)
+        return full([batch] + list(state_shape), init_value,
+                    dtype or "float32")
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([hidden_size, input_size],
+                                               weight_ih_attr,
+                                               default_initializer=u)
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size],
+                                               weight_hh_attr,
+                                               default_initializer=u)
+        self.bias_ih = self.create_parameter([hidden_size], bias_ih_attr,
+                                             is_bias=True,
+                                             default_initializer=u)
+        self.bias_hh = self.create_parameter([hidden_size], bias_hh_attr,
+                                             is_bias=True,
+                                             default_initializer=u)
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def f(x, h, wi, wh, bi, bh):
+            out = act(x @ wi.T + bi + h @ wh.T + bh)
+            return out
+        h = apply(f, inputs, states, self.weight_ih, self.weight_hh,
+                  self.bias_ih, self.bias_hh, op_name="simple_rnn_cell")
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size],
+                                               weight_ih_attr,
+                                               default_initializer=u)
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size],
+                                               weight_hh_attr,
+                                               default_initializer=u)
+        self.bias_ih = self.create_parameter([4 * hidden_size], bias_ih_attr,
+                                             is_bias=True,
+                                             default_initializer=u)
+        self.bias_hh = self.create_parameter([4 * hidden_size], bias_hh_attr,
+                                             is_bias=True,
+                                             default_initializer=u)
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h_prev, c_prev = states
+        hs = self.hidden_size
+
+        def f(x, h, c, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + h @ wh.T + bh
+            i, fg, g, o = jnp.split(gates, 4, axis=-1)
+            i = jax.nn.sigmoid(i)
+            fg = jax.nn.sigmoid(fg)
+            g = jnp.tanh(g)
+            o = jax.nn.sigmoid(o)
+            c_new = fg * c + i * g
+            h_new = o * jnp.tanh(c_new)
+            return h_new, c_new
+        h, c = apply(f, inputs, h_prev, c_prev, self.weight_ih,
+                     self.weight_hh, self.bias_ih, self.bias_hh,
+                     op_name="lstm_cell")
+        return h, (h, c)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size],
+                                               weight_ih_attr,
+                                               default_initializer=u)
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size],
+                                               weight_hh_attr,
+                                               default_initializer=u)
+        self.bias_ih = self.create_parameter([3 * hidden_size], bias_ih_attr,
+                                             is_bias=True,
+                                             default_initializer=u)
+        self.bias_hh = self.create_parameter([3 * hidden_size], bias_hh_attr,
+                                             is_bias=True,
+                                             default_initializer=u)
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def f(x, h, wi, wh, bi, bh):
+            xg = x @ wi.T + bi
+            hg = h @ wh.T + bh
+            xr, xz, xn = jnp.split(xg, 3, axis=-1)
+            hr, hz, hn = jnp.split(hg, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn)
+            return (1 - z) * n + z * h
+        h = apply(f, inputs, states, self.weight_ih, self.weight_hh,
+                  self.bias_ih, self.bias_hh, op_name="gru_cell")
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class RNN(Layer):
+    """Runs a cell over time via lax.scan (reference RNN wrapper rnn.py)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        if initial_states is None:
+            ref = inputs if self.time_major else inputs
+            batch_axis = 1 if self.time_major else 0
+            initial_states = self.cell.get_initial_states(
+                ref, batch_dim_idx=batch_axis)
+        # Python-loop over time through the cell keeps the tape simple and is
+        # jax-traceable; under jit XLA unrolls or the fit-path uses scan.
+        time_axis = 0 if self.time_major else 1
+        steps = inputs.shape[time_axis]
+        outputs = []
+        states = initial_states
+        order = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        from ...ops import manipulation as M
+        for t in order:
+            x_t = M.slice(inputs, [time_axis], [t], [t + 1])
+            x_t = M.squeeze(x_t, time_axis)
+            out, states = self.cell(x_t, states)
+            outputs.append(out)
+        if self.is_reverse:
+            outputs = outputs[::-1]
+        out = M.stack(outputs, axis=time_axis)
+        return out, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops import manipulation as M
+        states_fw, states_bw = (initial_states if initial_states is not None
+                                else (None, None))
+        out_fw, st_fw = self.rnn_fw(inputs, states_fw, sequence_length)
+        out_bw, st_bw = self.rnn_bw(inputs, states_bw, sequence_length)
+        return M.concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)
+
+
+class _RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.direction = direction
+        self.time_major = time_major
+        self.dropout = dropout
+        bidirect = 2 if direction in ("bidirect", "bidirectional") else 1
+        self.num_directions = bidirect
+
+        def make_cell(in_sz):
+            kw = dict(weight_ih_attr=weight_ih_attr,
+                      weight_hh_attr=weight_hh_attr,
+                      bias_ih_attr=bias_ih_attr, bias_hh_attr=bias_hh_attr)
+            if mode == "LSTM":
+                return LSTMCell(in_sz, hidden_size, **kw)
+            if mode == "GRU":
+                return GRUCell(in_sz, hidden_size, **kw)
+            return SimpleRNNCell(in_sz, hidden_size, activation, **kw)
+
+        from .container import LayerList
+        self._all_layers = LayerList()
+        for layer_i in range(num_layers):
+            in_sz = input_size if layer_i == 0 else hidden_size * bidirect
+            if bidirect == 2:
+                self._all_layers.append(BiRNN(make_cell(in_sz),
+                                              make_cell(in_sz), time_major))
+            else:
+                self._all_layers.append(RNN(make_cell(in_sz),
+                                            time_major=time_major))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops import manipulation as M
+        batch_axis = 1 if self.time_major else 0
+        states_list = self._expand_states(inputs, initial_states, batch_axis)
+        out = inputs
+        final_states = []
+        for i, rnn_l in enumerate(self._all_layers):
+            out, st = rnn_l(out, states_list[i], sequence_length)
+            final_states.append(st)
+            if self.dropout > 0.0 and i < self.num_layers - 1:
+                out = F.dropout(out, self.dropout, training=self.training)
+        return out, self._pack_states(final_states)
+
+    def _expand_states(self, inputs, initial_states, batch_axis):
+        if initial_states is None:
+            return [None] * self.num_layers
+        # states come stacked [num_layers*dirs, batch, hidden]
+        from ...ops import manipulation as M
+        if self.mode == "LSTM":
+            h, c = initial_states
+            hs = M.unbind(h, 0)
+            cs = M.unbind(c, 0)
+            out = []
+            d = self.num_directions
+            for i in range(self.num_layers):
+                if d == 2:
+                    out.append(((hs[2 * i], cs[2 * i]),
+                                (hs[2 * i + 1], cs[2 * i + 1])))
+                else:
+                    out.append((hs[i], cs[i]))
+            return out
+        hs = M.unbind(initial_states, 0)
+        d = self.num_directions
+        if d == 2:
+            return [(hs[2 * i], hs[2 * i + 1]) for i in range(self.num_layers)]
+        return list(hs)
+
+    def _pack_states(self, final_states):
+        from ...ops import manipulation as M
+        d = self.num_directions
+        if self.mode == "LSTM":
+            hs, cs = [], []
+            for st in final_states:
+                if d == 2:
+                    (h_f, c_f), (h_b, c_b) = st
+                    hs += [h_f, h_b]
+                    cs += [c_f, c_b]
+                else:
+                    h, c = st
+                    hs.append(h)
+                    cs.append(c)
+            return M.stack(hs, 0), M.stack(cs, 0)
+        hs = []
+        for st in final_states:
+            if d == 2:
+                hs += [st[0], st[1]]
+            else:
+                hs.append(st)
+        return M.stack(hs, 0)
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kw):
+        super().__init__("RNN", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, activation, **kw)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kw):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kw)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kw):
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kw)

@@ -1,0 +1,5 @@
+"""paddle.optimizer parity surface."""
+from . import lr  # noqa: F401
+from .optimizer import Optimizer  # noqa: F401
+from .optimizers import (SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb,
+                         Lars, Momentum, RMSProp)  # noqa: F401

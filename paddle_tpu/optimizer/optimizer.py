@@ -1,0 +1,187 @@
+"""Optimizer base (reference: python/paddle/optimizer/optimizer.py:48; CUDA
+kernels operators/optimizers/*).
+
+TPU-native design: every optimizer is defined by two pure functions
+  init_state(param)                  -> per-param state pytree
+  apply_one(param, grad, state, lr)  -> (new_param, new_state)
+The eager `step()` applies them per parameter (dygraph parity). The jitted
+fit path calls `functional_update` on whole pytrees inside the compiled
+train step — XLA fuses the update into one kernel sweep, which subsumes the
+reference's fuse_optimizer_ops_pass (SURVEY.md row 22).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.errors import InvalidArgumentError, enforce
+from ..core.tensor import Tensor, no_grad
+from .lr import LRScheduler
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, multi_precision=False):
+        self._learning_rate = learning_rate
+        self._parameter_list = list(parameters) if parameters is not None else None
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        if isinstance(weight_decay, float) or weight_decay is None:
+            self._coupled_wd = weight_decay  # L2-style added to grad
+        else:
+            self._coupled_wd = getattr(weight_decay, "_coeff", None)
+        self._state: Dict[int, dict] = {}       # id(param) -> state pytree
+        self._master: Dict[int, jax.Array] = {}  # fp32 master weights
+        self._accumulators_created = False
+
+    # -- hyperparameters ----------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        enforce(not isinstance(self._learning_rate, LRScheduler),
+                "cannot set_lr when using an LRScheduler",
+                InvalidArgumentError)
+        self._learning_rate = float(value)
+
+    @property
+    def _lr_scheduler(self):
+        return self._learning_rate if isinstance(self._learning_rate,
+                                                 LRScheduler) else None
+
+    # -- subclass interface (pure) -----------------------------------------
+    def init_state(self, param: jax.Array) -> dict:
+        return {}
+
+    def apply_one(self, param, grad, state, lr, wd):
+        raise NotImplementedError
+
+    # -- eager step ---------------------------------------------------------
+    @no_grad()
+    def step(self):
+        params = self._parameter_list
+        enforce(params is not None,
+                "Optimizer created without a parameter list; pass "
+                "parameters=model.parameters()", InvalidArgumentError)
+        params_grads = [(p, p.grad) for p in params
+                        if (p.grad is not None and p.trainable
+                            and not p.stop_gradient)]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        lr = self.get_lr()
+        for p, g in params_grads:
+            if g is None:
+                continue
+            pid = id(p)
+            if pid not in self._state:
+                self._state[pid] = self.init_state(p._data)
+                if self._multi_precision and p.dtype != jnp.float32:
+                    self._master[pid] = p._data.astype(jnp.float32)
+            wd = self._param_wd(p)
+            arr = self._master.get(pid, p._data)
+            g_arr = g._data
+            if g_arr.dtype != arr.dtype:
+                g_arr = g_arr.astype(arr.dtype)
+            new_p, new_s = self.apply_one(arr, g_arr, self._state[pid], lr, wd)
+            self._state[pid] = new_s
+            if pid in self._master:
+                self._master[pid] = new_p
+                p._data = new_p.astype(p._data.dtype)
+            else:
+                p._data = new_p
+
+    minimize_step = step
+
+    def _param_wd(self, p):
+        wd = self._coupled_wd or 0.0
+        reg = getattr(p, "regularizer", None)
+        if reg is not None and hasattr(reg, "_coeff"):
+            wd = reg._coeff
+        return wd
+
+    @no_grad()
+    def clear_grad(self, set_to_zero=False):
+        if self._parameter_list:
+            for p in self._parameter_list:
+                p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    # -- functional pytree path (used by jitted train steps) ---------------
+    def functional_init(self, params: Dict[str, jax.Array]):
+        return {k: self.init_state(v) for k, v in params.items()}
+
+    def functional_update(self, params: Dict[str, jax.Array],
+                          grads: Dict[str, jax.Array], opt_state, lr=None):
+        """Pure: (params, grads, state) -> (new_params, new_state).
+        Safe to call inside jax.jit; `lr` may be a traced scalar."""
+        if lr is None:
+            lr = self.get_lr()
+        if self._grad_clip is not None:
+            grads = _clip_pytree(grads, self._grad_clip)
+        new_params, new_state = {}, {}
+        for k, p in params.items():
+            g = grads.get(k)
+            if g is None:
+                new_params[k] = p
+                new_state[k] = opt_state[k]
+                continue
+            if g.dtype != p.dtype:
+                g = g.astype(p.dtype)
+            wd = self._coupled_wd or 0.0
+            new_params[k], new_state[k] = self.apply_one(
+                p, g, opt_state[k], lr, wd)
+        return new_params, new_state
+
+    # -- checkpoint ---------------------------------------------------------
+    def state_dict(self):
+        out = {"LR_Scheduler": (self._lr_scheduler.state_dict()
+                                if self._lr_scheduler else
+                                {"lr": self.get_lr()})}
+        if self._parameter_list:
+            name_of = {id(p): p.name for p in self._parameter_list}
+            for pid, st in self._state.items():
+                base = name_of.get(pid, str(pid))
+                for k, v in st.items():
+                    out[f"{base}_{k}"] = Tensor(v) if isinstance(
+                        v, jax.Array) else v
+        return out
+
+    def set_state_dict(self, state_dict):
+        sch = state_dict.get("LR_Scheduler")
+        if sch and self._lr_scheduler:
+            self._lr_scheduler.set_state_dict(sch)
+        if not self._parameter_list:
+            return
+        for p in self._parameter_list:
+            pid = id(p)
+            st = self._state.get(pid) or self.init_state(p._data)
+            loaded = {}
+            for k in st:
+                key = f"{p.name}_{k}"
+                if key in state_dict:
+                    v = state_dict[key]
+                    loaded[k] = v._data if isinstance(v, Tensor) else jnp.asarray(v)
+                else:
+                    loaded[k] = st[k]
+            self._state[pid] = loaded
+
+    def _create_accumulators(self, *a, **k):  # legacy hook
+        pass
+
+
+def _clip_pytree(grads: Dict[str, jax.Array], clip):
+    """Apply a ClipGradBy* object to a dict of raw grads (functional path)."""
+    fake = [(None, Tensor(g)) for g in grads.values()]
+    clipped = clip(fake)
+    return {k: t._data for k, (_, t) in zip(grads.keys(), clipped)}

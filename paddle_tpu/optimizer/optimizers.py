@@ -1,0 +1,250 @@
+"""Concrete optimizers (reference: python/paddle/optimizer/{sgd,momentum,adam,
+adamw,lamb,rmsprop,adagrad,adadelta,adamax}.py; kernels operators/optimizers/).
+Each is a pair of pure functions over one param — see optimizer.py."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+__all__ = ["SGD", "Momentum", "Adam", "AdamW", "Lamb", "RMSProp", "Adagrad",
+           "Adadelta", "Adamax", "Lars"]
+
+
+class SGD(Optimizer):
+    def apply_one(self, p, g, s, lr, wd):
+        if wd:
+            g = g + wd * p
+        return p - lr * g, s
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def init_state(self, p):
+        return {"velocity": jnp.zeros_like(p)}
+
+    def apply_one(self, p, g, s, lr, wd):
+        if wd:
+            g = g + wd * p
+        v = self._momentum * s["velocity"] + g
+        if self._nesterov:
+            update = g + self._momentum * v
+        else:
+            update = v
+        return p - lr * update, {"velocity": v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def init_state(self, p):
+        return {"moment1": jnp.zeros_like(p), "moment2": jnp.zeros_like(p),
+                "beta1_pow": jnp.ones([], jnp.float32),
+                "beta2_pow": jnp.ones([], jnp.float32)}
+
+    def _adam_core(self, p, g, s, lr):
+        b1, b2, eps = self._beta1, self._beta2, self._eps
+        m = b1 * s["moment1"] + (1 - b1) * g
+        v = b2 * s["moment2"] + (1 - b2) * (g * g)
+        b1p = s["beta1_pow"] * b1
+        b2p = s["beta2_pow"] * b2
+        mhat = m / (1 - b1p).astype(p.dtype)
+        vhat = v / (1 - b2p).astype(p.dtype)
+        new_p = p - lr * mhat / (jnp.sqrt(vhat) + eps)
+        return new_p, {"moment1": m, "moment2": v, "beta1_pow": b1p,
+                       "beta2_pow": b2p}
+
+    def apply_one(self, p, g, s, lr, wd):
+        if wd:  # coupled L2 (reference Adam regularization path)
+            g = g + wd * p
+        return self._adam_core(p, g, s, lr)
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: python/paddle/optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision, name)
+        self._wd = weight_decay
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._coupled_wd = None
+        self._decoupled_wd = weight_decay
+
+    def apply_one(self, p, g, s, lr, wd):
+        new_p, new_s = self._adam_core(p, g, s, lr)
+        new_p = new_p - lr * self._decoupled_wd * p
+        return new_p, new_s
+
+
+class Lamb(Optimizer):
+    """reference: operators/optimizers/lamb_op.h (trust-ratio Adam)."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def init_state(self, p):
+        return {"moment1": jnp.zeros_like(p), "moment2": jnp.zeros_like(p),
+                "beta1_pow": jnp.ones([], jnp.float32),
+                "beta2_pow": jnp.ones([], jnp.float32)}
+
+    def apply_one(self, p, g, s, lr, wd):
+        b1, b2, eps = self._beta1, self._beta2, self._eps
+        m = b1 * s["moment1"] + (1 - b1) * g
+        v = b2 * s["moment2"] + (1 - b2) * (g * g)
+        b1p = s["beta1_pow"] * b1
+        b2p = s["beta2_pow"] * b2
+        mhat = m / (1 - b1p).astype(p.dtype)
+        vhat = v / (1 - b2p).astype(p.dtype)
+        r = mhat / (jnp.sqrt(vhat) + eps) + self._wd * p
+        p_norm = jnp.linalg.norm(p)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+        return p - lr * trust * r, {"moment1": m, "moment2": v,
+                                    "beta1_pow": b1p, "beta2_pow": b2p}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, rho=0.95, epsilon=1e-6,
+                 momentum=0.0, centered=False, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._rho, self._eps = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def init_state(self, p):
+        s = {"mean_square": jnp.zeros_like(p),
+             "momentum": jnp.zeros_like(p)}
+        if self._centered:
+            s["mean_grad"] = jnp.zeros_like(p)
+        return s
+
+    def apply_one(self, p, g, s, lr, wd):
+        if wd:
+            g = g + wd * p
+        ms = self._rho * s["mean_square"] + (1 - self._rho) * g * g
+        if self._centered:
+            mg = self._rho * s["mean_grad"] + (1 - self._rho) * g
+            denom = jnp.sqrt(ms - mg * mg + self._eps)
+        else:
+            mg = None
+            denom = jnp.sqrt(ms + self._eps)
+        mom = self._momentum * s["momentum"] + lr * g / denom
+        new_s = {"mean_square": ms, "momentum": mom}
+        if self._centered:
+            new_s["mean_grad"] = mg
+        return p - mom, new_s
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def init_state(self, p):
+        return {"moment": jnp.full_like(p, self._init_acc)}
+
+    def apply_one(self, p, g, s, lr, wd):
+        if wd:
+            g = g + wd * p
+        acc = s["moment"] + g * g
+        return p - lr * g / (jnp.sqrt(acc) + self._eps), {"moment": acc}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._eps, self._rho = epsilon, rho
+
+    def init_state(self, p):
+        return {"avg_squared_grad": jnp.zeros_like(p),
+                "avg_squared_update": jnp.zeros_like(p)}
+
+    def apply_one(self, p, g, s, lr, wd):
+        if wd:
+            g = g + wd * p
+        asg = self._rho * s["avg_squared_grad"] + (1 - self._rho) * g * g
+        update = g * jnp.sqrt(s["avg_squared_update"] + self._eps) / \
+            jnp.sqrt(asg + self._eps)
+        asu = self._rho * s["avg_squared_update"] + (1 - self._rho) * \
+            update * update
+        return p - lr * update, {"avg_squared_grad": asg,
+                                 "avg_squared_update": asu}
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def init_state(self, p):
+        return {"moment": jnp.zeros_like(p), "inf_norm": jnp.zeros_like(p),
+                "beta1_pow": jnp.ones([], jnp.float32)}
+
+    def apply_one(self, p, g, s, lr, wd):
+        if wd:
+            g = g + wd * p
+        m = self._beta1 * s["moment"] + (1 - self._beta1) * g
+        u = jnp.maximum(self._beta2 * s["inf_norm"], jnp.abs(g) + self._eps)
+        b1p = s["beta1_pow"] * self._beta1
+        new_p = p - lr / (1 - b1p).astype(p.dtype) * m / u
+        return new_p, {"moment": m, "inf_norm": u, "beta1_pow": b1p}
+
+
+class Lars(Momentum):
+    """LARS (reference: operators/optimizers/lars_momentum_op.*;
+    fleet lars meta-optimizer)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9,
+                 lars_coeff=0.001, lars_weight_decay=0.0005, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay=None,
+                 epsilon=1e-9, multi_precision=False, name=None):
+        super().__init__(learning_rate, momentum, parameters, False, None,
+                         grad_clip, multi_precision, name)
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+        self._lars_eps = epsilon
+
+    def apply_one(self, p, g, s, lr, wd):
+        p_norm = jnp.linalg.norm(p)
+        g_norm = jnp.linalg.norm(g)
+        local_lr = jnp.where(
+            (p_norm > 0) & (g_norm > 0),
+            self._lars_coeff * p_norm /
+            (g_norm + self._lars_wd * p_norm + self._lars_eps), 1.0)
+        g_eff = g + self._lars_wd * p
+        v = self._momentum * s["velocity"] + lr * local_lr * g_eff
+        return p - v, {"velocity": v}
