@@ -118,6 +118,7 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        self._unscaled = set()  # optimizer ids unscaled since last update()
 
     def is_enable(self):
         return self._enable
@@ -131,11 +132,17 @@ class GradScaler:
     def scale(self, loss):
         if not self._enable:
             return loss
+        self._unscaled.clear()  # new iteration begins
         return loss * self._scale
 
     def unscale_(self, optimizer):
         if not self._enable:
             return
+        # guard against double unscale (the documented pattern is
+        # unscale_ → clip → step; step() calls unscale_ again)
+        if id(optimizer) in self._unscaled:
+            return
+        self._unscaled.add(id(optimizer))
         params = optimizer._parameter_list or []
         inv = 1.0 / self._scale
         found = False
@@ -156,13 +163,14 @@ class GradScaler:
         self.unscale_(optimizer)
         if not self._found_inf:
             optimizer.step()
-        self.update()
 
     def minimize(self, optimizer, scaled_loss):
         scaled_loss.backward()
         self.step(optimizer)
+        self.update()
 
     def update(self):
+        self._unscaled.clear()
         if not (self._enable and self._dynamic):
             return
         if self._found_inf:

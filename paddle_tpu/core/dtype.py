@@ -8,6 +8,20 @@ API parity but bf16 is preferred throughout (MXU native).
 """
 from __future__ import annotations
 
+import jax
+
+# Paddle's dtype surface includes int64/float64 tensors (int64 is the default
+# index/label dtype). Enable x64 so those dtypes are real; JAX weak typing
+# keeps python-scalar arithmetic at float32, and the framework's creation /
+# division paths pin the default float dtype explicitly, so the hot path
+# stays f32/bf16 (TPU has no f64 MXU). This is process-global: applications
+# embedding plain JAX code alongside paddle_tpu can opt out with
+# PADDLE_TPU_NO_X64=1 (int64/float64 tensors then degrade to int32/float32).
+import os as _os
+
+if _os.environ.get("PADDLE_TPU_NO_X64", "0") != "1":
+    jax.config.update("jax_enable_x64", True)
+
 import jax.numpy as jnp
 import numpy as np
 

@@ -127,9 +127,14 @@ class Tensor:
             elif isinstance(data, float) and np_dtype is None:
                 data = jnp.asarray(data, dtype_mod.get_default_dtype())
             else:
-                if (np_dtype is None and isinstance(data, np.ndarray)
-                        and data.dtype == np.float64):
-                    np_dtype = dtype_mod.get_default_dtype()
+                if np_dtype is None:
+                    # python lists / float64 numpy default to the framework
+                    # default float dtype (paddle: to_tensor float data →
+                    # get_default_dtype), not x64-inferred float64
+                    if not isinstance(data, np.ndarray):
+                        data = np.asarray(data)
+                    if data.dtype == np.float64:
+                        np_dtype = dtype_mod.get_default_dtype()
                 data = jnp.asarray(data, np_dtype)
         dev = place_mod._place_to_jax_device(place)
         if dev is not None and not _is_tracer(data):

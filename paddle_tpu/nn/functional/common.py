@@ -268,9 +268,9 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
         for ax, t in zip(sp_axes, tgt):
             s = out.shape[ax]
             if t == 1 or s == 1:
-                idx = jnp.zeros(t)
+                idx = jnp.zeros(t, jnp.float32)
             else:
-                idx = jnp.linspace(0.0, s - 1.0, t)
+                idx = jnp.linspace(0.0, s - 1.0, t, dtype=jnp.float32)
             i0 = jnp.floor(idx).astype(jnp.int32)
             i1 = jnp.minimum(i0 + 1, s - 1)
             frac = (idx - i0).reshape([-1 if d == ax else 1

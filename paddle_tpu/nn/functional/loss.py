@@ -238,7 +238,7 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
         ext = ext.at[:, 1::2].set(lbl)
         ext_len = 2 * lbl_len + 1
         neg_inf = -1e30
-        alpha = jnp.full((B, 2 * S + 1), neg_inf)
+        alpha = jnp.full((B, 2 * S + 1), neg_inf, dtype=lp.dtype)
         alpha = alpha.at[:, 0].set(lp[0, :, blank])
         alpha = alpha.at[:, 1].set(
             jnp.take_along_axis(lp[0], ext[:, 1:2], axis=1)[:, 0])

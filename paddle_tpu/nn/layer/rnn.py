@@ -22,8 +22,10 @@ class RNNCellBase(Layer):
                            init_value=0.0, batch_dim_idx=0):
         from ...ops.creation import full
         batch = batch_ref.shape[batch_dim_idx]
-        state_shape = self.state_shape
-        if isinstance(state_shape, tuple):
+        state_shape = shape if shape is not None else self.state_shape
+        # tuple-of-shapes (e.g. LSTM (h, c)) vs a single flat shape of ints
+        if (isinstance(state_shape, tuple)
+                and state_shape and isinstance(state_shape[0], (tuple, list))):
             return tuple(full([batch] + list(s), init_value,
                               dtype or "float32") for s in state_shape)
         return full([batch] + list(state_shape), init_value,

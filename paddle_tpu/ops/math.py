@@ -5,6 +5,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ..core import dtype as dtype_mod
 from ..core.tensor import Tensor, apply
 
 __all__ = [
@@ -48,7 +49,17 @@ def _binary(jfn):
 add = _binary(jnp.add)
 subtract = _binary(jnp.subtract)
 multiply = _binary(jnp.multiply)
-divide = _binary(jnp.true_divide)
+def _true_divide_f32(a, b):
+    # int/int true division yields the default float dtype, not x64 float64
+    out = jnp.true_divide(a, b)
+    if out.dtype == jnp.float64 and not (
+            jnp.issubdtype(jnp.result_type(a), jnp.floating)
+            or jnp.issubdtype(jnp.result_type(b), jnp.floating)):
+        out = out.astype(dtype_mod.get_default_dtype())
+    return out
+
+
+divide = _binary(_true_divide_f32)
 floor_divide = _binary(jnp.floor_divide)
 remainder = _binary(jnp.remainder)
 mod = remainder

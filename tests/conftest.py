@@ -1,0 +1,25 @@
+"""Test configuration: force an 8-device virtual CPU mesh so multi-chip
+sharding tests run without TPU hardware (SURVEY.md §4 — the reference runs
+distributed tests as local subprocess simulations; on JAX the equivalent is
+xla_force_host_platform_device_count).
+
+Must run before the first `import jax` anywhere in the test process.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+# Numeric tests check against float64 numpy references; this JAX build
+# defaults matmuls to bf16-MXU-style passes even on CPU.
+jax.config.update("jax_default_matmul_precision", "highest")

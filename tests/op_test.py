@@ -1,0 +1,64 @@
+"""Per-op test harness — the OpTest analog.
+
+Reference: python/paddle/fluid/tests/unittests/op_test.py:255 —
+check_output compares op results against numpy references; check_grad
+compares analytic gradients (grad op) against numeric finite differences.
+
+Here: analytic gradients come from the eager tape (Tensor.backward), and
+numeric gradients from central finite differences on the same python op.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def check_output(op, np_ref, inputs, atol=1e-5, rtol=1e-5, kwargs=None):
+    """Run `op(*tensors, **kwargs)` and compare with `np_ref(*arrays)`."""
+    kwargs = kwargs or {}
+    tensors = [paddle.to_tensor(a) for a in inputs]
+    out = op(*tensors, **kwargs)
+    expect = np_ref(*inputs)
+    if not isinstance(out, (list, tuple)):
+        out, expect = [out], [expect]
+    for o, e in zip(out, expect):
+        np.testing.assert_allclose(np.asarray(o.numpy(), dtype=np.float64),
+                                   np.asarray(e, dtype=np.float64),
+                                   atol=atol, rtol=rtol)
+
+
+def numeric_grad(fn, arrays, idx, delta=1e-3):
+    """Central finite-difference d(sum(fn))/d(arrays[idx])."""
+    base = [np.array(a, dtype=np.float64) for a in arrays]
+    g = np.zeros_like(base[idx])
+    flat = base[idx].reshape(-1)
+    gflat = g.reshape(-1)
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + delta
+        hi = float(np.sum(np.asarray(fn(*[b.astype(np.float32) for b in base]))))
+        flat[i] = orig - delta
+        lo = float(np.sum(np.asarray(fn(*[b.astype(np.float32) for b in base]))))
+        flat[i] = orig
+        gflat[i] = (hi - lo) / (2 * delta)
+    return g
+
+
+def check_grad(op, inputs, atol=5e-3, rtol=5e-3, kwargs=None):
+    """Compare tape-analytic grad of sum(op(x)) with finite differences."""
+    kwargs = kwargs or {}
+    tensors = [paddle.to_tensor(np.asarray(a, np.float32), stop_gradient=False)
+               for a in inputs]
+    out = op(*tensors, **kwargs)
+    loss = paddle.sum(out)
+    loss.backward()
+
+    def np_fn(*arrays):
+        with paddle.no_grad():
+            return op(*[paddle.to_tensor(a) for a in arrays], **kwargs).numpy()
+
+    for i, t in enumerate(tensors):
+        ng = numeric_grad(np_fn, inputs, i)
+        ag = t.grad.numpy() if t.grad is not None else np.zeros_like(ng)
+        np.testing.assert_allclose(np.asarray(ag, np.float64), ng,
+                                   atol=atol, rtol=rtol,
+                                   err_msg=f"grad mismatch for input {i}")

@@ -1,0 +1,170 @@
+"""AMP autocast + GradScaler, DataLoader, metrics
+(reference: test_imperative_auto_mixed_precision.py, test_dataloader_*,
+test_metrics.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.amp as amp
+import paddle_tpu.io as io
+import paddle_tpu.metric as metric
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+
+
+# ---------------- AMP ------------------------------------------------------
+
+def test_autocast_matmul_bf16():
+    a = paddle.ones([4, 4])
+    with amp.auto_cast(enable=True, dtype="bfloat16"):
+        out = paddle.matmul(a, a)
+    assert out.dtype == paddle.bfloat16
+
+
+def test_autocast_blacklist_stays_fp32():
+    a = paddle.ones([8])
+    with amp.auto_cast(enable=True, dtype="bfloat16"):
+        s = paddle.sum(a)          # reduce: black list
+    assert s.dtype == paddle.float32
+
+
+def test_grad_scaler_scales_and_unscales():
+    w = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+    w.persistable = True
+    sgd = opt.SGD(learning_rate=0.1, parameters=[w])
+    scaler = amp.GradScaler(init_loss_scaling=128.0,
+                            use_dynamic_loss_scaling=False)
+    loss = paddle.sum(w * 2.0)
+    scaler.scale(loss).backward()
+    np.testing.assert_allclose(w.grad.numpy(), [256.0, 256.0])
+    scaler.step(sgd)
+    # after unscale, true grad 2.0 → w = 1 - 0.2
+    np.testing.assert_allclose(w.numpy(), [0.8, 0.8], rtol=1e-6)
+
+
+def test_grad_scaler_skips_on_inf():
+    w = paddle.to_tensor(np.ones(1, np.float32), stop_gradient=False)
+    w.persistable = True
+    sgd = opt.SGD(learning_rate=0.1, parameters=[w])
+    scaler = amp.GradScaler(init_loss_scaling=4.0,
+                            decr_every_n_nan_or_inf=1)
+    loss = paddle.sum(w * float("inf"))
+    scaler.scale(loss).backward()
+    scaler.step(sgd)
+    scaler.update()
+    np.testing.assert_allclose(w.numpy(), [1.0])  # update skipped
+    assert scaler.get_loss_scaling() < 4.0        # scale backed off
+
+
+# ---------------- DataLoader ----------------------------------------------
+
+class _SquareDataset(io.Dataset):
+    def __init__(self, n=20):
+        self.n = n
+
+    def __getitem__(self, i):
+        return np.float32(i), np.float32(i * i)
+
+    def __len__(self):
+        return self.n
+
+
+def test_dataloader_batches():
+    ds = _SquareDataset(20)
+    dl = io.DataLoader(ds, batch_size=4, shuffle=False, drop_last=False)
+    batches = list(dl)
+    assert len(batches) == 5
+    x, y = batches[0]
+    assert x.shape == [4]
+    np.testing.assert_allclose(y.numpy(), [0, 1, 4, 9])
+
+
+def test_dataloader_shuffle_epoch_cover():
+    dl = io.DataLoader(_SquareDataset(16), batch_size=4, shuffle=True)
+    seen = np.sort(np.concatenate([b[0].numpy() for b in dl]))
+    np.testing.assert_array_equal(seen, np.arange(16))
+
+
+def test_dataloader_multiprocess():
+    dl = io.DataLoader(_SquareDataset(12), batch_size=3, shuffle=False,
+                       num_workers=2)
+    got = sorted(float(x) for b in dl for x in b[0].numpy())
+    assert got == [float(i) for i in range(12)]
+
+
+def test_batch_sampler_and_distributed_sampler():
+    bs = io.BatchSampler(dataset=_SquareDataset(10), batch_size=3,
+                         drop_last=True)
+    assert len(list(bs)) == 3
+    dbs = io.DistributedBatchSampler(_SquareDataset(10), batch_size=2,
+                                     num_replicas=2, rank=0)
+    idx0 = [i for b in dbs for i in b]
+    dbs1 = io.DistributedBatchSampler(_SquareDataset(10), batch_size=2,
+                                      num_replicas=2, rank=1)
+    idx1 = [i for b in dbs1 for i in b]
+    assert len(set(idx0) & set(idx1)) == 0
+
+
+def test_tensor_dataset_random_split():
+    xs = np.arange(10, dtype=np.float32).reshape(10, 1)
+    td = io.TensorDataset([paddle.to_tensor(xs)])
+    a, b = io.random_split(td, [7, 3])
+    assert len(a) == 7 and len(b) == 3
+
+
+def test_iterable_dataset():
+    class Stream(io.IterableDataset):
+        def __iter__(self):
+            for i in range(8):
+                yield np.float32(i)
+
+    dl = io.DataLoader(Stream(), batch_size=4)
+    batches = list(dl)
+    assert len(batches) == 2
+
+
+# ---------------- Metrics ---------------------------------------------------
+
+def test_accuracy_metric():
+    m = metric.Accuracy()
+    pred = paddle.to_tensor(np.array([[0.1, 0.9], [0.8, 0.2]], np.float32))
+    label = paddle.to_tensor(np.array([[1], [1]], np.int64))
+    correct = m.compute(pred, label)
+    m.update(correct)
+    np.testing.assert_allclose(m.accumulate(), 0.5)
+    m.reset()
+
+
+def test_precision_recall():
+    p = metric.Precision()
+    r = metric.Recall()
+    pred = paddle.to_tensor(np.array([0.9, 0.8, 0.2, 0.1], np.float32))
+    lbl = paddle.to_tensor(np.array([1, 0, 1, 0], np.float32))
+    p.update(pred, lbl)
+    r.update(pred, lbl)
+    np.testing.assert_allclose(p.accumulate(), 0.5)
+    np.testing.assert_allclose(r.accumulate(), 0.5)
+
+
+def test_auc():
+    a = metric.Auc()
+    # column 1 = P(positive); positives score high → AUC = 1
+    pred = np.array([[0.9, 0.1], [0.3, 0.7], [0.6, 0.4], [0.2, 0.8]],
+                    np.float32)
+    lbl = np.array([[0], [1], [0], [1]], np.int64)
+    a.update(paddle.to_tensor(pred), paddle.to_tensor(lbl))
+    np.testing.assert_allclose(a.accumulate(), 1.0, atol=0.05)
+
+
+# ---------------- framework save/load --------------------------------------
+
+def test_save_load_state_dict(tmp_path):
+    lin = nn.Linear(3, 2)
+    path = str(tmp_path / "model.pdparams")
+    from paddle_tpu.framework import save, load
+    save(lin.state_dict(), path)
+    loaded = load(path)
+    lin2 = nn.Linear(3, 2)
+    lin2.set_state_dict(loaded)
+    x = paddle.ones([1, 3])
+    np.testing.assert_allclose(lin(x).numpy(), lin2(x).numpy(), atol=1e-6)

@@ -1,0 +1,239 @@
+"""nn.Layer system + layer/functional coverage
+(reference: unittests/test_layers.py, test_imperative_* family)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+RNG = np.random.default_rng(1)
+
+
+def _x(*shape):
+    return paddle.to_tensor(RNG.standard_normal(shape).astype(np.float32))
+
+
+def test_linear_forward_backward():
+    lin = nn.Linear(4, 3)
+    x = _x(2, 4)
+    y = lin(x)
+    assert y.shape == [2, 3]
+    paddle.sum(y).backward()
+    assert lin.weight.grad is not None and lin.weight.grad.shape == [4, 3]
+    assert lin.bias.grad.shape == [3]
+
+
+def test_layer_tree_and_state_dict():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(4, 8)
+            self.fc2 = nn.Linear(8, 2)
+
+        def forward(self, x):
+            return self.fc2(F.relu(self.fc1(x)))
+
+    net = Net()
+    names = dict(net.named_parameters())
+    assert set(names) == {"fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"}
+    sd = net.state_dict()
+    net2 = Net()
+    net2.set_state_dict(sd)
+    x = _x(3, 4)
+    np.testing.assert_allclose(net(x).numpy(), net2(x).numpy(), atol=1e-6)
+
+
+def test_sequential_and_containers():
+    seq = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    assert seq(_x(2, 4)).shape == [2, 2]
+    ll = nn.LayerList([nn.Linear(3, 3) for _ in range(3)])
+    assert len(ll) == 3
+    x = _x(1, 3)
+    for sub in ll:
+        x = sub(x)
+    assert x.shape == [1, 3]
+
+
+def test_conv2d_shapes():
+    conv = nn.Conv2D(3, 8, 3, stride=2, padding=1)
+    y = conv(_x(2, 3, 16, 16))
+    assert y.shape == [2, 8, 8, 8]
+    paddle.sum(y).backward()
+    assert conv.weight.grad.shape == [8, 3, 3, 3]
+
+
+def test_conv2d_matches_manual():
+    conv = nn.Conv2D(1, 1, 2, padding=0, bias_attr=False)
+    w = np.ones((1, 1, 2, 2), np.float32)
+    conv.weight.set_value(w)
+    x = np.arange(9, dtype=np.float32).reshape(1, 1, 3, 3)
+    y = conv(paddle.to_tensor(x)).numpy()
+    expect = np.array([[[[0+1+3+4, 1+2+4+5], [3+4+6+7, 4+5+7+8]]]], np.float32)
+    np.testing.assert_allclose(y, expect)
+
+
+def test_conv_transpose_roundtrip_shape():
+    up = nn.Conv2DTranspose(4, 2, 2, stride=2)
+    y = up(_x(1, 4, 5, 5))
+    assert y.shape == [1, 2, 10, 10]
+
+
+def test_pooling():
+    x = _x(1, 2, 8, 8)
+    assert nn.MaxPool2D(2)(x).shape == [1, 2, 4, 4]
+    assert nn.AvgPool2D(2, stride=2)(x).shape == [1, 2, 4, 4]
+    assert nn.AdaptiveAvgPool2D(1)(x).shape == [1, 2, 1, 1]
+    np.testing.assert_allclose(
+        nn.AdaptiveAvgPool2D(1)(x).numpy().squeeze(),
+        x.numpy().mean((2, 3)).squeeze(), atol=1e-6)
+
+
+def test_batchnorm_train_eval():
+    bn = nn.BatchNorm2D(3)
+    x = _x(4, 3, 5, 5)
+    bn.train()
+    y = bn(x)
+    m = y.numpy().mean((0, 2, 3))
+    np.testing.assert_allclose(m, np.zeros(3), atol=1e-5)
+    bn.eval()
+    y2 = bn(x)
+    assert y2.shape == x.shape
+
+
+def test_layernorm_normalizes():
+    ln = nn.LayerNorm(6)
+    x = _x(2, 6)
+    y = ln(x).numpy()
+    np.testing.assert_allclose(y.mean(-1), np.zeros(2), atol=1e-5)
+    np.testing.assert_allclose(y.std(-1, ddof=0), np.ones(2), atol=1e-3)
+
+
+def test_groupnorm_instance_norm():
+    gn = nn.GroupNorm(2, 4)
+    assert gn(_x(2, 4, 3, 3)).shape == [2, 4, 3, 3]
+    inorm = nn.InstanceNorm2D(4)
+    assert inorm(_x(2, 4, 3, 3)).shape == [2, 4, 3, 3]
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4)
+    idx = paddle.to_tensor(np.array([[1, 2], [3, 4]], np.int64))
+    y = emb(idx)
+    assert y.shape == [2, 2, 4]
+    paddle.sum(y).backward()
+    assert emb.weight.grad is not None
+
+
+def test_dropout_train_eval():
+    d = nn.Dropout(0.5)
+    x = paddle.ones([1000])
+    d.train()
+    y = d(x).numpy()
+    assert (y == 0).sum() > 300
+    d.eval()
+    np.testing.assert_array_equal(d(x).numpy(), x.numpy())
+
+
+@pytest.mark.parametrize("act,ref", [
+    (F.relu, lambda a: np.maximum(a, 0)),
+    (F.sigmoid, lambda a: 1 / (1 + np.exp(-a))),
+    (F.tanh, np.tanh),
+    (F.leaky_relu, lambda a: np.where(a > 0, a, 0.01 * a)),
+    (F.softplus, lambda a: np.log1p(np.exp(a))),
+    (F.silu, lambda a: a / (1 + np.exp(-a))),
+])
+def test_activations(act, ref):
+    a = RNG.standard_normal((3, 4)).astype(np.float32)
+    # atol 1e-4: this XLA build evaluates transcendentals with TPU-profile
+    # vectorised approximations (~3e-5 off float64 numpy references)
+    np.testing.assert_allclose(act(paddle.to_tensor(a)).numpy(), ref(a),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_softmax_cross_entropy():
+    logits = _x(4, 10)
+    labels = paddle.to_tensor(np.array([1, 3, 5, 7], np.int64))
+    loss = F.cross_entropy(logits, labels)
+    # numpy reference
+    z = logits.numpy()
+    z = z - z.max(1, keepdims=True)
+    logp = z - np.log(np.exp(z).sum(1, keepdims=True))
+    expect = -logp[np.arange(4), labels.numpy()].mean()
+    np.testing.assert_allclose(loss.numpy(), expect, rtol=1e-5)
+
+
+def test_loss_layers():
+    p, t = _x(4, 3), _x(4, 3)
+    np.testing.assert_allclose(
+        nn.MSELoss()(p, t).numpy(),
+        ((p.numpy() - t.numpy()) ** 2).mean(), rtol=1e-5)
+    np.testing.assert_allclose(
+        nn.L1Loss()(p, t).numpy(),
+        np.abs(p.numpy() - t.numpy()).mean(), rtol=1e-5)
+    logits = _x(4, 1)
+    lbl = paddle.to_tensor((RNG.random((4, 1)) > 0.5).astype(np.float32))
+    bce = nn.BCEWithLogitsLoss()(logits, lbl)
+    sig = 1 / (1 + np.exp(-logits.numpy()))
+    expect = -(lbl.numpy() * np.log(sig) +
+               (1 - lbl.numpy()) * np.log(1 - sig)).mean()
+    np.testing.assert_allclose(bce.numpy(), expect, rtol=1e-4)
+
+
+def test_multihead_attention():
+    mha = nn.MultiHeadAttention(16, 4)
+    q = _x(2, 5, 16)
+    out = mha(q, q, q)
+    assert out.shape == [2, 5, 16]
+    paddle.sum(out).backward()
+
+
+def test_transformer_encoder():
+    layer = nn.TransformerEncoderLayer(d_model=16, nhead=4, dim_feedforward=32)
+    enc = nn.TransformerEncoder(layer, num_layers=2)
+    y = enc(_x(2, 5, 16))
+    assert y.shape == [2, 5, 16]
+
+
+def test_rnn_lstm_gru():
+    lstm = nn.LSTM(4, 8, num_layers=1)
+    x = _x(2, 5, 4)
+    y, (h, c) = lstm(x)
+    assert y.shape == [2, 5, 8]
+    assert h.shape == [1, 2, 8] and c.shape == [1, 2, 8]
+    gru = nn.GRU(4, 8)
+    y2, h2 = gru(x)
+    assert y2.shape == [2, 5, 8]
+
+
+def test_forward_hooks():
+    lin = nn.Linear(2, 2)
+    seen = []
+    h = lin.register_forward_post_hook(lambda layer, inp, out: seen.append(1))
+    lin(_x(1, 2))
+    assert seen == [1]
+    h.remove()
+    lin(_x(1, 2))
+    assert seen == [1]
+
+
+def test_scaled_dot_product_attention():
+    q = _x(2, 3, 4, 8)  # [B, L, H, D] paddle convention
+    out = F.scaled_dot_product_attention(q, q, q)
+    assert out.shape == [2, 3, 4, 8]
+
+
+def test_interpolate():
+    x = _x(1, 2, 4, 4)
+    y = F.interpolate(x, scale_factor=2, mode="nearest")
+    assert y.shape == [1, 2, 8, 8]
+    y2 = F.interpolate(x, size=[6, 6], mode="bilinear")
+    assert y2.shape == [1, 2, 6, 6]
+
+
+def test_one_hot_and_pad():
+    oh = F.one_hot(paddle.to_tensor(np.array([0, 2], np.int64)), 3)
+    np.testing.assert_array_equal(oh.numpy(), [[1, 0, 0], [0, 0, 1]])
+    x = _x(1, 1, 2, 2)
+    y = F.pad(x, [1, 1, 1, 1])
+    assert y.shape == [1, 1, 4, 4]

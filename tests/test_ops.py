@@ -1,0 +1,224 @@
+"""Op library checks against numpy references via the OpTest harness
+(reference: unittests/test_*_op.py, harness op_test.py:255)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_output, check_grad
+
+RNG = np.random.default_rng(0)
+
+
+def _randf(*shape):
+    return RNG.standard_normal(shape).astype(np.float32)
+
+
+@pytest.mark.parametrize("op,ref", [
+    (paddle.exp, np.exp),
+    (paddle.log, lambda x: np.log(np.abs(x) + 1.0)),
+    (paddle.tanh, np.tanh),
+    (paddle.abs, np.abs),
+    (paddle.floor, np.floor),
+    (paddle.ceil, np.ceil),
+    (paddle.round, np.round),
+    (paddle.square, np.square),
+])
+def test_unary(op, ref):
+    # atol/rtol 1e-4: this XLA build approximates transcendentals at
+    # TPU-profile precision (see test_nn.test_activations note)
+    x = _randf(3, 4)
+    if op is paddle.log:
+        x = np.abs(x) + 1.0
+        check_output(paddle.log, np.log, [x], atol=1e-4, rtol=1e-4)
+    else:
+        check_output(op, ref, [x], atol=1e-4, rtol=1e-4)
+
+
+@pytest.mark.parametrize("op,ref", [
+    (paddle.add, np.add),
+    (paddle.subtract, np.subtract),
+    (paddle.multiply, np.multiply),
+    (paddle.maximum, np.maximum),
+    (paddle.minimum, np.minimum),
+    (paddle.atan2, np.arctan2),
+])
+def test_binary(op, ref):
+    check_output(op, ref, [_randf(3, 4), _randf(3, 4)])
+
+
+def test_broadcasting_binary():
+    check_output(paddle.add, np.add, [_randf(3, 1, 4), _randf(2, 4)])
+
+
+def test_matmul_variants():
+    check_output(paddle.matmul, np.matmul, [_randf(4, 5), _randf(5, 6)])
+    check_output(paddle.matmul, np.matmul, [_randf(2, 4, 5), _randf(2, 5, 6)])
+    check_output(paddle.bmm, np.matmul, [_randf(2, 4, 5), _randf(2, 5, 6)])
+    check_output(paddle.dot, np.dot, [_randf(7), _randf(7)])
+
+
+def test_reductions():
+    x = _randf(3, 4)
+    check_output(lambda t: paddle.sum(t, axis=1), lambda a: a.sum(1), [x])
+    check_output(lambda t: paddle.mean(t, axis=0), lambda a: a.mean(0), [x])
+    check_output(lambda t: paddle.max(t, axis=1), lambda a: a.max(1), [x])
+    check_output(lambda t: paddle.min(t), lambda a: a.min(), [x])
+    check_output(lambda t: paddle.prod(t, axis=1), lambda a: a.prod(1), [x])
+    check_output(paddle.logsumexp,
+                 lambda a: np.log(np.exp(a).sum()), [x], atol=1e-4)
+
+
+def test_manipulation():
+    x = _randf(2, 3, 4)
+    check_output(lambda t: paddle.reshape(t, [6, 4]),
+                 lambda a: a.reshape(6, 4), [x])
+    check_output(lambda t: paddle.transpose(t, [2, 0, 1]),
+                 lambda a: a.transpose(2, 0, 1), [x])
+    check_output(lambda t: paddle.squeeze(paddle.unsqueeze(t, 0), 0),
+                 lambda a: a, [x])
+    check_output(lambda t: paddle.flatten(t, 1, 2),
+                 lambda a: a.reshape(2, 12), [x])
+    check_output(lambda t: paddle.tile(t, [2, 1, 1]),
+                 lambda a: np.tile(a, (2, 1, 1)), [x])
+    check_output(lambda t: paddle.flip(t, axis=[0]),
+                 lambda a: np.flip(a, 0), [x])
+    check_output(lambda t: paddle.roll(t, 1, axis=0),
+                 lambda a: np.roll(a, 1, 0), [x])
+
+
+def test_concat_split_stack():
+    a, b = _randf(2, 3), _randf(2, 3)
+    check_output(lambda x, y: paddle.concat([x, y], axis=0),
+                 lambda x, y: np.concatenate([x, y], 0), [a, b])
+    check_output(lambda x, y: paddle.stack([x, y], axis=1),
+                 lambda x, y: np.stack([x, y], 1), [a, b])
+    parts = paddle.split(paddle.to_tensor(_randf(6, 2)), 3, axis=0)
+    assert len(parts) == 3 and parts[0].shape == [2, 2]
+    u = paddle.unbind(paddle.to_tensor(a), axis=0)
+    assert len(u) == 2
+
+
+def test_indexing_ops():
+    x = _randf(5, 3)
+    idx = np.array([0, 2, 4])
+    check_output(lambda t: paddle.gather(t, paddle.to_tensor(idx), axis=0),
+                 lambda a: a[idx], [x])
+    check_output(lambda t: paddle.index_select(t, paddle.to_tensor(idx), axis=0),
+                 lambda a: a[idx], [x])
+    cond = x > 0
+    got = paddle.masked_select(paddle.to_tensor(x), paddle.to_tensor(cond))
+    np.testing.assert_allclose(got.numpy(), x[cond])
+
+
+def test_where_clip():
+    x = _randf(3, 4)
+    check_output(lambda t: paddle.clip(t, -0.5, 0.5),
+                 lambda a: np.clip(a, -0.5, 0.5), [x])
+    check_output(lambda t: paddle.where(t > 0, t, -t), np.abs, [x])
+
+
+def test_creation():
+    assert paddle.zeros([2, 3]).shape == [2, 3]
+    assert paddle.ones([2], dtype="int32").dtype == paddle.int32
+    np.testing.assert_array_equal(paddle.arange(5).numpy(), np.arange(5))
+    np.testing.assert_allclose(paddle.linspace(0, 1, 5).numpy(),
+                               np.linspace(0, 1, 5), atol=1e-6)
+    np.testing.assert_array_equal(paddle.eye(3).numpy(), np.eye(3))
+    np.testing.assert_array_equal(
+        paddle.full([2, 2], 7).numpy(), np.full((2, 2), 7))
+    t = paddle.to_tensor(_randf(2, 2))
+    assert paddle.ones_like(t).shape == [2, 2]
+
+
+def test_linalg():
+    a = _randf(4, 4) + 4 * np.eye(4, dtype=np.float32)
+    check_output(paddle.inv, np.linalg.inv, [a], atol=1e-4)
+    spd = a @ a.T + np.eye(4, dtype=np.float32)
+    check_output(paddle.cholesky, np.linalg.cholesky, [spd], atol=1e-4)
+    sign, logdet = np.linalg.slogdet(spd)
+    out = paddle.slogdet(paddle.to_tensor(spd))
+    np.testing.assert_allclose(float(out[0].numpy()), sign, atol=1e-4)
+    np.testing.assert_allclose(float(out[1].numpy()), logdet, rtol=1e-4)
+    b = _randf(4, 2)
+    check_output(paddle.solve,
+                 lambda A, B: np.linalg.solve(A, B), [spd, b], atol=1e-3)
+    check_output(lambda t: paddle.norm(t, p=2),
+                 lambda x: np.linalg.norm(x), [_randf(5)], atol=1e-5)
+
+
+def test_sort_search():
+    x = _randf(4, 5)
+    check_output(lambda t: paddle.sort(t, axis=1),
+                 lambda a: np.sort(a, 1), [x])
+    check_output(lambda t: paddle.argsort(t, axis=1).astype("float32"),
+                 lambda a: np.argsort(a, 1, kind="stable").astype(np.float32), [x])
+    check_output(lambda t: paddle.argmax(t, axis=1).astype("float32"),
+                 lambda a: np.argmax(a, 1).astype(np.float32), [x])
+    vals, idx = paddle.topk(paddle.to_tensor(x), k=2, axis=1)
+    np.testing.assert_allclose(vals.numpy(), -np.sort(-x, 1)[:, :2])
+    sorted_arr = np.sort(_randf(10))
+    q = np.array([sorted_arr[3] + 1e-4], np.float32)
+    got = paddle.searchsorted(paddle.to_tensor(sorted_arr), paddle.to_tensor(q))
+    np.testing.assert_array_equal(got.numpy(), np.searchsorted(sorted_arr, q))
+
+
+def test_cumulative():
+    x = _randf(3, 4)
+    check_output(lambda t: paddle.cumsum(t, axis=1),
+                 lambda a: np.cumsum(a, 1), [x])
+    check_output(lambda t: paddle.cumprod(t, dim=1),
+                 lambda a: np.cumprod(a, 1), [x])
+
+
+def test_logic_ops():
+    a, b = _randf(3, 3), _randf(3, 3)
+    check_output(lambda x, y: paddle.greater_than(x, y).astype("float32"),
+                 lambda x, y: (x > y).astype(np.float32), [a, b])
+    check_output(lambda x, y: paddle.equal(x, x).astype("float32"),
+                 lambda x, y: np.ones_like(x), [a, b])
+    assert paddle.allclose(paddle.to_tensor(a), paddle.to_tensor(a)).item()
+
+
+def test_stat_ops():
+    x = _randf(4, 5)
+    check_output(lambda t: paddle.std(t, axis=1),
+                 lambda a: a.std(1, ddof=1), [x], atol=1e-5)
+    check_output(lambda t: paddle.var(t, axis=1),
+                 lambda a: a.var(1, ddof=1), [x], atol=1e-5)
+    check_output(paddle.median, np.median, [_randf(9)])
+    check_output(lambda t: paddle.quantile(t, 0.5),
+                 lambda a: np.quantile(a, 0.5), [_randf(9)], atol=1e-5)
+
+
+def test_einsum():
+    a, b = _randf(3, 4), _randf(4, 5)
+    check_output(lambda x, y: paddle.einsum("ij,jk->ik", x, y),
+                 lambda x, y: np.einsum("ij,jk->ik", x, y), [a, b])
+
+
+# ---- gradient checks (analytic tape vs finite differences) ----------------
+
+def test_grad_unary_chain():
+    check_grad(lambda x: paddle.tanh(paddle.exp(x)), [_randf(3, 3) * 0.5])
+
+
+def test_grad_matmul():
+    check_grad(paddle.matmul, [_randf(3, 4), _randf(4, 2)])
+
+
+def test_grad_reduce_mean():
+    check_grad(lambda x: paddle.mean(x, axis=1), [_randf(3, 4)])
+
+
+def test_grad_broadcast_mul():
+    check_grad(paddle.multiply, [_randf(3, 1), _randf(1, 4)])
+
+
+def test_grad_reshape_transpose():
+    check_grad(lambda x: paddle.transpose(paddle.reshape(x, [4, 3]), [1, 0]),
+               [_randf(3, 4)])
+
+
+def test_grad_softmax_like():
+    check_grad(lambda x: paddle.exp(x) / paddle.sum(paddle.exp(x)),
+               [_randf(5) * 0.3])

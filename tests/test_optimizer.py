@@ -1,0 +1,175 @@
+"""Optimizers + LR schedulers (reference: unittests/test_adam_op.py,
+test_sgd_op.py, test_lr_scheduler.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+
+
+def _fit_quadratic(optimizer_ctor, steps=120, **kw):
+    """Minimise ||w - target||^2; return final distance."""
+    paddle.seed(0)
+    target = np.array([1.0, -2.0, 3.0], np.float32)
+    w = paddle.to_tensor(np.zeros(3, np.float32), stop_gradient=False)
+    w.persistable = True
+    optimizer = optimizer_ctor(parameters=[w], **kw)
+    for _ in range(steps):
+        loss = paddle.sum((w - paddle.to_tensor(target)) ** 2)
+        loss.backward()
+        optimizer.step()
+        optimizer.clear_grad()
+    return float(np.abs(w.numpy() - target).max())
+
+
+@pytest.mark.parametrize("ctor,kw", [
+    (opt.SGD, dict(learning_rate=0.1)),
+    (opt.Momentum, dict(learning_rate=0.05, momentum=0.9)),
+    (opt.Adam, dict(learning_rate=0.1)),
+    (opt.AdamW, dict(learning_rate=0.1, weight_decay=0.0)),
+    (opt.RMSProp, dict(learning_rate=0.05)),
+    (opt.Adagrad, dict(learning_rate=0.5)),
+    (opt.Adamax, dict(learning_rate=0.2)),
+])
+def test_converges(ctor, kw):
+    assert _fit_quadratic(ctor, **kw) < 0.05
+
+
+def test_lamb_trust_ratio_update():
+    """LAMB normalises the update to lr * ||p|| (lamb_op.h semantics), so
+    check one step against the formula rather than asymptotic convergence."""
+    w0 = np.array([3.0, 4.0], np.float32)  # ||w0|| = 5
+    g = np.array([1.0, 0.0], np.float32)
+    w = paddle.to_tensor(w0, stop_gradient=False)
+    w.persistable = True
+    lamb = opt.Lamb(learning_rate=0.1, parameters=[w], lamb_weight_decay=0.0)
+    paddle.sum(w * paddle.to_tensor(g)).backward()
+    lamb.step()
+    b1, b2, eps = 0.9, 0.999, 1e-6
+    mhat = (1 - b1) * g / (1 - b1)
+    vhat = (1 - b2) * g * g / (1 - b2)
+    r = mhat / (np.sqrt(vhat) + eps)
+    trust = np.linalg.norm(w0) / np.linalg.norm(r)
+    expect = w0 - 0.1 * trust * r
+    np.testing.assert_allclose(w.numpy(), expect, rtol=1e-4)
+
+
+def test_adam_matches_reference_update():
+    """One Adam step against the textbook formula (adam_op.cc semantics)."""
+    w0 = np.array([1.0, 2.0], np.float32)
+    g = np.array([0.5, -0.3], np.float32)
+    lr, b1, b2, eps = 0.01, 0.9, 0.999, 1e-8
+    w = paddle.to_tensor(w0, stop_gradient=False)
+    w.persistable = True
+    adam = opt.Adam(learning_rate=lr, parameters=[w],
+                    beta1=b1, beta2=b2, epsilon=eps)
+    paddle.sum(w * paddle.to_tensor(g)).backward()
+    adam.step()
+    m = (1 - b1) * g
+    v = (1 - b2) * g * g
+    mhat, vhat = m / (1 - b1), v / (1 - b2)
+    expect = w0 - lr * mhat / (np.sqrt(vhat) + eps)
+    np.testing.assert_allclose(w.numpy(), expect, rtol=1e-5)
+
+
+def test_weight_decay_adamw_decouples():
+    w = paddle.to_tensor(np.array([10.0], np.float32), stop_gradient=False)
+    w.persistable = True
+    aw = opt.AdamW(learning_rate=0.1, parameters=[w], weight_decay=0.1)
+    paddle.sum(w * 0.0).backward()  # zero grad, only decay
+    aw.step()
+    assert float(w.numpy()[0]) < 10.0
+
+
+def test_optimizer_state_dict_roundtrip():
+    lin = nn.Linear(3, 3)
+    adam = opt.Adam(learning_rate=0.01, parameters=lin.parameters())
+    paddle.sum(lin(paddle.ones([2, 3]))).backward()
+    adam.step()
+    sd = adam.state_dict()
+    adam2 = opt.Adam(learning_rate=0.01, parameters=lin.parameters())
+    adam2.set_state_dict(sd)
+    assert adam2.state_dict().keys() == sd.keys()
+
+
+def test_grad_clip_global_norm():
+    w = paddle.to_tensor(np.ones(4, np.float32) * 3, stop_gradient=False)
+    w.persistable = True
+    sgd = opt.SGD(learning_rate=1.0, parameters=[w],
+                  grad_clip=nn.ClipGradByGlobalNorm(1.0))
+    paddle.sum(w * 10.0).backward()  # grad = 10 each, gnorm=20
+    sgd.step()
+    # clipped grad = 10/20 = 0.5 each
+    np.testing.assert_allclose(w.numpy(), 3 - 0.5, rtol=1e-5)
+
+
+# ---------------- LR schedulers -------------------------------------------
+
+def test_step_decay():
+    sch = opt.lr.StepDecay(learning_rate=1.0, step_size=2, gamma=0.5)
+    vals = []
+    for _ in range(6):
+        vals.append(sch())
+        sch.step()
+    np.testing.assert_allclose(vals, [1, 1, 0.5, 0.5, 0.25, 0.25])
+
+
+def test_multistep_piecewise():
+    sch = opt.lr.MultiStepDecay(learning_rate=1.0, milestones=[2, 4], gamma=0.1)
+    vals = [sch() for _ in range(5) if sch.step() or True]
+    ps = opt.lr.PiecewiseDecay(boundaries=[2, 4], values=[1.0, 0.5, 0.1])
+    got = []
+    for _ in range(5):
+        got.append(ps())
+        ps.step()
+    np.testing.assert_allclose(got, [1, 1, 0.5, 0.5, 0.1])
+
+
+def test_noam_warmup_shape():
+    sch = opt.lr.NoamDecay(d_model=64, warmup_steps=4, learning_rate=1.0)
+    vals = []
+    for _ in range(8):
+        vals.append(sch())
+        sch.step()
+    assert vals[1] < vals[3]  # warmup rising
+    assert vals[7] < vals[3] or vals[7] < vals[4]  # decaying after warmup
+
+
+def test_linear_warmup():
+    base = opt.lr.ExponentialDecay(learning_rate=1.0, gamma=0.9)
+    sch = opt.lr.LinearWarmup(base, warmup_steps=4, start_lr=0.0, end_lr=1.0)
+    v0 = sch(); sch.step()
+    v1 = sch(); sch.step()
+    assert v0 == 0.0 and 0 < v1 < 1.0
+
+
+def test_cosine_annealing():
+    sch = opt.lr.CosineAnnealingDecay(learning_rate=1.0, T_max=10)
+    first = sch()
+    for _ in range(10):
+        sch.step()
+    assert sch() < first
+
+
+def test_reduce_on_plateau():
+    sch = opt.lr.ReduceOnPlateau(learning_rate=1.0, factor=0.5, patience=1)
+    sch.step(metrics=1.0)
+    sch.step(metrics=1.0)
+    sch.step(metrics=1.0)
+    assert sch() <= 0.5
+
+
+def test_scheduler_with_optimizer():
+    w = paddle.to_tensor(np.zeros(2, np.float32), stop_gradient=False)
+    w.persistable = True
+    sch = opt.lr.StepDecay(learning_rate=0.1, step_size=1, gamma=0.1)
+    sgd = opt.SGD(learning_rate=sch, parameters=[w])
+    paddle.sum(w * 1.0).backward()
+    sgd.step()
+    np.testing.assert_allclose(w.numpy(), [-0.1, -0.1], rtol=1e-6)
+    sch.step()
+    sgd.clear_grad()
+    paddle.sum(w * 1.0).backward()
+    sgd.step()
+    np.testing.assert_allclose(w.numpy(), [-0.11, -0.11], rtol=1e-5)
