@@ -1,0 +1,129 @@
+"""Flagship benchmark: GPT-2 124M training step on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline = measured MFU / 0.45 (BASELINE.json north star: >=45% MFU for
+Model.fit on GPT-2-class models; the reference repo publishes no absolute
+numbers — BASELINE.md).
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+# bf16 peak TFLOP/s per chip by generation (public spec sheets)
+PEAK_FLOPS = {"v4": 275e12, "v5e": 197e12, "v5litepod": 197e12,
+              "v5p": 459e12, "v6e": 918e12}
+
+
+def peak_flops():
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
+    for k, v in PEAK_FLOPS.items():
+        if k in gen:
+            return v
+    import jax
+    kind = jax.devices()[0].device_kind.lower()
+    for k, v in PEAK_FLOPS.items():
+        if k in kind.replace(" ", ""):
+            return v
+    return 197e12
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.framework import functional_call
+    from paddle_tpu.models import GPT, GPTConfig
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    if on_cpu:  # smoke-mode so the bench is debuggable off-TPU
+        cfg = GPTConfig(vocab_size=512, max_seq_len=128, hidden=128,
+                        layers=2, heads=4)
+        B, T, iters = 2, 128, 3
+    else:
+        cfg = GPTConfig()                      # GPT-2 124M
+        B, T, iters = 8, 1024, 16
+
+    paddle.seed(0)
+    model = GPT(cfg)
+    model.eval()
+    params = {k: v._data for k, v in model.named_parameters()}
+    adam = opt.Adam(learning_rate=1e-4, parameters=list(model.parameters()))
+    opt_state = adam.functional_init(params)
+
+    class LossModule:
+        def __init__(self, m):
+            self._m = m
+
+        def named_parameters(self, *a, **k):
+            return self._m.named_parameters(*a, **k)
+
+        def named_buffers(self, *a, **k):
+            return self._m.named_buffers(*a, **k)
+
+        def __call__(self, ids, labels):
+            return self._m.loss(ids, labels)
+
+    wrapped = LossModule(model)
+
+    def train_step(p, s, ids):
+        labels = jnp.concatenate([ids[:, 1:], ids[:, :1]], axis=1)
+
+        def loss_of(pp):
+            out, _ = functional_call(wrapped, pp, {}, ids, labels)
+            return out
+
+        loss, grads = jax.value_and_grad(loss_of)(p)
+        new_p, new_s = adam.functional_update(p, grads, s, lr=1e-4)
+        return loss, new_p, new_s
+
+    step = jax.jit(train_step, donate_argnums=(0, 1))
+
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, T)), jnp.int32)
+
+    # warmup / compile
+    loss, params, opt_state = step(params, opt_state, ids)
+    _ = float(loss)  # host fetch
+
+    def run(n, p, s):
+        """Chain n steps and force completion with a host fetch — through
+        the TPU tunnel, block_until_ready returns before execution and a
+        device->host read is the only true sync (~100ms RTT)."""
+        t0 = time.perf_counter()
+        loss = None
+        for _ in range(n):
+            loss, p, s = step(p, s, ids)
+        _ = float(loss)
+        return time.perf_counter() - t0, p, s
+
+    # marginal step time: (t_long - t_short) / (n_long - n_short) cancels
+    # the constant tunnel fetch latency
+    n_short, n_long = max(iters // 4, 1), iters
+    dt_short, params, opt_state = run(n_short, params, opt_state)
+    dt_long, params, opt_state = run(n_long, params, opt_state)
+    step_time = max((dt_long - dt_short) / (n_long - n_short), 1e-9)
+
+    tokens_per_sec = B * T / step_time
+    n_params = model.num_params()
+    # 6N per token (fwd+bwd) + attention 12*L*h*T term
+    flops_per_token = 6 * n_params + 12 * cfg.layers * cfg.hidden * T
+    mfu = tokens_per_sec * flops_per_token / peak_flops()
+
+    print(json.dumps({
+        "metric": "gpt2_124m_train_tokens_per_sec" if not on_cpu
+                  else "gpt_tiny_cpu_smoke_tokens_per_sec",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.45, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

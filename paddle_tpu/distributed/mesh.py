@@ -1,0 +1,57 @@
+"""Device-mesh construction — the TPU-native analog of the reference's
+multi-device graph build (ir/multi_devices_graph_pass) + NCCL ring setup
+(platform/collective_helper.h:67 NCCLCommContext keyed by ring_id).
+
+A Mesh axis here == a comm ring there: 'dp' is the data-parallel allreduce
+ring, 'tp' the tensor-parallel ring, 'pp' pipeline stages, 'sp' sequence
+shards, 'ep' experts. XLA derives the collectives from shardings laid out
+over these axes; no comm-init ops, no streams.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def build_mesh(shape: Optional[dict] = None,
+               axis_names: Sequence[str] = ("dp",),
+               devices=None) -> Mesh:
+    """Build a Mesh from {axis: size}. Sizes of -1 are inferred.
+
+    build_mesh({'dp': 2, 'tp': 4}) on 8 devices → 2x4 mesh.
+    build_mesh() → all devices on one 'dp' axis.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if shape is None:
+        shape = {axis_names[0]: n} if len(axis_names) == 1 else None
+    if shape is None:
+        raise ValueError("shape required for multi-axis mesh")
+    names = list(shape.keys())
+    sizes = list(shape.values())
+    if -1 in sizes:
+        known = math.prod(s for s in sizes if s != -1)
+        sizes[sizes.index(-1)] = n // known
+    if math.prod(sizes) != n:
+        raise ValueError(f"mesh {dict(zip(names, sizes))} != {n} devices")
+    dev_array = np.array(devices).reshape(sizes)
+    return Mesh(dev_array, tuple(names))
+
+
+_current_mesh = [None]
+
+
+def set_mesh(mesh: Mesh):
+    _current_mesh[0] = mesh
+
+
+def get_mesh() -> Optional[Mesh]:
+    return _current_mesh[0]
+
+
+def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(*spec))

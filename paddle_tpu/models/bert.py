@@ -1,0 +1,77 @@
+"""BERT/ERNIE-class bidirectional encoder (BASELINE config 3).
+
+Built on the same nn stack as the reference's transformer layers
+(/root/reference/python/paddle/nn/layer/transformer.py:437
+TransformerEncoderLayer); provides the MLM pretraining head the ERNIE-base
+benchmark exercises.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+from .. import nn
+from ..nn import functional as F
+
+
+@dataclasses.dataclass
+class BertConfig:
+    vocab_size: int = 30592          # 30522 padded to multiple of 128
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    hidden: int = 768
+    layers: int = 12
+    heads: int = 12
+    ffn_mult: int = 4
+    dropout: float = 0.0
+
+
+def bert_base(**kw):
+    return BertConfig(**kw)
+
+
+def bert_tiny(**kw):
+    return BertConfig(vocab_size=512, max_seq_len=128, hidden=64, layers=2,
+                      heads=4, **kw)
+
+
+class Bert(nn.Layer):
+    """Encoder + tied-embedding MLM head.
+    forward(ids [B,T]) -> mlm logits [B,T,V]."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.cfg = cfg
+        from ..framework import ParamAttr
+        from ..nn import initializer as I
+        emb_init = ParamAttr(initializer=I.Normal(0.0, 0.02))
+        self.tok = nn.Embedding(cfg.vocab_size, cfg.hidden, weight_attr=emb_init)
+        self.pos = nn.Embedding(cfg.max_seq_len, cfg.hidden, weight_attr=emb_init)
+        self.seg = nn.Embedding(cfg.type_vocab_size, cfg.hidden,
+                                weight_attr=emb_init)
+        self.ln = nn.LayerNorm(cfg.hidden)
+        self.drop = nn.Dropout(cfg.dropout)
+        enc_layer = nn.TransformerEncoderLayer(
+            d_model=cfg.hidden, nhead=cfg.heads,
+            dim_feedforward=cfg.ffn_mult * cfg.hidden,
+            dropout=cfg.dropout, activation="gelu", normalize_before=True)
+        self.encoder = nn.TransformerEncoder(enc_layer, num_layers=cfg.layers)
+        self.mlm_ln = nn.LayerNorm(cfg.hidden)
+        self.mlm_fc = nn.Linear(cfg.hidden, cfg.hidden)
+
+    def forward(self, ids, token_type_ids=None, attn_mask=None):
+        B, T = ids.shape
+        from ..ops.creation import arange, zeros
+        pos = arange(T, dtype="int64").unsqueeze(0)
+        seg = (token_type_ids if token_type_ids is not None
+               else zeros([B, T], dtype="int64"))
+        x = self.tok(ids) + self.pos(pos) + self.seg(seg)
+        x = self.drop(self.ln(x))
+        x = self.encoder(x, src_mask=attn_mask)
+        h = self.mlm_ln(F.gelu(self.mlm_fc(x)))
+        return F.linear(h, self.tok.weight.transpose([1, 0]))
+
+    def mlm_loss(self, ids, labels, ignore_index=-100, **kw):
+        logits = self.forward(ids, **kw)
+        V = logits.shape[-1]
+        return F.cross_entropy(logits.reshape([-1, V]), labels.reshape([-1]),
+                               ignore_index=ignore_index)

@@ -1,0 +1,174 @@
+"""GPT decoder language-model family, TPU-first.
+
+Capability analog of the reference's transformer stack
+(/root/reference/python/paddle/nn/layer/transformer.py:115 MultiHeadAttention,
+:437 TransformerEncoderLayer) arranged as a pre-LN causal LM (the reference
+ships no GPT model class; its GPT-class benchmark configs are external — we
+provide the architecture natively since BASELINE.md configs 4-5 are GPT-2
+345M / GPT-3 1.3B).
+
+TPU-first design decisions:
+  * weights are [in, out] so the hot matmuls are plain `x @ w` on the MXU —
+    no transposes in the step function;
+  * attention uses F.scaled_dot_product_attention which lowers to the Pallas
+    flash kernel on TPU and an XLA composition elsewhere;
+  * `gpt_param_shardings` gives the Megatron-style tensor-parallel
+    PartitionSpec for every parameter, so `jit(..., in_shardings=...)` over a
+    ('dp','tp') mesh runs the model tensor-parallel with XLA inserting the
+    all-reduces (the reference reaches multi-device only via graph rewrite
+    passes — ir/multi_devices_graph_pass — which XLA subsumes here).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..core.tensor import Tensor
+from ..nn import functional as F
+
+
+@dataclasses.dataclass
+class GPTConfig:
+    vocab_size: int = 50304          # 50257 padded to a multiple of 128 (MXU lane width)
+    max_seq_len: int = 1024
+    hidden: int = 768
+    layers: int = 12
+    heads: int = 12
+    ffn_mult: int = 4
+    dropout: float = 0.0
+    dtype: str = "float32"
+
+    @property
+    def head_dim(self):
+        return self.hidden // self.heads
+
+
+def gpt_tiny(**kw):
+    return GPTConfig(vocab_size=512, max_seq_len=128, hidden=64, layers=2,
+                     heads=4, **kw)
+
+
+def gpt2_124m(**kw):
+    return GPTConfig(hidden=768, layers=12, heads=12, **kw)
+
+
+def gpt2_345m(**kw):
+    return GPTConfig(hidden=1024, layers=24, heads=16, **kw)
+
+
+def gpt3_1p3b(**kw):
+    return GPTConfig(hidden=2048, layers=24, heads=16, max_seq_len=2048, **kw)
+
+
+class CausalSelfAttention(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.qkv = nn.Linear(cfg.hidden, 3 * cfg.hidden)
+        self.proj = nn.Linear(cfg.hidden, cfg.hidden)
+        self.drop = nn.Dropout(cfg.dropout)
+
+    def forward(self, x):
+        B, T, C = x.shape
+        H, D = self.cfg.heads, self.cfg.head_dim
+        qkv = self.qkv(x)                                   # [B,T,3C]
+        q, k, v = qkv.chunk(3, axis=-1)
+        q = q.reshape([B, T, H, D])
+        k = k.reshape([B, T, H, D])
+        v = v.reshape([B, T, H, D])
+        out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        out = out.reshape([B, T, C])
+        return self.drop(self.proj(out))
+
+
+class Block(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.ln1 = nn.LayerNorm(cfg.hidden)
+        self.attn = CausalSelfAttention(cfg)
+        self.ln2 = nn.LayerNorm(cfg.hidden)
+        self.fc1 = nn.Linear(cfg.hidden, cfg.ffn_mult * cfg.hidden)
+        self.fc2 = nn.Linear(cfg.ffn_mult * cfg.hidden, cfg.hidden)
+        self.drop = nn.Dropout(cfg.dropout)
+
+    def forward(self, x):
+        x = x + self.attn(self.ln1(x))
+        h = self.fc2(F.gelu(self.fc1(self.ln2(x))))
+        return x + self.drop(h)
+
+
+class GPT(nn.Layer):
+    """Pre-LN GPT decoder LM. forward(token_ids [B,T]) -> logits [B,T,V]."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        from ..framework import ParamAttr
+        from ..nn import initializer as I
+        emb_init = ParamAttr(initializer=I.Normal(0.0, 0.02))  # GPT-2 init
+        self.wte = nn.Embedding(cfg.vocab_size, cfg.hidden, weight_attr=emb_init)
+        self.wpe = nn.Embedding(cfg.max_seq_len, cfg.hidden, weight_attr=emb_init)
+        self.drop = nn.Dropout(cfg.dropout)
+        self.blocks = nn.LayerList([Block(cfg) for _ in range(cfg.layers)])
+        self.ln_f = nn.LayerNorm(cfg.hidden)
+        # weight tying (lm_head = wte.T) keeps the embedding matmul on-MXU
+        # and halves embedding memory, standard for the GPT family.
+
+    def forward(self, idx):
+        B, T = idx.shape
+        from ..ops.creation import arange
+        pos = arange(T, dtype="int64").unsqueeze(0)
+        x = self.drop(self.wte(idx) + self.wpe(pos))
+        for blk in self.blocks:
+            x = blk(x)
+        x = self.ln_f(x)
+        logits = F.linear(x, self.wte.weight.transpose([1, 0]))
+        return logits
+
+    def loss(self, idx, labels):
+        logits = self.forward(idx)
+        V = logits.shape[-1]
+        return F.cross_entropy(logits.reshape([-1, V]), labels.reshape([-1]))
+
+    def num_params(self) -> int:
+        return sum(int(math.prod(p.shape)) for p in self.parameters())
+
+    def flops_per_token(self) -> int:
+        """~6N + attention term; used by the MFU reporter."""
+        n = self.num_params()
+        c = self.cfg
+        attn = 12 * c.layers * c.hidden * c.max_seq_len
+        return 6 * n + attn
+
+
+def gpt_param_shardings(params, mesh_axis_tp="tp"):
+    """Megatron-style TP PartitionSpecs keyed by the functional param dict
+    names produced by `framework.functional_call` on a GPT instance.
+
+    Column-parallel (shard output dim): qkv and ffn-in weights.
+    Row-parallel (shard input dim): attn proj and ffn-out weights — XLA
+    inserts the psum where the partial sums meet, exactly the Megatron
+    f/g collectives, but compiler-derived instead of hand-written.
+    Embeddings shard over vocab/feature rows.
+    """
+    from jax.sharding import PartitionSpec as P
+    specs = {}
+    for name, v in params.items():
+        ndim = len(v.shape)
+        if "qkv.weight" in name or "fc1.weight" in name:
+            specs[name] = P(None, mesh_axis_tp)          # column parallel
+        elif "qkv.bias" in name or "fc1.bias" in name:
+            specs[name] = P(mesh_axis_tp)
+        elif "proj.weight" in name or "fc2.weight" in name:
+            specs[name] = P(mesh_axis_tp, None)          # row parallel
+        elif "wte.weight" in name:
+            specs[name] = P(mesh_axis_tp, None)          # vocab parallel
+        elif ndim >= 2:
+            specs[name] = P(*([None] * ndim))
+        else:
+            specs[name] = P()                            # replicate ln/bias
+    return specs

@@ -7,7 +7,12 @@ Must run before the first `import jax` anywhere in the test process.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force-assign (not setdefault: the environment pins JAX_PLATFORMS=axon) so
+# subprocesses spawned by tests inherit the CPU platform too. For THIS
+# process the axon plugin still overrides the env var during registration —
+# the jax.config.update below is what actually wins here (verified: even a
+# pre-import env assignment alone still yields the TPU device).
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -19,6 +24,8 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 # Numeric tests check against float64 numpy references; this JAX build
 # defaults matmuls to bf16-MXU-style passes even on CPU.
