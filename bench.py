@@ -38,7 +38,7 @@ def main():
 
     import paddle_tpu as paddle
     import paddle_tpu.optimizer as opt
-    from paddle_tpu.framework import functional_call
+    from paddle_tpu.framework import MethodAdapter, functional_call
     from paddle_tpu.models import GPT, GPTConfig
 
     on_cpu = jax.devices()[0].platform == "cpu"
@@ -57,20 +57,7 @@ def main():
     adam = opt.Adam(learning_rate=1e-4, parameters=list(model.parameters()))
     opt_state = adam.functional_init(params)
 
-    class LossModule:
-        def __init__(self, m):
-            self._m = m
-
-        def named_parameters(self, *a, **k):
-            return self._m.named_parameters(*a, **k)
-
-        def named_buffers(self, *a, **k):
-            return self._m.named_buffers(*a, **k)
-
-        def __call__(self, ids, labels):
-            return self._m.loss(ids, labels)
-
-    wrapped = LossModule(model)
+    wrapped = MethodAdapter(model, "loss")
 
     def train_step(p, s, ids):
         labels = jnp.concatenate([ids[:, 1:], ids[:, :1]], axis=1)
@@ -111,10 +98,7 @@ def main():
     step_time = max((dt_long - dt_short) / (n_long - n_short), 1e-9)
 
     tokens_per_sec = B * T / step_time
-    n_params = model.num_params()
-    # 6N per token (fwd+bwd) + attention 12*L*h*T term
-    flops_per_token = 6 * n_params + 12 * cfg.layers * cfg.hidden * T
-    mfu = tokens_per_sec * flops_per_token / peak_flops()
+    mfu = tokens_per_sec * model.flops_per_token(T) / peak_flops()
 
     print(json.dumps({
         "metric": "gpt2_124m_train_tokens_per_sec" if not on_cpu

@@ -22,4 +22,7 @@ from .core.autograd import grad
 from .ops import *  # noqa: F401,F403  — tensor function library
 from .ops import einsum  # noqa: F401
 
+from .framework import Parameter, ParamAttr, save, load  # noqa: F401
+from .hapi import Model, summary, flops  # noqa: F401
+
 __version__ = "0.1.0"

@@ -2,4 +2,5 @@
 env/collective/parallel (DP) first, fleet strategy layer, sharding,
 pipeline, launcher, PS. See SURVEY.md §2 rows 26-38."""
 from . import env  # noqa: F401
+from .mesh import build_mesh, get_mesh, named_sharding, set_mesh
 from .env import ParallelEnv, get_rank, get_world_size  # noqa: F401

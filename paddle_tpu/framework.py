@@ -172,6 +172,26 @@ def functional_call(layer, params: Dict[str, jax.Array],
     return unwrap(out), new_state
 
 
+class MethodAdapter:
+    """Present `getattr(layer, method)` as the __call__ surface that
+    functional_call drives, sharing the layer's parameter tree — e.g.
+    MethodAdapter(gpt, "loss") makes functional_call run gpt.loss(ids,
+    labels) purely."""
+
+    def __init__(self, layer, method: str):
+        self._layer = layer
+        self._method = method
+
+    def named_parameters(self, *a, **k):
+        return self._layer.named_parameters(*a, **k)
+
+    def named_buffers(self, *a, **k):
+        return self._layer.named_buffers(*a, **k)
+
+    def __call__(self, *args, **kwargs):
+        return getattr(self._layer, self._method)(*args, **kwargs)
+
+
 def unwrap(obj):
     """Tensor pytree -> raw jax array pytree."""
     if isinstance(obj, Tensor):

@@ -1,0 +1,435 @@
+"""hapi Model — fit/evaluate/predict on a jit-compiled functional step.
+
+Reference: python/paddle/hapi/model.py:810 (Model), :1299 (fit); the
+reference dispatches each batch through the dygraph tracer or a static
+Program (adapters model.py:224,:609). TPU-native redesign: ONE jitted
+train step — functional_call(layer) + jax.value_and_grad + the optimizer's
+pure functional_update — so the whole step (fwd, bwd, update) is a single
+XLA executable; buffers (BN stats) and the dropout PRNG key are threaded
+functionally through the step instead of mutated.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import random as random_mod
+from ..core.tensor import Tensor
+from ..framework import functional_call
+from ..io import DataLoader
+from ..metric import Metric
+from . import callbacks as cbks_mod
+
+
+def _as_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _to_jax(batch):
+    out = []
+    for b in _as_list(batch):
+        out.append(b._data if isinstance(b, Tensor) else jnp.asarray(b))
+    return out
+
+
+class Model:
+    """Wraps a Layer with train/eval/predict loops (hapi/model.py:810)."""
+
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self._amp_level = "O0"
+        self._jit_step = None
+        self._jit_eval = None
+        self._jit_pred = None
+        self._grad_accum_n = 1
+        self.stop_training = False
+
+    # ------------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = _as_list(metrics)
+        for m in self._metrics:
+            if not isinstance(m, Metric):
+                raise TypeError(f"metrics must be Metric, got {type(m)}")
+        if isinstance(amp_configs, str):
+            self._amp_level = amp_configs
+        elif isinstance(amp_configs, dict):
+            self._amp_level = amp_configs.get("level", "O1")
+        self._invalidate()
+
+    def _invalidate(self):
+        self._jit_step = self._jit_eval = self._jit_pred = None
+        self._jit_grad = self._jit_apply = None
+        self._accum_grads = None
+        self._accum_count = 0
+
+    # -- functional plumbing -------------------------------------------
+    def _split_tree(self):
+        from ..framework import param_arrays, state_arrays
+        return param_arrays(self.network), state_arrays(self.network)
+
+    def _write_back(self, params, state):
+        lookup = dict(self.network.named_parameters())
+        lookup.update(dict(self.network.named_buffers()))
+        for k, v in {**params, **state}.items():
+            if k in lookup:
+                lookup[k]._data = v
+
+    def _compute_loss(self, outputs, labels):
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        if self._loss is None:
+            return outs[0]
+        wrapped_outs = [Tensor(o) if not isinstance(o, Tensor) else o
+                        for o in outs]
+        wrapped_lbls = [Tensor(l) if not isinstance(l, Tensor) else l
+                        for l in labels]
+        loss = self._loss(*wrapped_outs, *wrapped_lbls)
+        return loss._data if isinstance(loss, Tensor) else loss
+
+    def _build_train_step(self):
+        optimizer = self._optimizer
+        amp_on = self._amp_level in ("O1", "O2")
+
+        def train_step(params, state, opt_state, key, lr, inputs, labels):
+            def loss_of(p):
+                from .. import amp as amp_mod
+                with random_mod.key_scope(key):
+                    ctx = amp_mod.auto_cast(enable=amp_on,
+                                            level=self._amp_level,
+                                            dtype="bfloat16")
+                    with ctx:
+                        outs, new_state = functional_call(
+                            self.network, p, state, *inputs)
+                loss = self._compute_loss(outs, labels)
+                return loss, (outs, new_state)
+
+            (loss, (outs, new_state)), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(params)
+            new_params, new_opt = optimizer.functional_update(
+                params, grads, opt_state, lr=lr)
+            return loss, outs, new_params, new_state, new_opt
+
+        return jax.jit(train_step, donate_argnums=(0, 2))
+
+    def _build_grad_step(self):
+        amp_on = self._amp_level in ("O1", "O2")
+
+        def grad_step(params, state, key, inputs, labels):
+            def loss_of(p):
+                from .. import amp as amp_mod
+                with random_mod.key_scope(key):
+                    with amp_mod.auto_cast(enable=amp_on,
+                                           level=self._amp_level,
+                                           dtype="bfloat16"):
+                        outs, new_state = functional_call(
+                            self.network, p, state, *inputs)
+                loss = self._compute_loss(outs, labels)
+                return loss, (outs, new_state)
+
+            (loss, (outs, new_state)), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(params)
+            return loss, outs, new_state, grads
+
+        return jax.jit(grad_step)
+
+    def _build_apply_step(self):
+        optimizer = self._optimizer
+        n_acc = self._grad_accum_n
+
+        def apply_step(params, opt_state, grads, lr):
+            grads = jax.tree_util.tree_map(lambda g: g / n_acc, grads)
+            return optimizer.functional_update(params, grads, opt_state,
+                                               lr=lr)
+
+        return jax.jit(apply_step, donate_argnums=(0, 1, 2))
+
+    def _build_eval_step(self):
+        def eval_step(params, state, inputs, labels):
+            outs, _ = functional_call(self.network, params, state, *inputs)
+            loss = (self._compute_loss(outs, labels)
+                    if (self._loss is not None and labels) else None)
+            return loss, outs
+
+        return jax.jit(eval_step)
+
+    # ------------------------------------------------------------------
+    def train_batch(self, inputs, labels=None):
+        """One optimizer step on a batch; returns [loss] (+metric updates)."""
+        if self._optimizer is None:
+            raise RuntimeError("call prepare(optimizer, loss) first")
+        self.network.train()
+        if self._jit_step is None:
+            self._jit_step = self._build_train_step()
+            self._params, self._state = self._split_tree()
+            restored = getattr(self, "_restored_opt_state", None)
+            if restored is not None and set(restored) == set(self._params):
+                self._opt_state = jax.tree_util.tree_map(jnp.asarray, restored)
+            else:
+                self._opt_state = self._optimizer.functional_init(self._params)
+            self._restored_opt_state = None
+        inputs = _to_jax(inputs)
+        labels = _to_jax(labels)
+        key = random_mod.next_key()
+        lr = jnp.asarray(self._optimizer.get_lr(), jnp.float32)
+        n_acc = getattr(self, "_grad_accum_n", 1)
+        if n_acc > 1:
+            # gradient merge (reference GradientMergeOptimizer
+            # optimizer.py:5671): accumulate microbatch grads, apply every
+            # n_acc batches with the mean
+            if getattr(self, "_jit_grad", None) is None:
+                self._jit_grad = self._build_grad_step()
+                self._jit_apply = self._build_apply_step()
+                self._accum_grads = None
+                self._accum_count = 0
+            loss, outs, self._state, grads = self._jit_grad(
+                self._params, self._state, key, inputs, labels)
+            self._accum_grads = grads if self._accum_grads is None else \
+                jax.tree_util.tree_map(jnp.add, self._accum_grads, grads)
+            self._accum_count += 1
+            if self._accum_count >= n_acc:
+                self._params, self._opt_state = self._jit_apply(
+                    self._params, self._opt_state, self._accum_grads, lr)
+                self._accum_grads = None
+                self._accum_count = 0
+        else:
+            loss, outs, self._params, self._state, self._opt_state = \
+                self._jit_step(self._params, self._state, self._opt_state,
+                               key, lr, inputs, labels)
+        self._update_metrics(outs, labels)
+        return [float(jax.device_get(loss))]
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        if self._jit_eval is None:
+            self._jit_eval = self._build_eval_step()
+        if self._jit_step is not None:
+            params, state = self._params, self._state
+        else:
+            params, state = self._split_tree()
+            params = {**params}
+        inputs, labels = _to_jax(inputs), _to_jax(labels)
+        loss, outs = self._jit_eval({**params}, state, inputs, labels)
+        self._update_metrics(outs, labels)
+        return [float(jax.device_get(loss))] if loss is not None else []
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        if self._jit_eval is None:
+            self._jit_eval = self._build_eval_step()
+        if self._jit_step is not None:
+            params, state = self._params, self._state
+        else:
+            params, state = self._split_tree()
+        _, outs = self._jit_eval({**params}, state, _to_jax(inputs), [])
+        outs = outs if isinstance(outs, (list, tuple)) else [outs]
+        return [np.asarray(jax.device_get(o)) for o in outs]
+
+    def _update_metrics(self, outs, labels):
+        if not self._metrics:
+            return
+        outs = outs if isinstance(outs, (list, tuple)) else [outs]
+        pred = Tensor(outs[0])
+        lbls = [Tensor(l) for l in labels]
+        for m in self._metrics:
+            res = m.compute(pred, *lbls)
+            res = res if isinstance(res, (list, tuple)) else [res]
+            m.update(*[np.asarray(r._data if isinstance(r, Tensor) else r)
+                       for r in res])
+
+    # ------------------------------------------------------------------
+    def _make_loader(self, data, batch_size, shuffle, drop_last=False,
+                     num_workers=0):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          drop_last=drop_last, num_workers=num_workers)
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        """Train loop with callbacks (reference fit hapi/model.py:1299)."""
+        train_loader = self._make_loader(train_data, batch_size, shuffle,
+                                         drop_last, num_workers)
+        eval_loader = self._make_loader(eval_data, batch_size, False)
+        n_acc = max(int(accumulate_grad_batches), 1)
+        if n_acc != self._grad_accum_n:
+            self._grad_accum_n = n_acc
+            self._jit_grad = self._jit_apply = None  # apply step captures n
+            self._accum_grads, self._accum_count = None, 0
+
+        metric_names = ["loss"]
+        for m in self._metrics:
+            n = m.name()
+            metric_names += list(n) if isinstance(n, (list, tuple)) else [n]
+        cbks = cbks_mod.config_callbacks(
+            callbacks, model=self, epochs=epochs,
+            steps=self._len_or_none(train_loader), verbose=verbose,
+            log_freq=log_freq, save_freq=save_freq, save_dir=save_dir,
+            metrics=metric_names)
+
+        cbks.on_begin("train")
+        self.stop_training = False
+        global_step = 0
+        logs = {}
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            cbks.on_epoch_begin(epoch)
+            self._reset_metrics()
+            for step, batch in enumerate(train_loader):
+                cbks.on_batch_begin("train", step, logs)
+                ins, lbls = self._split_batch(batch)
+                losses = self.train_batch(ins, lbls)
+                logs = self._step_logs(losses, step, batch_size)
+                cbks.on_batch_end("train", step, logs)
+                global_step += 1
+                if num_iters is not None and global_step >= num_iters:
+                    self.stop_training = True
+                    break
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_loader, verbose=0,
+                                          _inside_fit=cbks)
+                logs.update({"eval_" + k: v for k, v in eval_logs.items()})
+            cbks.on_epoch_end(epoch, logs)
+        cbks.on_end("train", logs)
+        return self
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, _inside_fit=None):
+        loader = self._make_loader(eval_data, batch_size, False)
+        self._reset_metrics()
+        losses_sum, n = 0.0, 0
+        cbks = _inside_fit
+        if cbks:
+            cbks.on_begin("eval")
+        for step, batch in enumerate(loader):
+            ins, lbls = self._split_batch(batch)
+            losses = self.eval_batch(ins, lbls)
+            if losses:
+                losses_sum += losses[0]
+                n += 1
+        logs = {}
+        if n:
+            logs["loss"] = losses_sum / n
+        for m in self._metrics:
+            logs.update(self._metric_items(m))
+        if cbks:
+            cbks.on_end("eval", logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None, verbose=1):
+        loader = self._make_loader(test_data, batch_size, False)
+        outputs = []
+        for batch in loader:
+            ins, _ = self._split_batch(batch, has_labels=False)
+            outputs.append(self.predict_batch(ins))
+        # transpose: list-of-batches -> per-output list
+        n_out = len(outputs[0]) if outputs else 0
+        per_out = [[b[i] for b in outputs] for i in range(n_out)]
+        if stack_outputs:
+            per_out = [np.concatenate(o, axis=0) for o in per_out]
+        return per_out
+
+    # ------------------------------------------------------------------
+    def _split_batch(self, batch, has_labels=True):
+        batch = batch if isinstance(batch, (list, tuple)) else [batch]
+        if self._inputs is not None:
+            n_in = len(_as_list(self._inputs))
+            ins = list(batch[:n_in])
+            lbls = list(batch[n_in:]) if has_labels else []
+            return ins, lbls
+        # no input spec: (x, y) convention — trailing element is the label,
+        # dropped (not fed to the network) in predict mode
+        n_lbl = 1 if len(batch) > 1 else 0
+        if n_lbl == 0:
+            return list(batch), []
+        return list(batch[:-n_lbl]), \
+            (list(batch[-n_lbl:]) if has_labels else [])
+
+    @staticmethod
+    def _metric_items(m):
+        """paddle Metric.name()/accumulate() may return scalars or lists
+        (Accuracy with multiple topk)."""
+        names = m.name()
+        vals = m.accumulate()
+        names = names if isinstance(names, (list, tuple)) else [names]
+        vals = vals if isinstance(vals, (list, tuple)) else [vals]
+        return list(zip(names, vals))
+
+    def _step_logs(self, losses, step, batch_size):
+        logs = {"loss": losses[0] if losses else 0.0, "step": step,
+                "batch_size": batch_size}
+        for m in self._metrics:
+            logs.update(self._metric_items(m))
+        return logs
+
+    def _reset_metrics(self):
+        for m in self._metrics:
+            m.reset()
+
+    @staticmethod
+    def _len_or_none(loader):
+        try:
+            return len(loader)
+        except Exception:
+            return None
+
+    # ------------------------------------------------------------------
+    def _sync_network(self):
+        """Write jitted-step params back into the Layer tree."""
+        if self._jit_step is not None:
+            self._write_back(self._params, self._state)
+
+    def save(self, path, training=True):
+        """state_dict save (reference Model.save hapi/model.py; inference
+        export goes through paddle_tpu.jit.save)."""
+        self._sync_network()
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        from ..framework import save as fsave
+        fsave(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            opt_sd = self._optimizer.state_dict()
+            if self._jit_step is not None:
+                opt_sd["functional_state"] = jax.device_get(self._opt_state)
+            with open(path + ".pdopt", "wb") as f:
+                pickle.dump(opt_sd, f, protocol=4)
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework import load as fload
+        sd = fload(path + ".pdparams")
+        self.network.set_state_dict(sd)
+        self._invalidate()
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            with open(path + ".pdopt", "rb") as f:
+                opt_sd = pickle.load(f)
+            # functional slots (Adam moments etc.) re-seed the next jit step
+            self._restored_opt_state = opt_sd.pop("functional_state", None)
+            self._optimizer.set_state_dict(opt_sd)
+        return self
+
+    def parameters(self, *a, **k):
+        return self.network.parameters(*a, **k)
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary as _summary
+        return _summary(self.network, input_size, dtypes=dtype)

@@ -1,0 +1,106 @@
+"""Model summary + FLOPs estimate (reference: hapi/model_summary.py,
+hapi/dynamic_flops.py)."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+
+def _param_count(layer: Layer):
+    return sum(int(math.prod(p.shape)) for p in layer.parameters())
+
+
+def summary(net: Layer, input_size=None, dtypes=None, input=None):
+    """Print a per-layer table; returns {'total_params', 'trainable_params'}."""
+    rows = []
+    hooks = []
+
+    def mk_hook(name):
+        def hook(layer, inputs, outputs):
+            out = outputs[0] if isinstance(outputs, (list, tuple)) else outputs
+            shape = list(out.shape) if hasattr(out, "shape") else "?"
+            own = sum(int(math.prod(p.shape))
+                      for p in layer.parameters(include_sublayers=False))
+            rows.append((name, type(layer).__name__, shape, own))
+        return hook
+
+    for name, sub in net.named_sublayers():
+        hooks.append(sub.register_forward_post_hook(mk_hook(name)))
+
+    try:
+        if input is not None:
+            x = input if isinstance(input, (list, tuple)) else [input]
+        elif input_size is not None:
+            sizes = (input_size if isinstance(input_size, list)
+                     else [input_size])
+            dts = dtypes if isinstance(dtypes, (list, tuple)) else \
+                [dtypes] * len(sizes)
+            x = [Tensor(np.zeros([d if d is not None else 1 for d in s],
+                                 dtype=np.dtype(dt or "float32")))
+                 for s, dt in zip(sizes, dts)]
+        else:
+            raise ValueError("summary needs input_size or input")
+        was_training = net.training
+        net.eval()
+        net(*x)
+        if was_training:
+            net.train()
+    finally:
+        for h in hooks:
+            h.remove()
+
+    total = _param_count(net)
+    trainable = sum(int(math.prod(p.shape)) for p in net.parameters()
+                    if getattr(p, "trainable", True))
+    width = max([len(r[0]) for r in rows] + [10])
+    print(f"{'Layer':<{width}}  {'Type':<20} {'Output Shape':<20} Params")
+    print("-" * (width + 50))
+    for name, tname, shape, own in rows:
+        print(f"{name:<{width}}  {tname:<20} {str(shape):<20} {own}")
+    print("-" * (width + 50))
+    print(f"Total params: {total:,}\nTrainable params: {trainable:,}")
+    return {"total_params": total, "trainable_params": trainable}
+
+
+def flops(net: Layer, input_size, custom_ops=None, print_detail=False):
+    """Rough multiply-accumulate count for conv/linear layers."""
+    total = [0]
+    hooks = []
+
+    def conv_hook(layer, inputs, outputs):
+        out = outputs[0] if isinstance(outputs, (list, tuple)) else outputs
+        k = math.prod(layer._kernel_size) if hasattr(layer, "_kernel_size") \
+            else 1
+        cin = getattr(layer, "_in_channels", 1)
+        groups = getattr(layer, "_groups", 1)
+        total[0] += int(math.prod(out.shape)) * cin // groups * k
+
+    def linear_hook(layer, inputs, outputs):
+        out = outputs[0] if isinstance(outputs, (list, tuple)) else outputs
+        total[0] += int(math.prod(out.shape)) * layer.weight.shape[0]
+
+    from ..nn.layer.conv import _ConvNd
+    from ..nn.layer.common import Linear
+    for _, sub in net.named_sublayers():
+        if isinstance(sub, _ConvNd):
+            hooks.append(sub.register_forward_post_hook(conv_hook))
+        elif isinstance(sub, Linear):
+            hooks.append(sub.register_forward_post_hook(linear_hook))
+    try:
+        x = Tensor(np.zeros([d if d is not None else 1 for d in input_size],
+                            np.float32))
+        was_training = net.training
+        net.eval()
+        net(x)
+        if was_training:
+            net.train()
+    finally:
+        for h in hooks:
+            h.remove()
+    if print_detail:
+        print(f"FLOPs (MACs): {total[0]:,}")
+    return total[0]

@@ -137,11 +137,11 @@ class GPT(nn.Layer):
     def num_params(self) -> int:
         return sum(int(math.prod(p.shape)) for p in self.parameters())
 
-    def flops_per_token(self) -> int:
-        """~6N + attention term; used by the MFU reporter."""
+    def flops_per_token(self, seq_len=None) -> int:
+        """~6N + attention term for a train step (fwd+bwd); MFU reporter."""
         n = self.num_params()
         c = self.cfg
-        attn = 12 * c.layers * c.hidden * c.max_seq_len
+        attn = 12 * c.layers * c.hidden * (seq_len or c.max_seq_len)
         return 6 * n + attn
 
 

@@ -88,8 +88,12 @@ def _ceil_pads(a, pads, kernel, stride, n, channels_last):
 
 
 def _neg_inf(dtype):
-    return jnp.asarray(-jnp.inf, dtype) if jnp.issubdtype(dtype, jnp.floating) \
-        else jnp.iinfo(dtype).min
+    # python/numpy scalar, NOT a jnp array: jax only recognises the max
+    # monoid (and thus has a transpose rule for reverse-mode autodiff) when
+    # the init value is an identity scalar, not a staged constant
+    return (np.array(-np.inf, dtype)
+            if jnp.issubdtype(dtype, jnp.floating)
+            else np.array(np.iinfo(dtype).min, dtype))
 
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
@@ -115,7 +119,7 @@ def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
 
 
 def _zero(dtype):
-    return jnp.zeros((), dtype)
+    return np.zeros((), dtype)  # scalar identity (see _neg_inf note)
 
 
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,

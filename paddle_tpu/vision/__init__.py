@@ -1,0 +1,5 @@
+"""Vision: datasets, transforms, model zoo
+(reference: python/paddle/vision/)."""
+from . import datasets, models, transforms
+
+__all__ = ["datasets", "models", "transforms"]

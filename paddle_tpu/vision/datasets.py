@@ -1,0 +1,130 @@
+"""Vision datasets (reference: python/paddle/vision/datasets/ — mnist.py,
+cifar.py, flowers.py; the reference auto-downloads via paddle.dataset).
+
+This environment has no network egress, so constructors accept local files
+(standard idx/pickle formats) and raise a clear error otherwise; FakeData
+provides deterministic synthetic samples for smoke tests and benchmarks.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData"]
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic images + labels (torchvision FakeData analog;
+    no reference equivalent — exists because this build cannot download)."""
+
+    def __init__(self, num_samples=1000, image_shape=(1, 28, 28),
+                 num_classes=10, transform=None, seed=0):
+        self.num_samples = num_samples
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self.seed = seed
+
+    def __getitem__(self, idx):
+        rng = np.random.default_rng(self.seed + idx)
+        label = int(rng.integers(0, self.num_classes))
+        # class-dependent mean so models can actually learn from it
+        img = rng.normal(loc=label / self.num_classes, scale=0.3,
+                         size=self.image_shape).astype(np.float32)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(label)
+
+    def __len__(self):
+        return self.num_samples
+
+
+def _require(path, name):
+    if path is None or not os.path.exists(path):
+        raise RuntimeError(
+            f"{name}: no network egress in this environment — pass the "
+            f"local file path (got {path!r}), or use "
+            f"paddle_tpu.vision.datasets.FakeData for synthetic samples")
+    return path
+
+
+class MNIST(Dataset):
+    """idx-format MNIST (reference: vision/datasets/mnist.py parses the same
+    gzip idx files it downloads)."""
+
+    NAME = "MNIST"
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None):
+        self.mode = mode
+        self.transform = transform
+        _require(image_path, self.NAME)
+        _require(label_path, self.NAME)
+        with gzip.open(image_path, "rb") as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            self.images = np.frombuffer(f.read(), np.uint8).reshape(
+                n, rows, cols)
+        with gzip.open(label_path, "rb") as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            self.labels = np.frombuffer(f.read(), np.uint8).astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32)[None]  # CHW
+        if self.transform is not None:
+            img = self.transform(self.images[idx])
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    NAME = "FashionMNIST"
+
+
+class Cifar10(Dataset):
+    """python-pickle CIFAR tarball (reference: vision/datasets/cifar.py)."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        _require(data_file, "Cifar10")
+        self.transform = transform
+        self.mode = mode
+        data, labels = [], []
+        with tarfile.open(data_file) as tf:
+            want = self._member_names(mode)
+            names = [m for m in tf.getmembers()
+                     if any(w in m.name for w in want) and m.isfile()]
+            for m in sorted(names, key=lambda m: m.name):
+                d = pickle.load(tf.extractfile(m), encoding="bytes")
+                data.append(d[b"data"])
+                labels += list(d.get(b"labels", d.get(b"fine_labels", [])))
+        self.data = np.concatenate(data).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(labels, np.int64)
+
+    @staticmethod
+    def _member_names(mode):
+        return ("data_batch",) if mode == "train" else ("test_batch",)
+
+    def __getitem__(self, idx):
+        img = self.data[idx].astype(np.float32)
+        if self.transform is not None:
+            img = self.transform(self.data[idx].transpose(1, 2, 0))
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Cifar100(Cifar10):
+    # CIFAR-100 archive members are named 'train'/'test' (not data_batch_*)
+    @staticmethod
+    def _member_names(mode):
+        return ("train",) if mode == "train" else ("test",)

@@ -1,0 +1,12 @@
+"""Model zoo (reference: python/paddle/vision/models/ — lenet, resnet,
+vgg, mobilenet v1/v2)."""
+from .lenet import LeNet
+from .mobilenet import (MobileNetV1, MobileNetV2, mobilenet_v1, mobilenet_v2)
+from .resnet import (ResNet, resnet18, resnet34, resnet50, resnet101,
+                     resnet152)
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19
+
+__all__ = ["LeNet", "ResNet", "resnet18", "resnet34", "resnet50",
+           "resnet101", "resnet152", "VGG", "vgg11", "vgg13", "vgg16",
+           "vgg19", "MobileNetV1", "MobileNetV2", "mobilenet_v1",
+           "mobilenet_v2"]

@@ -1,0 +1,135 @@
+"""MobileNet V1/V2 (reference: python/paddle/vision/models/mobilenetv1.py,
+mobilenetv2.py)."""
+from __future__ import annotations
+
+from ... import nn
+
+
+class ConvBNReLU(nn.Sequential):
+    def __init__(self, in_c, out_c, kernel, stride=1, groups=1, relu6=True):
+        pad = (kernel - 1) // 2
+        super().__init__(
+            nn.Conv2D(in_c, out_c, kernel, stride=stride, padding=pad,
+                      groups=groups, bias_attr=False),
+            nn.BatchNorm2D(out_c),
+            nn.ReLU6() if relu6 else nn.ReLU())
+
+
+class DepthwiseSeparable(nn.Layer):
+    def __init__(self, in_c, out_c1, out_c2, num_groups, stride, scale):
+        super().__init__()
+        self.dw = ConvBNReLU(int(in_c * scale), int(out_c1 * scale), 3,
+                             stride=stride, groups=int(num_groups * scale),
+                             relu6=False)
+        self.pw = ConvBNReLU(int(out_c1 * scale), int(out_c2 * scale), 1,
+                             relu6=False)
+
+    def forward(self, x):
+        return self.pw(self.dw(x))
+
+
+class MobileNetV1(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.scale = scale
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        cfg = [  # in, out1, out2, groups, stride
+            (32, 32, 64, 32, 1), (64, 64, 128, 64, 2),
+            (128, 128, 128, 128, 1), (128, 128, 256, 128, 2),
+            (256, 256, 256, 256, 1), (256, 256, 512, 256, 2),
+            (512, 512, 512, 512, 1), (512, 512, 512, 512, 1),
+            (512, 512, 512, 512, 1), (512, 512, 512, 512, 1),
+            (512, 512, 512, 512, 1), (512, 512, 1024, 512, 2),
+            (1024, 1024, 1024, 1024, 1)]
+        self.conv1 = ConvBNReLU(3, int(32 * scale), 3, stride=2, relu6=False)
+        self.blocks = nn.Sequential(*[
+            DepthwiseSeparable(i, o1, o2, g, s, scale)
+            for i, o1, o2, g, s in cfg])
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(int(1024 * scale), num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.conv1(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.fc(x)
+        return x
+
+
+class InvertedResidual(nn.Layer):
+    def __init__(self, inp, oup, stride, expand_ratio):
+        super().__init__()
+        self.stride = stride
+        hidden = int(round(inp * expand_ratio))
+        self.use_res = stride == 1 and inp == oup
+        layers = []
+        if expand_ratio != 1:
+            layers.append(ConvBNReLU(inp, hidden, 1))
+        layers += [
+            ConvBNReLU(hidden, hidden, 3, stride=stride, groups=hidden),
+            nn.Conv2D(hidden, oup, 1, bias_attr=False),
+            nn.BatchNorm2D(oup)]
+        self.conv = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+def _make_divisible(v, divisor=8, min_value=None):
+    min_value = min_value or divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class MobileNetV2(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        cfg = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+               (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+        input_channel = _make_divisible(32 * scale)
+        features = [ConvBNReLU(3, input_channel, 3, stride=2)]
+        for t, c, n, s in cfg:
+            out_c = _make_divisible(c * scale)
+            for i in range(n):
+                features.append(InvertedResidual(
+                    input_channel, out_c, s if i == 0 else 1, t))
+                input_channel = out_c
+        self.last_channel = _make_divisible(1280 * max(1.0, scale))
+        features.append(ConvBNReLU(input_channel, self.last_channel, 1))
+        self.features = nn.Sequential(*features)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Dropout(0.2), nn.Linear(self.last_channel, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.classifier(x)
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError("no bundled pretrained weights")
+    return MobileNetV1(scale=scale, **kwargs)
+
+
+def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError("no bundled pretrained weights")
+    return MobileNetV2(scale=scale, **kwargs)

@@ -1,0 +1,183 @@
+"""hapi Model.fit + vision zoo (reference: python/paddle/tests/test_model.py,
+test_vision_models.py). BASELINE config 1: LeNet classifier via Model.fit."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.hapi import EarlyStopping, Model
+from paddle_tpu.metric import Accuracy
+from paddle_tpu.vision.datasets import FakeData
+from paddle_tpu.vision.models import (LeNet, MobileNetV2, mobilenet_v1,
+                                      resnet18, resnet50, vgg16)
+from paddle_tpu.vision import transforms as T
+
+
+def test_lenet_fit_learns():
+    paddle.seed(42)
+    net = LeNet()
+    model = Model(net)
+    model.prepare(opt.Adam(learning_rate=3e-3,
+                           parameters=net.parameters()),
+                  nn.CrossEntropyLoss(), Accuracy())
+    train = FakeData(num_samples=256, image_shape=(1, 28, 28), num_classes=10)
+    val = FakeData(num_samples=64, image_shape=(1, 28, 28), num_classes=10,
+                   seed=999)
+    model.fit(train, val, batch_size=32, epochs=8, verbose=0)
+    logs = model.evaluate(val, batch_size=32, verbose=0)
+    # class-conditioned FakeData is learnable: random guess = 0.1
+    assert logs["acc"] > 0.5, logs
+
+
+def test_model_train_eval_predict_batch():
+    paddle.seed(0)
+    net = LeNet()
+    model = Model(net)
+    model.prepare(opt.SGD(learning_rate=0.01, parameters=net.parameters()),
+                  nn.CrossEntropyLoss())
+    x = np.random.randn(4, 1, 28, 28).astype(np.float32)
+    y = np.array([[1], [2], [3], [4]], np.int64)
+    loss1 = model.train_batch([x], [y])
+    loss2 = model.train_batch([x], [y])
+    assert loss2[0] < loss1[0] * 1.5  # moving
+    ev = model.eval_batch([x], [y])
+    assert len(ev) == 1
+    out = model.predict_batch([x])
+    assert out[0].shape == (4, 10)
+
+
+def test_model_save_load(tmp_path):
+    paddle.seed(0)
+    net = LeNet()
+    model = Model(net)
+    model.prepare(opt.Adam(learning_rate=1e-3,
+                           parameters=net.parameters()),
+                  nn.CrossEntropyLoss())
+    x = np.random.randn(2, 1, 28, 28).astype(np.float32)
+    y = np.array([[1], [2]], np.int64)
+    model.train_batch([x], [y])
+    pred_before = model.predict_batch([x])[0]
+    path = str(tmp_path / "ckpt" / "model")
+    model.save(path)
+
+    net2 = LeNet()
+    model2 = Model(net2)
+    model2.prepare(opt.Adam(learning_rate=1e-3,
+                            parameters=net2.parameters()),
+                   nn.CrossEntropyLoss())
+    model2.load(path)
+    pred_after = model2.predict_batch([x])[0]
+    np.testing.assert_allclose(pred_before, pred_after, atol=1e-5)
+
+
+def test_grad_accumulation_matches_big_batch():
+    """4 microbatches with accumulate_grad_batches=4 == one batch of 4x,
+    for SGD (linear in grads)."""
+    def run(accum, batches):
+        paddle.seed(0)
+        net = nn.Linear(3, 2)
+        model = Model(net)
+        model.prepare(opt.SGD(learning_rate=0.1,
+                              parameters=net.parameters()),
+                      nn.MSELoss())
+        from paddle_tpu.io import TensorDataset
+        xs = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(4, 3))
+        ys = paddle.to_tensor(np.ones((4, 2), np.float32))
+        ds = TensorDataset([xs, ys])
+        model.fit(ds, batch_size=batches, epochs=1, verbose=0,
+                  shuffle=False, accumulate_grad_batches=accum)
+        model._sync_network()
+        return net.weight.numpy()
+
+    w_accum = run(accum=4, batches=1)
+    w_big = run(accum=1, batches=4)
+    np.testing.assert_allclose(w_accum, w_big, rtol=1e-5)
+
+
+def test_resume_restores_optimizer_slots(tmp_path):
+    paddle.seed(0)
+    net = nn.Linear(2, 2)
+    model = Model(net)
+    model.prepare(opt.Adam(learning_rate=0.1,
+                           parameters=net.parameters()),
+                  nn.MSELoss())
+    x = np.ones((2, 2), np.float32)
+    y = np.zeros((2, 2), np.float32)
+    model.train_batch([x], [y])
+    model.save(str(tmp_path / "m"))
+    net2 = nn.Linear(2, 2)
+    model2 = Model(net2)
+    model2.prepare(opt.Adam(learning_rate=0.1,
+                            parameters=net2.parameters()),
+                   nn.MSELoss())
+    model2.load(str(tmp_path / "m"))
+    model2.train_batch([x], [y])  # triggers jit init from restored slots
+    m1 = model2._opt_state
+    # moment1 should reflect two accumulated steps, not one fresh step
+    model.train_batch([x], [y])
+    m0 = model._opt_state
+    k = sorted(m0.keys())[0]
+    np.testing.assert_allclose(np.asarray(m0[k]["moment1"]),
+                               np.asarray(m1[k]["moment1"]), rtol=1e-5)
+
+
+def test_early_stopping_stops():
+    paddle.seed(0)
+    net = LeNet()
+    model = Model(net)
+    model.prepare(opt.SGD(learning_rate=0.0,  # lr 0: loss can't improve
+                          parameters=net.parameters()),
+                  nn.CrossEntropyLoss())
+    data = FakeData(num_samples=64, image_shape=(1, 28, 28))
+    es = EarlyStopping(monitor="loss", patience=0, verbose=0)
+    model.fit(data, batch_size=32, epochs=10, verbose=0, callbacks=[es])
+    assert model.stop_training
+
+
+def test_summary_and_flops():
+    net = LeNet()
+    info = paddle.summary(net, (1, 1, 28, 28))
+    assert info["total_params"] == 61610  # classic LeNet-5 paddle variant
+    fl = paddle.flops(net, (1, 1, 28, 28))
+    assert fl > 0
+
+
+@pytest.mark.parametrize("ctor,size,n_out", [
+    (resnet18, 64, 1000),
+    (lambda: MobileNetV2(scale=0.25, num_classes=7), 32, 7),
+])
+def test_vision_models_forward(ctor, size, n_out):
+    net = ctor()
+    net.eval()
+    x = paddle.to_tensor(np.random.randn(1, 3, size, size).astype(np.float32))
+    out = net(x)
+    assert out.shape == [1, n_out]
+
+
+def test_resnet50_structure():
+    net = resnet50(num_classes=10)
+    n = sum(int(np.prod(p.shape)) for p in net.parameters())
+    assert 23_000_000 < n < 26_000_000  # ~23.5M + fc
+
+
+def test_transforms_pipeline():
+    tf = T.Compose([
+        T.Resize(36), T.RandomCrop(32), T.RandomHorizontalFlip(),
+        T.ToTensor(), T.Normalize(mean=[0.5], std=[0.5])])
+    img = (np.random.rand(28, 30, 3) * 255).astype(np.uint8)
+    out = tf(img)
+    assert out.shape == (3, 32, 32)
+    assert out.dtype == np.float32
+    assert -1.01 <= out.min() and out.max() <= 1.01
+
+
+def test_transforms_functional():
+    img = (np.random.rand(10, 8, 3) * 255).astype(np.uint8)
+    assert T.resize(img, (5, 4)).shape == (5, 4, 3)
+    assert T.center_crop(img, 6).shape == (6, 6, 3)
+    np.testing.assert_array_equal(T.hflip(img), img[:, ::-1])
+    assert T.pad(img, 2).shape == (14, 12, 3)
+    g = T.Grayscale(3)(img)
+    assert g.shape == (10, 8, 3)
+    np.testing.assert_allclose(g[..., 0], g[..., 1])
