@@ -1,6 +1,40 @@
-"""paddle.distributed parity surface — built out in stages:
-env/collective/parallel (DP) first, fleet strategy layer, sharding,
-pipeline, launcher, PS. See SURVEY.md §2 rows 26-38."""
-from . import env  # noqa: F401
-from .mesh import build_mesh, get_mesh, named_sharding, set_mesh
-from .env import ParallelEnv, get_rank, get_world_size  # noqa: F401
+"""paddle.distributed parity surface (SURVEY.md §2 rows 26-38)."""
+from . import collective, env, fleet, sharding  # noqa: F401
+from .collective import (ReduceOp, all_gather, all_reduce, alltoall,  # noqa: F401
+                         barrier, broadcast, get_group, new_group, p2p,
+                         recv, reduce, reduce_scatter, scatter, send)
+from .env import get_rank, get_world_size, init_distributed  # noqa: F401
+from .mesh import build_mesh, get_mesh, named_sharding, set_mesh  # noqa: F401
+from .parallel import DataParallel, ParallelEnv, init_parallel_env  # noqa: F401
+from .pipeline import PipelineLayer, pipeline_spmd, stack_stage_params  # noqa: F401
+
+init = init_parallel_env  # paddle.distributed alias surface
+
+
+def spawn(func, args=(), nprocs=1, join=True, daemon=False, **options):
+    """paddle.distributed.spawn parity (reference spawn.py:317) —
+    multiprocessing fan-out with the PADDLE_* env protocol. With
+    join=False returns the process list for the caller to join."""
+    import multiprocessing as mp
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        env = {"PADDLE_TRAINER_ID": str(rank),
+               "PADDLE_TRAINERS_NUM": str(nprocs)}
+        p = ctx.Process(target=_spawn_entry, args=(func, args, env),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+    if not join:
+        return procs
+    for p in procs:
+        p.join()
+    if any(p.exitcode != 0 for p in procs):
+        raise RuntimeError(
+            f"spawn: worker exit codes {[p.exitcode for p in procs]}")
+
+
+def _spawn_entry(func, args, env):
+    import os
+    os.environ.update(env)
+    func(*args)

@@ -1,0 +1,322 @@
+"""Functional collectives — paddle.distributed.{all_reduce, all_gather, ...}
+parity (reference: python/paddle/distributed/collective.py:101-457 and the
+c_* op set operators/collective/, SURVEY.md §2 row 27).
+
+TPU-native redesign: the reference issues NCCL calls on comm streams via
+per-op kernels (c_allreduce_op.h:109). Here a collective is a *traceable
+function*: inside a `shard_map`ped / pjit'ed region it lowers to the XLA
+ICI collective (psum/all_gather/ppermute — compiler-scheduled, no streams,
+no comm-init); called eagerly on a sharded array it jits a tiny psum over
+the current mesh. `ReduceOp` and `group` keep the paddle API shape; a group
+names a mesh axis instead of an NCCL ring id.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+from . import mesh as mesh_mod
+
+__all__ = ["ReduceOp", "new_group", "get_group", "all_reduce", "all_gather",
+           "reduce_scatter", "broadcast", "reduce", "scatter", "alltoall",
+           "send", "recv", "barrier", "split_group_axis"]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """A communication group == a named mesh axis (the reference's ring_id →
+    axis name)."""
+
+    def __init__(self, axis: str, mesh=None):
+        self.axis = axis
+        self.mesh = mesh
+
+    @property
+    def nranks(self):
+        m = self.mesh or mesh_mod.get_mesh()
+        return int(m.shape[self.axis]) if m is not None else 1
+
+    def __repr__(self):
+        return f"Group(axis={self.axis!r}, nranks={self.nranks})"
+
+
+_groups = {}
+
+
+def new_group(ranks=None, axis: str = None, mesh=None, backend=None):
+    """Create/fetch the group for a mesh axis (paddle's new_group takes rank
+    lists; on TPU the mesh topology already fixes membership, so the axis
+    name is the identity)."""
+    axis = axis or "dp"
+    if axis not in _groups:
+        _groups[axis] = Group(axis, mesh)
+    return _groups[axis]
+
+
+def get_group(axis="dp"):
+    return new_group(axis=axis)
+
+
+def _axis_of(group) -> str:
+    if group is None:
+        return "dp"
+    if isinstance(group, Group):
+        return group.axis
+    return str(group)
+
+
+def _in_trace(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def _sharded_over(arr, axis) -> bool:
+    sh = getattr(arr, "sharding", None)
+    spec = getattr(sh, "spec", None)
+    if spec is None:
+        return False
+    for entry in spec:
+        if entry == axis or (isinstance(entry, tuple) and axis in entry):
+            return True
+    return False
+
+
+def _unwrap(t):
+    return t._data if isinstance(t, Tensor) else t
+
+
+def _rewrap(out, like):
+    if isinstance(like, Tensor):
+        like._data = out
+        return like
+    return out
+
+
+def _eager_collective(fn_name, arr, axis, **kw):
+    """Run a collective on a (possibly sharded) concrete array by jitting a
+    shard_map over the current mesh — eager-API parity for dygraph code."""
+    m = mesh_mod.get_mesh()
+    if m is None or axis not in m.axis_names or m.shape[axis] == 1:
+        # single rank: collectives are identities (paddle does the same for
+        # world_size == 1)
+        return arr
+
+    if not _sharded_over(arr, axis):
+        # replicated operand: every "rank" already holds the same value, so
+        # apply replicated SPMD semantics locally (eager DDP grads land
+        # here — AVG of identical replicas is the identity)
+        n = int(m.shape[axis])
+        op = kw.get("op", ReduceOp.SUM)
+        if fn_name in ("all_reduce", "reduce"):
+            if op == ReduceOp.SUM:
+                return arr * n
+            if op == ReduceOp.PROD:
+                return arr ** n
+            return arr  # AVG/MAX/MIN of identical replicas
+        if fn_name == "broadcast":
+            return arr
+        if fn_name == "all_gather":
+            reps = [n if i == kw.get("gather_axis", 0) else 1
+                    for i in range(arr.ndim)]
+            return jnp.tile(arr, reps)
+        raise ValueError(
+            f"{fn_name}: operand must be sharded over mesh axis {axis!r} "
+            f"(got sharding {getattr(arr, 'sharding', None)}); device_put "
+            f"it with a NamedSharding first")
+
+    def inner(a):
+        return _traced_collective(fn_name, a, axis, **kw)
+
+    in_spec = P(axis, *([None] * (arr.ndim - 1)))
+    if fn_name in ("all_reduce", "reduce"):
+        out_spec = P(*([None] * arr.ndim))
+    elif fn_name == "all_gather":
+        out_spec = P(*([None] * (arr.ndim + 0)))
+    elif fn_name == "reduce_scatter":
+        out_spec = P(axis, *([None] * (arr.ndim - 1)))
+    else:
+        out_spec = in_spec
+    f = jax.shard_map(inner, mesh=m, in_specs=(in_spec,),
+                      out_specs=out_spec, check_vma=False)
+    return jax.jit(f)(arr)
+
+
+def _traced_collective(fn_name, a, axis, **kw):
+    if fn_name == "all_reduce":
+        op = kw.get("op", ReduceOp.SUM)
+        if op == ReduceOp.SUM:
+            return jax.lax.psum(a, axis)
+        if op == ReduceOp.AVG:
+            return jax.lax.pmean(a, axis)
+        if op == ReduceOp.MAX:
+            return jax.lax.pmax(a, axis)
+        if op == ReduceOp.MIN:
+            return jax.lax.pmin(a, axis)
+        if op == ReduceOp.PROD:
+            return jnp.exp(jax.lax.psum(jnp.log(a), axis))
+        raise ValueError(f"unknown reduce op {op}")
+    if fn_name == "all_gather":
+        return jax.lax.all_gather(a, axis, axis=kw.get("gather_axis", 0),
+                                  tiled=kw.get("tiled", True))
+    if fn_name == "reduce_scatter":
+        return jax.lax.psum_scatter(a, axis,
+                                    scatter_dimension=kw.get("scatter_axis", 0),
+                                    tiled=True)
+    if fn_name == "broadcast":
+        src = kw.get("src", 0)
+        idx = jax.lax.axis_index(axis)
+        masked = jnp.where(idx == src, a, jnp.zeros_like(a))
+        return jax.lax.psum(masked, axis)
+    if fn_name == "ppermute":
+        return jax.lax.ppermute(a, axis, kw["perm"])
+    if fn_name == "alltoall":
+        return jax.lax.all_to_all(a, axis,
+                                  split_axis=kw.get("split_axis", 0),
+                                  concat_axis=kw.get("concat_axis", 0),
+                                  tiled=True)
+    raise ValueError(fn_name)
+
+
+def _dispatch(fn_name, tensor, group=None, **kw):
+    axis = _axis_of(group)
+    arr = _unwrap(tensor)
+    if _in_trace(arr):
+        out = _traced_collective(fn_name, arr, axis, **kw)
+    else:
+        out = _eager_collective(fn_name, arr, axis, **kw)
+    return _rewrap(out, tensor)
+
+
+# ---- public API -----------------------------------------------------------
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """SUM/MAX/MIN/PROD allreduce over the group axis
+    (reference collective.py:157; kernel c_allreduce_op.h:109)."""
+    return _dispatch("all_reduce", tensor, group, op=op)
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    """Reduce-to-root == allreduce on TPU (SPMD keeps all replicas; the
+    reference's c_reduce writes only rank dst — XLA has no cheaper form)."""
+    return _dispatch("all_reduce", tensor, group, op=op)
+
+
+def all_gather(tensor_list, tensor=None, group=None, sync_op=True,
+               gather_axis=0):
+    """Gather shards from every rank (reference collective.py:313). Two
+    call shapes: paddle's `all_gather(out_list, t)` eager form, or the
+    functional `out = all_gather(t)` form for traced code."""
+    if tensor is None:
+        return _dispatch("all_gather", tensor_list, group,
+                         gather_axis=gather_axis)
+    out = _dispatch("all_gather", tensor, group, gather_axis=gather_axis)
+    n = get_group(_axis_of(group)).nranks or 1
+    arr = _unwrap(out)
+    for i, piece in enumerate(jnp.split(arr, n, axis=gather_axis)):
+        tensor_list.append(Tensor(piece))
+    return out
+
+
+def reduce_scatter(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
+                   scatter_axis=0):
+    """Sum + scatter shards (ZeRO's grad primitive; reference
+    c_reducescatter op)."""
+    return _dispatch("reduce_scatter", tensor, group,
+                     scatter_axis=scatter_axis)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    """Broadcast rank src's value (reference collective.py:101)."""
+    return _dispatch("broadcast", tensor, group, src=src)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    """Rank src's i-th shard to rank i — on an SPMD mesh this is a dynamic
+    slice by axis index after broadcasting src's data."""
+    axis = _axis_of(group)
+    arr = _unwrap(tensor)
+
+    def traced(a):
+        a = _traced_collective("broadcast", a, axis, src=src)
+        n = jax.lax.psum(jnp.ones((), jnp.int32), axis)
+        idx = jax.lax.axis_index(axis)
+        shard = a.shape[0] // get_group(axis).nranks
+        return jax.lax.dynamic_slice_in_dim(a, idx * shard, shard, 0)
+
+    if _in_trace(arr):
+        return _rewrap(traced(arr), tensor)
+    m = mesh_mod.get_mesh()
+    if m is None or axis not in m.axis_names:
+        return tensor
+        nd = arr.ndim
+    f = jax.shard_map(traced, mesh=m,
+                  in_specs=(P(axis, *([None] * (nd - 1))),),
+                  out_specs=P(axis, *([None] * (nd - 1))))
+    return _rewrap(jax.jit(f)(arr), tensor)
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True,
+             split_axis=0, concat_axis=0):
+    """All-to-all (the Ulysses sequence-parallel primitive; no reference
+    analog — the reference has no SP, SURVEY.md §5)."""
+    return _dispatch("alltoall", in_tensor_list, group,
+                     split_axis=split_axis, concat_axis=concat_axis)
+
+
+def p2p(tensor, src, dst, group=None):
+    """Single-edge transfer src → dst as a ppermute (reference
+    send_v2/recv_v2 over NCCL p2p). SPMD note: every rank executes this;
+    dst receives src's value, all other ranks receive zeros. Pipeline
+    schedules build full shift permutations instead (distributed.pipeline)."""
+    return _dispatch("ppermute", tensor, group, perm=[(src, dst)])
+
+
+def send(tensor, dst=0, group=None, sync_op=True, src=0):
+    """paddle.distributed.send parity. In the reference the *calling rank*
+    is the sender; under single-controller SPMD the sender must be named
+    explicitly (src, default rank 0)."""
+    return p2p(tensor, src, dst, group)
+
+
+def recv(tensor, src=0, group=None, sync_op=True, dst=None):
+    """paddle.distributed.recv parity; dst defaults to (src+1) % nranks."""
+    if dst is None:
+        dst = (src + 1) % max(get_group(_axis_of(group)).nranks, 1)
+    return p2p(tensor, src, dst, group)
+
+
+def barrier(group=None):
+    """Device-level barrier: a tiny psum forces a sync point (the reference
+    uses a barrier table / c_barrier op). In single-controller JAX the host
+    is already in lockstep; this syncs outstanding device work."""
+    m = mesh_mod.get_mesh()
+    axis = _axis_of(group)
+    if m is None or axis not in m.axis_names:
+        return
+    x = jnp.ones((int(m.shape[axis]),), jnp.float32)
+    sharding = NamedSharding(m, P(axis))
+    arr = jax.device_put(x, sharding)
+    _eager_collective("all_reduce", arr, axis, op=ReduceOp.SUM)
+
+
+def split_group_axis(mesh, axis: str, size: int):
+    """Utility: split a mesh axis into two (e.g. 'dp' -> 'dp','sharding')."""
+    import numpy as np
+    devs = mesh.devices
+    names = list(mesh.axis_names)
+    i = names.index(axis)
+    shape = list(devs.shape)
+    outer = shape[i] // size
+    new_shape = shape[:i] + [outer, size] + shape[i + 1:]
+    new_names = names[:i] + [axis, f"{axis}_inner"] + names[i + 1:]
+    return jax.sharding.Mesh(devs.reshape(new_shape), tuple(new_names))

@@ -1,0 +1,134 @@
+"""Fleet facade (reference: fleet/base/fleet_base.py — init,
+distributed_optimizer :598, minimize :1075, worker utilities; role maker
+fleet/base/role_maker.py).
+
+TPU-native: init() wires jax.distributed (the gen_comm_id/gloo-rendezvous
+analog) and builds the hybrid mesh from DistributedStrategy; the
+meta-optimizer stack is replaced by the strategy compiler
+(compiler.compile_train_step)."""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+from .. import env as env_mod
+from .. import mesh as mesh_mod
+from .compiler import CompiledTrainStep, compile_train_step
+from .strategy import DistributedStrategy
+
+__all__ = ["init", "DistributedStrategy", "distributed_optimizer",
+           "distributed_model", "compile_train_step", "CompiledTrainStep",
+           "worker_num", "worker_index", "is_first_worker", "barrier_worker",
+           "get_strategy", "get_mesh", "UserDefinedRoleMaker",
+           "PaddleCloudRoleMaker"]
+
+_state = {"strategy": None, "initialized": False, "role_maker": None}
+
+
+class PaddleCloudRoleMaker:
+    """Reads the PADDLE_* env protocol (reference role_maker.py — the env
+    names are kept so cloud launch scripts port over)."""
+
+    def __init__(self, is_collective=True, **kwargs):
+        self.is_collective = is_collective
+
+    def worker_num(self):
+        return env_mod.get_world_size()
+
+    def worker_index(self):
+        return env_mod.get_rank()
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    def __init__(self, current_id=0, workers_num=1, role=None, **kw):
+        super().__init__(True)
+        self._id = current_id
+        self._n = workers_num
+
+    def worker_num(self):
+        return self._n
+
+    def worker_index(self):
+        return self._id
+
+
+def init(role_maker=None, is_collective=True, strategy=None):
+    """fleet.init parity: bootstrap multi-process jax (DCN), build the
+    hybrid device mesh from the strategy, remember both."""
+    if strategy is None:
+        strategy = DistributedStrategy()
+    env_mod.init_distributed()
+    _state["strategy"] = strategy
+    _state["role_maker"] = role_maker or PaddleCloudRoleMaker(is_collective)
+    try:
+        strategy.build_mesh()
+    except ValueError:
+        # device count does not match hybrid degrees: leave mesh unset,
+        # compile_train_step may be given an explicit mesh later
+        pass
+    _state["initialized"] = True
+    return None
+
+
+def get_strategy() -> Optional[DistributedStrategy]:
+    return _state["strategy"]
+
+
+def get_mesh():
+    return mesh_mod.get_mesh()
+
+
+def worker_num():
+    rm = _state["role_maker"]
+    return rm.worker_num() if rm else env_mod.get_world_size()
+
+
+def worker_index():
+    rm = _state["role_maker"]
+    return rm.worker_index() if rm else env_mod.get_rank()
+
+
+def is_first_worker():
+    return worker_index() == 0
+
+
+def barrier_worker():
+    from .. import collective
+    collective.barrier()
+
+
+class _DistributedOptimizer:
+    """Wrapper marking the optimizer for strategy compilation
+    (fleet_base.py:598). user_defined_strategy rides along; minimize()
+    builds and runs nothing by itself — the compiled step owns the
+    update (there is no per-op program to rewrite)."""
+
+    def __init__(self, optimizer, strategy):
+        self._inner = optimizer
+        self.user_defined_strategy = strategy
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        loss.backward()
+        self._inner.step()
+        return [], []
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    strategy = strategy or _state["strategy"] or DistributedStrategy()
+    _state["strategy"] = strategy
+    return _DistributedOptimizer(optimizer, strategy)
+
+
+def distributed_model(model):
+    """fleet.distributed_model parity: tags the layer with the active
+    strategy; the jitted path (hapi Model / compile_train_step) consumes
+    the tag. Eager forward/backward stays single-replica per process —
+    on TPU data parallelism is sharding, not layer wrapping."""
+    model._fleet_strategy = _state["strategy"]
+    return model

@@ -1,0 +1,222 @@
+"""Strategy compiler: DistributedStrategy + Layer + Optimizer -> one jitted
+SPMD train step.
+
+Reference analog: fleet/base/strategy_compiler.py + the meta-optimizer
+stack (fleet/meta_optimizers/*, SURVEY.md §2 row 37) which rewrite the
+Program op-by-op (insert c_broadcast/c_allreduce, cast ops, recompute
+clones). Here each strategy toggle maps to a functional transform or a
+sharding assignment and XLA emits the collectives:
+
+  amp            -> autocast ctx inside the traced step (+ bf16: no loss
+                    scaling needed on TPU, bf16 exponent == fp32)
+  recompute      -> jax.checkpoint around the forward
+  tensor_parallel-> model-supplied param PartitionSpecs ('tp' axis)
+  sharding (ZeRO)-> optimizer-state/grad/param specs over 'dp'
+  dp             -> batch PartitionSpec over 'dp'
+  gradient_merge -> microbatch lax.scan accumulating grads
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...core import random as random_mod
+from ...framework import MethodAdapter, functional_call, param_arrays, \
+    state_arrays
+from .. import sharding as zero_mod
+from .strategy import DistributedStrategy
+
+
+class CompiledTrainStep:
+    """Holds the jitted step + sharded live arrays; call(step_fn) style:
+        prog = compile_train_step(layer, opt, strategy, loss_method="loss")
+        loss = prog.step(ids, labels)        # updates internal params
+    """
+
+    def __init__(self, step, params, state, opt_state, shardings, mesh,
+                 layer, data_sharding):
+        self._step = step
+        self.params = params
+        self.state = state
+        self.opt_state = opt_state
+        self.shardings = shardings
+        self.mesh = mesh
+        self.layer = layer
+        self.data_sharding = data_sharding
+        self._opt = None
+
+    def step(self, *data, lr=None):
+        data = tuple(jax.device_put(jnp.asarray(d), self.data_sharding)
+                     for d in data)
+        key = random_mod.next_key()
+        lr = jnp.asarray(lr if lr is not None else 0.001, jnp.float32)
+        loss, self.params, self.state, self.opt_state = self._step(
+            self.params, self.state, self.opt_state, key, lr, data)
+        return loss
+
+    def write_back(self):
+        """Copy sharded params back into the Layer tree (host-gathered)."""
+        lookup = dict(self.layer.named_parameters())
+        lookup.update(dict(self.layer.named_buffers()))
+        for k, v in {**self.params, **self.state}.items():
+            if k in lookup:
+                lookup[k]._data = jax.device_get(v)
+
+
+def _tp_specs(layer, params, strategy) -> Dict[str, P]:
+    """Tensor-parallel specs: the model supplies them (GPT ships
+    gpt_param_shardings); fall back to replicated."""
+    fn = getattr(layer, "param_shardings", None)
+    if callable(fn):
+        return fn(params, mesh_axis_tp="tp")
+    try:
+        from ...models.gpt import GPT, gpt_param_shardings
+        if isinstance(layer, GPT):
+            return gpt_param_shardings(params, mesh_axis_tp="tp")
+    except ImportError:
+        pass
+    return {k: P(*([None] * getattr(v, "ndim", 0)))
+            for k, v in params.items()}
+
+
+def _merge_specs(base: Dict[str, P], extra: Dict[str, P]) -> Dict[str, P]:
+    """Combine TP specs with ZeRO specs: ZeRO claims a dimension the TP
+    spec left unsharded; on conflict TP wins (matches Megatron+ZeRO
+    practice: never double-shard one dim)."""
+    out = {}
+    for k, tp in base.items():
+        z = extra.get(k)
+        if z is None:
+            out[k] = tp
+            continue
+        merged = []
+        for i in range(len(tp)):
+            t = tp[i] if i < len(tp) else None
+            s = z[i] if i < len(z) else None
+            merged.append(t if t is not None else s)
+        out[k] = P(*merged)
+    return out
+
+
+def compile_train_step(layer, optimizer, strategy: DistributedStrategy,
+                       loss_method: str = "loss", mesh=None,
+                       lr_default: float = 1e-3) -> CompiledTrainStep:
+    mesh = mesh or strategy.build_mesh()
+    wrapped = MethodAdapter(layer, loss_method) if loss_method else layer
+    params = param_arrays(layer)
+    state = state_arrays(layer)
+    opt_state = optimizer.functional_init(params)
+
+    amp_on = bool(strategy.amp)
+    pure_bf16 = amp_on and strategy.amp_configs.use_pure_bf16
+    recompute = bool(strategy.recompute)
+    n_tp = int(mesh.shape.get("tp", 1))
+    n_dp = int(mesh.shape.get("dp", 1))
+    stage = strategy.sharding_stage()
+    k_merge = (strategy.gradient_merge_configs.k_steps
+               if strategy.gradient_merge else 1)
+
+    # ---- parameter/state shardings ---------------------------------------
+    tp_specs = _tp_specs(layer, params, strategy) if n_tp > 1 else \
+        {k: P(*([None] * getattr(v, "ndim", 0))) for k, v in params.items()}
+    if stage >= 1:
+        zspecs = zero_mod.shard_specs(params, "dp", n_dp)
+        pspecs = _merge_specs(tp_specs, zspecs if stage >= 3 else
+                              {k: P(*([None] * getattr(v, "ndim", 0)))
+                               for k, v in params.items()})
+        state_specs = {
+            name: {slot: (_merge_specs({name: tp_specs[name]},
+                                       {name: zspecs[name]})[name]
+                          if tuple(getattr(v, "shape", ())) ==
+                          tuple(params[name].shape)
+                          else P(*([None] * getattr(v, "ndim", 0))))
+                   for slot, v in st.items()}
+            for name, st in opt_state.items()}
+    else:
+        pspecs = tp_specs
+        state_specs = {
+            name: {slot: (tp_specs[name]
+                          if tuple(getattr(v, "shape", ())) ==
+                          tuple(params[name].shape)
+                          else P(*([None] * getattr(v, "ndim", 0))))
+                   for slot, v in st.items()}
+            for name, st in opt_state.items()}
+
+    p_sh = {k: NamedSharding(mesh, pspecs[k]) for k in params}
+    s_sh = {n: {sl: NamedSharding(mesh, sp) for sl, sp in st.items()}
+            for n, st in state_specs.items()}
+    buf_sh = {k: NamedSharding(mesh, P(*([None] * getattr(v, "ndim", 0))))
+              for k, v in state.items()}
+    data_sh = NamedSharding(mesh, P("dp"))  # leading batch dim over dp
+
+    # ---- the traced step -------------------------------------------------
+    def forward_loss(p, st, key, *data):
+        from ... import amp as amp_mod
+        with random_mod.key_scope(key):
+            with amp_mod.auto_cast(enable=amp_on, level="O2" if pure_bf16
+                                   else "O1", dtype="bfloat16"):
+                out, new_state = functional_call(wrapped, p, st, *data)
+        return out, new_state
+
+    if recompute:
+        # reference RecomputeOptimizer/backward.py:725; on TPU this is
+        # jax.checkpoint — recompute activations in backward instead of
+        # storing them (SURVEY.md §8.4)
+        policy = getattr(jax.checkpoint_policies,
+                         strategy.recompute_configs.policy, None)
+        forward_loss = jax.checkpoint(
+            forward_loss, policy=policy, static_argnums=())
+
+    def train_step(p, st, opt_st, key, lr, data):
+        if k_merge > 1:
+            # gradient merge: split the batch into k microbatches and
+            # accumulate grads in a scan (GradientMergeOptimizer analog)
+            def micro(carry, mb):
+                acc, st_c, i = carry
+                def loss_of(pp):
+                    out, new_st = forward_loss(pp, st_c,
+                                               jax.random.fold_in(key, i),
+                                               *mb)
+                    return out, new_st
+                (loss, new_st), g = jax.value_and_grad(
+                    loss_of, has_aux=True)(p)
+                acc = jax.tree_util.tree_map(jnp.add, acc, g)
+                return (acc, new_st, i + 1), loss
+
+            micro_data = [d.reshape((k_merge, d.shape[0] // k_merge)
+                                    + d.shape[1:]) for d in data]
+            zero = jax.tree_util.tree_map(jnp.zeros_like, p)
+            (grads, new_state, _), losses = jax.lax.scan(
+                micro, (zero, st, 0), tuple(micro_data))
+            if strategy.gradient_merge_configs.avg:
+                grads = jax.tree_util.tree_map(lambda g: g / k_merge, grads)
+            loss = losses.mean()
+        else:
+            def loss_of(pp):
+                out, new_st = forward_loss(pp, st, key, *data)
+                return out, new_st
+            (loss, new_state), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(p)
+        new_p, new_opt = optimizer.functional_update(p, grads, opt_st, lr=lr)
+        return loss, new_p, new_state, new_opt
+
+    jitted = jax.jit(
+        train_step,
+        # data is a tuple pytree; a single sharding broadcasts to all leaves
+        in_shardings=(p_sh, buf_sh, s_sh, None, None, data_sh),
+        out_shardings=(NamedSharding(mesh, P()), p_sh, buf_sh, s_sh),
+        donate_argnums=(0, 2))
+
+    params = jax.device_put(params, p_sh)
+    state = jax.device_put(state, buf_sh)
+    opt_state = {n: {sl: jax.device_put(v, s_sh[n][sl])
+                     for sl, v in st.items()}
+                 for n, st in opt_state.items()}
+
+    return CompiledTrainStep(jitted, params, state, opt_state,
+                             {"params": p_sh, "opt": s_sh}, mesh, layer,
+                             data_sh)

@@ -1,0 +1,111 @@
+"""Process launcher (reference: fleet/launch.py:334 launch(), process
+management launch_utils.py:425 TrainerProc / :435 start_local_trainers /
+:526 watch_local_trainers).
+
+On TPU pods the unit is one process per HOST (all local chips belong to
+it), coordinated by jax.distributed — so the launcher starts one worker
+per host entry and exports the same PADDLE_* env protocol the reference
+uses, plus the jax coordinator address.
+
+Usage: python -m paddle_tpu.distributed.launch --nproc_per_node=1
+           --ips=host1,host2 train.py [args...]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+__all__ = ["launch", "start_local_trainers", "watch_local_trainers", "main"]
+
+
+class TrainerProc:
+    def __init__(self, proc, rank, log_file=None):
+        self.proc = proc
+        self.rank = rank
+        self.log_file = log_file
+
+
+def start_local_trainers(script, script_args, nproc, node_rank, nnodes,
+                         master, log_dir=None):
+    """Spawn nproc workers on this node with the PADDLE_* env protocol
+    (launch_utils.py:435)."""
+    procs = []
+    world = nproc * nnodes
+    endpoints = ",".join(f"{master.split(':')[0]}:{int(master.split(':')[1]) + i}"
+                         for i in range(world))
+    for local_rank in range(nproc):
+        rank = node_rank * nproc + local_rank
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_TRAINER_ENDPOINTS": endpoints,
+            "PADDLE_MASTER_ENDPOINT": master,
+            "PADDLE_LOCAL_RANK": str(local_rank),
+            "FLAGS_selected_tpus": str(local_rank),
+        })
+        log = None
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+            log = open(os.path.join(log_dir, f"workerlog.{rank}"), "w")
+        p = subprocess.Popen([sys.executable, script] + list(script_args),
+                             env=env, stdout=log or None, stderr=log or None)
+        procs.append(TrainerProc(p, rank, log))
+    return procs
+
+
+def watch_local_trainers(procs, poll_s=1.0):
+    """Abort all if any worker dies (launch_utils.py:526)."""
+    try:
+        while True:
+            alive = False
+            for tp in procs:
+                ret = tp.proc.poll()
+                if ret is None:
+                    alive = True
+                elif ret != 0:
+                    for other in procs:
+                        if other.proc.poll() is None:
+                            other.proc.send_signal(signal.SIGTERM)
+                    raise RuntimeError(
+                        f"worker rank {tp.rank} exited with code {ret}")
+            if not alive:
+                return 0
+            time.sleep(poll_s)
+    finally:
+        for tp in procs:
+            if tp.log_file:
+                tp.log_file.close()
+
+
+def launch(args=None):
+    parser = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    parser.add_argument("--nproc_per_node", type=int, default=1)
+    parser.add_argument("--ips", type=str, default="127.0.0.1",
+                        help="comma-separated host list")
+    parser.add_argument("--node_rank", type=int,
+                        default=int(os.environ.get("PADDLE_NODE_RANK", 0)))
+    parser.add_argument("--master_port", type=int, default=6170)
+    parser.add_argument("--log_dir", type=str, default=None)
+    parser.add_argument("script")
+    parser.add_argument("script_args", nargs=argparse.REMAINDER)
+    ns = parser.parse_args(args)
+
+    hosts = ns.ips.split(",")
+    master = f"{hosts[0]}:{ns.master_port}"
+    procs = start_local_trainers(ns.script, ns.script_args,
+                                 ns.nproc_per_node, ns.node_rank,
+                                 len(hosts), master, ns.log_dir)
+    return watch_local_trainers(procs)
+
+
+def main():
+    sys.exit(launch())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,89 @@
+"""Dygraph data-parallel surface: init_parallel_env + DataParallel
+(reference: python/paddle/distributed/parallel.py:57, DataParallel
+fluid/dygraph/parallel.py:322 with the C++ bucketing Reducer
+imperative/reducer.cc:376-748).
+
+TPU-native: there is no reducer. In the jitted path DP is a batch
+sharding and XLA fuses/schedules the grad all-reduces (what the
+reference's bucket fusion + comm/compute overlap does by hand,
+reducer.cc:685 FusedAllReduceSchedule). The eager path averages grads
+across the 'dp' mesh axis after backward — correctness parity for
+dygraph-style loops."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from . import collective, env as env_mod, mesh as mesh_mod
+
+__all__ = ["init_parallel_env", "ParallelEnv", "DataParallel"]
+
+
+class ParallelEnv:
+    """Env-derived rank info (reference ParallelEnv dygraph/parallel.py)."""
+
+    @property
+    def rank(self):
+        return env_mod.get_rank()
+
+    @property
+    def world_size(self):
+        return env_mod.get_world_size()
+
+    @property
+    def device_id(self):
+        return 0
+
+    local_rank = rank
+    nranks = world_size
+
+
+def init_parallel_env():
+    """Bootstrap multi-process jax + a default all-device 'dp' mesh."""
+    env_mod.init_distributed()
+    if mesh_mod.get_mesh() is None:
+        mesh_mod.set_mesh(mesh_mod.build_mesh())
+    return ParallelEnv()
+
+
+class DataParallel:
+    """Layer wrapper with DDP's API (forward passthrough, grad averaging).
+
+    After `loss.backward()`, call `apply_collective_grads()` (the reference
+    does this implicitly from C++ hooks; an explicit call keeps the eager
+    tape simple) — it all-reduce-averages every trainable grad over 'dp'.
+    Under jit (hapi / fleet compiled steps) this wrapper is transparent:
+    sharded data already implies the reduction."""
+
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        self._layers = layers
+        self._group = group
+        self.find_unused_parameters = find_unused_parameters
+
+    def __call__(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_layers"], name)
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def apply_collective_grads(self):
+        n = collective.get_group(
+            self._group.axis if self._group else "dp").nranks
+        if n <= 1:
+            return
+        for p in self._layers.parameters():
+            if p.grad is not None:
+                collective.all_reduce(p.grad, op=collective.ReduceOp.AVG,
+                                      group=self._group)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)

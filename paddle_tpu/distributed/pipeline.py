@@ -1,0 +1,140 @@
+"""Pipeline parallelism — 1F1B/GPipe over a 'pp' mesh axis.
+
+Reference: PipelineTrainer/SectionWorker (pipeline_trainer.cc:27,
+section_worker.cc:98-165 — F-then-B and 1F1B loops over microbatch scopes,
+cross-stage send_v2/recv_v2 over NCCL p2p; program split
+optimizer.py:3718, SURVEY.md §8.2).
+
+TPU-native redesign: the reference runs a *host thread per stage* issuing
+ops; on TPU the whole pipeline is ONE jitted SPMD program over the 'pp'
+axis. Stage-local layer stacks are a leading-axis-stacked pytree sharded
+over 'pp'; activations move between neighbour stages with
+lax.ppermute (ICI neighbour hops); the microbatch loop is a lax.scan with
+a circular buffer, which XLA overlaps with compute (the 1F1B memory
+profile falls out of steady-state: each stage holds at most
+n_stages in-flight microbatch activations).
+
+Design restriction (same as every SPMD pipeline): the pipelined body must
+be homogeneous — L identical blocks split as L/pp per stage. Embedding and
+head run replicated outside the pipelined region (negligible FLOPs vs the
+block stack; params shared across ranks)."""
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+__all__ = ["pipeline_spmd", "stack_stage_params", "PipelineLayer"]
+
+
+def stack_stage_params(block_params_list):
+    """[{name: arr} per layer] -> {name: arr[L, ...]} stacked pytree.
+    Shard the leading dim over 'pp' to place L/pp layers per stage."""
+    out = {}
+    for name in block_params_list[0]:
+        out[name] = jnp.stack([bp[name] for bp in block_params_list])
+    return out
+
+
+def pipeline_spmd(block_fn: Callable, n_stages: int, n_micro: int,
+                  mesh, axis: str = "pp"):
+    """Build pipelined_fn(stacked_params, x_micro) -> y_micro.
+
+    block_fn(params_one_layer, x) -> x          (one transformer block)
+    stacked_params: {name: [L, ...]} sharded P(axis) on dim 0 — each stage
+      holds its local [L/pp, ...] slab.
+    x_micro: [n_micro, micro_batch, ...] activations, replicated input;
+      output is the fully-processed microbatch stack (valid on last stage,
+      broadcast to all).
+
+    Schedule: circular-shift loop of n_micro + n_stages - 1 ticks
+    (fill + steady state + drain). Each tick: run local stage stack on the
+    held activation, ppermute result to the next stage. This is the
+    F-then-B schedule for the forward; because the whole loop lives inside
+    one jit, jax.grad over it yields the reversed (B) schedule
+    automatically — no hand-written 1F1B interleave is needed for
+    correctness, and XLA's scheduler overlaps the ppermute with block
+    compute (the throughput property 1F1B exists for)."""
+
+    def run_local_stack(local_params, x):
+        # scan over this stage's L/pp layers
+        def body(h, layer_params):
+            return block_fn(layer_params, h), None
+        h, _ = jax.lax.scan(body, x, local_params)
+        return h
+
+    def staged(local_params, x_micro):
+        stage = jax.lax.axis_index(axis)
+        n_ticks = n_micro + n_stages - 1
+        micro_shape = x_micro.shape[1:]
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        def tick(carry, t):
+            held, outputs = carry
+            # stage 0 injects microbatch t (if any left); others use held
+            inject = jnp.where(t < n_micro, t, n_micro - 1)
+            x_in = jnp.where(stage == 0, x_micro[inject], held)
+            y = run_local_stack(local_params, x_in)
+            # pass to next stage; last stage's output is recorded
+            out_idx = t - (n_stages - 1)
+            rec = jnp.logical_and(stage == n_stages - 1, out_idx >= 0)
+            outputs = jax.lax.cond(
+                rec,
+                lambda o: jax.lax.dynamic_update_index_in_dim(
+                    o, y, jnp.maximum(out_idx, 0), 0),
+                lambda o: o, outputs)
+            held_next = jax.lax.ppermute(y, axis, perm)
+            return (held_next, outputs), None
+
+        outputs0 = jnp.zeros((n_micro,) + micro_shape, x_micro.dtype)
+        held0 = jnp.zeros(micro_shape, x_micro.dtype)
+        (_, outputs), _ = jax.lax.scan(
+            tick, (held0, outputs0), jnp.arange(n_ticks))
+        # broadcast last stage's outputs to every stage (psum of masked)
+        mask = (stage == n_stages - 1).astype(x_micro.dtype)
+        outputs = jax.lax.psum(outputs * mask, axis)
+        return outputs
+
+    def pipelined(stacked_params, x_micro, in_mesh=mesh):
+        nd_x = x_micro.ndim
+        param_specs = jax.tree_util.tree_map(
+            lambda v: P(axis, *([None] * (v.ndim - 1))), stacked_params)
+        f = jax.shard_map(
+            staged, mesh=in_mesh,
+            in_specs=(param_specs, P(*([None] * nd_x))),
+            out_specs=P(*([None] * nd_x)),
+            check_vma=False)
+        return f(stacked_params, x_micro)
+
+    return pipelined
+
+
+class PipelineLayer:
+    """User-facing wrapper (reference PipelineOptimizer surface): holds a
+    GPT-like model whose homogeneous blocks get pipelined.
+
+    pipeline_forward(params, ids) computes embed (replicated) -> pipelined
+    blocks -> head, with microbatching over dim 0."""
+
+    def __init__(self, embed_fn, block_fn, head_fn, n_stages, n_micro,
+                 mesh, axis="pp"):
+        self.embed_fn = embed_fn
+        self.block_fn = block_fn
+        self.head_fn = head_fn
+        self.n_stages = n_stages
+        self.n_micro = n_micro
+        self.mesh = mesh
+        self.axis = axis
+        self._pipe = pipeline_spmd(block_fn, n_stages, n_micro, mesh, axis)
+
+    def __call__(self, embed_params, stacked_block_params, head_params, ids):
+        n_micro = self.n_micro
+        B = ids.shape[0]
+        micro = ids.reshape((n_micro, B // n_micro) + ids.shape[1:])
+        h = jax.vmap(lambda m: self.embed_fn(embed_params, m))(micro)
+        h = self._pipe(stacked_block_params, h)
+        out = jax.vmap(lambda m: self.head_fn(head_params, m))(h)
+        return out.reshape((B,) + out.shape[2:])

@@ -119,6 +119,7 @@ class ProgBarLogger(Callback):
     def on_epoch_begin(self, epoch, logs=None):
         self._epoch = epoch
         self._step = 0
+        self._epoch_t0 = time.time()
         if self.verbose and self.epochs:
             print(f"Epoch {epoch + 1}/{self.epochs}")
 
@@ -139,7 +140,7 @@ class ProgBarLogger(Callback):
 
     def on_epoch_end(self, epoch, logs=None):
         if self.verbose:
-            dt = time.time() - self._t0
+            dt = time.time() - getattr(self, "_epoch_t0", self._t0)
             print(f"epoch {epoch + 1} done ({dt:.1f}s) - {self._fmt(logs)}")
 
     def on_eval_end(self, logs=None):
@@ -214,7 +215,10 @@ class EarlyStopping(Callback):
 
     def on_epoch_end(self, epoch, logs=None):
         logs = logs or {}
-        cur = logs.get(self.monitor, logs.get("eval_" + self.monitor))
+        # prefer the eval metric: stopping on the last train-batch loss
+        # would track noise and never catch overfitting (the reference
+        # monitors eval results)
+        cur = logs.get("eval_" + self.monitor, logs.get(self.monitor))
         if cur is None:
             return
         if self.better(cur, self.best):
@@ -246,12 +250,14 @@ class VisualDL(Callback):
     def on_train_begin(self, logs=None):
         os.makedirs(self.log_dir, exist_ok=True)
         self._f = open(os.path.join(self.log_dir, "scalars.tsv"), "a")
+        self._f.write(f"# run {time.strftime('%Y-%m-%dT%H:%M:%S')}\n")
 
     def on_train_batch_end(self, step, logs=None):
         self._step += 1
         for k, v in (logs or {}).items():
             if isinstance(v, numbers.Number):
                 self._f.write(f"{self._step}\t{k}\t{v}\n")
+        self._f.flush()  # survive crashes mid-training
 
     def on_train_end(self, logs=None):
         if self._f:

@@ -221,9 +221,8 @@ class Model:
             params, state = self._params, self._state
         else:
             params, state = self._split_tree()
-            params = {**params}
         inputs, labels = _to_jax(inputs), _to_jax(labels)
-        loss, outs = self._jit_eval({**params}, state, inputs, labels)
+        loss, outs = self._jit_eval(params, state, inputs, labels)
         self._update_metrics(outs, labels)
         return [float(jax.device_get(loss))] if loss is not None else []
 
@@ -312,10 +311,15 @@ class Model:
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None, _inside_fit=None):
-        loader = self._make_loader(eval_data, batch_size, False)
+        loader = self._make_loader(eval_data, batch_size, False,
+                                   num_workers=num_workers)
         self._reset_metrics()
         losses_sum, n = 0.0, 0
         cbks = _inside_fit
+        if cbks is None and (callbacks or verbose):
+            cbks = cbks_mod.config_callbacks(
+                callbacks, model=self, verbose=verbose, log_freq=log_freq,
+                steps=self._len_or_none(loader), mode="eval")
         if cbks:
             cbks.on_begin("eval")
         for step, batch in enumerate(loader):
@@ -335,7 +339,8 @@ class Model:
 
     def predict(self, test_data, batch_size=1, num_workers=0,
                 stack_outputs=False, callbacks=None, verbose=1):
-        loader = self._make_loader(test_data, batch_size, False)
+        loader = self._make_loader(test_data, batch_size, False,
+                                   num_workers=num_workers)
         outputs = []
         for batch in loader:
             ins, _ = self._split_batch(batch, has_labels=False)

@@ -76,9 +76,10 @@ class MNIST(Dataset):
             self.labels = np.frombuffer(f.read(), np.uint8).astype(np.int64)
 
     def __getitem__(self, idx):
-        img = self.images[idx].astype(np.float32)[None]  # CHW
         if self.transform is not None:
             img = self.transform(self.images[idx])
+        else:
+            img = self.images[idx].astype(np.float32)[None]  # CHW
         return img, self.labels[idx]
 
     def __len__(self):
@@ -114,9 +115,10 @@ class Cifar10(Dataset):
         return ("data_batch",) if mode == "train" else ("test_batch",)
 
     def __getitem__(self, idx):
-        img = self.data[idx].astype(np.float32)
         if self.transform is not None:
             img = self.transform(self.data[idx].transpose(1, 2, 0))
+        else:
+            img = self.data[idx].astype(np.float32)
         return img, self.labels[idx]
 
     def __len__(self):

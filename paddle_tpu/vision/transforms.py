@@ -186,8 +186,12 @@ class RandomCrop(BaseTransform):
             img = pad(img, (0, 0, max(0, tw - w), max(0, th - h)), self.fill,
                       self.padding_mode)
             h, w = img.shape[:2]
-        top = pyrandom.randint(0, max(0, h - th))
-        left = pyrandom.randint(0, max(0, w - tw))
+        if h < th or w < tw:
+            raise ValueError(
+                f"RandomCrop: image ({h}x{w}) smaller than crop size "
+                f"({th}x{tw}); pass pad_if_needed=True")
+        top = pyrandom.randint(0, h - th)
+        left = pyrandom.randint(0, w - tw)
         return crop(img, top, left, th, tw)
 
 
