@@ -80,15 +80,20 @@ def _in_trace(x) -> bool:
     return isinstance(x, jax.core.Tracer)
 
 
-def _sharded_over(arr, axis) -> bool:
+def _sharded_dim(arr, axis):
+    """Index of the array dimension sharded over `axis`, or None."""
     sh = getattr(arr, "sharding", None)
     spec = getattr(sh, "spec", None)
     if spec is None:
-        return False
-    for entry in spec:
+        return None
+    for d, entry in enumerate(spec):
         if entry == axis or (isinstance(entry, tuple) and axis in entry):
-            return True
-    return False
+            return d
+    return None
+
+
+def _sharded_over(arr, axis) -> bool:
+    return _sharded_dim(arr, axis) is not None
 
 
 def _unwrap(t):
@@ -137,15 +142,22 @@ def _eager_collective(fn_name, arr, axis, **kw):
     def inner(a):
         return _traced_collective(fn_name, a, axis, **kw)
 
-    in_spec = P(axis, *([None] * (arr.ndim - 1)))
-    if fn_name in ("all_reduce", "reduce"):
+    # split along the dimension the array is actually sharded on (paddle
+    # semantics: each rank's local shard is "its" tensor)
+    d = _sharded_dim(arr, axis)
+    spec = [None] * arr.ndim
+    spec[d] = axis
+    in_spec = P(*spec)
+    if fn_name in ("all_reduce", "reduce", "all_gather"):
         out_spec = P(*([None] * arr.ndim))
-    elif fn_name == "all_gather":
-        out_spec = P(*([None] * (arr.ndim + 0)))
     elif fn_name == "reduce_scatter":
-        out_spec = P(axis, *([None] * (arr.ndim - 1)))
+        out_spec = in_spec
     else:
         out_spec = in_spec
+    if fn_name == "all_gather":
+        kw = {**kw, "gather_axis": kw.get("gather_axis", d)}
+    if fn_name == "reduce_scatter":
+        kw = {**kw, "scatter_axis": kw.get("scatter_axis", d)}
     f = jax.shard_map(inner, mesh=m, in_specs=(in_spec,),
                       out_specs=out_spec, check_vma=False)
     return jax.jit(f)(arr)
@@ -258,7 +270,7 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     m = mesh_mod.get_mesh()
     if m is None or axis not in m.axis_names:
         return tensor
-        nd = arr.ndim
+    nd = arr.ndim
     f = jax.shard_map(traced, mesh=m,
                   in_specs=(P(axis, *([None] * (nd - 1))),),
                   out_specs=P(axis, *([None] * (nd - 1))))
@@ -268,7 +280,19 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
 def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True,
              split_axis=0, concat_axis=0):
     """All-to-all (the Ulysses sequence-parallel primitive; no reference
-    analog — the reference has no SP, SURVEY.md §5)."""
+    analog — the reference has no SP, SURVEY.md §5). Two call shapes:
+    paddle's eager `alltoall([t0..tn], out_list)` list form, or the
+    functional single-array form for traced code."""
+    if isinstance(in_tensor_list, (list, tuple)):
+        stacked = jnp.concatenate([_unwrap(t) for t in in_tensor_list],
+                                  axis=0)
+        out = _dispatch("alltoall", stacked, group,
+                        split_axis=split_axis, concat_axis=concat_axis)
+        arr = _unwrap(out)
+        pieces = jnp.split(arr, len(in_tensor_list), axis=0)
+        if out_tensor_list is not None:
+            out_tensor_list.extend(Tensor(piece) for piece in pieces)
+        return [Tensor(piece) for piece in pieces]
     return _dispatch("alltoall", in_tensor_list, group,
                      split_axis=split_axis, concat_axis=concat_axis)
 

@@ -53,7 +53,10 @@ class CompiledTrainStep:
         data = tuple(jax.device_put(jnp.asarray(d), self.data_sharding)
                      for d in data)
         key = random_mod.next_key()
-        lr = jnp.asarray(lr if lr is not None else 0.001, jnp.float32)
+        if lr is None:
+            # follow the optimizer's configured lr / scheduler
+            lr = self._opt.get_lr() if self._opt is not None else 1e-3
+        lr = jnp.asarray(lr, jnp.float32)
         loss, self.params, self.state, self.opt_state = self._step(
             self.params, self.state, self.opt_state, key, lr, data)
         return loss
@@ -68,17 +71,11 @@ class CompiledTrainStep:
 
 
 def _tp_specs(layer, params, strategy) -> Dict[str, P]:
-    """Tensor-parallel specs: the model supplies them (GPT ships
-    gpt_param_shardings); fall back to replicated."""
+    """Tensor-parallel specs via the model's `param_shardings` protocol
+    (GPT implements it with its Megatron rules); replicated otherwise."""
     fn = getattr(layer, "param_shardings", None)
     if callable(fn):
         return fn(params, mesh_axis_tp="tp")
-    try:
-        from ...models.gpt import GPT, gpt_param_shardings
-        if isinstance(layer, GPT):
-            return gpt_param_shardings(params, mesh_axis_tp="tp")
-    except ImportError:
-        pass
     return {k: P(*([None] * getattr(v, "ndim", 0)))
             for k, v in params.items()}
 
@@ -217,6 +214,8 @@ def compile_train_step(layer, optimizer, strategy: DistributedStrategy,
                      for sl, v in st.items()}
                  for n, st in opt_state.items()}
 
-    return CompiledTrainStep(jitted, params, state, opt_state,
+    prog = CompiledTrainStep(jitted, params, state, opt_state,
                              {"params": p_sh, "opt": s_sh}, mesh, layer,
                              data_sh)
+    prog._opt = optimizer
+    return prog

@@ -30,13 +30,17 @@ class TrainerProc:
 
 
 def start_local_trainers(script, script_args, nproc, node_rank, nnodes,
-                         master, log_dir=None):
+                         master, log_dir=None, hosts=None):
     """Spawn nproc workers on this node with the PADDLE_* env protocol
-    (launch_utils.py:435)."""
+    (launch_utils.py:435). Endpoints pair each host with its local ranks'
+    ports (rank r lives on hosts[r // nproc])."""
     procs = []
     world = nproc * nnodes
-    endpoints = ",".join(f"{master.split(':')[0]}:{int(master.split(':')[1]) + i}"
-                         for i in range(world))
+    base_port = int(master.split(":")[1])
+    hosts = hosts or [master.split(":")[0]] * nnodes
+    endpoints = ",".join(
+        f"{hosts[r // nproc]}:{base_port + (r % nproc)}"
+        for r in range(world))
     for local_rank in range(nproc):
         rank = node_rank * nproc + local_rank
         env = dict(os.environ)
@@ -99,7 +103,7 @@ def launch(args=None):
     master = f"{hosts[0]}:{ns.master_port}"
     procs = start_local_trainers(ns.script, ns.script_args,
                                  ns.nproc_per_node, ns.node_rank,
-                                 len(hosts), master, ns.log_dir)
+                                 len(hosts), master, ns.log_dir, hosts=hosts)
     return watch_local_trainers(procs)
 
 

@@ -73,6 +73,16 @@ class DataParallel:
         return self._layers(*args, **kwargs)
 
     def apply_collective_grads(self):
+        if jax.process_count() > 1:
+            # multi-process eager DDP: per-process grads differ (different
+            # data), so average across processes explicitly — the mesh-based
+            # eager path would see replicated arrays and no-op
+            from jax.experimental import multihost_utils
+            for p in self._layers.parameters():
+                if p.grad is not None:
+                    stacked = multihost_utils.process_allgather(p.grad._data)
+                    p.grad.set_value(stacked.mean(axis=0))
+            return
         n = collective.get_group(
             self._group.axis if self._group else "dp").nranks
         if n <= 1:

@@ -54,8 +54,10 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         from ...core import random as random_mod
         rng_key = random_mod.next_key()
 
+    seq_len = query.shape[1]
     use_pallas = (get_flags("use_pallas_attention") and attn_mask is None
-                  and dropout_p == 0.0)
+                  and dropout_p == 0.0
+                  and seq_len >= get_flags("pallas_attention_min_seq"))
     if use_pallas:
         try:
             from ...ops.pallas.flash_attention import flash_attention

@@ -1,0 +1,5 @@
+"""Pallas TPU kernels — the hot-op set the reference implements as
+hand-written CUDA (operators/fused/ multihead_matmul, fused attention;
+operators/optimizers/adam_op.cu; math/softmax.cu): here re-designed as
+TPU Pallas kernels with jnp fallbacks off-TPU."""
+from . import flash_attention  # noqa: F401
