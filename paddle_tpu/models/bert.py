@@ -75,3 +75,33 @@ class Bert(nn.Layer):
         V = logits.shape[-1]
         return F.cross_entropy(logits.reshape([-1, V]), labels.reshape([-1]),
                                ignore_index=ignore_index)
+
+    def param_shardings(self, params, mesh_axis_tp="tp"):
+        """Strategy-compiler protocol: Megatron TP PartitionSpecs.
+        Column-parallel q/k/v + ffn-in, row-parallel out_proj + ffn-out,
+        vocab-parallel token embedding; everything else replicated."""
+        return bert_param_shardings(params, mesh_axis_tp=mesh_axis_tp)
+
+
+def bert_param_shardings(params, mesh_axis_tp="tp"):
+    from jax.sharding import PartitionSpec as P
+    col_w = ("q_proj.weight", "k_proj.weight", "v_proj.weight",
+             "linear1.weight")
+    col_b = ("q_proj.bias", "k_proj.bias", "v_proj.bias", "linear1.bias")
+    row_w = ("out_proj.weight", "linear2.weight")
+    specs = {}
+    for name, v in params.items():
+        ndim = len(v.shape)
+        if any(name.endswith(s) for s in col_w):
+            specs[name] = P(None, mesh_axis_tp)
+        elif any(name.endswith(s) for s in col_b):
+            specs[name] = P(mesh_axis_tp)
+        elif any(name.endswith(s) for s in row_w):
+            specs[name] = P(mesh_axis_tp, None)
+        elif name.endswith("tok.weight"):
+            specs[name] = P(mesh_axis_tp, None)
+        elif ndim >= 2:
+            specs[name] = P(*([None] * ndim))
+        else:
+            specs[name] = P()
+    return specs

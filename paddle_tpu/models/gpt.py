@@ -138,11 +138,19 @@ class GPT(nn.Layer):
         return sum(int(math.prod(p.shape)) for p in self.parameters())
 
     def flops_per_token(self, seq_len=None) -> int:
-        """~6N + attention term for a train step (fwd+bwd); MFU reporter."""
+        """Train-step (fwd+bwd) FLOPs per token: 6N for the parameter
+        matmuls plus the attention score/value matmuls, which contribute
+        12 * layers * hidden * seq per token (fwd QK^T and AV are each
+        2*T*hidden per token per layer; x3 for fwd+bwd)."""
         n = self.num_params()
         c = self.cfg
         attn = 12 * c.layers * c.hidden * (seq_len or c.max_seq_len)
         return 6 * n + attn
+
+    def param_shardings(self, params, mesh_axis_tp="tp"):
+        """Strategy-compiler protocol (fleet/compiler.py `_tp_specs`):
+        Megatron tensor-parallel PartitionSpecs for every parameter."""
+        return gpt_param_shardings(params, mesh_axis_tp=mesh_axis_tp)
 
 
 def gpt_param_shardings(params, mesh_axis_tp="tp"):
