@@ -63,7 +63,10 @@ def main():
         labels = jnp.concatenate([ids[:, 1:], ids[:, :1]], axis=1)
 
         def loss_of(pp):
-            out, _ = functional_call(wrapped, pp, {}, ids, labels)
+            # AMP O2: matmul-class ops run bf16 on the MXU (full rate),
+            # softmax/LN/CE stay f32; master params and Adam state are f32.
+            with paddle.amp.auto_cast(level="O2", dtype="bfloat16"):
+                out, _ = functional_call(wrapped, pp, {}, ids, labels)
             return out
 
         loss, grads = jax.value_and_grad(loss_of)(p)
@@ -91,11 +94,18 @@ def main():
         return time.perf_counter() - t0, p, s
 
     # marginal step time: (t_long - t_short) / (n_long - n_short) cancels
-    # the constant tunnel fetch latency
+    # the constant tunnel fetch latency; best-of-2 damps RTT jitter, and a
+    # round where jitter makes the delta non-positive is discarded
     n_short, n_long = max(iters // 4, 1), iters
-    dt_short, params, opt_state = run(n_short, params, opt_state)
-    dt_long, params, opt_state = run(n_long, params, opt_state)
-    step_time = max((dt_long - dt_short) / (n_long - n_short), 1e-9)
+    estimates = []
+    for _ in range(2):
+        dt_short, params, opt_state = run(n_short, params, opt_state)
+        dt_long, params, opt_state = run(n_long, params, opt_state)
+        delta = (dt_long - dt_short) / (n_long - n_short)
+        if delta > 0:
+            estimates.append(delta)
+    # all-jitter fallback: amortised long-run time bounds the step above
+    step_time = min(estimates) if estimates else dt_long / n_long
 
     tokens_per_sec = B * T / step_time
     mfu = tokens_per_sec * model.flops_per_token(T) / peak_flops()

@@ -25,4 +25,12 @@ from .ops import einsum  # noqa: F401
 from .framework import Parameter, ParamAttr, save, load  # noqa: F401
 from .hapi import Model, summary, flops  # noqa: F401
 
+# submodules reachable as attributes (paddle.nn.Linear, paddle.amp.auto_cast
+# ... — matches the reference package layout python/paddle/__init__.py)
+from . import amp  # noqa: F401
+from . import metric  # noqa: F401
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import io  # noqa: F401
+
 __version__ = "0.1.0"

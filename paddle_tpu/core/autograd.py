@@ -82,6 +82,11 @@ def _sweep(roots, root_grads, retain_graph, wanted=None, accumulate_leaf=True):
                     c = _run_hooks(t, c)
                     if t._retain_grads:
                         _leaf_accum(t, c)
+                if c.dtype != dt:
+                    # mixed-precision graphs (AMP) hand back cotangents in
+                    # the downstream op's compute dtype; jax.vjp requires
+                    # the exact output aval
+                    c = c.astype(dt)
             cots.append(c)
         if not has_any:
             continue

@@ -66,8 +66,13 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                 lambda q, k, v: flash_attention(q, k, v, causal=is_causal,
                                                 scale=scale),
                 *args, op_name="flash_attention")
-        except Exception:
-            pass  # fall back to XLA composition
+        except (ValueError, ImportError) as e:
+            # expected fallbacks: seq len not divisible by the block size,
+            # or pallas unavailable in this build — surface the reason once
+            # so env-var block tuning mistakes don't silently benchmark XLA
+            import warnings
+            warnings.warn(f"flash_attention unavailable ({e}); falling back "
+                          f"to the XLA attention composition")
 
     args = [query, key, value]
     if attn_mask is not None:

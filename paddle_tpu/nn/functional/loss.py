@@ -13,6 +13,7 @@ __all__ = [
     "kl_div", "smooth_l1_loss", "margin_ranking_loss", "square_error_cost",
     "log_loss", "hinge_embedding_loss", "cosine_embedding_loss", "ctc_loss",
     "sigmoid_focal_loss", "triplet_margin_loss", "soft_margin_loss",
+    "linear_cross_entropy",
 ]
 
 
@@ -56,6 +57,20 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
 
     args = [input, label] + ([weight] if weight is not None else [])
     return apply(f, *args, op_name="cross_entropy")
+
+
+def linear_cross_entropy(input, weight, label, fused=None, reduction="mean",
+                         name=None):
+    """Fused LM-head loss: -log softmax(input @ weight.T)[label] without
+    materialising the [tokens, vocab] logits (ops/pallas/fused_ce.py).
+    input [N, H], weight [V, H] (e.g. a tied embedding table), label [N].
+    """
+    from ...ops.pallas.fused_ce import linear_cross_entropy as _lce
+
+    def f(x, w, lbl):
+        return _reduce(_lce(x, w, lbl, fused=fused), reduction)
+
+    return apply(f, input, weight, label, op_name="linear_cross_entropy")
 
 
 def softmax_with_cross_entropy(logits, label, soft_label=False,

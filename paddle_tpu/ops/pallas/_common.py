@@ -1,0 +1,35 @@
+"""Shared helpers for the Pallas TPU kernels (flash_attention, fused_ce)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+try:  # pltpu only resolves on TPU builds; interpret mode covers CPU tests
+    from jax.experimental.pallas import tpu as pltpu
+    VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    VMEM = None
+
+NEG_INF = np.float32(-1e30)
+LANE = 128      # TPU lane width: per-row scalars ride a broadcast lane dim
+I0 = np.int32(0)  # index-map zero pinned to i32 (x64 would make it i64)
+
+
+def on_tpu() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def interpret() -> bool:
+    return not on_tpu()
+
+
+def mxu_dtype():
+    """MXU operand dtype follows jax_default_matmul_precision: 'highest'
+    keeps f32 operands (tests, debugging); the TPU default streams bf16
+    through the MXU at full rate (accumulation is always f32)."""
+    prec = jax.config.jax_default_matmul_precision
+    if prec in ("highest", "float32"):
+        return jnp.float32
+    return jnp.bfloat16

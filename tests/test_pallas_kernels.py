@@ -78,6 +78,7 @@ def test_sdpa_routes_to_flash():
     long enough (below the threshold XLA's composition is faster)."""
     import paddle_tpu as paddle
     import paddle_tpu.nn.functional as F
+    old = paddle.get_flags("pallas_attention_min_seq")
     paddle.set_flags({"pallas_attention_min_seq": 128})
     try:
         rng = np.random.default_rng(3)
@@ -88,4 +89,141 @@ def test_sdpa_routes_to_flash():
         np.testing.assert_allclose(np.asarray(out._data), np.asarray(ref),
                                    atol=2e-5, rtol=2e-5)
     finally:
-        paddle.set_flags({"pallas_attention_min_seq": 2048})
+        paddle.set_flags({"pallas_attention_min_seq": old})
+
+
+# ---------------------------------------------------------------------------
+# fused linear + cross-entropy (ops/pallas/fused_ce.py)
+# ---------------------------------------------------------------------------
+
+from paddle_tpu.ops.pallas.fused_ce import linear_cross_entropy
+
+
+def _ref_lce(x, w, labels):
+    lg = (x.astype(jnp.float32) @ w.astype(jnp.float32).T)
+    logp = jax.nn.log_softmax(lg, axis=-1)
+    return -jnp.take_along_axis(logp, labels[:, None], axis=1)[:, 0]
+
+
+@pytest.mark.parametrize("N,H,V", [(128, 128, 384), (256, 256, 1000)])
+def test_linear_cross_entropy_forward(N, H, V):
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(N, H)) * 0.1, jnp.float32)
+    w = jnp.asarray(rng.normal(size=(V, H)) * 0.1, jnp.float32)
+    labels = jnp.asarray(rng.integers(0, V, (N,)), jnp.int32)
+    out = linear_cross_entropy(x, w, labels)
+    ref = _ref_lce(x, w, labels)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_linear_cross_entropy_grads():
+    rng = np.random.default_rng(1)
+    N, H, V = 128, 128, 500
+    x = jnp.asarray(rng.normal(size=(N, H)) * 0.1, jnp.float32)
+    w = jnp.asarray(rng.normal(size=(V, H)) * 0.1, jnp.float32)
+    labels = jnp.asarray(rng.integers(0, V, (N,)), jnp.int32)
+
+    gx, gw = jax.grad(lambda x, w: linear_cross_entropy(x, w, labels).mean(),
+                      argnums=(0, 1))(x, w)
+    rx, rw = jax.grad(lambda x, w: _ref_lce(x, w, labels).mean(),
+                      argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(rx),
+                               rtol=2e-3, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(rw),
+                               rtol=2e-3, atol=1e-5)
+
+
+def test_linear_cross_entropy_under_jit():
+    rng = np.random.default_rng(2)
+    N, H, V = 128, 128, 384
+    x = jnp.asarray(rng.normal(size=(N, H)) * 0.1, jnp.float32)
+    w = jnp.asarray(rng.normal(size=(V, H)) * 0.1, jnp.float32)
+    labels = jnp.asarray(rng.integers(0, V, (N,)), jnp.int32)
+    f = jax.jit(lambda x, w: linear_cross_entropy(x, w, labels).mean())
+    np.testing.assert_allclose(float(f(x, w)),
+                               float(_ref_lce(x, w, labels).mean()),
+                               rtol=1e-4)
+
+
+def test_flash_multiblock_carry():
+    """Pin small blocks so T=256 exercises the cross-block online-softmax
+    carry (m/l/acc scratch across the inner grid dim) in fwd and bwd."""
+    import os
+    os.environ["PT_FLASH_FWD_BLOCKS"] = "128,128"
+    os.environ["PT_FLASH_BWD_BLOCKS"] = "128,128"
+    try:
+        rng = np.random.default_rng(7)
+        B, T, H, D = 1, 256, 2, 32
+        q, k, v = (jnp.asarray(rng.standard_normal((B, T, H, D)),
+                               jnp.float32) * 0.3 for _ in range(3))
+        out = flash_attention(q, k, v, causal=True)
+        ref = _ref_attention(q, k, v, True, 1 / np.sqrt(D))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+        gf = jax.grad(lambda q, k, v: (
+            flash_attention(q, k, v, causal=True) ** 2).sum(),
+            argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda q, k, v: (
+            _ref_attention(q, k, v, True, 1 / np.sqrt(D)) ** 2).sum(),
+            argnums=(0, 1, 2))(q, k, v)
+        for a, b, n in zip(gf, gr, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-4, rtol=5e-4,
+                                       err_msg=f"d{n} mismatch")
+    finally:
+        del os.environ["PT_FLASH_FWD_BLOCKS"]
+        del os.environ["PT_FLASH_BWD_BLOCKS"]
+
+
+def test_flash_env_blocks_must_divide():
+    import os
+    os.environ["PT_FLASH_FWD_BLOCKS"] = "96,96"
+    try:
+        q = jnp.zeros((1, 256, 1, 32), jnp.float32)
+        with pytest.raises(ValueError):
+            flash_attention(q, q, q)
+    finally:
+        del os.environ["PT_FLASH_FWD_BLOCKS"]
+
+
+def test_linear_cross_entropy_pallas_kernels_interpret(monkeypatch):
+    """Force the Pallas path (interpret mode on CPU) to cover the actual
+    kernels incl. vocab padding, not just the XLA fallback."""
+    from paddle_tpu.ops.pallas import fused_ce
+    monkeypatch.setattr(fused_ce, "_pallas_ok", lambda N, H: True)
+    rng = np.random.default_rng(3)
+    N, H, V = 128, 128, 700    # pads to 1024 internally
+    x = jnp.asarray(rng.normal(size=(N, H)) * 0.1, jnp.float32)
+    w = jnp.asarray(rng.normal(size=(V, H)) * 0.1, jnp.float32)
+    labels = jnp.asarray(rng.integers(0, V, (N,)), jnp.int32)
+    out = fused_ce.linear_cross_entropy(x, w, labels, fused=True)
+    ref = _ref_lce(x, w, labels)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+    gx, gw = jax.grad(
+        lambda x, w: fused_ce.linear_cross_entropy(
+            x, w, labels, fused=True).mean(), argnums=(0, 1))(x, w)
+    rx, rw = jax.grad(lambda x, w: _ref_lce(x, w, labels).mean(),
+                      argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(rx),
+                               rtol=2e-3, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(rw),
+                               rtol=2e-3, atol=1e-5)
+
+
+def test_functional_linear_cross_entropy_tensor_api():
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    rng = np.random.default_rng(4)
+    N, H, V = 64, 32, 100
+    x = paddle.to_tensor(rng.normal(size=(N, H)).astype(np.float32) * 0.1,
+                         stop_gradient=False)
+    w = paddle.to_tensor(rng.normal(size=(V, H)).astype(np.float32) * 0.1,
+                         stop_gradient=False)
+    lbl = paddle.to_tensor(rng.integers(0, V, (N,)).astype(np.int64))
+    loss = F.linear_cross_entropy(x, w, lbl)
+    loss.backward()
+    ref = _ref_lce(x._data, w._data, lbl._data.astype(jnp.int32)).mean()
+    np.testing.assert_allclose(float(loss.numpy()), float(ref), rtol=1e-4)
+    assert x.grad is not None and w.grad is not None
