@@ -99,10 +99,28 @@ def _merge_specs(base: Dict[str, P], extra: Dict[str, P]) -> Dict[str, P]:
     return out
 
 
+def _slot_shardings(mesh, opt_state, params, slot_specs):
+    """Optimizer-slot shardings: a slot shaped like its parameter follows
+    the parameter's spec; scalars (beta powers, steps) replicate."""
+    return {n: {sl: (NamedSharding(mesh, slot_specs[n])
+                     if tuple(getattr(v, "shape", ())) ==
+                     tuple(params[n].shape)
+                     else NamedSharding(mesh, P()))
+                for sl, v in st.items()}
+            for n, st in opt_state.items()}
+
+
+def _put_opt_state(opt_state, s_sh):
+    return {n: {sl: jax.device_put(v, s_sh[n][sl]) for sl, v in st.items()}
+            for n, st in opt_state.items()}
+
+
 def compile_train_step(layer, optimizer, strategy: DistributedStrategy,
                        loss_method: str = "loss", mesh=None,
                        lr_default: float = 1e-3) -> CompiledTrainStep:
     mesh = mesh or strategy.build_mesh()
+    if int(mesh.shape.get("pp", 1)) > 1:
+        return _compile_pipeline_step(layer, optimizer, strategy, mesh)
     wrapped = MethodAdapter(layer, loss_method) if loss_method else layer
     params = param_arrays(layer)
     state = state_arrays(layer)
@@ -125,27 +143,13 @@ def compile_train_step(layer, optimizer, strategy: DistributedStrategy,
         pspecs = _merge_specs(tp_specs, zspecs if stage >= 3 else
                               {k: P(*([None] * getattr(v, "ndim", 0)))
                                for k, v in params.items()})
-        state_specs = {
-            name: {slot: (_merge_specs({name: tp_specs[name]},
-                                       {name: zspecs[name]})[name]
-                          if tuple(getattr(v, "shape", ())) ==
-                          tuple(params[name].shape)
-                          else P(*([None] * getattr(v, "ndim", 0))))
-                   for slot, v in st.items()}
-            for name, st in opt_state.items()}
+        slot_specs = _merge_specs(tp_specs, zspecs)
     else:
         pspecs = tp_specs
-        state_specs = {
-            name: {slot: (tp_specs[name]
-                          if tuple(getattr(v, "shape", ())) ==
-                          tuple(params[name].shape)
-                          else P(*([None] * getattr(v, "ndim", 0))))
-                   for slot, v in st.items()}
-            for name, st in opt_state.items()}
+        slot_specs = tp_specs
 
     p_sh = {k: NamedSharding(mesh, pspecs[k]) for k in params}
-    s_sh = {n: {sl: NamedSharding(mesh, sp) for sl, sp in st.items()}
-            for n, st in state_specs.items()}
+    s_sh = _slot_shardings(mesh, opt_state, params, slot_specs)
     buf_sh = {k: NamedSharding(mesh, P(*([None] * getattr(v, "ndim", 0))))
               for k, v in state.items()}
     data_sh = NamedSharding(mesh, P("dp"))  # leading batch dim over dp
@@ -210,12 +214,164 @@ def compile_train_step(layer, optimizer, strategy: DistributedStrategy,
 
     params = jax.device_put(params, p_sh)
     state = jax.device_put(state, buf_sh)
-    opt_state = {n: {sl: jax.device_put(v, s_sh[n][sl])
-                     for sl, v in st.items()}
-                 for n, st in opt_state.items()}
+    opt_state = _put_opt_state(opt_state, s_sh)
 
     prog = CompiledTrainStep(jitted, params, state, opt_state,
                              {"params": p_sh, "opt": s_sh}, mesh, layer,
                              data_sh)
     prog._opt = optimizer
     return prog
+
+
+# ---------------------------------------------------------------------------
+# pipeline-parallel step (strategy.pipeline / pp_degree > 1)
+# ---------------------------------------------------------------------------
+
+def _compile_pipeline_step(layer, optimizer, strategy, mesh):
+    """PP branch of the strategy compiler.
+
+    Reference: PipelineOptimizer splits the Program into per-stage sections
+    executed by SectionWorker 1F1B loops (optimizer.py:3718,
+    section_worker.cc:98-165). TPU-native: the layer supplies an
+    (embed, blocks, head) decomposition; homogeneous blocks are stacked on
+    a leading layer axis sharded over 'pp' and driven by the SPMD schedule
+    in distributed/pipeline.py (ppermute ring inside one jitted scan).
+    Composes with dp (microbatch dim sharded over 'dp'), recompute
+    (jax.checkpoint per block) and AMP (autocast inside the traced blocks).
+    Microbatch count = pipeline_configs.accumulate_steps.
+    """
+    from ..pipeline import pipeline_spmd, stack_stage_params
+
+    if int(mesh.shape.get("tp", 1)) > 1:
+        raise NotImplementedError(
+            "pipeline + tensor_parallel in one mesh is not supported yet; "
+            "tp collectives would need manual insertion inside the "
+            "pipeline's shard_map region")
+    if strategy.sharding:
+        raise NotImplementedError(
+            "pipeline + sharding (ZeRO) is not supported yet; optimizer "
+            "state would need 'dp' specs threaded through the stacked "
+            "layout — disable one of the two")
+    if strategy.gradient_merge and strategy.gradient_merge_configs.k_steps > 1:
+        raise NotImplementedError(
+            "pipeline already microbatches via "
+            "pipeline_configs.accumulate_steps; gradient_merge on top is "
+            "not supported — fold k_steps into accumulate_steps")
+    split = getattr(layer, "pipeline_split_params", None)
+    fns = getattr(layer, "pipeline_fns", None)
+    if not (callable(split) and callable(fns)):
+        raise TypeError(
+            "pipeline=True requires the layer to implement "
+            "pipeline_split_params(params) and pipeline_fns() "
+            "(see models/gpt.py for the protocol)")
+
+    n_pp = int(mesh.shape["pp"])
+    n_dp = int(mesh.shape.get("dp", 1))
+    n_micro = max(int(strategy.pipeline_configs.accumulate_steps), 1)
+    amp_on = bool(strategy.amp)
+    pure_bf16 = amp_on and strategy.amp_configs.use_pure_bf16
+
+    params = param_arrays(layer)
+    state = state_arrays(layer)
+    ep, blocks_list, hp = split(params)
+    n_layers = len(blocks_list)
+    if n_layers % n_pp:
+        raise ValueError(f"{n_layers} blocks not divisible by pp={n_pp}")
+    embed_fn, block_fn, head_loss_fn = fns()
+    if strategy.recompute:
+        policy = getattr(jax.checkpoint_policies,
+                         strategy.recompute_configs.policy, None)
+        block_fn = jax.checkpoint(block_fn, policy=policy)
+
+    stacked = stack_stage_params(blocks_list)
+    flat = {}
+    flat.update({f"embed.{k}": v for k, v in ep.items()})
+    flat.update({f"head.{k}": v for k, v in hp.items()})
+    flat.update({f"stacked.{k}": v for k, v in stacked.items()})
+    opt_state = optimizer.functional_init(flat)
+
+    def _pspec(k, v):
+        if k.startswith("stacked."):
+            return P("pp", *([None] * (v.ndim - 1)))
+        return P(*([None] * v.ndim))
+
+    pspecs = {k: _pspec(k, v) for k, v in flat.items()}
+    p_sh = {k: NamedSharding(mesh, pspecs[k]) for k in flat}
+    s_sh = _slot_shardings(mesh, opt_state, flat, pspecs)
+    buf_sh = {k: NamedSharding(mesh, P(*([None] * getattr(v, "ndim", 0))))
+              for k, v in state.items()}
+    data_sh = NamedSharding(mesh, P("dp") if n_dp > 1 else P())
+
+    pipe = pipeline_spmd(block_fn, n_pp, n_micro, mesh, axis="pp",
+                         batch_axis="dp" if n_dp > 1 else None)
+
+    def _sub(p, prefix):
+        cut = len(prefix)
+        return {k[cut:]: v for k, v in p.items() if k.startswith(prefix)}
+
+    def train_step(p, st, opt_st, key, lr, data):
+        ids, labels = data
+
+        def loss_of(pp):
+            from ... import amp as amp_mod
+            with random_mod.key_scope(key):
+                with amp_mod.auto_cast(enable=amp_on,
+                                       level="O2" if pure_bf16 else "O1",
+                                       dtype="bfloat16"):
+                    epp = _sub(pp, "embed.")
+                    hpp = _sub(pp, "head.")
+                    spp = _sub(pp, "stacked.")
+                    mb = ids.shape[0] // n_micro
+                    ids_m = ids.reshape((n_micro, mb) + ids.shape[1:])
+                    lab_m = labels.reshape((n_micro, mb) + labels.shape[1:])
+                    h = jax.vmap(embed_fn, in_axes=(None, 0))(epp, ids_m)
+                    h = pipe(spp, h)
+                    losses = jax.vmap(head_loss_fn,
+                                      in_axes=(None, None, 0, 0))(
+                        hpp, epp, h, lab_m)
+            return losses.mean()
+
+        loss, grads = jax.value_and_grad(loss_of)(p)
+        new_p, new_opt = optimizer.functional_update(p, grads, opt_st, lr=lr)
+        return loss, new_p, st, new_opt
+
+    jitted = jax.jit(
+        train_step,
+        in_shardings=(p_sh, buf_sh, s_sh, None, None, data_sh),
+        out_shardings=(NamedSharding(mesh, P()), p_sh, buf_sh, s_sh),
+        donate_argnums=(0, 2))
+
+    flat = jax.device_put(flat, p_sh)
+    state = jax.device_put(state, buf_sh)
+    opt_state = _put_opt_state(opt_state, s_sh)
+
+    prog = _PipelineTrainStep(jitted, flat, state, opt_state,
+                              {"params": p_sh, "opt": s_sh}, mesh, layer,
+                              data_sh)
+    prog._opt = optimizer
+    prog._n_layers = n_layers
+    return prog
+
+
+class _PipelineTrainStep(CompiledTrainStep):
+    """CompiledTrainStep whose param dict uses the pipeline layout
+    (embed.* / head.* / stacked.*[L, ...]); write_back unstacks."""
+
+    def write_back(self):
+        lookup = dict(self.layer.named_parameters())
+        lookup.update(dict(self.layer.named_buffers()))
+        for k, v in self.params.items():
+            if k.startswith("embed.") or k.startswith("head."):
+                name = k.split(".", 1)[1]
+                if name in lookup:
+                    lookup[name]._data = jax.device_get(v)
+            elif k.startswith("stacked."):
+                rel = k[len("stacked."):]
+                stacked = jax.device_get(v)
+                for i in range(self._n_layers):
+                    name = f"blocks.{i}.{rel}"
+                    if name in lookup:
+                        lookup[name]._data = stacked[i]
+        for k, v in self.state.items():
+            if k in lookup:
+                lookup[k]._data = jax.device_get(v)

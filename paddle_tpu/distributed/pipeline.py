@@ -40,7 +40,7 @@ def stack_stage_params(block_params_list):
 
 
 def pipeline_spmd(block_fn: Callable, n_stages: int, n_micro: int,
-                  mesh, axis: str = "pp"):
+                  mesh, axis: str = "pp", batch_axis: str = None):
     """Build pipelined_fn(stacked_params, x_micro) -> y_micro.
 
     block_fn(params_one_layer, x) -> x          (one transformer block)
@@ -99,13 +99,19 @@ def pipeline_spmd(block_fn: Callable, n_stages: int, n_micro: int,
         return outputs
 
     def pipelined(stacked_params, x_micro, in_mesh=mesh):
+        # x_micro [n_micro, micro_batch, ...]: the micro_batch dim may ride
+        # a data-parallel axis so dp x pp composes in one shard_map
         nd_x = x_micro.ndim
+        dspec = [None] * nd_x
+        if batch_axis is not None:
+            dspec[1] = batch_axis
+        dspec = P(*dspec)
         param_specs = jax.tree_util.tree_map(
             lambda v: P(axis, *([None] * (v.ndim - 1))), stacked_params)
         f = jax.shard_map(
             staged, mesh=in_mesh,
-            in_specs=(param_specs, P(*([None] * nd_x))),
-            out_specs=P(*([None] * nd_x)),
+            in_specs=(param_specs, dspec),
+            out_specs=dspec,
             check_vma=False)
         return f(stacked_params, x_micro)
 

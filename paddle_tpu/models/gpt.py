@@ -152,6 +152,67 @@ class GPT(nn.Layer):
         Megatron tensor-parallel PartitionSpecs for every parameter."""
         return gpt_param_shardings(params, mesh_axis_tp=mesh_axis_tp)
 
+    # -- pipeline-parallel protocol (fleet/compiler.py pipeline branch) ----
+    def pipeline_split_params(self, params):
+        """Split the flat functional param dict into (embed, [block_i],
+        head) for the SPMD pipeline: homogeneous blocks are stacked and
+        sharded over 'pp'; embed/head run replicated outside the pipelined
+        region (reference program splitter: PipelineOptimizer
+        optimizer.py:3718 assigns ops to stages; here the split is by
+        construction)."""
+        embed = {k: v for k, v in params.items()
+                 if k.startswith(("wte.", "wpe."))}
+        head = {k: v for k, v in params.items() if k.startswith("ln_f.")}
+        blocks = []
+        for i in range(self.cfg.layers):
+            pref = f"blocks.{i}."
+            blocks.append({k[len(pref):]: v for k, v in params.items()
+                           if k.startswith(pref)})
+        return embed, blocks, head
+
+    def pipeline_fns(self, ignore_index=-100):
+        """Pure (embed_fn, block_fn, head_loss_fn) for the pipeline step.
+        block_fn reuses blocks[0] as the shared functional template (all
+        blocks are structurally identical; layer i's params are fed in).
+        Dropout is not representable on this pure path — refuse rather than
+        silently change regularization."""
+        if self.cfg.dropout > 0:
+            raise NotImplementedError(
+                "pipeline_fns: dropout > 0 is not supported on the "
+                "pipeline-parallel path (pure per-stage functions carry no "
+                "dropout rng); set GPTConfig.dropout=0")
+        from ..framework import functional_call
+        from ..ops.pallas.fused_ce import linear_cross_entropy
+        blk0 = self.blocks[0]
+
+        def embed_fn(ep, ids):
+            T = ids.shape[-1]
+            pos = jnp.arange(T)
+            return ep["wte.weight"][ids] + ep["wpe.weight"][pos]
+
+        def block_fn(bp, h):
+            out, _ = functional_call(blk0, bp, {}, h, mutable_state=False)
+            return out
+
+        def head_loss_fn(hp, ep, h, labels):
+            g, b = hp["ln_f.weight"], hp["ln_f.bias"]
+            mu = h.mean(-1, keepdims=True)
+            var = ((h - mu) ** 2).mean(-1, keepdims=True)
+            hn = (h - mu) / jnp.sqrt(var + 1e-5) * g + b
+            H = hn.shape[-1]
+            lab = labels.reshape(-1).astype(jnp.int32)
+            valid = lab != ignore_index
+            # tied head via the fused linear+CE op (same ignore_index
+            # masking as F.cross_entropy: padded rows contribute 0)
+            rows = linear_cross_entropy(
+                hn.reshape(-1, H), ep["wte.weight"],
+                jnp.where(valid, lab, 0))
+            rows = jnp.where(valid, rows, 0.0)
+            denom = jnp.maximum(valid.astype(jnp.float32).sum(), 1.0)
+            return rows.sum() / denom
+
+        return embed_fn, block_fn, head_loss_fn
+
 
 def gpt_param_shardings(params, mesh_axis_tp="tp"):
     """Megatron-style TP PartitionSpecs keyed by the functional param dict

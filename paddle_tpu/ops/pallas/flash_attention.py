@@ -355,7 +355,10 @@ def flash_attention(q, k, v, causal=False, scale=None):
     B, T, H, D = q.shape
     if scale is None:
         scale = 1.0 / math.sqrt(D)
+    # validate BOTH directions' blocks up front so a bad env override fails
+    # here (where sdpa's fallback can catch it) rather than mid-backward
     bq, bk = _block_sizes(T, D)
+    _bwd_block_sizes(T, D)
     if T % bq or T % bk:
         raise ValueError(f"flash_attention: seq len {T} must be a multiple "
                          f"of the block size {bq}")

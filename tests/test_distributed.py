@@ -250,3 +250,76 @@ def test_fleet_init_and_helpers():
     o = opt.SGD(learning_rate=0.1)
     dopt = fleet.distributed_optimizer(o, s)
     assert dopt.user_defined_strategy is s
+
+
+def test_compiled_step_pipeline_matches_sequential():
+    """VERDICT r1 #3: DistributedStrategy(pipeline=True, pp_degree=2) x dp=2
+    through the fleet API matches single-device sequential training, incl.
+    recompute composition and write_back."""
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.distributed.fleet.compiler import compile_train_step
+
+    rng = np.random.default_rng(0)
+    B, T = 8, 32
+    ids = rng.integers(0, 512, (B, T)).astype(np.int64)
+    labels = rng.integers(0, 512, (B, T)).astype(np.int64)
+
+    m1 = _tiny_gpt()
+    s1 = DistributedStrategy()
+    mesh1 = s1.build_mesh(devices=jax.devices()[:1])
+    adam1 = opt.Adam(learning_rate=1e-3, parameters=list(m1.parameters()))
+    prog1 = compile_train_step(m1, adam1, s1, mesh=mesh1)
+    seq = [float(jax.device_get(prog1.step(ids, labels, lr=1e-3)))
+           for _ in range(3)]
+
+    m2 = _tiny_gpt()
+    s2 = DistributedStrategy()
+    s2.pipeline = True
+    s2.hybrid_configs.pp_degree = 2
+    s2.hybrid_configs.dp_degree = 2
+    s2.pipeline_configs.accumulate_steps = 4
+    s2.recompute = True
+    mesh2 = s2.build_mesh(devices=jax.devices()[:4])
+    adam2 = opt.Adam(learning_rate=1e-3, parameters=list(m2.parameters()))
+    prog2 = compile_train_step(m2, adam2, s2, mesh=mesh2)
+    pp = [float(jax.device_get(prog2.step(ids, labels, lr=1e-3)))
+          for _ in range(3)]
+
+    np.testing.assert_allclose(seq, pp, atol=2e-4)
+    # stacked block params are sharded over 'pp'
+    k = [k for k in prog2.params if k.startswith("stacked.")][0]
+    assert prog2.params[k].sharding.spec[0] == "pp"
+
+    # write_back unstacks into the Layer tree and matches sequential
+    prog2.write_back()
+    p_after = {k: v._data for k, v in m2.named_parameters()}
+    err = max(float(jnp.abs(p_after[k] -
+                            jax.device_get(prog1.params[k])).max())
+              for k in prog1.params)
+    assert err < 2e-4, err
+
+
+def test_pipeline_requires_protocol_and_rejects_tp():
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.distributed.fleet.compiler import compile_train_step
+    import paddle_tpu.nn as nn
+
+    s = DistributedStrategy()
+    s.pipeline = True
+    s.hybrid_configs.pp_degree = 2
+    mesh = s.build_mesh(devices=jax.devices()[:2])
+    lin = nn.Linear(4, 4)
+    adam = opt.Adam(learning_rate=1e-3, parameters=list(lin.parameters()))
+    with pytest.raises(TypeError):
+        compile_train_step(lin, adam, s, mesh=mesh)
+
+    s2 = DistributedStrategy()
+    s2.pipeline = True
+    s2.tensor_parallel = True
+    s2.hybrid_configs.pp_degree = 2
+    s2.hybrid_configs.mp_degree = 2
+    mesh2 = s2.build_mesh(devices=jax.devices()[:4])
+    m = _tiny_gpt()
+    adam2 = opt.Adam(learning_rate=1e-3, parameters=list(m.parameters()))
+    with pytest.raises(NotImplementedError):
+        compile_train_step(m, adam2, s2, mesh=mesh2)
