@@ -326,10 +326,12 @@ def _compile_pipeline_step(layer, optimizer, strategy, mesh):
                     lab_m = labels.reshape((n_micro, mb) + labels.shape[1:])
                     h = jax.vmap(embed_fn, in_axes=(None, 0))(epp, ids_m)
                     h = pipe(spp, h)
-                    losses = jax.vmap(head_loss_fn,
-                                      in_axes=(None, None, 0, 0))(
+                    sums, counts = jax.vmap(head_loss_fn,
+                                            in_axes=(None, None, 0, 0))(
                         hpp, epp, h, lab_m)
-            return losses.mean()
+            # global masked mean across all microbatches (head_loss_fn
+            # returns per-microbatch (loss_sum, valid_count))
+            return sums.sum() / jnp.maximum(counts.sum(), 1.0)
 
         loss, grads = jax.value_and_grad(loss_of)(p)
         new_p, new_opt = optimizer.functional_update(p, grads, opt_st, lr=lr)

@@ -353,6 +353,23 @@ class Model:
         return per_out
 
     # ------------------------------------------------------------------
+    def _inputs_spec(self):
+        """InputSpec list for inference export (Model(net, inputs=...))."""
+        from ..static import InputSpec
+        if self._inputs is None:
+            raise ValueError(
+                "Model.save(training=False) needs the Model constructed "
+                "with inputs=[InputSpec(...)] so the exported program's "
+                "signature is known")
+        out = []
+        for s in _as_list(self._inputs):
+            if isinstance(s, InputSpec):
+                out.append(s)
+            else:
+                out.append(InputSpec(tuple(s.shape), str(s.dtype)))
+        return out
+
+    # ------------------------------------------------------------------
     def _split_batch(self, batch, has_labels=True):
         batch = batch if isinstance(batch, (list, tuple)) else [batch]
         if self._inputs is not None:
@@ -403,12 +420,19 @@ class Model:
             self._write_back(self._params, self._state)
 
     def save(self, path, training=True):
-        """state_dict save (reference Model.save hapi/model.py; inference
-        export goes through paddle_tpu.jit.save)."""
+        """training=True: checkpoint (state dict + optimizer slots).
+        training=False: inference export — serialized StableHLO + params
+        via paddle_tpu.jit.save, loadable without the model class
+        (reference Model.save hapi/model.py -> save_inference_model)."""
         self._sync_network()
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
+        if not training:
+            from .. import jit as jit_mod
+            spec = self._inputs_spec()
+            jit_mod.save(self.network, path, input_spec=spec)
+            return
         from ..framework import save as fsave
         fsave(self.network.state_dict(), path + ".pdparams")
         if training and self._optimizer is not None:

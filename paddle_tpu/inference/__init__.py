@@ -1,0 +1,111 @@
+"""paddle.inference — the deployment predictor surface (reference L7:
+AnalysisPredictor analysis_predictor.cc:145 Init, :354 Run, config
+analysis_config.cc).
+
+TPU-native: the "analysis + pass pipeline + NaiveExecutor" stack collapses
+to (deserialize StableHLO, bind params, jit.call) — XLA is the optimizer
+pass pipeline. The Config/Predictor API keeps the reference's shape so
+serving code ports over; the engine is paddle_tpu.jit.load.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import jit as jit_mod
+
+__all__ = ["Config", "Predictor", "create_predictor"]
+
+
+class Config:
+    """AnalysisConfig parity: points at the saved program + params.
+    Accepts either the artifact prefix (Config(prefix)) or the two file
+    paths (Config(model_file, params_file))."""
+
+    def __init__(self, prog_file=None, params_file=None):
+        if prog_file is not None and prog_file.endswith(".pdmodel"):
+            self._prefix = prog_file[:-len(".pdmodel")]
+        else:
+            self._prefix = prog_file
+        self._params_file = params_file
+        self._enable_memory_optim = True
+
+    def prog_file(self):
+        return (self._prefix or "") + ".pdmodel"
+
+    def params_file(self):
+        return self._params_file or (self._prefix or "") + ".pdiparams"
+
+    # parity toggles — XLA owns these decisions on TPU
+    def enable_memory_optim(self, flag=True):
+        self._enable_memory_optim = flag
+
+    def switch_ir_optim(self, flag=True):
+        pass
+
+    def enable_use_gpu(self, *a, **k):  # pragma: no cover - parity no-op
+        pass
+
+    def disable_glog_info(self):  # pragma: no cover - parity no-op
+        pass
+
+
+class _InputHandle:
+    def __init__(self, predictor, idx):
+        self._p = predictor
+        self._idx = idx
+
+    def copy_from_cpu(self, array):
+        self._p._inputs[self._idx] = np.asarray(array)
+
+    def reshape(self, shape):  # data arrives via copy_from_cpu; no-op
+        pass
+
+
+class _OutputHandle:
+    def __init__(self, predictor, idx):
+        self._p = predictor
+        self._idx = idx
+
+    def copy_to_cpu(self):
+        return np.asarray(self._p._outputs[self._idx])
+
+
+class Predictor:
+    """AnalysisPredictor::Run parity: copy inputs -> run program -> fetch."""
+
+    def __init__(self, config: Config):
+        self._layer = jit_mod.load(config.prog_file(),
+                                   params_path=config.params_file())
+        n_in = len(self._layer.in_avals) - len(self._layer._params)
+        self._n_inputs = max(n_in, 1)
+        self._inputs = [None] * self._n_inputs
+        self._outputs = []
+
+    def get_input_names(self):
+        return [f"x{i}" for i in range(self._n_inputs)]
+
+    def get_input_handle(self, name):
+        idx = int(name[1:]) if name.startswith("x") else 0
+        return _InputHandle(self, idx)
+
+    def run(self, inputs=None):
+        if inputs is not None:
+            self._inputs = [np.asarray(a) for a in inputs]
+        if any(a is None for a in self._inputs):
+            raise ValueError("inputs not set; use copy_from_cpu or run([..])")
+        out = self._layer(*self._inputs)
+        leaves = out if isinstance(out, (list, tuple)) else [out]
+        self._outputs = [np.asarray(t._data if hasattr(t, "_data") else t)
+                         for t in leaves]
+        return self._outputs
+
+    def get_output_names(self):
+        return [f"out{i}" for i in range(max(len(self._outputs), 1))]
+
+    def get_output_handle(self, name):
+        idx = int(name[3:]) if name.startswith("out") else 0
+        return _OutputHandle(self, idx)
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

@@ -1,0 +1,214 @@
+"""paddle.jit — dygraph-to-static + program save/load, TPU-native.
+
+Reference surface: @to_static / @declarative (fluid/dygraph/jit.py:160,
+dygraph_to_static/program_translator.py:756 — an AST transformer that
+rewrites Python into ProgramDesc) and jit.save/jit.load +
+save_inference_model (fluid/io.py:1199) which bundle a serialized program
+with parameters so inference needs no model class.
+
+TPU-native redesign: tracing IS the translation — `to_static` wraps the
+layer in functional_call + jax.jit (no AST surgery; Python control flow is
+resolved at trace time exactly like the reference's program capture).
+`save` exports the traced forward as a versioned StableHLO module
+(jax.export) next to a parameter pickle; `load` rebuilds a callable
+TranslatedLayer from those two artifacts alone — the NaiveExecutor-style
+serve path (naive_executor.h analog): deserialize + bind params + run.
+
+Artifacts (paddle naming parity):
+    {path}.pdmodel    — serialized StableHLO module (jax.export bytes)
+    {path}.pdiparams  — pickled {name: numpy} parameter payloads
+"""
+from __future__ import annotations
+
+import functools
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import export as jax_export
+
+from ..core.tensor import Tensor
+from ..framework import functional_call, param_arrays, state_arrays
+from ..static import InputSpec
+
+__all__ = ["to_static", "save", "load", "TranslatedLayer", "not_to_static"]
+
+
+def _spec_to_aval(spec, sym_ctx):
+    """InputSpec -> ShapeDtypeStruct; None dims become export symbols."""
+    dims = []
+    for i, d in enumerate(spec.shape):
+        if d is None or (isinstance(d, int) and d < 0):
+            name = f"d{len(sym_ctx)}"
+            sym = jax_export.symbolic_shape(name, scope=sym_ctx["scope"])[0]
+            sym_ctx[name] = sym
+            dims.append(sym)
+        else:
+            dims.append(int(d))
+    return jax.ShapeDtypeStruct(tuple(dims), spec.dtype)
+
+
+class StaticFunction:
+    """What @to_static returns: the layer/function with a jit-compiled
+    functional fast path and enough metadata for jit.save."""
+
+    def __init__(self, fn_or_layer, input_spec=None):
+        self._target = fn_or_layer
+        self._input_spec = input_spec
+        self._is_layer = hasattr(fn_or_layer, "named_parameters")
+        self._jit_cache = {}
+        functools.update_wrapper(self, getattr(
+            fn_or_layer, "forward", fn_or_layer), updated=())
+
+    def _jitted_for(self, static_kwargs):
+        """One compiled entry per static-kwarg combination (non-array
+        kwargs like training=False are compile-time constants)."""
+        key = tuple(sorted(static_kwargs.items()))
+        if key not in self._jit_cache:
+            if self._is_layer:
+                def _run(p, st, *args):
+                    out, _ = functional_call(self._target, p, st, *args,
+                                             mutable_state=False,
+                                             **dict(key))
+                    return out
+            else:
+                def _run(*args):
+                    return self._target(*args, **dict(key))
+            self._jit_cache[key] = jax.jit(_run)
+        return self._jit_cache[key]
+
+    def __call__(self, *args, **kwargs):
+        arrayish = (Tensor, jnp.ndarray, np.ndarray)
+        static_kw = {k: v for k, v in kwargs.items()
+                     if not isinstance(v, arrayish)}
+        if len(static_kw) != len(kwargs):
+            raise NotImplementedError(
+                "to_static: tensor-valued keyword arguments are not "
+                "supported; pass tensors positionally")
+        raw = [a._data if isinstance(a, Tensor) else a for a in args]
+        if not self._is_layer:
+            return self._jitted_for(static_kw)(*raw)
+        p = param_arrays(self._target)
+        st = state_arrays(self._target)
+        out = self._jitted_for(static_kw)(p, st, *raw)
+        return jax.tree_util.tree_map(Tensor, out)
+
+    # paddle parity helpers
+    @property
+    def inner_layer(self):
+        return self._target if self._is_layer else None
+
+    def concrete_program(self, *specs):  # reference: partial_program
+        return self._jitted_for({})
+
+
+def to_static(function=None, input_spec=None, **kwargs):
+    """Decorator/wrapper: paddle.jit.to_static(layer_or_fn).
+
+    The engine is trace-and-compile (jax.jit over functional_call); the
+    reference's AST transform pipeline (dygraph_to_static/) is unnecessary
+    because tracing executes the genuine Python."""
+    if function is None:
+        return lambda f: to_static(f, input_spec=input_spec, **kwargs)
+    return StaticFunction(function, input_spec)
+
+
+def not_to_static(func):
+    """Parity marker (reference jit.py not_to_static): excluded from
+    translation — a no-op here since tracing follows real calls."""
+    return func
+
+
+def save(layer, path, input_spec=None):
+    """Serialize `layer`'s forward as StableHLO + params; the result loads
+    and runs with jit.load without the model class (reference:
+    save_inference_model fluid/io.py:1199 + jit.save)."""
+    target = layer._target if isinstance(layer, StaticFunction) else layer
+    spec = input_spec or getattr(layer, "_input_spec", None)
+    if spec is None:
+        raise ValueError("jit.save needs input_spec=[InputSpec(...), ...] "
+                         "to trace the exported program")
+    was_training = bool(getattr(target, "training", False))
+    if hasattr(target, "eval"):
+        target.eval()            # export inference behavior (no dropout)
+    try:
+        params = param_arrays(target)
+        state = state_arrays(target)
+        merged = {**params, **state}
+
+        def fwd(pp, *inputs):
+            out, _ = functional_call(target, pp, {}, *inputs,
+                                     mutable_state=False)
+            return out
+
+        sym_ctx = {"scope": jax_export.SymbolicScope()}
+        in_avals = tuple(
+            _spec_to_aval(s if isinstance(s, InputSpec) else InputSpec(*s),
+                          sym_ctx)
+            for s in spec)
+        p_avals = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                   for k, v in merged.items()}
+        exported = jax_export.export(jax.jit(fwd))(p_avals, *in_avals)
+    finally:
+        if was_training and hasattr(target, "train"):
+            target.train()
+
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+    with open(path + ".pdiparams", "wb") as f:
+        pickle.dump({k: np.asarray(jax.device_get(v))
+                     for k, v in merged.items()}, f, protocol=4)
+
+
+class TranslatedLayer:
+    """Loaded inference program: deserialized StableHLO + bound params —
+    runnable without the original model class (reference TranslatedLayer
+    fluid/dygraph/io.py; executor analog: NaiveExecutor)."""
+
+    def __init__(self, exported, params):
+        self._exported = exported
+        self._params = params
+        self._call = jax.jit(exported.call)
+
+    def __call__(self, *args):
+        raw = [a._data if isinstance(a, Tensor) else jnp.asarray(a)
+               for a in args]
+        out = self._call(self._params, *raw)
+        return jax.tree_util.tree_map(Tensor, out)
+
+    def forward(self, *args):
+        return self(*args)
+
+    def eval(self):
+        return self
+
+    def train(self):
+        raise RuntimeError("TranslatedLayer is an inference program; "
+                           "training state was not exported")
+
+    @property
+    def program_bytes(self):
+        return self._exported.serialize()
+
+    @property
+    def in_avals(self):
+        return self._exported.in_avals
+
+
+def load(path, params_path=None):
+    """jit.load: read {path}.pdmodel + params -> TranslatedLayer.
+    params default to {path}.pdiparams; pass params_path to load them from
+    elsewhere (the two-file inference.Config form)."""
+    model_file = path if path.endswith(".pdmodel") else path + ".pdmodel"
+    with open(model_file, "rb") as f:
+        exported = jax_export.deserialize(f.read())
+    params_file = params_path or (
+        model_file[:-len(".pdmodel")] + ".pdiparams")
+    with open(params_file, "rb") as f:
+        params = {k: jnp.asarray(v) for k, v in pickle.load(f).items()}
+    return TranslatedLayer(exported, params)

@@ -194,11 +194,17 @@ class GPT(nn.Layer):
             out, _ = functional_call(blk0, bp, {}, h, mutable_state=False)
             return out
 
+        eps = self.ln_f._epsilon
+
         def head_loss_fn(hp, ep, h, labels):
+            """Returns (loss_sum, valid_token_count) so the caller can form
+            the GLOBAL masked mean over all microbatches — a per-microbatch
+            mean-of-means would weight unevenly-padded microbatches
+            differently from the sequential path."""
             g, b = hp["ln_f.weight"], hp["ln_f.bias"]
             mu = h.mean(-1, keepdims=True)
             var = ((h - mu) ** 2).mean(-1, keepdims=True)
-            hn = (h - mu) / jnp.sqrt(var + 1e-5) * g + b
+            hn = (h - mu) / jnp.sqrt(var + eps) * g + b
             H = hn.shape[-1]
             lab = labels.reshape(-1).astype(jnp.int32)
             valid = lab != ignore_index
@@ -208,8 +214,7 @@ class GPT(nn.Layer):
                 hn.reshape(-1, H), ep["wte.weight"],
                 jnp.where(valid, lab, 0))
             rows = jnp.where(valid, rows, 0.0)
-            denom = jnp.maximum(valid.astype(jnp.float32).sum(), 1.0)
-            return rows.sum() / denom
+            return rows.sum(), valid.astype(jnp.float32).sum()
 
         return embed_fn, block_fn, head_loss_fn
 

@@ -30,7 +30,13 @@ def _shape_arg(shape):
         return tuple(int(s) for s in shape.numpy())
     out = []
     for s in shape:
-        out.append(int(s.item()) if isinstance(s, Tensor) else int(s))
+        if isinstance(s, Tensor):
+            out.append(int(s.item()))
+        elif isinstance(s, (int, np.integer)):
+            out.append(int(s))
+        else:
+            # symbolic dims (jax.export shape polymorphism) pass through
+            out.append(s)
     return tuple(out)
 
 

@@ -1,0 +1,38 @@
+"""paddle.static surface.
+
+The reference's static-graph entry (python/paddle/static/) carries the
+Program/Executor machinery; on TPU the program IS the jitted/exported
+StableHLO module, so this module keeps only the pieces with meaning here:
+InputSpec (shape/dtype declarations for jit.save / to_static) and thin
+aliases onto the jit path.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core import dtype as dtype_mod
+
+__all__ = ["InputSpec"]
+
+
+class InputSpec:
+    """Declares one input's (shape, dtype, name); None dims are symbolic
+    (exported modules accept any size there). Reference:
+    python/paddle/static/input.py InputSpec."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = dtype_mod.convert_dtype(dtype)
+        self.name = name
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tuple(tensor.shape), str(tensor.dtype), name)
+
+    @classmethod
+    def from_numpy(cls, ndarray, name=None):
+        return cls(ndarray.shape, str(ndarray.dtype), name)
+
+    def __repr__(self):
+        return (f"InputSpec(shape={self.shape}, dtype={self.dtype}, "
+                f"name={self.name})")

@@ -323,3 +323,34 @@ def test_pipeline_requires_protocol_and_rejects_tp():
     adam2 = opt.Adam(learning_rate=1e-3, parameters=list(m.parameters()))
     with pytest.raises(NotImplementedError):
         compile_train_step(m, adam2, s2, mesh=mesh2)
+
+
+def test_pipeline_ignore_index_matches_sequential():
+    """Padding concentrated in some microbatches must still give the GLOBAL
+    masked mean (not a mean of per-microbatch means)."""
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.distributed.fleet.compiler import compile_train_step
+
+    rng = np.random.default_rng(1)
+    B, T = 8, 32
+    ids = rng.integers(0, 512, (B, T)).astype(np.int64)
+    labels = rng.integers(0, 512, (B, T)).astype(np.int64)
+    labels[-3:] = -100          # last microbatches mostly padding
+
+    m1 = _tiny_gpt()
+    s1 = DistributedStrategy()
+    mesh1 = s1.build_mesh(devices=jax.devices()[:1])
+    adam1 = opt.Adam(learning_rate=1e-3, parameters=list(m1.parameters()))
+    prog1 = compile_train_step(m1, adam1, s1, mesh=mesh1)
+    seq = float(jax.device_get(prog1.step(ids, labels, lr=1e-3)))
+
+    m2 = _tiny_gpt()
+    s2 = DistributedStrategy()
+    s2.pipeline = True
+    s2.hybrid_configs.pp_degree = 2
+    s2.pipeline_configs.accumulate_steps = 4
+    mesh2 = s2.build_mesh(devices=jax.devices()[:2])
+    adam2 = opt.Adam(learning_rate=1e-3, parameters=list(m2.parameters()))
+    prog2 = compile_train_step(m2, adam2, s2, mesh=mesh2)
+    pp = float(jax.device_get(prog2.step(ids, labels, lr=1e-3)))
+    np.testing.assert_allclose(seq, pp, atol=2e-4)
