@@ -100,6 +100,17 @@ def maybe_cast_inputs(op_name, arrays):
 _tensor_mod._amp_hook[0] = maybe_cast_inputs
 
 
+@jax.jit
+def _fused_unscale(grads, inv_scale):
+    """One fused kernel: unscale every grad and reduce a single finite
+    flag (check_finite_and_unscale_op analog — O(1) host syncs/step)."""
+    scaled = [g.astype(jnp.float32) * inv_scale for g in grads]
+    finite = jnp.asarray(True)
+    for g in scaled:
+        finite = jnp.logical_and(finite, jnp.isfinite(g).all())
+    return scaled, finite
+
+
 class GradScaler:
     """Dynamic loss scaling (reference: amp/grad_scaler.py:20 wrapping
     AmpScaler loss_scaler.py:27; kernels update_loss_scaling_op,
@@ -143,18 +154,22 @@ class GradScaler:
         if id(optimizer) in self._unscaled:
             return
         self._unscaled.add(id(optimizer))
-        params = optimizer._parameter_list or []
-        inv = 1.0 / self._scale
-        found = False
-        for p in params:
-            if p.grad is None:
-                continue
-            g = p.grad._data.astype(jnp.float32) * inv
-            if not bool(jnp.isfinite(g).all()):
-                found = True
+        params = [p for p in (optimizer._parameter_list or [])
+                  if p.grad is not None]
+        if not params:
+            self._found_inf = False
+            return
+        grads = [p.grad._data for p in params]
+        new_grads, finite = _fused_unscale(
+            grads, jnp.float32(1.0 / self._scale))
+        # ONE device->host sync for the whole parameter set (reference
+        # fuses this the same way: check_finite_and_unscale_op takes the
+        # full grad list and emits a single FoundInfinite scalar)
+        self._found_inf = not bool(finite)
+        for p, g in zip(params, new_grads):
             p.grad.set_value(g.astype(p.grad.dtype)
-                             if p.grad.dtype != jnp.float32 else g)
-        self._found_inf = found
+                             if p.grad.dtype not in (jnp.float32,)
+                             else g)
 
     def step(self, optimizer):
         if not self._enable:

@@ -1,0 +1,57 @@
+"""Jit-path NaN/Inf scanning.
+
+Reference: FLAGS_check_nan_inf (platform/flags.cc:44) scans every op
+output post-run (framework/details/nan_inf_utils_detail.cc). The eager
+dispatcher has that per-op scan (core/tensor.py); under jit the graph
+executes as one XLA program, so the TPU-native equivalent is a fused
+finite-check over a whole pytree (typically the gradient tree) with ONE
+device reduction, raising host-side with the offending leaf names —
+per-op checks inside jit would break fusion and serialize the step.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .flags import get_flags
+
+__all__ = ["tree_finite", "guard_tree"]
+
+
+def _leaves_with_names(tree):
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    names, leaves = [], []
+    for path, leaf in flat:
+        names.append(jax.tree_util.keystr(path))
+        leaves.append(leaf)
+    return names, leaves
+
+
+def tree_finite(tree):
+    """(all_finite scalar, per-leaf finite vector) — traceable."""
+    _, leaves = _leaves_with_names(tree)
+    flags = jnp.stack([jnp.isfinite(l).all()
+                       if jnp.issubdtype(jnp.asarray(l).dtype, jnp.floating)
+                       else jnp.asarray(True) for l in leaves])
+    return flags.all(), flags
+
+
+def guard_tree(tree, label="gradients"):
+    """Identity on `tree`; when FLAGS_check_nan_inf is set, attaches a
+    fused finite-check that raises FloatingPointError on the host with
+    the first offending leaf names. Safe inside jit."""
+    if not get_flags("check_nan_inf"):
+        return tree
+    names, _ = _leaves_with_names(tree)
+    _, flags = tree_finite(tree)
+
+    def report(mask):
+        import numpy as np
+        bad = [n for n, ok in zip(names, np.asarray(mask)) if not ok]
+        if bad:
+            raise FloatingPointError(
+                f"NaN/Inf detected in {label}: {bad[:10]}"
+                + (f" (+{len(bad) - 10} more)" if len(bad) > 10 else ""))
+
+    jax.debug.callback(report, flags)
+    return tree

@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ...core import nan_inf
 from ...core import random as random_mod
 from ...framework import MethodAdapter, functional_call, param_arrays, \
     state_arrays
@@ -68,6 +69,29 @@ class CompiledTrainStep:
         for k, v in {**self.params, **self.state}.items():
             if k in lookup:
                 lookup[k]._data = jax.device_get(v)
+
+    # -- sharded checkpoint (io/checkpoint.py) -----------------------------
+    def save_checkpoint(self, path, step=0, meta=None):
+        """Per-process shard files + PartitionSpec metadata; resumable on a
+        different mesh shape (io/checkpoint.py)."""
+        from ...io.checkpoint import save_checkpoint as _save
+        _save(path, self.params, self.opt_state, self.state, step=step,
+              meta=meta)
+
+    def restore_checkpoint(self, path):
+        """Restore params/opt state onto THIS program's shardings (the
+        saved mesh shape may differ — shards re-tile)."""
+        from ...io.checkpoint import load_checkpoint as _load
+        sh = {"params": self.shardings["params"],
+              "opt": self.shardings["opt"]}
+        params, opt, state, step, meta = _load(path, mesh=self.mesh,
+                                               shardings=sh)
+        self.params = params
+        if opt:     # a params-only checkpoint keeps the live slots
+            self.opt_state = opt
+        if state:
+            self.state = state
+        return step, meta
 
 
 def _tp_specs(layer, params, strategy) -> Dict[str, P]:
@@ -202,6 +226,7 @@ def compile_train_step(layer, optimizer, strategy: DistributedStrategy,
                 return out, new_st
             (loss, new_state), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(p)
+        grads = nan_inf.guard_tree(grads)   # FLAGS_check_nan_inf, jit path
         new_p, new_opt = optimizer.functional_update(p, grads, opt_st, lr=lr)
         return loss, new_p, new_state, new_opt
 
@@ -334,6 +359,7 @@ def _compile_pipeline_step(layer, optimizer, strategy, mesh):
             return sums.sum() / jnp.maximum(counts.sum(), 1.0)
 
         loss, grads = jax.value_and_grad(loss_of)(p)
+        grads = nan_inf.guard_tree(grads)   # FLAGS_check_nan_inf, jit path
         new_p, new_opt = optimizer.functional_update(p, grads, opt_st, lr=lr)
         return loss, new_p, st, new_opt
 

@@ -85,8 +85,11 @@ class Predictor:
         return [f"x{i}" for i in range(self._n_inputs)]
 
     def get_input_handle(self, name):
-        idx = int(name[1:]) if name.startswith("x") else 0
-        return _InputHandle(self, idx)
+        names = self.get_input_names()
+        if name not in names:
+            raise KeyError(f"unknown input {name!r}; exported inputs are "
+                           f"positional: {names}")
+        return _InputHandle(self, names.index(name))
 
     def run(self, inputs=None):
         if inputs is not None:
@@ -103,8 +106,11 @@ class Predictor:
         return [f"out{i}" for i in range(max(len(self._outputs), 1))]
 
     def get_output_handle(self, name):
-        idx = int(name[3:]) if name.startswith("out") else 0
-        return _OutputHandle(self, idx)
+        names = self.get_output_names()
+        if name not in names:
+            raise KeyError(f"unknown output {name!r}; exported outputs are "
+                           f"positional: {names}")
+        return _OutputHandle(self, names.index(name))
 
 
 def create_predictor(config: Config) -> Predictor:

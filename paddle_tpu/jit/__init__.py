@@ -37,14 +37,25 @@ __all__ = ["to_static", "save", "load", "TranslatedLayer", "not_to_static"]
 
 
 def _spec_to_aval(spec, sym_ctx):
-    """InputSpec -> ShapeDtypeStruct; None dims become export symbols."""
+    """InputSpec -> ShapeDtypeStruct with export symbols for dynamic dims.
+
+    Sharing rules (multi-input models need equal dynamic dims to share ONE
+    symbol or tracing fails on shape mismatch): a None LEADING dim is the
+    shared 'batch' symbol across all inputs; a string dim (e.g.
+    shape=[None, "seqlen"]) shares the symbol of that name; None elsewhere
+    gets a fresh independent symbol."""
+    def sym(name):
+        if name not in sym_ctx:
+            sym_ctx[name] = jax_export.symbolic_shape(
+                name, scope=sym_ctx["scope"])[0]
+        return sym_ctx[name]
+
     dims = []
     for i, d in enumerate(spec.shape):
-        if d is None or (isinstance(d, int) and d < 0):
-            name = f"d{len(sym_ctx)}"
-            sym = jax_export.symbolic_shape(name, scope=sym_ctx["scope"])[0]
-            sym_ctx[name] = sym
-            dims.append(sym)
+        if isinstance(d, str):
+            dims.append(sym(d))
+        elif d is None or (isinstance(d, int) and d < 0):
+            dims.append(sym("batch" if i == 0 else f"d{i}_{id(spec)}"))
         else:
             dims.append(int(d))
     return jax.ShapeDtypeStruct(tuple(dims), spec.dtype)
@@ -130,18 +141,26 @@ def save(layer, path, input_spec=None):
     if spec is None:
         raise ValueError("jit.save needs input_spec=[InputSpec(...), ...] "
                          "to trace the exported program")
+    is_layer = hasattr(target, "named_parameters")
     was_training = bool(getattr(target, "training", False))
     if hasattr(target, "eval"):
         target.eval()            # export inference behavior (no dropout)
     try:
-        params = param_arrays(target)
-        state = state_arrays(target)
-        merged = {**params, **state}
+        if is_layer:
+            params = param_arrays(target)
+            state = state_arrays(target)
+            merged = {**params, **state}
 
-        def fwd(pp, *inputs):
-            out, _ = functional_call(target, pp, {}, *inputs,
-                                     mutable_state=False)
-            return out
+            def fwd(pp, *inputs):
+                out, _ = functional_call(target, pp, {}, *inputs,
+                                         mutable_state=False)
+                return out
+        else:
+            merged = {}          # plain function: no parameters to bundle
+
+            def fwd(pp, *inputs):
+                del pp
+                return target(*inputs)
 
         sym_ctx = {"scope": jax_export.SymbolicScope()}
         in_avals = tuple(

@@ -8,8 +8,6 @@ aliases onto the jit path.
 """
 from __future__ import annotations
 
-import numpy as np
-
 from ..core import dtype as dtype_mod
 
 __all__ = ["InputSpec"]

@@ -4,6 +4,9 @@ test_metrics.py)."""
 import numpy as np
 import pytest
 
+import jax
+import jax.numpy as jnp
+
 import paddle_tpu as paddle
 import paddle_tpu.amp as amp
 import paddle_tpu.io as io
@@ -168,3 +171,54 @@ def test_save_load_state_dict(tmp_path):
     lin2.set_state_dict(loaded)
     x = paddle.ones([1, 3])
     np.testing.assert_allclose(lin(x).numpy(), lin2(x).numpy(), atol=1e-6)
+
+
+def test_fused_unscale_single_sync():
+    """GradScaler.unscale_ is one fused kernel + one host sync (reference
+    check_finite_and_unscale_op), and flags inf correctly."""
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as popt
+    from paddle_tpu.amp import GradScaler
+
+    paddle.seed(0)
+    lin = nn.Linear(4, 4)
+    sgd = popt.SGD(learning_rate=0.1, parameters=list(lin.parameters()))
+    scaler = GradScaler(init_loss_scaling=4.0)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    loss = scaler.scale(lin(x).mean())
+    loss.backward()
+    scaler.unscale_(sgd)
+    assert scaler._found_inf is False
+    # grads were divided by the scale
+    g = lin.weight.grad.numpy()
+    assert np.all(np.isfinite(g))
+
+    # poison one grad -> found_inf with the same single-sync path
+    lin.weight.grad.set_value(
+        jnp.full(lin.weight.shape, jnp.inf, jnp.float32))
+    scaler._unscaled.clear()
+    scaler.unscale_(sgd)
+    assert scaler._found_inf is True
+
+
+def test_jit_nan_guard_raises():
+    """FLAGS_check_nan_inf covers the jit path via a fused tree check."""
+    from paddle_tpu.core import nan_inf
+
+    paddle.set_flags({"check_nan_inf": True})
+    try:
+        @jax.jit
+        def step(g):
+            g = nan_inf.guard_tree(g, "gradients")
+            return jax.tree_util.tree_map(lambda a: a * 2, g)
+
+        good = {"w": jnp.ones((2, 2)), "b": jnp.zeros((2,))}
+        out = step(good)
+        jax.block_until_ready(jax.tree_util.tree_leaves(out))
+
+        bad = {"w": jnp.full((2, 2), jnp.nan), "b": jnp.zeros((2,))}
+        with pytest.raises(Exception, match="NaN/Inf"):
+            out = step(bad)
+            jax.block_until_ready(jax.tree_util.tree_leaves(out))
+    finally:
+        paddle.set_flags({"check_nan_inf": False})

@@ -1,0 +1,121 @@
+"""Sharded checkpointing (io/checkpoint.py): per-shard files + spec
+metadata, restore across mesh shapes (reference capability:
+fluid/io.py:239-995 save/load_persistables, but shard-aware)."""
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.optimizer as opt
+from paddle_tpu.io.checkpoint import (load_checkpoint, load_sharded,
+                                      save_checkpoint, save_sharded)
+
+
+def _mesh(n, axis="dp"):
+    return Mesh(np.array(jax.devices()[:n]).reshape(n), (axis,))
+
+
+def test_roundtrip_sharded_and_replicated(tmp_path):
+    mesh = _mesh(4)
+    x = jnp.arange(32, dtype=jnp.float32).reshape(8, 4)
+    xs = jax.device_put(x, NamedSharding(mesh, P("dp", None)))
+    y = jnp.ones((3, 3))          # host-local, unsharded
+    scalar = jnp.float32(7.0)
+    path = str(tmp_path / "ck")
+    save_sharded(path, {"x": xs, "nested": {"y": y, "s": scalar}}, step=5,
+                 meta={"k": "v"})
+    files = os.listdir(path)
+    # 4 dp shards of x + full y + full s + meta
+    assert sum(f.startswith("x__") for f in files) == 4
+    assert "meta.json" in files
+
+    tree, step, meta = load_sharded(path)
+    assert step == 5 and meta == {"k": "v"}
+    np.testing.assert_array_equal(np.asarray(tree["x"]), np.asarray(x))
+    np.testing.assert_array_equal(np.asarray(tree["nested"]["y"]),
+                                  np.asarray(y))
+    assert float(tree["nested"]["s"]) == 7.0
+
+
+def test_restore_onto_different_mesh(tmp_path):
+    mesh4 = _mesh(4)
+    x = jnp.arange(64, dtype=jnp.float32).reshape(8, 8)
+    xs = jax.device_put(x, NamedSharding(mesh4, P("dp", None)))
+    path = str(tmp_path / "ck")
+    save_sharded(path, {"x": xs})
+
+    mesh2 = _mesh(2)
+    tree, _, _ = load_sharded(path, mesh=mesh2)
+    out = tree["x"]
+    assert out.sharding.spec == P("dp", None)
+    assert len(out.sharding.mesh.devices.ravel()) == 2
+    np.testing.assert_array_equal(np.asarray(jax.device_get(out)),
+                                  np.asarray(x))
+
+    # mesh without the saved axis name -> replicated
+    mesh_other = _mesh(2, axis="tp")
+    tree2, _, _ = load_sharded(path, mesh=mesh_other)
+    assert tree2["x"].sharding.spec == P(None, None)
+
+
+def test_zero2_resume_across_dp_sizes(tmp_path):
+    """VERDICT r1 #5 'done' bar: ZeRO-2 train -> checkpoint -> resume on a
+    different dp size; loss curve continues exactly."""
+    from paddle_tpu.distributed.fleet.compiler import compile_train_step
+    from paddle_tpu.distributed.fleet.strategy import DistributedStrategy
+    from paddle_tpu.models import GPT, gpt_tiny
+
+    def make_prog(dp):
+        paddle.seed(0)
+        m = GPT(gpt_tiny())
+        s = DistributedStrategy()
+        s.sharding = True
+        s.sharding_configs.stage = 2
+        s.hybrid_configs.dp_degree = dp
+        mesh = s.build_mesh(devices=jax.devices()[:dp])
+        adam = opt.Adam(learning_rate=1e-3,
+                        parameters=list(m.parameters()))
+        return compile_train_step(m, adam, s, mesh=mesh)
+
+    rng = np.random.default_rng(0)
+    batches = [(rng.integers(0, 512, (8, 32)).astype(np.int64),
+                rng.integers(0, 512, (8, 32)).astype(np.int64))
+               for _ in range(4)]
+
+    progA = make_prog(4)
+    lossesA = [float(jax.device_get(progA.step(x, y, lr=1e-3)))
+               for x, y in batches]
+
+    progB = make_prog(4)
+    for x, y in batches[:2]:
+        progB.step(x, y, lr=1e-3)
+    ckpt = str(tmp_path / "zero2")
+    progB.save_checkpoint(ckpt, step=2, meta={"note": "zero2"})
+
+    progC = make_prog(2)
+    step, meta = progC.restore_checkpoint(ckpt)
+    assert step == 2 and meta["note"] == "zero2"
+    lossesC = [float(jax.device_get(progC.step(x, y, lr=1e-3)))
+               for x, y in batches[2:]]
+    np.testing.assert_allclose(lossesA[2:], lossesC, atol=3e-4)
+    # ZeRO slot sharding survives the restore
+    k = [k for k in progC.opt_state if "fc1.weight" in k][0]
+    assert progC.opt_state[k]["moment1"].sharding.spec == P("dp", None)
+
+
+def test_save_load_checkpoint_wrappers(tmp_path):
+    params = {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,))}
+    opt_state = {"w": {"m": jnp.full((4, 4), 0.5)},
+                 "b": {"m": jnp.full((4,), 0.25)}}
+    path = str(tmp_path / "ck")
+    save_checkpoint(path, params, opt_state, step=9)
+    p, o, st, step, meta = load_checkpoint(path)
+    assert step == 9 and st == {}
+    np.testing.assert_array_equal(np.asarray(p["w"]), 1.0)
+    np.testing.assert_array_equal(np.asarray(o["b"]["m"]), 0.25)

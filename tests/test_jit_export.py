@@ -180,3 +180,48 @@ def test_jit_save_restores_train_mode(tmp_path):
     paddle.jit.save(net, str(tmp_path / "n"),
                     input_spec=[InputSpec([None, 8], "float32")])
     assert net.training
+
+
+def test_shared_batch_symbol_multi_input(tmp_path):
+    """Multiple inputs with a None leading dim share one 'batch' symbol."""
+    class TwoIn(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(8, 4)
+
+        def forward(self, a, b):
+            return self.fc(a + b)
+
+    paddle.seed(8)
+    net = TwoIn()
+    a = np.random.default_rng(8).normal(size=(3, 8)).astype(np.float32)
+    prefix = str(tmp_path / "two")
+    paddle.jit.save(net, prefix, input_spec=[
+        InputSpec([None, 8], "float32"), InputSpec([None, 8], "float32")])
+    loaded = paddle.jit.load(prefix)
+    ref = net(paddle.to_tensor(a), paddle.to_tensor(a)).numpy()
+    np.testing.assert_allclose(loaded(a, a).numpy(), ref, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_jit_save_plain_function(tmp_path):
+    def f(x):
+        return x * 2.0 + 1.0
+
+    sf = paddle.jit.to_static(f, input_spec=[InputSpec([None, 4], "float32")])
+    prefix = str(tmp_path / "fn")
+    paddle.jit.save(sf, prefix)
+    loaded = paddle.jit.load(prefix)
+    x = np.ones((2, 4), np.float32)
+    np.testing.assert_allclose(loaded(x).numpy(), 3.0)
+
+
+def test_predictor_rejects_unknown_names(tmp_path):
+    paddle.seed(9)
+    net = SmallNet()
+    prefix = str(tmp_path / "net")
+    paddle.jit.save(net, prefix, input_spec=[InputSpec([None, 8], "float32")])
+    from paddle_tpu.inference import Config, create_predictor
+    pred = create_predictor(Config(prefix))
+    with pytest.raises(KeyError):
+        pred.get_input_handle("input_ids")
