@@ -110,6 +110,29 @@ def main():
     tokens_per_sec = B * T / step_time
     mfu = tokens_per_sec * model.flops_per_token(T) / peak_flops()
 
+    if "--breakdown" in sys.argv:
+        # step-time decomposition (stderr; stdout stays one JSON line);
+        # timing methodology lives in utils/op_bench.bench_fn
+        from paddle_tpu.utils.op_bench import bench_fn
+
+        labels = jnp.concatenate([ids[:, 1:], ids[:, :1]], axis=1)
+
+        def loss_of(pp):
+            with paddle.amp.auto_cast(level="O2", dtype="bfloat16"):
+                out, _ = functional_call(wrapped, pp, {}, ids, labels)
+            return out
+
+        t_fwd = bench_fn(loss_of, params)["ms"]
+        t_fb = bench_fn(lambda p: jax.value_and_grad(loss_of)(p),
+                        params)["ms"]
+        t_opt = bench_fn(lambda p, s: adam.functional_update(
+            p, p, s, lr=1e-4), params, opt_state)["ms"]
+        step_ms = step_time * 1e3
+        print(f"breakdown: step={step_ms:.2f}ms fwd={t_fwd:.2f}ms "
+              f"bwd={t_fb - t_fwd:.2f}ms optimizer={t_opt:.2f}ms "
+              f"overlap/other={step_ms - t_fb - t_opt:.2f}ms",
+              file=sys.stderr)
+
     print(json.dumps({
         "metric": "gpt2_124m_train_tokens_per_sec" if not on_cpu
                   else "gpt_tiny_cpu_smoke_tokens_per_sec",

@@ -39,7 +39,11 @@ def tree_finite(tree):
 def guard_tree(tree, label="gradients"):
     """Identity on `tree`; when FLAGS_check_nan_inf is set, attaches a
     fused finite-check that raises FloatingPointError on the host with
-    the first offending leaf names. Safe inside jit."""
+    the first offending leaf names. Safe inside jit.
+
+    The flag is read at TRACE time: set it before the first call of a
+    jitted step (compiled programs bake the decision in — toggling later
+    requires recompilation, unlike the per-op eager check)."""
     if not get_flags("check_nan_inf"):
         return tree
     names, _ = _leaves_with_names(tree)

@@ -383,6 +383,7 @@ def _differentiable(t: Tensor) -> bool:
 # Op dispatch: the Tracer::TraceOp analog.
 # ---------------------------------------------------------------------------
 _amp_hook = [None]  # paddle_tpu.amp installs maybe_cast_inputs here
+_profiler_hook = [None]  # paddle_tpu.profiler installs its per-op hook
 
 
 def apply(fn, *args, op_name: str = None, n_outputs: int = None, **kwargs):
@@ -393,6 +394,15 @@ def apply(fn, *args, op_name: str = None, n_outputs: int = None, **kwargs):
     by their jax.Array payloads. Differentiation is w.r.t. inexact-dtype
     Tensor args with stop_gradient=False.
     """
+    if _profiler_hook[0] is not None:  # per-op RecordEvent while profiling
+        rec = _profiler_hook[0](op_name or getattr(fn, "__name__", "op"))
+        if rec is not None:
+            with rec:
+                return _apply_inner(fn, args, op_name, kwargs)
+    return _apply_inner(fn, args, op_name, kwargs)
+
+
+def _apply_inner(fn, args, op_name, kwargs):
     raw = [a._data if isinstance(a, Tensor) else a for a in args]
     if _amp_hook[0] is not None:  # autocast (set by paddle_tpu.amp on import)
         raw = _amp_hook[0](op_name or getattr(fn, "__name__", "op"), raw)

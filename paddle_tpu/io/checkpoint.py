@@ -78,7 +78,9 @@ def _spec_from_json(spec_json, ndim):
 
 def save_sharded(path, tree, step=0, meta=None):
     """Write a (nested) dict of jax arrays; each process stores only its
-    addressable, replica-0 shards."""
+    addressable, replica-0 shards and ITS OWN shard index
+    (`index.{pid}.json`) — indices merge at load, so no process needs to
+    know about shards it cannot address (multi-host safe)."""
     flat = _flatten(tree)
     os.makedirs(path, exist_ok=True)
     pid = jax.process_index()
@@ -116,10 +118,12 @@ def save_sharded(path, tree, step=0, meta=None):
                                         "stop": list(stops)})
         index[name] = entry
 
+    with open(os.path.join(path, f"index.{pid}.json"), "w") as f:
+        json.dump(index, f, indent=1)
     if pid == 0:
         with open(os.path.join(path, "meta.json"), "w") as f:
             json.dump({"step": int(step), "meta": meta or {},
-                       "arrays": index}, f, indent=1)
+                       "n_processes": jax.process_count()}, f, indent=1)
 
 
 def _read_slice(path, entry, starts, stops, dtype):
@@ -149,10 +153,24 @@ def load_sharded(path, mesh: Mesh = None, shardings=None):
     Returns (tree, step, meta)."""
     with open(os.path.join(path, "meta.json")) as f:
         header = json.load(f)
+    # merge every process's shard index (multi-host: each wrote its own)
+    arrays = {}
+    import glob as _glob
+    for idx_file in sorted(_glob.glob(os.path.join(path, "index.*.json"))):
+        with open(idx_file) as f:
+            for name, entry in json.load(f).items():
+                if name not in arrays:
+                    arrays[name] = entry
+                else:
+                    known = {tuple(s["start"])
+                             for s in arrays[name]["shards"]}
+                    arrays[name]["shards"].extend(
+                        s for s in entry["shards"]
+                        if tuple(s["start"]) not in known)
     shardings = shardings or {}
 
     flat = {}
-    for name, entry in header["arrays"].items():
+    for name, entry in arrays.items():
         shape = tuple(entry["shape"])
         dtype = np.dtype(entry["dtype"])
         target = shardings.get(name)

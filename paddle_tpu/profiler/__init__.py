@@ -1,0 +1,161 @@
+"""Profiler: host event annotation + aggregated tables + device tracing.
+
+Reference: RAII RecordEvent pushed at every op (platform/profiler.h:127,
+tracer.cc:136), EnableProfiler/DisableProfiler building aggregated tables
+and a chrome trace (profiler.h:210, platform/profiler.proto), CUPTI
+DeviceTracer correlating kernel timestamps (device_tracer.h:43), python
+surface fluid/profiler.py.
+
+TPU-native mapping: device-side timing belongs to XLA/libtpu — jax
+profiler traces (XPlane) already carry per-fusion device timelines, so
+`start_trace/stop_trace` delegate there (view in TensorBoard/xprof).
+Host-side RecordEvent keeps the reference's annotation API: it feeds BOTH
+the in-process aggregation table (summary() below) and
+jax.profiler.TraceAnnotation so host spans land on the XPlane timeline
+next to the device rows. Per-op auto-annotation hooks into the eager
+dispatcher when the profiler is on.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Dict, List, Optional
+
+import jax
+
+__all__ = ["RecordEvent", "start_profiler", "stop_profiler", "profiler",
+           "start_trace", "stop_trace", "is_profiling", "summary"]
+
+_lock = threading.Lock()
+_events: List[tuple] = []      # (name, start, dur, thread_id)
+_enabled = False
+
+
+def is_profiling() -> bool:
+    return _enabled
+
+
+class RecordEvent:
+    """RAII/contextmanager/decorator annotation (profiler.h:127 analog).
+
+        with profiler.RecordEvent("data_load"):
+            ...
+    Active even when only jax tracing is on (TraceAnnotation); the table
+    row is recorded only while the host profiler is enabled."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._ann = None
+        self._t0 = None
+
+    def __enter__(self):
+        self._ann = jax.profiler.TraceAnnotation(self.name)
+        self._ann.__enter__()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dur = time.perf_counter() - self._t0
+        self._ann.__exit__(*exc)
+        if _enabled:
+            with _lock:
+                _events.append((self.name, self._t0, dur,
+                                threading.get_ident()))
+        return False
+
+    def __call__(self, fn):
+        def wrapped(*a, **k):
+            with RecordEvent(self.name):
+                return fn(*a, **k)
+        return wrapped
+
+
+def _op_hook(op_name):
+    """Eager-dispatcher hook: annotate each op while profiling."""
+    return RecordEvent(f"op::{op_name}") if _enabled else None
+
+
+from ..core import tensor as _tensor_mod
+
+_tensor_mod._profiler_hook[0] = _op_hook
+
+
+def start_profiler(state: str = "All", tracer_option: str = "Default"):
+    """fluid/profiler.py surface; `state`/`tracer_option` kept for parity
+    (host events always; device events come from start_trace/XPlane)."""
+    global _enabled
+    with _lock:
+        _events.clear()
+    _enabled = True
+
+
+def stop_profiler(sorted_key: str = "total", profile_path: Optional[str] = None,
+                  print_table: bool = True):
+    global _enabled
+    _enabled = False
+    table = summary(sorted_key)
+    if profile_path:
+        with open(profile_path, "w") as f:
+            f.write(table)
+    if print_table:
+        print(table)
+    return table
+
+
+def summary(sorted_key: str = "total") -> str:
+    """Aggregated event table (EnableProfiler table analog)."""
+    with _lock:
+        events = list(_events)
+    agg: Dict[str, List[float]] = {}
+    for name, _, dur, _ in events:
+        agg.setdefault(name, []).append(dur)
+    keyfn = {"total": lambda kv: -sum(kv[1]),
+             "max": lambda kv: -max(kv[1]),
+             "min": lambda kv: -min(kv[1]),
+             "calls": lambda kv: -len(kv[1])}.get(
+        sorted_key, lambda kv: -sum(kv[1]))
+    rows = sorted(agg.items(), key=keyfn)
+    total_all = sum(sum(v) for v in agg.values()) or 1e-12
+    lines = [f"{'Event':<40s} {'Calls':>7s} {'Total(ms)':>10s} "
+             f"{'Avg(ms)':>9s} {'Min(ms)':>9s} {'Max(ms)':>9s} {'Ratio':>7s}"]
+    for name, durs in rows:
+        t = sum(durs)
+        lines.append(
+            f"{name[:40]:<40s} {len(durs):>7d} {t * 1e3:>10.3f} "
+            f"{t / len(durs) * 1e3:>9.3f} {min(durs) * 1e3:>9.3f} "
+            f"{max(durs) * 1e3:>9.3f} {t / total_all:>6.1%}")
+    return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def profiler(state: str = "All", sorted_key: str = "total",
+             profile_path: Optional[str] = None):
+    """`with profiler.profiler(...):` — fluid/profiler.py parity."""
+    start_profiler(state)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+# ---------------------------------------------------------------------------
+# device tracing (XPlane; view with TensorBoard profile plugin / xprof)
+# ---------------------------------------------------------------------------
+
+def start_trace(log_dir: str):
+    """DeviceTracer analog: libtpu/XLA device timelines via jax.profiler."""
+    jax.profiler.start_trace(log_dir)
+
+
+def stop_trace():
+    jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    start_trace(log_dir)
+    try:
+        yield
+    finally:
+        stop_trace()

@@ -1,0 +1,90 @@
+"""ICI/DCN collective bandwidth probe (BASELINE.md: the Fleet allreduce-BW
+analog; reference tooling lived in benchmark scripts over
+operators/collective/).
+
+Sweeps buffer sizes through psum/all_gather/reduce_scatter under
+shard_map over the full device mesh and reports algorithmic bus bandwidth
+busBW = 2*(n-1)/n * bytes / t for allreduce (NCCL-tests convention; the
+same formula the reference's fleet benchmarks quote), so numbers compare
+directly against NCCL baselines. On a single chip this measures loopback
+(no ICI); its purpose is the multi-chip pod where XLA emits ICI ring
+collectives.
+
+CLI: python -m paddle_tpu.utils.collective_bench [--sizes MB,MB,...]
+"""
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["bench_collectives"]
+
+
+def _time_op(fn, x, n_short=2, n_long=8):
+    jax.block_until_ready(fn(x))
+
+    def run(n):
+        t0 = time.perf_counter()
+        o = None
+        for _ in range(n):
+            o = fn(x)
+        jax.block_until_ready(o)
+        return time.perf_counter() - t0
+
+    d1, d2 = run(n_short), run(n_long)
+    delta = (d2 - d1) / (n_long - n_short)
+    return delta if delta > 0 else run(n_long) / n_long
+
+
+def bench_collectives(sizes_mb=(1, 4, 16, 64), devices=None):
+    """`size` follows the NCCL-tests convention: per-rank buffer bytes.
+    Input is [n, per_rank] with row i on device i (distinct buffers)."""
+    devices = devices or jax.devices()
+    n = len(devices)
+    mesh = Mesh(np.array(devices), ("x",))
+    rows = []
+    for mb in sizes_mb:
+        per = max(int(mb * 1e6 / 4), n)
+        per = ((per + n - 1) // n) * n   # psum_scatter needs per % n == 0
+        size_bytes = per * 4
+        x = jnp.broadcast_to(
+            jnp.arange(n, dtype=jnp.float32)[:, None], (n, per))
+
+        ar = jax.jit(jax.shard_map(
+            lambda a: jax.lax.psum(a, "x"), mesh=mesh,
+            in_specs=P("x", None), out_specs=P(None, None),
+            check_vma=False))
+        bus_ar = 2 * (n - 1) / n * size_bytes / _time_op(ar, x) / 1e9
+
+        ag = jax.jit(jax.shard_map(
+            lambda a: jax.lax.all_gather(a, "x", axis=0, tiled=True),
+            mesh=mesh, in_specs=P("x", None), out_specs=P(None, None),
+            check_vma=False))
+        bus_ag = (n - 1) / n * size_bytes / _time_op(ag, x) / 1e9
+
+        rs = jax.jit(jax.shard_map(
+            lambda a: jax.lax.psum_scatter(a, "x", scatter_dimension=1,
+                                           tiled=True),
+            mesh=mesh, in_specs=P("x", None), out_specs=P("x", None),
+            check_vma=False))
+        bus_rs = (n - 1) / n * size_bytes / _time_op(rs, x) / 1e9
+
+        rows.append({"MB": mb, "allreduce_GBps": bus_ar,
+                     "allgather_GBps": bus_ag, "reducescatter_GBps": bus_rs})
+        print(f"{mb:6.1f} MB  allreduce {bus_ar:8.2f} GB/s  "
+              f"allgather {bus_ag:8.2f} GB/s  "
+              f"reduce_scatter {bus_rs:8.2f} GB/s   (n={n})")
+    return rows
+
+
+if __name__ == "__main__":
+    import sys
+    sizes = (1, 4, 16, 64)
+    for a in sys.argv[1:]:
+        if a.startswith("--sizes"):
+            sizes = tuple(float(s) for s in a.split("=")[1].split(","))
+    bench_collectives(sizes)

@@ -1,0 +1,117 @@
+"""Per-op microbenchmark harness (op_tester analog —
+/root/reference/paddle/fluid/operators/benchmark/op_tester.cc:1).
+
+Tunnel-aware timing: through remote TPU attachments a device->host fetch
+costs a large constant RTT, so wall-clocking one call measures the network.
+`bench_fn` chains n dependent calls inside each timed window and reports
+the MARGINAL time ((t_long - t_short) / (n_long - n_short)), which cancels
+the fetch constant; outputs are reduced to scalars on-device.
+
+CLI:  python -m paddle_tpu.utils.op_bench [op ...]   (default: hot set)
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["bench_fn", "bench_suite", "HOT_OPS"]
+
+
+def bench_fn(fn: Callable, *args, n_short=4, n_long=16, repeats=2,
+             flops=0) -> Dict[str, float]:
+    """fn(*args) -> scalar-reducible pytree. Returns marginal ms/call."""
+    def scal(t):
+        return sum(jnp.sum(l).astype(jnp.float32)
+                   for l in jax.tree_util.tree_leaves(t)) * jnp.float32(1e-12)
+
+    jfn = jax.jit(lambda *a: scal(fn(*a)))
+    out = jfn(*args)
+    _ = float(out)          # compile + first fetch
+
+    def run(n):
+        t0 = time.perf_counter()
+        o = None
+        for _ in range(n):
+            o = jfn(*args)
+        _ = float(o)
+        return time.perf_counter() - t0
+
+    best = float("inf")
+    for _ in range(repeats):
+        d1, d2 = run(n_short), run(n_long)
+        delta = (d2 - d1) / (n_long - n_short)
+        if delta > 0:
+            best = min(best, delta)
+    if best == float("inf"):
+        best = run(n_long) / n_long
+    res = {"ms": best * 1e3}
+    if flops:
+        res["tflops"] = flops / best / 1e12
+    return res
+
+
+def _mk(shape, dtype=jnp.bfloat16, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.normal(size=shape) * 0.1, dtype)
+
+
+def _adam_update(p, g, m, v):
+    m2 = 0.9 * m + 0.1 * g
+    v2 = 0.999 * v + 0.001 * g * g
+    return p - 1e-3 * m2 / (jnp.sqrt(v2) + 1e-8), m2, v2
+
+
+def HOT_OPS():
+    """BASELINE.json north-star op set: matmul, conv, layer_norm, softmax,
+    fused attention, adam."""
+    from ..ops.pallas.flash_attention import flash_attention
+    B, T, H, D = 8, 1024, 12, 64
+    x = _mk((8192, 768))
+    w = _mk((768, 3072))
+    img = _mk((32, 224, 224, 3), jnp.bfloat16)
+    kern = _mk((7, 7, 3, 64))
+    h = _mk((8192, 768), jnp.float32)
+    q = _mk((B, T, H, D))
+    p32 = _mk((8192, 768), jnp.float32)
+    return {
+        "matmul_8192x768x3072": (lambda: (
+            lambda a, b: a @ b, (x, w),
+            {"flops": 2 * 8192 * 768 * 3072})),
+        "conv2d_7x7_s2": (lambda: (
+            lambda i, k: jax.lax.conv_general_dilated(
+                i, k, (2, 2), "SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC")), (img, kern),
+            {"flops": 2 * 32 * 112 * 112 * 64 * 7 * 7 * 3})),
+        "layer_norm_8192x768": (lambda: (
+            lambda a: (a - a.mean(-1, keepdims=True))
+            / jnp.sqrt(a.var(-1, keepdims=True) + 1e-5), (h,), {})),
+        "softmax_8192x768": (lambda: (
+            lambda a: jax.nn.softmax(a, axis=-1), (h,), {})),
+        "flash_attention_8x1024x12x64": (lambda: (
+            lambda a: flash_attention(a, a, a, causal=True), (q,),
+            {"flops": 4 * B * H * T * T * D})),
+        "adam_update_8192x768": (lambda: (
+            _adam_update, (p32, p32, p32, p32), {})),
+    }
+
+
+def bench_suite(names=None):
+    ops = HOT_OPS()
+    names = names or list(ops)
+    rows = []
+    for name in names:
+        fn, args, extra = ops[name]()
+        r = bench_fn(fn, *args, **extra)
+        rows.append((name, r))
+        tfl = f"  {r['tflops']:7.1f} TF/s" if "tflops" in r else ""
+        print(f"{name:36s} {r['ms']:9.3f} ms{tfl}")
+    return rows
+
+
+if __name__ == "__main__":
+    import sys
+    bench_suite(sys.argv[1:] or None)

@@ -1,0 +1,56 @@
+"""Profiler (RecordEvent/tables, reference platform/profiler.h) and the
+measurement harness (op_tester + collective-BW analogs)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import profiler
+
+
+def test_record_event_and_summary():
+    profiler.start_profiler()
+    with profiler.RecordEvent("outer"):
+        with profiler.RecordEvent("inner"):
+            _ = jnp.ones((4, 4)) @ jnp.ones((4, 4))
+    table = profiler.stop_profiler(print_table=False)
+    assert "outer" in table and "inner" in table
+    assert "Calls" in table
+
+
+def test_profiler_context_and_op_hook(capsys):
+    import paddle_tpu.nn.functional as F
+    x = paddle.to_tensor(np.ones((4, 8), np.float32))
+    with profiler.profiler(sorted_key="calls"):
+        _ = F.relu(x)
+        _ = F.relu(x)
+    out = capsys.readouterr().out
+    assert "op::relu" in out           # eager dispatcher auto-annotation
+
+
+def test_record_event_as_decorator():
+    profiler.start_profiler()
+
+    @profiler.RecordEvent("fn_scope")
+    def f(a):
+        return a + 1
+
+    f(jnp.ones(3))
+    table = profiler.stop_profiler(print_table=False)
+    assert "fn_scope" in table
+
+
+def test_op_bench_marginal():
+    from paddle_tpu.utils.op_bench import bench_fn
+    r = bench_fn(lambda a, b: a @ b, jnp.ones((64, 64)), jnp.ones((64, 64)),
+                 n_short=1, n_long=3, repeats=1, flops=2 * 64 ** 3)
+    assert r["ms"] > 0 and "tflops" in r
+
+
+def test_collective_bench_runs():
+    from paddle_tpu.utils.collective_bench import bench_collectives
+    rows = bench_collectives(sizes_mb=(0.25,), devices=jax.devices()[:4])
+    assert rows and rows[0]["allreduce_GBps"] > 0
+    assert rows[0]["reducescatter_GBps"] > 0
