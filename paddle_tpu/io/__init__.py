@@ -1,5 +1,6 @@
 """paddle.io parity surface."""
-from .dataloader import DataLoader, default_collate_fn, get_worker_info  # noqa: F401
+from .dataloader import DataLoader, default_collate_fn, get_worker_info, \
+    device_prefetch  # noqa: F401
 from .dataset import (ChainDataset, ComposeDataset, ConcatDataset, Dataset,
                       IterableDataset, Subset, TensorDataset,
                       random_split)  # noqa: F401

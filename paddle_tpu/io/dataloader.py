@@ -5,9 +5,15 @@ shared-memory LoDTensors via mmap_allocator.cc).
 TPU-native design: workers produce *numpy host batches*; the device transfer
 happens once per batch (jax.device_put, or sharded put in the fit loop) —
 there is no per-tensor CUDA pinned-memory dance because PJRT owns staging.
-Multi-process mode uses the native shared-memory ring queue
-(native/shm_queue.cpp) when built, else multiprocessing.queues; worker death
-is detected via sentinels + process liveness polling (the SIGCHLD +
+
+Multi-process mode uses the SPAWN start method (fork under JAX's
+multithreaded runtime risks deadlock — the reference forks because its C++
+runtime is fork-aware; ours is not) and a shared-memory batch transport:
+each collated batch's arrays are packed into ONE posix shm segment
+(multiprocessing.shared_memory = the mmap_allocator.cc capability; the
+packing itself is memcpy-bound so numpy already runs it at memory speed)
+and only (shapes, dtypes, offsets, shm name) travel through the queue.
+Worker death is detected via sentinels + liveness polling (SIGCHLD +
 CleanupFuncRegistrar analog in fluid/multiprocess_utils.py).
 """
 from __future__ import annotations
@@ -18,15 +24,23 @@ import multiprocessing as mp
 import queue
 import threading
 import traceback
+from multiprocessing import shared_memory as shm_mod
 from typing import Callable, Optional
 
 import numpy as np
 
+from ..core.flags import define_flag, get_flags
 from ..core.tensor import Tensor
 from .dataset import Dataset, IterableDataset
 from .sampler import BatchSampler, SequenceSampler
 
-__all__ = ["DataLoader", "default_collate_fn", "get_worker_info"]
+__all__ = ["DataLoader", "default_collate_fn", "get_worker_info",
+           "device_prefetch"]
+
+define_flag("dataloader_start_method", "spawn",
+            "multiprocessing start method for DataLoader workers; spawn "
+            "avoids the fork-under-threads deadlock the JAX runtime "
+            "documents, fork trades safety for startup latency.")
 
 _worker_info = threading.local()
 
@@ -152,10 +166,113 @@ class DataLoader:
         return gen()
 
 
+# ---------------------------------------------------------------------------
+# shared-memory batch transport (mmap_allocator.cc capability)
+# ---------------------------------------------------------------------------
+
+class _ShmBatch:
+    """Marker travelling through the queue: arrays live in one shm segment,
+    only layout metadata is pickled."""
+
+    def __init__(self, shm_name, layout):
+        self.shm_name = shm_name
+        self.layout = layout       # pickled tree with _ArrRef leaves
+
+
+class _ArrRef:
+    def __init__(self, offset, shape, dtype):
+        self.offset = offset
+        self.shape = shape
+        self.dtype = dtype
+
+
+def _tree_arrays(obj):
+    if isinstance(obj, np.ndarray):
+        yield obj
+    elif isinstance(obj, (list, tuple)):
+        for v in obj:
+            yield from _tree_arrays(v)
+    elif isinstance(obj, dict):
+        for v in obj.values():
+            yield from _tree_arrays(v)
+
+
+def _pack_batch(data):
+    """Collated tree -> (_ShmBatch, shm segment). The CONSUMER unlinks the
+    segment; the producer unregisters it from its resource_tracker so the
+    worker's exit cleanup does not double-unlink."""
+    arrays = [a for a in _tree_arrays(data) if not a.dtype.hasobject]
+    total = sum(int(a.nbytes) for a in arrays)
+    if total == 0:
+        return data, None
+    seg = shm_mod.SharedMemory(create=True, size=max(total, 1))
+    try:  # consumer owns the name from here on
+        from multiprocessing import resource_tracker
+        resource_tracker.unregister(seg._name, "shared_memory")
+    except Exception:
+        pass
+    offset = 0
+
+    def rebuild(obj):
+        nonlocal offset
+        if isinstance(obj, np.ndarray):
+            if obj.dtype.hasobject:
+                # PyObject pointers cannot cross processes through raw
+                # bytes; leave the leaf to mp.Queue's pickling
+                return obj
+            a = np.ascontiguousarray(obj)
+            view = np.ndarray(a.shape, a.dtype, buffer=seg.buf,
+                              offset=offset)
+            view[...] = a
+            ref = _ArrRef(offset, a.shape, str(a.dtype))
+            offset += int(a.nbytes)
+            return ref
+        if isinstance(obj, (list, tuple)):
+            return type(obj)(rebuild(v) for v in obj)
+        if isinstance(obj, dict):
+            return {k: rebuild(v) for k, v in obj.items()}
+        return obj
+
+    layout = rebuild(data)
+    return _ShmBatch(seg.name, layout), seg
+
+
+def _unpack_batch(msg: "_ShmBatch"):
+    seg = shm_mod.SharedMemory(name=msg.shm_name)
+    try:
+        def rebuild(obj):
+            if isinstance(obj, _ArrRef):
+                view = np.ndarray(obj.shape, obj.dtype, buffer=seg.buf,
+                                  offset=obj.offset)
+                return view.copy()     # detach before the segment dies
+            if isinstance(obj, (list, tuple)):
+                return type(obj)(rebuild(v) for v in obj)
+            if isinstance(obj, dict):
+                return {k: rebuild(v) for k, v in obj.items()}
+            return obj
+
+        return rebuild(msg.layout)
+    finally:
+        seg.close()
+        try:
+            seg.unlink()
+        except FileNotFoundError:
+            pass
+
+
 def _worker_loop(dataset, index_queue, out_queue, collate_fn, init_fn,
                  worker_id, num_workers, iterable, batch_size, drop_last,
-                 base_seed):
+                 base_seed, use_shm):
     """Reference: fluid/dataloader/worker.py:171 _worker_loop."""
+    def put(seq, data):
+        if use_shm and not isinstance(data, _WorkerException):
+            msg, seg = _pack_batch(data)
+            if seg is not None:
+                seg.close()   # segment persists until the consumer unlinks
+            out_queue.put((seq, msg))
+        else:
+            out_queue.put((seq, data))
+
     try:
         np.random.seed((base_seed + worker_id) % (2 ** 32))
         _worker_info.info = WorkerInfo(worker_id, num_workers, dataset,
@@ -168,10 +285,10 @@ def _worker_loop(dataset, index_queue, out_queue, collate_fn, init_fn,
             for sample in it:
                 batch.append(sample)
                 if len(batch) == batch_size:
-                    out_queue.put((0, collate_fn(batch)))
+                    put(0, collate_fn(batch))
                     batch = []
             if batch and not drop_last:
-                out_queue.put((0, collate_fn(batch)))
+                put(0, collate_fn(batch))
             out_queue.put((None, None))  # exhausted
             return
         while True:
@@ -181,7 +298,7 @@ def _worker_loop(dataset, index_queue, out_queue, collate_fn, init_fn,
             seq, indices = task
             try:
                 batch = [dataset[i] for i in indices]
-                out_queue.put((seq, collate_fn(batch)))
+                put(seq, collate_fn(batch))
             except Exception:
                 out_queue.put((seq, _WorkerException(traceback.format_exc())))
     except KeyboardInterrupt:
@@ -200,7 +317,7 @@ class _MultiprocessIter:
 
     def __init__(self, loader: DataLoader):
         self.loader = loader
-        self._ctx = mp.get_context("fork")
+        self._ctx = mp.get_context(get_flags("dataloader_start_method"))
         n = loader.num_workers
         self._index_queues = [self._ctx.Queue() for _ in range(n)]
         self._out_queue = self._ctx.Queue()
@@ -219,7 +336,8 @@ class _MultiprocessIter:
                       self._out_queue, loader.collate_fn,
                       loader.worker_init_fn, wid, n, iterable,
                       loader.batch_size,
-                      getattr(loader, "drop_last", False), base_seed),
+                      getattr(loader, "drop_last", False), base_seed,
+                      loader.use_shared_memory),
                 daemon=True)
             w.start()
             self._workers.append(w)
@@ -275,7 +393,10 @@ class _MultiprocessIter:
         timeout = self.loader.timeout or 5.0
         while True:
             try:
-                return self._out_queue.get(timeout=timeout)
+                seq, data = self._out_queue.get(timeout=timeout)
+                if isinstance(data, _ShmBatch):
+                    data = _unpack_batch(data)
+                return seq, data
             except queue.Empty:
                 dead = [w for w in self._workers if not w.is_alive()]
                 if dead and self._exhausted_workers < len(dead):
@@ -293,7 +414,87 @@ class _MultiprocessIter:
                 q.put(None)
             except Exception:
                 pass
+        # stop producers FIRST, then sweep in-flight shm segments — a
+        # drain-before-terminate races with workers still packing batches
         for w in getattr(self, "_workers", []):
             if w.is_alive():
                 w.terminate()
+        for w in getattr(self, "_workers", []):
+            try:
+                w.join(timeout=2.0)
+            except Exception:
+                pass
+        try:
+            while True:
+                _, data = self._out_queue.get(timeout=0.05)
+                if isinstance(data, _ShmBatch):
+                    try:
+                        seg = shm_mod.SharedMemory(name=data.shm_name)
+                        seg.close()
+                        seg.unlink()
+                    except FileNotFoundError:
+                        pass
+        except Exception:
+            pass
         self._workers = []
+
+
+def device_prefetch(iterator, sharding=None, depth=2):
+    """Overlap host->device transfer with compute: a background thread
+    device_puts upcoming batches (double buffering by default). Reference
+    capability: operators/reader/buffered_reader.cc (device-buffered
+    queue feeding the executor).
+
+        for xb, yb in io.device_prefetch(loader, sharding=data_sharding):
+            step(xb, yb)
+    """
+    import jax
+
+    def put(tree):
+        def one(x):
+            if isinstance(x, Tensor):
+                x = x._data
+            if isinstance(x, np.ndarray):
+                return jax.device_put(x, sharding)
+            return x
+        if isinstance(tree, (list, tuple)):
+            return type(tree)(one(v) for v in tree)
+        if isinstance(tree, dict):
+            return {k: one(v) for k, v in tree.items()}
+        return one(tree)
+
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    done = object()
+    stop = threading.Event()
+
+    def offer(item):
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def feeder():
+        try:
+            for item in iterator:
+                if not offer(put(item)):
+                    return            # consumer abandoned the stream
+        except BaseException as e:    # propagate to the consumer
+            offer(e)
+            return
+        offer(done)
+
+    t = threading.Thread(target=feeder, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is done:
+                break
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()                    # unblock the feeder on early exit
