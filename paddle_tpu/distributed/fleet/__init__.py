@@ -22,7 +22,9 @@ __all__ = ["init", "DistributedStrategy", "distributed_optimizer",
            "distributed_model", "compile_train_step", "CompiledTrainStep",
            "worker_num", "worker_index", "is_first_worker", "barrier_worker",
            "get_strategy", "get_mesh", "UserDefinedRoleMaker",
-           "PaddleCloudRoleMaker"]
+           "PaddleCloudRoleMaker", "is_server", "is_worker", "init_server",
+           "run_server", "server_endpoints", "ps_client", "stop_worker",
+           "stop_server"]
 
 _state = {"strategy": None, "initialized": False, "role_maker": None}
 
@@ -132,3 +134,99 @@ def distributed_model(model):
     on TPU data parallelism is sharding, not layer wrapping."""
     model._fleet_strategy = _state["strategy"]
     return model
+
+
+# ---------------------------------------------------------------------------
+# parameter-server mode (reference: fleet PS role — fleet_base.py
+# init_server/run_server/stop_worker, runtime fleet/runtime/the_one_ps.py;
+# env protocol TRAINING_ROLE / PADDLE_PSERVERS_IP_PORT_LIST)
+# ---------------------------------------------------------------------------
+
+_ps_state = {"server": None, "client": None}
+
+
+def is_server() -> bool:
+    return os.environ.get("TRAINING_ROLE", "").upper() == "PSERVER"
+
+def is_worker() -> bool:
+    return not is_server()
+
+
+def init_server(port: int = 0, model_path: str = None):
+    """Start the native table server in-process (the brpc_ps_server
+    analog, distributed/ps/native/ps_server.cpp); optionally restore
+    tables from a save() snapshot.
+
+    If the launcher exported PADDLE_PSERVERS_IP_PORT_LIST, this host's
+    entry decides the bind port (the documented env protocol); otherwise
+    an ephemeral port is bound and published into the env."""
+    from ..ps import PSClient, PSServer
+    if port == 0:
+        eps = server_endpoints()
+        idx = int(os.environ.get("PADDLE_PSERVER_ID", "0"))
+        if eps and idx < len(eps):
+            port = int(eps[idx].rsplit(":", 1)[1])
+    srv = PSServer(port=port)
+    _ps_state["server"] = srv
+    os.environ.setdefault("PADDLE_PSERVERS_IP_PORT_LIST", srv.endpoint)
+    if model_path:
+        c = PSClient(srv.endpoint)
+        c.load(model_path)
+        c.close()
+    return srv
+
+
+def run_server():
+    """Block until a worker sends STOP (reference run_server)."""
+    srv = _ps_state["server"]
+    if srv is None:
+        raise RuntimeError("call fleet.init_server() first")
+    srv._proc.wait()
+
+
+def server_endpoints():
+    eps = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+    return [e for e in eps.replace(",", ";").split(";") if e]
+
+
+def ps_client():
+    """Worker-side connection to the (first) server endpoint."""
+    from ..ps import PSClient
+    if _ps_state["client"] is None:
+        eps = server_endpoints()
+        if not eps:
+            raise RuntimeError("PADDLE_PSERVERS_IP_PORT_LIST not set")
+        _ps_state["client"] = PSClient(eps[0])
+    return _ps_state["client"]
+
+
+def stop_worker():
+    """Worker-side teardown: close this worker's client connection. The
+    server keeps running (reference semantics: trainers call stop_worker;
+    the server is stopped separately via stop_server)."""
+    c = _ps_state.get("client")
+    if c is not None:
+        try:
+            c.close()
+        except Exception:
+            pass
+        _ps_state["client"] = None
+
+
+def stop_server():
+    """Shut the table server down via RPC (callable from any process that
+    can reach PADDLE_PSERVERS_IP_PORT_LIST; typically trainer 0 after all
+    workers barrier out, or the server host itself)."""
+    from ..ps import PSClient
+    eps = server_endpoints()
+    srv = _ps_state.get("server")
+    target = srv.endpoint if srv is not None else (eps[0] if eps else None)
+    if target is None:
+        return
+    try:
+        c = PSClient(target)
+        c.stop_server()
+        c.close()
+    except Exception:
+        if srv is not None:
+            srv._proc.terminate()

@@ -1,0 +1,243 @@
+"""Parameter-server capability: native C++ table server + Python client.
+
+Reference: the brpc PS stack — BrpcPsServer
+(/root/reference/paddle/fluid/distributed/service/brpc_ps_server.h:40),
+PSClient (service/ps_client.h:60), dense/sparse tables (table/table.h:32),
+AsyncCommunicator with background merge-and-send threads
+(service/communicator.h:346, FLAGS_communicator_max_merge_var_num).
+
+TPU-native split: collective training never routes through this (XLA/ICI
+owns it); the PS serves the embedding-heavy async-SGD workloads whose
+sparse tables exceed chip memory. The server is dependency-free C++
+(native/ps_server.cpp, compiled on demand with g++) speaking a
+length-prefixed TCP protocol; SGD applies server-side like the reference's
+server optimizer. The client is numpy-first; AsyncCommunicator batches
+sparse pushes on a background thread.
+"""
+from __future__ import annotations
+
+import os
+import queue
+import socket
+import struct
+import subprocess
+import threading
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+__all__ = ["build_server_binary", "PSServer", "PSClient",
+           "AsyncCommunicator"]
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "native")
+
+CREATE_DENSE, CREATE_SPARSE = 1, 2
+PULL_DENSE, PUSH_DENSE = 3, 4
+PULL_SPARSE, PUSH_SPARSE = 5, 6
+BARRIER, STOP, PING, SAVE, LOAD = 7, 8, 9, 10, 11
+
+
+def build_server_binary(force=False) -> str:
+    """Compile native/ps_server.cpp once (g++ -O2); returns binary path."""
+    src = os.path.join(_NATIVE_DIR, "ps_server.cpp")
+    out = os.path.join(_NATIVE_DIR, "ps_server")
+    if force or (not os.path.exists(out)
+                 or os.path.getmtime(out) < os.path.getmtime(src)):
+        cmd = ["g++", "-O2", "-std=c++17", "-pthread", "-o", out, src]
+        res = subprocess.run(cmd, capture_output=True, text=True)
+        if res.returncode != 0:
+            raise RuntimeError(f"ps_server build failed:\n{res.stderr}")
+    return out
+
+
+class PSServer:
+    """Owns one native server process (BrpcPsServer analog)."""
+
+    def __init__(self, port: int = 0):
+        binary = build_server_binary()
+        self._proc = subprocess.Popen([binary, str(port)],
+                                      stdout=subprocess.PIPE, text=True)
+        line = self._proc.stdout.readline().strip()
+        if not line.startswith("PS_LISTENING"):
+            raise RuntimeError(f"ps_server failed to start: {line!r}")
+        self.port = int(line.split()[1])
+        self.endpoint = f"127.0.0.1:{self.port}"
+
+    def stop(self):
+        if self._proc.poll() is None:
+            try:
+                PSClient(self.endpoint).stop_server()
+            except Exception:
+                self._proc.terminate()
+            self._proc.wait(timeout=10)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class PSClient:
+    """Blocking RPC verbs over one TCP connection (ps_client.h:60 analog).
+    Not thread-safe; AsyncCommunicator owns its own client."""
+
+    def __init__(self, endpoint: str, timeout: float = 30.0):
+        host, port = endpoint.rsplit(":", 1)
+        self._sock = socket.create_connection((host, int(port)),
+                                              timeout=timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    # -- wire helpers ------------------------------------------------------
+    def _send(self, verb: int, table: int, n: int, *payloads: bytes):
+        msg = struct.pack("<BIQ", verb, table, n) + b"".join(payloads)
+        self._sock.sendall(msg)
+
+    def _recv_reply(self) -> bytes:
+        hdr = self._recv_exact(8)
+        (n,) = struct.unpack("<Q", hdr)
+        return self._recv_exact(n) if n else b""
+
+    def _recv_exact(self, n: int) -> bytes:
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("ps server closed connection")
+            buf.extend(chunk)
+        return bytes(buf)
+
+    # -- table verbs -------------------------------------------------------
+    def create_dense_table(self, table: int, size: int,
+                           init: Optional[np.ndarray] = None):
+        if init is not None:
+            init = np.ascontiguousarray(init, np.float32).ravel()
+            self._send(CREATE_DENSE, table, init.size,
+                       struct.pack("<Q", 1), init.tobytes())
+        else:
+            self._send(CREATE_DENSE, table, size, struct.pack("<Q", 0))
+        self._recv_reply()
+
+    def create_sparse_table(self, table: int, dim: int):
+        self._send(CREATE_SPARSE, table, dim)
+        self._recv_reply()
+
+    def pull_dense(self, table: int) -> np.ndarray:
+        self._send(PULL_DENSE, table, 0)
+        return np.frombuffer(self._recv_reply(), np.float32).copy()
+
+    def push_dense(self, table: int, grad: np.ndarray, lr: float = 1.0):
+        g = np.ascontiguousarray(grad, np.float32).ravel()
+        self._send(PUSH_DENSE, table, g.size, struct.pack("<f", lr),
+                   g.tobytes())
+        self._recv_reply()
+
+    def pull_sparse(self, table: int, keys: np.ndarray,
+                    dim: int) -> np.ndarray:
+        k = np.ascontiguousarray(keys, np.uint64).ravel()
+        self._send(PULL_SPARSE, table, k.size, k.tobytes())
+        out = np.frombuffer(self._recv_reply(), np.float32).copy()
+        return out.reshape(k.size, dim)
+
+    def push_sparse(self, table: int, keys: np.ndarray, grads: np.ndarray,
+                    lr: float = 1.0):
+        k = np.ascontiguousarray(keys, np.uint64).ravel()
+        g = np.ascontiguousarray(grads, np.float32).reshape(k.size, -1)
+        self._send(PUSH_SPARSE, table, k.size, struct.pack("<f", lr),
+                   k.tobytes(), g.tobytes())
+        self._recv_reply()
+
+    def barrier(self, world: int):
+        self._send(BARRIER, 0, world)
+        self._recv_reply()
+
+    def ping(self):
+        self._send(PING, 0, 0)
+        self._recv_reply()
+
+    def save(self, path: str):
+        p = path.encode()
+        self._send(SAVE, 0, len(p), p)
+        self._recv_reply()
+
+    def load(self, path: str):
+        p = path.encode()
+        self._send(LOAD, 0, len(p), p)
+        self._recv_reply()
+
+    def stop_server(self):
+        self._send(STOP, 0, 0)
+        self._recv_reply()
+
+    def close(self):
+        self._sock.close()
+
+
+class AsyncCommunicator:
+    """Background merge-and-send of sparse grads (communicator.h:346).
+
+    push() enqueues (keys, grads); the sender thread coalesces up to
+    `max_merge` pending updates per table (summing grads on duplicate keys
+    — the reference's merge-before-send) and issues one push_sparse RPC.
+    """
+
+    def __init__(self, endpoint: str, lr: float = 0.1, max_merge: int = 20):
+        self._client = PSClient(endpoint)
+        self._lr = lr
+        self._max_merge = max_merge
+        self._q: "queue.Queue" = queue.Queue()
+        self._stop = threading.Event()
+        self._flushed = threading.Condition()
+        self._pending = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def push(self, table: int, keys: np.ndarray, grads: np.ndarray):
+        with self._flushed:
+            self._pending += 1
+        self._q.put((table, np.asarray(keys), np.asarray(grads)))
+
+    def _loop(self):
+        while not self._stop.is_set() or not self._q.empty():
+            try:
+                table, keys, grads = self._q.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            batch = [(keys, grads)]
+            while len(batch) < self._max_merge:
+                try:
+                    t2, k2, g2 = self._q.get_nowait()
+                except queue.Empty:
+                    break
+                if t2 != table:
+                    self._q.put((t2, k2, g2))
+                    break
+                batch.append((k2, g2))
+            merged: Dict[int, np.ndarray] = {}
+            for k, g in batch:
+                g = g.reshape(len(k), -1)
+                for i, key in enumerate(np.asarray(k).ravel()):
+                    key = int(key)
+                    if key in merged:
+                        merged[key] = merged[key] + g[i]
+                    else:
+                        merged[key] = g[i].astype(np.float32)
+            keys_m = np.fromiter(merged.keys(), np.uint64, len(merged))
+            grads_m = np.stack([merged[int(k)] for k in keys_m])
+            self._client.push_sparse(table, keys_m, grads_m, lr=self._lr)
+            with self._flushed:
+                self._pending -= len(batch)
+                self._flushed.notify_all()
+
+    def flush(self, timeout: float = 30.0):
+        with self._flushed:
+            self._flushed.wait_for(lambda: self._pending == 0,
+                                   timeout=timeout)
+
+    def stop(self):
+        self.flush()
+        self._stop.set()
+        self._thread.join(timeout=10)
+        self._client.close()

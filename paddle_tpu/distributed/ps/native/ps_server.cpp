@@ -1,0 +1,349 @@
+// Parameter-server core: dense + sparse tables over a TCP binary protocol.
+//
+// Reference: BrpcPsServer / Table
+// (/root/reference/paddle/fluid/distributed/service/brpc_ps_server.h:40,
+//  table/table.h:32, common_dense_table.cc, common_sparse_table.cc) and the
+// RPC verbs of Communicator (service/communicator.h:215-233
+// RpcRecvDense/RpcSendDense/RpcSendSparse/RpcRecvSparse, barrier :258).
+//
+// TPU-native context: the collective training path never touches this —
+// XLA/ICI owns gradients there. The PS exists for the embedding-heavy
+// async-SGD capability (PS mode in fleet): sparse tables too large for any
+// chip, updated server-side. brpc is replaced by a dependency-free
+// length-prefixed TCP protocol; one thread per connection, per-table
+// sharded mutexes, server-side SGD apply (the reference's server optimizer).
+//
+// Protocol (little endian):
+//   request : u8 verb | u32 table | u64 n | payload
+//   reply   : u64 n   | payload
+// Verbs:
+//   1 CREATE_DENSE  n=size            payload: optional n f32 init
+//   2 CREATE_SPARSE n=dim
+//   3 PULL_DENSE                      -> n f32
+//   4 PUSH_DENSE    n floats          payload: f32 lr | n f32 grad
+//   5 PULL_SPARSE   n keys            payload: n u64  -> n*dim f32
+//   6 PUSH_SPARSE   n keys            payload: f32 lr | n u64 | n*dim f32
+//   7 BARRIER       n=world           blocks until n arrivals (generation)
+//   8 STOP
+//   9 PING                            -> 0
+//  10 SAVE          payload: path     persist all tables
+//  11 LOAD          payload: path
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct DenseTable {
+  std::vector<float> w;
+  std::mutex mu;
+};
+
+struct SparseTable {
+  uint64_t dim = 0;
+  std::unordered_map<uint64_t, std::vector<float>> rows;
+  std::mutex mu;
+};
+
+struct Server {
+  std::unordered_map<uint32_t, DenseTable> dense;
+  std::unordered_map<uint32_t, SparseTable> sparse;
+  std::mutex tables_mu;
+
+  std::mutex barrier_mu;
+  std::condition_variable barrier_cv;
+  uint64_t barrier_count = 0, barrier_gen = 0;
+
+  std::atomic<bool> stopping{false};
+  int listen_fd = -1;
+};
+
+bool read_full(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n) {
+    ssize_t r = ::write(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool reply(int fd, const void* payload, uint64_t n_bytes) {
+  if (!write_full(fd, &n_bytes, sizeof(n_bytes))) return false;
+  return n_bytes == 0 || write_full(fd, payload, n_bytes);
+}
+
+void save_tables(Server& s, const std::string& path) {
+  std::ofstream f(path, std::ios::binary);
+  std::lock_guard<std::mutex> lk(s.tables_mu);
+  uint64_t nd = s.dense.size(), ns = s.sparse.size();
+  f.write(reinterpret_cast<char*>(&nd), 8);
+  for (auto& [id, t] : s.dense) {
+    uint64_t n = t.w.size();
+    f.write(reinterpret_cast<const char*>(&id), 4);
+    f.write(reinterpret_cast<char*>(&n), 8);
+    f.write(reinterpret_cast<const char*>(t.w.data()), n * 4);
+  }
+  f.write(reinterpret_cast<char*>(&ns), 8);
+  for (auto& [id, t] : s.sparse) {
+    uint64_t n = t.rows.size();
+    f.write(reinterpret_cast<const char*>(&id), 4);
+    f.write(reinterpret_cast<const char*>(&t.dim), 8);
+    f.write(reinterpret_cast<char*>(&n), 8);
+    for (auto& [k, row] : t.rows) {
+      f.write(reinterpret_cast<const char*>(&k), 8);
+      f.write(reinterpret_cast<const char*>(row.data()), t.dim * 4);
+    }
+  }
+}
+
+void load_tables(Server& s, const std::string& path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) return;
+  std::lock_guard<std::mutex> lk(s.tables_mu);
+  uint64_t nd = 0;
+  f.read(reinterpret_cast<char*>(&nd), 8);
+  for (uint64_t i = 0; i < nd; ++i) {
+    uint32_t id;
+    uint64_t n;
+    f.read(reinterpret_cast<char*>(&id), 4);
+    f.read(reinterpret_cast<char*>(&n), 8);
+    auto& t = s.dense[id];
+    t.w.resize(n);
+    f.read(reinterpret_cast<char*>(t.w.data()), n * 4);
+  }
+  uint64_t ns = 0;
+  f.read(reinterpret_cast<char*>(&ns), 8);
+  for (uint64_t i = 0; i < ns; ++i) {
+    uint32_t id;
+    uint64_t dim, n;
+    f.read(reinterpret_cast<char*>(&id), 4);
+    f.read(reinterpret_cast<char*>(&dim), 8);
+    f.read(reinterpret_cast<char*>(&n), 8);
+    auto& t = s.sparse[id];
+    t.dim = dim;
+    for (uint64_t j = 0; j < n; ++j) {
+      uint64_t k;
+      f.read(reinterpret_cast<char*>(&k), 8);
+      auto& row = t.rows[k];
+      row.resize(dim);
+      f.read(reinterpret_cast<char*>(row.data()), dim * 4);
+    }
+  }
+}
+
+void handle(Server& s, int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  for (;;) {
+    uint8_t verb;
+    uint32_t table;
+    uint64_t n;
+    if (!read_full(fd, &verb, 1) || !read_full(fd, &table, 4) ||
+        !read_full(fd, &n, 8))
+      break;
+    switch (verb) {
+      case 1: {  // CREATE_DENSE
+        std::vector<float> init;
+        uint64_t have_init;
+        if (!read_full(fd, &have_init, 8)) goto done;
+        if (have_init) {
+          init.resize(n);
+          if (!read_full(fd, init.data(), n * 4)) goto done;
+        }
+        {
+          std::lock_guard<std::mutex> lk(s.tables_mu);
+          auto& t = s.dense[table];
+          std::lock_guard<std::mutex> lt(t.mu);
+          t.w.assign(n, 0.f);
+          if (have_init) t.w = init;
+        }
+        if (!reply(fd, nullptr, 0)) goto done;
+        break;
+      }
+      case 2: {  // CREATE_SPARSE
+        std::lock_guard<std::mutex> lk(s.tables_mu);
+        s.sparse[table].dim = n;
+        if (!reply(fd, nullptr, 0)) goto done;
+        break;
+      }
+      case 3: {  // PULL_DENSE
+        DenseTable* t;
+        {
+          std::lock_guard<std::mutex> lk(s.tables_mu);
+          t = &s.dense[table];
+        }
+        std::lock_guard<std::mutex> lt(t->mu);
+        if (!reply(fd, t->w.data(), t->w.size() * 4)) goto done;
+        break;
+      }
+      case 4: {  // PUSH_DENSE (server-side SGD)
+        float lr;
+        std::vector<float> g(n);
+        if (!read_full(fd, &lr, 4) || !read_full(fd, g.data(), n * 4))
+          goto done;
+        DenseTable* t;
+        {
+          std::lock_guard<std::mutex> lk(s.tables_mu);
+          t = &s.dense[table];
+        }
+        {
+          std::lock_guard<std::mutex> lt(t->mu);
+          if (t->w.size() < n) t->w.resize(n, 0.f);
+          for (uint64_t i = 0; i < n; ++i) t->w[i] -= lr * g[i];
+        }
+        if (!reply(fd, nullptr, 0)) goto done;
+        break;
+      }
+      case 5: {  // PULL_SPARSE
+        std::vector<uint64_t> keys(n);
+        if (!read_full(fd, keys.data(), n * 8)) goto done;
+        SparseTable* t;
+        {
+          std::lock_guard<std::mutex> lk(s.tables_mu);
+          t = &s.sparse[table];
+        }
+        std::vector<float> out;
+        {
+          std::lock_guard<std::mutex> lt(t->mu);
+          out.resize(n * t->dim, 0.f);
+          for (uint64_t i = 0; i < n; ++i) {
+            auto it = t->rows.find(keys[i]);
+            if (it != t->rows.end())
+              std::memcpy(out.data() + i * t->dim, it->second.data(),
+                          t->dim * 4);
+          }
+        }
+        if (!reply(fd, out.data(), out.size() * 4)) goto done;
+        break;
+      }
+      case 6: {  // PUSH_SPARSE (server-side SGD, rows created on demand)
+        float lr;
+        std::vector<uint64_t> keys(n);
+        if (!read_full(fd, &lr, 4) || !read_full(fd, keys.data(), n * 8))
+          goto done;
+        SparseTable* t;
+        {
+          std::lock_guard<std::mutex> lk(s.tables_mu);
+          t = &s.sparse[table];
+        }
+        std::vector<float> g(n * t->dim);
+        if (!read_full(fd, g.data(), g.size() * 4)) goto done;
+        {
+          std::lock_guard<std::mutex> lt(t->mu);
+          for (uint64_t i = 0; i < n; ++i) {
+            auto& row = t->rows[keys[i]];
+            if (row.size() != t->dim) row.assign(t->dim, 0.f);
+            for (uint64_t d = 0; d < t->dim; ++d)
+              row[d] -= lr * g[i * t->dim + d];
+          }
+        }
+        if (!reply(fd, nullptr, 0)) goto done;
+        break;
+      }
+      case 7: {  // BARRIER(n == world size)
+        std::unique_lock<std::mutex> lk(s.barrier_mu);
+        uint64_t gen = s.barrier_gen;
+        if (++s.barrier_count >= n) {
+          s.barrier_count = 0;
+          ++s.barrier_gen;
+          s.barrier_cv.notify_all();
+        } else {
+          s.barrier_cv.wait(lk, [&] { return s.barrier_gen != gen; });
+        }
+        if (!reply(fd, nullptr, 0)) goto done;
+        break;
+      }
+      case 8:  // STOP
+        reply(fd, nullptr, 0);
+        s.stopping = true;
+        ::shutdown(s.listen_fd, SHUT_RDWR);  // unblock accept()
+        goto done;
+      case 9:  // PING
+        if (!reply(fd, nullptr, 0)) goto done;
+        break;
+      case 10:
+      case 11: {  // SAVE / LOAD
+        std::string path(n, '\0');
+        if (!read_full(fd, path.data(), n)) goto done;
+        if (verb == 10)
+          save_tables(s, path);
+        else
+          load_tables(s, path);
+        if (!reply(fd, nullptr, 0)) goto done;
+        break;
+      }
+      default:
+        goto done;
+    }
+  }
+done:
+  ::close(fd);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  int port = argc > 1 ? std::atoi(argv[1]) : 0;
+  Server server;
+
+  int lfd = ::socket(AF_INET, SOCK_STREAM, 0);
+  server.listen_fd = lfd;
+  int one = 1;
+  setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(lfd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    std::perror("bind");
+    return 1;
+  }
+  socklen_t alen = sizeof(addr);
+  ::getsockname(lfd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  ::listen(lfd, 64);  // must precede the announce: clients connect on it
+  std::printf("PS_LISTENING %d\n", ntohs(addr.sin_port));
+  std::fflush(stdout);
+
+  std::vector<std::thread> threads;
+  while (!server.stopping) {
+    int cfd = ::accept(lfd, nullptr, nullptr);
+    if (cfd < 0) break;
+    if (server.stopping) {
+      ::close(cfd);
+      break;
+    }
+    threads.emplace_back([&server, cfd] { handle(server, cfd); });
+  }
+  ::close(lfd);
+  for (auto& t : threads)
+    if (t.joinable()) t.detach();  // connection threads exit on close
+  return 0;
+}

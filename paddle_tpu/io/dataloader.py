@@ -79,14 +79,19 @@ def default_collate_fn(batch):
     return np.asarray(batch)
 
 
-def _to_tensor_tree(obj, return_list):
-    if isinstance(obj, np.ndarray):
-        return Tensor(obj)
+def _tree_map(fn, obj):
+    """Map fn over non-container leaves of a list/tuple/dict tree (the one
+    traversal shared by collate, shm pack/unpack and prefetch)."""
     if isinstance(obj, (list, tuple)):
-        return type(obj)(_to_tensor_tree(v, return_list) for v in obj)
+        return type(obj)(_tree_map(fn, v) for v in obj)
     if isinstance(obj, dict):
-        return {k: _to_tensor_tree(v, return_list) for k, v in obj.items()}
-    return obj
+        return {k: _tree_map(fn, v) for k, v in obj.items()}
+    return fn(obj)
+
+
+def _to_tensor_tree(obj, return_list):
+    return _tree_map(
+        lambda v: Tensor(v) if isinstance(v, np.ndarray) else v, obj)
 
 
 class DataLoader:
@@ -104,6 +109,8 @@ class DataLoader:
         self.prefetch_factor = prefetch_factor
         self.timeout = timeout
         self.worker_init_fn = worker_init_fn
+        self.persistent_workers = bool(persistent_workers)
+        self._persistent_iter = None
         self._iterable_dataset = isinstance(dataset, IterableDataset)
         self._as_tensor = True
 
@@ -133,6 +140,16 @@ class DataLoader:
     def __iter__(self):
         if self.num_workers == 0:
             return self._single_process_iter()
+        if self.persistent_workers and not self._iterable_dataset:
+            # amortize spawn startup across epochs (reference keeps worker
+            # processes alive the same way)
+            if (self._persistent_iter is None
+                    or not self._persistent_iter._workers):
+                self._persistent_iter = _MultiprocessIter(
+                    self, persistent=True)
+            else:
+                self._persistent_iter.reset()
+            return self._persistent_iter
         return _MultiprocessIter(self)
 
     def __call__(self):
@@ -187,14 +204,10 @@ class _ArrRef:
 
 
 def _tree_arrays(obj):
-    if isinstance(obj, np.ndarray):
-        yield obj
-    elif isinstance(obj, (list, tuple)):
-        for v in obj:
-            yield from _tree_arrays(v)
-    elif isinstance(obj, dict):
-        for v in obj.values():
-            yield from _tree_arrays(v)
+    out = []
+    _tree_map(lambda v: out.append(v) if isinstance(v, np.ndarray) else v,
+              obj)
+    return out
 
 
 def _pack_batch(data):
@@ -213,45 +226,34 @@ def _pack_batch(data):
         pass
     offset = 0
 
-    def rebuild(obj):
+    def pack_leaf(obj):
         nonlocal offset
-        if isinstance(obj, np.ndarray):
-            if obj.dtype.hasobject:
-                # PyObject pointers cannot cross processes through raw
-                # bytes; leave the leaf to mp.Queue's pickling
-                return obj
-            a = np.ascontiguousarray(obj)
-            view = np.ndarray(a.shape, a.dtype, buffer=seg.buf,
-                              offset=offset)
-            view[...] = a
-            ref = _ArrRef(offset, a.shape, str(a.dtype))
-            offset += int(a.nbytes)
-            return ref
-        if isinstance(obj, (list, tuple)):
-            return type(obj)(rebuild(v) for v in obj)
-        if isinstance(obj, dict):
-            return {k: rebuild(v) for k, v in obj.items()}
-        return obj
+        if not isinstance(obj, np.ndarray) or obj.dtype.hasobject:
+            # PyObject pointers cannot cross processes through raw bytes;
+            # non-array and object-dtype leaves ride mp.Queue's pickling
+            return obj
+        a = np.ascontiguousarray(obj)
+        view = np.ndarray(a.shape, a.dtype, buffer=seg.buf, offset=offset)
+        view[...] = a
+        ref = _ArrRef(offset, a.shape, str(a.dtype))
+        offset += int(a.nbytes)
+        return ref
 
-    layout = rebuild(data)
+    layout = _tree_map(pack_leaf, data)
     return _ShmBatch(seg.name, layout), seg
 
 
 def _unpack_batch(msg: "_ShmBatch"):
     seg = shm_mod.SharedMemory(name=msg.shm_name)
     try:
-        def rebuild(obj):
+        def unpack_leaf(obj):
             if isinstance(obj, _ArrRef):
                 view = np.ndarray(obj.shape, obj.dtype, buffer=seg.buf,
                                   offset=obj.offset)
                 return view.copy()     # detach before the segment dies
-            if isinstance(obj, (list, tuple)):
-                return type(obj)(rebuild(v) for v in obj)
-            if isinstance(obj, dict):
-                return {k: rebuild(v) for k, v in obj.items()}
             return obj
 
-        return rebuild(msg.layout)
+        return _tree_map(unpack_leaf, msg.layout)
     finally:
         seg.close()
         try:
@@ -315,8 +317,9 @@ class _MultiprocessIter:
     N workers pull index batches from per-worker queues; a collector thread
     reorders completed batches by sequence id."""
 
-    def __init__(self, loader: DataLoader):
+    def __init__(self, loader: DataLoader, persistent=False):
         self.loader = loader
+        self._persistent = persistent
         self._ctx = mp.get_context(get_flags("dataloader_start_method"))
         n = loader.num_workers
         self._index_queues = [self._ctx.Queue() for _ in range(n)]
@@ -376,7 +379,8 @@ class _MultiprocessIter:
                 return _to_tensor_tree(data, loader.return_list)
 
         if self._seq_rcvd >= self._seq_send and not self._dispatch_next():
-            self._shutdown()
+            if not self._persistent:
+                self._shutdown()
             raise StopIteration
         while self._seq_rcvd not in self._cache:
             seq, data = self._get_from_queue()
@@ -388,6 +392,24 @@ class _MultiprocessIter:
             self._shutdown()
             raise RuntimeError("DataLoader worker failed:\n" + data.tb)
         return _to_tensor_tree(data, loader.return_list)
+
+    def reset(self):
+        """Re-arm a persistent iterator for the next epoch: drain any
+        abandoned in-flight batches (unlinking their shm), restart the
+        sampler, re-prime the pipeline."""
+        while self._seq_rcvd < self._seq_send:
+            if self._seq_rcvd in self._cache:
+                self._cache.pop(self._seq_rcvd)
+            else:
+                seq, _ = self._get_from_queue()
+                if seq != self._seq_rcvd:
+                    self._cache[seq] = None
+                    continue
+            self._seq_rcvd += 1
+        self._cache.clear()
+        self._sampler_iter = iter(self.loader.batch_sampler)
+        for _ in range(len(self._workers) * self.loader.prefetch_factor):
+            self._dispatch_next()
 
     def _get_from_queue(self):
         timeout = self.loader.timeout or 5.0
@@ -457,11 +479,7 @@ def device_prefetch(iterator, sharding=None, depth=2):
             if isinstance(x, np.ndarray):
                 return jax.device_put(x, sharding)
             return x
-        if isinstance(tree, (list, tuple)):
-            return type(tree)(one(v) for v in tree)
-        if isinstance(tree, dict):
-            return {k: one(v) for k, v in tree.items()}
-        return one(tree)
+        return _tree_map(one, tree)
 
     q: "queue.Queue" = queue.Queue(maxsize=depth)
     done = object()

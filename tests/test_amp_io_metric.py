@@ -312,3 +312,25 @@ def test_pack_batch_object_arrays_fall_back():
     out = _unpack_batch(msg)
     np.testing.assert_array_equal(out["x"], num)
     assert out["o"][0] == {"a": 1}
+
+
+def test_dataloader_persistent_workers():
+    """persistent_workers=True reuses spawn workers across epochs."""
+    dl = io.DataLoader(_ShmDs(), batch_size=4, num_workers=2, shuffle=False,
+                       persistent_workers=True)
+    it1 = iter(dl)
+    first = [int(v) for v in next(it1)[1]["label"].numpy()]
+    # abandon mid-epoch, then full epoch on the SAME worker pool
+    it2 = iter(dl)
+    assert it2 is it1
+    seen = []
+    for _, yb in it2:
+        seen.extend(int(v) for v in yb["label"].numpy())
+    assert seen == list(range(32))
+    it3 = iter(dl)
+    assert it3 is it1          # processes survived
+    seen2 = []
+    for _, yb in it3:
+        seen2.extend(int(v) for v in yb["label"].numpy())
+    assert seen2 == list(range(32))
+    it1._shutdown()
