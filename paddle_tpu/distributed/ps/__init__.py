@@ -137,7 +137,8 @@ class PSClient:
     def pull_sparse(self, table: int, keys: np.ndarray,
                     dim: int) -> np.ndarray:
         k = np.ascontiguousarray(keys, np.uint64).ravel()
-        self._send(PULL_SPARSE, table, k.size, k.tobytes())
+        self._send(PULL_SPARSE, table, k.size, struct.pack("<Q", dim),
+                   k.tobytes())
         out = np.frombuffer(self._recv_reply(), np.float32).copy()
         return out.reshape(k.size, dim)
 
@@ -146,7 +147,7 @@ class PSClient:
         k = np.ascontiguousarray(keys, np.uint64).ravel()
         g = np.ascontiguousarray(grads, np.float32).reshape(k.size, -1)
         self._send(PUSH_SPARSE, table, k.size, struct.pack("<f", lr),
-                   k.tobytes(), g.tobytes())
+                   struct.pack("<Q", g.shape[1]), k.tobytes(), g.tobytes())
         self._recv_reply()
 
     def barrier(self, world: int):
@@ -191,6 +192,7 @@ class AsyncCommunicator:
         self._stop = threading.Event()
         self._flushed = threading.Condition()
         self._pending = 0
+        self._error = None
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
@@ -200,6 +202,14 @@ class AsyncCommunicator:
         self._q.put((table, np.asarray(keys), np.asarray(grads)))
 
     def _loop(self):
+        try:
+            self._loop_inner()
+        except Exception as e:      # surface RPC failures to flush()/push()
+            self._error = e
+            with self._flushed:
+                self._flushed.notify_all()
+
+    def _loop_inner(self):
         while not self._stop.is_set() or not self._q.empty():
             try:
                 table, keys, grads = self._q.get(timeout=0.05)
@@ -233,8 +243,17 @@ class AsyncCommunicator:
 
     def flush(self, timeout: float = 30.0):
         with self._flushed:
-            self._flushed.wait_for(lambda: self._pending == 0,
-                                   timeout=timeout)
+            ok = self._flushed.wait_for(
+                lambda: self._pending == 0 or self._error is not None,
+                timeout=timeout)
+        if self._error is not None:
+            raise RuntimeError(
+                "AsyncCommunicator sender failed; queued sparse updates "
+                "were lost") from self._error
+        if not ok:
+            raise TimeoutError(
+                f"AsyncCommunicator.flush: {self._pending} pending pushes "
+                f"after {timeout}s")
 
     def stop(self):
         self.flush()

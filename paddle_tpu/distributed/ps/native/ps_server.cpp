@@ -21,8 +21,10 @@
 //   2 CREATE_SPARSE n=dim
 //   3 PULL_DENSE                      -> n f32
 //   4 PUSH_DENSE    n floats          payload: f32 lr | n f32 grad
-//   5 PULL_SPARSE   n keys            payload: n u64  -> n*dim f32
-//   6 PUSH_SPARSE   n keys            payload: f32 lr | n u64 | n*dim f32
+//   5 PULL_SPARSE   n keys            payload: u64 dim | n u64  -> n*dim f32
+//   6 PUSH_SPARSE   n keys            payload: f32 lr | u64 dim | n u64 | n*dim f32
+//       (dim travels on the wire so a missing/mismatched table can drain
+//        the request and return zeros instead of desyncing the stream)
 //   7 BARRIER       n=world           blocks until n arrivals (generation)
 //   8 STOP
 //   9 PING                            -> 0
@@ -106,6 +108,7 @@ void save_tables(Server& s, const std::string& path) {
   uint64_t nd = s.dense.size(), ns = s.sparse.size();
   f.write(reinterpret_cast<char*>(&nd), 8);
   for (auto& [id, t] : s.dense) {
+    std::lock_guard<std::mutex> lt(t.mu);  // racing pushes resize w
     uint64_t n = t.w.size();
     f.write(reinterpret_cast<const char*>(&id), 4);
     f.write(reinterpret_cast<char*>(&n), 8);
@@ -113,6 +116,7 @@ void save_tables(Server& s, const std::string& path) {
   }
   f.write(reinterpret_cast<char*>(&ns), 8);
   for (auto& [id, t] : s.sparse) {
+    std::lock_guard<std::mutex> lt(t.mu);
     uint64_t n = t.rows.size();
     f.write(reinterpret_cast<const char*>(&id), 4);
     f.write(reinterpret_cast<const char*>(&t.dim), 8);
@@ -136,6 +140,7 @@ void load_tables(Server& s, const std::string& path) {
     f.read(reinterpret_cast<char*>(&id), 4);
     f.read(reinterpret_cast<char*>(&n), 8);
     auto& t = s.dense[id];
+    std::lock_guard<std::mutex> lt(t.mu);
     t.w.resize(n);
     f.read(reinterpret_cast<char*>(t.w.data()), n * 4);
   }
@@ -148,6 +153,7 @@ void load_tables(Server& s, const std::string& path) {
     f.read(reinterpret_cast<char*>(&dim), 8);
     f.read(reinterpret_cast<char*>(&n), 8);
     auto& t = s.sparse[id];
+    std::lock_guard<std::mutex> lt(t.mu);
     t.dim = dim;
     for (uint64_t j = 0; j < n; ++j) {
       uint64_t k;
@@ -223,22 +229,25 @@ void handle(Server& s, int fd) {
         break;
       }
       case 5: {  // PULL_SPARSE
+        uint64_t dim;
         std::vector<uint64_t> keys(n);
-        if (!read_full(fd, keys.data(), n * 8)) goto done;
+        if (!read_full(fd, &dim, 8) || !read_full(fd, keys.data(), n * 8))
+          goto done;
         SparseTable* t;
         {
           std::lock_guard<std::mutex> lk(s.tables_mu);
           t = &s.sparse[table];
         }
-        std::vector<float> out;
+        std::vector<float> out(n * dim, 0.f);
         {
           std::lock_guard<std::mutex> lt(t->mu);
-          out.resize(n * t->dim, 0.f);
-          for (uint64_t i = 0; i < n; ++i) {
-            auto it = t->rows.find(keys[i]);
-            if (it != t->rows.end())
-              std::memcpy(out.data() + i * t->dim, it->second.data(),
-                          t->dim * 4);
+          if (t->dim == dim) {
+            for (uint64_t i = 0; i < n; ++i) {
+              auto it = t->rows.find(keys[i]);
+              if (it != t->rows.end())
+                std::memcpy(out.data() + i * dim, it->second.data(),
+                            dim * 4);
+            }
           }
         }
         if (!reply(fd, out.data(), out.size() * 4)) goto done;
@@ -246,23 +255,34 @@ void handle(Server& s, int fd) {
       }
       case 6: {  // PUSH_SPARSE (server-side SGD, rows created on demand)
         float lr;
+        uint64_t dim;
         std::vector<uint64_t> keys(n);
-        if (!read_full(fd, &lr, 4) || !read_full(fd, keys.data(), n * 8))
+        if (!read_full(fd, &lr, 4) || !read_full(fd, &dim, 8) ||
+            !read_full(fd, keys.data(), n * 8))
           goto done;
+        std::vector<float> g(n * dim);  // client dim: stream stays in sync
+        if (!read_full(fd, g.data(), g.size() * 4)) goto done;
         SparseTable* t;
         {
           std::lock_guard<std::mutex> lk(s.tables_mu);
           t = &s.sparse[table];
         }
-        std::vector<float> g(n * t->dim);
-        if (!read_full(fd, g.data(), g.size() * 4)) goto done;
         {
           std::lock_guard<std::mutex> lt(t->mu);
-          for (uint64_t i = 0; i < n; ++i) {
-            auto& row = t->rows[keys[i]];
-            if (row.size() != t->dim) row.assign(t->dim, 0.f);
-            for (uint64_t d = 0; d < t->dim; ++d)
-              row[d] -= lr * g[i * t->dim + d];
+          if (t->dim == 0) t->dim = dim;  // implicit create
+          if (t->dim == dim) {
+            for (uint64_t i = 0; i < n; ++i) {
+              auto& row = t->rows[keys[i]];
+              if (row.size() != dim) row.assign(dim, 0.f);
+              for (uint64_t d = 0; d < dim; ++d)
+                row[d] -= lr * g[i * dim + d];
+            }
+          } else {
+            std::fprintf(stderr,
+                         "ps_server: PUSH_SPARSE dim %llu != table dim "
+                         "%llu, update dropped\n",
+                         (unsigned long long)dim,
+                         (unsigned long long)t->dim);
           }
         }
         if (!reply(fd, nullptr, 0)) goto done;

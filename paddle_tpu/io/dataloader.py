@@ -204,9 +204,20 @@ class _ArrRef:
 
 
 def _tree_arrays(obj):
+    """Collect ndarray leaves without rebuilding containers."""
     out = []
-    _tree_map(lambda v: out.append(v) if isinstance(v, np.ndarray) else v,
-              obj)
+
+    def visit(o):
+        if isinstance(o, np.ndarray):
+            out.append(o)
+        elif isinstance(o, (list, tuple)):
+            for v in o:
+                visit(v)
+        elif isinstance(o, dict):
+            for v in o.values():
+                visit(v)
+
+    visit(obj)
     return out
 
 
