@@ -35,5 +35,9 @@ from . import io  # noqa: F401
 from . import jit  # noqa: F401
 from . import static  # noqa: F401
 from . import inference  # noqa: F401
+from . import text  # noqa: F401
+from . import utils  # noqa: F401
+from . import profiler  # noqa: F401
+from .core import monitor  # noqa: F401
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"

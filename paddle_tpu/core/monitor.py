@@ -1,0 +1,68 @@
+"""Stat registry + device memory counters.
+
+Reference: StatRegistry (platform/monitor.h:77 — global named int
+counters, e.g. STAT_GPU_MEM) exported to python via
+global_value_getter_setter.cc.
+
+TPU-native: the registry keeps the reference's named-counter surface for
+framework/user instrumentation; device memory numbers come from PJRT
+(jax Device.memory_stats) instead of allocator internals, because XLA
+owns HBM on TPU (SURVEY.md rows 7/10).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import jax
+
+__all__ = ["stat_inc", "stat_set", "stat_get", "stat_reset", "all_stats",
+           "device_memory_stats", "hbm_usage"]
+
+_lock = threading.Lock()
+_stats: Dict[str, int] = {}
+
+
+def stat_inc(name: str, value: int = 1) -> int:
+    with _lock:
+        _stats[name] = _stats.get(name, 0) + int(value)
+        return _stats[name]
+
+
+def stat_set(name: str, value: int):
+    with _lock:
+        _stats[name] = int(value)
+
+
+def stat_get(name: str, default: int = 0) -> int:
+    with _lock:
+        return _stats.get(name, default)
+
+
+def stat_reset(name: Optional[str] = None):
+    with _lock:
+        if name is None:
+            _stats.clear()
+        else:
+            _stats.pop(name, None)
+
+
+def all_stats() -> Dict[str, int]:
+    with _lock:
+        return dict(_stats)
+
+
+def device_memory_stats(device=None) -> Dict[str, int]:
+    """PJRT per-device memory counters (bytes_in_use, peak_bytes_in_use,
+    bytes_limit where the runtime reports them)."""
+    device = device or jax.devices()[0]
+    try:
+        return dict(device.memory_stats() or {})
+    except Exception:
+        return {}
+
+
+def hbm_usage(device=None):
+    """(bytes_in_use, bytes_limit) — the STAT_GPU_MEM analog for HBM."""
+    st = device_memory_stats(device)
+    return st.get("bytes_in_use", 0), st.get("bytes_limit", 0)

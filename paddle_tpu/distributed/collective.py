@@ -305,16 +305,32 @@ def p2p(tensor, src, dst, group=None):
     return _dispatch("ppermute", tensor, group, perm=[(src, dst)])
 
 
-def send(tensor, dst=0, group=None, sync_op=True, src=0):
+_P2P_SEMANTICS_WARNING = (
+    "SPMD {name}: under single-controller SPMD every rank executes this "
+    "op and only dst receives src's value — OTHER RANKS RECEIVE ZEROS, "
+    "unlike the reference's per-rank point-to-point. Pass src/dst "
+    "explicitly (defaulting {defaults}) or build a full permutation with "
+    "p2p/ppermute.")
+
+
+def send(tensor, dst=0, group=None, sync_op=True, src=None):
     """paddle.distributed.send parity. In the reference the *calling rank*
     is the sender; under single-controller SPMD the sender must be named
-    explicitly (src, default rank 0)."""
+    explicitly via src."""
+    if src is None:
+        import warnings
+        warnings.warn(_P2P_SEMANTICS_WARNING.format(
+            name="send", defaults="src=0"))
+        src = 0
     return p2p(tensor, src, dst, group)
 
 
 def recv(tensor, src=0, group=None, sync_op=True, dst=None):
     """paddle.distributed.recv parity; dst defaults to (src+1) % nranks."""
     if dst is None:
+        import warnings
+        warnings.warn(_P2P_SEMANTICS_WARNING.format(
+            name="recv", defaults="dst=(src+1)%nranks"))
         dst = (src + 1) % max(get_group(_axis_of(group)).nranks, 1)
     return p2p(tensor, src, dst, group)
 

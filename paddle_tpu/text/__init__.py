@@ -1,0 +1,320 @@
+"""paddle.text — NLP datasets + sequence decode utilities.
+
+Reference: python/paddle/text/datasets/ (Conll05st, Imdb, Imikolov,
+Movielens, UCIHousing, WMT14, WMT16 — all Dataset subclasses whose
+constructors download a corpus and build vocabularies).
+
+TPU-native build runs with zero egress, so each dataset keeps the
+reference class name and sample layout but sources from (a) a local
+`data_file` in a simple documented format, or (b) `mode='synthetic'`
+(deterministic generated corpora) so pipelines/tests run hermetically.
+The download machinery (paddle.dataset.common.download) is intentionally
+absent. viterbi_decode/ViterbiDecoder give the CRF decode op of the
+later reference surface (lod-free: dense [B, T, N] emissions).
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply
+from ..io.dataset import Dataset
+
+__all__ = ["Imdb", "Imikolov", "UCIHousing", "Movielens", "WMT14",
+           "Conll05st", "build_vocab", "viterbi_decode", "ViterbiDecoder"]
+
+
+def build_vocab(corpus, min_freq=1, specials=("<pad>", "<unk>")):
+    """token -> id map from an iterable of token lists."""
+    freq: Dict[str, int] = {}
+    for tokens in corpus:
+        for t in tokens:
+            freq[t] = freq.get(t, 0) + 1
+    vocab = {s: i for i, s in enumerate(specials)}
+    for t, c in sorted(freq.items(), key=lambda kv: (-kv[1], kv[0])):
+        if c >= min_freq and t not in vocab:
+            vocab[t] = len(vocab)
+    return vocab
+
+
+def _synth_tokens(rng, n_docs, vocab_size, doc_len):
+    return [[f"w{int(i)}" for i in
+             rng.integers(2, vocab_size, rng.integers(5, doc_len))]
+            for _ in range(n_docs)]
+
+
+class Imdb(Dataset):
+    """Sentiment classification: sample = (ids int64 [T], label int64).
+    data_file format: one example per line, `label<TAB>space-joined text`
+    (reference reads the aclImdb tar; same sample contract)."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 cutoff: int = 150, vocab: Optional[dict] = None,
+                 n_synthetic: int = 256):
+        docs, labels = [], []
+        if data_file and os.path.exists(data_file):
+            with open(data_file) as f:
+                for line in f:
+                    lab, _, text = line.rstrip("\n").partition("\t")
+                    docs.append(text.split())
+                    labels.append(int(lab))
+        else:
+            rng = np.random.default_rng(0 if mode == "train" else 1)
+            docs = _synth_tokens(rng, n_synthetic, 200, 40)
+            # synthetic labels correlate with a marker token so models
+            # can actually learn something in tests
+            labels = []
+            for d in docs:
+                pos = rng.random() < 0.5
+                d.insert(0, "good" if pos else "bad")
+                labels.append(int(pos))
+        self.word_idx = vocab or build_vocab(docs)
+        unk = self.word_idx.get("<unk>", 1)
+        self.docs = [np.array([self.word_idx.get(t, unk) for t in d],
+                              np.int64) for d in docs]
+        self.labels = np.array(labels, np.int64)
+
+    def __len__(self):
+        return len(self.docs)
+
+    def __getitem__(self, i):
+        return self.docs[i], self.labels[i]
+
+
+class Imikolov(Dataset):
+    """N-gram LM (PTB-style): sample = tuple of n int64 ids (context...,
+    target). data_file: one sentence per line."""
+
+    def __init__(self, data_file: Optional[str] = None, data_type="NGRAM",
+                 window_size: int = 5, mode: str = "train",
+                 min_word_freq: int = 1, n_synthetic: int = 128):
+        if data_file and os.path.exists(data_file):
+            with open(data_file) as f:
+                sents = [l.split() for l in f if l.strip()]
+        else:
+            rng = np.random.default_rng(0 if mode == "train" else 1)
+            sents = _synth_tokens(rng, n_synthetic, 100, 20)
+        self.word_idx = build_vocab(sents, min_freq=min_word_freq,
+                                    specials=("<s>", "<e>", "<unk>"))
+        unk = self.word_idx["<unk>"]
+        self.samples = []
+        for s in sents:
+            ids = [self.word_idx.get(t, unk) for t in s]
+            ids = [self.word_idx["<s>"]] + ids + [self.word_idx["<e>"]]
+            for i in range(len(ids) - window_size + 1):
+                self.samples.append(tuple(
+                    np.int64(v) for v in ids[i:i + window_size]))
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, i):
+        return self.samples[i]
+
+
+class UCIHousing(Dataset):
+    """Regression: sample = (features f32 [13], price f32 [1]).
+    data_file: whitespace-separated rows of 14 floats."""
+
+    N_FEAT = 13
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 n_synthetic: int = 256):
+        if data_file and os.path.exists(data_file):
+            raw = np.loadtxt(data_file).astype(np.float32)
+        else:
+            rng = np.random.default_rng(0 if mode == "train" else 1)
+            x = rng.normal(size=(n_synthetic, self.N_FEAT))
+            w = np.linspace(-1, 1, self.N_FEAT)
+            y = x @ w + 0.1 * rng.normal(size=n_synthetic)
+            raw = np.concatenate([x, y[:, None]], 1).astype(np.float32)
+        mu, sig = raw[:, :-1].mean(0), raw[:, :-1].std(0) + 1e-8
+        self.x = ((raw[:, :-1] - mu) / sig).astype(np.float32)
+        self.y = raw[:, -1:].astype(np.float32)
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+class Movielens(Dataset):
+    """Rating prediction: sample = (user int64, movie int64, rating f32).
+    data_file: `user<TAB>movie<TAB>rating` lines."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 n_users: int = 100, n_movies: int = 200,
+                 n_synthetic: int = 1024):
+        if data_file and os.path.exists(data_file):
+            rows = np.loadtxt(data_file, delimiter="\t")
+            self.users = rows[:, 0].astype(np.int64)
+            self.movies = rows[:, 1].astype(np.int64)
+            self.ratings = rows[:, 2].astype(np.float32)
+        else:
+            rng = np.random.default_rng(0 if mode == "train" else 1)
+            self.users = rng.integers(0, n_users, n_synthetic)
+            self.movies = rng.integers(0, n_movies, n_synthetic)
+            u_bias = rng.normal(size=n_users)
+            m_bias = rng.normal(size=n_movies)
+            self.ratings = np.clip(
+                3 + u_bias[self.users] + m_bias[self.movies]
+                + 0.3 * rng.normal(size=n_synthetic), 1, 5).astype(
+                    np.float32)
+
+    def __len__(self):
+        return len(self.users)
+
+    def __getitem__(self, i):
+        return self.users[i], self.movies[i], self.ratings[i]
+
+
+class WMT14(Dataset):
+    """Translation: sample = (src_ids int64, trg_ids int64 with <s>,
+    trg_next int64 with </s>). data_file: `src sentence<TAB>trg sentence`."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 dict_size: int = 1000, n_synthetic: int = 128):
+        pairs = []
+        if data_file and os.path.exists(data_file):
+            with open(data_file) as f:
+                for line in f:
+                    s, _, t = line.rstrip("\n").partition("\t")
+                    pairs.append((s.split(), t.split()))
+        else:
+            rng = np.random.default_rng(0 if mode == "train" else 1)
+            for _ in range(n_synthetic):
+                n = int(rng.integers(3, 12))
+                src = [f"s{int(i)}" for i in rng.integers(0, 50, n)]
+                pairs.append((src, [t.replace("s", "t") for t in src]))
+        self.src_idx = build_vocab((s for s, _ in pairs),
+                                   specials=("<s>", "<e>", "<unk>"))
+        self.trg_idx = build_vocab((t for _, t in pairs),
+                                   specials=("<s>", "<e>", "<unk>"))
+        su, tu = self.src_idx["<unk>"], self.trg_idx["<unk>"]
+        self.samples = []
+        for s, t in pairs:
+            sid = np.array([self.src_idx.get(w, su) for w in s], np.int64)
+            tid = [self.trg_idx.get(w, tu) for w in t]
+            self.samples.append((
+                sid,
+                np.array([self.trg_idx["<s>"]] + tid, np.int64),
+                np.array(tid + [self.trg_idx["<e>"]], np.int64)))
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, i):
+        return self.samples[i]
+
+
+class Conll05st(Dataset):
+    """SRL-style tagging: sample = (word_ids int64 [T], pred_ids int64 [T],
+    label_ids int64 [T]). data_file: `tokens<TAB>predicates<TAB>labels`
+    (space-joined)."""
+
+    N_LABELS = 9
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 n_synthetic: int = 128):
+        self.samples = []
+        if data_file and os.path.exists(data_file):
+            with open(data_file) as f:
+                rows = [l.rstrip("\n").split("\t") for l in f if l.strip()]
+            toks = [r[0].split() for r in rows]
+            self.word_idx = build_vocab(toks)
+            unk = self.word_idx["<unk>"]
+            for r, t in zip(rows, toks):
+                w = np.array([self.word_idx.get(x, unk) for x in t],
+                             np.int64)
+                p = np.array([int(x) for x in r[1].split()], np.int64)
+                l = np.array([int(x) for x in r[2].split()], np.int64)
+                self.samples.append((w, p, l))
+        else:
+            rng = np.random.default_rng(0 if mode == "train" else 1)
+            self.word_idx = {f"w{i}": i for i in range(100)}
+            for _ in range(n_synthetic):
+                T = int(rng.integers(5, 15))
+                w = rng.integers(0, 100, T).astype(np.int64)
+                p = (rng.random(T) < 0.2).astype(np.int64)
+                l = rng.integers(0, self.N_LABELS, T).astype(np.int64)
+                self.samples.append((w, p, l))
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, i):
+        return self.samples[i]
+
+
+# ---------------------------------------------------------------------------
+# Viterbi decode (CRF inference) — lax.scan over time, batched
+# ---------------------------------------------------------------------------
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=False, name=None):
+    """Best tag path per sequence. potentials [B, T, N] (emission scores),
+    transition_params [N, N]; optional lengths [B] restrict the decode to
+    each sequence's valid prefix (positions past the length repeat the
+    final valid tag). Returns (scores [B], paths [B, T] int64).
+    Dynamic-programming scan — compiler-friendly control flow, no
+    python-loop-over-time."""
+    if include_bos_eos_tag:
+        raise NotImplementedError(
+            "include_bos_eos_tag=True (implicit SOS/EOS transitions) is "
+            "not supported; add explicit bos/eos rows to the emissions")
+
+    def f(emis, trans, *maybe_len):
+        B, T, N = emis.shape
+        lens = maybe_len[0] if maybe_len else None
+
+        def step(carry, xs):
+            alpha = carry                                   # [B, N]
+            e_t, t = xs
+            scores = alpha[:, :, None] + trans[None]        # [B, N, N]
+            best = scores.max(axis=1) + e_t                 # [B, N]
+            back = scores.argmax(axis=1)                    # [B, N]
+            if lens is not None:
+                active = (t < lens)[:, None]                # [B, 1]
+                best = jnp.where(active, best, alpha)       # freeze alpha
+                ident = jnp.broadcast_to(
+                    jnp.arange(N)[None], (B, N))            # pass-through
+                back = jnp.where(active, back, ident)
+            return best, back
+
+        alpha0 = emis[:, 0]
+        ts = jnp.arange(1, T)
+        alpha, backs = jax.lax.scan(
+            step, alpha0, (jnp.swapaxes(emis[:, 1:], 0, 1), ts))
+        score = alpha.max(axis=1)
+        last = alpha.argmax(axis=1)
+
+        def backtrack(carry, back_t):
+            tag = carry
+            prev = jnp.take_along_axis(back_t, tag[:, None], 1)[:, 0]
+            return prev, prev
+
+        _, path_rev = jax.lax.scan(backtrack, last, backs, reverse=True)
+        paths = jnp.concatenate([path_rev, last[None]], 0)  # [T, B]
+        return score, jnp.swapaxes(paths, 0, 1).astype(jnp.int64)
+
+    args = [potentials, transition_params]
+    if lengths is not None:
+        args.append(lengths)
+    return apply(f, *args, op_name="viterbi_decode")
+
+
+class ViterbiDecoder:
+    """Layer-style wrapper holding transitions (reference ViterbiDecoder)."""
+
+    def __init__(self, transitions, include_bos_eos_tag=False, name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

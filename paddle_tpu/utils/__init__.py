@@ -1,7 +1,9 @@
 """paddle.utils: measurement tooling (op microbench, collective BW probe)
 + misc helpers. Reference: python/paddle/utils/ + the op_tester benchmark
 binary (operators/benchmark/op_tester.cc)."""
-from . import op_bench  # noqa: F401
 from . import collective_bench  # noqa: F401
+from . import custom_op  # noqa: F401
+from . import op_bench  # noqa: F401
+from .custom_op import register_op  # noqa: F401
 
-__all__ = ["op_bench", "collective_bench"]
+__all__ = ["op_bench", "collective_bench", "custom_op", "register_op"]

@@ -1,0 +1,127 @@
+"""Custom-op extension API — register user kernels without editing ops/.
+
+Reference: utils/cpp_extension + framework/custom_operator.cc let users
+compile C++/CUDA ops against stable headers and register them into the
+op registry at import time.
+
+TPU-native: a custom kernel is a JAX-traceable function (jnp composition
+or a Pallas TPU kernel — the CUDA analog here); registration wires it
+into the eager tape (core.tensor.apply), the AMP lists, and optionally
+the paddle namespace / Tensor methods. A custom backward is attached as
+jax.custom_vjp, mirroring the reference's (forward, backward) op pairs.
+
+    from paddle_tpu.utils.custom_op import register_op
+
+    @register_op("custom_relu", tensor_method=True)
+    def custom_relu(x):
+        return jnp.maximum(x, 0)
+
+    # with hand-written backward (e.g. wrapping a Pallas kernel pair):
+    register_op("my_gelu", fwd_fn, grad_fn=bwd_fn)
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+
+__all__ = ["register_op", "deregister_op", "registered_ops"]
+
+_registry = {}
+
+
+def register_op(name: str, fn: Optional[Callable] = None, *,
+                grad_fn: Optional[Callable] = None,
+                tensor_method: bool = False,
+                namespace: bool = True,
+                amp_list: Optional[str] = None):
+    """Register `fn(*raw_arrays, **kwargs) -> array(s)` as op `name`.
+
+    grad_fn(res, cotangent) -> input cotangents, with res = (inputs, out);
+    omitted -> autodiff through the traced body (jax.vjp).
+    tensor_method -> also attach as Tensor.<name>.
+    namespace -> expose as paddle_tpu.<name> / paddle_tpu.ops.<name>.
+    amp_list -> "white" (run in bf16 under autocast) or "black" (force f32).
+    """
+    if fn is None:
+        return lambda f: register_op(name, f, grad_fn=grad_fn,
+                                     tensor_method=tensor_method,
+                                     namespace=namespace, amp_list=amp_list)
+    if amp_list not in (None, "white", "black"):
+        raise ValueError("amp_list must be 'white' or 'black'")
+    from ..core.tensor import Tensor, apply
+
+    def _make_kernel(kwargs):
+        """Kwargs are compile-time attrs (reference op Attrs): close over
+        them so the custom_vjp callable stays positional-only."""
+        if grad_fn is None:
+            return lambda *raw: fn(*raw, **kwargs)
+
+        @jax.custom_vjp
+        def kernel(*raw):
+            return fn(*raw, **kwargs)
+
+        def k_fwd(*raw):
+            out = fn(*raw, **kwargs)
+            return out, (raw, out)
+
+        def k_bwd(res, g):
+            cots = grad_fn(res, g)
+            if not isinstance(cots, (tuple, list)):
+                cots = (cots,)
+            return tuple(cots)
+
+        kernel.defvjp(k_fwd, k_bwd)
+        return kernel
+
+    @functools.wraps(fn)
+    def op(*args, **kwargs):
+        return apply(_make_kernel(kwargs), *args, op_name=name)
+
+    # refuse to shadow core API surface (reference: duplicate op
+    # registration is a hard error in OpRegistry)
+    import paddle_tpu
+    import paddle_tpu.ops as ops_mod
+    for mod in ((paddle_tpu, ops_mod) if namespace else ()):
+        existing = getattr(mod, name, None)
+        if existing is not None and _registry.get(name) is not existing:
+            raise ValueError(
+                f"register_op: {name!r} already exists on "
+                f"{mod.__name__}; pick another name or deregister first")
+    if tensor_method and name in Tensor.__dict__ \
+            and _registry.get(name) is not Tensor.__dict__[name]:
+        raise ValueError(f"register_op: Tensor.{name} already exists")
+
+    _registry[name] = op
+    if namespace:
+        setattr(ops_mod, name, op)
+        setattr(paddle_tpu, name, op)
+    if tensor_method:
+        setattr(Tensor, name, op)
+    if amp_list:
+        from .. import amp as amp_mod
+        (amp_mod.WHITE_LIST if amp_list == "white"
+         else amp_mod.BLACK_LIST).add(name)
+    return op
+
+
+def deregister_op(name: str):
+    op = _registry.pop(name, None)
+    if op is None:
+        return
+    import paddle_tpu
+    import paddle_tpu.ops as ops_mod
+    from ..core.tensor import Tensor
+    for mod in (paddle_tpu, ops_mod):
+        if getattr(mod, name, None) is op:
+            delattr(mod, name)
+    if getattr(Tensor, name, None) is op:
+        delattr(Tensor, name)
+    from .. import amp as amp_mod
+    amp_mod.WHITE_LIST.discard(name)
+    amp_mod.BLACK_LIST.discard(name)
+
+
+def registered_ops():
+    return dict(_registry)

@@ -1,0 +1,224 @@
+"""paddle.text datasets + viterbi, custom-op registration, stat registry,
+float64-leak audit (ADVICE r1: x64 side effects)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.io as io
+from paddle_tpu import text
+
+
+def test_imdb_dataset_and_training_signal():
+    ds = text.Imdb(mode="train")
+    ids, label = ds[0]
+    assert ids.dtype == np.int64 and label in (0, 1)
+    # marker tokens make labels learnable
+    good, bad = ds.word_idx.get("good"), ds.word_idx.get("bad")
+    hits = sum((good in d.tolist()) == bool(l)
+               for d, l in zip(ds.docs, ds.labels))
+    assert hits == len(ds)
+    assert bad is not None
+
+
+def test_imikolov_ngrams():
+    ds = text.Imikolov(window_size=3)
+    s = ds[0]
+    assert len(s) == 3 and all(isinstance(v, np.int64) for v in s)
+    assert len(ds) > 100
+
+
+def test_ucihousing_with_dataloader():
+    ds = text.UCIHousing(mode="train")
+    x, y = ds[0]
+    assert x.shape == (13,) and y.shape == (1,)
+    dl = io.DataLoader(ds, batch_size=16)
+    xb, yb = next(iter(dl))
+    assert xb.shape == [16, 13]
+
+
+def test_wmt14_and_conll_and_movielens():
+    w = text.WMT14()
+    src, trg_in, trg_out = w[0]
+    assert trg_in[0] == w.trg_idx["<s>"] and trg_out[-1] == w.trg_idx["<e>"]
+    np.testing.assert_array_equal(trg_in[1:], trg_out[:-1])
+
+    c = text.Conll05st()
+    words, preds, labels = c[0]
+    assert words.shape == preds.shape == labels.shape
+
+    m = text.Movielens()
+    u, mv, r = m[0]
+    assert 1.0 <= r <= 5.0
+
+
+def test_imdb_file_loader(tmp_path):
+    p = tmp_path / "imdb.tsv"
+    p.write_text("1\tgreat movie\n0\tterrible film\n")
+    ds = text.Imdb(data_file=str(p))
+    assert len(ds) == 2
+    assert ds[0][1] == 1 and ds[1][1] == 0
+
+
+def test_viterbi_decode_matches_bruteforce():
+    rng = np.random.default_rng(0)
+    B, T, N = 2, 5, 3
+    emis = rng.normal(size=(B, T, N)).astype(np.float32)
+    trans = rng.normal(size=(N, N)).astype(np.float32)
+
+    def brute(e):
+        import itertools
+        best, path = -1e30, None
+        for p in itertools.product(range(N), repeat=T):
+            s = e[0, p[0]] + sum(trans[p[i - 1], p[i]] + e[i, p[i]]
+                                 for i in range(1, T))
+            if s > best:
+                best, path = s, p
+        return best, path
+
+    scores, paths = text.viterbi_decode(
+        paddle.to_tensor(emis), paddle.to_tensor(trans))
+    for b in range(B):
+        bs, bp = brute(emis[b])
+        assert abs(float(scores.numpy()[b]) - bs) < 1e-4
+        assert tuple(paths.numpy()[b]) == bp
+
+    dec = text.ViterbiDecoder(paddle.to_tensor(trans))
+    s2, p2 = dec(paddle.to_tensor(emis))
+    np.testing.assert_array_equal(p2.numpy(), paths.numpy())
+
+
+def test_register_custom_op_roundtrip():
+    from paddle_tpu.utils.custom_op import deregister_op, register_op
+
+    @register_op("my_square_plus", tensor_method=True, amp_list="white")
+    def my_square_plus(x, c=1.0):
+        return x * x + c
+
+    try:
+        t = paddle.to_tensor(np.array([1., 2.], np.float32),
+                             stop_gradient=False)
+        out = paddle.my_square_plus(t, c=2.0)
+        np.testing.assert_allclose(out.numpy(), [3., 6.])
+        out.backward(paddle.to_tensor(np.ones(2, np.float32)))
+        np.testing.assert_allclose(t.grad.numpy(), [2., 4.])  # autodiff
+        assert hasattr(t, "my_square_plus")
+        from paddle_tpu import amp as amp_mod
+        assert "my_square_plus" in amp_mod.WHITE_LIST
+    finally:
+        deregister_op("my_square_plus")
+    assert not hasattr(paddle, "my_square_plus")
+
+
+def test_register_custom_op_with_grad_fn():
+    from paddle_tpu.utils.custom_op import deregister_op, register_op
+
+    def grad_fn(res, g):
+        (x,), _ = res
+        return (jnp.full_like(x, 7.0) * g,)   # deliberately fake grad
+
+    register_op("fake_grad_relu", lambda x: jnp.maximum(x, 0),
+                grad_fn=grad_fn)
+    try:
+        t = paddle.to_tensor(np.array([-1., 2.], np.float32),
+                             stop_gradient=False)
+        out = paddle.fake_grad_relu(t)
+        out.backward(paddle.to_tensor(np.ones(2, np.float32)))
+        np.testing.assert_allclose(t.grad.numpy(), [7., 7.])
+    finally:
+        deregister_op("fake_grad_relu")
+
+
+def test_stat_registry_and_memory():
+    from paddle_tpu.core import monitor
+    monitor.stat_reset()
+    monitor.stat_inc("steps")
+    monitor.stat_inc("steps", 4)
+    assert monitor.stat_get("steps") == 5
+    monitor.stat_set("epoch", 2)
+    assert monitor.all_stats() == {"steps": 5, "epoch": 2}
+    st = monitor.device_memory_stats()
+    assert isinstance(st, dict)
+
+
+def test_no_float64_leak_from_f32_ops():
+    """ADVICE r1 (medium): jax x64 is on; public f32-in ops must not emit
+    float64 (it errors or degrades on real TPU)."""
+    a32 = paddle.to_tensor(np.ones((3, 3), np.float32))
+    ops_to_check = [
+        lambda: paddle.divide(a32, a32),
+        lambda: paddle.mean(a32),
+        lambda: paddle.var(a32),
+        lambda: paddle.norm(a32),
+        lambda: paddle.softmax(a32._data) if hasattr(paddle, "softmax")
+        else paddle.exp(a32),
+        lambda: paddle.cumsum(a32),
+        lambda: paddle.logsumexp(a32),
+        lambda: paddle.nn.functional.interpolate(
+            paddle.to_tensor(np.ones((1, 1, 4, 4), np.float32)),
+            scale_factor=2),
+        lambda: paddle.nn.functional.log_softmax(a32),
+        lambda: paddle.matmul(a32, a32),
+    ]
+    for fn in ops_to_check:
+        out = fn()
+        arr = out._data if hasattr(out, "_data") else out
+        assert arr.dtype != jnp.float64, fn
+
+
+def test_send_recv_warn_on_implicit_ranks():
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.mesh import build_mesh, set_mesh
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    n = len(jax.devices())
+    mesh = build_mesh({"dp": n})
+    set_mesh(mesh)
+    arr = jax.device_put(jnp.ones((n,), jnp.float32),
+                         NamedSharding(mesh, P("dp")))
+    x = paddle.to_tensor(arr)
+    with pytest.warns(UserWarning, match="RECEIVE ZEROS"):
+        dist.send(x, dst=1)
+    with pytest.warns(UserWarning, match="RECEIVE ZEROS"):
+        dist.recv(x, src=0)
+
+
+def test_viterbi_decode_with_lengths():
+    rng = np.random.default_rng(3)
+    N = 3
+    emis = rng.normal(size=(2, 6, N)).astype(np.float32)
+    trans = rng.normal(size=(N, N)).astype(np.float32)
+    lengths = np.array([4, 6], np.int64)
+    sc, paths = text.viterbi_decode(paddle.to_tensor(emis),
+                                    paddle.to_tensor(trans),
+                                    paddle.to_tensor(lengths))
+    # row 0 must match decoding its 4-step prefix alone
+    sc4, p4 = text.viterbi_decode(paddle.to_tensor(emis[:1, :4]),
+                                  paddle.to_tensor(trans))
+    assert abs(float(sc.numpy()[0]) - float(sc4.numpy()[0])) < 1e-4
+    np.testing.assert_array_equal(paths.numpy()[0, :4], p4.numpy()[0])
+    # positions past the length repeat the final valid tag
+    assert (paths.numpy()[0, 4:] == paths.numpy()[0, 3]).all()
+
+
+def test_register_op_rejects_collisions_and_kwargs_with_grad():
+    from paddle_tpu.utils.custom_op import deregister_op, register_op
+
+    with pytest.raises(ValueError, match="already exists"):
+        register_op("mean", lambda x: x)
+    with pytest.raises(ValueError, match="amp_list"):
+        register_op("zz_bad_amp", lambda x: x, amp_list="whte")
+    assert not hasattr(paddle, "zz_bad_amp")   # nothing half-registered
+
+    # kwargs + grad_fn + bare-array cotangent all work together
+    register_op("zz_scaled", lambda x, c=1.0: x * c,
+                grad_fn=lambda res, g: g * 3.0)
+    try:
+        t = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+        out = paddle.zz_scaled(t, c=5.0)
+        np.testing.assert_allclose(out.numpy(), 5.0)
+        out.backward(paddle.to_tensor(np.ones(2, np.float32)))
+        np.testing.assert_allclose(t.grad.numpy(), 3.0)
+    finally:
+        deregister_op("zz_scaled")
