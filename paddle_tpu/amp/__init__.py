@@ -30,7 +30,7 @@ __all__ = ["auto_cast", "amp_guard", "GradScaler", "decorate",
 # fp16_lists.py analog: ops that are numerically safe/beneficial in low
 # precision (matmul-class feeds the MXU) vs ops that must stay fp32.
 WHITE_LIST = {"matmul", "linear", "conv1d", "conv2d", "conv3d", "einsum",
-              "flash_attention", "sdpa", "mm", "bmm"}
+              "flash_attention", "sdpa", "sp_attention", "mm", "bmm"}
 BLACK_LIST = {"softmax", "log_softmax", "cross_entropy", "layer_norm",
               "batch_norm", "norm", "mean", "sum", "exp", "log", "logsumexp",
               "cumsum", "softmax_with_cross_entropy", "kl_div", "nll_loss"}

@@ -51,8 +51,7 @@ class CompiledTrainStep:
         self._opt = None
 
     def step(self, *data, lr=None):
-        data = tuple(jax.device_put(jnp.asarray(d), self.data_sharding)
-                     for d in data)
+        data = tuple(self._put_data(d) for d in data)
         key = random_mod.next_key()
         if lr is None:
             # follow the optimizer's configured lr / scheduler
@@ -61,6 +60,15 @@ class CompiledTrainStep:
         loss, self.params, self.state, self.opt_state = self._step(
             self.params, self.state, self.opt_state, key, lr, data)
         return loss
+
+    def _put_data(self, d):
+        """Shard one data arg; the spec is truncated to the array's rank
+        (a [B] per-sample tensor under dp x sp sharding takes P('dp'))."""
+        d = jnp.asarray(d)
+        sh = self.data_sharding
+        if isinstance(sh, NamedSharding) and len(sh.spec) > d.ndim:
+            sh = NamedSharding(sh.mesh, P(*sh.spec[:d.ndim]))
+        return jax.device_put(d, sh)
 
     def write_back(self):
         """Copy sharded params back into the Layer tree (host-gathered)."""
@@ -155,6 +163,7 @@ def compile_train_step(layer, optimizer, strategy: DistributedStrategy,
     recompute = bool(strategy.recompute)
     n_tp = int(mesh.shape.get("tp", 1))
     n_dp = int(mesh.shape.get("dp", 1))
+    n_sp = int(mesh.shape.get("sp", 1))
     stage = strategy.sharding_stage()
     k_merge = (strategy.gradient_merge_configs.k_steps
                if strategy.gradient_merge else 1)
@@ -176,15 +185,23 @@ def compile_train_step(layer, optimizer, strategy: DistributedStrategy,
     s_sh = _slot_shardings(mesh, opt_state, params, slot_specs)
     buf_sh = {k: NamedSharding(mesh, P(*([None] * getattr(v, "ndim", 0))))
               for k, v in state.items()}
-    data_sh = NamedSharding(mesh, P("dp"))  # leading batch dim over dp
+    # batch over dp; with sequence parallel the seq dim rides 'sp' too
+    data_sh = NamedSharding(mesh, P("dp", "sp") if n_sp > 1 else P("dp"))
 
     # ---- the traced step -------------------------------------------------
     def forward_loss(p, st, key, *data):
+        import contextlib
+
         from ... import amp as amp_mod
+        from ...nn.functional.attention import seq_parallel_scope
+        sp_ctx = seq_parallel_scope(
+            mesh, "sp", impl=strategy.sequence_parallel_impl,
+            batch_axis="dp" if n_dp > 1 else None) if n_sp > 1             else contextlib.nullcontext()
         with random_mod.key_scope(key):
             with amp_mod.auto_cast(enable=amp_on, level="O2" if pure_bf16
                                    else "O1", dtype="bfloat16"):
-                out, new_state = functional_call(wrapped, p, st, *data)
+                with sp_ctx:
+                    out, new_state = functional_call(wrapped, p, st, *data)
         return out, new_state
 
     if recompute:
@@ -233,7 +250,7 @@ def compile_train_step(layer, optimizer, strategy: DistributedStrategy,
     jitted = jax.jit(
         train_step,
         # data is a tuple pytree; a single sharding broadcasts to all leaves
-        in_shardings=(p_sh, buf_sh, s_sh, None, None, data_sh),
+        in_shardings=(p_sh, buf_sh, s_sh, None, None, None),
         out_shardings=(NamedSharding(mesh, P()), p_sh, buf_sh, s_sh),
         donate_argnums=(0, 2))
 
@@ -365,7 +382,7 @@ def _compile_pipeline_step(layer, optimizer, strategy, mesh):
 
     jitted = jax.jit(
         train_step,
-        in_shardings=(p_sh, buf_sh, s_sh, None, None, data_sh),
+        in_shardings=(p_sh, buf_sh, s_sh, None, None, None),
         out_shardings=(NamedSharding(mesh, P()), p_sh, buf_sh, s_sh),
         donate_argnums=(0, 2))
 

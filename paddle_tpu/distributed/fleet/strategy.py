@@ -77,6 +77,7 @@ class DistributedStrategy:
         self.gradient_merge_configs = GradientMergeConfig()
         self.tensor_parallel = False
         self.sequence_parallel = False
+        self.sequence_parallel_impl = "ring"   # "ring" | "ulysses"
         self.hybrid_configs = HybridConfig()
         self.find_unused_parameters = False
         self.fuse_all_reduce_ops = True     # parity no-op: XLA fuses

@@ -1,0 +1,159 @@
+"""Sequence/context parallelism: ring attention + Ulysses all-to-all.
+
+The reference has NO long-context parallelism (SURVEY.md §5: repo-wide
+grep negative — long sequences were handled by recompute + AMP only), so
+these are new TPU-native components, not ports. They shard the SEQUENCE
+dim of attention across the 'sp' mesh axis so context length scales with
+chip count:
+
+* ring_attention — K/V blocks rotate around the ICI ring (lax.ppermute)
+  while Q stays resident; softmax is accumulated online (flash-style
+  m/l/acc state) so no rank ever materialises full-T scores. Peak
+  activation per chip: O(T/sp * T/sp) per step. Causal blocks strictly
+  above the diagonal are computed-but-masked (they cost one matmul but
+  keep the schedule static; a pl.when-style skip is a future optimisation).
+
+* ulysses_attention — all-to-all re-shards [B, T/sp, H, D] into
+  [B, T, H/sp, D] (heads split, sequence gathered), runs ordinary
+  attention per head group (which routes to the Pallas flash kernel at
+  qualifying shapes), and all-to-alls back. Needs H % sp == 0; comm is
+  2 all-to-alls instead of sp ppermutes, usually the winner on ICI while
+  heads are plentiful.
+
+Both run inside jax.shard_map over the 'sp' axis and compose with dp
+(batch dim left to the caller's specs). Layouts follow the framework's
+[B, T, H, D] sdpa convention.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["ring_attention", "ulysses_attention",
+           "make_ring_attention", "make_ulysses_attention"]
+
+
+def _block_attn_state(q, k, v, scale, m, l, acc, q_off, kv_off, causal):
+    """One online-softmax accumulation step of q against a K/V block.
+    q [B,Tq,H,D]; k,v [B,Tk,H,D]; m,l [B,H,Tq]; acc [B,Tq,H,D]."""
+    qt = jnp.swapaxes(q, 1, 2)                     # [B,H,Tq,D]
+    kt = jnp.swapaxes(k, 1, 2)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
+    s = s.astype(jnp.float32)
+    if causal:
+        Tq, Tk = q.shape[1], k.shape[1]
+        qpos = q_off + jnp.arange(Tq)[:, None]
+        kpos = kv_off + jnp.arange(Tk)[None, :]
+        s = jnp.where(qpos >= kpos, s, jnp.float32(-1e30))
+    m_new = jnp.maximum(m, s.max(axis=-1))         # [B,H,Tq]
+    p = jnp.exp(s - m_new[..., None])              # [B,H,Tq,Tk]
+    corr = jnp.exp(m - m_new)                      # [B,H,Tq]
+    l_new = l * corr + p.sum(axis=-1)
+    vt = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
+    pv = jnp.einsum("bhqk,bhkd->bhqd", p, vt)      # [B,H,Tq,D]
+    # acc layout [B,Tq,H,D]: bring corr to [B,Tq,H,1]
+    corr_b = jnp.transpose(corr, (0, 2, 1))[..., None]
+    acc_new = acc * corr_b + jnp.transpose(pv, (0, 2, 1, 3))
+    return m_new, l_new, acc_new
+
+
+def ring_attention(q, k, v, axis: str = "sp", causal: bool = False,
+                   scale=None):
+    """Shard_map-INNER ring attention: q/k/v are the local [B, T/sp, H, D]
+    shards; returns the local output shard. Call inside shard_map/pjit
+    over `axis` (or use make_ring_attention for the wrapped version)."""
+    B, Tl, H, D = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+    n = jax.lax.axis_size(axis)
+    r = jax.lax.axis_index(axis)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    m0 = jnp.full((B, H, Tl), -1e30, jnp.float32)
+    l0 = jnp.zeros((B, H, Tl), jnp.float32)
+    acc0 = jnp.zeros((B, Tl, H, D), jnp.float32)
+
+    def step(carry, t):
+        kb, vb, m, l, acc = carry
+        j = (r - t) % n                  # which global block we now hold
+        m, l, acc = _block_attn_state(
+            q, kb, vb, scale, m, l, acc,
+            q_off=r * Tl, kv_off=j * Tl, causal=causal)
+        kb = jax.lax.ppermute(kb, axis, perm)
+        vb = jax.lax.ppermute(vb, axis, perm)
+        return (kb, vb, m, l, acc), None
+
+    (_, _, m, l, acc), _ = jax.lax.scan(
+        step, (k, v, m0, l0, acc0), jnp.arange(n))
+    denom = jnp.transpose(jnp.maximum(l, 1e-30), (0, 2, 1))[..., None]
+    return (acc / denom).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis: str = "sp", causal: bool = False,
+                      scale=None):
+    """Shard_map-INNER Ulysses: local [B, T/sp, H, D] -> all_to_all to
+    [B, T, H/sp, D] -> full attention -> all_to_all back."""
+    n = jax.lax.axis_size(axis)
+    del n  # head split count == axis size; all_to_all handles it
+
+    def a2a(x, split, concat):
+        return jax.lax.all_to_all(x, axis, split_axis=split,
+                                  concat_axis=concat, tiled=True)
+
+    qg = a2a(q, 2, 1)   # [B, T, H/sp, D]
+    kg = a2a(k, 2, 1)
+    vg = a2a(v, 2, 1)
+
+    D = q.shape[-1]
+    T = qg.shape[1]
+    s = scale if scale is not None else 1.0 / math.sqrt(D)
+
+    # flash kernel on the gathered shard when it qualifies (same routing
+    # rule as sdpa), else the XLA composition — O(T) memory either way on
+    # TPU; the composition materialises [B, H/sp, T, T] and is the CPU/
+    # small-shape fallback
+    from ..core.flags import get_flags as _gf
+    use_flash = (jax.default_backend() == "tpu"
+                 and _gf("use_pallas_attention")
+                 and T % 128 == 0
+                 and T >= _gf("pallas_attention_min_seq"))
+    if use_flash:
+        from ..ops.pallas.flash_attention import flash_attention
+        out = flash_attention(qg, kg, vg, causal=causal, scale=s)
+    else:
+        qt = jnp.swapaxes(qg, 1, 2)
+        kt = jnp.swapaxes(kg, 1, 2)
+        logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * s
+        logits = logits.astype(jnp.float32)
+        if causal:
+            mask = jnp.tril(jnp.ones((T, T), bool))
+            logits = jnp.where(mask, logits, jnp.float32(-1e30))
+        probs = jax.nn.softmax(logits, axis=-1).astype(qg.dtype)
+        out = jnp.einsum("bhqk,bhkd->bhqd", probs, jnp.swapaxes(vg, 1, 2))
+        out = jnp.swapaxes(out, 1, 2)   # [B, T, H/sp, D]
+    return a2a(out, 1, 2)               # back to [B, T/sp, H, D]
+
+
+def make_ring_attention(mesh, axis: str = "sp", causal: bool = False,
+                        scale=None, batch_axis: str = None):
+    """Jit-level wrapper: global [B, T, H, D] arrays, seq dim sharded over
+    `axis` inside one shard_map (optionally batch over `batch_axis`)."""
+    dspec = P(batch_axis, axis, None, None)
+
+    fn = functools.partial(ring_attention, axis=axis, causal=causal,
+                           scale=scale)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(dspec, dspec, dspec),
+                         out_specs=dspec, check_vma=False)
+
+
+def make_ulysses_attention(mesh, axis: str = "sp", causal: bool = False,
+                           scale=None, batch_axis: str = None):
+    dspec = P(batch_axis, axis, None, None)
+    fn = functools.partial(ulysses_attention, axis=axis, causal=causal,
+                           scale=scale)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(dspec, dspec, dspec),
+                         out_specs=dspec, check_vma=False)

@@ -16,7 +16,32 @@ import jax.numpy as jnp
 from ...core.flags import get_flags
 from ...core.tensor import Tensor, apply
 
-__all__ = ["scaled_dot_product_attention"]
+__all__ = ["scaled_dot_product_attention", "seq_parallel_scope"]
+
+# sequence-parallel routing context: when set (by the fleet strategy
+# compiler or user code), qualifying sdpa calls run ring/Ulysses attention
+# over the 'sp' mesh axis instead of single-device attention
+_seq_parallel_ctx = [None]   # (mesh, axis, impl, batch_axis) | None
+
+
+class seq_parallel_scope:
+    """with seq_parallel_scope(mesh, "sp", impl="ring", batch_axis="dp"):
+    attention inside routes through distributed.sequence_parallel."""
+
+    def __init__(self, mesh, axis="sp", impl="ring", batch_axis=None):
+        if impl not in ("ring", "ulysses"):
+            raise ValueError(f"sequence_parallel impl must be 'ring' or "
+                             f"'ulysses', got {impl!r}")
+        self._val = (mesh, axis, impl, batch_axis)
+
+    def __enter__(self):
+        self._prev = _seq_parallel_ctx[0]
+        _seq_parallel_ctx[0] = self._val
+        return self
+
+    def __exit__(self, *exc):
+        _seq_parallel_ctx[0] = self._prev
+        return False
 
 
 def _sdpa_xla(q, k, v, mask, dropout_p, causal, scale, key=None):
@@ -53,6 +78,36 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     if dropout_p > 0.0 and rng_key is None:
         from ...core import random as random_mod
         rng_key = random_mod.next_key()
+
+    sp = _seq_parallel_ctx[0]
+    if sp is not None:
+        mesh, axis, impl, batch_axis = sp
+        n_sp = int(mesh.shape[axis])
+        T, H = query.shape[1], query.shape[2]
+        if attn_mask is not None or dropout_p > 0.0:
+            import warnings
+            warnings.warn(
+                "sequence_parallel is active but this attention call uses "
+                "attn_mask/dropout, which the SP paths do not support — "
+                "falling back to single-device attention (GSPMD will "
+                "gather the sequence dim; no SP memory savings here)")
+        elif T % n_sp:
+            raise ValueError(
+                f"sequence_parallel: seq len {T} not divisible by "
+                f"sp={n_sp} (hybrid_configs.sep_degree)")
+        elif impl == "ulysses" and H % n_sp:
+            raise ValueError(
+                f"sequence_parallel impl='ulysses': num heads {H} not "
+                f"divisible by sp={n_sp}; use impl='ring' or adjust "
+                f"sep_degree")
+        else:
+            from ...distributed.sequence_parallel import (
+                make_ring_attention, make_ulysses_attention)
+            maker = make_ring_attention if impl == "ring" \
+                else make_ulysses_attention
+            f = maker(mesh, axis=axis, causal=is_causal, scale=scale,
+                      batch_axis=batch_axis)
+            return apply(f, query, key, value, op_name="sp_attention")
 
     seq_len = query.shape[1]
     use_pallas = (get_flags("use_pallas_attention") and attn_mask is None

@@ -53,8 +53,11 @@ class Imdb(Dataset):
     (reference reads the aclImdb tar; same sample contract)."""
 
     def __init__(self, data_file: Optional[str] = None, mode: str = "train",
-                 cutoff: int = 150, vocab: Optional[dict] = None,
+                 cutoff: int = 1, vocab: Optional[dict] = None,
                  n_synthetic: int = 256):
+        # cutoff = vocab frequency threshold (reference build_dict cutoff;
+        # default 1 here instead of 150 because local/synthetic corpora
+        # are tiny)
         docs, labels = [], []
         if data_file and os.path.exists(data_file):
             with open(data_file) as f:
@@ -72,7 +75,7 @@ class Imdb(Dataset):
                 pos = rng.random() < 0.5
                 d.insert(0, "good" if pos else "bad")
                 labels.append(int(pos))
-        self.word_idx = vocab or build_vocab(docs)
+        self.word_idx = vocab or build_vocab(docs, min_freq=cutoff)
         unk = self.word_idx.get("<unk>", 1)
         self.docs = [np.array([self.word_idx.get(t, unk) for t in d],
                               np.int64) for d in docs]
@@ -92,6 +95,8 @@ class Imikolov(Dataset):
     def __init__(self, data_file: Optional[str] = None, data_type="NGRAM",
                  window_size: int = 5, mode: str = "train",
                  min_word_freq: int = 1, n_synthetic: int = 128):
+        if data_type not in ("NGRAM", "SEQ"):
+            raise ValueError("data_type must be 'NGRAM' or 'SEQ'")
         if data_file and os.path.exists(data_file):
             with open(data_file) as f:
                 sents = [l.split() for l in f if l.strip()]
@@ -105,9 +110,14 @@ class Imikolov(Dataset):
         for s in sents:
             ids = [self.word_idx.get(t, unk) for t in s]
             ids = [self.word_idx["<s>"]] + ids + [self.word_idx["<e>"]]
-            for i in range(len(ids) - window_size + 1):
-                self.samples.append(tuple(
-                    np.int64(v) for v in ids[i:i + window_size]))
+            if data_type == "SEQ":
+                # whole-sentence LM pairs (input, shifted target)
+                self.samples.append((np.array(ids[:-1], np.int64),
+                                     np.array(ids[1:], np.int64)))
+            else:
+                for i in range(len(ids) - window_size + 1):
+                    self.samples.append(tuple(
+                        np.int64(v) for v in ids[i:i + window_size]))
 
     def __len__(self):
         return len(self.samples)
