@@ -164,12 +164,14 @@ def compile_train_step(layer, optimizer, strategy: DistributedStrategy,
     n_tp = int(mesh.shape.get("tp", 1))
     n_dp = int(mesh.shape.get("dp", 1))
     n_sp = int(mesh.shape.get("sp", 1))
+    n_ep = int(mesh.shape.get("ep", 1))
     stage = strategy.sharding_stage()
     k_merge = (strategy.gradient_merge_configs.k_steps
                if strategy.gradient_merge else 1)
 
     # ---- parameter/state shardings ---------------------------------------
-    tp_specs = _tp_specs(layer, params, strategy) if n_tp > 1 else \
+    tp_specs = _tp_specs(layer, params, strategy) \
+        if (n_tp > 1 or n_ep > 1) else \
         {k: P(*([None] * getattr(v, "ndim", 0))) for k, v in params.items()}
     if stage >= 1:
         zspecs = zero_mod.shard_specs(params, "dp", n_dp)
@@ -194,9 +196,12 @@ def compile_train_step(layer, optimizer, strategy: DistributedStrategy,
 
         from ... import amp as amp_mod
         from ...nn.functional.attention import seq_parallel_scope
-        sp_ctx = seq_parallel_scope(
-            mesh, "sp", impl=strategy.sequence_parallel_impl,
-            batch_axis="dp" if n_dp > 1 else None) if n_sp > 1             else contextlib.nullcontext()
+        if n_sp > 1:
+            sp_ctx = seq_parallel_scope(
+                mesh, "sp", impl=strategy.sequence_parallel_impl,
+                batch_axis="dp" if n_dp > 1 else None)
+        else:
+            sp_ctx = contextlib.nullcontext()
         with random_mod.key_scope(key):
             with amp_mod.auto_cast(enable=amp_on, level="O2" if pure_bf16
                                    else "O1", dtype="bfloat16"):
@@ -299,6 +304,11 @@ def _compile_pipeline_step(layer, optimizer, strategy, mesh):
             "pipeline already microbatches via "
             "pipeline_configs.accumulate_steps; gradient_merge on top is "
             "not supported — fold k_steps into accumulate_steps")
+    if int(mesh.shape.get("sp", 1)) > 1 or int(mesh.shape.get("ep", 1)) > 1:
+        raise NotImplementedError(
+            "pipeline + sequence/expert parallel in one mesh is not "
+            "supported yet; the pipeline shard_map region would need the "
+            "sp/ep collectives inserted manually")
     split = getattr(layer, "pipeline_split_params", None)
     fns = getattr(layer, "pipeline_fns", None)
     if not (callable(split) and callable(fns)):

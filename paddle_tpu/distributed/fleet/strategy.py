@@ -24,6 +24,7 @@ class HybridConfig:
     pp_degree: int = 1           # pipeline ('pp' axis)
     sharding_degree: int = 1     # ZeRO group size over dp
     sep_degree: int = 1          # sequence parallel ('sp' axis)
+    ep_degree: int = 1           # expert parallel ('ep' axis, MoE)
 
 
 @dataclasses.dataclass
@@ -78,6 +79,7 @@ class DistributedStrategy:
         self.tensor_parallel = False
         self.sequence_parallel = False
         self.sequence_parallel_impl = "ring"   # "ring" | "ulysses"
+        self.expert_parallel = False
         self.hybrid_configs = HybridConfig()
         self.find_unused_parameters = False
         self.fuse_all_reduce_ops = True     # parity no-op: XLA fuses
@@ -90,15 +92,17 @@ class DistributedStrategy:
         mp = h.mp_degree if self.tensor_parallel or h.mp_degree > 1 else 1
         pp = h.pp_degree if self.pipeline or h.pp_degree > 1 else 1
         sp = h.sep_degree if self.sequence_parallel or h.sep_degree > 1 else 1
-        fixed = mp * pp * sp
+        ep = h.ep_degree if self.expert_parallel or h.ep_degree > 1 else 1
+        fixed = mp * pp * sp * ep
         if n_devices % fixed:
             raise ValueError(f"{n_devices} devices not divisible by "
-                             f"mp*pp*sp={fixed}")
+                             f"mp*pp*sp*ep={fixed}")
         dp = h.dp_degree if h.dp_degree > 0 else n_devices // fixed
         if dp * fixed != n_devices:
             raise ValueError(
-                f"dp({dp})*mp({mp})*pp({pp})*sp({sp}) != {n_devices}")
-        return {"dp": dp, "pp": pp, "sp": sp, "tp": mp}
+                f"dp({dp})*mp({mp})*pp({pp})*sp({sp})*ep({ep}) "
+                f"!= {n_devices}")
+        return {"dp": dp, "pp": pp, "sp": sp, "tp": mp, "ep": ep}
 
     def build_mesh(self, devices=None):
         devices = list(devices if devices is not None else jax.devices())
@@ -107,8 +111,8 @@ class DistributedStrategy:
         # links; pp outermost tolerates the most latency (scaling-book
         # ordering), mirroring the reference's ring nesting
         shape = {k: v for k, v in
-                 (("pp", deg["pp"]), ("dp", deg["dp"]), ("sp", deg["sp"]),
-                  ("tp", deg["tp"]))}
+                 (("pp", deg["pp"]), ("dp", deg["dp"]), ("ep", deg["ep"]),
+                  ("sp", deg["sp"]), ("tp", deg["tp"]))}
         mesh = mesh_mod.build_mesh(shape, devices=devices)
         mesh_mod.set_mesh(mesh)
         return mesh
@@ -121,5 +125,6 @@ class DistributedStrategy:
     def __repr__(self):
         on = [k for k in ("amp", "recompute", "sharding", "pipeline",
                           "gradient_merge", "tensor_parallel",
-                          "sequence_parallel") if getattr(self, k)]
+                          "sequence_parallel", "expert_parallel")
+              if getattr(self, k)]
         return f"DistributedStrategy(enabled={on}, hybrid={self.hybrid_configs})"

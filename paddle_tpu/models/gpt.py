@@ -41,6 +41,8 @@ class GPTConfig:
     ffn_mult: int = 4
     dropout: float = 0.0
     dtype: str = "float32"
+    moe_experts: int = 0         # >0: MoE FFN with this many experts
+    moe_top_k: int = 2
 
     @property
     def head_dim(self):
@@ -91,13 +93,22 @@ class Block(nn.Layer):
         self.ln1 = nn.LayerNorm(cfg.hidden)
         self.attn = CausalSelfAttention(cfg)
         self.ln2 = nn.LayerNorm(cfg.hidden)
-        self.fc1 = nn.Linear(cfg.hidden, cfg.ffn_mult * cfg.hidden)
-        self.fc2 = nn.Linear(cfg.ffn_mult * cfg.hidden, cfg.hidden)
+        if cfg.moe_experts > 0:
+            # expert-parallel FFN (nn/layer/moe.py; new capability — the
+            # reference has no MoE)
+            self.moe = nn.MoELayer(cfg.hidden, cfg.ffn_mult * cfg.hidden,
+                                   cfg.moe_experts, top_k=cfg.moe_top_k)
+        else:
+            self.fc1 = nn.Linear(cfg.hidden, cfg.ffn_mult * cfg.hidden)
+            self.fc2 = nn.Linear(cfg.ffn_mult * cfg.hidden, cfg.hidden)
         self.drop = nn.Dropout(cfg.dropout)
 
     def forward(self, x):
         x = x + self.attn(self.ln1(x))
-        h = self.fc2(F.gelu(self.fc1(self.ln2(x))))
+        if hasattr(self, "moe"):
+            h = self.moe(self.ln2(x))
+        else:
+            h = self.fc2(F.gelu(self.fc1(self.ln2(x))))
         return x + self.drop(h)
 
 
@@ -129,7 +140,19 @@ class GPT(nn.Layer):
         logits = F.linear(x, self.wte.weight.transpose([1, 0]))
         return logits
 
-    def loss(self, idx, labels):
+    def loss(self, idx, labels, moe_aux_coef=0.01):
+        if self.cfg.moe_experts > 0:
+            from ..nn.layer.moe import collect_aux_losses
+            with collect_aux_losses() as auxes:
+                logits = self.forward(idx)
+            V = logits.shape[-1]
+            ce = F.cross_entropy(logits.reshape([-1, V]),
+                                 labels.reshape([-1]))
+            # Switch load-balance pressure so experts don't collapse
+            total_aux = auxes[0]
+            for a in auxes[1:]:
+                total_aux = total_aux + a
+            return ce + moe_aux_coef * total_aux / max(len(auxes), 1)
         logits = self.forward(idx)
         V = logits.shape[-1]
         return F.cross_entropy(logits.reshape([-1, V]), labels.reshape([-1]))
@@ -233,7 +256,10 @@ def gpt_param_shardings(params, mesh_axis_tp="tp"):
     specs = {}
     for name, v in params.items():
         ndim = len(v.shape)
-        if "qkv.weight" in name or "fc1.weight" in name:
+        if ".moe." in name and name.rsplit(".", 1)[-1] in (
+                "w_in", "b_in", "w_out", "b_out"):
+            specs[name] = P("ep", *([None] * (ndim - 1)))  # expert parallel
+        elif "qkv.weight" in name or "fc1.weight" in name:
             specs[name] = P(None, mesh_axis_tp)          # column parallel
         elif "qkv.bias" in name or "fc1.bias" in name:
             specs[name] = P(mesh_axis_tp)

@@ -4,6 +4,7 @@ from . import initializer  # noqa: F401
 from .layer.activation import *   # noqa: F401,F403
 from .layer.common import *      # noqa: F401,F403
 from .layer.container import *   # noqa: F401,F403
+from .layer.moe import MoELayer  # noqa: F401
 from .layer.conv import *        # noqa: F401,F403
 from .layer.layers import Layer  # noqa: F401
 from .layer.loss import *        # noqa: F401,F403

@@ -1,0 +1,155 @@
+"""Mixture-of-Experts layer with expert parallelism over the 'ep' axis.
+
+The reference snapshot has NO MoE/expert parallelism (SURVEY.md §2
+parallelism census: EP absent) — this is a new TPU-native component.
+
+Design: GSPMD-style einsum dispatch (the Mesh-TensorFlow/Switch
+formulation). Tokens pick experts by gate logits; a capacity-bounded
+dispatch one-hot [tokens, E, C] routes token vectors into per-expert
+batches with two einsums. Expert weights are stacked [E, ...] and
+sharded P('ep', ...): under jit, XLA partitions the expert dimension and
+inserts the all-to-alls — no hand-written collectives, the same
+compiler-owned pattern as the rest of the framework. Tokens over
+capacity are dropped (standard Switch behavior); an auxiliary
+load-balancing loss (Switch-style) is accumulated on the layer.
+
+Routing math is exact w.r.t. the dense equivalent when capacity is
+ample, which is what the tests pin.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import apply
+from ..initializer import Normal
+from .layers import Layer
+
+__all__ = ["MoELayer", "collect_aux_losses"]
+
+# trace-local collector: GPT.loss (or any training loss) opens this scope
+# so every MoE layer's load-balance loss from the CURRENT forward is
+# gathered and added to the objective — storing tracers on the layer
+# across steps would leak them
+_aux_collector = [None]
+
+
+class collect_aux_losses:
+    """with collect_aux_losses() as aux: ...forward...; then sum(aux)."""
+
+    def __enter__(self):
+        self._prev = _aux_collector[0]
+        _aux_collector[0] = []
+        return _aux_collector[0]
+
+    def __exit__(self, *exc):
+        _aux_collector[0] = self._prev
+        return False
+
+
+class MoELayer(Layer):
+    """Top-k routed FFN experts: y = sum_k gate_k * expert_k(x).
+
+    Input [B, T, M] -> output [B, T, M]. Experts are position-wise FFNs
+    (M -> hidden -> M, gelu), weights stacked on a leading E dim.
+    """
+
+    def __init__(self, d_model, d_hidden, num_experts, top_k=2,
+                 capacity_factor=2.0, name=None):
+        super().__init__()
+        self.num_experts = int(num_experts)
+        self.top_k = int(top_k)
+        self.capacity_factor = float(capacity_factor)
+        self.d_model = d_model
+        self.d_hidden = d_hidden
+        init = Normal(0.0, 0.02)
+        E = self.num_experts
+        self.gate_w = self.create_parameter(
+            [d_model, E], default_initializer=init)
+        self.w_in = self.create_parameter(
+            [E, d_model, d_hidden], default_initializer=init)
+        self.b_in = self.create_parameter(
+            [E, d_hidden], is_bias=True)
+        self.w_out = self.create_parameter(
+            [E, d_hidden, d_model], default_initializer=init)
+        self.b_out = self.create_parameter(
+            [E, d_model], is_bias=True)
+        self.aux_loss = None   # set on every forward (load-balance loss)
+
+    # -- strategy-compiler protocol: expert dim rides 'ep' -----------------
+    def param_shardings(self, params, mesh_axis_tp="tp", mesh_axis_ep="ep"):
+        from jax.sharding import PartitionSpec as P
+        specs = {}
+        for name, v in params.items():
+            nd = len(v.shape)
+            if any(name.endswith(s) for s in
+                   ("w_in", "b_in", "w_out", "b_out")):
+                specs[name] = P(mesh_axis_ep, *([None] * (nd - 1)))
+            else:
+                specs[name] = P(*([None] * nd))
+        return specs
+
+    def forward(self, x):
+        E, K = self.num_experts, self.top_k
+        M, H = self.d_model, self.d_hidden
+        cap_f = self.capacity_factor
+
+        def f(xa, gw, wi, bi, wo, bo):
+            B, T, _ = xa.shape
+            N = B * T
+            C = max(int(math.ceil(cap_f * N * K / E)), 1)
+            xt = xa.reshape(N, M)
+            logits = (xt @ gw).astype(jnp.float32)          # [N, E]
+            probs = jax.nn.softmax(logits, axis=-1)
+
+            # top-k routing with capacity: process the k-th choices in
+            # sequence so positions accumulate per expert
+            gates_list, onehot_list = [], []
+            masked = probs
+            for _ in range(K):
+                idx = masked.argmax(axis=-1)                # [N]
+                oh = jax.nn.one_hot(idx, E, dtype=jnp.float32)
+                gates_list.append((probs * oh).sum(-1))     # [N]
+                onehot_list.append(oh)
+                masked = masked * (1.0 - oh)
+
+            # positions within each expert's capacity, counted across the
+            # flattened (k, token) order
+            flat_oh = jnp.concatenate(onehot_list, 0)       # [K*N, E]
+            pos = jnp.cumsum(flat_oh, axis=0) - flat_oh     # [K*N, E]
+            keep = (pos < C) * flat_oh                      # drop overflow
+            pos_id = (pos * flat_oh).sum(-1).astype(jnp.int32)   # [K*N]
+            cap_oh = jax.nn.one_hot(pos_id, C, dtype=jnp.float32)
+
+            gates = jnp.concatenate(gates_list, 0)          # [K*N]
+            # dispatch/combine tensors [K*N, E, C]
+            dispatch = keep[:, :, None] * cap_oh[:, None, :]
+            combine = dispatch * gates[:, None, None]
+
+            xrep = jnp.tile(xt, (K, 1))                     # [K*N, M]
+            expert_in = jnp.einsum("nec,nm->ecm", dispatch,
+                                   xrep.astype(jnp.float32))
+            h = jnp.einsum("ecm,emh->ech", expert_in,
+                           wi.astype(jnp.float32)) + bi[:, None, :]
+            h = jax.nn.gelu(h)
+            eout = jnp.einsum("ech,ehm->ecm", h,
+                              wo.astype(jnp.float32)) + bo[:, None, :]
+            y = jnp.einsum("nec,ecm->nm", combine, eout)    # [K*N, M]
+            y = y.reshape(K, N, M).sum(0)
+
+            # Switch aux loss: E * sum_e frac_tokens_e * mean_prob_e
+            frac = onehot_list[0].mean(0)
+            mean_p = probs.mean(0)
+            aux = (frac * mean_p).sum() * E
+            return y.reshape(B, T, M).astype(xa.dtype), aux
+
+        out, aux = apply(f, x, self.gate_w, self.w_in, self.b_in,
+                         self.w_out, self.b_out, op_name="moe")
+        if _aux_collector[0] is not None:
+            _aux_collector[0].append(aux)
+        import jax.core as _core
+        if not isinstance(aux._data, _core.Tracer):
+            self.aux_loss = aux   # eager convenience; never store tracers
+        return out
