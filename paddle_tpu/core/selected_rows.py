@@ -1,0 +1,79 @@
+"""SelectedRows: the sparse row-subset gradient representation.
+
+Reference: framework/selected_rows.h:41 — a (rows, value, height) triple
+carrying only the embedding rows an op actually touched; the reference's
+sparse-grad path keeps lookup_table gradients in this form so optimizers
+and the parameter server update rows instead of the full table.
+
+TPU-native split: ON-CHIP embedding backward stays a dense scatter-add —
+that is what the MXU/XLA execute efficiently and what the tape produces.
+SelectedRows is the HOST-SIDE interchange format: extracting the touched
+rows from a dense grad (from_dense) for parameter-server push_sparse,
+row-wise optimizer updates on host tables, and compact checkpoint deltas.
+`Embedding(sparse=True)` records the ids of the last forward so the
+touched-row set is known without scanning the dense grad for nonzeros.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["SelectedRows"]
+
+
+class SelectedRows:
+    """rows [n] int64, value [n, ...] — rows index dim 0 of a [height, ...]
+    dense tensor. Duplicate rows are allowed until consolidated."""
+
+    def __init__(self, rows, value, height):
+        self.rows = np.asarray(rows, np.int64).ravel()
+        self.value = np.asarray(value)
+        self.height = int(height)
+        if self.value.shape[0] != self.rows.shape[0]:
+            raise ValueError(
+                f"rows ({self.rows.shape[0]}) and value rows "
+                f"({self.value.shape[0]}) disagree")
+
+    @classmethod
+    def from_dense(cls, dense_grad, ids=None):
+        """Extract the sparse form from a dense gradient. With `ids` (the
+        forward's lookup indices) only those rows are gathered; otherwise
+        nonzero rows are detected."""
+        dense = np.asarray(dense_grad)
+        if ids is not None:
+            rows = np.unique(np.asarray(ids).ravel())
+        else:
+            nz = np.abs(dense).reshape(dense.shape[0], -1).sum(axis=1)
+            rows = np.nonzero(nz)[0]
+        return cls(rows, dense[rows], dense.shape[0])
+
+    def merge_rows(self):
+        """Consolidate duplicate rows by summation (reference
+        MergeAdd functor for SelectedRows)."""
+        uniq, inv = np.unique(self.rows, return_inverse=True)
+        out = np.zeros((uniq.shape[0],) + self.value.shape[1:],
+                       self.value.dtype)
+        np.add.at(out, inv, self.value)
+        return SelectedRows(uniq, out, self.height)
+
+    def to_dense(self):
+        out = np.zeros((self.height,) + self.value.shape[1:],
+                       self.value.dtype)
+        np.add.at(out, self.rows, self.value)
+        return out
+
+    def apply_sgd(self, param, lr):
+        """Row-wise SGD on a host-side numpy table (in place)."""
+        m = self.merge_rows()
+        param[m.rows] -= lr * m.value
+        return param
+
+    def push_to_ps(self, client, table: int, lr: float = 1.0):
+        """One push_sparse RPC carrying only the touched rows
+        (distributed/ps PSClient)."""
+        m = self.merge_rows()
+        client.push_sparse(table, m.rows.astype(np.uint64),
+                           m.value.astype(np.float32), lr=lr)
+
+    def __repr__(self):
+        return (f"SelectedRows(rows={self.rows.shape[0]}, "
+                f"height={self.height}, dim={self.value.shape[1:]})")

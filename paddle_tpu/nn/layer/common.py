@@ -1,6 +1,8 @@
 """Common layers (reference: python/paddle/nn/layer/common.py)."""
 from __future__ import annotations
 
+import jax
+
 from ...core import dtype as dtype_mod
 from .. import functional as F
 from .. import initializer as I
@@ -91,6 +93,8 @@ class Embedding(Layer):
         self._embedding_dim = embedding_dim
         self._padding_idx = None if padding_idx is None else \
             (padding_idx if padding_idx >= 0 else num_embeddings + padding_idx)
+        self._sparse = bool(sparse)
+        self._last_ids = None
         self.weight = self.create_parameter(
             [num_embeddings, embedding_dim], attr=weight_attr,
             default_initializer=I.Normal(0.0, 1.0))
@@ -98,7 +102,41 @@ class Embedding(Layer):
             self.weight._data = self.weight._data.at[self._padding_idx].set(0.0)
 
     def forward(self, x):
+        if self._sparse:
+            # remember the touched rows so sparse_grad() can extract a
+            # SelectedRows view of the dense tape gradient (on-chip
+            # backward stays a dense scatter-add — the XLA-efficient
+            # form; SelectedRows is the host/PS interchange format).
+            # Ids ACCUMULATE across forwards (grads accumulate too) and
+            # reset when sparse_grad() drains them. Tracers (jit) are
+            # skipped: there is no host-side grad to pair them with.
+            import numpy as np
+
+            from ...core.tensor import Tensor
+            raw = x._data if isinstance(x, Tensor) else x
+            if isinstance(raw, jax.core.Tracer):
+                pass
+            else:
+                if self._last_ids is None:
+                    self._last_ids = []
+                self._last_ids.append(np.asarray(raw))
         return F.embedding(x, self.weight, padding_idx=self._padding_idx)
+
+    def sparse_grad(self):
+        """SelectedRows over the rows touched since the last drain —
+        covers every forward that contributed to the accumulated grad
+        (requires sparse=True and a completed backward). Draining resets
+        the recorded id set; pair with clear_grad()."""
+        from ...core.selected_rows import SelectedRows
+        if not self._sparse:
+            raise RuntimeError("Embedding(sparse=True) required")
+        if self.weight.grad is None or not self._last_ids:
+            return None
+        import numpy as np
+        ids = np.concatenate([np.asarray(i).ravel()
+                              for i in self._last_ids])
+        self._last_ids = None
+        return SelectedRows.from_dense(self.weight.grad.numpy(), ids=ids)
 
     def extra_repr(self):
         return f"{self._num_embeddings}, {self._embedding_dim}"

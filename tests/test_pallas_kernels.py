@@ -227,3 +227,42 @@ def test_functional_linear_cross_entropy_tensor_api():
     ref = _ref_lce(x._data, w._data, lbl._data.astype(jnp.int32)).mean()
     np.testing.assert_allclose(float(loss.numpy()), float(ref), rtol=1e-4)
     assert x.grad is not None and w.grad is not None
+
+
+def test_flash_fused_bwd_single_sweep_matches():
+    """The fused single-pass backward (nk==1 route) matches the two-pass
+    scheme exactly."""
+    import os
+    import math
+    import paddle_tpu.ops.pallas.flash_attention as fa
+    rng = np.random.default_rng(11)
+    B, T, H, D = 1, 256, 2, 32
+    scale = 1.0 / math.sqrt(D)
+
+    def to3(x):
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(B * H, T, D)
+
+    q, k, v, ct = (jnp.asarray(rng.standard_normal((B, T, H, D)),
+                               jnp.float32) * 0.3 for _ in range(4))
+    for causal in (False, True):
+        o, lse = fa._fwd(to3(q), to3(k), to3(v), scale, causal)
+        res = (to3(q), to3(k), to3(v), o, lse)
+        d_two = fa._bwd(scale, causal, res, to3(ct))
+        d_fused = fa._bwd_fused(scale, causal, res, to3(ct))
+        for a, b, n in zip(d_fused, d_two, ("dq", "dk", "dv")):
+            np.testing.assert_allclose(np.asarray(a, np.float32),
+                                       np.asarray(b, np.float32),
+                                       atol=1e-4, rtol=1e-4,
+                                       err_msg=f"{n} causal={causal}")
+
+
+def test_flash_bwd_dispatch_routes_by_k_sweeps():
+    import paddle_tpu.ops.pallas.flash_attention as fa
+    # T=256 default bk=256 -> nk=1 -> fused; forced bk=128 -> two-pass
+    assert fa._bwd_block_sizes(256, 32)[1] == 256
+    import os
+    os.environ["PT_FLASH_BWD_BLOCKS"] = "128,128"
+    try:
+        assert fa._bwd_block_sizes(256, 32)[1] == 128
+    finally:
+        del os.environ["PT_FLASH_BWD_BLOCKS"]

@@ -222,3 +222,52 @@ def test_register_op_rejects_collisions_and_kwargs_with_grad():
         np.testing.assert_allclose(t.grad.numpy(), 3.0)
     finally:
         deregister_op("zz_scaled")
+
+
+def test_selected_rows_roundtrip_and_merge():
+    from paddle_tpu.core.selected_rows import SelectedRows
+    sr = SelectedRows([2, 0, 2], np.array([[1., 1.], [2., 2.], [3., 3.]],
+                                          np.float32), height=4)
+    m = sr.merge_rows()
+    assert m.rows.tolist() == [0, 2]
+    np.testing.assert_array_equal(m.value, [[2., 2.], [4., 4.]])
+    dense = sr.to_dense()
+    np.testing.assert_array_equal(dense[2], [4., 4.])
+    assert dense.shape == (4, 2)
+    p = np.zeros((4, 2), np.float32)
+    sr.apply_sgd(p, lr=0.5)
+    np.testing.assert_array_equal(p[2], [-2., -2.])
+
+
+def test_embedding_sparse_grad():
+    import paddle_tpu.nn as nn
+    paddle.seed(0)
+    emb = nn.Embedding(10, 4, sparse=True)
+    ids = paddle.to_tensor(np.array([[1, 3, 1]], np.int64))
+    out = emb(ids)
+    out.sum().backward()
+    sr = emb.sparse_grad()
+    assert sr is not None and sr.rows.tolist() == [1, 3]
+    assert sr.height == 10
+    # touched rows carry grad 1s (row 1 twice -> from_dense gathers the
+    # already-accumulated dense rows)
+    np.testing.assert_allclose(sr.value[0], 2.0)
+    np.testing.assert_allclose(sr.value[1], 1.0)
+
+
+def test_sparse_grad_pushes_to_ps():
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed.ps import PSClient, PSServer
+    paddle.seed(0)
+    with PSServer() as srv:
+        c = PSClient(srv.endpoint)
+        c.create_sparse_table(0, dim=4)
+        emb = nn.Embedding(10, 4, sparse=True)
+        ids = paddle.to_tensor(np.array([[2, 5]], np.int64))
+        emb(ids).sum().backward()
+        emb.sparse_grad().push_to_ps(c, table=0, lr=1.0)
+        rows = c.pull_sparse(0, np.array([2, 5, 7]), dim=4)
+        np.testing.assert_allclose(rows[0], -1.0)
+        np.testing.assert_allclose(rows[1], -1.0)
+        np.testing.assert_allclose(rows[2], 0.0)
+        c.close()
