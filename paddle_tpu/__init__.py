@@ -39,5 +39,6 @@ from . import text  # noqa: F401
 from . import utils  # noqa: F401
 from . import profiler  # noqa: F401
 from .core import monitor  # noqa: F401
+from . import device  # noqa: F401
 
 __version__ = "0.2.0"

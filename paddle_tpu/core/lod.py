@@ -1,0 +1,93 @@
+"""Ragged-sequence utilities — the LoDTensor capability, TPU-shaped.
+
+Reference: LoDTensor (framework/lod_tensor.h:114) carries level-of-detail
+offsets so one dense buffer holds variable-length sequences, and
+sequence ops consume the offsets directly.
+
+TPU-native design decision: XLA requires static shapes, so ragged data
+lives as (padded dense tensor, lengths) — the form every jitted op can
+consume — and LoD offsets become a host-side descriptor used at the data
+boundary. This module converts between the three forms and provides the
+mask/segment helpers the reference's sequence ops derive from LoD:
+
+    pack_sequence   [list of [Ti, ...]] -> (padded [B, Tmax, ...], lengths)
+    unpack_sequence (padded, lengths)   -> list of [Ti, ...]
+    lod_from_lengths / lengths_from_lod   offsets <-> lengths
+    sequence_mask   lengths -> bool [B, Tmax] (traceable)
+    segment_ids     lengths -> flat segment ids for segment reductions
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["pack_sequence", "unpack_sequence", "lod_from_lengths",
+           "lengths_from_lod", "sequence_mask", "segment_ids"]
+
+
+def lod_from_lengths(lengths: Sequence[int]) -> List[int]:
+    """[3, 1, 2] -> [0, 3, 4, 6] (reference level-0 offsets)."""
+    out = [0]
+    for n in lengths:
+        out.append(out[-1] + int(n))
+    return out
+
+
+def lengths_from_lod(lod: Sequence[int]) -> List[int]:
+    return [int(b) - int(a) for a, b in zip(lod[:-1], lod[1:])]
+
+
+def pack_sequence(seqs, pad_value=0, max_len=None):
+    """List of per-sequence arrays [Ti, ...] -> (padded [B, Tmax, ...]
+    numpy array, lengths int64 [B]). The static-shape form XLA wants."""
+    seqs = [np.asarray(s) for s in seqs]
+    lengths = np.array([s.shape[0] for s in seqs], np.int64)
+    Tmax = int(max_len if max_len is not None
+               else (lengths.max() if len(seqs) else 0))
+    trailing = seqs[0].shape[1:] if seqs else ()
+    out = np.full((len(seqs), Tmax) + trailing, pad_value,
+                  seqs[0].dtype if seqs else np.float32)
+    for i, s in enumerate(seqs):
+        t = min(s.shape[0], Tmax)
+        out[i, :t] = s[:t]
+    return out, lengths
+
+
+def unpack_sequence(padded, lengths):
+    padded = np.asarray(padded)
+    return [padded[i, :int(n)] for i, n in enumerate(np.asarray(lengths))]
+
+
+def sequence_mask(lengths, max_len=None, dtype="bool"):
+    """lengths [B] -> mask [B, Tmax]; True on valid positions (reference
+    sequence_mask op). Traceable under jit ONLY with an explicit max_len
+    (shapes must be static); without it, lengths must be concrete."""
+    lengths = jnp.asarray(lengths)
+    if max_len is None:
+        import jax as _jax
+        if isinstance(lengths, _jax.core.Tracer):
+            raise ValueError(
+                "sequence_mask under jit needs an explicit max_len "
+                "(output shape must be static)")
+        max_len = int(np.asarray(lengths).max())
+    pos = jnp.arange(int(max_len))
+    mask = pos[None, :] < lengths[:, None]
+    return mask if dtype == "bool" else mask.astype(dtype)
+
+
+def segment_ids(lengths, total=None):
+    """lengths [B] -> flat ids (0,0,0,1,2,2,...) for segment_sum-style
+    reductions over ragged flat layouts. With `total`, the result is
+    padded to that static length using segment id B (out of range, so
+    segment_sum(num_segments=B) drops the padding) or truncated."""
+    lengths = np.asarray(lengths)
+    ids = np.repeat(np.arange(len(lengths)), lengths)
+    if total is not None:
+        if len(ids) > total:
+            ids = ids[:total]
+        else:
+            ids = np.concatenate(
+                [ids, np.full(total - len(ids), len(lengths), ids.dtype)])
+    return ids

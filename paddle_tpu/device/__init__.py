@@ -1,0 +1,35 @@
+"""paddle.device — device management surface (reference:
+python/paddle/device/__init__.py set_device/get_device; init
+platform/init.cc InitDevices).
+
+TPU-native: PJRT owns device discovery/initialization at first use (the
+InitDevices analog is jax's lazy backend init); this module gives the
+reference's naming. Synchronize flushes outstanding device work."""
+from __future__ import annotations
+
+import jax
+
+from ..core.place import (CPUPlace, Place, TPUPlace,  # noqa: F401
+                          device_count, get_device, is_compiled_with_cuda,
+                          is_compiled_with_tpu, set_device)
+
+__all__ = ["set_device", "get_device", "device_count", "synchronize",
+           "is_compiled_with_cuda", "is_compiled_with_tpu", "CPUPlace",
+           "TPUPlace", "Place", "get_all_device_type"]
+
+
+def synchronize(device=None):
+    """Block until outstanding device work completes (cuda.synchronize
+    parity; on TPU a tiny transfer is the sync point). `device` may be a
+    Place or a jax device; default = all local devices."""
+    if device is None:
+        targets = jax.local_devices()
+    else:
+        targets = [device.jax_device() if isinstance(device, Place)
+                   else device]
+    for d in targets:
+        (jax.device_put(0.0, d) + 0).block_until_ready()
+
+
+def get_all_device_type():
+    return sorted({d.platform for d in jax.devices()})

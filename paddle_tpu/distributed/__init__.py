@@ -38,3 +38,6 @@ def _spawn_entry(func, args, env):
     import os
     os.environ.update(env)
     func(*args)
+
+from . import elastic  # noqa: F401
+from . import sequence_parallel  # noqa: F401

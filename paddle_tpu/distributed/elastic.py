@@ -1,0 +1,102 @@
+"""Failure recovery: gang-restart supervision + checkpoint-resume loop.
+
+Reference state of the art (SURVEY.md §5): no elastic training — the
+launcher watches children and aborts (launch_utils.py:526
+watch_local_trainers), PS mode has a HeartBeatMonitor. The TPU-native
+equivalent: JAX's multi-controller runtime restarts the WHOLE job on any
+worker loss, so recovery = supervisor (gang restart, bounded retries) +
+sharded checkpoint resume (io/checkpoint.py). Two layers:
+
+* supervise() — launcher-level: run the whole trainer gang, restart it
+  from scratch up to max_restarts times when any rank fails. Trainers
+  are expected to resume from their newest checkpoint on startup.
+
+* run_with_recovery() — in-process: drive a step function with periodic
+  checkpoints; on a transient failure, reload the newest checkpoint and
+  continue. Useful for single-process training and as the body of each
+  supervised trainer.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Callable, Optional
+
+__all__ = ["supervise", "run_with_recovery", "latest_checkpoint"]
+
+
+def latest_checkpoint(ckpt_dir: str) -> Optional[str]:
+    """Newest step-numbered checkpoint directory under ckpt_dir
+    (save_checkpoint targets named `step_{n}`)."""
+    if not os.path.isdir(ckpt_dir):
+        return None
+    best, best_step = None, -1
+    for name in os.listdir(ckpt_dir):
+        if name.startswith("step_"):
+            try:
+                s = int(name.split("_", 1)[1])
+            except ValueError:
+                continue
+            if s > best_step and os.path.exists(
+                    os.path.join(ckpt_dir, name, "meta.json")):
+                best, best_step = os.path.join(ckpt_dir, name), s
+    return best
+
+
+def supervise(start_gang: Callable[[], list], max_restarts: int = 3,
+              poll_s: float = 1.0, backoff_s: float = 5.0) -> int:
+    """Launcher-level gang supervision: `start_gang()` launches the
+    trainer processes (e.g. a start_local_trainers closure); any nonzero
+    exit tears the gang down and relaunches it, up to max_restarts.
+    Returns 0 on success; raises after exhausting restarts."""
+    from .launch import watch_local_trainers
+
+    attempt = 0
+    while True:
+        procs = start_gang()
+        try:
+            return watch_local_trainers(procs, poll_s=poll_s)
+        except RuntimeError as e:
+            attempt += 1
+            if attempt > max_restarts:
+                raise RuntimeError(
+                    f"gang failed {attempt} times; giving up") from e
+            time.sleep(backoff_s)
+
+
+def run_with_recovery(step_fn: Callable[[int], None],
+                      save_fn: Callable[[str, int], None],
+                      restore_fn: Callable[[str], int],
+                      ckpt_dir: str, total_steps: int,
+                      checkpoint_every: int = 100,
+                      max_restarts: int = 3):
+    """Checkpointed training loop with transient-failure recovery.
+
+    step_fn(step)            one training step
+    save_fn(path, step)      write a checkpoint (CompiledTrainStep.
+                             save_checkpoint fits directly)
+    restore_fn(path) -> int  load a checkpoint, return its step
+    On an exception from step_fn the newest checkpoint is restored and
+    the loop continues from there, up to max_restarts times."""
+    os.makedirs(ckpt_dir, exist_ok=True)
+    step = 0
+    ck = latest_checkpoint(ckpt_dir)
+    if ck is not None:
+        step = restore_fn(ck)
+    else:
+        # initial snapshot: a failure before the first periodic checkpoint
+        # must restore pristine state, not replay onto mutated params
+        save_fn(os.path.join(ckpt_dir, "step_0"), 0)
+    restarts = 0
+    while step < total_steps:
+        try:
+            step_fn(step)
+            step += 1
+            if step % checkpoint_every == 0 or step == total_steps:
+                save_fn(os.path.join(ckpt_dir, f"step_{step}"), step)
+        except Exception:
+            restarts += 1
+            if restarts > max_restarts:
+                raise
+            step = restore_fn(latest_checkpoint(ckpt_dir))
+    return step

@@ -271,3 +271,52 @@ def test_sparse_grad_pushes_to_ps():
         np.testing.assert_allclose(rows[1], -1.0)
         np.testing.assert_allclose(rows[2], 0.0)
         c.close()
+
+
+def test_lod_pack_unpack_roundtrip():
+    from paddle_tpu.core import lod
+    seqs = [np.arange(3, dtype=np.float32).reshape(3, 1),
+            np.arange(1, dtype=np.float32).reshape(1, 1),
+            np.arange(2, dtype=np.float32).reshape(2, 1)]
+    padded, lengths = lod.pack_sequence(seqs, pad_value=-1)
+    assert padded.shape == (3, 3, 1)
+    assert lengths.tolist() == [3, 1, 2]
+    assert padded[1, 1, 0] == -1
+    back = lod.unpack_sequence(padded, lengths)
+    for a, b in zip(back, seqs):
+        np.testing.assert_array_equal(a, b)
+
+    offs = lod.lod_from_lengths([3, 1, 2])
+    assert offs == [0, 3, 4, 6]
+    assert lod.lengths_from_lod(offs) == [3, 1, 2]
+
+    mask = np.asarray(lod.sequence_mask(lengths))
+    np.testing.assert_array_equal(
+        mask, [[1, 1, 1], [1, 0, 0], [1, 1, 0]])
+    np.testing.assert_array_equal(lod.segment_ids([2, 3]),
+                                  [0, 0, 1, 1, 1])
+
+
+def test_device_module_surface():
+    assert "tpu" in paddle.device.get_all_device_type() or \
+        "cpu" in paddle.device.get_all_device_type()
+    paddle.device.synchronize()
+    assert isinstance(paddle.device.get_device(), str)
+
+
+def test_lod_edge_cases():
+    from paddle_tpu.core import lod
+    # max_len=0 honored (not treated as unset)
+    seqs = [np.ones((3,), np.float32)]
+    padded, _ = lod.pack_sequence(seqs, max_len=0)
+    assert padded.shape == (1, 0)
+    # segment_ids total pads with out-of-range id / truncates
+    np.testing.assert_array_equal(lod.segment_ids([2, 1], total=5),
+                                  [0, 0, 1, 2, 2])
+    np.testing.assert_array_equal(lod.segment_ids([2, 1], total=2), [0, 0])
+    # sequence_mask under jit requires explicit max_len
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="max_len"):
+        jax.jit(lambda l: lod.sequence_mask(l))(jnp.array([2, 1]))
+    m = jax.jit(lambda l: lod.sequence_mask(l, max_len=3))(jnp.array([2, 1]))
+    np.testing.assert_array_equal(np.asarray(m), [[1, 1, 0], [1, 0, 0]])
