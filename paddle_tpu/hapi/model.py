@@ -59,7 +59,10 @@ class Model:
 
     # ------------------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None,
-                amp_configs=None):
+                amp_configs=None, strategy=None):
+        """strategy: a DistributedStrategy routes training through the
+        fleet strategy compiler (dp/ZeRO/tp/sp/ep per its toggles); the
+        eval/predict paths stay single-device on synced params."""
         self._optimizer = optimizer
         self._loss = loss
         self._metrics = _as_list(metrics)
@@ -70,9 +73,17 @@ class Model:
             self._amp_level = amp_configs
         elif isinstance(amp_configs, dict):
             self._amp_level = amp_configs.get("level", "O1")
+        self._strategy = strategy
+        if strategy is not None and self._metrics:
+            import warnings
+            warnings.warn(
+                "metrics are not computed on the strategy training path "
+                "(the compiled step returns only the loss); use "
+                "Model.evaluate() for metrics")
         self._invalidate()
 
     def _invalidate(self):
+        self._dist_prog = None
         self._jit_step = self._jit_eval = self._jit_pred = None
         self._jit_grad = self._jit_apply = None
         self._accum_grads = None
@@ -168,11 +179,69 @@ class Model:
         return jax.jit(eval_step)
 
     # ------------------------------------------------------------------
+    def _dist_train_batch(self, inputs, labels):
+        """Strategy-compiled step (reference: fleet.distributed_optimizer
+        -> meta-optimizer rewrites; here compile_train_step)."""
+        from ..distributed.fleet.compiler import compile_train_step
+
+        if self._dist_prog is None:
+            net, model = self.network, self
+
+            class _LossAdapter:
+                """Presents network+loss as the layer-with-a-loss-method
+                protocol compile_train_step drives. param_shardings is
+                delegated via __getattr__ only when the network has one —
+                the compiler provides the replicated fallback."""
+
+                def named_parameters(self, *a, **k):
+                    return net.named_parameters(*a, **k)
+
+                def named_buffers(self, *a, **k):
+                    return net.named_buffers(*a, **k)
+
+                def __getattr__(self, name):
+                    if name == "param_shardings" and callable(
+                            getattr(net, "param_shardings", None)):
+                        return net.param_shardings
+                    raise AttributeError(name)
+
+                def loss(self, *batch):
+                    k = model._dist_n_inputs
+                    outs = net(*batch[:k])
+                    return Tensor(model._compute_loss(outs,
+                                                      list(batch[k:])))
+
+            self._dist_n_inputs = len(inputs)
+            from ..distributed import mesh as mesh_mod
+            self._dist_prog = compile_train_step(
+                _LossAdapter(), self._optimizer, self._strategy,
+                mesh=mesh_mod.get_mesh())   # honor a pre-built mesh
+            restored = getattr(self, "_restored_opt_state", None)
+            if restored is not None and \
+                    set(restored) == set(self._dist_prog.opt_state):
+                sh = self._dist_prog.shardings["opt"]
+                self._dist_prog.opt_state = {
+                    n: {sl: jax.device_put(jnp.asarray(v), sh[n][sl])
+                        for sl, v in st.items()}
+                    for n, st in restored.items()}
+                self._restored_opt_state = None
+        loss = self._dist_prog.step(*inputs, *labels,
+                                    lr=self._optimizer.get_lr())
+        return [float(jax.device_get(loss))]
+
     def train_batch(self, inputs, labels=None):
         """One optimizer step on a batch; returns [loss] (+metric updates)."""
         if self._optimizer is None:
             raise RuntimeError("call prepare(optimizer, loss) first")
         self.network.train()
+        if getattr(self, "_strategy", None) is not None:
+            if getattr(self, "_grad_accum_n", 1) > 1:
+                raise ValueError(
+                    "accumulate_grad_batches is not supported with a "
+                    "DistributedStrategy; set strategy.gradient_merge "
+                    "and gradient_merge_configs.k_steps instead")
+            return self._dist_train_batch(_as_list(inputs),
+                                          _as_list(labels))
         if self._jit_step is None:
             self._jit_step = self._build_train_step()
             self._params, self._state = self._split_tree()
@@ -215,6 +284,8 @@ class Model:
 
     def eval_batch(self, inputs, labels=None):
         self.network.eval()
+        if getattr(self, "_dist_prog", None) is not None:
+            self._dist_prog.write_back()   # eval on the TRAINED params
         if self._jit_eval is None:
             self._jit_eval = self._build_eval_step()
         if self._jit_step is not None:
@@ -228,6 +299,8 @@ class Model:
 
     def predict_batch(self, inputs):
         self.network.eval()
+        if getattr(self, "_dist_prog", None) is not None:
+            self._dist_prog.write_back()
         if self._jit_eval is None:
             self._jit_eval = self._build_eval_step()
         if self._jit_step is not None:
@@ -416,6 +489,8 @@ class Model:
     # ------------------------------------------------------------------
     def _sync_network(self):
         """Write jitted-step params back into the Layer tree."""
+        if getattr(self, "_dist_prog", None) is not None:
+            self._dist_prog.write_back()
         if self._jit_step is not None:
             self._write_back(self._params, self._state)
 
@@ -437,7 +512,10 @@ class Model:
         fsave(self.network.state_dict(), path + ".pdparams")
         if training and self._optimizer is not None:
             opt_sd = self._optimizer.state_dict()
-            if self._jit_step is not None:
+            if getattr(self, "_dist_prog", None) is not None:
+                opt_sd["functional_state"] = jax.device_get(
+                    self._dist_prog.opt_state)
+            elif self._jit_step is not None:
                 opt_sd["functional_state"] = jax.device_get(self._opt_state)
             with open(path + ".pdopt", "wb") as f:
                 pickle.dump(opt_sd, f, protocol=4)
