@@ -80,6 +80,13 @@ class Model:
                 "metrics are not computed on the strategy training path "
                 "(the compiled step returns only the loss); use "
                 "Model.evaluate() for metrics")
+        if strategy is not None and self._amp_level != "O0" \
+                and not strategy.amp:
+            import warnings
+            warnings.warn(
+                "amp_configs is ignored on the strategy training path; "
+                "set strategy.amp=True (+ amp_configs.use_pure_bf16 for "
+                "O2) instead")
         self._invalidate()
 
     def _invalidate(self):
@@ -199,10 +206,15 @@ class Model:
                 def named_buffers(self, *a, **k):
                     return net.named_buffers(*a, **k)
 
+                _FORWARDED = ("param_shardings",
+                              "pipeline_split_params", "pipeline_fns")
+
                 def __getattr__(self, name):
-                    if name == "param_shardings" and callable(
-                            getattr(net, "param_shardings", None)):
-                        return net.param_shardings
+                    # expose the network's sharding/pipeline protocols to
+                    # the compiler only when the network implements them
+                    if name in self._FORWARDED and callable(
+                            getattr(net, name, None)):
+                        return getattr(net, name)
                     raise AttributeError(name)
 
                 def loss(self, *batch):
@@ -213,12 +225,25 @@ class Model:
 
             self._dist_n_inputs = len(inputs)
             from ..distributed import mesh as mesh_mod
+            mesh = mesh_mod.get_mesh()
+            if mesh is not None:
+                # a stale global mesh from another strategy must not
+                # silently override this strategy's degrees
+                want = self._strategy.resolve_degrees(
+                    len(mesh.devices.ravel()))
+                have = {k: int(v) for k, v in mesh.shape.items()}
+                if {k: v for k, v in want.items()
+                        if k in have} != have:
+                    mesh = None     # compiler rebuilds from the strategy
             self._dist_prog = compile_train_step(
                 _LossAdapter(), self._optimizer, self._strategy,
-                mesh=mesh_mod.get_mesh())   # honor a pre-built mesh
+                mesh=mesh)
             restored = getattr(self, "_restored_opt_state", None)
             if restored is not None and \
-                    set(restored) == set(self._dist_prog.opt_state):
+                    set(restored) == set(self._dist_prog.opt_state) and \
+                    all(set(restored[n]) ==
+                        set(self._dist_prog.opt_state[n])
+                        for n in restored):
                 sh = self._dist_prog.shardings["opt"]
                 self._dist_prog.opt_state = {
                     n: {sl: jax.device_put(jnp.asarray(v), sh[n][sl])
@@ -227,6 +252,7 @@ class Model:
                 self._restored_opt_state = None
         loss = self._dist_prog.step(*inputs, *labels,
                                     lr=self._optimizer.get_lr())
+        self._dist_dirty = True
         return [float(jax.device_get(loss))]
 
     def train_batch(self, inputs, labels=None):
@@ -282,10 +308,16 @@ class Model:
         self._update_metrics(outs, labels)
         return [float(jax.device_get(loss))]
 
+    def _sync_dist_if_dirty(self):
+        """One host gather per train->eval transition, not per batch."""
+        if getattr(self, "_dist_prog", None) is not None and \
+                getattr(self, "_dist_dirty", False):
+            self._dist_prog.write_back()
+            self._dist_dirty = False
+
     def eval_batch(self, inputs, labels=None):
         self.network.eval()
-        if getattr(self, "_dist_prog", None) is not None:
-            self._dist_prog.write_back()   # eval on the TRAINED params
+        self._sync_dist_if_dirty()     # eval on the TRAINED params
         if self._jit_eval is None:
             self._jit_eval = self._build_eval_step()
         if self._jit_step is not None:
@@ -299,8 +331,7 @@ class Model:
 
     def predict_batch(self, inputs):
         self.network.eval()
-        if getattr(self, "_dist_prog", None) is not None:
-            self._dist_prog.write_back()
+        self._sync_dist_if_dirty()
         if self._jit_eval is None:
             self._jit_eval = self._build_eval_step()
         if self._jit_step is not None:

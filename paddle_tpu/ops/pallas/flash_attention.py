@@ -205,7 +205,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 # backward: dk/dv pass (grid over k blocks x q blocks, dk/dv scratch)
 # ---------------------------------------------------------------------------
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref,
                     *rest, scale, causal, block_q, block_k, nq, mxu,
                     emit_dq=False):
     """Shared dk/dv (+ optionally dq) backward body, grid (BH, nk, nq)
@@ -235,7 +235,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         v = v_ref[0].astype(mxu)
         do = do_ref[0].astype(mxu)
         lse = lse_ref[0][:, :1]
-        delta = delta_ref[0][:, :1]
+        # delta = rowsum(dO * O) computed in-kernel: avoids materialising
+        # a [BH, T, LANE] f32 delta in HBM (ADVICE r1: 128x overhead for
+        # per-row scalars)
+        delta = jnp.sum(do_ref[0].astype(jnp.float32)
+                        * o_ref[0].astype(jnp.float32), axis=1,
+                        keepdims=True)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal:
@@ -277,6 +282,8 @@ def _bwd(scale, causal, res, g):
     bq, bk = _bwd_block_sizes(T, D)
     nq, nk = T // bq, T // bk
     do3 = g
+    # dq pass still consumes a precomputed delta (its blocks iterate k
+    # inner, so per-block recompute there would repeat the same rowsum)
     delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
                     axis=-1)
     delta = jnp.broadcast_to(delta[..., None], (BH, T, LANE))
@@ -328,7 +335,7 @@ def _bwd(scale, causal, res, g):
                          memory_space=_VMEM),
             pl.BlockSpec((1, bq, LANE), lambda b, i, j: (b, j, _I0),
                          memory_space=_VMEM),
-            pl.BlockSpec((1, bq, LANE), lambda b, i, j: (b, j, _I0),
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, j, _I0),
                          memory_space=_VMEM),
         ],
         out_specs=[
@@ -347,7 +354,7 @@ def _bwd(scale, causal, res, g):
         ] if pltpu is not None else [],
         interpret=_interpret(),
         **kwargs,
-    )(q3, k3, v3, do3, lse, delta)
+    )(q3, k3, v3, do3, lse, o3)
     return dq, dk, dv
 
 
@@ -414,10 +421,7 @@ def _bwd_fused(scale, causal, res, g):
     bq, bk = _bwd_block_sizes(T, D)
     nq, nk = T // bq, T // bk
     assert nk == 1, "fused backward requires a single k sweep"
-    do3 = g
-    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
-                    axis=-1)
-    delta = jnp.broadcast_to(delta[..., None], (BH, T, LANE))
+    do3 = g      # delta is computed in-kernel from (do, o) blocks
 
     kwargs = {}
     if pltpu is not None and not _interpret():
@@ -440,7 +444,7 @@ def _bwd_fused(scale, causal, res, g):
                          memory_space=_VMEM),
             pl.BlockSpec((1, bq, LANE), lambda b, i, j: (b, j, _I0),
                          memory_space=_VMEM),
-            pl.BlockSpec((1, bq, LANE), lambda b, i, j: (b, j, _I0),
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, j, _I0),
                          memory_space=_VMEM),
         ],
         out_specs=[
@@ -462,5 +466,5 @@ def _bwd_fused(scale, causal, res, g):
         ] if pltpu is not None else [],
         interpret=_interpret(),
         **kwargs,
-    )(q3, k3, v3, do3, lse, delta)
+    )(q3, k3, v3, do3, lse, o3)
     return dq, dk, dv

@@ -185,7 +185,7 @@ def test_transforms_functional():
     np.testing.assert_allclose(g[..., 0], g[..., 1])
 
 
-def test_model_fit_with_distributed_strategy():
+def test_model_fit_with_distributed_strategy(tmp_path):
     """Model.prepare(strategy=...) routes fit through the fleet strategy
     compiler (dp=2 + ZeRO-2) and matches single-device training."""
     import jax
@@ -227,7 +227,7 @@ def test_model_fit_with_distributed_strategy():
     np.testing.assert_allclose(ref_losses, dist_losses, atol=1e-4)
 
     # save() works off the synced network
-    dist.save("/tmp/hapi_dist_ck")
+    dist.save(str(tmp_path / "hapi_dist_ck"))
     ref._sync_network()
     ref_w = dict(ref.network.named_parameters())
     dist._sync_network()
@@ -236,7 +236,7 @@ def test_model_fit_with_distributed_strategy():
                                    np.asarray(ref_w[k]._data), atol=1e-4)
 
 
-def test_model_strategy_eval_save_load_resume():
+def test_model_strategy_eval_save_load_resume(tmp_path):
     """Strategy path: eval sees trained params, save/load round-trips the
     functional optimizer state, grad accumulation conflicts raise."""
     import jax
@@ -262,14 +262,15 @@ def test_model_strategy_eval_save_load_resume():
     ev = m.eval_batch([x], [y])
     assert ev[0] < 1.5 * l + 1e-3
 
-    m.save("/tmp/hapi_strat_ck")
+    ck = str(tmp_path / "hapi_strat_ck")
+    m.save(ck)
     import pickle as pk
-    with open("/tmp/hapi_strat_ck.pdopt", "rb") as f:
+    with open(ck + ".pdopt", "rb") as f:
         sd = pk.load(f)
     assert "functional_state" in sd      # dist opt slots persisted
 
     # load resets the compiled program and restores the slots
-    m.load("/tmp/hapi_strat_ck")
+    m.load(ck)
     assert m._dist_prog is None
     l2 = m.train_batch([x], [y])[0]
     assert np.isfinite(l2)
