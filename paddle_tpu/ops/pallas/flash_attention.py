@@ -14,8 +14,11 @@ Mosaic pipelines the K/V block DMAs against MXU work. Causal pruning skips
 above-diagonal blocks with pl.when. f32 accumulation via
 preferred_element_type; bf16-friendly inputs.
 
-Backward is the standard two-pass flash backward (dq pass over k blocks,
-dkv pass over q blocks) using saved logsumexp and delta = rowsum(dO * O).
+Backward: a fused single-pass kernel (one score recompute emits dq, dk
+and dv together) when the k sweep is single-block (T <= the k-block cap);
+the standard two-pass scheme (dq pass over k blocks, dkv pass over q
+blocks) above that. delta = rowsum(dO * O) is computed in-kernel in the
+dkv/fused bodies. Saved residuals: q, k, v, o, logsumexp.
 """
 from __future__ import annotations
 
