@@ -199,7 +199,8 @@ def compile_train_step(layer, optimizer, strategy: DistributedStrategy,
         if n_sp > 1:
             sp_ctx = seq_parallel_scope(
                 mesh, "sp", impl=strategy.sequence_parallel_impl,
-                batch_axis="dp" if n_dp > 1 else None)
+                batch_axis="dp" if n_dp > 1 else None,
+                head_axis="tp" if n_tp > 1 else None)
         else:
             sp_ctx = contextlib.nullcontext()
         with random_mod.key_scope(key):

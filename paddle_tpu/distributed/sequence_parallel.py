@@ -139,10 +139,13 @@ def ulysses_attention(q, k, v, axis: str = "sp", causal: bool = False,
 
 
 def make_ring_attention(mesh, axis: str = "sp", causal: bool = False,
-                        scale=None, batch_axis: str = None):
+                        scale=None, batch_axis: str = None,
+                        head_axis: str = None):
     """Jit-level wrapper: global [B, T, H, D] arrays, seq dim sharded over
-    `axis` inside one shard_map (optionally batch over `batch_axis`)."""
-    dspec = P(batch_axis, axis, None, None)
+    `axis` inside one shard_map (optionally batch over `batch_axis` and
+    heads over `head_axis` — attention is per-head, so tensor-parallel
+    head sharding composes with the ring for a dp x tp x sp mesh)."""
+    dspec = P(batch_axis, axis, head_axis, None)
 
     fn = functools.partial(ring_attention, axis=axis, causal=causal,
                            scale=scale)
@@ -151,8 +154,9 @@ def make_ring_attention(mesh, axis: str = "sp", causal: bool = False,
 
 
 def make_ulysses_attention(mesh, axis: str = "sp", causal: bool = False,
-                           scale=None, batch_axis: str = None):
-    dspec = P(batch_axis, axis, None, None)
+                           scale=None, batch_axis: str = None,
+                           head_axis: str = None):
+    dspec = P(batch_axis, axis, head_axis, None)
     fn = functools.partial(ulysses_attention, axis=axis, causal=causal,
                            scale=scale)
     return jax.shard_map(fn, mesh=mesh, in_specs=(dspec, dspec, dspec),

@@ -21,18 +21,22 @@ __all__ = ["scaled_dot_product_attention", "seq_parallel_scope"]
 # sequence-parallel routing context: when set (by the fleet strategy
 # compiler or user code), qualifying sdpa calls run ring/Ulysses attention
 # over the 'sp' mesh axis instead of single-device attention
-_seq_parallel_ctx = [None]   # (mesh, axis, impl, batch_axis) | None
+_seq_parallel_ctx = [None]   # (mesh, axis, impl, batch_axis, head_axis)
 
 
 class seq_parallel_scope:
     """with seq_parallel_scope(mesh, "sp", impl="ring", batch_axis="dp"):
     attention inside routes through distributed.sequence_parallel."""
 
-    def __init__(self, mesh, axis="sp", impl="ring", batch_axis=None):
+    def __init__(self, mesh, axis="sp", impl="ring", batch_axis=None,
+                 head_axis=None):
+        """head_axis: mesh axis the HEAD dim is already sharded over
+        (tensor parallel) — attention is per-head, so it composes with
+        the sequence ring/all-to-all."""
         if impl not in ("ring", "ulysses"):
             raise ValueError(f"sequence_parallel impl must be 'ring' or "
                              f"'ulysses', got {impl!r}")
-        self._val = (mesh, axis, impl, batch_axis)
+        self._val = (mesh, axis, impl, batch_axis, head_axis)
 
     def __enter__(self):
         self._prev = _seq_parallel_ctx[0]
@@ -81,9 +85,11 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
 
     sp = _seq_parallel_ctx[0]
     if sp is not None:
-        mesh, axis, impl, batch_axis = sp
+        mesh, axis, impl, batch_axis, head_axis = sp
         n_sp = int(mesh.shape[axis])
+        n_head_shards = int(mesh.shape[head_axis]) if head_axis else 1
         T, H = query.shape[1], query.shape[2]
+        local_h = H // max(n_head_shards, 1)
         if attn_mask is not None or dropout_p > 0.0:
             import warnings
             warnings.warn(
@@ -95,18 +101,23 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
             raise ValueError(
                 f"sequence_parallel: seq len {T} not divisible by "
                 f"sp={n_sp} (hybrid_configs.sep_degree)")
-        elif impl == "ulysses" and H % n_sp:
+        elif head_axis and H % n_head_shards:
             raise ValueError(
-                f"sequence_parallel impl='ulysses': num heads {H} not "
-                f"divisible by sp={n_sp}; use impl='ring' or adjust "
-                f"sep_degree")
+                f"sequence_parallel with head_axis={head_axis!r}: "
+                f"{H} heads not divisible by its size {n_head_shards}")
+        elif impl == "ulysses" and local_h % n_sp:
+            raise ValueError(
+                f"sequence_parallel impl='ulysses': sp={n_sp} must divide "
+                f"the local head count {local_h} "
+                f"(= {H} heads / {n_head_shards} head shards); use "
+                f"impl='ring' or adjust sep_degree")
         else:
             from ...distributed.sequence_parallel import (
                 make_ring_attention, make_ulysses_attention)
             maker = make_ring_attention if impl == "ring" \
                 else make_ulysses_attention
             f = maker(mesh, axis=axis, causal=is_causal, scale=scale,
-                      batch_axis=batch_axis)
+                      batch_axis=batch_axis, head_axis=head_axis)
             return apply(f, query, key, value, op_name="sp_attention")
 
     seq_len = query.shape[1]

@@ -561,3 +561,38 @@ def test_run_with_recovery_resumes_from_checkpoint(tmp_path):
     assert latest_checkpoint(ckpt_dir).endswith("step_6")
     np.testing.assert_allclose([losses[i] for i in range(6)], ref,
                                atol=3e-4)
+
+
+def test_compiled_step_tp_x_sp_hybrid():
+    """3-axis hybrid: dp=2 x tp=2 x sp=2 on 8 devices — TP head sharding
+    composes with ring attention over 'sp'; matches sequential."""
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.distributed.fleet.compiler import compile_train_step
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 512, (4, 32)).astype(np.int64)
+    labels = rng.integers(0, 512, (4, 32)).astype(np.int64)
+
+    m1 = _tiny_gpt()
+    s1 = DistributedStrategy()
+    mesh1 = s1.build_mesh(devices=jax.devices()[:1])
+    adam1 = opt.Adam(learning_rate=1e-3, parameters=list(m1.parameters()))
+    prog1 = compile_train_step(m1, adam1, s1, mesh=mesh1)
+    seq = [float(jax.device_get(prog1.step(ids, labels, lr=1e-3)))
+           for _ in range(3)]
+
+    m2 = _tiny_gpt()
+    s2 = DistributedStrategy()
+    s2.tensor_parallel = True
+    s2.sequence_parallel = True
+    s2.hybrid_configs.mp_degree = 2
+    s2.hybrid_configs.sep_degree = 2
+    s2.hybrid_configs.dp_degree = 2
+    mesh2 = s2.build_mesh(devices=jax.devices()[:8])
+    adam2 = opt.Adam(learning_rate=1e-3, parameters=list(m2.parameters()))
+    prog2 = compile_train_step(m2, adam2, s2, mesh=mesh2)
+    hyb = [float(jax.device_get(prog2.step(ids, labels, lr=1e-3)))
+           for _ in range(3)]
+    np.testing.assert_allclose(seq, hyb, atol=3e-4)
+    qkv = [k for k in prog2.params if "qkv.weight" in k][0]
+    assert prog2.params[qkv].sharding.spec == P(None, "tp")
