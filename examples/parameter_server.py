@@ -1,0 +1,58 @@
+"""Async parameter-server training: native C++ table server + two worker
+processes updating a shared sparse embedding table."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    # the TPU PJRT plugin overrides the env var; config wins (conftest.py)
+    import jax as _jax
+    _jax.config.update("jax_platforms", "cpu")
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+import paddle_tpu.distributed.fleet as fleet
+
+WORKER = '''
+import os, sys
+import numpy as np
+from paddle_tpu.distributed.ps import PSClient
+wid = int(sys.argv[1])
+c = PSClient(os.environ["PADDLE_PSERVERS_IP_PORT_LIST"])
+rng = np.random.default_rng(wid)
+targets = np.random.default_rng(0).normal(size=(32, 8)).astype(np.float32)
+for _ in range(200):
+    ids = rng.integers(0, 32, 8)
+    w = c.pull_sparse(0, ids, dim=8)
+    c.push_sparse(0, ids, w - targets[ids], lr=0.1)   # dL/dw of ||w-t||^2/2
+c.barrier(world=2)
+c.close()
+'''
+
+
+def main():
+    srv = fleet.init_server()
+    print("server on", srv.endpoint)
+    c = fleet.ps_client()
+    c.create_sparse_table(0, dim=8)
+
+    procs = [subprocess.Popen([sys.executable, "-c", WORKER, str(i)],
+                              env=dict(os.environ)) for i in range(2)]
+    for p in procs:
+        assert p.wait(timeout=120) == 0
+
+    targets = np.random.default_rng(0).normal(size=(32, 8)).astype(np.float32)
+    final = c.pull_sparse(0, np.arange(32), dim=8)
+    print("max |w - target| after async training:",
+          float(np.abs(final - targets).max()))
+    fleet.stop_worker()
+    srv.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -13,16 +13,17 @@ chip count:
   above the diagonal are computed-but-masked (they cost one matmul but
   keep the schedule static; a pl.when-style skip is a future optimisation).
 
-* ulysses_attention — all-to-all re-shards [B, T/sp, H, D] into
-  [B, T, H/sp, D] (heads split, sequence gathered), runs ordinary
-  attention per head group (which routes to the Pallas flash kernel at
-  qualifying shapes), and all-to-alls back. Needs H % sp == 0; comm is
+* ulysses_attention — all-to-all re-shards the LOCAL heads: [B, T/sp,
+  Hl, D] -> [B, T, Hl/sp, D] (heads split, sequence gathered), runs
+  ordinary attention per head group (routing to the Pallas flash kernel
+  at qualifying shapes), and all-to-alls back. Needs the local head
+  count (H, or H/tp under head_axis sharding) divisible by sp; comm is
   2 all-to-alls instead of sp ppermutes, usually the winner on ICI while
   heads are plentiful.
 
 Both run inside jax.shard_map over the 'sp' axis and compose with dp
-(batch dim left to the caller's specs). Layouts follow the framework's
-[B, T, H, D] sdpa convention.
+(batch dim) and tensor-parallel head sharding (head_axis — attention is
+per-head). Layouts follow the framework's [B, T, H, D] sdpa convention.
 """
 from __future__ import annotations
 

@@ -102,9 +102,22 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                 f"sequence_parallel: seq len {T} not divisible by "
                 f"sp={n_sp} (hybrid_configs.sep_degree)")
         elif head_axis and H % n_head_shards:
-            raise ValueError(
-                f"sequence_parallel with head_axis={head_axis!r}: "
-                f"{H} heads not divisible by its size {n_head_shards}")
+            # uneven head sharding: keep the pre-head_axis behavior (GSPMD
+            # handles the tp collectives outside the SP region) rather
+            # than rejecting a config that used to work
+            import warnings
+            warnings.warn(
+                f"sequence_parallel: {H} heads not divisible by "
+                f"{head_axis!r} size {n_head_shards}; running the SP "
+                f"region with replicated heads")
+            sp_head = None
+            from ...distributed.sequence_parallel import (
+                make_ring_attention, make_ulysses_attention)
+            maker = make_ring_attention if impl == "ring" \
+                else make_ulysses_attention
+            f = maker(mesh, axis=axis, causal=is_causal, scale=scale,
+                      batch_axis=batch_axis, head_axis=sp_head)
+            return apply(f, query, key, value, op_name="sp_attention")
         elif impl == "ulysses" and local_h % n_sp:
             raise ValueError(
                 f"sequence_parallel impl='ulysses': sp={n_sp} must divide "

@@ -596,3 +596,20 @@ def test_compiled_step_tp_x_sp_hybrid():
     np.testing.assert_allclose(seq, hyb, atol=3e-4)
     qkv = [k for k in prog2.params if "qkv.weight" in k][0]
     assert prog2.params[qkv].sharding.spec == P(None, "tp")
+
+
+def test_sp_uneven_heads_fall_back_to_replicated():
+    """heads % tp != 0 under an SP scope warns and runs (pre-head_axis
+    behavior) instead of rejecting the config."""
+    from jax.sharding import Mesh
+    from paddle_tpu.nn.functional.attention import seq_parallel_scope
+    import paddle_tpu.nn.functional as F
+
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("sp", "tp"))
+    rng = np.random.default_rng(0)
+    q = paddle.to_tensor(
+        rng.normal(size=(2, 32, 3, 8)).astype(np.float32))  # 3 heads, tp=2
+    with seq_parallel_scope(mesh, "sp", head_axis="tp"):
+        with pytest.warns(UserWarning, match="replicated heads"):
+            out = F.scaled_dot_product_attention(q, q, q, is_causal=True)
+    assert out.shape == [2, 32, 3, 8]
