@@ -10,9 +10,7 @@ if os.environ.get("JAX_PLATFORMS") == "cpu":
     import jax as _jax
     _jax.config.update("jax_platforms", "cpu")
 
-import os
 import subprocess
-import sys
 
 import numpy as np
 

@@ -126,8 +126,15 @@ def set_device(device: str):
     raise ValueError(f"Unknown device {device!r}")
 
 
-_default_place = TPUPlace(0) if is_compiled_with_tpu() else CPUPlace()
+# Resolved LAZILY: probing devices at import would initialize the XLA
+# backend and break jax.distributed.initialize (fleet.init on multi-host
+# must run before any backend touch).
+_default_place = None
 
 
 def get_default_place() -> Place:
+    global _default_place
+    if _default_place is None:
+        _default_place = TPUPlace(0) if is_compiled_with_tpu() else \
+            CPUPlace()
     return _default_place

@@ -24,9 +24,13 @@ class Generator:
 
     def manual_seed(self, seed: int):
         self._seed = int(seed)
-        self._key = jax.random.key(self._seed)
-        self._offset = 0
+        self._key = None        # materialised lazily: creating a key at
+        self._offset = 0        # import would initialise the XLA backend
         return self
+
+    def _ensure_key(self):
+        if self._key is None:
+            self._key = jax.random.key(self._seed)
 
     @property
     def initial_seed(self) -> int:
@@ -35,6 +39,7 @@ class Generator:
     def next_key(self):
         """Return a fresh key; advances internal state (eager use only)."""
         with self._lock:
+            self._ensure_key()
             self._key, sub = jax.random.split(self._key)
             self._offset += 1
             return sub
@@ -44,6 +49,7 @@ class Generator:
 
     def set_state(self, state):
         self.manual_seed(state["seed"])
+        self._ensure_key()
         # Replay the chain to the recorded offset.
         for _ in range(state["offset"]):
             self._key, _ = jax.random.split(self._key)

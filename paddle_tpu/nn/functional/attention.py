@@ -87,9 +87,7 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     if sp is not None:
         mesh, axis, impl, batch_axis, head_axis = sp
         n_sp = int(mesh.shape[axis])
-        n_head_shards = int(mesh.shape[head_axis]) if head_axis else 1
         T, H = query.shape[1], query.shape[2]
-        local_h = H // max(n_head_shards, 1)
         if attn_mask is not None or dropout_p > 0.0:
             import warnings
             warnings.warn(
@@ -97,34 +95,28 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                 "attn_mask/dropout, which the SP paths do not support — "
                 "falling back to single-device attention (GSPMD will "
                 "gather the sequence dim; no SP memory savings here)")
-        elif T % n_sp:
-            raise ValueError(
-                f"sequence_parallel: seq len {T} not divisible by "
-                f"sp={n_sp} (hybrid_configs.sep_degree)")
-        elif head_axis and H % n_head_shards:
-            # uneven head sharding: keep the pre-head_axis behavior (GSPMD
-            # handles the tp collectives outside the SP region) rather
-            # than rejecting a config that used to work
-            import warnings
-            warnings.warn(
-                f"sequence_parallel: {H} heads not divisible by "
-                f"{head_axis!r} size {n_head_shards}; running the SP "
-                f"region with replicated heads")
-            sp_head = None
-            from ...distributed.sequence_parallel import (
-                make_ring_attention, make_ulysses_attention)
-            maker = make_ring_attention if impl == "ring" \
-                else make_ulysses_attention
-            f = maker(mesh, axis=axis, causal=is_causal, scale=scale,
-                      batch_axis=batch_axis, head_axis=sp_head)
-            return apply(f, query, key, value, op_name="sp_attention")
-        elif impl == "ulysses" and local_h % n_sp:
-            raise ValueError(
-                f"sequence_parallel impl='ulysses': sp={n_sp} must divide "
-                f"the local head count {local_h} "
-                f"(= {H} heads / {n_head_shards} head shards); use "
-                f"impl='ring' or adjust sep_degree")
         else:
+            if T % n_sp:
+                raise ValueError(
+                    f"sequence_parallel: seq len {T} not divisible by "
+                    f"sp={n_sp} (hybrid_configs.sep_degree)")
+            n_head_shards = int(mesh.shape[head_axis]) if head_axis else 1
+            if head_axis and H % n_head_shards:
+                # uneven head sharding: keep the pre-head_axis behavior
+                # (GSPMD handles tp collectives outside the SP region)
+                import warnings
+                warnings.warn(
+                    f"sequence_parallel: {H} heads not divisible by "
+                    f"{head_axis!r} size {n_head_shards}; running the SP "
+                    f"region with replicated heads")
+                head_axis, n_head_shards = None, 1
+            local_h = H // n_head_shards
+            if impl == "ulysses" and local_h % n_sp:
+                raise ValueError(
+                    f"sequence_parallel impl='ulysses': sp={n_sp} must "
+                    f"divide the local head count {local_h} "
+                    f"(= {H} heads / {n_head_shards} head shards); use "
+                    f"impl='ring' or adjust sep_degree")
             from ...distributed.sequence_parallel import (
                 make_ring_attention, make_ulysses_attention)
             maker = make_ring_attention if impl == "ring" \

@@ -1,0 +1,93 @@
+"""Multi-process DCN test: 2 REAL processes bootstrap through
+paddle_tpu.distributed.env (jax.distributed = the gen_comm_id/rendezvous
+analog, reference gen_comm_id_helper.cc:286) and run a global collective
+over their combined device set.
+
+This is the SURVEY §4.3 pattern — distributed tests as local subprocess
+simulations (reference test_dist_base.py _run_cluster) — applied to the
+JAX multi-controller runtime: each process owns 2 virtual CPU devices;
+the psum must see all 4 global devices or the assertion fails.
+"""
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+WORKER = r'''
+import os, sys
+sys.path.insert(0, os.environ["REPO_ROOT"])
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+# `import paddle_tpu` must stay backend-clean so the PADDLE_* bootstrap
+# (jax.distributed.initialize) can still run — this line is part of the
+# test
+import paddle_tpu.distributed.env as env
+
+env.init_distributed()
+
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+assert env.get_world_size() == 2
+rank = env.get_rank()
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 4, len(jax.devices())       # 2 local x 2 procs
+assert len(jax.local_devices()) == 2
+
+mesh = Mesh(np.array(jax.devices()), ("dp",))
+
+def allsum(a):
+    return jax.lax.psum(a, "dp")
+
+f = jax.jit(jax.shard_map(allsum, mesh=mesh, in_specs=P("dp"),
+                          out_specs=P(None), check_vma=False))
+from jax.experimental import multihost_utils
+arr = multihost_utils.host_local_array_to_global_array(
+    np.full((2,), float(rank + 1), np.float32), mesh, P("dp"))
+out = f(arr)
+# global operand rows: proc0 contributes [1,1], proc1 [2,2] -> psum = 6
+local = np.asarray([s.data for s in out.addressable_shards][0]).ravel()
+assert np.allclose(local, 6.0), local
+print(f"RANK{rank}_OK")
+'''
+
+
+@pytest.mark.timeout(180)
+def test_two_process_dcn_collective(tmp_path):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coordinator = f"127.0.0.1:{port}"
+
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+            "REPO_ROOT": os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))),
+            "PADDLE_MASTER_ENDPOINT": coordinator,
+            "PADDLE_TRAINERS_NUM": "2",
+            "PADDLE_TRAINER_ID": str(rank),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", WORKER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+
+    try:
+        outs = []
+        for rank, p in enumerate(procs):
+            out, _ = p.communicate(timeout=150)
+            outs.append(out)
+            assert p.returncode == 0, f"rank {rank} failed:\n{out[-3000:]}"
+        assert any("RANK0_OK" in o for o in outs)
+        assert any("RANK1_OK" in o for o in outs)
+    finally:
+        for p in procs:          # never leak a rank blocked on rendezvous
+            if p.poll() is None:
+                p.kill()
