@@ -26,11 +26,15 @@ class Generator:
         self._seed = int(seed)
         self._key = None        # materialised lazily: creating a key at
         self._offset = 0        # import would initialise the XLA backend
+        self._replay = 0
         return self
 
     def _ensure_key(self):
         if self._key is None:
             self._key = jax.random.key(self._seed)
+            for _ in range(getattr(self, "_replay", 0)):
+                self._key, _ = jax.random.split(self._key)
+            self._replay = 0
 
     @property
     def initial_seed(self) -> int:
@@ -48,12 +52,11 @@ class Generator:
         return {"seed": self._seed, "offset": self._offset}
 
     def set_state(self, state):
+        # record only; the chain replays inside _ensure_key so restoring a
+        # checkpoint before fleet.init keeps the backend untouched
         self.manual_seed(state["seed"])
-        self._ensure_key()
-        # Replay the chain to the recorded offset.
-        for _ in range(state["offset"]):
-            self._key, _ = jax.random.split(self._key)
         self._offset = state["offset"]
+        self._replay = state["offset"]
 
 
 _default_generator = Generator(np.random.randint(0, 2**31 - 1))
