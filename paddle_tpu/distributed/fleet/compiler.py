@@ -102,6 +102,27 @@ class CompiledTrainStep:
         return step, meta
 
 
+def _maybe_swap_optimizer(optimizer, strategy):
+    """lars/lamb meta-optimizers: the reference rewrites momentum ->
+    lars_momentum / adam -> lamb ops in the program
+    (fleet/meta_optimizers/lars_optimizer.py, lamb_optimizer.py); here the
+    toggle swaps the optimizer class, carrying over lr and parameters."""
+    from ... import optimizer as opt_mod
+    # carry grad_clip over; weight decay uses Lars/Lamb's own decoupled
+    # lars_weight_decay / lamb_weight_decay defaults (the reference meta-
+    # optimizers likewise source decay from their own configs)
+    kw = dict(grad_clip=optimizer._grad_clip)
+    if getattr(strategy, "lamb", False) and not isinstance(
+            optimizer, opt_mod.Lamb):
+        return opt_mod.Lamb(learning_rate=optimizer._learning_rate,
+                            parameters=optimizer._parameter_list, **kw)
+    if getattr(strategy, "lars", False) and not isinstance(
+            optimizer, opt_mod.Lars):
+        return opt_mod.Lars(learning_rate=optimizer._learning_rate,
+                            parameters=optimizer._parameter_list, **kw)
+    return optimizer
+
+
 def _tp_specs(layer, params, strategy) -> Dict[str, P]:
     """Tensor-parallel specs via the model's `param_shardings` protocol
     (GPT implements it with its Megatron rules); replicated otherwise."""
@@ -151,8 +172,15 @@ def compile_train_step(layer, optimizer, strategy: DistributedStrategy,
                        loss_method: str = "loss", mesh=None,
                        lr_default: float = 1e-3) -> CompiledTrainStep:
     mesh = mesh or strategy.build_mesh()
+    optimizer = _maybe_swap_optimizer(optimizer, strategy)
     if int(mesh.shape.get("pp", 1)) > 1:
         return _compile_pipeline_step(layer, optimizer, strategy, mesh)
+    from .grad_comm import active_mode, compile_explicit_dp_step
+    if active_mode(strategy):
+        # localsgd / adaptive_localsgd / dgc / fp16_allreduce need manual
+        # control of the dp gradient exchange (fleet/grad_comm.py)
+        return compile_explicit_dp_step(layer, optimizer, strategy, mesh,
+                                        loss_method=loss_method)
     wrapped = MethodAdapter(layer, loss_method) if loss_method else layer
     params = param_arrays(layer)
     state = state_arrays(layer)

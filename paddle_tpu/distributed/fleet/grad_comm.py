@@ -1,0 +1,355 @@
+"""Gradient-communication meta-optimizers: LocalSGD, AdaptiveLocalSGD,
+DGC, fp16_allreduce — the explicit-DP branch of the strategy compiler.
+
+Reference analogs (rewrites of the Program's allreduce ops):
+  LocalSGDOptimizer          fleet/meta_optimizers/localsgd_optimizer.py
+  AdaptiveLocalSGDOptimizer  (same file, adaptive k from loss)
+  DGCOptimizer               fleet/meta_optimizers/dgc_optimizer.py +
+                             details/sparse_all_reduce_op_handle.cc
+  FP16AllReduceOptimizer     fleet/meta_optimizers/fp16_allreduce_optimizer.py
+
+TPU-native design: the implicit-SPMD step (compiler.py) lets XLA insert
+the dp gradient mean, which leaves no seam to compress or skip it. These
+modes therefore run the whole train step inside one `jax.shard_map` over
+the 'dp' axis with *manual* collectives:
+
+  plain            g <- pmean(g, 'dp')
+  fp16_allreduce   g <- pmean(bf16(g), 'dp') upcast f32 (half the ICI
+                   bytes; bf16 keeps the f32 exponent so no loss scaling)
+  dgc              top-k sparsified momentum: u = m*u + g; v += u; send
+                   only the top-k (values, indices) via all_gather
+                   (2k words instead of n), scatter-add, keep the residual
+                   locally (error feedback); momentum-factor masking
+  localsgd         NO per-step comm; each dp rank trains on its own batch
+                   shard and params are pmean-averaged every k steps
+  adaptive_localsgd k recomputed from the loss ratio sqrt(loss0/loss_t)
+                   (Wang et al. adaptive communication; paddle's
+                   _adaptive_localsgd heuristic)
+
+LocalSGD stores params STACKED on a leading dp axis (sharded P('dp')) so
+replicas can genuinely diverge between syncs — under SPMD a "replicated"
+array must be identical on every device, so divergence needs its own
+axis. DGC's (u, v) residuals are per-rank state and are stacked the same
+way.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...core import nan_inf
+from ...core import random as random_mod
+from ...framework import MethodAdapter, functional_call, param_arrays, \
+    state_arrays
+
+
+def active_mode(strategy) -> str | None:
+    """Which explicit-DP mode the strategy asks for (None: implicit SPMD)."""
+    on = [m for m in ("localsgd", "adaptive_localsgd", "dgc")
+          if getattr(strategy, m, False)]
+    if len(on) > 1:
+        raise ValueError(f"at most one of localsgd/adaptive_localsgd/dgc "
+                         f"may be enabled, got {on}")
+    if on:
+        if getattr(strategy, "fp16_allreduce", False):
+            raise ValueError(
+                f"{on[0]} controls the gradient exchange itself; "
+                "fp16_allreduce would be a silent no-op — disable one")
+        return on[0]
+    if getattr(strategy, "fp16_allreduce", False):
+        return "fp16_allreduce"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# DGC compress/exchange (runs per-rank inside shard_map)
+# ---------------------------------------------------------------------------
+
+def _dgc_exchange(g, u, v, momentum, keep_ratio, n_dp, axis="dp"):
+    """One DGC round for a single flat gradient: returns (g_global, u', v').
+
+    u: momentum accumulator, v: velocity/error residual (both local).
+    Comm cost 2k*n_dp words via all_gather of (values, indices) versus n
+    for a dense allreduce.
+    """
+    n = g.shape[0]
+    k = max(1, int(n * keep_ratio))
+    u = momentum * u + g
+    v = v + u
+    vals, idx = jax.lax.top_k(jnp.abs(v), k)
+    sel = v[idx]                              # signed top-k values
+    # residual: keep everything NOT sent (error feedback) and clear the
+    # momentum for sent coordinates (momentum factor masking)
+    mask = jnp.zeros((n,), jnp.bool_).at[idx].set(True)
+    v = jnp.where(mask, 0.0, v)
+    u = jnp.where(mask, 0.0, u)
+    all_sel = jax.lax.all_gather(sel, axis)   # [dp, k]
+    all_idx = jax.lax.all_gather(idx, axis)   # [dp, k]
+    dense = jnp.zeros((n,), g.dtype).at[all_idx.reshape(-1)].add(
+        all_sel.reshape(-1))
+    return dense / n_dp, u, v
+
+
+# ---------------------------------------------------------------------------
+# the compiled explicit-DP step
+# ---------------------------------------------------------------------------
+
+def compile_explicit_dp_step(layer, optimizer, strategy, mesh,
+                             loss_method="loss"):
+    """Build a CompiledTrainStep whose grad exchange is hand-written inside
+    shard_map over 'dp' (localsgd / adaptive_localsgd / dgc /
+    fp16_allreduce). Single-axis only: tp/pp/sp/ep must be 1."""
+    from .compiler import CompiledTrainStep
+
+    mode = active_mode(strategy)
+    assert mode is not None
+    for ax in ("tp", "pp", "sp", "ep"):
+        if int(mesh.shape.get(ax, 1)) > 1:
+            raise NotImplementedError(
+                f"{mode} composes only with data parallelism; got "
+                f"{ax}={mesh.shape[ax]} (the shard_map region would need "
+                f"the {ax} collectives inserted manually)")
+    if strategy.sharding:
+        raise NotImplementedError(f"{mode} + sharding (ZeRO) is not "
+                                  "supported — disable one")
+    if strategy.gradient_merge and strategy.gradient_merge_configs.k_steps > 1:
+        raise NotImplementedError(f"{mode} + gradient_merge is not "
+                                  "supported yet")
+
+    n_dp = int(mesh.shape["dp"])
+    amp_on = bool(strategy.amp)
+    pure_bf16 = amp_on and strategy.amp_configs.use_pure_bf16
+    local_params = mode in ("localsgd", "adaptive_localsgd")
+
+    wrapped = MethodAdapter(layer, loss_method) if loss_method else layer
+    params = param_arrays(layer)
+    state = state_arrays(layer)
+    opt_state = optimizer.functional_init(params)
+
+    if mode == "localsgd":
+        cfg = strategy.localsgd_configs
+        k0 = max(int(cfg.k_steps), 1)
+        begin = int(cfg.begin_step)
+    elif mode == "adaptive_localsgd":
+        cfg = strategy.adaptive_localsgd_configs
+        k0 = max(int(cfg.init_k_steps), 1)
+        begin = int(cfg.begin_step)
+    elif mode == "dgc":
+        cfg = strategy.dgc_configs
+        keep_ratio = max(1.0 - float(cfg.sparsity), 1e-6)
+        dgc_momentum = float(cfg.momentum)
+        rampup = int(cfg.rampup_begin_step)
+
+    # ---- forward/loss on the LOCAL batch shard ---------------------------
+    def forward_loss(p, st, key, *data):
+        with random_mod.key_scope(key):
+            from ... import amp as amp_mod
+            with amp_mod.auto_cast(enable=amp_on,
+                                   level="O2" if pure_bf16 else "O1",
+                                   dtype="bfloat16"):
+                out, new_state = functional_call(wrapped, p, st, *data)
+        return out, new_state
+
+    if strategy.recompute:
+        policy = getattr(jax.checkpoint_policies,
+                         strategy.recompute_configs.policy, None)
+        forward_loss = jax.checkpoint(forward_loss, policy=policy)
+
+    def local_grads(p, st, key, data):
+        def loss_of(pp):
+            out, new_st = forward_loss(pp, st, key, *data)
+            return out, new_st
+        (loss, new_st), g = jax.value_and_grad(loss_of, has_aux=True)(p)
+        return loss, new_st, g
+
+    # ---- per-rank body (inside shard_map over 'dp') ----------------------
+    def body(p, st, opt_st, comm, key, lr, data):
+        if local_params:
+            p = jax.tree_util.tree_map(lambda x: x[0], p)       # unstack
+            opt_core = jax.tree_util.tree_map(lambda x: x[0], opt_st)
+        else:
+            opt_core = opt_st
+        # decorrelate dropout across ranks
+        key = jax.random.fold_in(key, jax.lax.axis_index("dp"))
+        loss, new_st, g = local_grads(p, st, key, data)
+        g = nan_inf.guard_tree(g)
+
+        if mode == "fp16_allreduce":
+            g = jax.tree_util.tree_map(
+                lambda x: jax.lax.pmean(x.astype(jnp.bfloat16), "dp")
+                .astype(x.dtype), g)
+            new_p, new_opt = optimizer.functional_update(p, g, opt_core,
+                                                         lr=lr)
+            new_comm = comm
+        elif mode == "dgc":
+            step_i = comm["step"]
+            flat, tree = jax.tree_util.tree_flatten(g)
+            new_u, new_v, out = [], [], []
+            for i, gl in enumerate(flat):
+                gf = gl.reshape(-1)
+                u = comm["u"][i][0].reshape(-1)
+                v = comm["v"][i][0].reshape(-1)
+
+                def dense_path(gf=gf, u=u, v=v):
+                    return jax.lax.pmean(gf, "dp"), u, v
+
+                def dgc_path(gf=gf, u=u, v=v):
+                    return _dgc_exchange(gf, u, v, dgc_momentum,
+                                         keep_ratio, n_dp)
+
+                gg, uu, vv = jax.lax.cond(step_i < rampup, dense_path,
+                                          dgc_path)
+                out.append(gg.reshape(gl.shape))
+                new_u.append(uu.reshape(gl.shape)[None])
+                new_v.append(vv.reshape(gl.shape)[None])
+            g = jax.tree_util.tree_unflatten(tree, out)
+            new_p, new_opt = optimizer.functional_update(p, g, opt_core,
+                                                         lr=lr)
+            new_comm = {"u": new_u, "v": new_v, "step": step_i + 1}
+        else:                                   # localsgd / adaptive
+            new_p, new_opt = optimizer.functional_update(p, g, opt_core,
+                                                         lr=lr)
+            step_i = comm["step"] + 1
+            since = comm["since"] + 1
+            k_now = comm["k"]
+            # warm-up: before begin_step, sync every step (paddle
+            # LocalSGDOptimizer semantics); after it, every k steps
+            do_sync = jnp.logical_or(step_i < begin, since >= k_now)
+
+            def sync(tree_p):
+                return jax.tree_util.tree_map(
+                    lambda x: jax.lax.pmean(x, "dp"), tree_p)
+
+            new_p, new_opt = jax.lax.cond(
+                do_sync, lambda pp: (sync(pp[0]), sync(pp[1])),
+                lambda pp: pp, (new_p, new_opt))
+            gloss = jax.lax.pmean(loss, "dp")
+            if mode == "adaptive_localsgd":
+                # paddle _adaptive_localsgd: grow the interval as the loss
+                # falls: k = clip(init_k * sqrt(loss0/loss), 1, 16)
+                loss0 = jnp.where(comm["loss0"] <= 0.0, gloss, comm["loss0"])
+                k_new = jnp.clip(
+                    jnp.round(k0 * jnp.sqrt(loss0 /
+                                            jnp.maximum(gloss, 1e-8))),
+                    1, 16).astype(jnp.int32)
+                k_now = jnp.where(do_sync, k_new, k_now)
+            else:
+                loss0 = comm["loss0"]
+            new_comm = {"step": step_i,
+                        "since": jnp.where(do_sync, 0, since),
+                        "k": k_now, "loss0": loss0}
+        loss = jax.lax.pmean(loss, "dp")
+        if local_params:
+            new_p = jax.tree_util.tree_map(lambda x: x[None], new_p)
+            new_opt = jax.tree_util.tree_map(lambda x: x[None], new_opt)
+        return loss, new_p, new_st, new_opt, new_comm
+
+    # ---- stack/shard layout ----------------------------------------------
+    def _stack(tree):
+        return jax.tree_util.tree_map(
+            lambda x: jnp.broadcast_to(x[None], (n_dp,) + x.shape), tree)
+
+    if local_params:
+        params_l = _stack(params)
+        opt_l = _stack(opt_state)
+        pspec = jax.tree_util.tree_map(
+            lambda x: P(*(("dp",) + (None,) * (x.ndim - 1))), params_l)
+        ospec = jax.tree_util.tree_map(
+            lambda x: P(*(("dp",) + (None,) * (x.ndim - 1))), opt_l)
+        comm = {"step": jnp.zeros((), jnp.int32),
+                "since": jnp.zeros((), jnp.int32),
+                "k": jnp.asarray(k0, jnp.int32),
+                "loss0": jnp.zeros((), jnp.float32)}
+        comm_spec = {"step": P(), "since": P(), "k": P(), "loss0": P()}
+    else:
+        params_l = params
+        opt_l = opt_state
+        pspec = jax.tree_util.tree_map(lambda x: P(*((None,) * x.ndim)),
+                                       params)
+        ospec = jax.tree_util.tree_map(lambda x: P(*((None,) * x.ndim)),
+                                       opt_state)
+        if mode == "dgc":
+            flat, _ = jax.tree_util.tree_flatten(
+                jax.tree_util.tree_map(jnp.zeros_like, params))
+            zstk = [jnp.zeros((n_dp,) + f.shape, f.dtype) for f in flat]
+            comm = {"u": zstk, "v": [z.copy() for z in zstk],
+                    "step": jnp.zeros((), jnp.int32)}
+            comm_spec = {
+                "u": [P(*(("dp",) + (None,) * (z.ndim - 1))) for z in zstk],
+                "v": [P(*(("dp",) + (None,) * (z.ndim - 1))) for z in zstk],
+                "step": P()}
+        else:
+            comm = {}
+            comm_spec = {}
+
+    buf_spec = jax.tree_util.tree_map(lambda x: P(*((None,) * x.ndim)),
+                                      state)
+    dspec = P("dp")
+
+    smapped = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(pspec, buf_spec, ospec, comm_spec, P(), P(), dspec),
+        out_specs=(P(), pspec, buf_spec, ospec, comm_spec),
+        check_vma=False)
+
+    def train_step(p, st, opt_bundle, key, lr, data):
+        loss, new_p, new_st, new_opt, new_comm = smapped(
+            p, st, opt_bundle["opt"], opt_bundle["comm"], key, lr, data)
+        return loss, new_p, new_st, {"opt": new_opt, "comm": new_comm}
+
+    p_sh = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), pspec)
+    s_sh = {"opt": jax.tree_util.tree_map(
+                lambda s: NamedSharding(mesh, s), ospec),
+            "comm": jax.tree_util.tree_map(
+                lambda s: NamedSharding(mesh, s), comm_spec)}
+    buf_sh = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), buf_spec)
+    data_sh = NamedSharding(mesh, P("dp"))
+
+    jitted = jax.jit(train_step,
+                     in_shardings=(p_sh, buf_sh, s_sh, None, None, None),
+                     out_shardings=(NamedSharding(mesh, P()), p_sh, buf_sh,
+                                    s_sh),
+                     donate_argnums=(0, 2))
+
+    params_l = jax.device_put(params_l, p_sh)
+    state = jax.device_put(state, buf_sh)
+    opt_bundle = jax.device_put({"opt": opt_l, "comm": comm}, s_sh)
+
+    cls = _LocalParamsTrainStep if local_params else _ExplicitDPTrainStep
+    prog = cls(jitted, params_l, state, opt_bundle,
+               {"params": p_sh, "opt": s_sh}, mesh, layer, data_sh)
+    prog._opt = optimizer
+    return prog
+
+
+# CompiledTrainStep import is deferred to avoid a circular import at module
+# load (compiler.py imports grad_comm lazily); build the classes at bottom.
+def _make_classes():
+    from .compiler import CompiledTrainStep
+
+    class ExplicitDP(CompiledTrainStep):
+        pass
+
+    class LocalParams(CompiledTrainStep):
+        """Params carry a leading per-rank replica axis; write_back
+        averages the replicas (what the final localsgd sync would do)."""
+
+        def write_back(self):
+            lookup = dict(self.layer.named_parameters())
+            lookup.update(dict(self.layer.named_buffers()))
+            for k, v in self.params.items():
+                if k in lookup:
+                    lookup[k]._data = jax.device_get(v).mean(axis=0)
+            for k, v in self.state.items():
+                if k in lookup:
+                    lookup[k]._data = jax.device_get(v)
+
+    return ExplicitDP, LocalParams
+
+
+_ExplicitDPTrainStep, _LocalParamsTrainStep = _make_classes()

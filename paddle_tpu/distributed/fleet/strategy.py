@@ -62,6 +62,25 @@ class GradientMergeConfig:
     avg: bool = True
 
 
+@dataclasses.dataclass
+class LocalSGDConfig:
+    k_steps: int = 4             # sync params every k local steps
+    begin_step: int = 1          # warm-up: sync every step before this
+
+
+@dataclasses.dataclass
+class AdaptiveLocalSGDConfig:
+    init_k_steps: int = 1
+    begin_step: int = 1
+
+
+@dataclasses.dataclass
+class DGCConfig:
+    rampup_begin_step: int = 0   # dense allreduce before this step
+    sparsity: float = 0.999      # fraction dropped; keep ratio = 1-sparsity
+    momentum: float = 0.9
+
+
 class DistributedStrategy:
     """Mutable strategy object with paddle's toggles-as-properties shape."""
 
@@ -85,6 +104,20 @@ class DistributedStrategy:
         self.fuse_all_reduce_ops = True     # parity no-op: XLA fuses
         self.fuse_grad_size_in_MB = 32      # parity no-op
         self.nccl_comm_num = 1              # parity no-op: no NCCL
+        # gradient-communication meta-optimizers (fleet/grad_comm.py)
+        self.localsgd = False
+        self.localsgd_configs = LocalSGDConfig()
+        self.adaptive_localsgd = False
+        self.adaptive_localsgd_configs = AdaptiveLocalSGDConfig()
+        self.dgc = False
+        self.dgc_configs = DGCConfig()
+        self.fp16_allreduce = False         # bf16 on TPU (f32 exponent)
+        self.hierarchical_allreduce = False  # parity no-op: XLA owns topology
+        # optimizer-swap toggles (lars/lamb meta-optimizers: the reference
+        # rewrites momentum->lars_momentum ops; here fleet swaps the
+        # optimizer class at compile time when these are set)
+        self.lars = False
+        self.lamb = False
 
     # -- mesh compilation --------------------------------------------------
     def resolve_degrees(self, n_devices: int):

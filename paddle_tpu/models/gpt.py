@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import nn
+from .. import ops as F_ops
 from ..core.tensor import Tensor
 from ..nn import functional as F
 
@@ -129,33 +130,54 @@ class GPT(nn.Layer):
         # weight tying (lm_head = wte.T) keeps the embedding matmul on-MXU
         # and halves embedding memory, standard for the GPT family.
 
-    def forward(self, idx):
+    def forward_hidden(self, idx):
+        """Final-layer-norm hidden states [B,T,C] (everything but the tied
+        LM head) — the input the fused linear+CE loss consumes."""
         B, T = idx.shape
         from ..ops.creation import arange
         pos = arange(T, dtype="int64").unsqueeze(0)
         x = self.drop(self.wte(idx) + self.wpe(pos))
         for blk in self.blocks:
             x = blk(x)
-        x = self.ln_f(x)
+        return self.ln_f(x)
+
+    def forward(self, idx):
+        x = self.forward_hidden(idx)
         logits = F.linear(x, self.wte.weight.transpose([1, 0]))
         return logits
+
+    def _head_ce(self, h, labels, ignore_index=-100):
+        """Tied-head CE via linear_cross_entropy (ops/pallas/fused_ce.py):
+        the [tokens, vocab] logits are never saved as backward residuals —
+        the head matmul is recomputed in the VJP (and on large-vocab
+        geometries never hits HBM at all). Masking matches
+        F.cross_entropy's ignore_index semantics: ignored rows contribute
+        0 to the sum and are excluded from the mean's denominator."""
+        C = h.shape[-1]
+        lab = F_ops.reshape(labels, [-1])
+        valid = F_ops.not_equal(lab, F_ops.full_like(lab, ignore_index))
+        safe = F_ops.where(valid, lab, F_ops.zeros_like(lab))
+        rows = F.linear_cross_entropy(F_ops.reshape(h, [-1, C]),
+                                      self.wte.weight, safe,
+                                      reduction="none")
+        rows = F_ops.where(valid, rows, F_ops.zeros_like(rows))
+        n_valid = F_ops.sum(F_ops.cast(valid, "float32"))
+        # all-ignored batch -> 0 loss, not 0/0 (matches F.cross_entropy)
+        n_valid = F_ops.maximum(n_valid, F_ops.ones_like(n_valid))
+        return F_ops.sum(rows) / n_valid
 
     def loss(self, idx, labels, moe_aux_coef=0.01):
         if self.cfg.moe_experts > 0:
             from ..nn.layer.moe import collect_aux_losses
             with collect_aux_losses() as auxes:
-                logits = self.forward(idx)
-            V = logits.shape[-1]
-            ce = F.cross_entropy(logits.reshape([-1, V]),
-                                 labels.reshape([-1]))
+                h = self.forward_hidden(idx)
+            ce = self._head_ce(h, labels)
             # Switch load-balance pressure so experts don't collapse
             total_aux = auxes[0]
             for a in auxes[1:]:
                 total_aux = total_aux + a
             return ce + moe_aux_coef * total_aux / max(len(auxes), 1)
-        logits = self.forward(idx)
-        V = logits.shape[-1]
-        return F.cross_entropy(logits.reshape([-1, V]), labels.reshape([-1]))
+        return self._head_ce(self.forward_hidden(idx), labels)
 
     def num_params(self) -> int:
         return sum(int(math.prod(p.shape)) for p in self.parameters())
