@@ -412,6 +412,12 @@ def _apply_inner(fn, args, op_name, kwargs):
 
     if not diff_pos:
         out = fn(*raw, **kwargs)
+        # jax-native passthrough: called on raw tracers with no Tensor in
+        # sight (user's own jit/grad around a paddle op) — hand back raw
+        # arrays so the op is a valid JAX function, not a Tensor factory
+        if (not any(isinstance(a, Tensor) for a in args)
+                and any(isinstance(a, jax.core.Tracer) for a in args)):
+            return out
         return _wrap_outputs(out, None)
 
     def call(*diff_arrays):

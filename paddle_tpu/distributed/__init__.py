@@ -41,3 +41,5 @@ def _spawn_entry(func, args, env):
 
 from . import elastic  # noqa: F401
 from . import sequence_parallel  # noqa: F401
+
+from .store import Store, TCPStore, FileStore  # noqa: F401

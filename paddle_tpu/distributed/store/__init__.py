@@ -1,0 +1,256 @@
+"""Rendezvous stores: TCPStore (native C++ server) and FileStore.
+
+Reference: the gloo store layer fleet role makers rendezvous through —
+HdfsStore / http / file stores behind gloo_wrapper
+(/root/reference/paddle/fluid/framework/fleet/gloo_wrapper.h:113,
+platform/gloo_context.cc, python fleet/base/role_maker.py). The TPU
+collective path needs none of this (jax.distributed.initialize is the
+comm-id bootstrap); the store serves everything control-plane: PS
+worker/server rendezvous, elastic launcher state, user-level barriers.
+
+    store = TCPStore.start()            # or TCPStore("host:port")
+    store.set("k", b"v"); store.wait("k")
+    store.add("counter", 1)
+    store.barrier("init", world_size=8, rank=rank)
+"""
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import subprocess
+import time
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "native")
+
+__all__ = ["Store", "TCPStore", "FileStore"]
+
+_UNSET = object()   # wait(): distinguish "omitted" from "None = forever"
+
+
+def build_store_binary(force=False) -> str:
+    """Compile native/tcp_store.cpp once (g++ -O2); returns binary path."""
+    src = os.path.join(_NATIVE_DIR, "tcp_store.cpp")
+    out = os.path.join(_NATIVE_DIR, "tcp_store")
+    if force or (not os.path.exists(out)
+                 or os.path.getmtime(out) < os.path.getmtime(src)):
+        cmd = ["g++", "-O2", "-std=c++17", "-pthread", "-o", out, src]
+        res = subprocess.run(cmd, capture_output=True, text=True)
+        if res.returncode != 0:
+            raise RuntimeError(f"tcp_store build failed:\n{res.stderr}")
+    return out
+
+
+class Store:
+    """Key-value store interface (gloo-store analog).
+
+    Concrete stores implement set/get/wait/add/delete_key/num_keys;
+    barrier() is derived (gloo-style ADD + WAIT)."""
+
+    def set(self, key: str, value: bytes):
+        raise NotImplementedError
+
+    def get(self, key: str):
+        """Value bytes, or None when the key is absent (non-blocking)."""
+        raise NotImplementedError
+
+    def wait(self, key: str, timeout: float | None = None) -> bytes:
+        raise NotImplementedError
+
+    def add(self, key: str, delta: int = 1) -> int:
+        raise NotImplementedError
+
+    def delete_key(self, key: str) -> bool:
+        raise NotImplementedError
+
+    def num_keys(self) -> int:
+        raise NotImplementedError
+
+    def barrier(self, name: str, world_size: int, rank: int = 0,
+                timeout: float | None = 60.0):
+        """All `world_size` callers block until everyone arrived.
+
+        Reusable: the arrival counter only ever grows; each round of
+        `world_size` arrivals releases its own epoch key, so calling the
+        same barrier name every training step keeps synchronizing."""
+        n = self.add(f"__barrier__/{name}/count", 1)
+        epoch = (n - 1) // world_size
+        if n == (epoch + 1) * world_size:   # last arrival of this round
+            self.set(f"__barrier__/{name}/go/{epoch}", b"1")
+        self.wait(f"__barrier__/{name}/go/{epoch}", timeout=timeout)
+
+
+class TCPStore(Store):
+    """Client for the native tcp_store server; `TCPStore.start()` also
+    owns a server process (the rank-0 pattern)."""
+
+    def __init__(self, endpoint: str, timeout: float = 60.0):
+        host, port = endpoint.rsplit(":", 1)
+        self.endpoint = endpoint
+        self._timeout = timeout
+        self._sock = socket.create_connection((host, int(port)),
+                                              timeout=timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._proc = None
+
+    @classmethod
+    def start(cls, port: int = 0, timeout: float = 60.0) -> "TCPStore":
+        binary = build_store_binary()
+        proc = subprocess.Popen([binary, str(port)],
+                                stdout=subprocess.PIPE, text=True)
+        line = proc.stdout.readline().strip()
+        if not line.startswith("STORE_LISTENING"):
+            raise RuntimeError(f"tcp_store failed to start: {line!r}")
+        store = cls(f"127.0.0.1:{int(line.split()[1])}", timeout=timeout)
+        store._proc = proc
+        return store
+
+    # -- wire --------------------------------------------------------------
+    def _req(self, verb: int, key: str, n: int = 0, payload: bytes = b"",
+             sock=None):
+        sock = sock or self._sock
+        kb = key.encode()
+        sock.sendall(struct.pack("<BIQ", verb, len(kb), n) + kb + payload)
+        status = self._recv_exact(1, sock)[0]
+        (m,) = struct.unpack("<Q", self._recv_exact(8, sock))
+        body = self._recv_exact(m, sock) if m else b""
+        return status, body
+
+    def _recv_exact(self, n: int, sock=None) -> bytes:
+        sock = sock or self._sock
+        buf = b""
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("tcp_store connection closed")
+            buf += chunk
+        return buf
+
+    # -- Store interface ---------------------------------------------------
+    def set(self, key, value):
+        st, _ = self._req(1, key, len(value), bytes(value))
+        if st:
+            raise RuntimeError("tcp_store SET failed")
+
+    def get(self, key):
+        st, body = self._req(2, key)
+        return None if st else body
+
+    def wait(self, key, timeout=_UNSET):
+        # omitted -> constructor default; explicit None -> block forever
+        # (matches FileStore semantics; wire ms=0 means no deadline)
+        timeout = self._timeout if timeout is _UNSET else timeout
+        ms = 0 if timeout is None else max(int(timeout * 1000), 1)
+        # the blocking WAIT holds this connection; use a fresh socket so a
+        # concurrent set/add from the same client can't deadlock
+        host, port = self.endpoint.rsplit(":", 1)
+        with socket.create_connection(
+                (host, int(port)),
+                timeout=None if timeout is None else timeout + 5) as sock:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            st, body = self._req(3, key, ms, sock=sock)
+        if st:
+            raise TimeoutError(f"tcp_store WAIT({key!r}) timed out")
+        return body
+
+    def add(self, key, delta=1):
+        st, body = self._req(4, key, 8, struct.pack("<q", delta))
+        if st:
+            raise RuntimeError("tcp_store ADD failed")
+        return struct.unpack("<q", body)[0]
+
+    def delete_key(self, key):
+        st, _ = self._req(5, key)
+        return st == 0
+
+    def num_keys(self):
+        _, body = self._req(6, key="")
+        return struct.unpack("<Q", body)[0]
+
+    def stop_server(self):
+        try:
+            self._req(7, "")
+        except (ConnectionError, OSError):
+            pass
+        if self._proc is not None and self._proc.poll() is None:
+            try:
+                self._proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self._proc.terminate()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        if self._proc is not None:
+            self.stop_server()
+        self._sock.close()
+
+
+class FileStore(Store):
+    """Shared-filesystem store (gloo FileStore analog) — zero-server
+    rendezvous when ranks share an NFS/GCS-fuse path."""
+
+    def __init__(self, path: str):
+        self._dir = path
+        os.makedirs(path, exist_ok=True)
+
+    def _fn(self, key):
+        return os.path.join(self._dir, key.replace("/", "%2F"))
+
+    def set(self, key, value):
+        tmp = self._fn(key) + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(bytes(value))
+        os.replace(tmp, self._fn(key))     # atomic publish
+
+    def get(self, key):
+        try:
+            with open(self._fn(key), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+
+    def wait(self, key, timeout=60.0):
+        deadline = None if timeout is None else time.time() + timeout
+        while True:
+            v = self.get(key)
+            if v is not None:
+                return v
+            if deadline is not None and time.time() > deadline:
+                raise TimeoutError(f"FileStore WAIT({key!r}) timed out")
+            time.sleep(0.02)
+
+    def add(self, key, delta=1):
+        # lock via atomic O_EXCL lockfile (NFS-safe enough for rendezvous)
+        lock = self._fn(key) + ".lock"
+        deadline = time.time() + 60.0
+        while True:
+            try:
+                fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                os.close(fd)
+                break
+            except FileExistsError:
+                if time.time() > deadline:
+                    raise TimeoutError(f"FileStore ADD lock on {key!r}")
+                time.sleep(0.01)
+        try:
+            cur = self.get(key)
+            now = (int.from_bytes(cur, "little", signed=True)
+                   if cur else 0) + delta
+            self.set(key, now.to_bytes(8, "little", signed=True))
+            return now
+        finally:
+            os.unlink(lock)
+
+    def delete_key(self, key):
+        try:
+            os.unlink(self._fn(key))
+            return True
+        except FileNotFoundError:
+            return False
+
+    def num_keys(self):
+        return sum(1 for f in os.listdir(self._dir)
+                   if not f.endswith((".tmp", ".lock")))

@@ -1,0 +1,235 @@
+// TCPStore: key-value rendezvous store for DCN bootstrap and PS-mode
+// coordination.
+//
+// Reference: the gloo store wrappers the fleet role makers rendezvous
+// through (/root/reference/paddle/fluid/framework/fleet/gloo_wrapper.h:113
+// HdfsStore/ParallelConnectContext: Set/Get/Wait over a shared medium, and
+// platform/gloo_context.cc). The reference rides HDFS/HTTP/file stores;
+// TPU-native multihost already has the jax coordination service for the
+// collective path, so this store exists for everything *outside* it: PS
+// worker/server rendezvous, launcher elastic state, user barriers.
+//
+// Dependency-free length-prefixed TCP, one thread per connection (same
+// trade-offs as ps/native/ps_server.cpp: the store is a control-plane
+// service, connection counts are O(hosts), not O(requests/sec)).
+//
+// Protocol (little endian):
+//   request : u8 verb | u32 klen | u64 n | key | payload
+//   reply   : u8 status | u64 n | payload        (status 0 = ok)
+// Verbs:
+//   1 SET      payload = value bytes (n = value length)
+//   2 GET      -> value (status 1 if missing)
+//   3 WAIT     n = timeout_ms (0: forever) -> value once the key exists
+//   4 ADD      payload = i64 delta -> i64 new value (key created at 0)
+//   5 DEL      -> status 0 deleted / 1 missing
+//   6 NUMKEYS  -> u64 count
+//   7 STOP
+//   8 PING     -> 0 bytes
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Store {
+  std::unordered_map<std::string, std::vector<char>> kv;
+  std::mutex mu;
+  std::condition_variable cv;  // notified on every SET/ADD
+  std::atomic<bool> stopping{false};
+  int listen_fd = -1;
+};
+
+bool read_full(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n) {
+    ssize_t r = ::write(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool reply(int fd, uint8_t status, const void* payload, uint64_t n) {
+  if (!write_full(fd, &status, 1)) return false;
+  if (!write_full(fd, &n, sizeof(n))) return false;
+  return n == 0 || write_full(fd, payload, n);
+}
+
+void handle(Store& s, int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  for (;;) {
+    struct __attribute__((packed)) {
+      uint8_t verb;
+      uint32_t klen;
+      uint64_t n;
+    } hdr;
+    if (!read_full(fd, &hdr, sizeof(hdr))) break;
+    std::string key(hdr.klen, '\0');
+    if (hdr.klen && !read_full(fd, key.data(), hdr.klen)) break;
+
+    switch (hdr.verb) {
+      case 1: {  // SET
+        std::vector<char> val(hdr.n);
+        if (hdr.n && !read_full(fd, val.data(), hdr.n)) return;
+        {
+          std::lock_guard<std::mutex> lk(s.mu);
+          s.kv[key] = std::move(val);
+        }
+        s.cv.notify_all();
+        if (!reply(fd, 0, nullptr, 0)) return;
+        break;
+      }
+      case 2: {  // GET
+        std::lock_guard<std::mutex> lk(s.mu);
+        auto it = s.kv.find(key);
+        if (it == s.kv.end()) {
+          if (!reply(fd, 1, nullptr, 0)) return;
+        } else if (!reply(fd, 0, it->second.data(), it->second.size())) {
+          return;
+        }
+        break;
+      }
+      case 3: {  // WAIT (n = timeout_ms, 0 = forever)
+        std::unique_lock<std::mutex> lk(s.mu);
+        auto ready = [&] { return s.kv.count(key) || s.stopping.load(); };
+        bool ok;
+        if (hdr.n == 0) {
+          s.cv.wait(lk, ready);
+          ok = s.kv.count(key) != 0;
+        } else {
+          ok = s.cv.wait_for(lk, std::chrono::milliseconds(hdr.n), ready) &&
+               s.kv.count(key);
+        }
+        if (!ok) {
+          if (!reply(fd, 1, nullptr, 0)) return;
+        } else {
+          auto& v = s.kv[key];
+          if (!reply(fd, 0, v.data(), v.size())) return;
+        }
+        break;
+      }
+      case 4: {  // ADD
+        int64_t delta = 0;
+        if (hdr.n == 8) {
+          if (!read_full(fd, &delta, 8)) return;
+        }
+        int64_t now;
+        {
+          std::lock_guard<std::mutex> lk(s.mu);
+          auto& v = s.kv[key];
+          if (v.size() != 8) {
+            v.assign(8, 0);
+          }
+          std::memcpy(&now, v.data(), 8);
+          now += delta;
+          std::memcpy(v.data(), &now, 8);
+        }
+        s.cv.notify_all();
+        if (!reply(fd, 0, &now, 8)) return;
+        break;
+      }
+      case 5: {  // DEL
+        std::lock_guard<std::mutex> lk(s.mu);
+        uint8_t status = s.kv.erase(key) ? 0 : 1;
+        if (!reply(fd, status, nullptr, 0)) return;
+        break;
+      }
+      case 6: {  // NUMKEYS
+        uint64_t n;
+        {
+          std::lock_guard<std::mutex> lk(s.mu);
+          n = s.kv.size();
+        }
+        if (!reply(fd, 0, &n, 8)) return;
+        break;
+      }
+      case 7: {  // STOP
+        reply(fd, 0, nullptr, 0);
+        s.stopping.store(true);
+        s.cv.notify_all();
+        ::shutdown(s.listen_fd, SHUT_RDWR);
+        return;
+      }
+      case 8: {  // PING
+        if (!reply(fd, 0, nullptr, 0)) return;
+        break;
+      }
+      default:
+        return;  // protocol desync: drop the connection
+    }
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  int port = argc > 1 ? std::atoi(argv[1]) : 0;
+  Store store;
+
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    std::perror("bind");
+    return 1;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  if (listen(fd, 128) != 0) {
+    std::perror("listen");
+    return 1;
+  }
+  store.listen_fd = fd;
+  std::printf("STORE_LISTENING %d\n", ntohs(addr.sin_port));
+  std::fflush(stdout);
+
+  // Detached handlers: clients open a fresh connection per blocking WAIT,
+  // so joined threads would accumulate one zombie per wait for the store's
+  // lifetime. Handlers only touch `store` (stack-resident in main, alive
+  // until exit) and their own fd.
+  while (!store.stopping.load()) {
+    int cfd = ::accept(fd, nullptr, nullptr);
+    if (cfd < 0) break;
+    std::thread([&store, cfd] {
+      handle(store, cfd);
+      ::close(cfd);
+    }).detach();
+  }
+  ::close(fd);
+  // grace period: let detached handlers (notified via stopping/cv) drain
+  // before `store` leaves scope
+  std::this_thread::sleep_for(std::chrono::milliseconds(100));
+  return 0;
+}

@@ -90,21 +90,35 @@ def _to_saveable(obj):
     return obj
 
 
-def save(obj, path, protocol=4):
+def save(obj, path, protocol=4, cipher_key=None):
     """paddle.save parity: pickle a (possibly nested) state dict.
 
-    Tensors are converted to host numpy arrays (device→host transfer)."""
+    Tensors are converted to host numpy arrays (device→host transfer).
+    cipher_key (32 bytes) encrypts the file (io/crypto — the reference's
+    model-encryption capability, framework/io/crypto/cipher.cc)."""
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
+    if cipher_key is None:      # streaming path: no full-blob buffering
+        with open(path, "wb") as f:
+            pickle.dump(_to_saveable(obj), f, protocol=protocol)
+        return
+    from .io import crypto
+    blob = crypto.encrypt(pickle.dumps(_to_saveable(obj),
+                                       protocol=protocol), cipher_key)
     with open(path, "wb") as f:
-        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+        f.write(blob)
 
 
-def load(path, return_numpy=False):
-    """paddle.load parity."""
-    with open(path, "rb") as f:
-        obj = pickle.load(f)
+def load(path, return_numpy=False, cipher_key=None):
+    """paddle.load parity; cipher_key decrypts a file written with one."""
+    if cipher_key is None:      # streaming path
+        with open(path, "rb") as f:
+            obj = pickle.load(f)
+    else:
+        from .io import crypto
+        with open(path, "rb") as f:
+            obj = pickle.loads(crypto.decrypt(f.read(), cipher_key))
     if return_numpy:
         return obj
     return _from_saved(obj)

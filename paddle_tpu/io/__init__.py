@@ -7,3 +7,4 @@ from .dataset import (ChainDataset, ComposeDataset, ConcatDataset, Dataset,
 from .sampler import (BatchSampler, DistributedBatchSampler, RandomSampler,
                       Sampler, SequenceSampler, SubsetRandomSampler,
                       WeightedRandomSampler)  # noqa: F401
+from . import crypto  # noqa: F401  (model encryption, io/crypto/)

@@ -1,0 +1,144 @@
+"""Model-file encryption (reference: framework/io/crypto/cipher.cc +
+pybind/crypto.cc — CryptoPP AES behind CipherFactory; python surface
+paddle.fluid.core.CipherFactory). Dependency-free build: ChaCha20
+(RFC 7539) in native/chacha20.cpp, compiled on first use.
+
+    from paddle_tpu.io import crypto
+    key = crypto.CipherFactory.generate_key()        # 32 bytes
+    cipher = crypto.CipherFactory.create_cipher()
+    cipher.encrypt_to_file(plain_bytes, key, "model.enc")
+    plain = cipher.decrypt_from_file(key, "model.enc")
+
+`paddle.save/load(..., cipher_key=...)` route through this module.
+File layout: magic "PDTC" | u8 version | 12B nonce | 16B tag | ciphertext.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import secrets
+import subprocess
+
+__all__ = ["Cipher", "CipherFactory", "encrypt", "decrypt",
+           "encrypt_to_file", "decrypt_from_file"]
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "native")
+_MAGIC = b"PDTC"
+_VERSION = 1
+_lib = None
+
+
+def _load_lib():
+    global _lib
+    if _lib is not None:
+        return _lib
+    src = os.path.join(_NATIVE_DIR, "chacha20.cpp")
+    so = os.path.join(_NATIVE_DIR, "chacha20.so")
+    if (not os.path.exists(so)
+            or os.path.getmtime(so) < os.path.getmtime(src)):
+        res = subprocess.run(
+            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", so, src],
+            capture_output=True, text=True)
+        if res.returncode != 0:
+            raise RuntimeError(f"chacha20 build failed:\n{res.stderr}")
+    try:
+        lib = ctypes.CDLL(so)
+    except OSError:
+        # stale/foreign-platform artifact (e.g. copied checkout): rebuild
+        os.unlink(so)
+        return _load_lib()
+    lib.pd_chacha20_xor.restype = ctypes.c_int
+    lib.pd_chacha20_mac.restype = ctypes.c_int
+    _lib = lib
+    return lib
+
+
+def _keystream_xor(key: bytes, nonce: bytes, data: bytes,
+                   counter: int = 1) -> bytes:
+    lib = _load_lib()
+    buf = ctypes.create_string_buffer(bytes(data), len(data))
+    lib.pd_chacha20_xor(key, nonce, ctypes.c_uint32(counter), buf,
+                        ctypes.c_uint64(len(data)))
+    return buf.raw
+
+
+def _mac(key: bytes, nonce: bytes, data: bytes) -> bytes:
+    lib = _load_lib()
+    tag = ctypes.create_string_buffer(16)
+    lib.pd_chacha20_mac(key, nonce, bytes(data),
+                        ctypes.c_uint64(len(data)), tag)
+    return tag.raw
+
+
+def _check_key(key: bytes) -> bytes:
+    key = bytes(key)
+    if len(key) != 32:
+        raise ValueError(f"cipher key must be 32 bytes, got {len(key)}")
+    return key
+
+
+def encrypt(data: bytes, key: bytes) -> bytes:
+    """magic|version|nonce|tag|ciphertext (encrypt-then-MAC)."""
+    key = _check_key(key)
+    nonce = secrets.token_bytes(12)
+    ct = _keystream_xor(key, nonce, bytes(data))
+    tag = _mac(key, nonce, ct)
+    return _MAGIC + bytes([_VERSION]) + nonce + tag + ct
+
+
+def decrypt(blob: bytes, key: bytes) -> bytes:
+    key = _check_key(key)
+    if blob[:4] != _MAGIC or len(blob) < 4 + 1 + 12 + 16:
+        raise ValueError("not a paddle_tpu encrypted blob")
+    if blob[4] != _VERSION:
+        raise ValueError(f"unsupported cipher version {blob[4]}")
+    nonce = blob[5:17]
+    tag = blob[17:33]
+    ct = blob[33:]
+    import hmac as _hmac
+    if not _hmac.compare_digest(_mac(key, nonce, ct), tag):
+        raise ValueError("decryption failed: wrong key or corrupted file")
+    return _keystream_xor(key, nonce, ct)
+
+
+def encrypt_to_file(data: bytes, key: bytes, path: str):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(encrypt(data, key))
+
+
+def decrypt_from_file(key: bytes, path: str) -> bytes:
+    with open(path, "rb") as f:
+        return decrypt(f.read(), key)
+
+
+class Cipher:
+    """Reference Cipher surface (cipher.h: Encrypt/Decrypt +
+    EncryptToFile/DecryptFromFile)."""
+
+    def encrypt(self, plaintext: bytes, key: bytes) -> bytes:
+        return encrypt(plaintext, key)
+
+    def decrypt(self, ciphertext: bytes, key: bytes) -> bytes:
+        return decrypt(ciphertext, key)
+
+    def encrypt_to_file(self, plaintext: bytes, key: bytes, path: str):
+        encrypt_to_file(plaintext, key, path)
+
+    def decrypt_from_file(self, key: bytes, path: str) -> bytes:
+        return decrypt_from_file(key, path)
+
+
+class CipherFactory:
+    """Reference CipherFactory::CreateCipher parity."""
+
+    @staticmethod
+    def create_cipher(config_file: str = None) -> Cipher:
+        return Cipher()
+
+    @staticmethod
+    def generate_key() -> bytes:
+        return secrets.token_bytes(32)

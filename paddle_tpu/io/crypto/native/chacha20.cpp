@@ -1,0 +1,107 @@
+// ChaCha20 stream cipher (RFC 7539) + Poly1305-free keyed integrity tag
+// (HMAC-style over the keystream) for model-file encryption.
+//
+// Reference capability: AES cipher for saved programs/params
+// (/root/reference/paddle/fluid/framework/io/crypto/cipher.cc,
+//  cipher_utils.cc, pybind/crypto.cc — CryptoPP AES-CBC/GCM).
+// This build is dependency-free, so the cipher is ChaCha20: a public
+// RFC-specified design that is small enough to implement exactly and is
+// not table-driven (no cache-timing side channels). Integrity uses a
+// simple encrypt-then-MAC with a second ChaCha20 block as the key.
+//
+// C ABI (ctypes): all functions return 0 on success.
+//   pd_chacha20_xor(key32, nonce12, counter, buf, n)   in-place XOR
+//   pd_chacha20_mac(key32, nonce12, buf, n, tag16)     keystream MAC
+
+#include <stdint.h>
+#include <string.h>
+
+namespace {
+
+inline uint32_t rotl(uint32_t x, int n) {
+  return (x << n) | (x >> (32 - n));
+}
+
+inline uint32_t load32(const uint8_t* p) {
+  return static_cast<uint32_t>(p[0]) | (static_cast<uint32_t>(p[1]) << 8) |
+         (static_cast<uint32_t>(p[2]) << 16) |
+         (static_cast<uint32_t>(p[3]) << 24);
+}
+
+inline void store32(uint8_t* p, uint32_t v) {
+  p[0] = static_cast<uint8_t>(v);
+  p[1] = static_cast<uint8_t>(v >> 8);
+  p[2] = static_cast<uint8_t>(v >> 16);
+  p[3] = static_cast<uint8_t>(v >> 24);
+}
+
+#define QR(a, b, c, d)        \
+  a += b; d ^= a; d = rotl(d, 16); \
+  c += d; b ^= c; b = rotl(b, 12); \
+  a += b; d ^= a; d = rotl(d, 8);  \
+  c += d; b ^= c; b = rotl(b, 7)
+
+void chacha20_block(const uint8_t key[32], const uint8_t nonce[12],
+                    uint32_t counter, uint8_t out[64]) {
+  // RFC 7539 §2.3: constants | key | counter | nonce
+  uint32_t st[16] = {0x61707865u, 0x3320646eu, 0x79622d32u, 0x6b206574u};
+  for (int i = 0; i < 8; ++i) st[4 + i] = load32(key + 4 * i);
+  st[12] = counter;
+  for (int i = 0; i < 3; ++i) st[13 + i] = load32(nonce + 4 * i);
+
+  uint32_t x[16];
+  memcpy(x, st, sizeof(x));
+  for (int round = 0; round < 10; ++round) {  // 20 rounds = 10 double
+    QR(x[0], x[4], x[8], x[12]);
+    QR(x[1], x[5], x[9], x[13]);
+    QR(x[2], x[6], x[10], x[14]);
+    QR(x[3], x[7], x[11], x[15]);
+    QR(x[0], x[5], x[10], x[15]);
+    QR(x[1], x[6], x[11], x[12]);
+    QR(x[2], x[7], x[8], x[13]);
+    QR(x[3], x[4], x[9], x[14]);
+  }
+  for (int i = 0; i < 16; ++i) store32(out + 4 * i, x[i] + st[i]);
+}
+
+}  // namespace
+
+extern "C" {
+
+int pd_chacha20_xor(const uint8_t* key, const uint8_t* nonce,
+                    uint32_t counter, uint8_t* buf, uint64_t n) {
+  uint8_t block[64];
+  uint64_t off = 0;
+  while (off < n) {
+    chacha20_block(key, nonce, counter++, block);
+    uint64_t take = n - off < 64 ? n - off : 64;
+    for (uint64_t i = 0; i < take; ++i) buf[off + i] ^= block[i];
+    off += take;
+  }
+  return 0;
+}
+
+// Keyed tag: mix the ciphertext into a keystream-derived state (this is a
+// lightweight integrity check against corruption/wrong key, not an AEAD
+// proof — the reference's CBC mode had none at all).
+int pd_chacha20_mac(const uint8_t* key, const uint8_t* nonce,
+                    const uint8_t* buf, uint64_t n, uint8_t tag[16]) {
+  uint8_t block[64];
+  chacha20_block(key, nonce, 0xffffffffu, block);  // counter outside data use
+  uint32_t h[4] = {load32(block), load32(block + 4), load32(block + 8),
+                   load32(block + 12)};
+  for (uint64_t i = 0; i < n; ++i) {
+    uint32_t b = buf[i] + 1;
+    h[i & 3] = rotl(h[i & 3] ^ (b * 0x9e3779b1u), 13) * 0x85ebca6bu;
+  }
+  // fold in the length and finalize
+  h[0] ^= static_cast<uint32_t>(n);
+  h[1] ^= static_cast<uint32_t>(n >> 32);
+  for (int r = 0; r < 4; ++r)
+    for (int i = 0; i < 4; ++i)
+      h[i] = rotl(h[i] ^ h[(i + 1) & 3], 11) * 0xc2b2ae35u;
+  for (int i = 0; i < 4; ++i) store32(tag + 4 * i, h[i]);
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,260 @@
+"""JIT-compile user C++ custom ops and register them as paddle ops.
+
+Reference: python/paddle/utils/cpp_extension (load/setup compiling
+custom_relu_op.cc against stable ext headers, registered through
+framework/custom_operator.cc into the op registry; tests
+tests/custom_op/test_custom_attrs_jit.py — SURVEY.md §2 row 53, §4.8).
+
+TPU-native split of the capability:
+  * TPU-device custom kernels -> `utils.custom_op.register_op` with a
+    Pallas body (that is the CUDA-kernel analog; nothing to compile here).
+  * Host/CPU custom ops (IO, tokenizers, CPU reference kernels) -> THIS
+    module: g++ -shared against the stable C ABI in
+    ext_headers/paddle_ext.h, bound via ctypes, lifted into the op system
+    with `jax.pure_callback` so the op works under BOTH the eager tape and
+    jit (the callback runs host-side; XLA treats it as an opaque call).
+
+    mod = cpp_extension.load(name="my_ops", sources=["relu.cc"])
+    y = mod.custom_relu(x)          # eager Tensor or inside jit
+
+A `name__bwd` symbol, when exported, becomes the op's custom VJP —
+mirroring the reference's paired forward/backward custom kernels.
+"""
+from __future__ import annotations
+
+import ctypes
+import functools
+import os
+import subprocess
+import tempfile
+from typing import Callable, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["load", "get_include", "CppExtensionModule"]
+
+_HDR_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "ext_headers")
+
+_DTYPE_CODES = {
+    np.dtype(np.float32): 0, np.dtype(np.float64): 1,
+    np.dtype(np.int32): 2, np.dtype(np.int64): 3,
+    np.dtype(np.uint8): 4, np.dtype(np.bool_): 5,
+}
+
+
+def get_include() -> str:
+    """Directory holding paddle_ext.h (reference: paddle.sysconfig style)."""
+    return _HDR_DIR
+
+
+class _PdTensor(ctypes.Structure):
+    _fields_ = [("data", ctypes.c_void_p),
+                ("shape", ctypes.POINTER(ctypes.c_int64)),
+                ("ndim", ctypes.c_int32),
+                ("dtype", ctypes.c_int32)]
+
+
+def _as_pd(arr: np.ndarray, shapes_keepalive: list) -> _PdTensor:
+    shp = (ctypes.c_int64 * max(arr.ndim, 1))(*(arr.shape or (0,)))
+    shapes_keepalive.append(shp)
+    return _PdTensor(
+        data=arr.ctypes.data_as(ctypes.c_void_p),
+        shape=ctypes.cast(shp, ctypes.POINTER(ctypes.c_int64)),
+        ndim=arr.ndim,
+        dtype=_DTYPE_CODES[arr.dtype])
+
+
+def _compile(name: str, sources: Sequence[str], extra_cflags=()) -> str:
+    build_dir = os.path.join(tempfile.gettempdir(), "paddle_tpu_ext", name)
+    os.makedirs(build_dir, exist_ok=True)
+    out = os.path.join(build_dir, f"{name}.so")
+    srcs = [os.path.abspath(s) for s in sources]
+    stamp = max((os.path.getmtime(s) for s in srcs), default=0.0)
+    if not os.path.exists(out) or os.path.getmtime(out) < stamp:
+        cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+               f"-I{_HDR_DIR}", *extra_cflags, "-o", out, *srcs]
+        res = subprocess.run(cmd, capture_output=True, text=True)
+        if res.returncode != 0:
+            raise RuntimeError(
+                f"cpp_extension build of {name!r} failed:\n{res.stderr}")
+    return out
+
+
+def _exported_ops(so_path: str) -> Dict[str, bool]:
+    """{op_name: has_bwd} from the .so's dynamic symbol table (nm -D)."""
+    res = subprocess.run(["nm", "-D", "--defined-only", so_path],
+                         capture_output=True, text=True, check=True)
+    syms = {line.split()[-1] for line in res.stdout.splitlines() if line}
+    ops = {}
+    for s in syms:
+        if s.endswith("__fwd"):
+            base = s[:-len("__fwd")]
+            ops[base] = f"{base}__bwd" in syms
+    return ops
+
+
+def _call_kernel(cfun, ins: Sequence[np.ndarray],
+                 out_specs) -> tuple:
+    keep = []
+    in_arr = (_PdTensor * max(len(ins), 1))(
+        *[_as_pd(np.ascontiguousarray(a), keep) for a in ins])
+    outs = [np.zeros(shape, dtype) for shape, dtype in out_specs]
+    out_arr = (_PdTensor * max(len(outs), 1))(
+        *[_as_pd(o, keep) for o in outs])
+    cfun(in_arr, len(ins), out_arr, len(outs))
+    return tuple(outs)
+
+
+class CppExtensionModule:
+    """Namespace holding the ops exported by one compiled extension."""
+
+    def __init__(self, name, so_path, ops):
+        self.name = name
+        self.so_path = so_path
+        self._ops = ops
+
+    def __getattr__(self, item):
+        raise AttributeError(
+            f"extension {self.name!r} exports {sorted(self._ops)}; "
+            f"no op {item!r}")
+
+    def __repr__(self):
+        return f"<CppExtensionModule {self.name} ops={sorted(self._ops)}>"
+
+
+def load(name: str, sources: Sequence[str],
+         out_shapes: Optional[Dict[str, Callable]] = None,
+         num_outputs: Optional[Dict[str, int]] = None,
+         extra_cflags: Sequence[str] = (),
+         register: bool = False, verbose: bool = False):
+    """Compile `sources` and return a module exposing each `op__fwd` as a
+    paddle-callable op (usable on eager Tensors and inside jit).
+
+    out_shapes[op]: fn(*jax.ShapeDtypeStruct) -> list[(shape, dtype)] for
+    ops whose outputs are not same-shape-as-input-0 (the default rule, as
+    in the reference's InferShape fallback). num_outputs[op] defaults 1.
+    register=True additionally installs each op into the paddle namespace
+    via utils.custom_op.register_op.
+    """
+    so_path = _compile(name, sources, extra_cflags)
+    lib = ctypes.CDLL(so_path)
+    ops = _exported_ops(so_path)
+    if verbose:
+        print(f"cpp_extension {name}: {so_path} ops={sorted(ops)}")
+    if not ops:
+        raise RuntimeError(
+            f"{name}: no `<op>__fwd` symbols exported — declare kernels "
+            f'as extern "C" (see {_HDR_DIR}/paddle_ext.h)')
+
+    mod = CppExtensionModule(name, so_path, ops)
+    from ..core.tensor import apply
+
+    for op_name, has_bwd in ops.items():
+        fwd_c = getattr(lib, f"{op_name}__fwd")
+        fwd_c.restype = None
+        bwd_c = getattr(lib, f"{op_name}__bwd") if has_bwd else None
+        if bwd_c is not None:
+            bwd_c.restype = None
+        n_out = (num_outputs or {}).get(op_name, 1)
+        shape_fn = (out_shapes or {}).get(op_name)
+
+        def make(op_name=op_name, fwd_c=fwd_c, bwd_c=bwd_c, n_out=n_out,
+                 shape_fn=shape_fn):
+            def out_specs_of(avals):
+                if shape_fn is not None:
+                    return [(tuple(s), np.dtype(d))
+                            for s, d in shape_fn(*avals)]
+                a0 = avals[0]
+                return [(tuple(a0.shape), np.dtype(a0.dtype))] * n_out
+
+            def host_fwd(*arrs):
+                specs = out_specs_of([jax.ShapeDtypeStruct(a.shape, a.dtype)
+                                      for a in arrs])
+                return _call_kernel(fwd_c, arrs, specs)
+
+            def fwd_raw(*raw):
+                specs = out_specs_of(raw)
+                result = jax.pure_callback(
+                    host_fwd,
+                    tuple(jax.ShapeDtypeStruct(s, d) for s, d in specs),
+                    *raw, vmap_method="sequential")
+                return result[0] if len(result) == 1 else result
+
+            if bwd_c is None:
+                kernel = fwd_raw
+            else:
+                @jax.custom_vjp
+                def kernel(*raw):
+                    return fwd_raw(*raw)
+
+                def k_fwd(*raw):
+                    return fwd_raw(*raw), raw
+
+                def k_bwd(raw, g):
+                    gs = g if isinstance(g, (tuple, list)) else (g,)
+
+                    def host_bwd(*flat):
+                        ins = flat[:len(raw)]
+                        grads = flat[len(raw):]
+                        keep = []
+                        in_arr = (_PdTensor * max(len(ins), 1))(
+                            *[_as_pd(np.ascontiguousarray(a), keep)
+                              for a in ins])
+                        g_arr = (_PdTensor * max(len(grads), 1))(
+                            *[_as_pd(np.ascontiguousarray(a), keep)
+                              for a in grads])
+                        # the kernel still receives a dins slot per input
+                        # (ABI stability); integer slots are discarded
+                        douts = [np.zeros(a.shape,
+                                          a.dtype if np.issubdtype(
+                                              a.dtype, np.inexact)
+                                          else np.float32) for a in ins]
+                        d_arr = (_PdTensor * max(len(douts), 1))(
+                            *[_as_pd(o, keep) for o in douts])
+                        bwd_c(in_arr, len(ins), g_arr, len(grads),
+                              d_arr, len(douts))
+                        return tuple(o for o, a in zip(douts, ins)
+                                     if np.issubdtype(a.dtype, np.inexact))
+
+                    inexact = [np.issubdtype(np.dtype(r.dtype), np.inexact)
+                               for r in raw]
+                    dflt = jax.pure_callback(
+                        host_bwd,
+                        tuple(jax.ShapeDtypeStruct(r.shape, r.dtype)
+                              for r, ix in zip(raw, inexact) if ix),
+                        *raw, *gs, vmap_method="sequential")
+                    dflt = iter(dflt)
+                    # custom_vjp cotangent rule: float0 zeros for integer
+                    # primals, real cotangents for inexact ones
+                    return tuple(
+                        next(dflt) if ix else
+                        np.zeros(r.shape, jax.dtypes.float0)
+                        for r, ix in zip(raw, inexact))
+
+                kernel.defvjp(k_fwd, k_bwd)
+
+            @functools.wraps(kernel)
+            def op(*args, **kwargs):
+                if kwargs:
+                    # the C ABI carries tensors only; silently dropping
+                    # attrs would be silently-wrong numerics
+                    raise TypeError(
+                        f"{op_name}() got unexpected keyword arguments "
+                        f"{sorted(kwargs)}: cpp_extension ops take tensor "
+                        f"positional args only (bake attrs into the C++ "
+                        f"source, or use utils.custom_op.register_op for "
+                        f"attr-carrying custom ops)")
+                return apply(kernel, *args, op_name=op_name)
+            op.__name__ = op_name
+            return op
+
+        bound = make()
+        setattr(mod, op_name, bound)
+        if register:
+            from .custom_op import register_op
+            register_op(op_name, bound.__wrapped__
+                        if hasattr(bound, "__wrapped__") else bound)
+    return mod

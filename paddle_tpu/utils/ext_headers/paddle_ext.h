@@ -1,0 +1,64 @@
+/* paddle_tpu stable custom-op ABI (single header, C linkage).
+ *
+ * Reference: the stable-header custom-op surface in
+ * /root/reference/paddle/fluid/extension/include/ext_*.h consumed by
+ * python/paddle/utils/cpp_extension. The reference ships a C++ Tensor
+ * class; here the ABI is plain C structs so any compiler (and ctypes)
+ * can bind without name mangling or libstdc++ layout coupling.
+ *
+ * An op "name" exports:
+ *   void name__fwd(const pd_tensor* ins, int n_in,
+ *                  pd_tensor* outs, int n_out);
+ * and optionally the gradient kernel:
+ *   void name__bwd(const pd_tensor* ins, int n_in,
+ *                  const pd_tensor* grads, int n_grad,
+ *                  pd_tensor* dins, int n_dins);
+ *
+ * Output buffers are allocated by the framework before the call (shapes
+ * from the op's out_shapes rule on the Python side); kernels only fill
+ * .data. pd_numel is a convenience for elementwise loops.
+ */
+#ifndef PADDLE_TPU_EXT_H_
+#define PADDLE_TPU_EXT_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+enum pd_dtype {
+  PD_FLOAT32 = 0,
+  PD_FLOAT64 = 1,
+  PD_INT32 = 2,
+  PD_INT64 = 3,
+  PD_UINT8 = 4,
+  PD_BOOL = 5,
+};
+
+typedef struct {
+  void* data;
+  const int64_t* shape;
+  int32_t ndim;
+  int32_t dtype; /* pd_dtype */
+} pd_tensor;
+
+static inline int64_t pd_numel(const pd_tensor* t) {
+  int64_t n = 1;
+  for (int32_t i = 0; i < t->ndim; ++i) n *= t->shape[i];
+  return n;
+}
+
+/* Kernel definitions live outside this header's extern "C" block, so the
+ * macro itself must carry the C linkage. */
+#ifdef __cplusplus
+#define PD_KERNEL(name) extern "C" void name
+#else
+#define PD_KERNEL(name) void name
+#endif
+
+#ifdef __cplusplus
+} /* extern "C" */
+#endif
+
+#endif /* PADDLE_TPU_EXT_H_ */

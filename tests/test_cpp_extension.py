@@ -1,0 +1,110 @@
+"""cpp_extension: compile a user C++ op at test time and run it on eager
+Tensors and inside jit, with the exported __bwd as its VJP.
+
+Reference test model: tests/custom_op/custom_relu_op.cc +
+test_custom_attrs_jit.py (compile via utils/cpp_extension at test time —
+SURVEY.md §4.8)."""
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.utils import cpp_extension
+
+_SRC = textwrap.dedent("""
+    #include "paddle_ext.h"
+    #include <math.h>
+
+    // leaky_relu with a C++ forward and hand-written backward
+    PD_KERNEL(my_leaky_relu__fwd)(const pd_tensor* ins, int n_in,
+                                  pd_tensor* outs, int n_out) {
+      const float* x = (const float*)ins[0].data;
+      float* y = (float*)outs[0].data;
+      int64_t n = pd_numel(&ins[0]);
+      for (int64_t i = 0; i < n; ++i)
+        y[i] = x[i] > 0.f ? x[i] : 0.1f * x[i];
+    }
+
+    PD_KERNEL(my_leaky_relu__bwd)(const pd_tensor* ins, int n_in,
+                                  const pd_tensor* grads, int n_grad,
+                                  pd_tensor* dins, int n_dins) {
+      const float* x = (const float*)ins[0].data;
+      const float* g = (const float*)grads[0].data;
+      float* dx = (float*)dins[0].data;
+      int64_t n = pd_numel(&ins[0]);
+      for (int64_t i = 0; i < n; ++i)
+        dx[i] = x[i] > 0.f ? g[i] : 0.1f * g[i];
+    }
+
+    // two-input op, autodiff-opaque (no bwd): elementwise hypot
+    PD_KERNEL(my_hypot__fwd)(const pd_tensor* ins, int n_in,
+                             pd_tensor* outs, int n_out) {
+      const float* a = (const float*)ins[0].data;
+      const float* b = (const float*)ins[1].data;
+      float* y = (float*)outs[0].data;
+      int64_t n = pd_numel(&ins[0]);
+      for (int64_t i = 0; i < n; ++i) y[i] = hypotf(a[i], b[i]);
+    }
+""")
+
+
+@pytest.fixture(scope="module")
+def ext(tmp_path_factory):
+    d = tmp_path_factory.mktemp("ext")
+    src = os.path.join(d, "my_ops.cc")
+    with open(src, "w") as f:
+        f.write(_SRC)
+    return cpp_extension.load(name="test_my_ops", sources=[src])
+
+
+def test_exports(ext):
+    assert hasattr(ext, "my_leaky_relu")
+    assert hasattr(ext, "my_hypot")
+    with pytest.raises(AttributeError):
+        ext.nonexistent
+
+
+def test_eager_forward_and_grad(ext):
+    x = paddle.to_tensor(np.array([[-2.0, 3.0], [0.5, -1.0]], np.float32),
+                         stop_gradient=False)
+    y = ext.my_leaky_relu(x)
+    np.testing.assert_allclose(
+        y.numpy(), [[-0.2, 3.0], [0.5, -0.1]], rtol=1e-6)
+    y.sum().backward()
+    np.testing.assert_allclose(
+        x.grad.numpy(), [[0.1, 1.0], [1.0, 0.1]], rtol=1e-6)
+
+
+def test_inside_jit(ext):
+    def f(a):
+        return ext.my_leaky_relu(a) * 2.0
+
+    x = jnp.asarray([[-1.0, 2.0]], jnp.float32)
+    got = jax.jit(f)(x)
+    np.testing.assert_allclose(np.asarray(got), [[-0.2, 4.0]], rtol=1e-6)
+    # jit grad through the custom vjp
+    g = jax.grad(lambda a: jnp.sum(ext.my_leaky_relu(a)))(x)
+    np.testing.assert_allclose(np.asarray(g), [[0.1, 1.0]], rtol=1e-6)
+
+
+def test_two_input_op(ext):
+    a = paddle.to_tensor(np.array([3.0, 5.0], np.float32))
+    b = paddle.to_tensor(np.array([4.0, 12.0], np.float32))
+    np.testing.assert_allclose(ext.my_hypot(a, b).numpy(), [5.0, 13.0],
+                               rtol=1e-6)
+
+
+def test_kwargs_rejected(ext):
+    x = paddle.to_tensor(np.zeros((2,), np.float32))
+    with pytest.raises(TypeError, match="keyword arguments"):
+        ext.my_leaky_relu(x, scale=2.0)
+
+
+def test_get_include_has_header():
+    hdr = os.path.join(cpp_extension.get_include(), "paddle_ext.h")
+    assert os.path.exists(hdr)

@@ -1,0 +1,64 @@
+"""Model encryption (io/crypto: ChaCha20 RFC 7539 in native C++;
+reference capability: framework/io/crypto/cipher.cc AES via CryptoPP,
+pybind/crypto.cc CipherFactory)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import crypto
+
+
+def test_rfc7539_keystream_vector():
+    # RFC 7539 §2.4.2: the canonical ChaCha20 encryption test vector
+    key = bytes(range(32))
+    nonce = bytes.fromhex("000000000000004a00000000")
+    pt = (b"Ladies and Gentlemen of the class of '99: If I could offer "
+          b"you only one tip for the future, sunscreen would be it.")
+    ct = crypto._keystream_xor(key, nonce, pt, counter=1)
+    assert ct == bytes.fromhex(
+        "6e2e359a2568f98041ba0728dd0d6981e97e7aec1d4360c20a27afccfd9fae0b"
+        "f91b65c5524733ab8f593dabcd62b3571639d624e65152ab8f530c359f0861d8"
+        "07ca0dbf500d6a6156a38e088a22b65e52bc514d16ccf806818ce91ab7793736"
+        "5af90bbf74a35be6b40b8eedf2785e42874d")
+
+
+def test_roundtrip_and_integrity(tmp_path):
+    key = crypto.CipherFactory.generate_key()
+    cipher = crypto.CipherFactory.create_cipher()
+    data = b"\x00\x01" * 1000 + b"tail"
+    path = str(tmp_path / "m.enc")
+    cipher.encrypt_to_file(data, key, path)
+    assert cipher.decrypt_from_file(key, path) == data
+    # wrong key refused
+    with pytest.raises(ValueError, match="wrong key or corrupted"):
+        cipher.decrypt_from_file(crypto.CipherFactory.generate_key(), path)
+    # bit-flip refused
+    blob = bytearray(open(path, "rb").read())
+    blob[-1] ^= 0x40
+    with pytest.raises(ValueError):
+        crypto.decrypt(bytes(blob), key)
+    # nonces differ between encryptions (no keystream reuse)
+    assert crypto.encrypt(data, key)[5:17] != open(path, "rb").read()[5:17]
+
+
+def test_key_validation():
+    with pytest.raises(ValueError, match="32 bytes"):
+        crypto.encrypt(b"x", b"short")
+    with pytest.raises(ValueError, match="not a paddle_tpu encrypted"):
+        crypto.decrypt(b"garbage-blob-without-magic", bytes(32))
+
+
+def test_save_load_cipher_key(tmp_path):
+    key = crypto.CipherFactory.generate_key()
+    sd = {"w": paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3)),
+          "step": 7}
+    path = str(tmp_path / "model.pdparams.enc")
+    paddle.save(sd, path, cipher_key=key)
+    # encrypted on disk: pickle magic must NOT appear
+    raw = open(path, "rb").read()
+    assert raw[:4] == b"PDTC" and b"\x80\x04" not in raw[:10]
+    back = paddle.load(path, cipher_key=key)
+    np.testing.assert_array_equal(back["w"].numpy(), sd["w"].numpy())
+    assert back["step"] == 7
+    with pytest.raises(ValueError):
+        paddle.load(path, cipher_key=bytes(32))

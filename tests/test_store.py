@@ -1,0 +1,117 @@
+"""TCPStore (native C++ server) + FileStore rendezvous tests
+(reference: gloo store wrappers, gloo_wrapper.h:113 — SURVEY.md §2 row 34)."""
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.distributed import FileStore, TCPStore
+
+
+@pytest.fixture(scope="module")
+def store():
+    s = TCPStore.start()
+    yield s
+    s.stop_server()
+
+
+def test_set_get_delete(store):
+    assert store.get("missing") is None
+    store.set("k1", b"hello")
+    assert store.get("k1") == b"hello"
+    store.set("k1", b"world")          # overwrite
+    assert store.get("k1") == b"world"
+    assert store.delete_key("k1")
+    assert not store.delete_key("k1")
+    assert store.get("k1") is None
+
+
+def test_add_counter(store):
+    assert store.add("ctr", 1) == 1
+    assert store.add("ctr", 5) == 6
+    assert store.add("ctr", -2) == 4
+    store.delete_key("ctr")
+
+
+def test_wait_blocks_until_set(store):
+    def setter():
+        time.sleep(0.2)
+        TCPStore(store.endpoint).set("late", b"v")
+
+    t = threading.Thread(target=setter)
+    t.start()
+    assert store.wait("late", timeout=5.0) == b"v"
+    t.join()
+    store.delete_key("late")
+
+
+def test_wait_timeout(store):
+    with pytest.raises(TimeoutError):
+        store.wait("never", timeout=0.2)
+
+
+def test_num_keys(store):
+    base = store.num_keys()
+    store.set("nk1", b"x")
+    store.set("nk2", b"y")
+    assert store.num_keys() == base + 2
+    store.delete_key("nk1")
+    store.delete_key("nk2")
+
+
+def test_barrier_multiclient(store):
+    world = 4
+    errs = []
+
+    def worker(rank):
+        try:
+            c = TCPStore(store.endpoint)
+            c.barrier("b1", world_size=world, rank=rank, timeout=10.0)
+        except Exception as e:     # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(r,))
+               for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=15)
+    assert not errs
+
+
+def test_barrier_reusable_across_rounds(store):
+    """Same barrier name every step keeps synchronizing (epoch keys)."""
+    world = 3
+    order = []
+
+    def worker(rank, round_no):
+        c = TCPStore(store.endpoint)
+        c.barrier("loop", world_size=world, rank=rank, timeout=10.0)
+        order.append(round_no)
+
+    for rnd in range(3):
+        threads = [threading.Thread(target=worker, args=(r, rnd))
+                   for r in range(world)]
+        t0 = time.time()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=15)
+        assert time.time() - t0 < 10  # round 2+ must not hang or pass early
+    assert len(order) == 9
+
+
+def test_filestore(tmp_path):
+    fs = FileStore(str(tmp_path / "store"))
+    fs.set("a", b"1")
+    assert fs.get("a") == b"1"
+    assert fs.add("cnt", 3) == 3
+    assert fs.add("cnt", 4) == 7
+    assert fs.wait("a", timeout=1.0) == b"1"
+    with pytest.raises(TimeoutError):
+        fs.wait("zzz", timeout=0.2)
+    assert fs.num_keys() == 2
+    assert fs.delete_key("a")
+    # keys with slashes map to flat files
+    fs.set("x/y", b"2")
+    assert fs.get("x/y") == b"2"
