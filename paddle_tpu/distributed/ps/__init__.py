@@ -28,7 +28,7 @@ from typing import Dict, Optional
 import numpy as np
 
 __all__ = ["build_server_binary", "PSServer", "PSClient",
-           "AsyncCommunicator"]
+           "AsyncCommunicator", "GeoCommunicator"]
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "native")
@@ -101,13 +101,8 @@ class PSClient:
         return self._recv_exact(n) if n else b""
 
     def _recv_exact(self, n: int) -> bytes:
-        buf = bytearray()
-        while len(buf) < n:
-            chunk = self._sock.recv(n - len(buf))
-            if not chunk:
-                raise ConnectionError("ps server closed connection")
-            buf.extend(chunk)
-        return bytes(buf)
+        from ...utils.net import recv_exact
+        return recv_exact(self._sock, n, what="ps server")
 
     # -- table verbs -------------------------------------------------------
     def create_dense_table(self, table: int, size: int,
@@ -259,4 +254,85 @@ class AsyncCommunicator:
         self.flush()
         self._stop.set()
         self._thread.join(timeout=10)
+        self._client.close()
+
+
+class GeoCommunicator:
+    """Geo-SGD delta synchronization (reference: GeoCommunicator,
+    communicator.h:495 + sparse_geo_table.cc).
+
+    Workers train a LOCAL copy of the (sparse) embedding table; every
+    `sync_steps` optimizer applications the worker pushes the *delta*
+    against its last sync base, scaled by 1/nranks, and rebases onto the
+    fresh global rows — async workers see each other's progress without
+    per-step RPC. Server merge uses the existing server-side-SGD verb:
+    push_sparse(keys, -delta, lr=1) == w_global += delta.
+    """
+
+    def __init__(self, endpoint: str, table: int, dim: int,
+                 nranks: int = 1, sync_steps: int = 10):
+        self._client = PSClient(endpoint)
+        self._table = table
+        self._dim = dim
+        self._nranks = max(int(nranks), 1)
+        self._sync_steps = max(int(sync_steps), 1)
+        self._local: Dict[int, np.ndarray] = {}    # key -> local row
+        self._base: Dict[int, np.ndarray] = {}     # key -> row at last sync
+        self._touched: set = set()
+        self._applies = 0
+
+    def _ensure(self, keys: np.ndarray) -> np.ndarray:
+        """Make `keys` resident locally (unseen keys fetch the global
+        value and become the sync base); returns the raveled keys."""
+        keys = np.asarray(keys, np.uint64).ravel()
+        missing = [int(k) for k in keys if int(k) not in self._local]
+        if missing:
+            rows = self._client.pull_sparse(
+                self._table, np.asarray(missing, np.uint64), self._dim)
+            for k, r in zip(missing, rows):
+                self._local[k] = r.astype(np.float32).copy()
+                self._base[k] = r.astype(np.float32).copy()
+        return keys
+
+    def pull(self, keys: np.ndarray) -> np.ndarray:
+        """Local rows for `keys`."""
+        keys = self._ensure(keys)
+        return np.stack([self._local[int(k)] for k in keys])
+
+    def apply_grads(self, keys: np.ndarray, grads: np.ndarray,
+                    lr: float = 0.1):
+        """Local SGD on the worker copy; schedules a geo sync every
+        sync_steps applies."""
+        keys = self._ensure(keys)
+        grads = np.asarray(grads, np.float32).reshape(len(keys), self._dim)
+        for k, g in zip(keys, grads):
+            k = int(k)
+            self._local[k] = self._local[k] - lr * g
+            self._touched.add(k)
+        self._applies += 1
+        if self._applies % self._sync_steps == 0:
+            self.sync()
+
+    def sync(self):
+        """Push deltas/nranks for touched rows, pull fresh globals,
+        rebase."""
+        if not self._touched:
+            return
+        keys = np.fromiter(self._touched, np.uint64, len(self._touched))
+        delta = np.stack([(self._local[int(k)] - self._base[int(k)])
+                          / self._nranks for k in keys])
+        # server-side: w -= lr * grad with grad = -delta, lr = 1
+        self._client.push_sparse(self._table, keys, -delta, lr=1.0)
+        fresh = self._client.pull_sparse(self._table, keys, self._dim)
+        for k, r in zip(keys, fresh):
+            k = int(k)
+            self._local[k] = r.astype(np.float32).copy()
+            self._base[k] = self._local[k].copy()
+        self._touched.clear()
+
+    def close(self):
+        """Flush the partial sync window, then close (AsyncCommunicator
+        likewise flushes in stop() — un-synced local progress must not be
+        silently dropped)."""
+        self.sync()
         self._client.close()

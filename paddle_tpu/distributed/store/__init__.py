@@ -118,14 +118,8 @@ class TCPStore(Store):
         return status, body
 
     def _recv_exact(self, n: int, sock=None) -> bytes:
-        sock = sock or self._sock
-        buf = b""
-        while len(buf) < n:
-            chunk = sock.recv(n - len(buf))
-            if not chunk:
-                raise ConnectionError("tcp_store connection closed")
-            buf += chunk
-        return buf
+        from ...utils.net import recv_exact
+        return recv_exact(sock or self._sock, n, what="tcp_store")
 
     # -- Store interface ---------------------------------------------------
     def set(self, key, value):

@@ -1,0 +1,174 @@
+/* C client for the paddle_tpu inference serve daemon (serve.py protocol).
+ * See paddle_c_api.h for the reference-parity rationale. */
+#include "paddle_c_api.h"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#define PD_MAGIC 0x31494450u /* 'PDI1' */
+#define PD_ERR 0xFFFFFFFFu
+
+static __thread char g_err[512];
+
+struct PD_Predictor {
+  int fd;
+};
+
+const char* PD_GetLastError(void) { return g_err; }
+
+static void set_err(const char* msg) {
+  snprintf(g_err, sizeof(g_err), "%s", msg);
+}
+
+static int read_full(int fd, void* buf, size_t n) {
+  char* p = (char*)buf;
+  while (n) {
+    ssize_t r = read(fd, p, n);
+    if (r <= 0) return -1;
+    p += r;
+    n -= (size_t)r;
+  }
+  return 0;
+}
+
+static int write_full(int fd, const void* buf, size_t n) {
+  const char* p = (const char*)buf;
+  while (n) {
+    ssize_t r = write(fd, p, n);
+    if (r <= 0) return -1;
+    p += r;
+    n -= (size_t)r;
+  }
+  return 0;
+}
+
+static size_t dtype_size(PD_DataType dt) {
+  switch (dt) {
+    case PD_FLOAT32: return 4;
+    case PD_FLOAT64: return 8;
+    case PD_INT32: return 4;
+    case PD_INT64: return 8;
+    case PD_UINT8: return 1;
+    case PD_BOOL: return 1;
+  }
+  return 0;
+}
+
+int64_t PD_TensorNumel(const PD_Tensor* t) {
+  int64_t n = 1;
+  for (int32_t i = 0; i < t->ndim; ++i) n *= t->shape[i];
+  return n;
+}
+
+PD_Predictor* PD_PredictorConnect(const char* host, int port) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) {
+    set_err("socket() failed");
+    return NULL;
+  }
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)port);
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    set_err("inet_pton: numeric IPv4 host required");
+    close(fd);
+    return NULL;
+  }
+  if (connect(fd, (struct sockaddr*)&addr, sizeof(addr)) != 0) {
+    set_err("connect() failed — is the serve daemon running?");
+    close(fd);
+    return NULL;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  PD_Predictor* p = (PD_Predictor*)malloc(sizeof(PD_Predictor));
+  p->fd = fd;
+  return p;
+}
+
+int PD_PredictorRun(PD_Predictor* p, const PD_Tensor* ins, int n_in,
+                    PD_Tensor** outs, int* n_out) {
+  *outs = NULL;
+  *n_out = 0;
+  uint32_t hdr[2] = {PD_MAGIC, (uint32_t)n_in};
+  if (write_full(p->fd, hdr, sizeof(hdr)) != 0) goto io_err;
+  for (int i = 0; i < n_in; ++i) {
+    uint8_t meta[2] = {(uint8_t)ins[i].dtype, (uint8_t)ins[i].ndim};
+    if (write_full(p->fd, meta, 2) != 0) goto io_err;
+    if (write_full(p->fd, ins[i].shape,
+                   sizeof(int64_t) * (size_t)ins[i].ndim) != 0)
+      goto io_err;
+    if (write_full(p->fd, ins[i].data,
+                   dtype_size(ins[i].dtype) *
+                       (size_t)PD_TensorNumel(&ins[i])) != 0)
+      goto io_err;
+  }
+  uint32_t rhdr[2];
+  if (read_full(p->fd, rhdr, sizeof(rhdr)) != 0) goto io_err;
+  if (rhdr[0] != PD_MAGIC) {
+    set_err("protocol desync (bad magic)");
+    return -1;
+  }
+  if (rhdr[1] == PD_ERR) {
+    uint32_t mlen;
+    if (read_full(p->fd, &mlen, 4) != 0) goto io_err;
+    /* drain the WHOLE message (keeps the persistent connection in sync),
+     * truncate only the copy into g_err */
+    uint32_t keep = mlen < sizeof(g_err) - 1 ? mlen : sizeof(g_err) - 1;
+    if (read_full(p->fd, g_err, keep) != 0) goto io_err;
+    g_err[keep] = '\0';
+    for (uint32_t left = mlen - keep; left;) {
+      char sink[256];
+      uint32_t take = left < sizeof(sink) ? left : (uint32_t)sizeof(sink);
+      if (read_full(p->fd, sink, take) != 0) goto io_err;
+      left -= take;
+    }
+    return -1;
+  }
+  int n = (int)rhdr[1];
+  PD_Tensor* ts = (PD_Tensor*)calloc((size_t)n, sizeof(PD_Tensor));
+  for (int i = 0; i < n; ++i) {
+    uint8_t meta[2];
+    if (read_full(p->fd, meta, 2) != 0) goto io_err_free;
+    ts[i].dtype = (PD_DataType)meta[0];
+    ts[i].ndim = meta[1];
+    ts[i].shape = (int64_t*)malloc(sizeof(int64_t) * (size_t)meta[1]);
+    if (read_full(p->fd, ts[i].shape,
+                  sizeof(int64_t) * (size_t)meta[1]) != 0)
+      goto io_err_free;
+    size_t bytes = dtype_size(ts[i].dtype) * (size_t)PD_TensorNumel(&ts[i]);
+    ts[i].data = malloc(bytes);
+    if (read_full(p->fd, ts[i].data, bytes) != 0) goto io_err_free;
+  }
+  *outs = ts;
+  *n_out = n;
+  return 0;
+
+io_err_free:
+  PD_FreeTensors(ts, n);
+io_err:
+  set_err("i/o error talking to serve daemon");
+  return -1;
+}
+
+void PD_FreeTensors(PD_Tensor* ts, int n) {
+  if (!ts) return;
+  for (int i = 0; i < n; ++i) {
+    free(ts[i].shape);
+    free(ts[i].data);
+  }
+  free(ts);
+}
+
+void PD_PredictorDelete(PD_Predictor* p) {
+  if (!p) return;
+  close(p->fd);
+  free(p);
+}

@@ -1,0 +1,67 @@
+/* paddle_tpu inference C API.
+ *
+ * Reference shape: /root/reference/paddle/fluid/inference/capi/
+ * (PD_NewPredictor / PD_PredictorRun / PD_ZeroCopy tensors) and the Go
+ * bindings layered on it (go/paddle/{config,predictor,tensor}.go).
+ * There the predictor links into the client process; the TPU runtime
+ * (XLA/PJRT + Python) cannot, so this client speaks the serve daemon's
+ * wire protocol (inference/serve.py) over TCP — same capability, the
+ * process-separated deployment shape TPU serving uses anyway.
+ *
+ * Build:  cc -o app app.c paddle_c_api.c
+ * Use:
+ *   PD_Predictor* p = PD_PredictorConnect("127.0.0.1", 9000);
+ *   PD_Tensor in = {PD_FLOAT32, 2, (int64_t[]){1, 784}, data};
+ *   PD_Tensor* outs; int n_out;
+ *   PD_PredictorRun(p, &in, 1, &outs, &n_out);
+ *   ... outs[0].data ...
+ *   PD_FreeTensors(outs, n_out);
+ *   PD_PredictorDelete(p);
+ */
+#ifndef PADDLE_TPU_C_API_H_
+#define PADDLE_TPU_C_API_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef enum {
+  PD_FLOAT32 = 0,
+  PD_FLOAT64 = 1,
+  PD_INT32 = 2,
+  PD_INT64 = 3,
+  PD_UINT8 = 4,
+  PD_BOOL = 5,
+} PD_DataType;
+
+typedef struct {
+  PD_DataType dtype;
+  int32_t ndim;
+  int64_t* shape; /* length ndim */
+  void* data;     /* row-major payload */
+} PD_Tensor;
+
+typedef struct PD_Predictor PD_Predictor;
+
+/* NULL on connection failure. */
+PD_Predictor* PD_PredictorConnect(const char* host, int port);
+
+/* Run one inference. Returns 0 on success; on failure returns -1 and
+ * PD_GetLastError() describes the cause (including model-side errors
+ * relayed from the server). *outs is malloc'd (free with PD_FreeTensors). */
+int PD_PredictorRun(PD_Predictor* p, const PD_Tensor* ins, int n_in,
+                    PD_Tensor** outs, int* n_out);
+
+void PD_FreeTensors(PD_Tensor* ts, int n);
+void PD_PredictorDelete(PD_Predictor* p);
+const char* PD_GetLastError(void);
+
+int64_t PD_TensorNumel(const PD_Tensor* t);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* PADDLE_TPU_C_API_H_ */

@@ -1,0 +1,164 @@
+"""Inference serve daemon: a TCP front-end over Predictor, the transport
+behind the C/Go client APIs.
+
+Reference: the C API (/root/reference/paddle/fluid/inference/capi/) and Go
+bindings (go/paddle/) link AnalysisPredictor into the client process. A
+TPU predictor cannot be linked into a C program (the runtime is
+XLA/PJRT + Python), so the native-client capability is delivered as a
+daemon + thin C client (inference/capi/paddle_c_api.{h,c}): same
+capability boundary, process-separated — the deployment shape TPU serving
+uses in practice.
+
+Wire protocol (little endian), one request per round trip:
+  request : u32 magic 'PDI1' | u32 n_tensors | tensors
+  tensor  : u8 dtype | u8 ndim | i64 shape[ndim] | raw data
+  reply   : u32 magic | u32 n_tensors | tensors     (or n=0xFFFFFFFF +
+            u32 len + utf8 error message)
+dtype codes match utils/cpp_extension: 0 f32, 1 f64, 2 i32, 3 i64, 4 u8,
+5 bool.
+
+    python -m paddle_tpu.inference.serve /path/prefix --port 9000
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import struct
+import threading
+
+import numpy as np
+
+MAGIC = 0x31494450          # 'PDI1'
+ERR = 0xFFFFFFFF
+_DTYPES = [np.float32, np.float64, np.int32, np.int64, np.uint8, np.bool_]
+
+
+def _recv_exact(sock, n):
+    from ..utils.net import recv_exact
+    return recv_exact(sock, n, what="client")
+
+
+def read_tensors(sock):
+    magic, n = struct.unpack("<II", _recv_exact(sock, 8))
+    if magic != MAGIC:
+        raise ValueError("bad magic")
+    out = []
+    for _ in range(n):
+        dt, nd = struct.unpack("<BB", _recv_exact(sock, 2))
+        shape = struct.unpack(f"<{nd}q", _recv_exact(sock, 8 * nd)) \
+            if nd else ()
+        dtype = np.dtype(_DTYPES[dt])
+        count = int(np.prod(shape, dtype=np.int64)) if nd else 1
+        data = _recv_exact(sock, count * dtype.itemsize)
+        out.append(np.frombuffer(data, dtype).reshape(shape).copy())
+    return out
+
+
+def write_tensors(sock, arrays):
+    parts = [struct.pack("<II", MAGIC, len(arrays))]
+    for a in arrays:
+        a = np.ascontiguousarray(a)
+        dt = next(i for i, d in enumerate(_DTYPES) if np.dtype(d) == a.dtype)
+        parts.append(struct.pack("<BB", dt, a.ndim))
+        parts.append(struct.pack(f"<{a.ndim}q", *a.shape))
+        parts.append(a.tobytes())
+    sock.sendall(b"".join(parts))
+
+
+def write_error(sock, msg: str):
+    m = msg.encode()[:65536]
+    sock.sendall(struct.pack("<III", MAGIC, ERR, len(m)) + m)
+
+
+class InferenceServer:
+    """Serves one loaded model; thread-per-connection (the predictor call
+    itself is serialized — XLA executables are thread-compatible but
+    request ordering keeps tail latency predictable on one chip)."""
+
+    def __init__(self, model_prefix: str, port: int = 0):
+        from . import Config, create_predictor
+        self._predictor = create_predictor(Config(model_prefix))
+        self._lock = threading.Lock()
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("0.0.0.0", port))
+        self._srv.listen(64)
+        self.port = self._srv.getsockname()[1]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True)
+        self._thread.start()
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                break
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn):
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            while True:
+                try:
+                    inputs = read_tensors(conn)
+                except (ConnectionError, struct.error):
+                    return
+                except (ValueError, IndexError) as e:
+                    # unparseable request (bad magic / dtype code): the
+                    # stream is desynced — best-effort error frame, drop
+                    # the connection
+                    try:
+                        write_error(conn, f"malformed request: {e}")
+                    except OSError:
+                        pass
+                    return
+                try:
+                    with self._lock:
+                        outputs = self._predictor.run(inputs)
+                    write_tensors(conn, outputs)
+                except Exception as e:   # model-side error -> client
+                    write_error(conn, f"{type(e).__name__}: {e}")
+        finally:
+            conn.close()
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._srv.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._srv.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description="paddle_tpu inference server")
+    ap.add_argument("model", help="jit.save artifact prefix")
+    ap.add_argument("--port", type=int, default=9000)
+    args = ap.parse_args(argv)
+    # honor JAX_PLATFORMS for the daemon: a TPU PJRT plugin outranks the
+    # env var during backend registration, so an explicit config update is
+    # the only way `JAX_PLATFORMS=cpu python -m ...serve` stays off-chip
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if platforms:
+        import jax
+        jax.config.update("jax_platforms", platforms)
+    srv = InferenceServer(args.model, port=args.port)
+    print(f"SERVING {srv.port}", flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        srv.stop()
+
+
+if __name__ == "__main__":
+    main()

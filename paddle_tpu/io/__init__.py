@@ -8,3 +8,4 @@ from .sampler import (BatchSampler, DistributedBatchSampler, RandomSampler,
                       Sampler, SequenceSampler, SubsetRandomSampler,
                       WeightedRandomSampler)  # noqa: F401
 from . import crypto  # noqa: F401  (model encryption, io/crypto/)
+from .data_feed import Slot, InMemoryDataset  # noqa: F401  (PS data path)

@@ -1,0 +1,154 @@
+"""PS-path data ingestion: slot-parsed in-memory dataset.
+
+Reference: the DataFeed/Dataset family feeding PS and Dataset trainers —
+MultiSlotDataFeed text parsing (framework/data_feed.h:664, parse loop
+data_feed.cc), InMemoryDataset with load/shuffle
+(framework/data_set.h:157; python fleet/dataset/) — SURVEY.md §2 row 45.
+
+Wire format (MultiSlot text): one sample per line; for each declared slot
+in order: `<n> v1 ... vn` (n = number of values). Sparse slots hold
+uint64 feature ids of varying length per sample (the LoD raggedness);
+dense slots hold exactly `dim` floats.
+
+    words = Slot("words", dtype="uint64")            # sparse, ragged
+    label = Slot("label", dtype="float32", dim=1)    # dense
+    ds = InMemoryDataset([words, label])
+    ds.load_from_files([path1, path2])   # or ds.add_samples(lines)
+    ds.local_shuffle(seed=0)
+    for batch in ds.batches(batch_size=32):
+        batch["words"]   -> (values [total], lod offsets [B+1])
+        batch["label"]   -> np.ndarray [B, 1]
+
+Batches hand sparse slots over as (flat values, LoD offsets) — the
+SelectedRows/LoD representation the PS embedding path consumes
+(core/lod.py helpers turn them into padded/masked arrays for the model).
+"""
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+from typing import Dict, Iterator, List, Sequence
+
+import numpy as np
+
+__all__ = ["Slot", "InMemoryDataset", "parse_multi_slot_line"]
+
+
+@dataclass
+class Slot:
+    name: str
+    dtype: str = "uint64"     # "uint64" (sparse ids) | "float32" (dense)
+    dim: int = 0              # >0: dense slot with fixed width
+
+    @property
+    def is_sparse(self) -> bool:
+        return self.dim == 0
+
+
+def parse_multi_slot_line(line: str, slots: Sequence[Slot]):
+    """One text line -> [per-slot value list] (MultiSlotDataFeed parser)."""
+    toks = line.split()
+    out = []
+    i = 0
+    for slot in slots:
+        if i >= len(toks):
+            raise ValueError(f"line ran out of tokens at slot {slot.name!r}")
+        n = int(toks[i])
+        i += 1
+        vals = toks[i:i + n]
+        if len(vals) != n:
+            raise ValueError(
+                f"slot {slot.name!r} declares {n} values, found {len(vals)}")
+        i += n
+        if slot.is_sparse:
+            out.append(np.asarray(vals, np.uint64))
+        else:
+            if n != slot.dim:
+                raise ValueError(
+                    f"dense slot {slot.name!r} expects dim={slot.dim}, "
+                    f"line has {n}")
+            out.append(np.asarray(vals, np.float32))
+    if i != len(toks):
+        raise ValueError(f"{len(toks) - i} trailing tokens on line")
+    return out
+
+
+class InMemoryDataset:
+    """Load → (shuffle) → batch, all host-side (the PS ingestion path is
+    CPU-bound by design; the TPU never sees raw ids)."""
+
+    def __init__(self, slots: Sequence[Slot]):
+        if not slots:
+            raise ValueError("need at least one slot")
+        self._slots = list(slots)
+        self._samples: List[list] = []
+
+    def __len__(self):
+        return len(self._samples)
+
+    @property
+    def slots(self):
+        return list(self._slots)
+
+    def add_samples(self, lines):
+        for line in lines:
+            line = line.strip()
+            if line:
+                self._samples.append(
+                    parse_multi_slot_line(line, self._slots))
+
+    def load_from_files(self, paths: Sequence[str]):
+        for p in paths:
+            with open(p) as f:
+                self.add_samples(f)
+
+    def local_shuffle(self, seed=None):
+        random.Random(seed).shuffle(self._samples)
+
+    def global_shuffle(self, store, world_size: int, rank: int,
+                       seed: int = 0, name: str = "ds_shuffle",
+                       timeout: float = 120.0):
+        """Reference InMemoryDataset::GlobalShuffle semantics: every
+        sample (each rank may hold a DIFFERENT shard) is redistributed to
+        a pseudo-random destination rank. Samples travel through the
+        rendezvous store: rank r publishes one pickled bundle per
+        destination, then collects the bundles addressed to it."""
+        import pickle
+
+        rng = random.Random(seed + rank * 7919)   # per-rank stream is fine:
+        # destinations only need to be ~uniform, not agreed on
+        outgoing: List[List[list]] = [[] for _ in range(world_size)]
+        for s in self._samples:
+            outgoing[rng.randrange(world_size)].append(s)
+        for dest in range(world_size):
+            store.set(f"{name}/from{rank}/to{dest}",
+                      pickle.dumps(outgoing[dest]))
+        store.barrier(f"{name}/posted", world_size=world_size, rank=rank,
+                      timeout=timeout)
+        gathered: List[list] = []
+        for src in range(world_size):
+            blob = store.wait(f"{name}/from{src}/to{rank}",
+                              timeout=timeout)
+            gathered.extend(pickle.loads(blob))
+        self._samples = gathered
+        self.local_shuffle(seed=seed + rank + 1)
+
+    def batches(self, batch_size: int, drop_last: bool = False
+                ) -> Iterator[Dict[str, object]]:
+        """Sparse slots -> (flat values, lod offsets); dense -> [B, dim]."""
+        for start in range(0, len(self._samples), batch_size):
+            chunk = self._samples[start:start + batch_size]
+            if drop_last and len(chunk) < batch_size:
+                return
+            out: Dict[str, object] = {}
+            for j, slot in enumerate(self._slots):
+                vals = [s[j] for s in chunk]
+                if slot.is_sparse:
+                    lod = np.zeros(len(vals) + 1, np.int64)
+                    np.cumsum([len(v) for v in vals], out=lod[1:])
+                    flat = (np.concatenate(vals) if lod[-1]
+                            else np.zeros((0,), np.uint64))
+                    out[slot.name] = (flat, lod)
+                else:
+                    out[slot.name] = np.stack(vals)
+            yield out

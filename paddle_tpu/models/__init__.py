@@ -3,8 +3,10 @@ benchmark configs (BASELINE.md: ERNIE/BERT-base pretrain, GPT-2 345M,
 GPT-3 1.3B). Vision models live in paddle_tpu.vision.models."""
 from .gpt import (GPT, GPTConfig, gpt2_124m, gpt2_345m, gpt3_1p3b, gpt_tiny,
                   gpt_param_shardings)
-from .bert import Bert, BertConfig, bert_base, bert_tiny
+from .bert import (Bert, BertConfig, bert_base, bert_tiny,
+                   Ernie, ernie_base)
 
 __all__ = ["GPT", "GPTConfig", "gpt2_124m", "gpt2_345m", "gpt3_1p3b",
            "gpt_tiny", "gpt_param_shardings",
-           "Bert", "BertConfig", "bert_base", "bert_tiny"]
+           "Bert", "BertConfig", "bert_base", "bert_tiny",
+           "Ernie", "ernie_base"]

@@ -105,3 +105,15 @@ def bert_param_shardings(params, mesh_axis_tp="tp"):
         else:
             specs[name] = P()
     return specs
+
+
+def ernie_base(**kw):
+    """ERNIE-base geometry (BASELINE config 3 names ERNIE explicitly).
+    Architecturally the BERT encoder with ERNIE 1.0's zh vocab size; the
+    ERNIE difference is the pretraining task (entity/phrase masking —
+    a data-pipeline concern), not the network."""
+    kw.setdefault("vocab_size", 18048)   # 18000 padded to multiple of 128
+    return BertConfig(**kw)
+
+
+Ernie = Bert     # reference ships ERNIE as a model zoo entry over BERT

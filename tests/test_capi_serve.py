@@ -1,0 +1,146 @@
+"""C inference API: jit.save → serve daemon → a real compiled C client
+(inference/capi/paddle_c_api.{h,c}) gets the same logits as the Python
+predictor. Reference: inference/capi/ + go bindings (SURVEY.md §2 row 61).
+"""
+import os
+import struct
+import subprocess
+import socket
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.inference import Config, create_predictor
+from paddle_tpu.inference.serve import InferenceServer, MAGIC
+from paddle_tpu.static import InputSpec
+
+CAPI_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "paddle_tpu", "inference", "capi")
+
+
+class SmallNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 4)
+
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+        return self.fc2(F.relu(self.fc1(x)))
+
+
+@pytest.fixture(scope="module")
+def served_model(tmp_path_factory):
+    paddle.seed(7)
+    net = SmallNet()
+    prefix = str(tmp_path_factory.mktemp("m") / "net")
+    paddle.jit.save(net, prefix,
+                    input_spec=[InputSpec([None, 8], "float32")])
+    srv = InferenceServer(prefix, port=0)
+    yield prefix, srv
+    srv.stop()
+
+
+def _py_logits(prefix, x):
+    pred = create_predictor(Config(prefix))
+    return pred.run([x])[0]
+
+
+def test_python_client_roundtrip(served_model):
+    prefix, srv = served_model
+    x = np.random.default_rng(0).normal(size=(3, 8)).astype(np.float32)
+    from paddle_tpu.inference.serve import read_tensors, write_tensors
+    with socket.create_connection(("127.0.0.1", srv.port)) as sock:
+        write_tensors(sock, [x])
+        (out,) = read_tensors(sock)
+        # second request on the same connection (keep-alive)
+        write_tensors(sock, [x * 2])
+        (out2,) = read_tensors(sock)
+    np.testing.assert_allclose(out, _py_logits(prefix, x), rtol=1e-5)
+    np.testing.assert_allclose(out2, _py_logits(prefix, x * 2), rtol=1e-5)
+
+
+def test_server_relays_model_errors(served_model):
+    prefix, srv = served_model
+    from paddle_tpu.inference.serve import write_tensors, _recv_exact
+    bad = np.zeros((3, 5), np.float32)      # wrong feature width
+    with socket.create_connection(("127.0.0.1", srv.port)) as sock:
+        write_tensors(sock, [bad])
+        magic, n = struct.unpack("<II", _recv_exact(sock, 8))
+        assert magic == MAGIC and n == 0xFFFFFFFF
+        (mlen,) = struct.unpack("<I", _recv_exact(sock, 4))
+        msg = _recv_exact(sock, mlen).decode()
+        assert msg
+
+
+def test_c_client_end_to_end(served_model, tmp_path):
+    prefix, srv = served_model
+    x = np.random.default_rng(1).normal(size=(2, 8)).astype(np.float32)
+    expect = _py_logits(prefix, x)
+
+    main_c = tmp_path / "main.c"
+    main_c.write_text(textwrap.dedent("""
+        #include <stdio.h>
+        #include <stdlib.h>
+        #include "paddle_c_api.h"
+        int main(int argc, char** argv) {
+          PD_Predictor* p = PD_PredictorConnect("127.0.0.1",
+                                                atoi(argv[1]));
+          if (!p) { fprintf(stderr, "%s\\n", PD_GetLastError()); return 2; }
+          float data[16];
+          for (int i = 0; i < 16; ++i) data[i] = atof(argv[2 + i]);
+          int64_t shape[2] = {2, 8};
+          PD_Tensor in = {PD_FLOAT32, 2, shape, data};
+          PD_Tensor* outs; int n_out;
+          if (PD_PredictorRun(p, &in, 1, &outs, &n_out) != 0) {
+            fprintf(stderr, "%s\\n", PD_GetLastError()); return 3;
+          }
+          for (int i = 0; i < n_out; ++i) {
+            for (int64_t j = 0; j < PD_TensorNumel(&outs[i]); ++j)
+              printf("%.6f ", ((float*)outs[i].data)[j]);
+          }
+          PD_FreeTensors(outs, n_out);
+          PD_PredictorDelete(p);
+          return 0;
+        }
+    """))
+    exe = str(tmp_path / "client")
+    subprocess.run(["gcc", "-O2", "-I", CAPI_DIR, "-o", exe, str(main_c),
+                    os.path.join(CAPI_DIR, "paddle_c_api.c")],
+                   check=True, capture_output=True, text=True)
+    res = subprocess.run(
+        [exe, str(srv.port), *[f"{v:.8f}" for v in x.ravel()]],
+        capture_output=True, text=True, timeout=60)
+    assert res.returncode == 0, res.stderr
+    got = np.asarray([float(t) for t in res.stdout.split()],
+                     np.float32).reshape(expect.shape)
+    np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_c_client_connect_refused(tmp_path):
+    # find a dead port
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    main_c = tmp_path / "r.c"
+    main_c.write_text(textwrap.dedent("""
+        #include <stdio.h>
+        #include <stdlib.h>
+        #include "paddle_c_api.h"
+        int main(int argc, char** argv) {
+          PD_Predictor* p = PD_PredictorConnect("127.0.0.1",
+                                                atoi(argv[1]));
+          if (!p) { printf("REFUSED:%s", PD_GetLastError()); return 0; }
+          return 1;
+        }
+    """))
+    exe = str(tmp_path / "rc")
+    subprocess.run(["gcc", "-I", CAPI_DIR, "-o", exe, str(main_c),
+                    os.path.join(CAPI_DIR, "paddle_c_api.c")],
+                   check=True, capture_output=True)
+    res = subprocess.run([exe, str(port)], capture_output=True, text=True)
+    assert res.returncode == 0 and res.stdout.startswith("REFUSED:")
