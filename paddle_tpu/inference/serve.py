@@ -59,6 +59,14 @@ def write_tensors(sock, arrays):
     parts = [struct.pack("<II", MAGIC, len(arrays))]
     for a in arrays:
         a = np.ascontiguousarray(a)
+        if a.dtype not in [np.dtype(d) for d in _DTYPES]:
+            if np.issubdtype(a.dtype, np.floating) or \
+                    a.dtype.name == "bfloat16":
+                a = a.astype(np.float32)   # bf16/f16 outputs -> f32 wire
+            else:
+                raise ValueError(
+                    f"unsupported output dtype {a.dtype} on the wire "
+                    f"(supported: {[np.dtype(d).name for d in _DTYPES]})")
         dt = next(i for i, d in enumerate(_DTYPES) if np.dtype(d) == a.dtype)
         parts.append(struct.pack("<BB", dt, a.ndim))
         parts.append(struct.pack(f"<{a.ndim}q", *a.shape))
@@ -76,13 +84,16 @@ class InferenceServer:
     itself is serialized — XLA executables are thread-compatible but
     request ordering keeps tail latency predictable on one chip)."""
 
-    def __init__(self, model_prefix: str, port: int = 0):
+    def __init__(self, model_prefix: str, port: int = 0,
+                 host: str = "127.0.0.1"):
+        # loopback by default: the daemon is unauthenticated — exposing a
+        # model to the network segment must be an explicit --host choice
         from . import Config, create_predictor
         self._predictor = create_predictor(Config(model_prefix))
         self._lock = threading.Lock()
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._srv.bind(("0.0.0.0", port))
+        self._srv.bind((host, port))
         self._srv.listen(64)
         self.port = self._srv.getsockname()[1]
         self._stop = threading.Event()
@@ -144,6 +155,9 @@ def main(argv=None):
     ap = argparse.ArgumentParser(description="paddle_tpu inference server")
     ap.add_argument("model", help="jit.save artifact prefix")
     ap.add_argument("--port", type=int, default=9000)
+    ap.add_argument("--host", default="127.0.0.1",
+                    help="bind address (default loopback; 0.0.0.0 exposes "
+                         "the unauthenticated daemon to the network)")
     args = ap.parse_args(argv)
     # honor JAX_PLATFORMS for the daemon: a TPU PJRT plugin outranks the
     # env var during backend registration, so an explicit config update is
@@ -152,7 +166,7 @@ def main(argv=None):
     if platforms:
         import jax
         jax.config.update("jax_platforms", platforms)
-    srv = InferenceServer(args.model, port=args.port)
+    srv = InferenceServer(args.model, port=args.port, host=args.host)
     print(f"SERVING {srv.port}", flush=True)
     try:
         threading.Event().wait()

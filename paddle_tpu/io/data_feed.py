@@ -82,6 +82,7 @@ class InMemoryDataset:
             raise ValueError("need at least one slot")
         self._slots = list(slots)
         self._samples: List[list] = []
+        self._shuffle_epoch = 0
 
     def __len__(self):
         return len(self._samples)
@@ -115,21 +116,31 @@ class InMemoryDataset:
         destination, then collects the bundles addressed to it."""
         import pickle
 
+        # per-call epoch keys: repeated shuffles with the same name must
+        # not overwrite bundles a slower rank hasn't collected yet
+        epoch = self._shuffle_epoch
+        self._shuffle_epoch += 1
+        pfx = f"{name}/e{epoch}"
         rng = random.Random(seed + rank * 7919)   # per-rank stream is fine:
         # destinations only need to be ~uniform, not agreed on
         outgoing: List[List[list]] = [[] for _ in range(world_size)]
         for s in self._samples:
             outgoing[rng.randrange(world_size)].append(s)
         for dest in range(world_size):
-            store.set(f"{name}/from{rank}/to{dest}",
+            store.set(f"{pfx}/from{rank}/to{dest}",
                       pickle.dumps(outgoing[dest]))
-        store.barrier(f"{name}/posted", world_size=world_size, rank=rank,
+        store.barrier(f"{pfx}/posted", world_size=world_size, rank=rank,
                       timeout=timeout)
         gathered: List[list] = []
         for src in range(world_size):
-            blob = store.wait(f"{name}/from{src}/to{rank}",
+            blob = store.wait(f"{pfx}/from{src}/to{rank}",
                               timeout=timeout)
             gathered.extend(pickle.loads(blob))
+        # everyone collected -> each rank reclaims the bundles it posted
+        store.barrier(f"{pfx}/collected", world_size=world_size, rank=rank,
+                      timeout=timeout)
+        for dest in range(world_size):
+            store.delete_key(f"{pfx}/from{rank}/to{dest}")
         self._samples = gathered
         self.local_shuffle(seed=seed + rank + 1)
 

@@ -202,7 +202,9 @@ def matrix_rank(x, tol=None, hermitian=False, name=None):
 def lu(x, pivot=True, get_infos=False, name=None):
     def f(a):
         lu_mat, piv = jax.scipy.linalg.lu_factor(a)
-        return lu_mat, piv
+        # paddle returns LAPACK-style 1-based pivots (linalg.lu docs);
+        # jax's lu_factor is 0-based
+        return lu_mat, (piv + 1).astype(jnp.int32)
     lu_mat, piv = apply(f, x, op_name="lu")
     if get_infos:
         from .creation import zeros

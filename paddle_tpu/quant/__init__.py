@@ -1,0 +1,244 @@
+"""Quantization: QAT (fake-quant with straight-through gradients) and
+post-training conversion to int8 inference layers.
+
+Reference: the slim quantization stack —
+/root/reference/python/paddle/fluid/contrib/slim/quantization/
+(ImperativeQuantAware imperative/qat.py, QuantizationTransformPass,
+fake_quantize_* ops in paddle/fluid/operators/fake_quantize_op.cc:
+abs-max / moving-average-abs-max / channel-wise-abs-max).
+
+TPU-native: int8 is a first-class MXU dtype — an int8 x int8 -> int32
+`lax.dot_general` runs at double the bf16 rate on current TPUs, so the
+converted inference layer does REAL integer matmuls (dynamic per-tensor
+activation scales + per-channel weight scales), not just simulated
+rounding. Fake-quant in QAT uses the straight-through estimator, exactly
+the reference's fake_quantize semantics.
+
+    model = ...                             # nn.Layer with Linear inside
+    qat = QAT()                             # ImperativeQuantAware analog
+    qat.quantize(model)                     # in-place: Linear -> QATLinear
+    ... train as usual (fake-quant in fwd, STE in bwd) ...
+    qat.convert(model)                      # QATLinear -> Int8Linear
+
+    # or post-training (no retraining):
+    ptq = PTQ()
+    ptq.quantize(model)                     # observers only
+    for batch in calib: model(batch)        # collect abs-max stats
+    ptq.convert(model)
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import nn
+from ..core.tensor import Tensor, apply
+from ..nn import functional as F
+
+__all__ = ["fake_quant_abs_max", "QATLinear", "Int8Linear", "QAT", "PTQ",
+           "quanted_layers"]
+
+
+# ---------------------------------------------------------------------------
+# fake-quant primitive (STE)
+# ---------------------------------------------------------------------------
+
+@jax.custom_vjp
+def _fq(x, scale):
+    q = jnp.clip(jnp.round(x / scale * 127.0), -127.0, 127.0)
+    return q * scale / 127.0
+
+
+def _fq_fwd(x, scale):
+    return _fq(x, scale), (x, scale)
+
+
+def _fq_bwd(res, g):
+    x, scale = res
+    # straight-through inside the clip range (reference fake_quantize
+    # grad); no gradient to the scale (it is a statistic, not a weight)
+    mask = (jnp.abs(x) <= scale).astype(g.dtype)
+    return g * mask, jnp.zeros_like(scale)
+
+
+_fq.defvjp(_fq_fwd, _fq_bwd)
+
+
+def fake_quant_abs_max(x, scale=None, channel_axis=None):
+    """Simulated int8 round-trip. scale=None: dynamic abs-max (per tensor,
+    or per `channel_axis` slice — the channel_wise_abs_max variant)."""
+    def f(raw, *maybe_scale):
+        if maybe_scale:
+            s = maybe_scale[0]
+        elif channel_axis is not None:
+            axes = tuple(i for i in range(raw.ndim) if i != channel_axis)
+            s = jnp.max(jnp.abs(raw), axis=axes, keepdims=True)
+        else:
+            s = jnp.max(jnp.abs(raw))
+        s = jnp.maximum(s, 1e-8)
+        return _fq(raw, s)
+    args = (x,) if scale is None else (x, scale)
+    return apply(f, *args, op_name="fake_quantize_abs_max")
+
+
+# ---------------------------------------------------------------------------
+# QAT layer
+# ---------------------------------------------------------------------------
+
+class QATLinear(nn.Layer):
+    """Linear with fake-quant on activations (moving-average abs-max, the
+    reference's moving_average_abs_max observer) and weights (per-channel
+    abs-max), trained with STE."""
+
+    def __init__(self, inner, ema_decay=0.9):
+        super().__init__()
+        self.inner = inner
+        self._decay = ema_decay
+        self.register_buffer("act_scale",
+                             Tensor(np.zeros((), np.float32)))
+
+    def forward(self, x):
+        if self.training:
+            from ..ops.math import abs as _abs, max as _max
+            cur_t = _max(_abs(x))       # this batch's dynamic abs-max
+            # EMA update of the observer buffer (host-side state, mirrors
+            # the reference's moving-average state variable); under jit
+            # tracing the value is abstract — the buffer keeps its state
+            try:
+                prev = float(self.act_scale._data)
+                cur_f = float(cur_t._data if hasattr(cur_t, "_data")
+                              else cur_t)
+                new = cur_f if prev == 0.0 else \
+                    self._decay * prev + (1 - self._decay) * cur_f
+                self.act_scale._data = jnp.asarray(new, jnp.float32)
+            except (jax.errors.TracerArrayConversionError,
+                    jax.errors.ConcretizationTypeError):
+                pass
+            x = fake_quant_abs_max(x)               # quantize w/ batch stat
+        else:
+            # frozen observer; a never-calibrated (zero) observer falls
+            # back to this batch's dynamic scale instead of collapsing
+            # activations to ~0
+            def f(raw, s):
+                dyn = jnp.maximum(jnp.max(jnp.abs(raw)), 1e-8)
+                return _fq(raw, jnp.where(s > 0, s, dyn))
+            x = apply(f, x, self.act_scale,
+                      op_name="fake_quantize_moving_average_abs_max")
+        w = fake_quant_abs_max(self.inner.weight, channel_axis=1)
+        return F.linear(x, w, self.inner.bias)
+
+
+# ---------------------------------------------------------------------------
+# converted int8 inference layer
+# ---------------------------------------------------------------------------
+
+class Int8Linear(nn.Layer):
+    """Real-int8 inference linear: int8 weights (per-out-channel scales),
+    int8 activations (the calibrated observer scale when one was trained,
+    else dynamic per-tensor), int32 MXU accumulation."""
+
+    def __init__(self, weight_f32: np.ndarray, bias, act_scale=None,
+                 name=None):
+        super().__init__()
+        w = np.asarray(weight_f32, np.float32)           # [in, out]
+        w_scale = np.maximum(np.abs(w).max(axis=0), 1e-8)  # per out-channel
+        w_q = np.clip(np.round(w / w_scale * 127.0), -127, 127) \
+            .astype(np.int8)
+        self.register_buffer("w_q", Tensor(w_q))
+        self.register_buffer("w_scale",
+                             Tensor(w_scale.astype(np.float32)))
+        # static activation scale from QAT/PTQ calibration (0 = dynamic)
+        self._static_act = (act_scale is not None
+                            and float(act_scale) > 0.0)
+        self.register_buffer(
+            "act_scale",
+            Tensor(np.float32(float(act_scale) if self._static_act
+                              else 0.0)))
+        self.bias = bias
+
+    def forward(self, x):
+        static = self._static_act
+
+        def f(raw, wq, ws, a_s, *b):
+            if static:
+                a_scale = a_s
+            else:
+                a_scale = jnp.maximum(jnp.max(jnp.abs(raw)), 1e-8)
+            a_q = jnp.clip(jnp.round(raw / a_scale * 127.0), -127, 127) \
+                .astype(jnp.int8)
+            acc = jax.lax.dot_general(
+                a_q, wq, (((raw.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            out = acc.astype(jnp.float32) * (a_scale / 127.0) * \
+                (ws / 127.0)
+            if b:
+                out = out + b[0]
+            return out
+        args = (x, self.w_q, self.w_scale, self.act_scale)
+        if self.bias is not None:
+            args = args + (self.bias,)
+        return apply(f, *args, op_name="int8_linear")
+
+
+# ---------------------------------------------------------------------------
+# model rewriters (ImperativeQuantAware analog)
+# ---------------------------------------------------------------------------
+
+def _replace_children(layer, predicate, builder):
+    replaced = []
+    for name, child in list(layer.named_children()) \
+            if hasattr(layer, "named_children") else []:
+        if predicate(child):
+            new = builder(child)
+            setattr(layer, name, new)
+            replaced.append((layer, name, new))
+        else:
+            replaced += _replace_children(child, predicate, builder)
+    return replaced
+
+
+class QAT:
+    """Quantization-aware training driver (ImperativeQuantAware)."""
+
+    def __init__(self, ema_decay=0.9):
+        self._decay = ema_decay
+
+    def quantize(self, model):
+        _replace_children(
+            model, lambda c: isinstance(c, nn.Linear),
+            lambda c: QATLinear(c, ema_decay=self._decay))
+        return model
+
+    def convert(self, model):
+        """QATLinear -> Int8Linear for inference/export."""
+        _replace_children(
+            model, lambda c: isinstance(c, QATLinear),
+            lambda c: Int8Linear(np.asarray(c.inner.weight._data),
+                                 c.inner.bias,
+                                 act_scale=float(c.act_scale._data)))
+        model.eval()
+        return model
+
+
+class PTQ(QAT):
+    """Post-training quantization: same observers, no training needed —
+    quantize(), run calibration batches in eval... then convert()."""
+
+    def quantize(self, model):
+        super().quantize(model)
+        # PTQ calibrates in eval mode but must still update observers:
+        # flip the QAT layers to training so the EMA runs during calib
+        for lyr in quanted_layers(model):
+            lyr.train()
+        return model
+
+
+def quanted_layers(model):
+    out = []
+    for _, child in model.named_children() \
+            if hasattr(model, "named_children") else []:
+        if isinstance(child, (QATLinear, Int8Linear)):
+            out.append(child)
+        out += quanted_layers(child)
+    return out

@@ -1,0 +1,424 @@
+"""Sweep 2: the public-ops rows test_ops_sweep.py does not reach —
+creation, logic/bitwise, manipulation/indexing, linalg decompositions,
+random distributions, complex views (VERDICT r1 weak #7: every public op
+gets at least output coverage; grads where the op is smooth).
+
+Same harness contract as sweep 1 (reference OpTest: output vs numpy,
+analytic-vs-numeric grads — op_test.py:255,1362)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from tests.op_test import check_grad
+
+rng = np.random.default_rng(11)
+
+
+def T(a, **kw):
+    return paddle.to_tensor(np.asarray(a), **kw)
+
+
+def U(lo, hi, shape=(2, 3)):
+    return rng.uniform(lo, hi, shape).astype(np.float32)
+
+
+def assert_close(got, want, atol=1e-5, rtol=1e-5):
+    np.testing.assert_allclose(np.asarray(got.numpy(), np.float64),
+                               np.asarray(want, np.float64),
+                               atol=atol, rtol=rtol)
+
+
+# ---------------------------------------------------------------------------
+# creation
+# ---------------------------------------------------------------------------
+
+def test_creation_fill_family():
+    assert_close(paddle.zeros([2, 3]), np.zeros((2, 3)))
+    assert_close(paddle.ones([4]), np.ones(4))
+    assert_close(paddle.full([2, 2], 7.5), np.full((2, 2), 7.5))
+    x = T(U(-1, 1))
+    assert_close(paddle.zeros_like(x), np.zeros((2, 3)))
+    assert_close(paddle.ones_like(x), np.ones((2, 3)))
+    assert_close(paddle.full_like(x, 3), np.full((2, 3), 3.0))
+    assert paddle.empty([3, 2]).shape == [3, 2]
+    assert paddle.empty_like(x).shape == [2, 3]
+
+
+def test_creation_ranges():
+    assert_close(paddle.arange(5), np.arange(5))
+    assert_close(paddle.arange(1, 10, 2), np.arange(1, 10, 2))
+    assert_close(paddle.linspace(0, 1, 5), np.linspace(0, 1, 5))
+    assert_close(paddle.logspace(0, 2, 3), np.logspace(0, 2, 3))
+    assert_close(paddle.eye(3), np.eye(3))
+    assert_close(paddle.eye(2, 4), np.eye(2, 4))
+
+
+def test_creation_conversion():
+    a = U(-1, 1)
+    assert_close(paddle.as_tensor(a), a)
+    assert_close(paddle.assign(T(a)), a)
+    assert_close(paddle.clone(T(a)), a)
+    assert_close(paddle.diagflat(T(np.array([1.0, 2.0, 3.0]))),
+                 np.diagflat([1.0, 2.0, 3.0]))
+    np.testing.assert_allclose(T(a).tolist(), a.tolist(), rtol=1e-6)
+    mg = paddle.meshgrid(T(np.arange(2.0)), T(np.arange(3.0)))
+    ref = np.meshgrid(np.arange(2.0), np.arange(3.0), indexing="ij")
+    for g, r in zip(mg, ref):
+        assert_close(g, r)
+
+
+# ---------------------------------------------------------------------------
+# logic / predicates / bitwise
+# ---------------------------------------------------------------------------
+
+def test_predicates():
+    a = np.array([1.0, np.inf, -np.inf, np.nan], np.float32)
+    x = T(a)
+    assert_close(paddle.isfinite(x), np.isfinite(a))
+    assert_close(paddle.isinf(x), np.isinf(a))
+    assert_close(paddle.isnan(x), np.isnan(a))
+    assert bool(paddle.is_tensor(x))
+    assert not bool(paddle.is_tensor(a))
+    assert not bool(paddle.is_empty(x))
+    assert bool(paddle.is_empty(T(np.zeros((0, 3), np.float32))))
+
+
+def test_close_family():
+    a = U(-1, 1)
+    b = a + 1e-7
+    assert bool(paddle.allclose(T(a), T(b)))
+    assert not bool(paddle.allclose(T(a), T(a + 1.0)))
+    assert_close(paddle.isclose(T(a), T(b)), np.isclose(a, b))
+    assert bool(paddle.equal_all(T(a), T(a.copy())))
+    assert not bool(paddle.equal_all(T(a), T(b)))
+
+
+def test_bitwise():
+    a = np.array([0b1100, 0b1010], np.int32)
+    b = np.array([0b1010, 0b0110], np.int32)
+    assert_close(paddle.bitwise_and(T(a), T(b)), a & b)
+    assert_close(paddle.bitwise_or(T(a), T(b)), a | b)
+    assert_close(paddle.bitwise_xor(T(a), T(b)), a ^ b)
+    assert_close(paddle.bitwise_not(T(a)), ~a)
+    bo = np.array([True, False])
+    assert_close(paddle.logical_not(T(bo)), ~bo)
+
+
+# ---------------------------------------------------------------------------
+# manipulation / shaping
+# ---------------------------------------------------------------------------
+
+def test_atleast_and_rank():
+    s = T(np.float32(3.0))
+    assert paddle.atleast_1d(s).shape == [1]
+    assert paddle.atleast_2d(s).shape == [1, 1]
+    assert paddle.atleast_3d(s).shape == [1, 1, 1]
+    assert int(paddle.rank(T(U(-1, 1)))) == 2
+    assert paddle.broadcast_shape([2, 1, 3], [4, 3]) == [2, 4, 3]
+
+
+def test_concat_stack_split_family():
+    a, b = U(-1, 1), U(-1, 1)
+    assert_close(paddle.concat([T(a), T(b)], axis=0),
+                 np.concatenate([a, b], 0))
+    assert_close(paddle.stack([T(a), T(b)], axis=1), np.stack([a, b], 1))
+    parts = paddle.split(T(a), 3, axis=1)
+    for p, r in zip(parts, np.split(a, 3, 1)):
+        assert_close(p, r)
+    ch = paddle.chunk(T(a), 3, axis=1)
+    for p, r in zip(ch, np.split(a, 3, 1)):
+        assert_close(p, r)
+    ub = paddle.unbind(T(a), axis=0)
+    assert len(ub) == 2 and ub[0].shape == [3]
+    us = paddle.unstack(T(a), axis=1)
+    assert len(us) == 3 and us[0].shape == [2]
+    check_grad(lambda x, y: paddle.concat([x, y], axis=1), [a, b])
+
+
+def test_view_reshape_family():
+    a = U(-1, 1, (2, 6))
+    assert_close(paddle.view(T(a), [3, 4]), a.reshape(3, 4))
+    assert_close(paddle.view_as(T(a), T(U(-1, 1, (4, 3)))),
+                 a.reshape(4, 3))
+    x = T(a.copy())
+    y = paddle.reshape_(x, [6, 2])          # in-place surface
+    assert y.shape == [6, 2]
+    assert_close(paddle.reverse(T(a), axis=1), a[:, ::-1])
+    assert_close(paddle.expand_as(T(np.float32([[1], [2]])),
+                                  T(np.zeros((2, 3), np.float32))),
+                 np.array([[1, 1, 1], [2, 2, 2]], np.float32))
+    assert_close(paddle.cast(T(a), "int32"), a.astype(np.int32))
+
+
+def test_slice_family():
+    a = U(-1, 1, (4, 5))
+    assert_close(paddle.slice(T(a), axes=[0, 1], starts=[1, 0],
+                              ends=[3, 4]), a[1:3, 0:4])
+    assert_close(paddle.strided_slice(T(a), axes=[1], starts=[0],
+                                      ends=[5], strides=[2]), a[:, ::2])
+    assert_close(paddle.crop(T(a), shape=[2, 3], offsets=[1, 1]),
+                 a[1:3, 1:4])
+
+
+def test_gather_scatter_family():
+    a = U(-1, 1, (4, 3))
+    idx = np.array([2, 0], np.int64)
+    assert_close(paddle.gather(T(a), T(idx)), a[idx])
+    nd_idx = np.array([[1, 2], [3, 0]], np.int64)
+    assert_close(paddle.gather_nd(T(a), T(nd_idx)),
+                 a[nd_idx[:, 0], nd_idx[:, 1]])
+    assert_close(paddle.index_select(T(a), T(idx), axis=0), a[idx])
+    # scatter overwrite + add
+    upd = U(-1, 1, (2, 3))
+    ref = a.copy()
+    ref[idx] = upd
+    assert_close(paddle.scatter(T(a), T(idx), T(upd), overwrite=True), ref)
+    # paddle overwrite=False semantics: destination rows are ZEROED then
+    # accumulated (sum of updates replaces the row; duplicates add)
+    ref2 = a.copy()
+    ref2[idx] = 0
+    np.add.at(ref2, idx, upd)
+    assert_close(paddle.scatter(T(a), T(idx), T(upd), overwrite=False),
+                 ref2)
+    # scatter_nd / scatter_nd_add
+    sh = [4]
+    out = paddle.scatter_nd(T(np.array([[1], [3]], np.int64)),
+                            T(np.float32([9, 8])), sh)
+    assert_close(out, np.array([0, 9, 0, 8], np.float32))
+    base = np.zeros(4, np.float32)
+    out2 = paddle.scatter_nd_add(T(base),
+                                 T(np.array([[1], [1]], np.int64)),
+                                 T(np.float32([2, 5])))
+    assert_close(out2, np.array([0, 7, 0, 0], np.float32))
+    check_grad(lambda x: paddle.gather(x, T(idx)), [a])
+
+
+def test_axis_indexing_family():
+    a = U(-1, 1, (3, 4))
+    idx = np.array([[0, 2], [1, 0], [3, 3]], np.int64)
+    assert_close(paddle.take_along_axis(T(a), T(idx), axis=1),
+                 np.take_along_axis(a, idx, 1))
+    vals = U(-1, 1, (3, 2))
+    ref = a.copy()
+    np.put_along_axis(ref, idx, vals, 1)
+    assert_close(paddle.put_along_axis(T(a), T(idx), T(vals), axis=1), ref)
+    si = np.array([[0, 1], [2, 3], [1, 2]], np.int64)
+    assert_close(paddle.index_sample(T(a), T(si)),
+                 np.take_along_axis(a, si, 1))
+    out = paddle.index_add(T(a), T(np.array([0, 2], np.int64)), 0,
+                           T(np.ones((2, 4), np.float32)))
+    ref = a.copy(); ref[[0, 2]] += 1
+    assert_close(out, ref)
+
+
+def test_select_search_family():
+    a = U(-1, 1)
+    m = a > 0
+    assert_close(paddle.masked_select(T(a), T(m)), a[m])
+    assert_close(paddle.where(T(m), T(a), T(-a)), np.where(m, a, -a))
+    v, i = paddle.topk(T(a), k=2, axis=1)
+    rv = np.sort(a, 1)[:, ::-1][:, :2]
+    assert_close(v, rv)
+    seq = np.array([1.0, 3.0, 5.0, 7.0], np.float32)
+    q = np.array([0.0, 4.0, 8.0], np.float32)
+    assert_close(paddle.searchsorted(T(seq), T(q)),
+                 np.searchsorted(seq, q))
+    assert_close(paddle.kthvalue(T(a), k=2, axis=1)[0],
+                 np.sort(a, 1)[:, 1])
+    md = paddle.mode(T(np.float32([[1, 1, 2], [3, 3, 3]])))[0]
+    assert_close(md, np.float32([1, 3]))
+    assert_close(paddle.multiplex(
+        [T(np.float32([[1, 2], [3, 4]])), T(np.float32([[5, 6], [7, 8]]))],
+        T(np.array([1, 0], np.int64))), np.float32([[5, 6], [3, 4]]))
+
+
+def test_unique_family():
+    a = np.array([3, 1, 2, 1, 3], np.int64)
+    u = paddle.unique(T(a))
+    assert_close(u, np.unique(a))
+    uc = paddle.unique_consecutive(T(np.array([1, 1, 2, 2, 3, 1], np.int64)))
+    assert_close(uc, np.array([1, 2, 3, 1]))
+    assert_close(paddle.repeat_interleave(T(np.float32([1, 2])), 3),
+                 np.repeat(np.float32([1, 2]), 3))
+
+
+# ---------------------------------------------------------------------------
+# math extras
+# ---------------------------------------------------------------------------
+
+def test_math_extras():
+    a, b = U(0.5, 2), U(0.5, 2)
+    assert_close(paddle.add_n([T(a), T(b), T(a)]), a + b + a)
+    assert_close(paddle.scale(T(a), scale=2.0, bias=1.0), 2 * a + 1)
+    assert_close(paddle.scale(T(a), scale=2.0, bias=1.0,
+                              bias_after_scale=False), 2 * (a + 1))
+    x = T(a.copy())
+    assert_close(paddle.increment(x, 2.5), a + 2.5)
+    w = np.float32(0.3)
+    assert_close(paddle.lerp(T(a), T(b), w), a + w * (b - a))
+    check_grad(lambda x, y: paddle.lerp(x, y, 0.3), [a, b])
+    ia = np.array([4, 6, 9], np.int32)
+    ib = np.array([6, 4, 6], np.int32)
+    assert_close(paddle.gcd(T(ia), T(ib)), np.gcd(ia, ib))
+    assert_close(paddle.lcm(T(ia), T(ib)), np.lcm(ia, ib))
+
+
+def test_stat_extras():
+    a = U(-2, 2, (40,))
+    assert_close(paddle.quantile(T(a), 0.5), np.quantile(a, 0.5),
+                 atol=1e-4)
+    an = a.copy(); an[3] = np.nan
+    assert_close(paddle.nanmedian(T(an)), np.nanmedian(an), atol=1e-4)
+    assert_close(paddle.nanquantile(T(an), 0.25), np.nanquantile(an, 0.25),
+                 atol=1e-4)
+    m = U(-1, 1, (3, 20))
+    assert_close(paddle.cov(T(m)), np.cov(m), atol=1e-4, rtol=1e-4)
+    assert_close(paddle.corrcoef(T(m)), np.corrcoef(m), atol=1e-4,
+                 rtol=1e-4)
+    assert_close(paddle.logcumsumexp(T(a)),
+                 np.log(np.cumsum(np.exp(a.astype(np.float64)))),
+                 atol=1e-4)
+    h = paddle.histogram(T(np.float32([0.1, 0.5, 0.9, 0.5])), bins=2,
+                         min=0.0, max=1.0)
+    assert_close(h, np.array([1, 3]))
+    c = paddle.bincount(T(np.array([0, 2, 2, 3], np.int64)))
+    assert_close(c, np.bincount([0, 2, 2, 3]))
+    assert_close(paddle.dist(T(np.float32([1, 2])), T(np.float32([4, 6]))),
+                 5.0)
+
+
+# ---------------------------------------------------------------------------
+# linalg
+# ---------------------------------------------------------------------------
+
+def _spd(n=3):
+    m = rng.normal(size=(n, n)).astype(np.float32)
+    return m @ m.T + n * np.eye(n, dtype=np.float32)
+
+
+def test_linalg_decompositions():
+    s = _spd()
+    c = paddle.cholesky(T(s))
+    assert_close(c @ T(c.numpy().T), s, atol=1e-3, rtol=1e-3)
+    q, r = paddle.qr(T(s))
+    assert_close(q @ r, s, atol=1e-3, rtol=1e-3)
+    u, sv, vh = paddle.svd(T(s))
+    rec = u.numpy() @ np.diag(sv.numpy()) @ vh.numpy()
+    np.testing.assert_allclose(rec, s, atol=1e-3, rtol=1e-3)
+    w, v = paddle.eigh(T(s))
+    np.testing.assert_allclose(np.sort(w.numpy()),
+                               np.sort(np.linalg.eigvalsh(s)),
+                               atol=1e-3, rtol=1e-3)
+    assert_close(paddle.eigvalsh(T(s)), np.linalg.eigvalsh(s), atol=1e-3,
+                 rtol=1e-3)
+    ev = paddle.eigvals(T(s))
+    np.testing.assert_allclose(np.sort(ev.numpy().real),
+                               np.sort(np.linalg.eigvals(s).real),
+                               atol=1e-3, rtol=1e-3)
+    w2, _ = paddle.eig(T(s))
+    np.testing.assert_allclose(np.sort(w2.numpy().real),
+                               np.sort(np.linalg.eigvals(s).real),
+                               atol=1e-3, rtol=1e-3)
+    lu_out, pivots = paddle.lu(T(s))[:2]
+    # LU factors reproduce the matrix: P @ A == L @ U
+    lu_np = lu_out.numpy()
+    L = np.tril(lu_np, -1) + np.eye(3, dtype=np.float32)
+    Uu = np.triu(lu_np)
+    perm = np.eye(3, dtype=np.float32)
+    for i, p_ in enumerate(pivots.numpy() - 1):   # 1-based pivots
+        perm[[i, int(p_)]] = perm[[int(p_), i]]
+    np.testing.assert_allclose(perm @ s, L @ Uu, atol=1e-3, rtol=1e-3)
+
+
+def test_linalg_solvers():
+    s = _spd()
+    b = rng.normal(size=(3, 2)).astype(np.float32)
+    assert_close(paddle.solve(T(s), T(b)), np.linalg.solve(s, b),
+                 atol=1e-3, rtol=1e-3)
+    assert_close(paddle.inv(T(s)), np.linalg.inv(s), atol=1e-3, rtol=1e-3)
+    l = np.linalg.cholesky(s).astype(np.float32)
+    assert_close(paddle.triangular_solve(T(l), T(b), upper=False),
+                 np.linalg.solve(l, b), atol=1e-3, rtol=1e-3)
+    assert_close(paddle.cholesky_solve(T(b), T(l), upper=False),
+                 np.linalg.solve(s, b), atol=1e-2, rtol=1e-2)
+    sol = paddle.lstsq(T(s), T(b))[0]
+    assert_close(sol, np.linalg.lstsq(s, b, rcond=None)[0], atol=1e-2,
+                 rtol=1e-2)
+    assert_close(paddle.pinv(T(s)), np.linalg.pinv(s), atol=1e-3,
+                 rtol=1e-3)
+
+
+def test_linalg_scalars():
+    s = _spd()
+    assert_close(paddle.det(T(s)), np.linalg.det(s), rtol=1e-3)
+    sgn, logd = paddle.slogdet(T(s))
+    rs, rl = np.linalg.slogdet(s)
+    assert_close(sgn, rs, rtol=1e-3)
+    assert_close(logd, rl, rtol=1e-3)
+    assert int(paddle.matrix_rank(T(s))) == 3
+    assert_close(paddle.matrix_power(T(s), 2), s @ s, atol=1e-2, rtol=1e-3)
+    a, b2, c = (rng.normal(size=(2, 3)).astype(np.float32),
+                rng.normal(size=(3, 4)).astype(np.float32),
+                rng.normal(size=(4, 2)).astype(np.float32))
+    assert_close(paddle.multi_dot([T(a), T(b2), T(c)]), a @ b2 @ c,
+                 atol=1e-4, rtol=1e-4)
+    assert_close(paddle.norm(T(a)), np.linalg.norm(a), rtol=1e-4)
+    assert_close(paddle.norm(T(a), p=1, axis=1),
+                 np.abs(a).sum(1), rtol=1e-4)
+    x, y = U(-1, 1, (2, 3, 4)), U(-1, 1, (4, 3, 2))
+    assert_close(paddle.tensordot(T(x), T(y), axes=1),
+                 np.tensordot(x, y, axes=1), atol=1e-4, rtol=1e-4)
+    check_grad(lambda m: paddle.multi_dot([m, T(b2)]), [a])
+
+
+# ---------------------------------------------------------------------------
+# random (shape/dtype/statistical checks — seeded determinism)
+# ---------------------------------------------------------------------------
+
+def test_random_family():
+    paddle.seed(123)
+    r = paddle.randint(0, 10, [1000])
+    arr = r.numpy()
+    assert arr.min() >= 0 and arr.max() < 10
+    r2 = paddle.randint_like(r, 0, 5)
+    assert r2.numpy().max() < 5 and r2.shape == [1000]
+    p = paddle.randperm(50).numpy()
+    assert sorted(p.tolist()) == list(range(50))
+    sn = paddle.standard_normal([2000]).numpy()
+    assert abs(sn.mean()) < 0.1 and abs(sn.std() - 1) < 0.1
+    be = paddle.bernoulli(T(np.full((2000,), 0.3, np.float32))).numpy()
+    assert 0.2 < be.mean() < 0.4
+    po = paddle.poisson(T(np.full((2000,), 4.0, np.float32))).numpy()
+    assert 3.5 < po.mean() < 4.5
+    mn = paddle.multinomial(T(np.float32([0.0, 0.0, 1.0])), 5,
+                            replacement=True).numpy()
+    assert (mn == 2).all()
+    x = T(U(0, 1, (2000,)))
+    e = paddle.exponential_(x).numpy()
+    assert 0.8 < e.mean() < 1.25
+    u = paddle.uniform_(T(np.zeros(2000, np.float32)), min=2.0,
+                        max=3.0).numpy()
+    assert u.min() >= 2.0 and u.max() <= 3.0
+    # determinism under the same seed
+    paddle.seed(7)
+    a1 = paddle.standard_normal([8]).numpy()
+    paddle.seed(7)
+    a2 = paddle.standard_normal([8]).numpy()
+    np.testing.assert_array_equal(a1, a2)
+
+
+# ---------------------------------------------------------------------------
+# complex views
+# ---------------------------------------------------------------------------
+
+def test_complex_family():
+    re, im = U(-1, 1), U(-1, 1)
+    c = paddle.complex_(T(re), T(im))
+    np.testing.assert_allclose(c.numpy(), re + 1j * im, rtol=1e-6)
+    assert_close(paddle.conj(c).real(), re)
+    assert_close(paddle.conj(c).imag(), -im)
+    pair = np.stack([re, im], -1)
+    c2 = paddle.as_complex(T(pair))
+    np.testing.assert_allclose(c2.numpy(), re + 1j * im, rtol=1e-6)
+    back = paddle.as_real(c2)
+    assert_close(back, pair)

@@ -166,3 +166,31 @@ def test_global_shuffle_redistributes_disjoint_shards(tmp_path):
     # samples actually crossed ranks (rank 0 started with evens only)
     assert any(i % 2 for i in results[0]) or any(
         not i % 2 for i in results[1])
+
+
+def test_global_shuffle_reusable_and_cleans_store(tmp_path):
+    """Per-epoch keys: calling global_shuffle every epoch neither races
+    nor leaks bundles in the store."""
+    from paddle_tpu.distributed import FileStore
+    lines = [f"1 {i} 1 {float(i)}" for i in range(20)]
+    results = {}
+
+    def rank(r, store_dir):
+        store = FileStore(store_dir)
+        ds = InMemoryDataset([Slot("ids"), Slot("v", "float32", dim=1)])
+        ds.add_samples(lines[r::2])
+        for _ in range(3):                      # 3 epochs, same name
+            ds.global_shuffle(store, world_size=2, rank=r, seed=5)
+        results[r] = sorted(int(s[0][0]) for s in ds._samples)
+
+    d = str(tmp_path / "store")
+    ts = [threading.Thread(target=rank, args=(r, d)) for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    assert sorted(results[0] + results[1]) == list(range(20))
+    from paddle_tpu.distributed import FileStore as FS
+    leftover = [k for k in __import__("os").listdir(d)
+                if "from" in k and not k.endswith((".tmp", ".lock"))]
+    assert leftover == []                       # bundles reclaimed

@@ -1,0 +1,122 @@
+"""Quantization (paddle_tpu.quant): fake-quant STE, QAT training,
+int8 conversion, PTQ calibration. Reference: contrib/slim/quantization
+(ImperativeQuantAware, fake_quantize_*_op — SURVEY refs in quant/)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as opt
+from paddle_tpu.quant import (Int8Linear, PTQ, QAT, QATLinear,
+                              fake_quant_abs_max, quanted_layers)
+
+rng = np.random.default_rng(3)
+
+
+def _net():
+    paddle.seed(0)
+    return nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 4))
+
+
+def _data(n=64):
+    x = rng.normal(size=(n, 8)).astype(np.float32)
+    y = rng.integers(0, 4, (n,)).astype(np.int64)
+    return x, y
+
+
+def test_fake_quant_roundtrip_error_bounded():
+    x = paddle.to_tensor(rng.normal(size=(64,)).astype(np.float32))
+    q = fake_quant_abs_max(x)
+    err = np.abs(q.numpy() - x.numpy()).max()
+    scale = np.abs(x.numpy()).max()
+    assert err <= scale / 127.0 + 1e-7       # one int8 step
+    # values land on the int8 grid
+    grid = q.numpy() / (scale / 127.0)
+    np.testing.assert_allclose(grid, np.round(grid), atol=1e-4)
+
+
+def test_fake_quant_ste_gradient():
+    x = paddle.to_tensor(rng.normal(size=(16,)).astype(np.float32),
+                         stop_gradient=False)
+    fake_quant_abs_max(x).sum().backward()
+    # straight-through: gradient of sum is ~1 inside the clip range
+    np.testing.assert_allclose(x.grad.numpy(), np.ones(16), atol=1e-6)
+
+
+def test_qat_quantize_replaces_and_trains():
+    net = _net()
+    QAT().quantize(net)
+    qls = quanted_layers(net)
+    assert len(qls) == 2 and all(isinstance(l, QATLinear) for l in qls)
+    x, y = _data()
+    sgd = opt.SGD(learning_rate=0.1, parameters=list(net.parameters()))
+    losses = []
+    for _ in range(30):
+        loss = F.cross_entropy(net(paddle.to_tensor(x)),
+                               paddle.to_tensor(y))
+        loss.backward()
+        sgd.step()
+        sgd.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] - 0.2
+    # observers moved off zero
+    assert all(float(l.act_scale._data) > 0 for l in qls)
+
+
+def test_qat_convert_int8_close_to_float():
+    net = _net()
+    x, _ = _data(32)
+    ref = net(paddle.to_tensor(x)).numpy()
+    QAT().quantize(net)
+    net.eval()
+    # freeze observers with one calibration pass in train mode
+    for l in quanted_layers(net):
+        l.train()
+    net(paddle.to_tensor(x))
+    QAT().convert(net)
+    assert all(isinstance(l, Int8Linear) for l in quanted_layers(net))
+    got = net(paddle.to_tensor(x)).numpy()
+    # int8 simulation error stays small relative to the output range
+    denom = np.abs(ref).max()
+    assert np.abs(got - ref).max() / denom < 0.1
+    # top-1 agreement on most samples (the metric that matters)
+    agree = (got.argmax(1) == ref.argmax(1)).mean()
+    assert agree >= 0.9
+
+
+def test_int8_matmul_is_integer():
+    lin = Int8Linear(rng.normal(size=(8, 4)).astype(np.float32), None)
+    assert lin.w_q._data.dtype == jnp.int8
+    x = paddle.to_tensor(rng.normal(size=(3, 8)).astype(np.float32))
+    out = lin(x)
+    assert out.shape == [3, 4]
+
+
+def test_ptq_flow():
+    net = _net()
+    x, _ = _data(32)
+    ref = net(paddle.to_tensor(x)).numpy()
+    ptq = PTQ()
+    ptq.quantize(net)
+    for i in range(4):                      # calibration batches
+        net(paddle.to_tensor(x[i * 8:(i + 1) * 8]))
+    ptq.convert(net)
+    # calibration must flow into the converted layers as STATIC scales
+    assert all(l._static_act and float(l.act_scale._data) > 0
+               for l in quanted_layers(net))
+    got = net(paddle.to_tensor(x)).numpy()
+    assert (got.argmax(1) == ref.argmax(1)).mean() >= 0.9
+
+
+def test_eval_without_calibration_falls_back_to_dynamic():
+    net = _net()
+    x, _ = _data(16)
+    ref = net(paddle.to_tensor(x)).numpy()
+    QAT().quantize(net)
+    net.eval()                               # observers never updated (0)
+    got = net(paddle.to_tensor(x)).numpy()   # must not collapse to ~bias
+    assert np.abs(got).max() > 0.1 * np.abs(ref).max()
+    assert (got.argmax(1) == ref.argmax(1)).mean() >= 0.8
