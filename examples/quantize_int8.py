@@ -1,0 +1,67 @@
+"""Quantization walkthrough: fp32 train -> QAT fine-tune -> int8 convert
+-> export.
+
+Run: JAX_PLATFORMS=cpu python examples/quantize_int8.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as opt
+from paddle_tpu.quant import QAT, PTQ, quanted_layers
+
+
+def main():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(16, 64), nn.ReLU(), nn.Linear(64, 8))
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(256, 16)).astype(np.float32)
+    y = (x @ rng.normal(size=(16, 8)).astype(np.float32)).argmax(1)
+
+    def train(steps, lr):
+        adam = opt.Adam(learning_rate=lr,
+                        parameters=list(net.parameters()))
+        loss = None
+        for _ in range(steps):
+            loss = F.cross_entropy(net(paddle.to_tensor(x)),
+                                   paddle.to_tensor(y))
+            loss.backward()
+            adam.step()
+            adam.clear_grad()
+        return float(loss)
+
+    def acc():
+        return float((net(paddle.to_tensor(x)).numpy().argmax(1) == y)
+                     .mean())
+
+    print(f"fp32   : loss {train(80, 1e-2):.4f} acc {acc():.3f}")
+
+    # quantization-aware fine-tune: fake-quant forward, STE backward
+    QAT().quantize(net)
+    print(f"qat ft : loss {train(40, 2e-3):.4f} acc {acc():.3f}")
+
+    # convert: real int8 weights + int8 MXU matmul with calibrated scales
+    QAT().convert(net)
+    print(f"int8   : acc {acc():.3f} "
+          f"({len(quanted_layers(net))} Int8Linear layers)")
+
+    # the int8 model exports like any other
+    from paddle_tpu.static import InputSpec
+    prefix = "/tmp/paddle_tpu_int8_example/net"
+    paddle.jit.save(net, prefix,
+                    input_spec=[InputSpec([None, 16], "float32")])
+    loaded = paddle.jit.load(prefix)
+    out = loaded(x[:2])
+    print("exported + reloaded, logits shape:",
+          list(np.asarray(out._data if hasattr(out, "_data") else out)
+           .shape))
+
+
+if __name__ == "__main__":
+    main()

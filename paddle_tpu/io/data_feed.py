@@ -121,6 +121,15 @@ class InMemoryDataset:
         epoch = self._shuffle_epoch
         self._shuffle_epoch += 1
         pfx = f"{name}/e{epoch}"
+        # reclaim epoch e-2's barrier keys: every rank entering epoch e
+        # has fully completed e-1 (its 'posted' barrier), which in turn
+        # required completing ALL of e-2 — so nobody can still be waiting
+        # on e-2's go keys. (e-1's keys may still have waiters in-flight.)
+        if epoch >= 2:
+            old = f"{name}/e{epoch - 2}"
+            for barrier_name in ("posted", "collected"):
+                store.delete_key(f"__barrier__/{old}/{barrier_name}/count")
+                store.delete_key(f"__barrier__/{old}/{barrier_name}/go/0")
         rng = random.Random(seed + rank * 7919)   # per-rank stream is fine:
         # destinations only need to be ~uniform, not agreed on
         outgoing: List[List[list]] = [[] for _ in range(world_size)]

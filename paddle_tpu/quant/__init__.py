@@ -95,11 +95,16 @@ class QATLinear(nn.Layer):
         super().__init__()
         self.inner = inner
         self._decay = ema_decay
+        # PTQ sets this so observers run during calibration even with the
+        # model in eval() (dropout/BN must be off while stats collect —
+        # tying observation to `training` would make the two mutually
+        # exclusive for any model containing dropout)
+        self._calibrating = False
         self.register_buffer("act_scale",
                              Tensor(np.zeros((), np.float32)))
 
     def forward(self, x):
-        if self.training:
+        if self.training or self._calibrating:
             from ..ops.math import abs as _abs, max as _max
             cur_t = _max(_abs(x))       # this batch's dynamic abs-max
             # EMA update of the observer buffer (host-side state, mirrors
@@ -223,14 +228,15 @@ class QAT:
 
 class PTQ(QAT):
     """Post-training quantization: same observers, no training needed —
-    quantize(), run calibration batches in eval... then convert()."""
+    quantize(), model.eval(), run calibration batches, convert().
+    Observation is driven by a dedicated `_calibrating` flag, so
+    model.eval() (required to silence dropout/BN during calibration)
+    does NOT freeze the observers."""
 
     def quantize(self, model):
         super().quantize(model)
-        # PTQ calibrates in eval mode but must still update observers:
-        # flip the QAT layers to training so the EMA runs during calib
         for lyr in quanted_layers(model):
-            lyr.train()
+            lyr._calibrating = True
         return model
 
 

@@ -1,0 +1,317 @@
+"""nn.Layer sweep: every public layer class gets at least construct →
+forward → shape/value checks (losses also grad). Complements
+test_nn.py's deep tests the way test_ops_sweep2 complements the op
+sweeps (reference: per-layer unittests under fluid/tests/unittests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+rng = np.random.default_rng(5)
+
+
+def T(a, **kw):
+    return paddle.to_tensor(np.asarray(a), **kw)
+
+
+def X(*shape):
+    return T(rng.normal(size=shape).astype(np.float32))
+
+
+# ---------------------------------------------------------------------------
+# activations: (ctor, input shape, output shape or None=same)
+# ---------------------------------------------------------------------------
+ACTIVATIONS = [
+    (lambda: nn.CELU(), None),
+    (lambda: nn.ELU(), None),
+    (lambda: nn.GELU(), None),
+    (lambda: nn.Hardshrink(), None),
+    (lambda: nn.Hardsigmoid(), None),
+    (lambda: nn.Hardswish(), None),
+    (lambda: nn.Hardtanh(), None),
+    (lambda: nn.Identity(), None),
+    (lambda: nn.LeakyReLU(), None),
+    (lambda: nn.LogSigmoid(), None),
+    (lambda: nn.LogSoftmax(), None),
+    (lambda: nn.Mish(), None),
+    (lambda: nn.ReLU6(), None),
+    (lambda: nn.RReLU(), None),
+    (lambda: nn.SELU(), None),
+    (lambda: nn.Sigmoid(), None),
+    (lambda: nn.Silu(), None),
+    (lambda: nn.Softmax(), None),
+    (lambda: nn.Softplus(), None),
+    (lambda: nn.Softshrink(), None),
+    (lambda: nn.Softsign(), None),
+    (lambda: nn.Swish(), None),
+    (lambda: nn.Tanh(), None),
+    (lambda: nn.Tanhshrink(), None),
+    (lambda: nn.ThresholdedReLU(), None),
+]
+
+
+@pytest.mark.parametrize("ctor,out_shape",
+                         ACTIVATIONS,
+                         ids=[c().__class__.__name__ for c, _ in ACTIVATIONS])
+def test_activation_layers(ctor, out_shape):
+    layer = ctor()
+    x = X(2, 6)
+    y = layer(x)
+    assert y.shape == (list(out_shape) if out_shape else [2, 6])
+    assert np.isfinite(y.numpy()).all()
+
+
+def test_activation_values_spotcheck():
+    x = np.float32([-2.0, -0.5, 0.0, 0.5, 2.0])
+    np.testing.assert_allclose(nn.Sigmoid()(T(x)).numpy(),
+                               1 / (1 + np.exp(-x)), rtol=1e-5)
+    np.testing.assert_allclose(nn.Tanh()(T(x)).numpy(), np.tanh(x),
+                               rtol=1e-5)
+    np.testing.assert_allclose(nn.ReLU6()(T(x)).numpy(),
+                               np.clip(x, 0, 6), rtol=1e-5)
+    np.testing.assert_allclose(nn.LeakyReLU(0.1)(T(x)).numpy(),
+                               np.where(x > 0, x, 0.1 * x), rtol=1e-5)
+    np.testing.assert_allclose(
+        nn.LogSoftmax()(T(x[None])).numpy().ravel(),
+        x - (np.log(np.exp(x - x.max()).sum()) + x.max()), rtol=1e-4,
+        atol=1e-5)
+
+
+def test_parametric_activations():
+    pr = nn.PReLU(num_parameters=1)
+    y = pr(X(2, 4))
+    assert y.shape == [2, 4]
+    gl = nn.GLU()
+    assert gl(X(2, 8)).shape == [2, 4]
+    mx = nn.Maxout(groups=2)
+    assert mx(X(2, 8, 3, 3)).shape == [2, 4, 3, 3]
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+
+def _scalar_and_grad(loss):
+    assert loss.shape == []
+    loss.backward()
+
+
+def test_regression_losses():
+    p = T(rng.normal(size=(4, 3)).astype(np.float32), stop_gradient=False)
+    t = X(4, 3)
+    _scalar_and_grad(nn.SmoothL1Loss()(p, t))
+    np.testing.assert_allclose(
+        float(nn.KLDivLoss(reduction="mean")(
+            T(np.log(np.float32([[0.5, 0.5]]))),
+            T(np.float32([[0.5, 0.5]])))), 0.0, atol=1e-6)
+
+
+def test_classification_losses():
+    logp = paddle.nn.functional.log_softmax(X(4, 5), axis=1)
+    lab = T(rng.integers(0, 5, (4,)).astype(np.int64))
+    out = nn.NLLLoss()(logp, lab)
+    assert out.shape == []
+    p = T(rng.uniform(0.05, 0.95, (6,)).astype(np.float32),
+          stop_gradient=False)
+    t = T((rng.uniform(0, 1, (6,)) > 0.5).astype(np.float32))
+    _scalar_and_grad(nn.BCELoss()(p, t))
+    x = T(rng.normal(size=(6,)).astype(np.float32), stop_gradient=False)
+    y = T(np.where(rng.uniform(0, 1, (6,)) > 0.5, 1, -1)
+          .astype(np.float32))
+    _scalar_and_grad(nn.SoftMarginLoss()(x, y))
+    _scalar_and_grad(nn.HingeEmbeddingLoss()(x, y))
+
+
+def test_pairwise_losses():
+    a, b = X(4, 8), X(4, 8)
+    y = T(np.where(rng.uniform(0, 1, (4,)) > 0.5, 1, -1)
+          .astype(np.float32))
+    assert nn.CosineEmbeddingLoss()(a, b, y).shape == []
+    x1, x2 = X(4,), X(4,)
+    assert nn.MarginRankingLoss()(x1, x2, y).shape == []
+    an, po, ne = X(4, 8), X(4, 8), X(4, 8)
+    assert nn.TripletMarginLoss()(an, po, ne).shape == []
+
+
+def test_ctc_loss():
+    # [T_max, B, C] log-probs, greedy-friendly shapes
+    logits = X(6, 2, 5)
+    labels = T(rng.integers(1, 5, (2, 3)).astype(np.int32))
+    in_len = T(np.array([6, 6], np.int64))
+    lab_len = T(np.array([3, 3], np.int64))
+    loss = nn.CTCLoss()(logits, labels, in_len, lab_len)
+    assert loss.shape == [] and float(loss) > 0
+
+
+# ---------------------------------------------------------------------------
+# pooling / padding / reshuffle
+# ---------------------------------------------------------------------------
+
+def test_pool_1d_3d():
+    assert nn.AvgPool1D(2)(X(2, 3, 8)).shape == [2, 3, 4]
+    assert nn.MaxPool1D(2)(X(2, 3, 8)).shape == [2, 3, 4]
+    assert nn.AvgPool3D(2)(X(2, 3, 4, 4, 4)).shape == [2, 3, 2, 2, 2]
+    assert nn.MaxPool3D(2)(X(2, 3, 4, 4, 4)).shape == [2, 3, 2, 2, 2]
+    assert nn.AdaptiveAvgPool1D(4)(X(2, 3, 8)).shape == [2, 3, 4]
+    assert nn.AdaptiveMaxPool1D(4)(X(2, 3, 8)).shape == [2, 3, 4]
+    assert nn.AdaptiveMaxPool2D(2)(X(2, 3, 6, 6)).shape == [2, 3, 2, 2]
+    assert nn.AdaptiveAvgPool3D(2)(X(2, 3, 4, 4, 4)).shape \
+        == [2, 3, 2, 2, 2]
+    assert nn.AdaptiveMaxPool3D(2)(X(2, 3, 4, 4, 4)).shape \
+        == [2, 3, 2, 2, 2]
+
+
+def test_padding_layers():
+    assert nn.Pad1D(1)(X(2, 3, 5)).shape == [2, 3, 7]
+    assert nn.Pad2D(1)(X(2, 3, 5, 5)).shape == [2, 3, 7, 7]
+    assert nn.Pad3D(1)(X(2, 3, 4, 4, 4)).shape == [2, 3, 6, 6, 6]
+    assert nn.ZeroPad2D(2)(X(2, 3, 5, 5)).shape == [2, 3, 9, 9]
+
+
+def test_shuffle_and_flatten():
+    assert nn.PixelShuffle(2)(X(2, 8, 3, 3)).shape == [2, 2, 6, 6]
+    assert nn.PixelUnshuffle(2)(X(2, 2, 6, 6)).shape == [2, 8, 3, 3]
+    assert nn.ChannelShuffle(2)(X(2, 4, 3, 3)).shape == [2, 4, 3, 3]
+    assert nn.Flatten()(X(2, 3, 4)).shape == [2, 12]
+    u = nn.Unfold(kernel_sizes=2)(X(1, 3, 4, 4))
+    assert u.shape == [1, 12, 9]
+    f = nn.Fold(output_sizes=4, kernel_sizes=2)(u)
+    assert f.shape == [1, 3, 4, 4]
+
+
+def test_upsample_layers():
+    assert nn.Upsample(scale_factor=2)(X(1, 3, 4, 4)).shape \
+        == [1, 3, 8, 8]
+    assert nn.UpsamplingNearest2D(scale_factor=2)(X(1, 3, 4, 4)).shape \
+        == [1, 3, 8, 8]
+    assert nn.UpsamplingBilinear2D(scale_factor=2)(X(1, 3, 4, 4)).shape \
+        == [1, 3, 8, 8]
+
+
+# ---------------------------------------------------------------------------
+# conv / norm
+# ---------------------------------------------------------------------------
+
+def test_conv_1d_3d():
+    assert nn.Conv1D(3, 6, 3)(X(2, 3, 10)).shape == [2, 6, 8]
+    assert nn.Conv1DTranspose(3, 6, 3)(X(2, 3, 8)).shape == [2, 6, 10]
+    assert nn.Conv3D(2, 4, 3)(X(1, 2, 5, 5, 5)).shape == [1, 4, 3, 3, 3]
+    assert nn.Conv3DTranspose(2, 4, 3)(X(1, 2, 3, 3, 3)).shape \
+        == [1, 4, 5, 5, 5]
+
+
+def test_norm_layers():
+    bn1 = nn.BatchNorm1D(4)
+    bn1.train()
+    assert bn1(X(8, 4)).shape == [8, 4]
+    bn3 = nn.BatchNorm3D(3)
+    assert bn3(X(2, 3, 3, 3, 3)).shape == [2, 3, 3, 3, 3]
+    assert nn.InstanceNorm1D(3)(X(2, 3, 8)).shape == [2, 3, 8]
+    assert nn.InstanceNorm3D(3)(X(2, 3, 3, 3, 3)).shape \
+        == [2, 3, 3, 3, 3]
+    assert nn.LocalResponseNorm(3)(X(2, 4, 5, 5)).shape == [2, 4, 5, 5]
+    x = X(4, 6)
+    r = nn.RMSNorm(6)(x)
+    ref = x.numpy() / np.sqrt((x.numpy() ** 2).mean(-1, keepdims=True)
+                              + 1e-6)
+    np.testing.assert_allclose(r.numpy(), ref, rtol=1e-4, atol=1e-4)
+    # SyncBatchNorm degrades to BatchNorm off-mesh
+    sb = nn.SyncBatchNorm(4)
+    sb.train()
+    assert sb(X(8, 4, 2, 2)).shape == [8, 4, 2, 2]
+    sn = nn.SpectralNorm(nn.Linear(5, 3).weight.shape) \
+        if hasattr(nn.SpectralNorm, "__init__") else None
+
+
+def test_spectral_norm():
+    w = X(5, 3)
+    sn = nn.SpectralNorm(w.shape, dim=0, power_iters=5)
+    out = sn(w)
+    # largest singular value normalized to ~1
+    s = np.linalg.svd(out.numpy(), compute_uv=False)[0]
+    np.testing.assert_allclose(s, 1.0, rtol=0.1)
+
+
+# ---------------------------------------------------------------------------
+# recurrent
+# ---------------------------------------------------------------------------
+
+def test_rnn_cells_and_wrappers():
+    cell = nn.SimpleRNNCell(4, 8)
+    y, h = cell(X(2, 4))
+    assert y.shape == [2, 8]
+    g = nn.GRUCell(4, 8)
+    y, h = g(X(2, 4))
+    assert y.shape == [2, 8]
+    l = nn.LSTMCell(4, 8)
+    y, (h, c) = l(X(2, 4))
+    assert y.shape == [2, 8] and c.shape == [2, 8]
+    rnn = nn.RNN(nn.SimpleRNNCell(4, 8))
+    out, state = rnn(X(2, 5, 4))
+    assert out.shape == [2, 5, 8]
+    bi = nn.BiRNN(nn.SimpleRNNCell(4, 8), nn.SimpleRNNCell(4, 8))
+    out, states = bi(X(2, 5, 4))
+    assert out.shape == [2, 5, 16]
+    sr = nn.SimpleRNN(4, 8)
+    out, st = sr(X(2, 5, 4))
+    assert out.shape == [2, 5, 8]
+
+
+def test_transformer_decoder():
+    layer = nn.TransformerDecoderLayer(d_model=16, nhead=4,
+                                       dim_feedforward=32)
+    dec = nn.TransformerDecoder(layer, num_layers=2)
+    tgt, mem = X(2, 5, 16), X(2, 7, 16)
+    out = dec(tgt, mem)
+    assert out.shape == [2, 5, 16]
+
+
+# ---------------------------------------------------------------------------
+# misc containers / params / dropout / similarity
+# ---------------------------------------------------------------------------
+
+def test_misc_layers():
+    b = nn.Bilinear(3, 4, 5)
+    assert b(X(2, 3), X(2, 4)).shape == [2, 5]
+    cs = nn.CosineSimilarity()
+    a1, a2 = X(4, 8), X(4, 8)
+    ref = (a1.numpy() * a2.numpy()).sum(1) / (
+        np.linalg.norm(a1.numpy(), axis=1)
+        * np.linalg.norm(a2.numpy(), axis=1))
+    np.testing.assert_allclose(cs(a1, a2).numpy(), ref, rtol=1e-4,
+                               atol=1e-5)
+    for drop in (nn.Dropout2D(0.5), nn.Dropout3D(0.5),
+                 nn.AlphaDropout(0.5)):
+        drop.eval()
+        x = X(2, 3, 4, 4) if not isinstance(drop, nn.Dropout3D) \
+            else X(2, 3, 2, 4, 4)
+        np.testing.assert_allclose(drop(x).numpy(), x.numpy())
+
+
+def test_containers_and_params():
+    ld = nn.LayerDict({"a": nn.Linear(2, 3), "b": nn.ReLU()})
+    assert set(dict(ld.named_children())) >= {"a", "b"}
+    assert ld["a"](X(1, 2)).shape == [1, 3]
+    pl = nn.ParameterList([nn.Linear(2, 2).weight for _ in range(3)])
+    assert len(list(pl)) == 3
+    attr = nn.ParamAttr(name="w0")
+    lin = nn.Linear(2, 2, weight_attr=attr)
+    assert isinstance(lin.weight, paddle.framework.Parameter) or \
+        lin.weight is not None
+
+
+def test_grad_clip_classes():
+    import paddle_tpu.optimizer as opt
+    x = rng.normal(size=(8, 4)).astype(np.float32)
+    for clip in (nn.ClipGradByGlobalNorm(0.01), nn.ClipGradByNorm(0.01),
+                 nn.ClipGradByValue(0.001)):
+        paddle.seed(0)
+        lin = nn.Linear(4, 2)
+        sgd = opt.SGD(learning_rate=1.0, parameters=list(lin.parameters()),
+                      grad_clip=clip)
+        before = lin.weight.numpy().copy()
+        lin(T(x)).sum().backward()
+        sgd.step()
+        delta = np.abs(lin.weight.numpy() - before).max()
+        assert delta < 0.05        # clipped step is tiny despite lr=1

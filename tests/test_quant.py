@@ -101,6 +101,7 @@ def test_ptq_flow():
     ref = net(paddle.to_tensor(x)).numpy()
     ptq = PTQ()
     ptq.quantize(net)
+    net.eval()      # dropout/BN off; observers still run (_calibrating)
     for i in range(4):                      # calibration batches
         net(paddle.to_tensor(x[i * 8:(i + 1) * 8]))
     ptq.convert(net)
