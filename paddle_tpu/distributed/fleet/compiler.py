@@ -303,48 +303,34 @@ def compile_train_step(layer, optimizer, strategy: DistributedStrategy,
 # pipeline-parallel step (strategy.pipeline / pp_degree > 1)
 # ---------------------------------------------------------------------------
 
-def _compile_pipeline_step(layer, optimizer, strategy, mesh):
-    """PP branch of the strategy compiler.
-
-    Reference: PipelineOptimizer splits the Program into per-stage sections
-    executed by SectionWorker 1F1B loops (optimizer.py:3718,
-    section_worker.cc:98-165). TPU-native: the layer supplies an
-    (embed, blocks, head) decomposition; homogeneous blocks are stacked on
-    a leading layer axis sharded over 'pp' and driven by the SPMD schedule
-    in distributed/pipeline.py (ppermute ring inside one jitted scan).
-    Composes with dp (microbatch dim sharded over 'dp'), recompute
-    (jax.checkpoint per block) and AMP (autocast inside the traced blocks).
-    Microbatch count = pipeline_configs.accumulate_steps.
-    """
-    from ..pipeline import pipeline_spmd, stack_stage_params
-
-    if int(mesh.shape.get("tp", 1)) > 1:
-        raise NotImplementedError(
-            "pipeline + tensor_parallel in one mesh is not supported yet; "
-            "tp collectives would need manual insertion inside the "
-            "pipeline's shard_map region")
+def _check_pipeline_compat(strategy, mesh, what="pipeline"):
     if strategy.sharding:
         raise NotImplementedError(
-            "pipeline + sharding (ZeRO) is not supported yet; optimizer "
+            f"{what} + sharding (ZeRO) is not supported yet; optimizer "
             "state would need 'dp' specs threaded through the stacked "
             "layout — disable one of the two")
     if strategy.gradient_merge and strategy.gradient_merge_configs.k_steps > 1:
         raise NotImplementedError(
-            "pipeline already microbatches via "
+            f"{what} already microbatches via "
             "pipeline_configs.accumulate_steps; gradient_merge on top is "
             "not supported — fold k_steps into accumulate_steps")
     if int(mesh.shape.get("sp", 1)) > 1 or int(mesh.shape.get("ep", 1)) > 1:
         raise NotImplementedError(
-            "pipeline + sequence/expert parallel in one mesh is not "
+            f"{what} + sequence/expert parallel in one mesh is not "
             "supported yet; the pipeline shard_map region would need the "
             "sp/ep collectives inserted manually")
-    split = getattr(layer, "pipeline_split_params", None)
-    fns = getattr(layer, "pipeline_fns", None)
-    if not (callable(split) and callable(fns)):
-        raise TypeError(
-            "pipeline=True requires the layer to implement "
-            "pipeline_split_params(params) and pipeline_fns() "
-            "(see models/gpt.py for the protocol)")
+
+
+def _build_pipeline_program(layer, optimizer, strategy, mesh, *, block_fn,
+                            embed_fn, head_loss_fn, ep, hp, stacked,
+                            n_layers, stacked_pspec, prog_cls,
+                            stacked_param_specs=None):
+    """The machinery both pipeline branches share: flat param assembly
+    (embed.* / head.* / stacked.*), shardings, the microbatched
+    global-masked-mean loss, jit wiring and program construction. The
+    branches differ only in how the stacked block params are laid out and
+    what block_fn runs inside the pipeline shard_map."""
+    from ..pipeline import pipeline_spmd
 
     n_pp = int(mesh.shape["pp"])
     n_dp = int(mesh.shape.get("dp", 1))
@@ -352,19 +338,12 @@ def _compile_pipeline_step(layer, optimizer, strategy, mesh):
     amp_on = bool(strategy.amp)
     pure_bf16 = amp_on and strategy.amp_configs.use_pure_bf16
 
-    params = param_arrays(layer)
-    state = state_arrays(layer)
-    ep, blocks_list, hp = split(params)
-    n_layers = len(blocks_list)
-    if n_layers % n_pp:
-        raise ValueError(f"{n_layers} blocks not divisible by pp={n_pp}")
-    embed_fn, block_fn, head_loss_fn = fns()
     if strategy.recompute:
         policy = getattr(jax.checkpoint_policies,
                          strategy.recompute_configs.policy, None)
         block_fn = jax.checkpoint(block_fn, policy=policy)
 
-    stacked = stack_stage_params(blocks_list)
+    state = state_arrays(layer)
     flat = {}
     flat.update({f"embed.{k}": v for k, v in ep.items()})
     flat.update({f"head.{k}": v for k, v in hp.items()})
@@ -373,7 +352,7 @@ def _compile_pipeline_step(layer, optimizer, strategy, mesh):
 
     def _pspec(k, v):
         if k.startswith("stacked."):
-            return P("pp", *([None] * (v.ndim - 1)))
+            return stacked_pspec(k[len("stacked."):], v)
         return P(*([None] * v.ndim))
 
     pspecs = {k: _pspec(k, v) for k, v in flat.items()}
@@ -384,7 +363,8 @@ def _compile_pipeline_step(layer, optimizer, strategy, mesh):
     data_sh = NamedSharding(mesh, P("dp") if n_dp > 1 else P())
 
     pipe = pipeline_spmd(block_fn, n_pp, n_micro, mesh, axis="pp",
-                         batch_axis="dp" if n_dp > 1 else None)
+                         batch_axis="dp" if n_dp > 1 else None,
+                         param_specs=stacked_param_specs)
 
     def _sub(p, prefix):
         cut = len(prefix)
@@ -429,12 +409,104 @@ def _compile_pipeline_step(layer, optimizer, strategy, mesh):
     state = jax.device_put(state, buf_sh)
     opt_state = _put_opt_state(opt_state, s_sh)
 
-    prog = _PipelineTrainStep(jitted, flat, state, opt_state,
-                              {"params": p_sh, "opt": s_sh}, mesh, layer,
-                              data_sh)
+    prog = prog_cls(jitted, flat, state, opt_state,
+                    {"params": p_sh, "opt": s_sh}, mesh, layer, data_sh)
     prog._opt = optimizer
     prog._n_layers = n_layers
     return prog
+
+
+def _compile_pipeline_step(layer, optimizer, strategy, mesh):
+    """PP branch of the strategy compiler.
+
+    Reference: PipelineOptimizer splits the Program into per-stage sections
+    executed by SectionWorker 1F1B loops (optimizer.py:3718,
+    section_worker.cc:98-165). TPU-native: the layer supplies an
+    (embed, blocks, head) decomposition; homogeneous blocks are stacked on
+    a leading layer axis sharded over 'pp' and driven by the SPMD schedule
+    in distributed/pipeline.py (ppermute ring inside one jitted scan).
+    Composes with dp (microbatch dim sharded over 'dp'), tp (the manual-tp
+    branch below), recompute (jax.checkpoint per block) and AMP (autocast
+    inside the traced blocks). Microbatches = accumulate_steps.
+    """
+    from ..pipeline import stack_stage_params
+
+    n_tp = int(mesh.shape.get("tp", 1))
+    if n_tp > 1:
+        return _compile_pipeline_tp_step(layer, optimizer, strategy, mesh,
+                                         n_tp)
+    _check_pipeline_compat(strategy, mesh)
+    split = getattr(layer, "pipeline_split_params", None)
+    fns = getattr(layer, "pipeline_fns", None)
+    if not (callable(split) and callable(fns)):
+        raise TypeError(
+            "pipeline=True requires the layer to implement "
+            "pipeline_split_params(params) and pipeline_fns() "
+            "(see models/gpt.py for the protocol)")
+
+    params = param_arrays(layer)
+    ep, blocks_list, hp = split(params)
+    n_pp = int(mesh.shape["pp"])
+    if len(blocks_list) % n_pp:
+        raise ValueError(f"{len(blocks_list)} blocks not divisible by "
+                         f"pp={n_pp}")
+    embed_fn, block_fn, head_loss_fn = fns()
+    return _build_pipeline_program(
+        layer, optimizer, strategy, mesh, block_fn=block_fn,
+        embed_fn=embed_fn, head_loss_fn=head_loss_fn, ep=ep, hp=hp,
+        stacked=stack_stage_params(blocks_list),
+        n_layers=len(blocks_list),
+        stacked_pspec=lambda rel, v: P("pp", *([None] * (v.ndim - 1))),
+        prog_cls=_PipelineTrainStep)
+
+
+def _compile_pipeline_tp_step(layer, optimizer, strategy, mesh, n_tp):
+    """pp x tp (x dp) branch: the pipeline shard_map keeps every mesh axis
+    manual, so the block function is the layer's hand-written Megatron
+    block (models/gpt.py pipeline_block_fn_tp: split qkv head groups,
+    explicit psums over 'tp') and the stacked block params are physically
+    sharded with the layer's block_tp_specs. Reference analog: a program
+    pass emitting c_allreduce inside each pipeline section."""
+    from ..pipeline import stack_stage_params
+
+    for need in ("split_block_params_tp", "block_tp_specs",
+                 "pipeline_block_fn_tp", "pipeline_split_params",
+                 "pipeline_fns"):
+        if not callable(getattr(layer, need, None)):
+            raise TypeError(
+                f"pipeline + tensor_parallel requires the layer to "
+                f"implement {need} (see models/gpt.py)")
+    _check_pipeline_compat(strategy, mesh, what="pipeline+tp")
+    heads = getattr(getattr(layer, "cfg", None), "heads", None)
+    if heads is not None and heads % n_tp:
+        raise ValueError(f"{heads} attention heads not divisible by "
+                         f"tp={n_tp}")
+
+    params = param_arrays(layer)
+    ep, blocks_list, hp = layer.pipeline_split_params(params)
+    n_pp = int(mesh.shape["pp"])
+    if len(blocks_list) % n_pp:
+        raise ValueError(f"{len(blocks_list)} blocks not divisible by "
+                         f"pp={n_pp}")
+    embed_fn, _, head_loss_fn = layer.pipeline_fns()
+    block_fn = layer.pipeline_block_fn_tp(axis_tp="tp")
+    split_blocks = [layer.split_block_params_tp(b) for b in blocks_list]
+    tp_specs = layer.block_tp_specs(axis_pp="pp", axis_tp="tp")
+
+    def stacked_pspec(rel, v):
+        spec = tp_specs.get(rel)
+        if spec is None:
+            raise KeyError(f"block_tp_specs missing {rel!r}")
+        return spec
+
+    return _build_pipeline_program(
+        layer, optimizer, strategy, mesh, block_fn=block_fn,
+        embed_fn=embed_fn, head_loss_fn=head_loss_fn, ep=ep, hp=hp,
+        stacked=stack_stage_params(split_blocks),
+        n_layers=len(blocks_list), stacked_pspec=stacked_pspec,
+        prog_cls=_PipelineTpTrainStep,
+        stacked_param_specs={k: v for k, v in tp_specs.items()})
+
 
 
 class _PipelineTrainStep(CompiledTrainStep):
@@ -456,6 +528,34 @@ class _PipelineTrainStep(CompiledTrainStep):
                     name = f"blocks.{i}.{rel}"
                     if name in lookup:
                         lookup[name]._data = stacked[i]
+        for k, v in self.state.items():
+            if k in lookup:
+                lookup[k]._data = jax.device_get(v)
+
+
+class _PipelineTpTrainStep(_PipelineTrainStep):
+    """Pipeline layout with manual-tp split blocks: write_back merges the
+    split q/k/v back into the packed qkv params (layer protocol
+    merge_block_params_tp)."""
+
+    def write_back(self):
+        lookup = dict(self.layer.named_parameters())
+        lookup.update(dict(self.layer.named_buffers()))
+        stacked = {}
+        for k, v in self.params.items():
+            if k.startswith("embed.") or k.startswith("head."):
+                name = k.split(".", 1)[1]
+                if name in lookup:
+                    lookup[name]._data = jax.device_get(v)
+            elif k.startswith("stacked."):
+                stacked[k[len("stacked."):]] = jax.device_get(v)
+        for i in range(self._n_layers):
+            split_i = {rel: arr[i] for rel, arr in stacked.items()}
+            merged = self.layer.merge_block_params_tp(split_i)
+            for rel, arr in merged.items():
+                name = f"blocks.{i}.{rel}"
+                if name in lookup:
+                    lookup[name]._data = jnp.asarray(arr)
         for k, v in self.state.items():
             if k in lookup:
                 lookup[k]._data = jax.device_get(v)

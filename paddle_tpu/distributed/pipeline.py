@@ -40,7 +40,8 @@ def stack_stage_params(block_params_list):
 
 
 def pipeline_spmd(block_fn: Callable, n_stages: int, n_micro: int,
-                  mesh, axis: str = "pp", batch_axis: str = None):
+                  mesh, axis: str = "pp", batch_axis: str = None,
+                  param_specs=None):
     """Build pipelined_fn(stacked_params, x_micro) -> y_micro.
 
     block_fn(params_one_layer, x) -> x          (one transformer block)
@@ -106,11 +107,17 @@ def pipeline_spmd(block_fn: Callable, n_stages: int, n_micro: int,
         if batch_axis is not None:
             dspec[1] = batch_axis
         dspec = P(*dspec)
-        param_specs = jax.tree_util.tree_map(
-            lambda v: P(axis, *([None] * (v.ndim - 1))), stacked_params)
+        # default: params sharded over 'pp' only; a caller doing manual
+        # tensor parallelism inside block_fn (models/gpt.py
+        # pipeline_block_fn_tp) passes specs that also shard over 'tp' —
+        # every mesh axis stays manual, tp collectives are block_fn's job
+        pspecs = param_specs if param_specs is not None else \
+            jax.tree_util.tree_map(
+                lambda v: P(axis, *([None] * (v.ndim - 1))),
+                stacked_params)
         f = jax.shard_map(
             staged, mesh=in_mesh,
-            in_specs=(param_specs, dspec),
+            in_specs=(pspecs, dspec),
             out_specs=dspec,
             check_vma=False)
         return f(stacked_params, x_micro)

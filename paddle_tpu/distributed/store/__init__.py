@@ -80,6 +80,14 @@ class Store:
             self.set(f"__barrier__/{name}/go/{epoch}", b"1")
         self.wait(f"__barrier__/{name}/go/{epoch}", timeout=timeout)
 
+    def delete_barrier(self, name: str, max_epochs: int = 1):
+        """Reclaim a barrier's keys (the schema is private to this class).
+        Only safe once no caller can still be waiting on `name` — e.g.
+        after a later barrier proved everyone moved on."""
+        self.delete_key(f"__barrier__/{name}/count")
+        for e in range(max_epochs):
+            self.delete_key(f"__barrier__/{name}/go/{e}")
+
 
 class TCPStore(Store):
     """Client for the native tcp_store server; `TCPStore.start()` also

@@ -127,9 +127,8 @@ class InMemoryDataset:
         # on e-2's go keys. (e-1's keys may still have waiters in-flight.)
         if epoch >= 2:
             old = f"{name}/e{epoch - 2}"
-            for barrier_name in ("posted", "collected"):
-                store.delete_key(f"__barrier__/{old}/{barrier_name}/count")
-                store.delete_key(f"__barrier__/{old}/{barrier_name}/go/0")
+            store.delete_barrier(f"{old}/posted")
+            store.delete_barrier(f"{old}/collected")
         rng = random.Random(seed + rank * 7919)   # per-rank stream is fine:
         # destinations only need to be ~uniform, not agreed on
         outgoing: List[List[list]] = [[] for _ in range(world_size)]

@@ -263,6 +263,113 @@ class GPT(nn.Layer):
 
         return embed_fn, block_fn, head_loss_fn
 
+    # -- manual-tp pipeline protocol (pp x tp composition) -----------------
+    # The SPMD pipeline runs inside a shard_map where every mesh axis is
+    # manual, so tensor parallelism inside a stage cannot rely on GSPMD:
+    # the packed qkv matrix must be physically split per head-group and
+    # the two Megatron reductions (after attn-proj and after fc2) are
+    # explicit psums over 'tp'. Reference analog: the hand-inserted
+    # c_allreduce ops a Megatron program rewrite would emit.
+
+    TP_SPLIT_KEYS = ("q_w", "q_b", "k_w", "k_b", "v_w", "v_b")
+
+    @staticmethod
+    def split_block_params_tp(bp):
+        """One block's params -> manual-tp layout: packed qkv split into
+        q/k/v so a last-dim shard holds whole heads."""
+        import numpy as _np
+        qkv_w = _np.asarray(bp["attn.qkv.weight"])     # [H, 3H]
+        qkv_b = _np.asarray(bp["attn.qkv.bias"])       # [3H]
+        q_w, k_w, v_w = _np.split(qkv_w, 3, axis=1)
+        q_b, k_b, v_b = _np.split(qkv_b, 3)
+        out = {k: v for k, v in bp.items()
+               if not k.startswith("attn.qkv.")}
+        out.update({"q_w": q_w, "k_w": k_w, "v_w": v_w,
+                    "q_b": q_b, "k_b": k_b, "v_b": v_b})
+        return out
+
+    @staticmethod
+    def merge_block_params_tp(split):
+        """Inverse of split_block_params_tp (for write_back)."""
+        import numpy as _np
+        out = {k: v for k, v in split.items()
+               if k not in GPT.TP_SPLIT_KEYS}
+        out["attn.qkv.weight"] = _np.concatenate(
+            [split["q_w"], split["k_w"], split["v_w"]], axis=1)
+        out["attn.qkv.bias"] = _np.concatenate(
+            [split["q_b"], split["k_b"], split["v_b"]])
+        return out
+
+    @staticmethod
+    def block_tp_specs(axis_pp="pp", axis_tp="tp"):
+        """Stacked-layout PartitionSpecs for the split-tp block params
+        ([L, ...] leading layer dim over pp; Megatron col/row over tp)."""
+        from jax.sharding import PartitionSpec as P
+        return {
+            "ln1.weight": P(axis_pp, None), "ln1.bias": P(axis_pp, None),
+            "ln2.weight": P(axis_pp, None), "ln2.bias": P(axis_pp, None),
+            "q_w": P(axis_pp, None, axis_tp), "q_b": P(axis_pp, axis_tp),
+            "k_w": P(axis_pp, None, axis_tp), "k_b": P(axis_pp, axis_tp),
+            "v_w": P(axis_pp, None, axis_tp), "v_b": P(axis_pp, axis_tp),
+            "attn.proj.weight": P(axis_pp, axis_tp, None),
+            "attn.proj.bias": P(axis_pp, None),
+            "fc1.weight": P(axis_pp, None, axis_tp),
+            "fc1.bias": P(axis_pp, axis_tp),
+            "fc2.weight": P(axis_pp, axis_tp, None),
+            "fc2.bias": P(axis_pp, None),
+        }
+
+    def pipeline_block_fn_tp(self, axis_tp="tp"):
+        """block_fn for the manual-tp pipeline: local head-group attention
+        + Megatron MLP with explicit psums over `axis_tp`. Operates on the
+        split layout from split_block_params_tp (local tp shards)."""
+        if self.cfg.dropout > 0:
+            raise NotImplementedError(
+                "pipeline block with dropout > 0 unsupported (pure "
+                "per-stage functions carry no dropout rng)")
+        if self.cfg.moe_experts > 0:
+            raise NotImplementedError("pipeline+tp with MoE unsupported")
+        D = self.cfg.head_dim
+        eps1 = self.blocks[0].ln1._epsilon
+        eps2 = self.blocks[0].ln2._epsilon
+
+        def ln(x, g, b, eps):
+            mu = x.mean(-1, keepdims=True)
+            var = ((x - mu) ** 2).mean(-1, keepdims=True)
+            return (x - mu) / jnp.sqrt(var + eps) * g + b
+
+        def block_fn(bp, h):
+            B, T, H = h.shape
+            h1 = ln(h, bp["ln1.weight"], bp["ln1.bias"], eps1)
+            q = h1 @ bp["q_w"] + bp["q_b"]      # [B,T,H/ntp] local heads
+            k = h1 @ bp["k_w"] + bp["k_b"]
+            v = h1 @ bp["v_w"] + bp["v_b"]
+            nloc = q.shape[-1] // D
+            q = q.reshape(B, T, nloc, D)
+            k = k.reshape(B, T, nloc, D)
+            v = v.reshape(B, T, nloc, D)
+            # causal attention on the local head group — same op order as
+            # F.scaled_dot_product_attention's XLA core (attention.py
+            # _sdpa_xla) so pp x tp matches the sequential loss closely
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (1.0 / math.sqrt(D))
+            s = s.astype(jnp.float32)
+            causal = jnp.tril(jnp.ones((T, T), bool))
+            s = jnp.where(causal[None, None], s, -1e30)
+            p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+            o = jnp.einsum("bhqk,bkhd->bqhd", p, v).reshape(B, T, -1)
+            # row-parallel proj: partial sums meet across head groups
+            att = jax.lax.psum(o @ bp["attn.proj.weight"], axis_tp) \
+                + bp["attn.proj.bias"]
+            h = h + att
+            h2 = ln(h, bp["ln2.weight"], bp["ln2.bias"], eps2)
+            m = jax.nn.gelu(h2 @ bp["fc1.weight"] + bp["fc1.bias"],
+                            approximate=False)   # Block uses exact gelu
+            mo = jax.lax.psum(m @ bp["fc2.weight"], axis_tp) \
+                + bp["fc2.bias"]
+            return h + mo
+
+        return block_fn
+
 
 def gpt_param_shardings(params, mesh_axis_tp="tp"):
     """Megatron-style TP PartitionSpecs keyed by the functional param dict

@@ -14,9 +14,10 @@ __all__ = ["export"]
 
 
 def export(layer, path, input_spec=None, opset_version=9, **configs):
-    """paddle.onnx.export parity. Requires an onnx converter tool-chain
-    in the environment (the reference requires paddle2onnx the same
-    way); without one, raises and points at the native export path."""
+    """paddle.onnx.export parity stub: ALWAYS raises (conversion is not
+    implemented). Without the onnx package: RuntimeError pointing at the
+    native jit.save path; with it: NotImplementedError (no
+    StableHLO->ONNX converter in this build)."""
     try:
         import onnx  # noqa: F401
     except ImportError as e:

@@ -299,7 +299,56 @@ def test_compiled_step_pipeline_matches_sequential():
     assert err < 2e-4, err
 
 
-def test_pipeline_requires_protocol_and_rejects_tp():
+def test_compiled_step_pipeline_x_tensor_parallel():
+    """pp x tp x dp in one mesh: the manual-tp pipeline branch (split qkv
+    head groups, explicit psums inside the shard_map) matches sequential
+    training and write_back re-packs qkv."""
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.distributed.fleet.compiler import compile_train_step
+
+    rng = np.random.default_rng(1)
+    B, T = 8, 32
+    ids = rng.integers(0, 512, (B, T)).astype(np.int64)
+    labels = rng.integers(0, 512, (B, T)).astype(np.int64)
+
+    m1 = _tiny_gpt()
+    s1 = DistributedStrategy()
+    mesh1 = s1.build_mesh(devices=jax.devices()[:1])
+    adam1 = opt.Adam(learning_rate=1e-3, parameters=list(m1.parameters()))
+    prog1 = compile_train_step(m1, adam1, s1, mesh=mesh1)
+    seq = [float(jax.device_get(prog1.step(ids, labels, lr=1e-3)))
+           for _ in range(3)]
+
+    m2 = _tiny_gpt()
+    s2 = DistributedStrategy()
+    s2.pipeline = True
+    s2.tensor_parallel = True
+    s2.hybrid_configs.pp_degree = 2
+    s2.hybrid_configs.mp_degree = 2
+    s2.hybrid_configs.dp_degree = 2
+    s2.pipeline_configs.accumulate_steps = 2
+    s2.recompute = True
+    adam2 = opt.Adam(learning_rate=1e-3, parameters=list(m2.parameters()))
+    prog2 = compile_train_step(m2, adam2, s2)
+    assert dict(prog2.mesh.shape)["tp"] == 2
+    pptp = [float(jax.device_get(prog2.step(ids, labels, lr=1e-3)))
+            for _ in range(3)]
+    np.testing.assert_allclose(seq, pptp, atol=5e-3, rtol=1e-4)
+
+    # split q/k/v weights are sharded over BOTH pp (stack) and tp (cols)
+    spec = prog2.params["stacked.q_w"].sharding.spec
+    assert spec[0] == "pp" and spec[2] == "tp"
+
+    # write_back re-packs qkv; params match the sequential run
+    prog2.write_back()
+    p_after = {k: v._data for k, v in m2.named_parameters()}
+    err = max(float(jnp.abs(p_after[k] -
+                            jax.device_get(prog1.params[k])).max())
+              for k in prog1.params)
+    assert err < 5e-3, err
+
+
+def test_pipeline_tp_requires_protocol_and_divisible_heads():
     import paddle_tpu.optimizer as opt
     from paddle_tpu.distributed.fleet.compiler import compile_train_step
     import paddle_tpu.nn as nn
@@ -313,16 +362,33 @@ def test_pipeline_requires_protocol_and_rejects_tp():
     with pytest.raises(TypeError):
         compile_train_step(lin, adam, s, mesh=mesh)
 
+    # pipeline + tp needs the manual-tp block protocol; a layer without
+    # it (Linear) fails loudly instead of silently replicating
     s2 = DistributedStrategy()
     s2.pipeline = True
     s2.tensor_parallel = True
     s2.hybrid_configs.pp_degree = 2
     s2.hybrid_configs.mp_degree = 2
     mesh2 = s2.build_mesh(devices=jax.devices()[:4])
-    m = _tiny_gpt()
-    adam2 = opt.Adam(learning_rate=1e-3, parameters=list(m.parameters()))
-    with pytest.raises(NotImplementedError):
-        compile_train_step(m, adam2, s2, mesh=mesh2)
+    lin2 = nn.Linear(4, 4)
+    adam2 = opt.Adam(learning_rate=1e-3, parameters=list(lin2.parameters()))
+    with pytest.raises(TypeError, match="pipeline \\+ tensor_parallel"):
+        compile_train_step(lin2, adam2, s2, mesh=mesh2)
+
+    # heads not divisible by tp is a hard error
+    s3 = DistributedStrategy()
+    s3.pipeline = True
+    s3.tensor_parallel = True
+    s3.hybrid_configs.pp_degree = 2
+    s3.hybrid_configs.mp_degree = 4
+    mesh3 = s3.build_mesh(devices=jax.devices()[:8])
+    from paddle_tpu.models import GPT, GPTConfig
+    paddle.seed(0)
+    m3 = GPT(GPTConfig(vocab_size=512, max_seq_len=64, hidden=60,
+                       layers=2, heads=6))
+    adam3 = opt.Adam(learning_rate=1e-3, parameters=list(m3.parameters()))
+    with pytest.raises(ValueError, match="heads not divisible"):
+        compile_train_step(m3, adam3, s3, mesh=mesh3)
 
 
 def test_pipeline_ignore_index_matches_sequential():

@@ -220,8 +220,6 @@ def test_norm_layers():
     sb = nn.SyncBatchNorm(4)
     sb.train()
     assert sb(X(8, 4, 2, 2)).shape == [8, 4, 2, 2]
-    sn = nn.SpectralNorm(nn.Linear(5, 3).weight.shape) \
-        if hasattr(nn.SpectralNorm, "__init__") else None
 
 
 def test_spectral_norm():
