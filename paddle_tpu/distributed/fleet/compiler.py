@@ -303,12 +303,25 @@ def compile_train_step(layer, optimizer, strategy: DistributedStrategy,
 # pipeline-parallel step (strategy.pipeline / pp_degree > 1)
 # ---------------------------------------------------------------------------
 
+def _claim_free_dim(spec, shape, axis, n):
+    """Spec with `axis` claimed on the first unsharded dim divisible by n
+    (unchanged if none qualifies) — the ZeRO slot-sharding rule."""
+    dims = list(spec) + [None] * (len(shape) - len(spec))
+    for i, (s, d) in enumerate(zip(dims, shape)):
+        if s is None and d % n == 0 and d >= n:
+            dims[i] = axis
+            return P(*dims)
+    return spec
+
+
 def _check_pipeline_compat(strategy, mesh, what="pipeline"):
-    if strategy.sharding:
+    if strategy.sharding and strategy.sharding_stage() >= 3:
         raise NotImplementedError(
-            f"{what} + sharding (ZeRO) is not supported yet; optimizer "
-            "state would need 'dp' specs threaded through the stacked "
-            "layout — disable one of the two")
+            f"{what} + ZeRO-3 is not supported: stage-3 param sharding "
+            "conflicts with the pipeline's stacked-over-'pp' param layout "
+            "— use sharding stage 1/2 (optimizer-state sharding over dp)")
+    if strategy.sharding and int(mesh.shape.get("dp", 1)) < 2:
+        raise ValueError(f"{what} + sharding needs dp >= 2 in the mesh")
     if strategy.gradient_merge and strategy.gradient_merge_configs.k_steps > 1:
         raise NotImplementedError(
             f"{what} already microbatches via "
@@ -323,8 +336,7 @@ def _check_pipeline_compat(strategy, mesh, what="pipeline"):
 
 def _build_pipeline_program(layer, optimizer, strategy, mesh, *, block_fn,
                             embed_fn, head_loss_fn, ep, hp, stacked,
-                            n_layers, stacked_pspec, prog_cls,
-                            stacked_param_specs=None):
+                            n_layers, stacked_pspec, prog_cls):
     """The machinery both pipeline branches share: flat param assembly
     (embed.* / head.* / stacked.*), shardings, the microbatched
     global-masked-mean loss, jit wiring and program construction. The
@@ -357,14 +369,27 @@ def _build_pipeline_program(layer, optimizer, strategy, mesh, *, block_fn,
 
     pspecs = {k: _pspec(k, v) for k, v in flat.items()}
     p_sh = {k: NamedSharding(mesh, pspecs[k]) for k in flat}
-    s_sh = _slot_shardings(mesh, opt_state, flat, pspecs)
+    # pipeline + ZeRO-1/2: optimizer slots additionally shard over 'dp'
+    # on the first free, divisible dim (params keep the pipeline layout;
+    # XLA re-tiles grads at the update boundary — the reduce-scatter)
+    if strategy.sharding and strategy.sharding_stage() >= 1 and n_dp > 1:
+        slot_specs = {k: _claim_free_dim(pspecs[k], flat[k].shape, "dp",
+                                         n_dp)
+                      for k in flat}
+    else:
+        slot_specs = pspecs
+    s_sh = _slot_shardings(mesh, opt_state, flat, slot_specs)
     buf_sh = {k: NamedSharding(mesh, P(*([None] * getattr(v, "ndim", 0))))
               for k, v in state.items()}
     data_sh = NamedSharding(mesh, P("dp") if n_dp > 1 else P())
 
-    pipe = pipeline_spmd(block_fn, n_pp, n_micro, mesh, axis="pp",
-                         batch_axis="dp" if n_dp > 1 else None,
-                         param_specs=stacked_param_specs)
+    # shard_map in_specs derive from the SAME pspecs the jit in_shardings
+    # use — one source of truth for the stacked layout
+    pipe = pipeline_spmd(
+        block_fn, n_pp, n_micro, mesh, axis="pp",
+        batch_axis="dp" if n_dp > 1 else None,
+        param_specs={k[len("stacked."):]: v for k, v in pspecs.items()
+                     if k.startswith("stacked.")})
 
     def _sub(p, prefix):
         cut = len(prefix)
@@ -489,7 +514,11 @@ def _compile_pipeline_tp_step(layer, optimizer, strategy, mesh, n_tp):
         raise ValueError(f"{len(blocks_list)} blocks not divisible by "
                          f"pp={n_pp}")
     embed_fn, _, head_loss_fn = layer.pipeline_fns()
-    block_fn = layer.pipeline_block_fn_tp(axis_tp="tp")
+    # raw-jnp block ops bypass the autocast dispatcher hook, so AMP is
+    # delivered as an explicit compute dtype
+    block_fn = layer.pipeline_block_fn_tp(
+        axis_tp="tp",
+        compute_dtype="bfloat16" if strategy.amp else None)
     split_blocks = [layer.split_block_params_tp(b) for b in blocks_list]
     tp_specs = layer.block_tp_specs(axis_pp="pp", axis_tp="tp")
 
@@ -504,39 +533,13 @@ def _compile_pipeline_tp_step(layer, optimizer, strategy, mesh, n_tp):
         embed_fn=embed_fn, head_loss_fn=head_loss_fn, ep=ep, hp=hp,
         stacked=stack_stage_params(split_blocks),
         n_layers=len(blocks_list), stacked_pspec=stacked_pspec,
-        prog_cls=_PipelineTpTrainStep,
-        stacked_param_specs={k: v for k, v in tp_specs.items()})
+        prog_cls=_PipelineTpTrainStep)
 
 
 
 class _PipelineTrainStep(CompiledTrainStep):
     """CompiledTrainStep whose param dict uses the pipeline layout
     (embed.* / head.* / stacked.*[L, ...]); write_back unstacks."""
-
-    def write_back(self):
-        lookup = dict(self.layer.named_parameters())
-        lookup.update(dict(self.layer.named_buffers()))
-        for k, v in self.params.items():
-            if k.startswith("embed.") or k.startswith("head."):
-                name = k.split(".", 1)[1]
-                if name in lookup:
-                    lookup[name]._data = jax.device_get(v)
-            elif k.startswith("stacked."):
-                rel = k[len("stacked."):]
-                stacked = jax.device_get(v)
-                for i in range(self._n_layers):
-                    name = f"blocks.{i}.{rel}"
-                    if name in lookup:
-                        lookup[name]._data = stacked[i]
-        for k, v in self.state.items():
-            if k in lookup:
-                lookup[k]._data = jax.device_get(v)
-
-
-class _PipelineTpTrainStep(_PipelineTrainStep):
-    """Pipeline layout with manual-tp split blocks: write_back merges the
-    split q/k/v back into the packed qkv params (layer protocol
-    merge_block_params_tp)."""
 
     def write_back(self):
         lookup = dict(self.layer.named_parameters())
@@ -549,13 +552,29 @@ class _PipelineTpTrainStep(_PipelineTrainStep):
                     lookup[name]._data = jax.device_get(v)
             elif k.startswith("stacked."):
                 stacked[k[len("stacked."):]] = jax.device_get(v)
-        for i in range(self._n_layers):
-            split_i = {rel: arr[i] for rel, arr in stacked.items()}
-            merged = self.layer.merge_block_params_tp(split_i)
-            for rel, arr in merged.items():
-                name = f"blocks.{i}.{rel}"
-                if name in lookup:
-                    lookup[name]._data = jnp.asarray(arr)
+        self._write_back_stacked(lookup, stacked)
         for k, v in self.state.items():
             if k in lookup:
                 lookup[k]._data = jax.device_get(v)
+
+    def _write_back_stacked(self, lookup, stacked):
+        for rel, arr in stacked.items():
+            for i in range(self._n_layers):
+                name = f"blocks.{i}.{rel}"
+                if name in lookup:
+                    lookup[name]._data = arr[i]
+
+
+class _PipelineTpTrainStep(_PipelineTrainStep):
+    """Pipeline layout with manual-tp split blocks: write_back merges the
+    split q/k/v back into the packed qkv params (layer protocol
+    merge_block_params_tp)."""
+
+    def _write_back_stacked(self, lookup, stacked):
+        for i in range(self._n_layers):
+            split_i = {rel: arr[i] for rel, arr in stacked.items()}
+            for rel, arr in self.layer.merge_block_params_tp(
+                    split_i).items():
+                name = f"blocks.{i}.{rel}"
+                if name in lookup:
+                    lookup[name]._data = arr

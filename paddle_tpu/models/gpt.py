@@ -319,10 +319,15 @@ class GPT(nn.Layer):
             "fc2.bias": P(axis_pp, None),
         }
 
-    def pipeline_block_fn_tp(self, axis_tp="tp"):
+    def pipeline_block_fn_tp(self, axis_tp="tp", compute_dtype=None):
         """block_fn for the manual-tp pipeline: local head-group attention
         + Megatron MLP with explicit psums over `axis_tp`. Operates on the
-        split layout from split_block_params_tp (local tp shards)."""
+        split layout from split_block_params_tp (local tp shards).
+
+        compute_dtype="bfloat16": matmul/einsum operands cast to bf16 (the
+        AMP-O1 treatment — raw jnp ops here bypass the autocast dispatcher
+        hook, so the cast must be explicit); LN stats, softmax and the
+        residual stream stay f32."""
         if self.cfg.dropout > 0:
             raise NotImplementedError(
                 "pipeline block with dropout > 0 unsupported (pure "
@@ -332,6 +337,13 @@ class GPT(nn.Layer):
         D = self.cfg.head_dim
         eps1 = self.blocks[0].ln1._epsilon
         eps2 = self.blocks[0].ln2._epsilon
+        cd = jnp.bfloat16 if compute_dtype in ("bfloat16", "bf16",
+                                               jnp.bfloat16) else None
+
+        def mm(a, w):
+            if cd is not None:
+                return (a.astype(cd) @ w.astype(cd)).astype(jnp.float32)
+            return a @ w
 
         def ln(x, g, b, eps):
             mu = x.mean(-1, keepdims=True)
@@ -341,9 +353,9 @@ class GPT(nn.Layer):
         def block_fn(bp, h):
             B, T, H = h.shape
             h1 = ln(h, bp["ln1.weight"], bp["ln1.bias"], eps1)
-            q = h1 @ bp["q_w"] + bp["q_b"]      # [B,T,H/ntp] local heads
-            k = h1 @ bp["k_w"] + bp["k_b"]
-            v = h1 @ bp["v_w"] + bp["v_b"]
+            q = mm(h1, bp["q_w"]) + bp["q_b"]   # [B,T,H/ntp] local heads
+            k = mm(h1, bp["k_w"]) + bp["k_b"]
+            v = mm(h1, bp["v_w"]) + bp["v_b"]
             nloc = q.shape[-1] // D
             q = q.reshape(B, T, nloc, D)
             k = k.reshape(B, T, nloc, D)
@@ -351,20 +363,23 @@ class GPT(nn.Layer):
             # causal attention on the local head group — same op order as
             # F.scaled_dot_product_attention's XLA core (attention.py
             # _sdpa_xla) so pp x tp matches the sequential loss closely
+            if cd is not None:
+                q, k, v = q.astype(cd), k.astype(cd), v.astype(cd)
             s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (1.0 / math.sqrt(D))
             s = s.astype(jnp.float32)
             causal = jnp.tril(jnp.ones((T, T), bool))
             s = jnp.where(causal[None, None], s, -1e30)
             p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
-            o = jnp.einsum("bhqk,bkhd->bqhd", p, v).reshape(B, T, -1)
+            o = jnp.einsum("bhqk,bkhd->bqhd", p, v) \
+                .reshape(B, T, -1).astype(jnp.float32)
             # row-parallel proj: partial sums meet across head groups
-            att = jax.lax.psum(o @ bp["attn.proj.weight"], axis_tp) \
+            att = jax.lax.psum(mm(o, bp["attn.proj.weight"]), axis_tp) \
                 + bp["attn.proj.bias"]
             h = h + att
             h2 = ln(h, bp["ln2.weight"], bp["ln2.bias"], eps2)
-            m = jax.nn.gelu(h2 @ bp["fc1.weight"] + bp["fc1.bias"],
+            m = jax.nn.gelu(mm(h2, bp["fc1.weight"]) + bp["fc1.bias"],
                             approximate=False)   # Block uses exact gelu
-            mo = jax.lax.psum(m @ bp["fc2.weight"], axis_tp) \
+            mo = jax.lax.psum(mm(m, bp["fc2.weight"]), axis_tp) \
                 + bp["fc2.bias"]
             return h + mo
 

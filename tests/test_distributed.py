@@ -348,6 +348,42 @@ def test_compiled_step_pipeline_x_tensor_parallel():
     assert err < 5e-3, err
 
 
+def test_compiled_step_pipeline_with_zero_slots():
+    """pipeline + sharding stage-2: optimizer slots shard over 'dp' on a
+    free dim while params keep the stacked-'pp' layout; ZeRO-3 refused."""
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.distributed.fleet.compiler import compile_train_step
+
+    m = _tiny_gpt()
+    s = DistributedStrategy()
+    s.pipeline = True
+    s.sharding = True
+    s.sharding_configs.stage = 2
+    s.hybrid_configs.pp_degree = 2
+    s.hybrid_configs.dp_degree = 4
+    s.pipeline_configs.accumulate_steps = 2
+    adam = opt.Adam(learning_rate=1e-3, parameters=list(m.parameters()))
+    prog = compile_train_step(m, adam, s)
+    ids = np.random.default_rng(0).integers(0, 512, (8, 16)) \
+        .astype(np.int64)
+    l = [float(jax.device_get(prog.step(ids, ids, lr=1e-3)))
+         for _ in range(3)]
+    assert l[-1] < l[0]
+    k = "stacked.fc1.weight"
+    assert prog.params[k].sharding.spec[0] == "pp"
+    assert "dp" in tuple(prog.opt_state[k]["moment1"].sharding.spec)
+
+    s3 = DistributedStrategy()
+    s3.pipeline = True
+    s3.sharding = True
+    s3.sharding_configs.stage = 3
+    s3.hybrid_configs.pp_degree = 2
+    m2 = _tiny_gpt()
+    adam2 = opt.Adam(learning_rate=1e-3, parameters=list(m2.parameters()))
+    with pytest.raises(NotImplementedError, match="ZeRO-3"):
+        compile_train_step(m2, adam2, s3)
+
+
 def test_pipeline_tp_requires_protocol_and_divisible_heads():
     import paddle_tpu.optimizer as opt
     from paddle_tpu.distributed.fleet.compiler import compile_train_step
