@@ -2,8 +2,60 @@
 + misc helpers. Reference: python/paddle/utils/ + the op_tester benchmark
 binary (operators/benchmark/op_tester.cc)."""
 from . import collective_bench  # noqa: F401
+from . import cpp_extension  # noqa: F401
 from . import custom_op  # noqa: F401
 from . import op_bench  # noqa: F401
 from .custom_op import register_op  # noqa: F401
 
-__all__ = ["op_bench", "collective_bench", "custom_op", "register_op"]
+__all__ = ["op_bench", "collective_bench", "custom_op", "register_op",
+           "run_check", "cpp_extension"]
+
+
+def run_check():
+    """paddle.utils.run_check parity (reference:
+    python/paddle/utils/install_check.py): verify the install by running
+    a small computation on the attached backend, with a grad and —
+    multi-device — a collective; prints a summary like the reference's
+    "PaddlePaddle is installed successfully!"."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+
+    devices = jax.devices()
+    platform = devices[0].platform
+    x = paddle.to_tensor(jnp.ones((4, 4)), stop_gradient=False)
+    y = (x @ x).sum()
+    y.backward()
+    if float(y) != 64.0 or x.grad is None:
+        raise RuntimeError(
+            f"run_check: matmul/grad verification failed on {platform} "
+            f"(got {float(y)}, grad {'set' if x.grad is not None else 'missing'})")
+    n = len(devices)
+    # collective check through the framework's OWN mesh/collective layer,
+    # single-process only (a process-local array cannot feed a mesh that
+    # spans hosts; multihost verification is the DCN bootstrap test's job)
+    if n > 1 and jax.process_count() == 1:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..distributed import collective as C
+        from ..distributed import mesh as mesh_mod
+        mesh = mesh_mod.build_mesh({"dp": n})
+        prev = mesh_mod.get_mesh()
+        mesh_mod.set_mesh(mesh)
+        try:
+            arr = jax.device_put(jnp.ones(n),
+                                 NamedSharding(mesh, P("dp")))
+            out = C.all_reduce(paddle.Tensor(arr), op=C.ReduceOp.SUM)
+            total = float(jnp.asarray(out._data)[0])
+        finally:
+            mesh_mod.set_mesh(prev)
+        if total != n:
+            raise RuntimeError(
+                f"run_check: all_reduce over {n} devices returned "
+                f"{total}, expected {n}")
+        print(f"paddle_tpu works on {n} {platform} devices "
+              f"(matmul+grad+all_reduce verified).")
+    else:
+        print(f"paddle_tpu is installed successfully! "
+              f"(matmul+grad verified on {platform})")
