@@ -27,10 +27,13 @@ def run_check():
     x = paddle.to_tensor(jnp.ones((4, 4)), stop_gradient=False)
     y = (x @ x).sum()
     y.backward()
-    if float(y) != 64.0 or x.grad is None:
+    grad_ok = (x.grad is not None
+               and bool(jnp.all(jnp.asarray(x.grad._data) == 8.0)))
+    if float(y) != 64.0 or not grad_ok:
         raise RuntimeError(
             f"run_check: matmul/grad verification failed on {platform} "
-            f"(got {float(y)}, grad {'set' if x.grad is not None else 'missing'})")
+            f"(y={float(y)}, expected 64.0; d(sum(x@x))/dx "
+            f"{'== 8 ok' if grad_ok else 'wrong or missing'})")
     n = len(devices)
     # collective check through the framework's OWN mesh/collective layer,
     # single-process only (a process-local array cannot feed a mesh that

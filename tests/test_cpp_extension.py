@@ -108,7 +108,3 @@ def test_kwargs_rejected(ext):
 def test_get_include_has_header():
     hdr = os.path.join(cpp_extension.get_include(), "paddle_ext.h")
     assert os.path.exists(hdr)
-
-
-def test_run_check():
-    paddle.utils.run_check()          # raises on any failure

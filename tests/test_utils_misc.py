@@ -1,0 +1,7 @@
+"""paddle.utils misc surface: install check (reference:
+python/paddle/utils/install_check.py run_check)."""
+import paddle_tpu as paddle
+
+
+def test_run_check():
+    paddle.utils.run_check()          # raises on any failure
