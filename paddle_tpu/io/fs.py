@@ -1,0 +1,135 @@
+"""Filesystem abstraction for checkpoint/artifact IO.
+
+Reference: the HDFS/local FS layer distributed checkpoints route through
+(/root/reference/python/paddle/distributed/fleet/utils/fs.py — FS base,
+LocalFS, HDFSClient with ls_dir/is_file/mkdirs/delete/mv/upload/download;
+C++ twin framework/io/fs.cc). On TPU deployments the remote store is
+GCS/NFS-fuse rather than HDFS; the abstraction stays so checkpoint code
+is store-agnostic:
+
+    fs = LocalFS()                       # or any FS subclass
+    fs.mkdirs(dir); fs.put(path, bytes); fs.get(path)
+    save_checkpoint(..., fs=...)         # io/checkpoint.py accepts one
+
+A GCSFS/HDFS client would subclass FS with the same verbs; none ships in
+this zero-egress build (mount the bucket via FUSE and use LocalFS — the
+standard TPU-VM pattern).
+"""
+from __future__ import annotations
+
+import os
+import shutil
+from typing import List
+
+__all__ = ["FS", "LocalFS", "sync_dir"]
+
+
+class FS:
+    """Store-agnostic verbs (reference FS base: fs.py:33)."""
+
+    def ls_dir(self, path) -> List[str]:
+        raise NotImplementedError
+
+    def is_file(self, path) -> bool:
+        raise NotImplementedError
+
+    def is_dir(self, path) -> bool:
+        raise NotImplementedError
+
+    def is_exist(self, path) -> bool:
+        raise NotImplementedError
+
+    def mkdirs(self, path):
+        raise NotImplementedError
+
+    def delete(self, path):
+        raise NotImplementedError
+
+    def mv(self, src, dst, overwrite=False):
+        raise NotImplementedError
+
+    def put(self, path, data: bytes):
+        """Write bytes atomically (publish-on-rename)."""
+        raise NotImplementedError
+
+    def get(self, path) -> bytes:
+        raise NotImplementedError
+
+    # reference API keeps distinct upload/download for remote stores;
+    # for byte-level stores they alias put/get of local files
+    def upload(self, local_path, remote_path):
+        with open(local_path, "rb") as f:
+            self.put(remote_path, f.read())
+
+    def download(self, remote_path, local_path):
+        d = os.path.dirname(local_path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(local_path, "wb") as f:
+            f.write(self.get(remote_path))
+
+    def touch(self, path):
+        self.put(path, b"")
+
+
+class LocalFS(FS):
+    """Local/NFS/FUSE-mounted filesystem (reference LocalFS fs.py:100)."""
+
+    def ls_dir(self, path):
+        if not os.path.isdir(path):
+            return []
+        return sorted(os.listdir(path))
+
+    def is_file(self, path):
+        return os.path.isfile(path)
+
+    def is_dir(self, path):
+        return os.path.isdir(path)
+
+    def is_exist(self, path):
+        return os.path.exists(path)
+
+    def mkdirs(self, path):
+        os.makedirs(path, exist_ok=True)
+
+    def delete(self, path):
+        if os.path.isdir(path):
+            shutil.rmtree(path)
+        elif os.path.exists(path):
+            os.unlink(path)
+
+    def mv(self, src, dst, overwrite=False):
+        if os.path.exists(dst):
+            if not overwrite:
+                raise FileExistsError(f"mv: {dst} exists")
+            self.delete(dst)
+        d = os.path.dirname(dst)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        shutil.move(src, dst)
+
+    def put(self, path, data):
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)          # atomic publish
+
+    def get(self, path):
+        with open(path, "rb") as f:
+            return f.read()
+
+
+def sync_dir(src_dir: str, dst_dir: str, fs: FS = None):
+    """Mirror a finished checkpoint directory into `dst_dir` through an FS
+    (reference: fleet checkpoint upload via HDFSClient). Files are
+    published atomically one by one; call after save_checkpoint returns."""
+    fs = fs or LocalFS()
+    local = LocalFS()
+    fs.mkdirs(dst_dir)
+    for name in local.ls_dir(src_dir):
+        p = os.path.join(src_dir, name)
+        if local.is_file(p):
+            fs.put(os.path.join(dst_dir, name), local.get(p))

@@ -1,0 +1,63 @@
+"""FS abstraction (reference: fleet/utils/fs.py LocalFS/HDFSClient verbs,
+framework/io/fs.cc)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import FS, LocalFS, sync_dir
+
+
+def test_localfs_verbs(tmp_path):
+    fs = LocalFS()
+    root = str(tmp_path / "a")
+    assert not fs.is_exist(root)
+    fs.mkdirs(root)
+    assert fs.is_dir(root) and fs.is_exist(root)
+    fs.put(os.path.join(root, "f.bin"), b"hello")
+    assert fs.is_file(os.path.join(root, "f.bin"))
+    assert fs.get(os.path.join(root, "f.bin")) == b"hello"
+    assert fs.ls_dir(root) == ["f.bin"]
+    # atomic publish leaves no .tmp behind
+    assert not fs.is_exist(os.path.join(root, "f.bin.tmp"))
+    fs.mv(os.path.join(root, "f.bin"), os.path.join(root, "g.bin"))
+    assert fs.ls_dir(root) == ["g.bin"]
+    with pytest.raises(FileExistsError):
+        fs.put(os.path.join(root, "h.bin"), b"x") or \
+            fs.mv(os.path.join(root, "h.bin"), os.path.join(root, "g.bin"))
+    fs.mv(os.path.join(root, "h.bin"), os.path.join(root, "g.bin"),
+          overwrite=True)
+    assert fs.get(os.path.join(root, "g.bin")) == b"x"
+    fs.touch(os.path.join(root, "empty"))
+    assert fs.get(os.path.join(root, "empty")) == b""
+    fs.delete(root)
+    assert not fs.is_exist(root)
+
+
+def test_upload_download(tmp_path):
+    fs = LocalFS()
+    src = str(tmp_path / "local.bin")
+    open(src, "wb").write(b"data")
+    remote = str(tmp_path / "remote" / "r.bin")
+    fs.upload(src, remote)
+    assert fs.get(remote) == b"data"
+    back = str(tmp_path / "back" / "b.bin")
+    fs.download(remote, back)
+    assert open(back, "rb").read() == b"data"
+
+
+def test_sync_checkpoint_dir(tmp_path):
+    """save_checkpoint -> sync_dir -> load from the mirrored location."""
+    import jax.numpy as jnp
+    from paddle_tpu.io.checkpoint import load_checkpoint, save_checkpoint
+
+    src = str(tmp_path / "ckpt")
+    params = {"w": jnp.arange(8.0).reshape(2, 4), "b": jnp.ones(4)}
+    save_checkpoint(src, params, step=3)
+    dst = str(tmp_path / "mounted_bucket" / "ckpt")
+    sync_dir(src, dst)
+    p2, _, _, step, _ = load_checkpoint(dst)
+    assert step == 3
+    np.testing.assert_array_equal(np.asarray(p2["w"]),
+                                  np.arange(8.0).reshape(2, 4))
