@@ -9,7 +9,7 @@ is store-agnostic:
 
     fs = LocalFS()                       # or any FS subclass
     fs.mkdirs(dir); fs.put(path, bytes); fs.get(path)
-    save_checkpoint(..., fs=...)         # io/checkpoint.py accepts one
+    sync_dir(ckpt_dir, mounted_bucket)   # mirror a finished checkpoint
 
 A GCSFS/HDFS client would subclass FS with the same verbs; none ships in
 this zero-egress build (mount the bucket via FUSE and use LocalFS — the
@@ -68,8 +68,19 @@ class FS:
         with open(local_path, "wb") as f:
             f.write(self.get(remote_path))
 
-    def touch(self, path):
+    def touch(self, path, exist_ok=True):
+        """Create an empty file; an existing file is left untouched when
+        exist_ok (reference LocalFS.touch semantics, fs.py:319)."""
+        if self.is_exist(path):
+            if exist_ok:
+                return
+            raise FileExistsError(f"touch: {path} exists")
         self.put(path, b"")
+
+    def put_file(self, local_src, path):
+        """Publish a local file to `path` (subclasses may stream)."""
+        with open(local_src, "rb") as f:
+            self.put(path, f.read())
 
 
 class LocalFS(FS):
@@ -99,23 +110,57 @@ class LocalFS(FS):
             os.unlink(path)
 
     def mv(self, src, dst, overwrite=False):
-        if os.path.exists(dst):
-            if not overwrite:
-                raise FileExistsError(f"mv: {dst} exists")
-            self.delete(dst)
+        if os.path.exists(dst) and not overwrite:
+            raise FileExistsError(f"mv: {dst} exists")
         d = os.path.dirname(dst)
         if d:
             os.makedirs(d, exist_ok=True)
-        shutil.move(src, dst)
+        if os.path.isfile(src) and not os.path.isdir(dst):
+            os.replace(src, dst)       # atomic, dst never absent
+            return
+        if os.path.exists(dst):
+            # directories: keep a valid dst at every instant — rename the
+            # old one aside, move the new in, then reclaim
+            aside = dst + ".old"
+            shutil.rmtree(aside, ignore_errors=True)
+            os.replace(dst, aside) if os.path.isfile(dst) else \
+                os.rename(dst, aside)
+            shutil.move(src, dst)
+            self.delete(aside)
+        else:
+            shutil.move(src, dst)
 
     def put(self, path, data):
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(data)
-        os.replace(tmp, path)          # atomic publish
+        import tempfile
+        fd, tmp = tempfile.mkstemp(dir=d or ".",
+                                   prefix=os.path.basename(path) + ".")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(data)
+            os.replace(tmp, path)      # atomic publish, unique tmp name
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+
+    def put_file(self, local_src, path):
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        import tempfile
+        fd, tmp = tempfile.mkstemp(dir=d or ".",
+                                   prefix=os.path.basename(path) + ".")
+        os.close(fd)
+        try:
+            shutil.copyfile(local_src, tmp)    # streams in chunks
+            os.replace(tmp, path)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
 
     def get(self, path):
         with open(path, "rb") as f:
@@ -124,12 +169,31 @@ class LocalFS(FS):
 
 def sync_dir(src_dir: str, dst_dir: str, fs: FS = None):
     """Mirror a finished checkpoint directory into `dst_dir` through an FS
-    (reference: fleet checkpoint upload via HDFSClient). Files are
-    published atomically one by one; call after save_checkpoint returns."""
+    (reference: fleet checkpoint upload via HDFSClient), recursively.
+
+    Publish order makes the mirror pollable: data files first, index.*
+    next, meta.json LAST — a reader that waits for meta.json never sees
+    an index pointing at missing shards. Each file streams through the
+    FS put_file path (no whole-file bytes in memory for LocalFS)."""
     fs = fs or LocalFS()
     local = LocalFS()
     fs.mkdirs(dst_dir)
+
+    files, subdirs = [], []
     for name in local.ls_dir(src_dir):
         p = os.path.join(src_dir, name)
-        if local.is_file(p):
-            fs.put(os.path.join(dst_dir, name), local.get(p))
+        (subdirs if local.is_dir(p) else files).append(name)
+    for name in subdirs:
+        sync_dir(os.path.join(src_dir, name),
+                 os.path.join(dst_dir, name), fs=fs)
+
+    def rank(name):
+        if name == "meta.json":
+            return 2
+        if name.startswith("index."):
+            return 1
+        return 0
+
+    for name in sorted(files, key=rank):
+        fs.put_file(os.path.join(src_dir, name),
+                    os.path.join(dst_dir, name))

@@ -23,14 +23,17 @@ def test_localfs_verbs(tmp_path):
     assert not fs.is_exist(os.path.join(root, "f.bin.tmp"))
     fs.mv(os.path.join(root, "f.bin"), os.path.join(root, "g.bin"))
     assert fs.ls_dir(root) == ["g.bin"]
+    fs.put(os.path.join(root, "h.bin"), b"x")
     with pytest.raises(FileExistsError):
-        fs.put(os.path.join(root, "h.bin"), b"x") or \
-            fs.mv(os.path.join(root, "h.bin"), os.path.join(root, "g.bin"))
+        fs.mv(os.path.join(root, "h.bin"), os.path.join(root, "g.bin"))
     fs.mv(os.path.join(root, "h.bin"), os.path.join(root, "g.bin"),
           overwrite=True)
     assert fs.get(os.path.join(root, "g.bin")) == b"x"
     fs.touch(os.path.join(root, "empty"))
     assert fs.get(os.path.join(root, "empty")) == b""
+    # touch preserves existing content (reference semantics)
+    fs.touch(os.path.join(root, "g.bin"))
+    assert fs.get(os.path.join(root, "g.bin")) == b"x"
     fs.delete(root)
     assert not fs.is_exist(root)
 
