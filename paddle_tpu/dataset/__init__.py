@@ -1,0 +1,99 @@
+"""paddle.dataset — the legacy dataset namespace (reference:
+python/paddle/dataset/: mnist, cifar, imdb, imikolov, uci_housing,
+movielens, conll05, wmt14 as per-module `train()/test()` generators).
+
+This build's datasets live in `paddle.vision.datasets` and `paddle.text`
+(zero-egress: local files or synthetic corpora); this namespace re-exposes
+them with the legacy module-per-dataset shape so `paddle.dataset.mnist
+.train()`-style code keeps working.
+"""
+from __future__ import annotations
+
+import types as _types
+
+__all__ = ["mnist", "cifar", "imdb", "imikolov", "uci_housing",
+           "movielens", "conll05", "wmt14"]
+
+
+def _reader_from(dataset_cls, **fixed):
+    """Legacy reader creator: returns a generator fn over (fields...) —
+    the reference's paddle.reader protocol."""
+    def creator(**kw):
+        ds = dataset_cls(**{**fixed, **kw})
+
+        def reader():
+            for i in range(len(ds)):
+                yield tuple(ds[i])
+        return reader
+    return creator
+
+
+def _module(name, dataset_cls, train_kw, test_kw):
+    import sys
+    m = _types.ModuleType(f"{__name__}.{name}")
+    m.train = _reader_from(dataset_cls, **train_kw)
+    m.test = _reader_from(dataset_cls, **test_kw)
+    # register so the canonical legacy form works:
+    #   import paddle_tpu.dataset.mnist
+    sys.modules[m.__name__] = m
+    return m
+
+
+def _vision_reader(dataset_cls, image_shape, num_classes, mode):
+    """Legacy creator for the vision sets: with local file paths use the
+    real dataset; without (zero-egress default, where the reference would
+    download) fall back to deterministic synthetic samples."""
+    from ..vision.datasets import FakeData
+
+    def creator(**kw):
+        if kw:                       # user supplied local files
+            ds = dataset_cls(mode=mode, **kw)
+        else:
+            # widely separated seeds: FakeData seeds per item with
+            # seed+idx, so adjacent split seeds would alias samples
+            ds = FakeData(num_samples=512, image_shape=image_shape,
+                          num_classes=num_classes,
+                          seed=0 if mode == "train" else 1_000_000)
+
+        def reader():
+            for i in range(len(ds)):
+                yield tuple(ds[i])
+        return reader
+    return creator
+
+
+def _vision_module(name, dataset_cls, image_shape, num_classes):
+    import sys
+    m = _types.ModuleType(f"{__name__}.{name}")
+    m.train = _vision_reader(dataset_cls, image_shape, num_classes, "train")
+    m.test = _vision_reader(dataset_cls, image_shape, num_classes, "test")
+    sys.modules[m.__name__] = m
+    return m
+
+
+def _build():
+    from ..text import (Conll05st, Imdb, Imikolov, Movielens, UCIHousing,
+                        WMT14)
+    from ..vision.datasets import MNIST, Cifar10
+
+    mods = {
+        "mnist": _vision_module("mnist", MNIST, (1, 28, 28), 10),
+        "cifar": _vision_module("cifar", Cifar10, (3, 32, 32), 10),
+        "imdb": _module("imdb", Imdb,
+                        {"mode": "train"}, {"mode": "test"}),
+        "imikolov": _module("imikolov", Imikolov,
+                            {"mode": "train"}, {"mode": "test"}),
+        "uci_housing": _module("uci_housing", UCIHousing,
+                               {"mode": "train"}, {"mode": "test"}),
+        "movielens": _module("movielens", Movielens,
+                             {"mode": "train"}, {"mode": "test"}),
+        "conll05": _module("conll05", Conll05st,
+                   {"mode": "train"}, {"mode": "test"}),
+        "wmt14": _module("wmt14", WMT14,
+                         {"mode": "train"}, {"mode": "test"}),
+    }
+    return mods
+
+
+_mods = _build()
+globals().update(_mods)

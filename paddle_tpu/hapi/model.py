@@ -207,13 +207,17 @@ class Model:
                     return net.named_buffers(*a, **k)
 
                 _FORWARDED = ("param_shardings",
-                              "pipeline_split_params", "pipeline_fns")
+                              "pipeline_split_params", "pipeline_fns",
+                              # manual-tp pipeline protocol (pp x tp)
+                              "split_block_params_tp", "block_tp_specs",
+                              "pipeline_block_fn_tp",
+                              "merge_block_params_tp", "cfg")
 
                 def __getattr__(self, name):
                     # expose the network's sharding/pipeline protocols to
                     # the compiler only when the network implements them
-                    if name in self._FORWARDED and callable(
-                            getattr(net, name, None)):
+                    if name in self._FORWARDED and \
+                            getattr(net, name, None) is not None:
                         return getattr(net, name)
                     raise AttributeError(name)
 
