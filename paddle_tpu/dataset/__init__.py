@@ -17,8 +17,12 @@ __all__ = ["mnist", "cifar", "imdb", "imikolov", "uci_housing",
 
 def _reader_from(dataset_cls, **fixed):
     """Legacy reader creator: returns a generator fn over (fields...) —
-    the reference's paddle.reader protocol."""
-    def creator(**kw):
+    the reference's paddle.reader protocol. Positional args (the
+    reference creators take vocab dicts / sizes, e.g. imdb.train(word_idx),
+    imikolov.train(word_idx, n), wmt14.train(dict_size)) are accepted for
+    signature compatibility and ignored: the zero-egress datasets build
+    their own synthetic vocabularies."""
+    def creator(*_legacy_args, **kw):
         ds = dataset_cls(**{**fixed, **kw})
 
         def reader():
@@ -45,7 +49,7 @@ def _vision_reader(dataset_cls, image_shape, num_classes, mode):
     download) fall back to deterministic synthetic samples."""
     from ..vision.datasets import FakeData
 
-    def creator(**kw):
+    def creator(*_legacy_args, **kw):
         if kw:                       # user supplied local files
             ds = dataset_cls(mode=mode, **kw)
         else:
@@ -71,14 +75,31 @@ def _vision_module(name, dataset_cls, image_shape, num_classes):
     return m
 
 
+def _cifar_module():
+    """The reference cifar module's surface is train10/test10/train100/
+    test100 (python/paddle/dataset/cifar.py); train/test alias the -10
+    variants for convenience."""
+    import sys
+
+    from ..vision.datasets import Cifar10, Cifar100
+    m = _types.ModuleType(f"{__name__}.cifar")
+    m.train10 = _vision_reader(Cifar10, (3, 32, 32), 10, "train")
+    m.test10 = _vision_reader(Cifar10, (3, 32, 32), 10, "test")
+    m.train100 = _vision_reader(Cifar100, (3, 32, 32), 100, "train")
+    m.test100 = _vision_reader(Cifar100, (3, 32, 32), 100, "test")
+    m.train, m.test = m.train10, m.test10
+    sys.modules[m.__name__] = m
+    return m
+
+
 def _build():
     from ..text import (Conll05st, Imdb, Imikolov, Movielens, UCIHousing,
                         WMT14)
-    from ..vision.datasets import MNIST, Cifar10
+    from ..vision.datasets import MNIST
 
     mods = {
         "mnist": _vision_module("mnist", MNIST, (1, 28, 28), 10),
-        "cifar": _vision_module("cifar", Cifar10, (3, 32, 32), 10),
+        "cifar": _cifar_module(),
         "imdb": _module("imdb", Imdb,
                         {"mode": "train"}, {"mode": "test"}),
         "imikolov": _module("imikolov", Imikolov,

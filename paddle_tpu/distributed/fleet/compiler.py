@@ -314,7 +314,8 @@ def _claim_free_dim(spec, shape, axis, n):
     return spec
 
 
-def _check_pipeline_compat(strategy, mesh, what="pipeline"):
+def _check_pipeline_compat(strategy, mesh, what="pipeline",
+                           allow_sp=False):
     if strategy.sharding and strategy.sharding_stage() >= 3:
         raise NotImplementedError(
             f"{what} + ZeRO-3 is not supported: stage-3 param sharding "
@@ -327,16 +328,21 @@ def _check_pipeline_compat(strategy, mesh, what="pipeline"):
             f"{what} already microbatches via "
             "pipeline_configs.accumulate_steps; gradient_merge on top is "
             "not supported — fold k_steps into accumulate_steps")
-    if int(mesh.shape.get("sp", 1)) > 1 or int(mesh.shape.get("ep", 1)) > 1:
+    if int(mesh.shape.get("sp", 1)) > 1 and not allow_sp:
         raise NotImplementedError(
-            f"{what} + sequence/expert parallel in one mesh is not "
-            "supported yet; the pipeline shard_map region would need the "
-            "sp/ep collectives inserted manually")
+            f"{what} + sequence parallel needs the layer's "
+            "pipeline_block_fn_sp protocol (models/gpt.py provides it)")
+    if int(mesh.shape.get("ep", 1)) > 1:
+        raise NotImplementedError(
+            f"{what} + expert parallel in one mesh is not supported yet; "
+            "the pipeline shard_map region would need the ep collectives "
+            "inserted manually")
 
 
 def _build_pipeline_program(layer, optimizer, strategy, mesh, *, block_fn,
                             embed_fn, head_loss_fn, ep, hp, stacked,
-                            n_layers, stacked_pspec, prog_cls):
+                            n_layers, stacked_pspec, prog_cls,
+                            seq_axis=None):
     """The machinery both pipeline branches share: flat param assembly
     (embed.* / head.* / stacked.*), shardings, the microbatched
     global-masked-mean loss, jit wiring and program construction. The
@@ -389,7 +395,8 @@ def _build_pipeline_program(layer, optimizer, strategy, mesh, *, block_fn,
         block_fn, n_pp, n_micro, mesh, axis="pp",
         batch_axis="dp" if n_dp > 1 else None,
         param_specs={k[len("stacked."):]: v for k, v in pspecs.items()
-                     if k.startswith("stacked.")})
+                     if k.startswith("stacked.")},
+        seq_axis=seq_axis)
 
     def _sub(p, prefix):
         cut = len(prefix)
@@ -457,10 +464,17 @@ def _compile_pipeline_step(layer, optimizer, strategy, mesh):
     from ..pipeline import stack_stage_params
 
     n_tp = int(mesh.shape.get("tp", 1))
+    n_sp = int(mesh.shape.get("sp", 1))
     if n_tp > 1:
+        if n_sp > 1:
+            raise NotImplementedError(
+                "pipeline + tp + sp in one mesh is not supported; pick "
+                "two of the three")
         return _compile_pipeline_tp_step(layer, optimizer, strategy, mesh,
                                          n_tp)
-    _check_pipeline_compat(strategy, mesh)
+    sp_block = getattr(layer, "pipeline_block_fn_sp", None)
+    _check_pipeline_compat(strategy, mesh,
+                           allow_sp=callable(sp_block))
     split = getattr(layer, "pipeline_split_params", None)
     fns = getattr(layer, "pipeline_fns", None)
     if not (callable(split) and callable(fns)):
@@ -476,13 +490,21 @@ def _compile_pipeline_step(layer, optimizer, strategy, mesh):
         raise ValueError(f"{len(blocks_list)} blocks not divisible by "
                          f"pp={n_pp}")
     embed_fn, block_fn, head_loss_fn = fns()
+    if n_sp > 1:
+        # pp x sp: blocks see local sequence shards; attention is the
+        # shard_map-inner ring/Ulysses (the sp collectives live in the
+        # block, the pipeline just also shards the data's seq dim)
+        block_fn = sp_block(
+            axis_sp="sp", impl=strategy.sequence_parallel_impl,
+            compute_dtype="bfloat16" if strategy.amp else None)
     return _build_pipeline_program(
         layer, optimizer, strategy, mesh, block_fn=block_fn,
         embed_fn=embed_fn, head_loss_fn=head_loss_fn, ep=ep, hp=hp,
         stacked=stack_stage_params(blocks_list),
         n_layers=len(blocks_list),
         stacked_pspec=lambda rel, v: P("pp", *([None] * (v.ndim - 1))),
-        prog_cls=_PipelineTrainStep)
+        prog_cls=_PipelineTrainStep,
+        seq_axis="sp" if n_sp > 1 else None)
 
 
 def _compile_pipeline_tp_step(layer, optimizer, strategy, mesh, n_tp):

@@ -41,7 +41,7 @@ def stack_stage_params(block_params_list):
 
 def pipeline_spmd(block_fn: Callable, n_stages: int, n_micro: int,
                   mesh, axis: str = "pp", batch_axis: str = None,
-                  param_specs=None):
+                  param_specs=None, seq_axis: str = None):
     """Build pipelined_fn(stacked_params, x_micro) -> y_micro.
 
     block_fn(params_one_layer, x) -> x          (one transformer block)
@@ -106,6 +106,11 @@ def pipeline_spmd(block_fn: Callable, n_stages: int, n_micro: int,
         dspec = [None] * nd_x
         if batch_axis is not None:
             dspec[1] = batch_axis
+        if seq_axis is not None:
+            # sequence parallel: activations enter the pipeline as local
+            # [.., T/sp, ..] shards; block_fn owns the sp collectives
+            # (ring/Ulysses attention)
+            dspec[2] = seq_axis
         dspec = P(*dspec)
         # default: params sharded over 'pp' only; a caller doing manual
         # tensor parallelism inside block_fn (models/gpt.py

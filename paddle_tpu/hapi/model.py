@@ -211,7 +211,8 @@ class Model:
                               # manual-tp pipeline protocol (pp x tp)
                               "split_block_params_tp", "block_tp_specs",
                               "pipeline_block_fn_tp",
-                              "merge_block_params_tp", "cfg")
+                              "merge_block_params_tp",
+                              "pipeline_block_fn_sp", "cfg")
 
                 def __getattr__(self, name):
                     # expose the network's sharding/pipeline protocols to

@@ -67,6 +67,23 @@ def gpt3_1p3b(**kw):
     return GPTConfig(hidden=2048, layers=24, heads=16, max_seq_len=2048, **kw)
 
 
+
+def _pp_mm(cd):
+    """Matmul helper for the hand-written pipeline blocks: bf16 operands
+    when cd is set (AMP), f32 accumulate/output."""
+    def mm(a, w):
+        if cd is not None:
+            return (a.astype(cd) @ w.astype(cd)).astype(jnp.float32)
+        return a @ w
+    return mm
+
+
+def _pp_ln(x, g, b, eps):
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) / jnp.sqrt(var + eps) * g + b
+
+
 class CausalSelfAttention(nn.Layer):
     def __init__(self, cfg: GPTConfig):
         super().__init__()
@@ -339,16 +356,7 @@ class GPT(nn.Layer):
         eps2 = self.blocks[0].ln2._epsilon
         cd = jnp.bfloat16 if compute_dtype in ("bfloat16", "bf16",
                                                jnp.bfloat16) else None
-
-        def mm(a, w):
-            if cd is not None:
-                return (a.astype(cd) @ w.astype(cd)).astype(jnp.float32)
-            return a @ w
-
-        def ln(x, g, b, eps):
-            mu = x.mean(-1, keepdims=True)
-            var = ((x - mu) ** 2).mean(-1, keepdims=True)
-            return (x - mu) / jnp.sqrt(var + eps) * g + b
+        mm, ln = _pp_mm(cd), _pp_ln
 
         def block_fn(bp, h):
             B, T, H = h.shape
@@ -382,6 +390,56 @@ class GPT(nn.Layer):
             mo = jax.lax.psum(mm(m, bp["fc2.weight"]), axis_tp) \
                 + bp["fc2.bias"]
             return h + mo
+
+        return block_fn
+
+
+    def pipeline_block_fn_sp(self, axis_sp="sp", impl="ring",
+                             compute_dtype=None):
+        """block_fn for the pipeline x sequence-parallel mesh: the block
+        sees the LOCAL sequence shard [B, T/sp, C]; attention runs as
+        ring attention (K/V rotation over `axis_sp`) or Ulysses — both
+        shard_map-inner (distributed/sequence_parallel.py), which is what
+        the pipeline's all-manual region requires. LN/MLP are sequence-
+        elementwise, so they need no collectives at all."""
+        if self.cfg.dropout > 0:
+            raise NotImplementedError(
+                "pipeline block with dropout > 0 unsupported")
+        if self.cfg.moe_experts > 0:
+            raise NotImplementedError("pipeline+sp with MoE unsupported")
+        from ..distributed.sequence_parallel import (ring_attention,
+                                                     ulysses_attention)
+        impls = {"ring": ring_attention, "ulysses": ulysses_attention}
+        if impl not in impls:
+            raise ValueError(
+                f"sequence_parallel impl must be 'ring' or 'ulysses', "
+                f"got {impl!r}")
+        attn_impl = impls[impl]
+        D = self.cfg.head_dim
+        eps1 = self.blocks[0].ln1._epsilon
+        eps2 = self.blocks[0].ln2._epsilon
+        cd = jnp.bfloat16 if compute_dtype in ("bfloat16", "bf16",
+                                               jnp.bfloat16) else None
+        mm, ln = _pp_mm(cd), _pp_ln
+
+        def block_fn(bp, h):
+            B, Tl, H = h.shape
+            h1 = ln(h, bp["ln1.weight"], bp["ln1.bias"], eps1)
+            qkv = mm(h1, bp["attn.qkv.weight"]) + bp["attn.qkv.bias"]
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            nh = H // D
+            q = q.reshape(B, Tl, nh, D)
+            k = k.reshape(B, Tl, nh, D)
+            v = v.reshape(B, Tl, nh, D)
+            if cd is not None:
+                q, k, v = q.astype(cd), k.astype(cd), v.astype(cd)
+            o = attn_impl(q, k, v, axis=axis_sp, causal=True)
+            o = o.reshape(B, Tl, H).astype(jnp.float32)
+            h = h + mm(o, bp["attn.proj.weight"]) + bp["attn.proj.bias"]
+            h2 = ln(h, bp["ln2.weight"], bp["ln2.bias"], eps2)
+            m = jax.nn.gelu(mm(h2, bp["fc1.weight"]) + bp["fc1.bias"],
+                            approximate=False)
+            return h + mm(m, bp["fc2.weight"]) + bp["fc2.bias"]
 
         return block_fn
 
