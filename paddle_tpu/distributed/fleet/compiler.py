@@ -494,6 +494,13 @@ def _compile_pipeline_step(layer, optimizer, strategy, mesh):
         # pp x sp: blocks see local sequence shards; attention is the
         # shard_map-inner ring/Ulysses (the sp collectives live in the
         # block, the pipeline just also shards the data's seq dim)
+        heads = getattr(getattr(layer, "cfg", None), "heads", None)
+        if (strategy.sequence_parallel_impl == "ulysses"
+                and heads is not None and heads % n_sp):
+            raise ValueError(
+                f"pipeline + ulysses: {heads} attention heads not "
+                f"divisible by sp={n_sp} (use impl='ring' or adjust "
+                f"sep_degree)")
         block_fn = sp_block(
             axis_sp="sp", impl=strategy.sequence_parallel_impl,
             compute_dtype="bfloat16" if strategy.amp else None)

@@ -8,6 +8,8 @@ from ..core import dtype as dtype_mod
 from ..core.tensor import Tensor, apply
 
 __all__ = [
+    "create_array", "array_length", "array_read", "array_write",
+    "set_printoptions", "to_string",
     "zeros", "ones", "full", "zeros_like", "ones_like", "full_like",
     "arange", "linspace", "logspace", "eye", "empty", "empty_like", "tril",
     "triu", "diag", "diagflat", "meshgrid", "assign", "clone", "numel",
@@ -156,3 +158,61 @@ def as_tensor(data, dtype=None, place=None):
 
 
 import jax  # noqa: E402  (used by complex_)
+
+
+# -- TensorArray surface (reference: tensor/array.py create_array/
+# array_read/array_write/array_length over LoDTensorArray; static control
+# flow stored arrays in Scope — here a plain Python list is the honest
+# dygraph-parity container) ------------------------------------------------
+
+def create_array(dtype="float32", initialized_list=None):
+    arr = list(initialized_list) if initialized_list is not None else []
+    for v in arr:
+        if not isinstance(v, Tensor):
+            raise TypeError("create_array initialized_list must hold "
+                            f"Tensors, got {type(v)}")
+    return arr
+
+
+def array_length(array):
+    return len(array)
+
+
+def array_read(array, i):
+    return array[int(i)]
+
+
+def array_write(x, i, array=None):
+    if array is None:
+        array = []
+    i = int(i)
+    if i < len(array):
+        array[i] = x
+    elif i == len(array):
+        array.append(x)
+    else:
+        raise IndexError(f"array_write index {i} beyond length "
+                         f"{len(array)}")
+    return array
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """numpy-backed print options (reference tensor/to_string.py)."""
+    import numpy as np
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+def to_string(x):
+    return repr(x)

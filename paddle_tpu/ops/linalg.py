@@ -10,7 +10,7 @@ from ..core.flags import get_flags
 from ..core.tensor import Tensor, apply
 
 __all__ = [
-    "matmul", "mm", "bmm", "dot", "t", "norm", "dist", "cholesky", "inv",
+    "matmul", "mm", "bmm", "dot", "t", "norm", "dist", "cholesky", "inv", "inverse",
     "det", "slogdet", "svd", "qr", "eig", "eigh", "eigvals", "eigvalsh",
     "solve", "triangular_solve", "cholesky_solve", "matrix_power", "pinv",
     "cross", "histogram", "bincount", "mv", "matrix_rank", "lu", "lstsq",
@@ -233,3 +233,6 @@ def corrcoef(x, rowvar=True, name=None):
 
 def rank(input, name=None):
     return Tensor(jnp.asarray(input.ndim, jnp.int32))
+
+
+inverse = inv    # reference alias (tensor/linalg.py inverse)

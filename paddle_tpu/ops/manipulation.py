@@ -13,7 +13,7 @@ from ..core import dtype as dtype_mod
 from ..core.tensor import Tensor, apply
 
 __all__ = [
-    "reshape", "reshape_", "flatten", "squeeze", "unsqueeze", "transpose",
+    "reshape", "reshape_", "flatten", "squeeze", "squeeze_", "unsqueeze_", "scatter_", "unsqueeze", "transpose",
     "concat", "stack", "split", "chunk", "tile", "expand", "expand_as",
     "broadcast_to", "broadcast_shape", "flip", "reverse", "roll", "gather",
     "gather_nd", "scatter", "scatter_nd", "scatter_nd_add", "index_select",
@@ -415,3 +415,20 @@ def atleast_3d(*xs):
 
 def stride_check(*_a, **_k):
     raise NotImplementedError("strides are not observable under XLA")
+
+
+def _inplace_from(x, out):
+    x._data, x._node, x._out_idx = out._data, out._node, out._out_idx
+    return x
+
+
+def squeeze_(x, axis=None, name=None):
+    return _inplace_from(x, squeeze(x, axis))
+
+
+def unsqueeze_(x, axis, name=None):
+    return _inplace_from(x, unsqueeze(x, axis))
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    return _inplace_from(x, scatter(x, index, updates, overwrite=overwrite))

@@ -13,7 +13,7 @@ __all__ = [
     "mod", "floor_mod", "pow", "sqrt", "rsqrt", "square", "exp", "expm1",
     "log", "log2", "log10", "log1p", "abs", "neg", "sign", "floor", "ceil",
     "round", "trunc", "sin", "cos", "tan", "asin", "acos", "atan", "sinh",
-    "cosh", "tanh", "asinh", "acosh", "atanh", "reciprocal", "clip",
+    "cosh", "tanh", "tanh_", "addmm", "all", "any", "asinh", "acosh", "atanh", "reciprocal", "clip",
     "maximum", "minimum", "fmax", "fmin", "max", "min", "amax", "amin",
     "sum", "nansum", "mean", "nanmean", "prod", "cumsum", "cumprod",
     "logsumexp", "logcumsumexp", "add_n", "scale", "stanh", "erf", "erfinv",
@@ -276,3 +276,25 @@ def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
 
 def count_nonzero(x, axis=None, keepdim=False, name=None):
     return apply(lambda a: jnp.count_nonzero(a, axis=_axis(axis), keepdims=keepdim).astype(jnp.int64), x)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta*input + alpha*(x @ y) (reference tensor/math.py addmm)."""
+    return apply(lambda i, a, b: beta * i + alpha * (a @ b), input, x, y,
+                 op_name="addmm")
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.all(a, axis=_axis(axis), keepdims=keepdim),
+                 x, op_name="all")
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.any(a, axis=_axis(axis), keepdims=keepdim),
+                 x, op_name="any")
+
+
+def tanh_(x, name=None):
+    """In-place surface over tanh (reference inplace-op pair tanh_)."""
+    from .manipulation import _inplace_from
+    return _inplace_from(x, tanh(x))

@@ -15,7 +15,7 @@ from ..core.tensor import Tensor, apply
 
 __all__ = [
     "rand", "randn", "randint", "randint_like", "randperm", "uniform",
-    "uniform_", "normal", "standard_normal", "bernoulli", "multinomial",
+    "uniform_", "normal", "standard_normal", "gaussian", "bernoulli", "multinomial",
     "poisson", "exponential_",
 ]
 
@@ -121,3 +121,9 @@ def uniform_(x, min=-1.0, max=1.0, seed=0, name=None, key=None):
     k = jax.random.key(seed) if seed else _key(key)
     x.set_value(jax.random.uniform(k, tuple(x.shape), x.dtype, min, max))
     return x
+
+
+def gaussian(shape, mean=0.0, std=1.0, dtype=None, name=None, key=None):
+    """reference tensor/random.py gaussian: N(mean, std) samples."""
+    out = standard_normal(shape, dtype=dtype, key=key)
+    return apply(lambda a: a * std + mean, out, op_name="gaussian")

@@ -422,3 +422,57 @@ def test_complex_family():
     np.testing.assert_allclose(c2.numpy(), re + 1j * im, rtol=1e-6)
     back = paddle.as_real(c2)
     assert_close(back, pair)
+
+
+def test_reference_surface_completions():
+    """The last reference tensor-API rows (audited against
+    python/paddle/tensor __all__): addmm/all/any/gaussian/inverse/
+    TensorArray/inplace variants/print options."""
+    t = T(np.eye(2, dtype=np.float32))
+    assert_close(paddle.addmm(t, t, t, beta=1.0, alpha=2.0),
+                 np.eye(2) + 2 * np.eye(2))
+    assert bool(paddle.all(T(np.array([True, True]))))
+    assert not bool(paddle.all(T(np.array([True, False]))))
+    assert bool(paddle.any(T(np.array([False, True]))))
+    assert_close(paddle.all(T(np.array([[True, False], [True, True]])),
+                            axis=1), [False, True])
+    assert_close(paddle.inverse(t), np.eye(2))
+    g = paddle.gaussian([4000], mean=3.0, std=0.5).numpy()
+    assert 2.9 < g.mean() < 3.1 and 0.4 < g.std() < 0.6
+
+    # in-place variants rebind the same Tensor object
+    x = T(np.float32([0.5]))
+    y = paddle.tanh_(x)
+    assert y is x
+    assert_close(x, np.tanh(np.float32([0.5])), atol=1e-5)
+    x2 = T(U(-1, 1, (1, 2, 3)))
+    assert paddle.squeeze_(x2, 0) is x2 and x2.shape == [2, 3]
+    assert paddle.unsqueeze_(x2, 0) is x2 and x2.shape == [1, 2, 3]
+    x3 = T(np.zeros((3, 2), np.float32))
+    paddle.scatter_(x3, T(np.array([1], np.int64)),
+                    T(np.ones((1, 2), np.float32)))
+    assert_close(x3, [[0, 0], [1, 1], [0, 0]])
+
+
+def test_tensor_array_surface():
+    arr = paddle.create_array()
+    a = T(np.float32([1.0]))
+    b = T(np.float32([2.0]))
+    paddle.array_write(a, 0, arr)
+    paddle.array_write(b, 1, arr)
+    assert paddle.array_length(arr) == 2
+    assert paddle.array_read(arr, 0) is a
+    paddle.array_write(b, 0, arr)          # overwrite
+    assert paddle.array_read(arr, 0) is b
+    with pytest.raises(IndexError):
+        paddle.array_write(a, 5, arr)
+    with pytest.raises(TypeError):
+        paddle.create_array(initialized_list=[1.0])
+    assert isinstance(paddle.to_string(a), str)
+    import numpy as _np
+    saved = _np.get_printoptions()
+    try:
+        paddle.set_printoptions(precision=3)
+        assert _np.get_printoptions()["precision"] == 3
+    finally:
+        _np.set_printoptions(**saved)
