@@ -315,7 +315,7 @@ def _claim_free_dim(spec, shape, axis, n):
 
 
 def _check_pipeline_compat(strategy, mesh, what="pipeline",
-                           allow_sp=False):
+                           allow_sp=False, allow_ep=False):
     if strategy.sharding and strategy.sharding_stage() >= 3:
         raise NotImplementedError(
             f"{what} + ZeRO-3 is not supported: stage-3 param sharding "
@@ -332,11 +332,11 @@ def _check_pipeline_compat(strategy, mesh, what="pipeline",
         raise NotImplementedError(
             f"{what} + sequence parallel needs the layer's "
             "pipeline_block_fn_sp protocol (models/gpt.py provides it)")
-    if int(mesh.shape.get("ep", 1)) > 1:
+    if int(mesh.shape.get("ep", 1)) > 1 and not allow_ep:
         raise NotImplementedError(
-            f"{what} + expert parallel in one mesh is not supported yet; "
-            "the pipeline shard_map region would need the ep collectives "
-            "inserted manually")
+            f"{what} + expert parallel needs the layer's "
+            "pipeline_block_fn_ep protocol (models/gpt.py provides it "
+            "for MoE configs)")
 
 
 def _build_pipeline_program(layer, optimizer, strategy, mesh, *, block_fn,
@@ -472,9 +472,15 @@ def _compile_pipeline_step(layer, optimizer, strategy, mesh):
                 "two of the three")
         return _compile_pipeline_tp_step(layer, optimizer, strategy, mesh,
                                          n_tp)
+    n_ep = int(mesh.shape.get("ep", 1))
+    if n_sp > 1 and n_ep > 1:
+        raise NotImplementedError(
+            "pipeline + sp + ep in one mesh is not supported; pick two")
     sp_block = getattr(layer, "pipeline_block_fn_sp", None)
+    ep_block = getattr(layer, "pipeline_block_fn_ep", None)
     _check_pipeline_compat(strategy, mesh,
-                           allow_sp=callable(sp_block))
+                           allow_sp=callable(sp_block),
+                           allow_ep=callable(ep_block))
     split = getattr(layer, "pipeline_split_params", None)
     fns = getattr(layer, "pipeline_fns", None)
     if not (callable(split) and callable(fns)):
@@ -490,6 +496,37 @@ def _compile_pipeline_step(layer, optimizer, strategy, mesh):
         raise ValueError(f"{len(blocks_list)} blocks not divisible by "
                          f"pp={n_pp}")
     embed_fn, block_fn, head_loss_fn = fns()
+    if n_ep > 1:
+        # pp x ep: activations replicate over 'ep'; each member runs its
+        # local expert slab and one psum sums contributions (manual form
+        # of the GSPMD einsum dispatch). Stacked expert banks shard their
+        # E dim over 'ep' via the layer's block_ep_specs.
+        experts = getattr(getattr(layer, "cfg", None), "moe_experts", None)
+        if experts is not None and experts % n_ep:
+            raise ValueError(f"{experts} experts not divisible by "
+                             f"ep={n_ep}")
+        import warnings
+        warnings.warn(
+            "pipeline + expert parallel: the Switch load-balance aux "
+            "loss is not propagated on the pipeline path (see "
+            "pipeline_block_fn_ep); routing is unregularized")
+        block_fn = ep_block(
+            axis_ep="ep",
+            compute_dtype="bfloat16" if strategy.amp else None)
+        ep_specs = layer.block_ep_specs(axis_pp="pp", axis_ep="ep")
+
+        def ep_pspec(rel, v):
+            spec = ep_specs.get(rel)
+            if spec is None:
+                raise KeyError(f"block_ep_specs missing {rel!r}")
+            return spec
+
+        return _build_pipeline_program(
+            layer, optimizer, strategy, mesh, block_fn=block_fn,
+            embed_fn=embed_fn, head_loss_fn=head_loss_fn, ep=ep, hp=hp,
+            stacked=stack_stage_params(blocks_list),
+            n_layers=len(blocks_list), stacked_pspec=ep_pspec,
+            prog_cls=_PipelineTrainStep)
     if n_sp > 1:
         # pp x sp: blocks see local sequence shards; attention is the
         # shard_map-inner ring/Ulysses (the sp collectives live in the

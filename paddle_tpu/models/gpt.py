@@ -444,6 +444,123 @@ class GPT(nn.Layer):
         return block_fn
 
 
+    @staticmethod
+    def block_ep_specs(axis_pp="pp", axis_ep="ep"):
+        """Stacked-layout PartitionSpecs for a MoE block under manual
+        expert parallelism: expert banks shard their E dim over 'ep',
+        everything else replicates (attention is untouched by ep)."""
+        from jax.sharding import PartitionSpec as P
+
+        def expert(ndim):
+            return P(axis_pp, axis_ep, *([None] * (ndim - 2)))
+
+        return {
+            "ln1.weight": P(axis_pp, None), "ln1.bias": P(axis_pp, None),
+            "ln2.weight": P(axis_pp, None), "ln2.bias": P(axis_pp, None),
+            "attn.qkv.weight": P(axis_pp, None, None),
+            "attn.qkv.bias": P(axis_pp, None),
+            "attn.proj.weight": P(axis_pp, None, None),
+            "attn.proj.bias": P(axis_pp, None),
+            "moe.gate_w": P(axis_pp, None, None),
+            "moe.w_in": expert(4),   # [L, E, M, H]
+            "moe.b_in": expert(3),
+            "moe.w_out": expert(4),
+            "moe.b_out": expert(3),
+        }
+
+    def pipeline_block_fn_ep(self, axis_ep="ep", compute_dtype=None):
+        """block_fn for pipeline x expert parallelism: activations are
+        REPLICATED across 'ep' members, each member runs only its local
+        expert slab (E/n_ep experts of the stacked bank), and one psum
+        over 'ep' sums the per-expert contributions — the manual form of
+        the GSPMD einsum dispatch in nn/layer/moe.py.
+
+        Limitation (documented, loud): the Switch load-balance aux loss
+        is NOT propagated on the pipeline path (per-block scalars cannot
+        ride the ppermute ring without widening the carried activation);
+        routing still uses softmax top-k, but expert collapse pressure is
+        unregularized — prefer ep x dp (non-pipeline) for long MoE runs."""
+        if self.cfg.moe_experts <= 0:
+            raise ValueError("pipeline_block_fn_ep requires a MoE config "
+                             "(GPTConfig.moe_experts > 0)")
+        if self.cfg.dropout > 0:
+            raise NotImplementedError(
+                "pipeline block with dropout > 0 unsupported")
+        D = self.cfg.head_dim
+        E = self.cfg.moe_experts
+        K = self.cfg.moe_top_k
+        cap_f = self.blocks[0].moe.capacity_factor
+        eps1 = self.blocks[0].ln1._epsilon
+        eps2 = self.blocks[0].ln2._epsilon
+        cd = jnp.bfloat16 if compute_dtype in ("bfloat16", "bf16",
+                                               jnp.bfloat16) else None
+        mm, ln = _pp_mm(cd), _pp_ln
+
+        def block_fn(bp, h):
+            B, T, H = h.shape
+            h1 = ln(h, bp["ln1.weight"], bp["ln1.bias"], eps1)
+            qkv = mm(h1, bp["attn.qkv.weight"]) + bp["attn.qkv.bias"]
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            nh = H // D
+            q = q.reshape(B, T, nh, D)
+            k = k.reshape(B, T, nh, D)
+            v = v.reshape(B, T, nh, D)
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (1.0 / math.sqrt(D))
+            s = s.astype(jnp.float32)
+            causal = jnp.tril(jnp.ones((T, T), bool))
+            s = jnp.where(causal[None, None], s, -1e30)
+            p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+            o = jnp.einsum("bhqk,bkhd->bqhd", p, v).reshape(B, T, H) \
+                .astype(jnp.float32)
+            h = h + mm(o, bp["attn.proj.weight"]) + bp["attn.proj.bias"]
+
+            # --- MoE FFN, manual ep: full routing, local expert slab ---
+            h2 = ln(h, bp["ln2.weight"], bp["ln2.bias"], eps2)
+            N = B * T
+            C = max(int(math.ceil(cap_f * N * K / E)), 1)
+            xt = h2.reshape(N, H)
+            logits = (xt @ bp["moe.gate_w"]).astype(jnp.float32)
+            probs = jax.nn.softmax(logits, axis=-1)
+            gates_list, onehot_list = [], []
+            masked = probs
+            for _ in range(K):
+                idx = masked.argmax(axis=-1)
+                oh = jax.nn.one_hot(idx, E, dtype=jnp.float32)
+                gates_list.append((probs * oh).sum(-1))
+                onehot_list.append(oh)
+                masked = masked * (1.0 - oh)
+            flat_oh = jnp.concatenate(onehot_list, 0)
+            pos = jnp.cumsum(flat_oh, axis=0) - flat_oh
+            keep = (pos < C) * flat_oh
+            pos_id = (pos * flat_oh).sum(-1).astype(jnp.int32)
+            cap_oh = jax.nn.one_hot(pos_id, C, dtype=jnp.float32)
+            gates = jnp.concatenate(gates_list, 0)
+            dispatch = keep[:, :, None] * cap_oh[:, None, :]   # [KN,E,C]
+            combine = dispatch * gates[:, None, None]
+
+            # local expert slab: slice this member's E/n_ep experts
+            e_loc = bp["moe.w_in"].shape[0]
+            e0 = jax.lax.axis_index(axis_ep) * e_loc
+            disp_l = jax.lax.dynamic_slice_in_dim(dispatch, e0, e_loc, 1)
+            comb_l = jax.lax.dynamic_slice_in_dim(combine, e0, e_loc, 1)
+            xrep = jnp.tile(xt, (K, 1)).astype(jnp.float32)
+            expert_in = jnp.einsum("nec,nm->ecm", disp_l, xrep)
+            hh = jnp.einsum("ecm,emh->ech", expert_in,
+                            bp["moe.w_in"].astype(jnp.float32)) \
+                + bp["moe.b_in"][:, None, :]
+            hh = jax.nn.gelu(hh)
+            eout = jnp.einsum("ech,ehm->ecm", hh,
+                              bp["moe.w_out"].astype(jnp.float32)) \
+                + bp["moe.b_out"][:, None, :]
+            y = jnp.einsum("nec,ecm->nm", comb_l, eout)
+            y = y.reshape(K, N, H).sum(0)
+            # contributions from every member's experts meet here
+            y = jax.lax.psum(y, axis_ep)
+            return h + y.reshape(B, T, H).astype(h.dtype)
+
+        return block_fn
+
+
 def gpt_param_shardings(params, mesh_axis_tp="tp"):
     """Megatron-style TP PartitionSpecs keyed by the functional param dict
     names produced by `framework.functional_call` on a GPT instance.
