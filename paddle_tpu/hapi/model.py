@@ -233,12 +233,18 @@ class Model:
             mesh = mesh_mod.get_mesh()
             if mesh is not None:
                 # a stale global mesh from another strategy must not
-                # silently override this strategy's degrees
-                want = self._strategy.resolve_degrees(
-                    len(mesh.devices.ravel()))
+                # silently override this strategy's degrees; a mesh whose
+                # device count can't even satisfy the strategy (ValueError
+                # from resolve_degrees) is just as stale as one with the
+                # wrong axis sizes
+                try:
+                    want = self._strategy.resolve_degrees(
+                        len(mesh.devices.ravel()))
+                except ValueError:
+                    want = None
                 have = {k: int(v) for k, v in mesh.shape.items()}
-                if {k: v for k, v in want.items()
-                        if k in have} != have:
+                if want is None or {k: v for k, v in want.items()
+                                    if k in have} != have:
                     mesh = None     # compiler rebuilds from the strategy
             self._dist_prog = compile_train_step(
                 _LossAdapter(), self._optimizer, self._strategy,

@@ -81,3 +81,35 @@ def test_embedding_sparse_grad_to_ps_roundtrip():
         # untouched rows unchanged on the server
         other = c.pull_sparse(7, np.array([0], np.uint64), D)
         np.testing.assert_allclose(other[0], w0[0], rtol=1e-6)
+
+
+def test_prepare_after_stale_incompatible_mesh():
+    """r2 verdict regression: a user who builds one mesh, then prepares a
+    differently-shaped strategy, must get a working rebuild — not a crash.
+    The stale 2-device mesh can't even satisfy pp*tp=4; prepare must
+    discard it and build a fresh 4-device mesh from the strategy."""
+    import jax
+    from paddle_tpu.distributed import mesh as mesh_mod
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.models import GPT, gpt_tiny
+
+    stale = mesh_mod.build_mesh({"dp": 2}, devices=jax.devices()[:2])
+    mesh_mod.set_mesh(stale)
+
+    paddle.seed(0)
+    net = GPT(gpt_tiny())
+    s = DistributedStrategy()
+    s.pipeline = True
+    s.tensor_parallel = True
+    s.hybrid_configs.pp_degree = 2
+    s.hybrid_configs.mp_degree = 2
+    s.pipeline_configs.accumulate_steps = 2
+    model = Model(net)
+    adam = opt.Adam(learning_rate=1e-3, parameters=model.parameters())
+    model.prepare(adam, strategy=s)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 512, (8, 32)).astype(np.int64)
+    labels = rng.integers(0, 512, (8, 32)).astype(np.int64)
+    loss = float(model.train_batch([ids], [labels])[0])
+    assert np.isfinite(loss)
