@@ -342,13 +342,13 @@ def _check_pipeline_compat(strategy, mesh, what="pipeline",
 def _build_pipeline_program(layer, optimizer, strategy, mesh, *, block_fn,
                             embed_fn, head_loss_fn, ep, hp, stacked,
                             n_layers, stacked_pspec, prog_cls,
-                            seq_axis=None):
+                            seq_axis=None, replicated_axes=()):
     """The machinery both pipeline branches share: flat param assembly
     (embed.* / head.* / stacked.*), shardings, the microbatched
     global-masked-mean loss, jit wiring and program construction. The
     branches differ only in how the stacked block params are laid out and
     what block_fn runs inside the pipeline shard_map."""
-    from ..pipeline import pipeline_spmd
+    from ..pipeline import pipeline_value_and_grad
 
     n_pp = int(mesh.shape["pp"])
     n_dp = int(mesh.shape.get("dp", 1))
@@ -390,13 +390,27 @@ def _build_pipeline_program(layer, optimizer, strategy, mesh, *, block_fn,
     data_sh = NamedSharding(mesh, P("dp") if n_dp > 1 else P())
 
     # shard_map in_specs derive from the SAME pspecs the jit in_shardings
-    # use — one source of truth for the stacked layout
-    pipe = pipeline_spmd(
-        block_fn, n_pp, n_micro, mesh, axis="pp",
+    # use — one source of truth for the stacked layout. Training runs the
+    # true-1F1B fused fwd+bwd scheduler (O(n_stages) activation memory —
+    # section_worker.cc:128-165's profile); jax.grad over the forward
+    # scheduler would store residuals for all n_micro microbatches.
+    import inspect as _inspect
+
+    def _takes(fn_, name):
+        try:
+            return name in _inspect.signature(fn_).parameters
+        except (TypeError, ValueError):
+            return False
+
+    pipe_vag = pipeline_value_and_grad(
+        block_fn, embed_fn, head_loss_fn, n_pp, n_micro, mesh, axis="pp",
         batch_axis="dp" if n_dp > 1 else None,
         param_specs={k[len("stacked."):]: v for k, v in pspecs.items()
                      if k.startswith("stacked.")},
-        seq_axis=seq_axis)
+        seq_axis=seq_axis,
+        block_takes_key=_takes(block_fn, "key"),
+        embed_takes_key=_takes(embed_fn, "key"),
+        replicated_axes=replicated_axes)
 
     def _sub(p, prefix):
         cut = len(prefix)
@@ -404,29 +418,28 @@ def _build_pipeline_program(layer, optimizer, strategy, mesh, *, block_fn,
 
     def train_step(p, st, opt_st, key, lr, data):
         ids, labels = data
-
-        def loss_of(pp):
-            from ... import amp as amp_mod
-            with random_mod.key_scope(key):
-                with amp_mod.auto_cast(enable=amp_on,
-                                       level="O2" if pure_bf16 else "O1",
-                                       dtype="bfloat16"):
-                    epp = _sub(pp, "embed.")
-                    hpp = _sub(pp, "head.")
-                    spp = _sub(pp, "stacked.")
-                    mb = ids.shape[0] // n_micro
-                    ids_m = ids.reshape((n_micro, mb) + ids.shape[1:])
-                    lab_m = labels.reshape((n_micro, mb) + labels.shape[1:])
-                    h = jax.vmap(embed_fn, in_axes=(None, 0))(epp, ids_m)
-                    h = pipe(spp, h)
-                    sums, counts = jax.vmap(head_loss_fn,
-                                            in_axes=(None, None, 0, 0))(
-                        hpp, epp, h, lab_m)
-            # global masked mean across all microbatches (head_loss_fn
-            # returns per-microbatch (loss_sum, valid_count))
-            return sums.sum() / jnp.maximum(counts.sum(), 1.0)
-
-        loss, grads = jax.value_and_grad(loss_of)(p)
+        from ... import amp as amp_mod
+        with random_mod.key_scope(key):
+            with amp_mod.auto_cast(enable=amp_on,
+                                   level="O2" if pure_bf16 else "O1",
+                                   dtype="bfloat16"):
+                epp = _sub(p, "embed.")
+                hpp = _sub(p, "head.")
+                spp = _sub(p, "stacked.")
+                mb = ids.shape[0] // n_micro
+                ids_m = ids.reshape((n_micro, mb) + ids.shape[1:])
+                lab_m = labels.reshape((n_micro, mb) + labels.shape[1:])
+                sums, counts, d_sp, d_ep, d_hp = pipe_vag(
+                    spp, epp, hpp, ids_m, lab_m, key)
+        # global masked mean across all microbatches: grads came back as
+        # grads of loss_SUM; the valid-count denominator is
+        # label-determined (param-independent), so scaling is exact
+        denom = jnp.maximum(counts, 1.0)
+        loss = sums / denom
+        grads = {}
+        grads.update({f"embed.{k}": v / denom for k, v in d_ep.items()})
+        grads.update({f"head.{k}": v / denom for k, v in d_hp.items()})
+        grads.update({f"stacked.{k}": v / denom for k, v in d_sp.items()})
         grads = nan_inf.guard_tree(grads)   # FLAGS_check_nan_inf, jit path
         new_p, new_opt = optimizer.functional_update(p, grads, opt_st, lr=lr)
         return loss, new_p, st, new_opt
@@ -526,7 +539,7 @@ def _compile_pipeline_step(layer, optimizer, strategy, mesh):
             embed_fn=embed_fn, head_loss_fn=head_loss_fn, ep=ep, hp=hp,
             stacked=stack_stage_params(blocks_list),
             n_layers=len(blocks_list), stacked_pspec=ep_pspec,
-            prog_cls=_PipelineTrainStep)
+            prog_cls=_PipelineTrainStep, replicated_axes=("ep",))
     if n_sp > 1:
         # pp x sp: blocks see local sequence shards; attention is the
         # shard_map-inner ring/Ulysses (the sp collectives live in the
@@ -599,7 +612,7 @@ def _compile_pipeline_tp_step(layer, optimizer, strategy, mesh, n_tp):
         embed_fn=embed_fn, head_loss_fn=head_loss_fn, ep=ep, hp=hp,
         stacked=stack_stage_params(split_blocks),
         n_layers=len(blocks_list), stacked_pspec=stacked_pspec,
-        prog_cls=_PipelineTpTrainStep)
+        prog_cls=_PipelineTpTrainStep, replicated_axes=("tp",))
 
 
 

@@ -9,10 +9,20 @@ TPU-native redesign: the reference runs a *host thread per stage* issuing
 ops; on TPU the whole pipeline is ONE jitted SPMD program over the 'pp'
 axis. Stage-local layer stacks are a leading-axis-stacked pytree sharded
 over 'pp'; activations move between neighbour stages with
-lax.ppermute (ICI neighbour hops); the microbatch loop is a lax.scan with
-a circular buffer, which XLA overlaps with compute (the 1F1B memory
-profile falls out of steady-state: each stage holds at most
-n_stages in-flight microbatch activations).
+lax.ppermute (ICI neighbour hops); the microbatch loop is a lax.scan.
+
+Two schedulers live here:
+- `pipeline_spmd`: forward-only circular-shift loop (fill + steady +
+  drain). Differentiating *through* it (jax.grad) yields a GPipe-style
+  F-then-B whose saved residuals scale with n_micro — fine for eval /
+  small accumulate_steps, NOT the 1F1B memory profile.
+- `pipeline_value_and_grad`: the train scheduler. A fused fwd+bwd 1F1B
+  lockstep (section_worker.cc:128-165's interleave, re-derived for SPMD):
+  at tick t stage s runs forward of microbatch (t - s) AND backward of
+  microbatch (t - (2S-1-s)); boundary activations wait in a 2S-slot ring
+  buffer, the backward re-linearises the stage stack per microbatch
+  (full remat), so per-stage live activation memory is O(n_stages) and
+  independent of n_micro.
 
 Design restriction (same as every SPMD pipeline): the pipelined body must
 be homogeneous — L identical blocks split as L/pp per stage. Embedding and
@@ -27,7 +37,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-__all__ = ["pipeline_spmd", "stack_stage_params", "PipelineLayer"]
+__all__ = ["pipeline_spmd", "pipeline_value_and_grad",
+           "stack_stage_params", "PipelineLayer"]
 
 
 def stack_stage_params(block_params_list):
@@ -53,12 +64,11 @@ def pipeline_spmd(block_fn: Callable, n_stages: int, n_micro: int,
 
     Schedule: circular-shift loop of n_micro + n_stages - 1 ticks
     (fill + steady state + drain). Each tick: run local stage stack on the
-    held activation, ppermute result to the next stage. This is the
-    F-then-B schedule for the forward; because the whole loop lives inside
-    one jit, jax.grad over it yields the reversed (B) schedule
-    automatically — no hand-written 1F1B interleave is needed for
-    correctness, and XLA's scheduler overlaps the ppermute with block
-    compute (the throughput property 1F1B exists for)."""
+    held activation, ppermute result to the next stage. jax.grad over it
+    is correct but GPipe-shaped: the reversed scan stores residuals for
+    ALL n_micro microbatches per stage. Training uses
+    `pipeline_value_and_grad` (true 1F1B, O(n_stages) activation
+    memory); this forward scheduler serves eval/predict and direct use."""
 
     def run_local_stack(local_params, x):
         # scan over this stage's L/pp layers
@@ -128,6 +138,268 @@ def pipeline_spmd(block_fn: Callable, n_stages: int, n_micro: int,
         return f(stacked_params, x_micro)
 
     return pipelined
+
+
+def pipeline_value_and_grad(block_fn, embed_fn, head_loss_fn, n_stages,
+                            n_micro, mesh, axis: str = "pp",
+                            batch_axis: str = None, param_specs=None,
+                            seq_axis: str = None,
+                            block_takes_key: bool = False,
+                            embed_takes_key: bool = False,
+                            replicated_axes: tuple = ()):
+    """True-1F1B fused train pipeline: loss AND grads in one SPMD scan.
+
+    Reference: SectionWorker's 1F1B loop
+    (/root/reference/paddle/fluid/framework/section_worker.cc:128-165 —
+    warmup forwards, steady-state 1F+1B interleave, cooldown backwards,
+    bounding each stage to <= n_stages in-flight microbatches). SPMD
+    re-derivation: with unit F/B slots per tick, stage s runs
+    F_{t-s} and B_{t-(2S-1-s)} at tick t; forward activations hop s->s+1
+    and input-cotangents hop s->s-1 via ppermute each tick. In-flight
+    microbatches at stage s peak at 2(S-s)-1 <= 2S-1, so a 2S-slot ring
+    buffer of boundary activations suffices — per-stage live activation
+    memory is O(n_stages), independent of n_micro (asserted by
+    tests/test_distributed.py::test_pipeline_memory_scales_with_stages).
+    The backward slot re-linearises the stage stack from the saved
+    boundary input (full remat, the reference's recompute-mode trade);
+    embed runs in stage 0's slots and head+loss in the last stage's, so
+    no O(n_micro) activation or cotangent buffers exist anywhere.
+
+    Returns f(stacked, embed_p, head_p, ids_micro, labels_micro, key) ->
+    (loss_sum, valid_count, d_stacked, d_embed, d_head); grads are of
+    loss_SUM — divide by the count for mean-loss grads.
+
+    block_fn(bp, h[, key]) -> h;  embed_fn(ep, ids[, pos_offset][, key]);
+    head_loss_fn(hp, ep, h, labels) -> (loss_sum, valid_count).
+    Collectives inside block_fn (tp/sp/ep psums, ring ppermutes) are fine:
+    they run unconditionally every tick. embed/head must be collective-free
+    (they execute under a per-stage lax.cond).
+
+    `replicated_axes` names mesh axes over which activations are
+    REPLICATED while block_fn contains psums (tp on the manual-Megatron
+    path, ep on the expert path). Manual vjp inside shard_map transposes
+    psum to psum, so replicated cotangent seeds would double-count by the
+    axis size: instead the last stage seeds the stack vjp with dy/n and
+    cotangents stay *partial* across those axes (their psum is the true
+    cotangent — the invariant is self-maintaining through psum-transposes
+    stage to stage). Consequently grads of params SHARDED over such an
+    axis come out true directly, while grads of params replicated over it
+    (and the embed grads) are partial and get one psum at the end."""
+    S, M = n_stages, n_micro
+    K = 2 * S
+    n_ticks = 2 * S + M - 1
+    fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+    bwd_perm = [(i, (i - 1) % S) for i in range(S)]
+    n_rep = 1
+    for a in replicated_axes:
+        n_rep *= int(mesh.shape[a])
+
+    def staged(sp_, ep_, hp_, ids_m, lab_m, key):
+        stage = jax.lax.axis_index(axis)
+        is_last = stage == S - 1
+        is_first = stage == 0
+        f32 = jnp.float32
+
+        # dropout keys: decorrelate across data axes (dp shards, sp seq
+        # shards) but keep tp/ep members identical — replicated
+        # activations need identical masks or the manual psums break
+        if key is not None and (block_takes_key or embed_takes_key):
+            if batch_axis is not None:
+                key = jax.random.fold_in(key,
+                                         jax.lax.axis_index(batch_axis))
+            if seq_axis is not None:
+                key = jax.random.fold_in(key,
+                                         jax.lax.axis_index(seq_axis))
+        T_loc = ids_m.shape[2] if ids_m.ndim >= 3 else ids_m.shape[-1]
+        pos_off = (jax.lax.axis_index(seq_axis) * T_loc
+                   if seq_axis is not None else 0)
+
+        n_local = jax.tree_util.tree_leaves(sp_)[0].shape[0]
+
+        def _embed_with(e_, m_idx, k_m):
+            args = (e_, ids_m[m_idx])
+            kw = {}
+            if seq_axis is not None:
+                kw["pos_offset"] = pos_off
+            if embed_takes_key and k_m is not None:
+                kw["key"] = jax.random.fold_in(k_m, n_local * S)
+            return embed_fn(*args, **kw)
+
+        def run_stack(p_, x, k_m):
+            # global layer index rides the xs so recompute (backward
+            # slot) reproduces the forward's dropout masks exactly
+            gidx = jnp.arange(n_local) + stage * n_local
+
+            def body(h, xs):
+                lp, li = xs
+                if block_takes_key and k_m is not None:
+                    return block_fn(lp, h, jax.random.fold_in(k_m, li)), None
+                return block_fn(lp, h), None
+
+            h, _ = jax.lax.scan(body, x, (p_, gidx))
+            return h
+
+        def tick(carry, t):
+            act_in, g_in, buf, d_sp, d_ep, d_hp, loss_s, cnt_s = carry
+
+            # ---- forward slot: F_{t - stage} -------------------------
+            m_f = t - stage
+            mf_c = jnp.clip(m_f, 0, M - 1)
+            k_f = (jax.random.fold_in(key, mf_c)
+                   if key is not None and (block_takes_key or
+                                           embed_takes_key) else None)
+            x_f = jax.lax.cond(
+                is_first, lambda: _embed_with(ep_, mf_c, k_f),
+                lambda: act_in)
+            y_f = run_stack(sp_, x_f, k_f)
+            # ring-buffer the boundary input for the backward's remat.
+            # Slot m_f mod 2S is written even on invalid (fill/drain)
+            # ticks: for m_f < 0 the slot lands in the never-pending
+            # range (S, 2S); for m_f >= M it aliases microbatch
+            # m_f - 2S = m_b - 1, already consumed last tick.
+            buf = jax.lax.dynamic_update_index_in_dim(
+                buf, x_f, m_f % K, 0)
+
+            # ---- backward slot: B_{t - (2S-1-stage)} -----------------
+            m_b = t - (2 * S - 1 - stage)
+            v_b = jnp.logical_and(m_b >= 0, m_b < M).astype(f32)
+            mb_c = jnp.clip(m_b, 0, M - 1)
+            k_b = (jax.random.fold_in(key, mb_c)
+                   if key is not None and (block_takes_key or
+                                           embed_takes_key) else None)
+            x_b = jax.lax.dynamic_index_in_dim(buf, m_b % K, 0,
+                                               keepdims=False)
+            lab = lab_m[mb_c]
+            y_b, stk_vjp = jax.vjp(
+                lambda p_, x_: run_stack(p_, x_, k_b), sp_, x_b)
+
+            def last_branch(y_):
+                def hl(hp__, ep__, y__):
+                    s_, c_ = head_loss_fn(hp__, ep__, y__, lab)
+                    return s_, c_
+
+                (ls, c), (dhp, dep, dy) = jax.value_and_grad(
+                    hl, argnums=(0, 1, 2), has_aux=True)(hp_, ep_, y_)
+                # partial-cotangent protocol over replicated axes (see
+                # docstring): seed with dy/n so psum-transposes inside
+                # the stack reassemble the true cotangent. The head-side
+                # tied-embedding grad joins the (partial) embed-side grad
+                # in one accumulator, so it is made partial too.
+                if n_rep > 1:
+                    dy = dy / n_rep
+                    dep = jax.tree_util.tree_map(
+                        lambda g: g / n_rep, dep)
+                return (jnp.asarray(ls, f32), jnp.asarray(c, f32),
+                        dhp, dep, dy)
+
+            def mid_branch(y_):
+                return (jnp.zeros((), f32), jnp.zeros((), f32),
+                        jax.tree_util.tree_map(jnp.zeros_like, hp_),
+                        jax.tree_util.tree_map(jnp.zeros_like, ep_),
+                        g_in)
+
+            ls, c, dhp_m, dep_m, dy = jax.lax.cond(
+                is_last, last_branch, mid_branch, y_b)
+            d_sp_m, dx_m = stk_vjp(dy)
+
+            # stage 0's input is the embedding: fold its vjp into d_ep
+            dep_e = jax.lax.cond(
+                is_first,
+                lambda dx_: jax.vjp(
+                    lambda e_: _embed_with(e_, mb_c, k_b), ep_)[1](dx_)[0],
+                lambda dx_: jax.tree_util.tree_map(jnp.zeros_like, ep_),
+                dx_m)
+
+            acc = lambda a, g: a + v_b * g
+            d_sp = jax.tree_util.tree_map(acc, d_sp, d_sp_m)
+            d_hp = jax.tree_util.tree_map(acc, d_hp, dhp_m)
+            d_ep = jax.tree_util.tree_map(
+                lambda a, g1, g2: a + v_b * (g1 + g2),
+                d_ep, dep_m, dep_e)
+            loss_s = loss_s + v_b * ls
+            cnt_s = cnt_s + v_b * c
+
+            act_next = jax.lax.ppermute(y_f, axis, fwd_perm)
+            g_next = jax.lax.ppermute(dx_m, axis, bwd_perm)
+            return (act_next, g_next, buf, d_sp, d_ep, d_hp,
+                    loss_s, cnt_s), None
+
+        # one dead embed call pins the activation shape/dtype (only its
+        # static metadata is used — XLA DCEs the compute)
+        x0 = _embed_with(ep_, 0, None)
+        act0 = jnp.zeros(x0.shape, x0.dtype)
+        zeros_like_tree = functools.partial(
+            jax.tree_util.tree_map, jnp.zeros_like)
+        init = (act0, act0, jnp.zeros((K,) + x0.shape, x0.dtype),
+                zeros_like_tree(sp_), zeros_like_tree(ep_),
+                zeros_like_tree(hp_), jnp.zeros((), jnp.float32),
+                jnp.zeros((), jnp.float32))
+        (_, _, _, d_sp, d_ep, d_hp, loss_s, cnt_s), _ = jax.lax.scan(
+            tick, init, jnp.arange(n_ticks))
+
+        # reductions: loss/head/embed grads live on one stage (mask) and
+        # are partial across data shards; stacked grads are stage-owned
+        # (no pp psum) but partial across data shards. tp/ep members
+        # compute replicated copies — never psum over those axes.
+        data_axes = tuple(a for a in (batch_axis, seq_axis)
+                          if a is not None)
+        for a in data_axes + (axis,):
+            loss_s = jax.lax.psum(loss_s, a)
+            cnt_s = jax.lax.psum(cnt_s, a)
+            d_ep = jax.tree_util.tree_map(
+                lambda g: jax.lax.psum(g, a), d_ep)
+            d_hp = jax.tree_util.tree_map(
+                lambda g: jax.lax.psum(g, a), d_hp)
+        for a in data_axes:
+            d_sp = jax.tree_util.tree_map(
+                lambda g: jax.lax.psum(g, a), d_sp)
+        # partial-cotangent cleanup: embed grads (stage-0 vjp of partial
+        # dx) and grads of block params REPLICATED over a replicated axis
+        # are partial there; params sharded over the axis came out true
+        for a in replicated_axes:
+            d_ep = jax.tree_util.tree_map(
+                lambda g: jax.lax.psum(g, a), d_ep)
+            if param_specs is not None:
+                d_sp = {k: (g if a in tuple(param_specs[k])
+                            else jax.lax.psum(g, a))
+                        for k, g in d_sp.items()}
+            else:
+                d_sp = jax.tree_util.tree_map(
+                    lambda g: jax.lax.psum(g, a), d_sp)
+        return loss_s, cnt_s, d_sp, d_ep, d_hp
+
+    def fn(stacked, embed_p, head_p, ids_micro, labels_micro, key=None,
+           in_mesh=mesh):
+        nd = ids_micro.ndim
+        dspec = [None] * nd
+        if batch_axis is not None:
+            dspec[1] = batch_axis
+        if seq_axis is not None:
+            dspec[2] = seq_axis
+        dspec = P(*dspec)
+        pspecs = param_specs if param_specs is not None else \
+            jax.tree_util.tree_map(
+                lambda v: P(axis, *([None] * (v.ndim - 1))), stacked)
+        rep = lambda tree: jax.tree_util.tree_map(
+            lambda v: P(*([None] * getattr(v, "ndim", 0))), tree)
+        out_specs = (P(), P(), pspecs, rep(embed_p), rep(head_p))
+        use_key = key is not None and (block_takes_key or embed_takes_key)
+        if use_key:
+            f = jax.shard_map(
+                staged, mesh=in_mesh,
+                in_specs=(pspecs, rep(embed_p), rep(head_p), dspec, dspec,
+                          P()),
+                out_specs=out_specs, check_vma=False)
+            return f(stacked, embed_p, head_p, ids_micro, labels_micro,
+                     key)
+        f = jax.shard_map(
+            lambda a, b, c, d, e: staged(a, b, c, d, e, None),
+            mesh=in_mesh,
+            in_specs=(pspecs, rep(embed_p), rep(head_p), dspec, dspec),
+            out_specs=out_specs, check_vma=False)
+        return f(stacked, embed_p, head_p, ids_micro, labels_micro)
+
+    return fn
 
 
 class PipelineLayer:

@@ -84,6 +84,13 @@ def _pp_ln(x, g, b, eps):
     return (x - mu) / jnp.sqrt(var + eps) * g + b
 
 
+def _pp_dropout(x, key, p):
+    """Inverted dropout on raw jnp arrays (the pipeline's pure per-stage
+    fns bypass the Tensor-level F.dropout)."""
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    return jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+
+
 class CausalSelfAttention(nn.Layer):
     def __init__(self, cfg: GPTConfig):
         super().__init__()
@@ -236,25 +243,50 @@ class GPT(nn.Layer):
         """Pure (embed_fn, block_fn, head_loss_fn) for the pipeline step.
         block_fn reuses blocks[0] as the shared functional template (all
         blocks are structurally identical; layer i's params are fed in).
-        Dropout is not representable on this pure path — refuse rather than
-        silently change regularization."""
-        if self.cfg.dropout > 0:
-            raise NotImplementedError(
-                "pipeline_fns: dropout > 0 is not supported on the "
-                "pipeline-parallel path (pure per-stage functions carry no "
-                "dropout rng); set GPTConfig.dropout=0")
+
+        Dropout rides an explicit key: the 1F1B scheduler folds
+        (microbatch, global-layer, data-axis ranks) into the step key and
+        hands each block call its own subkey (`key_scope` makes the
+        Layer-level F.dropout draw from it), so the backward slot's remat
+        reproduces the forward's masks exactly — the reference threads
+        seeds the same way in its recompute pass
+        (fluid/backward.py modify_forward_desc_for_recompute).
+        embed_fn's pos_offset shifts wpe lookups for sequence-parallel
+        shards (local T/sp window into the global positions)."""
+        from ..core import random as random_mod
         from ..framework import functional_call
         from ..ops.pallas.fused_ce import linear_cross_entropy
         blk0 = self.blocks[0]
+        p_drop = float(self.cfg.dropout)
 
-        def embed_fn(ep, ids):
+        def embed_fn(ep, ids, pos_offset=0, key=None):
             T = ids.shape[-1]
-            pos = jnp.arange(T)
-            return ep["wte.weight"][ids] + ep["wpe.weight"][pos]
+            pos = jnp.arange(T) + pos_offset
+            x = ep["wte.weight"][ids] + ep["wpe.weight"][pos]
+            # self.training read at trace time — the same capture moment
+            # as blk0.training inside functional_call, so embed and block
+            # dropout always agree on train/eval mode
+            if p_drop > 0 and key is not None and self.training:
+                x = _pp_dropout(x, key, p_drop)
+            return x
 
-        def block_fn(bp, h):
-            out, _ = functional_call(blk0, bp, {}, h, mutable_state=False)
-            return out
+        if p_drop > 0:
+            def block_fn(bp, h, key=None):
+                if key is None:
+                    # no key -> trace-time constant masks; refuse loudly
+                    raise NotImplementedError(
+                        "GPT pipeline block with dropout > 0 needs the "
+                        "scheduler to thread a PRNG key (use the "
+                        "fleet-compiled train step)")
+                with random_mod.key_scope(key):
+                    out, _ = functional_call(blk0, bp, {}, h,
+                                             mutable_state=False)
+                return out
+        else:
+            def block_fn(bp, h):
+                out, _ = functional_call(blk0, bp, {}, h,
+                                         mutable_state=False)
+                return out
 
         eps = self.ln_f._epsilon
 
@@ -344,11 +376,12 @@ class GPT(nn.Layer):
         compute_dtype="bfloat16": matmul/einsum operands cast to bf16 (the
         AMP-O1 treatment — raw jnp ops here bypass the autocast dispatcher
         hook, so the cast must be explicit); LN stats, softmax and the
-        residual stream stay f32."""
-        if self.cfg.dropout > 0:
-            raise NotImplementedError(
-                "pipeline block with dropout > 0 unsupported (pure "
-                "per-stage functions carry no dropout rng)")
+        residual stream stay f32.
+
+        Dropout (Block's two sites: after attn-proj, after fc2) rides the
+        scheduler-threaded key. The mask key is NOT folded by tp rank:
+        the residual stream is replicated over 'tp', so every member must
+        draw the identical mask or the manual psums stop agreeing."""
         if self.cfg.moe_experts > 0:
             raise NotImplementedError("pipeline+tp with MoE unsupported")
         D = self.cfg.head_dim
@@ -357,8 +390,15 @@ class GPT(nn.Layer):
         cd = jnp.bfloat16 if compute_dtype in ("bfloat16", "bf16",
                                                jnp.bfloat16) else None
         mm, ln = _pp_mm(cd), _pp_ln
+        p_drop = float(self.cfg.dropout)
+        gpt_self = self
 
-        def block_fn(bp, h):
+        def _drop(x, key, site):
+            if p_drop <= 0 or key is None or not gpt_self.training:
+                return x
+            return _pp_dropout(x, jax.random.fold_in(key, site), p_drop)
+
+        def _block_core(bp, h, key):
             B, T, H = h.shape
             h1 = ln(h, bp["ln1.weight"], bp["ln1.bias"], eps1)
             q = mm(h1, bp["q_w"]) + bp["q_b"]   # [B,T,H/ntp] local heads
@@ -383,13 +423,20 @@ class GPT(nn.Layer):
             # row-parallel proj: partial sums meet across head groups
             att = jax.lax.psum(mm(o, bp["attn.proj.weight"]), axis_tp) \
                 + bp["attn.proj.bias"]
-            h = h + att
+            h = h + _drop(att, key, 0)
             h2 = ln(h, bp["ln2.weight"], bp["ln2.bias"], eps2)
             m = jax.nn.gelu(mm(h2, bp["fc1.weight"]) + bp["fc1.bias"],
                             approximate=False)   # Block uses exact gelu
             mo = jax.lax.psum(mm(m, bp["fc2.weight"]), axis_tp) \
                 + bp["fc2.bias"]
-            return h + mo
+            return h + _drop(mo, key, 1)
+
+        if p_drop > 0:
+            def block_fn(bp, h, key=None):
+                return _block_core(bp, h, key)
+        else:
+            def block_fn(bp, h):
+                return _block_core(bp, h, None)
 
         return block_fn
 

@@ -1,6 +1,8 @@
 """Distributed layer tests on the 8-device virtual CPU mesh
 (reference test style: test_collective_api_base.py subprocess simulations;
 here single-controller SPMD makes them in-process — SURVEY.md §4.3)."""
+import dataclasses
+
 import numpy as np
 import pytest
 
@@ -853,3 +855,276 @@ def test_eager_collective_semantics_pinned():
     expect = np.zeros(n, np.float32)
     expect[2] = 5.0   # dst rank receives src rank 0's shard value
     np.testing.assert_allclose(vals, expect)
+
+
+def test_pipeline_1f1b_value_and_grad_parity():
+    """pipeline_value_and_grad (true 1F1B fused fwd+bwd) == sequential
+    value_and_grad: loss, stacked-param grads, embed grads, head grads."""
+    from paddle_tpu.distributed.pipeline import pipeline_value_and_grad
+    mesh = mesh_mod.build_mesh({"pp": 4}, devices=jax.devices()[:4])
+    L, M, mb, T, V, D = 8, 6, 2, 4, 12, 16
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.standard_normal((L, D, D)).astype(np.float32) * 0.1)
+    ep = {"emb": jnp.asarray(
+        rng.standard_normal((V, D)).astype(np.float32) * 0.1)}
+    hp = {"out": jnp.asarray(
+        rng.standard_normal((D, V)).astype(np.float32) * 0.1)}
+    ids = jnp.asarray(rng.integers(0, V, (M, mb, T)))
+    lab = jnp.asarray(rng.integers(0, V, (M, mb, T)))
+
+    def block(p, h):
+        return jnp.tanh(h @ p)
+
+    def embed(e, i):
+        return e["emb"][i]
+
+    def head_loss(h_, e_, x, y):
+        logits = x @ h_["out"]
+        lp = jax.nn.log_softmax(logits)
+        nll = -jnp.take_along_axis(lp, y[..., None], axis=-1)
+        return nll.sum(), jnp.asarray(nll.size, jnp.float32)
+
+    pvag = pipeline_value_and_grad(block, embed, head_loss, 4, M, mesh)
+    ls, cnt, d_w, d_ep, d_hp = pvag(w, ep, hp, ids, lab)
+
+    def seq_loss(w_, e_, h_):
+        def one(i, y):
+            x = embed(e_, i)
+            for l in range(L):
+                x = block(w_[l], x)
+            s, c = head_loss(h_, e_, x, y)
+            return s, c
+        sums, cnts = jax.vmap(one)(ids, lab)
+        return sums.sum(), cnts.sum()
+
+    (ls_ref, cnt_ref), grads_ref = jax.value_and_grad(
+        seq_loss, argnums=(0, 1, 2), has_aux=True)(w, ep, hp)
+    np.testing.assert_allclose(float(ls), float(ls_ref), rtol=1e-5)
+    assert float(cnt) == float(cnt_ref)
+    np.testing.assert_allclose(np.asarray(d_w), np.asarray(grads_ref[0]),
+                               atol=1e-4)
+    np.testing.assert_allclose(np.asarray(d_ep["emb"]),
+                               np.asarray(grads_ref[1]["emb"]), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(d_hp["out"]),
+                               np.asarray(grads_ref[2]["out"]), atol=1e-4)
+
+
+def test_pipeline_memory_scales_with_stages_not_microbatches():
+    """The r2 verdict's 1F1B memory bound, measured: compiled temp memory
+    of the fused train pipeline must be ~flat in n_micro (ring buffer is
+    2*n_stages slots; a GPipe-style backward would grow linearly)."""
+    from paddle_tpu.distributed.pipeline import pipeline_value_and_grad
+    mesh = mesh_mod.build_mesh({"pp": 4}, devices=jax.devices()[:4])
+    L, mb, T, V, D = 8, 2, 8, 32, 64
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.standard_normal((L, D, D)).astype(np.float32) * 0.1)
+    ep = {"emb": jnp.asarray(
+        rng.standard_normal((V, D)).astype(np.float32) * 0.1)}
+    hp = {"out": jnp.asarray(
+        rng.standard_normal((D, V)).astype(np.float32) * 0.1)}
+
+    def block(p, h):
+        return jnp.tanh(h @ p)
+
+    def embed(e, i):
+        return e["emb"][i]
+
+    def head_loss(h_, e_, x, y):
+        lp = jax.nn.log_softmax(x @ h_["out"])
+        nll = -jnp.take_along_axis(lp, y[..., None], axis=-1)
+        return nll.sum(), jnp.asarray(nll.size, jnp.float32)
+
+    def temp_bytes(M):
+        pvag = pipeline_value_and_grad(block, embed, head_loss, 4, M, mesh)
+        ids = jnp.zeros((M, mb, T), jnp.int32)
+        lab = jnp.zeros((M, mb, T), jnp.int32)
+        c = jax.jit(pvag).lower(w, ep, hp, ids, lab).compile()
+        ma = c.memory_analysis()
+        if ma is None or not getattr(ma, "temp_size_in_bytes", 0):
+            pytest.skip("backend reports no memory analysis")
+        return ma.temp_size_in_bytes
+
+    t4, t32 = temp_bytes(4), temp_bytes(32)
+    # 8x the microbatches must NOT mean 8x the live activation memory:
+    # allow slack for per-tick transients, require far below linear
+    assert t32 < 2.0 * t4, (t4, t32)
+
+
+def test_pipeline_1f1b_dropout_key_parity():
+    """The 1F1B key-folding convention, checked exactly: a sequential run
+    applying fold_in(step_key, m) per microbatch, fold_in(., global_layer)
+    per block and fold_in(., L) for embed must reproduce the pipeline's
+    loss AND grads — grads only match if the backward slot's remat drew
+    the same masks as the forward slot."""
+    from paddle_tpu.distributed.pipeline import pipeline_value_and_grad
+    mesh = mesh_mod.build_mesh({"pp": 2}, devices=jax.devices()[:2])
+    L, M, mb, T, V, D = 4, 3, 2, 4, 12, 16
+    n_local = L // 2
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.standard_normal((L, D, D)).astype(np.float32) * 0.1)
+    ep = {"emb": jnp.asarray(
+        rng.standard_normal((V, D)).astype(np.float32) * 0.1)}
+    hp = {"out": jnp.asarray(
+        rng.standard_normal((D, V)).astype(np.float32) * 0.1)}
+    ids = jnp.asarray(rng.integers(0, V, (M, mb, T)))
+    lab = jnp.asarray(rng.integers(0, V, (M, mb, T)))
+    key = jax.random.key(42)
+
+    def drop(x, k):
+        keep = jax.random.bernoulli(k, 0.7, x.shape)
+        return jnp.where(keep, x / 0.7, 0.0)
+
+    def block(p, h, key=None):
+        h = jnp.tanh(h @ p)
+        return drop(h, key) if key is not None else h
+
+    def embed(e, i, key=None):
+        x = e["emb"][i]
+        return drop(x, key) if key is not None else x
+
+    def head_loss(h_, e_, x, y):
+        lp = jax.nn.log_softmax(x @ h_["out"])
+        nll = -jnp.take_along_axis(lp, y[..., None], axis=-1)
+        return nll.sum(), jnp.asarray(nll.size, jnp.float32)
+
+    pvag = pipeline_value_and_grad(block, embed, head_loss, 2, M, mesh,
+                                   block_takes_key=True,
+                                   embed_takes_key=True)
+    ls, cnt, d_w, d_ep, d_hp = pvag(w, ep, hp, ids, lab, key)
+
+    def seq_loss(w_, e_, h_):
+        def one(m):
+            k_m = jax.random.fold_in(key, m)
+            x = embed(e_, ids[m],
+                      key=jax.random.fold_in(k_m, n_local * 2))
+            for l in range(L):
+                x = block(w_[l], x, key=jax.random.fold_in(k_m, l))
+            return head_loss(h_, e_, x, lab[m])
+        sums, cnts = zip(*[one(m) for m in range(M)])
+        return sum(sums), sum(cnts)
+
+    (ls_ref, _), grads_ref = jax.value_and_grad(
+        seq_loss, argnums=(0, 1, 2), has_aux=True)(w, ep, hp)
+    np.testing.assert_allclose(float(ls), float(ls_ref), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(d_w), np.asarray(grads_ref[0]),
+                               atol=1e-4)
+    np.testing.assert_allclose(np.asarray(d_ep["emb"]),
+                               np.asarray(grads_ref[1]["emb"]), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(d_hp["out"]),
+                               np.asarray(grads_ref[2]["out"]), atol=1e-4)
+
+
+def test_pipeline_dropout_trains_via_strategy():
+    """VERDICT r2 #9: the fleet-compiled pp step accepts dropout>0 (the
+    old hard refusal at models/gpt.py pipeline_fns is lifted) and its
+    regularization is live (loss differs from the dropout=0 twin)."""
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.distributed.fleet.compiler import compile_train_step
+    from paddle_tpu.models import GPT, GPTConfig
+
+    paddle.seed(7)
+    cfg = GPTConfig(vocab_size=64, hidden=32, layers=4, heads=2,
+                    max_seq_len=16, dropout=0.3)
+    net = GPT(cfg)
+    net.train()
+    s = DistributedStrategy()
+    s.pipeline = True
+    s.hybrid_configs.pp_degree = 2
+    s.pipeline_configs.accumulate_steps = 2
+    mesh = mesh_mod.build_mesh({"pp": 2}, devices=jax.devices()[:2])
+    adam = opt.Adam(learning_rate=1e-3, parameters=net.parameters())
+    prog = compile_train_step(net, adam, s, mesh=mesh)
+
+    rng = np.random.default_rng(3)
+    ids = rng.integers(0, 64, (4, 16)).astype(np.int64)
+    lab = rng.integers(0, 64, (4, 16)).astype(np.int64)
+    losses = [float(prog.step(ids, lab)) for _ in range(3)]
+    assert all(np.isfinite(l) for l in losses)
+    # dropout must actually vary the loss across steps beyond pure
+    # optimization drift: re-running step 1's params is not required —
+    # instead check the pipeline ran with masks (loss != the dropout=0
+    # model's loss on the same seed/params)
+    paddle.seed(7)
+    cfg0 = dataclasses.replace(cfg, dropout=0.0)
+    net0 = GPT(cfg0)
+    net0.train()
+    adam0 = opt.Adam(learning_rate=1e-3, parameters=net0.parameters())
+    prog0 = compile_train_step(net0, adam0, s, mesh=mesh)
+    l0 = float(prog0.step(ids, lab))
+    assert abs(losses[0] - l0) > 1e-4
+
+
+def test_pipeline_dropout_grads_match_seeded_sequential(monkeypatch):
+    """Closes the r3 review gap: through the REAL fleet-compiled GPT path
+    (functional_call + key_scope dropout), one SGD step's param delta must
+    equal lr * grads of a sequential run that replays the scheduler's key
+    folding — fold_in(step_key, m), fold_in(., layer) per block,
+    fold_in(., L) for embed. Only holds if the backward slot's remat drew
+    the same masks as the forward slot."""
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.core import random as random_mod
+    from paddle_tpu.distributed.fleet.compiler import compile_train_step
+    from paddle_tpu.models import GPT, GPTConfig
+
+    paddle.seed(11)
+    cfg = GPTConfig(vocab_size=64, hidden=32, layers=4, heads=2,
+                    max_seq_len=16, dropout=0.25)
+    net = GPT(cfg)
+    net.train()
+    s = DistributedStrategy()
+    s.pipeline = True
+    s.hybrid_configs.pp_degree = 2
+    s.pipeline_configs.accumulate_steps = 2
+    mesh = mesh_mod.build_mesh({"pp": 2}, devices=jax.devices()[:2])
+    lr = 0.5
+    sgd = opt.SGD(learning_rate=lr, parameters=net.parameters())
+    prog = compile_train_step(net, sgd, s, mesh=mesh)
+
+    # pin the STEP key only; scope-internal draws (functional_call
+    # dropout) must keep splitting from the threaded key
+    fixed = jax.random.key(123)
+    orig_next = random_mod.next_key
+
+    def fake_next_key():
+        if getattr(random_mod._scope, "stack", None):
+            return orig_next()
+        return fixed
+    monkeypatch.setattr(random_mod, "next_key", fake_next_key)
+
+    rng = np.random.default_rng(5)
+    ids = rng.integers(0, 64, (4, 16)).astype(np.int64)
+    lab = rng.integers(0, 64, (4, 16)).astype(np.int64)
+    p_before = {k: np.asarray(v) for k, v in prog.params.items()}
+    loss_pipe = float(prog.step(ids, lab))
+    p_after = {k: np.asarray(v) for k, v in prog.params.items()}
+
+    embed_fn, block_fn, head_loss_fn = net.pipeline_fns()
+    L = cfg.layers
+    ids_m = ids.reshape(2, 2, 16)
+    lab_m = lab.reshape(2, 2, 16)
+
+    def _sub(p, pre):
+        return {k[len(pre):]: v for k, v in p.items() if k.startswith(pre)}
+
+    def seq(flat):
+        epp, hpp, spp = (_sub(flat, "embed."), _sub(flat, "head."),
+                         _sub(flat, "stacked."))
+        sums, cnts = jnp.zeros(()), jnp.zeros(())
+        for m in range(2):
+            k_m = jax.random.fold_in(fixed, m)
+            x = embed_fn(epp, jnp.asarray(ids_m[m]),
+                         key=jax.random.fold_in(k_m, L))
+            for l in range(L):
+                bp = {r: v[l] for r, v in spp.items()}
+                x = block_fn(bp, x, jax.random.fold_in(k_m, l))
+            s_, c_ = head_loss_fn(hpp, epp, x, jnp.asarray(lab_m[m]))
+            sums, cnts = sums + s_, cnts + c_
+        return sums / jnp.maximum(cnts, 1.0)
+
+    flat0 = {k: jnp.asarray(v) for k, v in p_before.items()}
+    loss_ref, g_ref = jax.value_and_grad(seq)(flat0)
+    np.testing.assert_allclose(loss_pipe, float(loss_ref), rtol=1e-5)
+    for k in p_before:
+        np.testing.assert_allclose(
+            p_after[k], p_before[k] - lr * np.asarray(g_ref[k]),
+            atol=2e-5, err_msg=k)
