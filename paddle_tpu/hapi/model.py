@@ -40,6 +40,112 @@ def _to_jax(batch):
     return out
 
 
+class _AsyncScalar:
+    """A loss that stays on device until someone looks at it.
+
+    fit() keeps the dispatch pipeline full by NOT fetching the loss every
+    batch (each fetch is a host sync — through a remote-attached TPU it
+    costs a full RTT); callbacks/logs materialise it lazily at log_freq.
+    Reference analog: the monitor fetches fetch_list values only at
+    Profiler/log steps, not per batch."""
+
+    __slots__ = ("_arr", "_val")
+
+    def __init__(self, arr):
+        self._arr = arr
+        self._val = None
+
+    def __float__(self):
+        if self._val is None:
+            self._val = float(jax.device_get(self._arr))
+            self._arr = None
+        return self._val
+
+    def __format__(self, spec):
+        return format(float(self), spec)
+
+    def __repr__(self):
+        return repr(float(self))
+
+    def __int__(self):
+        return int(float(self))
+
+    def __round__(self, ndigits=None):
+        return round(float(self), ndigits)
+
+    def __bool__(self):
+        return bool(float(self))
+
+    def __neg__(self):
+        return -float(self)
+
+    def __abs__(self):
+        return abs(float(self))
+
+    def __hash__(self):
+        return hash(float(self))
+
+    @staticmethod
+    def _coerce(o):
+        try:
+            return float(o)
+        except (TypeError, ValueError):
+            return None
+
+    def _cmp(self, o, op):
+        v = self._coerce(o)
+        if v is None:
+            return NotImplemented
+        return op(float(self), v)
+
+    def __lt__(self, o):
+        return self._cmp(o, lambda a, b: a < b)
+
+    def __le__(self, o):
+        return self._cmp(o, lambda a, b: a <= b)
+
+    def __gt__(self, o):
+        return self._cmp(o, lambda a, b: a > b)
+
+    def __ge__(self, o):
+        return self._cmp(o, lambda a, b: a >= b)
+
+    def __eq__(self, o):
+        v = self._coerce(o)
+        # mirror float: incomparable operands are unequal, never an error
+        return False if v is None else float(self) == v
+
+    def __ne__(self, o):
+        return not self.__eq__(o)
+
+    def __add__(self, o):
+        return self._cmp(o, lambda a, b: a + b)
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._cmp(o, lambda a, b: a - b)
+
+    def __rsub__(self, o):
+        return self._cmp(o, lambda a, b: b - a)
+
+    def __mul__(self, o):
+        return self._cmp(o, lambda a, b: a * b)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._cmp(o, lambda a, b: a / b)
+
+    def __rtruediv__(self, o):
+        return self._cmp(o, lambda a, b: b / a)
+
+
+import numbers as _numbers
+
+_numbers.Real.register(_AsyncScalar)
+
+
 class Model:
     """Wraps a Layer with train/eval/predict loops (hapi/model.py:810)."""
 
@@ -186,7 +292,7 @@ class Model:
         return jax.jit(eval_step)
 
     # ------------------------------------------------------------------
-    def _dist_train_batch(self, inputs, labels):
+    def _dist_train_batch(self, inputs, labels, sync=True):
         """Strategy-compiled step (reference: fleet.distributed_optimizer
         -> meta-optimizer rewrites; here compile_train_step)."""
         from ..distributed.fleet.compiler import compile_train_step
@@ -264,10 +370,13 @@ class Model:
         loss = self._dist_prog.step(*inputs, *labels,
                                     lr=self._optimizer.get_lr())
         self._dist_dirty = True
-        return [float(jax.device_get(loss))]
+        return [float(jax.device_get(loss))] if sync \
+            else [_AsyncScalar(loss)]
 
-    def train_batch(self, inputs, labels=None):
-        """One optimizer step on a batch; returns [loss] (+metric updates)."""
+    def train_batch(self, inputs, labels=None, sync=True):
+        """One optimizer step on a batch; returns [loss] (+metric updates).
+        sync=False keeps the loss on device (fit's log_freq-deferred
+        fetch; the returned value is float-convertible on demand)."""
         if self._optimizer is None:
             raise RuntimeError("call prepare(optimizer, loss) first")
         self.network.train()
@@ -278,7 +387,7 @@ class Model:
                     "DistributedStrategy; set strategy.gradient_merge "
                     "and gradient_merge_configs.k_steps instead")
             return self._dist_train_batch(_as_list(inputs),
-                                          _as_list(labels))
+                                          _as_list(labels), sync=sync)
         if self._jit_step is None:
             self._jit_step = self._build_train_step()
             self._params, self._state = self._split_tree()
@@ -317,7 +426,8 @@ class Model:
                 self._jit_step(self._params, self._state, self._opt_state,
                                key, lr, inputs, labels)
         self._update_metrics(outs, labels)
-        return [float(jax.device_get(loss))]
+        return [float(jax.device_get(loss))] if sync \
+            else [_AsyncScalar(loss)]
 
     def _sync_dist_if_dirty(self):
         """One host gather per train->eval transition, not per batch."""
@@ -376,8 +486,14 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            accumulate_grad_batches=1, num_iters=None):
-        """Train loop with callbacks (reference fit hapi/model.py:1299)."""
+            accumulate_grad_batches=1, num_iters=None, prefetch_device=True):
+        """Train loop with callbacks (reference fit hapi/model.py:1299).
+
+        TPU-grade loop discipline: batches are device_put ahead of compute
+        by a background thread (prefetch_device; reference
+        operators/reader/buffered_reader.cc) and the per-batch loss stays
+        on device until a callback/log actually reads it, so the host
+        never blocks the dispatch pipeline between steps."""
         train_loader = self._make_loader(train_data, batch_size, shuffle,
                                          drop_last, num_workers)
         eval_loader = self._make_loader(eval_data, batch_size, False)
@@ -406,10 +522,19 @@ class Model:
                 break
             cbks.on_epoch_begin(epoch)
             self._reset_metrics()
-            for step, batch in enumerate(train_loader):
+            it = train_loader
+            if prefetch_device:
+                from ..io.dataloader import device_prefetch
+                # strategy path: place batches directly onto the step's
+                # data sharding (known once the first batch has compiled;
+                # epoch 0 falls back to default placement)
+                sh = getattr(getattr(self, "_dist_prog", None),
+                             "data_sharding", None)
+                it = device_prefetch(iter(train_loader), sharding=sh)
+            for step, batch in enumerate(it):
                 cbks.on_batch_begin("train", step, logs)
                 ins, lbls = self._split_batch(batch)
-                losses = self.train_batch(ins, lbls)
+                losses = self.train_batch(ins, lbls, sync=False)
                 logs = self._step_logs(losses, step, batch_size)
                 cbks.on_batch_end("train", step, logs)
                 global_step += 1

@@ -483,12 +483,23 @@ def device_prefetch(iterator, sharding=None, depth=2):
     """
     import jax
 
+    def _fit_sharding(x):
+        """Truncate a NamedSharding's spec to the array's rank (a [B]
+        per-sample tensor under a ('dp','sp') batch spec takes P('dp') —
+        same rule as the compiled step's _put_data)."""
+        from jax.sharding import NamedSharding, PartitionSpec
+        if isinstance(sharding, NamedSharding) and \
+                len(sharding.spec) > x.ndim:
+            return NamedSharding(sharding.mesh,
+                                 PartitionSpec(*sharding.spec[:x.ndim]))
+        return sharding
+
     def put(tree):
         def one(x):
             if isinstance(x, Tensor):
                 x = x._data
             if isinstance(x, np.ndarray):
-                return jax.device_put(x, sharding)
+                return jax.device_put(x, _fit_sharding(x))
             return x
         return _tree_map(one, tree)
 
