@@ -40,6 +40,7 @@ from . import utils  # noqa: F401
 from . import quant  # noqa: F401
 from . import onnx  # noqa: F401
 from . import dataset  # noqa: F401
+from . import distribution  # noqa: F401
 from . import profiler  # noqa: F401
 from .core import monitor  # noqa: F401
 from . import device  # noqa: F401

@@ -1,0 +1,264 @@
+"""paddle.distribution — Distribution/Uniform/Normal/Categorical.
+
+Reference: /root/reference/python/paddle/distribution.py:41 (Distribution),
+:168 (Uniform), :390 (Normal), :640 (Categorical). The reference builds
+each method from fluid layer ops (uniform_random, elementwise_*,
+multinomial); TPU-native redesign: closed-form jnp math dispatched
+through `core.tensor.apply`, so every method is a taped op — log_prob /
+entropy / kl_divergence backprop into Tensor-valued parameters (the
+policy-gradient use), and `sample(shape, seed)` derives its key from the
+global generator (seed=0) or a caller seed, reproducible under
+`paddle.seed` and usable inside jitted code via `core.random.key_scope`.
+
+Semantics pinned to the reference:
+- batch shape broadcasting: params broadcast together; `sample(shape)`
+  returns `shape + batch_shape`, collapsed to `shape` when every param
+  was a bare python float (reference :269,:491 all_arg_is_float).
+- Uniform.log_prob is -inf outside [low, high) (reference :315 masks with
+  lb/ub booleans and takes log of the 0/1 mask).
+- Categorical takes unnormalised logits; probs/entropy/kl normalise via
+  softmax over the last axis (reference :827,:862); log_prob indexes
+  log_softmax directly (no exp/log underflow round-trip).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import random as random_mod
+from ..core.tensor import Tensor, apply
+
+__all__ = ["Distribution", "Uniform", "Normal", "Categorical"]
+
+
+def _as_param(x):
+    """Param coercion (reference _to_tensor): float/list/np stay f32;
+    Tensors pass through UNWRAPPED-never — the tape must keep linking
+    them (e.g. Categorical(policy(states)) backprops into the policy)."""
+    if isinstance(x, Tensor):
+        return x
+    a = jnp.asarray(x)
+    if a.dtype not in (jnp.float32, jnp.float64):
+        a = a.astype(jnp.float32)
+    return Tensor(a)
+
+
+def _as_value(v, dtype=None):
+    t = v if isinstance(v, Tensor) else Tensor(jnp.asarray(v))
+    if dtype is not None and str(t._data.dtype) != str(dtype):
+        t = Tensor(t._data.astype(dtype))
+    return t
+
+
+def _sample_key(seed):
+    if seed:
+        return jax.random.key(int(seed))
+    return random_mod.next_key()
+
+
+class Distribution:
+    """Abstract base (reference distribution.py:41)."""
+
+    def sample(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def probs(self, value):
+        raise NotImplementedError
+
+
+class Uniform(Distribution):
+    """U(low, high) on the half-open interval (reference :168)."""
+
+    def __init__(self, low, high, name=None):
+        self.name = name or "Uniform"
+        self.all_arg_is_float = isinstance(low, (int, float)) and \
+            isinstance(high, (int, float))
+        self.low = _as_param(low)
+        self.high = _as_param(high)
+        self.dtype = str(self.low._data.dtype)
+
+    @property
+    def batch_shape(self):
+        return tuple(jnp.broadcast_shapes(tuple(self.low._data.shape),
+                                          tuple(self.high._data.shape)))
+
+    def sample(self, shape, seed=0):
+        key = _sample_key(seed)
+        out_shape = tuple(shape) + self.batch_shape
+        collapse = self.all_arg_is_float
+
+        def f(lo, hi):
+            u = jax.random.uniform(key, out_shape, lo.dtype)
+            out = lo + u * (hi - lo)
+            return out.reshape(tuple(shape)) if collapse else out
+
+        return apply(f, self.low, self.high, op_name="uniform_sample")
+
+    def log_prob(self, value):
+        v = _as_value(value, self.low._data.dtype)
+
+        def f(lo, hi, vv):
+            inside = jnp.logical_and(lo < vv, vv < hi)
+            # log(mask) -> -inf outside the support, matching the
+            # reference's log(lb*ub) construction
+            return jnp.log(inside.astype(lo.dtype)) - jnp.log(hi - lo)
+
+        return apply(f, self.low, self.high, v, op_name="uniform_log_prob")
+
+    def probs(self, value):
+        v = _as_value(value, self.low._data.dtype)
+
+        def f(lo, hi, vv):
+            inside = jnp.logical_and(lo < vv, vv < hi)
+            return inside.astype(lo.dtype) / (hi - lo)
+
+        return apply(f, self.low, self.high, v, op_name="uniform_probs")
+
+    def entropy(self):
+        return apply(lambda lo, hi: jnp.log(hi - lo), self.low, self.high,
+                     op_name="uniform_entropy")
+
+
+class Normal(Distribution):
+    """N(loc, scale^2) (reference :390)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.name = name or "Normal"
+        self.all_arg_is_float = isinstance(loc, (int, float)) and \
+            isinstance(scale, (int, float))
+        self.loc = _as_param(loc)
+        self.scale = _as_param(scale)
+        self.dtype = str(self.loc._data.dtype)
+
+    @property
+    def batch_shape(self):
+        return tuple(jnp.broadcast_shapes(tuple(self.loc._data.shape),
+                                          tuple(self.scale._data.shape)))
+
+    def sample(self, shape, seed=0):
+        key = _sample_key(seed)
+        out_shape = tuple(shape) + self.batch_shape
+        collapse = self.all_arg_is_float
+
+        def f(loc, scale):
+            z = jax.random.normal(key, out_shape, loc.dtype)
+            out = loc + z * scale    # reparameterised: grads flow to params
+            return out.reshape(tuple(shape)) if collapse else out
+
+        return apply(f, self.loc, self.scale, op_name="normal_sample")
+
+    def entropy(self):
+        # 0.5 + 0.5 log(2 pi) + log(scale), elementwise over batch
+        return apply(
+            lambda loc, s: jnp.broadcast_to(
+                0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s),
+                jnp.broadcast_shapes(loc.shape, s.shape)),
+            self.loc, self.scale, op_name="normal_entropy")
+
+    def log_prob(self, value):
+        v = _as_value(value, self.loc._data.dtype)
+        return apply(
+            lambda loc, s, vv: -((vv - loc) ** 2) / (2.0 * s * s)
+            - jnp.log(s) - 0.5 * math.log(2 * math.pi),
+            self.loc, self.scale, v, op_name="normal_log_prob")
+
+    def probs(self, value):
+        v = _as_value(value, self.loc._data.dtype)
+        return apply(
+            lambda loc, s, vv: jnp.exp(-((vv - loc) ** 2) / (2.0 * s * s))
+            / (s * math.sqrt(2 * math.pi)),
+            self.loc, self.scale, v, op_name="normal_probs")
+
+    def kl_divergence(self, other):
+        """KL(self || other) for two Normals (reference :595)."""
+        if not isinstance(other, Normal):
+            raise TypeError("kl_divergence expects another Normal")
+
+        def f(l0, s0, l1, s1):
+            var_ratio = (s0 / s1) ** 2
+            t1 = ((l0 - l1) / s1) ** 2
+            return 0.5 * (var_ratio + t1 - 1.0 - jnp.log(var_ratio))
+
+        return apply(f, self.loc, self.scale, other.loc, other.scale,
+                     op_name="normal_kl")
+
+
+class Categorical(Distribution):
+    """Categorical over unnormalised logits (reference :640)."""
+
+    def __init__(self, logits, name=None):
+        self.name = name or "Categorical"
+        self.logits = _as_param(logits)
+        self.dtype = str(self.logits._data.dtype)
+
+    def sample(self, shape, seed=0):
+        """Draws category indices; output shape = shape + batch_shape
+        (logits shape minus the category axis), reference :726."""
+        key = _sample_key(seed)
+        batch = tuple(self.logits._data.shape[:-1])
+        out_shape = tuple(shape) + batch
+        n = int(np.prod(shape)) if len(tuple(shape)) else 1
+
+        def f(lg):
+            draws = jax.random.categorical(key, lg, axis=-1,
+                                           shape=(n,) + batch)
+            return draws.reshape(out_shape).astype(jnp.int64)
+
+        return apply(f, self.logits, op_name="categorical_sample")
+
+    def entropy(self):
+        def f(lg):
+            lp = jax.nn.log_softmax(lg, axis=-1)
+            return -(jnp.exp(lp) * lp).sum(-1)
+
+        return apply(f, self.logits, op_name="categorical_entropy")
+
+    def kl_divergence(self, other):
+        if not isinstance(other, Categorical):
+            raise TypeError("kl_divergence expects another Categorical")
+
+        def f(lg_p, lg_q):
+            lp = jax.nn.log_softmax(lg_p, axis=-1)
+            lq = jax.nn.log_softmax(lg_q, axis=-1)
+            return (jnp.exp(lp) * (lp - lq)).sum(-1)
+
+        return apply(f, self.logits, other.logits, op_name="categorical_kl")
+
+    @staticmethod
+    def _gather(table, v):
+        """Index per-category rows with broadcasting: v may carry extra
+        sample dims ([S..., batch...]) or broadcast up to the batch
+        shape; 1-D tables index freely with any value shape."""
+        if table.ndim == 1:
+            return table[v]
+        batch = tuple(table.shape[:-1])
+        out_shape = jnp.broadcast_shapes(tuple(v.shape), batch)
+        v = jnp.broadcast_to(v, out_shape)
+        t = jnp.broadcast_to(table, out_shape + table.shape[-1:])
+        return jnp.take_along_axis(t, v[..., None], axis=-1)[..., 0]
+
+    def probs(self, value):
+        """Probability of the given category indices (reference :862)."""
+        v = _as_value(value, jnp.int32)
+        return apply(
+            lambda lg, vv: self._gather(jax.nn.softmax(lg, axis=-1), vv),
+            self.logits, v, op_name="categorical_probs")
+
+    def log_prob(self, value):
+        v = _as_value(value, jnp.int32)
+        return apply(
+            lambda lg, vv: self._gather(
+                jax.nn.log_softmax(lg, axis=-1), vv),
+            self.logits, v, op_name="categorical_log_prob")

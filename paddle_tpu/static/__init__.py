@@ -9,8 +9,9 @@ aliases onto the jit path.
 from __future__ import annotations
 
 from ..core import dtype as dtype_mod
+from . import nn  # noqa: F401  (cond/case/switch_case/while_loop)
 
-__all__ = ["InputSpec"]
+__all__ = ["InputSpec", "nn"]
 
 
 class InputSpec:

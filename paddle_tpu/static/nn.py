@@ -1,0 +1,196 @@
+"""paddle.static.nn — data-dependent control flow over jax.lax.
+
+Reference: python/paddle/static/nn/__init__.py:49-51 (cond/case/
+while_loop/switch_case aliases) and fluid/layers/control_flow.py:2474
+(case), :3591 (switch_case); the reference lowers these to
+conditional_block / while ops inside a static Program. TPU-native
+redesign: ONE implementation serves both execution modes —
+
+- eager (concrete Tensor values): the predicate is read on the host and
+  only the chosen branch runs, exactly like the reference's dygraph
+  fallback. The autograd tape records the chosen branch's ops normally.
+- traced (inside jit / to_static / Model steps): the predicate is a
+  tracer, so the op lowers to jax.lax.cond/switch/while_loop — the
+  branch becomes part of the compiled program and an exported model
+  (jit.save) carries the data-dependent branch in its StableHLO, which
+  the reference needs an AST rewrite (dygraph_to_static
+  program_translator.py:756) to achieve.
+
+Conversion boundary (documented limitation, mirrored from XLA's model):
+traced while_loop bodies must keep loop-carried shapes/dtypes fixed;
+Python-side effects inside branches run at trace time, not per-step; and
+reverse-mode grad through a TRACED while_loop is unsupported (dynamic
+trip count — jax raises; use a bounded lax.scan-style loop or eager
+mode, where the host loop unrolls onto the tape and differentiates).
+cond/case/switch_case differentiate fine in both modes.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, _is_tracer
+
+__all__ = ["cond", "case", "switch_case", "while_loop"]
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _is_traced(x) -> bool:
+    return _is_tracer(_arr(x))
+
+
+def _unwrap_tree(out):
+    """Branch output (Tensor / nested list-tuple / None) -> jnp pytree."""
+    return jax.tree_util.tree_map(
+        lambda t: t._data if isinstance(t, Tensor) else jnp.asarray(t), out)
+
+
+def _wrap_like(tree):
+    return jax.tree_util.tree_map(Tensor, tree)
+
+
+def _as_branch(fn: Callable):
+    """Wrap a user branch (Tensors in closure, returns Tensors) as a
+    zero-arg jnp-pytree function for lax."""
+
+    def branch(_):
+        return _unwrap_tree(fn())
+
+    return branch
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """if/else on a boolean scalar (reference control_flow.py cond):
+    runs only the taken branch eagerly; lowers to jax.lax.cond when
+    traced. Both branches must return the same structure."""
+    if true_fn is None or false_fn is None:
+        raise TypeError("cond requires both true_fn and false_fn")
+    p = _arr(pred)
+    if not _is_traced(pred):
+        return true_fn() if bool(np.asarray(jax.device_get(p)).reshape(())) \
+            else false_fn()
+    out = jax.lax.cond(jnp.reshape(p, ()).astype(bool),
+                       _as_branch(true_fn), _as_branch(false_fn),
+                       operand=None)
+    return _wrap_like(out)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """if/elif/.../else chain (reference control_flow.py:2474): first
+    true pred wins; `default` (or the LAST fn when default is None) runs
+    when nothing matches."""
+    if not isinstance(pred_fn_pairs, (list, tuple)) or not pred_fn_pairs:
+        raise TypeError("pred_fn_pairs must be a non-empty list/tuple")
+    for pair in pred_fn_pairs:
+        if not (isinstance(pair, (list, tuple)) and len(pair) == 2
+                and callable(pair[1])):
+            raise TypeError("each element must be a (pred, callable) pair")
+    if default is None:
+        default = pred_fn_pairs[-1][1]
+    if not callable(default):
+        raise TypeError("default must be callable")
+
+    if not any(_is_traced(p) for p, _ in pred_fn_pairs):
+        for p, fn in pred_fn_pairs:
+            if bool(np.asarray(jax.device_get(_arr(p))).reshape(())):
+                return fn()
+        return default()
+
+    # traced: right-fold into a nested lax.cond chain; the default is the
+    # innermost branch so it only executes when every pred is false
+    def chain(pairs):
+        if not pairs:
+            return _as_branch(default)
+        (p, fn), rest = pairs[0], pairs[1:]
+        return lambda _: jax.lax.cond(
+            jnp.reshape(_arr(p), ()).astype(bool),
+            _as_branch(fn), chain(rest), operand=None)
+
+    return _wrap_like(chain(list(pred_fn_pairs))(None))
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """C-style switch (reference control_flow.py:3591). branch_fns may be
+    a dict {int: fn}, a list of fns, or a list of (int, fn) pairs; an
+    unmatched index runs `default` (or the max-index fn when default is
+    None)."""
+    if isinstance(branch_fns, dict):
+        keyed = dict(branch_fns)
+    else:
+        if not isinstance(branch_fns, (list, tuple)) or not branch_fns:
+            raise TypeError("branch_fns must be a dict or non-empty list")
+        if callable(branch_fns[0]):
+            keyed = dict(enumerate(branch_fns))
+        else:
+            keyed = {}
+            for pair in branch_fns:
+                if not (isinstance(pair, (list, tuple)) and len(pair) == 2
+                        and isinstance(pair[0], int)):
+                    raise TypeError(
+                        "branch_fns elements must be (int, callable)")
+                if pair[0] in keyed:
+                    raise ValueError(f"duplicate branch index {pair[0]}")
+                keyed[pair[0]] = pair[1]
+    for fn in keyed.values():
+        if not callable(fn):
+            raise TypeError("branch fns must be callable")
+    if default is None:
+        default = keyed[max(keyed)]
+    if not callable(default):
+        raise TypeError("default must be callable")
+
+    idx = _arr(branch_index)
+    if not _is_traced(branch_index):
+        i = int(np.asarray(jax.device_get(idx)).reshape(()))
+        return keyed.get(i, default)()
+
+    # traced: dense branch table for lax.switch; gaps -> default. The
+    # selector maps the runtime index to its table slot (unmatched -> 0,
+    # the default slot).
+    keys = sorted(keyed)
+    table = [_as_branch(default)] + [_as_branch(keyed[k]) for k in keys]
+    key_arr = jnp.asarray(keys, jnp.int32)
+    i = jnp.reshape(idx, ()).astype(jnp.int32)
+    matches = (key_arr == i)
+    slot = jnp.where(matches.any(),
+                     jnp.argmax(matches).astype(jnp.int32) + 1, 0)
+    return _wrap_like(jax.lax.switch(slot, table, None))
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    """while cond(vars): vars = body(vars) (reference while_loop).
+    Eager: a host loop (only as many iterations as actually run).
+    Traced: jax.lax.while_loop — loop-carried shapes must stay fixed.
+    Returns the final loop_vars as a list."""
+    if not callable(cond_fn) or not callable(body_fn):
+        raise TypeError("cond_fn and body_fn must be callable")
+    if not isinstance(loop_vars, (list, tuple)) or not loop_vars:
+        raise TypeError("loop_vars must be a non-empty list/tuple")
+
+    probe = cond_fn(*loop_vars)
+    if not _is_traced(probe) and not any(_is_traced(v) for v in loop_vars
+                                         if isinstance(v, Tensor)):
+        out = list(loop_vars)
+        while bool(np.asarray(jax.device_get(_arr(cond_fn(*out)))).reshape(())):
+            res = body_fn(*out)
+            out = list(res) if isinstance(res, (list, tuple)) else [res]
+        return out
+
+    def cond_w(state):
+        return jnp.reshape(_unwrap_tree(
+            cond_fn(*_wrap_like(list(state)))), ()).astype(bool)
+
+    def body_w(state):
+        res = body_fn(*_wrap_like(list(state)))
+        res = list(res) if isinstance(res, (list, tuple)) else [res]
+        return tuple(_unwrap_tree(res))
+
+    init = tuple(_unwrap_tree(list(loop_vars)))
+    out = jax.lax.while_loop(cond_w, body_w, init)
+    return list(_wrap_like(list(out)))
