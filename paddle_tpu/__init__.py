@@ -41,6 +41,8 @@ from . import quant  # noqa: F401
 from . import onnx  # noqa: F401
 from . import dataset  # noqa: F401
 from . import distribution  # noqa: F401
+from . import incubate  # noqa: F401
+from . import regularizer  # noqa: F401
 from . import profiler  # noqa: F401
 from .core import monitor  # noqa: F401
 from . import device  # noqa: F401

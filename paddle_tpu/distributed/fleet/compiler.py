@@ -173,6 +173,11 @@ def compile_train_step(layer, optimizer, strategy: DistributedStrategy,
                        lr_default: float = 1e-3) -> CompiledTrainStep:
     mesh = mesh or strategy.build_mesh()
     optimizer = _maybe_swap_optimizer(optimizer, strategy)
+    if hasattr(layer, "named_parameters"):
+        # per-param ParamAttr regularizers, keyed for the functional path
+        # (pipeline layouts rename params — those fall back to the
+        # optimizer-wide weight_decay)
+        optimizer.collect_param_regularizers(layer)
     if int(mesh.shape.get("pp", 1)) > 1:
         return _compile_pipeline_step(layer, optimizer, strategy, mesh)
     from .grad_comm import active_mode, compile_explicit_dp_step

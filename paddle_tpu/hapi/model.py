@@ -227,6 +227,7 @@ class Model:
 
     def _build_train_step(self):
         optimizer = self._optimizer
+        optimizer.collect_param_regularizers(self.network)
         amp_on = self._amp_level in ("O1", "O2")
 
         def train_step(params, state, opt_state, key, lr, inputs, labels):
@@ -547,6 +548,14 @@ class Model:
                 logs.update({"eval_" + k: v for k, v in eval_logs.items()})
             cbks.on_epoch_end(epoch, logs)
         cbks.on_end("train", logs)
+        # hand the user back a live Layer on the plain path: its jitted
+        # step donated the layer's OWN buffers on step 1, so without this
+        # the network's Tensors reference deleted arrays. The strategy
+        # path device_put-COPIES at compile (layer tensors stay valid,
+        # just stale) and keeps the deferred write_back on eval/save —
+        # a full host gather per fit() would cost seconds on big models.
+        if self._jit_step is not None:
+            self._write_back(self._params, self._state)
         return self
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,

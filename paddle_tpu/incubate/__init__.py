@@ -1,0 +1,5 @@
+"""paddle.incubate — experimental APIs kept at reference paths
+(python/paddle/incubate/__init__.py)."""
+from . import optimizer  # noqa: F401
+
+__all__ = ["optimizer"]

@@ -1,0 +1,257 @@
+"""paddle.incubate.optimizer — LookAhead and ModelAverage.
+
+Reference: python/paddle/incubate/optimizer/lookahead.py:26 (LookAhead,
+arXiv:1907.08610 slow/fast weights) and modelaverage.py:27 + the
+average_accumulates kernel fluid/operators/average_accumulates_op.h
+(3-sum sliding-window average with the 16384-step precision rotation).
+
+TPU-native: both are pure pytree transforms. LookAhead wraps any inner
+optimizer — eager `step()` and the compiler's functional path both work
+(the k-boundary merge is a jnp.where, so the jitted train step stays a
+single traced program). ModelAverage is an eval-time tool: `step()`
+accumulates, `apply()`/`restore()` swap the averaged weights in and out.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.autograd import no_grad
+from ...core.errors import InvalidArgumentError, enforce
+from ...optimizer.optimizer import Optimizer
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead(Optimizer):
+    """slow += alpha * (fast - slow); fast = slow — every k inner steps
+    (reference lookahead.py:26)."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        enforce(isinstance(inner_optimizer, Optimizer),
+                "inner_optimizer must be a paddle optimizer",
+                InvalidArgumentError)
+        enforce(0.0 <= alpha <= 1.0, "alpha must be in [0, 1]",
+                InvalidArgumentError)
+        enforce(int(k) >= 1, "k must be a positive integer",
+                InvalidArgumentError)
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._parameter_list = inner_optimizer._parameter_list
+        self._grad_clip = None
+        self._slow: Dict[int, jax.Array] = {}
+        self._k_count = 0
+
+    # lr surface delegates to the inner optimizer
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def set_lr(self, v):
+        return self.inner_optimizer.set_lr(v)
+
+    @property
+    def _lr_scheduler(self):
+        return self.inner_optimizer._lr_scheduler
+
+    def _fast_of(self, p):
+        """The fp32 master weight when the inner optimizer keeps one
+        (multi_precision), else the param itself — the slow/fast merge
+        must read and WRITE the master, or the next inner step would
+        overwrite the merge from the stale master copy."""
+        return self.inner_optimizer._master.get(id(p), p._data)
+
+    @no_grad()
+    def step(self):
+        params = self._parameter_list
+        enforce(params is not None,
+                "LookAhead needs the inner optimizer constructed with "
+                "parameters=model.parameters()", InvalidArgumentError)
+        for p in params:
+            if id(p) not in self._slow:
+                self._slow[id(p)] = self._fast_of(p)  # cycle start point
+        self.inner_optimizer.step()
+        self._k_count += 1
+        if self._k_count % self.k == 0:
+            for p in params:
+                slow = self._slow[id(p)]
+                slow = slow + self.alpha * (self._fast_of(p) - slow)
+                self._slow[id(p)] = slow
+                if id(p) in self.inner_optimizer._master:
+                    self.inner_optimizer._master[id(p)] = slow
+                p._data = slow.astype(p._data.dtype)
+
+    minimize_step = step
+
+    def clear_grad(self, set_to_zero=False):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        return None, None
+
+    # -- functional pytree path (fleet-compiled steps) ---------------------
+    def functional_init(self, params):
+        # slow starts as a COPY: jitted steps donate both params and opt
+        # state, and aliased buffers would be donated twice
+        return {
+            "__lookahead__": {
+                "slow": {k: jnp.copy(v) for k, v in params.items()},
+                "step": jnp.zeros((), jnp.int32)},
+            **self.inner_optimizer.functional_init(params),
+        }
+
+    def functional_update(self, params, grads, opt_state, lr=None):
+        la = opt_state["__lookahead__"]
+        inner_state = {k: v for k, v in opt_state.items()
+                       if k != "__lookahead__"}
+        fast, new_inner = self.inner_optimizer.functional_update(
+            params, grads, inner_state, lr=lr)
+        step = la["step"] + 1
+        sync = (step % self.k) == 0
+        new_slow, new_fast = {}, {}
+        for k, f in fast.items():
+            s = la["slow"][k]
+            merged = s + self.alpha * (f - s)
+            new_slow[k] = jnp.where(sync, merged, s)
+            new_fast[k] = jnp.where(sync, merged.astype(f.dtype), f)
+        new_inner["__lookahead__"] = {"slow": new_slow, "step": step}
+        return new_fast, new_inner
+
+    def collect_param_regularizers(self, layer):
+        self.inner_optimizer.collect_param_regularizers(layer)
+
+    def state_dict(self):
+        out = self.inner_optimizer.state_dict()
+        out["__lookahead_k_count__"] = self._k_count
+        # slow weights are accumulator state: resuming mid-cycle without
+        # them would re-anchor the next merge at the current fast point
+        if self._parameter_list:
+            for p in self._parameter_list:
+                if id(p) in self._slow:
+                    out[f"__lookahead_slow__{p.name}"] = \
+                        np.asarray(self._slow[id(p)])
+        return out
+
+    def set_state_dict(self, state_dict):
+        state_dict = dict(state_dict)
+        self._k_count = int(state_dict.pop("__lookahead_k_count__", 0))
+        if self._parameter_list:
+            for p in self._parameter_list:
+                v = state_dict.pop(f"__lookahead_slow__{p.name}", None)
+                if v is not None:
+                    self._slow[id(p)] = jnp.asarray(v)
+        self.inner_optimizer.set_state_dict(state_dict)
+
+
+class ModelAverage(Optimizer):
+    """Sliding-window parameter average (reference modelaverage.py:27;
+    window math = average_accumulates_op.h): `step()` accumulates after
+    each optimizer update, `apply()` swaps the averaged weights in for
+    evaluation, `restore()` puts the live weights back."""
+
+    _MAX_NUM_ACCUMULATES = 16384   # precision rotation, matches the kernel
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        enforce(min_average_window <= max_average_window,
+                "min_average_window must be <= max_average_window",
+                InvalidArgumentError)
+        self.average_window = float(average_window_rate)
+        self.min_average_window = int(min_average_window)
+        self.max_average_window = int(max_average_window)
+        self._parameter_list = list(parameters) if parameters is not None \
+            else None
+        self._grad_clip = None
+        self._acc: Dict[int, dict] = {}
+        self._restore_buf: Dict[int, jax.Array] = {}
+        self._applied = False
+
+    def _acc_of(self, p):
+        a = self._acc.get(id(p))
+        if a is None:
+            z = jnp.zeros_like(p._data)
+            a = {"sum_1": z, "sum_2": z, "sum_3": z,
+                 "num_accumulates": 0, "old_num_accumulates": 0,
+                 "num_updates": 0}
+            self._acc[id(p)] = a
+        return a
+
+    @no_grad()
+    def step(self):
+        enforce(self._parameter_list is not None,
+                "ModelAverage needs parameters=model.parameters()",
+                InvalidArgumentError)
+        enforce(not self._applied,
+                "ModelAverage.step() inside apply() — restore() first",
+                InvalidArgumentError)
+        for p in self._parameter_list:
+            a = self._acc_of(p)
+            a["num_updates"] += 1
+            a["num_accumulates"] += 1
+            a["sum_1"] = a["sum_1"] + p._data
+            if a["num_updates"] % self._MAX_NUM_ACCUMULATES == 0:
+                a["sum_2"] = a["sum_2"] + a["sum_1"]
+                a["sum_1"] = jnp.zeros_like(a["sum_1"])
+            if (a["num_accumulates"] >= self.min_average_window
+                    and a["num_accumulates"] >= min(
+                        self.max_average_window,
+                        a["num_updates"] * self.average_window)):
+                a["sum_3"] = a["sum_1"] + a["sum_2"]
+                a["sum_1"] = jnp.zeros_like(a["sum_1"])
+                a["sum_2"] = jnp.zeros_like(a["sum_2"])
+                a["old_num_accumulates"] = a["num_accumulates"]
+                a["num_accumulates"] = 0
+
+    minimize_step = step
+
+    def _average_of(self, p):
+        a = self._acc_of(p)
+        total = a["num_accumulates"] + a["old_num_accumulates"]
+        if total == 0:
+            return p._data
+        s = a["sum_1"] + a["sum_2"] + a["sum_3"]
+        return (s / float(total)).astype(p._data.dtype)
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        """Context manager: inside, parameters hold their windowed
+        average (reference :374). need_restore=False leaves the averaged
+        weights in place on exit (pair with an explicit restore())."""
+        enforce(not self._applied, "apply() is not reentrant",
+                InvalidArgumentError)
+        enforce(self._parameter_list is not None,
+                "ModelAverage needs parameters=model.parameters()",
+                InvalidArgumentError)
+        for p in self._parameter_list:
+            self._restore_buf[id(p)] = p._data
+            p._data = self._average_of(p)
+        self._applied = True
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        """Undo apply() (reference :430)."""
+        if not self._applied:
+            return
+        for p in self._parameter_list:
+            buf = self._restore_buf.pop(id(p), None)
+            if buf is not None:
+                p._data = buf
+        self._applied = False
+
+    def clear_grad(self, set_to_zero=False):
+        pass
+
+    clear_gradients = clear_grad

@@ -28,10 +28,15 @@ class Optimizer:
         self._parameter_list = list(parameters) if parameters is not None else None
         self._grad_clip = grad_clip
         self._multi_precision = multi_precision
+        self._global_reg = None
         if isinstance(weight_decay, float) or weight_decay is None:
             self._coupled_wd = weight_decay  # L2-style added to grad
         else:
-            self._coupled_wd = getattr(weight_decay, "_coeff", None)
+            # paddle.regularizer.L1Decay/L2Decay (or any _coeff object);
+            # L1 needs the sign(param) grad term, not the wd slot
+            self._global_reg = weight_decay
+            self._coupled_wd = None if getattr(weight_decay, "_l1", False) \
+                else getattr(weight_decay, "_coeff", None)
         self._state: Dict[int, dict] = {}       # id(param) -> state pytree
         self._master: Dict[int, jax.Array] = {}  # fp32 master weights
         self._accumulators_created = False
@@ -81,11 +86,11 @@ class Optimizer:
                 self._state[pid] = self.init_state(p._data)
                 if self._multi_precision and p.dtype != jnp.float32:
                     self._master[pid] = p._data.astype(jnp.float32)
-            wd = self._param_wd(p)
             arr = self._master.get(pid, p._data)
             g_arr = g._data
             if g_arr.dtype != arr.dtype:
                 g_arr = g_arr.astype(arr.dtype)
+            g_arr, wd = self._regularized(p, arr, g_arr)
             new_p, new_s = self.apply_one(arr, g_arr, self._state[pid], lr, wd)
             self._state[pid] = new_s
             if pid in self._master:
@@ -96,12 +101,33 @@ class Optimizer:
 
     minimize_step = step
 
-    def _param_wd(self, p):
+    def _apply_reg(self, reg, arr, g_arr):
+        """(grad', wd) for one param under regularizer `reg` (may be
+        None -> optimizer-wide weight_decay). A per-param
+        ParamAttr(regularizer=...) overrides the optimizer-wide one
+        (reference fluid/regularizer.py append_regularization_ops
+        priority); L1Decay adds coeff*sign(param) to the grad, L2-style
+        decay rides the wd slot apply_one already consumes."""
+        if reg is None:
+            reg = self._global_reg
+        if reg is not None and getattr(reg, "_l1", False):
+            return g_arr + reg._coeff * jnp.sign(arr), 0.0
         wd = self._coupled_wd or 0.0
-        reg = getattr(p, "regularizer", None)
         if reg is not None and hasattr(reg, "_coeff"):
             wd = reg._coeff
-        return wd
+        return g_arr, wd
+
+    def _regularized(self, p, arr, g_arr):
+        return self._apply_reg(getattr(p, "regularizer", None), arr, g_arr)
+
+    def collect_param_regularizers(self, layer):
+        """Record {param-name: regularizer} so the functional path (keyed
+        by named_parameters names) honours per-param ParamAttr
+        regularizers the same way the eager step() does. Called by the
+        compiled-step builders (hapi / fleet)."""
+        self._param_regs = {
+            name: p.regularizer for name, p in layer.named_parameters()
+            if getattr(p, "regularizer", None) is not None}
 
     @no_grad()
     def clear_grad(self, set_to_zero=False):
@@ -138,7 +164,11 @@ class Optimizer:
                 continue
             if g.dtype != p.dtype:
                 g = g.astype(p.dtype)
-            wd = self._coupled_wd or 0.0
+            # per-param regs resolve by name when the step builder called
+            # collect_param_regularizers; otherwise the optimizer-wide
+            # weight_decay applies
+            g, wd = self._apply_reg(
+                getattr(self, "_param_regs", {}).get(k), p, g)
             new_params[k], new_state[k] = self.apply_one(
                 p, g, opt_state[k], lr, wd)
         return new_params, new_state

@@ -1,0 +1,43 @@
+"""paddle.regularizer — L1Decay / L2Decay.
+
+Reference: python/paddle/regularizer.py:20 (L1Decay), :82 (L2Decay); the
+reference appends a decay op to each parameter's gradient in the
+append_regularization_ops pass (fluid/regularizer.py). TPU-native: the
+optimizer folds the decay term into the gradient at update time —
+L2Decay via the coupled weight-decay slot every apply_one already takes,
+L1Decay as coeff * sign(param) added to the gradient.
+
+Resolution order matches the reference: a ParamAttr(regularizer=...) on
+the parameter overrides the optimizer-wide weight_decay regularizer
+(fluid/regularizer.py append_regularization_ops: "The Regularizer
+specified in Parameter has higher priority").
+"""
+from __future__ import annotations
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class WeightDecayRegularizer:
+    _l1 = False
+
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    @property
+    def coeff(self):
+        return self._coeff
+
+    def __repr__(self):
+        return f"{type(self).__name__}(coeff={self._coeff})"
+
+
+class L1Decay(WeightDecayRegularizer):
+    """loss += coeff * sum(|param|)  ->  grad += coeff * sign(param)."""
+
+    _l1 = True
+
+
+class L2Decay(WeightDecayRegularizer):
+    """loss += 0.5 * coeff * sum(param^2)  ->  grad += coeff * param."""
+
+    _l1 = False

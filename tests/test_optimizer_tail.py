@@ -1,0 +1,239 @@
+"""LookAhead / ModelAverage / regularizer parity vs hand-computed
+updates (r2 verdict item 7)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.framework import ParamAttr
+from paddle_tpu.incubate.optimizer import LookAhead, ModelAverage
+from paddle_tpu.regularizer import L1Decay, L2Decay
+
+
+def _param(val):
+    lin = nn.Linear(1, 1)
+    lin.weight._data = jnp.asarray([[float(val)]], jnp.float32)
+    lin.bias._data = jnp.asarray([0.0], jnp.float32)
+    return lin
+
+
+def _step(lin, opt_, gw=1.0):
+    """One backward+step with d(loss)/dw == gw exactly."""
+    x = paddle.to_tensor(np.array([[float(gw)]], np.float32))
+    out = lin(x)
+    paddle.sum(out).backward()
+    opt_.step()
+    opt_.clear_grad()
+    return float(np.asarray(lin.weight._data).reshape(()))
+
+
+# -- LookAhead -------------------------------------------------------------
+
+def test_lookahead_hand_computed():
+    lin = _param(1.0)
+    inner = opt.SGD(learning_rate=0.1, parameters=lin.parameters())
+    la = LookAhead(inner, alpha=0.5, k=3)
+    # fast: 1.0 -> 0.9 -> 0.8 -> 0.7; at k=3: slow = 1 + .5*(0.7-1) = 0.85
+    assert abs(_step(lin, la) - 0.9) < 1e-6
+    assert abs(_step(lin, la) - 0.8) < 1e-6
+    assert abs(_step(lin, la) - 0.85) < 1e-6
+    # next cycle starts from 0.85: 0.75, 0.65, 0.55 -> slow=0.85+.5*(-0.3)=0.7
+    assert abs(_step(lin, la) - 0.75) < 1e-6
+    assert abs(_step(lin, la) - 0.65) < 1e-6
+    assert abs(_step(lin, la) - 0.70) < 1e-6
+
+
+def test_lookahead_functional_matches_eager():
+    params = {"w": jnp.asarray([2.0], jnp.float32)}
+    grads = {"w": jnp.asarray([1.0], jnp.float32)}
+    inner = opt.SGD(learning_rate=0.1)
+    la = LookAhead(inner, alpha=0.5, k=2)
+    st = la.functional_init(params)
+    p = params
+    seen = []
+    for _ in range(4):
+        p, st = la.functional_update(p, grads, st, lr=0.1)
+        seen.append(float(p["w"][0]))
+    # fast: 1.9, sync at 2: slow=2+.5*(1.8-2)=1.9 -> 1.9? hand-compute:
+    # s0=2: f=1.9; f=1.8 sync-> m=2+.5*(1.8-2)=1.9; f=1.8; f=1.7 sync->
+    # m=1.9+.5*(1.7-1.9)=1.8
+    np.testing.assert_allclose(seen, [1.9, 1.9, 1.8, 1.8], atol=1e-6)
+
+
+def test_lookahead_validation():
+    inner = opt.SGD(learning_rate=0.1)
+    with pytest.raises(Exception):
+        LookAhead(inner, alpha=2.0)
+    with pytest.raises(Exception):
+        LookAhead(inner, k=0)
+    with pytest.raises(Exception):
+        LookAhead("not an optimizer")
+
+
+def test_lookahead_with_adam_trains():
+    paddle.seed(0)
+    lin = nn.Linear(4, 2)
+    inner = opt.Adam(learning_rate=1e-2, parameters=lin.parameters())
+    la = LookAhead(inner, alpha=0.8, k=5)
+    x = paddle.to_tensor(np.random.default_rng(0).standard_normal(
+        (8, 4)).astype(np.float32))
+    losses = []
+    for _ in range(12):
+        loss = paddle.mean((lin(x) - 1.0) ** 2)
+        loss.backward()
+        la.step()
+        la.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+# -- ModelAverage ----------------------------------------------------------
+
+def test_model_average_hand_computed():
+    lin = _param(0.0)
+    sgd = opt.SGD(learning_rate=1.0, parameters=lin.parameters())
+    ma = ModelAverage(average_window_rate=1.0,
+                      parameters=lin.parameters(),
+                      min_average_window=2, max_average_window=100)
+    # w after each sgd step: -1, -2, -3 (grad=1, lr=1)
+    ws = []
+    for _ in range(3):
+        _step(lin, sgd)
+        ma.step()
+        ws.append(float(np.asarray(lin.weight._data).reshape(())))
+    assert ws == [-1.0, -2.0, -3.0]
+    # window holds the last accumulation cycle; with rate=1 min=2 the
+    # window resets at step>=2, so average covers a suffix — compute it
+    # through the same kernel math:
+    # step1: sum1=-1 na=1; step2: sum1=-3 na=2 -> reset: sum3=-3 old=2
+    # step3: sum1=-3 na=1 -> avg=(-3 + -3)/(1+2)=-2
+    with ma.apply():
+        assert abs(float(np.asarray(lin.weight._data).reshape(())) - (-2.0)) < 1e-6
+    # restored afterwards
+    assert float(np.asarray(lin.weight._data).reshape(())) == -3.0
+
+
+def test_model_average_apply_no_restore_then_restore():
+    lin = _param(0.0)
+    sgd = opt.SGD(learning_rate=1.0, parameters=lin.parameters())
+    ma = ModelAverage(1.0, parameters=lin.parameters(),
+                      min_average_window=1, max_average_window=1)
+    _step(lin, sgd)
+    ma.step()
+    with ma.apply(need_restore=False):
+        pass
+    applied = float(np.asarray(lin.weight._data).reshape(()))
+    ma.restore()
+    assert float(np.asarray(lin.weight._data).reshape(())) == -1.0
+    assert applied == -1.0  # single-step window = the param itself
+
+
+def test_model_average_precision_rotation():
+    lin = _param(1.0)
+    ma = ModelAverage(1e9, parameters=lin.parameters(),
+                      min_average_window=10 ** 8,
+                      max_average_window=10 ** 8)
+    ma._MAX_NUM_ACCUMULATES = 4   # exercise the rotation cheaply
+    for _ in range(9):
+        ma.step()
+    a = ma._acc[id(lin.weight)]
+    # after 9 steps with rotation at 4: sum_2 holds 8 copies, sum_1 one
+    np.testing.assert_allclose(np.asarray(a["sum_2"]), [[8.0]])
+    np.testing.assert_allclose(np.asarray(a["sum_1"]), [[1.0]])
+    with ma.apply():
+        np.testing.assert_allclose(
+            np.asarray(lin.weight._data), [[1.0]], atol=1e-6)
+
+
+# -- regularizer -----------------------------------------------------------
+
+def test_l2decay_optimizer_wide():
+    lin = _param(2.0)
+    sgd = opt.SGD(learning_rate=0.1, parameters=lin.parameters(),
+                  weight_decay=L2Decay(0.5))
+    # grad = 1 + 0.5*2 = 2 -> w = 2 - 0.1*2 = 1.8
+    assert abs(_step(lin, sgd) - 1.8) < 1e-6
+
+
+def test_l1decay_optimizer_wide():
+    lin = _param(2.0)
+    sgd = opt.SGD(learning_rate=0.1, parameters=lin.parameters(),
+                  weight_decay=L1Decay(0.5))
+    # grad = 1 + 0.5*sign(2) = 1.5 -> w = 2 - 0.15 = 1.85
+    assert abs(_step(lin, sgd) - 1.85) < 1e-6
+    lin2 = _param(-2.0)
+    sgd2 = opt.SGD(learning_rate=0.1, parameters=lin2.parameters(),
+                   weight_decay=L1Decay(0.5))
+    # grad = 1 - 0.5 = 0.5 -> w = -2.05
+    assert abs(_step(lin2, sgd2) - (-2.05)) < 1e-6
+
+
+def test_param_attr_regularizer_overrides_optimizer():
+    paddle.seed(0)
+    lin = nn.Linear(1, 1,
+                    weight_attr=ParamAttr(regularizer=L1Decay(1.0)))
+    lin.weight._data = jnp.asarray([[2.0]], jnp.float32)
+    lin.bias._data = jnp.asarray([0.0], jnp.float32)
+    sgd = opt.SGD(learning_rate=0.1, parameters=lin.parameters(),
+                  weight_decay=L2Decay(10.0))   # overridden for weight
+    # weight grad = 1 + 1*sign(2) = 2 -> 2 - 0.2 = 1.8 (L2(10) would
+    # give grad 21 -> -0.1); bias keeps the global L2 (bias=0 -> no-op)
+    assert abs(_step(lin, sgd) - 1.8) < 1e-6
+
+
+def test_l1_functional_path():
+    sgd = opt.SGD(learning_rate=0.1, weight_decay=L1Decay(0.5))
+    p = {"w": jnp.asarray([2.0], jnp.float32)}
+    g = {"w": jnp.asarray([1.0], jnp.float32)}
+    st = sgd.functional_init(p)
+    newp, _ = sgd.functional_update(p, g, st, lr=0.1)
+    np.testing.assert_allclose(np.asarray(newp["w"]), [1.85], atol=1e-6)
+
+
+def test_float_weight_decay_unchanged():
+    lin = _param(2.0)
+    sgd = opt.SGD(learning_rate=0.1, parameters=lin.parameters(),
+                  weight_decay=0.5)
+    assert abs(_step(lin, sgd) - 1.8) < 1e-6
+
+
+def test_lookahead_state_dict_roundtrip_mid_cycle():
+    lin = _param(1.0)
+    inner = opt.SGD(learning_rate=0.1, parameters=lin.parameters())
+    la = LookAhead(inner, alpha=0.5, k=3)
+    _step(lin, la)              # 0.9, mid-cycle
+    sd = la.state_dict()
+
+    lin2 = _param(float(np.asarray(lin.weight._data).reshape(())))
+    inner2 = opt.SGD(learning_rate=0.1, parameters=lin2.parameters())
+    la2 = LookAhead(inner2, alpha=0.5, k=3)
+    # remap saved slow key onto the new param name
+    sd2 = {k.replace(lin.weight.name, lin2.weight.name)
+           if k.startswith("__lookahead_slow__") else k: v
+           for k, v in sd.items()}
+    la2.set_state_dict(sd2)
+    # continue both
+    for _ in range(2):
+        a = _step(lin, la)
+        b = _step(lin2, la2)
+    assert abs(a - b) < 1e-6 and abs(a - 0.85) < 1e-6
+
+
+def test_param_attr_regularizer_on_functional_path():
+    """The r3 review gap: per-param ParamAttr regularizer must also
+    apply in compiled/functional steps (hapi fit path)."""
+    paddle.seed(0)
+    lin = nn.Linear(1, 1, weight_attr=ParamAttr(regularizer=L1Decay(1.0)))
+    lin.weight._data = jnp.asarray([[2.0]], jnp.float32)
+    lin.bias._data = jnp.asarray([0.0], jnp.float32)
+    sgd = opt.SGD(learning_rate=0.1, parameters=lin.parameters())
+    sgd.collect_param_regularizers(lin)
+    name = [n for n, _ in lin.named_parameters() if n.endswith("weight")][0]
+    p = {name: lin.weight._data}
+    g = {name: jnp.asarray([[1.0]], jnp.float32)}
+    newp, _ = sgd.functional_update(p, g, sgd.functional_init(p), lr=0.1)
+    # grad = 1 + sign(2) = 2 -> 2 - 0.2 = 1.8
+    np.testing.assert_allclose(np.asarray(newp[name]), [[1.8]], atol=1e-6)
