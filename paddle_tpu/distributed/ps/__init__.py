@@ -28,6 +28,7 @@ from typing import Dict, Optional
 import numpy as np
 
 __all__ = ["build_server_binary", "PSServer", "PSClient",
+           "ShardedPSClient", "PSServerDownError",
            "AsyncCommunicator", "GeoCommunicator"]
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -80,11 +81,30 @@ class PSServer:
         self.stop()
 
 
+class PSServerDownError(RuntimeError):
+    """A parameter server stopped answering (heartbeat timeout or broken
+    RPC). Reference analog: HeartBeatMonitor marking a worker/server
+    UNINITED (operators/distributed/heart_beat_monitor.h:51)."""
+
+
 class PSClient:
     """Blocking RPC verbs over one TCP connection (ps_client.h:60 analog).
-    Not thread-safe; AsyncCommunicator owns its own client."""
+    Not thread-safe; AsyncCommunicator owns its own client.
+
+    Constructing with a LIST of endpoints returns a ShardedPSClient —
+    the multi-server fleet client (dense tables range-split, sparse
+    tables key-sharded), mirroring ps_client.h:60's server-fleet
+    management."""
+
+    def __new__(cls, endpoint="", timeout: float = 30.0, **kw):
+        if cls is PSClient and isinstance(endpoint, (list, tuple)) \
+                and len(endpoint) > 1:
+            return object.__new__(ShardedPSClient)
+        return object.__new__(cls)
 
     def __init__(self, endpoint: str, timeout: float = 30.0):
+        if isinstance(endpoint, (list, tuple)):
+            (endpoint,) = endpoint
         host, port = endpoint.rsplit(":", 1)
         self._sock = socket.create_connection((host, int(port)),
                                               timeout=timeout)
@@ -169,6 +189,262 @@ class PSClient:
 
     def close(self):
         self._sock.close()
+
+
+class ShardedPSClient(PSClient):
+    """Fleet client over N servers (reference PSClient manages a server
+    fleet, service/ps_client.h:60; tables shard across servers,
+    table/table.h:32).
+
+    Sharding is client-side and deterministic, so every worker routes
+    identically with no coordination:
+    - sparse tables: row for key k lives on server k % n (the
+      reference's shard_num modulo in its sparse tables);
+    - dense tables: range-split — server i holds a contiguous slice of
+      ceil/floor(size/n) elements, pulls concatenate, pushes scatter;
+    - barrier runs on server 0 (one rendezvous point);
+    - create/save/load/stop broadcast (save/load get per-server
+      ".shardN" paths).
+
+    A heartbeat thread pings every server each `heartbeat_interval`
+    seconds (reference heart_beat_monitor.h:51); a dead server turns
+    every subsequent verb into a clean PSServerDownError naming the
+    endpoint instead of a hung socket."""
+
+    def __init__(self, endpoint, timeout: float = 30.0,
+                 heartbeat_interval: float = 2.0,
+                 heartbeat_misses: int = 3):
+        endpoints = list(endpoint)
+        if len(endpoints) < 2:
+            raise ValueError("ShardedPSClient needs >= 2 endpoints")
+        self.endpoints = endpoints
+        self._timeout = timeout
+        self._n = len(endpoints)
+        self._dense_sizes: Dict[int, list] = {}
+        self._dead: Dict[int, str] = {}
+        self._misses = [0] * self._n
+        self._hb_misses = max(int(heartbeat_misses), 1)
+        self._hb_stop = threading.Event()
+        self._hb_lock = threading.Lock()
+        self._clients = []
+        self._hb_clients = []
+        try:
+            for ep in endpoints:
+                self._clients.append(PSClient(ep, timeout=timeout))
+            for ep in endpoints:
+                self._hb_clients.append(PSClient(ep, timeout=timeout))
+        except Exception:
+            for c in self._clients + self._hb_clients:
+                try:
+                    c.close()
+                except Exception:
+                    pass
+            raise
+        import concurrent.futures as _fut
+        self._pool = _fut.ThreadPoolExecutor(
+            max_workers=self._n, thread_name_prefix="ps-shard")
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop, args=(heartbeat_interval,),
+            daemon=True)
+        self._hb_thread.start()
+
+    # -- liveness ----------------------------------------------------------
+    def _heartbeat_loop(self, interval: float):
+        while not self._hb_stop.wait(interval):
+            for i in range(self._n):
+                try:
+                    self._hb_clients[i].ping()
+                except Exception as e:
+                    # the probe socket dies WITH the server — a revived
+                    # server is only visible through a fresh connection
+                    if not self._hb_reconnect(i):
+                        self._misses[i] += 1
+                        if self._misses[i] >= self._hb_misses \
+                                and i not in self._dead:
+                            with self._hb_lock:
+                                self._dead[i] = f"heartbeat failed: {e}"
+                        continue
+                self._misses[i] = 0
+                if i in self._dead:
+                    # server answers again: reconnect the verb socket
+                    # and lift the quarantine
+                    self._try_revive(i)
+
+    def _hb_reconnect(self, i: int) -> bool:
+        try:
+            fresh = PSClient(self.endpoints[i], timeout=self._timeout)
+            fresh.ping()
+        except Exception:
+            return False
+        old, self._hb_clients[i] = self._hb_clients[i], fresh
+        try:
+            old.close()
+        except Exception:
+            pass
+        return True
+
+    def _try_revive(self, i: int):
+        try:
+            fresh = PSClient(self.endpoints[i], timeout=self._timeout)
+        except Exception:
+            return
+        with self._hb_lock:
+            old, self._clients[i] = self._clients[i], fresh
+            self._dead.pop(i, None)
+        try:
+            old.close()
+        except Exception:
+            pass
+
+    def _check(self, i: int):
+        why = self._dead.get(i)
+        if why:
+            raise PSServerDownError(
+                f"parameter server {i} at {self.endpoints[i]} is down "
+                f"({why}); its table shards are unavailable")
+
+    def _call(self, i: int, fn, *args, mark_dead=True, **kw):
+        self._check(i)
+        try:
+            return fn(self._clients[i], *args, **kw)
+        except PSServerDownError:
+            raise
+        except socket.timeout:
+            # slow != dead (a barrier legitimately blocks); leave
+            # liveness to the heartbeat and surface the timeout
+            raise
+        except (OSError, ConnectionError, struct.error) as e:
+            if mark_dead:
+                with self._hb_lock:
+                    self._dead[i] = f"rpc failed: {e}"
+            raise PSServerDownError(
+                f"parameter server {i} at {self.endpoints[i]} died "
+                f"mid-request: {e}") from e
+
+    def _fanout(self, fn_of_i):
+        """Run fn_of_i(i) for every server on the connection pool —
+        per-verb latency stays ~1 RTT instead of N serialized RTTs. Any
+        shard failure propagates after all futures settle."""
+        futs = [self._pool.submit(fn_of_i, i) for i in range(self._n)]
+        out, err = [], None
+        for f in futs:
+            try:
+                out.append(f.result())
+            except Exception as e:
+                err = err or e
+                out.append(None)
+        if err is not None:
+            raise err
+        return out
+
+    def alive(self) -> list:
+        return [i for i in range(self._n) if i not in self._dead]
+
+    # -- dense: range-split ------------------------------------------------
+    def _dense_split(self, size: int) -> list:
+        base, rem = divmod(size, self._n)
+        return [base + (1 if i < rem else 0) for i in range(self._n)]
+
+    def create_dense_table(self, table: int, size: int,
+                           init: Optional[np.ndarray] = None):
+        if init is not None:
+            init = np.ascontiguousarray(init, np.float32).ravel()
+            size = init.size
+        sizes = self._dense_split(size)
+        offs = np.concatenate([[0], np.cumsum(sizes)])
+        self._fanout(lambda i: self._call(
+            i, PSClient.create_dense_table, table, sizes[i],
+            init[offs[i]:offs[i + 1]] if init is not None else None))
+        self._dense_sizes[table] = sizes
+
+    def _sizes_of(self, table: int) -> list:
+        sizes = self._dense_sizes.get(table)
+        if sizes is None:
+            # another worker created the table; discover shard sizes
+            sizes = [p.size for p in self._fanout(
+                lambda i: self._call(i, PSClient.pull_dense, table))]
+            self._dense_sizes[table] = sizes
+        return sizes
+
+    def pull_dense(self, table: int) -> np.ndarray:
+        parts = self._fanout(
+            lambda i: self._call(i, PSClient.pull_dense, table))
+        self._dense_sizes.setdefault(table, [p.size for p in parts])
+        return np.concatenate(parts)
+
+    def push_dense(self, table: int, grad: np.ndarray, lr: float = 1.0):
+        g = np.ascontiguousarray(grad, np.float32).ravel()
+        sizes = self._sizes_of(table)
+        offs = np.concatenate([[0], np.cumsum(sizes)])
+        self._fanout(lambda i: self._call(
+            i, PSClient.push_dense, table, g[offs[i]:offs[i + 1]], lr))
+
+    # -- sparse: key-sharded -----------------------------------------------
+    def create_sparse_table(self, table: int, dim: int):
+        self._fanout(lambda i: self._call(
+            i, PSClient.create_sparse_table, table, dim))
+
+    def _route(self, keys: np.ndarray):
+        keys = np.ascontiguousarray(keys, np.uint64).ravel()
+        owner = (keys % np.uint64(self._n)).astype(np.int64)
+        return keys, owner
+
+    def pull_sparse(self, table: int, keys: np.ndarray,
+                    dim: int) -> np.ndarray:
+        keys, owner = self._route(keys)
+        out = np.empty((keys.size, dim), np.float32)
+        idxs = [np.nonzero(owner == i)[0] for i in range(self._n)]
+
+        def one(i):
+            if idxs[i].size:
+                out[idxs[i]] = self._call(
+                    i, PSClient.pull_sparse, table, keys[idxs[i]], dim)
+
+        self._fanout(one)
+        return out
+
+    def push_sparse(self, table: int, keys: np.ndarray, grads: np.ndarray,
+                    lr: float = 1.0):
+        keys, owner = self._route(keys)
+        g = np.ascontiguousarray(grads, np.float32).reshape(keys.size, -1)
+        idxs = [np.nonzero(owner == i)[0] for i in range(self._n)]
+        self._fanout(lambda i: self._call(
+            i, PSClient.push_sparse, table, keys[idxs[i]], g[idxs[i]], lr)
+            if idxs[i].size else None)
+
+    # -- control -----------------------------------------------------------
+    def barrier(self, world: int):
+        # barrier blocking is not a liveness signal
+        self._call(0, PSClient.barrier, world, mark_dead=False)
+
+    def ping(self):
+        self._fanout(lambda i: self._call(i, PSClient.ping))
+
+    def save(self, path: str):
+        self._fanout(lambda i: self._call(
+            i, PSClient.save, f"{path}.shard{i}"))
+
+    def load(self, path: str):
+        self._fanout(lambda i: self._call(
+            i, PSClient.load, f"{path}.shard{i}"))
+
+    def stop_server(self):
+        for i in range(self._n):
+            if i not in self._dead:
+                try:
+                    self._clients[i].stop_server()
+                except Exception:
+                    pass
+
+    def close(self):
+        self._hb_stop.set()
+        self._hb_thread.join(timeout=5)
+        self._pool.shutdown(wait=False)
+        for c in self._clients + self._hb_clients:
+            try:
+                c.close()
+            except Exception:
+                pass
 
 
 class AsyncCommunicator:

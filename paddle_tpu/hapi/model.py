@@ -516,8 +516,26 @@ class Model:
 
         cbks.on_begin("train")
         self.stop_training = False
-        global_step = 0
         logs = {}
+        try:
+            self._fit_epochs(epochs, train_loader, eval_loader, eval_freq,
+                             batch_size, num_iters, prefetch_device, cbks,
+                             logs)
+        finally:
+            # hand the user back a live Layer even on Ctrl-C / callback
+            # raise: the plain-path jitted step donated the layer's OWN
+            # buffers on step 1, so without this the network's Tensors
+            # reference deleted arrays. The strategy path device_put-
+            # COPIES at compile (tensors stay valid, just stale) and
+            # keeps the deferred write_back on eval/save — a full host
+            # gather per fit() costs seconds on big models.
+            if self._jit_step is not None:
+                self._write_back(self._params, self._state)
+        return self
+
+    def _fit_epochs(self, epochs, train_loader, eval_loader, eval_freq,
+                    batch_size, num_iters, prefetch_device, cbks, logs):
+        global_step = 0
         for epoch in range(epochs):
             if self.stop_training:
                 break
@@ -548,15 +566,6 @@ class Model:
                 logs.update({"eval_" + k: v for k, v in eval_logs.items()})
             cbks.on_epoch_end(epoch, logs)
         cbks.on_end("train", logs)
-        # hand the user back a live Layer on the plain path: its jitted
-        # step donated the layer's OWN buffers on step 1, so without this
-        # the network's Tensors reference deleted arrays. The strategy
-        # path device_put-COPIES at compile (layer tensors stay valid,
-        # just stale) and keeps the deferred write_back on eval/save —
-        # a full host gather per fit() would cost seconds on big models.
-        if self._jit_step is not None:
-            self._write_back(self._params, self._state)
-        return self
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None, _inside_fit=None):

@@ -32,11 +32,12 @@ class Optimizer:
         if isinstance(weight_decay, float) or weight_decay is None:
             self._coupled_wd = weight_decay  # L2-style added to grad
         else:
-            # paddle.regularizer.L1Decay/L2Decay (or any _coeff object);
-            # L1 needs the sign(param) grad term, not the wd slot
+            # paddle.regularizer.L1Decay/L2Decay (or any _coeff object):
+            # applied grad-side in _apply_reg, NOT via the wd slot —
+            # optimizers with decoupled decay (AdamW/Lamb) ignore the wd
+            # argument, which would silently drop the regularizer
             self._global_reg = weight_decay
-            self._coupled_wd = None if getattr(weight_decay, "_l1", False) \
-                else getattr(weight_decay, "_coeff", None)
+            self._coupled_wd = None
         self._state: Dict[int, dict] = {}       # id(param) -> state pytree
         self._master: Dict[int, jax.Array] = {}  # fp32 master weights
         self._accumulators_created = False
@@ -110,12 +111,14 @@ class Optimizer:
         decay rides the wd slot apply_one already consumes."""
         if reg is None:
             reg = self._global_reg
-        if reg is not None and getattr(reg, "_l1", False):
-            return g_arr + reg._coeff * jnp.sign(arr), 0.0
-        wd = self._coupled_wd or 0.0
         if reg is not None and hasattr(reg, "_coeff"):
-            wd = reg._coeff
-        return g_arr, wd
+            # grad-side application works for EVERY optimizer (the wd
+            # slot is `g + wd*p` where consumed, and ignored by the
+            # decoupled-decay optimizers)
+            if getattr(reg, "_l1", False):
+                return g_arr + reg._coeff * jnp.sign(arr), 0.0
+            return g_arr + reg._coeff * arr, 0.0
+        return g_arr, (self._coupled_wd or 0.0)
 
     def _regularized(self, p, arr, g_arr):
         return self._apply_reg(getattr(p, "regularizer", None), arr, g_arr)

@@ -237,3 +237,25 @@ def test_param_attr_regularizer_on_functional_path():
     newp, _ = sgd.functional_update(p, g, sgd.functional_init(p), lr=0.1)
     # grad = 1 + sign(2) = 2 -> 2 - 0.2 = 1.8
     np.testing.assert_allclose(np.asarray(newp[name]), [[1.8]], atol=1e-6)
+
+
+def test_l2decay_applies_under_adamw():
+    """r3 review gap: decoupled-decay optimizers ignore the wd slot, so
+    regularizer objects must act grad-side — AdamW with a per-param
+    L2Decay must differ from AdamW without it."""
+    paddle.seed(0)
+
+    def run(reg):
+        lin = nn.Linear(1, 1, weight_attr=ParamAttr(regularizer=reg)
+                        if reg else None)
+        lin.weight._data = jnp.asarray([[2.0]], jnp.float32)
+        lin.bias._data = jnp.asarray([0.0], jnp.float32)
+        aw = opt.AdamW(learning_rate=0.1, parameters=lin.parameters(),
+                       weight_decay=0.0)
+        # Adam's first step is ~sign(g)*lr regardless of |g|; several
+        # steps with a decaying param let the L2 term actually move it
+        for _ in range(5):
+            out = _step(lin, aw)
+        return out
+
+    assert abs(run(L2Decay(5.0)) - run(None)) > 1e-4
