@@ -190,13 +190,15 @@ def server_endpoints():
 
 
 def ps_client():
-    """Worker-side connection to the (first) server endpoint."""
+    """Worker-side connection to the server fleet: one endpoint gives a
+    plain client, several give the sharded fleet client (tables
+    key-shard / range-split across servers)."""
     from ..ps import PSClient
     if _ps_state["client"] is None:
         eps = server_endpoints()
         if not eps:
             raise RuntimeError("PADDLE_PSERVERS_IP_PORT_LIST not set")
-        _ps_state["client"] = PSClient(eps[0])
+        _ps_state["client"] = PSClient(eps)
     return _ps_state["client"]
 
 

@@ -226,13 +226,18 @@ class ShardedPSClient(PSClient):
         self._hb_misses = max(int(heartbeat_misses), 1)
         self._hb_stop = threading.Event()
         self._hb_lock = threading.Lock()
+        # probes use a SHORT timeout: a black-holed server must not stall
+        # detection (or the probing of its neighbours) for the full RPC
+        # timeout per round
+        self._hb_timeout = min(timeout, max(float(heartbeat_interval), 1.0))
         self._clients = []
         self._hb_clients = []
         try:
             for ep in endpoints:
                 self._clients.append(PSClient(ep, timeout=timeout))
             for ep in endpoints:
-                self._hb_clients.append(PSClient(ep, timeout=timeout))
+                self._hb_clients.append(
+                    PSClient(ep, timeout=self._hb_timeout))
         except Exception:
             for c in self._clients + self._hb_clients:
                 try:
@@ -272,7 +277,7 @@ class ShardedPSClient(PSClient):
 
     def _hb_reconnect(self, i: int) -> bool:
         try:
-            fresh = PSClient(self.endpoints[i], timeout=self._timeout)
+            fresh = PSClient(self.endpoints[i], timeout=self._hb_timeout)
             fresh.ping()
         except Exception:
             return False

@@ -107,8 +107,10 @@ class Optimizer:
         None -> optimizer-wide weight_decay). A per-param
         ParamAttr(regularizer=...) overrides the optimizer-wide one
         (reference fluid/regularizer.py append_regularization_ops
-        priority); L1Decay adds coeff*sign(param) to the grad, L2-style
-        decay rides the wd slot apply_one already consumes."""
+        priority). Regularizer OBJECTS always apply grad-side (L1:
+        coeff*sign(param), L2: coeff*param) — NOT via the wd slot, which
+        decoupled-decay optimizers (AdamW/Lamb) ignore; only a plain
+        float weight_decay rides the wd slot."""
         if reg is None:
             reg = self._global_reg
         if reg is not None and hasattr(reg, "_coeff"):

@@ -4,8 +4,9 @@ Reference: python/paddle/regularizer.py:20 (L1Decay), :82 (L2Decay); the
 reference appends a decay op to each parameter's gradient in the
 append_regularization_ops pass (fluid/regularizer.py). TPU-native: the
 optimizer folds the decay term into the gradient at update time —
-L2Decay via the coupled weight-decay slot every apply_one already takes,
-L1Decay as coeff * sign(param) added to the gradient.
+L2Decay as coeff * param and L1Decay as coeff * sign(param), both added
+to the gradient (grad-side, so the decay also reaches optimizers whose
+own weight_decay is decoupled, e.g. AdamW/Lamb).
 
 Resolution order matches the reference: a ParamAttr(regularizer=...) on
 the parameter overrides the optimizer-wide weight_decay regularizer
