@@ -242,6 +242,15 @@ def compile_explicit_dp_step(layer, optimizer, strategy, mesh,
                         "since": jnp.where(do_sync, 0, since),
                         "k": k_now, "loss0": loss0}
         loss = jax.lax.pmean(loss, "dp")
+        # layer buffers (BN running stats) update per-rank on different
+        # data shards but leave the shard_map under a replicated
+        # out_spec: pmean the float buffers so every rank agrees
+        # (sync-BN-style running stats); integer counters advance
+        # identically per rank and stay as-is
+        new_st = jax.tree_util.tree_map(
+            lambda b: (jax.lax.pmean(b, "dp")
+                       if jnp.issubdtype(b.dtype, jnp.floating) else b),
+            new_st)
         if local_params:
             new_p = jax.tree_util.tree_map(lambda x: x[None], new_p)
             new_opt = jax.tree_util.tree_map(lambda x: x[None], new_opt)

@@ -224,16 +224,39 @@ class FileStore(Store):
                 raise TimeoutError(f"FileStore WAIT({key!r}) timed out")
             time.sleep(0.02)
 
+    # a holder that crashes between lock and unlock must not wedge every
+    # later add(): locks older than this are presumed orphaned and broken
+    _LOCK_STALE_S = 10.0
+
     def add(self, key, delta=1):
         # lock via atomic O_EXCL lockfile (NFS-safe enough for rendezvous)
         lock = self._fn(key) + ".lock"
+        token = f"{os.getpid()} {time.time_ns()} {id(self)}".encode()
         deadline = time.time() + 60.0
         while True:
             try:
                 fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                os.write(fd, token)
                 os.close(fd)
                 break
             except FileExistsError:
+                try:
+                    age = time.time() - os.path.getmtime(lock)
+                    if age > self._LOCK_STALE_S:
+                        # atomic reclaim: rename first so exactly ONE
+                        # waiter wins — a bare unlink could delete a
+                        # FRESH lock created after our staleness check,
+                        # admitting two writers
+                        grave = f"{lock}.reclaim.{os.getpid()}-" \
+                                f"{time.time_ns()}"
+                        try:
+                            os.rename(lock, grave)
+                            os.unlink(grave)
+                        except OSError:
+                            pass        # another waiter won the rename
+                        continue
+                except OSError:
+                    pass                # holder released it meanwhile
                 if time.time() > deadline:
                     raise TimeoutError(f"FileStore ADD lock on {key!r}")
                 time.sleep(0.01)
@@ -244,7 +267,19 @@ class FileStore(Store):
             self.set(key, now.to_bytes(8, "little", signed=True))
             return now
         finally:
-            os.unlink(lock)
+            # release only OUR lock: if a reclaimer stole it mid-section
+            # (we stalled past the staleness window), the current file
+            # belongs to someone else
+            try:
+                with open(lock, "rb") as f:
+                    mine = f.read() == token
+            except OSError:
+                mine = False
+            if mine:
+                try:
+                    os.unlink(lock)
+                except OSError:
+                    pass
 
     def delete_key(self, key):
         try:

@@ -192,7 +192,11 @@ void handle(Store& s, int fd) {
 
 int main(int argc, char** argv) {
   int port = argc > 1 ? std::atoi(argv[1]) : 0;
-  Store store;
+  // Heap-allocated and intentionally leaked: detached handler threads
+  // may still be blocked in read() when main returns — a stack-resident
+  // Store would leave scope under them (use-after-scope UB). The process
+  // exits right after, so the leak is one object for one instant.
+  Store& store = *new Store();
 
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
   int one = 1;
@@ -228,8 +232,8 @@ int main(int argc, char** argv) {
     }).detach();
   }
   ::close(fd);
-  // grace period: let detached handlers (notified via stopping/cv) drain
-  // before `store` leaves scope
+  // brief drain so handlers finish writing replies; stragglers only
+  // reference the leaked Store, which stays valid past return
   std::this_thread::sleep_for(std::chrono::milliseconds(100));
   return 0;
 }

@@ -11,6 +11,10 @@ paddle.fluid.core.CipherFactory). Dependency-free build: ChaCha20
 
 `paddle.save/load(..., cipher_key=...)` route through this module.
 File layout: magic "PDTC" | u8 version | 12B nonce | 16B tag | ciphertext.
+Version 2: the tag is the RFC 8439 ChaCha20-Poly1305 AEAD tag (empty
+AAD, one-time key from the counter-0 block) — authenticated encryption,
+not just corruption detection. Version-1 files (pre-Poly1305 tag) are
+rejected; re-encrypt with the current build.
 """
 from __future__ import annotations
 
@@ -25,7 +29,7 @@ __all__ = ["Cipher", "CipherFactory", "encrypt", "decrypt",
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "native")
 _MAGIC = b"PDTC"
-_VERSION = 1
+_VERSION = 2
 _lib = None
 
 
@@ -50,6 +54,7 @@ def _load_lib():
         return _load_lib()
     lib.pd_chacha20_xor.restype = ctypes.c_int
     lib.pd_chacha20_mac.restype = ctypes.c_int
+    lib.pd_poly1305.restype = ctypes.c_int
     _lib = lib
     return lib
 

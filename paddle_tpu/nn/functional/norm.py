@@ -35,23 +35,27 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     reduce_axes = tuple(i for i in range(x.ndim) if i != (ch_axis % x.ndim))
 
     if use_batch_stats:
-        # compute in fp32 for stability regardless of activation dtype
+        # compute in fp32 for stability regardless of activation dtype.
+        # apply() mirrors its input kind: under functional_call the batch
+        # arrives as a raw traced array, so take payloads defensively
         mean_new = apply(lambda a: jnp.mean(a.astype(jnp.float32),
                                             axis=reduce_axes), x)
         var_new = apply(lambda a: jnp.var(a.astype(jnp.float32),
                                           axis=reduce_axes), x)
         with_stats_mean, with_stats_var = mean_new, var_new
+        mn = mean_new._data if isinstance(mean_new, Tensor) else mean_new
+        vn = var_new._data if isinstance(var_new, Tensor) else var_new
         # running-stat update (reference: batch_norm_op momentum convention:
         # running = momentum * running + (1-momentum) * batch)
         if running_mean is not None:
             running_mean.set_value(
                 momentum * running_mean._data.astype(jnp.float32)
-                + (1.0 - momentum) * mean_new._data)
+                + (1.0 - momentum) * mn)
         if running_var is not None:
             n = 1
             for i in reduce_axes:
                 n *= x.shape[i]
-            unbiased = var_new._data * (n / max(n - 1, 1))
+            unbiased = vn * (n / max(n - 1, 1))
             running_var.set_value(
                 momentum * running_var._data.astype(jnp.float32)
                 + (1.0 - momentum) * unbiased)

@@ -198,6 +198,11 @@ def _replace_children(layer, predicate, builder):
             new = builder(child)
             setattr(layer, name, new)
             replaced.append((layer, name, new))
+        elif isinstance(child, (QATLinear, Int8Linear)):
+            # already-quantized wrappers hold an inner Linear; recursing
+            # would wrap it a second time (double fake-quant) when
+            # quantize() runs twice or PTQ follows QAT
+            continue
         else:
             replaced += _replace_children(child, predicate, builder)
     return replaced

@@ -62,3 +62,52 @@ def test_save_load_cipher_key(tmp_path):
     assert back["step"] == 7
     with pytest.raises(ValueError):
         paddle.load(path, cipher_key=bytes(32))
+
+
+def test_poly1305_rfc7539_vector():
+    """RFC 7539 §2.5.2: the canonical Poly1305 test vector."""
+    import ctypes
+
+    from paddle_tpu.io.crypto import _load_lib
+
+    lib = _load_lib()
+    key = bytes.fromhex(
+        "85d6be7857556d337f4452fe42d506a8"
+        "0103808afb0db2fd4abff6af4149f51b")
+    msg = b"Cryptographic Forum Research Group"
+    tag = ctypes.create_string_buffer(16)
+    lib.pd_poly1305(key, msg, ctypes.c_uint64(len(msg)), tag)
+    assert tag.raw == bytes.fromhex("a8061dc1305136c6c22b8baf0c0127a9")
+
+
+def test_poly1305_edge_lengths():
+    """Exact multiples of 16 and the empty message exercise the hibit /
+    padding paths."""
+    import ctypes
+
+    from paddle_tpu.io.crypto import _load_lib
+
+    lib = _load_lib()
+    key = bytes(range(32))
+    for n in (0, 1, 15, 16, 17, 32, 63):
+        tag = ctypes.create_string_buffer(16)
+        lib.pd_poly1305(key, b"x" * n, ctypes.c_uint64(n), tag)
+        # determinism + length-sensitivity
+        tag2 = ctypes.create_string_buffer(16)
+        lib.pd_poly1305(key, b"x" * n, ctypes.c_uint64(n), tag2)
+        assert tag.raw == tag2.raw
+        if n:
+            tag3 = ctypes.create_string_buffer(16)
+            lib.pd_poly1305(key, b"x" * (n - 1) + b"y",
+                            ctypes.c_uint64(n), tag3)
+            assert tag.raw != tag3.raw
+
+
+def test_version1_files_rejected():
+    from paddle_tpu.io import crypto
+
+    key = crypto.CipherFactory.generate_key()
+    blob = crypto.encrypt(b"payload", key)
+    v1 = blob[:4] + bytes([1]) + blob[5:]
+    with pytest.raises(ValueError, match="version"):
+        crypto.decrypt(v1, key)

@@ -188,3 +188,49 @@ def test_lars_lamb_swap():
     prog2 = compile_train_step(m2, adam, st2, loss_method="loss")
     assert type(prog2._opt).__name__ == "Lamb"
     assert float(prog2.step(x, y)) > 0
+
+
+def test_localsgd_batchnorm_buffers_synced():
+    """ADVICE r2: per-rank BN running stats inside the explicit-DP
+    shard_map must leave as a pmean (sync-BN style), matching the
+    replicated out_spec; the value equals the average of the per-shard
+    momentum updates."""
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+    from paddle_tpu.distributed.fleet.compiler import compile_train_step
+
+    paddle.seed(0)
+
+    class BNNet(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.bn = nn.BatchNorm1D(4)
+            self.lin = nn.Linear(4, 1)
+
+        def loss(self, x, y):
+            out = self.lin(self.bn(x))
+            from paddle_tpu import ops
+            return ops.mean((out - y) * (out - y))
+
+    net = BNNet()
+    net.train()
+    s = DistributedStrategy()
+    s.localsgd = True
+    s.localsgd_configs.k_steps = 4
+    s.hybrid_configs.dp_degree = 2
+    mesh = s.build_mesh(devices=jax.devices()[:2])
+    sgd = opt.SGD(learning_rate=0.0, parameters=net.parameters())
+    prog = compile_train_step(net, sgd, s, mesh=mesh)
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((8, 4)).astype(np.float32)
+    x[4:] += 10.0       # shard 1 sees a very different distribution
+    prog.step(x, np.zeros((8, 1), np.float32), lr=0.0)
+
+    name = [k for k in prog.state if "mean" in k][0]
+    rm = np.asarray(jax.device_get(prog.state[name]))
+    # per rank: running = m*0 + (1-m)*batch_mean; pmean across ranks
+    m = float(net.bn._momentum)
+    per_rank = np.stack([x[:4].mean(0), x[4:].mean(0)])
+    np.testing.assert_allclose(rm, (1 - m) * per_rank.mean(0),
+                               rtol=1e-4, atol=1e-5)

@@ -121,3 +121,18 @@ def test_eval_without_calibration_falls_back_to_dynamic():
     got = net(paddle.to_tensor(x)).numpy()   # must not collapse to ~bias
     assert np.abs(got).max() > 0.1 * np.abs(ref).max()
     assert (got.argmax(1) == ref.argmax(1)).mean() >= 0.8
+
+
+def test_quantize_twice_is_idempotent():
+    """ADVICE r2: quantize() twice (or PTQ after QAT) must not descend
+    into QATLinear and double-wrap its inner Linear."""
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    q = QAT()
+    q.quantize(net)
+    first = [id(m) for m in net if isinstance(m, QATLinear)]
+    q.quantize(net)
+    second = [id(m) for m in net if isinstance(m, QATLinear)]
+    assert first == second
+    for m in net:
+        if isinstance(m, QATLinear):
+            assert not isinstance(m.inner, QATLinear)

@@ -115,3 +115,25 @@ def test_filestore(tmp_path):
     # keys with slashes map to flat files
     fs.set("x/y", b"2")
     assert fs.get("x/y") == b"2"
+
+
+def test_filestore_reclaims_stale_lock(tmp_path):
+    """ADVICE r2: a crashed holder's lockfile must not wedge add()
+    forever; reclamation is rename-atomic so only one waiter wins."""
+    import os
+    import time
+
+    from paddle_tpu.distributed.store import FileStore
+
+    fs = FileStore(str(tmp_path))
+    fs.add("cnt", 1)
+    # simulate a holder that died mid-critical-section
+    lock = fs._fn("cnt") + ".lock"
+    with open(lock, "wb") as f:
+        f.write(b"dead 0 0")
+    old = time.time() - 60
+    os.utime(lock, (old, old))
+    t0 = time.time()
+    assert fs.add("cnt", 1) == 2
+    assert time.time() - t0 < 30
+    assert not os.path.exists(lock)
