@@ -1,0 +1,188 @@
+"""Flowers / VOC2012 / DatasetFolder / ImageFolder on tiny synthetic
+archives in the standard layouts (r2 verdict item 10)."""
+import io
+import os
+import tarfile
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from paddle_tpu.vision.datasets import (DatasetFolder, Flowers, ImageFolder,
+                                        VOC2012)
+
+
+def _jpg_bytes(color, size=(8, 8)):
+    buf = io.BytesIO()
+    Image.new("RGB", size, color).save(buf, format="JPEG")
+    return buf.getvalue()
+
+
+def _png_bytes(value, size=(8, 8)):
+    buf = io.BytesIO()
+    Image.new("P", size, value).save(buf, format="PNG")
+    return buf.getvalue()
+
+
+def _add_bytes(tar, name, data):
+    info = tarfile.TarInfo(name)
+    info.size = len(data)
+    tar.addfile(info, io.BytesIO(data))
+
+
+@pytest.fixture
+def flowers_files(tmp_path):
+    import scipy.io as scio
+
+    data = tmp_path / "102flowers.tgz"
+    with tarfile.open(data, "w:gz") as t:
+        for i in range(1, 7):
+            _add_bytes(t, "jpg/image_%05d.jpg" % i,
+                       _jpg_bytes((i * 30, 0, 0)))
+    labels = tmp_path / "imagelabels.mat"
+    scio.savemat(labels, {"labels": np.arange(1, 7)[None]})
+    setid = tmp_path / "setid.mat"
+    scio.savemat(setid, {"trnid": np.array([[1, 2, 3]]),
+                         "valid": np.array([[4]]),
+                         "tstid": np.array([[5, 6]])})
+    return str(data), str(labels), str(setid)
+
+
+def test_flowers_modes_and_labels(flowers_files):
+    data, labels, setid = flowers_files
+    train = Flowers(data, labels, setid, mode="train")
+    assert len(train) == 3
+    img, lab = train[0]
+    assert img.size == (8, 8) and lab.dtype == np.int64 and lab[0] == 1
+    test = Flowers(data, labels, setid, mode="test", backend="cv2")
+    assert len(test) == 2
+    img, lab = test[1]
+    assert img.shape == (8, 8, 3) and lab[0] == 6
+
+
+def test_flowers_transform_applied(flowers_files):
+    data, labels, setid = flowers_files
+    ds = Flowers(data, labels, setid, mode="valid",
+                 transform=lambda im: np.zeros(3))
+    img, lab = ds[0]
+    assert np.allclose(img, 0) and lab[0] == 4
+
+
+def test_flowers_missing_file_message(tmp_path):
+    with pytest.raises(RuntimeError, match="no network egress"):
+        Flowers(str(tmp_path / "absent.tgz"), None, None)
+
+
+@pytest.fixture
+def voc_file(tmp_path):
+    path = tmp_path / "VOCtrainval.tar"
+    with tarfile.open(path, "w") as t:
+        stems_train, stems_val = ["a1", "a2"], ["b1"]
+        _add_bytes(t, "VOCdevkit/VOC2012/ImageSets/Segmentation/train.txt",
+                   ("\n".join(stems_train) + "\n").encode())
+        _add_bytes(t, "VOCdevkit/VOC2012/ImageSets/Segmentation/val.txt",
+                   ("\n".join(stems_val) + "\n").encode())
+        _add_bytes(t, "VOCdevkit/VOC2012/ImageSets/Segmentation/"
+                      "trainval.txt",
+                   ("\n".join(stems_train + stems_val) + "\n").encode())
+        for s in stems_train + stems_val:
+            _add_bytes(t, f"VOCdevkit/VOC2012/JPEGImages/{s}.jpg",
+                       _jpg_bytes((0, 100, 0)))
+            _add_bytes(t, f"VOCdevkit/VOC2012/SegmentationClass/{s}.png",
+                       _png_bytes(7))
+    return str(path)
+
+
+def test_voc2012_modes(voc_file):
+    train = VOC2012(voc_file, mode="trainval")
+    assert len(train) == 3
+    img, lab = train[0]
+    assert img.size == (8, 8) and lab.size == (8, 8)
+    val = VOC2012(voc_file, mode="valid", backend="cv2")
+    assert len(val) == 1
+    img, lab = val[0]
+    assert img.shape == (8, 8, 3)
+    # PIL remaps palette indices on save; constancy is the invariant
+    assert lab.shape == (8, 8) and len(np.unique(lab)) == 1
+    assert len(VOC2012(voc_file, mode="trainval")) == 3
+
+
+@pytest.fixture
+def folder_root(tmp_path):
+    for ci, cname in enumerate(["cat", "dog"]):
+        d = tmp_path / cname
+        d.mkdir()
+        for j in range(2 + ci):
+            Image.new("RGB", (4, 4), (ci * 100, j * 20, 0)).save(
+                d / f"{j}.png")
+    (tmp_path / "dog" / "notes.txt").write_text("not an image")
+    return str(tmp_path)
+
+
+def test_dataset_folder(folder_root):
+    ds = DatasetFolder(folder_root)
+    assert ds.classes == ["cat", "dog"]
+    assert ds.class_to_idx == {"cat": 0, "dog": 1}
+    assert len(ds) == 5                     # txt file filtered out
+    assert ds.targets == [0, 0, 1, 1, 1]
+    img, target = ds[0]
+    assert img.size == (4, 4) and target == 0
+
+
+def test_dataset_folder_custom_loader_and_valid(folder_root):
+    ds = DatasetFolder(folder_root, loader=lambda p: p,
+                       is_valid_file=lambda p: p.endswith("0.png"))
+    assert len(ds) == 2
+    path, target = ds[1]
+    assert path.endswith("0.png") and target == 1
+    with pytest.raises(ValueError):
+        DatasetFolder(folder_root, extensions=(".png",),
+                      is_valid_file=lambda p: True)
+
+
+def test_dataset_folder_empty_raises(tmp_path):
+    with pytest.raises(RuntimeError):
+        DatasetFolder(str(tmp_path))
+    (tmp_path / "classa").mkdir()
+    with pytest.raises(RuntimeError, match="0 files"):
+        DatasetFolder(str(tmp_path))
+
+
+def test_image_folder(folder_root):
+    ds = ImageFolder(folder_root)
+    assert len(ds) == 5                     # recursive, labels dropped
+    (sample,) = ds[0]
+    assert sample.size == (4, 4)
+    ds2 = ImageFolder(folder_root, transform=lambda im: np.asarray(im))
+    (arr,) = ds2[0]
+    assert arr.shape == (4, 4, 3)
+
+
+def test_tar_datasets_pickle_for_spawned_workers(flowers_files, voc_file):
+    """r3 review: TarFile handles are unpicklable; datasets must survive
+    pickling (spawned DataLoader workers) and reopen lazily."""
+    import pickle
+
+    data, labels, setid = flowers_files
+    ds = Flowers(data, labels, setid, mode="train")
+    _ = ds[0]
+    clone = pickle.loads(pickle.dumps(ds))
+    img, lab = clone[0]
+    assert img.size == (8, 8) and lab[0] == 1
+
+    voc = VOC2012(voc_file, mode="valid")
+    clone = pickle.loads(pickle.dumps(voc))
+    img, _ = clone[0]
+    assert img.size == (8, 8)
+
+
+def test_voc_train_means_trainval(voc_file):
+    # reference MODE_FLAG_MAP parity: 'train' -> trainval.txt
+    assert len(VOC2012(voc_file, mode="train")) == 3
+
+
+def test_string_extensions(folder_root):
+    ds = DatasetFolder(folder_root, extensions=".png")
+    assert len(ds) == 5
+    ds2 = ImageFolder(folder_root, extensions=".png")
+    assert len(ds2) == 5
