@@ -347,7 +347,8 @@ def _check_pipeline_compat(strategy, mesh, what="pipeline",
 def _build_pipeline_program(layer, optimizer, strategy, mesh, *, block_fn,
                             embed_fn, head_loss_fn, ep, hp, stacked,
                             n_layers, stacked_pspec, prog_cls,
-                            seq_axis=None, replicated_axes=()):
+                            seq_axis=None, replicated_axes=(),
+                            aux_from_blocks=False, aux_coef=0.01):
     """The machinery both pipeline branches share: flat param assembly
     (embed.* / head.* / stacked.*), shardings, the microbatched
     global-masked-mean loss, jit wiring and program construction. The
@@ -415,7 +416,8 @@ def _build_pipeline_program(layer, optimizer, strategy, mesh, *, block_fn,
         seq_axis=seq_axis,
         block_takes_key=_takes(block_fn, "key"),
         embed_takes_key=_takes(embed_fn, "key"),
-        replicated_axes=replicated_axes)
+        replicated_axes=replicated_axes,
+        aux_from_blocks=aux_from_blocks, aux_coef=aux_coef)
 
     def _sub(p, prefix):
         cut = len(prefix)
@@ -434,13 +436,20 @@ def _build_pipeline_program(layer, optimizer, strategy, mesh, *, block_fn,
                 mb = ids.shape[0] // n_micro
                 ids_m = ids.reshape((n_micro, mb) + ids.shape[1:])
                 lab_m = labels.reshape((n_micro, mb) + labels.shape[1:])
-                sums, counts, d_sp, d_ep, d_hp = pipe_vag(
-                    spp, epp, hpp, ids_m, lab_m, key)
+                out = pipe_vag(spp, epp, hpp, ids_m, lab_m, key)
+                if aux_from_blocks:
+                    sums, counts, d_sp, d_ep, d_hp, aux_s = out
+                else:
+                    sums, counts, d_sp, d_ep, d_hp = out
         # global masked mean across all microbatches: grads came back as
         # grads of loss_SUM; the valid-count denominator is
         # label-determined (param-independent), so scaling is exact
         denom = jnp.maximum(counts, 1.0)
         loss = sums / denom
+        if aux_from_blocks:
+            # the scheduler pre-scaled the aux grad seed by denom, so
+            # the /denom below lands both terms at this exact loss
+            loss = loss + aux_coef * aux_s / (n_layers * n_micro)
         grads = {}
         grads.update({f"embed.{k}": v / denom for k, v in d_ep.items()})
         grads.update({f"head.{k}": v / denom for k, v in d_hp.items()})
@@ -523,14 +532,12 @@ def _compile_pipeline_step(layer, optimizer, strategy, mesh):
         if experts is not None and experts % n_ep:
             raise ValueError(f"{experts} experts not divisible by "
                              f"ep={n_ep}")
-        import warnings
-        warnings.warn(
-            "pipeline + expert parallel: the Switch load-balance aux "
-            "loss is not propagated on the pipeline path (see "
-            "pipeline_block_fn_ep); routing is unregularized")
+        # Switch load-balance aux rides the 1F1B backward slot (blocks
+        # return (h, aux)); routing IS regularized on this path
         block_fn = ep_block(
             axis_ep="ep",
-            compute_dtype="bfloat16" if strategy.amp else None)
+            compute_dtype="bfloat16" if strategy.amp else None,
+            with_aux=True)
         ep_specs = layer.block_ep_specs(axis_pp="pp", axis_ep="ep")
 
         def ep_pspec(rel, v):
@@ -544,7 +551,10 @@ def _compile_pipeline_step(layer, optimizer, strategy, mesh):
             embed_fn=embed_fn, head_loss_fn=head_loss_fn, ep=ep, hp=hp,
             stacked=stack_stage_params(blocks_list),
             n_layers=len(blocks_list), stacked_pspec=ep_pspec,
-            prog_cls=_PipelineTrainStep, replicated_axes=("ep",))
+            prog_cls=_PipelineTrainStep, replicated_axes=("ep",),
+            aux_from_blocks=True,
+            aux_coef=float(getattr(getattr(layer, "cfg", None),
+                                   "moe_aux_coef", 0.01)))
     if n_sp > 1:
         # pp x sp: blocks see local sequence shards; attention is the
         # shard_map-inner ring/Ulysses (the sp collectives live in the
@@ -566,7 +576,13 @@ def _compile_pipeline_step(layer, optimizer, strategy, mesh):
         n_layers=len(blocks_list),
         stacked_pspec=lambda rel, v: P("pp", *([None] * (v.ndim - 1))),
         prog_cls=_PipelineTrainStep,
-        seq_axis="sp" if n_sp > 1 else None)
+        seq_axis="sp" if n_sp > 1 else None,
+        # plain-branch MoE blocks emit (h, aux) via collect_aux_losses;
+        # the sp branch's raw-jnp block refuses MoE upstream
+        aux_from_blocks=(n_sp == 1 and bool(
+            getattr(layer, "pipeline_block_emits_aux", False))),
+        aux_coef=float(getattr(getattr(layer, "cfg", None),
+                               "moe_aux_coef", 0.01)))
 
 
 def _compile_pipeline_tp_step(layer, optimizer, strategy, mesh, n_tp):

@@ -146,7 +146,9 @@ def pipeline_value_and_grad(block_fn, embed_fn, head_loss_fn, n_stages,
                             seq_axis: str = None,
                             block_takes_key: bool = False,
                             embed_takes_key: bool = False,
-                            replicated_axes: tuple = ()):
+                            replicated_axes: tuple = (),
+                            aux_from_blocks: bool = False,
+                            aux_coef: float = 0.0):
     """True-1F1B fused train pipeline: loss AND grads in one SPMD scan.
 
     Reference: SectionWorker's 1F1B loop
@@ -174,6 +176,19 @@ def pipeline_value_and_grad(block_fn, embed_fn, head_loss_fn, n_stages,
     Collectives inside block_fn (tp/sp/ep psums, ring ppermutes) are fine:
     they run unconditionally every tick. embed/head must be collective-free
     (they execute under a per-stage lax.cond).
+
+    `aux_from_blocks`: blocks return (h, aux_scalar) — e.g. the MoE
+    Switch load-balance loss — and the returned tuple gains a 6th
+    element aux_sum (Σ over microbatches and blocks, averaged over data
+    shards). The aux GRADIENT rides the backward slot's vjp as a second
+    cotangent seed scaled by aux_coef * valid_count / (L * n_micro * n_data)
+    (head_loss_fn must expose `.valid_count(labels)`), so after the
+    caller divides the grad accumulators by the global valid count the
+    aux term lands at exactly aux_coef * mean-over-blocks-and-microbatches
+    — the same weighting GPT.loss gives it on the sequential path. Note
+    the per-(shard, microbatch) aux is averaged where the non-pipeline
+    path computes one global-batch aux; the load-balance pressure is
+    statistically equivalent, not bitwise.
 
     `replicated_axes` names mesh axes over which activations are
     REPLICATED while block_fn contains psums (tp on the manual-Megatron
@@ -230,17 +245,52 @@ def pipeline_value_and_grad(block_fn, embed_fn, head_loss_fn, n_stages,
             # slot) reproduces the forward's dropout masks exactly
             gidx = jnp.arange(n_local) + stage * n_local
 
-            def body(h, xs):
-                lp, li = xs
+            def call(lp, h, li):
                 if block_takes_key and k_m is not None:
-                    return block_fn(lp, h, jax.random.fold_in(k_m, li)), None
-                return block_fn(lp, h), None
+                    return block_fn(lp, h, jax.random.fold_in(k_m, li))
+                return block_fn(lp, h)
 
-            h, _ = jax.lax.scan(body, x, (p_, gidx))
-            return h
+            def body(carry, xs):
+                h, aux = carry
+                lp, li = xs
+                out = call(lp, h, li)
+                if aux_from_blocks:
+                    h2, a = out
+                    return (h2, aux + jnp.asarray(a, jnp.float32)), None
+                return (out, aux), None
+
+            (h, aux), _ = jax.lax.scan(
+                body, (x, jnp.zeros((), jnp.float32)), (p_, gidx))
+            return h, aux
+
+        # aux cotangent seed: constant per tick. Scaled so that after the
+        # caller divides the accumulators by the global valid count, the
+        # aux term weighs aux_coef / (total_blocks * n_micro); divided by
+        # the data-shard count (the final psums SUM where aux wants a
+        # mean) and by n_rep (partial-cotangent protocol).
+        if aux_from_blocks:
+            vc = getattr(head_loss_fn, "valid_count", None)
+            if vc is None:
+                raise TypeError(
+                    "aux_from_blocks needs head_loss_fn.valid_count"
+                    "(labels) so the aux gradient can pre-scale by the "
+                    "global valid-token count")
+            cnt0 = jnp.asarray(vc(lab_m), jnp.float32)
+            n_data = 1
+            for a_ in (batch_axis, seq_axis):
+                if a_ is not None:
+                    cnt0 = jax.lax.psum(cnt0, a_)
+                    n_data *= int(mesh.shape[a_])
+            denom0 = jnp.maximum(cnt0, 1.0)
+            aux_seed = jnp.asarray(
+                aux_coef * denom0 / (n_local * S * M * n_data * n_rep),
+                jnp.float32)
+        else:
+            aux_seed = jnp.zeros((), jnp.float32)
 
         def tick(carry, t):
-            act_in, g_in, buf, d_sp, d_ep, d_hp, loss_s, cnt_s = carry
+            (act_in, g_in, buf, d_sp, d_ep, d_hp, loss_s, cnt_s,
+             aux_s) = carry
 
             # ---- forward slot: F_{t - stage} -------------------------
             m_f = t - stage
@@ -251,7 +301,7 @@ def pipeline_value_and_grad(block_fn, embed_fn, head_loss_fn, n_stages,
             x_f = jax.lax.cond(
                 is_first, lambda: _embed_with(ep_, mf_c, k_f),
                 lambda: act_in)
-            y_f = run_stack(sp_, x_f, k_f)
+            y_f, _ = run_stack(sp_, x_f, k_f)
             # ring-buffer the boundary input for the backward's remat.
             # Slot m_f mod 2S is written even on invalid (fill/drain)
             # ticks: for m_f < 0 the slot lands in the never-pending
@@ -270,7 +320,7 @@ def pipeline_value_and_grad(block_fn, embed_fn, head_loss_fn, n_stages,
             x_b = jax.lax.dynamic_index_in_dim(buf, m_b % K, 0,
                                                keepdims=False)
             lab = lab_m[mb_c]
-            y_b, stk_vjp = jax.vjp(
+            (y_b, aux_b), stk_vjp = jax.vjp(
                 lambda p_, x_: run_stack(p_, x_, k_b), sp_, x_b)
 
             def last_branch(y_):
@@ -300,7 +350,7 @@ def pipeline_value_and_grad(block_fn, embed_fn, head_loss_fn, n_stages,
 
             ls, c, dhp_m, dep_m, dy = jax.lax.cond(
                 is_last, last_branch, mid_branch, y_b)
-            d_sp_m, dx_m = stk_vjp(dy)
+            d_sp_m, dx_m = stk_vjp((dy, aux_seed))
 
             # stage 0's input is the embedding: fold its vjp into d_ep
             dep_e = jax.lax.cond(
@@ -318,11 +368,12 @@ def pipeline_value_and_grad(block_fn, embed_fn, head_loss_fn, n_stages,
                 d_ep, dep_m, dep_e)
             loss_s = loss_s + v_b * ls
             cnt_s = cnt_s + v_b * c
+            aux_s = aux_s + v_b * aux_b
 
             act_next = jax.lax.ppermute(y_f, axis, fwd_perm)
             g_next = jax.lax.ppermute(dx_m, axis, bwd_perm)
             return (act_next, g_next, buf, d_sp, d_ep, d_hp,
-                    loss_s, cnt_s), None
+                    loss_s, cnt_s, aux_s), None
 
         # one dead embed call pins the activation shape/dtype (only its
         # static metadata is used — XLA DCEs the compute)
@@ -333,9 +384,9 @@ def pipeline_value_and_grad(block_fn, embed_fn, head_loss_fn, n_stages,
         init = (act0, act0, jnp.zeros((K,) + x0.shape, x0.dtype),
                 zeros_like_tree(sp_), zeros_like_tree(ep_),
                 zeros_like_tree(hp_), jnp.zeros((), jnp.float32),
-                jnp.zeros((), jnp.float32))
-        (_, _, _, d_sp, d_ep, d_hp, loss_s, cnt_s), _ = jax.lax.scan(
-            tick, init, jnp.arange(n_ticks))
+                jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32))
+        (_, _, _, d_sp, d_ep, d_hp, loss_s, cnt_s, aux_s), _ = \
+            jax.lax.scan(tick, init, jnp.arange(n_ticks))
 
         # reductions: loss/head/embed grads live on one stage (mask) and
         # are partial across data shards; stacked grads are stage-owned
@@ -346,11 +397,13 @@ def pipeline_value_and_grad(block_fn, embed_fn, head_loss_fn, n_stages,
         for a in data_axes + (axis,):
             loss_s = jax.lax.psum(loss_s, a)
             cnt_s = jax.lax.psum(cnt_s, a)
+            aux_s = jax.lax.psum(aux_s, a)
             d_ep = jax.tree_util.tree_map(
                 lambda g: jax.lax.psum(g, a), d_ep)
             d_hp = jax.tree_util.tree_map(
                 lambda g: jax.lax.psum(g, a), d_hp)
         for a in data_axes:
+            aux_s = aux_s / int(mesh.shape[a])  # mean over data shards
             d_sp = jax.tree_util.tree_map(
                 lambda g: jax.lax.psum(g, a), d_sp)
         # partial-cotangent cleanup: embed grads (stage-0 vjp of partial
@@ -366,6 +419,8 @@ def pipeline_value_and_grad(block_fn, embed_fn, head_loss_fn, n_stages,
             else:
                 d_sp = jax.tree_util.tree_map(
                     lambda g: jax.lax.psum(g, a), d_sp)
+        if aux_from_blocks:
+            return loss_s, cnt_s, d_sp, d_ep, d_hp, aux_s
         return loss_s, cnt_s, d_sp, d_ep, d_hp
 
     def fn(stacked, embed_p, head_p, ids_micro, labels_micro, key=None,
@@ -383,6 +438,8 @@ def pipeline_value_and_grad(block_fn, embed_fn, head_loss_fn, n_stages,
         rep = lambda tree: jax.tree_util.tree_map(
             lambda v: P(*([None] * getattr(v, "ndim", 0))), tree)
         out_specs = (P(), P(), pspecs, rep(embed_p), rep(head_p))
+        if aux_from_blocks:
+            out_specs = out_specs + (P(),)
         use_key = key is not None and (block_takes_key or embed_takes_key)
         if use_key:
             f = jax.shard_map(

@@ -319,7 +319,10 @@ class Model:
                               "split_block_params_tp", "block_tp_specs",
                               "pipeline_block_fn_tp",
                               "merge_block_params_tp",
-                              "pipeline_block_fn_sp", "cfg")
+                              "pipeline_block_fn_sp",
+                              # expert-parallel pipeline protocol
+                              "pipeline_block_fn_ep", "block_ep_specs",
+                              "pipeline_block_emits_aux", "cfg")
 
                 def __getattr__(self, name):
                     # expose the network's sharding/pipeline protocols to

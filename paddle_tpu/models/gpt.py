@@ -44,6 +44,7 @@ class GPTConfig:
     dtype: str = "float32"
     moe_experts: int = 0         # >0: MoE FFN with this many experts
     moe_top_k: int = 2
+    moe_aux_coef: float = 0.01   # Switch load-balance pressure
 
     @property
     def head_dim(self):
@@ -190,7 +191,9 @@ class GPT(nn.Layer):
         n_valid = F_ops.maximum(n_valid, F_ops.ones_like(n_valid))
         return F_ops.sum(rows) / n_valid
 
-    def loss(self, idx, labels, moe_aux_coef=0.01):
+    def loss(self, idx, labels, moe_aux_coef=None):
+        if moe_aux_coef is None:
+            moe_aux_coef = getattr(self.cfg, "moe_aux_coef", 0.01)
         if self.cfg.moe_experts > 0:
             from ..nn.layer.moe import collect_aux_losses
             with collect_aux_losses() as auxes:
@@ -270,6 +273,32 @@ class GPT(nn.Layer):
                 x = _pp_dropout(x, key, p_drop)
             return x
 
+        emits_aux = self.cfg.moe_experts > 0
+
+        def _call_block(bp, h, key):
+            """One block through functional_call; MoE configs also return
+            the Switch load-balance aux (the 1F1B scheduler threads it
+            into the objective — reference analog: the aux-loss fetch the
+            pipeline trainer skips, here actually propagated)."""
+            import contextlib
+
+            ctx = random_mod.key_scope(key) if key is not None \
+                else contextlib.nullcontext()
+            if emits_aux:
+                from ..nn.layer.moe import collect_aux_losses
+                with collect_aux_losses() as auxes, ctx:
+                    out, _ = functional_call(blk0, bp, {}, h,
+                                             mutable_state=False)
+                total = auxes[0]
+                for a in auxes[1:]:
+                    total = total + a
+                total = total._data if hasattr(total, "_data") else total
+                return out, total
+            with ctx:
+                out, _ = functional_call(blk0, bp, {}, h,
+                                         mutable_state=False)
+            return out
+
         if p_drop > 0:
             def block_fn(bp, h, key=None):
                 if key is None:
@@ -278,15 +307,10 @@ class GPT(nn.Layer):
                         "GPT pipeline block with dropout > 0 needs the "
                         "scheduler to thread a PRNG key (use the "
                         "fleet-compiled train step)")
-                with random_mod.key_scope(key):
-                    out, _ = functional_call(blk0, bp, {}, h,
-                                             mutable_state=False)
-                return out
+                return _call_block(bp, h, key)
         else:
             def block_fn(bp, h):
-                out, _ = functional_call(blk0, bp, {}, h,
-                                         mutable_state=False)
-                return out
+                return _call_block(bp, h, None)
 
         eps = self.ln_f._epsilon
 
@@ -310,7 +334,18 @@ class GPT(nn.Layer):
             rows = jnp.where(valid, rows, 0.0)
             return rows.sum(), valid.astype(jnp.float32).sum()
 
+        # label-only count for the scheduler's aux-gradient pre-scaling
+        head_loss_fn.valid_count = lambda labels: (
+            labels.reshape(-1).astype(jnp.int32) != ignore_index
+        ).astype(jnp.float32).sum()
         return embed_fn, block_fn, head_loss_fn
+
+    @property
+    def pipeline_block_emits_aux(self):
+        """True when pipeline_fns' block_fn returns (h, aux) — MoE
+        configs carry the Switch load-balance loss through the 1F1B
+        scheduler."""
+        return self.cfg.moe_experts > 0
 
     # -- manual-tp pipeline protocol (pp x tp composition) -----------------
     # The SPMD pipeline runs inside a shard_map where every mesh axis is
@@ -515,18 +550,19 @@ class GPT(nn.Layer):
             "moe.b_out": expert(3),
         }
 
-    def pipeline_block_fn_ep(self, axis_ep="ep", compute_dtype=None):
+    def pipeline_block_fn_ep(self, axis_ep="ep", compute_dtype=None,
+                             with_aux=False):
         """block_fn for pipeline x expert parallelism: activations are
         REPLICATED across 'ep' members, each member runs only its local
         expert slab (E/n_ep experts of the stacked bank), and one psum
         over 'ep' sums the per-expert contributions — the manual form of
         the GSPMD einsum dispatch in nn/layer/moe.py.
 
-        Limitation (documented, loud): the Switch load-balance aux loss
-        is NOT propagated on the pipeline path (per-block scalars cannot
-        ride the ppermute ring without widening the carried activation);
-        routing still uses softmax top-k, but expert collapse pressure is
-        unregularized — prefer ep x dp (non-pipeline) for long MoE runs."""
+        with_aux=True: the block also returns the Switch load-balance
+        aux (E * sum_e frac_tokens_e * mean_prob_e, same formula as
+        nn/layer/moe.py) — the 1F1B scheduler threads it into the
+        objective, so expert-collapse pressure IS applied on the
+        pipeline path."""
         if self.cfg.moe_experts <= 0:
             raise ValueError("pipeline_block_fn_ep requires a MoE config "
                              "(GPTConfig.moe_experts > 0)")
@@ -603,7 +639,14 @@ class GPT(nn.Layer):
             y = y.reshape(K, N, H).sum(0)
             # contributions from every member's experts meet here
             y = jax.lax.psum(y, axis_ep)
-            return h + y.reshape(B, T, H).astype(h.dtype)
+            out = h + y.reshape(B, T, H).astype(h.dtype)
+            if with_aux:
+                # Switch aux (moe.py formula); routing is replicated over
+                # 'ep' so every member computes the identical value
+                frac = onehot_list[0].mean(0)
+                mean_p = probs.mean(0)
+                return out, (frac * mean_p).sum() * E
+            return out
 
         return block_fn
 

@@ -402,10 +402,9 @@ def test_compiled_step_pipeline_x_sequence_parallel():
 
 def test_compiled_step_pipeline_x_expert_parallel():
     """pp x ep x dp: manual expert dispatch (local slab + psum) matches
-    the plain pipeline running the same MoE blocks unsharded — both use
-    the pipeline CE (no aux), so they must agree step for step."""
-    import warnings
-
+    the plain pipeline running the same MoE blocks unsharded — both
+    include the Switch aux through the 1F1B scheduler, so they must
+    agree step for step."""
     import paddle_tpu.optimizer as opt
     from paddle_tpu.distributed.fleet.compiler import compile_train_step
     from paddle_tpu.models import GPT, gpt_tiny
@@ -438,9 +437,7 @@ def test_compiled_step_pipeline_x_expert_parallel():
     s2.hybrid_configs.dp_degree = 2
     s2.pipeline_configs.accumulate_steps = 2
     adam2 = opt.Adam(learning_rate=1e-3, parameters=list(m2.parameters()))
-    with warnings.catch_warnings():
-        warnings.simplefilter("ignore")   # documented aux-loss warning
-        prog2 = compile_train_step(m2, adam2, s2)
+    prog2 = compile_train_step(m2, adam2, s2)
     got = [float(jax.device_get(prog2.step(ids, labels, lr=1e-3)))
            for _ in range(3)]
     np.testing.assert_allclose(ref, got, atol=5e-3, rtol=1e-4)
@@ -1128,3 +1125,58 @@ def test_pipeline_dropout_grads_match_seeded_sequential(monkeypatch):
         np.testing.assert_allclose(
             p_after[k], p_before[k] - lr * np.asarray(g_ref[k]),
             atol=2e-5, err_msg=k)
+
+
+def test_pipeline_moe_aux_loss_matches_sequential():
+    """The Switch load-balance aux now rides the 1F1B pipeline: with
+    dp=1 and accumulate_steps=1 the per-microbatch aux IS the full-batch
+    aux, so the pipeline loss must equal sequential GPT.loss (CE + aux)
+    exactly, and training trajectories must track."""
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.distributed.fleet.compiler import compile_train_step
+    from paddle_tpu.models import GPT, gpt_tiny
+
+    rng = np.random.default_rng(5)
+    ids = rng.integers(0, 512, (4, 32)).astype(np.int64)
+    labels = rng.integers(0, 512, (4, 32)).astype(np.int64)
+
+    def make():
+        paddle.seed(0)
+        return GPT(gpt_tiny(moe_experts=4, moe_top_k=2))
+
+    # sequential reference: eager GPT.loss includes coef-0.01 aux
+    m_ref = make()
+    seq_losses = []
+    sgd_ref = opt.SGD(learning_rate=0.1, parameters=m_ref.parameters())
+    for _ in range(3):
+        loss = m_ref.loss(paddle.to_tensor(ids), paddle.to_tensor(labels))
+        seq_losses.append(float(loss))
+        loss.backward()
+        sgd_ref.step()
+        sgd_ref.clear_grad()
+
+    def run(strategy, n_dev):
+        m = make()
+        sgd = opt.SGD(learning_rate=0.1, parameters=list(m.parameters()))
+        mesh = strategy.build_mesh(devices=jax.devices()[:n_dev])
+        prog = compile_train_step(m, sgd, strategy, mesh=mesh)
+        return [float(jax.device_get(prog.step(ids, labels, lr=0.1)))
+                for _ in range(3)]
+
+    s_pp = DistributedStrategy()
+    s_pp.pipeline = True
+    s_pp.hybrid_configs.pp_degree = 2
+    s_pp.hybrid_configs.dp_degree = 1
+    s_pp.pipeline_configs.accumulate_steps = 1
+    np.testing.assert_allclose(run(s_pp, 2), seq_losses,
+                               rtol=2e-4, atol=5e-4)
+
+    s_pe = DistributedStrategy()
+    s_pe.pipeline = True
+    s_pe.expert_parallel = True
+    s_pe.hybrid_configs.pp_degree = 2
+    s_pe.hybrid_configs.ep_degree = 2
+    s_pe.hybrid_configs.dp_degree = 1
+    s_pe.pipeline_configs.accumulate_steps = 1
+    np.testing.assert_allclose(run(s_pe, 4), seq_losses,
+                               rtol=2e-4, atol=5e-4)

@@ -113,3 +113,34 @@ def test_prepare_after_stale_incompatible_mesh():
     labels = rng.integers(0, 512, (8, 32)).astype(np.int64)
     loss = float(model.train_batch([ids], [labels])[0])
     assert np.isfinite(loss)
+
+
+def test_hapi_fit_drives_pp_x_ep_moe():
+    """r3 drive gap: hapi's strategy adapter must forward the
+    expert-parallel pipeline protocol (pipeline_block_fn_ep etc.), and
+    the Switch aux coefficient from GPTConfig must reach the loss."""
+    import jax
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.models import GPT, gpt_tiny
+
+    paddle.seed(0)
+    net = GPT(gpt_tiny(moe_experts=4, moe_top_k=2, moe_aux_coef=0.05))
+    s = DistributedStrategy()
+    s.pipeline = True
+    s.expert_parallel = True
+    s.hybrid_configs.pp_degree = 2
+    s.hybrid_configs.ep_degree = 2
+    s.hybrid_configs.dp_degree = 2
+    s.pipeline_configs.accumulate_steps = 2
+    model = Model(net)
+    adam = opt.Adam(learning_rate=1e-3, parameters=model.parameters())
+    model.prepare(adam, strategy=s)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 512, (16, 32)).astype(np.int64)
+    lab = rng.integers(0, 512, (16, 32)).astype(np.int64)
+    l0 = float(model.train_batch([ids], [lab])[0])
+    l1 = float(model.train_batch([ids], [lab])[0])
+    assert np.isfinite(l0) and l1 < l0
+    spec = model._dist_prog.params["stacked.moe.w_in"].sharding.spec
+    assert spec[0] == "pp" and spec[1] == "ep"
