@@ -293,7 +293,12 @@ def compile_train_step(layer, optimizer, strategy: DistributedStrategy,
         out_shardings=(NamedSharding(mesh, P()), p_sh, buf_sh, s_sh),
         donate_argnums=(0, 2))
 
-    params = jax.device_put(params, p_sh)
+    # may_alias=False on params only (donated argnum 0): on a single
+    # device device_put would no-op and the program's donated buffers
+    # would ALIAS the layer's own arrays, leaving the user's Tensors
+    # deleted after step 1. state (argnum 1) is never donated.
+    params = {k: jax.device_put(v, p_sh[k], may_alias=False)
+              for k, v in params.items()}
     state = jax.device_put(state, buf_sh)
     opt_state = _put_opt_state(opt_state, s_sh)
 
@@ -464,7 +469,9 @@ def _build_pipeline_program(layer, optimizer, strategy, mesh, *, block_fn,
         out_shardings=(NamedSharding(mesh, P()), p_sh, buf_sh, s_sh),
         donate_argnums=(0, 2))
 
-    flat = jax.device_put(flat, p_sh)
+    # may_alias=False on the donated params only (see compile_train_step)
+    flat = {k: jax.device_put(v, p_sh[k], may_alias=False)
+            for k, v in flat.items()}
     state = jax.device_put(state, buf_sh)
     opt_state = _put_opt_state(opt_state, s_sh)
 

@@ -325,7 +325,11 @@ def compile_explicit_dp_step(layer, optimizer, strategy, mesh,
                                     s_sh),
                      donate_argnums=(0, 2))
 
-    params_l = jax.device_put(params_l, p_sh)
+    # may_alias=False: donated program buffers (params, argnum 0) must
+    # never alias the layer's own arrays (see fleet/compiler.py)
+    params_l = jax.tree_util.tree_map(
+        lambda v, sh: jax.device_put(v, sh, may_alias=False),
+        params_l, p_sh)
     state = jax.device_put(state, buf_sh)
     opt_bundle = jax.device_put({"opt": opt_l, "comm": comm}, s_sh)
 

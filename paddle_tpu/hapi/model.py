@@ -203,9 +203,16 @@ class Model:
         self._accum_count = 0
 
     # -- functional plumbing -------------------------------------------
-    def _split_tree(self):
+    def _split_tree(self, copy=False):
         from ..framework import param_arrays, state_arrays
-        return param_arrays(self.network), state_arrays(self.network)
+        params = param_arrays(self.network)
+        state = state_arrays(self.network)
+        if copy:
+            # the jitted train step donates params: a no-copy split would
+            # leave the network's own Tensors holding deleted buffers
+            params = {k: jax.device_put(v, may_alias=False)
+                      for k, v in params.items()}
+        return params, state
 
     def _write_back(self, params, state):
         lookup = dict(self.network.named_parameters())
@@ -394,7 +401,7 @@ class Model:
                                           _as_list(labels), sync=sync)
         if self._jit_step is None:
             self._jit_step = self._build_train_step()
-            self._params, self._state = self._split_tree()
+            self._params, self._state = self._split_tree(copy=True)
             restored = getattr(self, "_restored_opt_state", None)
             if restored is not None and set(restored) == set(self._params):
                 self._opt_state = jax.tree_util.tree_map(jnp.asarray, restored)

@@ -1180,3 +1180,23 @@ def test_pipeline_moe_aux_loss_matches_sequential():
     s_pe.pipeline_configs.accumulate_steps = 1
     np.testing.assert_allclose(run(s_pe, 4), seq_losses,
                                rtol=2e-4, atol=5e-4)
+
+
+def test_compiled_step_single_device_keeps_layer_arrays_live():
+    """r3: on a single device, device_put would no-op and the program's
+    donated buffers would alias the layer's arrays — the user's Tensors
+    must survive the first step."""
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.models import GPT, gpt_tiny
+
+    paddle.seed(0)
+    net = GPT(gpt_tiny())
+    s = DistributedStrategy()
+    mesh = s.build_mesh(devices=jax.devices()[:1])
+    prog = compile_train_step(
+        net, opt.Adam(learning_rate=1e-3,
+                      parameters=list(net.parameters())), s, mesh=mesh)
+    ids = np.random.default_rng(0).integers(0, 512, (2, 16)).astype(np.int64)
+    prog.step(ids, ids, lr=1e-3)
+    w = np.asarray(net.wte.weight._data)   # raises if donated-aliased
+    assert np.isfinite(w).all()
