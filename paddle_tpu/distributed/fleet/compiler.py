@@ -61,6 +61,22 @@ class CompiledTrainStep:
             self.params, self.state, self.opt_state, key, lr, data)
         return loss
 
+    def eval_step(self, *data):
+        """Loss on a batch under the SAME shardings as training — no
+        host gather, no parameter replication onto one device (the
+        reference evaluates pp/tp models through the sharded program
+        too; a single-device eval of a model that only fits sharded
+        would OOM). Built lazily on first use; traced in eval mode
+        (dropout off)."""
+        if getattr(self, "_eval_jitted", None) is None:
+            builder = getattr(self, "_eval_builder", None)
+            if builder is None:
+                raise NotImplementedError(
+                    "this compiled program has no eval path")
+            self._eval_jitted = builder()
+        data = tuple(self._put_data(d) for d in data)
+        return self._eval_jitted(self.params, self.state, data)
+
     def _put_data(self, d):
         """Shard one data arg; the spec is truncated to the array's rank
         (a [B] per-sample tensor under dp x sp sharding takes P('dp'))."""
@@ -306,6 +322,32 @@ def compile_train_step(layer, optimizer, strategy: DistributedStrategy,
                              {"params": p_sh, "opt": s_sh}, mesh, layer,
                              data_sh)
     prog._opt = optimizer
+
+    def _eval_builder():
+        def eval_fn(p, st, data):
+            # fixed key: eval-mode layers draw no dropout, and any
+            # stray randomness must at least be deterministic
+            out, _ = forward_loss(p, st, jax.random.key(0), *data)
+            return out
+
+        ejit = jax.jit(eval_fn, in_shardings=(p_sh, buf_sh, None),
+                       out_shardings=NamedSharding(mesh, P()))
+
+        def runner(p, st, data):
+            # trace under eval mode (dropout off, BN uses running stats)
+            was = bool(getattr(layer, "training", False))
+            if hasattr(layer, "eval"):
+                layer.eval()
+            try:
+                return ejit(p, st, data)
+            finally:
+                if was and hasattr(layer, "train"):
+                    layer.train()
+
+        return runner
+
+    prog._eval_builder = _eval_builder
+    prog._eval_batch_divisor = max(n_dp, 1)
     return prog
 
 
@@ -479,6 +521,64 @@ def _build_pipeline_program(layer, optimizer, strategy, mesh, *, block_fn,
                     {"params": p_sh, "opt": s_sh}, mesh, layer, data_sh)
     prog._opt = optimizer
     prog._n_layers = n_layers
+
+    def _eval_builder():
+        from ..pipeline import pipeline_spmd
+
+        # forward-only pipeline: the GPipe-shaped residuals of
+        # pipeline_spmd don't matter without a backward, and eval mode
+        # draws no dropout so the blocks need no keys. MoE blocks keep
+        # their aux so eval loss matches the train step's definition.
+        pipe = pipeline_spmd(
+            block_fn, n_pp, n_micro, mesh, axis="pp",
+            batch_axis="dp" if n_dp > 1 else None,
+            param_specs={k[len("stacked."):]: v
+                         for k, v in pspecs.items()
+                         if k.startswith("stacked.")},
+            seq_axis=seq_axis, aux_from_blocks=aux_from_blocks)
+
+        def eval_fn(p, st, data):
+            ids, labels = data
+            from ... import amp as amp_mod
+            with amp_mod.auto_cast(enable=amp_on,
+                                   level="O2" if pure_bf16 else "O1",
+                                   dtype="bfloat16"):
+                epp = _sub(p, "embed.")
+                hpp = _sub(p, "head.")
+                spp = _sub(p, "stacked.")
+                mb = ids.shape[0] // n_micro
+                ids_m = ids.reshape((n_micro, mb) + ids.shape[1:])
+                lab_m = labels.reshape((n_micro, mb) + labels.shape[1:])
+                h = jax.vmap(embed_fn, in_axes=(None, 0))(epp, ids_m)
+                out = pipe(spp, h)
+                h, aux_s = out if aux_from_blocks else (out, 0.0)
+                sums, counts = jax.vmap(
+                    head_loss_fn, in_axes=(None, None, 0, 0))(
+                    hpp, epp, h, lab_m)
+            loss = sums.sum() / jnp.maximum(counts.sum(), 1.0)
+            if aux_from_blocks:
+                loss = loss + aux_coef * aux_s / (n_layers * n_micro)
+            return loss
+
+        ejit = jax.jit(eval_fn, in_shardings=(p_sh, buf_sh, None),
+                       out_shardings=NamedSharding(mesh, P()))
+
+        def runner(p, st, data):
+            was = bool(getattr(layer, "training", False))
+            if hasattr(layer, "eval"):
+                layer.eval()
+            try:
+                return ejit(p, st, data)
+            finally:
+                if was and hasattr(layer, "train"):
+                    layer.train()
+
+        return runner
+
+    prog._eval_builder = _eval_builder
+    # batch divisibility the sharded eval requires (partial final
+    # batches fall back to the caller's synced path)
+    prog._eval_batch_divisor = n_micro * max(n_dp, 1)
     return prog
 
 

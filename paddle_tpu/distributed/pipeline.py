@@ -52,8 +52,12 @@ def stack_stage_params(block_params_list):
 
 def pipeline_spmd(block_fn: Callable, n_stages: int, n_micro: int,
                   mesh, axis: str = "pp", batch_axis: str = None,
-                  param_specs=None, seq_axis: str = None):
-    """Build pipelined_fn(stacked_params, x_micro) -> y_micro.
+                  param_specs=None, seq_axis: str = None,
+                  aux_from_blocks: bool = False):
+    """Build pipelined_fn(stacked_params, x_micro) -> y_micro
+    (or (y_micro, aux_sum) with aux_from_blocks: blocks return (h, aux)
+    and the masked per-microbatch auxes sum over stages — the MoE
+    load-balance term for the eval path).
 
     block_fn(params_one_layer, x) -> x          (one transformer block)
     stacked_params: {name: [L, ...]} sharded P(axis) on dim 0 — each stage
@@ -72,10 +76,16 @@ def pipeline_spmd(block_fn: Callable, n_stages: int, n_micro: int,
 
     def run_local_stack(local_params, x):
         # scan over this stage's L/pp layers
-        def body(h, layer_params):
-            return block_fn(layer_params, h), None
-        h, _ = jax.lax.scan(body, x, local_params)
-        return h
+        def body(carry, layer_params):
+            h, aux = carry
+            out = block_fn(layer_params, h)
+            if aux_from_blocks:
+                h2, a = out
+                return (h2, aux + jnp.asarray(a, jnp.float32)), None
+            return (out, aux), None
+        (h, aux), _ = jax.lax.scan(
+            body, (x, jnp.zeros((), jnp.float32)), local_params)
+        return h, aux
 
     def staged(local_params, x_micro):
         stage = jax.lax.axis_index(axis)
@@ -84,11 +94,16 @@ def pipeline_spmd(block_fn: Callable, n_stages: int, n_micro: int,
         perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
         def tick(carry, t):
-            held, outputs = carry
+            held, outputs, aux_s = carry
             # stage 0 injects microbatch t (if any left); others use held
             inject = jnp.where(t < n_micro, t, n_micro - 1)
             x_in = jnp.where(stage == 0, x_micro[inject], held)
-            y = run_local_stack(local_params, x_in)
+            y, aux = run_local_stack(local_params, x_in)
+            # stage s holds real microbatch t-s only inside the window —
+            # fill/drain ticks run on garbage and must not count
+            m = t - stage
+            valid = jnp.logical_and(m >= 0, m < n_micro)
+            aux_s = aux_s + valid.astype(jnp.float32) * aux
             # pass to next stage; last stage's output is recorded
             out_idx = t - (n_stages - 1)
             rec = jnp.logical_and(stage == n_stages - 1, out_idx >= 0)
@@ -98,15 +113,22 @@ def pipeline_spmd(block_fn: Callable, n_stages: int, n_micro: int,
                     o, y, jnp.maximum(out_idx, 0), 0),
                 lambda o: o, outputs)
             held_next = jax.lax.ppermute(y, axis, perm)
-            return (held_next, outputs), None
+            return (held_next, outputs, aux_s), None
 
         outputs0 = jnp.zeros((n_micro,) + micro_shape, x_micro.dtype)
         held0 = jnp.zeros(micro_shape, x_micro.dtype)
-        (_, outputs), _ = jax.lax.scan(
-            tick, (held0, outputs0), jnp.arange(n_ticks))
+        (_, outputs, aux_s), _ = jax.lax.scan(
+            tick, (held0, outputs0, jnp.zeros((), jnp.float32)),
+            jnp.arange(n_ticks))
         # broadcast last stage's outputs to every stage (psum of masked)
         mask = (stage == n_stages - 1).astype(x_micro.dtype)
         outputs = jax.lax.psum(outputs * mask, axis)
+        if aux_from_blocks:
+            aux_s = jax.lax.psum(aux_s, axis)       # sum over stages
+            for a_ in (batch_axis, seq_axis):
+                if a_ is not None:                  # mean over data shards
+                    aux_s = jax.lax.psum(aux_s, a_) / int(mesh.shape[a_])
+            return outputs, aux_s
         return outputs
 
     def pipelined(stacked_params, x_micro, in_mesh=mesh):
@@ -133,7 +155,7 @@ def pipeline_spmd(block_fn: Callable, n_stages: int, n_micro: int,
         f = jax.shard_map(
             staged, mesh=in_mesh,
             in_specs=(pspecs, dspec),
-            out_specs=dspec,
+            out_specs=(dspec, P()) if aux_from_blocks else dspec,
             check_vma=False)
         return f(stacked_params, x_micro)
 

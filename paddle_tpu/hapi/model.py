@@ -167,8 +167,10 @@ class Model:
     def prepare(self, optimizer=None, loss=None, metrics=None,
                 amp_configs=None, strategy=None):
         """strategy: a DistributedStrategy routes training through the
-        fleet strategy compiler (dp/ZeRO/tp/sp/ep per its toggles); the
-        eval/predict paths stay single-device on synced params."""
+        fleet strategy compiler (dp/ZeRO/tp/sp/ep per its toggles).
+        Metric-less evaluation runs under the SAME shardings (no host
+        gather); metric evaluation and predict sync params and run
+        single-device."""
         self._optimizer = optimizer
         self._loss = loss
         self._metrics = _as_list(metrics)
@@ -449,6 +451,21 @@ class Model:
 
     def eval_batch(self, inputs, labels=None):
         self.network.eval()
+        prog = getattr(self, "_dist_prog", None)
+        batch0 = _as_list(inputs)[0] if _as_list(inputs) else None
+        div = getattr(prog, "_eval_batch_divisor", 0) if prog else 0
+        if getattr(self, "_strategy", None) is not None and \
+                prog is not None and \
+                getattr(prog, "_eval_builder", None) is not None and \
+                not self._metrics and batch0 is not None and div and \
+                np.asarray(batch0).shape[0] % div == 0 and \
+                np.asarray(batch0).shape[0] >= div:
+            # evaluate under the TRAINING shardings — no host gather, no
+            # single-device replication of a model that only fits
+            # sharded (pp/tp/ZeRO-3 scale). Metric users and partial
+            # final batches fall through to the synced path.
+            loss = prog.eval_step(*_to_jax(inputs), *_to_jax(labels))
+            return [float(jax.device_get(loss))]
         self._sync_dist_if_dirty()     # eval on the TRAINED params
         if self._jit_eval is None:
             self._jit_eval = self._build_eval_step()

@@ -301,8 +301,10 @@ class GPT(nn.Layer):
 
         if p_drop > 0:
             def block_fn(bp, h, key=None):
-                if key is None:
-                    # no key -> trace-time constant masks; refuse loudly
+                if key is None and blk0.training:
+                    # no key in TRAIN mode -> trace-time constant masks;
+                    # refuse loudly (eval mode draws no dropout and is
+                    # fine keyless — the pipelined eval path)
                     raise NotImplementedError(
                         "GPT pipeline block with dropout > 0 needs the "
                         "scheduler to thread a PRNG key (use the "

@@ -1200,3 +1200,69 @@ def test_compiled_step_single_device_keeps_layer_arrays_live():
     prog.step(ids, ids, lr=1e-3)
     w = np.asarray(net.wte.weight._data)   # raises if donated-aliased
     assert np.isfinite(w).all()
+
+
+def test_compiled_eval_step_matches_train_loss():
+    """Sharded eval: CompiledTrainStep.eval_step computes the same loss
+    the next train step would report (same params, eval mode), under the
+    training shardings — pp and dp branches."""
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.models import GPT, gpt_tiny
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 512, (8, 32)).astype(np.int64)
+    labels = rng.integers(0, 512, (8, 32)).astype(np.int64)
+
+    for make_s, n_dev in [(lambda: DistributedStrategy(), 2),
+                          (None, 4)]:
+        paddle.seed(0)
+        net = GPT(gpt_tiny())
+        if make_s is None:
+            s = DistributedStrategy()
+            s.pipeline = True
+            s.hybrid_configs.pp_degree = 2
+            s.hybrid_configs.dp_degree = 2
+            s.pipeline_configs.accumulate_steps = 2
+        else:
+            s = make_s()
+            s.hybrid_configs.dp_degree = 2
+        mesh = s.build_mesh(devices=jax.devices()[:n_dev])
+        adam = opt.Adam(learning_rate=1e-3,
+                        parameters=list(net.parameters()))
+        prog = compile_train_step(net, adam, s, mesh=mesh)
+        ev = float(jax.device_get(prog.eval_step(ids, labels)))
+        tr = float(jax.device_get(prog.step(ids, labels, lr=1e-3)))
+        np.testing.assert_allclose(ev, tr, rtol=2e-4, atol=2e-4)
+        # eval after training reflects the updated params
+        ev2 = float(jax.device_get(prog.eval_step(ids, labels)))
+        assert ev2 < ev
+
+
+def test_hapi_evaluate_stays_sharded_under_strategy():
+    """hapi evaluate under a strategy must use the sharded eval step
+    (no host gather of the whole model)."""
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.io import TensorDataset
+    from paddle_tpu.models import GPT, gpt_tiny
+
+    paddle.seed(0)
+    net = GPT(gpt_tiny())
+    s = DistributedStrategy()
+    s.pipeline = True
+    s.hybrid_configs.pp_degree = 2
+    s.hybrid_configs.dp_degree = 1
+    s.pipeline_configs.accumulate_steps = 2
+    s.build_mesh(devices=jax.devices()[:2])
+    model = Model(net)
+    model.prepare(opt.Adam(learning_rate=1e-3,
+                           parameters=model.parameters()), strategy=s)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 512, (8, 32)).astype(np.int64)
+    lab = rng.integers(0, 512, (8, 32)).astype(np.int64)
+    l_train = float(model.train_batch([ids], [lab])[0])
+    logs = model.evaluate(TensorDataset([ids, lab]), batch_size=8,
+                          verbose=0)
+    assert np.isfinite(logs["loss"]) and logs["loss"] < l_train + 0.1
+    # the dirty flag must be untouched (no forced host sync happened)
+    assert model._dist_dirty
