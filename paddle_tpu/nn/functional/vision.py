@@ -1,0 +1,709 @@
+"""Vision functionals: spatial-transform sampling, channel reshuffles,
+legacy image ops.
+
+Reference surface: python/paddle/nn/functional/vision.py (affine_grid:60,
+grid_sample:152) plus the fluid.layers re-exports — affine_channel
+(fluid/layers/nn.py:12661), space_to_depth (nn.py:12555), shuffle_channel
+(nn.py:13270), temporal_shift (nn.py:13343), fsp_matrix (nn.py:13934),
+pad2d (nn.py:9272), image_resize (nn.py:7107), image_resize_short
+(nn.py:8205), roi_pool (nn.py:6863), roi_align (nn.py:6968), psroi_pool
+(nn.py:13723), prroi_pool (nn.py:13792).
+
+TPU-native design: every op below is expressed as dense jnp math with
+static output shapes so XLA can fuse and tile it. Where the reference's
+CPU/CUDA kernels use data-dependent inner loop bounds (roi quantization),
+we compute the same quantities with masks over the static [H, W] extent
+instead — jit-safe on TPU, identical numerics.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor, apply
+from .common import interpolate
+
+__all__ = [
+    "affine_grid", "grid_sample", "affine_channel", "space_to_depth",
+    "shuffle_channel", "temporal_shift", "fsp_matrix", "pad2d",
+    "pad_constant_like", "image_resize", "image_resize_short",
+    "resize_bilinear", "resize_nearest", "resize_trilinear",
+    "roi_pool", "roi_align", "psroi_pool", "prroi_pool",
+    "similarity_focus", "add_position_encoding", "random_crop",
+    "im2sequence", "grid_sampler",
+]
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """Generate a [N, H, W, 2] sampling grid from batched affine params
+    theta [N, 2, 3] (reference nn/functional/vision.py:60).
+
+    Base grid coordinates are in [-1, 1]; with align_corners the extremes
+    map to corner pixel centers, otherwise to pixel edges.
+    """
+    if isinstance(out_shape, Tensor):
+        out_shape = [int(v) for v in np.asarray(out_shape.numpy()).tolist()]
+    n, _, h, w = [int(v) for v in out_shape]
+
+    def f(th):
+        def axis_coords(size):
+            if align_corners:
+                if size == 1:
+                    return jnp.zeros((1,), th.dtype)
+                return jnp.linspace(-1.0, 1.0, size, dtype=th.dtype)
+            # edge-aligned: centers of `size` equal cells spanning [-1, 1]
+            step = 2.0 / size
+            return (jnp.arange(size, dtype=th.dtype) + 0.5) * step - 1.0
+
+        xs = axis_coords(w)
+        ys = axis_coords(h)
+        gx, gy = jnp.meshgrid(xs, ys)          # [H, W] each
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1)        # [H, W, 3]
+        # out[n, h, w, k] = sum_j base[h, w, j] * theta[n, k, j]
+        return jnp.einsum("hwj,nkj->nhwk", base, th)
+    return apply(f, theta, op_name="affine_grid")
+
+
+def _unnormalize(coord, size, align_corners):
+    if align_corners:
+        return (coord + 1.0) * (0.5 * (size - 1))
+    return (coord + 1.0) * (0.5 * size) - 0.5
+
+
+def _reflect(coord, size, align_corners):
+    # reference grid_sampler_op.h:79-96 — reflect about the pixel-center
+    # extremes (align_corners) or pixel edges (not align_corners).
+    if align_corners:
+        span = jnp.asarray(2.0 * max(size - 1, 1), coord.dtype)
+        absc = jnp.abs(coord)
+        extra = absc - jnp.floor(absc / span) * span
+        return jnp.minimum(extra, span - extra)
+    span = jnp.asarray(2.0 * size, coord.dtype)
+    absc = jnp.abs(coord + 0.5)
+    extra = absc - jnp.floor(absc / span) * span
+    return jnp.minimum(extra, span - extra) - 0.5
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Sample x [N, C, H, W] at grid [N, Hg, Wg, 2] locations (normalized
+    to [-1, 1]) — reference nn/functional/vision.py:152, kernel
+    grid_sampler_op.h. Fully differentiable, jit/vmap-safe; the gathers
+    lower to XLA dynamic-slice batches that stay on-chip.
+    """
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError("grid_sample mode must be 'bilinear' or 'nearest', "
+                         "got %r" % (mode,))
+    if padding_mode not in ("zeros", "border", "reflection"):
+        raise ValueError("grid_sample padding_mode must be zeros|border|"
+                         "reflection, got %r" % (padding_mode,))
+
+    def f(a, g):
+        n, c, h, w = a.shape
+        gx = _unnormalize(g[..., 0], w, align_corners)   # [N, Hg, Wg]
+        gy = _unnormalize(g[..., 1], h, align_corners)
+
+        if padding_mode == "border":
+            gx = jnp.clip(gx, 0.0, w - 1.0)
+            gy = jnp.clip(gy, 0.0, h - 1.0)
+        elif padding_mode == "reflection":
+            gx = jnp.clip(_reflect(gx, w, align_corners), 0.0, w - 1.0)
+            gy = jnp.clip(_reflect(gy, h, align_corners), 0.0, h - 1.0)
+
+        def gather(iy, ix):
+            # per-batch gather of a[n, :, iy, ix] -> [N, C, Hg, Wg]
+            iyc = jnp.clip(iy, 0, h - 1)
+            ixc = jnp.clip(ix, 0, w - 1)
+            flat = a.reshape(n, c, h * w)
+            idx = (iyc * w + ixc).reshape(n, -1)          # [N, Hg*Wg]
+            out = jnp.take_along_axis(flat, idx[:, None, :], axis=2)
+            return out.reshape(n, c, *iy.shape[1:])
+
+        def mask_of(iy, ix):
+            valid = ((iy >= 0) & (iy <= h - 1) & (ix >= 0) & (ix <= w - 1))
+            return valid.astype(a.dtype)[:, None]
+
+        if mode == "nearest":
+            ix = jnp.floor(gx + 0.5).astype(jnp.int32)
+            iy = jnp.floor(gy + 0.5).astype(jnp.int32)
+            out = gather(iy, ix)
+            if padding_mode == "zeros":
+                out = out * mask_of(iy, ix)
+            return out
+
+        x0 = jnp.floor(gx).astype(jnp.int32)
+        y0 = jnp.floor(gy).astype(jnp.int32)
+        x1, y1 = x0 + 1, y0 + 1
+        dx = (gx - x0.astype(gx.dtype))[:, None]          # [N, 1, Hg, Wg]
+        dy = (gy - y0.astype(gy.dtype))[:, None]
+
+        vals = 0.0
+        for iy, wy in ((y0, 1.0 - dy), (y1, dy)):
+            for ix, wx in ((x0, 1.0 - dx), (x1, dx)):
+                v = gather(iy, ix)
+                wgt = wx * wy
+                if padding_mode == "zeros":
+                    wgt = wgt * mask_of(iy, ix)
+                vals = vals + v * wgt
+        return vals.astype(a.dtype)
+    return apply(f, x, grid, op_name="grid_sample")
+
+
+def grid_sampler(x, grid, name=None):
+    """Legacy alias (fluid/layers/nn.py:12920): bilinear, zeros padding,
+    align_corners=True."""
+    return grid_sample(x, grid)
+
+
+def affine_channel(x, scale=None, bias=None, data_layout="NCHW", act=None,
+                   name=None):
+    """Per-channel y = scale * x + bias (fluid/layers/nn.py:12661)."""
+    ch_axis = 1 if data_layout == "NCHW" else -1
+
+    def f(a, s, b):
+        shape = [1] * a.ndim
+        shape[ch_axis] = a.shape[ch_axis]
+        out = a * s.reshape(shape) + b.reshape(shape)
+        if act == "relu":
+            out = jnp.maximum(out, 0)
+        elif act is not None:
+            raise ValueError("affine_channel act must be None or 'relu'")
+        return out
+    n_ch = int(x.shape[ch_axis])
+    if scale is None:
+        scale = Tensor(jnp.ones((n_ch,)))
+    if bias is None:
+        bias = Tensor(jnp.zeros((n_ch,)))
+    return apply(f, x, scale, bias, op_name="affine_channel")
+
+
+def space_to_depth(x, blocksize, name=None):
+    """Rearrange [N, C, H, W] -> [N, C*bs*bs, H/bs, W/bs]
+    (fluid/layers/nn.py:12555)."""
+    bs = int(blocksize)
+
+    def f(a):
+        n, c, h, w = a.shape
+        if h % bs or w % bs:
+            raise ValueError("space_to_depth: H and W must be divisible by "
+                             "blocksize %d, got %s" % (bs, (h, w)))
+        a = a.reshape(n, c, h // bs, bs, w // bs, bs)
+        a = a.transpose(0, 3, 5, 1, 2, 4)
+        return a.reshape(n, c * bs * bs, h // bs, w // bs)
+    return apply(f, x, op_name="space_to_depth")
+
+
+def shuffle_channel(x, group, name=None):
+    """ShuffleNet channel shuffle (fluid/layers/nn.py:13270)."""
+    g = int(group)
+
+    def f(a):
+        n, c, h, w = a.shape
+        if c % g:
+            raise ValueError("shuffle_channel: C %% group != 0")
+        return (a.reshape(n, g, c // g, h, w)
+                 .transpose(0, 2, 1, 3, 4)
+                 .reshape(n, c, h, w))
+    return apply(f, x, op_name="shuffle_channel")
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None,
+                   data_format="NCHW"):
+    """TSM temporal shift (fluid/layers/nn.py:13343): the first
+    C*shift_ratio channels shift one frame back, the next block one frame
+    forward, the rest stay."""
+    seg = int(seg_num)
+
+    def f(a):
+        if data_format == "NHWC":
+            a = a.transpose(0, 3, 1, 2)
+        nt, c, h, w = a.shape
+        n = nt // seg
+        v = a.reshape(n, seg, c, h, w)
+        c1 = int(c * shift_ratio)
+        c2 = int(c * 2 * shift_ratio)
+        # kernel temporal_shift_op.h:31-38 — channels [0, c1) read frame
+        # t-1 (zero at t=0), channels [c1, c2) read t+1 (zero at t=T-1)
+        past = jnp.concatenate(
+            [jnp.zeros_like(v[:, :1, :c1]), v[:, :-1, :c1]], axis=1)
+        future = jnp.concatenate(
+            [v[:, 1:, c1:c2], jnp.zeros_like(v[:, :1, c1:c2])], axis=1)
+        out = jnp.concatenate([past, future, v[:, :, c2:]], axis=2)
+        out = out.reshape(nt, c, h, w)
+        if data_format == "NHWC":
+            out = out.transpose(0, 2, 3, 1)
+        return out
+    return apply(f, x, op_name="temporal_shift")
+
+
+def fsp_matrix(x, y):
+    """Flow-of-solution-procedure matrix (fluid/layers/nn.py:13934):
+    out[n, i, j] = mean_hw x[n, i, h, w] * y[n, j, h, w]."""
+    def f(a, b):
+        n, c1, h, w = a.shape
+        return jnp.einsum("nihw,njhw->nij", a, b) / (h * w)
+    return apply(f, x, y, op_name="fsp_matrix")
+
+
+def pad2d(input, paddings=(0, 0, 0, 0), mode="constant", pad_value=0.0,
+          data_format="NCHW", name=None):
+    """Pad H/W dims with [top, bottom, left, right] (fluid/layers/nn.py:9272)."""
+    if isinstance(paddings, Tensor):
+        paddings = [int(v) for v in np.asarray(paddings.numpy()).tolist()]
+    t, b, l, r = [int(v) for v in paddings]
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "edge": "edge"}[mode]
+
+    def f(a):
+        if data_format == "NCHW":
+            widths = [(0, 0), (0, 0), (t, b), (l, r)]
+        else:
+            widths = [(0, 0), (t, b), (l, r), (0, 0)]
+        if jmode == "constant":
+            return jnp.pad(a, widths, constant_values=pad_value)
+        return jnp.pad(a, widths, mode=jmode)
+    return apply(f, input, op_name="pad2d")
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    """Pad y at the tail of every dim up to x's shape
+    (fluid/layers/nn.py — pad_constant_like)."""
+    def f(a, b):
+        widths = [(0, int(sa) - int(sb)) for sa, sb in zip(a.shape, b.shape)]
+        return jnp.pad(b, widths, constant_values=pad_value)
+    return apply(f, x, y, op_name="pad_constant_like")
+
+
+def image_resize(input, out_shape=None, scale=None, name=None,
+                 resample="BILINEAR", actual_shape=None, align_corners=True,
+                 align_mode=1, data_format="NCHW"):
+    """Legacy resize facade over interpolate (fluid/layers/nn.py:7107)."""
+    mode = {"BILINEAR": "bilinear", "NEAREST": "nearest",
+            "TRILINEAR": "trilinear", "BICUBIC": "bicubic",
+            "LINEAR": "linear"}[resample.upper()]
+    if actual_shape is not None:
+        out_shape = actual_shape
+    if mode == "nearest" and align_corners:
+        # legacy nearest honors align_corners (interpolate_op.h: in_k =
+        # round(k * (in-1)/(out-1))); the v2 interpolate path only does the
+        # half-pixel convention, so gather explicitly here.
+        if out_shape is None:
+            spatial = input.shape[2:]
+            out_shape = [int(round(s * scale)) for s in spatial]
+        tgt = [int(v) for v in out_shape]
+
+        def f(a):
+            out = a
+            for ax, t in zip(range(2, 2 + len(tgt)), tgt):
+                s = out.shape[ax]
+                ratio = 0.0 if t <= 1 else (s - 1.0) / (t - 1.0)
+                idx = jnp.floor(jnp.arange(t, dtype=jnp.float32) * ratio
+                                + 0.5).astype(jnp.int32)
+                out = jnp.take(out, jnp.clip(idx, 0, s - 1), axis=ax)
+            return out
+        return apply(f, input, op_name="resize_nearest_ac")
+    return interpolate(input, size=out_shape, scale_factor=scale, mode=mode,
+                       align_corners=align_corners, align_mode=align_mode,
+                       data_format=data_format)
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    """Resize so the short side equals out_short_len, keeping aspect
+    (fluid/layers/nn.py:8205)."""
+    h, w = int(input.shape[2]), int(input.shape[3])
+    short, long_ = (h, w) if h < w else (w, h)
+    ratio = float(out_short_len) / short
+    new_h, new_w = int(round(h * ratio)), int(round(w * ratio))
+    return image_resize(input, out_shape=[new_h, new_w], resample=resample)
+
+
+def resize_bilinear(input, out_shape=None, scale=None, name=None,
+                    actual_shape=None, align_corners=True, align_mode=1,
+                    data_format="NCHW"):
+    return image_resize(input, out_shape, scale, name, "BILINEAR",
+                        actual_shape, align_corners, align_mode, data_format)
+
+
+def resize_nearest(input, out_shape=None, scale=None, name=None,
+                   actual_shape=None, align_corners=True, data_format="NCHW"):
+    return image_resize(input, out_shape, scale, name, "NEAREST",
+                        actual_shape, align_corners, 1, data_format)
+
+
+def resize_trilinear(input, out_shape=None, scale=None, name=None,
+                     actual_shape=None, align_corners=True, align_mode=1,
+                     data_format="NCDHW"):
+    return image_resize(input, out_shape, scale, name, "TRILINEAR",
+                        actual_shape, align_corners, align_mode, data_format)
+
+
+# --------------------------------------------------------------------------
+# RoI ops. rois are [R, 4] (x1, y1, x2, y2) in input-image coordinates with
+# rois_num giving the per-image split (the LoD replacement — core/lod.py).
+# All four are computed with masks/integrals over the static [H, W] extent
+# instead of the reference's data-dependent loop bounds, so they jit.
+# --------------------------------------------------------------------------
+
+def _roi_batch_index(rois_shape0, rois_num, n_batch):
+    if rois_num is None:
+        return np.zeros(rois_shape0, np.int32)
+    rn = np.asarray(rois_num.numpy() if isinstance(rois_num, Tensor)
+                    else rois_num).astype(np.int64)
+    if int(rn.sum()) != int(rois_shape0):
+        raise ValueError(
+            "rois_num sums to %d but rois has %d rows" %
+            (int(rn.sum()), int(rois_shape0)))
+    return np.repeat(np.arange(len(rn), dtype=np.int32), rn)
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1, spatial_scale=1.0,
+             rois_num=None, name=None):
+    """Quantized max pooling per roi bin (fluid/layers/nn.py:6863,
+    kernel roi_pool_op.h): coords rounded, bins floor/ceil-split, empty
+    bins yield 0."""
+    ph, pw = int(pooled_height), int(pooled_width)
+    bidx = _roi_batch_index(int(rois.shape[0]), rois_num, int(input.shape[0]))
+
+    def f(feat, boxes):
+        n, c, h, w = feat.shape
+        x1 = jnp.round(boxes[:, 0] * spatial_scale)
+        y1 = jnp.round(boxes[:, 1] * spatial_scale)
+        x2 = jnp.round(boxes[:, 2] * spatial_scale)
+        y2 = jnp.round(boxes[:, 3] * spatial_scale)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+
+        ys = jnp.arange(h, dtype=jnp.float32)
+        xs = jnp.arange(w, dtype=jnp.float32)
+
+        def one(roi_i):
+            fy1, fx1 = y1[roi_i], x1[roi_i]
+            bh, bw = bin_h[roi_i], bin_w[roi_i]
+            # bin [i, j] covers rows [floor(y1+i*bh), ceil(y1+(i+1)*bh))
+            i_idx = jnp.arange(ph, dtype=jnp.float32)
+            j_idx = jnp.arange(pw, dtype=jnp.float32)
+            hs = jnp.clip(jnp.floor(fy1 + i_idx * bh), 0, h)
+            he = jnp.clip(jnp.ceil(fy1 + (i_idx + 1) * bh), 0, h)
+            ws_ = jnp.clip(jnp.floor(fx1 + j_idx * bw), 0, w)
+            we = jnp.clip(jnp.ceil(fx1 + (j_idx + 1) * bw), 0, w)
+            row_m = ((ys[None, :] >= hs[:, None]) &
+                     (ys[None, :] < he[:, None]))            # [ph, H]
+            col_m = ((xs[None, :] >= ws_[:, None]) &
+                     (xs[None, :] < we[:, None]))            # [pw, W]
+            m = row_m[:, None, :, None] & col_m[None, :, None, :]
+            fmap = feat[jnp.asarray(bidx)[roi_i]]             # [C, H, W]
+            neg = jnp.finfo(feat.dtype).min
+            masked = jnp.where(m[None], fmap[:, None, None],
+                               neg)                           # [C,ph,pw,H,W]
+            out = masked.max(axis=(3, 4))
+            empty = ~m.any(axis=(2, 3))
+            return jnp.where(empty[None], 0.0, out)
+        idx = jnp.arange(boxes.shape[0])
+        return jax.vmap(one)(idx).astype(feat.dtype)
+    return apply(f, input, rois, op_name="roi_pool")
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, rois_num=None, name=None):
+    """RoIAlign average of bilinear samples (fluid/layers/nn.py:6968,
+    kernel roi_align_op.h). sampling_ratio<=0 uses the reference's
+    adaptive ceil(roi_size/pooled) count, computed host-side from
+    concrete roi values (eager); a positive sampling_ratio gives a fully
+    static grid that jits."""
+    ph, pw = int(pooled_height), int(pooled_width)
+    bidx = _roi_batch_index(int(rois.shape[0]), rois_num, int(input.shape[0]))
+    sr = int(sampling_ratio)
+
+    adaptive_counts = None
+    if sr <= 0:
+        bx = np.asarray(rois.numpy() if isinstance(rois, Tensor) else rois)
+        rw = np.maximum(bx[:, 2] - bx[:, 0], 0.0) * spatial_scale
+        rh = np.maximum(bx[:, 3] - bx[:, 1], 0.0) * spatial_scale
+        rw = np.maximum(rw, 1.0)
+        rh = np.maximum(rh, 1.0)
+        adaptive_counts = (np.ceil(rh / ph).astype(int),
+                          np.ceil(rw / pw).astype(int))
+
+    def f(feat, boxes):
+        n, c, h, w = feat.shape
+
+        def sample_bilinear(fmap, ys, xs):
+            # fmap [C, H, W]; ys/xs flat sample coords
+            y0 = jnp.floor(ys)
+            x0 = jnp.floor(xs)
+            iy0 = jnp.clip(y0.astype(jnp.int32), 0, h - 1)
+            ix0 = jnp.clip(x0.astype(jnp.int32), 0, w - 1)
+            iy1 = jnp.clip(iy0 + 1, 0, h - 1)
+            ix1 = jnp.clip(ix0 + 1, 0, w - 1)
+            ly = jnp.clip(ys - y0, 0.0, 1.0)
+            lx = jnp.clip(xs - x0, 0.0, 1.0)
+            v00 = fmap[:, iy0, ix0]
+            v01 = fmap[:, iy0, ix1]
+            v10 = fmap[:, iy1, ix0]
+            v11 = fmap[:, iy1, ix1]
+            val = (v00 * (1 - ly) * (1 - lx) + v01 * (1 - ly) * lx +
+                   v10 * ly * (1 - lx) + v11 * ly * lx)
+            # reference: samples with y < -1 or y > H are dropped (weight 0)
+            ok = ((ys >= -1.0) & (ys <= h) & (xs >= -1.0) & (xs <= w))
+            return val * ok.astype(val.dtype)
+
+        def one(roi_i, gh, gw):
+            box = boxes[roi_i]
+            x1 = box[0] * spatial_scale
+            y1 = box[1] * spatial_scale
+            rw_ = jnp.maximum(box[2] * spatial_scale - x1, 1.0)
+            rh_ = jnp.maximum(box[3] * spatial_scale - y1, 1.0)
+            bin_h = rh_ / ph
+            bin_w = rw_ / pw
+            iy = (jnp.arange(gh, dtype=jnp.float32) + 0.5) / gh   # in-bin frac
+            ix = (jnp.arange(gw, dtype=jnp.float32) + 0.5) / gw
+            by = jnp.arange(ph, dtype=jnp.float32)
+            bx_ = jnp.arange(pw, dtype=jnp.float32)
+            ys = y1 + (by[:, None] + iy[None, :]) * bin_h         # [ph, gh]
+            xs = x1 + (bx_[:, None] + ix[None, :]) * bin_w        # [pw, gw]
+            yy = jnp.broadcast_to(ys[:, None, :, None], (ph, pw, gh, gw))
+            xx = jnp.broadcast_to(xs[None, :, None, :], (ph, pw, gh, gw))
+            vals = sample_bilinear(feat[jnp.asarray(bidx)[roi_i]],
+                                   yy.reshape(-1), xx.reshape(-1))
+            vals = vals.reshape(-1, ph, pw, gh, gw)
+            return vals.mean(axis=(3, 4))
+
+        if sr > 0:
+            idx = jnp.arange(boxes.shape[0])
+            return jax.vmap(lambda i: one(i, sr, sr))(idx).astype(feat.dtype)
+        outs = [one(i, int(adaptive_counts[0][i]), int(adaptive_counts[1][i]))
+                for i in range(boxes.shape[0])]
+        return jnp.stack(outs).astype(feat.dtype)
+    return apply(f, input, rois, op_name="roi_align")
+
+
+def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
+               pooled_width, rois_num=None, name=None):
+    """Position-sensitive RoI average pooling (fluid/layers/nn.py:13723,
+    kernel psroi_pool_op.h): C must equal output_channels*ph*pw; bin
+    [i, j] pools channel group i*pw+j."""
+    ph, pw = int(pooled_height), int(pooled_width)
+    oc = int(output_channels)
+    bidx = _roi_batch_index(int(rois.shape[0]), rois_num, int(input.shape[0]))
+
+    def f(feat, boxes):
+        n, c, h, w = feat.shape
+        if c != oc * ph * pw:
+            raise ValueError("psroi_pool: input channels %d != "
+                             "output_channels*ph*pw %d" % (c, oc * ph * pw))
+        # reference rounds roi corners to integer grid then scales
+        x1 = jnp.round(boxes[:, 0]) * spatial_scale
+        y1 = jnp.round(boxes[:, 1]) * spatial_scale
+        x2 = jnp.round(boxes[:, 2] + 1.0) * spatial_scale
+        y2 = jnp.round(boxes[:, 3] + 1.0) * spatial_scale
+        rh = jnp.maximum(y2 - y1, 0.1)
+        rw = jnp.maximum(x2 - x1, 0.1)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        ys = jnp.arange(h, dtype=jnp.float32)
+        xs = jnp.arange(w, dtype=jnp.float32)
+
+        def one(roi_i):
+            hs = jnp.clip(jnp.floor(y1[roi_i] +
+                                    jnp.arange(ph)[:, None] * bin_h[roi_i]),
+                          0, h)[:, 0]
+            he = jnp.clip(jnp.ceil(y1[roi_i] +
+                                   (jnp.arange(ph)[:, None] + 1) * bin_h[roi_i]),
+                          0, h)[:, 0]
+            ws_ = jnp.clip(jnp.floor(x1[roi_i] +
+                                     jnp.arange(pw)[:, None] * bin_w[roi_i]),
+                           0, w)[:, 0]
+            we = jnp.clip(jnp.ceil(x1[roi_i] +
+                                   (jnp.arange(pw)[:, None] + 1) * bin_w[roi_i]),
+                          0, w)[:, 0]
+            row_m = ((ys[None, :] >= hs[:, None]) &
+                     (ys[None, :] < he[:, None])).astype(feat.dtype)
+            col_m = ((xs[None, :] >= ws_[:, None]) &
+                     (xs[None, :] < we[:, None])).astype(feat.dtype)
+            fmap = feat[jnp.asarray(bidx)[roi_i]].reshape(oc, ph * pw, h, w)
+            # group channel for bin (i, j) is i*pw + j
+            g = fmap.transpose(1, 0, 2, 3).reshape(ph, pw, oc, h, w)
+            m = row_m[:, None, None, :, None] * col_m[None, :, None, None, :]
+            ssum = (g * m).sum(axis=(3, 4))
+            area = m.sum(axis=(3, 4))
+            out = jnp.where(area > 0, ssum / jnp.maximum(area, 1.0), 0.0)
+            return out.transpose(2, 0, 1)                      # [oc, ph, pw]
+        idx = jnp.arange(boxes.shape[0])
+        return jax.vmap(one)(idx).astype(feat.dtype)
+    return apply(f, input, rois, op_name="psroi_pool")
+
+
+def prroi_pool(input, rois, spatial_scale=1.0, pooled_height=1,
+               pooled_width=1, batch_roi_nums=None, name=None):
+    """Precise RoI pooling (fluid/layers/nn.py:13792): the exact integral
+    of the bilinearly-interpolated feature over each continuous bin,
+    divided by bin area. Separable: out = wy^T F wx / area with wy/wx the
+    per-axis integrals of the linear-interp hat bases — static [H]/[W]
+    weight vectors, so this jits and the MXU does the contraction."""
+    ph, pw = int(pooled_height), int(pooled_width)
+    bidx = _roi_batch_index(int(rois.shape[0]), batch_roi_nums,
+                            int(input.shape[0]))
+
+    def hat_integral(lo, hi, size):
+        """Integral over [lo, hi] of each pixel's hat basis
+        max(0, 1 - |t - c|) (peak at pixel center c, support [c-1, c+1]);
+        rising piece antiderivative F1(t) = t(1-c) + t^2/2, falling piece
+        F2(t) = t(1+c) - t^2/2."""
+        c = jnp.arange(size, dtype=jnp.float32)
+        a1 = jnp.clip(lo, c - 1, c)
+        b1 = jnp.clip(hi, c - 1, c)
+        a2 = jnp.clip(lo, c, c + 1)
+        b2 = jnp.clip(hi, c, c + 1)
+        F1 = lambda t: t * (1 - c) + t * t / 2  # noqa: E731
+        F2 = lambda t: t * (1 + c) - t * t / 2  # noqa: E731
+        return (F1(b1) - F1(a1)) + (F2(b2) - F2(a2))
+
+    def f(feat, boxes):
+        n, c, h, w = feat.shape
+        x1 = boxes[:, 0] * spatial_scale
+        y1 = boxes[:, 1] * spatial_scale
+        x2 = boxes[:, 2] * spatial_scale
+        y2 = boxes[:, 3] * spatial_scale
+        bin_h = (y2 - y1) / ph
+        bin_w = (x2 - x1) / pw
+
+        def one(roi_i):
+            fmap = feat[jnp.asarray(bidx)[roi_i]]    # [C, H, W]
+            outs = []
+            for i in range(ph):
+                row = []
+                for j in range(pw):
+                    lo_y = y1[roi_i] + i * bin_h[roi_i]
+                    hi_y = y1[roi_i] + (i + 1) * bin_h[roi_i]
+                    lo_x = x1[roi_i] + j * bin_w[roi_i]
+                    hi_x = x1[roi_i] + (j + 1) * bin_w[roi_i]
+                    wy = hat_integral(lo_y, hi_y, h)      # [H]
+                    wx = hat_integral(lo_x, hi_x, w)      # [W]
+                    area = jnp.maximum((hi_y - lo_y) * (hi_x - lo_x), 1e-9)
+                    val = jnp.einsum("chw,h,w->c", fmap, wy, wx) / area
+                    row.append(val)
+                outs.append(jnp.stack(row, axis=-1))
+            return jnp.stack(outs, axis=-2)               # [C, ph, pw]
+        idx = jnp.arange(boxes.shape[0])
+        return jax.vmap(one)(idx).astype(feat.dtype)
+    return apply(f, input, rois, op_name="prroi_pool")
+
+
+def similarity_focus(input, axis, indexes, name=None):
+    """Similarity-focus mask (fluid/layers/nn.py — similarity_focus):
+    for each selected channel slice, greedily mark per-(row, col) maxima
+    so every row and column of the [H, W] plane is covered once."""
+    if axis != 1:
+        raise ValueError("similarity_focus: only axis=1 (channel) is "
+                         "supported, matching the reference's usage")
+    idxs = [int(i) for i in indexes]
+
+    def f(a):
+        x = np.asarray(a)
+        n, c, h, w = x.shape
+        out = np.zeros_like(x)
+        # kernel similarity_focus_op.h:93-120 — walk values descending,
+        # mark a cell only if BOTH its row and column are untagged; stop
+        # after min(H, W) marks per (batch, index)
+        for b in range(n):
+            for ch in idxs:
+                plane = x[b, ch]
+                order = np.argsort(plane, axis=None, kind="stable")[::-1]
+                row_used = np.zeros(h, bool)
+                col_used = np.zeros(w, bool)
+                marked = 0
+                for flat in order:
+                    r, cc = divmod(int(flat), w)
+                    if row_used[r] or col_used[cc]:
+                        continue
+                    out[b, :, r, cc] = 1.0
+                    row_used[r] = True
+                    col_used[cc] = True
+                    marked += 1
+                    if marked == min(h, w):
+                        break
+        return jnp.asarray(out)
+    return apply(f, input, op_name="similarity_focus")
+
+
+def add_position_encoding(input, alpha, beta, name=None):
+    """out = alpha*x + beta*sinusoid PE (fluid/layers/nn.py —
+    add_position_encoding); x is [B, T, C] with even C."""
+    def f(a):
+        b, t, c = a.shape
+        half = c // 2
+        pos = jnp.arange(t, dtype=jnp.float32)[:, None]
+        if half > 1:
+            i = jnp.arange(half, dtype=jnp.float32)[None, :]
+            freq = pos / jnp.power(10000.0, i / (half - 1))
+        else:
+            # kernel add_position_encoding_op.h: half_size==1 -> j/10000
+            freq = pos / 10000.0
+        pe = jnp.concatenate([jnp.sin(freq), jnp.cos(freq)], axis=1)
+        return (alpha * a + beta * pe[None]).astype(a.dtype)
+    return apply(f, input, op_name="add_position_encoding")
+
+
+def random_crop(x, shape, seed=None):
+    """Random crop to `shape` over the trailing dims, with an independent
+    offset per leading-dim instance (kernel random_crop_op.h seeds its
+    engine per instance). Unseeded calls draw from the framework RNG so
+    paddle.seed makes them reproducible."""
+    from ...core import random as random_mod
+    arr = np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+    tgt = [int(s) for s in shape]
+    lead = arr.ndim - len(tgt)
+    if seed is None:
+        key = random_mod.next_key()
+        seed = int(jax.random.randint(key, (), 0, 2**31 - 1))
+    rng = np.random.RandomState(int(seed) & 0x7FFFFFFF)
+    lead_shape = arr.shape[:lead]
+    flat = arr.reshape((-1,) + arr.shape[lead:])
+    out = np.empty((flat.shape[0],) + tuple(tgt), arr.dtype)
+    for inst in range(flat.shape[0]):
+        starts = [rng.randint(0, flat.shape[1 + i] - t + 1)
+                  for i, t in enumerate(tgt)]
+        slc = tuple(slice(s, s + t) for s, t in zip(starts, tgt))
+        out[inst] = flat[inst][slc]
+    return Tensor(jnp.asarray(out.reshape(lead_shape + tuple(tgt))))
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, input_image_size=None,
+                out_stride=1, name=None):
+    """Unfold [N, C, H, W] into patch rows [N*oh*ow, C*fh*fw]
+    (fluid/layers/nn.py:5521). The padded-dense form of the reference's
+    LoD output: each image contributes oh*ow consecutive rows."""
+    if input_image_size is not None or out_stride != 1:
+        raise NotImplementedError(
+            "im2sequence: per-image real sizes (input_image_size/out_stride) "
+            "need the ragged LoD output; use the dense whole-extent form")
+    def to2(v):
+        return (int(v), int(v)) if isinstance(v, int) else tuple(int(i) for i in v)
+    fh, fw = to2(filter_size)
+    sh, sw = to2(stride)
+    pad = padding if isinstance(padding, (list, tuple)) else [padding]
+    pad = [int(p) for p in pad]
+    if len(pad) == 1:
+        pt = pb = pl = pr = pad[0]
+    elif len(pad) == 2:
+        pt = pb = pad[0]
+        pl = pr = pad[1]
+    else:
+        pt, pl, pb, pr = pad
+
+    def f(a):
+        n, c, h, w = a.shape
+        a = jnp.pad(a, [(0, 0), (0, 0), (pt, pb), (pl, pr)])
+        hh, ww = h + pt + pb, w + pl + pr
+        oh = (hh - fh) // sh + 1
+        ow = (ww - fw) // sw + 1
+        patches = jax.lax.conv_general_dilated_patches(
+            a, (fh, fw), (sh, sw), "VALID",
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))   # [N, C*fh*fw, oh, ow]
+        patches = patches.transpose(0, 2, 3, 1)
+        return patches.reshape(n * oh * ow, c * fh * fw)
+    return apply(f, input, op_name="im2sequence")

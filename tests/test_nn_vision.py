@@ -1,0 +1,357 @@
+"""Vision functional ops vs torch / numpy references.
+
+Reference test strategy: fluid/tests/unittests/test_grid_sampler_op.py,
+test_affine_grid_op.py, test_roi_align_op.py etc. compare against numpy
+kernels; here torch (CPU) is the oracle for the sampling ops — paddle's
+grid_sampler kernel follows the same semantics (grid_sampler_op.h).
+"""
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as TF
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+from op_test import check_grad
+
+
+RNG = np.random.RandomState(7)
+
+
+@pytest.mark.parametrize("align_corners", [True, False])
+def test_affine_grid_matches_torch(align_corners):
+    theta = RNG.randn(2, 2, 3).astype(np.float32)
+    out = F.affine_grid(paddle.to_tensor(theta), [2, 3, 5, 7],
+                        align_corners=align_corners).numpy()
+    ref = TF.affine_grid(torch.tensor(theta), (2, 3, 5, 7),
+                         align_corners=align_corners).numpy()
+    np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("mode", ["bilinear", "nearest"])
+@pytest.mark.parametrize("padding_mode", ["zeros", "border", "reflection"])
+@pytest.mark.parametrize("align_corners", [True, False])
+def test_grid_sample_matches_torch(mode, padding_mode, align_corners):
+    x = RNG.randn(2, 3, 6, 5).astype(np.float32)
+    grid = (RNG.rand(2, 4, 7, 2).astype(np.float32) * 2.4 - 1.2)
+    out = F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(grid),
+                        mode=mode, padding_mode=padding_mode,
+                        align_corners=align_corners).numpy()
+    ref = TF.grid_sample(torch.tensor(x), torch.tensor(grid), mode=mode,
+                         padding_mode=padding_mode,
+                         align_corners=align_corners).numpy()
+    np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-4)
+
+
+def test_grid_sample_grad():
+    x = RNG.randn(1, 2, 5, 5).astype(np.float32)
+    grid = (RNG.rand(1, 3, 3, 2).astype(np.float32) * 1.6 - 0.8)
+    check_grad(lambda a, g: F.grid_sample(a, g, padding_mode="border"),
+               [x, grid], atol=2e-2, rtol=2e-2)
+
+
+def test_affine_grid_then_sample_identity():
+    # identity theta must reproduce the input (away from border effects)
+    x = RNG.randn(1, 1, 8, 8).astype(np.float32)
+    theta = np.array([[[1.0, 0.0, 0.0], [0.0, 1.0, 0.0]]], np.float32)
+    grid = F.affine_grid(paddle.to_tensor(theta), [1, 1, 8, 8])
+    y = F.grid_sample(paddle.to_tensor(x), grid).numpy()
+    np.testing.assert_allclose(y, x, atol=1e-4)
+
+
+def test_affine_channel():
+    x = RNG.randn(2, 4, 3, 3).astype(np.float32)
+    s = RNG.randn(4).astype(np.float32)
+    b = RNG.randn(4).astype(np.float32)
+    out = F.affine_channel(paddle.to_tensor(x), paddle.to_tensor(s),
+                           paddle.to_tensor(b)).numpy()
+    ref = x * s[None, :, None, None] + b[None, :, None, None]
+    np.testing.assert_allclose(out, ref, atol=1e-6)
+    # NHWC layout
+    xh = np.transpose(x, (0, 2, 3, 1))
+    outh = F.affine_channel(paddle.to_tensor(xh), paddle.to_tensor(s),
+                            paddle.to_tensor(b), data_layout="NHWC").numpy()
+    np.testing.assert_allclose(outh, np.transpose(ref, (0, 2, 3, 1)),
+                               atol=1e-6)
+
+
+def test_space_to_depth():
+    x = np.arange(2 * 2 * 4 * 4, dtype=np.float32).reshape(2, 2, 4, 4)
+    out = F.space_to_depth(paddle.to_tensor(x), 2).numpy()
+    assert out.shape == (2, 8, 2, 2)
+    # block (0,0) of image 0 channel 0: x[0,0,0,0]
+    assert out[0, 0, 0, 0] == x[0, 0, 0, 0]
+    # reference layout: out[:, bs_idx... ] — inverse must reconstruct
+    n, c, h, w = x.shape
+    rec = (out.reshape(n, 2, 2, c, 2, 2)
+              .transpose(0, 3, 4, 1, 5, 2)
+              .reshape(n, c, h, w))
+    np.testing.assert_allclose(rec, x)
+
+
+def test_shuffle_channel():
+    x = np.arange(1 * 6 * 2 * 2, dtype=np.float32).reshape(1, 6, 2, 2)
+    out = F.shuffle_channel(paddle.to_tensor(x), 2).numpy()
+    ref = x.reshape(1, 2, 3, 2, 2).transpose(0, 2, 1, 3, 4).reshape(1, 6, 2, 2)
+    np.testing.assert_allclose(out, ref)
+
+
+def test_temporal_shift():
+    # kernel temporal_shift_op.h: ch<c1 reads t-1 (zero at t=0),
+    # c1<=ch<c2 reads t+1 (zero at t=T-1), rest copy through
+    x = RNG.randn(4, 4, 2, 2).astype(np.float32)  # N*T=4 (T=2), C=4
+    out = F.temporal_shift(paddle.to_tensor(x), seg_num=2,
+                           shift_ratio=0.25).numpy().reshape(2, 2, 4, 2, 2)
+    v = x.reshape(2, 2, 4, 2, 2)
+    np.testing.assert_allclose(out[:, 0, 0], 0 * v[:, 0, 0])   # t=0 <- t=-1
+    np.testing.assert_allclose(out[:, 1, 0], v[:, 0, 0])       # t=1 <- t=0
+    np.testing.assert_allclose(out[:, 0, 1], v[:, 1, 1])       # t=0 <- t=1
+    np.testing.assert_allclose(out[:, 1, 1], 0 * v[:, 1, 1])   # t=1 <- t=2
+    np.testing.assert_allclose(out[:, :, 2:], v[:, :, 2:])
+
+
+def test_fsp_matrix():
+    x = RNG.randn(2, 3, 4, 4).astype(np.float32)
+    y = RNG.randn(2, 5, 4, 4).astype(np.float32)
+    out = F.fsp_matrix(paddle.to_tensor(x), paddle.to_tensor(y)).numpy()
+    ref = np.einsum("nihw,njhw->nij", x, y) / 16.0
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+def test_pad2d_and_pad_constant_like():
+    x = RNG.randn(1, 1, 3, 3).astype(np.float32)
+    out = F.pad2d(paddle.to_tensor(x), [1, 2, 0, 1], pad_value=5.0).numpy()
+    assert out.shape == (1, 1, 6, 4)
+    assert out[0, 0, 0, 0] == 5.0
+    np.testing.assert_allclose(out[0, 0, 1:4, 0:3], x[0, 0])
+    refl = F.pad2d(paddle.to_tensor(x), [1, 1, 1, 1], mode="reflect").numpy()
+    ref = np.pad(x, [(0, 0), (0, 0), (1, 1), (1, 1)], mode="reflect")
+    np.testing.assert_allclose(refl, ref)
+
+    big = np.zeros((2, 3, 4), np.float32)
+    small = RNG.randn(1, 3, 2).astype(np.float32)
+    out = F.pad_constant_like(paddle.to_tensor(big), paddle.to_tensor(small),
+                              pad_value=-1.0).numpy()
+    assert out.shape == (2, 3, 4)
+    np.testing.assert_allclose(out[:1, :, :2], small)
+    assert (out[1:] == -1).all()
+
+
+def test_image_resize_facades():
+    x = RNG.randn(1, 3, 4, 4).astype(np.float32)
+    out = F.resize_bilinear(paddle.to_tensor(x), out_shape=[8, 8]).numpy()
+    assert out.shape == (1, 3, 8, 8)
+    ref = TF.interpolate(torch.tensor(x), size=(8, 8), mode="bilinear",
+                         align_corners=True).numpy()
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+    nn_ = F.resize_nearest(paddle.to_tensor(x), out_shape=[2, 2]).numpy()
+    assert nn_.shape == (1, 3, 2, 2)
+    short = F.image_resize_short(paddle.to_tensor(x), 8)
+    assert short.shape[2] == 8
+
+
+def _np_roi_align(feat, rois, bidx, ph, pw, scale, sr):
+    R = rois.shape[0]
+    C, H, W = feat.shape[1:]
+    out = np.zeros((R, C, ph, pw), np.float64)
+
+    def bil(fm, y, x):
+        if y < -1.0 or y > H or x < -1.0 or x > W:
+            return np.zeros(C)
+        y = min(max(y, 0.0), H - 1)
+        x = min(max(x, 0.0), W - 1)
+        y0, x0 = int(np.floor(y)), int(np.floor(x))
+        y1, x1 = min(y0 + 1, H - 1), min(x0 + 1, W - 1)
+        ly, lx = y - y0, x - x0
+        return (fm[:, y0, x0] * (1 - ly) * (1 - lx) +
+                fm[:, y0, x1] * (1 - ly) * lx +
+                fm[:, y1, x0] * ly * (1 - lx) +
+                fm[:, y1, x1] * ly * lx)
+
+    for r in range(R):
+        x1, y1, x2, y2 = rois[r] * scale
+        rw = max(x2 - x1, 1.0)
+        rh = max(y2 - y1, 1.0)
+        bh, bw = rh / ph, rw / pw
+        gh = sr if sr > 0 else int(np.ceil(rh / ph))
+        gw = sr if sr > 0 else int(np.ceil(rw / pw))
+        fm = feat[bidx[r]]
+        for i in range(ph):
+            for j in range(pw):
+                acc = np.zeros(C)
+                for iy in range(gh):
+                    for ix in range(gw):
+                        y = y1 + (i + (iy + 0.5) / gh) * bh
+                        x = x1 + (j + (ix + 0.5) / gw) * bw
+                        acc += bil(fm, y, x)
+                out[r, :, i, j] = acc / (gh * gw)
+    return out
+
+
+@pytest.mark.parametrize("sr", [2, -1])
+def test_roi_align(sr):
+    feat = RNG.randn(2, 3, 8, 8).astype(np.float32)
+    rois = np.array([[0, 0, 7, 7], [2, 2, 11, 11], [1, 0, 5, 9]], np.float32)
+    rois_num = [2, 1]
+    out = F.roi_align(paddle.to_tensor(feat), paddle.to_tensor(rois),
+                      pooled_height=2, pooled_width=2, spatial_scale=0.5,
+                      sampling_ratio=sr, rois_num=rois_num).numpy()
+    ref = _np_roi_align(feat, rois, [0, 0, 1], 2, 2, 0.5, sr)
+    np.testing.assert_allclose(out, ref, atol=1e-4, rtol=1e-4)
+
+
+def test_roi_align_grad():
+    feat = RNG.randn(1, 2, 6, 6).astype(np.float32)
+    rois = np.array([[0, 0, 5, 5]], np.float32)
+    check_grad(lambda f_: F.roi_align(f_, paddle.to_tensor(rois),
+                                      pooled_height=2, pooled_width=2,
+                                      sampling_ratio=2),
+               [feat], atol=2e-2, rtol=2e-2)
+
+
+def test_roi_pool():
+    feat = np.arange(1 * 1 * 4 * 4, dtype=np.float32).reshape(1, 1, 4, 4)
+    rois = np.array([[0, 0, 3, 3]], np.float32)
+    out = F.roi_pool(paddle.to_tensor(feat), paddle.to_tensor(rois),
+                     pooled_height=2, pooled_width=2).numpy()
+    # quantized bins of a 4x4 roi -> 2x2 max pool
+    ref = np.array([[[[5.0, 7.0], [13.0, 15.0]]]])
+    np.testing.assert_allclose(out, ref)
+
+
+def test_psroi_pool():
+    # C = oc * ph * pw = 2 * 2 * 2 = 8
+    feat = RNG.randn(1, 8, 6, 6).astype(np.float32)
+    rois = np.array([[0, 0, 5, 5]], np.float32)
+    out = F.psroi_pool(paddle.to_tensor(feat), paddle.to_tensor(rois),
+                       output_channels=2, spatial_scale=1.0,
+                       pooled_height=2, pooled_width=2).numpy()
+    assert out.shape == (1, 2, 2, 2)
+    # bin (0, 0) of output channel 0 averages channel 0 over rows [0,3) cols [0,3)
+    np.testing.assert_allclose(out[0, 0, 0, 0], feat[0, 0, 0:3, 0:3].mean(),
+                               atol=1e-5)
+    # bin (1, 1) of output channel 1 averages channel 4+3=7
+    np.testing.assert_allclose(out[0, 1, 1, 1], feat[0, 7, 3:6, 3:6].mean(),
+                               atol=1e-5)
+
+
+def test_prroi_pool_constant_field():
+    # integral-average of a constant field is the constant
+    feat = np.full((1, 2, 6, 6), 3.5, np.float32)
+    rois = np.array([[0.7, 1.2, 4.3, 4.9]], np.float32)
+    out = F.prroi_pool(paddle.to_tensor(feat), paddle.to_tensor(rois),
+                       spatial_scale=1.0, pooled_height=2,
+                       pooled_width=2).numpy()
+    np.testing.assert_allclose(out, np.full((1, 2, 2, 2), 3.5), atol=1e-4)
+
+
+def test_prroi_pool_linear_field():
+    # bilinear interp of a linear ramp is exact; integral average over a
+    # bin equals the ramp at the bin center
+    xs = np.arange(8, dtype=np.float32)
+    feat = np.broadcast_to(xs, (8, 8)).copy()[None, None]
+    rois = np.array([[1.0, 1.0, 5.0, 5.0]], np.float32)
+    out = F.prroi_pool(paddle.to_tensor(feat), paddle.to_tensor(rois),
+                       pooled_height=2, pooled_width=2).numpy()
+    # bins span x in [1,3] and [3,5] -> centers 2 and 4
+    np.testing.assert_allclose(out[0, 0, :, 0], [2.0, 2.0], atol=1e-4)
+    np.testing.assert_allclose(out[0, 0, :, 1], [4.0, 4.0], atol=1e-4)
+
+
+def test_prroi_pool_grad():
+    feat = RNG.randn(1, 1, 5, 5).astype(np.float32)
+    rois = np.array([[0.5, 0.5, 3.5, 3.5]], np.float32)
+    check_grad(lambda f_: F.prroi_pool(f_, paddle.to_tensor(rois),
+                                       pooled_height=2, pooled_width=2),
+               [feat], atol=2e-2, rtol=2e-2)
+
+
+def test_im2sequence():
+    x = np.arange(1 * 1 * 4 * 4, dtype=np.float32).reshape(1, 1, 4, 4)
+    out = F.im2sequence(paddle.to_tensor(x), filter_size=2, stride=2).numpy()
+    assert out.shape == (4, 4)
+    np.testing.assert_allclose(out[0], [0, 1, 4, 5])
+    np.testing.assert_allclose(out[3], [10, 11, 14, 15])
+
+
+def test_add_position_encoding():
+    x = RNG.randn(2, 5, 8).astype(np.float32)
+    out = F.add_position_encoding(paddle.to_tensor(x), 1.0, 1.0).numpy()
+    half = 4
+    pos = np.arange(5)[:, None]
+    i = np.arange(half)[None, :]
+    freq = pos / np.power(10000.0, i / (half - 1))
+    pe = np.concatenate([np.sin(freq), np.cos(freq)], axis=1)
+    np.testing.assert_allclose(out, x + pe[None], atol=1e-5)
+
+
+def test_random_crop():
+    x = RNG.randn(2, 3, 10, 10).astype(np.float32)
+    out = F.random_crop(paddle.to_tensor(x), [6, 6], seed=3)
+    assert out.numpy().shape == (2, 3, 6, 6)
+    out2 = F.random_crop(paddle.to_tensor(x), [6, 6], seed=3)
+    np.testing.assert_allclose(out.numpy(), out2.numpy())
+    # per-instance independence: with a distinctive per-instance pattern,
+    # different (n, c) instances should (almost surely) use different offsets
+    ramp = np.arange(100, dtype=np.float32).reshape(1, 1, 10, 10)
+    big = np.broadcast_to(ramp, (4, 2, 10, 10)).copy()
+    c = F.random_crop(paddle.to_tensor(big), [4, 4], seed=11).numpy()
+    corners = c.reshape(-1, 4, 4)[:, 0, 0]
+    assert len(np.unique(corners)) > 1
+
+
+def test_random_crop_seeded_by_framework_rng():
+    x = RNG.randn(2, 8, 8).astype(np.float32)
+    paddle.seed(1234)
+    a = F.random_crop(paddle.to_tensor(x), [4, 4]).numpy()
+    paddle.seed(1234)
+    b = F.random_crop(paddle.to_tensor(x), [4, 4]).numpy()
+    np.testing.assert_allclose(a, b)
+
+
+def test_similarity_focus_mask_properties():
+    # kernel: a cell is marked only when both its row and col are fresh;
+    # exactly min(H, W) cells marked, one per row/col pair
+    x = RNG.rand(1, 3, 4, 5).astype(np.float32)
+    out = F.similarity_focus(paddle.to_tensor(x), axis=1, indexes=[0]).numpy()
+    assert set(np.unique(out)).issubset({0.0, 1.0})
+    m = out[0, 0]
+    assert m.sum() == min(4, 5)
+    assert (m.sum(axis=1) <= 1).all()       # at most one mark per row
+    assert (m.sum(axis=0) <= 1).all()       # at most one mark per col
+    # the global max is always marked
+    r, c = np.unravel_index(np.argmax(x[0, 0]), x[0, 0].shape)
+    assert m[r, c] == 1.0
+
+
+def test_resize_nearest_align_corners():
+    x = RNG.randn(1, 1, 4, 4).astype(np.float32)
+    out = F.resize_nearest(paddle.to_tensor(x), out_shape=[7, 7],
+                           align_corners=True).numpy()
+    # interpolate_op.h align_corners nearest: in_k = round(k*(in-1)/(out-1))
+    idx = np.floor(np.arange(7) * (3.0 / 6.0) + 0.5).astype(int)
+    ref = x[:, :, idx][:, :, :, idx]
+    np.testing.assert_allclose(out, ref)
+
+
+def test_add_position_encoding_half1():
+    x = RNG.randn(1, 3, 2).astype(np.float32)
+    out = F.add_position_encoding(paddle.to_tensor(x), 1.0, 1.0).numpy()
+    pos = np.arange(3)[:, None] / 10000.0
+    pe = np.concatenate([np.sin(pos), np.cos(pos)], axis=1)
+    np.testing.assert_allclose(out, x + pe[None], atol=1e-5)
+
+
+def test_roi_batch_index_validates():
+    feat = paddle.to_tensor(RNG.randn(2, 1, 4, 4).astype(np.float32))
+    rois = paddle.to_tensor(np.array([[0, 0, 3, 3]] * 3, np.float32))
+    with pytest.raises(ValueError):
+        F.roi_align(feat, rois, 2, 2, rois_num=[1, 1])
+
+
+def test_im2sequence_unsupported_args_raise():
+    x = paddle.to_tensor(RNG.randn(1, 1, 4, 4).astype(np.float32))
+    with pytest.raises(NotImplementedError):
+        F.im2sequence(x, 2, 2, input_image_size=paddle.to_tensor(
+            np.array([[4, 4]], np.float32)))
