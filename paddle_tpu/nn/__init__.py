@@ -12,6 +12,8 @@ from .layer.norm import *        # noqa: F401,F403
 from .layer.pooling import *     # noqa: F401,F403
 from .layer.rnn import *         # noqa: F401,F403
 from .layer.transformer import *  # noqa: F401,F403
+from .layer.extras import *      # noqa: F401,F403
+from .functional.extension import crf_decoding  # noqa: F401
 
 from ..framework import Parameter, ParamAttr  # noqa: F401
 

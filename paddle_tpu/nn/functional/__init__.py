@@ -7,6 +7,8 @@ from .loss import *          # noqa: F401,F403
 from .norm import *          # noqa: F401,F403
 from .pooling import *       # noqa: F401,F403
 from .vision import *        # noqa: F401,F403
+from .detection import *     # noqa: F401,F403
+from .extension import *     # noqa: F401,F403
 
 # re-export a few tensor ops that paddle exposes under nn.functional too
 from ...ops.manipulation import pad  # noqa: F401

@@ -1,0 +1,831 @@
+"""Detection op family: priors/anchors, box coding, matching, NMS, FPN
+routing, proposal generation.
+
+Reference surface: python/paddle/fluid/layers/detection.py — prior_box:1764,
+density_prior_box:1925, anchor_generator:2399, box_coder:818,
+iou_similarity:764, box_clip:3043, box_decoder_and_assign:3797,
+bipartite_match:1317, target_assign:1407, multiclass_nms:3262,
+matrix_nms:3546, locality_aware_nms:3416, detection_output:621,
+polygon_box_transform:969, yolo_box:1134, generate_proposals:2894,
+distribute_fpn_proposals:3673, collect_fpn_proposals:3871.
+
+TPU-native split: the dense, differentiable math (priors, coding, IoU,
+yolo decode) is jnp and jit-friendly; the select-and-compact stages whose
+output SHAPE depends on data (NMS families, proposal generation, FPN
+scatter) run host-side in numpy exactly like the reference's CPU kernels,
+at the data boundary where XLA's static-shape rule doesn't apply.
+Batching that the reference expresses with LoD rides `rois_num`
+lists (core/lod.py design).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor, apply
+
+__all__ = [
+    "iou_similarity", "box_coder", "prior_box", "density_prior_box",
+    "anchor_generator", "box_clip", "box_decoder_and_assign",
+    "bipartite_match", "target_assign", "multiclass_nms", "matrix_nms",
+    "locality_aware_nms", "detection_output", "polygon_box_transform",
+    "yolo_box", "generate_proposals", "distribute_fpn_proposals",
+    "collect_fpn_proposals",
+]
+
+
+def _np(x):
+    if isinstance(x, Tensor):
+        return np.asarray(x.numpy())
+    return np.asarray(x)
+
+
+# ---------------------------------------------------------------------------
+# dense differentiable ops (jnp)
+# ---------------------------------------------------------------------------
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    """Pairwise IoU of x [N, 4] vs y [M, 4] -> [N, M]
+    (detection.py:764; kernel iou_similarity_op.h). Non-normalized boxes
+    count the +1 pixel in widths/heights."""
+    off = 0.0 if box_normalized else 1.0
+
+    def f(a, b):
+        ax1, ay1, ax2, ay2 = [a[:, i, None] for i in range(4)]
+        bx1, by1, bx2, by2 = [b[None, :, i] for i in range(4)]
+        iw = jnp.maximum(jnp.minimum(ax2, bx2) - jnp.maximum(ax1, bx1) + off,
+                         0.0)
+        ih = jnp.maximum(jnp.minimum(ay2, by2) - jnp.maximum(ay1, by1) + off,
+                         0.0)
+        inter = iw * ih
+        area_a = (ax2 - ax1 + off) * (ay2 - ay1 + off)
+        area_b = (bx2 - bx1 + off) * (by2 - by1 + off)
+        union = area_a + area_b - inter
+        return jnp.where(union > 0, inter / union, 0.0)
+    return apply(f, x, y, op_name="iou_similarity")
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, name=None,
+              axis=0):
+    """Encode/decode boxes against priors (detection.py:818; kernel
+    box_coder_op.h — the +1 width convention applies when not
+    normalized)."""
+    if code_type not in ("encode_center_size", "decode_center_size"):
+        raise ValueError("box_coder code_type must be encode_center_size or "
+                         "decode_center_size")
+    off = 0.0 if box_normalized else 1.0
+    var_is_tensor = isinstance(prior_box_var, Tensor)
+    var_list = (None if var_is_tensor or prior_box_var is None
+                else np.asarray(prior_box_var, np.float32))
+
+    def prior_parts(p):
+        pw = p[..., 2] - p[..., 0] + off
+        ph = p[..., 3] - p[..., 1] + off
+        px = p[..., 0] + pw * 0.5
+        py = p[..., 1] + ph * 0.5
+        return px, py, pw, ph
+
+    if code_type == "encode_center_size":
+        def f_enc(p, t, *maybe_var):
+            px, py, pw, ph = prior_parts(p)          # [M]
+            tx = (t[:, 0] + t[:, 2]) * 0.5           # [N]
+            ty = (t[:, 1] + t[:, 3]) * 0.5
+            tw = t[:, 2] - t[:, 0] + off
+            th = t[:, 3] - t[:, 1] + off
+            ox = (tx[:, None] - px[None]) / pw[None]
+            oy = (ty[:, None] - py[None]) / ph[None]
+            ow = jnp.log(jnp.abs(tw[:, None] / pw[None]))
+            oh = jnp.log(jnp.abs(th[:, None] / ph[None]))
+            out = jnp.stack([ox, oy, ow, oh], axis=-1)   # [N, M, 4]
+            if maybe_var:
+                out = out / maybe_var[0][None]           # [M, 4] broadcast
+            elif var_list is not None:
+                out = out / jnp.asarray(var_list)
+            return out
+        args = (prior_box, target_box) + ((prior_box_var,) if var_is_tensor
+                                          else ())
+        return apply(f_enc, *args, op_name="box_coder")
+
+    def f_dec(p, t, *maybe_var):
+        px, py, pw, ph = prior_parts(p)              # [K] (K = M or N)
+        if axis == 0:
+            exp = lambda v: v[None, :]               # noqa: E731 — [1, M]
+        else:
+            exp = lambda v: v[:, None]               # noqa: E731 — [N, 1]
+        if maybe_var:
+            v = maybe_var[0]                         # [K, 4]
+            vx, vy, vw, vh = [exp(v[:, i]) for i in range(4)]
+        elif var_list is not None:
+            vx, vy, vw, vh = [jnp.asarray(var_list[i]) for i in range(4)]
+        else:
+            vx = vy = vw = vh = jnp.asarray(1.0)
+        cx = vx * t[..., 0] * exp(pw) + exp(px)
+        cy = vy * t[..., 1] * exp(ph) + exp(py)
+        w = jnp.exp(vw * t[..., 2]) * exp(pw)
+        h = jnp.exp(vh * t[..., 3]) * exp(ph)
+        return jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                          cx + w * 0.5 - off, cy + h * 0.5 - off], axis=-1)
+    args = (prior_box, target_box) + ((prior_box_var,) if var_is_tensor
+                                      else ())
+    return apply(f_dec, *args, op_name="box_coder")
+
+
+def _expand_aspect_ratios(aspect_ratios, flip):
+    out = [1.0]
+    for ar in aspect_ratios:
+        if any(abs(ar - o) < 1e-6 for o in out):
+            continue
+        out.append(float(ar))
+        if flip:
+            out.append(1.0 / float(ar))
+    return out
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, name=None,
+              min_max_aspect_ratios_order=False):
+    """SSD prior boxes per feature-map cell (detection.py:1764; kernel
+    prior_box_op.h). Returns (boxes [H, W, P, 4], variances same shape),
+    normalized corner coords."""
+    min_sizes = [float(m) for m in (min_sizes if isinstance(
+        min_sizes, (list, tuple)) else [min_sizes])]
+    max_sizes = [float(m) for m in (max_sizes or [])]
+    ars = _expand_aspect_ratios(
+        aspect_ratios if isinstance(aspect_ratios, (list, tuple))
+        else [aspect_ratios], flip)
+    fh, fw = int(input.shape[2]), int(input.shape[3])
+    ih, iw = int(image.shape[2]), int(image.shape[3])
+    step_w = float(steps[0]) or iw / fw
+    step_h = float(steps[1]) or ih / fh
+
+    boxes = []
+    for si, s in enumerate(min_sizes):
+        per_size = []
+        # ar == 1 box at min_size
+        base = [(s, s)]
+        sq = []
+        if max_sizes:
+            m = max_sizes[si]
+            sq.append((np.sqrt(s * m), np.sqrt(s * m)))
+        rest = [(s * np.sqrt(ar), s / np.sqrt(ar)) for ar in ars
+                if abs(ar - 1.0) >= 1e-6]
+        if min_max_aspect_ratios_order:
+            per_size = base + sq + rest
+        else:
+            per_size = base + rest + sq
+        boxes.extend(per_size)
+    wh = np.asarray(boxes, np.float64)              # [P, 2] full w/h
+    cx = (np.arange(fw) + offset) * step_w          # [W]
+    cy = (np.arange(fh) + offset) * step_h          # [H]
+    half_w = wh[:, 0] / 2.0
+    half_h = wh[:, 1] / 2.0
+    out = np.empty((fh, fw, len(boxes), 4), np.float32)
+    out[..., 0] = ((cx[None, :, None] - half_w[None, None]) / iw)
+    out[..., 1] = ((cy[:, None, None] - half_h[None, None]) / ih)
+    out[..., 2] = ((cx[None, :, None] + half_w[None, None]) / iw)
+    out[..., 3] = ((cy[:, None, None] + half_h[None, None]) / ih)
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          out.shape).copy()
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(var))
+
+
+def density_prior_box(input, image, densities=None, fixed_sizes=None,
+                      fixed_ratios=None, variance=(0.1, 0.1, 0.2, 0.2),
+                      clip=False, steps=(0.0, 0.0), offset=0.5,
+                      flatten_to_2d=False, name=None):
+    """Density prior boxes (detection.py:1925; kernel
+    density_prior_box_op.h): per fixed_size a density x density lattice of
+    shifted centers, always clipped into [0, 1]."""
+    densities = [int(d) for d in densities]
+    fixed_sizes = [float(s) for s in fixed_sizes]
+    fixed_ratios = [float(r) for r in fixed_ratios]
+    fh, fw = int(input.shape[2]), int(input.shape[3])
+    ih, iw = int(image.shape[2]), int(image.shape[3])
+    step_w = float(steps[0]) or iw / fw
+    step_h = float(steps[1]) or ih / fh
+    step_avg = int((step_w + step_h) * 0.5)
+
+    # per-prior center offsets and half extents (independent of the cell)
+    doffs, halfw, halfh = [], [], []
+    for s, density in zip(fixed_sizes, densities):
+        shift = step_avg // density
+        for r in fixed_ratios:
+            bw = s * np.sqrt(r)
+            bh = s / np.sqrt(r)
+            for di in range(density):
+                for dj in range(density):
+                    doffs.append((-step_avg / 2.0 + shift / 2.0 + dj * shift,
+                                  -step_avg / 2.0 + shift / 2.0 + di * shift))
+                    halfw.append(bw / 2.0)
+                    halfh.append(bh / 2.0)
+    doffs = np.asarray(doffs, np.float64)            # [P, 2] (dx, dy)
+    halfw = np.asarray(halfw, np.float64)
+    halfh = np.asarray(halfh, np.float64)
+    cx = (np.arange(fw) + offset) * step_w           # [W]
+    cy = (np.arange(fh) + offset) * step_h           # [H]
+    x = cx[None, :, None] + doffs[None, None, :, 0]  # [1, W, P]
+    y = cy[:, None, None] + doffs[None, None, :, 1]  # [H, 1, P]
+    out = np.empty((fh, fw, len(halfw), 4), np.float32)
+    out[..., 0] = np.maximum((x - halfw) / iw, 0.0)
+    out[..., 1] = np.maximum((y - halfh) / ih, 0.0)
+    out[..., 2] = np.minimum((x + halfw) / iw, 1.0)
+    out[..., 3] = np.minimum((y + halfh) / ih, 1.0)
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          out.shape).copy()
+    if flatten_to_2d:
+        out = out.reshape(-1, 4)
+        var = var.reshape(-1, 4)
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(var))
+
+
+def anchor_generator(input, anchor_sizes=None, aspect_ratios=None,
+                     variance=(0.1, 0.1, 0.2, 0.2), stride=None, offset=0.5,
+                     name=None):
+    """RPN anchors per cell (detection.py:2399; kernel
+    anchor_generator_op.h — note the rounded base sizes and the
+    (size-1)/2 half extents). Returns (anchors [H, W, A, 4], variances)."""
+    anchor_sizes = [float(a) for a in anchor_sizes]
+    aspect_ratios = [float(a) for a in aspect_ratios]
+    sw, sh = float(stride[0]), float(stride[1])
+    fh, fw = int(input.shape[2]), int(input.shape[3])
+
+    shapes = []
+    for ar in aspect_ratios:
+        for size in anchor_sizes:
+            area = sw * sh
+            base_w = round(np.sqrt(area / ar))
+            base_h = round(base_w * ar)
+            shapes.append((size / sw * base_w, size / sh * base_h))
+    wh = np.asarray(shapes, np.float64)
+    xc = np.arange(fw) * sw + offset * (sw - 1)
+    yc = np.arange(fh) * sh + offset * (sh - 1)
+    out = np.empty((fh, fw, len(shapes), 4), np.float32)
+    out[..., 0] = xc[None, :, None] - 0.5 * (wh[None, None, :, 0] - 1)
+    out[..., 1] = yc[:, None, None] - 0.5 * (wh[None, None, :, 1] - 1)
+    out[..., 2] = xc[None, :, None] + 0.5 * (wh[None, None, :, 0] - 1)
+    out[..., 3] = yc[:, None, None] + 0.5 * (wh[None, None, :, 1] - 1)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          out.shape).copy()
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(var))
+
+
+def box_clip(input, im_info, name=None):
+    """Clip boxes into the original image extent (detection.py:3043):
+    im_info rows are (height, width, scale); boxes clip to
+    [0, w/scale - 1] x [0, h/scale - 1]. input [N, 4] with one im_info
+    row, or [B, N, 4] with [B, 3]."""
+    def f(b, info):
+        if b.ndim == 2:
+            info_row = info if info.ndim == 1 else info[0]
+            w = info_row[1] / info_row[2] - 1.0
+            h = info_row[0] / info_row[2] - 1.0
+            return jnp.stack([jnp.clip(b[:, 0], 0, w),
+                              jnp.clip(b[:, 1], 0, h),
+                              jnp.clip(b[:, 2], 0, w),
+                              jnp.clip(b[:, 3], 0, h)], axis=-1)
+        w = (info[:, 1] / info[:, 2] - 1.0)[:, None]
+        h = (info[:, 0] / info[:, 2] - 1.0)[:, None]
+        zero = jnp.asarray(0.0)
+        return jnp.stack([jnp.clip(b[..., 0], zero, w),
+                          jnp.clip(b[..., 1], zero, h),
+                          jnp.clip(b[..., 2], zero, w),
+                          jnp.clip(b[..., 3], zero, h)], axis=-1)
+    return apply(f, input, im_info, op_name="box_clip")
+
+
+def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
+                           box_clip, name=None):
+    """Per-class decode + argmax-class assignment (detection.py:3797;
+    kernel box_decoder_and_assign_op.h — +1 widths, dw/dh clipped at
+    box_clip, background class 0 excluded from the argmax)."""
+    clipv = float(box_clip)
+
+    def f(p, v, t, s):
+        n = p.shape[0]
+        c = s.shape[1]
+        pw = p[:, 2] - p[:, 0] + 1.0
+        ph = p[:, 3] - p[:, 1] + 1.0
+        px = p[:, 0] + pw * 0.5
+        py = p[:, 1] + ph * 0.5
+        td = t.reshape(n, c, 4)
+        dw = jnp.minimum(v[2] * td[..., 2], clipv)
+        dh = jnp.minimum(v[3] * td[..., 3], clipv)
+        cx = v[0] * td[..., 0] * pw[:, None] + px[:, None]
+        cy = v[1] * td[..., 1] * ph[:, None] + py[:, None]
+        w = jnp.exp(dw) * pw[:, None]
+        h = jnp.exp(dh) * ph[:, None]
+        dec = jnp.stack([cx - w / 2, cy - h / 2,
+                         cx + w / 2 - 1, cy + h / 2 - 1], axis=-1)
+        if c == 1:
+            # kernel: no foreground class (j > 0) to argmax -> keep prior
+            return dec.reshape(n, c * 4), p
+        # argmax over non-background classes (j > 0)
+        best = jnp.argmax(s[:, 1:], axis=1) + 1
+        assigned = jnp.take_along_axis(
+            dec, best[:, None, None].repeat(4, axis=2), axis=1)[:, 0]
+        return dec.reshape(n, c * 4), assigned
+    return apply(f, prior_box, prior_box_var, target_box, box_score,
+                 op_name="box_decoder_and_assign", n_outputs=2)
+
+
+def polygon_box_transform(input, name=None):
+    """EAST geometry map transform (detection.py:969; kernel: even
+    channels become 4*w - v, odd channels 4*h - v)."""
+    def f(a):
+        n, c, h, w = a.shape
+        ws = jnp.arange(w, dtype=a.dtype)[None, None, None, :]
+        hs = jnp.arange(h, dtype=a.dtype)[None, None, :, None]
+        even = (jnp.arange(c) % 2 == 0)[None, :, None, None]
+        return jnp.where(even, ws * 4 - a, hs * 4 - a)
+    return apply(f, input, op_name="polygon_box_transform")
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, name=None, scale_x_y=1.0):
+    """Decode YOLOv3 head output (detection.py:1134; kernel
+    yolo_box_op.h). x [N, A*(5+C), H, W], img_size [N, 2] (h, w int).
+    Returns (boxes [N, A*H*W, 4], scores [N, A*H*W, C]); entries whose
+    objectness is below conf_thresh are zeroed exactly like the kernel's
+    skipped writes."""
+    anchors = [int(a) for a in anchors]
+    an = len(anchors) // 2
+    cnum = int(class_num)
+    scale = float(scale_x_y)
+    bias = -0.5 * (scale - 1.0)
+
+    def f(xx, imgs):
+        n, _, h, w = xx.shape
+        in_h = int(downsample_ratio) * h
+        in_w = int(downsample_ratio) * w
+        v = xx.reshape(n, an, 5 + cnum, h, w)
+        aw = jnp.asarray(anchors[0::2], xx.dtype)[None, :, None, None]
+        ah = jnp.asarray(anchors[1::2], xx.dtype)[None, :, None, None]
+        img_h = imgs[:, 0].astype(xx.dtype)[:, None, None, None]
+        img_w = imgs[:, 1].astype(xx.dtype)[:, None, None, None]
+        gx = jnp.arange(w, dtype=xx.dtype)[None, None, None, :]
+        gy = jnp.arange(h, dtype=xx.dtype)[None, None, :, None]
+        sig = jax.nn.sigmoid
+        bx = (gx + sig(v[:, :, 0]) * scale + bias) * img_w / w
+        by = (gy + sig(v[:, :, 1]) * scale + bias) * img_h / h
+        bw = jnp.exp(v[:, :, 2]) * aw * img_w / in_w
+        bh = jnp.exp(v[:, :, 3]) * ah * img_h / in_h
+        conf = sig(v[:, :, 4])
+        keep = conf >= conf_thresh
+        x1, y1 = bx - bw / 2, by - bh / 2
+        x2, y2 = bx + bw / 2, by + bh / 2
+        if clip_bbox:
+            x1 = jnp.maximum(x1, 0.0)
+            y1 = jnp.maximum(y1, 0.0)
+            x2 = jnp.minimum(x2, img_w - 1)
+            y2 = jnp.minimum(y2, img_h - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1)      # [N, A, H, W, 4]
+        boxes = jnp.where(keep[..., None], boxes, 0.0)
+        cls = sig(v[:, :, 5:])                            # [N, A, C, H, W]
+        scores = conf[:, :, None] * cls
+        scores = jnp.where(keep[:, :, None], scores, 0.0)
+        boxes = boxes.reshape(n, an * h * w, 4)
+        scores = scores.transpose(0, 1, 3, 4, 2).reshape(n, an * h * w, cnum)
+        return boxes, scores
+    return apply(f, x, img_size, op_name="yolo_box", n_outputs=2)
+
+
+# ---------------------------------------------------------------------------
+# matching / assignment (host-side like the reference CPU kernels)
+# ---------------------------------------------------------------------------
+
+def _bipartite_match_one(dist, match_indices, match_dist):
+    """Greedy global-max matching (bipartite_match_op.cc:BipartiteMatch)."""
+    row, col = dist.shape
+    flat = [(i, j, dist[i, j]) for i in range(row) for j in range(col)]
+    flat.sort(key=lambda t: -t[2])
+    row_used = np.full(row, -1)
+    matched = 0
+    for i, j, d in flat:
+        if matched >= row:
+            break
+        if match_indices[j] == -1 and row_used[i] == -1 and d > 0:
+            match_indices[j] = i
+            row_used[i] = j
+            match_dist[j] = d
+            matched += 1
+
+
+def _argmax_match_one(dist, match_indices, match_dist, threshold):
+    row, col = dist.shape
+    eps = 1e-6
+    for j in range(col):
+        if match_indices[j] != -1:
+            continue
+        col_d = dist[:, j]
+        ok = (col_d >= max(threshold, eps))
+        if ok.any():
+            i = int(np.argmax(np.where(ok, col_d, -1.0)))
+            match_indices[j] = i
+            match_dist[j] = col_d[i]
+
+
+def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
+                    name=None):
+    """Greedy bipartite (+ optional per_prediction argmax) matching
+    (detection.py:1317; kernel bipartite_match_op.cc). dist_matrix is
+    [row, col] for one instance or [B, row, col] batched; returns
+    (match_indices int32 [B, col], match_distance [B, col])."""
+    d = _np(dist_matrix).astype(np.float64)
+    if d.ndim == 2:
+        d = d[None]
+    b, row, col = d.shape
+    indices = np.full((b, col), -1, np.int32)
+    dists = np.zeros((b, col), np.float32)
+    for i in range(b):
+        _bipartite_match_one(d[i], indices[i], dists[i])
+        if match_type == "per_prediction":
+            _argmax_match_one(d[i], indices[i], dists[i],
+                              float(dist_threshold or 0.5))
+    return Tensor(jnp.asarray(indices)), Tensor(jnp.asarray(dists))
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=0, name=None):
+    """Gather per-prediction targets by match index (detection.py:1407;
+    kernel target_assign_op.h). input [B, P, K], matched_indices
+    [B, M] -> (out [B, M, K] with mismatch_value at -1 slots,
+    out_weight [B, M, 1] 1/0; negative_indices rows get weight 1)."""
+    inp = _np(input)
+    mi = _np(matched_indices).astype(np.int64)
+    b, m = mi.shape
+    k = inp.shape[-1]
+    out = np.full((b, m, k), float(mismatch_value), inp.dtype)
+    wt = np.zeros((b, m, 1), np.float32)
+    for i in range(b):
+        pos = mi[i] >= 0
+        out[i, pos] = inp[i, mi[i][pos]]
+        wt[i, pos] = 1.0
+    if negative_indices is not None:
+        neg = negative_indices
+        neg = neg if isinstance(neg, (list, tuple)) else [_np(neg).ravel()]
+        for i, rows in enumerate(neg[:b]):
+            for j in np.asarray(rows, np.int64).ravel():
+                wt[i, int(j)] = 1.0
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(wt))
+
+
+# ---------------------------------------------------------------------------
+# NMS family (host-side)
+# ---------------------------------------------------------------------------
+
+def _jaccard(a, b, normalized):
+    off = 0.0 if normalized else 1.0
+    ix1 = max(a[0], b[0])
+    iy1 = max(a[1], b[1])
+    ix2 = min(a[2], b[2])
+    iy2 = min(a[3], b[3])
+    iw = max(ix2 - ix1 + off, 0.0)
+    ih = max(iy2 - iy1 + off, 0.0)
+    inter = iw * ih
+    ua = ((a[2] - a[0] + off) * (a[3] - a[1] + off) +
+          (b[2] - b[0] + off) * (b[3] - b[1] + off) - inter)
+    return inter / ua if ua > 0 else 0.0
+
+
+def _nms_fast(boxes, scores, score_threshold, nms_threshold, eta, top_k,
+              normalized):
+    """multiclass_nms_op.cc:NMSFast — adaptive-threshold greedy NMS."""
+    cand = [i for i in range(len(scores)) if scores[i] > score_threshold]
+    cand.sort(key=lambda i: (-scores[i], i))
+    if top_k > -1:
+        cand = cand[:top_k]
+    selected = []
+    adaptive = nms_threshold
+    for idx in cand:
+        keep = all(_jaccard(boxes[idx], boxes[k], normalized) <= adaptive
+                   for k in selected)
+        if keep:
+            selected.append(idx)
+            if eta < 1 and adaptive > 0.5:
+                adaptive *= eta
+    return selected
+
+
+def _multiclass_nms_one(boxes, scores, background_label, score_threshold,
+                        nms_top_k, nms_threshold, nms_eta, keep_top_k,
+                        normalized):
+    """One image: scores [C, M], boxes [M, 4] -> {label: [indices]}."""
+    c = scores.shape[0]
+    indices = {}
+    num_det = 0
+    for cls in range(c):
+        if cls == background_label:
+            continue
+        sel = _nms_fast(boxes, scores[cls], score_threshold, nms_threshold,
+                        nms_eta, nms_top_k, normalized)
+        if sel:
+            indices[cls] = sel
+            num_det += len(sel)
+    if keep_top_k > -1 and num_det > keep_top_k:
+        pairs = [(scores[cls][i], cls, i)
+                 for cls, sel in indices.items() for i in sel]
+        pairs.sort(key=lambda t: (-t[0], t[1], t[2]))
+        pairs = pairs[:keep_top_k]
+        indices = {}
+        for _, cls, i in pairs:
+            indices.setdefault(cls, []).append(i)
+    return indices
+
+
+def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                   nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                   background_label=0, name=None, return_index=False,
+                   return_rois_num=False):
+    """Per-class NMS then cross-class keep_top_k (detection.py:3262;
+    kernel multiclass_nms_op.cc). bboxes [N, M, 4], scores [N, C, M].
+    Output rows are [label, score, x1, y1, x2, y2], grouped by image then
+    ascending label; an empty batch yields the reference's [[-1]]
+    sentinel. Optional extras: flat input indices, per-image counts."""
+    bx = _np(bboxes).astype(np.float64)
+    sc = _np(scores).astype(np.float64)
+    n, c, m = sc.shape
+    rows, idxs, counts = [], [], []
+    for i in range(n):
+        sel = _multiclass_nms_one(bx[i], sc[i], background_label,
+                                  score_threshold, nms_top_k, nms_threshold,
+                                  nms_eta, keep_top_k, normalized)
+        cnt = 0
+        for cls in sorted(sel):
+            for j in sel[cls]:
+                rows.append([cls, sc[i, cls, j]] + list(bx[i, j]))
+                idxs.append(i * m + j)
+                cnt += 1
+        counts.append(cnt)
+    if not rows:
+        out = Tensor(jnp.asarray(np.array([[-1.0]], np.float32)))
+        extras = []
+        if return_index:
+            extras.append(Tensor(jnp.zeros((0, 1), jnp.int32)))
+        if return_rois_num:
+            extras.append(Tensor(jnp.asarray(np.array(counts, np.int32))))
+        return tuple([out] + extras) if extras else out
+    out = Tensor(jnp.asarray(np.asarray(rows, np.float32)))
+    extras = []
+    if return_index:
+        extras.append(Tensor(jnp.asarray(
+            np.asarray(idxs, np.int32)[:, None])))
+    if return_rois_num:
+        extras.append(Tensor(jnp.asarray(np.array(counts, np.int32))))
+    return tuple([out] + extras) if extras else out
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
+               keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """Soft suppression via decay factors (detection.py:3546; kernel
+    matrix_nms_op.cc — linear decay (1-iou)/(1-max_iou) or gaussian
+    exp((max^2-iou^2)*sigma))."""
+    bx = _np(bboxes).astype(np.float64)
+    sc = _np(scores).astype(np.float64)
+    n, c, m = sc.shape
+    all_rows, all_idx, counts = [], [], []
+    for i in range(n):
+        img_rows = []
+        for cls in range(c):
+            if cls == background_label:
+                continue
+            s = sc[i, cls]
+            perm = [j for j in range(m) if s[j] > score_threshold]
+            perm.sort(key=lambda j: (-s[j], j))
+            if nms_top_k > -1:
+                perm = perm[:nms_top_k]
+            if not perm:
+                continue
+            iou_max = [0.0]
+            ious = {}
+            for a in range(1, len(perm)):
+                mx = 0.0
+                for b in range(a):
+                    v = _jaccard(bx[i, perm[a]], bx[i, perm[b]], normalized)
+                    ious[(a, b)] = v
+                    mx = max(mx, v)
+                iou_max.append(mx)
+            if s[perm[0]] > post_threshold:
+                img_rows.append((s[perm[0]], cls, perm[0]))
+            for a in range(1, len(perm)):
+                decay = 1.0
+                for b in range(a):
+                    iou = ious[(a, b)]
+                    mx = iou_max[b]
+                    if use_gaussian:
+                        d = np.exp((mx * mx - iou * iou) * gaussian_sigma)
+                    else:
+                        d = (1.0 - iou) / (1.0 - mx) if mx < 1.0 else 0.0
+                    decay = min(decay, d)
+                ds = decay * s[perm[a]]
+                if ds > post_threshold:
+                    img_rows.append((ds, cls, perm[a]))
+        img_rows.sort(key=lambda t: (-t[0], t[1], t[2]))
+        if keep_top_k > -1:
+            img_rows = img_rows[:keep_top_k]
+        counts.append(len(img_rows))
+        for score, cls, j in img_rows:
+            all_rows.append([cls, score] + list(bx[i, j]))
+            all_idx.append(i * m + j)
+    if not all_rows:
+        out = Tensor(jnp.zeros((0, 6), jnp.float32))
+    else:
+        out = Tensor(jnp.asarray(np.asarray(all_rows, np.float32)))
+    res = [out]
+    if return_index:
+        res.append(Tensor(jnp.asarray(np.asarray(all_idx, np.int32)[:, None])))
+    if return_rois_num:
+        res.append(Tensor(jnp.asarray(np.asarray(counts, np.int32))))
+    return tuple(res) if len(res) > 1 else out
+
+
+def locality_aware_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                       nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                       background_label=-1, name=None):
+    """LANMS (detection.py:3416): weighted-merge consecutive high-IoU
+    boxes first, then standard multiclass NMS. Single image: bboxes
+    [1, M, 4], scores [1, C, M]."""
+    bx = _np(bboxes).astype(np.float64).copy()
+    sc = _np(scores).astype(np.float64).copy()
+    n, c, m = sc.shape
+    if n != 1:
+        raise ValueError("locality_aware_nms supports batch 1 (reference "
+                         "kernel operates on a single image)")
+    for cls in range(c):
+        if cls == background_label:
+            continue
+        # merge pass: walk boxes in index order, weighted-average adjacent
+        # boxes whose IoU exceeds the threshold (locality_aware_nms_op.cc)
+        order = [j for j in range(m) if sc[0, cls, j] > score_threshold]
+        merged_boxes = bx[0].copy()
+        merged_scores = sc[0, cls].copy()
+        prev = None
+        for j in order:
+            if prev is not None and _jaccard(merged_boxes[prev],
+                                             merged_boxes[j],
+                                             normalized) > nms_threshold:
+                w1 = merged_scores[prev]
+                w2 = merged_scores[j]
+                tot = w1 + w2
+                merged_boxes[j] = (merged_boxes[prev] * w1 +
+                                   merged_boxes[j] * w2) / tot
+                merged_scores[j] = tot
+                merged_scores[prev] = 0.0
+            prev = j
+        bx[0] = merged_boxes
+        sc[0, cls] = merged_scores
+    return multiclass_nms(Tensor(jnp.asarray(bx.astype(np.float32))),
+                          Tensor(jnp.asarray(sc.astype(np.float32))),
+                          score_threshold, nms_top_k, keep_top_k,
+                          nms_threshold, normalized, nms_eta,
+                          background_label)
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0,
+                     return_index=False):
+    """SSD head post-processing (detection.py:621): decode loc against
+    priors, then multiclass NMS. loc [N, M, 4], scores [N, M, C]."""
+    decoded = box_coder(prior_box, prior_box_var, loc,
+                        code_type="decode_center_size")
+    sc = _np(scores)
+    # reference applies softmax over classes before the NMS (detection.py:721)
+    e = np.exp(sc - sc.max(axis=-1, keepdims=True))
+    sc = e / e.sum(axis=-1, keepdims=True)
+    sc_t = Tensor(jnp.asarray(np.transpose(sc, (0, 2, 1))))
+    return multiclass_nms(decoded, sc_t, score_threshold, nms_top_k,
+                          keep_top_k, nms_threshold, True, nms_eta,
+                          background_label, return_index=return_index)
+
+
+# ---------------------------------------------------------------------------
+# proposal generation + FPN routing (host-side)
+# ---------------------------------------------------------------------------
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       return_rois_num=False, name=None):
+    """RPN proposal generation (detection.py:2894; kernel
+    generate_proposals_op.cc): decode deltas against anchors (+1 widths,
+    dw/dh clipped at log(1000/16)), clip to image, drop boxes smaller
+    than min_size * scale, per-image top-k, NMS. scores [N, A, H, W],
+    bbox_deltas [N, 4A, H, W], anchors/variances [H, W, A, 4]."""
+    sc = _np(scores).astype(np.float64)
+    bd = _np(bbox_deltas).astype(np.float64)
+    info = _np(im_info).astype(np.float64)
+    anc = _np(anchors).astype(np.float64).reshape(-1, 4)
+    var = _np(variances).astype(np.float64).reshape(-1, 4)
+    n, a, h, w = sc.shape
+    clip_v = np.log(1000.0 / 16.0)
+
+    aw = anc[:, 2] - anc[:, 0] + 1.0
+    ah = anc[:, 3] - anc[:, 1] + 1.0
+    ax = anc[:, 0] + aw * 0.5
+    ay = anc[:, 1] + ah * 0.5
+
+    all_rois, counts = [], []
+    for i in range(n):
+        # [A, H, W] -> [H, W, A] flat, matching anchors' layout
+        s = sc[i].transpose(1, 2, 0).ravel()
+        d = bd[i].reshape(a, 4, h, w).transpose(2, 3, 0, 1).reshape(-1, 4)
+        # kernel order: top-k on scores FIRST, then decode/clip/filter
+        order = np.argsort(-s, kind="stable")[:pre_nms_top_n]
+        do = d[order]
+        cx = var[order, 0] * do[:, 0] * aw[order] + ax[order]
+        cy = var[order, 1] * do[:, 1] * ah[order] + ay[order]
+        bw = np.exp(np.minimum(var[order, 2] * do[:, 2], clip_v)) * aw[order]
+        bh = np.exp(np.minimum(var[order, 3] * do[:, 3], clip_v)) * ah[order]
+        props = np.stack([cx - bw * 0.5, cy - bh * 0.5,
+                          cx + bw * 0.5 - 1, cy + bh * 0.5 - 1], axis=1)
+        im_h, im_w, scale = info[i, 0], info[i, 1], info[i, 2]
+        props[:, 0] = np.clip(props[:, 0], 0, im_w - 1)
+        props[:, 1] = np.clip(props[:, 1], 0, im_h - 1)
+        props[:, 2] = np.clip(props[:, 2], 0, im_w - 1)
+        props[:, 3] = np.clip(props[:, 3], 0, im_h - 1)
+        ms = max(min_size, 1.0) * scale
+        ws = props[:, 2] - props[:, 0] + 1
+        hs = props[:, 3] - props[:, 1] + 1
+        keep = np.where((ws >= ms) & (hs >= ms))[0]
+        props = props[keep]
+        sk = s[order][keep]
+        sel = _nms_fast(props, sk, -np.inf, nms_thresh, eta, -1, False)
+        sel = sel[:post_nms_top_n]
+        rois = props[sel]
+        all_rois.append(rois)
+        counts.append(len(rois))
+    out = Tensor(jnp.asarray(
+        np.concatenate(all_rois, 0).astype(np.float32)
+        if all_rois else np.zeros((0, 4), np.float32)))
+    if return_rois_num:
+        return out, Tensor(jnp.asarray(np.asarray(counts, np.int32)))
+    return out
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, rois_num=None, name=None):
+    """Route rois to FPN levels by sqrt-area (detection.py:3673; kernel
+    distribute_fpn_proposals_op.h: lvl = floor(log2(sqrt(area)/refer_scale
+    + 1e-6)) + refer_level, clamped). Returns (per-level roi tensors,
+    restore_index [R, 1] mapping concat order back to input order[,
+    per-level rois_num])."""
+    rois = _np(fpn_rois).astype(np.float64)
+    num_level = max_level - min_level + 1
+    ws = rois[:, 2] - rois[:, 0] + 1.0
+    hs = rois[:, 3] - rois[:, 1] + 1.0
+    scale = np.sqrt(ws * hs)
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-6) + refer_level)
+    lvl = np.clip(lvl, min_level, max_level).astype(int)
+    outs, order = [], []
+    level_counts = []
+    for L in range(min_level, max_level + 1):
+        idx = np.where(lvl == L)[0]
+        outs.append(Tensor(jnp.asarray(rois[idx].astype(np.float32))))
+        order.extend(idx.tolist())
+        level_counts.append(len(idx))
+    restore = np.empty(len(rois), np.int32)
+    restore[np.asarray(order, int)] = np.arange(len(rois), dtype=np.int32)
+    restore_t = Tensor(jnp.asarray(restore[:, None]))
+    if rois_num is not None:
+        rn = _np(rois_num).astype(np.int64)
+        starts = np.concatenate([[0], np.cumsum(rn)])
+        per_level_nums = []
+        for L in range(min_level, max_level + 1):
+            cnt = [int(((lvl[starts[i]:starts[i + 1]]) == L).sum())
+                   for i in range(len(rn))]
+            per_level_nums.append(Tensor(jnp.asarray(
+                np.asarray(cnt, np.int32))))
+        return outs, restore_t, per_level_nums
+    return outs, restore_t
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, rois_num_per_level=None, name=None):
+    """Merge per-level rois back, keep global top-k by score
+    (detection.py:3871; kernel collect_fpn_proposals_op.h)."""
+    rois = np.concatenate([_np(r) for r in multi_rois], 0)
+    scores = np.concatenate([_np(s).ravel() for s in multi_scores], 0)
+    order = np.argsort(-scores, kind="stable")[:post_nms_top_n]
+    if rois_num_per_level is not None:
+        # kernel: after top-k, stable-sort the selection by image id so
+        # output rows group by image (CompareByBatchid)
+        per_level = [_np(r).astype(np.int64) for r in rois_num_per_level]
+        n_img = len(per_level[0])
+        img_of = np.concatenate([
+            np.repeat(np.arange(n_img), lv) for lv in per_level])
+        order = order[np.argsort(img_of[order], kind="stable")]
+        sel_img = img_of[order]
+        counts = np.asarray([(sel_img == i).sum() for i in range(n_img)],
+                            np.int32)
+        out = Tensor(jnp.asarray(rois[order].astype(np.float32)))
+        return out, Tensor(jnp.asarray(counts))
+    return Tensor(jnp.asarray(rois[order].astype(np.float32)))

@@ -1,0 +1,618 @@
+"""Legacy functional extensions: CRF, sampled-softmax losses, metric
+losses, spectral/data norms, legacy fc/bilinear products, deformable conv.
+
+Reference surface: fluid/layers/nn.py — linear_chain_crf:726,
+crf_decoding:853, fc:211, data_norm:3214, spectral_norm:3626,
+bilinear_tensor_product:13144, deformable_conv:14221; fluid/layers/
+loss.py — center_loss:54, bpr_loss:153, teacher_student_sigmoid_loss:1465,
+npair_loss:1653; nn/functional/loss.py — hsigmoid_loss:331;
+nn/functional/extension.py — diag_embed:28; nce (fluid/layers/nn.py),
+dice_loss (nn.py:7055), smooth_l1 (nn.py:5791).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor, apply
+from .loss import ctc_loss
+
+__all__ = [
+    "linear_chain_crf", "crf_decoding", "hsigmoid_loss", "nce",
+    "bpr_loss", "center_loss", "npair_loss", "dice_loss", "smooth_l1",
+    "teacher_student_sigmoid_loss", "warpctc", "fc",
+    "bilinear_tensor_product", "data_norm", "spectral_norm", "diag_embed",
+    "soft_relu", "deformable_conv",
+]
+
+
+# ---------------------------------------------------------------------------
+# CRF
+# ---------------------------------------------------------------------------
+
+def linear_chain_crf(input, label, transition, length=None, name=None):
+    """Negative log-likelihood of a linear-chain CRF
+    (fluid/layers/nn.py:726; kernel linear_chain_crf_op.h).
+
+    input: emissions [B, T, D] (padded) or [T, D] single sequence.
+    label: [B, T] / [T] int tags. transition: [D + 2, D] — row 0 start
+    weights, row 1 stop weights, rows 2+ tag-to-tag transitions (the
+    reference's parameter layout). length: [B] valid lengths.
+    Returns nll [B, 1]. Differentiable in input and transition; the alpha
+    recursion is a lax.scan in log space, so it jits on TPU.
+    """
+    single = len(input.shape) == 2
+
+    def f(emit, lbl, trans, lens):
+        if emit.ndim == 2:
+            emit_b = emit[None]
+            lbl_b = lbl[None]
+        else:
+            emit_b = emit
+            lbl_b = lbl.reshape(emit.shape[0], emit.shape[1])
+        b, t, d = emit_b.shape
+        start_w = trans[0]
+        stop_w = trans[1]
+        trans_w = trans[2:]
+        ln = (jnp.full((b,), t, jnp.int32) if lens is None
+              else lens.reshape(-1).astype(jnp.int32))
+
+        # log Z by forward recursion
+        alpha0 = start_w[None, :] + emit_b[:, 0]              # [B, D]
+
+        def step(carry, k):
+            alpha = carry
+            nxt = jax.scipy.special.logsumexp(
+                alpha[:, :, None] + trans_w[None], axis=1) + emit_b[:, k]
+            alpha = jnp.where((k < ln)[:, None], nxt, alpha)
+            return alpha, None
+        alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, t))
+        log_z = jax.scipy.special.logsumexp(alpha + stop_w[None], axis=1)
+
+        # gold path score
+        first = jnp.take_along_axis(emit_b[:, 0], lbl_b[:, :1], axis=1)[:, 0]
+        score = start_w[lbl_b[:, 0]] + first
+
+        def body(carry, k):
+            sc = carry
+            prev = lbl_b[:, k - 1]
+            cur = lbl_b[:, k]
+            e = jnp.take_along_axis(emit_b[:, k], cur[:, None], axis=1)[:, 0]
+            add = trans_w[prev, cur] + e
+            sc = jnp.where(k < ln, sc + add, sc)
+            return sc, None
+        score, _ = jax.lax.scan(body, score, jnp.arange(1, t))
+        last = jnp.take_along_axis(lbl_b, (ln - 1)[:, None], axis=1)[:, 0]
+        score = score + stop_w[last]
+        return (log_z - score)[:, None]
+    args = [input, label, transition] + ([length] if length is not None
+                                         else [])
+    if length is None:
+        return apply(lambda e, l, tr: f(e, l, tr, None), *args,
+                     op_name="linear_chain_crf")
+    return apply(f, *args, op_name="linear_chain_crf")
+
+
+def crf_decoding(input, transition, label=None, length=None, name=None):
+    """Viterbi decode with start/stop transitions
+    (fluid/layers/nn.py:853; kernel crf_decoding_op.h). input [B, T, D],
+    transition [D+2, D]. Without label: returns the best path [B, T]
+    (zeros past each length). With label: 1 where the decoded tag equals
+    the label, 0 elsewhere/padding — the reference's correctness mask."""
+    emit = np.asarray(input.numpy() if isinstance(input, Tensor) else input,
+                      np.float64)
+    trans = np.asarray(transition.numpy()
+                       if isinstance(transition, Tensor) else transition,
+                       np.float64)
+    if emit.ndim == 2:
+        emit = emit[None]
+    b, t, d = emit.shape
+    start_w, stop_w, tw = trans[0], trans[1], trans[2:]
+    lens = (np.full(b, t, np.int64) if length is None
+            else np.asarray(length.numpy() if isinstance(length, Tensor)
+                            else length).reshape(-1).astype(np.int64))
+    paths = np.zeros((b, t), np.int64)
+    for i in range(b):
+        n = int(lens[i])
+        if n == 0:
+            continue
+        alpha = start_w + emit[i, 0]
+        track = np.zeros((n, d), np.int64)
+        for k in range(1, n):
+            cand = alpha[:, None] + tw
+            track[k] = np.argmax(cand, axis=0)
+            alpha = cand[track[k], np.arange(d)] + emit[i, k]
+        best = int(np.argmax(alpha + stop_w))
+        paths[i, n - 1] = best
+        for k in range(n - 1, 0, -1):
+            best = int(track[k][best])
+            paths[i, k - 1] = best
+    if label is not None:
+        lbl = np.asarray(label.numpy() if isinstance(label, Tensor)
+                         else label).reshape(b, -1)[:, :t]
+        mask = np.arange(t)[None, :] < lens[:, None]
+        out = ((lbl == paths) & mask).astype(np.int64)
+        return Tensor(jnp.asarray(out))
+    return Tensor(jnp.asarray(paths))
+
+
+# ---------------------------------------------------------------------------
+# sampled-softmax family
+# ---------------------------------------------------------------------------
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss (nn/functional/loss.py:331; kernel
+    hierarchical_sigmoid_op.h + matrix_bit_code.h). Default tree: the
+    complete binary tree code of (label + num_classes); custom tree via
+    path_table/path_code (negative entries are padding). Returns [N, 1]."""
+    if is_sparse:
+        raise NotImplementedError(
+            "hsigmoid_loss is_sparse targets the PS sparse table; use the "
+            "dense path (SelectedRows live host-side in this framework)")
+
+    if path_table is None:
+        n_cls = int(num_classes)
+        max_len = int(np.floor(np.log2(max(n_cls * 2 - 1, 2))))
+
+        def f(x, lbl, w, *maybe_b):
+            lbl = lbl.reshape(-1).astype(jnp.int32)
+            c = lbl + n_cls
+            j = jnp.arange(max_len)
+            # SimpleCode: calc_index(j) = (c >> (j+1)) - 1,
+            # calc_bit(j) = c & (1 << j); path length = bit_length(c) - 1
+            idx = (c[:, None] >> (j[None] + 1)) - 1          # [N, L]
+            bit = ((c[:, None] >> j[None]) & 1).astype(x.dtype)
+            blen = jnp.floor(
+                jnp.log2(c.astype(jnp.float32))).astype(jnp.int32)
+            valid = j[None] < blen[:, None]
+            idx_safe = jnp.clip(idx, 0, w.shape[0] - 1)
+            pre = jnp.einsum("nd,nld->nl", x, w[idx_safe])
+            if maybe_b:
+                pre = pre + maybe_b[0].reshape(-1)[idx_safe]
+            loss = jax.nn.softplus(pre) - bit * pre
+            return jnp.sum(jnp.where(valid, loss, 0.0), axis=1,
+                           keepdims=True)
+        args = [input, label, weight] + ([bias] if bias is not None else [])
+        return apply(f, *args, op_name="hsigmoid_loss")
+
+    def f(x, lbl, w, table, code, *maybe_b):
+        table = table.astype(jnp.int32)
+        code = code.astype(x.dtype)
+        valid = table >= 0
+        idx_safe = jnp.clip(table, 0, w.shape[0] - 1)
+        pre = jnp.einsum("nd,nld->nl", x, w[idx_safe])
+        if maybe_b:
+            pre = pre + maybe_b[0].reshape(-1)[idx_safe]
+        loss = jax.nn.softplus(pre) - code * pre
+        return jnp.sum(jnp.where(valid, loss, 0.0), axis=1, keepdims=True)
+    args = [input, label, weight, path_table, path_code] + (
+        [bias] if bias is not None else [])
+    return apply(f, *args, op_name="hsigmoid_loss")
+
+
+def nce(input, label, num_total_classes, weight, bias=None,
+        sample_weight=None, num_neg_samples=10, sampler="uniform",
+        custom_dist=None, seed=0, name=None):
+    """Noise-contrastive estimation loss (fluid/layers/nn.py nce; kernel
+    nce_op.h): per row, cost = -log(o/(o+b)) for the true class plus
+    -log(b/(o+b)) for each sampled negative, with o = sigmoid(x.w+bias)
+    and b = P(class) * num_neg. Negatives are sampled host-side (the
+    reference samples in-kernel); pass `seed` for determinism.
+    weight [C, D], bias [C]. Returns [N, 1]."""
+    n = int(input.shape[0])
+    c = int(num_total_classes)
+    k = int(num_neg_samples)
+    rng = np.random.RandomState(seed if seed else None)
+    if sampler == "uniform":
+        negs = rng.randint(0, c, size=(n, k))
+        prob = np.full(c, 1.0 / c)
+    elif sampler == "log_uniform":
+        # P(k) = (log(k+2) - log(k+1)) / log(c+1) — the reference's
+        # LogUniformSampler
+        u = rng.rand(n, k)
+        negs = (np.exp(u * np.log(c + 1.0)) - 1.0).astype(np.int64)
+        negs = np.clip(negs, 0, c - 1)
+        ks = np.arange(c)
+        prob = (np.log((ks + 2.0) / (ks + 1.0))) / np.log(c + 1.0)
+    elif sampler == "custom_dist":
+        p = np.asarray(custom_dist, np.float64)
+        p = p / p.sum()
+        negs = rng.choice(c, size=(n, k), p=p)
+        prob = p
+    else:
+        raise ValueError("nce sampler must be uniform|log_uniform|"
+                         "custom_dist")
+    negs_j = jnp.asarray(negs, jnp.int32)
+    prob_j = jnp.asarray(prob, jnp.float32)
+
+    def f(x, lbl, w, *rest):
+        b_ = rest[0] if bias is not None else None
+        sw = (rest[-1].reshape(-1) if sample_weight is not None else None)
+        lbl = lbl.reshape(-1).astype(jnp.int32)
+        samples = jnp.concatenate([lbl[:, None], negs_j], axis=1)  # [N,1+k]
+        logits = jnp.einsum("nd,nsd->ns", x, w[samples])
+        if b_ is not None:
+            logits = logits + b_.reshape(-1)[samples]
+        o = jax.nn.sigmoid(logits)
+        pb = prob_j[samples] * k
+        cost_true = -jnp.log(o[:, :1] / (o[:, :1] + pb[:, :1]) + 1e-20)
+        cost_neg = -jnp.log(pb[:, 1:] / (o[:, 1:] + pb[:, 1:]) + 1e-20)
+        out = cost_true[:, 0] + cost_neg.sum(axis=1)
+        if sw is not None:
+            out = out * sw
+        return out[:, None]
+    args = [input, label, weight]
+    if bias is not None:
+        args.append(bias)
+    if sample_weight is not None:
+        args.append(sample_weight)
+    return apply(f, *args, op_name="nce")
+
+
+# ---------------------------------------------------------------------------
+# metric / misc losses
+# ---------------------------------------------------------------------------
+
+def bpr_loss(input, label, name=None):
+    """Bayesian personalized ranking loss (fluid/layers/loss.py:153;
+    kernel bpr_loss_op.h): out[i] = -mean_{j != label_i}
+    log(sigmoid(x[i, label_i] - x[i, j]))."""
+    def f(x, lbl):
+        n, d = x.shape
+        lbl = lbl.reshape(-1).astype(jnp.int32)
+        pos = jnp.take_along_axis(x, lbl[:, None], axis=1)
+        # -log(1 + exp(x_j - x_pos)) summed over j != pos
+        val = -jax.nn.softplus(x - pos)
+        mask = jnp.arange(d)[None, :] != lbl[:, None]
+        s = jnp.sum(jnp.where(mask, val, 0.0), axis=1)
+        return (-s / (d - 1))[:, None]
+    return apply(f, input, label, op_name="bpr_loss")
+
+
+def center_loss(input, label, num_classes, alpha, centers,
+                update_center=True, name=None):
+    """Center loss (fluid/layers/loss.py:54; kernel center_loss_op.h):
+    0.5 * ||x - center[label]||^2 per row; optionally nudges centers by
+    alpha * mean class diff (the reference's in-op update, applied here
+    to the `centers` tensor in place)."""
+    x_np_free = None
+    if update_center:
+        x_np = np.asarray(input.numpy() if isinstance(input, Tensor)
+                          else input, np.float64)
+        l_np = np.asarray(label.numpy() if isinstance(label, Tensor)
+                          else label).reshape(-1).astype(np.int64)
+        c_np = np.asarray(centers.numpy(), np.float64).copy()
+        diff_acc = np.zeros_like(c_np)
+        counts = np.ones(c_np.shape[0], np.float64)
+        for i, l in enumerate(l_np):
+            diff_acc[l] += c_np[l] - x_np[i]
+            counts[l] += 1
+        c_np -= float(alpha) * diff_acc / counts[:, None]
+        x_np_free = c_np
+
+    def f(x, lbl, ctr):
+        lbl = lbl.reshape(-1).astype(jnp.int32)
+        diff = x - ctr[lbl]
+        return 0.5 * jnp.sum(diff * diff, axis=1, keepdims=True)
+    out = apply(f, input, label, centers, op_name="center_loss")
+    if update_center and isinstance(centers, Tensor):
+        centers.set_value(x_np_free.astype(np.asarray(
+            centers.numpy()).dtype))
+    return out
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """N-pair metric loss (fluid/layers/loss.py:1653): l2 term on both
+    embeddings plus softmax CE over the anchor@positive^T similarity with
+    same-label soft targets."""
+    def f(a, p, lbl):
+        lbl = lbl.reshape(-1)
+        b = a.shape[0]
+        reg = (jnp.sum(a * a) + jnp.sum(p * p)) / b * (l2_reg * 0.25)
+        sim = a @ p.T                                   # [B, B]
+        tgt = (lbl[:, None] == lbl[None, :]).astype(a.dtype)
+        tgt = tgt / jnp.sum(tgt, axis=1, keepdims=True)
+        logp = jax.nn.log_softmax(sim, axis=1)
+        ce = -jnp.mean(jnp.sum(tgt * logp, axis=1))
+        return ce + reg
+    return apply(f, anchor, positive, labels, op_name="npair_loss")
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """Dice loss (fluid/layers/nn.py:7055): 1 - 2|X∩Y|/(|X|+|Y|), labels
+    one-hot encoded from the trailing index dim."""
+    def f(x, lbl):
+        n_cls = x.shape[-1]
+        one_hot = jax.nn.one_hot(lbl.reshape(lbl.shape[:-1]), n_cls,
+                                 dtype=x.dtype)
+        red = tuple(range(1, x.ndim))
+        inter = jnp.sum(x * one_hot, axis=red)
+        union = jnp.sum(x, axis=red) + jnp.sum(one_hot, axis=red)
+        return jnp.mean(1.0 - (2.0 * inter + epsilon) / (union + epsilon))
+    return apply(f, input, label, op_name="dice_loss")
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
+    """Legacy smooth-L1 (fluid/layers/nn.py:5791; kernel smooth_l1_loss
+    _op.h): elementwise huber with sigma^2 scaling and in/out weights,
+    summed per row -> [N, 1]."""
+    s2 = float(sigma if sigma is not None else 1.0) ** 2
+
+    def f(a, b, *weights):
+        iw = weights[0] if inside_weight is not None else None
+        ow = (weights[-1] if outside_weight is not None else None)
+        d = a - b
+        if iw is not None:
+            d = d * iw
+        ad = jnp.abs(d)
+        val = jnp.where(ad < 1.0 / s2, 0.5 * d * d * s2, ad - 0.5 / s2)
+        if ow is not None:
+            val = val * ow
+        return jnp.sum(val.reshape(val.shape[0], -1), axis=1,
+                       keepdims=True)
+    args = [x, y]
+    if inside_weight is not None:
+        args.append(inside_weight)
+    if outside_weight is not None:
+        args.append(outside_weight)
+    return apply(f, *args, op_name="smooth_l1")
+
+
+def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    """Distillation CTR loss (fluid/layers/loss.py:1465; kernel
+    teacher_student_sigmoid_loss_op.cc): label encodes click z and
+    teacher value z' — -2/-1 when z' is absent, z' or 1+z' when present."""
+    # the reference applies the soft_max bounds only inside the grad
+    # kernel (sigmoid clamping); the forward value is unclipped
+    del soft_max_up_bound, soft_max_lower_bound
+
+    def f(x, lbl):
+        l = lbl.astype(x.dtype)
+        softplus_abs = jnp.log(1.0 + jnp.exp(-jnp.abs(x)))
+        base = jnp.maximum(x, 0.0) + softplus_abs
+
+        # z (click) and z' (teacher) per the kernel's label decoding
+        z = jnp.where(l < -1.0, 0.0,
+                      jnp.where(l < 0.0, 1.0,
+                                jnp.where(l < 1.0, 0.0, 1.0)))
+        has_teacher = l >= 0.0
+        zprime = jnp.where(l < 1.0, l, l - 1.0)
+        loss = (base - x * z) + jnp.where(
+            has_teacher, base - x * zprime, 0.0)
+        return loss
+    return apply(f, input, label, op_name="teacher_student_sigmoid_loss")
+
+
+def warpctc(input, label, blank=0, norm_by_times=False, input_length=None,
+            label_length=None):
+    """Legacy CTC facade (fluid warpctc) over the framework ctc_loss;
+    padded mode: input [Tmax, B, C] logits, label [B, Lmax]."""
+    if input_length is None or label_length is None:
+        raise NotImplementedError(
+            "warpctc requires input_length/label_length (the padded dense "
+            "form; LoD inputs are expressed as lengths here)")
+    out = ctc_loss(input, label, input_length, label_length, blank=blank,
+                   reduction="none", norm_by_times=norm_by_times)
+    return out.reshape([-1, 1]) if hasattr(out, "reshape") else out
+
+
+# ---------------------------------------------------------------------------
+# legacy layers-as-functions
+# ---------------------------------------------------------------------------
+
+def fc(input, size, num_flatten_dims=1, weight=None, bias=None, act=None,
+       name=None):
+    """Legacy fully-connected (fluid/layers/nn.py:211): flattens trailing
+    dims past num_flatten_dims, multiplies [prod(rest), size] weight.
+    Here weight/bias are explicit tensors (no global parameter scope)."""
+    nfd = int(num_flatten_dims)
+    if weight is None:
+        raise ValueError("fc requires an explicit weight tensor "
+                         "([prod(trailing dims), size]) in this framework")
+
+    def f(x, w, *maybe_b):
+        lead = x.shape[:nfd]
+        flat = x.reshape((int(np.prod(lead)), -1))
+        out = flat @ w
+        if maybe_b:
+            out = out + maybe_b[0]
+        out = out.reshape(tuple(lead) + (w.shape[1],))
+        if act == "relu":
+            out = jnp.maximum(out, 0)
+        elif act == "tanh":
+            out = jnp.tanh(out)
+        elif act is not None:
+            raise ValueError("fc act supports relu/tanh/None")
+        return out
+    args = [input, weight] + ([bias] if bias is not None else [])
+    return apply(f, *args, op_name="fc")
+
+
+def bilinear_tensor_product(x, y, weight, bias=None, act=None, name=None):
+    """out[:, i] = x @ W[i] @ y^T diag (fluid/layers/nn.py:13144):
+    W [size, dx, dy], x [N, dx], y [N, dy] -> [N, size]."""
+    def f(a, b, w, *maybe_b):
+        out = jnp.einsum("nd,kde,ne->nk", a, w, b)
+        if maybe_b:
+            out = out + maybe_b[0]
+        if act == "relu":
+            out = jnp.maximum(out, 0)
+        return out
+    args = [x, y, weight] + ([bias] if bias is not None else [])
+    return apply(f, *args, op_name="bilinear_tensor_product")
+
+
+def data_norm(input, epsilon=1e-4, batch_size=None, batch_sum=None,
+              batch_square_sum=None, name=None):
+    """Stats-based normalization (fluid/layers/nn.py:3214; kernel
+    data_norm_op.cc): y = (x - batch_sum/batch_size) /
+    sqrt(batch_square_sum/batch_size). The three stats are persistent
+    accumulators in the reference PS path; here they are explicit
+    tensors."""
+    if batch_size is None or batch_sum is None or batch_square_sum is None:
+        raise ValueError("data_norm needs batch_size/batch_sum/"
+                         "batch_square_sum stat tensors")
+
+    def f(x, n, s, sq):
+        mean = s / n
+        scale = jax.lax.rsqrt(sq / n + epsilon)
+        return (x - mean) * scale
+    return apply(f, input, batch_size, batch_sum, batch_square_sum,
+                 op_name="data_norm")
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """Spectral normalization (fluid/layers/nn.py:3626; kernel
+    spectral_norm_op.h). The reference persists u/v across calls so even
+    power_iters=1 converges over training steps; this functional form has
+    no state, so it compensates by running at least 20 iterations from a
+    deterministic start — approximating the reference's steady-state
+    sigma rather than its cold-start value."""
+    d = int(dim)
+
+    def f(w):
+        perm = (d,) + tuple(i for i in range(w.ndim) if i != d)
+        mat = jnp.transpose(w, perm).reshape(w.shape[d], -1)   # [h, w_]
+        h, w_ = mat.shape
+        key = jax.random.PRNGKey(0)
+        u = jax.random.normal(key, (h,), mat.dtype)
+        for _ in range(max(int(power_iters), 20)):
+            v = mat.T @ u
+            v = v / (jnp.linalg.norm(v) + eps)
+            u = mat @ v
+            u = u / (jnp.linalg.norm(u) + eps)
+        sigma = u @ mat @ v
+        return w / sigma
+    return apply(f, weight, op_name="spectral_norm")
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1):
+    """Batched diagonal embedding (nn/functional/extension.py:28)."""
+    def f(x):
+        n = x.shape[-1] + abs(int(offset))
+        out_ndim = x.ndim + 1
+        d1 = dim1 % out_ndim
+        d2 = dim2 % out_ndim
+        base = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+        idx = jnp.arange(x.shape[-1])
+        r = idx + max(-offset, 0)
+        c = idx + max(offset, 0)
+        base = base.at[..., r, c].set(x)
+        # move the two trailing diag dims to (dim1, dim2)
+        order = list(range(x.ndim - 1))
+        rest = [i for i in range(out_ndim) if i not in (d1, d2)]
+        perm = [0] * out_ndim
+        for src, dst in zip(order, rest):
+            perm[dst] = src
+        perm[d1] = x.ndim - 1
+        perm[d2] = x.ndim
+        return jnp.transpose(base, perm)
+    return apply(f, input, op_name="diag_embed")
+
+
+def soft_relu(x, threshold=40.0, name=None):
+    """log(1 + exp(min(max(x, -t), t))) (fluid soft_relu op)."""
+    t = float(threshold)
+
+    def f(a):
+        return jnp.log1p(jnp.exp(jnp.clip(a, -t, t)))
+    return apply(f, x, op_name="soft_relu")
+
+
+# ---------------------------------------------------------------------------
+# deformable convolution
+# ---------------------------------------------------------------------------
+
+def deformable_conv(input, offset, mask, num_filters, filter_size,
+                    weight, bias=None, stride=1, padding=0, dilation=1,
+                    groups=1, deformable_groups=1, im2col_step=1,
+                    modulated=True, name=None):
+    """Deformable conv v1/v2 (fluid/layers/nn.py:14221; kernel
+    deformable_conv_op.h im2col layout: offset channels are
+    [dg, kh*kw, (dy, dx)], mask channels [dg, kh*kw]).
+
+    Samples x at p0 + pk + offset with bilinear interpolation (zeros
+    outside), scales by mask when modulated, then contracts with the
+    [Co, Ci/g, kh, kw] weight on the MXU. weight is explicit (no global
+    scope); x [N, C, H, W]."""
+    def to2(v):
+        return (int(v), int(v)) if isinstance(v, int) else tuple(
+            int(i) for i in v)
+    kh, kw = to2(filter_size)
+    sh, sw = to2(stride)
+    ph, pw = to2(padding)
+    dh, dw = to2(dilation)
+    g = int(groups)
+    dg = int(deformable_groups)
+
+    def f(x, off, w, *rest):
+        msk = rest[0] if (modulated and mask is not None) else None
+        b_ = rest[-1] if bias is not None else None
+        n, c, h, wd = x.shape
+        oh = (h + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+        ow = (wd + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+        koff = off.reshape(n, dg, kh * kw, 2, oh, ow)
+        # sample positions: p0 + pk + offset (y, x per kernel layout)
+        base_y = (jnp.arange(oh) * sh - ph)[None, :, None]       # [1,oh,1]
+        base_x = (jnp.arange(ow) * sw - pw)[None, None, :]       # [1,1,ow]
+        ky = (jnp.arange(kh) * dh)[:, None].repeat(kw, 1).reshape(-1)
+        kx = (jnp.arange(kw) * dw)[None, :].repeat(kh, 0).reshape(-1)
+        # [N, dg, K, oh, ow]
+        py = (base_y[None, None] + ky[None, None, :, None, None] +
+              koff[:, :, :, 0])
+        px = (base_x[None, None] + kx[None, None, :, None, None] +
+              koff[:, :, :, 1])
+
+        y0 = jnp.floor(py)
+        x0 = jnp.floor(px)
+        ly = py - y0
+        lx = px - x0
+        vals = 0.0
+        cpg = c // dg                      # channels per deformable group
+        xg = x.reshape(n, dg, cpg, h, wd)
+
+        def gather(iy, ix):
+            iyc = jnp.clip(iy.astype(jnp.int32), 0, h - 1)
+            ixc = jnp.clip(ix.astype(jnp.int32), 0, wd - 1)
+            flat = xg.reshape(n, dg, cpg, h * wd)
+            idx = (iyc * wd + ixc).reshape(n, dg, 1, -1)
+            got = jnp.take_along_axis(
+                flat, jnp.broadcast_to(idx, (n, dg, cpg, idx.shape[-1])),
+                axis=3)
+            return got.reshape(n, dg, cpg, kh * kw, oh, ow)
+
+        for iy, wy in ((y0, 1 - ly), (y0 + 1, ly)):
+            for ix, wx in ((x0, 1 - lx), (x0 + 1, lx)):
+                inb = ((iy >= 0) & (iy <= h - 1) &
+                       (ix >= 0) & (ix <= wd - 1)).astype(x.dtype)
+                wgt = (wy * wx * inb)[:, :, None]    # [N,dg,1,K,oh,ow]
+                vals = vals + gather(iy, ix) * wgt
+        if msk is not None:
+            m = msk.reshape(n, dg, 1, kh * kw, oh, ow)
+            vals = vals * m
+        cols = vals.reshape(n, c, kh * kw, oh, ow)
+        # group conv contraction
+        co = w.shape[0]
+        wg = w.reshape(g, co // g, c // g, kh * kw)
+        colsg = cols.reshape(n, g, c // g, kh * kw, oh, ow)
+        out = jnp.einsum("ngckhw,gock->ngohw", colsg, wg)
+        out = out.reshape(n, co, oh, ow)
+        if b_ is not None:
+            out = out + b_.reshape(1, -1, 1, 1)
+        return out
+    args = [input, offset, weight]
+    if modulated and mask is not None:
+        args.insert(2, mask)
+
+        def reorder(x, off, msk, w, *rest):
+            return f(x, off, w, msk, *rest)
+        if bias is not None:
+            args.append(bias)
+        return apply(reorder, *args, op_name="deformable_conv")
+    if bias is not None:
+        args.append(bias)
+    return apply(f, *args, op_name="deformable_conv")

@@ -283,6 +283,13 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
         end1 = jnp.take_along_axis(alpha, idx_last[:, None], axis=1)[:, 0]
         end2 = jnp.take_along_axis(alpha, (idx_last - 1)[:, None], axis=1)[:, 0]
         loss = -jnp.logaddexp(end1, end2)
+        if norm_by_times:
+            # reference warpctc norm_by_times divides only the GRADIENT by
+            # each sequence's step count; value-preserving trick: forward
+            # value is loss, backward cotangent scales by 1/T
+            t_f = in_len.astype(loss.dtype)
+            scaled = loss / t_f
+            loss = scaled + jax.lax.stop_gradient(loss - scaled)
         if reduction == "mean":
             return jnp.mean(loss / jnp.maximum(lbl_len.astype(jnp.float32), 1.0))
         return _reduce(loss, reduction)

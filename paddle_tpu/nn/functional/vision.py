@@ -289,14 +289,17 @@ def image_resize(input, out_shape=None, scale=None, name=None,
         # legacy nearest honors align_corners (interpolate_op.h: in_k =
         # round(k * (in-1)/(out-1))); the v2 interpolate path only does the
         # half-pixel convention, so gather explicitly here.
+        channels_last = not data_format.startswith("NC")
+        first_sp = 1 if channels_last else 2
         if out_shape is None:
-            spatial = input.shape[2:]
+            spatial = input.shape[first_sp:len(input.shape) -
+                                  (1 if channels_last else 0)]
             out_shape = [int(round(s * scale)) for s in spatial]
         tgt = [int(v) for v in out_shape]
 
         def f(a):
             out = a
-            for ax, t in zip(range(2, 2 + len(tgt)), tgt):
+            for ax, t in zip(range(first_sp, first_sp + len(tgt)), tgt):
                 s = out.shape[ax]
                 ratio = 0.0 if t <= 1 else (s - 1.0) / (t - 1.0)
                 idx = jnp.floor(jnp.arange(t, dtype=jnp.float32) * ratio

@@ -1,0 +1,221 @@
+"""Remaining reference nn classes: PairwiseDistance, HSigmoidLoss,
+NCELoss, TreeConv (reference: python/paddle/nn/layer/distance.py:26,
+nn/functional/loss.py hsigmoid_loss wrapper classes,
+fluid/dygraph/nn.py:3096 TreeConv + operators/math/tree2col.cc)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor, apply
+from .. import functional as F
+from .. import initializer as I
+from .layers import Layer
+
+__all__ = ["PairwiseDistance", "HSigmoidLoss", "NCELoss", "TreeConv",
+           "ctc_greedy_decoder"]
+
+
+class PairwiseDistance(Layer):
+    """p-norm of x - y over axis 1 (reference nn/layer/distance.py:26)."""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p = float(p)
+        self.epsilon = float(epsilon)
+        self.keepdim = bool(keepdim)
+
+    def forward(self, x, y):
+        p, eps, keep = self.p, self.epsilon, self.keepdim
+
+        def f(a, b):
+            d = jnp.abs(a - b) + eps
+            if p == float("inf"):
+                out = jnp.max(d, axis=1, keepdims=keep)
+            else:
+                out = jnp.sum(d ** p, axis=1, keepdims=keep) ** (1.0 / p)
+            return out
+        return apply(f, x, y, op_name="pairwise_distance")
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical-sigmoid classifier head (reference paddle.nn
+    HSigmoidLoss over nn/functional/loss.py:331). Owns the
+    [num_classes - 1, feature_size] weight and optional bias."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if num_classes < 2:
+            raise ValueError("num_classes must be >= 2")
+        self._num_classes = num_classes
+        self._is_custom = is_custom
+        rows = num_classes if is_custom else num_classes - 1
+        self.weight = self.create_parameter(
+            [rows, feature_size], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.bias = self.create_parameter([rows, 1], attr=bias_attr,
+                                          is_bias=True)
+        if bias_attr is False:
+            self.bias = None
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        if self._is_custom and (path_table is None or path_code is None):
+            raise ValueError("custom tree needs path_table and path_code")
+        return F.hsigmoid_loss(input, label, self._num_classes, self.weight,
+                               self.bias, path_table=path_table,
+                               path_code=path_code)
+
+
+class NCELoss(Layer):
+    """Noise-contrastive estimation head (reference nn __all__ NCELoss /
+    fluid nce): owns [num_total_classes, dim] weight + bias and samples
+    negatives per call."""
+
+    def __init__(self, num_total_classes, dim, num_neg_samples=10,
+                 sampler="uniform", custom_dist=None, seed=0,
+                 weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        self._num_total_classes = num_total_classes
+        self._kw = dict(num_neg_samples=num_neg_samples, sampler=sampler,
+                        custom_dist=custom_dist, seed=seed)
+        self.weight = self.create_parameter(
+            [num_total_classes, dim], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.bias = self.create_parameter([num_total_classes], attr=bias_attr,
+                                          is_bias=True)
+        if bias_attr is False:
+            self.bias = None
+
+    def forward(self, input, label, sample_weight=None):
+        return F.nce(input, label, self._num_total_classes, self.weight,
+                     self.bias, sample_weight=sample_weight, **self._kw)
+
+
+def _tree_patches(edges, n_nodes, max_depth):
+    """Continuous-binary-tree patch coefficients
+    (tree2col.cc construct_patch): DFS from each node bounded by
+    max_depth; eta_t = (d_max - depth)/d_max,
+    eta_l = (1 - eta_t) * (index-1)/(pclen-1) (0.5 single child),
+    eta_r = (1 - eta_t)(1 - eta_l). Returns [P, n_nodes, 3] coeffs."""
+    tr = [[] for _ in range(n_nodes + 2)]
+    for u, v in edges:
+        if u == 0 and v == 0:
+            break
+        if u != 0 and v != 0:
+            tr[int(u)].append(int(v))
+    coeffs = []
+    for root in range(1, n_nodes + 1):
+        # (node, index, pclen, depth)
+        patch = [(root, 1, 1, 0)]
+        stack = [(root, 1, 1, 0)]
+        visited = {root}
+        while stack:
+            node, _, _, depth = stack[-1]
+            pushed = False
+            kids = tr[node]
+            for i, v in enumerate(kids):
+                if v not in visited and depth + 1 < max_depth:
+                    visited.add(v)
+                    stack.append((v, i, len(kids), depth + 1))
+                    patch.append((v, i + 1, len(kids), depth + 1))
+                    pushed = True
+                    break
+            if not pushed:
+                stack.pop()
+        c = np.zeros((n_nodes, 3))
+        for node, index, pclen, depth in patch:
+            eta_t = (max_depth - depth) / max_depth
+            tmp = 0.5 if pclen == 1 else (index - 1.0) / (pclen - 1.0)
+            eta_l = (1.0 - eta_t) * tmp
+            eta_r = (1.0 - eta_t) * (1.0 - eta_l)
+            c[node - 1, 0] += eta_l
+            c[node - 1, 1] += eta_r
+            c[node - 1, 2] += eta_t
+        coeffs.append(c)
+    return np.stack(coeffs)         # [P, n_nodes, 3]
+
+
+class TreeConv(Layer):
+    """Tree-based convolution (fluid/dygraph/nn.py:3096; kernel
+    tree_conv_op.h + tree2col.cc). nodes_vector [B, n, feature_size],
+    edge_set [B, n_edges, 2] int (1-based parent/child, 0-padded).
+    Output [B, n, output_size, num_filters] (act applied).
+
+    The patch coefficients depend only on the integer tree structure, so
+    they're built host-side; the feature contraction stays jnp and
+    differentiable through nodes_vector and the filter."""
+
+    def __init__(self, feature_size, output_size, num_filters=1, max_depth=2,
+                 act="tanh", param_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        self._feature_size = feature_size
+        self._output_size = output_size
+        self._num_filters = num_filters
+        self._max_depth = max_depth
+        self._act = act
+        self.weight = self.create_parameter(
+            [feature_size, 3, output_size, num_filters], attr=param_attr,
+            default_initializer=I.XavierNormal())
+        self.bias = self.create_parameter([1, output_size, num_filters],
+                                          attr=bias_attr, is_bias=True)
+        if bias_attr is False:
+            self.bias = None
+
+    def forward(self, nodes_vector, edge_set):
+        edges = np.asarray(edge_set.numpy()
+                           if isinstance(edge_set, Tensor) else edge_set)
+        feats_shape = nodes_vector.shape
+        b, n = int(feats_shape[0]), int(feats_shape[1])
+        coeff = np.stack([_tree_patches(edges[i], n, self._max_depth)
+                          for i in range(b)])       # [B, P, n, 3]
+        coeff_j = jnp.asarray(coeff, jnp.float32)
+        act = self._act
+
+        def f(x, w, *maybe_b):
+            # patch[b, p, i, k] = sum_v coeff[b, p, v, k] * x[b, v, i]
+            patch = jnp.einsum("bpvk,bvi->bpik", coeff_j, x)
+            out = jnp.einsum("bpik,ikof->bpof", patch, w)
+            if maybe_b:
+                out = out + maybe_b[0][None]
+            if act == "tanh":
+                out = jnp.tanh(out)
+            elif act == "relu":
+                out = jnp.maximum(out, 0)
+            elif act is not None:
+                raise ValueError("TreeConv act supports tanh/relu/None")
+            return out
+        args = [nodes_vector, self.weight] + (
+            [self.bias] if self.bias is not None else [])
+        return apply(f, *args, op_name="tree_conv")
+
+
+def ctc_greedy_decoder(input, blank, input_length=None, padding_value=0,
+                       name=None):
+    """Greedy CTC decode (fluid/layers/nn.py:5271): per-step argmax,
+    merge repeats, drop blanks. Padded mode: input [B, T, C] probs,
+    returns (decoded [B, T] padded with padding_value, lengths [B, 1])."""
+    x = np.asarray(input.numpy() if isinstance(input, Tensor) else input)
+    if x.ndim != 3:
+        raise ValueError("ctc_greedy_decoder expects padded [B, T, C] input "
+                         "(LoD mode is expressed via input_length)")
+    b, t, _ = x.shape
+    lens = (np.full(b, t, np.int64) if input_length is None
+            else np.asarray(input_length.numpy()
+                            if isinstance(input_length, Tensor)
+                            else input_length).reshape(-1).astype(np.int64))
+    am = x.argmax(axis=2)
+    out = np.full((b, t), padding_value, np.int64)
+    out_lens = np.zeros((b, 1), np.int64)
+    for i in range(b):
+        prev = -1
+        k = 0
+        for j in range(int(lens[i])):
+            tok = int(am[i, j])
+            if tok != prev and tok != blank:
+                out[i, k] = tok
+                k += 1
+            prev = tok
+        out_lens[i, 0] = k
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(out_lens))

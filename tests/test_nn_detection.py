@@ -1,0 +1,515 @@
+"""Detection op family vs independent numpy references.
+
+Reference test strategy: fluid/tests/unittests/test_box_coder_op.py,
+test_prior_box_op.py, test_multiclass_nms_op.py etc. — each op checked
+against a python kernel written from the op spec. The references here are
+re-derived from the C++ kernel semantics (box_coder_op.h,
+prior_box_op.h, multiclass_nms_op.cc, yolo_box_op.h), written as direct
+loops so they can't share bugs with the vectorized implementations.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+RNG = np.random.RandomState(11)
+
+
+def _np_iou(a, b, normalized=True):
+    off = 0.0 if normalized else 1.0
+    ix1 = max(a[0], b[0]); iy1 = max(a[1], b[1])
+    ix2 = min(a[2], b[2]); iy2 = min(a[3], b[3])
+    iw = max(ix2 - ix1 + off, 0.0); ih = max(iy2 - iy1 + off, 0.0)
+    inter = iw * ih
+    ua = ((a[2]-a[0]+off)*(a[3]-a[1]+off) + (b[2]-b[0]+off)*(b[3]-b[1]+off)
+          - inter)
+    return inter / ua if ua > 0 else 0.0
+
+
+def _rand_boxes(n, lo=0, hi=20):
+    x1 = RNG.uniform(lo, hi, n); y1 = RNG.uniform(lo, hi, n)
+    w = RNG.uniform(1, 8, n); h = RNG.uniform(1, 8, n)
+    return np.stack([x1, y1, x1 + w, y1 + h], 1).astype(np.float32)
+
+
+@pytest.mark.parametrize("normalized", [True, False])
+def test_iou_similarity(normalized):
+    a = _rand_boxes(5)
+    b = _rand_boxes(7)
+    out = F.iou_similarity(paddle.to_tensor(a), paddle.to_tensor(b),
+                           box_normalized=normalized).numpy()
+    ref = np.array([[_np_iou(x, y, normalized) for y in b] for x in a])
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+def _np_box_coder_encode(prior, target, var, normalized):
+    off = 0.0 if normalized else 1.0
+    n, m = target.shape[0], prior.shape[0]
+    out = np.zeros((n, m, 4))
+    for i in range(n):
+        for j in range(m):
+            pw = prior[j, 2] - prior[j, 0] + off
+            ph = prior[j, 3] - prior[j, 1] + off
+            px = prior[j, 0] + pw / 2
+            py = prior[j, 1] + ph / 2
+            tx = (target[i, 0] + target[i, 2]) / 2
+            ty = (target[i, 1] + target[i, 3]) / 2
+            tw = target[i, 2] - target[i, 0] + off
+            th = target[i, 3] - target[i, 1] + off
+            o = [(tx - px) / pw, (ty - py) / ph,
+                 np.log(abs(tw / pw)), np.log(abs(th / ph))]
+            out[i, j] = np.asarray(o) / var[j] if var is not None else o
+    return out
+
+
+@pytest.mark.parametrize("normalized", [True, False])
+def test_box_coder_encode(normalized):
+    prior = _rand_boxes(4)
+    target = _rand_boxes(3)
+    var = np.abs(RNG.rand(4, 4).astype(np.float32)) + 0.1
+    out = F.box_coder(paddle.to_tensor(prior), paddle.to_tensor(var),
+                      paddle.to_tensor(target), "encode_center_size",
+                      normalized).numpy()
+    ref = _np_box_coder_encode(prior, target, var, normalized)
+    np.testing.assert_allclose(out, ref, atol=1e-4, rtol=1e-4)
+    # list variance form
+    out2 = F.box_coder(paddle.to_tensor(prior), [0.1, 0.1, 0.2, 0.2],
+                       paddle.to_tensor(target), "encode_center_size",
+                       normalized).numpy()
+    ref2 = _np_box_coder_encode(
+        prior, target, np.tile([0.1, 0.1, 0.2, 0.2], (4, 1)), normalized)
+    np.testing.assert_allclose(out2, ref2, atol=1e-4, rtol=1e-4)
+
+
+@pytest.mark.parametrize("axis", [0, 1])
+def test_box_coder_decode_roundtrip(axis):
+    # decode(encode(t)) must reproduce t when prior aligns with the axis
+    prior = _rand_boxes(5)
+    target = _rand_boxes(3)
+    enc = F.box_coder(paddle.to_tensor(prior), [0.1, 0.1, 0.2, 0.2],
+                      paddle.to_tensor(target), "encode_center_size").numpy()
+    if axis == 0:
+        deltas = enc            # [N=3, M=5, 4], prior [5, 4] broadcast axis 0
+        dec = F.box_coder(paddle.to_tensor(prior), [0.1, 0.1, 0.2, 0.2],
+                          paddle.to_tensor(deltas.astype(np.float32)),
+                          "decode_center_size", axis=0).numpy()
+        for i in range(3):
+            for j in range(5):
+                np.testing.assert_allclose(dec[i, j], target[i], atol=1e-3)
+    else:
+        deltas = enc.transpose(1, 0, 2)   # [M=5, N=3, 4] -> prior axis 1
+        dec = F.box_coder(paddle.to_tensor(prior), [0.1, 0.1, 0.2, 0.2],
+                          paddle.to_tensor(deltas.astype(np.float32)),
+                          "decode_center_size", axis=1).numpy()
+        for j in range(5):
+            for i in range(3):
+                np.testing.assert_allclose(dec[j, i], target[i], atol=1e-3)
+
+
+def test_box_coder_decode_tensor_var():
+    prior = _rand_boxes(4)
+    var = (np.abs(RNG.rand(4, 4)) + 0.1).astype(np.float32)
+    deltas = RNG.randn(2, 4, 4).astype(np.float32) * 0.1
+    dec = F.box_coder(paddle.to_tensor(prior), paddle.to_tensor(var),
+                      paddle.to_tensor(deltas), "decode_center_size").numpy()
+    # loop reference (box_coder_op.h DecodeCenterSize, axis=0)
+    for i in range(2):
+        for j in range(4):
+            pw = prior[j, 2] - prior[j, 0]
+            ph = prior[j, 3] - prior[j, 1]
+            px = prior[j, 0] + pw / 2
+            py = prior[j, 1] + ph / 2
+            cx = var[j, 0] * deltas[i, j, 0] * pw + px
+            cy = var[j, 1] * deltas[i, j, 1] * ph + py
+            w = np.exp(var[j, 2] * deltas[i, j, 2]) * pw
+            h = np.exp(var[j, 3] * deltas[i, j, 3]) * ph
+            ref = [cx - w/2, cy - h/2, cx + w/2, cy + h/2]
+            np.testing.assert_allclose(dec[i, j], ref, atol=1e-4)
+
+
+def test_prior_box_kernel_parity():
+    fmap = paddle.to_tensor(RNG.randn(1, 8, 3, 4).astype(np.float32))
+    image = paddle.to_tensor(RNG.randn(1, 3, 30, 40).astype(np.float32))
+    boxes, var = F.prior_box(fmap, image, min_sizes=[4.0, 8.0],
+                             max_sizes=[10.0, 16.0], aspect_ratios=[2.0],
+                             flip=True, clip=True)
+    b = boxes.numpy()
+    # expanded ratios: [1, 2, 0.5]; priors per cell = 3 + 1(max) per size = 8
+    assert b.shape == (3, 4, 8, 4)
+    step_w, step_h = 40 / 4, 30 / 3
+    # cell (1, 2), first prior: min_size 4, ar=1
+    cx, cy = (2 + 0.5) * step_w, (1 + 0.5) * step_h
+    np.testing.assert_allclose(
+        b[1, 2, 0], [(cx - 2) / 40, (cy - 2) / 30,
+                     (cx + 2) / 40, (cy + 2) / 30], atol=1e-6)
+    # prior 1: ar=2 -> w = 4*sqrt(2)/2 half, h = 4/sqrt(2)/2 half
+    hw, hh = 4 * np.sqrt(2) / 2, 4 / np.sqrt(2) / 2
+    np.testing.assert_allclose(
+        b[1, 2, 1], [(cx - hw) / 40, (cy - hh) / 30,
+                     (cx + hw) / 40, (cy + hh) / 30], atol=1e-6)
+    # prior 3 (last of size 0): sqrt(min*max)
+    s = np.sqrt(4.0 * 10.0) / 2
+    np.testing.assert_allclose(
+        b[1, 2, 3], [(cx - s) / 40, (cy - s) / 30,
+                     (cx + s) / 40, (cy + s) / 30], atol=1e-6)
+    v = var.numpy()
+    assert v.shape == b.shape
+    np.testing.assert_allclose(v[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+    assert (b >= 0).all() and (b <= 1).all()
+
+
+def test_prior_box_min_max_order():
+    fmap = paddle.to_tensor(np.zeros((1, 1, 1, 1), np.float32))
+    image = paddle.to_tensor(np.zeros((1, 3, 10, 10), np.float32))
+    boxes, _ = F.prior_box(fmap, image, min_sizes=[4.0], max_sizes=[9.0],
+                           aspect_ratios=[2.0], flip=False,
+                           min_max_aspect_ratios_order=True)
+    b = boxes.numpy()[0, 0]
+    # order: min, max, ar boxes
+    assert b.shape[0] == 3
+    np.testing.assert_allclose(b[0, 2] - b[0, 0], 4.0 / 10, atol=1e-6)
+    np.testing.assert_allclose(b[1, 2] - b[1, 0], 6.0 / 10, atol=1e-6)
+
+
+def test_anchor_generator_kernel_parity():
+    fmap = paddle.to_tensor(RNG.randn(1, 8, 2, 2).astype(np.float32))
+    anchors, var = F.anchor_generator(
+        fmap, anchor_sizes=[32.0, 64.0], aspect_ratios=[0.5, 1.0],
+        stride=[16.0, 16.0], offset=0.5)
+    a = anchors.numpy()
+    assert a.shape == (2, 2, 4, 4)
+    # kernel: ar-major ordering; base_w = round(sqrt(256/ar)), base_h =
+    # round(base_w*ar); anchor = scale*base, corners at ctr +- (sz-1)/2
+    xc = 0 * 16 + 0.5 * 15
+    yc = xc
+    base_w = round(np.sqrt(16 * 16 / 0.5)); base_h = round(base_w * 0.5)
+    w0 = 32.0 / 16 * base_w; h0 = 32.0 / 16 * base_h
+    np.testing.assert_allclose(
+        a[0, 0, 0], [xc - .5 * (w0 - 1), yc - .5 * (h0 - 1),
+                     xc + .5 * (w0 - 1), yc + .5 * (h0 - 1)], atol=1e-4)
+    assert var.numpy().shape == a.shape
+
+
+def test_density_prior_box():
+    fmap = paddle.to_tensor(np.zeros((1, 1, 2, 2), np.float32))
+    image = paddle.to_tensor(np.zeros((1, 3, 16, 16), np.float32))
+    boxes, var = F.density_prior_box(
+        fmap, image, densities=[2], fixed_sizes=[4.0], fixed_ratios=[1.0])
+    b = boxes.numpy()
+    assert b.shape == (2, 2, 4, 4)      # 1 ratio * 2^2 density
+    # kernel loop for cell (0, 0): step=8, step_avg=8, shift=4
+    cx = cy = 0.5 * 8
+    dc = cx - 8 / 2.0 + 4 / 2.0
+    exp0 = [max((dc - 2) / 16, 0), max((dc - 2) / 16, 0),
+            min((dc + 2) / 16, 1), min((dc + 2) / 16, 1)]
+    np.testing.assert_allclose(b[0, 0, 0], exp0, atol=1e-6)
+    assert (b >= 0).all() and (b <= 1).all()
+    bf, vf = F.density_prior_box(
+        fmap, image, densities=[2], fixed_sizes=[4.0], fixed_ratios=[1.0],
+        flatten_to_2d=True)
+    assert bf.numpy().shape == (16, 4)
+
+
+def test_box_clip():
+    boxes = paddle.to_tensor(np.array(
+        [[-5.0, -3.0, 25.0, 40.0], [2.0, 2.0, 8.0, 8.0]], np.float32))
+    im_info = paddle.to_tensor(np.array([20.0, 30.0, 1.0], np.float32))
+    out = F.box_clip(boxes, im_info).numpy()
+    np.testing.assert_allclose(out[0], [0, 0, 25, 19])
+    np.testing.assert_allclose(out[1], [2, 2, 8, 8])
+
+
+def test_box_decoder_and_assign():
+    prior = _rand_boxes(3)
+    var = np.array([0.1, 0.1, 0.2, 0.2], np.float32)
+    n_cls = 4
+    deltas = (RNG.randn(3, n_cls * 4) * 0.2).astype(np.float32)
+    score = RNG.rand(3, n_cls).astype(np.float32)
+    dec, assigned = F.box_decoder_and_assign(
+        paddle.to_tensor(prior), paddle.to_tensor(var),
+        paddle.to_tensor(deltas), paddle.to_tensor(score), 4.135)
+    dec = dec.numpy(); assigned = assigned.numpy()
+    assert dec.shape == (3, n_cls * 4)
+    # loop reference for roi 0, class 1 (+1 widths per kernel)
+    pw = prior[0, 2] - prior[0, 0] + 1
+    ph = prior[0, 3] - prior[0, 1] + 1
+    px = prior[0, 0] + pw / 2
+    py = prior[0, 1] + ph / 2
+    d = deltas[0, 4:8]
+    dw = min(0.2 * d[2], 4.135); dh = min(0.2 * d[3], 4.135)
+    cx = 0.1 * d[0] * pw + px; cy = 0.1 * d[1] * ph + py
+    w = np.exp(dw) * pw; h = np.exp(dh) * ph
+    np.testing.assert_allclose(
+        dec[0, 4:8], [cx - w/2, cy - h/2, cx + w/2 - 1, cy + h/2 - 1],
+        atol=1e-4)
+    best = np.argmax(score[:, 1:], axis=1) + 1
+    for i in range(3):
+        np.testing.assert_allclose(assigned[i], dec[i, best[i]*4:(best[i]+1)*4],
+                                   atol=1e-5)
+
+
+def test_bipartite_match_greedy():
+    dist = np.array([[0.9, 0.1, 0.3],
+                     [0.8, 0.7, 0.2]], np.float32)
+    idx, d = F.bipartite_match(paddle.to_tensor(dist))
+    # global max 0.9 -> col 0 gets row 0; next best for col 1 is row 1 (0.7)
+    np.testing.assert_array_equal(idx.numpy()[0], [0, 1, -1])
+    np.testing.assert_allclose(d.numpy()[0], [0.9, 0.7, 0.0], atol=1e-6)
+
+
+def test_bipartite_match_per_prediction():
+    dist = np.array([[0.9, 0.1, 0.6],
+                     [0.8, 0.7, 0.2]], np.float32)
+    idx, d = F.bipartite_match(paddle.to_tensor(dist), "per_prediction", 0.5)
+    # bipartite assigns col0<-row0, col1<-row1; argmax pass fills col2 with
+    # row 0 (0.6 >= 0.5)
+    np.testing.assert_array_equal(idx.numpy()[0], [0, 1, 0])
+    np.testing.assert_allclose(d.numpy()[0], [0.9, 0.7, 0.6], atol=1e-6)
+
+
+def test_target_assign():
+    inp = RNG.randn(2, 4, 3).astype(np.float32)
+    match = np.array([[0, -1, 2], [3, 1, -1]], np.int32)
+    out, wt = F.target_assign(paddle.to_tensor(inp), paddle.to_tensor(match),
+                              mismatch_value=7)
+    o = out.numpy(); w = wt.numpy()
+    np.testing.assert_allclose(o[0, 0], inp[0, 0])
+    np.testing.assert_allclose(o[0, 1], [7, 7, 7])
+    np.testing.assert_allclose(o[1, 0], inp[1, 3])
+    np.testing.assert_allclose(w[:, :, 0], [[1, 0, 1], [1, 1, 0]])
+
+
+def _np_nms_single(boxes, scores, score_th, nms_th, top_k):
+    cand = sorted([i for i in range(len(scores)) if scores[i] > score_th],
+                  key=lambda i: -scores[i])[:top_k if top_k > 0 else None]
+    kept = []
+    for i in cand:
+        if all(_np_iou(boxes[i], boxes[k]) <= nms_th for k in kept):
+            kept.append(i)
+    return kept
+
+
+def test_multiclass_nms_single_class_matches_reference():
+    boxes = _rand_boxes(20)[None]             # [1, 20, 4]
+    scores = RNG.rand(1, 2, 20).astype(np.float32)
+    out = F.multiclass_nms(paddle.to_tensor(boxes), paddle.to_tensor(scores),
+                           score_threshold=0.3, nms_top_k=10, keep_top_k=10,
+                           nms_threshold=0.4, background_label=0)
+    o = out.numpy()
+    kept = _np_nms_single(boxes[0], scores[0, 1], 0.3, 0.4, 10)
+    assert o.shape == (len(kept), 6)
+    np.testing.assert_allclose(sorted(o[:, 1], reverse=True),
+                               sorted(scores[0, 1][kept], reverse=True),
+                               atol=1e-6)
+    assert (o[:, 0] == 1).all()
+
+
+def test_multiclass_nms_keep_top_k_and_labels():
+    boxes = _rand_boxes(30)[None]
+    scores = RNG.rand(1, 4, 30).astype(np.float32)
+    out, idx, cnt = F.multiclass_nms(
+        paddle.to_tensor(boxes), paddle.to_tensor(scores),
+        score_threshold=0.1, nms_top_k=20, keep_top_k=5, nms_threshold=0.5,
+        return_index=True, return_rois_num=True)
+    o = out.numpy()
+    assert o.shape[0] == 5 == int(cnt.numpy()[0])
+    assert (np.diff(o[:, 0]) >= 0).all()        # labels ascending
+    # index maps back to the right box
+    for r in range(o.shape[0]):
+        j = int(idx.numpy()[r, 0])
+        np.testing.assert_allclose(o[r, 2:], boxes[0, j], atol=1e-6)
+
+
+def test_multiclass_nms_empty_sentinel():
+    boxes = _rand_boxes(5)[None]
+    scores = np.zeros((1, 2, 5), np.float32)
+    out = F.multiclass_nms(paddle.to_tensor(boxes), paddle.to_tensor(scores),
+                           score_threshold=0.5, nms_top_k=5, keep_top_k=5)
+    np.testing.assert_allclose(out.numpy(), [[-1.0]])
+
+
+def test_multiclass_nms_eta_adapts_threshold():
+    # two boxes overlapping at iou=0.45: kept with nms_th=0.5; with
+    # eta=0.5 the threshold halves after the first keep, suppressing it
+    b = np.array([[0, 0, 10, 10], [0, 0, 10, 5.5]], np.float32)[None]
+    s = np.array([[[0.9, 0.8]]], np.float32).reshape(1, 1, 2)
+    both = F.multiclass_nms(paddle.to_tensor(b), paddle.to_tensor(s),
+                            0.1, 5, 5, nms_threshold=0.6,
+                            background_label=-1)
+    one = F.multiclass_nms(paddle.to_tensor(b), paddle.to_tensor(s),
+                           0.1, 5, 5, nms_threshold=0.6, nms_eta=0.5,
+                           background_label=-1)
+    assert both.numpy().shape[0] == 2
+    assert one.numpy().shape[0] == 1
+
+
+def test_matrix_nms_decay():
+    b = np.array([[0, 0, 10, 10], [0, 0, 10, 9], [30, 30, 40, 40]],
+                 np.float32)[None]
+    s = np.array([0.9, 0.8, 0.7], np.float32).reshape(1, 1, 3)
+    out, cnt = F.matrix_nms(paddle.to_tensor(b), paddle.to_tensor(s),
+                            score_threshold=0.1, post_threshold=0.0,
+                            nms_top_k=10, keep_top_k=10,
+                            background_label=-1)
+    o = out.numpy()
+    assert int(cnt.numpy()[0]) == 3
+    # top box keeps its score; near-duplicate decays by (1-iou); far box
+    # decays by ~1
+    # rows sorted by decayed score: 0.9, far box ~0.7, duplicate 0.8*(1-iou)
+    iou = _np_iou(b[0, 0], b[0, 1])
+    np.testing.assert_allclose(o[0, 1], 0.9, atol=1e-6)
+    np.testing.assert_allclose(o[1, 1], 0.7, atol=1e-4)
+    np.testing.assert_allclose(o[2, 1], 0.8 * (1 - iou), atol=1e-4)
+    # gaussian decay
+    outg, _ = F.matrix_nms(paddle.to_tensor(b), paddle.to_tensor(s),
+                           score_threshold=0.1, post_threshold=0.0,
+                           nms_top_k=10, keep_top_k=10, use_gaussian=True,
+                           gaussian_sigma=2.0, background_label=-1)
+    og = outg.numpy()
+    np.testing.assert_allclose(og[2, 1], 0.8 * np.exp(-(iou ** 2) * 2.0),
+                               atol=1e-4)
+
+
+def test_locality_aware_nms_merges():
+    b = np.array([[0, 0, 10, 10], [0.2, 0, 10.2, 10], [30, 30, 40, 40]],
+                 np.float32)[None]
+    s = np.array([0.6, 0.8, 0.9], np.float32).reshape(1, 1, 3)
+    out = F.locality_aware_nms(paddle.to_tensor(b), paddle.to_tensor(s),
+                               score_threshold=0.1, nms_top_k=10,
+                               keep_top_k=10, nms_threshold=0.5,
+                               background_label=-1)
+    o = out.numpy()
+    # first two merge (weighted by scores, summed score 1.4), far box kept
+    assert o.shape[0] == 2
+    assert np.isclose(o[0, 1], 1.4, atol=1e-5)
+    merged_x1 = (0 * 0.6 + 0.2 * 0.8) / 1.4
+    np.testing.assert_allclose(o[0, 2], merged_x1, atol=1e-5)
+
+
+def _np_yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample,
+                 clip_bbox, scale_x_y):
+    n, _, h, w = x.shape
+    an = len(anchors) // 2
+    bias = -0.5 * (scale_x_y - 1)
+    boxes = np.zeros((n, an * h * w, 4))
+    scores = np.zeros((n, an * h * w, class_num))
+    sig = lambda v: 1 / (1 + np.exp(-v))  # noqa: E731
+    v = x.reshape(n, an, 5 + class_num, h, w)
+    for i in range(n):
+        ih, iw = img_size[i]
+        for j in range(an):
+            for k in range(h):
+                for l in range(w):
+                    conf = sig(v[i, j, 4, k, l])
+                    pos = j * h * w + k * w + l
+                    if conf < conf_thresh:
+                        continue
+                    bx = (l + sig(v[i, j, 0, k, l]) * scale_x_y + bias) * iw / w
+                    by = (k + sig(v[i, j, 1, k, l]) * scale_x_y + bias) * ih / h
+                    bw = np.exp(v[i, j, 2, k, l]) * anchors[2*j] * iw / (
+                        downsample * w)
+                    bh = np.exp(v[i, j, 3, k, l]) * anchors[2*j+1] * ih / (
+                        downsample * h)
+                    box = [bx - bw/2, by - bh/2, bx + bw/2, by + bh/2]
+                    if clip_bbox:
+                        box = [max(box[0], 0), max(box[1], 0),
+                               min(box[2], iw - 1), min(box[3], ih - 1)]
+                    boxes[i, pos] = box
+                    scores[i, pos] = conf * sig(v[i, j, 5:, k, l])
+    return boxes, scores
+
+
+@pytest.mark.parametrize("scale_x_y", [1.0, 1.2])
+def test_yolo_box(scale_x_y):
+    anchors = [10, 13, 16, 30]
+    x = RNG.randn(2, 2 * 7, 3, 3).astype(np.float32)
+    img = np.array([[96, 128], [64, 64]], np.int32)
+    boxes, scores = F.yolo_box(paddle.to_tensor(x), paddle.to_tensor(img),
+                               anchors, 2, 0.4, 32, scale_x_y=scale_x_y)
+    rb, rs = _np_yolo_box(x, img, anchors, 2, 0.4, 32, True, scale_x_y)
+    np.testing.assert_allclose(boxes.numpy(), rb, atol=1e-4, rtol=1e-4)
+    np.testing.assert_allclose(scores.numpy(), rs, atol=1e-5, rtol=1e-4)
+
+
+def test_polygon_box_transform():
+    x = RNG.randn(1, 4, 3, 5).astype(np.float32)
+    out = F.polygon_box_transform(paddle.to_tensor(x)).numpy()
+    for c in range(4):
+        for hh in range(3):
+            for ww in range(5):
+                exp = (ww * 4 if c % 2 == 0 else hh * 4) - x[0, c, hh, ww]
+                np.testing.assert_allclose(out[0, c, hh, ww], exp, atol=1e-5)
+
+
+def test_generate_proposals():
+    h = w = 4
+    a = 3
+    anchors, var = F.anchor_generator(
+        paddle.to_tensor(np.zeros((1, 1, h, w), np.float32)),
+        anchor_sizes=[16.0], aspect_ratios=[0.5, 1.0, 2.0],
+        stride=[8.0, 8.0])
+    scores = RNG.rand(1, a, h, w).astype(np.float32)
+    deltas = (RNG.randn(1, 4 * a, h, w) * 0.1).astype(np.float32)
+    im_info = np.array([[32.0, 32.0, 1.0]], np.float32)
+    rois, num = F.generate_proposals(
+        paddle.to_tensor(scores), paddle.to_tensor(deltas),
+        paddle.to_tensor(im_info), anchors, var,
+        pre_nms_top_n=20, post_nms_top_n=10, nms_thresh=0.7, min_size=2.0,
+        return_rois_num=True)
+    r = rois.numpy()
+    assert r.shape[0] == int(num.numpy()[0]) <= 10
+    assert (r[:, 0] >= 0).all() and (r[:, 2] <= 31).all()
+    assert (r[:, 1] >= 0).all() and (r[:, 3] <= 31).all()
+    ws = r[:, 2] - r[:, 0] + 1
+    hs = r[:, 3] - r[:, 1] + 1
+    assert (ws >= 2).all() and (hs >= 2).all()
+    # kept boxes mutually below the NMS threshold
+    for i in range(len(r)):
+        for j in range(i + 1, len(r)):
+            assert _np_iou(r[i], r[j], normalized=False) <= 0.7 + 1e-6
+
+
+def test_distribute_and_collect_fpn():
+    rois = np.array([[0, 0, 10, 10],       # small -> low level
+                     [0, 0, 120, 120],     # medium
+                     [0, 0, 500, 500],     # large -> high level (scale>448)
+                     [0, 0, 15, 15]], np.float32)
+    outs, restore = F.distribute_fpn_proposals(
+        paddle.to_tensor(rois), 2, 5, 4, 224)
+    assert len(outs) == 4
+    sizes = [o.numpy().shape[0] for o in outs]
+    assert sum(sizes) == 4
+    # small rois land on level 2, large on 5
+    assert sizes[0] == 2 and sizes[-1] == 1
+    # restore index round-trips
+    cat = np.concatenate([o.numpy() for o in outs], 0)
+    np.testing.assert_allclose(cat[restore.numpy()[:, 0]], rois)
+
+    # with rois_num: per-level per-image counts
+    outs2, restore2, nums = F.distribute_fpn_proposals(
+        paddle.to_tensor(rois), 2, 5, 4, 224,
+        rois_num=paddle.to_tensor(np.array([3, 1], np.int32)))
+    assert [int(v.numpy().sum()) for v in nums] == sizes
+
+    # collect: top-2 by score, grouped by image
+    scores = [paddle.to_tensor(RNG.rand(int(s)).astype(np.float32))
+              for s in sizes]
+    merged, cnt = F.collect_fpn_proposals(
+        outs2, scores, 2, 5, post_nms_top_n=3, rois_num_per_level=nums)
+    assert merged.numpy().shape[0] == 3 == int(cnt.numpy().sum())
+
+
+def test_detection_output_shapes():
+    m = 6
+    prior = _rand_boxes(m) / 20.0
+    pvar = np.tile(np.array([0.1, 0.1, 0.2, 0.2], np.float32), (m, 1))
+    loc = (RNG.randn(2, m, 4) * 0.1).astype(np.float32)
+    conf = RNG.randn(2, m, 3).astype(np.float32)
+    out = F.detection_output(paddle.to_tensor(loc), paddle.to_tensor(conf),
+                             paddle.to_tensor(prior), paddle.to_tensor(pvar),
+                             score_threshold=0.01, nms_top_k=10, keep_top_k=5)
+    o = out.numpy()
+    assert o.ndim == 2 and o.shape[1] in (1, 6)
+    if o.shape[1] == 6:
+        assert set(np.unique(o[:, 0])).issubset({1.0, 2.0})

@@ -1,0 +1,566 @@
+"""CRF / sampled-softmax / legacy loss and layer functionals vs numpy
+references (reference: fluid/tests/unittests/test_linear_chain_crf_op.py,
+test_hsigmoid_op.py, test_nce.py, test_bpr_loss_op.py, ...)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+from op_test import check_grad
+
+RNG = np.random.RandomState(5)
+
+
+# --------------------------- CRF ------------------------------------------
+
+def _np_crf_nll(emit, label, trans, length):
+    """Direct enumeration over all tag paths (small D, T)."""
+    import itertools
+    d = emit.shape[-1]
+    start, stop, tw = trans[0], trans[1], trans[2:]
+    out = []
+    for b in range(emit.shape[0]):
+        n = int(length[b])
+        scores = []
+        for path in itertools.product(range(d), repeat=n):
+            s = start[path[0]] + emit[b, 0, path[0]]
+            for k in range(1, n):
+                s += tw[path[k-1], path[k]] + emit[b, k, path[k]]
+            s += stop[path[-1]]
+            scores.append(s)
+        logz = np.logaddexp.reduce(scores)
+        gold = start[label[b, 0]] + emit[b, 0, label[b, 0]]
+        for k in range(1, n):
+            gold += tw[label[b, k-1], label[b, k]] + emit[b, k, label[b, k]]
+        gold += stop[label[b, n-1]]
+        out.append(logz - gold)
+    return np.asarray(out)[:, None]
+
+
+def test_linear_chain_crf_matches_enumeration():
+    b, t, d = 2, 4, 3
+    emit = RNG.randn(b, t, d).astype(np.float32)
+    label = RNG.randint(0, d, (b, t)).astype(np.int64)
+    trans = (RNG.randn(d + 2, d) * 0.5).astype(np.float32)
+    length = np.array([4, 3], np.int64)
+    out = F.linear_chain_crf(paddle.to_tensor(emit), paddle.to_tensor(label),
+                             paddle.to_tensor(trans),
+                             paddle.to_tensor(length)).numpy()
+    ref = _np_crf_nll(emit, label, trans, length)
+    np.testing.assert_allclose(out, ref, atol=1e-4, rtol=1e-4)
+
+
+def test_linear_chain_crf_grad():
+    b, t, d = 1, 3, 3
+    emit = RNG.randn(b, t, d).astype(np.float32)
+    label = RNG.randint(0, d, (b, t)).astype(np.int64)
+    trans = (RNG.randn(d + 2, d) * 0.3).astype(np.float32)
+
+    lt = paddle.to_tensor(label)
+    check_grad(lambda e, tr: F.linear_chain_crf(e, lt, tr),
+               [emit, trans], atol=2e-2, rtol=2e-2)
+
+
+def test_crf_decoding_matches_brute_force():
+    import itertools
+    b, t, d = 2, 4, 3
+    emit = RNG.randn(b, t, d).astype(np.float32)
+    trans = (RNG.randn(d + 2, d) * 0.5).astype(np.float32)
+    length = np.array([4, 3], np.int64)
+    path = F.crf_decoding(paddle.to_tensor(emit), paddle.to_tensor(trans),
+                          length=paddle.to_tensor(length)).numpy()
+    start, stop, tw = trans[0], trans[1], trans[2:]
+    for bi in range(b):
+        n = int(length[bi])
+        best, best_s = None, -np.inf
+        for cand in itertools.product(range(d), repeat=n):
+            s = start[cand[0]] + emit[bi, 0, cand[0]]
+            for k in range(1, n):
+                s += tw[cand[k-1], cand[k]] + emit[bi, k, cand[k]]
+            s += stop[cand[-1]]
+            if s > best_s:
+                best, best_s = cand, s
+        np.testing.assert_array_equal(path[bi, :n], best)
+        assert (path[bi, n:] == 0).all()
+
+
+def test_crf_decoding_label_mask():
+    b, t, d = 1, 3, 4
+    emit = RNG.randn(b, t, d).astype(np.float32)
+    trans = (RNG.randn(d + 2, d) * 0.5).astype(np.float32)
+    gold = F.crf_decoding(paddle.to_tensor(emit), paddle.to_tensor(trans))
+    mask = F.crf_decoding(paddle.to_tensor(emit), paddle.to_tensor(trans),
+                          label=gold).numpy()
+    np.testing.assert_array_equal(mask, np.ones((b, t), np.int64))
+
+
+def test_crf_pairs_with_viterbi_decode():
+    # paddle.text.viterbi_decode (no start/stop) agrees with crf_decoding
+    # when start/stop rows are zero
+    from paddle_tpu.text import viterbi_decode
+    b, t, d = 2, 5, 3
+    emit = RNG.randn(b, t, d).astype(np.float32)
+    tw = RNG.randn(d, d).astype(np.float32)
+    trans = np.concatenate([np.zeros((2, d), np.float32), tw], 0)
+    p1 = F.crf_decoding(paddle.to_tensor(emit), paddle.to_tensor(trans))
+    _, p2 = viterbi_decode(paddle.to_tensor(emit), paddle.to_tensor(tw))
+    np.testing.assert_array_equal(p1.numpy(), np.asarray(p2.numpy()))
+
+
+# --------------------- hsigmoid / nce -------------------------------------
+
+def _np_hsigmoid_default(x, label, w, b, num_classes):
+    n = x.shape[0]
+    out = np.zeros((n, 1))
+    for i in range(n):
+        c = int(label[i]) + num_classes
+        L = c.bit_length() - 1
+        for j in range(L):
+            idx = (c >> (j + 1)) - 1
+            bit = (c >> j) & 1
+            pre = x[i] @ w[idx] + (b[idx] if b is not None else 0.0)
+            out[i, 0] += np.log1p(np.exp(pre)) - bit * pre
+    return out
+
+
+def test_hsigmoid_loss_default_tree():
+    n, d, c = 4, 5, 6
+    x = RNG.randn(n, d).astype(np.float32)
+    label = RNG.randint(0, c, (n, 1)).astype(np.int64)
+    w = (RNG.randn(c - 1, d) * 0.5).astype(np.float32)
+    b = (RNG.randn(c - 1) * 0.5).astype(np.float32)
+    out = F.hsigmoid_loss(paddle.to_tensor(x), paddle.to_tensor(label), c,
+                          paddle.to_tensor(w), paddle.to_tensor(b)).numpy()
+    ref = _np_hsigmoid_default(x, label.ravel(), w, b, c)
+    np.testing.assert_allclose(out, ref, atol=1e-4, rtol=1e-4)
+
+
+def test_hsigmoid_loss_custom_tree_and_grad():
+    n, d = 3, 4
+    x = RNG.randn(n, d).astype(np.float32)
+    label = np.zeros((n, 1), np.int64)
+    w = (RNG.randn(5, d) * 0.5).astype(np.float32)
+    table = np.array([[0, 2, -1], [1, 3, 4], [0, -1, -1]], np.int64)
+    code = np.array([[1, 0, 0], [0, 1, 1], [1, 0, 0]], np.int64)
+    out = F.hsigmoid_loss(paddle.to_tensor(x), paddle.to_tensor(label), 5,
+                          paddle.to_tensor(w), path_table=paddle.to_tensor(table),
+                          path_code=paddle.to_tensor(code)).numpy()
+    ref = np.zeros((n, 1))
+    for i in range(n):
+        for j in range(3):
+            if table[i, j] < 0:
+                continue
+            pre = x[i] @ w[table[i, j]]
+            ref[i, 0] += np.log1p(np.exp(pre)) - code[i, j] * pre
+    np.testing.assert_allclose(out, ref, atol=1e-4)
+
+    lt, tt, ct = (paddle.to_tensor(label), paddle.to_tensor(table),
+                  paddle.to_tensor(code))
+    check_grad(lambda xx, ww: F.hsigmoid_loss(xx, lt, 5, ww, path_table=tt,
+                                              path_code=ct),
+               [x, w], atol=2e-2, rtol=2e-2)
+
+
+def test_nce_uniform():
+    n, d, c, k = 3, 4, 8, 5
+    x = RNG.randn(n, d).astype(np.float32)
+    label = RNG.randint(0, c, (n, 1)).astype(np.int64)
+    w = (RNG.randn(c, d) * 0.3).astype(np.float32)
+    b = (RNG.randn(c) * 0.3).astype(np.float32)
+    out = F.nce(paddle.to_tensor(x), paddle.to_tensor(label), c,
+                paddle.to_tensor(w), paddle.to_tensor(b),
+                num_neg_samples=k, seed=7).numpy()
+    # reproduce sampling with the documented host RNG
+    negs = np.random.RandomState(7).randint(0, c, size=(n, k))
+    ref = np.zeros((n, 1))
+    sig = lambda v: 1 / (1 + np.exp(-v))  # noqa: E731
+    for i in range(n):
+        samples = [int(label[i, 0])] + list(negs[i])
+        for j, t in enumerate(samples):
+            o = sig(x[i] @ w[t] + b[t])
+            pb = (1.0 / c) * k
+            ref[i, 0] += -np.log(o / (o + pb)) if j == 0 else \
+                -np.log(pb / (o + pb))
+    np.testing.assert_allclose(out, ref, atol=1e-4, rtol=1e-4)
+
+
+# --------------------- metric losses --------------------------------------
+
+def test_bpr_loss():
+    n, d = 4, 6
+    x = RNG.randn(n, d).astype(np.float32)
+    label = RNG.randint(0, d, (n, 1)).astype(np.int64)
+    out = F.bpr_loss(paddle.to_tensor(x), paddle.to_tensor(label)).numpy()
+    ref = np.zeros((n, 1))
+    for i in range(n):
+        pos = int(label[i, 0])
+        s = sum(-np.log(1 + np.exp(x[i, j] - x[i, pos]))
+                for j in range(d) if j != pos)
+        ref[i, 0] = -s / (d - 1)
+    np.testing.assert_allclose(out, ref, atol=1e-4, rtol=1e-4)
+    check_grad(lambda xx: F.bpr_loss(xx, paddle.to_tensor(label)), [x],
+               atol=2e-2, rtol=2e-2)
+
+
+def test_center_loss_and_update():
+    n, d, c = 4, 3, 5
+    x = RNG.randn(n, d).astype(np.float32)
+    label = RNG.randint(0, c, (n,)).astype(np.int64)
+    centers0 = RNG.randn(c, d).astype(np.float32)
+    centers = paddle.to_tensor(centers0.copy())
+    out = F.center_loss(paddle.to_tensor(x), paddle.to_tensor(label), c,
+                        0.1, centers, update_center=True).numpy()
+    ref = 0.5 * ((x - centers0[label]) ** 2).sum(1, keepdims=True)
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+    # centers moved toward the class means (kernel center_loss_op.h update)
+    diff_acc = np.zeros((c, d)); counts = np.ones(c)
+    for i, l in enumerate(label):
+        diff_acc[l] += centers0[l] - x[i]; counts[l] += 1
+    expected = centers0 - 0.1 * diff_acc / counts[:, None]
+    np.testing.assert_allclose(centers.numpy(), expected, atol=1e-5)
+
+
+def test_npair_loss():
+    b, d = 4, 6
+    a = RNG.randn(b, d).astype(np.float32)
+    p = RNG.randn(b, d).astype(np.float32)
+    lbl = np.array([0, 1, 0, 2], np.int64)
+    out = float(F.npair_loss(paddle.to_tensor(a), paddle.to_tensor(p),
+                             paddle.to_tensor(lbl)).numpy())
+    sim = a @ p.T
+    tgt = (lbl[:, None] == lbl[None, :]).astype(np.float64)
+    tgt /= tgt.sum(1, keepdims=True)
+    logp = sim - np.log(np.exp(sim).sum(1, keepdims=True))
+    ce = -np.mean((tgt * logp).sum(1))
+    reg = ((a ** 2).sum() + (p ** 2).sum()) / b * 0.002 * 0.25
+    np.testing.assert_allclose(out, ce + reg, atol=1e-4)
+
+
+def test_dice_loss():
+    n, hw, c = 2, 5, 3
+    probs = np.abs(RNG.rand(n, hw, c)).astype(np.float32)
+    probs /= probs.sum(-1, keepdims=True)
+    label = RNG.randint(0, c, (n, hw, 1)).astype(np.int64)
+    out = float(F.dice_loss(paddle.to_tensor(probs),
+                            paddle.to_tensor(label)).numpy())
+    one_hot = np.eye(c)[label.squeeze(-1)]
+    inter = (probs * one_hot).sum((1, 2))
+    union = probs.sum((1, 2)) + one_hot.sum((1, 2))
+    ref = np.mean(1 - (2 * inter + 1e-5) / (union + 1e-5))
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+def test_smooth_l1():
+    n, d = 3, 4
+    x = RNG.randn(n, d).astype(np.float32)
+    y = RNG.randn(n, d).astype(np.float32)
+    iw = np.abs(RNG.rand(n, d)).astype(np.float32)
+    ow = np.abs(RNG.rand(n, d)).astype(np.float32)
+    sigma = 2.0
+    out = F.smooth_l1(paddle.to_tensor(x), paddle.to_tensor(y),
+                      paddle.to_tensor(iw), paddle.to_tensor(ow),
+                      sigma).numpy()
+    s2 = sigma ** 2
+    d_ = (x - y) * iw
+    ad = np.abs(d_)
+    val = np.where(ad < 1 / s2, 0.5 * d_ * d_ * s2, ad - 0.5 / s2) * ow
+    np.testing.assert_allclose(out, val.sum(1, keepdims=True), atol=1e-4,
+                               rtol=1e-4)
+
+
+def test_teacher_student_sigmoid_loss():
+    x = np.array([[0.5], [-0.3], [1.2], [0.1]], np.float32)
+    lbl = np.array([[-2.0], [-1.0], [0.4], [1.7]], np.float32)
+    out = F.teacher_student_sigmoid_loss(paddle.to_tensor(x),
+                                         paddle.to_tensor(lbl)).numpy()
+    def base(v): return max(v, 0) + np.log1p(np.exp(-abs(v)))
+    ref = np.array([
+        [base(0.5)],                                   # z=0, no teacher
+        [base(-0.3) - (-0.3)],                         # z=1, no teacher
+        [base(1.2) + base(1.2) - 1.2 * 0.4],           # z=0, z'=0.4
+        [base(0.1) - 0.1 + base(0.1) - 0.1 * 0.7],     # z=1, z'=0.7
+    ])
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+def test_warpctc_wraps_ctc():
+    T, B, C = 6, 2, 4
+    logits = RNG.randn(T, B, C).astype(np.float32)
+    labels = RNG.randint(1, C, (B, 3)).astype(np.int32)
+    in_len = np.array([6, 5], np.int32)
+    lbl_len = np.array([3, 2], np.int32)
+    out = F.warpctc(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                    input_length=paddle.to_tensor(in_len),
+                    label_length=paddle.to_tensor(lbl_len)).numpy()
+    assert out.shape == (B, 1)
+    ref = F.ctc_loss(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                     paddle.to_tensor(in_len), paddle.to_tensor(lbl_len),
+                     reduction="none").numpy()
+    np.testing.assert_allclose(out.ravel(), ref.ravel(), atol=1e-5)
+
+
+# ------------------ legacy layers-as-functions ----------------------------
+
+def test_fc():
+    x = RNG.randn(2, 3, 4).astype(np.float32)
+    w = RNG.randn(12, 5).astype(np.float32)
+    b = RNG.randn(5).astype(np.float32)
+    out = F.fc(paddle.to_tensor(x), 5, num_flatten_dims=1,
+               weight=paddle.to_tensor(w), bias=paddle.to_tensor(b)).numpy()
+    ref = x.reshape(2, 12) @ w + b
+    np.testing.assert_allclose(out, ref.reshape(2, 5), atol=1e-5)
+
+
+def test_bilinear_tensor_product():
+    x = RNG.randn(3, 4).astype(np.float32)
+    y = RNG.randn(3, 5).astype(np.float32)
+    w = RNG.randn(6, 4, 5).astype(np.float32)
+    out = F.bilinear_tensor_product(paddle.to_tensor(x), paddle.to_tensor(y),
+                                    paddle.to_tensor(w)).numpy()
+    ref = np.einsum("nd,kde,ne->nk", x, w, y)
+    np.testing.assert_allclose(out, ref, atol=1e-4, rtol=1e-4)
+
+
+def test_data_norm():
+    x = RNG.randn(4, 3).astype(np.float32)
+    bsz = np.full(3, 10.0, np.float32)
+    bsum = RNG.randn(3).astype(np.float32) * 10
+    bsq = (np.abs(RNG.randn(3)) * 10 + 10).astype(np.float32)
+    out = F.data_norm(paddle.to_tensor(x), batch_size=paddle.to_tensor(bsz),
+                      batch_sum=paddle.to_tensor(bsum),
+                      batch_square_sum=paddle.to_tensor(bsq)).numpy()
+    ref = (x - bsum / 10) / np.sqrt(bsq / 10 + 1e-4)
+    np.testing.assert_allclose(out, ref, atol=1e-3, rtol=1e-3)
+
+
+def test_spectral_norm():
+    w = RNG.randn(6, 8).astype(np.float32)
+    out = F.spectral_norm(paddle.to_tensor(w), power_iters=50).numpy()
+    sigma = np.linalg.svd(w, compute_uv=False)[0]
+    np.testing.assert_allclose(np.linalg.svd(out, compute_uv=False)[0],
+                               1.0, atol=1e-3)
+    np.testing.assert_allclose(out, w / sigma, atol=1e-3)
+
+
+def test_diag_embed():
+    x = RNG.randn(2, 3).astype(np.float32)
+    out = F.diag_embed(paddle.to_tensor(x)).numpy()
+    assert out.shape == (2, 3, 3)
+    for i in range(2):
+        np.testing.assert_allclose(out[i], np.diag(x[i]), atol=1e-6)
+    off = F.diag_embed(paddle.to_tensor(x), offset=1).numpy()
+    assert off.shape == (2, 4, 4)
+    np.testing.assert_allclose(off[0], np.diag(x[0], k=1), atol=1e-6)
+
+
+def test_soft_relu():
+    x = RNG.randn(3, 3).astype(np.float32) * 10
+    out = F.soft_relu(paddle.to_tensor(x), threshold=5.0).numpy()
+    ref = np.log1p(np.exp(np.clip(x, -5, 5)))
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+# ------------------ deformable conv ---------------------------------------
+
+def _np_deform_conv(x, off, msk, w, stride, pad, dil, dg):
+    n, c, h, wd = x.shape
+    co, cig, kh, kw = w.shape
+    oh = (h + 2 * pad - (dil * (kh - 1) + 1)) // stride + 1
+    ow = (wd + 2 * pad - (dil * (kw - 1) + 1)) // stride + 1
+    out = np.zeros((n, co, oh, ow))
+
+    def bil(img, y, xx):
+        if y <= -1 or y >= h or xx <= -1 or xx >= wd:
+            return 0.0
+        y0, x0 = int(np.floor(y)), int(np.floor(xx))
+        v = 0.0
+        for (yy, wy) in ((y0, 1 - (y - y0)), (y0 + 1, y - y0)):
+            for (xc, wx) in ((x0, 1 - (xx - x0)), (x0 + 1, xx - x0)):
+                if 0 <= yy < h and 0 <= xc < wd:
+                    v += img[yy, xc] * wy * wx
+        return v
+
+    cpg = c // dg
+    for b in range(n):
+        for o in range(co):
+            for i in range(oh):
+                for j in range(ow):
+                    acc = 0.0
+                    for ci in range(c):
+                        gidx = ci // cpg
+                        for ky in range(kh):
+                            for kx in range(kw):
+                                kk = ky * kw + kx
+                                dy = off[b, gidx, kk, 0, i, j]
+                                dx = off[b, gidx, kk, 1, i, j]
+                                y = i * stride - pad + ky * dil + dy
+                                xx = j * stride - pad + kx * dil + dx
+                                v = bil(x[b, ci], y, xx)
+                                if msk is not None:
+                                    v *= msk[b, gidx, kk, i, j]
+                                acc += v * w[o, ci, ky, kx]
+                    out[b, o, i, j] = acc
+    return out
+
+
+@pytest.mark.parametrize("modulated", [True, False])
+def test_deformable_conv(modulated):
+    n, c, h, wd = 1, 2, 5, 5
+    co, kh, kw = 3, 3, 3
+    dg = 1
+    x = RNG.randn(n, c, h, wd).astype(np.float32)
+    oh = ow = 5
+    off = (RNG.randn(n, dg, kh * kw, 2, oh, ow) * 0.5).astype(np.float32)
+    msk = np.abs(RNG.rand(n, dg * kh * kw, oh, ow)).astype(np.float32)
+    w = (RNG.randn(co, c, kh, kw) * 0.3).astype(np.float32)
+    out = F.deformable_conv(
+        paddle.to_tensor(x),
+        paddle.to_tensor(off.reshape(n, dg * kh * kw * 2, oh, ow)),
+        paddle.to_tensor(msk) if modulated else None,
+        co, (kh, kw), paddle.to_tensor(w), stride=1, padding=1,
+        modulated=modulated).numpy()
+    ref = _np_deform_conv(x, off, msk.reshape(n, dg, kh * kw, oh, ow)
+                          if modulated else None, w, 1, 1, 1, dg)
+    np.testing.assert_allclose(out, ref, atol=1e-4, rtol=1e-4)
+
+
+def test_deformable_conv_zero_offset_matches_conv():
+    x = RNG.randn(1, 2, 6, 6).astype(np.float32)
+    w = (RNG.randn(4, 2, 3, 3) * 0.3).astype(np.float32)
+    off = np.zeros((1, 18, 6, 6), np.float32)
+    out = F.deformable_conv(paddle.to_tensor(x), paddle.to_tensor(off), None,
+                            4, 3, paddle.to_tensor(w), padding=1,
+                            modulated=False).numpy()
+    import torch
+    ref = torch.nn.functional.conv2d(torch.tensor(x), torch.tensor(w),
+                                     padding=1).numpy()
+    np.testing.assert_allclose(out, ref, atol=1e-4, rtol=1e-4)
+
+
+def test_deformable_conv_grad():
+    x = RNG.randn(1, 1, 4, 4).astype(np.float32)
+    w = (RNG.randn(2, 1, 3, 3) * 0.3).astype(np.float32)
+    off = (RNG.randn(1, 18, 4, 4) * 0.3).astype(np.float32)
+    check_grad(lambda xx, oo, ww: F.deformable_conv(
+        xx, oo, None, 2, 3, ww, padding=1, modulated=False),
+        [x, off, w], atol=3e-2, rtol=3e-2)
+
+
+# ------------------ nn layer classes --------------------------------------
+
+def test_pairwise_distance():
+    import paddle_tpu.nn as nn
+    x = RNG.randn(4, 5).astype(np.float32)
+    y = RNG.randn(4, 5).astype(np.float32)
+    out = nn.PairwiseDistance(p=2.0)(paddle.to_tensor(x),
+                                     paddle.to_tensor(y)).numpy()
+    ref = ((np.abs(x - y) + 1e-6) ** 2).sum(1) ** 0.5
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+    inf = nn.PairwiseDistance(p=float("inf"), keepdim=True)(
+        paddle.to_tensor(x), paddle.to_tensor(y)).numpy()
+    np.testing.assert_allclose(inf, (np.abs(x - y) + 1e-6).max(1,
+                                                                keepdims=True),
+                               atol=1e-5)
+
+
+def test_hsigmoid_loss_layer_trains():
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as opt
+    layer = nn.HSigmoidLoss(feature_size=6, num_classes=8)
+    x = paddle.to_tensor(RNG.randn(16, 6).astype(np.float32))
+    lbl = paddle.to_tensor(RNG.randint(0, 8, (16, 1)).astype(np.int64))
+    o = opt.SGD(learning_rate=0.5, parameters=layer.parameters())
+    first = None
+    for _ in range(25):
+        loss = paddle.mean(layer(x, lbl))
+        loss.backward()
+        o.step(); o.clear_grad()
+        v = float(loss.numpy())
+        if first is None:
+            first = v
+    assert v < first, (first, v)
+
+
+def test_nce_loss_layer_shape():
+    import paddle_tpu.nn as nn
+    layer = nn.NCELoss(num_total_classes=12, dim=5, num_neg_samples=4, seed=3)
+    x = paddle.to_tensor(RNG.randn(6, 5).astype(np.float32))
+    lbl = paddle.to_tensor(RNG.randint(0, 12, (6, 1)).astype(np.int64))
+    out = layer(x, lbl)
+    assert tuple(out.shape) == (6, 1)
+    assert (out.numpy() > 0).all()
+
+
+def test_tree_conv():
+    import paddle_tpu.nn as nn
+    # tree: 1 -> (2, 3), 2 -> (4)
+    edges = np.array([[[1, 2], [1, 3], [2, 4], [0, 0]]], np.int32)
+    feats = RNG.randn(1, 4, 5).astype(np.float32)
+    layer = nn.TreeConv(feature_size=5, output_size=3, num_filters=2,
+                        max_depth=2, act=None, bias_attr=False)
+    out = layer(paddle.to_tensor(feats), paddle.to_tensor(edges))
+    assert tuple(out.shape) == (1, 4, 3, 2)
+    # node 3 (leaf, no children within depth): patch = itself only with
+    # eta_t = 1, eta_l = 0, eta_r = 0
+    w = layer.weight.numpy()          # [5, 3, out, nf]
+    ref_leaf = np.einsum("i,iof->of", feats[0, 2], w[:, 2])
+    np.testing.assert_allclose(out.numpy()[0, 2], ref_leaf, atol=1e-4,
+                               rtol=1e-4)
+    # node 1's patch includes children 2 and 3 at depth 1 (max_depth=2);
+    # tree2col.h: eta_t=(md-d)/md, eta_l=(1-eta_t)*(idx-1)/(pclen-1),
+    # eta_r=(1-eta_t)*(1-eta_l) — every entry contributes all three slots
+    def etas(index, pclen, depth, md=2.0):
+        eta_t = (md - depth) / md
+        tmp = 0.5 if pclen == 1 else (index - 1.0) / (pclen - 1.0)
+        eta_l = (1 - eta_t) * tmp
+        eta_r = (1 - eta_t) * (1 - eta_l)
+        return eta_l, eta_r, eta_t
+
+    patch = 0.0
+    for node, (index, pclen, depth) in ((0, (1, 1, 0)), (1, (1, 2, 1)),
+                                        (2, (2, 2, 1))):
+        el, er, et = etas(index, pclen, depth)
+        patch = patch + (
+            el * np.einsum("i,iof->of", feats[0, node], w[:, 0]) +
+            er * np.einsum("i,iof->of", feats[0, node], w[:, 1]) +
+            et * np.einsum("i,iof->of", feats[0, node], w[:, 2]))
+    np.testing.assert_allclose(out.numpy()[0, 0], patch, atol=1e-4, rtol=1e-4)
+
+
+def test_ctc_greedy_decoder():
+    import paddle_tpu.nn as nn
+    # [B=1, T=6, C=4], blank=0
+    probs = np.zeros((1, 6, 4), np.float32)
+    seq = [1, 1, 0, 2, 2, 3]
+    for t, s in enumerate(seq):
+        probs[0, t, s] = 1.0
+    dec, lens = nn.ctc_greedy_decoder(paddle.to_tensor(probs), blank=0,
+                                      padding_value=-1)
+    assert int(lens.numpy()[0, 0]) == 3
+    np.testing.assert_array_equal(dec.numpy()[0, :3], [1, 2, 3])
+    assert (dec.numpy()[0, 3:] == -1).all()
+
+
+def test_warpctc_norm_by_times_scales_grad_only():
+    T, B, C = 5, 2, 4
+    logits = RNG.randn(T, B, C).astype(np.float32)
+    labels = RNG.randint(1, C, (B, 2)).astype(np.int32)
+    in_len = np.array([5, 4], np.int32)
+    lbl_len = np.array([2, 2], np.int32)
+
+    def run(norm):
+        lt = paddle.to_tensor(logits.copy(), stop_gradient=False)
+        out = F.warpctc(lt, paddle.to_tensor(labels),
+                        input_length=paddle.to_tensor(in_len),
+                        label_length=paddle.to_tensor(lbl_len),
+                        norm_by_times=norm)
+        paddle.sum(out).backward()
+        return out.numpy(), np.asarray(lt.grad.numpy())
+
+    v0, g0 = run(False)
+    v1, g1 = run(True)
+    np.testing.assert_allclose(v0, v1, atol=1e-6)          # value unchanged
+    # grads scale by 1/T per sequence (batch dim 1 of [T, B, C])
+    np.testing.assert_allclose(g1[:, 0], g0[:, 0] / 5.0, atol=1e-6)
+    np.testing.assert_allclose(g1[:, 1], g0[:, 1] / 4.0, atol=1e-6)

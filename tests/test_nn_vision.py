@@ -355,3 +355,14 @@ def test_im2sequence_unsupported_args_raise():
     with pytest.raises(NotImplementedError):
         F.im2sequence(x, 2, 2, input_image_size=paddle.to_tensor(
             np.array([[4, 4]], np.float32)))
+
+
+def test_resize_nearest_align_corners_nhwc():
+    x = RNG.randn(1, 5, 6, 3).astype(np.float32)
+    out = F.resize_nearest(paddle.to_tensor(x), out_shape=[2, 2],
+                           align_corners=True, data_format="NHWC").numpy()
+    assert out.shape == (1, 2, 2, 3)
+    idx_h = np.floor(np.arange(2) * (4.0 / 1.0) + 0.5).astype(int)
+    idx_w = np.floor(np.arange(2) * (5.0 / 1.0) + 0.5).astype(int)
+    ref = x[:, np.clip(idx_h, 0, 4)][:, :, np.clip(idx_w, 0, 5)]
+    np.testing.assert_allclose(out, ref)
