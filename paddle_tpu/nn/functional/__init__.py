@@ -9,6 +9,10 @@ from .pooling import *       # noqa: F401,F403
 from .vision import *        # noqa: F401,F403
 from .detection import *     # noqa: F401,F403
 from .extension import *     # noqa: F401,F403
+from .sequence import *      # noqa: F401,F403
+from .array_ops import *     # noqa: F401,F403
+from .rnn_legacy import *    # noqa: F401,F403
+from .detection_tail import *  # noqa: F401,F403
 
 # re-export a few tensor ops that paddle exposes under nn.functional too
 from ...ops.manipulation import pad  # noqa: F401

@@ -1,0 +1,207 @@
+"""Tensor-array ops, legacy pool facades, PS-side utility ops.
+
+Reference surface: fluid/layers/control_flow.py — create_array:
+array_read, array_write, array_length; fluid/layers/tensor.py
+tensor_array_to_tensor; fluid/layers/nn.py — pool2d:?, pool3d,
+autoincreased_step_counter, hash (hash_op.cc), merge_selected_rows,
+continuous_value_model:13986 (kernel cvm_op.h), elu_/softmax_ inplace
+variants, erf.
+
+The reference's LoDTensorArray is an executor-scope list; eager python
+lists give identical semantics here (array_write grows the list, the
+static while_loop path in static/nn.py carries stacked tensors instead).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor, apply
+from ...ops.math import erf  # noqa: F401  (legacy re-export)
+from .activation import elu, softmax
+from .pooling import (avg_pool2d, avg_pool3d, max_pool2d, max_pool3d)
+
+__all__ = [
+    "create_array", "array_read", "array_write", "array_length",
+    "tensor_array_to_tensor", "autoincreased_step_counter", "hash",
+    "merge_selected_rows", "continuous_value_model", "pool2d", "pool3d",
+    "elu_", "softmax_", "erf",
+]
+
+
+def create_array(dtype="float32", initialized_list=None):
+    """LoDTensorArray analog: a python list of Tensors
+    (fluid/layers/control_flow.py create_array)."""
+    out = []
+    if initialized_list:
+        for v in initialized_list:
+            out.append(v if isinstance(v, Tensor) else Tensor(jnp.asarray(v)))
+    return out
+
+
+def _idx(i):
+    if isinstance(i, Tensor):
+        return int(np.asarray(i.numpy()).reshape(()))
+    return int(i)
+
+
+def array_write(x, i, array=None):
+    """array[i] = x, growing the list as needed (control_flow.py
+    array_write)."""
+    if array is None:
+        array = []
+    i = _idx(i)
+    while len(array) <= i:
+        array.append(None)
+    array[i] = x
+    return array
+
+
+def array_read(array, i):
+    return array[_idx(i)]
+
+
+def array_length(array):
+    return Tensor(jnp.asarray(np.int64(len(array))))
+
+
+def tensor_array_to_tensor(input, axis=1, use_stack=False, name=None):
+    """Concat or stack the array back into one tensor
+    (fluid/layers/tensor.py tensor_array_to_tensor). Returns (tensor,
+    per-element sizes along axis)."""
+    tensors = [t for t in input if t is not None]
+    sizes = np.asarray(
+        [1 if use_stack else int(t.shape[axis]) for t in tensors], np.int64)
+
+    def f(*xs):
+        if use_stack:
+            return jnp.stack(xs, axis=axis)
+        return jnp.concatenate(xs, axis=axis)
+    return (apply(f, *tensors, op_name="tensor_array_to_tensor"),
+            Tensor(jnp.asarray(sizes)))
+
+
+class _StepCounter:
+    def __init__(self):
+        self.counters = {}
+
+
+_STEP = _StepCounter()
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """Global step counter incremented per call
+    (fluid/layers/nn.py autoincreased_step_counter; the reference
+    increments a persistable variable per executor run)."""
+    key = counter_name or "@STEP_COUNTER@"
+    cur = _STEP.counters.get(key)
+    if cur is None:
+        cur = int(begin)
+    else:
+        cur += int(step)
+    _STEP.counters[key] = cur
+    return Tensor(jnp.asarray(np.int64(cur)))
+
+
+def hash(input, hash_size, num_hash=1, name=None):
+    """Hash int ids into [0, hash_size) with num_hash independent hashes
+    (fluid/layers/nn.py hash; kernel hash_op.h uses XXH64 with seed =
+    hash index). Same shape contract: [N, 1] int -> [N, num_hash, 1].
+    Deterministic splitmix64-style mixing stands in for XXH64 — same
+    distributional behavior, documented non-bit-exact."""
+    hs = int(hash_size)
+    nh = int(num_hash)
+
+    def f(x):
+        v = x.reshape(x.shape[0], -1).astype(jnp.uint64)
+        seeds = jnp.arange(1, nh + 1, dtype=jnp.uint64)[None, :, None]
+        h = v[:, None, :] * jnp.uint64(0x9E3779B97F4A7C15) + seeds
+        h = (h ^ (h >> 30)) * jnp.uint64(0xBF58476D1CE4E5B9)
+        h = (h ^ (h >> 27)) * jnp.uint64(0x94D049BB133111EB)
+        h = h ^ (h >> 31)
+        # combine the row's columns like the reference hashes the whole row
+        h = h.sum(axis=2) % jnp.uint64(hs)
+        return h.astype(jnp.int64)[:, :, None]
+    return apply(f, input, op_name="hash")
+
+
+def merge_selected_rows(x, name=None):
+    """Sum rows with duplicate ids (fluid merge_selected_rows over
+    core/selected_rows.py SelectedRows)."""
+    from ...core.selected_rows import SelectedRows
+    if not isinstance(x, SelectedRows):
+        raise TypeError("merge_selected_rows expects a SelectedRows")
+    rows = np.asarray(x.rows, np.int64)
+    vals = np.asarray(x.value.numpy() if isinstance(x.value, Tensor)
+                      else x.value)
+    uniq, inv = np.unique(rows, return_inverse=True)
+    out = np.zeros((len(uniq),) + vals.shape[1:], vals.dtype)
+    np.add.at(out, inv, vals)
+    return SelectedRows(uniq, out, x.height)
+
+
+def continuous_value_model(input, cvm, use_cvm=True):
+    """CTR show/click feature transform (fluid/layers/nn.py:13986;
+    kernel cvm_op.h): use_cvm keeps width and rewrites cols 0/1 to
+    log(show+1) and log(click+1)-log(show+1); otherwise drops both."""
+    def f(x, _cvm):
+        if use_cvm:
+            c0 = jnp.log(x[:, :1] + 1)
+            c1 = jnp.log(x[:, 1:2] + 1) - c0
+            return jnp.concatenate([c0, c1, x[:, 2:]], axis=1)
+        return x[:, 2:]
+    return apply(f, input, cvm, op_name="continuous_value_model")
+
+
+def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, name=None, exclusive=True,
+           data_format="NCHW"):
+    """Legacy pool facade (fluid pool2d) over the v2 pooling ops."""
+    if global_pooling or pool_size == -1:
+        pool_size = (input.shape[2:4] if data_format == "NCHW"
+                     else input.shape[1:3])
+        pool_size = [int(v) for v in pool_size]
+        pool_stride = pool_size
+        pool_padding = 0
+    if pool_type == "max":
+        return max_pool2d(input, pool_size, pool_stride, pool_padding,
+                          ceil_mode=ceil_mode, data_format=data_format)
+    return avg_pool2d(input, pool_size, pool_stride, pool_padding,
+                      ceil_mode=ceil_mode, exclusive=exclusive,
+                      data_format=data_format)
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, name=None, exclusive=True,
+           data_format="NCDHW"):
+    """Legacy pool facade (fluid pool3d)."""
+    if global_pooling or pool_size == -1:
+        pool_size = (input.shape[2:5] if data_format == "NCDHW"
+                     else input.shape[1:4])
+        pool_size = [int(v) for v in pool_size]
+        pool_stride = pool_size
+        pool_padding = 0
+    if pool_type == "max":
+        return max_pool3d(input, pool_size, pool_stride, pool_padding,
+                          ceil_mode=ceil_mode, data_format=data_format)
+    return avg_pool3d(input, pool_size, pool_stride, pool_padding,
+                      ceil_mode=ceil_mode, exclusive=exclusive,
+                      data_format=data_format)
+
+
+def elu_(x, alpha=1.0, name=None):
+    """In-place elu (reference elu_): same math; the tape framework has
+    no aliasing, so this rebinds the caller's tensor value."""
+    out = elu(x, alpha)
+    if isinstance(x, Tensor):
+        x.set_value(np.asarray(out.numpy()))
+    return out
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    out = softmax(x, axis, dtype)
+    if isinstance(x, Tensor):
+        x.set_value(np.asarray(out.numpy()))
+    return out

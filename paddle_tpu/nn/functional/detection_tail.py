@@ -1,0 +1,801 @@
+"""RCNN/RetinaNet/YOLO training-side ops and the remaining roi pooling
+variants.
+
+Reference surface: fluid/layers/detection.py — retinanet_target_assign:70,
+rpn_target_assign:311, multi_box_head:2106, generate_proposal_labels:2596,
+generate_mask_labels:2748, retinanet_detection_output:3106, yolov3_loss:
+1004; fluid/layers/nn.py — deformable_roi_pooling:14577,
+roi_perspective_transform (nn.py), filter_by_instag:10115.
+
+Split as elsewhere: the differentiable math (yolov3_loss,
+deformable_roi_pooling, roi_perspective_transform) is jnp; the sampling /
+target-assignment stages whose outputs are data-dependent subsets run
+host-side in numpy exactly like the reference CPU kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor, apply
+from .detection import _jaccard, _nms_fast, prior_box
+
+__all__ = [
+    "yolov3_loss", "rpn_target_assign", "retinanet_target_assign",
+    "retinanet_detection_output", "generate_proposal_labels",
+    "generate_mask_labels", "multi_box_head", "deformable_roi_pooling",
+    "roi_perspective_transform", "filter_by_instag",
+]
+
+
+def _np(x):
+    return np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+
+
+# ---------------------------------------------------------------------------
+# yolov3 loss (differentiable)
+# ---------------------------------------------------------------------------
+
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, gt_score=None,
+                use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 training loss (detection.py:1004; kernel yolov3_loss_op.h).
+
+    x [N, M*(5+C), H, W]; gt_box [N, B, 4] normalized (cx, cy, w, h);
+    gt_label [N, B] int; gt_score [N, B] mixup weights (default 1).
+    Per the kernel: each gt matches its best shape-IoU anchor over the
+    FULL anchor list; only matches whose anchor is in anchor_mask produce
+    location (sce x/y + l1 w/h, scaled by (2 - w*h) * score), class (sce
+    with label smoothing) and positive-objectness losses; predictions
+    whose best gt IoU exceeds ignore_thresh drop out of the negative
+    objectness term. Returns loss [N]."""
+    anchors = [int(a) for a in anchors]
+    mask = [int(m) for m in anchor_mask]
+    an_num = len(anchors) // 2
+    m_num = len(mask)
+    cnum = int(class_num)
+    scale = float(scale_x_y)
+    bias = -0.5 * (scale - 1.0)
+    pos = 1.0 - 1.0 / cnum if use_label_smooth else 1.0
+    neg = 1.0 / cnum if use_label_smooth else 0.0
+    # anchor index -> position in mask (-1 if unmasked)
+    lut = np.full(an_num, -1, np.int32)
+    for i, a in enumerate(mask):
+        lut[a] = i
+
+    def sce(logit, label):
+        return (jnp.maximum(logit, 0.0) - logit * label +
+                jnp.log1p(jnp.exp(-jnp.abs(logit))))
+
+    def f(xx, gtb, gtl, gts):
+        n, _, h, w = xx.shape
+        input_size = int(downsample_ratio) * h
+        v = xx.reshape(n, m_num, 5 + cnum, h, w)
+        valid = (gtb[..., 2] > 0) & (gtb[..., 3] > 0)          # [N, B]
+
+        # --- objectness ignore mask: best IoU of each pred vs gts ------
+        aw = jnp.asarray([anchors[2 * m] for m in mask],
+                         xx.dtype)[None, :, None, None]
+        ah = jnp.asarray([anchors[2 * m + 1] for m in mask],
+                         xx.dtype)[None, :, None, None]
+        gx = jnp.arange(w, dtype=xx.dtype)[None, None, None, :]
+        gy = jnp.arange(h, dtype=xx.dtype)[None, None, :, None]
+        px = (gx + jax.nn.sigmoid(v[:, :, 0]) * scale + bias) / w
+        py = (gy + jax.nn.sigmoid(v[:, :, 1]) * scale + bias) / h
+        pw = jnp.exp(v[:, :, 2]) * aw / input_size
+        phh = jnp.exp(v[:, :, 3]) * ah / input_size
+
+        def iou_cwh(x1, y1, w1, h1, x2, y2, w2, h2):
+            ov_w = (jnp.minimum(x1 + w1 / 2, x2 + w2 / 2) -
+                    jnp.maximum(x1 - w1 / 2, x2 - w2 / 2))
+            ov_h = (jnp.minimum(y1 + h1 / 2, y2 + h2 / 2) -
+                    jnp.maximum(y1 - h1 / 2, y2 - h2 / 2))
+            inter = jnp.where((ov_w > 0) & (ov_h > 0), ov_w * ov_h, 0.0)
+            return inter / (w1 * h1 + w2 * h2 - inter + 1e-10)
+
+        # preds [N, M, H, W] vs gts [N, B] -> best over B
+        ious = iou_cwh(px[..., None], py[..., None], pw[..., None],
+                       phh[..., None],
+                       gtb[:, None, None, None, :, 0],
+                       gtb[:, None, None, None, :, 1],
+                       gtb[:, None, None, None, :, 2],
+                       gtb[:, None, None, None, :, 3])
+        ious = jnp.where(valid[:, None, None, None, :], ious, 0.0)
+        best_iou = ious.max(axis=-1)                           # [N, M, H, W]
+        obj_mask = jnp.where(best_iou > ignore_thresh, -1.0, 0.0)
+
+        # --- per-gt positive assignment --------------------------------
+        gi = jnp.clip((gtb[..., 0] * w).astype(jnp.int32), 0, w - 1)
+        gj = jnp.clip((gtb[..., 1] * h).astype(jnp.int32), 0, h - 1)
+        an_w = jnp.asarray(anchors[0::2], xx.dtype) / input_size   # [A]
+        an_h = jnp.asarray(anchors[1::2], xx.dtype) / input_size
+        shape_iou = iou_cwh(0.0, 0.0, an_w[None, None, :],
+                            an_h[None, None, :],
+                            0.0, 0.0, gtb[..., None, 2], gtb[..., None, 3])
+        best_n = jnp.argmax(shape_iou, axis=-1)                 # [N, B]
+        mask_idx = jnp.asarray(lut)[best_n]                     # [N, B]
+        is_pos = valid & (mask_idx >= 0)
+        mi = jnp.clip(mask_idx, 0, m_num - 1)
+
+        score = gts if gts is not None else jnp.ones_like(gtb[..., 0])
+        loc_scale = (2.0 - gtb[..., 2] * gtb[..., 3]) * score    # [N, B]
+
+        bidx = jnp.arange(n)[:, None]
+        # gather the matched cell's raw outputs [N, B, 5+C]
+        cell = v[bidx, mi, :, gj, gi]
+        tx = gtb[..., 0] * w - gi.astype(xx.dtype)
+        ty = gtb[..., 1] * h - gj.astype(xx.dtype)
+        an_w_best = jnp.take(jnp.asarray(anchors[0::2], xx.dtype), best_n)
+        an_h_best = jnp.take(jnp.asarray(anchors[1::2], xx.dtype), best_n)
+        tw = jnp.log(jnp.clip(gtb[..., 2] * input_size / an_w_best,
+                              1e-9, None))
+        th = jnp.log(jnp.clip(gtb[..., 3] * input_size / an_h_best,
+                              1e-9, None))
+        loc = (sce(cell[..., 0], tx) + sce(cell[..., 1], ty) +
+               jnp.abs(cell[..., 2] - tw) + jnp.abs(cell[..., 3] - th))
+        loc = loc * loc_scale
+        cls_tgt = jnp.where(
+            jax.nn.one_hot(gtl, cnum, dtype=xx.dtype) > 0, pos, neg)
+        cls = (sce(cell[..., 5:], cls_tgt).sum(-1)) * score
+        per_gt = jnp.where(is_pos, loc + cls, 0.0)
+        loss = per_gt.sum(axis=1)                                # [N]
+
+        # positive objectness: scatter score into obj_mask at matched
+        # cells; non-positive (padding) gts route to a dummy anchor slot
+        # so they cannot clobber a real positive at the same cell
+        mi_safe = jnp.where(is_pos, mi, m_num)
+        padded = jnp.concatenate(
+            [obj_mask, jnp.zeros_like(obj_mask[:, :1])], axis=1)
+        padded = padded.at[bidx, mi_safe, gj, gi].set(
+            jnp.where(is_pos, score, padded[bidx, mi_safe, gj, gi]))
+        obj_mask = padded[:, :m_num]
+        obj_logit = v[:, :, 4]
+        pos_term = jnp.where(obj_mask > 1e-5,
+                             sce(obj_logit, 1.0) * obj_mask, 0.0)
+        neg_term = jnp.where((obj_mask <= 1e-5) & (obj_mask > -0.5),
+                             sce(obj_logit, 0.0), 0.0)
+        loss = loss + (pos_term + neg_term).sum(axis=(1, 2, 3))
+        return loss
+    args = [x, gt_box, gt_label]
+    if gt_score is not None:
+        args.append(gt_score)
+        return apply(lambda a, b, c, d: f(a, b, c, d), *args,
+                     op_name="yolov3_loss")
+    return apply(lambda a, b, c: f(a, b, c, None), *args,
+                 op_name="yolov3_loss")
+
+
+# ---------------------------------------------------------------------------
+# RPN / RCNN target sampling (host-side)
+# ---------------------------------------------------------------------------
+
+def _iou_matrix(a, b):
+    """Vectorized pairwise IoU with the +1 pixel convention
+    (a [N, 4] x b [M, 4] -> [N, M])."""
+    ix1 = np.maximum(a[:, None, 0], b[None, :, 0])
+    iy1 = np.maximum(a[:, None, 1], b[None, :, 1])
+    ix2 = np.minimum(a[:, None, 2], b[None, :, 2])
+    iy2 = np.minimum(a[:, None, 3], b[None, :, 3])
+    iw = np.maximum(ix2 - ix1 + 1, 0.0)
+    ih = np.maximum(iy2 - iy1 + 1, 0.0)
+    inter = iw * ih
+    area_a = (a[:, 2] - a[:, 0] + 1) * (a[:, 3] - a[:, 1] + 1)
+    area_b = (b[:, 2] - b[:, 0] + 1) * (b[:, 3] - b[:, 1] + 1)
+    union = area_a[:, None] + area_b[None, :] - inter
+    return np.where(union > 0, inter / np.maximum(union, 1e-12), 0.0)
+
+
+def _encode_pairs(anchors, var, gt):
+    """Per-row box_coder encode (anchor i vs gt i), +1 convention —
+    avoids the [N, N, 4] cross product for large fg sets."""
+    aw = anchors[:, 2] - anchors[:, 0] + 1
+    ah = anchors[:, 3] - anchors[:, 1] + 1
+    ax = anchors[:, 0] + aw / 2
+    ay = anchors[:, 1] + ah / 2
+    gx = (gt[:, 0] + gt[:, 2]) / 2
+    gy = (gt[:, 1] + gt[:, 3]) / 2
+    gw = gt[:, 2] - gt[:, 0] + 1
+    gh = gt[:, 3] - gt[:, 1] + 1
+    out = np.stack([(gx - ax) / aw, (gy - ay) / ah,
+                    np.log(np.abs(gw / aw)), np.log(np.abs(gh / ah))], 1)
+    return (out / var).astype(np.float32)
+
+
+def _anchor_gt_assign(anchors, gt, pos_ovl, neg_ovl):
+    """Labels per anchor: 1 fg (best-per-gt or IoU >= pos), 0 bg
+    (max IoU < neg), -1 ignore; returns labels, matched gt index,
+    max overlap."""
+    na = anchors.shape[0]
+    labels = np.full(na, -1, np.int64)
+    if len(gt) == 0:
+        labels[:] = 0
+        return labels, np.zeros(na, np.int64), np.zeros(na)
+    iou = _iou_matrix(anchors, gt)
+    argmax = iou.argmax(axis=1)
+    mx = iou.max(axis=1)
+    labels[mx < neg_ovl] = 0
+    # every gt's best anchor is positive (Faster-RCNN rule) — but a gt
+    # overlapping nothing (best == 0) must not match everything
+    best_per_gt = iou.max(axis=0)
+    for j in range(len(gt)):
+        if best_per_gt[j] > 0:
+            labels[np.where(iou[:, j] == best_per_gt[j])[0]] = 1
+    labels[mx >= pos_ovl] = 1
+    return labels, argmax, mx
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                      gt_boxes, is_crowd, im_info,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True):
+    """RPN training targets (detection.py:311; kernel
+    rpn_target_assign_op.cc): drop straddling anchors, IoU-assign
+    fg/bg, subsample to batch_size*fg_fraction positives, encode matched
+    gt boxes against anchors. Single image (the reference batches via
+    LoD). Returns (pred_scores, pred_location, target_label, target_bbox,
+    bbox_inside_weight)."""
+    anchors = _np(anchor_box).reshape(-1, 4).astype(np.float64)
+    var = _np(anchor_var).reshape(-1, 4).astype(np.float64)
+    gt = _np(gt_boxes).reshape(-1, 4).astype(np.float64)
+    crowd = _np(is_crowd).reshape(-1).astype(bool) if is_crowd is not None \
+        else np.zeros(len(gt), bool)
+    info = _np(im_info).reshape(-1)
+    bp = _np(bbox_pred).reshape(-1, 4)
+    cl = _np(cls_logits).reshape(-1, 1)
+    gt = gt[~crowd]
+
+    im_h, im_w = info[0], info[1]
+    if rpn_straddle_thresh >= 0:
+        inside = ((anchors[:, 0] >= -rpn_straddle_thresh) &
+                  (anchors[:, 1] >= -rpn_straddle_thresh) &
+                  (anchors[:, 2] < im_w + rpn_straddle_thresh) &
+                  (anchors[:, 3] < im_h + rpn_straddle_thresh))
+        idx = np.where(inside)[0]
+    else:
+        idx = np.arange(len(anchors))
+    labels, argmax, _ = _anchor_gt_assign(anchors[idx], gt,
+                                          rpn_positive_overlap,
+                                          rpn_negative_overlap)
+    rng = np.random.RandomState(0 if not use_random else None)
+    fg_cnt = int(rpn_batch_size_per_im * rpn_fg_fraction)
+    fg = np.where(labels == 1)[0]
+    if len(fg) > fg_cnt:
+        drop = rng.choice(fg, len(fg) - fg_cnt, replace=False) \
+            if use_random else fg[fg_cnt:]
+        labels[drop] = -1
+        fg = np.where(labels == 1)[0]
+    bg_cnt = rpn_batch_size_per_im - len(fg)
+    bg = np.where(labels == 0)[0]
+    if len(bg) > bg_cnt:
+        drop = rng.choice(bg, len(bg) - bg_cnt, replace=False) \
+            if use_random else bg[bg_cnt:]
+        labels[drop] = -1
+        bg = np.where(labels == 0)[0]
+
+    keep = np.concatenate([fg, bg])
+    loc_idx = idx[fg]
+    score_idx = idx[keep]
+    tgt_lbl = (labels[keep] == 1).astype(np.int32)[:, None]
+    if len(gt) and len(fg):
+        tgt_bbox = _encode_pairs(anchors[loc_idx], var[loc_idx],
+                                 gt[argmax[fg]])
+    else:
+        tgt_bbox = np.zeros((0, 4), np.float32)
+    inside_w = np.ones_like(tgt_bbox)
+    return (Tensor(jnp.asarray(cl[score_idx])),
+            Tensor(jnp.asarray(bp[loc_idx])),
+            Tensor(jnp.asarray(tgt_lbl)),
+            Tensor(jnp.asarray(tgt_bbox.astype(np.float32))),
+            Tensor(jnp.asarray(inside_w.astype(np.float32))))
+
+
+def retinanet_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                            gt_boxes, gt_labels, is_crowd, im_info,
+                            num_classes=1, positive_overlap=0.5,
+                            negative_overlap=0.4):
+    """RetinaNet targets (detection.py:70): no subsampling; every anchor
+    is fg (IoU >= pos), bg (IoU < neg) or ignored; classification target
+    is the one-hot class (bg rows all-zero). Returns (pred_scores,
+    pred_location, target_label, target_bbox, bbox_inside_weight,
+    fg_num)."""
+    anchors = _np(anchor_box).reshape(-1, 4).astype(np.float64)
+    var = _np(anchor_var).reshape(-1, 4).astype(np.float64)
+    gt = _np(gt_boxes).reshape(-1, 4).astype(np.float64)
+    gl = _np(gt_labels).reshape(-1).astype(np.int64)
+    crowd = _np(is_crowd).reshape(-1).astype(bool) if is_crowd is not None \
+        else np.zeros(len(gt), bool)
+    bp = _np(bbox_pred).reshape(-1, 4)
+    cl = _np(cls_logits).reshape(len(anchors), -1)
+    gt, gl = gt[~crowd], gl[~crowd]
+
+    labels, argmax, _ = _anchor_gt_assign(anchors, gt, positive_overlap,
+                                          negative_overlap)
+    fg = np.where(labels == 1)[0]
+    keep = np.where(labels >= 0)[0]
+    tgt_lbl = np.zeros((len(keep), 1), np.int32)
+    # target label: class id (1..num_classes) for fg rows, 0 for bg
+    fg_pos = {a: i for i, a in enumerate(keep)}
+    for a in fg:
+        tgt_lbl[fg_pos[a], 0] = int(gl[argmax[a]])
+    if len(fg):
+        tgt_bbox = _encode_pairs(anchors[fg], var[fg], gt[argmax[fg]])
+    else:
+        tgt_bbox = np.zeros((0, 4), np.float32)
+    fg_num = np.array([[len(fg) + 1]], np.int32)   # reference adds 1
+    return (Tensor(jnp.asarray(cl[keep])),
+            Tensor(jnp.asarray(bp[fg])),
+            Tensor(jnp.asarray(tgt_lbl)),
+            Tensor(jnp.asarray(tgt_bbox.astype(np.float32))),
+            Tensor(jnp.asarray(np.ones_like(tgt_bbox, np.float32))),
+            Tensor(jnp.asarray(fg_num)))
+
+
+def retinanet_detection_output(bboxes, scores, anchors, im_info,
+                               score_threshold=0.05, nms_top_k=1000,
+                               keep_top_k=100, nms_threshold=0.3,
+                               nms_eta=1.0):
+    """RetinaNet inference (detection.py:3106; kernel
+    retinanet_detection_output_op.cc): per level, keep at most nms_top_k
+    above-threshold (anchor, class) pairs, decode against anchors (+1
+    widths, no variance), clip to round(im/scale); merge levels and run
+    per-class NMS, keep_top_k overall. Single image. Returns rows
+    [label, score, x1, y1, x2, y2]."""
+    info = _np(im_info).reshape(-1)
+    im_h, im_w, sc_ = info[0], info[1], info[2]
+    ih = round(float(im_h) / sc_)
+    iw = round(float(im_w) / sc_)
+    dec_all, sc_all, cls_all = [], [], []
+    for lvl in range(len(bboxes)):
+        d = _np(bboxes[lvl]).reshape(-1, 4).astype(np.float64)
+        s = _np(scores[lvl]).reshape(d.shape[0], -1).astype(np.float64)
+        a = _np(anchors[lvl]).reshape(-1, 4).astype(np.float64)
+        flat = s.ravel()
+        cand = np.where(flat > score_threshold)[0]
+        cand = cand[np.argsort(-flat[cand], kind="stable")][:nms_top_k]
+        rows = cand // s.shape[1]
+        cls = cand % s.shape[1]
+        aw = a[rows, 2] - a[rows, 0] + 1
+        ah = a[rows, 3] - a[rows, 1] + 1
+        acx = a[rows, 0] + aw / 2
+        acy = a[rows, 1] + ah / 2
+        cx = d[rows, 0] * aw + acx
+        cy = d[rows, 1] * ah + acy
+        w = np.exp(d[rows, 2]) * aw
+        h = np.exp(d[rows, 3]) * ah
+        box = np.stack([cx - w / 2, cy - h / 2,
+                        cx + w / 2 - 1, cy + h / 2 - 1], 1)
+        box[:, 0::2] = np.clip(box[:, 0::2], 0, iw - 1)
+        box[:, 1::2] = np.clip(box[:, 1::2], 0, ih - 1)
+        dec_all.append(box)
+        sc_all.append(flat[cand])
+        cls_all.append(cls)
+    box = np.concatenate(dec_all) if dec_all else np.zeros((0, 4))
+    scr = np.concatenate(sc_all) if sc_all else np.zeros(0)
+    cls = np.concatenate(cls_all) if cls_all else np.zeros(0, int)
+    out_rows = []
+    for c in np.unique(cls):
+        sel_idx = np.where(cls == c)[0]
+        kept = _nms_fast(box[sel_idx], scr[sel_idx], -np.inf, nms_threshold,
+                         nms_eta, -1, False)
+        for k in kept:
+            i = sel_idx[k]
+            out_rows.append([c + 1, scr[i]] + list(box[i]))
+    out_rows.sort(key=lambda r: -r[1])
+    out_rows = out_rows[:keep_top_k]
+    if not out_rows:
+        return Tensor(jnp.zeros((0, 6), jnp.float32))
+    return Tensor(jnp.asarray(np.asarray(out_rows, np.float32)))
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info, batch_size_per_im=256,
+                             fg_fraction=0.25, fg_thresh=0.25,
+                             bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             class_nums=None, use_random=True,
+                             is_cls_agnostic=False, is_cascade_rcnn=False,
+                             max_overlap=None, return_max_overlap=False):
+    """Sample RCNN-head rois + regression targets (detection.py:2596;
+    kernel generate_proposal_labels_op.cc). Single image. Returns (rois,
+    labels_int32, bbox_targets, bbox_inside_weights,
+    bbox_outside_weights[, max_overlap])."""
+    rois = _np(rpn_rois).reshape(-1, 4).astype(np.float64)
+    gt = _np(gt_boxes).reshape(-1, 4).astype(np.float64)
+    gc = _np(gt_classes).reshape(-1).astype(np.int64)
+    crowd = _np(is_crowd).reshape(-1).astype(bool) if is_crowd is not None \
+        else np.zeros(len(gt), bool)
+    cn = int(class_nums or (int(gc.max()) + 1 if len(gc) else 1))
+    gt_clean = gt[~crowd]
+    gc_clean = gc[~crowd]
+    # gt boxes join the candidate pool (reference behavior)
+    if not is_cascade_rcnn:
+        cand = np.concatenate([rois, gt_clean], 0)
+    else:
+        cand = rois
+    if len(gt_clean):
+        iou = _iou_matrix(cand, gt_clean)
+        mx = iou.max(1)
+        am = iou.argmax(1)
+    else:
+        mx = np.zeros(len(cand))
+        am = np.zeros(len(cand), np.int64)
+    rng = np.random.RandomState(0 if not use_random else None)
+    fg_all = np.where(mx >= fg_thresh)[0]
+    bg_all = np.where((mx < bg_thresh_hi) & (mx >= bg_thresh_lo))[0]
+    fg_cnt = min(int(batch_size_per_im * fg_fraction), len(fg_all))
+    fg = (rng.choice(fg_all, fg_cnt, replace=False)
+          if use_random and len(fg_all) > fg_cnt else fg_all[:fg_cnt])
+    bg_cnt = min(batch_size_per_im - fg_cnt, len(bg_all))
+    bg = (rng.choice(bg_all, bg_cnt, replace=False)
+          if use_random and len(bg_all) > bg_cnt else bg_all[:bg_cnt])
+    keep = np.concatenate([fg, bg]).astype(int)
+    out_rois = cand[keep]
+    labels = np.zeros(len(keep), np.int32)
+    labels[:len(fg)] = gc_clean[am[fg]] if len(gt_clean) else 0
+
+    # per-class expanded bbox targets (reference layout [R, 4*class_nums])
+    tgt = np.zeros((len(keep), 4 * cn), np.float32)
+    inw = np.zeros_like(tgt)
+    if len(fg) and len(gt_clean):
+        w = np.asarray(bbox_reg_weights, np.float64)
+        matched = gt_clean[am[fg]]
+        boxes = cand[fg]
+        bw = boxes[:, 2] - boxes[:, 0] + 1
+        bh = boxes[:, 3] - boxes[:, 1] + 1
+        bx = boxes[:, 0] + bw / 2
+        by = boxes[:, 1] + bh / 2
+        gw = matched[:, 2] - matched[:, 0] + 1
+        gh = matched[:, 3] - matched[:, 1] + 1
+        gx = matched[:, 0] + gw / 2
+        gy = matched[:, 1] + gh / 2
+        deltas = np.stack([(gx - bx) / bw / w[0], (gy - by) / bh / w[1],
+                           np.log(gw / bw) / w[2],
+                           np.log(gh / bh) / w[3]], 1)
+        for i in range(len(fg)):
+            c = 0 if is_cls_agnostic else int(labels[i])
+            tgt[i, 4 * c:4 * c + 4] = deltas[i]
+            inw[i, 4 * c:4 * c + 4] = 1.0
+    outw = (inw > 0).astype(np.float32)
+    res = [Tensor(jnp.asarray(out_rois.astype(np.float32))),
+           Tensor(jnp.asarray(labels[:, None])),
+           Tensor(jnp.asarray(tgt)), Tensor(jnp.asarray(inw)),
+           Tensor(jnp.asarray(outw))]
+    if return_max_overlap:
+        res.append(Tensor(jnp.asarray(mx[keep].astype(np.float32))))
+    return tuple(res)
+
+
+def _rasterize_polygon(poly, h, w):
+    """Scanline polygon fill (even-odd), matching COCO-style polys."""
+    ys, xs = np.mgrid[0:h, 0:w]
+    pts = np.asarray(poly, np.float64).reshape(-1, 2)
+    # even-odd rule via ray casting
+    inside = np.zeros((h, w), bool)
+    n = len(pts)
+    px, py = xs + 0.5, ys + 0.5
+    j = n - 1
+    for i in range(n):
+        xi, yi = pts[i]
+        xj, yj = pts[j]
+        cond = ((yi > py) != (yj > py)) & (
+            px < (xj - xi) * (py - yi) / (yj - yi + 1e-12) + xi)
+        inside ^= cond
+        j = i
+    return inside
+
+
+def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
+                         labels_int32, num_classes, resolution):
+    """Mask-RCNN mask targets (detection.py:2748; kernel
+    mask_util.cc polys_to_mask_wrt_box): for each fg roi, rasterize its
+    matched gt polygon inside the roi and resize to resolution^2; the
+    K-class layout puts the mask in the matched class's block, -1
+    elsewhere. Single image; gt_segms is a list (one per gt) of polygon
+    lists [x0, y0, x1, y1, ...]. Returns (mask_rois, roi_has_mask_int32,
+    mask_int32 [fg, K * M * M])."""
+    r = _np(rois).reshape(-1, 4).astype(np.float64)
+    lbl = _np(labels_int32).reshape(-1).astype(np.int64)
+    crowd = _np(is_crowd).reshape(-1).astype(bool) if is_crowd is not None \
+        else np.zeros(len(gt_segms), bool)
+    m = int(resolution)
+    k = int(num_classes)
+    fg = np.where(lbl > 0)[0]
+    mask_rois = r[fg]
+    masks = np.full((len(fg), k * m * m), -1, np.int32)
+    has = np.zeros((len(fg), 1), np.int32)
+    # match each fg roi to the gt polygon with max IoU of bounding boxes
+    gt_bboxes = []
+    for si, segm in enumerate(gt_segms):
+        pts = np.concatenate([np.asarray(p, np.float64).reshape(-1, 2)
+                              for p in segm], 0)
+        gt_bboxes.append([pts[:, 0].min(), pts[:, 1].min(),
+                          pts[:, 0].max(), pts[:, 1].max()])
+    for i, ri in enumerate(fg):
+        box = r[ri]
+        best, best_iou = -1, 0.0
+        for si, gb in enumerate(gt_bboxes):
+            if crowd[si]:
+                continue
+            v = _jaccard(box, gb, False)
+            if v > best_iou:
+                best, best_iou = si, v
+        if best < 0:
+            continue
+        bw = max(box[2] - box[0], 1e-3)
+        bh = max(box[3] - box[1], 1e-3)
+        grid = np.zeros((m, m), bool)
+        for poly in gt_segms[best]:
+            pts = np.asarray(poly, np.float64).reshape(-1, 2).copy()
+            pts[:, 0] = (pts[:, 0] - box[0]) / bw * m
+            pts[:, 1] = (pts[:, 1] - box[1]) / bh * m
+            grid |= _rasterize_polygon(pts.ravel(), m, m)
+        cls = int(lbl[ri])
+        blk = grid.astype(np.int32).ravel()
+        masks[i, cls * m * m:(cls + 1) * m * m] = blk
+        has[i, 0] = 1
+    return (Tensor(jnp.asarray(mask_rois.astype(np.float32))),
+            Tensor(jnp.asarray(has)),
+            Tensor(jnp.asarray(masks)))
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False, loc_weights=None,
+                   conf_weights=None, loc_biases=None, conf_biases=None):
+    """SSD detection head (detection.py:2106): per feature map, a conv
+    producing 4 loc coords and num_classes scores per prior, plus the
+    prior boxes. The reference creates conv parameters in global scope;
+    here the per-level conv weights are explicit lists
+    ([C_out, C_in, k, k]). Returns (mbox_locs [N, P, 4], mbox_confs
+    [N, P, C], boxes [P, 4], variances [P, 4])."""
+    from .conv import conv2d
+    n_lvl = len(inputs)
+    if min_sizes is None:
+        # reference ratio schedule: evenly spread min_ratio..max_ratio
+        min_sizes, max_sizes = [], []
+        step = int(np.floor((max_ratio - min_ratio) / (n_lvl - 2)))
+        for ratio in range(min_ratio, max_ratio + 1, step):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+        min_sizes = [base_size * 0.1] + min_sizes
+        max_sizes = [base_size * 0.2] + max_sizes
+    def hwarrange(t, ch):
+        # [N, P*ch, H, W] -> [N, H*W*P, ch], tape-preserving
+        def f(arr):
+            nb, c, hh, ww = arr.shape
+            return arr.transpose(0, 2, 3, 1).reshape(
+                nb, hh * ww * (c // ch), ch)
+        return apply(f, t, op_name="mbox_arrange")
+
+    locs, confs, boxes_all, vars_all = [], [], [], []
+    for i, feat in enumerate(inputs):
+        ar = aspect_ratios[i] if isinstance(aspect_ratios[i],
+                                            (list, tuple)) \
+            else [aspect_ratios[i]]
+        mn = (list(min_sizes[i]) if isinstance(min_sizes[i], (list, tuple))
+              else [min_sizes[i]])
+        mx = None
+        if max_sizes:
+            mx = (list(max_sizes[i])
+                  if isinstance(max_sizes[i], (list, tuple))
+                  else [max_sizes[i]])
+        st = (steps[i] if steps else
+              (step_w[i] if step_w else 0.0,
+               step_h[i] if step_h else 0.0))
+        st = st if isinstance(st, (list, tuple)) else (st, st)
+        box, var = prior_box(feat, image, mn, mx, ar,
+                             variance, flip, clip, st, offset,
+                             min_max_aspect_ratios_order=
+                             min_max_aspect_ratios_order)
+        boxes_all.append(np.asarray(box.numpy()).reshape(-1, 4))
+        vars_all.append(np.asarray(var.numpy()).reshape(-1, 4))
+        lw = loc_weights[i]
+        lb = loc_biases[i] if loc_biases else None
+        loc = conv2d(feat, lw, lb, stride=stride, padding=pad)
+        locs.append(hwarrange(loc, 4))
+        cw = conf_weights[i]
+        cb = conf_biases[i] if conf_biases else None
+        conf = conv2d(feat, cw, cb, stride=stride, padding=pad)
+        confs.append(hwarrange(conf, num_classes))
+    mbox_locs = apply(lambda *xs: jnp.concatenate(xs, axis=1), *locs,
+                      op_name="mbox_concat")
+    mbox_confs = apply(lambda *xs: jnp.concatenate(xs, axis=1), *confs,
+                       op_name="mbox_concat")
+    return (mbox_locs, mbox_confs,
+            Tensor(jnp.asarray(np.concatenate(boxes_all, 0))),
+            Tensor(jnp.asarray(np.concatenate(vars_all, 0))))
+
+
+def deformable_roi_pooling(input, rois, trans, no_trans=False,
+                           spatial_scale=1.0, group_size=(1, 1),
+                           pooled_height=1, pooled_width=1, part_size=None,
+                           sample_per_part=1, trans_std=0.1,
+                           position_sensitive=False, name=None,
+                           rois_num=None):
+    """Deformable (PS-)RoI pooling (fluid/layers/nn.py:14577; kernel
+    deformable_psroi_pooling_op.h): rounded roi corners scaled -0.5,
+    per-bin offsets from trans [R, 2, part_h, part_w] * trans_std * roi
+    extent, sample_per_part^2 bilinear samples averaged per bin; with
+    position_sensitive, channel (c*gh + gy)*gw + gx feeds bin (gy, gx)."""
+    ph, pw = int(pooled_height), int(pooled_width)
+    gh, gw = int(group_size[0]), int(group_size[1])
+    spp = int(sample_per_part)
+    pth, ptw = (int(part_size[0]), int(part_size[1])) if part_size \
+        else (ph, pw)
+    from .vision import _roi_batch_index
+    bidx = _roi_batch_index(int(rois.shape[0]), rois_num, int(input.shape[0]))
+
+    def f(feat, boxes, tr):
+        n, c, h, w = feat.shape
+        out_dim = c // (gh * gw) if position_sensitive else c
+
+        x1 = jnp.round(boxes[:, 0]) * spatial_scale - 0.5
+        y1 = jnp.round(boxes[:, 1]) * spatial_scale - 0.5
+        x2 = (jnp.round(boxes[:, 2]) + 1.0) * spatial_scale - 0.5
+        y2 = (jnp.round(boxes[:, 3]) + 1.0) * spatial_scale - 0.5
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_w = rw / pw
+        bin_h = rh / ph
+
+        pi = jnp.arange(ph)
+        pj = jnp.arange(pw)
+        part_i = jnp.floor(pi / ph * pth).astype(jnp.int32)
+        part_j = jnp.floor(pj / pw * ptw).astype(jnp.int32)
+
+        def one(roi_i):
+            fmap = feat[jnp.asarray(bidx)[roi_i]]
+            if no_trans:
+                tx = jnp.zeros((ph, pw))
+                ty = jnp.zeros((ph, pw))
+            else:
+                # trans is class-agnostic here (num_classes=1 layout)
+                ty = tr[roi_i, 0][part_i[:, None], part_j[None, :]] * \
+                    trans_std
+                tx = tr[roi_i, 1][part_i[:, None], part_j[None, :]] * \
+                    trans_std
+            ws = (pj[None, :] * bin_w[roi_i] + x1[roi_i] +
+                  tx * rw[roi_i])                        # [ph, pw]
+            hs = (pi[:, None] * bin_h[roi_i] + y1[roi_i] +
+                  ty * rh[roi_i])
+            sub_w = bin_w[roi_i] / spp
+            sub_h = bin_h[roi_i] / spp
+            sw_ = ws[:, :, None, None] + jnp.arange(spp)[None, None, None,
+                                                         :] * sub_w
+            sh_ = hs[:, :, None, None] + jnp.arange(spp)[None, None, :,
+                                                         None] * sub_h
+            ok = ((sw_ >= -0.5) & (sw_ <= w - 0.5) &
+                  (sh_ >= -0.5) & (sh_ <= h - 0.5))
+            swc = jnp.clip(sw_, 0.0, w - 1.0)
+            shc = jnp.clip(sh_, 0.0, h - 1.0)
+            x0 = jnp.floor(swc).astype(jnp.int32)
+            y0 = jnp.floor(shc).astype(jnp.int32)
+            x1i = jnp.minimum(x0 + 1, w - 1)
+            y1i = jnp.minimum(y0 + 1, h - 1)
+            lx = swc - x0
+            ly = shc - y0
+            if position_sensitive:
+                gyi = jnp.clip((pi * gh) // ph, 0, gh - 1)
+                gxi = jnp.clip((pj * gw) // pw, 0, gw - 1)
+                chan = ((jnp.arange(out_dim)[:, None, None] * gh +
+                         gyi[None, :, None]) * gw + gxi[None, None, :])
+                fm = fmap[chan]                # [out, ph, pw, H, W]
+                def g(yy, xx):
+                    # index arrays broadcast to [ph, pw, spp, spp];
+                    # result [out, ph, pw, spp, spp]
+                    return fm[:, jnp.arange(ph)[:, None, None, None],
+                              jnp.arange(pw)[None, :, None, None],
+                              yy, xx]
+            else:
+                fm = fmap                      # [C, H, W]
+                def g(yy, xx):
+                    return fm[:, yy, xx]
+            val = (g(y0, x0) * (1 - ly) * (1 - lx) +
+                   g(y0, x1i) * (1 - ly) * lx +
+                   g(y1i, x0) * ly * (1 - lx) +
+                   g(y1i, x1i) * ly * lx)
+            val = val * ok[None].astype(val.dtype)
+            cnt = jnp.maximum(ok.sum(axis=(2, 3)), 1)
+            return val.sum(axis=(3, 4)) / cnt[None]
+        idx = jnp.arange(boxes.shape[0])
+        return jax.vmap(one)(idx).astype(feat.dtype)
+    tr = trans if trans is not None else Tensor(
+        jnp.zeros((int(rois.shape[0]), 2, pth, ptw)))
+    return apply(f, input, rois, tr, op_name="deformable_roi_pooling")
+
+
+def roi_perspective_transform(input, rois, transformed_height,
+                              transformed_width, spatial_scale=1.0,
+                              name=None, rois_num=None):
+    """Perspective-warp quad rois to a fixed extent (fluid/layers/nn.py
+    roi_perspective_transform; kernel roi_perspective_transform_op.cc):
+    each roi is 8 coords (4 corners); the homography mapping the output
+    rectangle onto the quad is solved and the input bilinearly sampled
+    (zeros outside). Returns [R, C, th, tw]."""
+    th, tw = int(transformed_height), int(transformed_width)
+    from .vision import _roi_batch_index
+    bidx = _roi_batch_index(int(rois.shape[0]), rois_num, int(input.shape[0]))
+    quads = _np(rois).reshape(-1, 8).astype(np.float64) * float(spatial_scale)
+
+    # solve the 8-dof homography H mapping (0,0),(tw-1,0),(tw-1,th-1),
+    # (0,th-1) to the quad corners, per roi (host-side linear solve on
+    # int geometry; sampling stays jnp/differentiable)
+    mats = []
+    dst = np.array([[0, 0], [tw - 1, 0], [tw - 1, th - 1], [0, th - 1]],
+                   np.float64)
+    for q in quads:
+        src = q.reshape(4, 2)
+        A = np.zeros((8, 8))
+        b = np.zeros(8)
+        for i in range(4):
+            x, y = dst[i]
+            u, v = src[i]
+            A[2 * i] = [x, y, 1, 0, 0, 0, -u * x, -u * y]
+            A[2 * i + 1] = [0, 0, 0, x, y, 1, -v * x, -v * y]
+            b[2 * i] = u
+            b[2 * i + 1] = v
+        sol = np.linalg.solve(A, b)
+        mats.append(np.append(sol, 1.0).reshape(3, 3))
+    mats = np.stack(mats)
+
+    def f(feat):
+        n, c, h, w = feat.shape
+        H = jnp.asarray(mats, feat.dtype)
+        ys, xs = jnp.meshgrid(jnp.arange(th, dtype=feat.dtype),
+                              jnp.arange(tw, dtype=feat.dtype),
+                              indexing="ij")
+        ones = jnp.ones_like(xs)
+        grid = jnp.stack([xs, ys, ones], -1).reshape(-1, 3)      # [thw, 3]
+
+        def one(roi_i):
+            uvw = grid @ H[roi_i].T
+            u = uvw[:, 0] / uvw[:, 2]
+            v = uvw[:, 1] / uvw[:, 2]
+            fmap = feat[jnp.asarray(bidx)[roi_i]]
+            x0 = jnp.floor(u).astype(jnp.int32)
+            y0 = jnp.floor(v).astype(jnp.int32)
+            lx = u - x0
+            ly = v - y0
+            val = 0.0
+            for (yy, wy) in ((y0, 1 - ly), (y0 + 1, ly)):
+                for (xx, wx) in ((x0, 1 - lx), (x0 + 1, lx)):
+                    okk = ((yy >= 0) & (yy < h) & (xx >= 0) & (xx < w))
+                    yc = jnp.clip(yy, 0, h - 1)
+                    xc = jnp.clip(xx, 0, w - 1)
+                    val = val + fmap[:, yc, xc] * (wy * wx *
+                                                   okk.astype(feat.dtype))
+            return val.reshape(c, th, tw)
+        idx = jnp.arange(quads.shape[0])
+        return jax.vmap(one)(idx).astype(feat.dtype)
+    return apply(f, input, op_name="roi_perspective_transform")
+
+
+def filter_by_instag(ins, ins_tag, filter_tag, is_lod=True,
+                     out_val_if_empty=0):
+    """Keep rows whose tag set intersects filter_tag
+    (fluid/layers/nn.py:10115; kernel filter_by_instag_op.h). Dense
+    form: ins [N, D], ins_tag a list (per row) or [N] array of tags.
+    Returns (filtered rows, loss_weight [kept, 1], kept index [K, 1]);
+    when nothing matches, one out_val_if_empty row with weight 0."""
+    x = _np(ins)
+    ftag = set(int(t) for t in _np(filter_tag).ravel())
+    if isinstance(ins_tag, (list, tuple)):
+        tags = [set(int(t) for t in np.asarray(row).ravel())
+                for row in ins_tag]
+    else:
+        tags = [{int(t)} for t in _np(ins_tag).ravel()]
+    keep = [i for i, ts in enumerate(tags) if ts & ftag]
+    if not keep:
+        out = np.full((1,) + x.shape[1:], out_val_if_empty, x.dtype)
+        return (Tensor(jnp.asarray(out)),
+                Tensor(jnp.zeros((1, 1), jnp.float32)),
+                Tensor(jnp.zeros((1, 1), jnp.int64)))
+    out = x[keep]
+    return (Tensor(jnp.asarray(out)),
+            Tensor(jnp.ones((len(keep), 1), jnp.float32)),
+            Tensor(jnp.asarray(np.asarray(keep, np.int64)[:, None])))

@@ -1,0 +1,350 @@
+"""Legacy recurrent functionals.
+
+Reference surface: fluid/layers/rnn.py — rnn (generic cell scan), birnn,
+dynamic_lstm:2262, lstm:2439, dynamic_lstmp:2616, dynamic_gru:2835,
+gru_unit:2998, lstm_unit:3392.
+
+Conventions carried over from the reference kernels:
+- lstm gate buffer order [i, f, c~, o] with peepholes applied as
+  checkI/checkF on the previous cell and checkO on the new cell
+  (math/detail/lstm_kernel.h, lstm_cpu_kernel.h:59-62);
+- gru gate order [u, r, c~] with origin_mode selecting
+  h = u*h_prev + (1-u)*c~ (True) or h = (1-u)*h_prev + u*c~ (False)
+  (math/detail/gru_kernel.h:76-101).
+
+The reference's fluid layers create parameters in a global scope; the
+eager equivalents here take explicit weight/bias tensors. Sequences ride
+the padded (x [B, T, ...], length) form (core/lod.py); the recurrences
+are jnp scans over time, which XLA compiles to on-chip loops.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor, apply
+
+__all__ = [
+    "rnn", "birnn", "dynamic_lstm", "dynamic_lstmp", "dynamic_gru",
+    "gru_unit", "lstm_unit", "lstm",
+]
+
+
+def rnn(cell, inputs, initial_states=None, sequence_length=None,
+        time_major=False, is_reverse=False, **kwargs):
+    """Run an RNNCell over time (fluid/layers/rnn.py rnn)."""
+    from ..layer.rnn import RNN as _RNN
+    return _RNN(cell, is_reverse=is_reverse, time_major=time_major)(
+        inputs, initial_states, sequence_length)
+
+
+def birnn(cell_fw, cell_bw, inputs, initial_states=None,
+          sequence_length=None, time_major=False, **kwargs):
+    """Bidirectional cell scan (fluid/layers/rnn.py birnn)."""
+    from ..layer.rnn import BiRNN as _BiRNN
+    return _BiRNN(cell_fw, cell_bw, time_major=time_major)(
+        inputs, initial_states, sequence_length)
+
+
+def _act(name):
+    return {"sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh,
+            "relu": lambda v: jnp.maximum(v, 0),
+            "identity": lambda v: v}[name]
+
+
+def dynamic_lstm(input, size, weight, bias, h_0=None, c_0=None,
+                 use_peepholes=True, is_reverse=False,
+                 gate_activation="sigmoid", cell_activation="tanh",
+                 candidate_activation="tanh", length=None, name=None):
+    """LSTM over pre-projected inputs (fluid/layers/rnn.py:2262).
+
+    input [B, T, 4D] (x @ Wx done by the caller, as in the reference),
+    weight [D, 4D] recurrent, bias [1, 4D] (or [1, 7D] with peepholes:
+    + Wic, Wfc, Woc). Returns (hidden [B, T, D], cell [B, T, D]);
+    steps past `length` hold the sequence's last state frozen."""
+    d = int(size) // 4
+    actg = _act(gate_activation)
+    actc = _act(cell_activation)
+    actn = _act(candidate_activation)
+    lens = None if length is None else np.asarray(
+        length.numpy() if isinstance(length, Tensor) else length
+    ).astype(np.int64)
+
+    def f(x, w, b):
+        bsz, t, _ = x.shape
+        gate_b = b.reshape(-1)[:4 * d]
+        if use_peepholes:
+            ck = b.reshape(-1)[4 * d:]
+            ck_i, ck_f, ck_o = ck[:d], ck[d:2 * d], ck[2 * d:3 * d]
+        ln = (jnp.full((bsz,), t) if lens is None else jnp.asarray(lens))
+        h0 = jnp.zeros((bsz, d), x.dtype)
+        c0 = jnp.zeros((bsz, d), x.dtype)
+
+        def step(carry, tt):
+            h, c = carry
+            idx = t - 1 - tt if is_reverse else tt
+            g = x[:, idx] + h @ w + gate_b
+            gi, gf, gc, go = (g[:, :d], g[:, d:2*d], g[:, 2*d:3*d],
+                              g[:, 3*d:])
+            if use_peepholes:
+                gi = gi + c * ck_i
+                gf = gf + c * ck_f
+            i = actg(gi)
+            fg = actg(gf)
+            cand = actn(gc)
+            c_new = i * cand + fg * c
+            if use_peepholes:
+                go = go + c_new * ck_o
+            o = actg(go)
+            h_new = o * actc(c_new)
+            live = (idx < ln)[:, None]
+            h_new = jnp.where(live, h_new, h)
+            c_new = jnp.where(live, c_new, c)
+            return (h_new, c_new), (h_new, c_new)
+        (_, _), (hs, cs) = jax.lax.scan(step, (h0, c0), jnp.arange(t))
+        hs = hs.transpose(1, 0, 2)
+        cs = cs.transpose(1, 0, 2)
+        if is_reverse:
+            hs = hs[:, ::-1]
+            cs = cs[:, ::-1]
+        return hs, cs
+    args = [input, weight, bias]
+    if h_0 is not None or c_0 is not None:
+        raise NotImplementedError(
+            "dynamic_lstm h_0/c_0: pass initial states via dynamic_lstmp "
+            "or nn.LSTM; the legacy facade starts from zeros like the "
+            "reference default")
+    return apply(f, *args, op_name="dynamic_lstm", n_outputs=2)
+
+
+def dynamic_lstmp(input, size, proj_size, weight, proj_weight, bias,
+                  use_peepholes=True, is_reverse=False,
+                  gate_activation="sigmoid", cell_activation="tanh",
+                  candidate_activation="tanh", proj_activation="tanh",
+                  length=None, name=None):
+    """LSTM with projection (fluid/layers/rnn.py:2616): recurrence runs
+    on the projected state r = act_p(h @ proj_weight) [B, P]; weight is
+    [P, 4D], proj_weight [D, P]. Returns (projection [B, T, P],
+    cell [B, T, D])."""
+    d = int(size) // 4
+    p = int(proj_size)
+    actg = _act(gate_activation)
+    actc = _act(cell_activation)
+    actn = _act(candidate_activation)
+    actp = _act(proj_activation)
+    lens = None if length is None else np.asarray(
+        length.numpy() if isinstance(length, Tensor) else length
+    ).astype(np.int64)
+
+    def f(x, w, pw, b):
+        bsz, t, _ = x.shape
+        gate_b = b.reshape(-1)[:4 * d]
+        if use_peepholes:
+            ck = b.reshape(-1)[4 * d:]
+            ck_i, ck_f, ck_o = ck[:d], ck[d:2 * d], ck[2 * d:3 * d]
+        ln = (jnp.full((bsz,), t) if lens is None else jnp.asarray(lens))
+        r0 = jnp.zeros((bsz, p), x.dtype)
+        c0 = jnp.zeros((bsz, d), x.dtype)
+
+        def step(carry, tt):
+            r, c = carry
+            idx = t - 1 - tt if is_reverse else tt
+            g = x[:, idx] + r @ w + gate_b
+            gi, gf, gc, go = (g[:, :d], g[:, d:2*d], g[:, 2*d:3*d],
+                              g[:, 3*d:])
+            if use_peepholes:
+                gi = gi + c * ck_i
+                gf = gf + c * ck_f
+            i = actg(gi)
+            fg = actg(gf)
+            c_new = i * actn(gc) + fg * c
+            if use_peepholes:
+                go = go + c_new * ck_o
+            h_new = actg(go) * actc(c_new)
+            r_new = actp(h_new @ pw)
+            live = (idx < ln)[:, None]
+            r_new = jnp.where(live, r_new, r)
+            c_new = jnp.where(live, c_new, c)
+            return (r_new, c_new), (r_new, c_new)
+        (_, _), (rs, cs) = jax.lax.scan(step, (r0, c0), jnp.arange(t))
+        rs = rs.transpose(1, 0, 2)
+        cs = cs.transpose(1, 0, 2)
+        if is_reverse:
+            rs = rs[:, ::-1]
+            cs = cs[:, ::-1]
+        return rs, cs
+    return apply(f, input, weight, proj_weight, bias,
+                 op_name="dynamic_lstmp", n_outputs=2)
+
+
+def dynamic_gru(input, size, weight, bias=None, is_reverse=False,
+                gate_activation="sigmoid", candidate_activation="tanh",
+                h_0=None, origin_mode=False, length=None, name=None):
+    """GRU over pre-projected inputs (fluid/layers/rnn.py:2835).
+    input [B, T, 3D] chunks [u, r, c~]; weight [D, 3D] (first 2D the
+    u/r recurrent block, last D the candidate block). Returns hidden
+    [B, T, D]."""
+    d = int(size)
+    actg = _act(gate_activation)
+    actc = _act(candidate_activation)
+    lens = None if length is None else np.asarray(
+        length.numpy() if isinstance(length, Tensor) else length
+    ).astype(np.int64)
+
+    def f(x, w, *rest):
+        bsz, t, _ = x.shape
+        b = rest[0].reshape(-1) if bias is not None else 0.0
+        h_init = (rest[-1] if h_0 is not None
+                  else jnp.zeros((bsz, d), x.dtype))
+        wg = w[:, :2 * d]          # u, r recurrent
+        wc = w[:, 2 * d:]          # candidate recurrent
+        ln = (jnp.full((bsz,), t) if lens is None else jnp.asarray(lens))
+
+        def step(h, tt):
+            idx = t - 1 - tt if is_reverse else tt
+            xt = x[:, idx] + b
+            xu, xr, xc = xt[:, :d], xt[:, d:2*d], xt[:, 2*d:]
+            hg = h @ wg
+            u = actg(xu + hg[:, :d])
+            r = actg(xr + hg[:, d:])
+            cand = actc(xc + (r * h) @ wc)
+            if origin_mode:
+                h_new = u * h + (1 - u) * cand
+            else:
+                h_new = (1 - u) * h + u * cand
+            h_new = jnp.where((idx < ln)[:, None], h_new, h)
+            return h_new, h_new
+        _, hs = jax.lax.scan(step, h_init, jnp.arange(t))
+        hs = hs.transpose(1, 0, 2)
+        if is_reverse:
+            hs = hs[:, ::-1]
+        return hs
+    args = [input, weight]
+    if bias is not None:
+        args.append(bias)
+    if h_0 is not None:
+        args.append(h_0)
+    return apply(f, *args, op_name="dynamic_gru")
+
+
+def gru_unit(input, hidden, size, weight, bias=None,
+             activation="tanh", gate_activation="sigmoid",
+             origin_mode=False, name=None):
+    """Single GRU step (fluid/layers/rnn.py:2998). input [B, 3D]
+    pre-projected, hidden [B, D], weight [D, 3D]. Returns (new hidden,
+    reset_hidden_prev r*h, gate [B, 3D]) like the reference op."""
+    d = int(size) // 3
+    actg = _act(gate_activation)
+    actc = _act(activation)
+
+    def f(x, h, w, *rest):
+        b = rest[0].reshape(-1) if bias is not None else 0.0
+        xt = x + b
+        wg = w[:, :2 * d]
+        wc = w[:, 2 * d:]
+        hg = h @ wg
+        u = actg(xt[:, :d] + hg[:, :d])
+        r = actg(xt[:, d:2*d] + hg[:, d:])
+        rh = r * h
+        cand = actc(xt[:, 2*d:] + rh @ wc)
+        if origin_mode:
+            h_new = u * h + (1 - u) * cand
+        else:
+            h_new = (1 - u) * h + u * cand
+        gate = jnp.concatenate([u, r, cand], axis=1)
+        return h_new, rh, gate
+    args = [input, hidden, weight]
+    if bias is not None:
+        args.append(bias)
+    return apply(f, *args, op_name="gru_unit", n_outputs=3)
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, weight, bias=None,
+              forget_bias=0.0, name=None):
+    """Single basic-LSTM step (fluid/layers/rnn.py:3392): concat [x, h]
+    through one [Dx + D, 4D] projection, gates [i, f, c~, o], forget
+    bias added before the sigmoid. Returns (hidden, cell)."""
+    fb = float(forget_bias)
+
+    def f(x, h, c, w, *rest):
+        d = h.shape[-1]
+        g = jnp.concatenate([x, h], axis=1) @ w
+        if rest:
+            g = g + rest[0].reshape(-1)
+        i = jax.nn.sigmoid(g[:, :d])
+        fg = jax.nn.sigmoid(g[:, d:2*d] + fb)
+        cand = jnp.tanh(g[:, 2*d:3*d])
+        o = jax.nn.sigmoid(g[:, 3*d:])
+        c_new = fg * c + i * cand
+        h_new = o * jnp.tanh(c_new)
+        return h_new, c_new
+    args = [x_t, hidden_t_prev, cell_t_prev, weight]
+    if bias is not None:
+        args.append(bias)
+    return apply(f, *args, op_name="lstm_unit", n_outputs=2)
+
+
+def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
+         weights=None, dropout_prob=0.0, is_bidirec=False, is_test=False,
+         name=None, default_initializer=None, seed=-1):
+    """Multi-layer (optionally bidirectional) LSTM over [T, B, D]
+    (fluid/layers/rnn.py:2439 — the cudnn LSTM). The reference holds one
+    flat cudnn weight in global scope; here `weights` is the explicit
+    per-layer-per-direction list of (w_ih [4H, in], w_hh [4H, H],
+    b_ih [4H], b_hh [4H]). Returns (out [T, B, H*dirs],
+    last_h [layers*dirs, B, H], last_c [...])."""
+    if weights is None:
+        raise ValueError(
+            "lstm needs explicit `weights` (list of (w_ih, w_hh, b_ih, "
+            "b_hh) per layer-direction); there is no global parameter "
+            "scope in the eager framework")
+    drop_keys = None
+    if dropout_prob > 0.0 and not is_test:
+        # reference cudnn LSTM applies dropout between layers in training
+        from ...core import random as random_mod
+        drop_keys = [random_mod.next_key() for _ in range(num_layers - 1)]
+    dirs = 2 if is_bidirec else 1
+    flat = []
+    for group in weights:
+        flat.extend(group)
+
+    def f(x, h0, c0, *ws):
+        t, bsz, _ = x.shape
+        groups = [ws[i * 4:(i + 1) * 4] for i in range(len(ws) // 4)]
+        out = x
+        last_h, last_c = [], []
+        for layer in range(num_layers):
+            layer_outs = []
+            for dr in range(dirs):
+                w_ih, w_hh, b_ih, b_hh = groups[layer * dirs + dr]
+                h = h0[layer * dirs + dr]
+                c = c0[layer * dirs + dr]
+                seq = out if dr == 0 else out[::-1]
+
+                def step(carry, xt):
+                    hh, cc = carry
+                    g = xt @ w_ih.T + b_ih + hh @ w_hh.T + b_hh
+                    hs4 = g.shape[-1] // 4
+                    i = jax.nn.sigmoid(g[:, :hs4])
+                    fg = jax.nn.sigmoid(g[:, hs4:2*hs4])
+                    cand = jnp.tanh(g[:, 2*hs4:3*hs4])
+                    o = jax.nn.sigmoid(g[:, 3*hs4:])
+                    c_new = fg * cc + i * cand
+                    h_new = o * jnp.tanh(c_new)
+                    return (h_new, c_new), h_new
+                (h_last, c_last), hs = jax.lax.scan(step, (h, c), seq)
+                if dr == 1:
+                    hs = hs[::-1]
+                layer_outs.append(hs)
+                last_h.append(h_last)
+                last_c.append(c_last)
+            out = (layer_outs[0] if dirs == 1
+                   else jnp.concatenate(layer_outs, axis=-1))
+            if drop_keys is not None and layer < num_layers - 1:
+                keep = jax.random.bernoulli(
+                    drop_keys[layer], 1.0 - dropout_prob, out.shape)
+                out = jnp.where(keep, out / (1.0 - dropout_prob), 0.0)
+        return out, jnp.stack(last_h), jnp.stack(last_c)
+    return apply(f, input, init_h, init_c, *flat, op_name="lstm",
+                 n_outputs=3)

@@ -1,0 +1,367 @@
+"""Sequence (LoD) op family on the padded-dense form.
+
+Reference surface: fluid/layers/sequence_lod.py — sequence_conv,
+sequence_softmax, sequence_pool, sequence_concat, sequence_first_step,
+sequence_last_step, sequence_slice, sequence_expand, sequence_expand_as,
+sequence_pad, sequence_unpad, sequence_reshape, sequence_scatter,
+sequence_enumerate, sequence_reverse, sequence_mask; fluid/layers/nn.py
+lod_reset/lod_append; control_flow reorder_lod_tensor_by_rank.
+
+TPU-native design (core/lod.py): the reference's LoD tensors are a flat
+buffer + offsets; XLA wants static shapes, so every op here takes either
+the flat form (x [sum_T, ...], lengths [B]) or the padded form
+(x [B, T, ...], lengths [B]) — whichever the reference op's access
+pattern matches — and the masks derived from lengths replace the offset
+arithmetic. Conversions live in core.lod (pack_sequence/unpack_sequence).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.lod import lod_from_lengths
+from ...core.lod import sequence_mask as _seq_mask
+from ...core.tensor import Tensor, apply
+
+__all__ = [
+    "sequence_mask", "sequence_pad", "sequence_unpad", "sequence_softmax",
+    "sequence_pool", "sequence_first_step", "sequence_last_step",
+    "sequence_reverse", "sequence_expand", "sequence_expand_as",
+    "sequence_concat", "sequence_reshape", "sequence_enumerate",
+    "sequence_slice", "sequence_scatter", "sequence_conv",
+    "lod_reset", "lod_append", "reorder_lod_tensor_by_rank",
+]
+
+
+def _np(x):
+    return np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """lengths -> [B, maxlen] mask (fluid sequence_mask; reference
+    default dtype int64)."""
+    m = _seq_mask(_np(x), max_len=maxlen, dtype="bool")
+    return Tensor(m if dtype == "bool" else m.astype(dtype))
+
+
+def sequence_pad(x, pad_value, maxlen=None, length=None, name=None):
+    """Flat (x [sum_T, ...], length [B]) -> (padded [B, Tmax, ...],
+    length) (fluid sequence_pad). pad_value is scalar or per-feature."""
+    if length is None:
+        raise ValueError("sequence_pad needs `length` (the LoD replacement)")
+    lens = _np(length).astype(np.int64)
+    tmax = int(maxlen) if maxlen is not None else int(lens.max())
+    lod = lod_from_lengths(lens)
+
+    def f(flat, pv):
+        outs = []
+        for i in range(len(lens)):
+            seg = flat[lod[i]:lod[i + 1]]
+            pad_rows = tmax - seg.shape[0]
+            fill = jnp.broadcast_to(pv, (pad_rows,) + seg.shape[1:])
+            outs.append(jnp.concatenate([seg, fill.astype(seg.dtype)], 0))
+        return jnp.stack(outs)
+    pv = pad_value if isinstance(pad_value, Tensor) else Tensor(
+        jnp.asarray(pad_value))
+    return (apply(f, x, pv, op_name="sequence_pad"),
+            Tensor(jnp.asarray(lens)))
+
+
+def sequence_unpad(x, length, name=None):
+    """Padded [B, T, ...] -> flat [sum_T, ...] (fluid sequence_unpad)."""
+    lens = _np(length).astype(np.int64)
+
+    def f(p):
+        return jnp.concatenate([p[i, :int(n)] for i, n in enumerate(lens)],
+                               axis=0)
+    return apply(f, x, op_name="sequence_unpad")
+
+
+def sequence_softmax(input, length=None, name=None):
+    """Softmax over each sequence's valid steps (fluid sequence_softmax).
+    Padded [B, T] (or [B, T, 1]); padding positions get 0."""
+    lens = None if length is None else _np(length).astype(np.int64)
+
+    def f(x):
+        v = x.reshape(x.shape[0], -1)
+        t = v.shape[1]
+        if lens is None:
+            mask = jnp.ones_like(v, bool)
+        else:
+            mask = jnp.arange(t)[None, :] < jnp.asarray(lens)[:, None]
+        z = jnp.where(mask, v, -jnp.inf)
+        out = jax.nn.softmax(z, axis=1)
+        return jnp.where(mask, out, 0.0).reshape(x.shape)
+    return apply(f, input, op_name="sequence_softmax")
+
+
+def sequence_pool(input, pool_type, length=None, pad_value=0.0, name=None):
+    """Per-sequence reduction over time (fluid sequence_pool): average,
+    sum, sqrt (sum/sqrt(len)), max, last, first. Padded [B, T, ...] ->
+    [B, ...]; empty sequences yield pad_value."""
+    pt = pool_type.lower()
+    lens = None if length is None else _np(length).astype(np.int64)
+
+    def f(x):
+        b, t = x.shape[0], x.shape[1]
+        ln = (jnp.full((b,), t) if lens is None else jnp.asarray(lens))
+        mask_shape = (b, t) + (1,) * (x.ndim - 2)
+        mask = (jnp.arange(t)[None, :] < ln[:, None]).reshape(mask_shape)
+        lnf = jnp.maximum(ln, 1).astype(x.dtype).reshape((b,) + (1,) *
+                                                         (x.ndim - 2))
+        if pt == "average":
+            out = jnp.sum(jnp.where(mask, x, 0), 1) / lnf
+        elif pt == "sum":
+            out = jnp.sum(jnp.where(mask, x, 0), 1)
+        elif pt == "sqrt":
+            out = jnp.sum(jnp.where(mask, x, 0), 1) / jnp.sqrt(lnf)
+        elif pt == "max":
+            out = jnp.max(jnp.where(mask, x, -jnp.inf), 1)
+        elif pt == "first":
+            out = x[:, 0]
+        elif pt == "last":
+            idx = jnp.maximum(ln - 1, 0)
+            out = jnp.take_along_axis(
+                x, idx.reshape((b, 1) + (1,) * (x.ndim - 2)), axis=1)[:, 0]
+        else:
+            raise ValueError("pool_type must be average|sum|sqrt|max|"
+                             "first|last")
+        empty = (ln == 0).reshape((b,) + (1,) * (x.ndim - 2))
+        return jnp.where(empty, pad_value, out)
+    return apply(f, input, op_name="sequence_pool")
+
+
+def sequence_first_step(input, length=None):
+    return sequence_pool(input, "first", length)
+
+
+def sequence_last_step(input, length=None):
+    return sequence_pool(input, "last", length)
+
+
+def sequence_reverse(x, length=None, name=None):
+    """Reverse each sequence's valid prefix (fluid sequence_reverse);
+    padding stays in place."""
+    lens = None if length is None else _np(length).astype(np.int64)
+
+    def f(v):
+        b, t = v.shape[0], v.shape[1]
+        ln = (jnp.full((b,), t) if lens is None else jnp.asarray(lens))
+        pos = jnp.arange(t)[None, :]
+        src = jnp.where(pos < ln[:, None], ln[:, None] - 1 - pos, pos)
+        idx = src.reshape((b, t) + (1,) * (v.ndim - 2))
+        return jnp.take_along_axis(
+            v, jnp.broadcast_to(idx, v.shape).astype(jnp.int32), axis=1)
+    return apply(f, x, op_name="sequence_reverse")
+
+
+def sequence_expand(x, y_lengths, ref_level=-1, x_lengths=None, name=None):
+    """Repeat sequences of x per y's per-sequence counts (fluid
+    sequence_expand). Flat form: x [N, ...] with x_lengths grouping rows
+    into sequences (default: one row per sequence); sequence i is tiled
+    y_lengths[i] times. Returns (flat out, out_lengths)."""
+    yl = _np(y_lengths).astype(np.int64)
+    xl = (np.ones(len(yl), np.int64) if x_lengths is None
+          else _np(x_lengths).astype(np.int64))
+    lod = lod_from_lengths(xl)
+
+    def f(v):
+        outs = []
+        for i, times in enumerate(yl):
+            seg = v[lod[i]:lod[i + 1]]
+            for _ in range(int(times)):
+                outs.append(seg)
+        return jnp.concatenate(outs, 0) if outs else v[:0]
+    out_lengths = np.repeat(xl, np.maximum(yl, 0))
+    return (apply(f, x, op_name="sequence_expand"),
+            Tensor(jnp.asarray(out_lengths)))
+
+
+def sequence_expand_as(x, times, name=None):
+    """Tile row i of x times[i] times (fluid sequence_expand_as on
+    one-row-per-sequence x). Returns (flat out, lengths=times)."""
+    tl = _np(times).astype(np.int64)
+
+    def f(v):
+        return jnp.repeat(v, jnp.asarray(tl), axis=0)
+    return (apply(f, x, op_name="sequence_expand_as"),
+            Tensor(jnp.asarray(tl)))
+
+
+def sequence_concat(inputs, lengths_list, name=None):
+    """Concatenate corresponding sequences across inputs (fluid
+    sequence_concat): out_i = concat(in1_i, in2_i, ...). Padded inputs
+    [B, Ti, ...]; returns (padded out, out_lengths)."""
+    lens = [_np(l).astype(np.int64) for l in lengths_list]
+    out_lens = np.sum(lens, axis=0)
+    tmax = int(out_lens.max())
+
+    def f(*xs):
+        b = xs[0].shape[0]
+        outs = []
+        for i in range(b):
+            parts = [x[i, :int(l[i])] for x, l in zip(xs, lens)]
+            seg = jnp.concatenate(parts, 0)
+            pad = tmax - seg.shape[0]
+            fill = jnp.zeros((pad,) + seg.shape[1:], seg.dtype)
+            outs.append(jnp.concatenate([seg, fill], 0))
+        return jnp.stack(outs)
+    return (apply(f, *inputs, op_name="sequence_concat"),
+            Tensor(jnp.asarray(out_lens)))
+
+
+def sequence_reshape(input, new_dim, length=None, name=None):
+    """Reshape flat [sum_T, D] rows into new_dim-wide rows (fluid
+    sequence_reshape); each sequence's T*D must divide new_dim. Returns
+    (flat out, new_lengths)."""
+    nd = int(new_dim)
+
+    def f(v):
+        return v.reshape(-1, nd)
+    out = apply(f, input, op_name="sequence_reshape")
+    if length is None:
+        return out
+    lens = _np(length).astype(np.int64)
+    d = int(input.shape[-1])
+    if (lens * d % nd).any():
+        raise ValueError("sequence_reshape: each sequence's numel must be "
+                         "divisible by new_dim")
+    return out, Tensor(jnp.asarray(lens * d // nd))
+
+
+def sequence_enumerate(input, win_size, pad_value=0, length=None, name=None):
+    """Sliding windows of ids (fluid sequence_enumerate): out[b, t] =
+    [x[t], ..., x[t + win - 1]] with pad_value past the sequence end.
+    Padded [B, T] -> [B, T, win]."""
+    win = int(win_size)
+    lens = None if length is None else _np(length).astype(np.int64)
+
+    def f(v):
+        b, t = v.shape
+        ln = (jnp.full((b,), t) if lens is None else jnp.asarray(lens))
+        pos = jnp.arange(t)[None, :, None] + jnp.arange(win)[None, None, :]
+        valid = pos < ln[:, None, None]
+        gathered = jnp.take_along_axis(
+            v[:, :, None], jnp.clip(pos, 0, t - 1), axis=1)
+        return jnp.where(valid, gathered, pad_value)
+    return apply(f, input, op_name="sequence_enumerate")
+
+
+def sequence_slice(input, offset, length, seq_lengths=None, name=None):
+    """Per-sequence subsequence (fluid sequence_slice): sequence i keeps
+    [offset[i], offset[i] + length[i]). Padded [B, T, ...] -> (padded,
+    length)."""
+    off = _np(offset).reshape(-1).astype(np.int64)
+    ln = _np(length).reshape(-1).astype(np.int64)
+    tmax = int(ln.max()) if len(ln) else 0
+
+    def f(v):
+        outs = []
+        for i in range(v.shape[0]):
+            seg = v[i, int(off[i]):int(off[i] + ln[i])]
+            pad = tmax - seg.shape[0]
+            fill = jnp.zeros((pad,) + seg.shape[1:], seg.dtype)
+            outs.append(jnp.concatenate([seg, fill], 0))
+        return jnp.stack(outs)
+    return apply(f, input, op_name="sequence_slice"), Tensor(jnp.asarray(ln))
+
+
+def sequence_scatter(input, index, updates, lengths=None, name=None):
+    """out = input; out[i, index[i, j]] += updates[i, j] for valid j
+    (fluid sequence_scatter — sequence i of the LoD index/updates pair
+    scatters into row i). index/updates padded [B, L] with lengths."""
+    lens = None if lengths is None else _np(lengths).astype(np.int64)
+
+    def f(x, idx, upd):
+        b, l = idx.shape[0], idx.shape[1]
+        ln = (jnp.full((b,), l) if lens is None else jnp.asarray(lens))
+        valid = jnp.arange(l)[None, :] < ln[:, None]
+        rows = jnp.broadcast_to(jnp.arange(b)[:, None], (b, l))
+        cols = jnp.clip(idx, 0, x.shape[1] - 1).astype(jnp.int32)
+        vals = jnp.where(valid, upd, 0).astype(x.dtype)
+        return x.at[rows.ravel(), cols.ravel()].add(vals.ravel())
+    return apply(f, input, index, updates, op_name="sequence_scatter")
+
+
+def sequence_conv(input, weight, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, bias=None, length=None,
+                  act=None, name=None):
+    """Context-window projection (fluid sequence_conv; kernel
+    sequence_conv_op.h ContextProjectFunctor): for each step t, stack
+    rows [t + padding_start, t + padding_start + filter_size) (zeros
+    outside the sequence) and multiply by weight
+    [filter_size * D, num_filters]. Padded [B, T, D]."""
+    if int(filter_stride) != 1:
+        raise ValueError("sequence_conv: filter_stride must be 1 "
+                         "(matches the reference's supported case)")
+    fs = int(filter_size)
+    start = -((fs - 1) // 2) if padding_start is None else int(padding_start)
+    lens = None if length is None else _np(length).astype(np.int64)
+
+    def f(x, w, *maybe_b):
+        b, t, d = x.shape
+        ln = (jnp.full((b,), t) if lens is None else jnp.asarray(lens))
+        pos = jnp.arange(t)[None, :, None] + start + \
+            jnp.arange(fs)[None, None, :]                     # [1, T, fs]
+        valid = (pos >= 0) & (pos < ln[:, None, None])
+        rows = jnp.take_along_axis(
+            x[:, :, None, :].repeat(fs, 2),
+            jnp.clip(pos, 0, t - 1)[..., None].repeat(d, -1), axis=1)
+        rows = jnp.where(valid[..., None], rows, 0.0)          # [B,T,fs,D]
+        ctx = rows.reshape(b, t, fs * d)
+        out = ctx @ w
+        if maybe_b:
+            out = out + maybe_b[0]
+        # steps past the sequence end are zero like the reference's
+        # flat output simply not containing them
+        step_valid = (jnp.arange(t)[None, :] < ln[:, None])[..., None]
+        out = jnp.where(step_valid, out, 0.0)
+        if act == "tanh":
+            out = jnp.tanh(out)
+        elif act == "relu":
+            out = jnp.maximum(out, 0)
+        return out
+    args = [input, weight] + ([bias] if bias is not None else [])
+    return apply(f, *args, op_name="sequence_conv")
+
+
+# ------------------------- LoD descriptor ops ------------------------------
+
+def lod_reset(x, y=None, target_lod=None):
+    """Attach a new lengths descriptor (fluid lod_reset). In the dense
+    design the descriptor is explicit, so this returns (x, lengths)
+    computed from `y` (another (tensor, lengths) pair or a lengths
+    tensor) or target_lod offsets."""
+    if y is not None:
+        lens = _np(y).astype(np.int64).reshape(-1)
+    elif target_lod is not None:
+        off = [int(v) for v in target_lod]
+        lens = np.asarray([b - a for a, b in zip(off[:-1], off[1:])],
+                          np.int64)
+    else:
+        raise ValueError("lod_reset needs y or target_lod")
+    return x, Tensor(jnp.asarray(lens))
+
+
+def lod_append(x, level):
+    """Append a deeper LoD level (fluid lod_append): the new level's
+    lengths partition the rows of x within each existing sequence."""
+    lens = _np(level).astype(np.int64).reshape(-1)
+    return x, Tensor(jnp.asarray(lens))
+
+
+def reorder_lod_tensor_by_rank(x, rank_table, lengths=None):
+    """Reorder sequences by a rank table (fluid
+    reorder_lod_tensor_by_rank): rank_table gives the new order of
+    sequence indices (the reference builds it from lod_rank_table on
+    descending length). Padded [B, T, ...]."""
+    order = _np(rank_table).reshape(-1).astype(np.int64)
+
+    def f(v):
+        return v[jnp.asarray(order)]
+    out = apply(f, x, op_name="reorder_lod_tensor_by_rank")
+    if lengths is None:
+        return out
+    lens = _np(lengths).astype(np.int64)[order]
+    return out, Tensor(jnp.asarray(lens))

@@ -1,0 +1,285 @@
+"""RCNN/RetinaNet/YOLO training-side ops (reference:
+fluid/tests/unittests/test_yolov3_loss_op.py, test_rpn_target_assign_op.py,
+test_generate_proposal_labels_op.py, test_deformable_psroi_pooling.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+from op_test import check_grad
+
+RNG = np.random.RandomState(13)
+
+
+def _np_sce(x, z):
+    return max(x, 0) - x * z + np.log1p(np.exp(-abs(x)))
+
+
+def test_yolov3_loss_single_gt_exact():
+    # 1 image, 1 anchor in mask, 1x1 grid, 1 gt centered in the cell
+    anchors = [16, 16]
+    mask = [0]
+    C = 2
+    h = w = 1
+    x = RNG.randn(1, 1 * (5 + C), h, w).astype(np.float32) * 0.5
+    gt = np.array([[[0.5, 0.5, 0.5, 0.5]]], np.float32)  # w=h=0.5 of img
+    lbl = np.array([[1]], np.int64)
+    loss = float(F.yolov3_loss(paddle.to_tensor(x), paddle.to_tensor(gt),
+                               paddle.to_tensor(lbl), anchors, mask, C,
+                               ignore_thresh=0.7, downsample_ratio=32,
+                               use_label_smooth=False).numpy()[0])
+    v = x.reshape(5 + C)
+    input_size = 32
+    tx = 0.5; ty = 0.5
+    tw = np.log(0.5 * input_size / 16); th = tw
+    scale = 2 - 0.25
+    ref = (_np_sce(v[0], tx) + _np_sce(v[1], ty)) * scale
+    ref += (abs(v[2] - tw) + abs(v[3] - th)) * scale
+    # class loss (no smoothing): one-hot target [0, 1]
+    ref += _np_sce(v[5], 0.0) + _np_sce(v[6], 1.0)
+    # objectness: the matched cell is positive with score 1
+    ref += _np_sce(v[4], 1.0)
+    np.testing.assert_allclose(loss, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_yolov3_loss_ignore_and_negatives():
+    # no gt -> all cells negative objectness
+    anchors = [10, 13, 16, 30]
+    mask = [0, 1]
+    C = 3
+    x = RNG.randn(1, 2 * (5 + C), 2, 2).astype(np.float32)
+    gt = np.zeros((1, 2, 4), np.float32)      # invalid gts (w=h=0)
+    lbl = np.zeros((1, 2), np.int64)
+    loss = float(F.yolov3_loss(paddle.to_tensor(x), paddle.to_tensor(gt),
+                               paddle.to_tensor(lbl), anchors, mask, C,
+                               0.7, 32).numpy()[0])
+    v = x.reshape(2, 5 + C, 2, 2)
+    ref = sum(_np_sce(v[j, 4, k, l], 0.0)
+              for j in range(2) for k in range(2) for l in range(2))
+    np.testing.assert_allclose(loss, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_yolov3_loss_grad():
+    anchors = [16, 16]
+    x = RNG.randn(1, 7, 2, 2).astype(np.float32) * 0.3
+    gt = np.array([[[0.4, 0.6, 0.5, 0.4]]], np.float32)
+    lbl = np.array([[0]], np.int64)
+    gtt, lt = paddle.to_tensor(gt), paddle.to_tensor(lbl)
+    check_grad(lambda xx: F.yolov3_loss(xx, gtt, lt, anchors, [0], 2,
+                                        0.7, 32),
+               [x], atol=3e-2, rtol=3e-2)
+
+
+def test_rpn_target_assign():
+    a = 30
+    anchors = np.stack([RNG.uniform(0, 20, a), RNG.uniform(0, 20, a),
+                        RNG.uniform(20, 40, a), RNG.uniform(20, 40, a)],
+                       1).astype(np.float32)
+    var = np.tile(np.array([1.0, 1.0, 1.0, 1.0], np.float32), (a, 1))
+    gt = np.array([[5, 5, 25, 25], [10, 10, 35, 35]], np.float32)
+    bbox_pred = RNG.randn(a, 4).astype(np.float32)
+    cls_logits = RNG.randn(a, 1).astype(np.float32)
+    im_info = np.array([[64.0, 64.0, 1.0]], np.float32)
+    ps, pl, tl, tb, iw = F.rpn_target_assign(
+        paddle.to_tensor(bbox_pred), paddle.to_tensor(cls_logits),
+        paddle.to_tensor(anchors), paddle.to_tensor(var),
+        paddle.to_tensor(gt), None, paddle.to_tensor(im_info),
+        rpn_batch_size_per_im=16, rpn_straddle_thresh=-1,
+        use_random=False)
+    lbls = tl.numpy().ravel()
+    assert ps.numpy().shape[0] == len(lbls) <= 16
+    assert pl.numpy().shape[0] == tb.numpy().shape[0] == lbls.sum()
+    assert lbls.sum() >= 1                    # best anchor per gt is fg
+    assert iw.numpy().shape == tb.numpy().shape
+
+
+def test_retinanet_target_assign():
+    a = 20
+    anchors = np.stack([RNG.uniform(0, 10, a), RNG.uniform(0, 10, a),
+                        RNG.uniform(15, 30, a), RNG.uniform(15, 30, a)],
+                       1).astype(np.float32)
+    var = np.ones((a, 4), np.float32)
+    gt = np.array([[2, 2, 20, 20]], np.float32)
+    gl = np.array([[3]], np.int64)
+    bp = RNG.randn(a, 4).astype(np.float32)
+    cl = RNG.randn(a, 5).astype(np.float32)
+    im_info = np.array([[32.0, 32.0, 1.0]], np.float32)
+    ps, pl, tl, tb, iw, fg = F.retinanet_target_assign(
+        paddle.to_tensor(bp), paddle.to_tensor(cl),
+        paddle.to_tensor(anchors), paddle.to_tensor(var),
+        paddle.to_tensor(gt), paddle.to_tensor(gl), None,
+        paddle.to_tensor(im_info), num_classes=5)
+    n_fg = int(fg.numpy()[0, 0]) - 1
+    assert pl.numpy().shape == (n_fg, 4)
+    lbls = tl.numpy().ravel()
+    assert (sorted(set(lbls)) in ([0, 3], [3], [0]))
+    assert (lbls == 3).sum() == n_fg
+
+
+def test_retinanet_detection_output():
+    # single level, 2 anchors; deltas 0 -> boxes = anchors
+    anchors = np.array([[0, 0, 9, 9], [20, 20, 29, 29]], np.float32)
+    deltas = np.zeros((2, 4), np.float32)
+    scores = np.array([[0.9, 0.1], [0.8, 0.2]], np.float32)
+    im_info = np.array([[64.0, 64.0, 1.0]], np.float32)
+    out = F.retinanet_detection_output(
+        [paddle.to_tensor(deltas)], [paddle.to_tensor(scores)],
+        [paddle.to_tensor(anchors)], paddle.to_tensor(im_info),
+        score_threshold=0.15).numpy()
+    # kept: class-0 on both anchors (0.9, 0.8), class-1 on anchor 1 (0.2)
+    assert out.shape[0] == 3
+    assert out[0, 0] == 1 and out[0, 1] == pytest.approx(0.9, abs=1e-5)
+    np.testing.assert_allclose(out[0, 2:], [0, 0, 9, 9], atol=1e-4)
+
+
+def test_generate_proposal_labels():
+    rois = np.array([[0, 0, 10, 10], [20, 20, 30, 30], [5, 5, 14, 14],
+                     [40, 40, 50, 50]], np.float32)
+    gt = np.array([[0, 0, 12, 12]], np.float32)
+    gc = np.array([[2]], np.int64)
+    im_info = np.array([[64.0, 64.0, 1.0]], np.float32)
+    out_rois, labels, tgt, inw, outw = F.generate_proposal_labels(
+        paddle.to_tensor(rois), paddle.to_tensor(gc), None,
+        paddle.to_tensor(gt), paddle.to_tensor(im_info),
+        batch_size_per_im=6, fg_fraction=0.5, fg_thresh=0.5,
+        bg_thresh_hi=0.5, bg_thresh_lo=0.0, class_nums=4,
+        use_random=False)
+    lbl = labels.numpy().ravel()
+    fg_rows = np.where(lbl > 0)[0]
+    assert (lbl[fg_rows] == 2).all()
+    # fg targets live in class-2 block, weights 1 there
+    t = tgt.numpy(); w = inw.numpy()
+    for r in fg_rows:
+        assert (w[r, 8:12] == 1).all()
+        assert (w[r, :8] == 0).all() and (w[r, 12:] == 0).all()
+    assert (outw.numpy() == (w > 0)).all()
+
+
+def test_generate_mask_labels():
+    # square gt polygon covering left half of the roi
+    rois = np.array([[0, 0, 10, 10], [20, 20, 28, 28]], np.float32)
+    labels = np.array([[1], [0]], np.int32)      # roi 1 is bg
+    segms = [[[0.0, 0.0, 5.0, 0.0, 5.0, 10.0, 0.0, 10.0]]]
+    im_info = np.array([[32, 32, 1]], np.float32)
+    mask_rois, has, masks = F.generate_mask_labels(
+        paddle.to_tensor(im_info), paddle.to_tensor(np.array([[1]])),
+        None, segms, paddle.to_tensor(rois), paddle.to_tensor(labels),
+        num_classes=3, resolution=4)
+    assert mask_rois.numpy().shape == (1, 4)
+    m = masks.numpy().reshape(1, 3, 4, 4)
+    # class-1 block has left half set
+    assert (m[0, 1, :, :2] == 1).all()
+    assert (m[0, 1, :, 2:] == 0).all()
+    assert (m[0, 0] == -1).all() and (m[0, 2] == -1).all()
+
+
+def test_multi_box_head():
+    f1 = paddle.to_tensor(RNG.randn(1, 4, 4, 4).astype(np.float32))
+    f2 = paddle.to_tensor(RNG.randn(1, 4, 2, 2).astype(np.float32))
+    img = paddle.to_tensor(np.zeros((1, 3, 32, 32), np.float32))
+    # priors per cell with ar=[2.] + flip: expanded [1, 2, .5] + max = 4
+    np_ = 4
+    lw = [paddle.to_tensor((RNG.randn(np_ * 4, 4, 3, 3) * 0.1
+                            ).astype(np.float32)) for _ in range(2)]
+    cw = [paddle.to_tensor((RNG.randn(np_ * 3, 4, 3, 3) * 0.1
+                            ).astype(np.float32)) for _ in range(2)]
+    locs, confs, boxes, vars_ = F.multi_box_head(
+        [f1, f2], img, base_size=32, num_classes=3,
+        aspect_ratios=[[2.0], [2.0]], min_sizes=[4.0, 8.0],
+        max_sizes=[8.0, 16.0], kernel_size=3, pad=1,
+        loc_weights=lw, conf_weights=cw)
+    P = 4 * 4 * np_ + 2 * 2 * np_
+    assert locs.numpy().shape == (1, P, 4)
+    assert confs.numpy().shape == (1, P, 3)
+    assert boxes.numpy().shape == (P, 4)
+    assert vars_.numpy().shape == (P, 4)
+
+
+def test_deformable_roi_pooling_zero_trans_matches_avg():
+    # no_trans + spp large enough approximates average pooling of the bin
+    feat = np.full((1, 2, 8, 8), 3.0, np.float32)
+    rois = np.array([[0, 0, 7, 7]], np.float32)
+    trans = np.zeros((1, 2, 2, 2), np.float32)
+    out = F.deformable_roi_pooling(
+        paddle.to_tensor(feat), paddle.to_tensor(rois),
+        paddle.to_tensor(trans), no_trans=True, pooled_height=2,
+        pooled_width=2, part_size=(2, 2), sample_per_part=4).numpy()
+    np.testing.assert_allclose(out, np.full((1, 2, 2, 2), 3.0), atol=1e-5)
+
+
+def test_deformable_roi_pooling_position_sensitive():
+    # C = out_dim * gh * gw = 1 * 2 * 2; each bin reads its own channel
+    feat = np.zeros((1, 4, 8, 8), np.float32)
+    for c in range(4):
+        feat[0, c] = c + 1
+    rois = np.array([[0, 0, 7, 7]], np.float32)
+    out = F.deformable_roi_pooling(
+        paddle.to_tensor(feat), paddle.to_tensor(rois), None,
+        no_trans=True, group_size=(2, 2), pooled_height=2, pooled_width=2,
+        sample_per_part=2, position_sensitive=True).numpy()
+    # bin (gy, gx) -> channel (0*2+gy)*2+gx
+    np.testing.assert_allclose(out[0, 0], [[1, 2], [3, 4]], atol=1e-5)
+
+
+def test_roi_perspective_transform_identity_quad():
+    feat = RNG.randn(1, 1, 8, 8).astype(np.float32)
+    # axis-aligned quad == plain crop+resize of the box
+    quad = np.array([[1, 1, 6, 1, 6, 6, 1, 6]], np.float32)
+    out = F.roi_perspective_transform(paddle.to_tensor(feat),
+                                      paddle.to_tensor(quad), 6, 6).numpy()
+    np.testing.assert_allclose(out[0, 0], feat[0, 0, 1:7, 1:7], atol=1e-4)
+
+
+def test_filter_by_instag():
+    x = RNG.randn(4, 3).astype(np.float32)
+    tags = [[1], [2], [1, 3], [4]]
+    out, w, idx = F.filter_by_instag(paddle.to_tensor(x), tags,
+                                     np.array([1, 4]))
+    np.testing.assert_allclose(out.numpy(), x[[0, 2, 3]])
+    assert (w.numpy() == 1).all()
+    np.testing.assert_array_equal(idx.numpy().ravel(), [0, 2, 3])
+    # empty result
+    out2, w2, _ = F.filter_by_instag(paddle.to_tensor(x), tags,
+                                     np.array([9]), out_val_if_empty=7)
+    assert (out2.numpy() == 7).all()
+    assert (w2.numpy() == 0).all()
+
+
+def test_anchor_assign_stray_gt_not_global_fg():
+    # a gt overlapping no anchor must not mark every anchor positive
+    from paddle_tpu.nn.functional.detection_tail import _anchor_gt_assign
+    anchors = np.array([[0, 0, 10, 10], [20, 20, 30, 30]], np.float64)
+    gt = np.array([[100, 100, 110, 110]], np.float64)
+    labels, _, _ = _anchor_gt_assign(anchors, gt, 0.7, 0.3)
+    assert (labels == 0).all()
+
+
+def test_multi_box_head_gradients_flow():
+    f1 = paddle.to_tensor(RNG.randn(1, 2, 2, 2).astype(np.float32))
+    img = paddle.to_tensor(np.zeros((1, 3, 16, 16), np.float32))
+    lw = [paddle.to_tensor((RNG.randn(4 * 4, 2, 3, 3) * 0.1
+                            ).astype(np.float32), stop_gradient=False)]
+    cw = [paddle.to_tensor((RNG.randn(4 * 2, 2, 3, 3) * 0.1
+                            ).astype(np.float32), stop_gradient=False)]
+    locs, confs, _, _ = F.multi_box_head(
+        [f1], img, base_size=16, num_classes=2, aspect_ratios=[[2.0]],
+        min_sizes=[4.0], max_sizes=[8.0], kernel_size=3, pad=1,
+        loc_weights=lw, conf_weights=cw)
+    loss = paddle.sum(locs) + paddle.sum(confs)
+    loss.backward()
+    assert np.abs(np.asarray(lw[0].grad.numpy())).sum() > 0
+    assert np.abs(np.asarray(cw[0].grad.numpy())).sum() > 0
+
+
+def test_generate_mask_labels_unmatched_has_zero():
+    rois = np.array([[50, 50, 60, 60]], np.float32)   # far from the polygon
+    labels = np.array([[1]], np.int32)
+    segms = [[[0.0, 0.0, 5.0, 0.0, 5.0, 5.0, 0.0, 5.0]]]
+    im_info = np.array([[64, 64, 1]], np.float32)
+    _, has, masks = F.generate_mask_labels(
+        paddle.to_tensor(im_info), paddle.to_tensor(np.array([[1]])),
+        None, segms, paddle.to_tensor(rois), paddle.to_tensor(labels),
+        num_classes=2, resolution=4)
+    assert int(has.numpy()[0, 0]) == 0
+    assert (masks.numpy() == -1).all()

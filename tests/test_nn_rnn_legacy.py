@@ -1,0 +1,164 @@
+"""Legacy recurrent functionals vs step-by-step numpy references
+(reference: fluid/tests/unittests/test_lstm_op.py, test_gru_op.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+RNG = np.random.RandomState(9)
+sig = lambda v: 1 / (1 + np.exp(-v))  # noqa: E731
+
+
+def _np_dynamic_lstm(x, w, b, use_peep, lens, origin_is_rev=False):
+    bsz, t, d4 = x.shape
+    d = d4 // 4
+    gb = b[:4 * d]
+    ck_i = b[4*d:5*d] if use_peep else 0
+    ck_f = b[5*d:6*d] if use_peep else 0
+    ck_o = b[6*d:7*d] if use_peep else 0
+    hs = np.zeros((bsz, t, d)); cs = np.zeros((bsz, t, d))
+    for bi in range(bsz):
+        h = np.zeros(d); c = np.zeros(d)
+        for tt in range(int(lens[bi])):
+            g = x[bi, tt] + h @ w + gb
+            gi, gf, gc, go = g[:d], g[d:2*d], g[2*d:3*d], g[3*d:]
+            i = sig(gi + c * ck_i)
+            f = sig(gf + c * ck_f)
+            c = i * np.tanh(gc) + f * c
+            o = sig(go + c * ck_o)
+            h = o * np.tanh(c)
+            hs[bi, tt] = h; cs[bi, tt] = c
+        # frozen past length in our convention
+        for tt in range(int(lens[bi]), t):
+            hs[bi, tt] = h; cs[bi, tt] = c
+    return hs, cs
+
+
+@pytest.mark.parametrize("use_peep", [True, False])
+def test_dynamic_lstm(use_peep):
+    b, t, d = 2, 4, 3
+    x = RNG.randn(b, t, 4 * d).astype(np.float32)
+    w = (RNG.randn(d, 4 * d) * 0.4).astype(np.float32)
+    bias = (RNG.randn(1, 7 * d if use_peep else 4 * d) * 0.3).astype(
+        np.float32)
+    lens = np.array([4, 2], np.int64)
+    h, c = F.dynamic_lstm(paddle.to_tensor(x), 4 * d, paddle.to_tensor(w),
+                          paddle.to_tensor(bias), use_peepholes=use_peep,
+                          length=paddle.to_tensor(lens))
+    rh, rc = _np_dynamic_lstm(x.astype(np.float64), w.astype(np.float64),
+                              bias.ravel().astype(np.float64), use_peep,
+                              lens)
+    np.testing.assert_allclose(h.numpy(), rh, atol=1e-4, rtol=1e-4)
+    np.testing.assert_allclose(c.numpy(), rc, atol=1e-4, rtol=1e-4)
+
+
+def test_dynamic_lstmp_shapes_and_projection():
+    b, t, d, p = 1, 3, 4, 2
+    x = RNG.randn(b, t, 4 * d).astype(np.float32)
+    w = (RNG.randn(p, 4 * d) * 0.4).astype(np.float32)
+    pw = (RNG.randn(d, p) * 0.4).astype(np.float32)
+    bias = (RNG.randn(1, 4 * d) * 0.3).astype(np.float32)
+    r, c = F.dynamic_lstmp(paddle.to_tensor(x), 4 * d, p,
+                           paddle.to_tensor(w), paddle.to_tensor(pw),
+                           paddle.to_tensor(bias), use_peepholes=False)
+    assert r.numpy().shape == (b, t, p)
+    assert c.numpy().shape == (b, t, d)
+    # step-0 reference
+    g = x[0, 0] + bias.ravel()
+    i = sig(g[:d]); f_ = sig(g[d:2*d]); cand = np.tanh(g[2*d:3*d])
+    c0 = i * cand
+    o = sig(g[3*d:])
+    h0 = o * np.tanh(c0)
+    r0 = np.tanh(h0 @ pw)
+    np.testing.assert_allclose(r.numpy()[0, 0], r0, atol=1e-4)
+
+
+@pytest.mark.parametrize("origin_mode", [True, False])
+def test_dynamic_gru(origin_mode):
+    b, t, d = 2, 3, 4
+    x = RNG.randn(b, t, 3 * d).astype(np.float32)
+    w = (RNG.randn(d, 3 * d) * 0.4).astype(np.float32)
+    bias = (RNG.randn(1, 3 * d) * 0.3).astype(np.float32)
+    lens = np.array([3, 2], np.int64)
+    out = F.dynamic_gru(paddle.to_tensor(x), d, paddle.to_tensor(w),
+                        paddle.to_tensor(bias), origin_mode=origin_mode,
+                        length=paddle.to_tensor(lens)).numpy()
+    for bi in range(b):
+        h = np.zeros(d)
+        for tt in range(int(lens[bi])):
+            xt = x[bi, tt] + bias.ravel()
+            hg = h @ w[:, :2*d]
+            u = sig(xt[:d] + hg[:d])
+            r = sig(xt[d:2*d] + hg[d:])
+            cand = np.tanh(xt[2*d:] + (r * h) @ w[:, 2*d:])
+            h = u * h + (1 - u) * cand if origin_mode else \
+                (1 - u) * h + u * cand
+            np.testing.assert_allclose(out[bi, tt], h, atol=1e-4, rtol=1e-4)
+
+
+def test_gru_unit():
+    b, d = 3, 4
+    x = RNG.randn(b, 3 * d).astype(np.float32)
+    h = RNG.randn(b, d).astype(np.float32)
+    w = (RNG.randn(d, 3 * d) * 0.4).astype(np.float32)
+    h_new, rh, gate = F.gru_unit(paddle.to_tensor(x), paddle.to_tensor(h),
+                                 3 * d, paddle.to_tensor(w))
+    hg = h @ w[:, :2*d]
+    u = sig(x[:, :d] + hg[:, :d])
+    r = sig(x[:, d:2*d] + hg[:, d:])
+    cand = np.tanh(x[:, 2*d:] + (r * h) @ w[:, 2*d:])
+    ref = (1 - u) * h + u * cand
+    np.testing.assert_allclose(h_new.numpy(), ref, atol=1e-4, rtol=1e-4)
+    np.testing.assert_allclose(rh.numpy(), r * h, atol=1e-4, rtol=1e-4)
+    assert gate.numpy().shape == (b, 3 * d)
+
+
+def test_lstm_unit():
+    b, dx, d = 2, 3, 4
+    x = RNG.randn(b, dx).astype(np.float32)
+    h = RNG.randn(b, d).astype(np.float32)
+    c = RNG.randn(b, d).astype(np.float32)
+    w = (RNG.randn(dx + d, 4 * d) * 0.4).astype(np.float32)
+    bias = (RNG.randn(4 * d) * 0.2).astype(np.float32)
+    h2, c2 = F.lstm_unit(paddle.to_tensor(x), paddle.to_tensor(h),
+                         paddle.to_tensor(c), paddle.to_tensor(w),
+                         paddle.to_tensor(bias), forget_bias=1.0)
+    g = np.concatenate([x, h], 1) @ w + bias
+    i = sig(g[:, :d]); f_ = sig(g[:, d:2*d] + 1.0)
+    cand = np.tanh(g[:, 2*d:3*d]); o = sig(g[:, 3*d:])
+    cr = f_ * c + i * cand
+    hr = o * np.tanh(cr)
+    np.testing.assert_allclose(c2.numpy(), cr, atol=1e-4, rtol=1e-4)
+    np.testing.assert_allclose(h2.numpy(), hr, atol=1e-4, rtol=1e-4)
+
+
+def test_lstm_multilayer_bidirec():
+    t, b, din, h = 5, 2, 3, 4
+    layers, dirs = 2, 2
+    x = RNG.randn(t, b, din).astype(np.float32)
+    weights = []
+    for layer in range(layers):
+        in_sz = din if layer == 0 else h * dirs
+        for _ in range(dirs):
+            weights.append(tuple(paddle.to_tensor(
+                (RNG.randn(*s) * 0.3).astype(np.float32))
+                for s in [(4*h, in_sz), (4*h, h), (4*h,), (4*h,)]))
+    h0 = paddle.to_tensor(np.zeros((layers * dirs, b, h), np.float32))
+    c0 = paddle.to_tensor(np.zeros((layers * dirs, b, h), np.float32))
+    out, lh, lc = F.lstm(paddle.to_tensor(x), h0, c0, t, h, layers,
+                         weights=weights, is_bidirec=True)
+    assert out.numpy().shape == (t, b, h * dirs)
+    assert lh.numpy().shape == (layers * dirs, b, h)
+    assert lc.numpy().shape == (layers * dirs, b, h)
+
+
+def test_rnn_birnn_functional():
+    cell = nn.LSTMCell(4, 5)
+    x = paddle.to_tensor(RNG.randn(2, 3, 4).astype(np.float32))
+    out, state = F.rnn(cell, x)
+    assert tuple(out.shape) == (2, 3, 5)
+    cell_bw = nn.LSTMCell(4, 5)
+    out2, _ = F.birnn(cell, cell_bw, x)
+    assert tuple(out2.shape) == (2, 3, 10)

@@ -1,0 +1,283 @@
+"""Sequence (LoD) family + tensor-array ops on the padded-dense form
+(reference test strategy: fluid/tests/unittests/test_sequence_*.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+RNG = np.random.RandomState(3)
+
+
+def test_sequence_mask():
+    lens = paddle.to_tensor(np.array([3, 1, 0], np.int64))
+    m = F.sequence_mask(lens, maxlen=4).numpy()
+    ref = np.array([[1, 1, 1, 0], [1, 0, 0, 0], [0, 0, 0, 0]], np.int64)
+    np.testing.assert_array_equal(m, ref)
+
+
+def test_sequence_pad_unpad_roundtrip():
+    flat = RNG.randn(6, 3).astype(np.float32)
+    lens = np.array([2, 3, 1], np.int64)
+    padded, out_len = F.sequence_pad(paddle.to_tensor(flat), 9.0,
+                                     length=paddle.to_tensor(lens))
+    p = padded.numpy()
+    assert p.shape == (3, 3, 3)
+    np.testing.assert_allclose(p[0, :2], flat[:2])
+    assert (p[0, 2] == 9.0).all()
+    np.testing.assert_array_equal(out_len.numpy(), lens)
+    back = F.sequence_unpad(padded, paddle.to_tensor(lens)).numpy()
+    np.testing.assert_allclose(back, flat)
+
+
+def test_sequence_softmax():
+    x = RNG.randn(2, 4).astype(np.float32)
+    lens = np.array([3, 2], np.int64)
+    out = F.sequence_softmax(paddle.to_tensor(x),
+                             paddle.to_tensor(lens)).numpy()
+    for i, n in enumerate(lens):
+        e = np.exp(x[i, :n] - x[i, :n].max())
+        np.testing.assert_allclose(out[i, :n], e / e.sum(), atol=1e-5)
+        assert (out[i, n:] == 0).all()
+    np.testing.assert_allclose(out.sum(1), [1, 1], atol=1e-5)
+
+
+@pytest.mark.parametrize("pt,expect", [
+    ("sum", lambda v: v.sum(0)),
+    ("average", lambda v: v.mean(0)),
+    ("sqrt", lambda v: v.sum(0) / np.sqrt(len(v))),
+    ("max", lambda v: v.max(0)),
+    ("first", lambda v: v[0]),
+    ("last", lambda v: v[-1]),
+])
+def test_sequence_pool(pt, expect):
+    x = RNG.randn(2, 5, 3).astype(np.float32)
+    lens = np.array([4, 2], np.int64)
+    out = F.sequence_pool(paddle.to_tensor(x), pt,
+                          paddle.to_tensor(lens)).numpy()
+    for i, n in enumerate(lens):
+        np.testing.assert_allclose(out[i], expect(x[i, :n]), atol=1e-5)
+    # facades
+    if pt == "first":
+        np.testing.assert_allclose(
+            F.sequence_first_step(paddle.to_tensor(x),
+                                  paddle.to_tensor(lens)).numpy(), out)
+    if pt == "last":
+        np.testing.assert_allclose(
+            F.sequence_last_step(paddle.to_tensor(x),
+                                 paddle.to_tensor(lens)).numpy(), out)
+
+
+def test_sequence_reverse():
+    x = np.arange(10, dtype=np.float32).reshape(2, 5)
+    lens = np.array([3, 5], np.int64)
+    out = F.sequence_reverse(paddle.to_tensor(x),
+                             paddle.to_tensor(lens)).numpy()
+    np.testing.assert_allclose(out[0], [2, 1, 0, 3, 4])
+    np.testing.assert_allclose(out[1], [9, 8, 7, 6, 5])
+
+
+def test_sequence_expand_and_expand_as():
+    x = RNG.randn(3, 2).astype(np.float32)   # 3 one-row sequences
+    times = np.array([2, 0, 3], np.int64)
+    out, lens = F.sequence_expand(paddle.to_tensor(x),
+                                  paddle.to_tensor(times))
+    o = out.numpy()
+    assert o.shape == (5, 2)
+    np.testing.assert_allclose(o[0], x[0]); np.testing.assert_allclose(o[1], x[0])
+    np.testing.assert_allclose(o[2], x[2])
+    # grouped: x rows [0:2] are seq A, [2:3] seq B; A tiled 2x, B 1x
+    out2, l2 = F.sequence_expand(paddle.to_tensor(x),
+                                 paddle.to_tensor(np.array([2, 1], np.int64)),
+                                 x_lengths=np.array([2, 1], np.int64))
+    o2 = out2.numpy()
+    assert o2.shape == (5, 2)
+    np.testing.assert_allclose(o2[:2], x[:2])
+    np.testing.assert_allclose(o2[2:4], x[:2])
+    np.testing.assert_allclose(o2[4], x[2])
+    np.testing.assert_array_equal(l2.numpy(), [2, 2, 1])
+
+    out3, l3 = F.sequence_expand_as(paddle.to_tensor(x),
+                                    paddle.to_tensor(times))
+    o3 = out3.numpy()
+    np.testing.assert_allclose(o3, np.repeat(x, times, axis=0))
+
+
+def test_sequence_concat():
+    a = RNG.randn(2, 3, 2).astype(np.float32)
+    b = RNG.randn(2, 2, 2).astype(np.float32)
+    la = np.array([2, 3], np.int64)
+    lb = np.array([1, 2], np.int64)
+    out, lens = F.sequence_concat([paddle.to_tensor(a), paddle.to_tensor(b)],
+                                  [la, lb])
+    o = out.numpy()
+    np.testing.assert_array_equal(lens.numpy(), [3, 5])
+    np.testing.assert_allclose(o[0, :2], a[0, :2])
+    np.testing.assert_allclose(o[0, 2], b[0, 0])
+    assert (o[0, 3:] == 0).all()
+    np.testing.assert_allclose(o[1, :3], a[1, :3])
+    np.testing.assert_allclose(o[1, 3:5], b[1, :2])
+
+
+def test_sequence_reshape():
+    flat = np.arange(12, dtype=np.float32).reshape(6, 2)
+    lens = np.array([2, 4], np.int64)
+    out, nl = F.sequence_reshape(paddle.to_tensor(flat), 4,
+                                 paddle.to_tensor(lens))
+    np.testing.assert_allclose(out.numpy(), flat.reshape(3, 4))
+    np.testing.assert_array_equal(nl.numpy(), [1, 2])
+
+
+def test_sequence_enumerate():
+    x = np.array([[1, 2, 3, 4], [5, 6, 0, 0]], np.int64)
+    lens = np.array([4, 2], np.int64)
+    out = F.sequence_enumerate(paddle.to_tensor(x), 2, pad_value=0,
+                               length=paddle.to_tensor(lens)).numpy()
+    np.testing.assert_array_equal(out[0], [[1, 2], [2, 3], [3, 4], [4, 0]])
+    np.testing.assert_array_equal(out[1], [[5, 6], [6, 0], [0, 0], [0, 0]])
+
+
+def test_sequence_slice():
+    x = RNG.randn(2, 5, 2).astype(np.float32)
+    off = np.array([1, 0], np.int64)
+    ln = np.array([2, 3], np.int64)
+    out, lens = F.sequence_slice(paddle.to_tensor(x), off, ln)
+    o = out.numpy()
+    np.testing.assert_allclose(o[0, :2], x[0, 1:3])
+    np.testing.assert_allclose(o[1, :3], x[1, :3])
+    np.testing.assert_array_equal(lens.numpy(), ln)
+
+
+def test_sequence_scatter():
+    x = np.zeros((2, 6), np.float32)
+    idx = np.array([[0, 2, 0], [5, 1, 0]], np.int64)
+    upd = np.array([[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]], np.float32)
+    lens = np.array([2, 3], np.int64)
+    out = F.sequence_scatter(paddle.to_tensor(x), paddle.to_tensor(idx),
+                             paddle.to_tensor(upd),
+                             paddle.to_tensor(lens)).numpy()
+    ref = np.zeros((2, 6), np.float32)
+    ref[0, 0] += 1; ref[0, 2] += 2
+    ref[1, 5] += 4; ref[1, 1] += 5; ref[1, 0] += 6
+    np.testing.assert_allclose(out, ref)
+
+
+def test_sequence_conv():
+    b, t, d, nf = 1, 4, 3, 2
+    x = RNG.randn(b, t, d).astype(np.float32)
+    w = RNG.randn(3 * d, nf).astype(np.float32)
+    lens = np.array([3], np.int64)
+    out = F.sequence_conv(paddle.to_tensor(x), paddle.to_tensor(w),
+                          filter_size=3, length=paddle.to_tensor(lens)).numpy()
+    # window centered (padding_start = -1): rows [t-1, t, t+1]
+    for step in range(3):
+        ctx = []
+        for k in (-1, 0, 1):
+            p = step + k
+            ctx.append(x[0, p] if 0 <= p < 3 else np.zeros(d, np.float32))
+        ref = np.concatenate(ctx) @ w
+        np.testing.assert_allclose(out[0, step], ref, atol=1e-5)
+    assert (out[0, 3:] == 0).all()
+
+
+def test_lod_descriptor_ops():
+    x = paddle.to_tensor(RNG.randn(6, 2).astype(np.float32))
+    _, lens = F.lod_reset(x, y=np.array([3, 3], np.int64))
+    np.testing.assert_array_equal(lens.numpy(), [3, 3])
+    _, lens2 = F.lod_reset(x, target_lod=[0, 2, 6])
+    np.testing.assert_array_equal(lens2.numpy(), [2, 4])
+    _, lens3 = F.lod_append(x, [1, 1, 2, 2])
+    np.testing.assert_array_equal(lens3.numpy(), [1, 1, 2, 2])
+
+    padded = paddle.to_tensor(RNG.randn(3, 4, 2).astype(np.float32))
+    order = np.array([2, 0, 1], np.int64)
+    out, ol = F.reorder_lod_tensor_by_rank(
+        padded, order, lengths=np.array([1, 2, 3], np.int64))
+    np.testing.assert_allclose(out.numpy(), padded.numpy()[order])
+    np.testing.assert_array_equal(ol.numpy(), [3, 1, 2])
+
+
+# ----------------------- tensor array ops ---------------------------------
+
+def test_array_ops_roundtrip():
+    arr = F.create_array()
+    for i in range(3):
+        F.array_write(paddle.to_tensor(np.full((2, 2), i, np.float32)),
+                      i, arr)
+    assert int(F.array_length(arr).numpy()) == 3
+    v = F.array_read(arr, 1).numpy()
+    assert (v == 1).all()
+    cat, sizes = F.tensor_array_to_tensor(arr, axis=0)
+    assert cat.numpy().shape == (6, 2)
+    np.testing.assert_array_equal(sizes.numpy(), [2, 2, 2])
+    st, _ = F.tensor_array_to_tensor(arr, axis=0, use_stack=True)
+    assert st.numpy().shape == (3, 2, 2)
+
+
+def test_autoincreased_step_counter():
+    a = int(F.autoincreased_step_counter("t1", begin=5, step=2).numpy())
+    b = int(F.autoincreased_step_counter("t1", begin=5, step=2).numpy())
+    assert (a, b) == (5, 7)
+
+
+def test_hash_op():
+    ids = np.array([[1], [2], [1]], np.int64)
+    out = F.hash(paddle.to_tensor(ids), hash_size=1000, num_hash=3).numpy()
+    assert out.shape == (3, 3, 1)
+    assert (out >= 0).all() and (out < 1000).all()
+    np.testing.assert_array_equal(out[0], out[2])     # deterministic
+    assert len(np.unique(out[0])) > 1                  # hashes differ by seed
+
+
+def test_merge_selected_rows():
+    from paddle_tpu.core.selected_rows import SelectedRows
+    sr = SelectedRows([1, 3, 1], np.array([[1.0], [2.0], [3.0]]), height=5)
+    merged = F.merge_selected_rows(sr)
+    np.testing.assert_array_equal(merged.rows, [1, 3])
+    np.testing.assert_allclose(np.asarray(merged.value), [[4.0], [2.0]])
+
+
+def test_continuous_value_model():
+    x = np.array([[3.0, 1.0, 7.0], [0.0, 0.0, 9.0]], np.float32)
+    cvm = paddle.to_tensor(x[:, :2].copy())
+    keep = F.continuous_value_model(paddle.to_tensor(x), cvm,
+                                    use_cvm=True).numpy()
+    np.testing.assert_allclose(keep[:, 0], np.log(x[:, 0] + 1), atol=1e-5)
+    np.testing.assert_allclose(keep[:, 1],
+                               np.log(x[:, 1] + 1) - np.log(x[:, 0] + 1),
+                               atol=1e-5)
+    np.testing.assert_allclose(keep[:, 2], x[:, 2])
+    drop = F.continuous_value_model(paddle.to_tensor(x), cvm,
+                                    use_cvm=False).numpy()
+    np.testing.assert_allclose(drop, x[:, 2:])
+
+
+def test_pool_facades():
+    x = RNG.randn(1, 2, 4, 4).astype(np.float32)
+    out = F.pool2d(paddle.to_tensor(x), pool_size=2, pool_stride=2).numpy()
+    assert out.shape == (1, 2, 2, 2)
+    np.testing.assert_allclose(out[0, 0, 0, 0], x[0, 0, :2, :2].max(),
+                               atol=1e-6)
+    g = F.pool2d(paddle.to_tensor(x), global_pooling=True,
+                 pool_type="avg").numpy()
+    np.testing.assert_allclose(g[0, :, 0, 0], x[0].mean(axis=(1, 2)),
+                               atol=1e-5)
+    x3 = RNG.randn(1, 1, 4, 4, 4).astype(np.float32)
+    out3 = F.pool3d(paddle.to_tensor(x3), pool_size=2, pool_stride=2,
+                    pool_type="avg").numpy()
+    assert out3.shape == (1, 1, 2, 2, 2)
+
+
+def test_inplace_aliases_and_erf():
+    from scipy.special import erf as sperf
+    x = RNG.randn(2, 3).astype(np.float32)
+    t = paddle.to_tensor(x.copy())
+    out = F.softmax_(t)
+    np.testing.assert_allclose(t.numpy(), out.numpy(), atol=1e-6)
+    np.testing.assert_allclose(out.numpy().sum(1), [1, 1], atol=1e-5)
+    t2 = paddle.to_tensor(x.copy())
+    F.elu_(t2, alpha=0.5)
+    ref = np.where(x > 0, x, 0.5 * (np.exp(x) - 1))
+    np.testing.assert_allclose(t2.numpy(), ref, atol=1e-5)
+    np.testing.assert_allclose(F.erf(paddle.to_tensor(x)).numpy(), sperf(x),
+                               atol=1e-4)
