@@ -47,4 +47,20 @@ from . import profiler  # noqa: F401
 from .core import monitor  # noqa: F401
 from . import device  # noqa: F401
 
+# 2.0-era top-level compatibility tail (reference python/paddle/__init__.py
+# re-exports these fluid-era names at the top level)
+from .legacy_alias import *  # noqa: F401,F403
+from .distributed.parallel import DataParallel  # noqa: F401
+from .hapi import callbacks  # noqa: F401
+from .static import data  # noqa: F401
+
+# LoD-era type aliases: a LoDTensor is a Tensor plus the host-side length
+# descriptor (core/lod.py); VarBase is the eager Tensor
+LoDTensor = Tensor
+VarBase = Tensor
+LoDTensorArray = list
+from .core.place import (CUDAPinnedPlace, XPUPlace)  # noqa: F401,E402
+
 __version__ = "0.2.0"
+full_version = __version__
+commit = "tpu-native"

@@ -61,6 +61,7 @@ class TPUPlace(Place):
 
 # Alias so code written against the reference API ("gpu:0") keeps working.
 CUDAPlace = TPUPlace
+XPUPlace = TPUPlace  # accelerator alias: the accelerator here IS the TPU
 
 
 class TPUPinnedPlace(Place):
@@ -69,6 +70,9 @@ class TPUPinnedPlace(Place):
     used by the DataLoader to request committed-host layouts."""
 
     device_type = "cpu"
+
+
+CUDAPinnedPlace = TPUPinnedPlace
 
 
 @functools.lru_cache(maxsize=None)

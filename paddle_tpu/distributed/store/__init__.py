@@ -251,7 +251,34 @@ class FileStore(Store):
                                 f"{time.time_ns()}"
                         try:
                             os.rename(lock, grave)
-                            os.unlink(grave)
+                            # TOCTOU re-check: between our staleness stat
+                            # and the rename, the stale holder may have
+                            # released and ANOTHER waiter O_EXCL-created
+                            # a fresh lock — which we just stole. If the
+                            # grave is fresh, put it back and retry.
+                            fresh = (time.time() - os.path.getmtime(grave)
+                                     <= self._LOCK_STALE_S)
+                            if fresh:
+                                # no-clobber restore via hardlink (EEXIST
+                                # = yet another waiter already locked;
+                                # residual race is then the original
+                                # holder's — documented). Filesystems
+                                # without hardlinks fall back to rename,
+                                # accepting the tiny clobber window.
+                                try:
+                                    os.link(grave, lock)
+                                except FileExistsError:
+                                    pass
+                                except OSError:
+                                    try:
+                                        os.rename(grave, lock)
+                                        continue
+                                    except OSError:
+                                        pass
+                            try:
+                                os.unlink(grave)
+                            except OSError:
+                                pass
                         except OSError:
                             pass        # another waiter won the rename
                         continue

@@ -290,7 +290,10 @@ class Model:
             return optimizer.functional_update(params, grads, opt_state,
                                                lr=lr)
 
-        return jax.jit(apply_step, donate_argnums=(0, 1, 2))
+        # donate params + opt slots only: donated grads have no matching
+        # output to alias for slot-less optimizers (SGD), which made XLA
+        # warn "Some donated buffers were not usable" on every fit
+        return jax.jit(apply_step, donate_argnums=(0, 1))
 
     def _build_eval_step(self):
         def eval_step(params, state, inputs, labels):
@@ -454,12 +457,16 @@ class Model:
         prog = getattr(self, "_dist_prog", None)
         batch0 = _as_list(inputs)[0] if _as_list(inputs) else None
         div = getattr(prog, "_eval_batch_divisor", 0) if prog else 0
+        # read shape without materializing (np.asarray on a device array
+        # would force a device->host copy per eval step)
+        b0 = getattr(batch0, "shape", None)
+        b0 = (b0[0] if b0 else
+              (len(batch0) if hasattr(batch0, "__len__") else None))
         if getattr(self, "_strategy", None) is not None and \
                 prog is not None and \
                 getattr(prog, "_eval_builder", None) is not None and \
                 not self._metrics and batch0 is not None and div and \
-                np.asarray(batch0).shape[0] % div == 0 and \
-                np.asarray(batch0).shape[0] >= div:
+                b0 is not None and b0 % div == 0 and b0 >= div:
             # evaluate under the TRAINING shardings — no host gather, no
             # single-device replication of a model that only fits
             # sharded (pp/tp/ZeRO-3 scale). Metric users and partial

@@ -1,0 +1,221 @@
+"""2.0-era top-level compatibility aliases.
+
+Reference: python/paddle/__init__.py re-exports a fluid-era tail —
+elementwise_*, reduce_*, fill_constant, create_parameter,
+create_global_var, shard_index, crop_tensor, shape, has_inf/has_nan,
+DataParallel, LoDTensor aliases, dygraph mode switches — so user code
+written against 2.0 imports them from the top level. Each alias here
+delegates to the modern op with the legacy signature adapted.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .core.tensor import Tensor, apply, to_tensor
+from .framework import Parameter
+from . import ops as _ops
+
+__all__ = [
+    "elementwise_add", "elementwise_sub", "elementwise_mul",
+    "elementwise_div", "elementwise_floordiv", "elementwise_mod",
+    "elementwise_pow", "elementwise_max", "elementwise_min",
+    "reduce_sum", "reduce_mean", "reduce_max", "reduce_min",
+    "reduce_prod", "reduce_all", "reduce_any",
+    "fill_constant", "create_parameter", "create_global_var",
+    "shard_index", "crop_tensor", "shape", "has_inf", "has_nan",
+    "get_tensor_from_selected_rows", "enable_dygraph", "disable_dygraph",
+    "in_dygraph_mode", "monkey_patch_math_varbase",
+    "monkey_patch_variable", "get_cuda_rng_state", "set_cuda_rng_state",
+    "get_cudnn_version", "is_compiled_with_xpu",
+]
+
+
+def _axis_broadcast(y, x_ndim, y_ndim, axis):
+    """fluid elementwise axis semantics: y's dims align to x starting at
+    `axis` (default -1 = trailing alignment, the numpy rule)."""
+    if axis == -1 or axis is None or y_ndim == 0:
+        return y
+    pad_right = x_ndim - axis - y_ndim
+    if pad_right <= 0:
+        return y
+    return y.reshape(tuple(y.shape) + (1,) * pad_right)
+
+
+def _elementwise(fn, op_tag):
+    def op(x, y, axis=-1, act=None, name=None):
+        def f(a, b):
+            b = _axis_broadcast(b, a.ndim, b.ndim, axis)
+            out = fn(a, b)
+            if act == "relu":
+                out = jnp.maximum(out, 0)
+            elif act is not None:
+                raise ValueError(f"{op_tag}: act supports relu/None")
+            return out
+        return apply(f, x, y, op_name=op_tag)
+    op.__name__ = op_tag
+    op.__doc__ = (f"Legacy {op_tag} (reference python/paddle/__init__.py "
+                  "fluid.layers re-export) with axis-aligned broadcast.")
+    return op
+
+
+elementwise_add = _elementwise(jnp.add, "elementwise_add")
+elementwise_sub = _elementwise(jnp.subtract, "elementwise_sub")
+elementwise_mul = _elementwise(jnp.multiply, "elementwise_mul")
+elementwise_div = _elementwise(jnp.divide, "elementwise_div")
+elementwise_floordiv = _elementwise(jnp.floor_divide, "elementwise_floordiv")
+elementwise_mod = _elementwise(jnp.mod, "elementwise_mod")
+elementwise_pow = _elementwise(jnp.power, "elementwise_pow")
+elementwise_max = _elementwise(jnp.maximum, "elementwise_max")
+elementwise_min = _elementwise(jnp.minimum, "elementwise_min")
+
+
+def _reduce(fn, op_tag):
+    def op(input, dim=None, keep_dim=False, name=None):
+        axis = tuple(dim) if isinstance(dim, (list, tuple)) else dim
+
+        def f(a):
+            return fn(a, axis=axis, keepdims=keep_dim)
+        return apply(f, input, op_name=op_tag)
+    op.__name__ = op_tag
+    op.__doc__ = f"Legacy {op_tag}(input, dim, keep_dim) reduction."
+    return op
+
+
+reduce_sum = _reduce(jnp.sum, "reduce_sum")
+reduce_mean = _reduce(jnp.mean, "reduce_mean")
+reduce_max = _reduce(jnp.max, "reduce_max")
+reduce_min = _reduce(jnp.min, "reduce_min")
+reduce_prod = _reduce(jnp.prod, "reduce_prod")
+reduce_all = _reduce(jnp.all, "reduce_all")
+reduce_any = _reduce(jnp.any, "reduce_any")
+
+
+def fill_constant(shape, dtype, value, force_cpu=False, out=None, name=None):
+    """Legacy fill_constant -> full (fluid/layers/tensor.py)."""
+    if isinstance(shape, Tensor):
+        shape = [int(v) for v in np.asarray(shape.numpy()).ravel()]
+    res = _ops.creation.full(shape, value, dtype=dtype)
+    if out is not None:
+        out.set_value(np.asarray(res.numpy()))
+        return out
+    return res
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """Standalone trainable parameter (fluid/layers/tensor.py
+    create_parameter)."""
+    from .framework import ParamAttr
+    from .nn import initializer as I
+    attr = ParamAttr._to_attr(attr)
+    init = None
+    if attr is not None and attr is not False:
+        init = attr.initializer
+    init = init or default_initializer or (
+        I.Constant(0.0) if is_bias else I.XavierNormal())
+    data = init(tuple(int(s) for s in shape), dtype)
+    return Parameter(data, name=name, trainable=True)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """Persistent scalar/tensor variable (fluid create_global_var)."""
+    return to_tensor(np.full([int(s) for s in shape], value,
+                             np.dtype(dtype)), stop_gradient=True)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    """Re-map global ids into a shard's local range (reference
+    paddle.shard_index): ids in [shard_id*size, (shard_id+1)*size) map to
+    id - shard_id*size, everything else to ignore_value."""
+    size = (int(index_num) + int(nshards) - 1) // int(nshards)
+    lo = int(shard_id) * size
+
+    def f(a):
+        local = a - lo
+        ok = (a >= lo) & (a < lo + size)
+        return jnp.where(ok, local, ignore_value)
+    return apply(f, input, op_name="shard_index")
+
+
+def crop_tensor(x, shape=None, offsets=None, name=None):
+    """Legacy crop_tensor -> ops.crop."""
+    return _ops.manipulation.crop(x, shape=shape, offsets=offsets)
+
+
+def shape(input):
+    """Shape as an int32 tensor (fluid/layers/nn.py shape op)."""
+    return to_tensor(np.asarray(input.shape, np.int32))
+
+
+def has_inf(x):
+    def f(a):
+        return jnp.isinf(a).any().reshape(1)
+    return apply(f, x, op_name="has_inf")
+
+
+def has_nan(x):
+    def f(a):
+        return jnp.isnan(a).any().reshape(1)
+    return apply(f, x, op_name="has_nan")
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    """SelectedRows value rows as a dense tensor (fluid
+    get_tensor_from_selected_rows)."""
+    from .core.selected_rows import SelectedRows
+    if not isinstance(x, SelectedRows):
+        raise TypeError("expects a SelectedRows")
+    return to_tensor(np.asarray(x.value))
+
+
+# --- dygraph mode switches ---------------------------------------------------
+# This framework is always eager (imperative over jax); to_static/jit
+# handles the graph path. The switches keep import-compatibility and are
+# observable through in_dygraph_mode.
+
+_DYGRAPH = {"on": True}
+
+
+def enable_dygraph(place=None):
+    _DYGRAPH["on"] = True
+
+
+def disable_dygraph():
+    _DYGRAPH["on"] = False
+
+
+def in_dygraph_mode():
+    return _DYGRAPH["on"]
+
+
+def monkey_patch_math_varbase():
+    """No-op: Tensor already carries the full math surface (the
+    reference patches methods onto VarBase at import)."""
+
+
+def monkey_patch_variable():
+    """No-op: see monkey_patch_math_varbase."""
+
+
+def get_cuda_rng_state():
+    """Maps to the device RNG state (no CUDA here; reference
+    get_cuda_rng_state)."""
+    from .core import random as random_mod
+    return random_mod.get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    from .core import random as random_mod
+    random_mod.set_rng_state(state)
+
+
+def get_cudnn_version():
+    """None: no cuDNN on TPU (reference returns None when CUDA is
+    absent)."""
+    return None
+
+
+def is_compiled_with_xpu():
+    return False

@@ -160,8 +160,7 @@ def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
     args = [x, gt_box, gt_label]
     if gt_score is not None:
         args.append(gt_score)
-        return apply(lambda a, b, c, d: f(a, b, c, d), *args,
-                     op_name="yolov3_loss")
+        return apply(f, *args, op_name="yolov3_loss")
     return apply(lambda a, b, c: f(a, b, c, None), *args,
                  op_name="yolov3_loss")
 

@@ -11,7 +11,15 @@ from __future__ import annotations
 from ..core import dtype as dtype_mod
 from . import nn  # noqa: F401  (cond/case/switch_case/while_loop)
 
-__all__ = ["InputSpec", "nn"]
+__all__ = ["InputSpec", "nn", "data"]
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Static input declaration (reference python/paddle/static/input.py
+    data): under the jit/export path a placeholder IS an InputSpec; -1
+    dims become None (dynamic until trace time)."""
+    shape = [None if (s is None or int(s) < 0) else int(s) for s in shape]
+    return InputSpec(shape, dtype=dtype, name=name)
 
 
 class InputSpec:

@@ -1,0 +1,108 @@
+"""2.0-era top-level alias tail (reference python/paddle/__init__.py)."""
+import numpy as np
+
+import paddle_tpu as paddle
+
+RNG = np.random.RandomState(2)
+
+
+def test_elementwise_axis_broadcast():
+    x = RNG.randn(2, 3, 4).astype(np.float32)
+    y = RNG.randn(3).astype(np.float32)
+    out = paddle.elementwise_add(paddle.to_tensor(x), paddle.to_tensor(y),
+                                 axis=1).numpy()
+    np.testing.assert_allclose(out, x + y[None, :, None], atol=1e-6)
+    out2 = paddle.elementwise_mul(paddle.to_tensor(x),
+                                  paddle.to_tensor(RNG.randn(4).astype(
+                                      np.float32))).numpy()
+    assert out2.shape == (2, 3, 4)
+
+
+def test_elementwise_grad_flows():
+    x = paddle.to_tensor(RNG.randn(2, 2).astype(np.float32),
+                         stop_gradient=False)
+    y = paddle.to_tensor(RNG.randn(2, 2).astype(np.float32),
+                         stop_gradient=False)
+    out = paddle.elementwise_sub(x, y)
+    paddle.sum(out).backward()
+    np.testing.assert_allclose(np.asarray(x.grad.numpy()), 1.0)
+    np.testing.assert_allclose(np.asarray(y.grad.numpy()), -1.0)
+
+
+def test_reduce_family():
+    x = RNG.randn(3, 4).astype(np.float32)
+    np.testing.assert_allclose(
+        paddle.reduce_mean(paddle.to_tensor(x), dim=0).numpy(), x.mean(0),
+        atol=1e-6)
+    np.testing.assert_allclose(
+        paddle.reduce_max(paddle.to_tensor(x), dim=1, keep_dim=True).numpy(),
+        x.max(1, keepdims=True), atol=1e-6)
+    np.testing.assert_allclose(
+        paddle.reduce_prod(paddle.to_tensor(x)).numpy(), x.prod(), rtol=1e-5)
+
+
+def test_fill_constant_and_global_var():
+    out = paddle.fill_constant([2, 3], "int64", 7)
+    assert out.numpy().dtype == np.int64 and (out.numpy() == 7).all()
+    g = paddle.create_global_var([2], 1.5, "float32")
+    np.testing.assert_allclose(g.numpy(), [1.5, 1.5])
+
+
+def test_create_parameter_trains():
+    import paddle_tpu.optimizer as opt
+    p = paddle.create_parameter([2, 2], "float32")
+    o = opt.SGD(learning_rate=0.1, parameters=[p])
+    before = p.numpy().copy()
+    loss = paddle.sum(p * p)
+    loss.backward(); o.step()
+    assert not np.allclose(p.numpy(), before)
+
+
+def test_shard_index():
+    ids = paddle.to_tensor(np.array([0, 9, 10, 19], np.int64))
+    out = paddle.shard_index(ids, 20, 2, 0).numpy()
+    np.testing.assert_array_equal(out, [0, 9, -1, -1])
+    out1 = paddle.shard_index(ids, 20, 2, 1, ignore_value=-7).numpy()
+    np.testing.assert_array_equal(out1, [-7, -7, 0, 9])
+
+
+def test_shape_has_nan_inf():
+    x = paddle.to_tensor(np.array([[1.0, np.inf]], np.float32))
+    np.testing.assert_array_equal(paddle.shape(x).numpy(), [1, 2])
+    assert bool(paddle.has_inf(x).numpy()[0])
+    assert not bool(paddle.has_nan(x).numpy()[0])
+
+
+def test_selected_rows_to_tensor():
+    from paddle_tpu.core.selected_rows import SelectedRows
+    sr = SelectedRows([0, 2], np.array([[1.0], [2.0]]), height=4)
+    np.testing.assert_allclose(
+        paddle.get_tensor_from_selected_rows(sr).numpy(), [[1.0], [2.0]])
+
+
+def test_dygraph_switches_and_misc():
+    assert paddle.in_dygraph_mode()
+    paddle.disable_dygraph()
+    assert not paddle.in_dygraph_mode()
+    paddle.enable_dygraph()
+    assert paddle.in_dygraph_mode()
+    paddle.monkey_patch_math_varbase()
+    paddle.monkey_patch_variable()
+    assert paddle.get_cudnn_version() is None
+    assert not paddle.is_compiled_with_xpu()
+    assert paddle.LoDTensor is paddle.Tensor
+    assert paddle.VarBase is paddle.Tensor
+    st = paddle.get_cuda_rng_state()
+    paddle.set_cuda_rng_state(st)
+
+
+def test_static_data_placeholder():
+    spec = paddle.static.data("img", [-1, 3, 32, 32], "float32")
+    assert spec.shape == (None, 3, 32, 32)
+    assert spec.name == "img"
+
+
+def test_crop_tensor():
+    x = paddle.to_tensor(np.arange(16, dtype=np.float32).reshape(4, 4))
+    out = paddle.crop_tensor(x, shape=[2, 2], offsets=[1, 1]).numpy()
+    np.testing.assert_allclose(out, [[5, 6], [9, 10]])
