@@ -47,8 +47,11 @@ from . import profiler  # noqa: F401
 from .core import monitor  # noqa: F401
 from . import device  # noqa: F401
 
-# 2.0-era top-level compatibility tail (reference python/paddle/__init__.py
-# re-exports these fluid-era names at the top level)
+# fluid-era compatibility tail. The reference exposes these through
+# paddle.fluid.layers.* (its 2.0 __init__ lists most of them commented
+# out); they live at the top level HERE as migration shims so fluid-era
+# user code ports with one import change — a deliberate superset of the
+# reference's top-level contract.
 from .legacy_alias import *  # noqa: F401,F403
 from .distributed.parallel import DataParallel  # noqa: F401
 from .hapi import callbacks  # noqa: F401

@@ -79,8 +79,15 @@ class CompiledTrainStep:
 
     def _put_data(self, d):
         """Shard one data arg; the spec is truncated to the array's rank
-        (a [B] per-sample tensor under dp x sp sharding takes P('dp'))."""
+        (a [B] per-sample tensor under dp x sp sharding takes P('dp')).
+        An optional _data_preproc (pipeline: host-side microbatch
+        reshape) runs BEFORE device_put so the program never reshapes
+        across sharded dims — that reshape forced the SPMD partitioner
+        into replicate-then-repartition fallbacks."""
         d = jnp.asarray(d)
+        pre = getattr(self, "_data_preproc", None)
+        if pre is not None:
+            d = pre(d)
         sh = self.data_sharding
         if isinstance(sh, NamedSharding) and len(sh.spec) > d.ndim:
             sh = NamedSharding(sh.mesh, P(*sh.spec[:d.ndim]))
@@ -440,7 +447,10 @@ def _build_pipeline_program(layer, optimizer, strategy, mesh, *, block_fn,
     s_sh = _slot_shardings(mesh, opt_state, flat, slot_specs)
     buf_sh = {k: NamedSharding(mesh, P(*([None] * getattr(v, "ndim", 0))))
               for k, v in state.items()}
-    data_sh = NamedSharding(mesh, P("dp") if n_dp > 1 else P())
+    # data arrives pre-microbatched ([n_micro, mb, T] via _data_preproc),
+    # so the spec leads with the unsharded micro dim
+    data_sh = NamedSharding(
+        mesh, P(None, "dp" if n_dp > 1 else None, seq_axis))
 
     # shard_map in_specs derive from the SAME pspecs the jit in_shardings
     # use — one source of truth for the stacked layout. Training runs the
@@ -480,10 +490,7 @@ def _build_pipeline_program(layer, optimizer, strategy, mesh, *, block_fn,
                 epp = _sub(p, "embed.")
                 hpp = _sub(p, "head.")
                 spp = _sub(p, "stacked.")
-                mb = ids.shape[0] // n_micro
-                ids_m = ids.reshape((n_micro, mb) + ids.shape[1:])
-                lab_m = labels.reshape((n_micro, mb) + labels.shape[1:])
-                out = pipe_vag(spp, epp, hpp, ids_m, lab_m, key)
+                out = pipe_vag(spp, epp, hpp, ids, labels, key)
                 if aux_from_blocks:
                     sums, counts, d_sp, d_ep, d_hp, aux_s = out
                 else:
@@ -522,6 +529,14 @@ def _build_pipeline_program(layer, optimizer, strategy, mesh, *, block_fn,
     prog._opt = optimizer
     prog._n_layers = n_layers
 
+    def _microbatch(d):
+        if d.shape[0] % n_micro:
+            raise ValueError(
+                f"pipeline batch {d.shape[0]} not divisible by "
+                f"accumulate_steps {n_micro}")
+        return d.reshape((n_micro, d.shape[0] // n_micro) + d.shape[1:])
+    prog._data_preproc = _microbatch
+
     def _eval_builder():
         from ..pipeline import pipeline_spmd
 
@@ -546,9 +561,7 @@ def _build_pipeline_program(layer, optimizer, strategy, mesh, *, block_fn,
                 epp = _sub(p, "embed.")
                 hpp = _sub(p, "head.")
                 spp = _sub(p, "stacked.")
-                mb = ids.shape[0] // n_micro
-                ids_m = ids.reshape((n_micro, mb) + ids.shape[1:])
-                lab_m = labels.reshape((n_micro, mb) + labels.shape[1:])
+                ids_m, lab_m = ids, labels
                 h = jax.vmap(embed_fn, in_axes=(None, 0))(epp, ids_m)
                 out = pipe(spp, h)
                 h, aux_s = out if aux_from_blocks else (out, 0.0)

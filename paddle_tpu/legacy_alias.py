@@ -1,11 +1,14 @@
-"""2.0-era top-level compatibility aliases.
+"""Fluid-era compatibility aliases.
 
-Reference: python/paddle/__init__.py re-exports a fluid-era tail —
-elementwise_*, reduce_*, fill_constant, create_parameter,
-create_global_var, shard_index, crop_tensor, shape, has_inf/has_nan,
-DataParallel, LoDTensor aliases, dygraph mode switches — so user code
-written against 2.0 imports them from the top level. Each alias here
-delegates to the modern op with the legacy signature adapted.
+Reference surface: paddle.fluid.layers.* (fluid/layers/nn.py,
+tensor.py, ops.py) — elementwise_*, reduce_*, fill_constant,
+create_parameter, create_global_var, shard_index, crop_tensor, shape,
+has_inf/has_nan — plus the genuinely top-level shard_index/
+monkey_patch/dygraph-switch names from python/paddle/__init__.py.
+Exported at the top level here as migration shims (a deliberate
+superset of the reference's top-level contract: the reference keeps
+most of these under paddle.fluid.layers); each delegates to the modern
+op with the legacy signature adapted.
 """
 from __future__ import annotations
 
