@@ -247,21 +247,27 @@ def compile_train_step(layer, optimizer, strategy: DistributedStrategy,
     data_sh = NamedSharding(mesh, P("dp", "sp") if n_sp > 1 else P("dp"))
 
     # ---- the traced step -------------------------------------------------
-    def forward_loss(p, st, key, *data):
+    def _run_contexts():
+        """One source of truth for the amp + sequence-parallel scopes the
+        train AND eval traces run under."""
         import contextlib
 
         from ... import amp as amp_mod
         from ...nn.functional.attention import seq_parallel_scope
-        if n_sp > 1:
-            sp_ctx = seq_parallel_scope(
-                mesh, "sp", impl=strategy.sequence_parallel_impl,
-                batch_axis="dp" if n_dp > 1 else None,
-                head_axis="tp" if n_tp > 1 else None)
-        else:
-            sp_ctx = contextlib.nullcontext()
+        sp_ctx = (seq_parallel_scope(
+            mesh, "sp", impl=strategy.sequence_parallel_impl,
+            batch_axis="dp" if n_dp > 1 else None,
+            head_axis="tp" if n_tp > 1 else None)
+            if n_sp > 1 else contextlib.nullcontext())
+        amp_ctx = amp_mod.auto_cast(enable=amp_on,
+                                    level="O2" if pure_bf16 else "O1",
+                                    dtype="bfloat16")
+        return sp_ctx, amp_ctx
+
+    def forward_loss(p, st, key, *data):
+        sp_ctx, amp_ctx = _run_contexts()
         with random_mod.key_scope(key):
-            with amp_mod.auto_cast(enable=amp_on, level="O2" if pure_bf16
-                                   else "O1", dtype="bfloat16"):
+            with amp_ctx:
                 with sp_ctx:
                     out, new_state = functional_call(wrapped, p, st, *data)
         return out, new_state
@@ -331,14 +337,33 @@ def compile_train_step(layer, optimizer, strategy: DistributedStrategy,
     prog._opt = optimizer
 
     def _eval_builder():
+        # when the layer exposes loss_and_outs (hapi's adapter does),
+        # the sharded eval also returns the forward outputs so Metric
+        # states accumulate WITHOUT gathering params — only the batch's
+        # outputs cross to host (reference hapi/model.py:810 runs
+        # metrics uniformly through prepare/fit/evaluate)
+        has_outs = getattr(layer, "loss_and_outs", None) is not None
+        wrapped_eval = (MethodAdapter(layer, "loss_and_outs") if has_outs
+                        else None)
+
         def eval_fn(p, st, data):
             # fixed key: eval-mode layers draw no dropout, and any
             # stray randomness must at least be deterministic
+            if has_outs:
+                sp_ctx, amp_ctx = _run_contexts()
+                with random_mod.key_scope(jax.random.key(0)):
+                    with amp_ctx:
+                        with sp_ctx:
+                            (loss, outs), _ = functional_call(
+                                wrapped_eval, p, st, *data)
+                return loss, outs
             out, _ = forward_loss(p, st, jax.random.key(0), *data)
             return out
 
+        out_sh = ((NamedSharding(mesh, P()), None) if has_outs
+                  else NamedSharding(mesh, P()))
         ejit = jax.jit(eval_fn, in_shardings=(p_sh, buf_sh, None),
-                       out_shardings=NamedSharding(mesh, P()))
+                       out_shardings=out_sh)
 
         def runner(p, st, data):
             # trace under eval mode (dropout off, BN uses running stats)
@@ -355,6 +380,8 @@ def compile_train_step(layer, optimizer, strategy: DistributedStrategy,
 
     prog._eval_builder = _eval_builder
     prog._eval_batch_divisor = max(n_dp, 1)
+    prog._eval_returns_outs = (getattr(layer, "loss_and_outs", None)
+                               is not None)
     return prog
 
 
@@ -561,13 +588,12 @@ def _build_pipeline_program(layer, optimizer, strategy, mesh, *, block_fn,
                 epp = _sub(p, "embed.")
                 hpp = _sub(p, "head.")
                 spp = _sub(p, "stacked.")
-                ids_m, lab_m = ids, labels
-                h = jax.vmap(embed_fn, in_axes=(None, 0))(epp, ids_m)
+                h = jax.vmap(embed_fn, in_axes=(None, 0))(epp, ids)
                 out = pipe(spp, h)
                 h, aux_s = out if aux_from_blocks else (out, 0.0)
                 sums, counts = jax.vmap(
                     head_loss_fn, in_axes=(None, None, 0, 0))(
-                    hpp, epp, h, lab_m)
+                    hpp, epp, h, labels)
             loss = sums.sum() / jnp.maximum(counts.sum(), 1.0)
             if aux_from_blocks:
                 loss = loss + aux_coef * aux_s / (n_layers * n_micro)

@@ -182,12 +182,14 @@ class Model:
         elif isinstance(amp_configs, dict):
             self._amp_level = amp_configs.get("level", "O1")
         self._strategy = strategy
-        if strategy is not None and self._metrics:
+        if strategy is not None and self._metrics and \
+                getattr(strategy, "pipeline", False):
             import warnings
             warnings.warn(
-                "metrics are not computed on the strategy training path "
-                "(the compiled step returns only the loss); use "
-                "Model.evaluate() for metrics")
+                "metrics under a PIPELINE strategy evaluate on the synced "
+                "host path (the pp eval program computes only the loss); "
+                "non-pp strategies compute metrics under the training "
+                "shardings")
         if strategy is not None and self._amp_level != "O0" \
                 and not strategy.amp:
             import warnings
@@ -350,6 +352,17 @@ class Model:
                     return Tensor(model._compute_loss(outs,
                                                       list(batch[k:])))
 
+                def loss_and_outs(self, *batch):
+                    """Sharded-eval protocol: loss + forward outputs so
+                    metric states accumulate without gathering params."""
+                    k = model._dist_n_inputs
+                    outs = net(*batch[:k])
+                    first = outs[0] if isinstance(outs, (list, tuple)) \
+                        else outs
+                    return (Tensor(model._compute_loss(outs,
+                                                       list(batch[k:]))),
+                            first)
+
             self._dist_n_inputs = len(inputs)
             from ..distributed import mesh as mesh_mod
             mesh = mesh_mod.get_mesh()
@@ -462,16 +475,27 @@ class Model:
         b0 = getattr(batch0, "shape", None)
         b0 = (b0[0] if b0 else
               (len(batch0) if hasattr(batch0, "__len__") else None))
+        metrics_ok = (not self._metrics or
+                      getattr(prog, "_eval_returns_outs", False))
         if getattr(self, "_strategy", None) is not None and \
                 prog is not None and \
                 getattr(prog, "_eval_builder", None) is not None and \
-                not self._metrics and batch0 is not None and div and \
+                metrics_ok and batch0 is not None and div and \
                 b0 is not None and b0 % div == 0 and b0 >= div:
-            # evaluate under the TRAINING shardings — no host gather, no
-            # single-device replication of a model that only fits
-            # sharded (pp/tp/ZeRO-3 scale). Metric users and partial
-            # final batches fall through to the synced path.
-            loss = prog.eval_step(*_to_jax(inputs), *_to_jax(labels))
+            # evaluate under the TRAINING shardings — no host gather of
+            # params, no single-device replication of a model that only
+            # fits sharded (pp/tp/ZeRO-3 scale). Metric states come from
+            # the step's returned outputs (batch-sized transfer only);
+            # pipeline programs (no outs) and partial final batches fall
+            # through to the synced path.
+            labels_j = _to_jax(labels)
+            res = prog.eval_step(*_to_jax(inputs), *labels_j)
+            if getattr(prog, "_eval_returns_outs", False):
+                loss, outs = res
+                if self._metrics:
+                    self._update_metrics(jax.device_get(outs), labels_j)
+            else:
+                loss = res
             return [float(jax.device_get(loss))]
         self._sync_dist_if_dirty()     # eval on the TRAINED params
         if self._jit_eval is None:

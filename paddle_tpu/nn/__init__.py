@@ -13,6 +13,8 @@ from .layer.pooling import *     # noqa: F401,F403
 from .layer.rnn import *         # noqa: F401,F403
 from .layer.transformer import *  # noqa: F401,F403
 from .layer.extras import *      # noqa: F401,F403
+from .layer.decode import (Decoder, BeamSearchDecoder, dynamic_decode,  # noqa: F401
+                           gather_tree)
 from .functional.extension import crf_decoding  # noqa: F401
 
 from ..framework import Parameter, ParamAttr  # noqa: F401

@@ -1,0 +1,318 @@
+"""Seq2seq decoding: Decoder, BeamSearchDecoder, dynamic_decode,
+gather_tree.
+
+Reference: fluid/layers/rnn.py — Decoder:790, BeamSearchDecoder:866,
+dynamic_decode:1581; gather_tree op (fluid/layers/nn.py gather_tree,
+kernel gather_tree_op.h).
+
+TPU-native design: the decode loop is a lax.while_loop over preallocated
+[max_step, batch, beam] buffers — static shapes, so the same code runs
+eagerly AND exports/jits (the reference builds a dynamic While program
+with growing LoDTensorArrays, which XLA cannot express). Finished beams
+are masked to emit only EOS exactly like the reference's noend mask
+(_beam_search_step, kinf = 1e9).
+"""
+from __future__ import annotations
+
+from collections import namedtuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+
+__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode", "gather_tree"]
+
+_KINF = 1e9
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _map(fn, struct):
+    """map over a (possibly nested tuple/list) structure of tensors."""
+    if isinstance(struct, (tuple, list)):
+        return type(struct)(_map(fn, s) for s in struct)
+    return fn(struct)
+
+
+def _unwrap_tree(t):
+    """Tensor leaves -> raw arrays; namedtuples/tuples/dicts stay pytrees."""
+    return jax.tree_util.tree_map(
+        lambda x: x._data if isinstance(x, Tensor) else jnp.asarray(x), t,
+        is_leaf=lambda x: isinstance(x, Tensor))
+
+
+class Decoder:
+    """Decoder interface (fluid/layers/rnn.py:790): initialize / step /
+    finalize contract used by dynamic_decode."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        raise NotImplementedError
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+
+class BeamSearchDecoder(Decoder):
+    """Beam-search wrapper over an RNN cell
+    (fluid/layers/rnn.py:866). Cell inputs/states ride merged
+    [batch*beam, ...] layout; scores accumulate log-softmax
+    probabilities; finished beams emit only end_token."""
+
+    OutputWrapper = namedtuple("OutputWrapper",
+                               ("scores", "predicted_ids", "parent_ids"))
+    StateWrapper = namedtuple("StateWrapper",
+                              ("cell_states", "log_probs", "finished",
+                               "lengths"))
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """[B, ...] -> [B*beam, ...] with each row repeated beam times
+        (reference rnn.py tile_beam_merge_with_batch)."""
+        a = _arr(x)
+        out = jnp.repeat(a, beam_size, axis=0)
+        return Tensor(out) if isinstance(x, Tensor) else out
+
+    # -- layout helpers ----------------------------------------------------
+    def _merge(self, a):
+        return a.reshape((-1,) + a.shape[2:])           # [B, K, ...] -> BK
+
+    def _split(self, a):
+        return a.reshape((-1, self.beam_size) + a.shape[1:])
+
+    def _gather_beams(self, a, beam_indices):
+        """a [B, K, ...]; beam_indices [B, K] -> rows reordered per beam."""
+        b = a.shape[0]
+        return a[jnp.arange(b)[:, None], beam_indices]
+
+    # -- Decoder interface (raw-array core) --------------------------------
+    def initialize(self, inits):
+        """inits: cell states [B, ...] (nested). Returns (inputs, state,
+        finished) with state a StateWrapper; log probs start [0, -inf...]
+        so step 0 expands only beam 0 (reference rnn.py:281-283)."""
+        cell_states = _map(lambda s: jnp.repeat(_arr(s), self.beam_size,
+                                                axis=0), inits)
+        first = jax.tree_util.tree_leaves(_unwrap_tree(cell_states))[0]
+        b = first.shape[0] // self.beam_size
+        log_probs = jnp.tile(
+            jnp.asarray([[0.0] + [-_KINF] * (self.beam_size - 1)],
+                        jnp.float32), (b, 1))
+        finished = jnp.zeros((b, self.beam_size), bool)
+        lengths = jnp.zeros((b, self.beam_size), jnp.int32)
+        ids = jnp.full((b, self.beam_size), self.start_token, jnp.int32)
+        inputs = self._embed(ids)
+        return inputs, self.StateWrapper(cell_states, log_probs, finished,
+                                         lengths), finished
+
+    def _embed(self, ids):
+        if self.embedding_fn is None:
+            return self._merge(ids)
+        out = self.embedding_fn(Tensor(self._merge(ids)))
+        return _arr(out)
+
+    def step(self, time, inputs, states, **kwargs):
+        cell_out, next_cell_states = self.cell(
+            Tensor(inputs), _map(Tensor, states.cell_states))
+        logits = cell_out
+        if self.output_fn is not None:
+            logits = self.output_fn(logits)
+        logits = self._split(_arr(logits))              # [B, K, V]
+        next_cell_states = _map(_arr, next_cell_states)
+        vocab = logits.shape[-1]
+
+        step_lp = jax.nn.log_softmax(logits)
+        # finished beams: all mass on end_token (noend mask)
+        noend = jnp.full((vocab,), -_KINF).at[self.end_token].set(0.0)
+        step_lp = jnp.where(states.finished[:, :, None], noend[None, None],
+                            step_lp)
+        log_probs = step_lp + states.log_probs[:, :, None]   # [B, K, V]
+        b = log_probs.shape[0]
+        scores = log_probs.reshape(b, self.beam_size * vocab)
+        topk_scores, topk_idx = jax.lax.top_k(scores, self.beam_size)
+        beam_idx = topk_idx // vocab                     # [B, K]
+        token_idx = (topk_idx % vocab).astype(jnp.int32)
+
+        next_cell_states = _map(
+            lambda a: self._merge(self._gather_beams(self._split(a),
+                                                     beam_idx)),
+            next_cell_states)
+        next_finished = self._gather_beams(states.finished, beam_idx)
+        next_lengths = self._gather_beams(states.lengths, beam_idx)
+        next_lengths = next_lengths + (~next_finished).astype(jnp.int32)
+        next_finished = next_finished | (token_idx == self.end_token)
+
+        outputs = self.OutputWrapper(topk_scores, token_idx,
+                                     beam_idx.astype(jnp.int32))
+        next_states = self.StateWrapper(next_cell_states, topk_scores,
+                                        next_finished, next_lengths)
+        next_inputs = self._embed(token_idx)
+        return outputs, next_states, next_inputs, next_finished
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        """Trace parent pointers back into whole sequences
+        (reference finalize -> gather_tree)."""
+        predicted = _gather_tree_arrays(outputs.predicted_ids,
+                                        outputs.parent_ids)
+        return predicted, final_states
+
+    @property
+    def tracks_own_finished(self):
+        return True
+
+
+def _gather_tree_arrays(ids, parents):
+    """ids/parents [T, B, K] -> full beams [T, B, K]
+    (kernel gather_tree_op.h backward trace)."""
+    t = ids.shape[0]
+
+    def body(carry, xs):
+        beam = carry                     # [B, K] current beam pointer
+        ids_t, parents_t = xs
+        b = ids_t.shape[0]
+        tok = ids_t[jnp.arange(b)[:, None], beam]
+        nxt = parents_t[jnp.arange(b)[:, None], beam]
+        return nxt, tok
+    k = ids.shape[-1]
+    init = jnp.broadcast_to(jnp.arange(k), ids.shape[1:]).astype(
+        parents.dtype)
+    _, toks = jax.lax.scan(body, init, (ids[::-1], parents[::-1]))
+    return toks[::-1]
+
+
+def gather_tree(ids, parents):
+    """Public gather_tree (fluid/layers/nn.py gather_tree): [T, B, K]
+    int tensors."""
+    out = _gather_tree_arrays(_arr(ids), _arr(parents))
+    return Tensor(out)
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """Run the decoder until every beam finished or max_step_num
+    (fluid/layers/rnn.py:1581). Returns (outputs, final_states[,
+    sequence_lengths]). Works with BeamSearchDecoder and any custom
+    Decoder following the initialize/step/finalize contract (states and
+    outputs may be arbitrary pytrees, namedtuples included).
+
+    TPU note: outputs live in [max_step_num, ...] buffers inside a
+    lax.while_loop, so the decode jits and exports; max_step_num=None
+    falls back to 256 steps (the reference's unbounded While cannot have
+    static shapes). Buffer rows past the stop step hold the step-0
+    template values — for BeamSearchDecoder the parent buffer pads with
+    the identity permutation so gather_tree passes through them
+    untouched."""
+    max_t = int(max_step_num) if max_step_num is not None else 256
+
+    inputs0, states0, finished0 = decoder.initialize(inits)
+    inputs0 = _unwrap_tree(inputs0)
+    states0 = _unwrap_tree(states0)
+    finished0 = _arr(finished0)
+
+    # run step 0 outside the loop: its outputs define the buffer shapes
+    out0, st1, in1, fin1 = decoder.step(jnp.asarray(0), inputs0, states0)
+    out0 = _unwrap_tree(out0)
+    st1 = _unwrap_tree(st1)
+    in1 = _unwrap_tree(in1)
+    fin1 = _arr(fin1)
+    own_fin = bool(getattr(decoder, "tracks_own_finished", False))
+    fin_acc1 = fin1 if own_fin else (finished0 | fin1)
+    lengths1 = (~finished0).astype(jnp.int32)
+
+    flat_out0, out_def = jax.tree_util.tree_flatten(out0)
+    flat_st1, st_def = jax.tree_util.tree_flatten(st1)
+    is_beam_out = isinstance(out0, BeamSearchDecoder.OutputWrapper)
+
+    bufs = []
+    for i, a in enumerate(flat_out0):
+        if is_beam_out and i == 2:
+            # parent_ids: identity padding so gather_tree's backward
+            # trace passes through unexecuted rows unchanged
+            k = a.shape[-1]
+            init = jnp.broadcast_to(jnp.arange(k, dtype=a.dtype),
+                                    (max_t,) + a.shape)
+        else:
+            init = jnp.zeros((max_t,) + a.shape, a.dtype)
+        bufs.append(init.at[0].set(a))
+
+    def cond(carry):
+        t = carry[0]
+        fin = carry[3]
+        return jnp.logical_and(t < max_t, ~jnp.all(fin))
+
+    def body(carry):
+        t, inputs, flat_st, fin, lengths, bufs_c = carry
+        states = jax.tree_util.tree_unflatten(st_def, flat_st)
+        out, next_st, next_in, step_fin = decoder.step(t, inputs, states)
+        out = _unwrap_tree(out)
+        next_st = _unwrap_tree(next_st)
+        next_in = _unwrap_tree(next_in)
+        step_fin = _arr(step_fin)
+        next_fin = step_fin if own_fin else (fin | step_fin)
+        next_lengths = lengths + (~fin).astype(jnp.int32)
+        if impute_finished:
+            old_flat = flat_st
+            new_flat = jax.tree_util.tree_flatten(next_st)[0]
+            mask = fin.reshape(-1)
+            imputed = [jnp.where(mask.reshape((-1,) + (1,) * (n.ndim - 1)),
+                                 o, n) if n.shape[:1] == mask.shape else n
+                       for o, n in zip(old_flat, new_flat)]
+            next_st = jax.tree_util.tree_unflatten(st_def, imputed)
+        flat_o = jax.tree_util.tree_flatten(out)[0]
+        bufs_n = [b.at[t].set(a) for b, a in zip(bufs_c, flat_o)]
+        return (t + 1, next_in,
+                jax.tree_util.tree_flatten(next_st)[0],
+                next_fin, next_lengths, bufs_n)
+
+    carry0 = (jnp.asarray(1), in1, flat_st1, fin_acc1, lengths1, bufs)
+    (t_end, _, flat_st, fin, lengths, bufs) = jax.lax.while_loop(
+        cond, body, carry0)
+
+    final_states_raw = jax.tree_util.tree_unflatten(st_def, flat_st)
+    outputs_raw = jax.tree_util.tree_unflatten(out_def, bufs)
+    # for decoders carrying lengths in their state (BeamSearchDecoder),
+    # the state's count is authoritative
+    seq_lengths = getattr(final_states_raw, "lengths", lengths)
+
+    final_states = jax.tree_util.tree_map(Tensor, final_states_raw)
+    try:
+        finalized, _ = decoder.finalize(outputs_raw, final_states,
+                                        seq_lengths)
+        out_tree = _unwrap_tree(finalized)
+    except NotImplementedError:
+        out_tree = outputs_raw
+
+    # trim to executed steps (concrete eagerly; padded extent under jit)
+    try:
+        n_valid = int(t_end)
+        out_tree = jax.tree_util.tree_map(lambda a: a[:n_valid], out_tree)
+    except (TypeError, jax.errors.TracerIntegerConversionError,
+            jax.errors.ConcretizationTypeError):
+        pass
+    if not output_time_major:
+        out_tree = jax.tree_util.tree_map(
+            lambda a: jnp.swapaxes(a, 0, 1), out_tree)
+    out_tree = jax.tree_util.tree_map(Tensor, out_tree)
+    res = [out_tree, final_states]
+    if return_length:
+        res.append(Tensor(seq_lengths))
+    return tuple(res)

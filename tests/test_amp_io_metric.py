@@ -334,3 +334,60 @@ def test_dataloader_persistent_workers():
         seen2.extend(int(v) for v in yb["label"].numpy())
     assert seen2 == list(range(32))
     it1._shutdown()
+
+
+def test_metrics_on_strategy_path_parity():
+    """prepare(strategy, metrics=...) evaluates under the training
+    shardings and matches host-path metrics exactly (r3 verdict #5)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.distributed.fleet.strategy import DistributedStrategy
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.io import TensorDataset
+    from paddle_tpu.metric import Accuracy
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 8).astype(np.float32)
+    y = rng.randint(0, 4, (16, 1)).astype(np.int64)
+    ds = TensorDataset([paddle.to_tensor(x), paddle.to_tensor(y)])
+
+    def build(strategy):
+        paddle.seed(7)
+        net = nn.Linear(8, 4)
+        m = Model(net)
+        m.prepare(opt.SGD(learning_rate=0.1,
+                          parameters=net.parameters()),
+                  nn.CrossEntropyLoss(),
+                  metrics=Accuracy(topk=(1, 2)),
+                  strategy=strategy)
+        if strategy is not None:
+            # build the dist program by running one training step
+            m.fit(ds, batch_size=16, epochs=1, verbose=0, shuffle=False)
+        return m
+
+    s = DistributedStrategy()
+    s.hybrid_configs.dp_degree = 8
+    m_dist = build(s)
+    logs_dist = m_dist.evaluate(ds, batch_size=16, verbose=0)
+    assert "acc_top1" in logs_dist and "acc_top2" in logs_dist
+    # the sharded path must have been used: program reports outs support
+    assert getattr(m_dist._dist_prog, "_eval_returns_outs", False)
+
+    # host-path reference with the SAME trained weights
+    m_dist._sync_network()
+    paddle.seed(7)
+    net_ref = nn.Linear(8, 4)
+    for (k1, p1), (k2, p2) in zip(net_ref.named_parameters(),
+                                  m_dist.network.named_parameters()):
+        p1.set_value(np.asarray(p2.numpy()))
+    m_ref = Model(net_ref)
+    m_ref.prepare(None, nn.CrossEntropyLoss(), metrics=Accuracy(topk=(1, 2)))
+    logs_ref = m_ref.evaluate(ds, batch_size=16, verbose=0)
+    np.testing.assert_allclose(logs_dist["acc_top1"], logs_ref["acc_top1"],
+                               atol=1e-6)
+    np.testing.assert_allclose(logs_dist["acc_top2"], logs_ref["acc_top2"],
+                               atol=1e-6)
+    np.testing.assert_allclose(logs_dist["loss"], logs_ref["loss"],
+                               atol=1e-4)

@@ -1,0 +1,202 @@
+"""BeamSearchDecoder + dynamic_decode (reference fluid/layers/rnn.py:866,
+1581; test strategy: test_rnn_decode_api.py greedy-equivalence +
+hand-checked beam)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+RNG = np.random.RandomState(17)
+
+
+class _FixedLogitCell(nn.RNNCellBase):
+    """Cell that ignores input and emits logits from a fixed table
+    indexed by time (via a counter in state)."""
+
+    def __init__(self, table):
+        super().__init__()
+        self.table = np.asarray(table, np.float32)   # [T, V]
+
+    def forward(self, inputs, states):
+        import jax.numpy as jnp
+        from paddle_tpu.core.tensor import Tensor
+        step = states._data if isinstance(states, Tensor) else states
+        t = jnp.clip(step[:, 0].astype(jnp.int32), 0, len(self.table) - 1)
+        logits = jnp.asarray(self.table)[t]
+        return Tensor(logits), Tensor(step + 1.0)
+
+
+def test_gather_tree_hand_case():
+    # kernel example: T=3, B=1, K=2
+    ids = np.array([[[2, 2]], [[6, 1]], [[3, 9]]], np.int64)
+    parents = np.array([[[0, 0]], [[1, 0]], [[0, 1]]], np.int64)
+    out = nn.gather_tree(paddle.to_tensor(ids),
+                         paddle.to_tensor(parents)).numpy()
+    # beam 0 at t=2 came from parent 0 at t=1 (token 6), whose parent at
+    # t=0 is 1 -> token 2; beam 1 traces 9 <- parent 1 (token 1) <- 0 (2)
+    np.testing.assert_array_equal(out[:, 0, 0], [2, 6, 3])
+    np.testing.assert_array_equal(out[:, 0, 1], [2, 1, 9])
+
+
+def test_beam1_equals_greedy():
+    V = 6
+    table = RNG.randn(5, V).astype(np.float32)
+    table[:, 0] -= 100.0          # avoid instant EOS (end_token=0)
+    cell = _FixedLogitCell(table)
+    dec = nn.BeamSearchDecoder(cell, start_token=1, end_token=0,
+                               beam_size=1,
+                               embedding_fn=lambda ids: paddle.to_tensor(
+                                   np.zeros((int(np.prod(ids.shape)), 1),
+                                            np.float32)))
+    init = paddle.to_tensor(np.zeros((2, 1), np.float32))
+    out, _, lens = nn.dynamic_decode(dec, inits=init, max_step_num=5,
+                                     return_length=True)
+    pred = out.numpy()                  # [B, T, 1]
+    greedy = table.argmax(axis=1)
+    for b in range(2):
+        np.testing.assert_array_equal(pred[b, :, 0], greedy)
+
+
+def test_beam4_hand_checked():
+    # V=3, end=2. Step logits chosen so the best 2-step path switches beams
+    t0 = np.log(np.array([0.6, 0.3, 0.1], np.float32))
+    t1 = np.log(np.array([0.1, 0.2, 0.7], np.float32))
+    table = np.stack([t0, t1])
+    cell = _FixedLogitCell(table)
+    dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=2,
+                               beam_size=3,
+                               embedding_fn=lambda ids: paddle.to_tensor(
+                                   np.zeros((int(np.prod(ids.shape)), 1),
+                                            np.float32)))
+    init = paddle.to_tensor(np.zeros((1, 1), np.float32))
+    out, states, lens = nn.dynamic_decode(dec, inits=init, max_step_num=2,
+                                          return_length=True)
+    pred = out.numpy()[0]               # [T, K]
+    # step0 best tokens: 0 (0.6), 1 (0.3), 2 (0.1). step1 all beams see
+    # the same logits; best joint: 0->2 (0.6*0.7); then 1->2 (0.3*0.7);
+    # then the step-0 EOS beam (0.1, frozen emitting eos, total 0.1 >
+    # 0.6*0.2=0.12? no: 0.12 > 0.1) -> 0->1 (0.12)
+    np.testing.assert_array_equal(pred[:, 0], [0, 2])
+    np.testing.assert_array_equal(pred[:, 1], [1, 2])
+    np.testing.assert_array_equal(pred[:, 2], [0, 1])
+    sc = states.log_probs.numpy()[0]
+    np.testing.assert_allclose(np.exp(sc), [0.42, 0.21, 0.12], atol=1e-4)
+    np.testing.assert_array_equal(lens.numpy()[0], [2, 2, 2])
+
+
+def test_beam_search_with_real_gru_trains_nothing_but_runs():
+    # full wiring: embedding + GRUCell + output projection, batch 2
+    V, D, H, K = 10, 8, 8, 4
+    emb = nn.Embedding(V, D)
+    cell = nn.GRUCell(D, H)
+    proj = nn.Linear(H, V)
+    dec = nn.BeamSearchDecoder(cell, start_token=1, end_token=2,
+                               beam_size=K, embedding_fn=emb,
+                               output_fn=proj)
+    enc_final = paddle.to_tensor(RNG.randn(2, H).astype(np.float32))
+    out, states, lens = nn.dynamic_decode(dec, inits=enc_final,
+                                          max_step_num=7,
+                                          return_length=True)
+    o = out.numpy()
+    assert o.shape[0] == 2 and o.shape[2] == K and o.shape[1] <= 7
+    assert (o >= 0).all() and (o < V).all()
+    assert lens.numpy().shape == (2, K)
+    # time-major variant
+    out_tm, _ = nn.dynamic_decode(dec, inits=enc_final, max_step_num=4,
+                                  output_time_major=True)
+    assert out_tm.numpy().shape[1] == 2
+
+
+def test_dynamic_decode_stops_on_eos():
+    # logits force EOS at step 1 for every beam -> decode stops early
+    table = np.array([[0.0, 5.0, -5.0], [-5.0, -5.0, 5.0]], np.float32)
+    cell = _FixedLogitCell(table)
+    dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=2,
+                               beam_size=2,
+                               embedding_fn=lambda ids: paddle.to_tensor(
+                                   np.zeros((int(np.prod(ids.shape)), 1),
+                                            np.float32)))
+    init = paddle.to_tensor(np.zeros((1, 1), np.float32))
+    out, states, lens = nn.dynamic_decode(dec, inits=init, max_step_num=10,
+                                          return_length=True)
+    assert out.numpy().shape[1] == 2          # stopped at t=2, not 10
+    assert states.finished.numpy().all()
+
+
+def test_dynamic_decode_exports_under_jit():
+    import jax
+    import jax.numpy as jnp
+    V, D, H, K = 8, 4, 4, 2
+    emb = nn.Embedding(V, D)
+    cell = nn.GRUCell(D, H)
+    proj = nn.Linear(H, V)
+    dec = nn.BeamSearchDecoder(cell, start_token=1, end_token=0,
+                               beam_size=K, embedding_fn=emb,
+                               output_fn=proj)
+
+    def decode(enc):
+        out, _ = nn.dynamic_decode(dec, inits=paddle.to_tensor(enc),
+                                   max_step_num=5)
+        return out._data
+
+    enc = RNG.randn(2, H).astype(np.float32)
+    jitted = jax.jit(decode)
+    got = jitted(enc)
+    assert got.shape == (2, 5, K)
+    eager, _ = nn.dynamic_decode(dec, inits=paddle.to_tensor(enc),
+                                 max_step_num=5)
+    e = eager.numpy()
+    np.testing.assert_array_equal(np.asarray(got)[:, :e.shape[1]], e)
+
+
+def test_early_stop_preserves_distinct_beams():
+    # regression: padded gather_tree rows must not collapse beams to
+    # beam 0 when decoding stops well before max_step_num
+    t0 = np.log(np.array([0.55, 0.35, 0.1], np.float32))
+    t1 = np.log(np.array([0.05, 0.05, 0.9], np.float32))   # all -> EOS
+    cell = _FixedLogitCell(np.stack([t0, t1]))
+    dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=2,
+                               beam_size=3,
+                               embedding_fn=lambda ids: paddle.to_tensor(
+                                   np.zeros((int(np.prod(ids.shape)), 1),
+                                            np.float32)))
+    init = paddle.to_tensor(np.zeros((1, 1), np.float32))
+    out, _, lens = nn.dynamic_decode(dec, inits=init, max_step_num=20,
+                                     return_length=True)
+    pred = out.numpy()[0]
+    assert pred.shape[0] == 2          # stopped at t=2, not 20
+    # the three beams end distinct: 0->2, 1->2, 2(eos at t=0)
+    np.testing.assert_array_equal(pred[:, 0], [0, 2])
+    np.testing.assert_array_equal(pred[:, 1], [1, 2])
+    assert pred[0, 2] == 2
+
+
+def test_custom_decoder_generic_path():
+    import jax.numpy as jnp
+    from paddle_tpu.core.tensor import Tensor
+
+    class CountDecoder(nn.Decoder):
+        """Emits time indices; finishes after 3 steps."""
+
+        def initialize(self, inits):
+            b = int(inits.shape[0])
+            state = {"t": jnp.zeros((b,), jnp.int32)}
+            return jnp.zeros((b, 1), jnp.float32), state, \
+                jnp.zeros((b,), bool)
+
+        def step(self, time, inputs, states):
+            t = states["t"]
+            out = {"tok": t * 10}
+            nxt = {"t": t + 1}
+            fin = (t + 1) >= 3
+            return out, nxt, inputs, fin
+
+    dec = CountDecoder()
+    out, final = nn.dynamic_decode(
+        dec, inits=paddle.to_tensor(np.zeros((2, 1), np.float32)),
+        max_step_num=8)
+    tok = out["tok"].numpy()          # [B, T]
+    assert tok.shape == (2, 3)
+    np.testing.assert_array_equal(tok[0], [0, 10, 20])
+    np.testing.assert_array_equal(final["t"].numpy(), [3, 3])
