@@ -712,9 +712,12 @@ def _compile_pipeline_step(layer, optimizer, strategy, mesh):
                 f"pipeline + ulysses: {heads} attention heads not "
                 f"divisible by sp={n_sp} (use impl='ring' or adjust "
                 f"sep_degree)")
+        sp_is_moe = bool(getattr(getattr(layer, "cfg", None),
+                                 "moe_experts", 0))
         block_fn = sp_block(
             axis_sp="sp", impl=strategy.sequence_parallel_impl,
-            compute_dtype="bfloat16" if strategy.amp else None)
+            compute_dtype="bfloat16" if strategy.amp else None,
+            with_aux=sp_is_moe)
     return _build_pipeline_program(
         layer, optimizer, strategy, mesh, block_fn=block_fn,
         embed_fn=embed_fn, head_loss_fn=head_loss_fn, ep=ep, hp=hp,
@@ -724,9 +727,11 @@ def _compile_pipeline_step(layer, optimizer, strategy, mesh):
         prog_cls=_PipelineTrainStep,
         seq_axis="sp" if n_sp > 1 else None,
         # plain-branch MoE blocks emit (h, aux) via collect_aux_losses;
-        # the sp branch's raw-jnp block refuses MoE upstream
-        aux_from_blocks=(n_sp == 1 and bool(
-            getattr(layer, "pipeline_block_emits_aux", False))),
+        # the sp branch's raw-jnp MoE block threads its aux explicitly
+        aux_from_blocks=bool(
+            getattr(getattr(layer, "cfg", None), "moe_experts", 0)
+            if n_sp > 1
+            else getattr(layer, "pipeline_block_emits_aux", False)),
         aux_coef=float(getattr(getattr(layer, "cfg", None),
                                "moe_aux_coef", 0.01)))
 
@@ -760,11 +765,21 @@ def _compile_pipeline_tp_step(layer, optimizer, strategy, mesh, n_tp):
         raise ValueError(f"{len(blocks_list)} blocks not divisible by "
                          f"pp={n_pp}")
     embed_fn, _, head_loss_fn = layer.pipeline_fns()
+    tp_is_moe = bool(getattr(getattr(layer, "cfg", None),
+                             "moe_experts", 0))
+    if tp_is_moe:
+        # expert hidden dims shard over tp (block_tp_specs moe.* rows)
+        ffn_hidden = int(getattr(layer.cfg, "ffn_mult", 4)) * \
+            int(getattr(layer.cfg, "hidden"))
+        if ffn_hidden % n_tp:
+            raise ValueError(f"MoE expert hidden {ffn_hidden} not "
+                             f"divisible by tp={n_tp}")
     # raw-jnp block ops bypass the autocast dispatcher hook, so AMP is
     # delivered as an explicit compute dtype
     block_fn = layer.pipeline_block_fn_tp(
         axis_tp="tp",
-        compute_dtype="bfloat16" if strategy.amp else None)
+        compute_dtype="bfloat16" if strategy.amp else None,
+        with_aux=tp_is_moe)
     split_blocks = [layer.split_block_params_tp(b) for b in blocks_list]
     tp_specs = layer.block_tp_specs(axis_pp="pp", axis_tp="tp")
 
@@ -779,7 +794,10 @@ def _compile_pipeline_tp_step(layer, optimizer, strategy, mesh, n_tp):
         embed_fn=embed_fn, head_loss_fn=head_loss_fn, ep=ep, hp=hp,
         stacked=stack_stage_params(split_blocks),
         n_layers=len(blocks_list), stacked_pspec=stacked_pspec,
-        prog_cls=_PipelineTpTrainStep, replicated_axes=("tp",))
+        prog_cls=_PipelineTpTrainStep, replicated_axes=("tp",),
+        aux_from_blocks=tp_is_moe,
+        aux_coef=float(getattr(getattr(layer, "cfg", None),
+                               "moe_aux_coef", 0.01)))
 
 
 

@@ -182,14 +182,20 @@ class Model:
         elif isinstance(amp_configs, dict):
             self._amp_level = amp_configs.get("level", "O1")
         self._strategy = strategy
-        if strategy is not None and self._metrics and \
-                getattr(strategy, "pipeline", False):
+        if strategy is not None and self._metrics:
             import warnings
-            warnings.warn(
-                "metrics under a PIPELINE strategy evaluate on the synced "
-                "host path (the pp eval program computes only the loss); "
-                "non-pp strategies compute metrics under the training "
-                "shardings")
+            if getattr(strategy, "pipeline", False):
+                warnings.warn(
+                    "metrics under a PIPELINE strategy evaluate on the "
+                    "synced host path (the pp eval program computes only "
+                    "the loss); non-pp strategies compute metrics under "
+                    "the training shardings via evaluate()")
+            else:
+                warnings.warn(
+                    "metrics are computed by evaluate() (under the "
+                    "training shardings), not during fit() — the strategy "
+                    "train step returns only the loss, so per-batch train "
+                    "logs omit metric values")
         if strategy is not None and self._amp_level != "O0" \
                 and not strategy.amp:
             import warnings
@@ -714,8 +720,12 @@ class Model:
     def _step_logs(self, losses, step, batch_size):
         logs = {"loss": losses[0] if losses else 0.0, "step": step,
                 "batch_size": batch_size}
-        for m in self._metrics:
-            logs.update(self._metric_items(m))
+        # the strategy training step computes only the loss — metric
+        # states never update during fit there, so reporting
+        # accumulate() would print frozen zeros as if they were live
+        if getattr(self, "_strategy", None) is None:
+            for m in self._metrics:
+                logs.update(self._metric_items(m))
         return logs
 
     def _reset_metrics(self):

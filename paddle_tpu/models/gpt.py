@@ -92,6 +92,77 @@ def _pp_dropout(x, key, p):
     return jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
 
 
+def _pp_moe(xt, bp, E, K, C, axis_ep=None, axis_tp=None, axis_sp=None):
+    """Dense Switch-MoE FFN on raw jnp arrays for the pipeline blocks
+    (same routing math as nn/layer/moe.py), in three partitionings:
+
+      axis_ep: each member holds E/n_ep experts; contributions psum over
+               'ep' (activations replicated).
+      axis_tp: every member holds ALL experts but only Hf/n_tp of each
+               expert's hidden dim; partial expert outputs psum over 'tp'
+               (Megatron row-parallel w_out).
+      axis_sp: experts fully replicated; each member routes its LOCAL
+               token shard; the aux statistics pmean over 'sp' BEFORE
+               the product so the load-balance value matches the global
+               computation exactly (mean-of-products != product-of-means).
+
+    Returns (y [N, H], aux scalar)."""
+    N, H = xt.shape
+    logits = (xt @ bp["moe.gate_w"]).astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    gates_list, onehot_list = [], []
+    masked = probs
+    for _ in range(K):
+        idx = masked.argmax(axis=-1)
+        oh = jax.nn.one_hot(idx, E, dtype=jnp.float32)
+        gates_list.append((probs * oh).sum(-1))
+        onehot_list.append(oh)
+        masked = masked * (1.0 - oh)
+    flat_oh = jnp.concatenate(onehot_list, 0)
+    pos = jnp.cumsum(flat_oh, axis=0) - flat_oh
+    keep = (pos < C) * flat_oh
+    pos_id = (pos * flat_oh).sum(-1).astype(jnp.int32)
+    cap_oh = jax.nn.one_hot(pos_id, C, dtype=jnp.float32)
+    gates = jnp.concatenate(gates_list, 0)
+    dispatch = keep[:, :, None] * cap_oh[:, None, :]       # [KN, E, C]
+    combine = dispatch * gates[:, None, None]
+
+    if axis_ep is not None:
+        e_loc = bp["moe.w_in"].shape[0]
+        e0 = jax.lax.axis_index(axis_ep) * e_loc
+        disp_l = jax.lax.dynamic_slice_in_dim(dispatch, e0, e_loc, 1)
+        comb_l = jax.lax.dynamic_slice_in_dim(combine, e0, e_loc, 1)
+    else:
+        disp_l, comb_l = dispatch, combine
+
+    xrep = jnp.tile(xt, (K, 1)).astype(jnp.float32)
+    expert_in = jnp.einsum("nec,nm->ecm", disp_l, xrep)
+    hh = jnp.einsum("ecm,emh->ech", expert_in,
+                    bp["moe.w_in"].astype(jnp.float32)) \
+        + bp["moe.b_in"][:, None, :]
+    hh = jax.nn.gelu(hh)
+    eout = jnp.einsum("ech,ehm->ecm", hh,
+                      bp["moe.w_out"].astype(jnp.float32))
+    if axis_tp is not None:
+        # hidden dim is tp-local: partial expert outputs meet here;
+        # b_out is added once, after the psum
+        eout = jax.lax.psum(eout, axis_tp)
+    eout = eout + bp["moe.b_out"][:, None, :]
+    y = jnp.einsum("nec,ecm->nm", comb_l, eout)
+    y = y.reshape(K, N, H).sum(0)
+    if axis_ep is not None:
+        y = jax.lax.psum(y, axis_ep)
+
+    frac = onehot_list[0].mean(0)
+    mean_p = probs.mean(0)
+    if axis_sp is not None:
+        # exact global load-balance statistics across sequence shards
+        frac = jax.lax.pmean(frac, axis_sp)
+        mean_p = jax.lax.pmean(mean_p, axis_sp)
+    aux = (frac * mean_p).sum() * E
+    return y, aux
+
+
 class CausalSelfAttention(nn.Layer):
     def __init__(self, cfg: GPTConfig):
         super().__init__()
@@ -403,12 +474,28 @@ class GPT(nn.Layer):
             "fc1.bias": P(axis_pp, axis_tp),
             "fc2.weight": P(axis_pp, axis_tp, None),
             "fc2.bias": P(axis_pp, None),
+            # MoE under tp: every member holds all experts, hidden dim
+            # sharded (Megatron column/row split per expert); router and
+            # output biases replicate
+            "moe.gate_w": P(axis_pp, None, None),
+            "moe.w_in": P(axis_pp, None, None, axis_tp),   # [L,E,M,Hf]
+            "moe.b_in": P(axis_pp, None, axis_tp),
+            "moe.w_out": P(axis_pp, None, axis_tp, None),  # [L,E,Hf,M]
+            "moe.b_out": P(axis_pp, None, None),
         }
 
-    def pipeline_block_fn_tp(self, axis_tp="tp", compute_dtype=None):
+    def pipeline_block_fn_tp(self, axis_tp="tp", compute_dtype=None,
+                             with_aux=False):
         """block_fn for the manual-tp pipeline: local head-group attention
         + Megatron MLP with explicit psums over `axis_tp`. Operates on the
         split layout from split_block_params_tp (local tp shards).
+
+        MoE configs replace the MLP with the Switch FFN partitioned the
+        Megatron way: every member holds all experts but only Hf/n_tp of
+        each expert's hidden dim (block_tp_specs moe.* entries), partial
+        expert outputs psum over 'tp' (_pp_moe axis_tp). Routing runs on
+        the replicated stream, so members agree without a collective;
+        with_aux threads the load-balance aux to the scheduler.
 
         compute_dtype="bfloat16": matmul/einsum operands cast to bf16 (the
         AMP-O1 treatment — raw jnp ops here bypass the autocast dispatcher
@@ -419,8 +506,12 @@ class GPT(nn.Layer):
         scheduler-threaded key. The mask key is NOT folded by tp rank:
         the residual stream is replicated over 'tp', so every member must
         draw the identical mask or the manual psums stop agreeing."""
-        if self.cfg.moe_experts > 0:
-            raise NotImplementedError("pipeline+tp with MoE unsupported")
+        is_moe = self.cfg.moe_experts > 0
+        if with_aux and not is_moe:
+            raise ValueError("with_aux needs a MoE config")
+        E = self.cfg.moe_experts
+        K = self.cfg.moe_top_k if is_moe else 0
+        cap_f = self.blocks[0].moe.capacity_factor if is_moe else 0.0
         D = self.cfg.head_dim
         eps1 = self.blocks[0].ln1._epsilon
         eps2 = self.blocks[0].ln2._epsilon
@@ -462,6 +553,13 @@ class GPT(nn.Layer):
                 + bp["attn.proj.bias"]
             h = h + _drop(att, key, 0)
             h2 = ln(h, bp["ln2.weight"], bp["ln2.bias"], eps2)
+            if is_moe:
+                N = B * T
+                C = max(int(math.ceil(cap_f * N * K / E)), 1)
+                y, aux = _pp_moe(h2.reshape(N, H), bp, E, K, C,
+                                 axis_tp=axis_tp)
+                out = h + _drop(y.reshape(B, T, H).astype(h.dtype), key, 1)
+                return (out, aux) if with_aux else out
             m = jax.nn.gelu(mm(h2, bp["fc1.weight"]) + bp["fc1.bias"],
                             approximate=False)   # Block uses exact gelu
             mo = jax.lax.psum(mm(m, bp["fc2.weight"]), axis_tp) \
@@ -479,18 +577,21 @@ class GPT(nn.Layer):
 
 
     def pipeline_block_fn_sp(self, axis_sp="sp", impl="ring",
-                             compute_dtype=None):
+                             compute_dtype=None, with_aux=False):
         """block_fn for the pipeline x sequence-parallel mesh: the block
         sees the LOCAL sequence shard [B, T/sp, C]; attention runs as
         ring attention (K/V rotation over `axis_sp`) or Ulysses — both
         shard_map-inner (distributed/sequence_parallel.py), which is what
         the pipeline's all-manual region requires. LN/MLP are sequence-
-        elementwise, so they need no collectives at all."""
-        if self.cfg.dropout > 0:
-            raise NotImplementedError(
-                "pipeline block with dropout > 0 unsupported")
-        if self.cfg.moe_experts > 0:
-            raise NotImplementedError("pipeline+sp with MoE unsupported")
+        elementwise, so they need no collectives at all.
+
+        Dropout rides the scheduler key, which the 1F1B scheduler FOLDS
+        by the sp rank (pipeline_value_and_grad's data-axis folding) —
+        each shard holds different tokens, so masks must decorrelate.
+
+        MoE: experts replicate; each member routes its local tokens with
+        local capacity (_pp_moe axis_sp folds the load-balance stats
+        across shards so the aux matches the global value exactly)."""
         from ..distributed.sequence_parallel import (ring_attention,
                                                      ulysses_attention)
         impls = {"ring": ring_attention, "ulysses": ulysses_attention}
@@ -499,14 +600,27 @@ class GPT(nn.Layer):
                 f"sequence_parallel impl must be 'ring' or 'ulysses', "
                 f"got {impl!r}")
         attn_impl = impls[impl]
+        is_moe = self.cfg.moe_experts > 0
+        if with_aux and not is_moe:
+            raise ValueError("with_aux needs a MoE config")
+        E = self.cfg.moe_experts
+        K = self.cfg.moe_top_k if is_moe else 0
+        cap_f = self.blocks[0].moe.capacity_factor if is_moe else 0.0
         D = self.cfg.head_dim
         eps1 = self.blocks[0].ln1._epsilon
         eps2 = self.blocks[0].ln2._epsilon
         cd = jnp.bfloat16 if compute_dtype in ("bfloat16", "bf16",
                                                jnp.bfloat16) else None
         mm, ln = _pp_mm(cd), _pp_ln
+        p_drop = float(self.cfg.dropout)
+        gpt_self = self
 
-        def block_fn(bp, h):
+        def _drop(x, key, site):
+            if p_drop <= 0 or key is None or not gpt_self.training:
+                return x
+            return _pp_dropout(x, jax.random.fold_in(key, site), p_drop)
+
+        def _core(bp, h, key):
             B, Tl, H = h.shape
             h1 = ln(h, bp["ln1.weight"], bp["ln1.bias"], eps1)
             qkv = mm(h1, bp["attn.qkv.weight"]) + bp["attn.qkv.bias"]
@@ -519,11 +633,28 @@ class GPT(nn.Layer):
                 q, k, v = q.astype(cd), k.astype(cd), v.astype(cd)
             o = attn_impl(q, k, v, axis=axis_sp, causal=True)
             o = o.reshape(B, Tl, H).astype(jnp.float32)
-            h = h + mm(o, bp["attn.proj.weight"]) + bp["attn.proj.bias"]
+            att = mm(o, bp["attn.proj.weight"]) + bp["attn.proj.bias"]
+            h = h + _drop(att, key, 0)
             h2 = ln(h, bp["ln2.weight"], bp["ln2.bias"], eps2)
+            if is_moe:
+                N = B * Tl
+                C = max(int(math.ceil(cap_f * N * K / E)), 1)
+                y, aux = _pp_moe(h2.reshape(N, H), bp, E, K, C,
+                                 axis_sp=axis_sp)
+                out = h + _drop(y.reshape(B, Tl, H).astype(h.dtype),
+                                key, 1)
+                return (out, aux) if with_aux else out
             m = jax.nn.gelu(mm(h2, bp["fc1.weight"]) + bp["fc1.bias"],
                             approximate=False)
-            return h + mm(m, bp["fc2.weight"]) + bp["fc2.bias"]
+            return h + _drop(mm(m, bp["fc2.weight"]) + bp["fc2.bias"],
+                             key, 1)
+
+        if p_drop > 0:
+            def block_fn(bp, h, key=None):
+                return _core(bp, h, key)
+        else:
+            def block_fn(bp, h):
+                return _core(bp, h, None)
 
         return block_fn
 
@@ -568,9 +699,6 @@ class GPT(nn.Layer):
         if self.cfg.moe_experts <= 0:
             raise ValueError("pipeline_block_fn_ep requires a MoE config "
                              "(GPTConfig.moe_experts > 0)")
-        if self.cfg.dropout > 0:
-            raise NotImplementedError(
-                "pipeline block with dropout > 0 unsupported")
         D = self.cfg.head_dim
         E = self.cfg.moe_experts
         K = self.cfg.moe_top_k
@@ -580,8 +708,18 @@ class GPT(nn.Layer):
         cd = jnp.bfloat16 if compute_dtype in ("bfloat16", "bf16",
                                                jnp.bfloat16) else None
         mm, ln = _pp_mm(cd), _pp_ln
+        p_drop = float(self.cfg.dropout)
+        gpt_self = self
 
-        def block_fn(bp, h):
+        def _drop(x, key, site):
+            # key identical across 'ep' members (the scheduler folds only
+            # data axes): the residual stream is replicated over ep, so
+            # every member must draw the same mask or the psum breaks
+            if p_drop <= 0 or key is None or not gpt_self.training:
+                return x
+            return _pp_dropout(x, jax.random.fold_in(key, site), p_drop)
+
+        def _core(bp, h, key):
             B, T, H = h.shape
             h1 = ln(h, bp["ln1.weight"], bp["ln1.bias"], eps1)
             qkv = mm(h1, bp["attn.qkv.weight"]) + bp["attn.qkv.bias"]
@@ -597,58 +735,26 @@ class GPT(nn.Layer):
             p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
             o = jnp.einsum("bhqk,bkhd->bqhd", p, v).reshape(B, T, H) \
                 .astype(jnp.float32)
-            h = h + mm(o, bp["attn.proj.weight"]) + bp["attn.proj.bias"]
+            att = mm(o, bp["attn.proj.weight"]) + bp["attn.proj.bias"]
+            h = h + _drop(att, key, 0)
 
             # --- MoE FFN, manual ep: full routing, local expert slab ---
             h2 = ln(h, bp["ln2.weight"], bp["ln2.bias"], eps2)
             N = B * T
             C = max(int(math.ceil(cap_f * N * K / E)), 1)
-            xt = h2.reshape(N, H)
-            logits = (xt @ bp["moe.gate_w"]).astype(jnp.float32)
-            probs = jax.nn.softmax(logits, axis=-1)
-            gates_list, onehot_list = [], []
-            masked = probs
-            for _ in range(K):
-                idx = masked.argmax(axis=-1)
-                oh = jax.nn.one_hot(idx, E, dtype=jnp.float32)
-                gates_list.append((probs * oh).sum(-1))
-                onehot_list.append(oh)
-                masked = masked * (1.0 - oh)
-            flat_oh = jnp.concatenate(onehot_list, 0)
-            pos = jnp.cumsum(flat_oh, axis=0) - flat_oh
-            keep = (pos < C) * flat_oh
-            pos_id = (pos * flat_oh).sum(-1).astype(jnp.int32)
-            cap_oh = jax.nn.one_hot(pos_id, C, dtype=jnp.float32)
-            gates = jnp.concatenate(gates_list, 0)
-            dispatch = keep[:, :, None] * cap_oh[:, None, :]   # [KN,E,C]
-            combine = dispatch * gates[:, None, None]
+            y, aux = _pp_moe(h2.reshape(N, H), bp, E, K, C,
+                             axis_ep=axis_ep)
+            out = h + _drop(y.reshape(B, T, H).astype(h.dtype), key, 1)
+            # routing is replicated over 'ep' so every member computes
+            # the identical aux value
+            return (out, aux) if with_aux else out
 
-            # local expert slab: slice this member's E/n_ep experts
-            e_loc = bp["moe.w_in"].shape[0]
-            e0 = jax.lax.axis_index(axis_ep) * e_loc
-            disp_l = jax.lax.dynamic_slice_in_dim(dispatch, e0, e_loc, 1)
-            comb_l = jax.lax.dynamic_slice_in_dim(combine, e0, e_loc, 1)
-            xrep = jnp.tile(xt, (K, 1)).astype(jnp.float32)
-            expert_in = jnp.einsum("nec,nm->ecm", disp_l, xrep)
-            hh = jnp.einsum("ecm,emh->ech", expert_in,
-                            bp["moe.w_in"].astype(jnp.float32)) \
-                + bp["moe.b_in"][:, None, :]
-            hh = jax.nn.gelu(hh)
-            eout = jnp.einsum("ech,ehm->ecm", hh,
-                              bp["moe.w_out"].astype(jnp.float32)) \
-                + bp["moe.b_out"][:, None, :]
-            y = jnp.einsum("nec,ecm->nm", comb_l, eout)
-            y = y.reshape(K, N, H).sum(0)
-            # contributions from every member's experts meet here
-            y = jax.lax.psum(y, axis_ep)
-            out = h + y.reshape(B, T, H).astype(h.dtype)
-            if with_aux:
-                # Switch aux (moe.py formula); routing is replicated over
-                # 'ep' so every member computes the identical value
-                frac = onehot_list[0].mean(0)
-                mean_p = probs.mean(0)
-                return out, (frac * mean_p).sum() * E
-            return out
+        if p_drop > 0:
+            def block_fn(bp, h, key=None):
+                return _core(bp, h, key)
+        else:
+            def block_fn(bp, h):
+                return _core(bp, h, None)
 
         return block_fn
 

@@ -229,7 +229,8 @@ def dynamic_decode(decoder, inits=None, max_step_num=None,
     finished0 = _arr(finished0)
 
     # run step 0 outside the loop: its outputs define the buffer shapes
-    out0, st1, in1, fin1 = decoder.step(jnp.asarray(0), inputs0, states0)
+    out0, st1, in1, fin1 = decoder.step(jnp.asarray(0), inputs0, states0,
+                                        **kwargs)
     out0 = _unwrap_tree(out0)
     st1 = _unwrap_tree(st1)
     in1 = _unwrap_tree(in1)
@@ -262,7 +263,8 @@ def dynamic_decode(decoder, inits=None, max_step_num=None,
     def body(carry):
         t, inputs, flat_st, fin, lengths, bufs_c = carry
         states = jax.tree_util.tree_unflatten(st_def, flat_st)
-        out, next_st, next_in, step_fin = decoder.step(t, inputs, states)
+        out, next_st, next_in, step_fin = decoder.step(
+            t, inputs, states, **kwargs)
         out = _unwrap_tree(out)
         next_st = _unwrap_tree(next_st)
         next_in = _unwrap_tree(next_in)

@@ -1266,3 +1266,173 @@ def test_hapi_evaluate_stays_sharded_under_strategy():
     assert np.isfinite(logs["loss"]) and logs["loss"] < l_train + 0.1
     # the dirty flag must be untouched (no forced host sync happened)
     assert model._dist_dirty
+
+
+def test_pipeline_tp_moe_matches_sequential():
+    """r3 verdict #3: MoE under pp x tp — expert hidden dims shard over
+    'tp' (Megatron row/column split per expert, psum where partials
+    meet); with dp=1, acc=1 the pipelined loss must track sequential
+    GPT.loss (CE + aux) step for step."""
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.distributed.fleet.compiler import compile_train_step
+    from paddle_tpu.models import GPT, gpt_tiny
+
+    rng = np.random.default_rng(5)
+    ids = rng.integers(0, 512, (4, 32)).astype(np.int64)
+    labels = rng.integers(0, 512, (4, 32)).astype(np.int64)
+
+    def make():
+        paddle.seed(0)
+        return GPT(gpt_tiny(moe_experts=4, moe_top_k=2))
+
+    m_ref = make()
+    sgd_ref = opt.SGD(learning_rate=0.1, parameters=m_ref.parameters())
+    seq_losses = []
+    for _ in range(3):
+        loss = m_ref.loss(paddle.to_tensor(ids), paddle.to_tensor(labels))
+        seq_losses.append(float(loss))
+        loss.backward(); sgd_ref.step(); sgd_ref.clear_grad()
+
+    m = make()
+    s = DistributedStrategy()
+    s.pipeline = True
+    s.tensor_parallel = True
+    s.hybrid_configs.pp_degree = 2
+    s.hybrid_configs.mp_degree = 2
+    s.hybrid_configs.dp_degree = 1
+    s.pipeline_configs.accumulate_steps = 1
+    mesh = s.build_mesh(devices=jax.devices()[:4])
+    sgd = opt.SGD(learning_rate=0.1, parameters=list(m.parameters()))
+    prog = compile_train_step(m, sgd, s, mesh=mesh)
+    pp_losses = [float(jax.device_get(prog.step(ids, labels, lr=0.1)))
+                 for _ in range(3)]
+    np.testing.assert_allclose(pp_losses, seq_losses, rtol=2e-4, atol=5e-4)
+
+
+def test_pipeline_sp_moe_matches_sequential():
+    """r3 verdict #3: MoE under pp x sp — experts replicate, each seq
+    shard routes its local tokens, aux statistics pmean over 'sp' before
+    the product. With non-binding capacity the routing is identical to
+    sequential, so losses must match."""
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.distributed.fleet.compiler import compile_train_step
+    from paddle_tpu.models import GPT, gpt_tiny
+
+    rng = np.random.default_rng(6)
+    ids = rng.integers(0, 512, (4, 32)).astype(np.int64)
+    labels = rng.integers(0, 512, (4, 32)).astype(np.int64)
+
+    def make():
+        paddle.seed(0)
+        m = GPT(gpt_tiny(moe_experts=4, moe_top_k=2))
+        for b in m.blocks:
+            b.moe.capacity_factor = 8.0     # non-binding: no drops
+        return m
+
+    m_ref = make()
+    sgd_ref = opt.SGD(learning_rate=0.1, parameters=m_ref.parameters())
+    seq_losses = []
+    for _ in range(3):
+        loss = m_ref.loss(paddle.to_tensor(ids), paddle.to_tensor(labels))
+        seq_losses.append(float(loss))
+        loss.backward(); sgd_ref.step(); sgd_ref.clear_grad()
+
+    m = make()
+    s = DistributedStrategy()
+    s.pipeline = True
+    s.sequence_parallel = True
+    s.hybrid_configs.pp_degree = 2
+    s.hybrid_configs.sep_degree = 2
+    s.hybrid_configs.dp_degree = 1
+    s.pipeline_configs.accumulate_steps = 1
+    mesh = s.build_mesh(devices=jax.devices()[:4])
+    sgd = opt.SGD(learning_rate=0.1, parameters=list(m.parameters()))
+    prog = compile_train_step(m, sgd, s, mesh=mesh)
+    # ONE step: XLA:CPU's thread rendezvous cannot re-execute a program
+    # whose 1F1B tick overlaps the pp-ring and sp-ring collective
+    # permutes (pre-existing CPU-emulation limit, crashes at HEAD too;
+    # TPU schedules collectives in hardware). First-step parity fully
+    # exercises routing/aux/ring math.
+    pp_loss = float(jax.device_get(prog.step(ids, labels, lr=0.1)))
+    np.testing.assert_allclose(pp_loss, seq_losses[0], rtol=5e-4,
+                               atol=1e-3)
+
+
+def test_pipeline_sp_dropout_trains():
+    """r3 verdict #3: dropout under pp x sp — the scheduler folds the sp
+    rank into the key (different tokens per shard need decorrelated
+    masks); the step runs and regularization is live. ONE pp x sp
+    program per process (XLA:CPU cannot re-execute the overlapping
+    pp+sp collective permutes — pre-existing CPU-emulation limit; the
+    dryrun runs these programs once for the same reason), so the
+    dropout-is-live check compares against the EAGER loss of the same
+    weights with dropout off."""
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.distributed.fleet.compiler import compile_train_step
+    from paddle_tpu.models import GPT, GPTConfig
+
+    paddle.seed(7)
+    cfg = GPTConfig(vocab_size=64, hidden=32, layers=4, heads=2,
+                    max_seq_len=32, dropout=0.3)
+    net = GPT(cfg)
+    net.train()
+    rng = np.random.default_rng(3)
+    ids = rng.integers(0, 64, (4, 32)).astype(np.int64)
+    lab = rng.integers(0, 64, (4, 32)).astype(np.int64)
+    # eager eval-mode loss on the SAME initial weights (dropout off)
+    net.eval()
+    l_ref = float(net.loss(paddle.to_tensor(ids), paddle.to_tensor(lab)))
+    net.train()
+
+    s = DistributedStrategy()
+    s.pipeline = True
+    s.sequence_parallel = True
+    s.hybrid_configs.pp_degree = 2
+    s.hybrid_configs.sep_degree = 2
+    s.hybrid_configs.dp_degree = 1
+    s.pipeline_configs.accumulate_steps = 2
+    mesh = s.build_mesh(devices=jax.devices()[:4])
+    adam = opt.Adam(learning_rate=1e-3, parameters=net.parameters())
+    prog = compile_train_step(net, adam, s, mesh=mesh)
+    l_drop = float(jax.device_get(prog.step(ids, lab)))
+    assert np.isfinite(l_drop)
+    # masks are live: the trained step's loss differs from the
+    # deterministic no-dropout forward on identical weights
+    assert abs(l_drop - l_ref) > 1e-4
+
+
+def test_pipeline_ep_dropout_trains():
+    """r3 verdict #3: dropout under pp x ep — ep members share the key
+    (replicated stream, identical masks) so the psum stays exact; the
+    MoE step runs with dropout live."""
+    import dataclasses as _dc
+
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.distributed.fleet.compiler import compile_train_step
+    from paddle_tpu.models import GPT, gpt_tiny
+
+    def build(drop):
+        paddle.seed(7)
+        cfg = _dc.replace(gpt_tiny(moe_experts=4, moe_top_k=2),
+                          dropout=drop)
+        net = GPT(cfg)
+        net.train()
+        s = DistributedStrategy()
+        s.pipeline = True
+        s.expert_parallel = True
+        s.hybrid_configs.pp_degree = 2
+        s.hybrid_configs.ep_degree = 2
+        s.hybrid_configs.dp_degree = 1
+        s.pipeline_configs.accumulate_steps = 2
+        mesh = s.build_mesh(devices=jax.devices()[:4])
+        adam = opt.Adam(learning_rate=1e-3, parameters=net.parameters())
+        return compile_train_step(net, adam, s, mesh=mesh)
+
+    rng = np.random.default_rng(4)
+    ids = rng.integers(0, 512, (4, 16)).astype(np.int64)
+    lab = rng.integers(0, 512, (4, 16)).astype(np.int64)
+    prog = build(0.3)
+    losses = [float(jax.device_get(prog.step(ids, lab))) for _ in range(3)]
+    assert all(np.isfinite(l) for l in losses)
+    l0 = float(jax.device_get(build(0.0).step(ids, lab)))
+    assert abs(losses[0] - l0) > 1e-4
