@@ -492,6 +492,13 @@ def _build_pipeline_program(layer, optimizer, strategy, mesh, *, block_fn,
         except (TypeError, ValueError):
             return False
 
+    schedule = getattr(strategy.pipeline_configs, "schedule_mode", "1F1B")
+    if schedule not in ("1F1B", "F-then-B"):
+        raise ValueError(
+            f"pipeline_configs.schedule_mode must be '1F1B' or "
+            f"'F-then-B', got {schedule!r} (reference "
+            f"distributed_strategy.proto schedule_mode)")
+
     pipe_vag = pipeline_value_and_grad(
         block_fn, embed_fn, head_loss_fn, n_pp, n_micro, mesh, axis="pp",
         batch_axis="dp" if n_dp > 1 else None,
@@ -503,9 +510,87 @@ def _build_pipeline_program(layer, optimizer, strategy, mesh, *, block_fn,
         replicated_axes=replicated_axes,
         aux_from_blocks=aux_from_blocks, aux_coef=aux_coef)
 
+    # F-then-B (stored residuals): jax.grad over the forward scheduler —
+    # residuals for all n_micro microbatches stay live (GPipe memory
+    # profile) but the backward re-executes NOTHING, the reference's
+    # no-recompute SectionWorker profile (section_worker.cc:128-165).
+    # 1F1B (default) re-linearizes per backward slot: O(n_stages)
+    # activation memory at a ~1.3x forward-FLOPs tax.
+    from ..pipeline import pipeline_spmd as _pipe_fwd_builder
+    pipe_fwd = _pipe_fwd_builder(
+        block_fn, n_pp, n_micro, mesh, axis="pp",
+        batch_axis="dp" if n_dp > 1 else None,
+        param_specs={k[len("stacked."):]: v for k, v in pspecs.items()
+                     if k.startswith("stacked.")},
+        seq_axis=seq_axis, aux_from_blocks=aux_from_blocks)
+    embed_takes_key = _takes(embed_fn, "key")
+    block_takes_key = _takes(block_fn, "key")
+
     def _sub(p, prefix):
         cut = len(prefix)
         return {k[cut:]: v for k, v in p.items() if k.startswith(prefix)}
+
+    def _fthenb_loss(p, ids, labels, key):
+        epp = _sub(p, "embed.")
+        hpp = _sub(p, "head.")
+        spp = _sub(p, "stacked.")
+        n_local = n_layers // n_pp
+        batch_axis = "dp" if n_dp > 1 else None
+
+        if embed_takes_key and key is not None:
+            # embed dropout must draw per-(data-shard, microbatch) masks
+            # with the SAME fold order as the 1F1B scheduler
+            # (data ranks -> microbatch -> embed tag) so the two
+            # schedule modes are mask-identical
+            def emb_sm(ep_, ids_, k_):
+                from ..pipeline import embed_key_tag, fold_data_axes
+                k_ = fold_data_axes(k_, batch_axis, seq_axis)
+                t_loc = ids_.shape[-1]
+                pos_off = (jax.lax.axis_index(seq_axis) * t_loc
+                           if seq_axis is not None else 0)
+
+                def one(ids_m, m):
+                    k_m = jax.random.fold_in(k_, m)
+                    kw = {"key": embed_key_tag(k_m, n_local * n_pp)}
+                    if seq_axis is not None:
+                        kw["pos_offset"] = pos_off
+                    return embed_fn(ep_, ids_m, **kw)
+                return jax.vmap(one)(ids_, jnp.arange(n_micro))
+            rep = jax.tree_util.tree_map(
+                lambda v: P(*([None] * v.ndim)), epp)
+            hspec = P(None, batch_axis, seq_axis, None)
+            h = jax.shard_map(
+                emb_sm, mesh=mesh,
+                in_specs=(rep, P(None, batch_axis, seq_axis), P()),
+                out_specs=hspec, check_vma=False)(epp, ids, key)
+        else:
+            h = jax.vmap(lambda i_: embed_fn(epp, i_))(ids)
+        out = pipe_fwd(spp, h, key if block_takes_key else None)
+        if aux_from_blocks:
+            h_out, aux_s = out
+        else:
+            h_out, aux_s = out, 0.0
+        sums, counts = jax.vmap(
+            head_loss_fn, in_axes=(None, None, 0, 0))(hpp, epp, h_out,
+                                                      labels)
+        loss = sums.sum() / jnp.maximum(counts.sum(), 1.0)
+        if aux_from_blocks:
+            loss = loss + aux_coef * aux_s / (n_layers * n_micro)
+        return loss
+
+    def train_step_fthenb(p, st, opt_st, key, lr, data):
+        ids, labels = data
+        from ... import amp as amp_mod
+        with random_mod.key_scope(key):
+            with amp_mod.auto_cast(enable=amp_on,
+                                   level="O2" if pure_bf16 else "O1",
+                                   dtype="bfloat16"):
+                loss, grads = jax.value_and_grad(
+                    lambda pp: _fthenb_loss(pp, ids, labels, key))(p)
+        grads = nan_inf.guard_tree(grads)
+        new_p, new_opt = optimizer.functional_update(p, grads, opt_st,
+                                                     lr=lr)
+        return loss, new_p, st, new_opt
 
     def train_step(p, st, opt_st, key, lr, data):
         ids, labels = data
@@ -540,7 +625,7 @@ def _build_pipeline_program(layer, optimizer, strategy, mesh, *, block_fn,
         return loss, new_p, st, new_opt
 
     jitted = jax.jit(
-        train_step,
+        train_step_fthenb if schedule == "F-then-B" else train_step,
         in_shardings=(p_sh, buf_sh, s_sh, None, None, None),
         out_shardings=(NamedSharding(mesh, P()), p_sh, buf_sh, s_sh),
         donate_argnums=(0, 2))

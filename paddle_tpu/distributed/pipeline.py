@@ -41,6 +41,25 @@ __all__ = ["pipeline_spmd", "pipeline_value_and_grad",
            "stack_stage_params", "PipelineLayer"]
 
 
+def fold_data_axes(key, batch_axis=None, seq_axis=None):
+    """THE dropout key-fold prefix shared by every pipeline scheduler:
+    decorrelate across data shards (dp batch shards, sp sequence shards),
+    keep replicated axes (tp/ep) identical. Call only inside shard_map.
+    Fold order is part of the mask contract — 1F1B, F-then-B and the
+    compiler's embed shard_map must all agree bitwise."""
+    for a_ in (batch_axis, seq_axis):
+        if a_ is not None:
+            key = jax.random.fold_in(key, jax.lax.axis_index(a_))
+    return key
+
+
+def embed_key_tag(k_m, n_layers_total):
+    """The embed call's dropout key: the per-microbatch key folded with a
+    tag one past the last global layer index (so embed masks never
+    collide with block masks)."""
+    return jax.random.fold_in(k_m, n_layers_total)
+
+
 def stack_stage_params(block_params_list):
     """[{name: arr} per layer] -> {name: arr[L, ...]} stacked pytree.
     Shard the leading dim over 'pp' to place L/pp layers per stage."""
@@ -70,35 +89,60 @@ def pipeline_spmd(block_fn: Callable, n_stages: int, n_micro: int,
     (fill + steady state + drain). Each tick: run local stage stack on the
     held activation, ppermute result to the next stage. jax.grad over it
     is correct but GPipe-shaped: the reversed scan stores residuals for
-    ALL n_micro microbatches per stage. Training uses
-    `pipeline_value_and_grad` (true 1F1B, O(n_stages) activation
-    memory); this forward scheduler serves eval/predict and direct use."""
+    ALL n_micro microbatches per stage — exactly the stored-residual
+    ("F-then-B") schedule the reference's SectionWorker runs when
+    recompute is off (section_worker.cc:128-165): ~1.3x fewer FLOPs than
+    the remat 1F1B, O(n_micro) activation memory. Training selects it
+    via strategy.pipeline_configs.schedule_mode = "F-then-B";
+    `pipeline_value_and_grad` (true 1F1B, O(n_stages) memory) is the
+    default. `key` threads dropout with the SAME (data-rank, microbatch,
+    global-layer) folding as the 1F1B scheduler, so the two schedules
+    draw identical masks."""
+    import inspect as _inspect
 
-    def run_local_stack(local_params, x):
-        # scan over this stage's L/pp layers
-        def body(carry, layer_params):
+    try:
+        block_takes_key = "key" in _inspect.signature(block_fn).parameters
+    except (TypeError, ValueError):
+        block_takes_key = False
+
+    def run_local_stack(local_params, x, k_m, stage):
+        # scan over this stage's L/pp layers; global layer index folds
+        # into the dropout key exactly like pipeline_value_and_grad
+        n_local = jax.tree_util.tree_leaves(local_params)[0].shape[0]
+        gidx = jnp.arange(n_local) + stage * n_local
+
+        def body(carry, xs):
             h, aux = carry
-            out = block_fn(layer_params, h)
+            lp, li = xs
+            if block_takes_key and k_m is not None:
+                out = block_fn(lp, h, jax.random.fold_in(k_m, li))
+            else:
+                out = block_fn(lp, h)
             if aux_from_blocks:
                 h2, a = out
                 return (h2, aux + jnp.asarray(a, jnp.float32)), None
             return (out, aux), None
         (h, aux), _ = jax.lax.scan(
-            body, (x, jnp.zeros((), jnp.float32)), local_params)
+            body, (x, jnp.zeros((), jnp.float32)), (local_params, gidx))
         return h, aux
 
-    def staged(local_params, x_micro):
+    def staged(local_params, x_micro, key=None):
         stage = jax.lax.axis_index(axis)
         n_ticks = n_micro + n_stages - 1
         micro_shape = x_micro.shape[1:]
         perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+        if key is not None and block_takes_key:
+            key = fold_data_axes(key, batch_axis, seq_axis)
 
         def tick(carry, t):
             held, outputs, aux_s = carry
             # stage 0 injects microbatch t (if any left); others use held
             inject = jnp.where(t < n_micro, t, n_micro - 1)
             x_in = jnp.where(stage == 0, x_micro[inject], held)
-            y, aux = run_local_stack(local_params, x_in)
+            m_now = jnp.clip(t - stage, 0, n_micro - 1)
+            k_m = (jax.random.fold_in(key, m_now)
+                   if key is not None and block_takes_key else None)
+            y, aux = run_local_stack(local_params, x_in, k_m, stage)
             # stage s holds real microbatch t-s only inside the window —
             # fill/drain ticks run on garbage and must not count
             m = t - stage
@@ -131,7 +175,7 @@ def pipeline_spmd(block_fn: Callable, n_stages: int, n_micro: int,
             return outputs, aux_s
         return outputs
 
-    def pipelined(stacked_params, x_micro, in_mesh=mesh):
+    def pipelined(stacked_params, x_micro, key=None, in_mesh=mesh):
         # x_micro [n_micro, micro_batch, ...]: the micro_batch dim may ride
         # a data-parallel axis so dp x pp composes in one shard_map
         nd_x = x_micro.ndim
@@ -152,6 +196,13 @@ def pipeline_spmd(block_fn: Callable, n_stages: int, n_micro: int,
             jax.tree_util.tree_map(
                 lambda v: P(axis, *([None] * (v.ndim - 1))),
                 stacked_params)
+        if key is not None and block_takes_key:
+            f = jax.shard_map(
+                staged, mesh=in_mesh,
+                in_specs=(pspecs, dspec, P()),
+                out_specs=(dspec, P()) if aux_from_blocks else dspec,
+                check_vma=False)
+            return f(stacked_params, x_micro, key)
         f = jax.shard_map(
             staged, mesh=in_mesh,
             in_specs=(pspecs, dspec),
@@ -241,12 +292,7 @@ def pipeline_value_and_grad(block_fn, embed_fn, head_loss_fn, n_stages,
         # shards) but keep tp/ep members identical — replicated
         # activations need identical masks or the manual psums break
         if key is not None and (block_takes_key or embed_takes_key):
-            if batch_axis is not None:
-                key = jax.random.fold_in(key,
-                                         jax.lax.axis_index(batch_axis))
-            if seq_axis is not None:
-                key = jax.random.fold_in(key,
-                                         jax.lax.axis_index(seq_axis))
+            key = fold_data_axes(key, batch_axis, seq_axis)
         T_loc = ids_m.shape[2] if ids_m.ndim >= 3 else ids_m.shape[-1]
         pos_off = (jax.lax.axis_index(seq_axis) * T_loc
                    if seq_axis is not None else 0)
@@ -259,7 +305,7 @@ def pipeline_value_and_grad(block_fn, embed_fn, head_loss_fn, n_stages,
             if seq_axis is not None:
                 kw["pos_offset"] = pos_off
             if embed_takes_key and k_m is not None:
-                kw["key"] = jax.random.fold_in(k_m, n_local * S)
+                kw["key"] = embed_key_tag(k_m, n_local * S)
             return embed_fn(*args, **kw)
 
         def run_stack(p_, x, k_m):

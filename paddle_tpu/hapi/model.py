@@ -333,6 +333,19 @@ class Model:
                 def named_buffers(self, *a, **k):
                     return net.named_buffers(*a, **k)
 
+                # train/eval must reach the real network: the pipelined
+                # eval builder flips the layer to eval mode around its
+                # trace (dropout blocks refuse keyless TRAIN traces)
+                def eval(self):
+                    net.eval()
+
+                def train(self):
+                    net.train()
+
+                @property
+                def training(self):
+                    return getattr(net, "training", False)
+
                 _FORWARDED = ("param_shardings",
                               "pipeline_split_params", "pipeline_fns",
                               # manual-tp pipeline protocol (pp x tp)

@@ -143,15 +143,23 @@ def _pp_moe(xt, bp, E, K, C, axis_ep=None, axis_tp=None, axis_sp=None):
     hh = jax.nn.gelu(hh)
     eout = jnp.einsum("ech,ehm->ecm", hh,
                       bp["moe.w_out"].astype(jnp.float32))
+    # combine is linear, so collectives ride the [KN, M] combined output
+    # rather than the ~K*cap_f-times-larger [E, C, M] expert tensor;
+    # the bias contribution einsum('nec,em->nm') is exact because each
+    # dispatched slot receives its expert's bias once
+    y_core = jnp.einsum("nec,ecm->nm", comb_l, eout)
+    bias_t = jnp.einsum("nec,em->nm", comb_l, bp["moe.b_out"])
     if axis_tp is not None:
-        # hidden dim is tp-local: partial expert outputs meet here;
-        # b_out is added once, after the psum
-        eout = jax.lax.psum(eout, axis_tp)
-    eout = eout + bp["moe.b_out"][:, None, :]
-    y = jnp.einsum("nec,ecm->nm", comb_l, eout)
+        # hidden dim is tp-local: partial combined outputs meet here;
+        # bias (replicated) is added once, after the psum
+        y = jax.lax.psum(y_core, axis_tp) + bias_t
+    elif axis_ep is not None:
+        # each member contributes its local experts' outputs AND their
+        # bias share; the psum assembles both
+        y = jax.lax.psum(y_core + bias_t, axis_ep)
+    else:
+        y = y_core + bias_t
     y = y.reshape(K, N, H).sum(0)
-    if axis_ep is not None:
-        y = jax.lax.psum(y, axis_ep)
 
     frac = onehot_list[0].mean(0)
     mean_p = probs.mean(0)
