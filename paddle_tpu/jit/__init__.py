@@ -70,6 +70,11 @@ class StaticFunction:
         self._input_spec = input_spec
         self._is_layer = hasattr(fn_or_layer, "named_parameters")
         self._jit_cache = {}
+        # AST pass (reference program_translator.py:756): rewrite
+        # tensor-dependent plain-Python if/while in the forward into the
+        # static.nn combinators so un-annotated models trace and export
+        from .ast_transform import convert_target
+        self._target = convert_target(fn_or_layer)
         functools.update_wrapper(self, getattr(
             fn_or_layer, "forward", fn_or_layer), updated=())
 
@@ -142,6 +147,10 @@ def save(layer, path, input_spec=None):
         raise ValueError("jit.save needs input_spec=[InputSpec(...), ...] "
                          "to trace the exported program")
     is_layer = hasattr(target, "named_parameters")
+    # AST pass (see StaticFunction): un-annotated tensor-dependent
+    # if/while must lower to lax for the export trace
+    from .ast_transform import convert_target
+    target = convert_target(target)
     was_training = bool(getattr(target, "training", False))
     if hasattr(target, "eval"):
         target.eval()            # export inference behavior (no dropout)

@@ -1,0 +1,360 @@
+"""AST-level dygraph->static conversion for plain-Python control flow.
+
+Reference: dygraph_to_static/program_translator.py:756 + the transformer
+stack (ifelse_transformer.py, loop_transformer.py) — the reference
+rewrites `if`/`while` on tensor values into cond/while_loop ops at the
+source level so un-annotated user code traces into a Program.
+
+TPU-native version: the same source rewrite, but the targets are the
+static.nn combinators (which resolve eagerly on concrete values and
+lower to lax.cond / lax.while_loop under tracing):
+
+    if x.mean() > 0:        ->   def __jst_true():  y = a; return (y,)
+        y = a                    def __jst_false(): y = b; return (y,)
+    else:                        (y,) = __jst_cond(x.mean() > 0,
+        y = b                                      __jst_true, __jst_false)
+
+    while n.sum() < k:      ->   def __jst_cond0(n): return n.sum() < k
+        n = n + 1                def __jst_body0(n): n = n + 1; return (n,)
+                                 [n] = __jst_while(__jst_cond0,
+                                                   __jst_body0, [n])
+
+Supported shapes: assignment-style if/else (no return/break/continue in
+the branches), both-branches-single-return if/else, and assignment-style
+while. Anything else is left as genuine Python with a one-time warning —
+concrete values still run; tensor-dependent untransformed control flow
+surfaces jax's tracer-bool error at trace time (the documented
+fallback). Nested callees are not rewritten (convert them explicitly
+with paddle.jit.to_static)."""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+import types
+import warnings
+
+import numpy as np
+
+__all__ = ["convert_function", "maybe_convert"]
+
+
+def _tensorish(x):
+    from ..core.tensor import Tensor
+    import jax
+    import jax.numpy as jnp
+    return isinstance(x, (Tensor, jnp.ndarray, jax.core.Tracer))
+
+
+_JST_UNDEF = object()     # call-site placeholder for not-yet-bound locals
+
+
+def _jst_cond(pred, true_fn, false_fn, vals=()):
+    """Runtime dispatch: python `if` for plain values, static.nn.cond
+    (eager-resolving, lax-lowering) for tensor predicates. `vals` are the
+    current values of the branch-state variables, passed as positional
+    args so branch bodies may rebind them (a closure read of a rebound
+    name would hit UnboundLocalError)."""
+    tf = lambda: true_fn(*vals)     # noqa: E731
+    ff = lambda: false_fn(*vals)    # noqa: E731
+    if not _tensorish(pred):
+        return tf() if pred else ff()
+    from ..static import nn as snn
+    return snn.cond(pred, tf, ff)
+
+
+def _jst_while(cond_fn, body_fn, loop_vars):
+    """Runtime dispatch for `while`: static.nn.while_loop handles both
+    concrete (host loop) and traced (lax.while_loop) conditions; a plain
+    python loop serves the no-tensor case exactly."""
+    probe = cond_fn(*loop_vars)
+    if not _tensorish(probe) and not any(_tensorish(v) for v in loop_vars):
+        out = list(loop_vars)
+        while cond_fn(*out):
+            res = body_fn(*out)
+            out = list(res) if isinstance(res, (list, tuple)) else [res]
+        return out
+    from ..static import nn as snn
+    return snn.while_loop(cond_fn, body_fn, list(loop_vars))
+
+
+def _assigned_names(stmts):
+    """Names bound by a statement list (Assign/AugAssign/AnnAssign/For
+    targets), in deterministic order."""
+    found = []
+
+    def add(n):
+        if n not in found:
+            found.append(n)
+
+    for node in stmts:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Store):
+                add(sub.id)
+            elif isinstance(sub, ast.AugAssign) and isinstance(
+                    sub.target, ast.Name):
+                add(sub.target.id)
+    return found
+
+
+def _has_control_escape(stmts):
+    for node in stmts:
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.Return, ast.Break, ast.Continue,
+                                ast.Yield, ast.YieldFrom)):
+                return True
+    return False
+
+
+def _names_loaded(node):
+    return {n.id for n in ast.walk(node)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)}
+
+
+def _loaded_before_store(stmts):
+    """Names with a loop-carried dependency: loaded before any store in
+    a linear pass over the statement list (iteration-local temps —
+    stored first, loaded later — are excluded). Within one statement the
+    RHS evaluates before the target, which matches ast.walk's
+    value-before-target field order for Assign."""
+    stored = set()
+    carried = []
+    for node in stmts:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name):
+                if isinstance(sub.ctx, ast.Load):
+                    if sub.id not in stored and sub.id not in carried:
+                        carried.append(sub.id)
+                elif isinstance(sub.ctx, ast.Store):
+                    stored.add(sub.id)
+            elif isinstance(sub, ast.AugAssign) and isinstance(
+                    sub.target, ast.Name):
+                # target is read-then-written
+                if sub.target.id not in stored and \
+                        sub.target.id not in carried:
+                    carried.append(sub.target.id)
+    return carried
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self.counter = 0
+        self.changed = False
+        self.skipped = False
+
+    # -- if ---------------------------------------------------------------
+    def visit_If(self, node):
+        self.generic_visit(node)
+        body, orelse = node.body, node.orelse
+
+        def single_return(stmts):
+            return (len(stmts) == 1 and isinstance(stmts[0], ast.Return)
+                    and stmts[0].value is not None)
+
+        if single_return(body) and single_return(orelse):
+            # return __jst_cond(test, lambda: e1, lambda: e2)
+            lam_t = ast.Lambda(
+                args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                                   kw_defaults=[], defaults=[]),
+                body=body[0].value)
+            lam_f = ast.Lambda(
+                args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                                   kw_defaults=[], defaults=[]),
+                body=orelse[0].value)
+            self.changed = True
+            call = ast.Call(
+                func=ast.Name(id="__jst_cond", ctx=ast.Load()),
+                args=[node.test, lam_t, lam_f], keywords=[])
+            return ast.copy_location(ast.Return(value=call), node)
+
+        if (_has_control_escape(body) or _has_control_escape(orelse)):
+            self.skipped = True
+            return node
+
+        out = _assigned_names(body) + [
+            n for n in _assigned_names(orelse)
+            if n not in _assigned_names(body)]
+        out = [n for n in out if not n.startswith("__jst")]
+        i = self.counter
+        self.counter += 1
+        self.changed = True
+        # branch fns take the state vars as PARAMETERS (a branch body
+        # rebinding `h` makes `h` local — a closure read of the outer
+        # value would raise UnboundLocalError); current values ride the
+        # __jst_cond call, sentinel-filled for not-yet-bound names
+        params = ast.arguments(
+            posonlyargs=[],
+            args=[ast.arg(arg=n) for n in out],
+            kwonlyargs=[], kw_defaults=[], defaults=[])
+        ret_tuple = ast.Return(value=ast.Tuple(
+            elts=[ast.Name(id=n, ctx=ast.Load()) for n in out] or
+                 [ast.Constant(value=0)],
+            ctx=ast.Load()))
+        fn_t = ast.FunctionDef(
+            name=f"__jst_true_{i}", args=params,
+            body=list(body) + [ret_tuple], decorator_list=[])
+        fn_f = ast.FunctionDef(
+            name=f"__jst_false_{i}", args=params,
+            body=(list(orelse) or [ast.Pass()]) + [ret_tuple],
+            decorator_list=[])
+        # __jst_v_n = n if bound else _JST_UNDEF  (per state var)
+        grabs = []
+        for n in out:
+            grabs.append(ast.Try(
+                body=[ast.Assign(
+                    targets=[ast.Name(id=f"__jst_v_{n}", ctx=ast.Store())],
+                    value=ast.Name(id=n, ctx=ast.Load()))],
+                handlers=[ast.ExceptHandler(
+                    type=ast.Tuple(
+                        elts=[ast.Name(id="NameError", ctx=ast.Load()),
+                              ast.Name(id="UnboundLocalError",
+                                       ctx=ast.Load())],
+                        ctx=ast.Load()),
+                    name=None,
+                    body=[ast.Assign(
+                        targets=[ast.Name(id=f"__jst_v_{n}",
+                                          ctx=ast.Store())],
+                        value=ast.Name(id="__jst_undef",
+                                       ctx=ast.Load()))])],
+                orelse=[], finalbody=[]))
+        call = ast.Call(
+            func=ast.Name(id="__jst_cond", ctx=ast.Load()),
+            args=[node.test,
+                  ast.Name(id=fn_t.name, ctx=ast.Load()),
+                  ast.Name(id=fn_f.name, ctx=ast.Load()),
+                  ast.Tuple(elts=[ast.Name(id=f"__jst_v_{n}",
+                                           ctx=ast.Load()) for n in out],
+                            ctx=ast.Load())],
+            keywords=[])
+        if out:
+            assign = ast.Assign(
+                targets=[ast.Tuple(
+                    elts=[ast.Name(id=n, ctx=ast.Store()) for n in out],
+                    ctx=ast.Store())],
+                value=call)
+        else:
+            assign = ast.Expr(value=call)
+        return [ast.copy_location(n_, node)
+                for n_ in (fn_t, fn_f, *grabs, assign)]
+
+    # -- while ------------------------------------------------------------
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse or _has_control_escape(node.body):
+            self.skipped = True
+            return node
+        # loop-carried vars only: assigned in the body AND read before
+        # written (or read by the test). Iteration-local temps stay local
+        # to the body fn — note the python loop-variable leak (reading a
+        # body temp AFTER the loop) is not preserved.
+        assigned = [n for n in _assigned_names(node.body)
+                    if not n.startswith("__jst")]
+        carried = set(_loaded_before_store(node.body)) | \
+            _names_loaded(node.test)
+        loop_vars = [n for n in assigned if n in carried]
+        if not loop_vars:
+            self.skipped = True
+            return node
+        i = self.counter
+        self.counter += 1
+        self.changed = True
+        params = ast.arguments(
+            posonlyargs=[],
+            args=[ast.arg(arg=n) for n in loop_vars],
+            kwonlyargs=[], kw_defaults=[], defaults=[])
+        fn_c = ast.FunctionDef(
+            name=f"__jst_loopcond_{i}", args=params,
+            body=[ast.Return(value=node.test)], decorator_list=[])
+        fn_b = ast.FunctionDef(
+            name=f"__jst_loopbody_{i}", args=params,
+            body=list(node.body) + [ast.Return(value=ast.Tuple(
+                elts=[ast.Name(id=n, ctx=ast.Load()) for n in loop_vars],
+                ctx=ast.Load()))],
+            decorator_list=[])
+        call = ast.Call(
+            func=ast.Name(id="__jst_while", ctx=ast.Load()),
+            args=[ast.Name(id=fn_c.name, ctx=ast.Load()),
+                  ast.Name(id=fn_b.name, ctx=ast.Load()),
+                  ast.List(elts=[ast.Name(id=n, ctx=ast.Load())
+                                 for n in loop_vars], ctx=ast.Load())],
+            keywords=[])
+        assign = ast.Assign(
+            targets=[ast.List(
+                elts=[ast.Name(id=n, ctx=ast.Store()) for n in loop_vars],
+                ctx=ast.Store())],
+            value=call)
+        return [ast.copy_location(n_, node) for n_ in (fn_c, fn_b, assign)]
+
+
+def convert_function(fn):
+    """Rewrite tensor-dependent if/while in `fn` into the static.nn
+    combinators. Returns the converted function, or `fn` unchanged (with
+    a warning) when the source can't be transformed."""
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError) as e:
+        warnings.warn(
+            f"to_static: cannot read source of {fn!r} ({e}); falling back "
+            "to trace-time resolution — tensor-dependent Python `if`/"
+            "`while` will fail under tracing")
+        return fn
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return fn
+    fdef.decorator_list = []     # the wrapper re-applies nothing
+
+    tr = _ControlFlowTransformer()
+    tr.visit(fdef)
+    ast.fix_missing_locations(tree)
+    if tr.skipped:
+        warnings.warn(
+            f"to_static: some control flow in {fn.__qualname__} uses "
+            "return/break/continue inside if/while bodies and was left as "
+            "plain Python (resolved at trace time; tensor-dependent "
+            "predicates there will fail under tracing)")
+    if not tr.changed:
+        return fn                # nothing to do
+
+    # closure variables become globals of the compiled copy
+    namespace = dict(fn.__globals__)
+    if fn.__closure__:
+        namespace.update(zip(fn.__code__.co_freevars,
+                             (c.cell_contents for c in fn.__closure__)))
+    namespace["__jst_cond"] = _jst_cond
+    namespace["__jst_while"] = _jst_while
+    namespace["__jst_undef"] = _JST_UNDEF
+    code = compile(tree, filename=f"<to_static {fn.__qualname__}>",
+                   mode="exec")
+    exec(code, namespace)
+    converted = namespace[fdef.name]
+    converted = functools.wraps(fn)(converted)
+    converted.__jst_converted__ = True
+    return converted
+
+
+def maybe_convert(fn):
+    """convert_function with idempotence (already-converted functions and
+    bound methods pass through converted)."""
+    if getattr(fn, "__jst_converted__", False):
+        return fn
+    if isinstance(fn, types.MethodType):
+        conv = convert_function(fn.__func__)
+        if conv is fn.__func__:
+            return fn
+        return types.MethodType(conv, fn.__self__)
+    return convert_function(fn)
+
+
+def convert_target(obj):
+    """Apply the AST pass to a layer (rewriting its forward in place) or
+    a plain function (returning the converted function) — the shared
+    entry for StaticFunction and jit.save."""
+    if hasattr(obj, "named_parameters"):
+        conv = maybe_convert(obj.forward)
+        if getattr(conv, "__jst_converted__", False) and not \
+                getattr(obj.forward, "__jst_converted__", False):
+            obj.forward = conv
+        return obj
+    return maybe_convert(obj)

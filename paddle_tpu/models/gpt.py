@@ -107,6 +107,11 @@ def _pp_moe(xt, bp, E, K, C, axis_ep=None, axis_tp=None, axis_sp=None):
                computation exactly (mean-of-products != product-of-means).
 
     Returns (y [N, H], aux scalar)."""
+    if axis_tp is not None and axis_ep is not None:
+        raise NotImplementedError(
+            "_pp_moe: tp x ep expert sharding in one block is not "
+            "supported (pick one; the combine below reduces over a "
+            "single axis)")
     N, H = xt.shape
     logits = (xt @ bp["moe.gate_w"]).astype(jnp.float32)
     probs = jax.nn.softmax(logits, axis=-1)

@@ -1,0 +1,179 @@
+"""AST-level to_static conversion (reference
+dygraph_to_static/program_translator.py:756 — plain-Python if/while on
+tensor values auto-convert to cond/while_loop)."""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+RNG = np.random.RandomState(21)
+
+
+class BranchyNet(nn.Layer):
+    """Un-annotated tensor-dependent `if` (the verdict's target case)."""
+
+    def __init__(self):
+        super().__init__()
+        self.pos = nn.Linear(4, 4)
+        self.neg = nn.Linear(4, 4)
+
+    def forward(self, x):
+        if x.mean() > 0:
+            y = self.pos(x)
+        else:
+            y = self.neg(x)
+        return y * 2
+
+
+class ReturnyNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(4, 4)
+
+    def forward(self, x):
+        h = self.fc(x)
+        if h.sum() > 0:
+            return h
+        else:
+            return -h
+
+
+def _np_run(net, x):
+    # eager reference (plain python if resolves on concrete values)
+    return net(paddle.to_tensor(x)).numpy()
+
+
+def test_tensor_if_traces_and_matches_both_branches():
+    paddle.seed(0)
+    net = BranchyNet()
+    xpos = np.abs(RNG.randn(2, 4)).astype(np.float32)
+    xneg = -np.abs(RNG.randn(2, 4)).astype(np.float32)
+    ref_pos = _np_run(net, xpos)
+    ref_neg = _np_run(net, xneg)
+
+    st = paddle.jit.to_static(net)
+    np.testing.assert_allclose(st(paddle.to_tensor(xpos)).numpy(), ref_pos,
+                               atol=1e-5)
+    np.testing.assert_allclose(st(paddle.to_tensor(xneg)).numpy(), ref_neg,
+                               atol=1e-5)
+    # ONE compiled program serves both branches (lax.cond, not retraces)
+    assert len(st._jit_cache) == 1
+
+
+def test_return_style_if():
+    paddle.seed(1)
+    net = ReturnyNet()
+    x = RNG.randn(2, 4).astype(np.float32)
+    ref = _np_run(net, x)
+    st = paddle.jit.to_static(net)
+    np.testing.assert_allclose(st(paddle.to_tensor(x)).numpy(), ref,
+                               atol=1e-5)
+    np.testing.assert_allclose(
+        st(paddle.to_tensor(-x * 3)).numpy(),
+        _np_run(net, -x * 3), atol=1e-5)
+
+
+def test_tensor_while_converts():
+    class LoopNet(nn.Layer):
+        def forward(self, x):
+            s = x
+            while s.sum() < 10.0:
+                s = s * 2
+            return s
+
+    net = LoopNet()
+    x = np.full((2, 2), 0.25, np.float32)
+    ref = _np_run(net, x)      # 0.25*16 -> sum 16 >= 10
+    st = paddle.jit.to_static(net)
+    np.testing.assert_allclose(st(paddle.to_tensor(x)).numpy(), ref,
+                               atol=1e-5)
+
+
+def test_export_roundtrip_with_tensor_if(tmp_path):
+    """The verdict's DONE criterion: an un-annotated model with a
+    tensor-dependent `if` exports and round-trips."""
+    paddle.seed(3)
+    net = BranchyNet()
+    x = np.abs(RNG.randn(2, 4)).astype(np.float32)
+    ref_pos = _np_run(net, x)
+    ref_neg = _np_run(net, -x)
+
+    from paddle_tpu.static import InputSpec
+    path = str(tmp_path / "branchy")
+    paddle.jit.save(net, path, input_spec=[InputSpec([None, 4], "float32")])
+    loaded = paddle.jit.load(path)
+    np.testing.assert_allclose(
+        np.asarray(loaded(paddle.to_tensor(x)).numpy()), ref_pos, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(loaded(paddle.to_tensor(-x)).numpy()), ref_neg,
+        atol=1e-5)
+
+
+def test_plain_python_if_untouched():
+    class FlagNet(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+            self.double = True
+
+        def forward(self, x):
+            h = self.fc(x)
+            if self.double:          # plain python bool: static branch
+                h = h * 2
+            return h
+
+    net = FlagNet()
+    x = RNG.randn(2, 4).astype(np.float32)
+    ref = _np_run(net, x)
+    st = paddle.jit.to_static(net)
+    np.testing.assert_allclose(st(paddle.to_tensor(x)).numpy(), ref,
+                               atol=1e-5)
+
+
+def test_unsupported_shape_warns_and_falls_back():
+    class BreakNet(nn.Layer):
+        def forward(self, x):
+            out = x
+            while True:
+                out = out + 1
+                if float(out.sum()) > 3:   # host read; eager-only net
+                    break
+            return out
+
+    net = BreakNet()
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        paddle.jit.to_static(net)
+    assert any("plain Python" in str(ww.message) for ww in w)
+
+
+def test_eager_behavior_preserved_after_wrap():
+    # to_static converts forward in place; EAGER calls must still work
+    paddle.seed(5)
+    net = BranchyNet()
+    x = np.abs(RNG.randn(2, 4)).astype(np.float32)
+    ref = _np_run(net, x)
+    paddle.jit.to_static(net)
+    np.testing.assert_allclose(_np_run(net, x), ref, atol=1e-5)
+
+
+def test_while_with_iteration_local_temp():
+    """Regression (review): iteration-local temps (stored before loaded)
+    must not enter the loop carry — they'd read unbound at the call."""
+    class TempLoop(nn.Layer):
+        def forward(self, x):
+            s = x
+            while s.sum() < 8.0:
+                tmp = s * 2
+                s = tmp + 0.5
+            return s
+
+    net = TempLoop()
+    x = np.full((2, 2), 0.25, np.float32)
+    ref = _np_run(net, x)
+    st = paddle.jit.to_static(net)
+    np.testing.assert_allclose(st(paddle.to_tensor(x)).numpy(), ref,
+                               atol=1e-5)
