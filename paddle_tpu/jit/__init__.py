@@ -6,9 +6,12 @@ rewrites Python into ProgramDesc) and jit.save/jit.load +
 save_inference_model (fluid/io.py:1199) which bundle a serialized program
 with parameters so inference needs no model class.
 
-TPU-native redesign: tracing IS the translation — `to_static` wraps the
-layer in functional_call + jax.jit (no AST surgery; Python control flow is
-resolved at trace time exactly like the reference's program capture).
+TPU-native redesign: tracing is the main translation — `to_static` wraps
+the layer in functional_call + jax.jit — plus a small AST pass
+(ast_transform.py, the analog of dygraph_to_static's transformer stack)
+that rewrites tensor-dependent plain-Python if/while into the static.nn
+combinators so they lower to lax.cond/lax.while_loop instead of failing
+the trace.
 `save` exports the traced forward as a versioned StableHLO module
 (jax.export) next to a parameter pickle; `load` rebuilds a callable
 TranslatedLayer from those two artifacts alone — the NaiveExecutor-style
@@ -66,7 +69,6 @@ class StaticFunction:
     functional fast path and enough metadata for jit.save."""
 
     def __init__(self, fn_or_layer, input_spec=None):
-        self._target = fn_or_layer
         self._input_spec = input_spec
         self._is_layer = hasattr(fn_or_layer, "named_parameters")
         self._jit_cache = {}
@@ -123,9 +125,10 @@ class StaticFunction:
 def to_static(function=None, input_spec=None, **kwargs):
     """Decorator/wrapper: paddle.jit.to_static(layer_or_fn).
 
-    The engine is trace-and-compile (jax.jit over functional_call); the
-    reference's AST transform pipeline (dygraph_to_static/) is unnecessary
-    because tracing executes the genuine Python."""
+    The engine is trace-and-compile (jax.jit over functional_call),
+    with the ast_transform pass rewriting tensor-dependent plain-Python
+    if/while into lax-lowering combinators first (transformed frames
+    show `<to_static ...>` filenames in tracebacks)."""
     if function is None:
         return lambda f: to_static(f, input_spec=input_spec, **kwargs)
     return StaticFunction(function, input_spec)
@@ -148,9 +151,19 @@ def save(layer, path, input_spec=None):
                          "to trace the exported program")
     is_layer = hasattr(target, "named_parameters")
     # AST pass (see StaticFunction): un-annotated tensor-dependent
-    # if/while must lower to lax for the export trace
-    from .ast_transform import convert_target
-    target = convert_target(target)
+    # if/while must lower to lax for the export trace. For layers the
+    # converted forward is swapped in only for the trace — save must not
+    # permanently mutate the caller's object.
+    from .ast_transform import maybe_convert
+    restore_forward = None
+    if is_layer:
+        conv = maybe_convert(target.forward)
+        if getattr(conv, "__jst_converted__", False) and not \
+                getattr(target.forward, "__jst_converted__", False):
+            restore_forward = target.__dict__.get("forward", None)
+            target.forward = conv
+    else:
+        target = maybe_convert(target)
     was_training = bool(getattr(target, "training", False))
     if hasattr(target, "eval"):
         target.eval()            # export inference behavior (no dropout)
@@ -182,6 +195,15 @@ def save(layer, path, input_spec=None):
     finally:
         if was_training and hasattr(target, "train"):
             target.train()
+        if is_layer:
+            # undo the temporary converted-forward swap
+            if restore_forward is not None:
+                target.forward = restore_forward
+            elif "forward" in getattr(target, "__dict__", {}):
+                try:
+                    del target.__dict__["forward"]
+                except (KeyError, TypeError):
+                    pass
 
     d = os.path.dirname(path)
     if d:

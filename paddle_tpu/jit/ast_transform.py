@@ -50,16 +50,24 @@ def _tensorish(x):
 _JST_UNDEF = object()     # call-site placeholder for not-yet-bound locals
 
 
-def _jst_cond(pred, true_fn, false_fn, vals=()):
+def _jst_cond(pred, true_fn, false_fn, vals=(), risky=()):
     """Runtime dispatch: python `if` for plain values, static.nn.cond
     (eager-resolving, lax-lowering) for tensor predicates. `vals` are the
     current values of the branch-state variables, passed as positional
     args so branch bodies may rebind them (a closure read of a rebound
-    name would hit UnboundLocalError)."""
+    name would hit UnboundLocalError). `risky` names vars assigned in
+    only ONE branch: unbound-before + traced predicate means the other
+    branch would emit the raw sentinel into lax.cond — refuse clearly."""
     tf = lambda: true_fn(*vals)     # noqa: E731
     ff = lambda: false_fn(*vals)    # noqa: E731
     if not _tensorish(pred):
         return tf() if pred else ff()
+    undef = [n for n, v in risky if v is _JST_UNDEF]
+    if undef:
+        raise NotImplementedError(
+            f"to_static: variable(s) {undef} are bound in only one branch "
+            "of a tensor-dependent `if` — lax.cond needs both branches to "
+            "produce every output; bind them before the `if`")
     from ..static import nn as snn
     return snn.cond(pred, tf, ff)
 
@@ -79,9 +87,21 @@ def _jst_while(cond_fn, body_fn, loop_vars):
     return snn.while_loop(cond_fn, body_fn, list(loop_vars))
 
 
+_NESTED_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _walk_same_scope(node):
+    """ast.walk that does NOT descend into nested function scopes (their
+    returns/stores belong to the nested function, not this one)."""
+    for child in ast.iter_child_nodes(node):
+        yield child
+        if not isinstance(child, _NESTED_SCOPES):
+            yield from _walk_same_scope(child)
+
+
 def _assigned_names(stmts):
-    """Names bound by a statement list (Assign/AugAssign/AnnAssign/For
-    targets), in deterministic order."""
+    """Names bound by a statement list in THIS scope
+    (Assign/AugAssign/AnnAssign/For targets), in deterministic order."""
     found = []
 
     def add(n):
@@ -89,7 +109,9 @@ def _assigned_names(stmts):
             found.append(n)
 
     for node in stmts:
-        for sub in ast.walk(node):
+        if isinstance(node, _NESTED_SCOPES):
+            continue             # a def/lambda statement binds no Name here
+        for sub in [node] + list(_walk_same_scope(node)):
             if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Store):
                 add(sub.id)
             elif isinstance(sub, ast.AugAssign) and isinstance(
@@ -99,8 +121,12 @@ def _assigned_names(stmts):
 
 
 def _has_control_escape(stmts):
+    """Return/break/continue/yield in THIS scope (synthesized __jst_*
+    inner functions and user lambdas don't count)."""
     for node in stmts:
-        for sub in ast.walk(node):
+        if isinstance(node, _NESTED_SCOPES):
+            continue
+        for sub in [node] + list(_walk_same_scope(node)):
             if isinstance(sub, (ast.Return, ast.Break, ast.Continue,
                                 ast.Yield, ast.YieldFrom)):
                 return True
@@ -112,28 +138,57 @@ def _names_loaded(node):
             if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)}
 
 
+def _events(node):
+    """Yield ('load'|'store', name) in EVALUATION order: Assign values
+    before targets (ast.walk would visit targets first — its field order
+    is (targets, value)), AugAssign targets as load-then-store. Nested
+    function scopes contribute their free-variable loads at the def
+    site and no stores."""
+    if isinstance(node, _NESTED_SCOPES):
+        for n in ast.walk(node):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+                yield ("load", n.id)
+        return
+    if isinstance(node, ast.Assign):
+        yield from _events(node.value)
+        for t in node.targets:
+            yield from _events(t)
+        return
+    if isinstance(node, ast.AnnAssign):
+        if node.value is not None:
+            yield from _events(node.value)
+        yield from _events(node.target)
+        return
+    if isinstance(node, ast.AugAssign):
+        if isinstance(node.target, ast.Name):
+            yield ("load", node.target.id)
+        yield from _events(node.value)
+        if isinstance(node.target, ast.Name):
+            yield ("store", node.target.id)
+        else:
+            yield from _events(node.target)
+        return
+    if isinstance(node, ast.Name):
+        yield (("store" if isinstance(node.ctx, ast.Store) else "load"),
+               node.id)
+        return
+    for child in ast.iter_child_nodes(node):
+        yield from _events(child)
+
+
 def _loaded_before_store(stmts):
     """Names with a loop-carried dependency: loaded before any store in
-    a linear pass over the statement list (iteration-local temps —
-    stored first, loaded later — are excluded). Within one statement the
-    RHS evaluates before the target, which matches ast.walk's
-    value-before-target field order for Assign."""
+    evaluation order over the statement list (iteration-local temps —
+    stored first, loaded later — are excluded)."""
     stored = set()
     carried = []
     for node in stmts:
-        for sub in ast.walk(node):
-            if isinstance(sub, ast.Name):
-                if isinstance(sub.ctx, ast.Load):
-                    if sub.id not in stored and sub.id not in carried:
-                        carried.append(sub.id)
-                elif isinstance(sub.ctx, ast.Store):
-                    stored.add(sub.id)
-            elif isinstance(sub, ast.AugAssign) and isinstance(
-                    sub.target, ast.Name):
-                # target is read-then-written
-                if sub.target.id not in stored and \
-                        sub.target.id not in carried:
-                    carried.append(sub.target.id)
+        for kind, name in _events(node):
+            if kind == "load":
+                if name not in stored and name not in carried:
+                    carried.append(name)
+            else:
+                stored.add(name)
     return carried
 
 
@@ -218,6 +273,8 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                         value=ast.Name(id="__jst_undef",
                                        ctx=ast.Load()))])],
                 orelse=[], finalbody=[]))
+        in_both = set(_assigned_names(body)) & set(_assigned_names(orelse))
+        risky = [n for n in out if n not in in_both]
         call = ast.Call(
             func=ast.Name(id="__jst_cond", ctx=ast.Load()),
             args=[node.test,
@@ -225,7 +282,13 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                   ast.Name(id=fn_f.name, ctx=ast.Load()),
                   ast.Tuple(elts=[ast.Name(id=f"__jst_v_{n}",
                                            ctx=ast.Load()) for n in out],
-                            ctx=ast.Load())],
+                            ctx=ast.Load()),
+                  ast.Tuple(elts=[
+                      ast.Tuple(elts=[ast.Constant(value=n),
+                                      ast.Name(id=f"__jst_v_{n}",
+                                               ctx=ast.Load())],
+                                ctx=ast.Load())
+                      for n in risky], ctx=ast.Load())],
             keywords=[])
         if out:
             assign = ast.Assign(
@@ -303,7 +366,13 @@ def convert_function(fn):
     fdef = tree.body[0]
     if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
         return fn
-    fdef.decorator_list = []     # the wrapper re-applies nothing
+    if fdef.decorator_list:
+        # a rebuilt copy cannot re-apply arbitrary decorators faithfully
+        warnings.warn(
+            f"to_static: {fn.__qualname__} carries decorators; leaving it "
+            "unconverted (tensor-dependent plain-Python control flow "
+            "inside will fail under tracing)")
+        return fn
 
     tr = _ControlFlowTransformer()
     tr.visit(fdef)

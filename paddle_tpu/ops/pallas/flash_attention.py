@@ -66,10 +66,17 @@ def _block_sizes(T, D, env_key="PT_FLASH_FWD_BLOCKS"):
     """Large blocks amortise per-grid-step overhead: at (128,128) a T=1024
     head is 6k grid steps of ~4 MFLOP each and the kernel is dispatch-bound
     (measured 8.5 ms/layer fwd+bwd vs 3.9 ms at (512,1024) on v5e). The env
-    keys PT_FLASH_{FWD,BWD}_BLOCKS are perf-tuning escape hatches."""
+    keys PT_FLASH_{FWD,BWD}_BLOCKS are perf-tuning escape hatches.
+
+    (1024, 1024) caps are the long-context sweep's optimum on v5e:
+    every T in {1024..16384} lands >= 46% MFU vs the 42.5-44.5% tail the
+    old (512, 1024) caps left at T >= 4096 (numbers + methodology:
+    benchmarks/RESULTS.md long-context table; reproduce with
+    benchmarks/longctx.py). 2048-wide blocks exceed VMEM at D=64 (the
+    f32 score tile alone is 16 MB)."""
     if env_key in os.environ:
         return _env_blocks(env_key, T)
-    return _pick_block(T, 512), _pick_block(T, 1024)
+    return _pick_block(T, 1024), _pick_block(T, 1024)
 
 
 def _bwd_block_sizes(T, D):

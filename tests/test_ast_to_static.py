@@ -177,3 +177,101 @@ def test_while_with_iteration_local_temp():
     st = paddle.jit.to_static(net)
     np.testing.assert_allclose(st(paddle.to_tensor(x)).numpy(), ref,
                                atol=1e-5)
+
+
+def test_while_plain_assign_rmw_carried():
+    """Regression (review): `acc = acc * 2` (plain Assign RMW) must be
+    loop-carried — ast field order visits targets before values."""
+    class RMWLoop(nn.Layer):
+        def forward(self, x):
+            acc = x
+            n = x.sum() * 0
+            while n < 3:
+                acc = acc * 2
+                n = n + 1
+            return acc
+
+    net = RMWLoop()
+    x = np.ones((2, 2), np.float32)
+    ref = _np_run(net, x)
+    np.testing.assert_allclose(ref, x * 8)
+    st = paddle.jit.to_static(net)
+    np.testing.assert_allclose(st(paddle.to_tensor(x)).numpy(), ref,
+                               atol=1e-5)
+
+
+def test_nested_tensor_if_converts():
+    """Regression (review): synthesized returns in inner __jst fns must
+    not mark the OUTER if/while unsupported."""
+    class NestedNet(nn.Layer):
+        def forward(self, x):
+            if x.mean() > 0:
+                if x.sum() > 10:
+                    y = x * 3
+                else:
+                    y = x * 2
+            else:
+                y = -x
+            return y
+
+    net = NestedNet()
+    st = paddle.jit.to_static(net)
+    for xv in (np.full((2, 2), 5.0, np.float32),
+               np.full((2, 2), 0.5, np.float32),
+               np.full((2, 2), -1.0, np.float32)):
+        np.testing.assert_allclose(st(paddle.to_tensor(xv)).numpy(),
+                                   _np_run(net, xv), atol=1e-5)
+    assert len(st._jit_cache) == 1
+
+
+def test_one_branch_only_var_clear_error():
+    """Regression (review): a var bound in only one branch of a traced
+    if raises an actionable error, not a dtype-object crash."""
+    class OneBranch(nn.Layer):
+        def forward(self, x):
+            if x.mean() > 0:
+                y = x * 2
+            else:
+                z = x * 3
+                y = z
+            return y
+
+    st = paddle.jit.to_static(OneBranch())
+    with pytest.raises(Exception) as ei:
+        st(paddle.to_tensor(np.ones((2, 2), np.float32)))
+    assert "only one branch" in str(ei.value)
+
+
+def test_decorated_forward_left_alone():
+    import functools
+
+    def noisy(fn):
+        @functools.wraps(fn)
+        def inner(self, x):
+            return fn(self, x)
+        return inner
+
+    class DecNet(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(2, 2)
+
+        @noisy
+        def forward(self, x):
+            return self.fc(x)
+
+    net = DecNet()
+    st = paddle.jit.to_static(net)
+    x = RNG.randn(2, 2).astype(np.float32)
+    np.testing.assert_allclose(st(paddle.to_tensor(x)).numpy(),
+                               _np_run(net, x), atol=1e-5)
+
+
+def test_save_does_not_mutate_layer(tmp_path):
+    from paddle_tpu.static import InputSpec
+    net = BranchyNet()
+    before = net.__dict__.get("forward", None)
+    paddle.jit.save(net, str(tmp_path / "m"),
+                    input_spec=[InputSpec([None, 4], "float32")])
+    after = net.__dict__.get("forward", None)
+    assert before is after      # save left the layer untouched
