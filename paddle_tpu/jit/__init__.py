@@ -156,12 +156,14 @@ def save(layer, path, input_spec=None):
     # permanently mutate the caller's object.
     from .ast_transform import maybe_convert
     restore_forward = None
+    did_swap = False
     if is_layer:
         conv = maybe_convert(target.forward)
         if getattr(conv, "__jst_converted__", False) and not \
                 getattr(target.forward, "__jst_converted__", False):
             restore_forward = target.__dict__.get("forward", None)
             target.forward = conv
+            did_swap = True
     else:
         target = maybe_convert(target)
     was_training = bool(getattr(target, "training", False))
@@ -195,15 +197,13 @@ def save(layer, path, input_spec=None):
     finally:
         if was_training and hasattr(target, "train"):
             target.train()
-        if is_layer:
-            # undo the temporary converted-forward swap
+        if is_layer and did_swap:
+            # undo the temporary converted-forward swap (and ONLY then —
+            # a pre-existing instance forward must survive save)
             if restore_forward is not None:
                 target.forward = restore_forward
-            elif "forward" in getattr(target, "__dict__", {}):
-                try:
-                    del target.__dict__["forward"]
-                except (KeyError, TypeError):
-                    pass
+            else:
+                target.__dict__.pop("forward", None)
 
     d = os.path.dirname(path)
     if d:

@@ -15,7 +15,22 @@ from .layer.transformer import *  # noqa: F401,F403
 from .layer.extras import *      # noqa: F401,F403
 from .layer.decode import (Decoder, BeamSearchDecoder, dynamic_decode,  # noqa: F401
                            gather_tree)
+from .layer.rnn_builders import DynamicRNN, StaticRNN  # noqa: F401
+from .layer import weight_norm_hook  # noqa: F401
+from .layer.weight_norm_hook import remove_weight_norm, weight_norm  # noqa: F401
 from .functional.extension import crf_decoding  # noqa: F401
+from ..static.nn import cond, while_loop  # noqa: F401
+
+# reference nn exposes its layer/functional submodules as attributes
+from .layer import (common, conv, loss, norm, rnn)  # noqa: F401
+from .functional import extension, vision  # noqa: F401
+
+
+def Input(shape=None, dtype="float32", name=None):
+    """Static input declaration (reference paddle.nn.Input -> fluid
+    data): a placeholder spec consumed by jit.save / to_static."""
+    from ..static import InputSpec
+    return InputSpec(shape or [None], dtype=dtype, name=name)
 
 from ..framework import Parameter, ParamAttr  # noqa: F401
 

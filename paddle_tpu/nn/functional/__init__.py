@@ -14,5 +14,7 @@ from .array_ops import *     # noqa: F401,F403
 from .rnn_legacy import *    # noqa: F401,F403
 from .detection_tail import *  # noqa: F401,F403
 
+from ..layer.decode import gather_tree  # noqa: F401
+
 # re-export a few tensor ops that paddle exposes under nn.functional too
 from ...ops.manipulation import pad  # noqa: F401

@@ -1,0 +1,313 @@
+"""StaticRNN / DynamicRNN — the fluid with-block RNN builders, eager.
+
+Reference: fluid/layers/control_flow.py StaticRNN:448 (step/step_input/
+memory/update_memory/step_output protocol, time on dim 0) and DynamicRNN
+(fluid/layers/control_flow.py:2878 — block/step_input/memory/
+update_memory/output over LoD sequences).
+
+The reference executes the with-block ONCE to build a Program block that
+the executor replays per timestep. Eager equivalent: the with-block's
+source is recovered from the calling frame (the same AST machinery as
+jit/ast_transform), compiled into a step function, and re-executed per
+timestep with the builder in replay mode — step_input yields step t's
+slice, memory carries state, update_memory/step_output record. The
+initial with-block pass runs on step-0 data purely to type-check user
+code (its results are discarded), matching the reference's build pass.
+
+DynamicRNN rides the padded-dense sequence form (core/lod.py): inputs
+[B, T, ...] with `lengths`; finished sequences hold their memory and pad
+their outputs with zeros.
+"""
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor, apply
+
+__all__ = ["StaticRNN", "DynamicRNN"]
+
+
+class _StepCtx:
+    def __init__(self, rnn):
+        self._rnn = rnn
+
+    def __enter__(self):
+        frame = inspect.stack()[1].frame
+        self._rnn._capture_frame(frame)
+        self._rnn._mode = "build"
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self._rnn._mode = "after"
+        return False
+
+
+def _find_with_body(func_source, lineno_rel, ctx_name):
+    """The statement list of the `with <...>.step()/block():` at (or
+    nearest above) the given source line."""
+    tree = ast.parse(func_source)
+    best = None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.With) and node.lineno <= lineno_rel:
+            if best is None or node.lineno > best.lineno:
+                src = ast.get_source_segment(func_source, node.items[0]
+                                             .context_expr) or ""
+                if ctx_name in src:
+                    best = node
+    if best is None:
+        raise RuntimeError(
+            f"could not locate the `with ...{ctx_name}()` block in the "
+            "calling function's source (the builders need readable "
+            "source, like the reference's program capture)")
+    return best.body
+
+
+class _RnnBuilderBase:
+    """Shared engine: capture the with-block, replay per timestep."""
+
+    _CTX_NAME = "step"
+
+    def __init__(self, name=None):
+        self._mode = "before"
+        self._inputs = []          # raw [T, B, ...] (time-major)
+        self._lengths = None
+        self._mems = []            # dicts: init, current, update
+        self._outputs = []         # marker ids registered via step_output
+        self._t = 0
+        self._n_steps = None
+        self._frame_info = None
+        self._step_code = None
+        self._seen_inputs = 0
+        self._seen_mems = 0
+
+    # -- capture -----------------------------------------------------------
+    def _capture_frame(self, frame):
+        self._frame_info = {
+            "locals": dict(frame.f_locals),
+            "globals": frame.f_globals,
+            "lineno": frame.f_lineno,
+            "code": frame.f_code,
+        }
+
+    def _compile_step(self):
+        info = self._frame_info
+        try:
+            if info["code"].co_name == "<module>":
+                # getsource on module code yields only the first logical
+                # line; take the whole file instead
+                import linecache
+                lines = linecache.getlines(info["code"].co_filename)
+                if not lines:
+                    raise OSError("no source lines")
+                src = "".join(lines)
+                first = 1
+            else:
+                src = textwrap.dedent(inspect.getsource(info["code"]))
+                first = info["code"].co_firstlineno
+            rel = info["lineno"] - first + 1
+        except (OSError, TypeError) as e:
+            raise RuntimeError(
+                f"{type(self).__name__}: cannot read the caller's source "
+                f"({e}); the with-block builders need it") from None
+        body = _find_with_body(src, rel, self._CTX_NAME)
+        mod = ast.Module(body=list(body), type_ignores=[])
+        ast.increment_lineno(mod, 0)
+        ast.fix_missing_locations(mod)
+        self._step_code = compile(
+            mod, filename=f"<{type(self).__name__} step>", mode="exec")
+
+    # -- user protocol -----------------------------------------------------
+    def memory(self, init=None, shape=None, batch_ref=None, value=0.0,
+               init_value=0.0, dtype="float32", **kw):
+        if self._mode == "build":
+            if init is not None:
+                t = init if isinstance(init, Tensor) else \
+                    Tensor(jnp.asarray(init))
+            else:
+                if batch_ref is not None:
+                    b = (batch_ref.shape[0] if not isinstance(
+                        batch_ref, Tensor) else int(batch_ref.shape[0]))
+                else:
+                    b = self._batch_size()
+                dims = [b if (d is None or int(d) < 0) else int(d)
+                        for d in (shape or [])]
+                t = Tensor(jnp.full(tuple(dims),
+                                    float(value or init_value),
+                                    jnp.dtype(dtype)))
+            # memories stay TENSORS across steps so the tape chains the
+            # whole unrolled recurrence (BPTT through the builder)
+            self._mems.append({"init": t, "cur": t, "new": None})
+            return t
+        m = self._mems[self._seen_mems]
+        self._seen_mems += 1
+        return m["cur"]
+
+    def update_memory(self, mem, var):
+        # slot selected by the IDENTITY of `mem` (multi-memory blocks —
+        # e.g. LSTM h and c — must each update their own slot)
+        i = None
+        for j, m in enumerate(self._mems):
+            if m["cur"] is mem or m["init"] is mem:
+                i = j
+                break
+        if i is None:
+            i = (self._seen_mems - 1 if self._mode == "replay"
+                 else len(self._mems) - 1)
+        new = var if isinstance(var, Tensor) else Tensor(jnp.asarray(var))
+        if self._mode == "replay" and self._lengths is not None:
+            cur = self._mems[i]["cur"]
+            t_now = self._t
+            lengths = self._lengths
+
+            def f(n_, c_):
+                active = (t_now < lengths)
+                shp = (-1,) + (1,) * (n_.ndim - 1)
+                return jnp.where(active.reshape(shp), n_, c_)
+            new = apply(f, new, cur, op_name="drnn_mask")
+        self._mems[i]["new"] = new
+
+    def __call__(self):
+        if self._mode != "after":
+            raise RuntimeError("call the RNN after the with-block closes")
+        return self._run()
+
+    # -- engine ------------------------------------------------------------
+    def _batch_size(self):
+        if not self._inputs:
+            raise ValueError("memory(shape with -1) needs a step_input "
+                             "first (or pass batch_ref)")
+        return int(self._inputs[0].shape[1])
+
+    def _run(self):
+        self._compile_step()
+        self._mode = "replay"
+        for m in self._mems:
+            m["cur"] = m["init"]
+        outs = []
+        info = self._frame_info
+        for t in range(self._n_steps):
+            self._t = t
+            self._seen_inputs = 0
+            self._seen_mems = 0
+            self._step_outs = []
+            loc = dict(info["locals"])
+            exec(self._step_code, info["globals"], loc)
+            for m in self._mems:
+                if m["new"] is not None:
+                    m["cur"] = m["new"]
+                    m["new"] = None
+            outs.append(list(self._step_outs))
+        self._mode = "after"
+        return self._assemble(outs)
+
+
+class StaticRNN(_RnnBuilderBase):
+    """fluid.layers.StaticRNN (control_flow.py:448): inputs are
+    time-major [T, B, ...]; rnn() returns the stacked step outputs
+    [T, B, ...] (a tuple when multiple step_outputs)."""
+
+    _CTX_NAME = "step"
+
+    def step(self):
+        return _StepCtx(self)
+
+    def step_input(self, x):
+        t_in = x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+        if self._mode == "build":
+            # the TENSOR is kept so replay slices through the tape —
+            # grads reach whatever produced the input (embeddings etc.)
+            self._inputs.append(t_in)
+            n = t_in.shape[0]
+            if self._n_steps is None:
+                self._n_steps = int(n)
+            elif self._n_steps != int(n):
+                raise ValueError("step_input sequence lengths disagree")
+            return apply(lambda a: a[0], t_in, op_name="rnn_step_in")
+        i = self._seen_inputs
+        self._seen_inputs += 1
+        t_now = self._t
+        return apply(lambda a: a[t_now], self._inputs[i],
+                     op_name="rnn_step_in")
+
+    def step_output(self, o):
+        if self._mode == "build":
+            self._outputs.append(None)
+            return
+        self._step_outs.append(o if isinstance(o, Tensor)
+                               else Tensor(jnp.asarray(o)))
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    def _assemble(self, outs):
+        res = []
+        for k in range(len(outs[0])):
+            steps = [outs[t][k] for t in range(self._n_steps)]
+            res.append(apply(lambda *xs: jnp.stack(xs), *steps,
+                             op_name="static_rnn_stack"))
+        return res[0] if len(res) == 1 else tuple(res)
+
+
+class DynamicRNN(_RnnBuilderBase):
+    """fluid DynamicRNN (control_flow.py:2878) on the padded-dense form:
+    step_input takes (x [B, T, ...], lengths); finished sequences freeze
+    their memory and pad outputs with zeros. drnn() returns the padded
+    [B, T, ...] outputs (tuple when multiple)."""
+
+    _CTX_NAME = "block"
+
+    def block(self):
+        return _StepCtx(self)
+
+    def step_input(self, x, lengths=None, level=0):
+        t_in = x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+        if self._mode == "build":
+            self._inputs.append(t_in)           # batch-major [B, T, ...]
+            n = t_in.shape[1]
+            if self._n_steps is None:
+                self._n_steps = int(n)
+            else:
+                self._n_steps = max(self._n_steps, int(n))
+            if lengths is not None:
+                ln = lengths._data if isinstance(lengths, Tensor) else \
+                    jnp.asarray(lengths)
+                self._lengths = ln.reshape(-1)
+            return apply(lambda a: a[:, 0], t_in, op_name="drnn_step_in")
+        i = self._seen_inputs
+        self._seen_inputs += 1
+        t_now = self._t
+        return apply(lambda a: a[:, t_now], self._inputs[i],
+                     op_name="drnn_step_in")
+
+    def output(self, *outputs):
+        if self._mode == "build":
+            for _ in outputs:
+                self._outputs.append(None)
+            return
+        for o in outputs:
+            self._step_outs.append(o if isinstance(o, Tensor)
+                                   else Tensor(jnp.asarray(o)))
+
+    def _assemble(self, outs):
+        n_steps = self._n_steps
+        lengths = self._lengths
+        res = []
+        for k in range(len(outs[0])):
+            steps = [outs[t][k] for t in range(n_steps)]
+
+            def f(*xs):
+                s = jnp.swapaxes(jnp.stack(xs), 0, 1)   # [B, T, ...]
+                if lengths is not None:
+                    tpos = jnp.arange(n_steps)
+                    mask = tpos[None, :] < lengths[:, None]
+                    shape = mask.shape + (1,) * (s.ndim - 2)
+                    s = jnp.where(mask.reshape(shape), s, 0)
+                return s
+            res.append(apply(f, *steps, op_name="dynamic_rnn_stack"))
+        return res[0] if len(res) == 1 else tuple(res)

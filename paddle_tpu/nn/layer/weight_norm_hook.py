@@ -1,0 +1,103 @@
+"""Weight normalization (reference python/paddle/nn/utils/
+weight_norm_hook.py): reparameterize a layer's weight as
+w = g * v / ||v|| via a forward pre-hook, so the optimizer trains
+(g, v) while forward sees the composed weight.
+
+    layer = nn.Linear(4, 8)
+    weight_norm(layer)          # adds weight_g / weight_v params
+    remove_weight_norm(layer)   # folds back into a plain weight
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor, apply
+from ...framework import Parameter
+
+__all__ = ["weight_norm", "remove_weight_norm"]
+
+
+def _norm_except(v, dim):
+    """||v|| reduced over every axis except `dim` (dim=None: full norm)."""
+    if dim is None:
+        return jnp.sqrt(jnp.sum(v * v))
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(v * v, axis=axes, keepdims=True))
+
+
+def _compose(g, v, dim):
+    def f(g_, v_):
+        n = _norm_except(v_, dim)
+        if dim is None:
+            return v_ * (g_ / jnp.maximum(n, 1e-12))
+        shape = [1] * v_.ndim
+        shape[dim] = -1
+        return v_ * (g_.reshape(shape) / jnp.maximum(n, 1e-12))
+    return apply(f, g, v, op_name="weight_norm")
+
+
+class _WeightNormHook:
+    def __init__(self, name, dim):
+        self.name = name
+        self.dim = dim
+
+    def __call__(self, layer, inputs):
+        g = getattr(layer, self.name + "_g")
+        v = getattr(layer, self.name + "_v")
+        composed = _compose(g, v, self.dim)
+        # rebind the composed weight for this forward (not a Parameter:
+        # grads flow to g/v through the tape)
+        object.__setattr__(layer, self.name, composed)
+        return None
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Apply weight normalization to `layer.<name>` (reference
+    weight_norm_hook.weight_norm). dim is the kept axis of the norm
+    (None: whole-tensor norm)."""
+    if hasattr(layer, name + "_g"):
+        raise ValueError(f"weight_norm already applied to {name!r}")
+    w = getattr(layer, name)
+    w_arr = np.asarray(w.numpy())
+    if dim is not None:
+        axes = tuple(i for i in range(w_arr.ndim) if i != dim)
+        g0 = np.sqrt((w_arr * w_arr).sum(axis=axes))
+    else:
+        g0 = np.asarray(np.sqrt((w_arr * w_arr).sum()))
+    # the original weight Parameter leaves the trainable set; g/v join it
+    if name in layer._parameters:
+        del layer._parameters[name]
+    layer.add_parameter(name + "_g", Parameter(jnp.asarray(g0)))
+    layer.add_parameter(name + "_v", Parameter(jnp.asarray(w_arr)))
+    hook = _WeightNormHook(name, dim)
+    handle = layer.register_forward_pre_hook(hook)
+    layer._weight_norm_handles = getattr(layer, "_weight_norm_handles", {})
+    layer._weight_norm_handles[name] = (handle, hook)
+    # expose a composed weight immediately (pre-hook refreshes per call)
+    object.__setattr__(layer, name,
+                       _compose(getattr(layer, name + "_g"),
+                                getattr(layer, name + "_v"), dim))
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    """Fold g/v back into a plain trainable weight (reference
+    remove_weight_norm)."""
+    handles = getattr(layer, "_weight_norm_handles", {})
+    if name not in handles:
+        raise ValueError(f"weight_norm not applied to {name!r}")
+    handle, hook = handles.pop(name)
+    handle.remove()
+    g = getattr(layer, name + "_g")
+    v = getattr(layer, name + "_v")
+    composed = _compose(g, v, hook.dim)
+    del layer._parameters[name + "_g"]
+    del layer._parameters[name + "_v"]
+    if hasattr(layer, name):
+        try:
+            object.__delattr__(layer, name)
+        except AttributeError:
+            pass
+    layer.add_parameter(name, Parameter(composed._data))
+    return layer

@@ -261,7 +261,9 @@ def test_decorated_forward_left_alone():
             return self.fc(x)
 
     net = DecNet()
-    st = paddle.jit.to_static(net)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")       # expected decorator warning
+        st = paddle.jit.to_static(net)
     x = RNG.randn(2, 2).astype(np.float32)
     np.testing.assert_allclose(st(paddle.to_tensor(x)).numpy(),
                                _np_run(net, x), atol=1e-5)

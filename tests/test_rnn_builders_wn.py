@@ -1,0 +1,169 @@
+"""StaticRNN/DynamicRNN with-block builders + weight_norm (reference:
+fluid/tests/unittests/test_static_rnn*, test_weight_normalization.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+RNG = np.random.RandomState(23)
+
+
+def test_static_rnn_cumsum():
+    # h_t = h_{t-1} + x_t: output is the running sum over time
+    x = RNG.randn(5, 3, 4).astype(np.float32)       # [T, B, D]
+
+    rnn = nn.StaticRNN()
+    with rnn.step():
+        xt = rnn.step_input(paddle.to_tensor(x))
+        prev = rnn.memory(shape=[-1, 4], batch_ref=xt)
+        h = prev + xt
+        rnn.update_memory(prev, h)
+        rnn.step_output(h)
+    out = rnn().numpy()
+    np.testing.assert_allclose(out, np.cumsum(x, axis=0), atol=1e-5)
+
+
+def test_static_rnn_with_layer():
+    paddle.seed(0)
+    fc = nn.Linear(4, 4)
+    x = RNG.randn(3, 2, 4).astype(np.float32)
+
+    rnn = nn.StaticRNN()
+    with rnn.step():
+        xt = rnn.step_input(paddle.to_tensor(x))
+        prev = rnn.memory(shape=[-1, 4], batch_ref=xt)
+        h = paddle.tanh(fc(xt) + prev)
+        rnn.update_memory(prev, h)
+        rnn.step_output(h)
+    out = rnn().numpy()
+
+    # manual reference
+    h = np.zeros((2, 4), np.float32)
+    w, b = fc.weight.numpy(), fc.bias.numpy()
+    for t in range(3):
+        h = np.tanh(x[t] @ np.asarray(w) + np.asarray(b) + h)
+        np.testing.assert_allclose(out[t], h, atol=2e-4)
+
+
+def test_dynamic_rnn_lengths_mask():
+    x = RNG.randn(2, 4, 3).astype(np.float32)       # [B, T, D]
+    lengths = np.array([4, 2], np.int64)
+
+    drnn = nn.DynamicRNN()
+    with drnn.block():
+        xt = drnn.step_input(paddle.to_tensor(x),
+                             lengths=paddle.to_tensor(lengths))
+        prev = drnn.memory(shape=[-1, 3], batch_ref=xt)
+        h = prev + xt
+        drnn.update_memory(prev, h)
+        drnn.output(h)
+    out = drnn().numpy()
+    # sequence 0: full cumsum; sequence 1: frozen after t=1, padded 0
+    np.testing.assert_allclose(out[0], np.cumsum(x[0], axis=0), atol=1e-5)
+    np.testing.assert_allclose(out[1, :2], np.cumsum(x[1, :2], axis=0),
+                               atol=1e-5)
+    assert (out[1, 2:] == 0).all()
+
+
+def test_weight_norm_roundtrip():
+    paddle.seed(1)
+    fc = nn.Linear(4, 6)
+    w0 = np.asarray(fc.weight.numpy()).copy()
+    x = RNG.randn(3, 4).astype(np.float32)
+    ref = fc(paddle.to_tensor(x)).numpy()
+
+    nn.weight_norm(fc, dim=0)
+    names = {n for n, _ in fc.named_parameters()}
+    assert "weight_g" in names and "weight_v" in names
+    assert "weight" not in names
+    # composed weight reproduces the original forward
+    np.testing.assert_allclose(fc(paddle.to_tensor(x)).numpy(), ref,
+                               atol=1e-5)
+    # g scales the norm: doubling g doubles the output (bias removed)
+    fc.bias.set_value(np.zeros_like(np.asarray(fc.bias.numpy())))
+    base = fc(paddle.to_tensor(x)).numpy()
+    fc.weight_g.set_value(np.asarray(fc.weight_g.numpy()) * 2)
+    np.testing.assert_allclose(fc(paddle.to_tensor(x)).numpy(), 2 * base,
+                               atol=1e-4)
+
+    nn.remove_weight_norm(fc)
+    names = {n for n, _ in fc.named_parameters()}
+    assert "weight" in names and "weight_g" not in names
+    np.testing.assert_allclose(fc(paddle.to_tensor(x)).numpy(), 2 * base,
+                               atol=1e-4)
+
+
+def test_weight_norm_trains():
+    import paddle_tpu.optimizer as opt
+    paddle.seed(2)
+    fc = nn.Linear(3, 1)
+    nn.weight_norm(fc)
+    o = opt.SGD(learning_rate=0.1, parameters=list(fc.parameters()))
+    x = RNG.randn(16, 3).astype(np.float32)
+    y = (x @ np.array([[1.0], [2.0], [-1.0]], np.float32))
+    first = None
+    for _ in range(60):
+        pred = fc(paddle.to_tensor(x))
+        loss = paddle.mean((pred - paddle.to_tensor(y)) ** 2)
+        loss.backward(); o.step(); o.clear_grad()
+        v = float(loss.numpy())
+        if first is None: first = v
+    assert v < first * 0.2, (first, v)
+
+
+def test_nn_input_spec():
+    spec = nn.Input(shape=[None, 8], dtype="float32", name="feat")
+    assert spec.shape == (None, 8)
+    assert spec.name == "feat"
+
+
+def test_static_rnn_two_memories_lstmlike():
+    """Regression (review): update_memory must select the slot by the
+    identity of `mem` — two-memory blocks (h and c) update their own."""
+    x = RNG.randn(3, 2, 2).astype(np.float32)
+    rnn = nn.StaticRNN()
+    with rnn.step():
+        xt = rnn.step_input(paddle.to_tensor(x))
+        h = rnn.memory(shape=[-1, 2], batch_ref=xt)
+        c = rnn.memory(init=paddle.to_tensor(np.ones((2, 2), np.float32)))
+        new_c = c * 0.5
+        new_h = h + xt + new_c
+        rnn.update_memory(h, new_h)
+        rnn.update_memory(c, new_c)
+        rnn.step_output(new_h)
+        rnn.step_output(new_c)
+    hs, cs = rnn()
+    # c halves each step: 0.5, 0.25, 0.125
+    np.testing.assert_allclose(cs.numpy()[:, 0, 0], [0.5, 0.25, 0.125],
+                               atol=1e-6)
+    # h accumulates x + c
+    ref_h = np.zeros((2, 2), np.float32)
+    cval = np.ones((2, 2), np.float32)
+    for t in range(3):
+        cval = cval * 0.5
+        ref_h = ref_h + x[t] + cval
+        np.testing.assert_allclose(hs.numpy()[t], ref_h, atol=1e-5)
+
+
+def test_static_rnn_grads_reach_input_producer():
+    """Regression (review): step_input slices through the tape so the
+    layer producing the input trains too."""
+    import paddle_tpu.optimizer as opt
+    paddle.seed(9)
+    emb = nn.Embedding(10, 4)
+    ids = RNG.randint(0, 10, (3, 2)).astype(np.int64)   # [T, B]
+    x = emb(paddle.to_tensor(ids))                      # [T, B, 4]
+    rnn = nn.StaticRNN()
+    with rnn.step():
+        xt = rnn.step_input(x)
+        prev = rnn.memory(shape=[-1, 4], batch_ref=xt)
+        h = prev + xt
+        rnn.update_memory(prev, h)
+        rnn.step_output(h)
+    out = rnn()
+    loss = paddle.mean(out ** 2)
+    loss.backward()
+    g = emb.weight.grad
+    assert g is not None
+    assert np.abs(np.asarray(g.numpy())).sum() > 0
