@@ -43,3 +43,4 @@ from . import elastic  # noqa: F401
 from . import sequence_parallel  # noqa: F401
 
 from .store import Store, TCPStore, FileStore  # noqa: F401
+from .entry_attr import CountFilterEntry, EntryAttr, ProbabilityEntry  # noqa: F401,E402

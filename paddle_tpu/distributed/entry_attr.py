@@ -1,0 +1,48 @@
+"""Sparse-table entry admission policies (reference
+python/paddle/distributed/entry_attr.py): decide whether a sparse
+feature id gets an embedding entry — ProbabilityEntry admits with a
+coin flip, CountFilterEntry after a show-count threshold. Consumed by
+the PS sparse tables (distributed/ps)."""
+from __future__ import annotations
+
+__all__ = ["EntryAttr", "ProbabilityEntry", "CountFilterEntry"]
+
+
+class EntryAttr:
+    def _to_attr(self):
+        raise NotImplementedError
+
+
+class ProbabilityEntry(EntryAttr):
+    def __init__(self, probability):
+        if not isinstance(probability, float):
+            raise ValueError("probability must be a float in (0,1)")
+        if probability <= 0 or probability >= 1:
+            raise ValueError("probability must be a float in (0,1)")
+        self._name = "probability_entry"
+        self._probability = probability
+
+    def _to_attr(self):
+        return ":".join([self._name, str(self._probability)])
+
+    def admit(self, rng):
+        """Host-side admission decision for the PS sparse table."""
+        return float(rng.random()) < self._probability
+
+
+class CountFilterEntry(EntryAttr):
+    def __init__(self, count_filter):
+        if not isinstance(count_filter, int):
+            raise ValueError("count_filter must be a valid integer greater "
+                             "than 0")
+        if count_filter < 0:
+            raise ValueError("count_filter must be a valid integer greater "
+                             "or equal than 0")
+        self._name = "count_filter_entry"
+        self._count_filter = count_filter
+
+    def _to_attr(self):
+        return ":".join([self._name, str(self._count_filter)])
+
+    def admit(self, seen_count):
+        return int(seen_count) >= self._count_filter

@@ -10,3 +10,4 @@ from .sampler import (BatchSampler, DistributedBatchSampler, RandomSampler,
 from . import crypto  # noqa: F401  (model encryption, io/crypto/)
 from .data_feed import Slot, InMemoryDataset  # noqa: F401  (PS data path)
 from .fs import FS, LocalFS, sync_dir  # noqa: F401  (fs abstraction)
+

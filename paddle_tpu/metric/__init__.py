@@ -184,3 +184,8 @@ def accuracy(input, label, k=1, correct=None, total=None, name=None):
         hit = (topk_idx == lbl[..., None]).any(axis=-1)
         return jnp.mean(hit.astype(jnp.float32))
     return apply(f, input, label, op_name="accuracy")
+
+
+import sys as _sys
+
+metrics = _sys.modules[__name__]   # reference alias: paddle.metric.metrics

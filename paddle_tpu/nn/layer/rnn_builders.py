@@ -177,11 +177,13 @@ class _RnnBuilderBase:
         return self._run()
 
     # -- engine ------------------------------------------------------------
+    _BATCH_DIM = 1          # StaticRNN: [T, B, ...]
+
     def _batch_size(self):
         if not self._inputs:
             raise ValueError("memory(shape with -1) needs a step_input "
                              "first (or pass batch_ref)")
-        return int(self._inputs[0].shape[1])
+        return int(self._inputs[0].shape[self._BATCH_DIM])
 
     def _run(self):
         self._compile_step()
@@ -195,8 +197,12 @@ class _RnnBuilderBase:
             self._seen_inputs = 0
             self._seen_mems = 0
             self._step_outs = []
-            loc = dict(info["locals"])
-            exec(self._step_code, info["globals"], loc)
+            # ONE merged namespace as globals AND locals: with separate
+            # dicts, lambdas/genexprs in the block could not see names
+            # the block itself assigns (exec writes them to locals only)
+            ns = dict(info["globals"])
+            ns.update(info["locals"])
+            exec(self._step_code, ns)
             for m in self._mems:
                 if m["new"] is not None:
                     m["cur"] = m["new"]
@@ -261,6 +267,7 @@ class DynamicRNN(_RnnBuilderBase):
     [B, T, ...] outputs (tuple when multiple)."""
 
     _CTX_NAME = "block"
+    _BATCH_DIM = 0          # DynamicRNN: [B, T, ...]
 
     def block(self):
         return _StepCtx(self)
@@ -269,11 +276,16 @@ class DynamicRNN(_RnnBuilderBase):
         t_in = x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
         if self._mode == "build":
             self._inputs.append(t_in)           # batch-major [B, T, ...]
-            n = t_in.shape[1]
+            n = int(t_in.shape[1])
             if self._n_steps is None:
-                self._n_steps = int(n)
-            else:
-                self._n_steps = max(self._n_steps, int(n))
+                self._n_steps = n
+            elif self._n_steps != n:
+                # jnp index clamping would silently repeat the shorter
+                # input's last step — refuse instead (StaticRNN does too)
+                raise ValueError(
+                    f"step_input sequence lengths disagree "
+                    f"({self._n_steps} vs {n}); pad inputs to one T and "
+                    "pass lengths")
             if lengths is not None:
                 ln = lengths._data if isinstance(lengths, Tensor) else \
                     jnp.asarray(lengths)

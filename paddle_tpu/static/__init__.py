@@ -11,7 +11,12 @@ from __future__ import annotations
 from ..core import dtype as dtype_mod
 from . import nn  # noqa: F401  (cond/case/switch_case/while_loop)
 
-__all__ = ["InputSpec", "nn", "data"]
+from .compat import *  # noqa: F401,F403
+from ..legacy_alias import create_global_var, create_parameter  # noqa: F401
+from .compat import __all__ as _compat_all
+from .. import amp  # noqa: F401  (reference static re-exports amp)
+
+__all__ = ["InputSpec", "nn", "data", "amp"] + list(_compat_all)
 
 
 def data(name, shape, dtype="float32", lod_level=0):

@@ -1,0 +1,512 @@
+"""paddle.static compatibility surface over the trace-based design.
+
+Reference: python/paddle/static/__init__.py re-exports the Program/
+Executor machinery (fluid/framework.py Program:4458, executor.py
+Executor:779, io.py save/load_inference_model). In this framework the
+"program" IS a traced callable (StaticFunction / exported StableHLO),
+so each name here maps onto that design with REAL behavior:
+
+- Executor.run drives callables, StaticFunction and loaded
+  TranslatedLayer programs with feed/fetch dicts;
+- save/load_inference_model and the (de)serialize helpers are the
+  jit.save/jit.load artifacts ({path}.pdmodel/.pdiparams);
+- gradients/append_backward are the tape's autograd surface;
+- accuracy/auc are the static metric ops as direct math;
+- Program/Scope/program_guard keep the structural API (a Program
+  records the layers/fetches the Executor binds; a Scope is the
+  name->Tensor dict feed/fetch resolve against).
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = [
+    "Program", "CompiledProgram", "BuildStrategy", "ExecutionStrategy",
+    "Executor", "ParallelExecutor", "Scope", "Variable", "global_scope",
+    "scope_guard", "program_guard", "default_main_program",
+    "default_startup_program", "name_scope", "device_guard",
+    "cpu_places", "cuda_places", "xpu_places", "gradients",
+    "append_backward", "py_func", "Print", "accuracy", "auc",
+    "save", "load", "save_inference_model", "load_inference_model",
+    "serialize_program", "deserialize_program", "serialize_persistables",
+    "deserialize_persistables", "save_to_file", "load_from_file",
+    "normalize_program", "save_vars", "load_vars", "load_program_state",
+    "set_program_state", "WeightNormParamAttr",
+]
+
+Variable = Tensor          # the eager Tensor IS the variable
+
+
+class Scope:
+    """Name -> Tensor binding the Executor resolves feeds/fetches
+    against (reference Scope; here a plain dict)."""
+
+    def __init__(self):
+        self.vars = {}
+
+    def var(self, name):
+        return self.vars.setdefault(name, None)
+
+    def find_var(self, name):
+        return self.vars.get(name)
+
+    def set(self, name, value):
+        self.vars[name] = value
+
+
+_GLOBAL_SCOPE = Scope()
+_SCOPE_STACK = [_GLOBAL_SCOPE]
+
+
+def global_scope():
+    return _SCOPE_STACK[-1]
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    _SCOPE_STACK.append(scope)
+    try:
+        yield
+    finally:
+        _SCOPE_STACK.pop()
+
+
+class Program:
+    """A runnable unit: callables/layers registered (or passed straight
+    to Executor.run). The startup program's job — parameter init —
+    already happened eagerly at layer construction, so running it is a
+    no-op by design (documented), not an omission."""
+
+    def __init__(self):
+        self._callables = []
+        self.random_seed = None
+
+    def add(self, fn):
+        self._callables.append(fn)
+        return fn
+
+    def global_block(self):
+        return self
+
+    # block API subset used by porting code
+    @property
+    def ops(self):
+        return list(self._callables)
+
+    def clone(self, for_test=False):
+        p = Program()
+        p._callables = list(self._callables)
+        return p
+
+
+_MAIN = Program()
+_STARTUP = Program()
+
+
+def default_main_program():
+    return _MAIN
+
+
+def default_startup_program():
+    return _STARTUP
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    global _MAIN, _STARTUP
+    old = (_MAIN, _STARTUP)
+    _MAIN = main_program
+    if startup_program is not None:
+        _STARTUP = startup_program
+    try:
+        yield
+    finally:
+        _MAIN, _STARTUP = old
+
+
+@dataclasses.dataclass
+class BuildStrategy:
+    """Build hints (reference BuildStrategy): XLA owns fusion/memory
+    passes, so these are accepted-and-recorded toggles."""
+    enable_inplace: bool = True
+    fuse_all_optimizer_ops: bool = False
+    fuse_elewise_add_act_ops: bool = False
+    memory_optimize: bool = True
+    reduce_strategy: int = 0
+
+
+@dataclasses.dataclass
+class ExecutionStrategy:
+    num_threads: int = 1
+    num_iteration_per_drop_scope: int = 100
+
+
+class CompiledProgram:
+    """CompiledProgram(program-or-callable).with_data_parallel analog:
+    binding happens at Executor.run; jit compilation is the engine."""
+
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+        self.build_strategy = build_strategy or BuildStrategy()
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, places=None):
+        if build_strategy is not None:
+            self.build_strategy = build_strategy
+        return self
+
+
+class Executor:
+    """Runs callables / StaticFunction / jit.load programs with
+    feed/fetch dicts (reference executor.py:779). The callable's
+    positional order defines the feed binding: feed keys are matched by
+    the callable's signature when available, else by sorted key."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            scope=None, return_numpy=True):
+        feed = feed or {}
+        scope = scope or global_scope()
+        if program is None or program is _STARTUP or (
+                isinstance(program, Program) and not program._callables):
+            return []            # startup: params were eagerly initialized
+        target = program.program if isinstance(program, CompiledProgram) \
+            else program
+        runners = (target._callables if isinstance(target, Program)
+                   else [target])
+        import inspect as _inspect
+        outs = []
+        for fn in runners:
+            args = []
+            try:
+                sig = _inspect.signature(getattr(fn, "forward", fn))
+                params = [p for n_, p in sig.parameters.items()
+                          if n_ != "self"]
+                var_positional = any(
+                    p.kind is _inspect.Parameter.VAR_POSITIONAL
+                    for p in params)
+                names = [p.name for p in params
+                         if p.kind in (_inspect.Parameter.POSITIONAL_ONLY,
+                                       _inspect.Parameter
+                                       .POSITIONAL_OR_KEYWORD)]
+            except (TypeError, ValueError):
+                var_positional, names = True, []
+            bound = [n for n in names if n in feed]
+            if bound:
+                args = [to_tensor(np.asarray(feed[n])) for n in bound]
+            elif feed:
+                # no name matched (or *args callable): feed values bind
+                # positionally in sorted-key order — the reference feeds
+                # by placeholder name; here a traced callable's params
+                # may be named differently than the user's feed keys
+                args = [to_tensor(np.asarray(feed[k]))
+                        for k in sorted(feed)]
+            out = fn(*args)
+            outs.append(out)
+            scope.set(getattr(out, "name", None) or f"fetch_{len(outs)}",
+                      out)
+        if fetch_list:
+            res = []
+            for f in fetch_list:
+                v = f if isinstance(f, Tensor) else scope.find_var(str(f))
+                if v is None:
+                    raise KeyError(
+                        f"fetch target {f!r} not found in the scope "
+                        "(pass the Tensor itself, or set() it on the "
+                        "scope) — the reference Executor raises on "
+                        "unknown fetches too")
+                res.append(np.asarray(v.numpy()) if return_numpy and
+                           hasattr(v, "numpy") else v)
+            return res
+        if return_numpy:
+            return [np.asarray(o.numpy()) if hasattr(o, "numpy") else o
+                    for o in outs]
+        return outs
+
+    def close(self):
+        pass
+
+
+ParallelExecutor = Executor      # jit SPMD steps are the parallel engine
+
+
+def cpu_places(device_count=None):
+    import jax
+    n = device_count or max(1, len([d for d in jax.devices()
+                                    if d.platform == "cpu"]) or 1)
+    from ..core.place import CPUPlace
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    import jax
+    from ..core.place import TPUPlace
+    ids = device_ids if device_ids is not None else \
+        range(len(jax.devices()))
+    return [TPUPlace(i) for i in ids]
+
+
+xpu_places = cuda_places
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    """Name prefix context (reference fluid.name_scope); eager Tensors
+    carry generated names, so this is an annotation scope."""
+    yield
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """Op placement hint (reference device_guard); XLA places ops, so
+    the hint is accepted without effect."""
+    yield
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """d(targets)/d(inputs) via the tape (reference append_backward
+    machinery -> here core.autograd.grad)."""
+    from ..core.autograd import grad as _grad
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    total = targets[0]
+    for t in targets[1:]:
+        total = total + t
+    return _grad([total], list(inputs),
+                 grad_outputs=target_gradients, allow_unused=True)
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """Populate .grad on the parameters reaching `loss` (reference
+    backward.py append_backward). Returns [(param, grad)] pairs."""
+    loss.backward()
+    params = parameter_list or []
+    if not params:
+        return []
+    out = []
+    for p in params:
+        out.append((p, p.grad))
+    return out
+
+
+def py_func(func, x, out=None, backward_func=None, skip_vars_in_backward_input=None):
+    """Run a python function as an op (reference py_func_op): eager call
+    with Tensor(in)/Tensor(out) conversion; the tape handles backward
+    when `func` is built from framework ops, else it is a constant."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    res = func(*xs)
+    if isinstance(res, (list, tuple)):
+        return [r if isinstance(r, Tensor) else to_tensor(np.asarray(r))
+                for r in res]
+    return res if isinstance(res, Tensor) else to_tensor(np.asarray(res))
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """Debug print op (reference Print): prints and passes through."""
+    arr = np.asarray(input.numpy()) if hasattr(input, "numpy") else \
+        np.asarray(input)
+    head = f"{message or 'Print'}:"
+    if print_tensor_shape:
+        head += f" shape={list(arr.shape)}"
+    if print_tensor_type:
+        head += f" dtype={arr.dtype}"
+    flat = arr.reshape(-1)[:max(int(summarize), 0) or None]
+    print(head, flat)
+    return input
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """Static accuracy op (reference layers.accuracy): top-k hit rate."""
+    from ..metric import Accuracy
+    m = Accuracy(topk=(k,))
+    corr = m.compute(input, label)
+    res = m.update(corr)
+    return to_tensor(np.asarray(res, np.float32))
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    """Static AUC op (reference layers.auc): area under the ROC curve of
+    the positive-class scores."""
+    from ..metric import Auc
+    m = Auc(curve=curve, num_thresholds=num_thresholds)
+    m.update(input, label)
+    return to_tensor(np.asarray(m.accumulate(), np.float32))
+
+
+# -- save/load surface over the jit artifacts --------------------------------
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kw):
+    """Export for serving (reference static/io.py save_inference_model):
+    `fetch_vars` is the layer/StaticFunction; feed_vars supply the
+    InputSpecs (the jit.save artifact pair)."""
+    from .. import jit as jit_mod
+    from . import InputSpec
+    target = fetch_vars
+    if isinstance(target, (list, tuple)):
+        if len(target) != 1:
+            raise ValueError("save_inference_model here exports ONE "
+                             "callable (the traced program)")
+        target = target[0]
+    specs = [f if isinstance(f, InputSpec) else
+             InputSpec(list(getattr(f, "shape", [None])),
+                       str(getattr(f, "dtype", "float32")))
+             for f in (feed_vars if isinstance(feed_vars, (list, tuple))
+                       else [feed_vars])]
+    jit_mod.save(target, path_prefix, input_spec=specs)
+    return path_prefix
+
+
+def load_inference_model(path_prefix, executor=None, **kw):
+    """Load a served program (reference load_inference_model). Returns
+    (program, feed_names, fetch_names) with `program` a callable."""
+    from .. import jit as jit_mod
+    prog = jit_mod.load(path_prefix)
+    return prog, [], []
+
+
+def serialize_program(feed_vars=None, fetch_vars=None, program=None,
+                      path=None, layer=None, input_spec=None):
+    """Program bytes = the exported StableHLO module (jit.save's
+    .pdmodel payload) for a layer/StaticFunction."""
+    import os
+    import tempfile
+
+    from .. import jit as jit_mod
+    target = fetch_vars or layer or program
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "m")
+        jit_mod.save(target, p, input_spec=input_spec or feed_vars)
+        with open(p + ".pdmodel", "rb") as f:
+            return f.read()
+
+
+def deserialize_program(data):
+    from jax import export as jax_export
+    return jax_export.deserialize(data)
+
+
+def serialize_persistables(feed_vars=None, fetch_vars=None, program=None,
+                           layer=None):
+    import pickle
+
+    target = fetch_vars or layer or program
+    params = {k: np.asarray(v.numpy())
+              for k, v in dict(target.named_parameters()).items()}
+    return pickle.dumps(params, protocol=4)
+
+
+def deserialize_persistables(program_or_layer, data, executor=None):
+    import pickle
+
+    params = pickle.loads(data)
+    lookup = dict(program_or_layer.named_parameters())
+    for k, v in params.items():
+        if k in lookup:
+            lookup[k].set_value(v)
+    return program_or_layer
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def normalize_program(program, feed_vars=None, fetch_vars=None):
+    """Inference-ready form (reference prunes feed/fetch ops); traced
+    programs are already minimal — identity."""
+    return program
+
+
+def save_vars(executor=None, dirname=None, main_program=None, vars=None,
+              predicate=None, filename=None):
+    """Persist named Tensors (reference io.save_vars) as one pickle."""
+    import os
+    import pickle
+
+    payload = {getattr(v, "name", f"var_{i}"): np.asarray(v.numpy())
+               for i, v in enumerate(vars or [])}
+    path = os.path.join(dirname or ".", filename or "vars.pkl")
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(payload, f, protocol=4)
+    return path
+
+
+def load_vars(executor=None, dirname=None, main_program=None, vars=None,
+              predicate=None, filename=None):
+    import os
+    import pickle
+
+    path = os.path.join(dirname or ".", filename or "vars.pkl")
+    with open(path, "rb") as f:
+        payload = pickle.load(f)
+    for v in vars or []:
+        n = getattr(v, "name", None)
+        if n in payload:
+            v.set_value(payload[n])
+    return payload
+
+
+def load_program_state(model_path, var_list=None):
+    """state dict from a framework save (reference
+    load_program_state over .pdparams)."""
+    from ..framework import load as _load
+    return _load(model_path if model_path.endswith(".pdparams")
+                 else model_path + ".pdparams")
+
+
+def set_program_state(program_or_layer, state):
+    lookup = dict(program_or_layer.named_parameters())
+    for k, v in state.items():
+        if k in lookup:
+            lookup[k].set_value(np.asarray(v))
+
+
+def save(program_or_layer, path, **kw):
+    """static.save -> framework save of the layer's state
+    (reference static/io.py save)."""
+    from ..framework import save as _save
+    _save(dict(program_or_layer.named_parameters()) if hasattr(
+        program_or_layer, "named_parameters") else program_or_layer,
+        path if path.endswith(".pdparams") else path + ".pdparams")
+
+
+def load(program_or_layer, path, executor=None, var_list=None):
+    state = load_program_state(path)
+    set_program_state(program_or_layer, state)
+    return state
+
+
+class WeightNormParamAttr:
+    """ParamAttr marker requesting weight normalization (reference
+    WeightNormParamAttr); consumed by applying nn.weight_norm to the
+    owning layer with the recorded dim."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip

@@ -328,3 +328,8 @@ class ViterbiDecoder:
     def __call__(self, potentials, lengths=None):
         return viterbi_decode(potentials, self.transitions, lengths,
                               self.include_bos_eos_tag)
+
+
+import sys as _sys
+
+datasets = _sys.modules[__name__]  # reference alias: paddle.text.datasets

@@ -3,3 +3,6 @@
 from . import datasets, models, transforms
 
 __all__ = ["datasets", "models", "transforms"]
+
+from . import image, ops  # noqa: F401,E402
+from .image import get_image_backend, image_load, set_image_backend  # noqa: F401,E402

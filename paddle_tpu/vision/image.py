@@ -1,0 +1,51 @@
+"""paddle.vision.image (reference python/paddle/vision/image.py):
+image backend selection + image_load. Backends here: 'numpy' (raw
+arrays / .npy) always, 'pil' when Pillow is importable — the reference's
+cv2 backend has no library in this environment and raises the same
+ValueError the reference gives for unknown backends."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+__all__ = ["set_image_backend", "get_image_backend", "image_load"]
+
+_BACKEND = "numpy"
+
+
+def set_image_backend(backend):
+    global _BACKEND
+    if backend not in ("numpy", "pil"):
+        raise ValueError(
+            f"Expected backend are one of ['numpy', 'pil'], but got "
+            f"{backend}")
+    _BACKEND = backend
+
+
+def get_image_backend():
+    return _BACKEND
+
+
+def image_load(path, backend=None):
+    """Load an image file honoring the backend contract (reference
+    image_load dispatches cv2/PIL): 'numpy' accepts .npy/.npz and
+    returns ndarrays; 'pil' loads through Pillow."""
+    backend = backend or _BACKEND
+    ext = os.path.splitext(path)[1].lower()
+    if backend == "numpy":
+        if ext == ".npy":
+            return np.load(path)
+        if ext == ".npz":
+            data = np.load(path)
+            return data[list(data.files)[0]]
+        raise ValueError(
+            f"image_load backend 'numpy' reads .npy/.npz, got {ext!r}; "
+            "set_image_backend('pil') for image formats")
+    try:
+        from PIL import Image
+    except ImportError:
+        raise RuntimeError(
+            "image_load backend 'pil' needs Pillow (zero-egress image; "
+            "use the 'numpy' backend with .npy/.npz)")
+    return Image.open(path)

@@ -1,0 +1,69 @@
+"""paddle.vision.ops (reference python/paddle/vision/ops.py:25):
+yolo_loss, yolo_box, deform_conv2d, DeformConv2D — thin v2-signature
+facades over the nn.functional implementations."""
+from __future__ import annotations
+
+from .. import nn
+from ..nn import functional as F
+
+__all__ = ["yolo_loss", "yolo_box", "deform_conv2d", "DeformConv2D"]
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    return F.yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask,
+                         class_num, ignore_thresh, downsample_ratio,
+                         gt_score=gt_score,
+                         use_label_smooth=use_label_smooth,
+                         scale_x_y=scale_x_y)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, name=None, scale_x_y=1.0):
+    return F.yolo_box(x, img_size, anchors, class_num, conf_thresh,
+                      downsample_ratio, clip_bbox=clip_bbox,
+                      scale_x_y=scale_x_y)
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """v2 signature (weight explicit, mask None = v1)."""
+    kh, kw = int(weight.shape[2]), int(weight.shape[3])
+    return F.deformable_conv(x, offset, mask, int(weight.shape[0]),
+                             (kh, kw), weight, bias=bias, stride=stride,
+                             padding=padding, dilation=dilation,
+                             groups=groups,
+                             deformable_groups=deformable_groups,
+                             modulated=mask is not None)
+
+
+class DeformConv2D(nn.Layer):
+    """Deformable conv layer (reference vision/ops.py DeformConv2D):
+    owns the [out, in/groups, kh, kw] weight; offset (and mask for v2)
+    arrive per call."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        from ..nn import initializer as I
+        ks = kernel_size if isinstance(kernel_size, (list, tuple)) \
+            else (kernel_size, kernel_size)
+        self._attrs = dict(stride=stride, padding=padding,
+                           dilation=dilation,
+                           deformable_groups=deformable_groups,
+                           groups=groups)
+        self._kernel = tuple(int(k) for k in ks)
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, *self._kernel],
+            attr=weight_attr, default_initializer=I.XavierNormal())
+        self.bias = self.create_parameter([out_channels], attr=bias_attr,
+                                          is_bias=True)
+        if bias_attr is False:
+            self.bias = None
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, bias=self.bias,
+                             mask=mask, **self._attrs)

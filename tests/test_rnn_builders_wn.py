@@ -167,3 +167,30 @@ def test_static_rnn_grads_reach_input_producer():
     g = emb.weight.grad
     assert g is not None
     assert np.abs(np.asarray(g.numpy())).sum() > 0
+
+
+def test_dynamic_rnn_batch_size_and_lambda():
+    """Regressions (review): memory(shape=[-1,D]) sizes by BATCH for the
+    batch-major DynamicRNN, and block-local lambdas see block names."""
+    x = RNG.randn(2, 4, 3).astype(np.float32)
+    drnn = nn.DynamicRNN()
+    with drnn.block():
+        xt = drnn.step_input(paddle.to_tensor(x))
+        prev = drnn.memory(shape=[-1, 3])          # no batch_ref
+        f = lambda t: t + xt                        # noqa: E731
+        h = f(prev)
+        drnn.update_memory(prev, h)
+        drnn.output(h)
+    out = drnn().numpy()
+    assert out.shape == (2, 4, 3)
+    np.testing.assert_allclose(out[0], np.cumsum(x[0], 0), atol=1e-5)
+
+
+def test_dynamic_rnn_rejects_mismatched_inputs():
+    a = paddle.to_tensor(RNG.randn(2, 4, 3).astype(np.float32))
+    b = paddle.to_tensor(RNG.randn(2, 2, 3).astype(np.float32))
+    drnn = nn.DynamicRNN()
+    with pytest.raises(ValueError):
+        with drnn.block():
+            drnn.step_input(a)
+            drnn.step_input(b)

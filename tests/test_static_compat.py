@@ -1,0 +1,120 @@
+"""paddle.static compatibility surface (reference static/__init__.py):
+Executor/Program/save-load over the trace-based engine."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import static
+
+RNG = np.random.RandomState(31)
+
+
+def test_executor_runs_layer_with_feed_fetch():
+    paddle.seed(0)
+    net = nn.Linear(4, 2)
+    exe = static.Executor()
+    x = RNG.randn(3, 4).astype(np.float32)
+    # startup program: no-op (params eagerly initialized)
+    assert exe.run(static.default_startup_program()) == []
+    out = exe.run(net, feed={"x": x}, fetch_list=None)
+    ref = net(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(out[0], ref, atol=1e-6)
+
+
+def test_program_guard_and_scope():
+    main = static.Program()
+    startup = static.Program()
+    with static.program_guard(main, startup):
+        assert static.default_main_program() is main
+    assert static.default_main_program() is not main
+    sc = static.Scope()
+    with static.scope_guard(sc):
+        assert static.global_scope() is sc
+        sc.set("k", paddle.to_tensor(np.ones(2, np.float32)))
+        assert sc.find_var("k") is not None
+
+
+def test_gradients_and_append_backward():
+    w = paddle.create_parameter([3], "float32")
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32),
+                         stop_gradient=False)
+    y = paddle.sum(w * x)
+    (gx,) = static.gradients([y], [x])
+    np.testing.assert_allclose(np.asarray(gx.numpy()),
+                               np.asarray(w.numpy()), atol=1e-6)
+
+    w2 = paddle.create_parameter([2], "float32")
+    loss = paddle.sum(w2 * w2)
+    pairs = static.append_backward(loss, parameter_list=[w2])
+    assert pairs[0][0] is w2
+    np.testing.assert_allclose(np.asarray(pairs[0][1].numpy()),
+                               2 * np.asarray(w2.numpy()), atol=1e-5)
+
+
+def test_save_load_inference_model(tmp_path):
+    paddle.seed(1)
+    net = nn.Linear(4, 2)
+    x = RNG.randn(2, 4).astype(np.float32)
+    ref = net(paddle.to_tensor(x)).numpy()
+    path = str(tmp_path / "m")
+    static.save_inference_model(
+        path, [static.InputSpec([None, 4], "float32")], net)
+    prog, _, _ = static.load_inference_model(path)
+    got = np.asarray(prog(paddle.to_tensor(x)).numpy())
+    np.testing.assert_allclose(got, ref, atol=1e-5)
+
+
+def test_program_state_roundtrip(tmp_path):
+    paddle.seed(2)
+    net = nn.Linear(3, 3)
+    path = str(tmp_path / "state")
+    static.save(net, path)
+    w0 = np.asarray(net.weight.numpy()).copy()
+    net.weight.set_value(np.zeros_like(w0))
+    static.load(net, path)
+    np.testing.assert_allclose(np.asarray(net.weight.numpy()), w0)
+
+
+def test_accuracy_auc_ops():
+    pred = paddle.to_tensor(np.array([[0.9, 0.1], [0.2, 0.8]], np.float32))
+    lbl = paddle.to_tensor(np.array([[0], [1]], np.int64))
+    acc = float(static.accuracy(pred, lbl).numpy())
+    assert acc == 1.0
+    auc = float(static.auc(pred, lbl).numpy())
+    assert 0.9 <= auc <= 1.0
+
+
+def test_places_and_misc():
+    assert len(static.cpu_places(2)) == 2
+    assert static.cuda_places([0])
+    with static.name_scope("blk"):
+        pass
+    with static.device_guard("cpu"):
+        pass
+    t = paddle.to_tensor(np.arange(4, dtype=np.float32))
+    out = static.Print(t, message="dbg", summarize=2)
+    assert out is t
+    assert static.Variable is paddle.Tensor
+
+
+def test_vision_ops_namespace():
+    import paddle_tpu.vision as vision
+    x = paddle.to_tensor(RNG.randn(1, 2 * 7, 3, 3).astype(np.float32))
+    img = paddle.to_tensor(np.array([[96, 96]], np.int32))
+    boxes, scores = vision.ops.yolo_box(x, img, [10, 13, 16, 30], 2,
+                                        0.3, 32)
+    assert boxes.numpy().shape == (1, 18, 4)
+    layer = vision.ops.DeformConv2D(2, 4, 3, padding=1)
+    xi = paddle.to_tensor(RNG.randn(1, 2, 5, 5).astype(np.float32))
+    off = paddle.to_tensor(np.zeros((1, 18, 5, 5), np.float32))
+    out = layer(xi, off)
+    assert out.numpy().shape == (1, 4, 5, 5)
+
+
+def test_entry_attrs():
+    from paddle_tpu.distributed import CountFilterEntry, ProbabilityEntry
+    p = ProbabilityEntry(0.5)
+    assert p._to_attr() == "probability_entry:0.5"
+    c = CountFilterEntry(3)
+    assert c._to_attr() == "count_filter_entry:3"
+    assert not c.admit(2) and c.admit(3)
