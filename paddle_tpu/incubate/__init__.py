@@ -3,3 +3,28 @@
 from . import optimizer  # noqa: F401
 
 __all__ = ["optimizer"]
+
+
+class LayerHelper:
+    """Thin fluid LayerHelper analog (reference fluid/layer_helper.py):
+    eager layers own their parameters directly, so the helper only
+    carries the naming/creation conveniences porting code touches."""
+
+    def __init__(self, layer_type, **kwargs):
+        self.layer_type = layer_type
+        self.kwargs = kwargs
+
+    def create_parameter(self, attr=None, shape=None, dtype="float32",
+                         is_bias=False, default_initializer=None):
+        from ..legacy_alias import create_parameter as _cp
+        return _cp(shape, dtype, attr=attr, is_bias=is_bias,
+                   default_initializer=default_initializer)
+
+    def create_variable_for_type_inference(self, dtype="float32"):
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor
+        return Tensor(jnp.zeros([], jnp.dtype(dtype)))
+
+
+from ..io import reader_compat as reader  # noqa: F401,E402

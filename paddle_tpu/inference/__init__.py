@@ -115,3 +115,35 @@ class Predictor:
 
 def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
+
+
+import enum as _enum
+
+
+class DataType(_enum.Enum):
+    """reference paddle/inference DataType (paddle_infer enums)."""
+    FLOAT32 = 0
+    INT64 = 1
+    INT32 = 2
+    UINT8 = 3
+    INT8 = 4
+    FLOAT16 = 5
+    BFLOAT16 = 6
+
+
+class PlaceType(_enum.Enum):
+    UNK = -1
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    TPU = 3
+
+
+class PrecisionType(_enum.Enum):
+    Float32 = 0
+    Half = 1
+    Int8 = 2
+    Bfloat16 = 3
+
+
+from ..core.tensor import Tensor  # noqa: F401,E402  (handle type parity)

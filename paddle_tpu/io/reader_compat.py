@@ -1,0 +1,123 @@
+"""paddle.incubate.reader (reference fluid/contrib/reader):
+decorator-style reader pipeline helpers over python generators."""
+from __future__ import annotations
+
+__all__ = ["cache", "buffered", "compose", "chain", "shuffle",
+           "xmap_readers", "ComposeNotAligned"]
+
+
+def cache(reader):
+    """Materialize a reader's items once, replay from memory. The cache
+    publishes only on a COMPLETED pass — abandoned or interleaved first
+    passes cannot corrupt it."""
+    state = {"items": None}
+
+    def new_reader():
+        if state["items"] is not None:
+            yield from state["items"]
+            return
+        local = []
+        for it in reader():
+            local.append(it)
+            yield it
+        state["items"] = local
+    return new_reader
+
+
+def buffered(reader, size):
+    """Prefetch up to `size` items on a background thread."""
+    import queue
+    import threading
+
+    def new_reader():
+        q = queue.Queue(maxsize=size)
+        END = object()
+        err = []
+
+        def fill():
+            try:
+                for it in reader():
+                    q.put(it)
+            except BaseException as e:      # propagate to the consumer
+                err.append(e)
+            finally:
+                q.put(END)
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            it = q.get()
+            if it is END:
+                break
+            yield it
+        if err:
+            raise err[0]
+    return new_reader
+
+
+class ComposeNotAligned(ValueError):
+    """Raised when composed readers end at different lengths
+    (reference fluid/reader compose check_alignment)."""
+
+
+def compose(*readers, check_alignment=True):
+    """Zip readers: yields tuples of one item from each; by default a
+    length mismatch raises ComposeNotAligned like the reference."""
+    def new_reader():
+        gens = [r() for r in readers]
+        while True:
+            outs, stops = [], 0
+            for g in gens:
+                try:
+                    outs.append(next(g))
+                except StopIteration:
+                    stops += 1
+                    outs.append(None)
+            if stops == len(gens):
+                return
+            if stops:
+                if check_alignment:
+                    raise ComposeNotAligned(
+                        "composed readers have different lengths")
+                return
+            flat = []
+            for it in outs:
+                if isinstance(it, tuple):
+                    flat.extend(it)
+                else:
+                    flat.append(it)
+            yield tuple(flat)
+    return new_reader
+
+
+def chain(*readers):
+    def new_reader():
+        for r in readers:
+            yield from r()
+    return new_reader
+
+
+def shuffle(reader, buf_size):
+    import random
+
+    def new_reader():
+        buf = []
+        for it in reader():
+            buf.append(it)
+            if len(buf) >= buf_size:
+                random.shuffle(buf)
+                yield from buf
+                buf = []
+        random.shuffle(buf)
+        yield from buf
+    return new_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel map over a reader (thread pool; the reference uses
+    threads too)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    def new_reader():
+        with ThreadPoolExecutor(max_workers=process_num) as pool:
+            yield from pool.map(mapper, reader())
+    return new_reader

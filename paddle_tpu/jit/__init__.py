@@ -36,7 +36,9 @@ from ..core.tensor import Tensor
 from ..framework import functional_call, param_arrays, state_arrays
 from ..static import InputSpec
 
-__all__ = ["to_static", "save", "load", "TranslatedLayer", "not_to_static"]
+__all__ = ["to_static", "save", "load", "TranslatedLayer", "not_to_static",
+           "ProgramTranslator", "TracedLayer", "set_code_level",
+           "set_verbosity"]
 
 
 def _spec_to_aval(spec, sym_ctx):
@@ -98,6 +100,11 @@ class StaticFunction:
         return self._jit_cache[key]
 
     def __call__(self, *args, **kwargs):
+        from .ast_transform import translation_enabled
+        if not translation_enabled():
+            # ProgramTranslator.enable(False): run dygraph per the
+            # reference contract (decided per CALL, not at decoration)
+            return self._target(*args, **kwargs)
         arrayish = (Tensor, jnp.ndarray, np.ndarray)
         static_kw = {k: v for k, v in kwargs.items()
                      if not isinstance(v, arrayish)}
@@ -262,3 +269,71 @@ def load(path, params_path=None):
     with open(params_file, "rb") as f:
         params = {k: jnp.asarray(v) for k, v in pickle.load(f).items()}
     return TranslatedLayer(exported, params)
+
+
+class ProgramTranslator:
+    """Singleton controlling dygraph-to-static translation (reference
+    dygraph_to_static/program_translator.py ProgramTranslator): enable()
+    toggles the AST conversion pass globally."""
+
+    _instance = None
+
+    @classmethod
+    def get_instance(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def enable(self, enable_to_static):
+        from .ast_transform import enable_translation
+        enable_translation(enable_to_static)
+
+    @property
+    def enable_to_static(self):
+        from .ast_transform import translation_enabled
+        return translation_enabled()
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """Translation logging level (reference jit.set_verbosity)."""
+    from . import ast_transform as _at
+    _at._VERBOSITY[0] = int(level)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """Print converted sources as they are produced, up to `level`
+    conversions per function (reference jit.set_code_level)."""
+    from . import ast_transform as _at
+    _at._CODE_LEVEL[0] = int(level)
+
+
+class TracedLayer:
+    """Trace-and-bundle a layer from example inputs (reference
+    fluid/dygraph/jit.py TracedLayer): trace() runs the layer, returns
+    (traced, outputs); the traced object calls through jit and
+    save_inference_model exports the jit artifacts."""
+
+    def __init__(self, layer, inputs):
+        self._static = StaticFunction(layer)
+        self._layer = layer
+        self._inputs = inputs
+
+    @classmethod
+    def trace(cls, layer, inputs):
+        """Returns (dygraph_outputs, traced_layer) — the reference's
+        order (fluid/dygraph/jit.py TracedLayer.trace)."""
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        tl = cls(layer, inputs)
+        outs = tl(*inputs)
+        return outs, tl
+
+    def __call__(self, *args):
+        return self._static(*args)
+
+    def save_inference_model(self, path, feed=None, fetch=None, **kw):
+        specs = [InputSpec(list(np.asarray(
+            a._data if isinstance(a, Tensor) else a).shape),
+            str(np.asarray(a._data if isinstance(a, Tensor)
+                           else a).dtype)) for a in self._inputs]
+        save(self._layer, path, input_spec=specs)
+        return path

@@ -354,6 +354,8 @@ def convert_function(fn):
     """Rewrite tensor-dependent if/while in `fn` into the static.nn
     combinators. Returns the converted function, or `fn` unchanged (with
     a warning) when the source can't be transformed."""
+    if not _TRANSLATION_ENABLED[0]:
+        return fn
     try:
         src = textwrap.dedent(inspect.getsource(fn))
         tree = ast.parse(src)
@@ -394,6 +396,9 @@ def convert_function(fn):
     namespace["__jst_cond"] = _jst_cond
     namespace["__jst_while"] = _jst_while
     namespace["__jst_undef"] = _JST_UNDEF
+    if _CODE_LEVEL[0] > 0:
+        print(f"[to_static] converted {fn.__qualname__}:")
+        print(ast.unparse(tree))
     code = compile(tree, filename=f"<to_static {fn.__qualname__}>",
                    mode="exec")
     exec(code, namespace)
@@ -427,3 +432,17 @@ def convert_target(obj):
             obj.forward = conv
         return obj
     return maybe_convert(obj)
+
+
+_TRANSLATION_ENABLED = [True]
+_VERBOSITY = [0]
+_CODE_LEVEL = [0]
+
+
+def enable_translation(flag):
+    """ProgramTranslator.enable analog: globally toggles the AST pass."""
+    _TRANSLATION_ENABLED[0] = bool(flag)
+
+
+def translation_enabled():
+    return _TRANSLATION_ENABLED[0]

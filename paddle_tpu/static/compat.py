@@ -385,10 +385,19 @@ def serialize_program(feed_vars=None, fetch_vars=None, program=None,
     import tempfile
 
     from .. import jit as jit_mod
+    from . import InputSpec
     target = fetch_vars or layer or program
+    specs = input_spec
+    if specs is None and feed_vars is not None:
+        fv = feed_vars if isinstance(feed_vars, (list, tuple)) \
+            else [feed_vars]
+        specs = [f if isinstance(f, InputSpec) else
+                 InputSpec(list(getattr(f, "shape", [None])),
+                           str(getattr(f, "dtype", "float32")))
+                 for f in fv]
     with tempfile.TemporaryDirectory() as d:
         p = os.path.join(d, "m")
-        jit_mod.save(target, p, input_spec=input_spec or feed_vars)
+        jit_mod.save(target, p, input_spec=specs)
         with open(p + ".pdmodel", "rb") as f:
             return f.read()
 

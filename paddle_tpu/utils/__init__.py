@@ -6,6 +6,9 @@ from . import cpp_extension  # noqa: F401
 from . import custom_op  # noqa: F401
 from . import op_bench  # noqa: F401
 from .custom_op import register_op  # noqa: F401
+from .compat import (OpLastCheckpointChecker, Profiler,  # noqa: F401
+                     ProfilerOptions, deprecated, download, get_profiler,
+                     require_version, try_import, unique_name)
 
 __all__ = ["op_bench", "collective_bench", "custom_op", "register_op",
            "run_check", "cpp_extension"]

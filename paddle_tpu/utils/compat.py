@@ -1,0 +1,128 @@
+"""paddle.utils tail (reference python/paddle/utils/__init__.py):
+deprecated, try_import, require_version, unique_name, download facade,
+legacy profiler aliases."""
+from __future__ import annotations
+
+import functools
+import importlib
+import warnings
+
+__all__ = ["deprecated", "try_import", "require_version", "unique_name",
+           "download", "Profiler", "ProfilerOptions", "get_profiler",
+           "OpLastCheckpointChecker"]
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """Decorator emitting a DeprecationWarning on call (reference
+    utils/deprecated.py)."""
+    def wrap(fn):
+        msg = f"API {fn.__name__} is deprecated"
+        if since:
+            msg += f" since {since}"
+        if update_to:
+            msg += f"; use {update_to} instead"
+        if reason:
+            msg += f" ({reason})"
+
+        @functools.wraps(fn)
+        def inner(*a, **kw):
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*a, **kw)
+        return inner
+    return wrap
+
+
+def try_import(module_name, err_msg=None):
+    """Import or raise a friendly ImportError (reference
+    utils/lazy_import.py try_import)."""
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(
+            err_msg or f"Failed importing {module_name}. This likely "
+            f"means the optional dependency is not installed.")
+
+
+def require_version(min_version, max_version=None):
+    """Check the installed framework version against a range (reference
+    utils/install_check-style require_version)."""
+    from .. import __version__
+
+    def parse(v):
+        return tuple(int(x) for x in str(v).split(".")[:3])
+    cur = parse(__version__)
+    if parse(min_version) > cur:
+        raise Exception(
+            f"installed version {__version__} < required {min_version}")
+    if max_version is not None and parse(max_version) < cur:
+        raise Exception(
+            f"installed version {__version__} > allowed {max_version}")
+    return True
+
+
+class _UniqueNameModule:
+    """paddle.utils.unique_name (reference fluid/unique_name.py):
+    generate(prefix) -> prefix_N, guard() scopes the counters, switch()
+    swaps generators."""
+
+    def __init__(self):
+        self._counters = {}
+
+    def generate(self, key):
+        n = self._counters.get(key, 0)
+        self._counters[key] = n + 1
+        return f"{key}_{n}"
+
+    def switch(self, new_generator=None):
+        old = dict(self._counters)
+        self._counters = {} if new_generator is None else new_generator
+        return old
+
+    def guard(self, new_generator=None):
+        import contextlib
+
+        @contextlib.contextmanager
+        def g():
+            old = self._counters
+            self._counters = {}
+            try:
+                yield
+            finally:
+                self._counters = old
+        return g()
+
+
+unique_name = _UniqueNameModule()
+
+
+def download(url, path=None, md5sum=None, **kw):
+    """Zero-egress environment: downloads are unavailable by design;
+    datasets read local files (see paddle.vision.datasets docstrings)."""
+    raise RuntimeError(
+        "paddle.utils.download: this environment has no network egress; "
+        "place the file locally and pass its path to the dataset/loader")
+
+
+# legacy fluid profiler aliases over paddle_tpu.profiler
+class ProfilerOptions:
+    def __init__(self, options=None):
+        self.options = options or {}
+
+
+def Profiler(*a, **kw):
+    from .. import profiler as prof
+    return prof.Profiler(*a, **kw) if hasattr(prof, "Profiler") else prof
+
+
+def get_profiler(*a, **kw):
+    from .. import profiler as prof
+    return prof
+
+
+class OpLastCheckpointChecker:
+    """Compat checker for op-version checkpoints (reference
+    utils/op_version.py); custom ops here version through
+    utils.custom_op's registry, so every query reports 'current'."""
+
+    def check(self, op_name, **kw):
+        return True

@@ -1,6 +1,7 @@
 """paddle.static compatibility surface (reference static/__init__.py):
 Executor/Program/save-load over the trace-based engine."""
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 import paddle_tpu.nn as nn
@@ -118,3 +119,105 @@ def test_entry_attrs():
     c = CountFilterEntry(3)
     assert c._to_attr() == "count_filter_entry:3"
     assert not c.admit(2) and c.admit(3)
+
+
+def test_utils_tail():
+    from paddle_tpu import utils
+    assert utils.require_version("0.0.1")
+    with static.name_scope("x"):
+        pass
+    n1, n2 = utils.unique_name.generate("w"), utils.unique_name.generate("w")
+    assert n1 != n2
+    with utils.unique_name.guard():
+        assert utils.unique_name.generate("w").endswith("_0")
+    import pytest as _pt
+    with _pt.raises(RuntimeError):
+        utils.download("http://example.com/x")
+
+    @utils.deprecated(update_to="paddle.new_api", since="2.0")
+    def old():
+        return 42
+    import warnings as _w
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter("always")
+        assert old() == 42
+    assert any("deprecated" in str(r.message) for r in rec)
+
+
+def test_jit_translator_and_traced_layer(tmp_path):
+    import paddle_tpu
+    pt = paddle_tpu.jit.ProgramTranslator.get_instance()
+    try:
+        assert pt.enable_to_static
+        pt.enable(False)
+        assert not pt.enable_to_static
+    finally:
+        pt.enable(True)
+
+    paddle.seed(4)
+    net = nn.Linear(3, 2)
+    x = paddle.to_tensor(RNG.randn(2, 3).astype(np.float32))
+    # reference order: (dygraph outputs, traced layer)
+    outs, tl = paddle_tpu.jit.TracedLayer.trace(net, [x])
+    np.testing.assert_allclose(outs.numpy(), net(x).numpy(), atol=1e-5)
+    tl.save_inference_model(str(tmp_path / "tl"))
+    loaded = paddle_tpu.jit.load(str(tmp_path / "tl"))
+    np.testing.assert_allclose(np.asarray(loaded(x).numpy()),
+                               np.asarray(net(x).numpy()), atol=1e-5)
+
+
+def test_incubate_reader_pipeline():
+    import paddle_tpu.incubate as inc
+    base = lambda: iter(range(10))                       # noqa: E731
+    shuffled = sorted(inc.reader.shuffle(base, 4)())
+    assert shuffled == list(range(10))
+    assert list(inc.reader.chain(base, base)()) == list(range(10)) * 2
+    doubled = list(inc.reader.xmap_readers(lambda v: v * 2, base, 2, 4)())
+    assert sorted(doubled) == [v * 2 for v in range(10)]
+
+
+def test_reader_compat_hazards():
+    import paddle_tpu.incubate as inc
+
+    # cache publishes only a COMPLETED pass
+    calls = [0]
+    def base():
+        calls[0] += 1
+        yield from range(3)
+    r = inc.reader.cache(base)
+    g = r(); next(g)                       # abandoned first pass
+    assert list(r()) == [0, 1, 2]
+    assert list(r()) == [0, 1, 2]          # from cache, uncorrupted
+    assert calls[0] == 2                   # third call replays memory
+
+    # buffered propagates source exceptions instead of hanging
+    def bad():
+        yield 1
+        raise RuntimeError("boom")
+    with pytest.raises(RuntimeError):
+        list(inc.reader.buffered(bad, 2)())
+
+    # compose alignment check
+    a = lambda: iter(range(3))             # noqa: E731
+    b = lambda: iter(range(2))             # noqa: E731
+    with pytest.raises(inc.reader.ComposeNotAligned):
+        list(inc.reader.compose(a, b)())
+    assert len(list(inc.reader.compose(
+        a, b, check_alignment=False)())) == 2
+
+
+def test_translator_disable_runs_dygraph():
+    """enable(False) must affect ALREADY-decorated functions per call."""
+    import paddle_tpu
+    paddle.seed(5)
+    net = nn.Linear(2, 2)
+    st = paddle_tpu.jit.to_static(net)
+    x = paddle.to_tensor(RNG.randn(2, 2).astype(np.float32))
+    ref = st(x).numpy()
+    pt = paddle_tpu.jit.ProgramTranslator.get_instance()
+    try:
+        pt.enable(False)
+        out = st(x)                        # dygraph path, same numbers
+        np.testing.assert_allclose(out.numpy(), ref, atol=1e-5)
+    finally:
+        pt.enable(True)
