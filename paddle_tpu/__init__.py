@@ -64,6 +64,6 @@ VarBase = Tensor
 LoDTensorArray = list
 from .core.place import (CUDAPinnedPlace, XPUPlace)  # noqa: F401,E402
 
-__version__ = "0.2.0"
+__version__ = "0.3.0"
 full_version = __version__
 commit = "tpu-native"
