@@ -354,14 +354,18 @@ def test_metrics_on_strategy_path_parity():
     ds = TensorDataset([paddle.to_tensor(x), paddle.to_tensor(y)])
 
     def build(strategy):
+        import warnings as _w
         paddle.seed(7)
         net = nn.Linear(8, 4)
         m = Model(net)
-        m.prepare(opt.SGD(learning_rate=0.1,
-                          parameters=net.parameters()),
-                  nn.CrossEntropyLoss(),
-                  metrics=Accuracy(topk=(1, 2)),
-                  strategy=strategy)
+        with _w.catch_warnings():
+            # expected informational warning: fit() omits metric values
+            _w.simplefilter("ignore", UserWarning)
+            m.prepare(opt.SGD(learning_rate=0.1,
+                              parameters=net.parameters()),
+                      nn.CrossEntropyLoss(),
+                      metrics=Accuracy(topk=(1, 2)),
+                      strategy=strategy)
         if strategy is not None:
             # build the dist program by running one training step
             m.fit(ds, batch_size=16, epochs=1, verbose=0, shuffle=False)
