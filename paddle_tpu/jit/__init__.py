@@ -33,7 +33,8 @@ import numpy as np
 from jax import export as jax_export
 
 from ..core.tensor import Tensor
-from ..framework import functional_call, param_arrays, state_arrays
+from ..framework import (functional_call, param_arrays, state_arrays,
+                         unwrap as _untensor)
 from ..static import InputSpec
 
 __all__ = ["to_static", "save", "load", "TranslatedLayer", "not_to_static",
@@ -95,7 +96,10 @@ class StaticFunction:
                     return out
             else:
                 def _run(*args):
-                    return self._target(*args, **dict(key))
+                    # a converted body's static.nn combinators return
+                    # Tensor objects — unwrap before leaving jax.jit
+                    # (Tensor is not a valid JAX output type)
+                    return _untensor(self._target(*args, **dict(key)))
             self._jit_cache[key] = jax.jit(_run)
         return self._jit_cache[key]
 
@@ -114,7 +118,8 @@ class StaticFunction:
                 "supported; pass tensors positionally")
         raw = [a._data if isinstance(a, Tensor) else a for a in args]
         if not self._is_layer:
-            return self._jitted_for(static_kw)(*raw)
+            out = self._jitted_for(static_kw)(*raw)
+            return jax.tree_util.tree_map(Tensor, out)
         p = param_arrays(self._target)
         st = state_arrays(self._target)
         out = self._jitted_for(static_kw)(p, st, *raw)
@@ -191,7 +196,7 @@ def save(layer, path, input_spec=None):
 
             def fwd(pp, *inputs):
                 del pp
-                return target(*inputs)
+                return _untensor(target(*inputs))
 
         sym_ctx = {"scope": jax_export.SymbolicScope()}
         in_avals = tuple(

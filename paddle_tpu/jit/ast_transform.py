@@ -72,19 +72,92 @@ def _jst_cond(pred, true_fn, false_fn, vals=(), risky=()):
     return snn.cond(pred, tf, ff)
 
 
-def _jst_while(cond_fn, body_fn, loop_vars):
+def _jst_while(cond_fn, body_fn, loop_vars, n_carried=None):
     """Runtime dispatch for `while`: static.nn.while_loop handles both
     concrete (host loop) and traced (lax.while_loop) conditions; a plain
-    python loop serves the no-tensor case exactly."""
+    python loop serves the no-tensor case exactly.
+
+    loop_vars[:n_carried] are true loop-carried names; the tail holds
+    body-local temps (stored before loaded each iteration) that Python
+    semantics leak out of the loop — they ride along so a read AFTER the
+    loop sees the last iteration's value. Temps unbound before the loop
+    arrive as the _JST_UNDEF sentinel; their input values are dead (the
+    body writes them before any read), and the caller deletes any name
+    still sentinel-valued after the loop so a later read raises NameError
+    exactly as unconverted Python would."""
+    if n_carried is None:
+        n_carried = len(loop_vars)
+    carried, extras = list(loop_vars[:n_carried]), list(loop_vars[n_carried:])
     probe = cond_fn(*loop_vars)
-    if not _tensorish(probe) and not any(_tensorish(v) for v in loop_vars):
+    if not _tensorish(probe) and not any(_tensorish(v) for v in carried):
         out = list(loop_vars)
         while cond_fn(*out):
             res = body_fn(*out)
             out = list(res) if isinstance(res, (list, tuple)) else [res]
         return out
     from ..static import nn as snn
-    return snn.while_loop(cond_fn, body_fn, list(loop_vars))
+    if not extras:
+        return snn.while_loop(cond_fn, body_fn, carried)
+    # Traced loop with body temps: lax.while_loop needs a typed initial
+    # carry for every output. A temp's INPUT is dead (the body writes it
+    # before any read), so one abstract body evaluation with scalar
+    # placeholders yields the temps' output avals. A temp bound BEFORE
+    # the loop seeds the carry with its real value (correct for zero and
+    # >=1 iterations alike); an unbound one gets zeros — a dynamic trip
+    # count cannot reproduce Python's NameError-only-when-zero-iterations
+    # there. Temps that aren't array-typed (strings, lists) or whose
+    # pre-loop binding has a different shape can't ride a traced carry —
+    # fall back to carrying only the true loop vars and leave the temps
+    # undefined after the loop (the caller's sentinel guard turns a later
+    # read into NameError, with a warning here explaining why).
+    import jax
+    import jax.lax
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor
+
+    def _raw(x):
+        return x._data if isinstance(x, Tensor) else x
+
+    def _fallback(reason):
+        warnings.warn(
+            f"to_static: body-local temp(s) of a tensor-dependent `while` "
+            f"cannot be carried through lax.while_loop ({reason}); they "
+            "will be undefined after the loop")
+        out_c = snn.while_loop(
+            lambda *c: cond_fn(*c, *extras),
+            lambda *c: list(body_fn(*c, *extras))[:n_carried], carried)
+        return list(out_c) + [_JST_UNDEF] * len(extras)
+
+    try:
+        ph = [jnp.zeros(()) for _ in extras]
+        out_avals = jax.eval_shape(
+            lambda c, e: [_raw(r) for r in body_fn(*c, *e)[n_carried:]],
+            tuple(_raw(v) for v in carried), tuple(ph))
+    except Exception:
+        return _fallback("not array-typed")
+
+    extra_init = []
+    for v, a in zip(extras, out_avals):
+        if v is _JST_UNDEF:
+            extra_init.append(jnp.zeros(a.shape, a.dtype))
+        elif np.shape(_raw(v)) == tuple(a.shape):
+            extra_init.append(jax.lax.convert_element_type(_raw(v),
+                                                           a.dtype))
+        else:
+            return _fallback("pre-loop binding has a different shape "
+                             "than the loop body produces")
+
+    def body_strong(*vals):
+        # pin the temps' dtypes: eval_shape may report weak types while
+        # jnp.zeros seeds are strong — lax.while_loop requires the carry
+        # types to match exactly across iterations
+        res = list(body_fn(*vals))
+        res[n_carried:] = [
+            jax.lax.convert_element_type(_raw(r), a.dtype)
+            for r, a in zip(res[n_carried:], out_avals)]
+        return res
+
+    return snn.while_loop(cond_fn, body_strong, carried + extra_init)
 
 
 _NESTED_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
@@ -192,6 +265,37 @@ def _loaded_before_store(stmts):
     return carried
 
 
+def _grab_or_undef(n):
+    """`__jst_v_{n} = n` guarded by try/except -> sentinel when unbound."""
+    return ast.Try(
+        body=[ast.Assign(
+            targets=[ast.Name(id=f"__jst_v_{n}", ctx=ast.Store())],
+            value=ast.Name(id=n, ctx=ast.Load()))],
+        handlers=[ast.ExceptHandler(
+            type=ast.Tuple(
+                elts=[ast.Name(id="NameError", ctx=ast.Load()),
+                      ast.Name(id="UnboundLocalError", ctx=ast.Load())],
+                ctx=ast.Load()),
+            name=None,
+            body=[ast.Assign(
+                targets=[ast.Name(id=f"__jst_v_{n}", ctx=ast.Store())],
+                value=ast.Name(id="__jst_undef", ctx=ast.Load()))])],
+        orelse=[], finalbody=[])
+
+
+def _undef_guard(n):
+    """`if n is __jst_undef: del n` — a name the construct could not bind
+    must end the statement unbound (NameError on a later read), not bound
+    to the leaked sentinel object."""
+    return ast.If(
+        test=ast.Compare(
+            left=ast.Name(id=n, ctx=ast.Load()),
+            ops=[ast.Is()],
+            comparators=[ast.Name(id="__jst_undef", ctx=ast.Load())]),
+        body=[ast.Delete(targets=[ast.Name(id=n, ctx=ast.Del())])],
+        orelse=[])
+
+
 class _ControlFlowTransformer(ast.NodeTransformer):
     def __init__(self):
         self.counter = 0
@@ -254,25 +358,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             body=(list(orelse) or [ast.Pass()]) + [ret_tuple],
             decorator_list=[])
         # __jst_v_n = n if bound else _JST_UNDEF  (per state var)
-        grabs = []
-        for n in out:
-            grabs.append(ast.Try(
-                body=[ast.Assign(
-                    targets=[ast.Name(id=f"__jst_v_{n}", ctx=ast.Store())],
-                    value=ast.Name(id=n, ctx=ast.Load()))],
-                handlers=[ast.ExceptHandler(
-                    type=ast.Tuple(
-                        elts=[ast.Name(id="NameError", ctx=ast.Load()),
-                              ast.Name(id="UnboundLocalError",
-                                       ctx=ast.Load())],
-                        ctx=ast.Load()),
-                    name=None,
-                    body=[ast.Assign(
-                        targets=[ast.Name(id=f"__jst_v_{n}",
-                                          ctx=ast.Store())],
-                        value=ast.Name(id="__jst_undef",
-                                       ctx=ast.Load()))])],
-                orelse=[], finalbody=[]))
+        grabs = [_grab_or_undef(n) for n in out]
         in_both = set(_assigned_names(body)) & set(_assigned_names(orelse))
         risky = [n for n in out if n not in in_both]
         call = ast.Call(
@@ -298,8 +384,12 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                 value=call)
         else:
             assign = ast.Expr(value=call)
+        # a risky name whose branch did not run comes back as the sentinel
+        # (concrete predicate + unbound-before): unbind it so a later read
+        # raises NameError exactly as the untransformed Python would
+        guards = [_undef_guard(n) for n in risky]
         return [ast.copy_location(n_, node)
-                for n_ in (fn_t, fn_f, *grabs, assign)]
+                for n_ in (fn_t, fn_f, *grabs, assign, *guards)]
 
     # -- while ------------------------------------------------------------
     def visit_While(self, node):
@@ -307,24 +397,29 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         if node.orelse or _has_control_escape(node.body):
             self.skipped = True
             return node
-        # loop-carried vars only: assigned in the body AND read before
-        # written (or read by the test). Iteration-local temps stay local
-        # to the body fn — note the python loop-variable leak (reading a
-        # body temp AFTER the loop) is not preserved.
+        # ALL body-assigned names ride the loop (python scoping leaks
+        # them: a body temp read AFTER the loop sees the last iteration's
+        # value). The true loop-carried ones — read before written, or
+        # read by the test — come first; temps follow with sentinel-
+        # filled initial values (__jst_while treats their inputs as dead)
+        # and get a post-loop del-guard so a zero-iteration loop leaves
+        # them unbound, as plain Python would.
         assigned = [n for n in _assigned_names(node.body)
                     if not n.startswith("__jst")]
         carried = set(_loaded_before_store(node.body)) | \
             _names_loaded(node.test)
         loop_vars = [n for n in assigned if n in carried]
+        extras = [n for n in assigned if n not in carried]
         if not loop_vars:
             self.skipped = True
             return node
         i = self.counter
         self.counter += 1
         self.changed = True
+        all_vars = loop_vars + extras
         params = ast.arguments(
             posonlyargs=[],
-            args=[ast.arg(arg=n) for n in loop_vars],
+            args=[ast.arg(arg=n) for n in all_vars],
             kwonlyargs=[], kw_defaults=[], defaults=[])
         fn_c = ast.FunctionDef(
             name=f"__jst_loopcond_{i}", args=params,
@@ -332,22 +427,42 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         fn_b = ast.FunctionDef(
             name=f"__jst_loopbody_{i}", args=params,
             body=list(node.body) + [ast.Return(value=ast.Tuple(
-                elts=[ast.Name(id=n, ctx=ast.Load()) for n in loop_vars],
+                elts=[ast.Name(id=n, ctx=ast.Load()) for n in all_vars],
                 ctx=ast.Load()))],
             decorator_list=[])
+        grabs = [_grab_or_undef(n) for n in extras]
         call = ast.Call(
             func=ast.Name(id="__jst_while", ctx=ast.Load()),
             args=[ast.Name(id=fn_c.name, ctx=ast.Load()),
                   ast.Name(id=fn_b.name, ctx=ast.Load()),
-                  ast.List(elts=[ast.Name(id=n, ctx=ast.Load())
-                                 for n in loop_vars], ctx=ast.Load())],
+                  ast.List(
+                      elts=[ast.Name(id=n, ctx=ast.Load())
+                            for n in loop_vars] +
+                           [ast.Name(id=f"__jst_v_{n}", ctx=ast.Load())
+                            for n in extras],
+                      ctx=ast.Load()),
+                  ast.Constant(value=len(loop_vars))],
             keywords=[])
         assign = ast.Assign(
             targets=[ast.List(
-                elts=[ast.Name(id=n, ctx=ast.Store()) for n in loop_vars],
+                elts=[ast.Name(id=n, ctx=ast.Store()) for n in all_vars],
                 ctx=ast.Store())],
             value=call)
-        return [ast.copy_location(n_, node) for n_ in (fn_c, fn_b, assign)]
+        guards = [_undef_guard(n) for n in extras]
+        return [ast.copy_location(n_, node)
+                for n_ in (fn_c, fn_b, *grabs, assign, *guards)]
+
+
+def _decorator_tail(dec):
+    """Final attribute name of a decorator expression: `paddle.jit.
+    to_static`, `jit.to_static(...)` and bare `to_static` all ->
+    'to_static'; anything unrecognisable -> None."""
+    t = dec.func if isinstance(dec, ast.Call) else dec
+    if isinstance(t, ast.Attribute):
+        return t.attr
+    if isinstance(t, ast.Name):
+        return t.id
+    return None
 
 
 def convert_function(fn):
@@ -368,13 +483,27 @@ def convert_function(fn):
     fdef = tree.body[0]
     if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
         return fn
-    if fdef.decorator_list:
-        # a rebuilt copy cannot re-apply arbitrary decorators faithfully
+    # `@paddle.jit.to_static` / `@declarative` decorate the very functions
+    # we are asked to convert: strip them (the reference transformer drops
+    # its own decorator the same way — dygraph_to_static/utils.py
+    # remove_if_exist) rather than bailing. `@not_to_static` is an
+    # explicit opt-out; anything else can't be re-applied faithfully to a
+    # rebuilt copy, so leave the function unconverted with the warning.
+    kept = []
+    for dec in fdef.decorator_list:
+        name = _decorator_tail(dec)
+        if name in ("to_static", "declarative"):
+            continue
+        if name == "not_to_static":
+            return fn
+        kept.append(dec)
+    if kept:
         warnings.warn(
             f"to_static: {fn.__qualname__} carries decorators; leaving it "
             "unconverted (tensor-dependent plain-Python control flow "
             "inside will fail under tracing)")
         return fn
+    fdef.decorator_list = []
 
     tr = _ControlFlowTransformer()
     tr.visit(fdef)

@@ -80,7 +80,23 @@ def _block_sizes(T, D, env_key="PT_FLASH_FWD_BLOCKS"):
 
 
 def _bwd_block_sizes(T, D):
-    return _block_sizes(T, D, env_key="PT_FLASH_BWD_BLOCKS")
+    """Backward caps get their own VMEM budget — the bwd working set is
+    larger than the forward's. Per (bq, bk) grid step of the dkv kernel
+    the f32 score-sized intermediates are s/p (reusable), dp and ds at
+    bq*bk*4 B each (~3 live tiles), plus double-buffered I/O tiles
+    (q/k/v/do/o bf16 + lse f32: ~(4*max(bq,bk)*D*2 + bq*128*4)*2 B) and
+    the dk/dv f32 scratch (2*bk*D*4 B). At (1024, 1024):
+      D=64 : 12 MB + 1.9 MB + 0.5 MB ~= 14.4 MB -> fits 16 MB VMEM
+             (exercised fwd+bwd by the benchmarks/longctx.py training
+             sweep at T=1k..16k, D=64 — the RESULTS.md numbers)
+      D=128: 12 MB + 3.5 MB + 1.0 MB ~= 16.5 MB -> over budget, so wide
+             heads cap bq at 512, halving the score tiles to 2 MB each
+             (~9.75 MB total) with the same nk==1 fused-path eligibility
+             (bk stays 1024)."""
+    if "PT_FLASH_BWD_BLOCKS" in os.environ:
+        return _env_blocks("PT_FLASH_BWD_BLOCKS", T)
+    cap_q = 1024 if D <= 64 else 512
+    return _pick_block(T, cap_q), _pick_block(T, 1024)
 
 
 # ---------------------------------------------------------------------------

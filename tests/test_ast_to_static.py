@@ -277,3 +277,148 @@ def test_save_does_not_mutate_layer(tmp_path):
                     input_spec=[InputSpec([None, 4], "float32")])
     after = net.__dict__.get("forward", None)
     assert before is after      # save left the layer untouched
+
+# ---- round-5 advisor regressions (ADVICE r4) -------------------------------
+
+def test_plain_function_tensor_if():
+    """ADVICE r4 (medium): to_static on a plain non-layer function whose
+    converted body returns Tensor objects must unwrap before leaving
+    jax.jit and rewrap for the caller."""
+    def f(x):
+        if x.mean() > 0:
+            y = x + 1
+        else:
+            y = x - 1
+        return y
+
+    st = paddle.jit.to_static(f)
+    x = np.ones((4,), np.float32)
+    out = st(paddle.to_tensor(x))
+    assert isinstance(out, paddle.Tensor)
+    np.testing.assert_allclose(out.numpy(), x + 1, atol=1e-6)
+    np.testing.assert_allclose(st(paddle.to_tensor(-x)).numpy(), -x - 1,
+                               atol=1e-6)
+
+
+def test_plain_function_tensor_while():
+    def g(n):
+        # terminates at 5 per element: 2 elements * 5 = 10
+        while n.sum() < 10:
+            n = n + 1
+        return n
+
+    st = paddle.jit.to_static(g)
+    out = st(paddle.to_tensor(np.zeros((2,), np.float32)))
+    np.testing.assert_allclose(out.numpy(), np.full((2,), 5.0), atol=1e-6)
+
+
+def test_decorator_form_converts():
+    """ADVICE r4 (medium): the @to_static decorator form — the reference's
+    primary usage — must strip its own decorator and convert."""
+    @paddle.jit.to_static
+    def f(x):
+        if x.mean() > 0:
+            y = x * 2
+        else:
+            y = x * 3
+        return y
+
+    x = np.ones((4,), np.float32)
+    np.testing.assert_allclose(f(paddle.to_tensor(x)).numpy(), x * 2,
+                               atol=1e-6)
+    np.testing.assert_allclose(f(paddle.to_tensor(-x)).numpy(), -x * 3,
+                               atol=1e-6)
+
+
+def test_while_body_temp_read_after_loop():
+    """ADVICE r4 (medium): a body-local temp read AFTER the loop must hold
+    the last iteration's value (python loop-variable leak)."""
+    class TempAfter(nn.Layer):
+        def forward(self, n):
+            while n.sum() < 6.0:
+                y = n * 2
+                n = n + 1
+            return y
+
+    net = TempAfter()
+    x = np.zeros((2,), np.float32)
+    ref = _np_run(net, x)                      # last iter: n=2 -> y=4
+    np.testing.assert_allclose(ref, np.full((2,), 4.0))
+    st = paddle.jit.to_static(net)
+    np.testing.assert_allclose(st(paddle.to_tensor(x)).numpy(), ref,
+                               atol=1e-6)
+
+
+def test_while_body_temp_concrete_and_zero_iter():
+    from paddle_tpu.jit.ast_transform import convert_function
+
+    def h(n):
+        while n < 3:
+            y = n * 2
+            n = n + 1
+        return y
+
+    assert convert_function(h)(0) == 4         # concrete host loop
+
+    def h0(n):
+        while n < 0:
+            y = n * 2
+            n = n + 1
+        return y
+
+    with pytest.raises(NameError):             # zero iterations: y unbound
+        convert_function(h0)(5)
+
+
+def test_one_branch_sentinel_does_not_leak():
+    """ADVICE r4 (low): concrete predicate taking the non-assigning branch
+    must leave the var unbound (NameError), not bound to the sentinel."""
+    from paddle_tpu.jit.ast_transform import convert_function
+
+    def k(flag):
+        if flag:
+            z = 1
+        return z
+
+    kc = convert_function(k)
+    assert kc(True) == 1
+    with pytest.raises(NameError):
+        kc(False)
+
+
+def test_while_temp_prebound_zero_iterations():
+    """Review r5: a temp bound BEFORE a traced loop that runs zero times
+    must keep its pre-loop value, not come back zeroed."""
+    class PreBound(nn.Layer):
+        def forward(self, x):
+            y = x * 7
+            n = x * 0 + 5
+            while n.sum() < 0:
+                y = n * 2
+                n = n + 1
+            return y
+
+    net = PreBound()
+    x = np.ones((2,), np.float32)
+    st = paddle.jit.to_static(net)
+    np.testing.assert_allclose(st(paddle.to_tensor(x)).numpy(), x * 7,
+                               atol=1e-6)
+
+
+def test_while_python_int_temp_weak_type():
+    """Review r5: an ordinary python-int temp (weak-typed aval) must ride
+    the traced carry without a lax carry-type mismatch."""
+    class IntTemp(nn.Layer):
+        def forward(self, n):
+            while n.sum() < 3.0:
+                y = 2
+                n = n + 1
+            return n * y
+
+    net = IntTemp()
+    x = np.zeros((1,), np.float32)
+    ref = _np_run(net, x)                      # n ends at 3 -> 6
+    np.testing.assert_allclose(ref, np.array([6.0]))
+    st = paddle.jit.to_static(net)
+    np.testing.assert_allclose(st(paddle.to_tensor(x)).numpy(), ref,
+                               atol=1e-6)
