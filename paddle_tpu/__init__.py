@@ -64,6 +64,31 @@ VarBase = Tensor
 LoDTensorArray = list
 from .core.place import (CUDAPinnedPlace, XPUPlace)  # noqa: F401,E402
 
+# mode switches (reference python/paddle/__init__.py:269-271 maps them
+# onto the dygraph toggles: enable_static == disable_dygraph). The
+# framework is always-eager with jit/to_static as the graph path, so the
+# flag is observable state for ported code, not an execution-engine swap.
+from .legacy_alias import (enable_dygraph as disable_static,  # noqa: E402,F401
+                           disable_dygraph as enable_static,
+                           in_dygraph_mode as in_dynamic_mode)
+from . import tensor  # noqa: F401,E402  (paddle.tensor submodule alias)
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Wrap a sample reader into a batched reader (reference
+    python/paddle/batch.py:1): `reader` is a zero-arg generator
+    function; the result yields lists of `batch_size` samples."""
+    def batch_reader():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return batch_reader
+
 __version__ = "0.3.0"
 full_version = __version__
 commit = "tpu-native"

@@ -10,6 +10,11 @@ from .pipeline import PipelineLayer, pipeline_spmd, stack_stage_params  # noqa: 
 
 init = init_parallel_env  # paddle.distributed alias surface
 
+# dataset readers at the distributed path (reference
+# python/paddle/distributed/__init__.py:40-47 re-exports the fleet
+# dataset family)
+from ..io.data_feed import InMemoryDataset, QueueDataset  # noqa: F401,E402
+
 
 def spawn(func, args=(), nprocs=1, join=True, daemon=False, **options):
     """paddle.distributed.spawn parity (reference spawn.py:317) —

@@ -24,9 +24,19 @@ __all__ = ["init", "DistributedStrategy", "distributed_optimizer",
            "get_strategy", "get_mesh", "UserDefinedRoleMaker",
            "PaddleCloudRoleMaker", "is_server", "is_worker", "init_server",
            "run_server", "server_endpoints", "ps_client", "stop_worker",
-           "stop_server"]
+           "stop_server", "Fleet", "UtilBase", "Role", "fleet", "util",
+           "MultiSlotDataGenerator", "MultiSlotStringDataGenerator",
+           "utils", "data_generator"]
 
 _state = {"strategy": None, "initialized": False, "role_maker": None}
+
+
+class Role:
+    """Process roles (reference fleet/base/role_maker.py:26)."""
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
 
 
 class PaddleCloudRoleMaker:
@@ -124,7 +134,9 @@ class _DistributedOptimizer:
 def distributed_optimizer(optimizer, strategy=None):
     strategy = strategy or _state["strategy"] or DistributedStrategy()
     _state["strategy"] = strategy
-    return _DistributedOptimizer(optimizer, strategy)
+    wrapped = _DistributedOptimizer(optimizer, strategy)
+    _state["optimizer"] = wrapped
+    return wrapped
 
 
 def distributed_model(model):
@@ -232,3 +244,270 @@ def stop_server():
     except Exception:
         if srv is not None:
             srv._proc.terminate()
+
+
+# ---------------------------------------------------------------------------
+# facade objects (reference fleet/__init__.py:16-34 binds module-level
+# names to ONE Fleet() singleton's methods; same shape here, with the
+# module-level functions as the implementation)
+# ---------------------------------------------------------------------------
+
+from . import utils            # noqa: E402,F401  (LocalFS/HDFSClient/...)
+from . import data_generator   # noqa: E402
+from .data_generator import (MultiSlotDataGenerator,         # noqa: E402
+                             MultiSlotStringDataGenerator)
+
+
+class UtilBase:
+    """Worker utilities (reference fleet/base/util_factory.py UtilBase):
+    cross-worker collectives over the active communication backend plus
+    the file-shard helper PS ingestion uses."""
+
+    def all_reduce(self, input, mode="sum"):
+        from .. import collective
+        from ...core.tensor import to_tensor
+        import numpy as np
+        ops = {"sum": collective.ReduceOp.SUM,
+               "max": collective.ReduceOp.MAX,
+               "min": collective.ReduceOp.MIN}
+        if mode not in ops:
+            raise ValueError(f"all_reduce mode must be one of "
+                             f"{sorted(ops)}, got {mode!r}")
+        t = collective.all_reduce(to_tensor(np.asarray(input)),
+                                  op=ops[mode])
+        return np.asarray(t.numpy())
+
+    def all_gather(self, input):
+        from .. import collective
+        from ...core.tensor import to_tensor
+        import numpy as np
+        t = collective.all_gather(to_tensor(np.asarray(input)))
+        return [np.asarray(x.numpy()) for x in t] \
+            if isinstance(t, (list, tuple)) else np.asarray(t.numpy())
+
+    def barrier(self, comm_world="worker"):
+        barrier_worker()
+
+    def get_file_shard(self, files):
+        """Split `files` across workers, contiguous blocks with the
+        remainder spread over the first ranks (reference
+        util_factory.get_file_shard)."""
+        if not isinstance(files, list):
+            raise TypeError("files should be a list of file names")
+        n, idx = worker_num(), worker_index()
+        base, extra = divmod(len(files), n)
+        start = idx * base + min(idx, extra)
+        return files[start:start + base + (1 if idx < extra else 0)]
+
+    def print_on_rank(self, message, rank_id=0):
+        if worker_index() == rank_id:
+            print(message)
+
+
+class Fleet:
+    """The facade class itself (reference fleet/base/fleet_base.py
+    Fleet): every method delegates to the module-level implementation,
+    and `fleet` below is the singleton whose bound methods the module
+    names mirror — reference code doing `Fleet().init(...)` or
+    `fleet.init(...)` lands in the same place."""
+
+    def __init__(self):
+        self._util = UtilBase()
+
+    # lifecycle / topology
+    def init(self, role_maker=None, is_collective=True, strategy=None):
+        return init(role_maker, is_collective, strategy)
+
+    def is_first_worker(self):
+        return is_first_worker()
+
+    def worker_num(self):
+        return worker_num()
+
+    def worker_index(self):
+        return worker_index()
+
+    def is_worker(self):
+        return is_worker()
+
+    def worker_endpoints(self, to_string=False):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        lst = [e for e in eps.replace(";", ",").split(",") if e]
+        return ",".join(lst) if to_string else lst
+
+    def server_num(self):
+        return len(server_endpoints())
+
+    def server_index(self):
+        return int(os.environ.get("PADDLE_PSERVER_ID", "0"))
+
+    def server_endpoints(self, to_string=False):
+        eps = server_endpoints()
+        return ",".join(eps) if to_string else eps
+
+    def is_server(self):
+        return is_server()
+
+    @property
+    def util(self):
+        return self._util
+
+    def barrier_worker(self):
+        return barrier_worker()
+
+    # PS lifecycle
+    def init_worker(self):
+        return None            # table connections open lazily (ps_client)
+
+    def init_server(self, *args, **kwargs):
+        return init_server(*args, **kwargs)
+
+    def run_server(self):
+        return run_server()
+
+    def stop_worker(self):
+        return stop_worker()
+
+    # training surface
+    def distributed_optimizer(self, optimizer, strategy=None):
+        return distributed_optimizer(optimizer, strategy)
+
+    def distributed_model(self, model):
+        return distributed_model(model)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        """backward + apply through the fleet optimizer (reference
+        Fleet.minimize steps the inner optimizer too)."""
+        loss.backward()
+        opt = _state.get("optimizer")
+        if opt is None:
+            raise RuntimeError(
+                "fleet.minimize needs a fleet optimizer: call "
+                "fleet.distributed_optimizer(opt) first (the reference "
+                "requires the same)")
+        opt._inner.step()
+        return [], []
+
+    # dygraph optimizer delegation (reference fleet_base.py step/
+    # clear_grad/set_lr/get_lr/state_dict act on the wrapped optimizer)
+    def _opt(self):
+        opt = _state.get("optimizer")
+        if opt is None:
+            raise RuntimeError(
+                "no fleet optimizer yet: call fleet.distributed_optimizer "
+                "(the reference raises the same way)")
+        return opt
+
+    def step(self):
+        return self._opt().step()
+
+    def clear_grad(self):
+        return self._opt().clear_grad()
+
+    def set_lr(self, value):
+        opt = self._opt()
+        if hasattr(opt, "set_lr"):
+            return opt.set_lr(value)
+        # reach THROUGH the _DistributedOptimizer wrapper: setattr on the
+        # wrapper would shadow the inner optimizer's lr (get_lr would
+        # report the new value while training kept the old one)
+        opt._inner._learning_rate = value
+
+    def get_lr(self):
+        opt = self._opt()
+        if hasattr(opt, "get_lr"):
+            return opt.get_lr()
+        lr = getattr(opt, "_learning_rate", None)
+        return lr() if callable(lr) else lr
+
+    def state_dict(self):
+        opt = _state.get("optimizer")
+        if opt is not None and hasattr(opt, "state_dict"):
+            return opt.state_dict()
+        st = _state["strategy"]
+        return dict(st.__dict__) if st is not None else {}
+
+    def set_state_dict(self, state):
+        opt = _state.get("optimizer")
+        if opt is not None and hasattr(opt, "set_state_dict"):
+            return opt.set_state_dict(state)
+
+    def shrink(self, threshold=None):
+        """PS table shrink (reference fleet_base.shrink: drop sparse
+        rows below the show/click threshold); delegated to the table
+        server when one is connected, no-op otherwise."""
+        c = _ps_state.get("client")
+        if c is not None and hasattr(c, "shrink"):
+            return c.shrink(threshold)
+
+    def save_inference_model(self, executor, dirname, feeded_var_names=None,
+                             target_vars=None, main_program=None,
+                             export_for_deployment=True):
+        """Export the layer/StaticFunction for serving. The reference
+        passes feed NAMES (strings); shapes live in the program there —
+        here the target must carry input_spec (a StaticFunction from
+        to_static, or a layer with _input_spec), which supplies the real
+        specs; bare name strings cannot."""
+        from ... import jit as jit_mod
+        target = target_vars or main_program
+        if isinstance(target, (list, tuple)):
+            target = target[0]
+        if target is None:
+            raise ValueError("fleet.save_inference_model needs the model "
+                             "as target_vars (a Layer or to_static-"
+                             "wrapped function)")
+        spec = getattr(target, "_input_spec", None)
+        if spec is None:
+            raise ValueError(
+                "fleet.save_inference_model: the target has no input "
+                "spec — wrap it with paddle.jit.to_static(layer, "
+                "input_spec=[...]) so the export knows shapes/dtypes "
+                "(string feed names alone don't carry them here)")
+        jit_mod.save(target, dirname, input_spec=spec)
+        return dirname
+
+    def save_persistables(self, executor, dirname, main_program=None,
+                          mode=1):
+        from ...static.compat import default_main_program, save as _save
+        target = main_program
+        if target is None:
+            prog = default_main_program()
+            if getattr(prog, "_parameters", None):
+                target = prog
+        if target is None or not hasattr(target, "named_parameters") and \
+                not getattr(target, "_parameters", None):
+            raise ValueError(
+                "fleet.save_persistables: pass main_program (a layer or "
+                "a static Program holding parameters); the default "
+                "program has none to save")
+        if not hasattr(target, "named_parameters"):
+            # static Program: persist its registered parameter scope
+            from ...framework import save as _fsave
+            _fsave({k: v for k, v in target._parameters.items()},
+                   dirname if dirname.endswith(".pdparams")
+                   else dirname + ".pdparams")
+            return dirname
+        return _save(target, dirname)
+
+
+fleet = Fleet()
+util = fleet.util
+
+# module-level bindings of the singleton's methods (reference
+# fleet/__init__.py:36-63 binds exactly this set)
+from . import metrics                    # noqa: E402,F401
+init_worker = fleet.init_worker
+worker_endpoints = fleet.worker_endpoints
+server_num = fleet.server_num
+server_index = fleet.server_index
+minimize = fleet.minimize
+save_inference_model = fleet.save_inference_model
+save_persistables = fleet.save_persistables
+state_dict = fleet.state_dict
+set_state_dict = fleet.set_state_dict
+step = fleet.step
+clear_grad = fleet.clear_grad
+set_lr = fleet.set_lr
+get_lr = fleet.get_lr
+shrink = fleet.shrink

@@ -1,0 +1,109 @@
+"""Fleet data generators — user-defined ETL emitting MultiSlot text.
+
+Reference: python/paddle/distributed/fleet/data_generator/
+data_generator.py (DataGenerator.run_from_stdin:94 /
+MultiSlotDataGenerator._gen_str:296): users subclass, override
+`generate_sample(line)`, and the runner streams stdin -> parsed sample
+-> slot-count wire format on stdout, which the MultiSlot feed
+(io/data_feed.py parse_multi_slot_line) consumes directly — the same
+pipe protocol the PS trainers use for out-of-process ETL."""
+from __future__ import annotations
+
+import sys
+
+__all__ = ["DataGenerator", "MultiSlotDataGenerator",
+           "MultiSlotStringDataGenerator"]
+
+
+class DataGenerator:
+    def __init__(self):
+        self.batch_size_ = 1
+        self._proto_info = None
+
+    def set_batch(self, batch_size):
+        self.batch_size_ = int(batch_size)
+
+    def generate_sample(self, line):
+        """Override: return a zero-arg iterator yielding samples of the
+        form [(slot_name, [values...]), ...]."""
+        raise NotImplementedError(
+            "generate_sample must be overridden: return an iterator of "
+            "[(name, [value, ...]), ...] samples")
+
+    def generate_batch(self, samples):
+        """Override for batch-level rework; defaults to pass-through."""
+        def local_iter():
+            for s in samples:
+                yield s
+        return local_iter
+
+    def _gen_str(self, line):
+        raise NotImplementedError(
+            "use MultiSlotDataGenerator or MultiSlotStringDataGenerator")
+
+    def _flush(self, batch_samples, write):
+        for sample in self.generate_batch(batch_samples)():
+            write(self._gen_str(sample))
+
+    def run_from_memory(self):
+        """Emit generate_sample(None) output to stdout (debug path)."""
+        self._run_lines([None], sys.stdout.write)
+
+    def run_from_stdin(self):
+        """stdin lines -> generate_sample -> slot wire format on stdout."""
+        self._run_lines(sys.stdin, sys.stdout.write)
+
+    def _run_lines(self, lines, write):
+        batch = []
+        for line in lines:
+            for sample in self.generate_sample(line)():
+                if sample is None:
+                    continue
+                batch.append(sample)
+                if len(batch) == self.batch_size_:
+                    self._flush(batch, write)
+                    batch = []
+        if batch:
+            self._flush(batch, write)
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """Numeric slots: each (name, values) renders as `<n> v1 ... vn`
+    (reference _gen_str data_generator.py:296; int => uint64 slot,
+    any float => float slot in the proto info)."""
+
+    def _gen_str(self, line):
+        if not isinstance(line, (list, tuple)):
+            raise ValueError(
+                "sample must be [(name, [value, ...]), ...], got "
+                f"{type(line).__name__}")
+        if self._proto_info is None:
+            self._proto_info = []
+            for name, elements in line:
+                kind = "float" if any(isinstance(e, float)
+                                      for e in elements) else "uint64"
+                self._proto_info.append((name, kind))
+        parts = []
+        for name, elements in line:
+            if not elements:
+                raise ValueError(
+                    f"slot {name!r} is empty; pad it in generate_sample")
+            parts.append(str(len(elements)))
+            parts.extend(str(e) for e in elements)
+        return " ".join(parts) + "\n"
+
+
+class MultiSlotStringDataGenerator(DataGenerator):
+    """String slots: values pass through untouched (reference
+    MultiSlotStringDataGenerator — faster, no type bookkeeping)."""
+
+    def _gen_str(self, line):
+        if not isinstance(line, (list, tuple)):
+            raise ValueError(
+                "sample must be [(name, [str, ...]), ...], got "
+                f"{type(line).__name__}")
+        parts = []
+        for _name, elements in line:
+            parts.append(str(len(elements)))
+            parts.extend(str(e) for e in elements)
+        return " ".join(parts) + "\n"

@@ -1,0 +1,77 @@
+"""fleet.metrics — cross-worker metric aggregation.
+
+Reference: python/paddle/distributed/fleet/metrics/metric.py (sum/max/
+min/auc/mae/rmse/acc over a fleet allreduce of local accumulators). The
+TPU transport is the collective backend (XLA psum over ICI / DCN
+jax.distributed); each helper reduces a local numpy/Tensor value across
+workers and returns the global metric on every rank."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["sum", "max", "min", "auc", "mae", "rmse", "acc"]
+
+_pysum, _pymax, _pymin = sum, max, min
+
+
+def _allreduce(value, mode="sum"):
+    from .. import collective, env
+    from ...core.tensor import to_tensor
+    arr = np.asarray(value, np.float64)
+    if env.get_world_size() <= 1:
+        return arr
+    op = {"sum": collective.ReduceOp.SUM, "max": collective.ReduceOp.MAX,
+          "min": collective.ReduceOp.MIN}[mode]
+    return np.asarray(collective.all_reduce(
+        to_tensor(arr), op=op).numpy())
+
+
+def sum(input, scope=None, util=None):  # noqa: A001
+    """Global sum of a local accumulator (reference metrics.sum)."""
+    return _allreduce(input, "sum")
+
+
+def max(input, scope=None, util=None):  # noqa: A001
+    return _allreduce(input, "max")
+
+
+def min(input, scope=None, util=None):  # noqa: A001
+    return _allreduce(input, "min")
+
+
+def auc(stat_pos, stat_neg, scope=None, util=None):
+    """Global AUC from per-worker positive/negative threshold histograms
+    (reference metrics.auc: allreduce the two histograms, then the
+    trapezoid sweep)."""
+    pos = _allreduce(stat_pos, "sum").reshape(-1)
+    neg = _allreduce(stat_neg, "sum").reshape(-1)
+    # sweep thresholds high->low accumulating (fp, tp); trapezoid area
+    tp = fp = 0.0
+    area = 0.0
+    for i in range(len(pos) - 1, -1, -1):
+        new_tp = tp + pos[i]
+        new_fp = fp + neg[i]
+        area += (new_fp - fp) * (tp + new_tp) / 2.0
+        tp, fp = new_tp, new_fp
+    if tp == 0 or fp == 0:
+        return 0.5
+    return float(area / (tp * fp))
+
+
+def mae(abserr, total_ins_num, scope=None, util=None):
+    """Global mean absolute error: allreduce(|err| sum) / allreduce(n)."""
+    err = float(_allreduce(abserr, "sum"))
+    n = float(_allreduce(total_ins_num, "sum"))
+    return err / _pymax(n, 1.0)
+
+
+def rmse(sqrerr, total_ins_num, scope=None, util=None):
+    err = float(_allreduce(sqrerr, "sum"))
+    n = float(_allreduce(total_ins_num, "sum"))
+    return float(np.sqrt(err / _pymax(n, 1.0)))
+
+
+def acc(correct, total, scope=None, util=None):
+    c = float(_allreduce(correct, "sum"))
+    t = float(_allreduce(total, "sum"))
+    return c / _pymax(t, 1.0)

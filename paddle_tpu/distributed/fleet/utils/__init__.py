@@ -1,0 +1,60 @@
+"""fleet.utils — filesystem clients + distributed-inference helper.
+
+Reference: python/paddle/distributed/fleet/utils/__init__.py exports
+LocalFS + HDFSClient (fs.py:34,419) and DistributedInfer (ps_util.py).
+The FS verbs live in io/fs.py (LocalFS for mounted stores, the
+fsspec-backed RemoteFS/HDFSClient for object stores); this module is
+the fleet-path facade reference code imports from."""
+from __future__ import annotations
+
+from ....io.fs import FS, LocalFS, RemoteFS, HDFSClient, sync_dir
+
+__all__ = ["FS", "LocalFS", "RemoteFS", "HDFSClient", "sync_dir",
+           "DistributedInfer", "recompute"]
+
+
+class DistributedInfer:
+    """PS inference helper (reference fleet/utils/ps_util.py
+    DistributedInfer): pulls the sharded sparse/dense parameters from
+    the PS fleet into the local model so inference runs without the
+    servers in the loop."""
+
+    def __init__(self, main_program=None, startup_program=None):
+        self.main_program = main_program
+        self.startup_program = startup_program
+
+    def init_distributed_infer_env(self, exe=None, loss=None,
+                                   role_maker=None, dirname=None):
+        if dirname:
+            self.load_inference_params(dirname)
+
+    def load_inference_params(self, dirname):
+        """Load persisted parameters into the bound program/layer."""
+        from ....static.compat import load_program_state, set_program_state
+        if self.main_program is None:
+            raise ValueError("DistributedInfer needs main_program (a "
+                             "layer or program holding the parameters)")
+        state = load_program_state(dirname)
+        set_program_state(self.main_program, state)
+        return state
+
+    def get_dist_infer_program(self):
+        return self.main_program
+
+
+def recompute(function, *args, **kwargs):
+    """Activation recomputation for one block call: forward runs
+    normally, residuals are rematerialized in backward (jax.checkpoint —
+    the reference's RecomputeFunction CUDA autograd node, as a compiler
+    policy). Tensor in/out preserving."""
+    import jax
+
+    from ....core.tensor import Tensor
+    from ....framework import unwrap, wrap
+
+    def raw_fn(*raw):
+        out = function(*wrap(list(raw)), **kwargs)
+        return unwrap(out)
+
+    out = jax.checkpoint(raw_fn)(*unwrap(list(args)))
+    return jax.tree_util.tree_map(Tensor, out)

@@ -31,7 +31,8 @@ from typing import Dict, Iterator, List, Sequence
 
 import numpy as np
 
-__all__ = ["Slot", "InMemoryDataset", "parse_multi_slot_line"]
+__all__ = ["Slot", "InMemoryDataset", "QueueDataset",
+           "parse_multi_slot_line"]
 
 
 @dataclass
@@ -70,6 +71,23 @@ def parse_multi_slot_line(line: str, slots: Sequence[Slot]):
             out.append(np.asarray(vals, np.float32))
     if i != len(toks):
         raise ValueError(f"{len(toks) - i} trailing tokens on line")
+    return out
+
+
+def _pack_batch(slots: Sequence[Slot], chunk) -> Dict[str, object]:
+    """Parsed samples -> one feed batch: sparse slots as (flat values,
+    lod offsets), dense slots stacked [B, dim]."""
+    out: Dict[str, object] = {}
+    for j, slot in enumerate(slots):
+        vals = [s[j] for s in chunk]
+        if slot.is_sparse:
+            lod = np.zeros(len(vals) + 1, np.int64)
+            np.cumsum([len(v) for v in vals], out=lod[1:])
+            flat = (np.concatenate(vals) if lod[-1]
+                    else np.zeros((0,), np.uint64))
+            out[slot.name] = (flat, lod)
+        else:
+            out[slot.name] = np.stack(vals)
     return out
 
 
@@ -159,15 +177,51 @@ class InMemoryDataset:
             chunk = self._samples[start:start + batch_size]
             if drop_last and len(chunk) < batch_size:
                 return
-            out: Dict[str, object] = {}
-            for j, slot in enumerate(self._slots):
-                vals = [s[j] for s in chunk]
-                if slot.is_sparse:
-                    lod = np.zeros(len(vals) + 1, np.int64)
-                    np.cumsum([len(v) for v in vals], out=lod[1:])
-                    flat = (np.concatenate(vals) if lod[-1]
-                            else np.zeros((0,), np.uint64))
-                    out[slot.name] = (flat, lod)
-                else:
-                    out[slot.name] = np.stack(vals)
-            yield out
+            yield _pack_batch(self._slots, chunk)
+
+
+class QueueDataset:
+    """Streaming MultiSlot dataset (reference QueueDataset
+    framework/data_set.h / python fleet/dataset: files stream through a
+    feed queue in order, nothing is materialized and shuffle is
+    unsupported — the contract that distinguishes it from
+    InMemoryDataset). Parses lazily file-by-file."""
+
+    def __init__(self, slots: Sequence[Slot]):
+        if not slots:
+            raise ValueError("need at least one slot")
+        self._slots = list(slots)
+        self._filelist: List[str] = []
+
+    @property
+    def slots(self):
+        return list(self._slots)
+
+    def set_filelist(self, paths: Sequence[str]):
+        self._filelist = list(paths)
+
+    def local_shuffle(self, seed=None):
+        raise RuntimeError("QueueDataset streams files in order; use "
+                           "InMemoryDataset for shuffling (the reference "
+                           "raises the same way)")
+
+    global_shuffle = local_shuffle
+
+    def _samples(self):
+        for p in self._filelist:
+            with open(p) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        yield parse_multi_slot_line(line, self._slots)
+
+    def batches(self, batch_size: int, drop_last: bool = False
+                ) -> Iterator[Dict[str, object]]:
+        chunk: List[list] = []
+        for s in self._samples():
+            chunk.append(s)
+            if len(chunk) == batch_size:
+                yield _pack_batch(self._slots, chunk)
+                chunk = []
+        if chunk and not drop_last:
+            yield _pack_batch(self._slots, chunk)

@@ -39,7 +39,11 @@ from ..static import InputSpec
 
 __all__ = ["to_static", "save", "load", "TranslatedLayer", "not_to_static",
            "ProgramTranslator", "TracedLayer", "set_code_level",
-           "set_verbosity"]
+           "set_verbosity", "dy2static"]
+
+# the conversion-pass module under its reference name (python/paddle/
+# jit/__init__.py imports `from . import dy2static`)
+from . import ast_transform as dy2static  # noqa: E402
 
 
 def _spec_to_aval(spec, sym_ctx):

@@ -84,11 +84,17 @@ class Program:
 
     def __init__(self):
         self._callables = []
+        self._parameters = {}    # static.nn ops register implicit params
         self.random_seed = None
 
     def add(self, fn):
         self._callables.append(fn)
         return fn
+
+    def all_parameters(self):
+        """Implicitly created static.nn parameters (reference
+        Program.all_parameters) — feed these to an optimizer."""
+        return list(self._parameters.values())
 
     def global_block(self):
         return self
@@ -101,6 +107,7 @@ class Program:
     def clone(self, for_test=False):
         p = Program()
         p._callables = list(self._callables)
+        p._parameters = dict(self._parameters)
         return p
 
 

@@ -33,8 +33,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.tensor import Tensor, _is_tracer
+from .nn_ops import *          # noqa: F401,F403  (fluid-style op layer)
+from .nn_ops import __all__ as _ops_all
 
-__all__ = ["cond", "case", "switch_case", "while_loop"]
+__all__ = ["cond", "case", "switch_case", "while_loop"] + list(_ops_all)
 
 
 def _arr(x):

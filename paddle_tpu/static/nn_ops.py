@@ -1,0 +1,553 @@
+"""paddle.static.nn op layer — fluid-1.x-style functions with implicit
+parameters.
+
+Reference: python/paddle/static/nn/__init__.py:15-42 re-exports the
+fluid layer functions (fluid/layers/nn.py fc:87, conv2d:1411,
+batch_norm:2744, layer_norm:3015, ...) which create parameters in the
+startup program's global block and append ops to the main program.
+
+TPU-native redesign: the eager Tensor IS the variable and jit tracing IS
+the program, so each op here (a) resolves/creates its parameters in a
+process-wide *static parameter scope* — same fluid semantics: a
+`ParamAttr(name=...)` shared between two calls shares the weights, an
+anonymous call gets a fresh `{op}_{i}.w_0`-style name — and (b) computes
+the result immediately through the existing nn.functional kernels. The
+created parameters register on `default_main_program()` so
+`program.all_parameters()` feeds optimizers exactly like reference
+static-graph code expects.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply, to_tensor  # noqa: F401
+from ..framework import ParamAttr
+
+__all__ = [
+    "fc", "batch_norm", "embedding", "bilinear_tensor_product", "conv2d",
+    "conv2d_transpose", "conv3d", "conv3d_transpose", "create_parameter",
+    "crf_decoding", "data_norm", "deform_conv2d", "group_norm",
+    "instance_norm", "layer_norm", "multi_box_head", "nce", "prelu",
+    "py_func", "row_conv", "spectral_norm", "sparse_embedding",
+]
+
+
+# -- the static parameter scope ----------------------------------------------
+
+_PARAMS: dict = {}
+_COUNTERS: dict = {}
+
+
+def _unique(prefix):
+    i = _COUNTERS.get(prefix, 0)
+    _COUNTERS[prefix] = i + 1
+    return f"{prefix}_{i}"
+
+
+def reset_parameter_scope():
+    """Drop every implicitly created parameter (test isolation; the
+    reference analog is a fresh startup Program)."""
+    _PARAMS.clear()
+    _COUNTERS.clear()
+
+
+def parameter_scope():
+    return dict(_PARAMS)
+
+
+def _param(name, shape, dtype, attr, is_bias=False, default_init=None):
+    """Fluid create-or-share: an attr-named parameter that already exists
+    is reused (shape-checked); otherwise a new one is created under
+    `name` and registered on the scope + default main program."""
+    from ..legacy_alias import create_parameter as _create
+    attr = ParamAttr._to_attr(attr)
+    if attr is False:
+        return None
+    pname = attr.name or name
+    if pname in _PARAMS:
+        p = _PARAMS[pname]
+        if tuple(int(s) for s in p.shape) != tuple(int(s) for s in shape):
+            raise ValueError(
+                f"static.nn parameter {pname!r} exists with shape "
+                f"{tuple(p.shape)}, requested {tuple(shape)}")
+        return p
+    p = _create(shape, dtype=dtype, name=pname, attr=attr, is_bias=is_bias,
+                default_initializer=default_init)
+    p.name = pname
+    _PARAMS[pname] = p
+    prog = _default_program()
+    if prog is not None:
+        prog._parameters[pname] = p
+    return p
+
+
+def _default_program():
+    from .compat import default_main_program
+    try:
+        return default_main_program()
+    except Exception:
+        return None
+
+
+def _act(out, act):
+    if act is None:
+        return out
+    from ..nn import functional as F
+    fn = getattr(F, act, None)
+    if fn is None:
+        raise ValueError(f"unknown activation {act!r}")
+    return fn(out)
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """paddle.static.nn.create_parameter (fluid/layers/tensor.py) — the
+    scope-registered variant of the top-level helper."""
+    return _param(name or _unique("create_parameter") + ".w_0",
+                  shape, dtype, attr, is_bias=is_bias,
+                  default_init=default_initializer)
+
+
+# -- dense / embedding --------------------------------------------------------
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """Fully-connected over flattened trailing dims (reference
+    static/nn/common.py fc): each input gets its own weight; outputs
+    sum before one shared bias + activation."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    base = name or _unique("fc")
+    out = None
+    for i, xi in enumerate(xs):
+        shp = tuple(int(s) for s in xi.shape)
+        nfd = num_flatten_dims if num_flatten_dims >= 0 else len(shp) - 1
+        in_dim = int(np.prod(shp[nfd:]))
+        w = _param(f"{base}.w_{i}", (in_dim, size), str(xi.dtype),
+                   weight_attr)
+        flat = xi.reshape(list(shp[:nfd]) + [in_dim])
+        term = flat.matmul(w)
+        out = term if out is None else out + term
+    b = _param(f"{base}.b_0", (size,), str(xs[0].dtype), bias_attr,
+               is_bias=True)
+    if b is not None:
+        out = out + b
+    return _act(out, activation)
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32"):
+    """Lookup-table op (reference fluid/input.py embedding)."""
+    from ..nn import functional as F
+    w = _param(_unique("embedding") + ".w_0", tuple(size), dtype,
+               param_attr)
+    return F.embedding(input, w, padding_idx=padding_idx, sparse=is_sparse)
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,
+                     entry=None, table_class="CommonSparseTable",
+                     param_attr=None, dtype="float32"):
+    """PS-backed large-vocab embedding (reference
+    fluid/contrib/layers/sparse_embedding): on TPU the table lives
+    sharded in HBM and the lookup is the same gather — the PS
+    distribution strategy (distributed/ps) shards it at scale."""
+    return embedding(input, size, is_sparse=True, padding_idx=padding_idx,
+                     param_attr=param_attr, dtype=dtype)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    """out_k = x^T W_k y + b_k (reference fluid/layers/nn.py
+    bilinear_tensor_product)."""
+    from ..nn import functional as F
+    d1, d2 = int(x.shape[-1]), int(y.shape[-1])
+    base = name or _unique("bilinear_tensor_product")
+    w = _param(f"{base}.w_0", (size, d1, d2), str(x.dtype), param_attr)
+    b = _param(f"{base}.b_0", (1, size), str(x.dtype), bias_attr,
+               is_bias=True)
+    out = F.bilinear_tensor_product(x, y, w, b)
+    return _act(out, act)
+
+
+# -- convolutions -------------------------------------------------------------
+
+def _filter_tuple(filter_size, n):
+    if isinstance(filter_size, (list, tuple)):
+        return tuple(int(k) for k in filter_size)
+    return (int(filter_size),) * n
+
+
+def _conv_nd(n, op_name, input, num_filters, filter_size, stride, padding,
+             dilation, groups, param_attr, bias_attr, act, data_format,
+             transpose=False, output_size=None, output_padding=0):
+    from ..nn import functional as F
+    groups = groups or 1
+    channels_last = not data_format.startswith("NC")
+    c_in = int(input.shape[-1] if channels_last else input.shape[1])
+    k = _filter_tuple(filter_size, n)
+    if transpose:
+        # reference transpose-conv weight layout: [in_c, out_c/groups, *k]
+        wshape = (c_in, num_filters // groups) + k
+    else:
+        wshape = (num_filters, c_in // groups) + k
+    base = _unique(op_name)
+    fan_in = int(np.prod((c_in // groups,) + k))
+    from ..nn import initializer as I
+    w = _param(f"{base}.w_0", wshape, str(input.dtype), param_attr,
+               default_init=I.Normal(0.0, float(np.sqrt(2.0 / fan_in))))
+    b = _param(f"{base}.b_0", (num_filters,), str(input.dtype), bias_attr,
+               is_bias=True)
+    fn = getattr(F, f"conv{n}d_transpose" if transpose else f"conv{n}d")
+    kw = dict(stride=stride, padding=padding, dilation=dilation,
+              groups=groups, data_format=data_format)
+    if transpose:
+        kw["output_size"] = output_size
+        kw["output_padding"] = output_padding
+    out = fn(input, w, b, **kw)
+    return _act(out, act)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=None, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None, data_format="NCHW"):
+    """fluid/layers/nn.py conv2d: implicit [O, I/g, kh, kw] filter."""
+    return _conv_nd(2, name or "conv2d", input, num_filters, filter_size,
+                    stride, padding, dilation, groups, param_attr,
+                    bias_attr, act, data_format)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=None, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None, data_format="NCDHW"):
+    return _conv_nd(3, name or "conv3d", input, num_filters, filter_size,
+                    stride, padding, dilation, groups, param_attr,
+                    bias_attr, act, data_format)
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=None,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCHW"):
+    """fluid/layers/nn.py conv2d_transpose. One of output_size /
+    filter_size must be given; filter_size derives from output_size the
+    reference way when absent."""
+    if filter_size is None:
+        if output_size is None:
+            raise ValueError("conv2d_transpose: output_size and "
+                             "filter_size cannot both be None")
+        out = _filter_tuple(output_size, 2)
+        channels_last = not data_format.startswith("NC")
+        sp = input.shape[1:-1] if channels_last else input.shape[2:]
+        stride_t = _filter_tuple(stride, 2)
+        pad_t = _filter_tuple(padding, 2) if not isinstance(
+            padding, str) else (0, 0)
+        dil_t = _filter_tuple(dilation, 2)
+        filter_size = tuple(
+            (out[i] - (int(sp[i]) - 1) * stride_t[i] + 2 * pad_t[i] - 1)
+            // dil_t[i] + 1 for i in range(2))
+    return _conv_nd(2, name or "conv2d_transpose", input, num_filters,
+                    filter_size, stride, padding, dilation, groups,
+                    param_attr, bias_attr, act, data_format,
+                    transpose=True, output_size=output_size)
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=None,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCDHW"):
+    if filter_size is None:
+        raise ValueError("conv3d_transpose requires filter_size")
+    return _conv_nd(3, name or "conv3d_transpose", input, num_filters,
+                    filter_size, stride, padding, dilation, groups,
+                    param_attr, bias_attr, act, data_format,
+                    transpose=True, output_size=output_size)
+
+
+def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, weight_attr=None, bias_attr=None,
+                  name=None):
+    """static/nn/common.py deform_conv2d over the functional
+    deformable_conv kernel (v2 when mask is given, v1 when None)."""
+    from ..nn import functional as F
+    c_in = int(x.shape[1])
+    k = _filter_tuple(filter_size, 2)
+    base = name or _unique("deform_conv2d")
+    w = _param(f"{base}.w_0", (num_filters, c_in // (groups or 1)) + k,
+               str(x.dtype), weight_attr)
+    b = _param(f"{base}.b_0", (num_filters,), str(x.dtype), bias_attr,
+               is_bias=True)
+    return F.deformable_conv(x, offset, mask, num_filters, k, w, bias=b,
+                             stride=stride, padding=padding,
+                             dilation=dilation, groups=groups or 1,
+                             deformable_groups=deformable_groups,
+                             im2col_step=im2col_step,
+                             modulated=mask is not None)
+
+
+# -- normalization ------------------------------------------------------------
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               in_place=False, name=None, moving_mean_name=None,
+               moving_variance_name=None,
+               do_model_average_for_mean_and_var=True,
+               use_global_stats=False):
+    """fluid/layers/nn.py batch_norm: implicit scale/bias + moving
+    mean/variance; training mode updates the moving stats in place."""
+    from ..nn import functional as F
+    channels_last = not data_layout.startswith("NC")
+    c = int(input.shape[-1 if channels_last else 1])
+    base = name or _unique("batch_norm")
+    from ..nn import initializer as I
+    w = _param(f"{base}.w_0", (c,), "float32", param_attr,
+               default_init=I.Constant(1.0))
+    b = _param(f"{base}.b_0", (c,), "float32", bias_attr, is_bias=True)
+    mean = _param(moving_mean_name or f"{base}.w_1", (c,), "float32", None,
+                  default_init=I.Constant(0.0))
+    var = _param(moving_variance_name or f"{base}.w_2", (c,), "float32",
+                 None, default_init=I.Constant(1.0))
+    mean.stop_gradient = True
+    var.stop_gradient = True
+    out = F.batch_norm(input, mean, var, weight=w, bias=b,
+                       training=not is_test, momentum=momentum,
+                       epsilon=epsilon, data_format=data_layout,
+                       use_global_stats=use_global_stats)
+    return _act(out, act)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    """fluid/layers/nn.py layer_norm: normalize over
+    dims[begin_norm_axis:], flat [prod(norm_dims)] scale/shift."""
+    from ..nn import functional as F
+    from ..nn import initializer as I
+    shp = tuple(int(s) for s in input.shape)
+    norm_shape = shp[begin_norm_axis:]
+    base = name or _unique("layer_norm")
+    w = _param(f"{base}.w_0", (int(np.prod(norm_shape)),), "float32",
+               param_attr, default_init=I.Constant(1.0)) if scale else None
+    b = _param(f"{base}.b_0", (int(np.prod(norm_shape)),), "float32",
+               bias_attr, is_bias=True) if shift else None
+    wr = w.reshape(list(norm_shape)) if w is not None else None
+    br = b.reshape(list(norm_shape)) if b is not None else None
+    out = F.layer_norm(input, list(norm_shape), weight=wr, bias=br,
+                       epsilon=epsilon)
+    return _act(out, act)
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, data_layout="NCHW", name=None):
+    from ..nn import functional as F
+    from ..nn import initializer as I
+    channels_last = not data_layout.startswith("NC")
+    c = int(input.shape[-1 if channels_last else 1])
+    base = name or _unique("group_norm")
+    w = _param(f"{base}.w_0", (c,), "float32", param_attr,
+               default_init=I.Constant(1.0))
+    b = _param(f"{base}.b_0", (c,), "float32", bias_attr, is_bias=True)
+    out = F.group_norm(input, groups, epsilon=epsilon, weight=w, bias=b,
+                       data_format=data_layout)
+    return _act(out, act)
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    from ..nn import functional as F
+    from ..nn import initializer as I
+    c = int(input.shape[1])
+    base = name or _unique("instance_norm")
+    w = _param(f"{base}.w_0", (c,), "float32", param_attr,
+               default_init=I.Constant(1.0))
+    b = _param(f"{base}.b_0", (c,), "float32", bias_attr, is_bias=True)
+    return F.instance_norm(input, weight=w, bias=b, eps=epsilon)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              batch_size_default=1e4, batch_sum_default=0.0,
+              batch_square_sum_default=1e4, slot_dim=-1, sync_stats=False,
+              summary_decay_rate=0.9999999, enable_scale_and_shift=False):
+    """fluid/layers/nn.py data_norm (kernel data_norm_op.cc): normalize
+    by accumulated batch statistics — mean = batch_sum / batch_size,
+    var = batch_square_sum / batch_size - mean^2 — then fold the current
+    batch into the accumulators with `summary_decay_rate`."""
+    from ..nn import initializer as I
+    c = int(input.shape[-1])
+    base = name or _unique("data_norm")
+    bsize = _param(f"{base}.batch_size", (c,), "float32", None,
+                   default_init=I.Constant(float(batch_size_default)))
+    bsum = _param(f"{base}.batch_sum", (c,), "float32", None,
+                  default_init=I.Constant(float(batch_sum_default)))
+    bsq = _param(f"{base}.batch_square_sum", (c,), "float32", None,
+                 default_init=I.Constant(float(batch_square_sum_default)))
+    for p in (bsize, bsum, bsq):
+        p.stop_gradient = True
+    mean = bsum / bsize
+    scale = bsize / (bsq - (bsum * bsum) / bsize + epsilon)
+    out = (input - mean) * scale.sqrt()
+    if enable_scale_and_shift:
+        w = _param(f"{base}.w_0", (c,), "float32", param_attr,
+                   default_init=I.Constant(1.0))
+        b = _param(f"{base}.b_0", (c,), "float32", None, is_bias=True)
+        out = out * w + b
+    # fold the batch into the summaries (reference decay update)
+    n = int(np.prod(input.shape[:-1]))
+    d = float(summary_decay_rate)
+    x = input.detach() if hasattr(input, "detach") else input
+    bsize.set_value((bsize * d + float(n)).numpy())
+    bsum.set_value((bsum * d + x.sum(axis=tuple(
+        range(x.ndim - 1)))).numpy())
+    bsq.set_value((bsq * d + (x * x).sum(axis=tuple(
+        range(x.ndim - 1)))).numpy())
+    return _act(out, act)
+
+
+# -- sequence / misc ops ------------------------------------------------------
+
+def crf_decoding(input, param_attr, label=None, length=None):
+    """Viterbi decode against the shared CRF transition parameter
+    (reference fluid/layers/nn.py crf_decoding; the transition is the
+    one linear_chain_crf trains, addressed by param_attr name)."""
+    from ..nn import functional as F
+    tag_num = int(input.shape[-1])
+    w = _param(_unique("crfw"), (tag_num + 2, tag_num), "float32",
+               param_attr)
+    return F.crf_decoding(input, w, label=label, length=length)
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=None, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    """fluid/layers/nn.py nce over the functional NCE kernel."""
+    from ..nn import functional as F
+    d = int(input.shape[-1])
+    base = name or _unique("nce")
+    w = _param(f"{base}.w_0", (num_total_classes, d), str(input.dtype),
+               param_attr)
+    b = _param(f"{base}.b_0", (num_total_classes,), str(input.dtype),
+               bias_attr, is_bias=True)
+    return F.nce(input, label, num_total_classes, w, bias=b,
+                 sample_weight=sample_weight,
+                 num_neg_samples=num_neg_samples or 10, sampler=sampler,
+                 custom_dist=custom_dist, seed=seed)
+
+
+def prelu(x, mode, param_attr=None, data_format="NCHW", name=None):
+    """fluid/layers/nn.py prelu: mode in {'all','channel','element'}
+    sizes the implicit alpha."""
+    from ..nn import functional as F
+    from ..nn import initializer as I
+    if mode == "all":
+        shape = (1,)
+    elif mode == "channel":
+        shape = (int(x.shape[1 if data_format.startswith("NC")
+                             else -1]),)
+    elif mode == "element":
+        shape = tuple(int(s) for s in x.shape[1:])
+    else:
+        raise ValueError("prelu mode must be 'all'|'channel'|'element'")
+    base = name or _unique("prelu")
+    alpha = _param(f"{base}.w_0", shape, str(x.dtype), param_attr,
+                   default_init=I.Constant(0.25))
+    if mode == "element":
+        return apply(lambda a, al: jnp.where(a > 0, a, al[None] * a),
+                     x, alpha)
+    return F.prelu(x, alpha, data_format=data_format)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """Lookahead row convolution (fluid/layers/nn.py row_conv; kernel
+    row_conv_op.cc): out[t] = sum_{i=0..k} in[t+i] * w[i] per channel,
+    for [B, T, D] batched input."""
+    d = int(input.shape[-1])
+    k = int(future_context_size)
+    w = _param(_unique("row_conv") + ".w_0", (k + 1, d),
+               str(input.dtype), param_attr)
+
+    def f(a, wt):
+        # pad T future steps with zeros, window-sum the lookahead
+        pad = [(0, 0)] * a.ndim
+        pad[-2] = (0, k)
+        ap = jnp.pad(a, pad)
+        out = jnp.zeros_like(a)
+        for i in range(k + 1):
+            out = out + ap[..., i:i + a.shape[-2], :] * wt[i]
+        return out
+
+    return _act(apply(f, input, w), act)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """fluid/layers/nn.py spectral_norm — stateless power iteration over
+    the given weight (the functional kernel)."""
+    from ..nn import functional as F
+    return F.spectral_norm(weight, dim=dim, power_iters=power_iters,
+                           eps=eps)
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """SSD head (fluid/layers/detection.py multi_box_head): implicit
+    per-level loc/conf conv parameters + prior boxes, over the
+    functional kernel (which takes the weights explicitly)."""
+    from ..nn import functional as F
+    from ..nn import initializer as I
+    base = name or _unique("multi_box_head")
+    n_lvl = len(inputs)
+    # replicate the kernel's prior-count logic to size the convs
+    if min_sizes is None:
+        ms, mx = [], []
+        step_r = int(np.floor((max_ratio - min_ratio) / (n_lvl - 2)))
+        for r in range(min_ratio, max_ratio + 1, step_r):
+            ms.append(base_size * r / 100.0)
+            mx.append(base_size * (r + step_r) / 100.0)
+        ms = [base_size * 0.10] + ms
+        mx = [base_size * 0.20] + mx
+        min_sizes_l, max_sizes_l = ms[:n_lvl], mx[:n_lvl]
+    else:
+        min_sizes_l = list(min_sizes)
+        max_sizes_l = list(max_sizes) if max_sizes else [None] * n_lvl
+    loc_w, loc_b, conf_w, conf_b = [], [], [], []
+    k = int(kernel_size)
+    for i, feat in enumerate(inputs):
+        ar = aspect_ratios[i] if isinstance(aspect_ratios[i],
+                                            (list, tuple)) \
+            else [aspect_ratios[i]]
+        n_prior = len(ar) * (2 if flip else 1) + 1
+        if max_sizes_l[i]:
+            n_prior += 1
+        c_in = int(feat.shape[1])
+        loc_w.append(_param(f"{base}.loc{i}.w_0",
+                            (n_prior * 4, c_in, k, k), str(feat.dtype),
+                            None, default_init=I.XavierNormal()))
+        loc_b.append(_param(f"{base}.loc{i}.b_0", (n_prior * 4,),
+                            str(feat.dtype), None, is_bias=True))
+        conf_w.append(_param(f"{base}.conf{i}.w_0",
+                             (n_prior * num_classes, c_in, k, k),
+                             str(feat.dtype), None,
+                             default_init=I.XavierNormal()))
+        conf_b.append(_param(f"{base}.conf{i}.b_0",
+                             (n_prior * num_classes,), str(feat.dtype),
+                             None, is_bias=True))
+    return F.multi_box_head(
+        inputs, image, base_size, num_classes, aspect_ratios,
+        min_ratio=min_ratio, max_ratio=max_ratio, min_sizes=min_sizes,
+        max_sizes=max_sizes, steps=steps, step_w=step_w, step_h=step_h,
+        offset=offset, variance=variance, flip=flip, clip=clip,
+        kernel_size=kernel_size, pad=pad, stride=stride,
+        min_max_aspect_ratios_order=min_max_aspect_ratios_order,
+        loc_weights=loc_w, conf_weights=conf_w, loc_biases=loc_b,
+        conf_biases=conf_b)
+
+
+def py_func(func, x, out=None, backward_func=None,
+            skip_vars_in_backward_input=None):
+    from .compat import py_func as _pf
+    return _pf(func, x, out=out, backward_func=backward_func,
+               skip_vars_in_backward_input=skip_vars_in_backward_input)

@@ -1,0 +1,13 @@
+"""paddle.tensor — the tensor function library as a submodule.
+
+Reference: python/paddle/tensor/__init__.py:1 groups the tensor ops
+(creation/linalg/manipulation/math/random/search/stat...) under one
+module that the top level star-imports. Here `ops/` is that library;
+this module is the name-parity alias so `import paddle.tensor` /
+`paddle.tensor.concat(...)`-style code ports unchanged."""
+from . import ops as _ops
+from .ops import *            # noqa: F401,F403
+from .core.tensor import Tensor, to_tensor  # noqa: F401
+
+__all__ = [n for n in dir(_ops) if not n.startswith("_")] + \
+    ["Tensor", "to_tensor"]

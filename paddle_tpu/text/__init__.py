@@ -24,7 +24,7 @@ import numpy as np
 from ..core.tensor import Tensor, apply
 from ..io.dataset import Dataset
 
-__all__ = ["Imdb", "Imikolov", "UCIHousing", "Movielens", "WMT14",
+__all__ = ["Imdb", "Imikolov", "UCIHousing", "Movielens", "WMT14", "WMT16",
            "Conll05st", "build_vocab", "viterbi_decode", "ViterbiDecoder"]
 
 
@@ -220,6 +220,56 @@ class WMT14(Dataset):
 
     def __getitem__(self, i):
         return self.samples[i]
+
+
+class WMT16(WMT14):
+    """ACL2016 MMT translation set (reference
+    python/paddle/text/datasets/wmt16.py:1: BPE-tokenized en<->de with
+    <unk> replacement and per-language dicts). Same sample contract as
+    the reference — (src_ids, trg_ids [<s> +], trg_next [+ <e>]) — over
+    a local `data_file` (`src<TAB>trg` lines) or the synthetic corpus;
+    src_dict_size/trg_dict_size of -1 keep the full vocabulary."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 src_dict_size: int = -1, trg_dict_size: int = -1,
+                 lang: str = "en", n_synthetic: int = 128):
+        super().__init__(data_file=data_file, mode=mode,
+                         n_synthetic=n_synthetic)
+        self.lang = lang
+        self.src_dict_size = src_dict_size
+        self.trg_dict_size = trg_dict_size
+        for attr, cap in (("src_idx", src_dict_size),
+                          ("trg_idx", trg_dict_size)):
+            if cap and cap > 0:
+                vocab = getattr(self, attr)
+                unk = vocab["<unk>"]
+                if cap <= unk:
+                    raise ValueError(
+                        f"WMT16 {attr[:3]}_dict_size={cap} would drop the "
+                        f"specials (<s>/<e>/<unk> occupy ids 0..{unk}); "
+                        f"use at least {unk + 1}")
+                kept = {w: i for w, i in vocab.items() if i < cap}
+                setattr(self, attr, kept)
+                # remap dropped ids onto <unk> in the materialized samples
+                col = 0 if attr == "src_idx" else 1
+                fixed = []
+                for smp in self.samples:
+                    smp = list(smp)
+                    if col == 0:
+                        smp[0] = np.where(smp[0] < cap, smp[0], unk)
+                    else:
+                        smp[1] = np.where(smp[1] < cap, smp[1], unk)
+                        smp[2] = np.where(smp[2] < cap, smp[2], unk)
+                    fixed.append(tuple(smp))
+                self.samples = fixed
+
+    def get_dict(self, lang: str, reverse: bool = False):
+        """Word dict for `lang` (reference wmt16.get_dict): the source
+        language is self.lang; the other side is the target."""
+        vocab = self.src_idx if lang == self.lang else self.trg_idx
+        if reverse:
+            return {i: w for w, i in vocab.items()}
+        return dict(vocab)
 
 
 class Conll05st(Dataset):

@@ -11,7 +11,28 @@ from .compat import (OpLastCheckpointChecker, Profiler,  # noqa: F401
                      require_version, try_import, unique_name)
 
 __all__ = ["op_bench", "collective_bench", "custom_op", "register_op",
-           "run_check", "cpp_extension"]
+           "run_check", "cpp_extension", "dump_config", "deprecated",
+           "download", "unique_name", "require_version", "try_import"]
+
+
+def dump_config(config, path=None):
+    """paddle.utils.dump_config (reference utils/__init__.py:29 lists it
+    in __all__; the v1 helper printed a trainer config). Renders any
+    config-ish object — dict, DistributedStrategy, dataclass, namespace —
+    as sorted `key = value` lines; writes to `path` when given, returns
+    the text."""
+    if hasattr(config, "__dict__") and not isinstance(config, dict):
+        items = {k: v for k, v in vars(config).items()
+                 if not k.startswith("_")}
+    elif isinstance(config, dict):
+        items = config
+    else:
+        items = {"value": config}
+    text = "\n".join(f"{k} = {items[k]!r}" for k in sorted(items)) + "\n"
+    if path:
+        with open(path, "w") as f:
+            f.write(text)
+    return text
 
 
 def run_check():
