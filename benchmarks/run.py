@@ -113,7 +113,10 @@ def config2_resnet50(smoke):
     from paddle_tpu.vision.models import resnet18, resnet50
 
     paddle.seed(0)
-    inner = resnet18() if smoke else resnet50()
+    # PT_BENCH_CONV_FORMAT=NHWC measures the channels-last zoo option
+    fmt = os.environ.get("PT_BENCH_CONV_FORMAT", "NCHW")
+    inner = resnet18(data_format=fmt) if smoke else \
+        resnet50(data_format=fmt)
 
     # jitted train step through the strategy compiler: on TPU the eager
     # op-at-a-time executor pays a dispatch round-trip per op (~1k ops in
@@ -127,9 +130,10 @@ def config2_resnet50(smoke):
             return F.cross_entropy(self.net(x), y)
 
     model = Wrap()
-    B, H = (4, 32) if smoke else (64, 224)
+    B, H = (4, 32) if smoke else (256, 224)
     s = DistributedStrategy()
     s.amp = not smoke
+    s.amp_configs.use_pure_bf16 = not smoke
     mom = opt.Momentum(learning_rate=0.1,
                        parameters=list(model.parameters()))
     import jax
@@ -139,7 +143,8 @@ def config2_resnet50(smoke):
     rng = np.random.default_rng(0)
     # pre-stage the batch on device: measuring compute, not the host link
     # (the real input pipeline overlaps transfers via device_prefetch)
-    x = prog._put_data(rng.normal(size=(B, 3, H, H)).astype(np.float32))
+    shape = (B, 3, H, H) if fmt == "NCHW" else (B, H, H, 3)
+    x = prog._put_data(rng.normal(size=shape).astype(np.float32))
     y = prog._put_data(rng.integers(0, 1000, (B,)).astype(np.int64))
 
     def step():
@@ -147,7 +152,7 @@ def config2_resnet50(smoke):
 
     dt = _timed_steps(step)
     _emit("2_resnet50_train" if not smoke else "2_resnet18_smoke",
-          B / dt, "images/s")
+          B / dt, "images/s", {"data_format": fmt, "batch": B})
 
 
 def _compiled_lm(model_cfg_fn, strategy_fn, B, T, smoke):
@@ -242,17 +247,62 @@ def config5_gpt3_1p3b_pp(smoke):
         from paddle_tpu.models import GPT
         return GPT(gpt_tiny() if smoke else gpt3_1p3b())
 
-    def strat(n):
+    import jax
+    n = len(jax.devices())
+
+    if n == 1 and not smoke:
+        # single chip (the TPU bench box): 1.3B fits 16 GB HBM as pure
+        # bf16 — params 2.6 GB + Adam m/v slots 5.2 GB (zeros_like
+        # follows the bf16 param dtype) + remat'd activations. The
+        # pp=2 x dp=4 virtual-mesh run below (--smoke / dryrun) stays
+        # the multi-chip correctness artifact.
+        import paddle_tpu as paddle
+        import paddle_tpu.optimizer as opt
+        from paddle_tpu.distributed.fleet.compiler import \
+            compile_train_step
+        from paddle_tpu.models import GPT
+
+        paddle.seed(0)
+        # build on HOST: eager construction would otherwise leave f32
+        # originals + bf16 casts resident in HBM next to the compiled
+        # program's own param/slot buffers (that transient peak is what
+        # OOMed, not the steady state)
+        cpu0 = jax.devices("cpu")[0] if any(
+            d.platform == "cpu" for d in jax.devices("cpu")) else None
+        with jax.default_device(cpu0):
+            # fused_head_ce: stream the tied-head CE through the Pallas
+            # kernel — the two ~1.5 GB f32 logits buffers (fwd live +
+            # bwd remat) never materialize
+            model = GPT(gpt3_1p3b(fused_head_ce=True)).bfloat16()
+        model.eval()
+        s = DistributedStrategy()
+        s.recompute = True
+        adam = opt.Adam(learning_rate=1e-4,
+                        parameters=list(model.parameters()))
+        prog = compile_train_step(model, adam, s, loss_method="loss")
+        rng = np.random.default_rng(0)
+        B, T = 4, 2048
+        ids = prog._put_data(
+            rng.integers(0, model.cfg.vocab_size, (B, T)).astype(np.int64))
+
+        def step():
+            return prog.step(ids, ids)
+
+        dt = _timed_steps(step, n_short=1, n_long=5)
+        tps = B * T / dt
+        _emit("5_gpt3_1p3b_single_chip_bf16_remat", tps, "tokens/s",
+              {"mfu": _mfu(tps, model, T), "params_dtype": "bfloat16"})
+        return
+
+    def strat(nn_):
         s = DistributedStrategy()
         s.amp = not smoke
         s.recompute = True
         s.pipeline = True
-        s.hybrid_configs.pp_degree = 2 if n >= 2 else 1
+        s.hybrid_configs.pp_degree = 2 if nn_ >= 2 else 1
         s.pipeline_configs.accumulate_steps = 4
         return s
 
-    import jax
-    n = len(jax.devices())
     pp = 2 if n >= 2 else 1
     dp = max(n // pp, 1)
     # microbatch dim (B / accumulate_steps) must divide by dp

@@ -275,11 +275,30 @@ def compile_train_step(layer, optimizer, strategy: DistributedStrategy,
     if recompute:
         # reference RecomputeOptimizer/backward.py:725; on TPU this is
         # jax.checkpoint — recompute activations in backward instead of
-        # storing them (SURVEY.md §8.4)
+        # storing them (SURVEY.md §8.4). Models exposing the per-block
+        # protocol get block-scoped checkpoints (peak memory = ONE
+        # block's activations); a whole-forward checkpoint is the
+        # fallback and only trades compute, not peak memory.
         policy = getattr(jax.checkpoint_policies,
                          strategy.recompute_configs.policy, None)
-        forward_loss = jax.checkpoint(
-            forward_loss, policy=policy, static_argnums=())
+        if hasattr(layer, "enable_block_recompute"):
+            # set/restore AROUND the traced forward only — a persistent
+            # flag would leak block remat into later compiles of the
+            # same layer and into eager jax.grad use
+            _inner_fl = forward_loss
+
+            def forward_loss(p, st, key, *data):
+                prev = getattr(layer, "_recompute_blocks", False)
+                prev_pol = getattr(layer, "_recompute_policy", None)
+                layer.enable_block_recompute(True, policy=policy)
+                try:
+                    return _inner_fl(p, st, key, *data)
+                finally:
+                    layer._recompute_blocks = prev
+                    layer._recompute_policy = prev_pol
+        else:
+            forward_loss = jax.checkpoint(
+                forward_loss, policy=policy, static_argnums=())
 
     def train_step(p, st, opt_st, key, lr, data):
         if k_merge > 1:
@@ -724,12 +743,8 @@ def _compile_pipeline_step(layer, optimizer, strategy, mesh):
     n_tp = int(mesh.shape.get("tp", 1))
     n_sp = int(mesh.shape.get("sp", 1))
     if n_tp > 1:
-        if n_sp > 1:
-            raise NotImplementedError(
-                "pipeline + tp + sp in one mesh is not supported; pick "
-                "two of the three")
         return _compile_pipeline_tp_step(layer, optimizer, strategy, mesh,
-                                         n_tp)
+                                         n_tp, n_sp=n_sp)
     n_ep = int(mesh.shape.get("ep", 1))
     if n_sp > 1 and n_ep > 1:
         raise NotImplementedError(
@@ -821,27 +836,44 @@ def _compile_pipeline_step(layer, optimizer, strategy, mesh):
                                "moe_aux_coef", 0.01)))
 
 
-def _compile_pipeline_tp_step(layer, optimizer, strategy, mesh, n_tp):
-    """pp x tp (x dp) branch: the pipeline shard_map keeps every mesh axis
-    manual, so the block function is the layer's hand-written Megatron
-    block (models/gpt.py pipeline_block_fn_tp: split qkv head groups,
-    explicit psums over 'tp') and the stacked block params are physically
-    sharded with the layer's block_tp_specs. Reference analog: a program
+def _compile_pipeline_tp_step(layer, optimizer, strategy, mesh, n_tp,
+                              n_sp=1):
+    """pp x tp (x sp) (x dp) branch: the pipeline shard_map keeps every
+    mesh axis manual, so the block function is the layer's hand-written
+    Megatron block (models/gpt.py pipeline_block_fn_tp: split qkv head
+    groups, explicit psums over 'tp') and the stacked block params are
+    physically sharded with the layer's block_tp_specs. With sp > 1 the
+    block is pipeline_block_fn_tp_sp — ring/Ulysses attention over 'sp'
+    on the local tp head group — and the data's sequence dim shards over
+    'sp' (the v5p-64 long-context mesh). Reference analog: a program
     pass emitting c_allreduce inside each pipeline section."""
     from ..pipeline import stack_stage_params
 
-    for need in ("split_block_params_tp", "block_tp_specs",
-                 "pipeline_block_fn_tp", "pipeline_split_params",
-                 "pipeline_fns"):
+    need_fns = ["split_block_params_tp", "block_tp_specs",
+                "pipeline_split_params", "pipeline_fns",
+                "pipeline_block_fn_tp_sp" if n_sp > 1
+                else "pipeline_block_fn_tp"]
+    for need in need_fns:
         if not callable(getattr(layer, need, None)):
             raise TypeError(
-                f"pipeline + tensor_parallel requires the layer to "
-                f"implement {need} (see models/gpt.py)")
-    _check_pipeline_compat(strategy, mesh, what="pipeline+tp")
+                f"pipeline + tensor_parallel{' + sequence_parallel' if n_sp > 1 else ''} "
+                f"requires the layer to implement {need} "
+                f"(see models/gpt.py)")
+    _check_pipeline_compat(strategy, mesh,
+                           what="pipeline+tp" + ("+sp" if n_sp > 1
+                                                 else ""),
+                           allow_sp=n_sp > 1)
     heads = getattr(getattr(layer, "cfg", None), "heads", None)
     if heads is not None and heads % n_tp:
         raise ValueError(f"{heads} attention heads not divisible by "
                          f"tp={n_tp}")
+    if (n_sp > 1 and strategy.sequence_parallel_impl == "ulysses"
+            and heads is not None and (heads // n_tp) % n_sp):
+        raise ValueError(
+            f"pipeline + tp + ulysses: local head count "
+            f"{heads // n_tp} (= {heads} heads / tp={n_tp}) not "
+            f"divisible by sp={n_sp} (use impl='ring' or adjust "
+            f"degrees)")
 
     params = param_arrays(layer)
     ep, blocks_list, hp = layer.pipeline_split_params(params)
@@ -861,10 +893,17 @@ def _compile_pipeline_tp_step(layer, optimizer, strategy, mesh, n_tp):
                              f"divisible by tp={n_tp}")
     # raw-jnp block ops bypass the autocast dispatcher hook, so AMP is
     # delivered as an explicit compute dtype
-    block_fn = layer.pipeline_block_fn_tp(
-        axis_tp="tp",
-        compute_dtype="bfloat16" if strategy.amp else None,
-        with_aux=tp_is_moe)
+    if n_sp > 1:
+        block_fn = layer.pipeline_block_fn_tp_sp(
+            axis_tp="tp", axis_sp="sp",
+            impl=strategy.sequence_parallel_impl,
+            compute_dtype="bfloat16" if strategy.amp else None,
+            with_aux=tp_is_moe)
+    else:
+        block_fn = layer.pipeline_block_fn_tp(
+            axis_tp="tp",
+            compute_dtype="bfloat16" if strategy.amp else None,
+            with_aux=tp_is_moe)
     split_blocks = [layer.split_block_params_tp(b) for b in blocks_list]
     tp_specs = layer.block_tp_specs(axis_pp="pp", axis_tp="tp")
 
@@ -880,6 +919,7 @@ def _compile_pipeline_tp_step(layer, optimizer, strategy, mesh, n_tp):
         stacked=stack_stage_params(split_blocks),
         n_layers=len(blocks_list), stacked_pspec=stacked_pspec,
         prog_cls=_PipelineTpTrainStep, replicated_axes=("tp",),
+        seq_axis="sp" if n_sp > 1 else None,
         aux_from_blocks=tp_is_moe,
         aux_coef=float(getattr(getattr(layer, "cfg", None),
                                "moe_aux_coef", 0.01)))

@@ -42,11 +42,12 @@ class DistributedInfer:
         return self.main_program
 
 
-def recompute(function, *args, **kwargs):
+def recompute(function, *args, checkpoint_policy=None, **kwargs):
     """Activation recomputation for one block call: forward runs
     normally, residuals are rematerialized in backward (jax.checkpoint —
     the reference's RecomputeFunction CUDA autograd node, as a compiler
-    policy). Tensor in/out preserving."""
+    policy). Tensor in/out preserving. `checkpoint_policy` is a
+    jax.checkpoint_policies entry (consumed here, not forwarded)."""
     import jax
 
     from ....core.tensor import Tensor
@@ -56,5 +57,6 @@ def recompute(function, *args, **kwargs):
         out = function(*wrap(list(raw)), **kwargs)
         return unwrap(out)
 
-    out = jax.checkpoint(raw_fn)(*unwrap(list(args)))
+    out = jax.checkpoint(raw_fn, policy=checkpoint_policy)(
+        *unwrap(list(args)))
     return jax.tree_util.tree_map(Tensor, out)

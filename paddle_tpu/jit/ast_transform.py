@@ -29,6 +29,7 @@ with paddle.jit.to_static)."""
 from __future__ import annotations
 
 import ast
+import copy
 import functools
 import inspect
 import textwrap
@@ -158,6 +159,42 @@ def _jst_while(cond_fn, body_fn, loop_vars, n_carried=None):
         return res
 
     return snn.while_loop(cond_fn, body_strong, carried + extra_init)
+
+
+class _JstRange:
+    """range(...) whose bounds hold tensors/tracers — the traced-for
+    carrier (__jst_range returns a real `range` when all args are
+    concrete, so this type's presence MEANS the trip count is
+    data-dependent)."""
+
+    def __init__(self, start, stop, step):
+        self.start = start
+        self.stop = stop
+        self.step = step
+
+
+def _jst_range(*args):
+    if len(args) == 1:
+        start, stop, step = 0, args[0], 1
+    elif len(args) == 2:
+        start, stop, step = args[0], args[1], 1
+    elif len(args) == 3:
+        start, stop, step = args
+    else:
+        raise TypeError(f"range expected 1-3 arguments, got {len(args)}")
+    if not any(_tensorish(a) for a in (start, stop, step)):
+        return range(int(start), int(stop), int(step))
+    return _JstRange(start, stop, step)
+
+
+def _jst_rng_cond(i, r):
+    """Loop-continue predicate for a _JstRange index carry."""
+    step = r.step
+    if _tensorish(step):
+        raise NotImplementedError(
+            "to_static: tensor-valued range STEP is not supported "
+            "(tensor start/stop are); make the step a python int")
+    return (i < r.stop) if step > 0 else (i > r.stop)
 
 
 _NESTED_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
@@ -391,9 +428,98 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         return [ast.copy_location(n_, node)
                 for n_ in (fn_t, fn_f, *grabs, assign, *guards)]
 
+    # -- for --------------------------------------------------------------
+    def visit_For(self, node):
+        """`for <name> in range(...)` with a tensor-dependent bound
+        lowers to the while machinery (reference loop_transformer.py:294
+        visit_For). A CONCRETE range keeps the plain Python loop — it
+        unrolls at trace time, which XLA prefers for short loops and
+        which stays differentiable (lax.while_loop is not) — so the
+        range()-vs-traced dispatch happens at RUNTIME via __jst_range:
+
+            __jst_R = __jst_range(args...)
+            if isinstance(__jst_R, range):   # concrete: native python
+                for i in __jst_R: body
+            else:                            # traced bound: lax path
+                i = __jst_R.start
+                [while-converted: cond __jst_rng_cond(i, R), body+step]
+
+        Non-range iterables stay untouched: lists/tuples and tensors
+        have static trip counts (a tensor's leading dim is a static
+        shape), so plain Python iteration already traces correctly."""
+        self.generic_visit(node)
+        it = node.iter
+        is_range_call = (isinstance(it, ast.Call)
+                         and isinstance(it.func, ast.Name)
+                         and it.func.id == "range" and not it.keywords)
+        if not is_range_call or not isinstance(node.target, ast.Name):
+            return node          # static-trip-count python loop: leave it
+        if node.orelse or _has_control_escape(node.body):
+            self.skipped = True
+            return node
+        i = self.counter
+        self.counter += 1
+        rng = f"__jst_R_{i}"
+        tgt = node.target.id
+        setup = ast.Assign(
+            targets=[ast.Name(id=rng, ctx=ast.Store())],
+            value=ast.Call(func=ast.Name(id="__jst_range", ctx=ast.Load()),
+                           args=list(it.args), keywords=[]))
+        concrete_for = ast.For(
+            target=ast.Name(id=tgt, ctx=ast.Store()),
+            iter=ast.Name(id=rng, ctx=ast.Load()),
+            body=copy.deepcopy(node.body), orelse=[])
+        # traced branch: index-carried while over the range formula
+        init = ast.Assign(
+            targets=[ast.Name(id=tgt, ctx=ast.Store())],
+            value=ast.Attribute(value=ast.Name(id=rng, ctx=ast.Load()),
+                                attr="start", ctx=ast.Load()))
+        bump = ast.Assign(
+            targets=[ast.Name(id=tgt, ctx=ast.Store())],
+            value=ast.BinOp(
+                left=ast.Name(id=tgt, ctx=ast.Load()), op=ast.Add(),
+                right=ast.Attribute(value=ast.Name(id=rng, ctx=ast.Load()),
+                                    attr="step", ctx=ast.Load())))
+        wh = ast.While(
+            test=ast.Call(func=ast.Name(id="__jst_rng_cond",
+                                        ctx=ast.Load()),
+                          args=[ast.Name(id=tgt, ctx=ast.Load()),
+                                ast.Name(id=rng, ctx=ast.Load())],
+                          keywords=[]),
+            body=list(node.body) + [bump], orelse=[])
+        ast.copy_location(wh, node)
+        ast.fix_missing_locations(wh)
+        converted = self._build_while(wh)
+        if converted is wh:      # while conversion declined
+            self.skipped = True
+            return node
+        # python leaves the loop var at the LAST YIELDED index; the
+        # while lowering bumps once more after the final iteration, so
+        # undo one step (a zero-trip traced loop leaves start - step
+        # where python leaves the name unbound — the same dynamic-trip
+        # caveat as while body temps)
+        unbump = ast.Assign(
+            targets=[ast.Name(id=tgt, ctx=ast.Store())],
+            value=ast.BinOp(
+                left=ast.Name(id=tgt, ctx=ast.Load()), op=ast.Sub(),
+                right=ast.Attribute(value=ast.Name(id=rng, ctx=ast.Load()),
+                                    attr="step", ctx=ast.Load())))
+        dispatch = ast.If(
+            test=ast.Call(func=ast.Name(id="isinstance", ctx=ast.Load()),
+                          args=[ast.Name(id=rng, ctx=ast.Load()),
+                                ast.Name(id="range", ctx=ast.Load())],
+                          keywords=[]),
+            body=[concrete_for],
+            orelse=[init] + list(converted) + [unbump])
+        self.changed = True
+        return [ast.copy_location(n_, node) for n_ in (setup, dispatch)]
+
     # -- while ------------------------------------------------------------
     def visit_While(self, node):
         self.generic_visit(node)
+        return self._build_while(node)
+
+    def _build_while(self, node):
         if node.orelse or _has_control_escape(node.body):
             self.skipped = True
             return node
@@ -525,6 +651,8 @@ def convert_function(fn):
     namespace["__jst_cond"] = _jst_cond
     namespace["__jst_while"] = _jst_while
     namespace["__jst_undef"] = _JST_UNDEF
+    namespace["__jst_range"] = _jst_range
+    namespace["__jst_rng_cond"] = _jst_rng_cond
     if _CODE_LEVEL[0] > 0:
         print(f"[to_static] converted {fn.__qualname__}:")
         print(ast.unparse(tree))

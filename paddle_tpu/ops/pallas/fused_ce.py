@@ -38,10 +38,22 @@ from ._common import (pltpu, VMEM as _VMEM, on_tpu as _on_tpu,
                       mxu_dtype as _mxu_dtype, NEG_INF, LANE, I0 as _I0)
 
 
-def _blocks(N, V):
-    bn = 512 if N % 512 == 0 else 256 if N % 256 == 0 else 128
-    bv = 1024
-    return bn, bv
+def _blocks(N, V, H=768):
+    """Tile sizes under the 16 MB VMEM budget. The bwd working set per
+    grid step is ~(2*bn + 2*bv)*H*2 B of double-buffered bf16 x/w tiles
+    + bn*H*4 B f32 scratch + 2*bn*bv*4 B f32 logit tiles; at H <= 1024
+    the (512, 1024) tiles fit (~13 MB), at H = 2048 they hit 19+ MB (the
+    config-5 stack OOM), so wide hidden dims halve both caps."""
+    if H <= 1024:
+        cap_n, cap_v = 512, 1024
+    elif H <= 2048:
+        cap_n, cap_v = 256, 512
+    else:
+        cap_n, cap_v = 128, 256
+    bn = cap_n
+    while bn > 128 and N % bn:
+        bn //= 2
+    return bn, cap_v
 
 
 # ---------------------------------------------------------------------------
@@ -87,7 +99,7 @@ def _fwd_kernel(x_ref, w_ref, lbl_ref, lse_ref, lab_ref, m_sc, l_sc, lab_sc,
 def _fwd_pallas(x, w, labels, V):
     N, H = x.shape
     Vp = w.shape[0]
-    bn, bv = _blocks(N, Vp)
+    bn, bv = _blocks(N, Vp, H)
     assert Vp % bv == 0, f"padded vocab {Vp} must divide v-block {bv}"
     nn, nv = N // bn, Vp // bv
     lbl2 = labels.astype(jnp.int32).reshape(N, 1)
@@ -190,7 +202,7 @@ def _bwd_dw_kernel(x_ref, w_ref, lbl_ref, lse_ref, g_ref, dw_ref, dw_sc,
 def _bwd_pallas(x, w, labels, lse, g, V):
     N, H = x.shape
     Vp = w.shape[0]
-    bn, bv = _blocks(N, Vp)
+    bn, bv = _blocks(N, Vp, H)
     assert Vp % bv == 0, f"padded vocab {Vp} must divide v-block {bv}"
     nn, nv = N // bn, Vp // bv
     lbl2 = labels.astype(jnp.int32).reshape(N, 1)
@@ -305,7 +317,7 @@ def _lce_pallas(x, w, labels):
 
 def _lce_pallas_fwd(x, w, labels):
     V = w.shape[0]
-    wp = _pad_vocab(w, bv=_blocks(x.shape[0], V)[1])
+    wp = _pad_vocab(w, bv=_blocks(x.shape[0], V, x.shape[1])[1])
     lse, lab = _fwd_pallas(x, wp, labels, V)
     return lse - lab, (x, w, labels, lse)
 
@@ -313,7 +325,7 @@ def _lce_pallas_fwd(x, w, labels):
 def _lce_pallas_bwd(res, g):
     x, w, labels, lse = res
     V = w.shape[0]
-    wp = _pad_vocab(w, bv=_blocks(x.shape[0], V)[1])
+    wp = _pad_vocab(w, bv=_blocks(x.shape[0], V, x.shape[1])[1])
     dx, dwp = _bwd_pallas(x, wp, labels, lse, g, V)
     return dx, dwp[:V], None
 

@@ -99,6 +99,57 @@ def HOT_OPS():
     }
 
 
+def eager_overhead(n_short=60, n_long=240, repeats=3):
+    """µs/op of the EAGER dispatch path — Tensor.apply + tape recording
+    (VERDICT r4 Next #10; the reference tracked the same quantity with
+    operators/benchmark/op_tester.cc). Chains n dependent ops on [8, 8]
+    tensors (device compute is negligible at that size) with ONE host
+    sync per window; the marginal time is the per-op python-side cost.
+    Returns {op: µs/op}."""
+    from ..core.tensor import to_tensor
+    from ..nn import functional as F
+
+    eye = to_tensor(np.eye(8, dtype=np.float32))
+    one = to_tensor(np.ones((8, 8), np.float32))
+
+    def chain_add(x, n):
+        for _ in range(n):
+            x = x + one
+        return x
+
+    def chain_matmul(x, n):
+        for _ in range(n):
+            x = x.matmul(eye)          # identity keeps values bounded
+        return x
+
+    def chain_layer_norm(x, n):
+        for _ in range(n):
+            x = F.layer_norm(x, [8])
+        return x
+
+    out = {}
+    for name, chain in (("add", chain_add), ("matmul", chain_matmul),
+                        ("layer_norm", chain_layer_norm)):
+        def run(n):
+            x = to_tensor(np.ones((8, 8), np.float32))
+            t0 = time.perf_counter()
+            y = chain(x, n)
+            float(np.asarray(y.numpy()).sum())
+            return time.perf_counter() - t0
+
+        run(4)                          # warm the per-op jit caches
+        best = float("inf")
+        for _ in range(repeats):
+            d1, d2 = run(n_short), run(n_long)
+            delta = (d2 - d1) / (n_long - n_short)
+            if delta > 0:
+                best = min(best, delta)
+        if best == float("inf"):
+            best = run(n_long) / n_long
+        out[name] = best * 1e6
+    return out
+
+
 def bench_suite(names=None):
     ops = HOT_OPS()
     names = names or list(ops)
@@ -114,4 +165,8 @@ def bench_suite(names=None):
 
 if __name__ == "__main__":
     import sys
-    bench_suite(sys.argv[1:] or None)
+    if "--eager" in sys.argv:
+        for op, us in eager_overhead().items():
+            print(f"eager {op:12s} {us:8.1f} us/op")
+    else:
+        bench_suite(sys.argv[1:] or None)

@@ -386,7 +386,9 @@ def test_compiled_step_pipeline_x_sequence_parallel():
            for _ in range(3)]
     np.testing.assert_allclose(seq, pps, atol=5e-3, rtol=1e-4)
 
-    # pp + tp + sp in one mesh is refused explicitly
+    # pp x tp x sp in ONE mesh (VERDICT r4 Next #7 — the v5p-64
+    # long-context mesh): Megatron tp inside a ring-attention sp stage
+    # under pp, vs the same sequential steps
     s3 = DistributedStrategy()
     s3.pipeline = True
     s3.tensor_parallel = True
@@ -394,10 +396,15 @@ def test_compiled_step_pipeline_x_sequence_parallel():
     s3.hybrid_configs.pp_degree = 2
     s3.hybrid_configs.mp_degree = 2
     s3.hybrid_configs.sep_degree = 2
+    s3.pipeline_configs.accumulate_steps = 2
     m3 = _tiny_gpt()
     adam3 = opt.Adam(learning_rate=1e-3, parameters=list(m3.parameters()))
-    with pytest.raises(NotImplementedError, match="two of the three"):
-        compile_train_step(m3, adam3, s3)
+    prog3 = compile_train_step(m3, adam3, s3)
+    shape3 = dict(prog3.mesh.shape)
+    assert shape3["pp"] == 2 and shape3["tp"] == 2 and shape3["sp"] == 2
+    ppts = [float(jax.device_get(prog3.step(ids, labels, lr=1e-3)))
+            for _ in range(3)]
+    np.testing.assert_allclose(seq, ppts, atol=5e-3, rtol=1e-4)
 
 
 def test_compiled_step_pipeline_x_expert_parallel():

@@ -223,8 +223,12 @@ def test_norm_layers():
 
 
 def test_spectral_norm():
+    # seed: the layer's power-iteration u draws from the global RNG, and
+    # 5 iterations from an unlucky u can under-converge past rtol=0.1 —
+    # suite ordering must not decide that
+    paddle.seed(7)
     w = X(5, 3)
-    sn = nn.SpectralNorm(w.shape, dim=0, power_iters=5)
+    sn = nn.SpectralNorm(w.shape, dim=0, power_iters=20)
     out = sn(w)
     # largest singular value normalized to ~1
     s = np.linalg.svd(out.numpy(), compute_uv=False)[0]

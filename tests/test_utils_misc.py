@@ -5,3 +5,15 @@ import paddle_tpu as paddle
 
 def test_run_check():
     paddle.utils.run_check()          # raises on any failure
+
+
+def test_eager_dispatch_overhead_gate():
+    """Regression gate (VERDICT r4 Next #10): the eager tape's python
+    overhead per op stays bounded. CPU-measured; the generous ceiling
+    catches order-of-magnitude regressions (accidental sync per op,
+    retrace per call), not scheduler noise."""
+    from paddle_tpu.utils.op_bench import eager_overhead
+    us = eager_overhead(n_short=30, n_long=90, repeats=2)
+    assert set(us) == {"add", "matmul", "layer_norm"}
+    for op, v in us.items():
+        assert v < 5000.0, f"eager {op} dispatch {v:.0f} us/op (regressed?)"
