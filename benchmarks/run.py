@@ -267,8 +267,10 @@ def config5_gpt3_1p3b_pp(smoke):
         # originals + bf16 casts resident in HBM next to the compiled
         # program's own param/slot buffers (that transient peak is what
         # OOMed, not the steady state)
-        cpu0 = jax.devices("cpu")[0] if any(
-            d.platform == "cpu" for d in jax.devices("cpu")) else None
+        try:
+            cpu0 = jax.devices("cpu")[0]
+        except RuntimeError:        # cpu backend excluded by JAX_PLATFORMS
+            cpu0 = None
         with jax.default_device(cpu0):
             # fused_head_ce: stream the tied-head CE through the Pallas
             # kernel — the two ~1.5 GB f32 logits buffers (fwd live +
@@ -277,9 +279,13 @@ def config5_gpt3_1p3b_pp(smoke):
         model.eval()
         s = DistributedStrategy()
         s.recompute = True
-        adam = opt.Adam(learning_rate=1e-4,
-                        parameters=list(model.parameters()))
-        prog = compile_train_step(model, adam, s, loss_method="loss")
+        # reduced-precision optimizer state (the 16 GB fit): Momentum's
+        # single bf16 slot. Adam's two slots fit arithmetically, but the
+        # tunnel's AOT execution path does not honor buffer donation, so
+        # step in+out Adam state alone (2 x 7.9 GB) exceeds HBM.
+        mom = opt.Momentum(learning_rate=1e-4, momentum=0.9,
+                           parameters=list(model.parameters()))
+        prog = compile_train_step(model, mom, s, loss_method="loss")
         rng = np.random.default_rng(0)
         B, T = 4, 2048
         ids = prog._put_data(
@@ -291,7 +297,8 @@ def config5_gpt3_1p3b_pp(smoke):
         dt = _timed_steps(step, n_short=1, n_long=5)
         tps = B * T / dt
         _emit("5_gpt3_1p3b_single_chip_bf16_remat", tps, "tokens/s",
-              {"mfu": _mfu(tps, model, T), "params_dtype": "bfloat16"})
+              {"mfu": _mfu(tps, model, T), "params_dtype": "bfloat16",
+               "optimizer": "momentum_bf16", "recompute": "per-block"})
         return
 
     def strat(nn_):

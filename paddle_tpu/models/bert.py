@@ -23,6 +23,7 @@ class BertConfig:
     heads: int = 12
     ffn_mult: int = 4
     dropout: float = 0.0
+    fused_head_ce: bool = None   # see GPTConfig.fused_head_ce
 
 
 def bert_base(**kw):
@@ -77,25 +78,18 @@ class Bert(nn.Layer):
         return self.mlm_ln(F.gelu(self.mlm_fc(x)))
 
     def mlm_loss(self, ids, labels, ignore_index=-100, **kw):
-        """Tied-head MLM CE through linear_cross_entropy (the fused-CE
-        op, ops/pallas/fused_ce.py): the [B*T, V] logits are recomputed
-        in the VJP instead of being saved as residuals — on the ERNIE
-        geometry (B=32, T=512, V=18048) the eliminated f32 logits
-        residual is ~1.2 GB/step of HBM traffic (the r4 config-3 gap;
-        VERDICT r4 Weak #1)."""
-        from .. import ops as F_ops
+        """Tied-head MLM CE through the shared masked_linear_ce (the
+        fused-CE path, ops/pallas/fused_ce.py): the [B*T, V] logits are
+        recomputed in the VJP instead of being saved as residuals — on
+        the ERNIE geometry (B=32, T=512, V=18048) the eliminated f32
+        logits residual is ~1.2 GB/step of HBM traffic (the r4 config-3
+        gap; VERDICT r4 Weak #1)."""
+        from .gpt import masked_linear_ce
         h = self.forward_hidden(ids, **kw)
-        C = h.shape[-1]
-        lab = F_ops.reshape(labels, [-1])
-        valid = F_ops.not_equal(lab, F_ops.full_like(lab, ignore_index))
-        safe = F_ops.where(valid, lab, F_ops.zeros_like(lab))
-        rows = F.linear_cross_entropy(F_ops.reshape(h, [-1, C]),
-                                      self.tok.weight, safe,
-                                      reduction="none")
-        rows = F_ops.where(valid, rows, F_ops.zeros_like(rows))
-        n_valid = F_ops.sum(F_ops.cast(valid, "float32"))
-        n_valid = F_ops.maximum(n_valid, F_ops.ones_like(n_valid))
-        return F_ops.sum(rows) / n_valid
+        return masked_linear_ce(h, self.tok.weight, labels,
+                                ignore_index=ignore_index,
+                                fused=getattr(self.cfg, "fused_head_ce",
+                                              None))
 
     def num_params(self) -> int:
         import math

@@ -38,15 +38,18 @@ from ._common import (pltpu, VMEM as _VMEM, on_tpu as _on_tpu,
                       mxu_dtype as _mxu_dtype, NEG_INF, LANE, I0 as _I0)
 
 
-def _blocks(N, V, H=768):
+def _blocks(N, V, H=768, itemsize=2):
     """Tile sizes under the 16 MB VMEM budget. The bwd working set per
-    grid step is ~(2*bn + 2*bv)*H*2 B of double-buffered bf16 x/w tiles
-    + bn*H*4 B f32 scratch + 2*bn*bv*4 B f32 logit tiles; at H <= 1024
-    the (512, 1024) tiles fit (~13 MB), at H = 2048 they hit 19+ MB (the
-    config-5 stack OOM), so wide hidden dims halve both caps."""
-    if H <= 1024:
+    grid step is ~(2*bn + 2*bv)*H*itemsize B of double-buffered x/w
+    tiles + (bn+bv)*H*4 B f32 scratch/out + 2*bn*bv*4 B f32 logit
+    tiles. The caps key on H*itemsize (bytes per row): bf16 rows at
+    H <= 1024 fit the (512, 1024) tiles (~13 MB); H = 2048 bf16 — or
+    H = 1024 f32 — hit 19-20 MB (both observed as compile-time VMEM
+    stack OOMs), so each doubling of the row bytes halves the caps."""
+    row_bytes = H * max(int(itemsize), 1)
+    if row_bytes <= 2048:
         cap_n, cap_v = 512, 1024
-    elif H <= 2048:
+    elif row_bytes <= 4096:
         cap_n, cap_v = 256, 512
     else:
         cap_n, cap_v = 128, 256
@@ -99,7 +102,7 @@ def _fwd_kernel(x_ref, w_ref, lbl_ref, lse_ref, lab_ref, m_sc, l_sc, lab_sc,
 def _fwd_pallas(x, w, labels, V):
     N, H = x.shape
     Vp = w.shape[0]
-    bn, bv = _blocks(N, Vp, H)
+    bn, bv = _blocks(N, Vp, H, x.dtype.itemsize)
     assert Vp % bv == 0, f"padded vocab {Vp} must divide v-block {bv}"
     nn, nv = N // bn, Vp // bv
     lbl2 = labels.astype(jnp.int32).reshape(N, 1)
@@ -202,7 +205,7 @@ def _bwd_dw_kernel(x_ref, w_ref, lbl_ref, lse_ref, g_ref, dw_ref, dw_sc,
 def _bwd_pallas(x, w, labels, lse, g, V):
     N, H = x.shape
     Vp = w.shape[0]
-    bn, bv = _blocks(N, Vp, H)
+    bn, bv = _blocks(N, Vp, H, x.dtype.itemsize)
     assert Vp % bv == 0, f"padded vocab {Vp} must divide v-block {bv}"
     nn, nv = N // bn, Vp // bv
     lbl2 = labels.astype(jnp.int32).reshape(N, 1)
@@ -317,7 +320,8 @@ def _lce_pallas(x, w, labels):
 
 def _lce_pallas_fwd(x, w, labels):
     V = w.shape[0]
-    wp = _pad_vocab(w, bv=_blocks(x.shape[0], V, x.shape[1])[1])
+    wp = _pad_vocab(w, bv=_blocks(x.shape[0], V, x.shape[1],
+                                  x.dtype.itemsize)[1])
     lse, lab = _fwd_pallas(x, wp, labels, V)
     return lse - lab, (x, w, labels, lse)
 
@@ -325,7 +329,8 @@ def _lce_pallas_fwd(x, w, labels):
 def _lce_pallas_bwd(res, g):
     x, w, labels, lse = res
     V = w.shape[0]
-    wp = _pad_vocab(w, bv=_blocks(x.shape[0], V, x.shape[1])[1])
+    wp = _pad_vocab(w, bv=_blocks(x.shape[0], V, x.shape[1],
+                                  x.dtype.itemsize)[1])
     dx, dwp = _bwd_pallas(x, wp, labels, lse, g, V)
     return dx, dwp[:V], None
 
