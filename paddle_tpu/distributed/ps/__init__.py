@@ -617,3 +617,8 @@ class GeoCommunicator:
         silently dropped)."""
         self.sync()
         self._client.close()
+
+
+# heterogeneous trainer (SURVEY row 33): sparse tier on the PS hosts,
+# dense tier on the accelerator — see heter.py
+from .heter import HeterTrainer  # noqa: F401,E402

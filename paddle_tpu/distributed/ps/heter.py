@@ -1,0 +1,233 @@
+"""Heterogeneous parameter-server training (SURVEY §2 row 33).
+
+Reference: paddle/fluid/framework/fleet/heter_ps/ — heter_comm.h:1
+(cross-tier gradient/value movement with dedicated copy streams),
+heter_ps.h (sparse tables on the capacious CPU/host tier while dense
+math runs on the accelerator tier), heter_section_worker.cc (the split
+trainer loop).
+
+TPU-native redesign: the heterogeneous split maps onto host-DRAM PS
+servers (distributed/ps — the C++ table fleet; terabytes of cheap
+memory) for the UNBOUNDED sparse state, and one jitted XLA program on
+the TPU for everything dense. A step is:
+
+    pull_sparse(keys)  ->  [jit] segment-pool + dense fwd/bwd, with the
+    (host tier)             pulled rows as INPUTS and their gradient as
+                            an OUTPUT (the dense update applies inside)
+                       ->  push_sparse(keys, row_grads)   (async)
+
+The pull for batch k+1 overlaps the device step for batch k via a
+prefetch thread, and the push for batch k overlaps batch k+1 — the
+copy-stream overlap heter_comm implements with CUDA streams. Sparse
+rows are padded to a power-of-two capacity so ONE compiled program
+serves every batch (XLA static shapes); the pad rows are masked out of
+both the pool and the pushed gradient.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+
+__all__ = ["HeterTrainer"]
+
+
+def _pad_capacity(n: int, minimum: int = 128) -> int:
+    c = minimum
+    while c < n:
+        c *= 2
+    return c
+
+
+class HeterTrainer:
+    """Train `dense_model` on the accelerator against sparse embeddings
+    living on the PS host tier.
+
+    dense_model.forward(pooled [B, emb_dim], feats [B, F]) -> logits;
+    `loss_fn(logits, labels) -> scalar` (defaults to softmax CE via
+    nn.functional). The sparse table updates with plain SGD on the
+    servers (the reference's sparse SGD rule); the dense params update
+    with `optimizer` inside the jitted step."""
+
+    def __init__(self, client, dense_model, emb_dim, optimizer, table=0,
+                 lr_sparse=0.1, loss_fn=None, create_table=True):
+        self.client = client
+        self.model = dense_model
+        self.emb_dim = int(emb_dim)
+        self.table = int(table)
+        self.lr_sparse = float(lr_sparse)
+        self.optimizer = optimizer
+        if create_table:
+            client.create_sparse_table(self.table, self.emb_dim)
+        if loss_fn is None:
+            from ...nn import functional as F
+
+            def loss_fn(logits, labels):
+                return F.cross_entropy(logits, labels)
+        self._loss_fn = loss_fn
+        self._jits = {}          # capacity -> compiled step
+        self._params = {k: v._data for k, v in
+                        dense_model.named_parameters()}
+        self._opt_state = optimizer.functional_init(self._params)
+        self._push_pending = None      # (keys, device row-grads)
+        # one socket, two threads (prefetch pulls + main-thread pushes):
+        # RPCs serialize on this lock — the OVERLAP we are after is
+        # host-RPC vs device-compute, which the lock does not hinder
+        self._net_lock = threading.Lock()
+
+    # -- the jitted dense step --------------------------------------------
+
+    def _jitted(self, capacity, B):
+        import jax
+        import jax.numpy as jnp
+
+        from ...framework import functional_call
+
+        key = (capacity, B)
+        if key in self._jits:
+            return self._jits[key]
+        model = self.model
+        loss_fn = self._loss_fn
+        opt = self.optimizer
+
+        def step(params, opt_state, rows, seg, valid, feats, labels):
+            def loss_of(p, r):
+                # masked segment-sum pool: pad rows fall into segment B
+                # (dropped); valid scales real rows by 1.0
+                pooled = jax.ops.segment_sum(
+                    r * valid[:, None], seg, num_segments=B + 1)[:B]
+                out, _ = functional_call(model, p, {}, pooled, feats,
+                                         mutable_state=False)
+                from ...core.tensor import Tensor
+                lval = loss_fn(Tensor(out) if not hasattr(out, "_data")
+                               else out, Tensor(labels))
+                return (lval._data if hasattr(lval, "_data")
+                        else lval).astype(jnp.float32)
+
+            (loss), (gp, grows) = jax.value_and_grad(
+                loss_of, argnums=(0, 1))(params, rows)
+            new_p, new_opt = opt.functional_update(params, gp, opt_state)
+            return loss, new_p, new_opt, grows
+
+        self._jits[key] = jax.jit(step)
+        return self._jits[key]
+
+    # -- one training step -------------------------------------------------
+
+    def step(self, keys, lod, feats, labels, rows=None):
+        """keys: flat uint64 ids; lod: [B+1] offsets (MultiSlot feed
+        layout); feats [B, F] f32; labels [B] int64. `rows` lets the
+        prefetch path hand in already-pulled values."""
+        import jax.numpy as jnp
+
+        keys = np.ascontiguousarray(keys, np.uint64).ravel()
+        lod = np.asarray(lod, np.int64)
+        B = len(lod) - 1
+        n = keys.size
+        # drain the previous step's sparse push BEFORE pulling rows it
+        # would touch: direct synchronous step() loops see at most the
+        # documented one-step-stale values (prefetched `rows` callers
+        # accept the wider train()-loop staleness below)
+        self.flush()
+        if rows is None:
+            with self._net_lock:
+                rows = self.client.pull_sparse(self.table, keys,
+                                               self.emb_dim)
+        cap = _pad_capacity(n)
+        rows_p = np.zeros((cap, self.emb_dim), np.float32)
+        rows_p[:n] = rows
+        seg = np.full((cap,), B, np.int32)     # pad -> dropped segment
+        seg[:n] = np.repeat(np.arange(B, dtype=np.int32),
+                            np.diff(lod).astype(np.int64))
+        valid = np.zeros((cap,), np.float32)
+        valid[:n] = 1.0
+
+        fn = self._jitted(cap, B)
+        loss, self._params, self._opt_state, grows = fn(
+            self._params, self._opt_state, jnp.asarray(rows_p),
+            jnp.asarray(seg), jnp.asarray(valid),
+            jnp.asarray(np.asarray(feats, np.float32)),
+            jnp.asarray(np.asarray(labels)))
+        self._push_pending = (keys, grows, n)
+        return loss
+
+    def flush(self):
+        """Complete the outstanding sparse push (host-side)."""
+        if self._push_pending is None:
+            return
+        keys, grows, n = self._push_pending
+        self._push_pending = None
+        g = np.asarray(grows)[:n]
+        with self._net_lock:
+            self.client.push_sparse(self.table, keys, g, self.lr_sparse)
+
+    # -- prefetch-overlapped epoch loop ------------------------------------
+
+    def train(self, batches, epochs=1):
+        """batches: a reusable iterable, or a zero-arg callable returning
+        one, of (keys, lod, feats, labels). The pull for batch k+1 runs
+        on a thread while the device computes batch k. Returns per-step
+        losses (one host sync per step — a faithful loss curve).
+
+        Staleness bound: the producer runs up to its queue depth plus
+        one in-flight pull ahead, and the push is deferred one step, so
+        a key recurring within a 3-batch window trains on values up to
+        THREE pushes stale — the async-PS trade-off (reference Async
+        communicator semantics). Call step() directly for the
+        one-step-stale synchronous profile."""
+        # materialize ONCE: a generator would silently yield zero work
+        # on every epoch after the first
+        work = list(batches() if callable(batches) else batches)
+        losses = []
+        stop = threading.Event()
+        for _ in range(int(epochs)):
+            q: queue.Queue = queue.Queue(maxsize=2)
+
+            def producer():
+                for (keys, lod, feats, labels) in work:
+                    if stop.is_set():
+                        return
+                    k = np.ascontiguousarray(keys, np.uint64).ravel()
+                    with self._net_lock:
+                        rows = self.client.pull_sparse(self.table, k,
+                                                       self.emb_dim)
+                    q.put((k, lod, feats, labels, rows))
+                q.put(None)
+
+            t = threading.Thread(target=producer, daemon=True)
+            t.start()
+            try:
+                while True:
+                    item = q.get()
+                    if item is None:
+                        break
+                    k, lod, feats, labels, rows = item
+                    losses.append(float(np.asarray(
+                        self.step(k, lod, feats, labels, rows=rows))))
+            except BaseException:
+                # unblock the producer (it may be parked in q.put on the
+                # full queue) so the thread and its pulled rows don't
+                # outlive this call
+                stop.set()
+                while True:
+                    try:
+                        q.get_nowait()
+                    except queue.Empty:
+                        break
+                t.join(timeout=10.0)
+                raise
+            t.join()
+            self.flush()
+        return losses
+
+    def dense_state(self):
+        return dict(self._params)
+
+    def write_back(self):
+        """Copy the jitted step's dense params back onto the layer."""
+        import jax
+        lookup = dict(self.model.named_parameters())
+        for k, v in self._params.items():
+            if k in lookup:
+                lookup[k]._data = jax.device_get(v)
