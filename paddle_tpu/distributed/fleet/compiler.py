@@ -746,9 +746,6 @@ def _compile_pipeline_step(layer, optimizer, strategy, mesh):
         return _compile_pipeline_tp_step(layer, optimizer, strategy, mesh,
                                          n_tp, n_sp=n_sp)
     n_ep = int(mesh.shape.get("ep", 1))
-    if n_sp > 1 and n_ep > 1:
-        raise NotImplementedError(
-            "pipeline + sp + ep in one mesh is not supported; pick two")
     sp_block = getattr(layer, "pipeline_block_fn_sp", None)
     ep_block = getattr(layer, "pipeline_block_fn_ep", None)
     _check_pipeline_compat(strategy, mesh,
@@ -779,11 +776,24 @@ def _compile_pipeline_step(layer, optimizer, strategy, mesh):
             raise ValueError(f"{experts} experts not divisible by "
                              f"ep={n_ep}")
         # Switch load-balance aux rides the 1F1B backward slot (blocks
-        # return (h, aux)); routing IS regularized on this path
+        # return (h, aux)); routing IS regularized on this path. With
+        # sp > 1 the block additionally runs ring/Ulysses attention over
+        # the sequence shards (pp x sp x ep — formerly refused)
+        ep_kw = {}
+        if n_sp > 1:
+            heads_ep = getattr(getattr(layer, "cfg", None), "heads", None)
+            if (strategy.sequence_parallel_impl == "ulysses"
+                    and heads_ep is not None and heads_ep % n_sp):
+                raise ValueError(
+                    f"pipeline + ep + ulysses: {heads_ep} attention heads "
+                    f"not divisible by sp={n_sp} (use impl='ring' or "
+                    f"adjust sep_degree)")
+            ep_kw = {"axis_sp": "sp",
+                     "impl": strategy.sequence_parallel_impl}
         block_fn = ep_block(
             axis_ep="ep",
             compute_dtype="bfloat16" if strategy.amp else None,
-            with_aux=True)
+            with_aux=True, **ep_kw)
         ep_specs = layer.block_ep_specs(axis_pp="pp", axis_ep="ep")
 
         def ep_pspec(rel, v):
@@ -798,6 +808,7 @@ def _compile_pipeline_step(layer, optimizer, strategy, mesh):
             stacked=stack_stage_params(blocks_list),
             n_layers=len(blocks_list), stacked_pspec=ep_pspec,
             prog_cls=_PipelineTrainStep, replicated_axes=("ep",),
+            seq_axis="sp" if n_sp > 1 else None,
             aux_from_blocks=True,
             aux_coef=float(getattr(getattr(layer, "cfg", None),
                                    "moe_aux_coef", 0.01)))

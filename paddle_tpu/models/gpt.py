@@ -764,12 +764,19 @@ class GPT(nn.Layer):
         }
 
     def pipeline_block_fn_ep(self, axis_ep="ep", compute_dtype=None,
-                             with_aux=False):
+                             with_aux=False, axis_sp=None, impl="ring"):
         """block_fn for pipeline x expert parallelism: activations are
         REPLICATED across 'ep' members, each member runs only its local
         expert slab (E/n_ep experts of the stacked bank), and one psum
         over 'ep' sums the per-expert contributions — the manual form of
         the GSPMD einsum dispatch in nn/layer/moe.py.
+
+        With `axis_sp` set this is the pp x sp x EP block (formerly an
+        explicit refusal): the stream is the LOCAL sequence shard, the
+        attention is ring/Ulysses over `axis_sp`, each member routes its
+        local tokens with local capacity, and _pp_moe folds the
+        load-balance statistics over 'sp' (exact global aux) while the
+        expert-slab psum stays over 'ep'.
 
         with_aux=True: the block also returns the Switch load-balance
         aux (E * sum_e frac_tokens_e * mean_prob_e, same formula as
@@ -779,6 +786,16 @@ class GPT(nn.Layer):
         if self.cfg.moe_experts <= 0:
             raise ValueError("pipeline_block_fn_ep requires a MoE config "
                              "(GPTConfig.moe_experts > 0)")
+        attn_impl = None
+        if axis_sp is not None:
+            from ..distributed.sequence_parallel import (
+                ring_attention, ulysses_attention)
+            impls = {"ring": ring_attention, "ulysses": ulysses_attention}
+            if impl not in impls:
+                raise ValueError(
+                    f"sequence_parallel impl must be 'ring' or "
+                    f"'ulysses', got {impl!r}")
+            attn_impl = impls[impl]
         D = self.cfg.head_dim
         E = self.cfg.moe_experts
         K = self.cfg.moe_top_k
@@ -808,13 +825,20 @@ class GPT(nn.Layer):
             q = q.reshape(B, T, nh, D)
             k = k.reshape(B, T, nh, D)
             v = v.reshape(B, T, nh, D)
-            s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (1.0 / math.sqrt(D))
-            s = s.astype(jnp.float32)
-            causal = jnp.tril(jnp.ones((T, T), bool))
-            s = jnp.where(causal[None, None], s, -1e30)
-            p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
-            o = jnp.einsum("bhqk,bkhd->bqhd", p, v).reshape(B, T, H) \
-                .astype(jnp.float32)
+            if attn_impl is not None:
+                if cd is not None:   # AMP: ring traffic + matmuls in bf16
+                    q, k, v = q.astype(cd), k.astype(cd), v.astype(cd)
+                o = attn_impl(q, k, v, axis=axis_sp, causal=True) \
+                    .reshape(B, T, H).astype(jnp.float32)
+            else:
+                s = jnp.einsum("bqhd,bkhd->bhqk", q, k) \
+                    * (1.0 / math.sqrt(D))
+                s = s.astype(jnp.float32)
+                causal = jnp.tril(jnp.ones((T, T), bool))
+                s = jnp.where(causal[None, None], s, -1e30)
+                p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+                o = jnp.einsum("bhqk,bkhd->bqhd", p, v).reshape(B, T, H) \
+                    .astype(jnp.float32)
             att = mm(o, bp["attn.proj.weight"]) + bp["attn.proj.bias"]
             h = h + _drop(att, key, 0)
 
@@ -823,7 +847,7 @@ class GPT(nn.Layer):
             N = B * T
             C = max(int(math.ceil(cap_f * N * K / E)), 1)
             y, aux = _pp_moe(h2.reshape(N, H), bp, E, K, C,
-                             axis_ep=axis_ep)
+                             axis_ep=axis_ep, axis_sp=axis_sp)
             out = h + _drop(y.reshape(B, T, H).astype(h.dtype), key, 1)
             # routing is replicated over 'ep' so every member computes
             # the identical aux value
