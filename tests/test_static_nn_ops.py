@@ -290,3 +290,27 @@ def test_tensor_module_alias():
     import paddle_tpu.tensor as pt
     x = pt.to_tensor(np.ones((2, 2), np.float32))
     np.testing.assert_allclose(pt.concat([x, x]).numpy().shape, (4, 2))
+
+
+def test_fleet_optimizer_delegation():
+    """Review r5: fleet.minimize must STEP the optimizer; set_lr must
+    reach through the wrapper to the inner optimizer."""
+    import paddle_tpu.distributed.fleet as fleet
+    import paddle_tpu.optimizer as opt
+
+    lin = paddle.nn.Linear(4, 1)
+    sgd = opt.SGD(learning_rate=0.1, parameters=list(lin.parameters()))
+    wrapped = fleet.distributed_optimizer(sgd)
+    x = paddle.to_tensor(np.ones((8, 4), np.float32))
+    w0 = np.asarray(lin.weight.numpy()).copy()
+    loss = (lin(x) ** 2).mean()
+    fleet.fleet.minimize(loss)
+    w1 = np.asarray(lin.weight.numpy())
+    assert not np.allclose(w0, w1), "minimize did not apply an update"
+
+    fleet.fleet.set_lr(0.025)
+    assert abs(fleet.fleet.get_lr() - 0.025) < 1e-9
+    # the INNER optimizer sees the new lr, not a wrapper shadow
+    got = sgd.get_lr() if hasattr(sgd, "get_lr") else sgd._learning_rate
+    got = got() if callable(got) else got
+    assert abs(float(got) - 0.025) < 1e-9
