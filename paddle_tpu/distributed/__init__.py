@@ -14,6 +14,7 @@ init = init_parallel_env  # paddle.distributed alias surface
 # python/paddle/distributed/__init__.py:40-47 re-exports the fleet
 # dataset family)
 from ..io.data_feed import InMemoryDataset, QueueDataset  # noqa: F401,E402
+from . import cloud_utils  # noqa: F401,E402  (PaddleCloud env discovery)
 
 
 def spawn(func, args=(), nprocs=1, join=True, daemon=False, **options):

@@ -1,0 +1,122 @@
+"""Cloud-environment cluster discovery (reference
+python/paddle/distributed/cloud_utils.py:20 get_cloud_cluster, :101
+get_cluster_and_pod): PaddleCloud exports the cluster topology through
+PADDLE_* env vars; these helpers parse it into a (cluster, pod)
+description the launcher consumes.
+
+TPU-native note: on TPU pods the runtime (GKE/queued resources) plays
+PaddleCloud's role, but the env protocol is kept verbatim so cloud
+launch scripts port over — the same names feed `jax.distributed`
+bootstrap in distributed/env.py."""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import List
+
+__all__ = ["Pod", "Cluster", "get_cloud_cluster", "get_cluster_and_pod",
+           "get_trainers_num"]
+
+
+@dataclasses.dataclass
+class Pod:
+    """One node's slice of the cluster: its rank, address, and the
+    trainer endpoints it hosts (reference distributed/utils.py Pod)."""
+    rank: int
+    addr: str
+    trainer_endpoints: List[str]
+
+    def trainers_num(self) -> int:
+        return len(self.trainer_endpoints)
+
+
+@dataclasses.dataclass
+class Cluster:
+    """All pods (reference distributed/utils.py Cluster)."""
+    pods: List[Pod]
+
+    def trainers_num(self) -> int:
+        return sum(p.trainers_num() for p in self.pods)
+
+    def trainers_endpoints(self) -> List[str]:
+        return [ep for p in self.pods for ep in p.trainer_endpoints]
+
+    def pods_endpoints(self) -> List[str]:
+        return [p.trainer_endpoints[0] for p in self.pods]
+
+
+def _require(name):
+    v = os.getenv(name)
+    if v is None:
+        raise RuntimeError(
+            f"{name} should not be None — the cloud launcher exports it "
+            "(reference cloud_utils.get_cloud_cluster asserts the same)")
+    return v
+
+
+def get_cloud_cluster(args_node_ips=None, args_node_ip=None,
+                      args_port=6170, selected_devices=None):
+    """Build the Cluster/Pod pair from the PaddleCloud env protocol:
+    PADDLE_TRAINERS (node ip list), POD_IP, PADDLE_TRAINER_ID,
+    TRAINER_PORTS_NUM (ports per node). `selected_devices` sizes the
+    per-node trainer count (defaults to one per port)."""
+    import warnings
+
+    node_ips = _require("PADDLE_TRAINERS").split(",")
+    node_ip = _require("POD_IP")
+    node_rank = int(_require("PADDLE_TRAINER_ID"))
+    if selected_devices:
+        n_per_node = len(selected_devices)
+    else:
+        n_per_node = int(_require("TRAINER_PORTS_NUM"))
+    base_port = int(args_port or 6170)
+    # the reference warns when launch args disagree with the cloud env
+    # (env wins); keep that diagnostic rather than silently ignoring
+    if args_node_ips and (sorted(str(args_node_ips).split(","))
+                          != sorted(node_ips)):
+        warnings.warn(
+            f"--ips {args_node_ips} differs from PADDLE_TRAINERS "
+            f"{node_ips}; the cloud env wins (reference behavior)")
+    if args_node_ip and args_node_ip != node_ip:
+        warnings.warn(
+            f"--node_ip {args_node_ip} differs from POD_IP {node_ip}; "
+            "the cloud env wins (reference behavior)")
+
+    pods = []
+    for rank, ip in enumerate(node_ips):
+        eps = [f"{ip}:{base_port + i}" for i in range(n_per_node)]
+        pods.append(Pod(rank=rank, addr=ip, trainer_endpoints=eps))
+    cluster = Cluster(pods=pods)
+    if node_ip not in node_ips or node_rank >= len(pods):
+        raise RuntimeError(
+            f"POD_IP {node_ip} / PADDLE_TRAINER_ID {node_rank} not "
+            f"consistent with PADDLE_TRAINERS {node_ips}")
+    return cluster, pods[node_rank]
+
+
+def get_trainers_num() -> int:
+    """PADDLE_TRAINERS_NUM with a single-node default (reference
+    cloud_utils._get_trainers_num)."""
+    return int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+
+
+def get_cluster_and_pod(args):
+    """The launch-time entry (reference cloud_utils.get_cluster_and_pod):
+    cloud env wins when present, else a single-node cluster from args
+    (args needs .node_ip/.port/.selected_devices attrs or dict keys)."""
+    def _arg(name, default=None):
+        if isinstance(args, dict):
+            return args.get(name, default)
+        return getattr(args, name, default)
+
+    if os.getenv("PADDLE_TRAINERS"):
+        return get_cloud_cluster(
+            _arg("node_ips"), _arg("node_ip"), _arg("port", 6170),
+            _arg("selected_devices"))
+    ip = _arg("node_ip", "127.0.0.1")
+    port = int(_arg("port", 6170))
+    devices = _arg("selected_devices") or [0]
+    pod = Pod(rank=0, addr=ip,
+              trainer_endpoints=[f"{ip}:{port + i}"
+                                 for i in range(len(devices))])
+    return Cluster(pods=[pod]), pod

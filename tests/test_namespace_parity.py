@@ -33,12 +33,6 @@ EXPECTED_ABSENT = {
         # utils/plot.py); no display stack in this build
         "plot",
     },
-    "distributed": {
-        # torch-style single-node launch module alias (reference maps
-        # `paddle.distributed.launch` onto fleet.launch at import); the
-        # launcher here is paddle_tpu.distributed.launch_mod's CLI
-        "cloud_utils",
-    },
     "utils": {
         # reference lists these in utils/__init__ imports; internal
         # version-DB tooling tied to the op proto registry

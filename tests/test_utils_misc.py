@@ -17,3 +17,54 @@ def test_eager_dispatch_overhead_gate():
     assert set(us) == {"add", "matmul", "layer_norm"}
     for op, v in us.items():
         assert v < 5000.0, f"eager {op} dispatch {v:.0f} us/op (regressed?)"
+
+
+def test_cloud_utils_cluster_discovery(monkeypatch):
+    """reference distributed/cloud_utils.py: the PaddleCloud env protocol
+    parses into (Cluster, Pod); single-node fallback without it."""
+    from paddle_tpu.distributed import cloud_utils as cu
+
+    monkeypatch.setenv("PADDLE_TRAINERS", "10.0.0.1,10.0.0.2")
+    monkeypatch.setenv("POD_IP", "10.0.0.2")
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "1")
+    monkeypatch.setenv("TRAINER_PORTS_NUM", "2")
+    cluster, pod = cu.get_cloud_cluster(args_port=7000)
+    assert cluster.trainers_num() == 4
+    assert pod.rank == 1 and pod.addr == "10.0.0.2"
+    assert pod.trainer_endpoints == ["10.0.0.2:7000", "10.0.0.2:7001"]
+    assert cluster.trainers_endpoints()[0] == "10.0.0.1:7000"
+
+    monkeypatch.delenv("PADDLE_TRAINERS")
+    cluster2, pod2 = cu.get_cluster_and_pod(
+        {"node_ip": "127.0.0.1", "port": 6170,
+         "selected_devices": [0, 1]})
+    assert cluster2.trainers_num() == 2 and pod2.rank == 0
+
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "8")
+    assert cu.get_trainers_num() == 8
+
+
+def test_cloud_utils_validation(monkeypatch):
+    """Review r5: bad rank/ip must raise the diagnostic error (not
+    IndexError / silent wrong pod); TRAINER_PORTS_NUM only required
+    when selected_devices doesn't size the node."""
+    import pytest as _pytest
+
+    from paddle_tpu.distributed import cloud_utils as cu
+
+    monkeypatch.setenv("PADDLE_TRAINERS", "10.0.0.1,10.0.0.2")
+    monkeypatch.setenv("POD_IP", "10.0.0.1")
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "5")
+    monkeypatch.setenv("TRAINER_PORTS_NUM", "1")
+    with _pytest.raises(RuntimeError, match="not consistent"):
+        cu.get_cloud_cluster()
+
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+    monkeypatch.setenv("POD_IP", "10.9.9.9")
+    with _pytest.raises(RuntimeError, match="not consistent"):
+        cu.get_cloud_cluster()
+
+    monkeypatch.setenv("POD_IP", "10.0.0.1")
+    monkeypatch.delenv("TRAINER_PORTS_NUM")
+    cluster, pod = cu.get_cloud_cluster(selected_devices=[0, 1])
+    assert pod.trainers_num() == 2     # sized by devices, no ports env
