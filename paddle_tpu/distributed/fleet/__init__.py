@@ -254,6 +254,7 @@ def stop_server():
 
 from . import utils            # noqa: E402,F401  (LocalFS/HDFSClient/...)
 from . import data_generator   # noqa: E402
+from . import dataset          # noqa: E402,F401  (MultiSlot readers)
 from .data_generator import (MultiSlotDataGenerator,         # noqa: E402
                              MultiSlotStringDataGenerator)
 

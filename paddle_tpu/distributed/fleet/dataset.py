@@ -1,0 +1,8 @@
+"""paddle.distributed.fleet.dataset (reference fleet/dataset/
+__init__.py re-exports the dataset family): the MultiSlot readers live
+in io.data_feed; this is the fleet-path import surface."""
+from ...io.data_feed import (InMemoryDataset, QueueDataset,  # noqa: F401
+                             Slot, parse_multi_slot_line)
+
+__all__ = ["InMemoryDataset", "QueueDataset", "Slot",
+           "parse_multi_slot_line"]

@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["sum", "max", "min", "auc", "mae", "rmse", "acc"]
+__all__ = ["sum", "max", "min", "auc", "mae", "mse", "rmse", "acc"]
 
 _pysum, _pymax, _pymin = sum, max, min
 
@@ -75,3 +75,11 @@ def acc(correct, total, scope=None, util=None):
     c = float(_allreduce(correct, "sum"))
     t = float(_allreduce(total, "sum"))
     return c / _pymax(t, 1.0)
+
+
+def mse(sqrerr, total_ins_num, scope=None, util=None):
+    """Global mean squared error (reference metrics.mse:323):
+    allreduce(sq err sum) / allreduce(n)."""
+    err = float(_allreduce(sqrerr, "sum"))
+    n = float(_allreduce(total_ins_num, "sum"))
+    return err / _pymax(n, 1.0)

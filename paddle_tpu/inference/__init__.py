@@ -13,7 +13,8 @@ import numpy as np
 
 from .. import jit as jit_mod
 
-__all__ = ["Config", "Predictor", "create_predictor"]
+__all__ = ["Config", "Predictor", "create_predictor",
+           "PredictorPool", "get_version", "get_num_bytes_of_data_type"]
 
 
 class Config:
@@ -147,3 +148,45 @@ class PrecisionType(_enum.Enum):
 
 
 from ..core.tensor import Tensor  # noqa: F401,E402  (handle type parity)
+
+
+def get_version() -> str:
+    """Inference-library version string (reference paddle_infer
+    get_version — the AnalysisPredictor build tag); here the framework
+    version."""
+    from .. import __version__
+    return __version__
+
+
+def get_num_bytes_of_data_type(dtype) -> int:
+    """Byte width of a paddle_infer DataType (reference
+    get_num_bytes_of_data_type)."""
+    sizes = {DataType.FLOAT32: 4, DataType.INT64: 8, DataType.INT32: 4,
+             DataType.UINT8: 1, DataType.INT8: 1, DataType.FLOAT16: 2,
+             DataType.BFLOAT16: 2}
+    if dtype not in sizes:
+        raise ValueError(f"unknown inference DataType: {dtype!r}")
+    return sizes[dtype]
+
+
+class PredictorPool:
+    """A pool of Predictors over one Config (reference PredictorPool:
+    thread-per-predictor serving). Each retrieve(i) slot holds its own
+    Predictor instance — independent input/output bindings — while the
+    deserialized program weights are shared through jit.load's arrays."""
+
+    def __init__(self, config: Config, size: int = 1):
+        if size < 1:
+            raise ValueError("PredictorPool size must be >= 1")
+        self._preds = [Predictor(config) for _ in range(int(size))]
+
+    def retrieve(self, idx: int) -> Predictor:
+        if not 0 <= idx < len(self._preds):
+            raise IndexError(
+                f"PredictorPool.retrieve: idx {idx} out of range "
+                f"[0, {len(self._preds)}) — the reference pool rejects "
+                "out-of-range handles the same way")
+        return self._preds[idx]
+
+    def __len__(self):
+        return len(self._preds)

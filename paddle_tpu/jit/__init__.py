@@ -43,7 +43,7 @@ __all__ = ["to_static", "save", "load", "TranslatedLayer", "not_to_static",
 
 # the conversion-pass module under its reference name (python/paddle/
 # jit/__init__.py imports `from . import dy2static`)
-from . import ast_transform as dy2static  # noqa: E402
+from . import dy2static  # noqa: E402
 
 
 def _spec_to_aval(spec, sym_ctx):

@@ -1,0 +1,15 @@
+"""paddle.jit.dy2static — the conversion-pass module under its reference
+import path (python/paddle/jit/dy2static; the transformer stack). The
+implementation is ast_transform; this module makes
+`import paddle.jit.dy2static` port unchanged."""
+from .ast_transform import (convert_function, convert_target,  # noqa: F401
+                            enable_translation, maybe_convert,
+                            translation_enabled)
+
+# reference transformer-stack submodules (jit/dy2static/
+# {convert_operators,convert_call_func,variable_trans_func}.py): the
+# runtime combinators (__jst_cond/__jst_while/_jst_range + the scope
+# machinery) all live in ast_transform; the names alias it
+from . import ast_transform as convert_operators  # noqa: E402,F401
+from . import ast_transform as convert_call_func  # noqa: E402,F401
+from . import ast_transform as variable_trans_func  # noqa: E402,F401

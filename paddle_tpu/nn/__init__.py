@@ -1,6 +1,7 @@
 """paddle.nn parity surface (reference: python/paddle/nn/__init__.py)."""
 from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
+from . import utils  # noqa: F401  (weight_norm_hook import path)
 from .layer.activation import *   # noqa: F401,F403
 from .layer.common import *      # noqa: F401,F403
 from .layer.container import *   # noqa: F401,F403

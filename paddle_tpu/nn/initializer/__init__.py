@@ -175,3 +175,65 @@ def calculate_gain(nonlinearity, param=None):
         a = 0.01 if param is None else param
         return math.sqrt(2.0 / (1 + a ** 2))
     return gains.get(nonlinearity, 1.0)
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsample kernel init for transposed convs (reference
+    python/paddle/nn/initializer — fluid BilinearInitializer /
+    bilinear_init_op semantics): weight [C_out, C_in, kH, kW] filled
+    with the separable triangle kernel so a stride-s deconv performs
+    bilinear interpolation."""
+
+    def __call__(self, shape, dtype):
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer expects a 4-D conv "
+                             f"weight, got shape {tuple(shape)}")
+        kh, kw = int(shape[2]), int(shape[3])
+
+        def tri(k):
+            # reference formula (fluid/initializer.py BilinearInitializer
+            # :805): f = ceil(k/2), c = (2f - 1 - f%2) / (2f),
+            # w[x] = 1 - |x/f - c| — odd sizes differ from the naive
+            # centered triangle
+            f = math.ceil(k / 2)
+            c = (2 * f - 1 - f % 2) / (2.0 * f)
+            return 1.0 - np.abs(np.arange(k) / f - c)
+
+        kern = np.outer(tri(kh), tri(kw)).astype(np.float32)
+        out = np.zeros(shape, np.float32)
+        out[...] = kern                       # every (oc, ic) plane
+        return jnp.asarray(out, dtype)
+
+
+# global default initializers (reference nn/initializer
+# set_global_initializer): consumed by Layer.create_parameter when
+# neither the ParamAttr nor the layer supplies one
+_GLOBAL_INIT = {"weight": None, "bias": None}
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Override the framework-wide default weight/bias initializers
+    (reference set_global_initializer). Pass None to restore the
+    built-in defaults (XavierNormal / Constant(0))."""
+    if weight_init is not None and not callable(weight_init):
+        raise TypeError("weight_init must be an Initializer or None")
+    if bias_init is not None and not callable(bias_init):
+        raise TypeError("bias_init must be an Initializer or None")
+    _GLOBAL_INIT["weight"] = weight_init
+    _GLOBAL_INIT["bias"] = bias_init
+
+
+def _global_default(is_bias):
+    return _GLOBAL_INIT["bias" if is_bias else "weight"]
+
+
+# reference submodule import paths (nn/initializer/{constant,normal,
+# uniform,xavier,kaiming,assign}.py): the classes all live in this one
+# module; the names alias it so `initializer.xavier.XavierNormal`-style
+# references resolve
+import sys as _sys                                         # noqa: E402
+constant = normal = uniform = xavier = kaiming = assign = \
+    _sys.modules[__name__]
+
+__all__ += ["Bilinear", "set_global_initializer", "constant", "normal",
+            "uniform", "xavier", "kaiming", "assign"]

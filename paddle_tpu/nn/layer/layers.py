@@ -46,7 +46,11 @@ class Layer:
         if attr is False:
             return None
         dtype = dtype_mod.convert_dtype(dtype) or self._dtype
-        init = attr.initializer or default_initializer
+        # precedence (reference set_global_initializer contract): an
+        # explicit ParamAttr initializer wins; then the global override;
+        # then the layer's own default; then the built-ins
+        init = attr.initializer or I._global_default(is_bias) \
+            or default_initializer
         if init is None:
             init = I.Constant(0.0) if is_bias else I.XavierNormal()
         data = init(tuple(int(s) for s in shape), dtype)

@@ -1,0 +1,9 @@
+"""paddle.nn.utils (reference python/paddle/nn/utils/__init__.py:15-16:
+weight_norm_hook module + weight_norm/remove_weight_norm): the
+reparameterization utilities live on the layer package; this is the
+reference's import path for them."""
+from ..layer import weight_norm_hook  # noqa: F401
+from ..layer.weight_norm_hook import (weight_norm,  # noqa: F401
+                                      remove_weight_norm)
+
+__all__ = ["weight_norm_hook", "weight_norm", "remove_weight_norm"]

@@ -14,7 +14,8 @@ from . import nn  # noqa: F401  (cond/case/switch_case/while_loop)
 from .compat import *  # noqa: F401,F403
 from ..legacy_alias import create_global_var, create_parameter  # noqa: F401
 from .compat import __all__ as _compat_all
-from .. import amp  # noqa: F401  (reference static re-exports amp)
+from . import amp  # noqa: F401  (static/amp.py: the amp surface
+# + the reference's mixed_precision/bf16 sub-names)
 
 __all__ = ["InputSpec", "nn", "data", "amp"] + list(_compat_all)
 

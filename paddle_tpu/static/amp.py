@@ -1,0 +1,10 @@
+"""paddle.static.amp — the reference re-exports the amp surface under
+static (python/paddle/static/amp/__init__.py); one implementation
+serves both paths here."""
+from ..amp import *  # noqa: F401,F403
+from ..amp import auto_cast, decorate, GradScaler  # noqa: F401
+
+# reference layout: static.amp re-exports fluid.contrib.mixed_precision
+# (+ its bf16 sub-package); one amp implementation serves every path
+from .. import amp as mixed_precision  # noqa: E402,F401
+from .. import amp as bf16  # noqa: E402,F401

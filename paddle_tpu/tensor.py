@@ -11,3 +11,7 @@ from .core.tensor import Tensor, to_tensor  # noqa: F401
 
 __all__ = [n for n in dir(_ops) if not n.startswith("_")] + \
     ["Tensor", "to_tensor"]
+
+# reference paddle/tensor/__init__.py exports these two beyond the op
+# library surface
+from .legacy_alias import shape, shard_index  # noqa: F401,E402

@@ -380,6 +380,4 @@ class ViterbiDecoder:
                               self.include_bos_eos_tag)
 
 
-import sys as _sys
-
-datasets = _sys.modules[__name__]  # reference alias: paddle.text.datasets
+from . import datasets  # noqa: E402  (text/datasets.py submodule)

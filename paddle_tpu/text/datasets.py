@@ -1,0 +1,14 @@
+"""paddle.text.datasets — the dataset classes under their reference
+import path (python/paddle/text/datasets/__init__.py); implementations
+live in the text package root."""
+from . import (Conll05st, Imdb, Imikolov, Movielens,  # noqa: F401
+               UCIHousing, WMT14, WMT16)
+
+__all__ = ["Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing",
+           "WMT14", "WMT16"]
+
+# reference per-dataset submodules (text/datasets/{imdb,wmt16,...}.py):
+# all classes live in this one module; the names alias it
+import sys as _sys                                         # noqa: E402
+conll05 = imdb = imikolov = movielens = uci_housing = wmt14 = wmt16 = \
+    _sys.modules[__name__]

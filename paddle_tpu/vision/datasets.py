@@ -379,3 +379,10 @@ class ImageFolder(Dataset):
 
     def __len__(self):
         return len(self.samples)
+
+
+# reference package layout (vision/datasets/{mnist,cifar,flowers,folder,
+# voc2012}.py): the classes live in this one module; the names alias it
+# so `paddle.vision.datasets.mnist.MNIST`-style paths resolve
+import sys as _sys                                         # noqa: E402
+mnist = cifar = flowers = folder = voc2012 = _sys.modules[__name__]

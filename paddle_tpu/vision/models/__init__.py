@@ -10,3 +10,9 @@ __all__ = ["LeNet", "ResNet", "resnet18", "resnet34", "resnet50",
            "resnet101", "resnet152", "VGG", "vgg11", "vgg13", "vgg16",
            "vgg19", "MobileNetV1", "MobileNetV2", "mobilenet_v1",
            "mobilenet_v2"]
+
+# reference submodule import paths (vision/models/{mobilenetv1,
+# mobilenetv2}.py — one mobilenet module here carries both families)
+from . import mobilenet as mobilenetv1  # noqa: E402
+from . import mobilenet as mobilenetv2  # noqa: E402
+__all__ += ["mobilenetv1", "mobilenetv2"]

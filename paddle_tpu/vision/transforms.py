@@ -407,3 +407,11 @@ class Grayscale(BaseTransform):
                     + 0.114 * img[:, :, 2]).astype(np.float32)[:, :, None]
         out = np.repeat(gray, self.num_output_channels, axis=2)
         return out.astype(img.dtype)
+
+
+# reference package layout (vision/transforms/__init__.py imports the
+# `transforms` and `functional` submodules): one module carries both
+# the transform classes and the functional verbs here; the aliases keep
+# `paddle.vision.transforms.functional.resize`-style paths working
+import sys as _sys                                         # noqa: E402
+transforms = functional = _sys.modules[__name__]
