@@ -40,8 +40,37 @@ def peak_flops():
     return 197e12
 
 
+def _devices_or_cpu_fallback():
+    """Probe the accelerator backend BEFORE any framework import touches
+    it. When init fails (no TPU attached, driver unavailable), re-exec
+    once with JAX_PLATFORMS=cpu so the bench still runs in smoke mode
+    and emits its JSON line; if even CPU init fails, emit an error JSON
+    (rc 0) so the harness gets a parseable result instead of a
+    traceback."""
+    import jax
+    try:
+        return jax.devices()
+    except Exception as e:                      # backend init failure
+        if os.environ.get("_PADDLE_TPU_BENCH_CPU_FALLBACK"):
+            print(json.dumps({"metric": "bench_backend_error",
+                              "value": 0.0, "unit": "tokens/s",
+                              "vs_baseline": 0.0,
+                              "error": str(e).split("\n")[0]}))
+            sys.exit(0)
+        sys.stderr.write(
+            f"bench: accelerator backend failed to initialize ({e!r}); "
+            "retrying on CPU (JAX_PLATFORMS=cpu)\n")
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   _PADDLE_TPU_BENCH_CPU_FALLBACK="1")
+        os.execve(sys.executable,
+                  [sys.executable, os.path.abspath(__file__)]
+                  + sys.argv[1:], env)
+
+
 def main():
     import jax
+
+    _devices_or_cpu_fallback()
 
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn

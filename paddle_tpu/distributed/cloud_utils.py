@@ -59,7 +59,12 @@ def get_cloud_cluster(args_node_ips=None, args_node_ip=None,
     """Build the Cluster/Pod pair from the PaddleCloud env protocol:
     PADDLE_TRAINERS (node ip list), POD_IP, PADDLE_TRAINER_ID,
     TRAINER_PORTS_NUM (ports per node). `selected_devices` sizes the
-    per-node trainer count (defaults to one per port)."""
+    per-node trainer count (defaults to one per port).
+
+    Endpoint precedence matches the reference (cloud_utils.py:53-60):
+    DISTRIBUTED_TRAINER_ENDPOINTS, when the cloud exports it, IS the
+    endpoint list (the ports the cloud actually allocated); otherwise
+    ports are synthesized from PADDLE_PORT, falling back to args_port."""
     import warnings
 
     node_ips = _require("PADDLE_TRAINERS").split(",")
@@ -69,7 +74,7 @@ def get_cloud_cluster(args_node_ips=None, args_node_ip=None,
         n_per_node = len(selected_devices)
     else:
         n_per_node = int(_require("TRAINER_PORTS_NUM"))
-    base_port = int(args_port or 6170)
+    base_port = int(os.getenv("PADDLE_PORT") or args_port or 6170)
     # the reference warns when launch args disagree with the cloud env
     # (env wins); keep that diagnostic rather than silently ignoring
     if args_node_ips and (sorted(str(args_node_ips).split(","))
@@ -82,9 +87,23 @@ def get_cloud_cluster(args_node_ips=None, args_node_ip=None,
             f"--node_ip {args_node_ip} differs from POD_IP {node_ip}; "
             "the cloud env wins (reference behavior)")
 
+    ep_env = os.getenv("DISTRIBUTED_TRAINER_ENDPOINTS")
+    if ep_env:
+        # cloud-allocated endpoints: n_per_node consecutive entries per
+        # node, in PADDLE_TRAINERS order (reference layout)
+        eps_all = [e.strip() for e in ep_env.split(",") if e.strip()]
+        if len(eps_all) != len(node_ips) * n_per_node:
+            raise RuntimeError(
+                f"DISTRIBUTED_TRAINER_ENDPOINTS has {len(eps_all)} "
+                f"entries, want {len(node_ips)} nodes x {n_per_node} "
+                "trainers")
+        chunks = [eps_all[i * n_per_node:(i + 1) * n_per_node]
+                  for i in range(len(node_ips))]
+    else:
+        chunks = [[f"{ip}:{base_port + i}" for i in range(n_per_node)]
+                  for ip in node_ips]
     pods = []
-    for rank, ip in enumerate(node_ips):
-        eps = [f"{ip}:{base_port + i}" for i in range(n_per_node)]
+    for rank, (ip, eps) in enumerate(zip(node_ips, chunks)):
         pods.append(Pod(rank=rank, addr=ip, trainer_endpoints=eps))
     cluster = Cluster(pods=pods)
     if node_ip not in node_ips or node_rank >= len(pods):

@@ -12,35 +12,33 @@ sharded checkpoint resume (io/checkpoint.py). Two layers:
   are expected to resume from their newest checkpoint on startup.
 
 * run_with_recovery() — in-process: drive a step function with periodic
-  checkpoints; on a transient failure, reload the newest checkpoint and
-  continue. Useful for single-process training and as the body of each
-  supervised trainer.
+  checkpoints; on a transient failure, reload the newest VALID
+  checkpoint and continue. A checkpoint that fails manifest validation
+  or restore (torn write, corrupt shard) is skipped with a warning and
+  the next older one is tried — a worker loss degrades to a one-step
+  rollback, never a corrupt-state resume (docs/fault_tolerance.md).
 """
 from __future__ import annotations
 
 import os
 import time
+import warnings
 from typing import Callable, Optional
+
+from ..testing import chaos
+from ..utils.retry import backoff_delays
+from ..io.checkpoint import (CheckpointError, gc_checkpoints,
+                             latest_checkpoint as _latest_valid,
+                             list_checkpoints, validate_checkpoint)
 
 __all__ = ["supervise", "run_with_recovery", "latest_checkpoint"]
 
 
 def latest_checkpoint(ckpt_dir: str) -> Optional[str]:
     """Newest step-numbered checkpoint directory under ckpt_dir
-    (save_checkpoint targets named `step_{n}`)."""
-    if not os.path.isdir(ckpt_dir):
-        return None
-    best, best_step = None, -1
-    for name in os.listdir(ckpt_dir):
-        if name.startswith("step_"):
-            try:
-                s = int(name.split("_", 1)[1])
-            except ValueError:
-                continue
-            if s > best_step and os.path.exists(
-                    os.path.join(ckpt_dir, name, "meta.json")):
-                best, best_step = os.path.join(ckpt_dir, name), s
-    return best
+    (save_checkpoint targets named `step_{n}`) whose manifest validates
+    — a half-written or corrupt step is skipped, never selected."""
+    return _latest_valid(ckpt_dir)
 
 
 def supervise(start_gang: Callable[[], list], max_restarts: int = 3,
@@ -48,9 +46,13 @@ def supervise(start_gang: Callable[[], list], max_restarts: int = 3,
     """Launcher-level gang supervision: `start_gang()` launches the
     trainer processes (e.g. a start_local_trainers closure); any nonzero
     exit tears the gang down and relaunches it, up to max_restarts.
-    Returns 0 on success; raises after exhausting restarts."""
+    Restarts back off exponentially (base `backoff_s`, jittered) so a
+    crash-looping gang doesn't hammer the rendezvous store. Returns 0 on
+    success; raises after exhausting restarts."""
     from .launch import watch_local_trainers
 
+    delays = backoff_delays(max_restarts, base_delay=backoff_s,
+                            max_delay=8 * backoff_s)
     attempt = 0
     while True:
         procs = start_gang()
@@ -61,7 +63,24 @@ def supervise(start_gang: Callable[[], list], max_restarts: int = 3,
             if attempt > max_restarts:
                 raise RuntimeError(
                     f"gang failed {attempt} times; giving up") from e
-            time.sleep(backoff_s)
+            time.sleep(next(delays))
+
+
+def _restore_newest_valid(restore_fn, ckpt_dir):
+    """Try checkpoints newest-first; one that fails validation or whose
+    restore raises is skipped (warned), falling back to the previous
+    step. Raises CheckpointError when nothing loads."""
+    last_err = None
+    for step, path in list_checkpoints(ckpt_dir):
+        try:
+            validate_checkpoint(path)
+            return restore_fn(path)
+        except Exception as e:          # noqa: BLE001 - any load fault
+            last_err = e
+            warnings.warn(f"checkpoint {path} unusable ({e}); "
+                          "falling back to previous step")
+    raise CheckpointError(
+        f"no loadable checkpoint under {ckpt_dir}") from last_err
 
 
 def run_with_recovery(step_fn: Callable[[int], None],
@@ -69,34 +88,45 @@ def run_with_recovery(step_fn: Callable[[int], None],
                       restore_fn: Callable[[str], int],
                       ckpt_dir: str, total_steps: int,
                       checkpoint_every: int = 100,
-                      max_restarts: int = 3):
+                      max_restarts: int = 3,
+                      keep_last: int = None,
+                      backoff_s: float = 0.1,
+                      max_backoff_s: float = 5.0):
     """Checkpointed training loop with transient-failure recovery.
 
     step_fn(step)            one training step
     save_fn(path, step)      write a checkpoint (CompiledTrainStep.
                              save_checkpoint fits directly)
     restore_fn(path) -> int  load a checkpoint, return its step
-    On an exception from step_fn the newest checkpoint is restored and
-    the loop continues from there, up to max_restarts times."""
+    On an exception from step_fn (or a failed save) the newest VALID
+    checkpoint is restored — falling back past torn/corrupt steps — and
+    the loop continues from there, up to max_restarts times with
+    jittered exponential backoff between attempts. `keep_last=k` prunes
+    older checkpoints after each successful save."""
     os.makedirs(ckpt_dir, exist_ok=True)
     step = 0
-    ck = latest_checkpoint(ckpt_dir)
-    if ck is not None:
-        step = restore_fn(ck)
+    if latest_checkpoint(ckpt_dir) is not None:
+        step = _restore_newest_valid(restore_fn, ckpt_dir)
     else:
         # initial snapshot: a failure before the first periodic checkpoint
         # must restore pristine state, not replay onto mutated params
         save_fn(os.path.join(ckpt_dir, "step_0"), 0)
     restarts = 0
+    delays = backoff_delays(max_restarts, base_delay=backoff_s,
+                            max_delay=max_backoff_s)
     while step < total_steps:
         try:
+            chaos.maybe_fail("step.fn", f"step={step}")
             step_fn(step)
             step += 1
             if step % checkpoint_every == 0 or step == total_steps:
                 save_fn(os.path.join(ckpt_dir, f"step_{step}"), step)
+                if keep_last:
+                    gc_checkpoints(ckpt_dir, keep_last)
         except Exception:
             restarts += 1
             if restarts > max_restarts:
                 raise
-            step = restore_fn(latest_checkpoint(ckpt_dir))
+            time.sleep(next(delays))
+            step = _restore_newest_valid(restore_fn, ckpt_dir)
     return step

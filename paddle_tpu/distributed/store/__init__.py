@@ -21,10 +21,19 @@ import struct
 import subprocess
 import time
 
+from ...testing import chaos
+from ...utils.retry import (WatchdogTimeout, backoff_delays,
+                            call_with_watchdog)
+
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "native")
 
-__all__ = ["Store", "TCPStore", "FileStore"]
+__all__ = ["Store", "TCPStore", "FileStore", "BarrierTimeout"]
+
+
+class BarrierTimeout(TimeoutError):
+    """A store barrier did not release within its wall-clock bound
+    (missing peer, wedged server, or lost release key)."""
 
 _UNSET = object()   # wait(): distinguish "omitted" from "None = forever"
 
@@ -73,12 +82,32 @@ class Store:
 
         Reusable: the arrival counter only ever grows; each round of
         `world_size` arrivals releases its own epoch key, so calling the
-        same barrier name every training step keeps synchronizing."""
-        n = self.add(f"__barrier__/{name}/count", 1)
-        epoch = (n - 1) // world_size
-        if n == (epoch + 1) * world_size:   # last arrival of this round
-            self.set(f"__barrier__/{name}/go/{epoch}", b"1")
-        self.wait(f"__barrier__/{name}/go/{epoch}", timeout=timeout)
+        same barrier name every training step keeps synchronizing.
+
+        With a finite `timeout` the whole arrival runs under a
+        wall-clock watchdog (utils.retry.call_with_watchdog): even if a
+        store RPC wedges past its own deadline, the caller gets a typed
+        `BarrierTimeout` instead of blocking forever (the abandoned
+        worker thread is a daemon and dies with the process)."""
+
+        def _arrive():
+            n = self.add(f"__barrier__/{name}/count", 1)
+            epoch = (n - 1) // world_size
+            if n == (epoch + 1) * world_size:  # last arrival of the round
+                self.set(f"__barrier__/{name}/go/{epoch}", b"1")
+            self.wait(f"__barrier__/{name}/go/{epoch}", timeout=timeout)
+
+        if timeout is None:
+            return _arrive()
+        try:
+            # small grace over the inner wait deadline so the watchdog
+            # only fires when a call truly hangs past its own timeout
+            call_with_watchdog(_arrive, timeout + 5.0,
+                               what=f"barrier {name!r}")
+        except (WatchdogTimeout, TimeoutError) as e:
+            raise BarrierTimeout(
+                f"barrier {name!r} (world_size={world_size}, rank={rank}) "
+                f"not released within {timeout}s") from e
 
     def delete_barrier(self, name: str, max_epochs: int = 1):
         """Reclaim a barrier's keys (the schema is private to this class).
@@ -93,14 +122,21 @@ class TCPStore(Store):
     """Client for the native tcp_store server; `TCPStore.start()` also
     owns a server process (the rank-0 pattern)."""
 
-    def __init__(self, endpoint: str, timeout: float = 60.0):
-        host, port = endpoint.rsplit(":", 1)
+    def __init__(self, endpoint: str, timeout: float = 60.0,
+                 retries: int = None):
         self.endpoint = endpoint
         self._timeout = timeout
-        self._sock = socket.create_connection((host, int(port)),
-                                              timeout=timeout)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._retries = (int(os.environ.get("PADDLE_TPU_STORE_RETRIES", 3))
+                         if retries is None else retries)
+        self._sock = self._connect()
         self._proc = None
+
+    def _connect(self):
+        host, port = self.endpoint.rsplit(":", 1)
+        sock = socket.create_connection((host, int(port)),
+                                        timeout=self._timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return sock
 
     @classmethod
     def start(cls, port: int = 0, timeout: float = 60.0) -> "TCPStore":
@@ -115,15 +151,44 @@ class TCPStore(Store):
         return store
 
     # -- wire --------------------------------------------------------------
-    def _req(self, verb: int, key: str, n: int = 0, payload: bytes = b"",
-             sock=None):
-        sock = sock or self._sock
+    def _req_once(self, verb: int, key: str, n: int, payload: bytes, sock):
+        chaos.maybe_fail("store.req", f"verb={verb} key={key}")
         kb = key.encode()
         sock.sendall(struct.pack("<BIQ", verb, len(kb), n) + kb + payload)
         status = self._recv_exact(1, sock)[0]
         (m,) = struct.unpack("<Q", self._recv_exact(8, sock))
         body = self._recv_exact(m, sock) if m else b""
         return status, body
+
+    def _req(self, verb: int, key: str, n: int = 0, payload: bytes = b"",
+             sock=None):
+        if sock is not None:     # caller-owned socket (WAIT): single shot
+            return self._req_once(verb, key, n, payload, sock)
+        # transient connection faults reconnect + retry with backoff.
+        # Caveat (documented, docs/fault_tolerance.md): a fault after the
+        # request was sent but before the reply retries the verb, so ADD
+        # is at-least-once under retry — rendezvous counters tolerate
+        # over-count (a gang member counted twice releases the barrier
+        # early only for itself to then wait on the next epoch key).
+        delays = backoff_delays(self._retries, base_delay=0.05,
+                                max_delay=1.0)
+        attempt = 0
+        while True:
+            try:
+                if self._sock is None:     # reconnect is retried too
+                    self._sock = self._connect()
+                return self._req_once(verb, key, n, payload, self._sock)
+            except (ConnectionError, TimeoutError, OSError):
+                attempt += 1
+                if attempt > self._retries:
+                    raise
+                if self._sock is not None:
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                    self._sock = None
+                time.sleep(next(delays))
 
     def _recv_exact(self, n: int, sock=None) -> bytes:
         from ...utils.net import recv_exact
@@ -187,7 +252,8 @@ class TCPStore(Store):
     def __exit__(self, *exc):
         if self._proc is not None:
             self.stop_server()
-        self._sock.close()
+        if self._sock is not None:
+            self._sock.close()
 
 
 class FileStore(Store):
@@ -202,6 +268,9 @@ class FileStore(Store):
         return os.path.join(self._dir, key.replace("/", "%2F"))
 
     def set(self, key, value):
+        # chaos site on the mutating verbs only (wait() polls get(), so
+        # arming reads would make injection counts nondeterministic)
+        chaos.maybe_fail("store.req", f"set {key}")
         tmp = self._fn(key) + ".tmp"
         with open(tmp, "wb") as f:
             f.write(bytes(value))
@@ -229,6 +298,7 @@ class FileStore(Store):
     _LOCK_STALE_S = 10.0
 
     def add(self, key, delta=1):
+        chaos.maybe_fail("store.req", f"add {key}")
         # lock via atomic O_EXCL lockfile (NFS-safe enough for rendezvous)
         lock = self._fn(key) + ".lock"
         token = f"{os.getpid()} {time.time_ns()} {id(self)}".encode()

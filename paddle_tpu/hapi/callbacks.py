@@ -151,19 +151,55 @@ class ProgBarLogger(Callback):
 
 
 class ModelCheckpoint(Callback):
-    def __init__(self, save_freq=1, save_dir=None):
+    """Periodic checkpointing with an atomic publish and retention.
+
+    Model.save writes `{path}.pdparams` (+ `.pdopt`); saving straight to
+    the final prefix means a crash mid-write leaves a truncated pickle
+    under the name a resume would load. Instead each save goes to a
+    `.tmp` prefix and rename-publishes — `.pdopt` first, `.pdparams`
+    last, so the params file (the one load() requires) only appears once
+    its optimizer twin is in place. `keep_last=k` prunes older epoch
+    checkpoints ('final'/'best_model' are never pruned). The full
+    fsync+checksum protocol lives in io/checkpoint.py
+    (docs/fault_tolerance.md); this callback covers the hapi pickle
+    format with the same commit-by-rename discipline."""
+
+    def __init__(self, save_freq=1, save_dir=None, keep_last=None):
         super().__init__()
         self.save_freq = save_freq
         self.save_dir = save_dir
+        self.keep_last = keep_last
+
+    def _atomic_save(self, path):
+        tmp = path + ".tmp"
+        self.model.save(tmp)
+        # publish order: params LAST = commit point
+        for ext in (".pdopt", ".pdparams"):
+            if os.path.exists(tmp + ext):
+                os.replace(tmp + ext, path + ext)
+
+    def _gc(self):
+        if not self.keep_last or not os.path.isdir(self.save_dir):
+            return
+        epochs = sorted({int(f.split(".")[0])
+                         for f in os.listdir(self.save_dir)
+                         if f.split(".")[0].isdigit()
+                         and f.endswith((".pdparams", ".pdopt"))})
+        for e in epochs[:-self.keep_last] if len(epochs) > self.keep_last \
+                else []:
+            for ext in (".pdparams", ".pdopt"):
+                p = os.path.join(self.save_dir, f"{e}{ext}")
+                if os.path.exists(p):
+                    os.unlink(p)
 
     def on_epoch_end(self, epoch, logs=None):
         if self.model and self.save_dir and (epoch + 1) % self.save_freq == 0:
-            path = os.path.join(self.save_dir, f"{epoch}")
-            self.model.save(path)
+            self._atomic_save(os.path.join(self.save_dir, f"{epoch}"))
+            self._gc()
 
     def on_train_end(self, logs=None):
         if self.model and self.save_dir:
-            self.model.save(os.path.join(self.save_dir, "final"))
+            self._atomic_save(os.path.join(self.save_dir, "final"))
 
 
 class LRScheduler(Callback):

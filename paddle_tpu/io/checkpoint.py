@@ -1,5 +1,7 @@
 """Sharded checkpointing: save/restore pytrees of (possibly sharded) jax
-arrays across mesh-shape changes.
+arrays across mesh-shape changes — with an atomic, checksummed commit
+protocol so a preemption mid-save can never produce a checkpoint that
+`latest_checkpoint()` selects but `load_checkpoint()` cannot read.
 
 Reference analog: fluid.io save/load_persistables + save/load ops
 (/root/reference/python/paddle/fluid/io.py:239-995,
@@ -17,22 +19,54 @@ holds a whole array. TPU-native design:
   memmaps — resuming ZeRO-2 on a different dp size re-tiles shards without
   materialising full arrays (beyond the largest per-device slice).
 
+Atomic commit protocol (docs/fault_tolerance.md):
+
+1. everything is written into `{path}.tmp`;
+2. each shard entry records the file's byte size and crc32 in the
+   per-process index; every file (and the directory) is fsynced;
+3. `meta.json` is written LAST, then the directory renames to `{path}`
+   in one atomic step.
+
+A crash at any point leaves either the previous checkpoint untouched
+plus a `.tmp` orphan (garbage-collected by retention), or the complete
+new checkpoint. `latest_checkpoint()` validates manifests and returns
+the newest *valid* step; `gc_checkpoints(keep_last=k)` bounds disk use.
+
 Layout: `{path}/meta.json` + `{path}/{escaped_name}__{offsets}.npy`.
 Nested trees (optimizer slot dicts) flatten with '/' joined keys.
+
+Multi-host note: every process writes into the shared `{path}.tmp`;
+process 0 performs the commit rename. Callers must barrier between the
+last writer finishing and process 0 committing (the fleet compiler's
+save path is single-controller per host group, which already orders
+this); single-process training needs nothing.
 """
 from __future__ import annotations
 
 import json
 import os
 import re
+import shutil
+import warnings
+import zlib
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..testing import chaos
+
 __all__ = ["save_sharded", "load_sharded", "save_checkpoint",
-           "load_checkpoint"]
+           "load_checkpoint", "CheckpointError", "validate_checkpoint",
+           "is_valid_checkpoint", "list_checkpoints", "latest_checkpoint",
+           "gc_checkpoints"]
+
+FORMAT_VERSION = 2      # 1 = pre-checksum (still loadable/validatable)
+
+
+class CheckpointError(RuntimeError):
+    """A checkpoint directory is missing, incomplete, or corrupt."""
 
 
 def _flatten(tree, prefix=""):
@@ -76,14 +110,80 @@ def _spec_from_json(spec_json, ndim):
     return P(*axes)
 
 
-def save_sharded(path, tree, step=0, meta=None):
+# -- integrity plumbing -------------------------------------------------------
+
+def _file_crc32(path) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            crc = zlib.crc32(chunk, crc)
+    return crc & 0xFFFFFFFF
+
+
+def _fsync_file(path):
+    try:
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    except OSError:        # pragma: no cover - fs without fsync support
+        pass
+
+
+def _fsync_dir(path):
+    try:
+        fd = os.open(path, os.O_RDONLY | getattr(os, "O_DIRECTORY", 0))
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    except OSError:        # pragma: no cover
+        pass
+
+
+def _save_npy(dirpath, fname, array) -> dict:
+    """Write one shard file and return its manifest fields. The
+    `ckpt.write` chaos site models a torn/failed shard write."""
+    chaos.maybe_fail("ckpt.write", fname)
+    full = os.path.join(dirpath, fname)
+    np.save(full, array)
+    _fsync_file(full)
+    return {"size": os.path.getsize(full), "crc32": _file_crc32(full)}
+
+
+def _commit_dir(work, final):
+    """Atomically publish `work` as `final`. An existing `final` is
+    renamed aside first so a valid directory exists at every instant."""
+    chaos.maybe_fail("ckpt.rename", final)
+    if os.path.exists(final):
+        aside = final + ".old"
+        shutil.rmtree(aside, ignore_errors=True)
+        os.rename(final, aside)
+        os.rename(work, final)
+        shutil.rmtree(aside, ignore_errors=True)
+    else:
+        os.rename(work, final)
+    _fsync_dir(os.path.dirname(final) or ".")
+
+
+def save_sharded(path, tree, step=0, meta=None, atomic=True):
     """Write a (nested) dict of jax arrays; each process stores only its
     addressable, replica-0 shards and ITS OWN shard index
     (`index.{pid}.json`) — indices merge at load, so no process needs to
-    know about shards it cannot address (multi-host safe)."""
+    know about shards it cannot address (multi-host safe).
+
+    With `atomic` (default), everything goes into `{path}.tmp` and
+    process 0 rename-commits after writing `meta.json` last; per-file
+    sizes + crc32 checksums land in the index so load/validate can
+    reject torn writes."""
     flat = _flatten(tree)
-    os.makedirs(path, exist_ok=True)
+    final = path.rstrip("/")
+    work = final + ".tmp" if atomic else final
     pid = jax.process_index()
+    if atomic and pid == 0:
+        shutil.rmtree(work, ignore_errors=True)   # stale orphan
+    os.makedirs(work, exist_ok=True)
 
     index = {}
     for name, arr in flat.items():
@@ -94,12 +194,12 @@ def save_sharded(path, tree, step=0, meta=None):
                  "shards": []}
         if not hasattr(arr, "addressable_shards") or arr.ndim == 0:
             fname = f"{_escape(name)}__full.npy"
+            shard = {"file": fname, "start": [0] * arr.ndim,
+                     "stop": list(arr.shape)}
             if pid == 0:
-                np.save(os.path.join(path, fname),
-                        np.asarray(jax.device_get(arr)))
-            entry["shards"].append({"file": fname,
-                                    "start": [0] * arr.ndim,
-                                    "stop": list(arr.shape)})
+                shard.update(_save_npy(work, fname,
+                                       np.asarray(jax.device_get(arr))))
+            entry["shards"].append(shard)
         else:
             seen = set()
             for sh in arr.addressable_shards:
@@ -112,18 +212,132 @@ def save_sharded(path, tree, step=0, meta=None):
                 seen.add(starts)
                 fname = (f"{_escape(name)}__"
                          + "_".join(str(s) for s in starts) + ".npy")
-                np.save(os.path.join(path, fname), np.asarray(sh.data))
-                entry["shards"].append({"file": fname,
-                                        "start": list(starts),
-                                        "stop": list(stops)})
+                shard = {"file": fname, "start": list(starts),
+                         "stop": list(stops)}
+                shard.update(_save_npy(work, fname, np.asarray(sh.data)))
+                entry["shards"].append(shard)
         index[name] = entry
 
-    with open(os.path.join(path, f"index.{pid}.json"), "w") as f:
+    idx_path = os.path.join(work, f"index.{pid}.json")
+    with open(idx_path, "w") as f:
         json.dump(index, f, indent=1)
+    _fsync_file(idx_path)
     if pid == 0:
-        with open(os.path.join(path, "meta.json"), "w") as f:
+        meta_path = os.path.join(work, "meta.json")
+        with open(meta_path, "w") as f:
             json.dump({"step": int(step), "meta": meta or {},
+                       "format": FORMAT_VERSION,
                        "n_processes": jax.process_count()}, f, indent=1)
+        _fsync_file(meta_path)
+        _fsync_dir(work)
+        if atomic:
+            _commit_dir(work, final)
+
+
+# -- validation / discovery / retention --------------------------------------
+
+def validate_checkpoint(path, deep=True):
+    """Raise `CheckpointError` unless `path` is a complete checkpoint:
+    parseable meta.json, at least one parseable index, every indexed
+    shard file present with its recorded size — and, with `deep`, its
+    recorded crc32. Pre-checksum (format 1) checkpoints validate on
+    existence alone."""
+    if not os.path.isdir(path):
+        raise CheckpointError(f"{path}: not a directory")
+    try:
+        with open(os.path.join(path, "meta.json")) as f:
+            json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointError(f"{path}: bad meta.json ({e})") from e
+    import glob as _glob
+    idx_files = sorted(_glob.glob(os.path.join(path, "index.*.json")))
+    if not idx_files:
+        raise CheckpointError(f"{path}: no index files")
+    for idx_file in idx_files:
+        try:
+            with open(idx_file) as f:
+                index = json.load(f)
+        except (OSError, ValueError) as e:
+            raise CheckpointError(
+                f"{path}: bad {os.path.basename(idx_file)} ({e})") from e
+        for name, entry in index.items():
+            for sh in entry["shards"]:
+                fp = os.path.join(path, sh["file"])
+                if not os.path.isfile(fp):
+                    raise CheckpointError(
+                        f"{path}: {name} shard {sh['file']} missing")
+                if "size" in sh and os.path.getsize(fp) != sh["size"]:
+                    raise CheckpointError(
+                        f"{path}: {sh['file']} size "
+                        f"{os.path.getsize(fp)} != recorded {sh['size']}")
+                if deep and "crc32" in sh and _file_crc32(fp) != sh["crc32"]:
+                    raise CheckpointError(
+                        f"{path}: {sh['file']} crc mismatch (torn or "
+                        "corrupt write)")
+
+
+def is_valid_checkpoint(path, deep=True) -> bool:
+    try:
+        validate_checkpoint(path, deep=deep)
+        return True
+    except CheckpointError:
+        return False
+
+
+def list_checkpoints(ckpt_dir):
+    """All committed `step_{n}` directories under `ckpt_dir` (no
+    validation), newest step first, as (step, path) pairs. `.tmp`/`.old`
+    work directories never appear."""
+    if not os.path.isdir(ckpt_dir):
+        return []
+    out = []
+    for name in os.listdir(ckpt_dir):
+        if not name.startswith("step_") or "." in name:
+            continue
+        try:
+            s = int(name.split("_", 1)[1])
+        except ValueError:
+            continue
+        out.append((s, os.path.join(ckpt_dir, name)))
+    return sorted(out, reverse=True)
+
+
+def latest_checkpoint(ckpt_dir, validate=True, deep=True):
+    """Newest step-numbered checkpoint under `ckpt_dir` that passes
+    manifest validation (newest first, so at most the broken suffix is
+    scanned); invalid candidates are skipped with a warning. Returns the
+    path, or None."""
+    for step, path in list_checkpoints(ckpt_dir):
+        if not validate:
+            if os.path.exists(os.path.join(path, "meta.json")):
+                return path
+            continue
+        try:
+            validate_checkpoint(path, deep=deep)
+            return path
+        except CheckpointError as e:
+            warnings.warn(f"skipping invalid checkpoint: {e}")
+    return None
+
+
+def gc_checkpoints(ckpt_dir, keep_last, protect=()):
+    """Retention: delete all but the newest `keep_last` committed
+    checkpoints, plus any orphaned `.tmp`/`.old` work directories.
+    Paths in `protect` survive regardless. Best-effort (a half-deleted
+    old step is harmless — it is older than every kept one)."""
+    if not keep_last or not os.path.isdir(ckpt_dir):
+        return
+    protect = {os.path.abspath(p) for p in protect}
+    kept = 0
+    for step, path in list_checkpoints(ckpt_dir):
+        if kept < keep_last:
+            kept += 1                    # protected entries count too
+        elif os.path.abspath(path) not in protect:
+            shutil.rmtree(path, ignore_errors=True)
+    for name in os.listdir(ckpt_dir):
+        if name.startswith("step_") and (name.endswith(".tmp")
+                                         or name.endswith(".old")):
+            shutil.rmtree(os.path.join(ckpt_dir, name), ignore_errors=True)
 
 
 def _read_slice(path, entry, starts, stops, dtype):
@@ -144,15 +358,25 @@ def _read_slice(path, entry, starts, stops, dtype):
     return out
 
 
-def load_sharded(path, mesh: Mesh = None, shardings=None):
+def load_sharded(path, mesh: Mesh = None, shardings=None, validate=True):
     """Restore the tree. With `mesh`, arrays land sharded per their SAVED
     PartitionSpecs re-bound to the new mesh (any device count whose axis
     names match); `shardings` ({flat_name: Sharding}) overrides per array;
     with neither, arrays come back as host-local jnp arrays.
 
+    `validate` (default) verifies the manifest (sizes + checksums) up
+    front and raises `CheckpointError` on a torn or corrupt checkpoint —
+    callers like `elastic.run_with_recovery` catch it and fall back to
+    the previous step.
+
     Returns (tree, step, meta)."""
-    with open(os.path.join(path, "meta.json")) as f:
-        header = json.load(f)
+    if validate:
+        validate_checkpoint(path)
+    try:
+        with open(os.path.join(path, "meta.json")) as f:
+            header = json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointError(f"{path}: bad meta.json ({e})") from e
     # merge every process's shard index (multi-host: each wrote its own)
     arrays = {}
     import glob as _glob
@@ -201,18 +425,26 @@ def load_sharded(path, mesh: Mesh = None, shardings=None):
 # ---------------------------------------------------------------------------
 
 def save_checkpoint(path, params, opt_state=None, state=None, step=0,
-                    meta=None):
+                    meta=None, keep_last=None):
+    """Atomic checkpoint of the train state. With `keep_last=k` and a
+    `step_{n}`-named `path`, older sibling checkpoints beyond the newest
+    k (this one included) are garbage-collected after the commit."""
     tree = {"params": params}
     if opt_state:
         tree["opt"] = opt_state
     if state:
         tree["state"] = state
     save_sharded(path, tree, step=step, meta=meta)
+    if keep_last and re.fullmatch(r"step_\d+",
+                                  os.path.basename(path.rstrip("/"))):
+        gc_checkpoints(os.path.dirname(path.rstrip("/")) or ".", keep_last,
+                       protect=(path,))
 
 
-def load_checkpoint(path, mesh=None, shardings=None):
+def load_checkpoint(path, mesh=None, shardings=None, validate=True):
     """shardings may be {"params": {...}, "opt": {...}} nested or flat."""
     flat_sh = _flatten(shardings) if shardings else None
-    tree, step, meta = load_sharded(path, mesh=mesh, shardings=flat_sh)
+    tree, step, meta = load_sharded(path, mesh=mesh, shardings=flat_sh,
+                                    validate=validate)
     return (tree.get("params", {}), tree.get("opt", {}),
             tree.get("state", {}), step, meta)

@@ -21,6 +21,8 @@ import os
 import shutil
 from typing import List
 
+from ..testing import chaos
+
 __all__ = ["FS", "LocalFS", "RemoteFS", "HDFSClient", "sync_dir"]
 
 
@@ -131,6 +133,7 @@ class LocalFS(FS):
             shutil.move(src, dst)
 
     def put(self, path, data):
+        chaos.maybe_fail("fs.put", path)
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
@@ -147,6 +150,7 @@ class LocalFS(FS):
             raise
 
     def put_file(self, local_src, path):
+        chaos.maybe_fail("fs.put", path)
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
@@ -176,11 +180,23 @@ class RemoteFS(FS):
     FS verb maps onto the fsspec call, so sharded checkpoint save/load
     (`sync_dir`, io.checkpoint) runs against any mounted or remote store.
 
+    Every idempotent verb retries transient store faults (OSError /
+    ConnectionError / TimeoutError) with bounded exponential backoff +
+    jitter via utils.retry — a flaky RPC degrades to a short stall, not
+    a failed checkpoint mirror. `retries=0` opts out. `mv` stays
+    single-shot (not idempotent: a retry after a half-applied rename
+    would fail spuriously or clobber).
+
     fsspec is import-guarded: constructing a RemoteFS without the
     package (or without the protocol's driver) raises a clear error;
     importing this module never does."""
 
-    def __init__(self, protocol: str = "file", **storage_options):
+    #: transient-fault allowlist for retries (NOT FileExistsError etc. —
+    #: those are real answers, retrying them can't help)
+    _TRANSIENT = (ConnectionError, TimeoutError, OSError)
+
+    def __init__(self, protocol: str = "file", retries: int = 3,
+                 retry_base_delay: float = 0.1, **storage_options):
         try:
             import fsspec
         except ImportError as e:          # pragma: no cover
@@ -190,28 +206,38 @@ class RemoteFS(FS):
             ) from e
         self._fs = fsspec.filesystem(protocol, **storage_options)
         self.protocol = protocol
+        self._retries = retries
+        self._retry_base_delay = retry_base_delay
+
+    def _retry(self, fn, *args, **kwargs):
+        from ..utils.retry import retry_call
+        return retry_call(fn, *args, retries=self._retries,
+                          base_delay=self._retry_base_delay,
+                          retry_on=self._TRANSIENT, **kwargs)
 
     def ls_dir(self, path):
         if not self.is_dir(path):
             return []
         return sorted(os.path.basename(p.rstrip("/"))
-                      for p in self._fs.ls(path, detail=False))
+                      for p in self._retry(self._fs.ls, path, detail=False))
 
     def is_file(self, path):
-        return self._fs.isfile(path)
+        return self._retry(self._fs.isfile, path)
 
     def is_dir(self, path):
-        return self._fs.isdir(path)
+        return self._retry(self._fs.isdir, path)
 
     def is_exist(self, path):
-        return self._fs.exists(path)
+        return self._retry(self._fs.exists, path)
 
     def mkdirs(self, path):
-        self._fs.makedirs(path, exist_ok=True)
+        self._retry(self._fs.makedirs, path, exist_ok=True)
 
     def delete(self, path):
-        if self._fs.exists(path):
-            self._fs.rm(path, recursive=True)
+        def _del():
+            if self._fs.exists(path):
+                self._fs.rm(path, recursive=True)
+        self._retry(_del)
 
     def mv(self, src, dst, overwrite=False):
         if self._fs.exists(dst):
@@ -221,27 +247,35 @@ class RemoteFS(FS):
         self._fs.mv(src, dst, recursive=True)
 
     def put(self, path, data: bytes):
-        parent = os.path.dirname(path.rstrip("/"))
-        if parent:
-            self._fs.makedirs(parent, exist_ok=True)
-        with self._fs.open(path, "wb") as f:
-            f.write(data)
+        def _put():
+            chaos.maybe_fail("fs.put", path)
+            parent = os.path.dirname(path.rstrip("/"))
+            if parent:
+                self._fs.makedirs(parent, exist_ok=True)
+            with self._fs.open(path, "wb") as f:
+                f.write(data)
+        self._retry(_put)
 
     def get(self, path) -> bytes:
-        with self._fs.open(path, "rb") as f:
-            return f.read()
+        def _get():
+            with self._fs.open(path, "rb") as f:
+                return f.read()
+        return self._retry(_get)
 
     def put_file(self, local_src, path):
-        parent = os.path.dirname(path.rstrip("/"))
-        if parent:
-            self._fs.makedirs(parent, exist_ok=True)
-        self._fs.put_file(local_src, path)
+        def _put():
+            chaos.maybe_fail("fs.put", path)
+            parent = os.path.dirname(path.rstrip("/"))
+            if parent:
+                self._fs.makedirs(parent, exist_ok=True)
+            self._fs.put_file(local_src, path)
+        self._retry(_put)
 
     def download(self, remote_path, local_path):
         d = os.path.dirname(local_path)
         if d:
             os.makedirs(d, exist_ok=True)
-        self._fs.get_file(remote_path, local_path)
+        self._retry(self._fs.get_file, remote_path, local_path)
 
     # reference-API surface (fs.py:95-110)
     def rename(self, src, dst):

@@ -85,6 +85,7 @@ class Program:
     def __init__(self):
         self._callables = []
         self._parameters = {}    # static.nn ops register implicit params
+        self._buffers = {}       # non-trainable stats (moving mean/var)
         self.random_seed = None
 
     def add(self, fn):
@@ -92,9 +93,18 @@ class Program:
         return fn
 
     def all_parameters(self):
-        """Implicitly created static.nn parameters (reference
-        Program.all_parameters) — feed these to an optimizer."""
+        """Implicitly created static.nn TRAINABLE parameters (reference
+        Program.all_parameters) — feed these to an optimizer. Running
+        statistics (batch_norm moving mean/var, data_norm accumulators)
+        live in the buffer table instead: the reference keeps them as
+        persistable non-parameter variables, so an optimizer never
+        weight-decays them."""
         return list(self._parameters.values())
+
+    def all_buffers(self):
+        """Non-trainable running statistics registered by static.nn ops
+        (persistable in the reference, excluded from all_parameters)."""
+        return list(self._buffers.values())
 
     def global_block(self):
         return self
@@ -108,6 +118,7 @@ class Program:
         p = Program()
         p._callables = list(self._callables)
         p._parameters = dict(self._parameters)
+        p._buffers = dict(self._buffers)
         return p
 
 

@@ -56,10 +56,19 @@ def parameter_scope():
     return dict(_PARAMS)
 
 
-def _param(name, shape, dtype, attr, is_bias=False, default_init=None):
+def _param(name, shape, dtype, attr, is_bias=False, default_init=None,
+           is_buffer=False):
     """Fluid create-or-share: an attr-named parameter that already exists
     is reused (shape-checked); otherwise a new one is created under
-    `name` and registered on the scope + default main program."""
+    `name` and registered on the scope + default main program.
+
+    `is_buffer` marks non-trainable running statistics (batch_norm
+    moving mean/var, data_norm accumulators): they stay addressable by
+    name in the scope but register on the program's BUFFER table, so
+    `Program.all_parameters()` never hands them to an optimizer (the
+    reference keeps them as persistable non-parameter variables — an
+    optimizer applying weight decay to running stats would corrupt
+    them)."""
     from ..legacy_alias import create_parameter as _create
     attr = ParamAttr._to_attr(attr)
     if attr is False:
@@ -78,7 +87,10 @@ def _param(name, shape, dtype, attr, is_bias=False, default_init=None):
     _PARAMS[pname] = p
     prog = _default_program()
     if prog is not None:
-        prog._parameters[pname] = p
+        if is_buffer:
+            prog._buffers[pname] = p
+        else:
+            prog._parameters[pname] = p
     return p
 
 
@@ -304,9 +316,9 @@ def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
                default_init=I.Constant(1.0))
     b = _param(f"{base}.b_0", (c,), "float32", bias_attr, is_bias=True)
     mean = _param(moving_mean_name or f"{base}.w_1", (c,), "float32", None,
-                  default_init=I.Constant(0.0))
+                  default_init=I.Constant(0.0), is_buffer=True)
     var = _param(moving_variance_name or f"{base}.w_2", (c,), "float32",
-                 None, default_init=I.Constant(1.0))
+                 None, default_init=I.Constant(1.0), is_buffer=True)
     mean.stop_gradient = True
     var.stop_gradient = True
     out = F.batch_norm(input, mean, var, weight=w, bias=b,
@@ -371,21 +383,26 @@ def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
               summary_decay_rate=0.9999999, enable_scale_and_shift=False):
     """fluid/layers/nn.py data_norm (kernel data_norm_op.cc): normalize
     by accumulated batch statistics — mean = batch_sum / batch_size,
-    var = batch_square_sum / batch_size - mean^2 — then fold the current
-    batch into the accumulators with `summary_decay_rate`."""
+    scale = sqrt(batch_size / batch_square_sum) with NO mean^2
+    subtraction (the reference kernel normalizes by the raw second
+    moment, data_norm_op.cc:303) — then fold the current batch into the
+    accumulators with `summary_decay_rate`."""
     from ..nn import initializer as I
     c = int(input.shape[-1])
     base = name or _unique("data_norm")
     bsize = _param(f"{base}.batch_size", (c,), "float32", None,
-                   default_init=I.Constant(float(batch_size_default)))
+                   default_init=I.Constant(float(batch_size_default)),
+                   is_buffer=True)
     bsum = _param(f"{base}.batch_sum", (c,), "float32", None,
-                  default_init=I.Constant(float(batch_sum_default)))
+                  default_init=I.Constant(float(batch_sum_default)),
+                  is_buffer=True)
     bsq = _param(f"{base}.batch_square_sum", (c,), "float32", None,
-                 default_init=I.Constant(float(batch_square_sum_default)))
+                 default_init=I.Constant(float(batch_square_sum_default)),
+                 is_buffer=True)
     for p in (bsize, bsum, bsq):
         p.stop_gradient = True
     mean = bsum / bsize
-    scale = bsize / (bsq - (bsum * bsum) / bsize + epsilon)
+    scale = bsize / (bsq + epsilon)
     out = (input - mean) * scale.sqrt()
     if enable_scale_and_shift:
         w = _param(f"{base}.w_0", (c,), "float32", param_attr,

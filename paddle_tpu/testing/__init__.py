@@ -1,0 +1,9 @@
+"""paddle_tpu.testing: deterministic fault-injection tooling.
+
+`chaos` is the injection harness the fault-tolerance layer is verified
+with (docs/fault_tolerance.md); it is import-light so production modules
+can hook injection sites unconditionally.
+"""
+from . import chaos  # noqa: F401
+
+__all__ = ["chaos"]

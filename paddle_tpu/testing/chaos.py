@@ -1,0 +1,196 @@
+"""Deterministic fault injection for the checkpoint/store/recovery stack.
+
+Production code marks *named injection sites* with `maybe_fail(site)`;
+with no schedule active that is a dict lookup and a return, so the hooks
+stay in the hot path permanently. A schedule arms sites to raise a
+chosen exception on chosen call numbers — deterministically, so every
+recovery path is exercised by ordinary pytest instead of hope:
+
+    from paddle_tpu.testing import chaos
+    with chaos.inject("ckpt.rename:1:OSError"):
+        save_checkpoint(...)        # first rename raises OSError
+
+or, process-wide, via the environment:
+
+    PADDLE_TPU_CHAOS="store.req:1-3:ConnectionError;step.fn:5:RuntimeError"
+
+Spec grammar (';'-separated rules):
+
+    <site>:<calls>:<ExcName>
+
+    site      dotted site name; '*' suffix wildcard matches a prefix
+              ("ckpt.*"). Shipped sites: fs.put, ckpt.write,
+              ckpt.rename, store.req, step.fn.
+    calls     which hits fire, 1-based per site counter:
+                "3"        call #3 only
+                "1-4"      calls 1..4
+                "2,5"      calls 2 and 5
+                "3+"       call 3 and every later call
+                "p0.3@7"   each call fails with prob 0.3, seeded RNG(7)
+                           (seeded => the schedule is reproducible)
+    ExcName   OSError | ConnectionError | ConnectionResetError |
+              BrokenPipeError | TimeoutError | RuntimeError | IOError
+
+Schedules record every fired fault in `.fired` for assertions. Counters
+are per-schedule, so nesting `inject()` restarts the count.
+"""
+from __future__ import annotations
+
+import os
+import random
+import re
+import threading
+from typing import List, Optional
+
+__all__ = ["ChaosFault", "Rule", "Schedule", "inject", "maybe_fail",
+           "active_schedule", "fail_once"]
+
+_EXC_REGISTRY = {
+    "OSError": OSError,
+    "IOError": OSError,
+    "ConnectionError": ConnectionError,
+    "ConnectionResetError": ConnectionResetError,
+    "BrokenPipeError": BrokenPipeError,
+    "TimeoutError": TimeoutError,
+    "RuntimeError": RuntimeError,
+}
+
+
+class ChaosFault(RuntimeError):
+    """Raised only when a rule names no concrete exception type."""
+
+
+class Rule:
+    """One armed site: which calls fire and what they raise."""
+
+    def __init__(self, site: str, calls=None, from_call: int = None,
+                 prob: float = None, seed: int = 0, exc=OSError):
+        self.site = site
+        self.calls = set(calls) if calls else None
+        self.from_call = from_call
+        self.prob = prob
+        self.exc = exc
+        self._rng = random.Random(seed)
+
+    def matches(self, site: str) -> bool:
+        if self.site.endswith("*"):
+            return site.startswith(self.site[:-1])
+        return site == self.site
+
+    def should_fire(self, n: int) -> bool:
+        if self.prob is not None:
+            return self._rng.random() < self.prob
+        if self.from_call is not None and n >= self.from_call:
+            return True
+        return self.calls is not None and n in self.calls
+
+    def make_exc(self, site: str, n: int, detail=None) -> BaseException:
+        msg = f"chaos[{site}#{n}]" + (f" {detail}" if detail else "")
+        return self.exc(msg)
+
+    @classmethod
+    def parse(cls, text: str) -> "Rule":
+        parts = text.strip().split(":")
+        if len(parts) != 3:
+            raise ValueError(
+                f"chaos rule {text!r}: want <site>:<calls>:<ExcName>")
+        site, calls_s, exc_s = parts
+        exc = _EXC_REGISTRY.get(exc_s)
+        if exc is None:
+            raise ValueError(f"chaos rule {text!r}: unknown exception "
+                             f"{exc_s!r} (one of {sorted(_EXC_REGISTRY)})")
+        m = re.fullmatch(r"p([0-9.]+)@(\d+)", calls_s)
+        if m:
+            return cls(site, prob=float(m.group(1)), seed=int(m.group(2)),
+                       exc=exc)
+        if calls_s.endswith("+"):
+            return cls(site, from_call=int(calls_s[:-1]), exc=exc)
+        calls = set()
+        for tok in calls_s.split(","):
+            if "-" in tok:
+                a, b = tok.split("-")
+                calls.update(range(int(a), int(b) + 1))
+            else:
+                calls.add(int(tok))
+        return cls(site, calls=calls, exc=exc)
+
+
+class Schedule:
+    """A set of rules plus per-site call counters (thread-safe)."""
+
+    def __init__(self, rules: List[Rule]):
+        self.rules = list(rules)
+        self.counts = {}
+        self.fired = []          # [(site, call_no, exc_type_name)]
+        self._lock = threading.Lock()
+
+    @classmethod
+    def coerce(cls, spec) -> "Schedule":
+        if isinstance(spec, Schedule):
+            return spec
+        if isinstance(spec, Rule):
+            return cls([spec])
+        if isinstance(spec, str):
+            return cls([Rule.parse(r) for r in spec.split(";") if r.strip()])
+        return cls(list(spec))    # iterable of Rules
+
+    def hit(self, site: str, detail=None):
+        with self._lock:
+            n = self.counts.get(site, 0) + 1
+            self.counts[site] = n
+            for r in self.rules:
+                if r.matches(site) and r.should_fire(n):
+                    self.fired.append((site, n, r.exc.__name__))
+                    raise r.make_exc(site, n, detail)
+
+
+_STACK: List[Schedule] = []
+_ENV_SPEC: Optional[str] = None
+_ENV_SCHED: Optional[Schedule] = None
+
+
+def active_schedule() -> Optional[Schedule]:
+    """The innermost `inject()` schedule, else the PADDLE_TPU_CHAOS env
+    schedule (parsed once per distinct value), else None."""
+    global _ENV_SPEC, _ENV_SCHED
+    if _STACK:
+        return _STACK[-1]
+    spec = os.environ.get("PADDLE_TPU_CHAOS")
+    if not spec:
+        _ENV_SPEC = _ENV_SCHED = None
+        return None
+    if spec != _ENV_SPEC:
+        _ENV_SPEC, _ENV_SCHED = spec, Schedule.coerce(spec)
+    return _ENV_SCHED
+
+
+def maybe_fail(site: str, detail=None):
+    """Injection-site hook: no-op unless a schedule arms `site`."""
+    sched = active_schedule()
+    if sched is not None:
+        sched.hit(site, detail)
+
+
+class inject:
+    """Context manager arming a schedule for the enclosed block.
+
+    `spec` is a grammar string (module docstring), a Rule, an iterable
+    of Rules, or a prebuilt Schedule. Yields the Schedule so tests can
+    assert on `.fired` / `.counts`.
+    """
+
+    def __init__(self, spec):
+        self.schedule = Schedule.coerce(spec)
+
+    def __enter__(self) -> Schedule:
+        _STACK.append(self.schedule)
+        return self.schedule
+
+    def __exit__(self, *exc):
+        _STACK.pop()
+        return False
+
+
+def fail_once(site: str, call: int = 1, exc=OSError) -> inject:
+    """Shorthand: `with chaos.fail_once("ckpt.rename"): ...`."""
+    return inject(Rule(site, calls={call}, exc=exc))

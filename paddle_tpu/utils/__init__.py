@@ -5,6 +5,7 @@ from . import collective_bench  # noqa: F401
 from . import cpp_extension  # noqa: F401
 from . import custom_op  # noqa: F401
 from . import op_bench  # noqa: F401
+from . import retry  # noqa: F401  (fault-tolerance backoff/watchdog)
 from .custom_op import register_op  # noqa: F401
 from .compat import (OpLastCheckpointChecker, Profiler,  # noqa: F401
                      ProfilerOptions, deprecated, download, get_profiler,
@@ -12,7 +13,8 @@ from .compat import (OpLastCheckpointChecker, Profiler,  # noqa: F401
 
 __all__ = ["op_bench", "collective_bench", "custom_op", "register_op",
            "run_check", "cpp_extension", "dump_config", "deprecated",
-           "download", "unique_name", "require_version", "try_import"]
+           "download", "unique_name", "require_version", "try_import",
+           "retry"]
 
 
 def dump_config(config, path=None):

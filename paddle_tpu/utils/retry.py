@@ -1,0 +1,131 @@
+"""Bounded retry/backoff + wall-clock watchdog primitives.
+
+The fault-tolerance layer (docs/fault_tolerance.md) routes every
+transient-failure-prone call through here: `TCPStore._req` reconnects,
+`RemoteFS` verbs, and `elastic.run_with_recovery` restarts all use the
+same bounded exponential backoff with jitter, and hang-prone control
+calls (`Store.barrier`) run under `call_with_watchdog` so a wedged peer
+raises a typed TimeoutError instead of blocking forever.
+
+No reference analog: the reference stack aborts on the first failure
+(launch_utils.py watch_local_trainers); this module is what turns those
+aborts into bounded retries.
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Callable, Iterable, Tuple, Type
+
+__all__ = ["DeadlineExceeded", "WatchdogTimeout", "backoff_delays",
+           "retry_call", "retry", "call_with_watchdog"]
+
+
+class DeadlineExceeded(TimeoutError):
+    """A retry loop ran out of wall-clock budget before succeeding."""
+
+
+class WatchdogTimeout(TimeoutError):
+    """A watchdogged call did not return within its wall-clock bound."""
+
+
+def backoff_delays(retries: int, base_delay: float = 0.05,
+                   max_delay: float = 2.0, jitter: float = 0.5,
+                   rng: random.Random = None):
+    """Yield `retries` sleep durations: capped exponential backoff with
+    multiplicative jitter in [1, 1+jitter) (decorrelates gang restarts)."""
+    rng = rng or random
+    for i in range(retries):
+        d = min(max_delay, base_delay * (2.0 ** i))
+        yield d * (1.0 + jitter * rng.random())
+
+
+def retry_call(fn: Callable, *args,
+               retries: int = 3,
+               base_delay: float = 0.05,
+               max_delay: float = 2.0,
+               jitter: float = 0.5,
+               retry_on: Tuple[Type[BaseException], ...] = (
+                   ConnectionError, TimeoutError, OSError),
+               deadline: float = None,
+               on_retry: Callable = None,
+               sleep: Callable[[float], None] = time.sleep,
+               **kwargs):
+    """Call `fn(*args, **kwargs)`, retrying on exceptions in the
+    `retry_on` allowlist — at most `retries` retries (retries+1 attempts
+    total), bounded exponential backoff with jitter between attempts.
+
+    `deadline` is an optional wall-clock budget in seconds for the WHOLE
+    loop: when sleeping for the next attempt would cross it, the loop
+    raises `DeadlineExceeded` chained to the last failure instead of
+    sleeping. `on_retry(attempt, exc, delay)` observes each retry.
+    Non-allowlisted exceptions propagate immediately.
+    """
+    t0 = time.monotonic()
+    delays = backoff_delays(retries, base_delay, max_delay, jitter)
+    attempt = 0
+    while True:
+        try:
+            return fn(*args, **kwargs)
+        except retry_on as e:
+            attempt += 1
+            if attempt > retries:
+                raise
+            delay = next(delays)
+            if deadline is not None and \
+                    time.monotonic() - t0 + delay > deadline:
+                raise DeadlineExceeded(
+                    f"retry of {getattr(fn, '__name__', fn)!r} exceeded "
+                    f"{deadline}s deadline after {attempt} attempts"
+                ) from e
+            if on_retry is not None:
+                on_retry(attempt, e, delay)
+            sleep(delay)
+
+
+def retry(**policy):
+    """Decorator form of `retry_call`: `@retry(retries=5, retry_on=(...))`."""
+
+    def deco(fn):
+        def wrapped(*args, **kwargs):
+            return retry_call(fn, *args, **policy, **kwargs)
+        wrapped.__name__ = getattr(fn, "__name__", "retried")
+        wrapped.__doc__ = fn.__doc__
+        wrapped.__wrapped__ = fn
+        return wrapped
+    return deco
+
+
+def call_with_watchdog(fn: Callable, timeout: float, what: str = "call",
+                       *args, **kwargs):
+    """Run `fn(*args, **kwargs)` under a wall-clock watchdog: if it has
+    not returned after `timeout` seconds, raise `WatchdogTimeout`.
+
+    The call runs in a daemon worker thread; on timeout the worker is
+    abandoned (Python threads cannot be killed), which is exactly the
+    right trade for hung control-plane RPCs — the caller gets a typed,
+    catchable error instead of blocking forever, and the leaked thread
+    dies with the process. `timeout=None` degrades to a plain call.
+    """
+    if timeout is None:
+        return fn(*args, **kwargs)
+    result = {}
+    done = threading.Event()
+
+    def _run():
+        try:
+            result["value"] = fn(*args, **kwargs)
+        except BaseException as e:          # surfaced in the caller
+            result["exc"] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(target=_run, daemon=True,
+                         name=f"watchdog:{what}")
+    t.start()
+    if not done.wait(timeout):
+        raise WatchdogTimeout(f"{what} did not finish within {timeout}s")
+    if "exc" in result:
+        raise result["exc"]
+    return result.get("value")

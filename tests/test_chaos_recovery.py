@@ -1,0 +1,440 @@
+"""Fault-injection tests for the checkpoint/restore pipeline
+(docs/fault_tolerance.md): atomic commits survive mid-save kills, the
+loader falls back past corrupt checkpoints, retries are bounded, and
+hung barriers raise typed timeouts — all driven deterministically by
+paddle_tpu.testing.chaos schedules, on CPU."""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.io.checkpoint import (CheckpointError, gc_checkpoints,
+                                      latest_checkpoint, list_checkpoints,
+                                      load_checkpoint, save_checkpoint,
+                                      validate_checkpoint)
+from paddle_tpu.testing import chaos
+from paddle_tpu.utils.retry import (DeadlineExceeded, WatchdogTimeout,
+                                    call_with_watchdog, retry_call)
+
+
+# -- chaos harness ------------------------------------------------------------
+
+def test_chaos_spec_grammar():
+    sched = chaos.Schedule.coerce(
+        "fs.put:3:OSError;store.req:1-2:ConnectionError;step.fn:4+:"
+        "RuntimeError")
+    # call-numbered rules fire exactly on their calls
+    for n in range(1, 6):
+        if n == 3:
+            with pytest.raises(OSError):
+                sched.hit("fs.put")
+        else:
+            sched.hit("fs.put")
+    with pytest.raises(ConnectionError):
+        sched.hit("store.req")
+    with pytest.raises(ConnectionError):
+        sched.hit("store.req")
+    sched.hit("store.req")                     # call 3: disarmed
+    for _ in range(3):
+        sched.hit("step.fn")                   # 1..3 pass
+    for _ in range(3):                         # 4+ fire forever
+        with pytest.raises(RuntimeError):
+            sched.hit("step.fn")
+    assert ("fs.put", 3, "OSError") in sched.fired
+
+
+def test_chaos_seeded_probability_is_deterministic():
+    fires = []
+    for _ in range(2):
+        sched = chaos.Schedule.coerce("x.y:p0.5@42:OSError")
+        hits = []
+        for n in range(1, 21):
+            try:
+                sched.hit("x.y")
+                hits.append(False)
+            except OSError:
+                hits.append(True)
+        fires.append(hits)
+    assert fires[0] == fires[1]                # same seed, same schedule
+    assert any(fires[0]) and not all(fires[0])
+
+
+def test_chaos_env_spec(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_CHAOS", "env.site:1:OSError")
+    with pytest.raises(OSError):
+        chaos.maybe_fail("env.site")
+    chaos.maybe_fail("env.site")               # call 2: disarmed
+    monkeypatch.delenv("PADDLE_TPU_CHAOS")
+    chaos.maybe_fail("env.site")               # schedule dropped with env
+
+
+def test_chaos_wildcard_and_nesting():
+    with chaos.inject("ckpt.*:1:OSError") as outer:
+        with chaos.inject("other:1:OSError"):
+            chaos.maybe_fail("ckpt.rename")    # inner schedule: disarmed
+        with pytest.raises(OSError):
+            chaos.maybe_fail("ckpt.rename")    # outer, call 1 of ckpt.*
+    assert outer.counts["ckpt.rename"] == 1
+
+
+# -- retry/backoff primitive --------------------------------------------------
+
+def test_retry_bounded_then_success():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise ConnectionError("flap")
+        return "ok"
+
+    assert retry_call(flaky, retries=3, base_delay=0.001) == "ok"
+    assert calls["n"] == 3
+
+
+def test_retry_exhaustion_raises_last_error():
+    calls = {"n": 0}
+
+    def always():
+        calls["n"] += 1
+        raise ConnectionError(f"flap {calls['n']}")
+
+    with pytest.raises(ConnectionError, match="flap 3"):
+        retry_call(always, retries=2, base_delay=0.001)
+    assert calls["n"] == 3                     # retries+1 attempts, bounded
+
+
+def test_retry_allowlist_passes_through():
+    def bad():
+        raise ValueError("logic bug, not transient")
+
+    calls = {"n": 0}
+
+    def counting_bad():
+        calls["n"] += 1
+        return bad()
+
+    with pytest.raises(ValueError):
+        retry_call(counting_bad, retries=5, base_delay=0.001)
+    assert calls["n"] == 1                     # never retried
+
+
+def test_retry_deadline():
+    def always():
+        raise TimeoutError("slow")
+
+    t0 = time.monotonic()
+    with pytest.raises(DeadlineExceeded):
+        retry_call(always, retries=100, base_delay=0.2, max_delay=0.2,
+                   deadline=0.3)
+    assert time.monotonic() - t0 < 2.0
+
+
+def test_watchdog_times_out_hung_call():
+    with pytest.raises(WatchdogTimeout):
+        call_with_watchdog(lambda: time.sleep(30), 0.2, what="hung")
+    assert call_with_watchdog(lambda: 7, 5.0) == 7
+
+
+# -- atomic checkpoint commit -------------------------------------------------
+
+def _params(v):
+    return {"w": np.full((4, 4), float(v), np.float32),
+            "nested": {"b": np.full((3,), float(v), np.float32)}}
+
+
+def test_mid_save_kill_preserves_previous_checkpoint(tmp_path):
+    d = str(tmp_path)
+    save_checkpoint(os.path.join(d, "step_2"), _params(2), step=2)
+    # (a) ISSUE acceptance: kill a save mid-write -> previous restored
+    with chaos.inject("ckpt.write:2:OSError"):
+        with pytest.raises(OSError):
+            save_checkpoint(os.path.join(d, "step_4"), _params(4), step=4)
+    assert not os.path.exists(os.path.join(d, "step_4"))
+    ck = latest_checkpoint(d)
+    assert ck is not None and ck.endswith("step_2")
+    p, _, _, step, _ = load_checkpoint(ck)
+    assert step == 2
+    np.testing.assert_array_equal(np.asarray(p["w"]), 2.0)
+    # a later clean save commits and retention reclaims the .tmp orphan
+    assert os.path.isdir(os.path.join(d, "step_4.tmp"))
+    save_checkpoint(os.path.join(d, "step_4"), _params(4), step=4,
+                    keep_last=2)
+    assert latest_checkpoint(d).endswith("step_4")
+    assert not os.path.exists(os.path.join(d, "step_4.tmp"))
+
+
+def test_rename_fault_is_atomic(tmp_path):
+    d = str(tmp_path)
+    save_checkpoint(os.path.join(d, "step_1"), _params(1), step=1)
+    with chaos.inject("ckpt.rename:1:OSError"):
+        with pytest.raises(OSError):
+            save_checkpoint(os.path.join(d, "step_3"), _params(3), step=3)
+    # everything was written, but nothing was published
+    assert not os.path.exists(os.path.join(d, "step_3"))
+    assert latest_checkpoint(d).endswith("step_1")
+
+
+def test_corrupt_shard_falls_back_with_warning(tmp_path):
+    d = str(tmp_path)
+    save_checkpoint(os.path.join(d, "step_2"), _params(2), step=2)
+    save_checkpoint(os.path.join(d, "step_4"), _params(4), step=4)
+    # flip bytes inside one shard of the newest step (size unchanged ->
+    # only the crc32 catches it)
+    shard = [f for f in os.listdir(os.path.join(d, "step_4"))
+             if "w__" in f][0]
+    fp = os.path.join(d, "step_4", shard)
+    with open(fp, "r+b") as f:
+        f.seek(os.path.getsize(fp) - 8)
+        f.write(b"\xde\xad\xbe\xef\xde\xad\xbe\xef")
+    with pytest.raises(CheckpointError, match="crc"):
+        validate_checkpoint(os.path.join(d, "step_4"))
+    with pytest.raises(CheckpointError):
+        load_checkpoint(os.path.join(d, "step_4"))
+    # (b) ISSUE acceptance: latest falls back to the older valid step
+    with pytest.warns(UserWarning, match="skipping invalid checkpoint"):
+        ck = latest_checkpoint(d)
+    assert ck.endswith("step_2")
+
+
+def test_truncated_shard_detected_by_size(tmp_path):
+    d = str(tmp_path)
+    save_checkpoint(os.path.join(d, "step_1"), _params(1), step=1)
+    shard = [f for f in os.listdir(os.path.join(d, "step_1"))
+             if f.endswith(".npy")][0]
+    fp = os.path.join(d, "step_1", shard)
+    with open(fp, "r+b") as f:
+        f.truncate(os.path.getsize(fp) - 4)
+    with pytest.raises(CheckpointError, match="size"):
+        validate_checkpoint(os.path.join(d, "step_1"), deep=False)
+    assert latest_checkpoint(d) is None
+
+
+def test_missing_meta_or_index_invalid(tmp_path):
+    d = str(tmp_path)
+    save_checkpoint(os.path.join(d, "step_1"), _params(1), step=1)
+    os.unlink(os.path.join(d, "step_1", "meta.json"))
+    assert latest_checkpoint(d) is None
+    # pre-checksum (format 1) checkpoints still validate on existence
+    save_checkpoint(os.path.join(d, "step_2"), _params(2), step=2)
+    idx = os.path.join(d, "step_2", "index.0.json")
+    with open(idx) as f:
+        index = json.load(f)
+    for entry in index.values():
+        for sh in entry["shards"]:
+            sh.pop("size", None), sh.pop("crc32", None)
+    with open(idx, "w") as f:
+        json.dump(index, f)
+    assert latest_checkpoint(d).endswith("step_2")
+
+
+def test_retention_keeps_last_k(tmp_path):
+    d = str(tmp_path)
+    for s in (1, 2, 3, 4, 5):
+        save_checkpoint(os.path.join(d, f"step_{s}"), _params(s), step=s,
+                        keep_last=2)
+    assert [s for s, _ in list_checkpoints(d)] == [5, 4]
+    gc_checkpoints(d, keep_last=1)
+    assert [s for s, _ in list_checkpoints(d)] == [5]
+
+
+# -- recovery loop under chaos ------------------------------------------------
+
+def _recovery_harness(tmp_path):
+    """A tiny deterministic 'trainer': state w increments by 1 per step;
+    save/restore go through the real sharded checkpoint path."""
+    state = {"w": np.zeros((2,), np.float32)}
+
+    def step_fn(step):
+        state["w"] = state["w"] + 1.0
+
+    def save_fn(path, step):
+        save_checkpoint(path, {"w": state["w"]}, step=step)
+
+    def restore_fn(path):
+        p, _, _, step, _ = load_checkpoint(path)
+        state["w"] = np.asarray(p["w"])
+        return step
+
+    return state, step_fn, save_fn, restore_fn
+
+
+def test_recovery_from_transient_step_failures(tmp_path):
+    from paddle_tpu.distributed.elastic import run_with_recovery
+    state, step_fn, save_fn, restore_fn = _recovery_harness(tmp_path)
+    with chaos.inject("step.fn:3,7:RuntimeError") as sched:
+        end = run_with_recovery(step_fn, save_fn, restore_fn,
+                                str(tmp_path / "ck"), total_steps=6,
+                                checkpoint_every=2, max_restarts=3,
+                                backoff_s=0.001)
+    assert end == 6
+    assert len(sched.fired) == 2               # both injected faults hit
+    np.testing.assert_array_equal(state["w"], 6.0)
+
+
+def test_recovery_exhausts_bounded_restarts(tmp_path):
+    from paddle_tpu.distributed.elastic import run_with_recovery
+    state, step_fn, save_fn, restore_fn = _recovery_harness(tmp_path)
+    with chaos.inject("step.fn:1+:RuntimeError"):
+        with pytest.raises(RuntimeError, match="chaos"):
+            run_with_recovery(step_fn, save_fn, restore_fn,
+                              str(tmp_path / "ck"), total_steps=6,
+                              checkpoint_every=2, max_restarts=2,
+                              backoff_s=0.001)
+
+
+def test_recovery_falls_back_past_corrupt_newest(tmp_path):
+    """A crash with a corrupt newest checkpoint rolls back ONE more step
+    instead of resuming corrupt state."""
+    from paddle_tpu.distributed.elastic import run_with_recovery
+    state, step_fn, save_fn, restore_fn = _recovery_harness(tmp_path)
+    ckpt_dir = str(tmp_path / "ck")
+    end = run_with_recovery(step_fn, save_fn, restore_fn, ckpt_dir,
+                            total_steps=4, checkpoint_every=2)
+    assert end == 4
+    # corrupt newest (step_4), then resume a longer run: restore must
+    # fall back to step_2 and recompute
+    shard = [f for f in os.listdir(os.path.join(ckpt_dir, "step_4"))
+             if f.endswith(".npy")][0]
+    with open(os.path.join(ckpt_dir, "step_4", shard), "r+b") as f:
+        f.seek(10)
+        f.write(b"\xff" * 8)
+    state["w"] = np.full((2,), 99.0, np.float32)   # poison live state
+    with pytest.warns(UserWarning):
+        end = run_with_recovery(step_fn, save_fn, restore_fn, ckpt_dir,
+                                total_steps=6, checkpoint_every=2)
+    assert end == 6
+    np.testing.assert_array_equal(state["w"], 6.0)
+
+
+def test_recovery_survives_failed_save(tmp_path):
+    """A save that dies mid-write is itself a recoverable fault: the
+    loop restores the previous step and retries through it."""
+    from paddle_tpu.distributed.elastic import run_with_recovery
+    state, step_fn, save_fn, restore_fn = _recovery_harness(tmp_path)
+    # third ckpt.write call overall dies (inside the step_2 save)
+    with chaos.inject("ckpt.write:3:OSError"):
+        end = run_with_recovery(step_fn, save_fn, restore_fn,
+                                str(tmp_path / "ck"), total_steps=4,
+                                checkpoint_every=2, backoff_s=0.001)
+    assert end == 4
+    np.testing.assert_array_equal(state["w"], 4.0)
+    ck = latest_checkpoint(str(tmp_path / "ck"))
+    assert ck.endswith("step_4")
+
+
+# -- store RPC flaps ----------------------------------------------------------
+
+def test_tcpstore_retries_transient_flaps():
+    from paddle_tpu.distributed import TCPStore
+    store = TCPStore.start()
+    try:
+        # (c) ISSUE acceptance: N transient faults -> bounded retries,
+        # then success (chaos fires before each send; the client
+        # reconnects and re-issues)
+        with chaos.inject("store.req:1-2:ConnectionError") as sched:
+            store.set("k", b"v")
+        assert sched.counts["store.req"] == 3
+        assert store.get("k") == b"v"
+        # exhaustion: more consecutive faults than retries -> raises
+        with chaos.inject("store.req:1+:ConnectionError"):
+            with pytest.raises(ConnectionError):
+                store.set("k2", b"w", )
+    finally:
+        store.stop_server()
+
+
+def test_filestore_barrier_watchdog_raises_typed_timeout(tmp_path):
+    from paddle_tpu.distributed import FileStore
+    from paddle_tpu.distributed.store import BarrierTimeout
+    fs = FileStore(str(tmp_path / "store"))
+    t0 = time.monotonic()
+    with pytest.raises(BarrierTimeout):
+        fs.barrier("never", world_size=2, rank=0, timeout=0.3)
+    assert time.monotonic() - t0 < 6.0
+    # a released barrier still works
+    fs2 = FileStore(str(tmp_path / "store"))
+    import threading
+    t = threading.Thread(
+        target=lambda: fs2.barrier("ok", world_size=2, rank=1, timeout=5.0))
+    t.start()
+    fs.barrier("ok", world_size=2, rank=0, timeout=5.0)
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+
+
+def test_remotefs_put_retries(tmp_path):
+    pytest.importorskip("fsspec")
+    from paddle_tpu.io.fs import RemoteFS
+    fs = RemoteFS("memory", retries=3, retry_base_delay=0.001)
+    with chaos.inject("fs.put:1-2:OSError") as sched:
+        fs.put("/ck/meta.json", b"{}")
+    assert sched.counts["fs.put"] == 3
+    assert fs.get("/ck/meta.json") == b"{}"
+    fs2 = RemoteFS("memory", retries=0)
+    with chaos.inject("fs.put:1+:OSError"):
+        with pytest.raises(OSError):
+            fs2.put("/ck/other", b"x")
+
+
+# -- hapi ModelCheckpoint atomic publish + retention --------------------------
+
+class _FakeModel:
+    """Stands in for hapi.Model: save(prefix) writes the pickle pair."""
+
+    def __init__(self):
+        self.saved = []
+
+    def save(self, path):
+        for ext in (".pdparams", ".pdopt"):
+            with open(path + ext, "wb") as f:
+                f.write(b"state")
+        self.saved.append(path)
+
+
+def test_model_checkpoint_atomic_and_retention(tmp_path):
+    from paddle_tpu.hapi.callbacks import ModelCheckpoint
+    d = str(tmp_path / "saves")
+    os.makedirs(d)
+    cb = ModelCheckpoint(save_freq=1, save_dir=d, keep_last=2)
+    cb.set_model(_FakeModel())
+    for epoch in range(5):
+        cb.on_epoch_end(epoch)
+    names = sorted(os.listdir(d))
+    assert "3.pdparams" in names and "4.pdparams" in names
+    assert "0.pdparams" not in names and "2.pdparams" not in names
+    assert not any(".tmp" in n for n in names)     # published via rename
+    cb.on_train_end()
+    assert os.path.exists(os.path.join(d, "final.pdparams"))
+
+
+# -- cloud env precedence (satellite) -----------------------------------------
+
+def test_cloud_cluster_endpoint_precedence(monkeypatch):
+    from paddle_tpu.distributed.cloud_utils import get_cloud_cluster
+    monkeypatch.setenv("PADDLE_TRAINERS", "10.0.0.1,10.0.0.2")
+    monkeypatch.setenv("POD_IP", "10.0.0.2")
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "1")
+    monkeypatch.setenv("TRAINER_PORTS_NUM", "2")
+    # 1) cloud-allocated endpoints win outright
+    monkeypatch.setenv(
+        "DISTRIBUTED_TRAINER_ENDPOINTS",
+        "10.0.0.1:6001,10.0.0.1:6002,10.0.0.2:6005,10.0.0.2:6006")
+    cluster, pod = get_cloud_cluster(args_port=9999)
+    assert pod.trainer_endpoints == ["10.0.0.2:6005", "10.0.0.2:6006"]
+    assert cluster.trainers_endpoints()[0] == "10.0.0.1:6001"
+    # 2) else PADDLE_PORT beats args_port
+    monkeypatch.delenv("DISTRIBUTED_TRAINER_ENDPOINTS")
+    monkeypatch.setenv("PADDLE_PORT", "7100")
+    _, pod = get_cloud_cluster(args_port=9999)
+    assert pod.trainer_endpoints == ["10.0.0.2:7100", "10.0.0.2:7101"]
+    # 3) else args_port
+    monkeypatch.delenv("PADDLE_PORT")
+    _, pod = get_cloud_cluster(args_port=9999)
+    assert pod.trainer_endpoints == ["10.0.0.2:9999", "10.0.0.2:10000"]
+    # malformed endpoint count is a hard error, not silent misplacement
+    monkeypatch.setenv("DISTRIBUTED_TRAINER_ENDPOINTS", "10.0.0.1:6001")
+    with pytest.raises(RuntimeError, match="ENDPOINTS"):
+        get_cloud_cluster()

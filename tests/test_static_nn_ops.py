@@ -314,3 +314,34 @@ def test_fleet_optimizer_delegation():
     got = sgd.get_lr() if hasattr(sgd, "get_lr") else sgd._learning_rate
     got = got() if callable(got) else got
     assert abs(float(got) - 0.025) < 1e-9
+
+
+def test_data_norm_reference_scale_no_mean_sq_subtraction():
+    """data_norm_op.cc:303 normalizes by the RAW second moment:
+    scale = sqrt(batch_size / batch_square_sum), no mean^2 term.
+    With bsize=4, bsum=0, bsq=16 the output must be exactly x * 0.5."""
+    x = paddle.to_tensor(RNG.randn(8, 3).astype(np.float32))
+    out = snn.data_norm(x, name="dn_scale_ref", epsilon=0.0,
+                        batch_size_default=4.0, batch_sum_default=0.0,
+                        batch_square_sum_default=16.0)
+    np.testing.assert_allclose(out.numpy(), x.numpy() * 0.5, atol=1e-6)
+
+
+def test_moving_stats_are_buffers_not_parameters():
+    """batch_norm/data_norm moving statistics register as non-trainable
+    buffers: visible via Program.all_buffers(), excluded from
+    Program.all_parameters() so optimizers never weight-decay them."""
+    prog = static.Program()
+    with static.program_guard(prog):
+        img = paddle.to_tensor(RNG.randn(2, 3, 4, 4).astype(np.float32))
+        snn.batch_norm(img, name="bn_buf")
+        x = paddle.to_tensor(RNG.randn(4, 3).astype(np.float32))
+        snn.data_norm(x, name="dn_buf")
+    params, bufs = prog.all_parameters(), prog.all_buffers()
+    # bn: scale + bias trainable; bn mean/var + dn size/sum/sq_sum are
+    # buffers and never leak into the trainable list
+    assert len(bufs) == 5
+    assert len(params) == 2
+    buf_ids = {id(b) for b in bufs}
+    assert all(id(p) not in buf_ids for p in params)
+    assert all(p.stop_gradient for p in bufs)
