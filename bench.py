@@ -16,6 +16,7 @@ marginal-step estimator cancels: step_time = (t(n_long) - t(n_short)) /
 import json
 import os
 import sys
+import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -67,6 +68,34 @@ def _devices_or_cpu_fallback():
                   + sys.argv[1:], env)
 
 
+def _error_json(metric, msg):
+    """One parseable error line, rc 0 — the harness contract on failure."""
+    print(json.dumps({"metric": metric, "value": 0.0, "unit": "tokens/s",
+                      "vs_baseline": 0.0, "error": msg}), flush=True)
+
+
+def _compile_watchdog():
+    """Bound the (uninterruptible, C++-side) XLA compile: if the warmup
+    fit has not finished within PADDLE_TPU_COMPILE_TIMEOUT seconds, emit
+    an error JSON line and exit rc 0 — instead of the harness hitting
+    `timeout -k` with no output at all (MULTICHIP r05 died that way).
+    Returns the timer; cancel() it once warmup completes."""
+    timeout = float(os.environ.get("PADDLE_TPU_COMPILE_TIMEOUT", "600"))
+    if timeout <= 0:
+        return None
+
+    def _expire():
+        _error_json("bench_compile_timeout",
+                    f"compile watchdog expired after {timeout:.0f}s "
+                    "(set PADDLE_TPU_COMPILE_TIMEOUT to raise)")
+        os._exit(0)     # compile is stuck in XLA; no clean unwind exists
+
+    t = threading.Timer(timeout, _expire)
+    t.daemon = True
+    t.start()
+    return t
+
+
 def main():
     import jax
 
@@ -86,6 +115,9 @@ def main():
         cfg = GPTConfig(vocab_size=512, max_seq_len=128, hidden=128,
                         layers=2, heads=4)
         B, T, n_short, n_long = 2, 128, 1, 3
+        # multichip smoke (xla_force_host_platform_device_count): the
+        # global batch must stay divisible by the dp degree
+        B = max(B, len(jax.devices()))
     else:
         cfg = GPTConfig()                      # GPT-2 124M
         # B=16 is the single-chip sweet spot with the fused-CE head (no
@@ -116,6 +148,17 @@ def main():
     # softmax/LN/CE stay f32; master params and Adam state are f32.
     s.amp = True
     s.amp_configs.use_pure_bf16 = True
+    n_dev = len(jax.devices())
+    if n_dev > 1:
+        # fail fast with a parseable error when the mesh cannot be built
+        # (fleet.init only warns and leaves the mesh unset — on multichip
+        # that used to surface as a silent hang until the harness timeout)
+        try:
+            s.resolve_degrees(n_dev)
+        except ValueError as e:
+            _error_json("bench_mesh_error",
+                        f"mesh build failed for {n_dev} devices: {e}")
+            return
     adam = opt.Adam(learning_rate=1e-4, parameters=model.parameters())
     model.prepare(adam, strategy=s)
 
@@ -143,7 +186,10 @@ def main():
         return time.perf_counter() - t0, loss
 
     ds_short, ds_long = dataset(n_short), dataset(n_long)
+    watchdog = _compile_watchdog()              # bounds the AOT compile
     fit_time(ds_short)                          # compile + warmup
+    if watchdog is not None:
+        watchdog.cancel()
     estimates, loss = [], float("nan")
     for _ in range(2):
         dt_short, _ = fit_time(ds_short)
@@ -189,12 +235,23 @@ def main():
               f"overlap/other={step_ms - t_fb - t_opt:.2f}ms",
               file=sys.stderr)
 
+    # compile observability: total explicit-AOT compile seconds and the
+    # persistent-cache verdict ("hit" only when every compile hit)
+    from paddle_tpu import profiler
+    compiles = profiler.compile_events()
+    compile_s = round(sum(e["compile_s"] for e in compiles), 3)
+    verdicts = {e["cache"] for e in compiles}
+    compile_cache = ("off" if not verdicts or verdicts == {"off"}
+                     else "miss" if "miss" in verdicts else "hit")
+
     print(json.dumps({
         "metric": "gpt2_124m_fit_tokens_per_sec" if not on_cpu
                   else "gpt_tiny_cpu_smoke_tokens_per_sec",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.45, 4),
+        "compile_s": compile_s,
+        "compile_cache": compile_cache,
     }))
 
 

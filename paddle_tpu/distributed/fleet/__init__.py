@@ -76,10 +76,17 @@ def init(role_maker=None, is_collective=True, strategy=None):
     _state["role_maker"] = role_maker or PaddleCloudRoleMaker(is_collective)
     try:
         strategy.build_mesh()
-    except ValueError:
-        # device count does not match hybrid degrees: leave mesh unset,
-        # compile_train_step may be given an explicit mesh later
-        pass
+    except ValueError as e:
+        # device count does not match hybrid degrees: leave mesh unset so
+        # compile_train_step may be given an explicit mesh later — but say
+        # so NOW. On multi-device runs a silently-missing mesh used to
+        # surface much later as a hang or an opaque compile error
+        # (MULTICHIP r05 died at timeout having printed nothing).
+        import warnings
+        warnings.warn(
+            f"fleet.init: mesh build failed ({e}); no global mesh was "
+            "set. Fix the strategy's hybrid degrees or pass an explicit "
+            "mesh to compile_train_step.", RuntimeWarning, stacklevel=2)
     _state["initialized"] = True
     return None
 

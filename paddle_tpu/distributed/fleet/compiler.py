@@ -22,12 +22,13 @@ from typing import Callable, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ...core import nan_inf
 from ...core import random as random_mod
 from ...framework import MethodAdapter, functional_call, param_arrays, \
-    state_arrays
+    state_arrays, unaliased_put
 from .. import sharding as zero_mod
 from .strategy import DistributedStrategy
 
@@ -49,6 +50,10 @@ class CompiledTrainStep:
         self.layer = layer
         self.data_sharding = data_sharding
         self._opt = None
+        self._step_label = "fleet.train_step"
+        self._aot = None
+        self._guard = None
+        self.compile_stats = None
 
     def step(self, *data, lr=None):
         data = tuple(self._put_data(d) for d in data)
@@ -57,8 +62,32 @@ class CompiledTrainStep:
             # follow the optimizer's configured lr / scheduler
             lr = self._opt.get_lr() if self._opt is not None else 1e-3
         lr = jnp.asarray(lr, jnp.float32)
-        loss, self.params, self.state, self.opt_state = self._step(
-            self.params, self.state, self.opt_state, key, lr, data)
+        args = (self.params, self.state, self.opt_state, key, lr, data)
+        # every strategy path (SPMD jit, pipeline, grad_comm shard_map)
+        # funnels here: AOT-compile once (timed, persistent-cache aware)
+        # and watch the data signature instead of silently retracing
+        from ...jit import compile_cache
+        if self._guard is None:
+            self._guard = compile_cache.RetraceGuard(self._step_label)
+        verdict = self._guard.check(data=data)
+        if self._aot is None or verdict == "retrace":
+            # CPU + multi-device mesh: never serve this executable from
+            # the persistent cache — deserializing a sub-mesh SPMD
+            # executable on the CPU backend corrupts the heap (observed
+            # under xla_force_host_platform_device_count); TPU keeps it
+            n_mesh = int(getattr(self.mesh, "size", 1) or 1) \
+                if self.mesh is not None else 1
+            use_cache = not (n_mesh > 1
+                             and jax.default_backend() == "cpu")
+            try:
+                self._aot, self.compile_stats = compile_cache.aot_compile(
+                    self._step, *args, label=self._step_label,
+                    use_cache=use_cache)
+            except compile_cache.RetraceError:
+                raise
+            except Exception:  # exotic input: keep the implicit jit path
+                self._aot = self._step
+        loss, self.params, self.state, self.opt_state = self._aot(*args)
         return loss
 
     def eval_step(self, *data):
@@ -175,6 +204,21 @@ def _merge_specs(base: Dict[str, P], extra: Dict[str, P]) -> Dict[str, P]:
     return out
 
 
+def _scan_stacked_names(layer):
+    """Fully-qualified names of params living in a ScanBlockStack: their
+    dim 0 is the lax.scan xs axis (see sharding.shard_specs
+    ``skip_leading``)."""
+    walk = getattr(layer, "named_sublayers", None)
+    if walk is None:        # facade layers (hapi adapters) without one
+        return set()
+    names = set()
+    for pfx, sub in [("", layer)] + list(walk()):
+        if getattr(sub, "_scan_stack", False):
+            p = pfx + "." if pfx else ""
+            names.update(p + rel for rel in sub._rels)
+    return names
+
+
 def _slot_shardings(mesh, opt_state, params, slot_specs):
     """Optimizer-slot shardings: a slot shaped like its parameter follows
     the parameter's spec; scalars (beta powers, steps) replicate."""
@@ -196,6 +240,12 @@ def compile_train_step(layer, optimizer, strategy: DistributedStrategy,
                        lr_default: float = 1e-3) -> CompiledTrainStep:
     mesh = mesh or strategy.build_mesh()
     optimizer = _maybe_swap_optimizer(optimizer, strategy)
+    if not getattr(strategy, "scan_layers", True):
+        # escape hatch: trace scan-stacked models as an unrolled Python
+        # loop over the stacked params (depth-linear HLO again)
+        setter = getattr(layer, "set_scan_unroll", None)
+        if setter is not None:
+            setter(True)
     if hasattr(layer, "named_parameters"):
         # per-param ParamAttr regularizers, keyed for the functional path
         # (pipeline layouts rename params — those fall back to the
@@ -229,8 +279,10 @@ def compile_train_step(layer, optimizer, strategy: DistributedStrategy,
     tp_specs = _tp_specs(layer, params, strategy) \
         if (n_tp > 1 or n_ep > 1) else \
         {k: P(*([None] * getattr(v, "ndim", 0))) for k, v in params.items()}
+    scan_stacked = _scan_stacked_names(layer)
     if stage >= 1:
-        zspecs = zero_mod.shard_specs(params, "dp", n_dp)
+        zspecs = zero_mod.shard_specs(params, "dp", n_dp,
+                                      skip_leading=scan_stacked)
         pspecs = _merge_specs(tp_specs, zspecs if stage >= 3 else
                               {k: P(*([None] * getattr(v, "ndim", 0)))
                                for k, v in params.items()})
@@ -331,6 +383,16 @@ def compile_train_step(layer, optimizer, strategy: DistributedStrategy,
             (loss, new_state), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(p)
         grads = nan_inf.guard_tree(grads)   # FLAGS_check_nan_inf, jit path
+        if scan_stacked and stage >= 1 and n_dp > 1:
+            # pin scan-stacked grads replicated: letting the dp-sharded
+            # Adam slots propagate a partition into the scan-transpose's
+            # dynamic_update_slice accumulator miscompiles in XLA:CPU
+            # (heap corruption) — reshard at the update instead
+            grads = {k: (jax.lax.with_sharding_constraint(
+                             v, NamedSharding(mesh,
+                                              P(*([None] * v.ndim))))
+                         if k in scan_stacked else v)
+                     for k, v in grads.items()}
         new_p, new_opt = optimizer.functional_update(p, grads, opt_st, lr=lr)
         return loss, new_p, new_state, new_opt
 
@@ -341,12 +403,12 @@ def compile_train_step(layer, optimizer, strategy: DistributedStrategy,
         out_shardings=(NamedSharding(mesh, P()), p_sh, buf_sh, s_sh),
         donate_argnums=(0, 2))
 
-    # may_alias=False on params only (donated argnum 0): on a single
-    # device device_put would no-op and the program's donated buffers
-    # would ALIAS the layer's own arrays, leaving the user's Tensors
-    # deleted after step 1. state (argnum 1) is never donated.
-    params = {k: jax.device_put(v, p_sh[k], may_alias=False)
-              for k, v in params.items()}
+    # true copy on params only (donated argnum 0): an aliasing placement
+    # would leave the program's donated buffers sharing storage with the
+    # layer's own arrays, so the user's Tensors die after step 1 — and
+    # device_put(may_alias=False) still aliases on this jax build's CPU
+    # backend. state (argnum 1) is never donated.
+    params = {k: unaliased_put(v, p_sh[k]) for k, v in params.items()}
     state = jax.device_put(state, buf_sh)
     opt_state = _put_opt_state(opt_state, s_sh)
 
@@ -649,9 +711,8 @@ def _build_pipeline_program(layer, optimizer, strategy, mesh, *, block_fn,
         out_shardings=(NamedSharding(mesh, P()), p_sh, buf_sh, s_sh),
         donate_argnums=(0, 2))
 
-    # may_alias=False on the donated params only (see compile_train_step)
-    flat = {k: jax.device_put(v, p_sh[k], may_alias=False)
-            for k, v in flat.items()}
+    # true copy on the donated params only (see compile_train_step)
+    flat = {k: unaliased_put(v, p_sh[k]) for k, v in flat.items()}
     state = jax.device_put(state, buf_sh)
     opt_state = _put_opt_state(opt_state, s_sh)
 
@@ -659,6 +720,7 @@ def _build_pipeline_program(layer, optimizer, strategy, mesh, *, block_fn,
                     {"params": p_sh, "opt": s_sh}, mesh, layer, data_sh)
     prog._opt = optimizer
     prog._n_layers = n_layers
+    prog._step_label = "fleet.pipeline_step"
 
     def _microbatch(d):
         if d.shape[0] % n_micro:
@@ -959,6 +1021,12 @@ class _PipelineTrainStep(CompiledTrainStep):
 
     def _write_back_stacked(self, lookup, stacked):
         for rel, arr in stacked.items():
+            name = "blocks." + rel
+            if name in lookup and \
+                    tuple(lookup[name]._data.shape) == tuple(arr.shape):
+                # scan layout: the layer itself holds the [L, ...] stack
+                lookup[name]._data = arr
+                continue
             for i in range(self._n_layers):
                 name = f"blocks.{i}.{rel}"
                 if name in lookup:
@@ -971,6 +1039,7 @@ class _PipelineTpTrainStep(_PipelineTrainStep):
     merge_block_params_tp)."""
 
     def _write_back_stacked(self, lookup, stacked):
+        scan_rows = {}          # scan layout: collect rows, stack once
         for i in range(self._n_layers):
             split_i = {rel: arr[i] for rel, arr in stacked.items()}
             for rel, arr in self.layer.merge_block_params_tp(
@@ -978,3 +1047,10 @@ class _PipelineTpTrainStep(_PipelineTrainStep):
                 name = f"blocks.{i}.{rel}"
                 if name in lookup:
                     lookup[name]._data = arr
+                else:
+                    scan_rows.setdefault(rel, []).append(arr)
+        for rel, rows in scan_rows.items():
+            name = "blocks." + rel
+            if name in lookup and len(rows) == self._n_layers:
+                lookup[name]._data = np.stack(
+                    [np.asarray(r) for r in rows])

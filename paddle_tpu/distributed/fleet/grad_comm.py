@@ -43,7 +43,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ...core import nan_inf
 from ...core import random as random_mod
 from ...framework import MethodAdapter, functional_call, param_arrays, \
-    state_arrays
+    state_arrays, unaliased_put
 
 
 def active_mode(strategy) -> str | None:
@@ -325,11 +325,9 @@ def compile_explicit_dp_step(layer, optimizer, strategy, mesh,
                                     s_sh),
                      donate_argnums=(0, 2))
 
-    # may_alias=False: donated program buffers (params, argnum 0) must
-    # never alias the layer's own arrays (see fleet/compiler.py)
-    params_l = jax.tree_util.tree_map(
-        lambda v, sh: jax.device_put(v, sh, may_alias=False),
-        params_l, p_sh)
+    # true copy: donated program buffers (params, argnum 0) must never
+    # alias the layer's own arrays (see fleet/compiler.py)
+    params_l = jax.tree_util.tree_map(unaliased_put, params_l, p_sh)
     state = jax.device_put(state, buf_sh)
     opt_bundle = jax.device_put({"opt": opt_l, "comm": comm}, s_sh)
 
@@ -337,6 +335,9 @@ def compile_explicit_dp_step(layer, optimizer, strategy, mesh,
     prog = cls(jitted, params_l, state, opt_bundle,
                {"params": p_sh, "opt": s_sh}, mesh, layer, data_sh)
     prog._opt = optimizer
+    # the shard_map step rides the shared CompiledTrainStep.step AOT +
+    # persistent-cache + retrace-guard path; label it for compile reports
+    prog._step_label = f"fleet.{mode}_step"
     return prog
 
 

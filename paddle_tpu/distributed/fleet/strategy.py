@@ -98,6 +98,12 @@ class DistributedStrategy:
         self.tensor_parallel = False
         self.sequence_parallel = False
         self.sequence_parallel_impl = "ring"   # "ring" | "ulysses"
+        # scan-over-layers (depth-invariant compile): False asks models
+        # built with a ScanBlockStack to unroll the stacked params in a
+        # Python loop instead of jax.lax.scan (compiler calls the layer's
+        # set_scan_unroll protocol). Per-model layout choice stays on the
+        # model config (e.g. GPTConfig.scan_layers).
+        self.scan_layers = True
         self.expert_parallel = False
         self.hybrid_configs = HybridConfig()
         self.find_unused_parameters = False

@@ -34,24 +34,32 @@ __all__ = ["shard_specs", "shard_params_and_state", "group_by_stage",
            "build_sharded_update"]
 
 
-def _first_divisible_dim(shape, n):
+def _first_divisible_dim(shape, n, start=0):
     for i, d in enumerate(shape):
-        if d % n == 0 and d >= n:
+        if i >= start and d % n == 0 and d >= n:
             return i
     return None
 
 
 def shard_specs(arrays: Dict[str, jax.Array], axis: str, n: int,
-                min_size: int = 1024) -> Dict[str, P]:
+                min_size: int = 1024, skip_leading=()) -> Dict[str, P]:
     """PartitionSpec per array: split the first dim divisible by the axis
     size; small or indivisible arrays stay replicated (paddle's shard.py
     keeps whole params per rank; dimension-splitting is strictly more
-    parallel and what pjit wants)."""
+    parallel and what pjit wants).
+
+    ``skip_leading`` names arrays whose dim 0 must stay whole — the
+    scan-stacked ``[layers, ...]`` params, where dim 0 is a lax.scan xs
+    axis (splitting it puts the loop counter into partitioned
+    dynamic-slice index arithmetic inside the scan transpose, which XLA's
+    SPMD partitioner miscompiles under x64); the split moves to the first
+    divisible per-block dim instead."""
     specs = {}
     for name, v in arrays.items():
         shape = tuple(getattr(v, "shape", ()))
         size = math.prod(shape) if shape else 0
-        dim = _first_divisible_dim(shape, n)
+        dim = _first_divisible_dim(shape, n,
+                                   start=1 if name in skip_leading else 0)
         if dim is None or size < min_size:
             specs[name] = P(*([None] * len(shape)))
         else:

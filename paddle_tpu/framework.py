@@ -138,6 +138,20 @@ def _from_saved(obj):
 # Functional bridge (the jit fast path)
 # ---------------------------------------------------------------------------
 
+def unaliased_put(v, sharding=None):
+    """device_put a TRUE copy of ``v`` (optionally onto ``sharding``).
+
+    ``jax.device_put(..., may_alias=False)`` still aliases the source
+    buffer on this jax build's CPU backend, so donating the result also
+    deletes the source — a Layer's own Tensor ends up pointing at a
+    deleted array after step 1. Route through ``jnp.array(copy=True)``
+    (an XLA copy, never an alias) before the placement."""
+    import jax.numpy as jnp
+
+    v = jnp.array(v, copy=True)
+    return v if sharding is None else jax.device_put(v, sharding)
+
+
 def param_arrays(layer) -> Dict[str, jax.Array]:
     """Trainable parameter payloads keyed by qualified name."""
     return {n: p._data for n, p in layer.named_parameters()

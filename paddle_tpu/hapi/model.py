@@ -23,6 +23,7 @@ from ..core import random as random_mod
 from ..core.tensor import Tensor
 from ..framework import functional_call
 from ..io import DataLoader
+from ..jit import compile_cache
 from ..metric import Metric
 from . import callbacks as cbks_mod
 
@@ -203,25 +204,30 @@ class Model:
                 "amp_configs is ignored on the strategy training path; "
                 "set strategy.amp=True (+ amp_configs.use_pure_bf16 for "
                 "O2) instead")
+        # wire the persistent XLA compile cache (PADDLE_TPU_COMPILE_CACHE,
+        # default ~/.cache/paddle_tpu/xla) before the first compile
+        compile_cache.setup_compilation_cache()
         self._invalidate()
 
     def _invalidate(self):
         self._dist_prog = None
         self._jit_step = self._jit_eval = self._jit_pred = None
         self._jit_grad = self._jit_apply = None
+        self._aot_step = None
+        self._retrace_guard = None
+        self._compile_stats = None
         self._accum_grads = None
         self._accum_count = 0
 
     # -- functional plumbing -------------------------------------------
     def _split_tree(self, copy=False):
-        from ..framework import param_arrays, state_arrays
+        from ..framework import param_arrays, state_arrays, unaliased_put
         params = param_arrays(self.network)
         state = state_arrays(self.network)
         if copy:
             # the jitted train step donates params: a no-copy split would
             # leave the network's own Tensors holding deleted buffers
-            params = {k: jax.device_put(v, may_alias=False)
-                      for k, v in params.items()}
+            params = {k: unaliased_put(v) for k, v in params.items()}
         return params, state
 
     def _write_back(self, params, state):
@@ -266,7 +272,8 @@ class Model:
                 params, grads, opt_state, lr=lr)
             return loss, outs, new_params, new_state, new_opt
 
-        return jax.jit(train_step, donate_argnums=(0, 2))
+        return jax.jit(train_step,
+                       donate_argnums=self._donate_argnums((0, 2), 2))
 
     def _build_grad_step(self):
         amp_on = self._amp_level in ("O1", "O2")
@@ -301,7 +308,17 @@ class Model:
         # donate params + opt slots only: donated grads have no matching
         # output to alias for slot-less optimizers (SGD), which made XLA
         # warn "Some donated buffers were not usable" on every fit
-        return jax.jit(apply_step, donate_argnums=(0, 1))
+        return jax.jit(apply_step,
+                       donate_argnums=self._donate_argnums((0, 1), 1))
+
+    def _donate_argnums(self, argnums, opt_argnum):
+        """Drop the opt_state argnum when the optimizer keeps no slots
+        (e.g. plain SGD): donating a leaf-less pytree arg makes XLA warn
+        "Some donated buffers were not usable" on every compile."""
+        opt_state = getattr(self, "_opt_state", None)
+        if not jax.tree_util.tree_leaves(opt_state):
+            return tuple(a for a in argnums if a != opt_argnum)
+        return tuple(argnums)
 
     def _build_eval_step(self):
         def eval_step(params, state, inputs, labels):
@@ -333,6 +350,10 @@ class Model:
                 def named_buffers(self, *a, **k):
                     return net.named_buffers(*a, **k)
 
+                def named_sublayers(self, *a, **k):
+                    # the compiler walks these for scan-stacked params
+                    return net.named_sublayers(*a, **k)
+
                 # train/eval must reach the real network: the pipelined
                 # eval builder flips the layer to eval mode around its
                 # trace (dropout blocks refuse keyless TRAIN traces)
@@ -355,7 +376,9 @@ class Model:
                               "pipeline_block_fn_sp",
                               # expert-parallel pipeline protocol
                               "pipeline_block_fn_ep", "block_ep_specs",
-                              "pipeline_block_emits_aux", "cfg")
+                              "pipeline_block_emits_aux", "cfg",
+                              # scan-over-layers unroll escape hatch
+                              "set_scan_unroll")
 
                 def __getattr__(self, name):
                     # expose the network's sharding/pipeline protocols to
@@ -437,7 +460,6 @@ class Model:
             return self._dist_train_batch(_as_list(inputs),
                                           _as_list(labels), sync=sync)
         if self._jit_step is None:
-            self._jit_step = self._build_train_step()
             self._params, self._state = self._split_tree(copy=True)
             restored = getattr(self, "_restored_opt_state", None)
             if restored is not None and set(restored) == set(self._params):
@@ -445,6 +467,12 @@ class Model:
             else:
                 self._opt_state = self._optimizer.functional_init(self._params)
             self._restored_opt_state = None
+            # opt_state must exist first: _build_train_step derives
+            # donate_argnums from whether the optimizer keeps slots
+            self._jit_step = self._build_train_step()
+            self._aot_step = None
+            self._retrace_guard = compile_cache.RetraceGuard(
+                "hapi.train_step")
         inputs = _to_jax(inputs)
         labels = _to_jax(labels)
         key = random_mod.next_key()
@@ -470,9 +498,25 @@ class Model:
                 self._accum_grads = None
                 self._accum_count = 0
         else:
+            args = (self._params, self._state, self._opt_state,
+                    key, lr, inputs, labels)
+            verdict = self._retrace_guard.check(inputs=inputs,
+                                                labels=labels)
+            if self._aot_step is None or verdict == "retrace":
+                # explicit AOT compile (timed, persistent-cache aware)
+                # instead of the first-step implicit trace; the compiled
+                # executable is called directly below — lowering does not
+                # seed the jit wrapper's own cache
+                try:
+                    self._aot_step, self._compile_stats = \
+                        compile_cache.aot_compile(self._jit_step, *args,
+                                                  label="hapi.train_step")
+                except compile_cache.RetraceError:
+                    raise
+                except Exception:  # exotic input: keep the implicit path
+                    self._aot_step = self._jit_step
             loss, outs, self._params, self._state, self._opt_state = \
-                self._jit_step(self._params, self._state, self._opt_state,
-                               key, lr, inputs, labels)
+                self._aot_step(*args)
         self._update_metrics(outs, labels)
         return [float(jax.device_get(loss))] if sync \
             else [_AsyncScalar(loss)]

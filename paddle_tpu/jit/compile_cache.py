@@ -1,0 +1,243 @@
+"""Depth-invariant-compilation support: persistent XLA compile cache,
+explicit AOT warmup, and a retrace guard.
+
+Three small pieces shared by the hapi single-device train step and the
+fleet ``CompiledTrainStep`` (SPMD / pipeline / explicit-DP shard_map — all
+strategy paths funnel through ``CompiledTrainStep.step``):
+
+* ``setup_compilation_cache()`` points ``jax_compilation_cache_dir`` at
+  ``PADDLE_TPU_COMPILE_CACHE`` (default ``~/.cache/paddle_tpu/xla``) so a
+  recompile of an identical HLO module is a disk read, not an XLA run.
+  Set the env var to ``0``/``off`` to disable.
+* ``aot_compile(jitted, *args)`` replaces the first-step implicit compile
+  with an explicit ``.lower().compile()``, timed and reported through
+  ``paddle_tpu.profiler.record_compile`` with a cache hit/miss verdict
+  (detected by diffing the cache directory around the compile).
+* ``RetraceGuard`` fingerprints the (shape, dtype, sharding) signature of
+  the step inputs; a mid-run change emits ONE structured warning naming
+  the input that changed instead of silently recompiling.
+  ``PADDLE_TPU_RETRACE=error`` escalates to ``RetraceError`` for CI;
+  ``=off`` silences the warning (the recompile still happens).
+"""
+from __future__ import annotations
+
+import os
+import time
+import warnings
+from typing import Any, Dict, Optional, Tuple
+
+__all__ = ["setup_compilation_cache", "suspend_compilation_cache",
+           "cache_dir", "aot_compile",
+           "RetraceGuard", "RetraceError", "RetraceWarning"]
+
+_DISABLED = ("", "0", "off", "none", "disabled", "false")
+
+# last directory applied to jax.config (setup is idempotent per dir)
+_configured: list = [None]
+
+
+def cache_dir() -> Optional[str]:
+    """Resolved persistent-cache directory, or None when disabled."""
+    d = os.environ.get("PADDLE_TPU_COMPILE_CACHE")
+    if d is None:
+        d = os.path.join("~", ".cache", "paddle_tpu", "xla")
+    if d.strip().lower() in _DISABLED:
+        return None
+    return os.path.expanduser(d)
+
+
+def setup_compilation_cache() -> Optional[str]:
+    """Idempotently wire jax's persistent compilation cache.
+
+    Returns the active cache directory, or None when disabled or when the
+    jax build does not support the persistent cache (never raises — a
+    missing cache only costs compile time)."""
+    d = cache_dir()
+    if d is None or _configured[0] == d:
+        return _configured[0]
+    try:
+        import jax
+
+        os.makedirs(d, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", d)
+        # the in-process cache object is created lazily on the FIRST
+        # compile — which usually happened (disabled) during framework
+        # import; reset so the new dir actually takes effect
+        try:
+            from jax.experimental.compilation_cache import (
+                compilation_cache as _cc)
+            _cc.reset_cache()
+        except Exception:
+            pass
+        # Default thresholds skip "cheap" (sub-second / small) compiles —
+        # exactly the CPU-test regime; cache everything instead.
+        for opt, val in (("jax_persistent_cache_min_compile_time_secs", 0),
+                         ("jax_persistent_cache_min_entry_size_bytes", -1)):
+            try:
+                jax.config.update(opt, val)
+            except Exception:
+                pass
+    except Exception:
+        return None
+    _configured[0] = d
+    return d
+
+
+def _cache_listing(d: Optional[str]) -> Optional[set]:
+    if d is None:
+        return None
+    try:
+        return set(os.listdir(d))
+    except OSError:
+        return None
+
+
+def suspend_compilation_cache() -> None:
+    """Detach the persistent cache (until the next
+    ``setup_compilation_cache`` call). Used for compiles that must not be
+    served from disk — deserializing a multi-device executable on the CPU
+    backend corrupts the heap (observed with forced-host-device meshes),
+    so those compiles opt out via ``aot_compile(use_cache=False)``."""
+    if _configured[0] is None:
+        return
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", None)
+        try:
+            from jax.experimental.compilation_cache import (
+                compilation_cache as _cc)
+            _cc.reset_cache()
+        except Exception:
+            pass
+    except Exception:
+        return
+    _configured[0] = None
+
+
+def aot_compile(jitted, *args, label: str = "step", use_cache: bool = True,
+                **kwargs) -> Tuple[Any, Dict[str, Any]]:
+    """Explicit ``jitted.lower(*args).compile()`` with timing + cache stats.
+
+    Returns ``(compiled_executable, stats)`` where stats holds ``label``,
+    ``compile_s`` and ``cache`` ("hit" | "miss" | "off"). The executable
+    must be called directly (lowering does NOT seed the jit wrapper's own
+    in-memory cache). Also records the compile via
+    ``paddle_tpu.profiler.record_compile`` so bench/tools can report it.
+    ``use_cache=False`` detaches the persistent cache for this compile
+    (see :func:`suspend_compilation_cache`)."""
+    if use_cache:
+        d = setup_compilation_cache()
+    else:
+        suspend_compilation_cache()
+        d = None
+    before = _cache_listing(d)
+    t0 = time.perf_counter()
+    compiled = jitted.lower(*args, **kwargs).compile()
+    dt = time.perf_counter() - t0
+    if before is None:
+        cache = "off"
+    else:
+        after = _cache_listing(d)
+        cache = "miss" if after is None or (after - before) else "hit"
+    stats = {"label": label, "compile_s": round(dt, 4), "cache": cache}
+    from .. import profiler
+
+    profiler.record_compile(label, dt, cache)
+    return compiled, stats
+
+
+# ---------------------------------------------------------------------------
+# retrace guard
+# ---------------------------------------------------------------------------
+
+class RetraceError(RuntimeError):
+    """Raised on a mid-run input-signature change under
+    ``PADDLE_TPU_RETRACE=error``."""
+
+
+class RetraceWarning(UserWarning):
+    """A compiled train step was handed inputs with a new signature."""
+
+
+def _leaf_sig(leaf) -> tuple:
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    sharding = getattr(leaf, "sharding", None)
+    if shape is None:  # python static arg: fingerprint by value
+        return ("static", repr(leaf))
+    return (tuple(shape), str(dtype),
+            None if sharding is None else str(sharding))
+
+
+def _fingerprint(named_trees: Dict[str, Any]) -> Dict[str, tuple]:
+    import jax
+
+    fp = {}
+    for group, tree in named_trees.items():
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        fp[group] = (str(treedef), tuple(_leaf_sig(l) for l in leaves))
+    return fp
+
+
+def _describe_diff(old: Dict[str, tuple], new: Dict[str, tuple]) -> str:
+    import jax
+
+    parts = []
+    for group in new:
+        o, n = old.get(group), new[group]
+        if o == n:
+            continue
+        if o is None:
+            parts.append(f"{group}: new input group")
+            continue
+        if o[0] != n[0]:
+            parts.append(f"{group}: pytree structure changed")
+            continue
+        for i, (a, b) in enumerate(zip(o[1], n[1])):
+            if a != b:
+                parts.append(f"{group}[leaf {i}]: {a} -> {b}")
+    for group in old:
+        if group not in new:
+            parts.append(f"{group}: input group removed")
+    return "; ".join(parts) or "signature changed"
+
+
+class RetraceGuard:
+    """Per-compiled-step input-signature watchdog.
+
+    ``check(**named_trees)`` returns ``"first"`` on the initial call,
+    ``"match"`` while the signature is stable, and ``"retrace"`` when it
+    changed — after emitting one :class:`RetraceWarning` naming the
+    changed input (or raising :class:`RetraceError` when
+    ``PADDLE_TPU_RETRACE=error``)."""
+
+    def __init__(self, label: str = "step"):
+        self.label = label
+        self._fp: Optional[Dict[str, tuple]] = None
+        self._warned = False
+
+    def reset(self):
+        self._fp = None
+        self._warned = False
+
+    def check(self, **named_trees) -> str:
+        fp = _fingerprint(named_trees)
+        if self._fp is None:
+            self._fp = fp
+            return "first"
+        if fp == self._fp:
+            return "match"
+        diff = _describe_diff(self._fp, fp)
+        mode = os.environ.get("PADDLE_TPU_RETRACE", "warn").strip().lower()
+        msg = (f"paddle_tpu retrace guard [{self.label}]: compiled-step "
+               f"input signature changed mid-run -> recompiling. "
+               f"Changed: {diff}. (PADDLE_TPU_RETRACE=error makes this "
+               f"fatal; =off silences it)")
+        if mode == "error":
+            raise RetraceError(msg)
+        if mode != "off" and not self._warned:
+            warnings.warn(msg, RetraceWarning, stacklevel=3)
+            self._warned = True  # one structured warning per run
+        self._fp = fp
+        return "retrace"

@@ -24,6 +24,10 @@ class BertConfig:
     ffn_mult: int = 4
     dropout: float = 0.0
     fused_head_ce: bool = None   # see GPTConfig.fused_head_ce
+    # scan-over-layers (see GPTConfig.scan_layers): stack encoder-layer
+    # params on a leading [layers] axis and run them as one lax.scan step
+    # so XLA compile time stays (near-)invariant in depth. Default on.
+    scan_layers: bool = True
 
 
 def bert_base(**kw):
@@ -55,7 +59,9 @@ class Bert(nn.Layer):
             d_model=cfg.hidden, nhead=cfg.heads,
             dim_feedforward=cfg.ffn_mult * cfg.hidden,
             dropout=cfg.dropout, activation="gelu", normalize_before=True)
-        self.encoder = nn.TransformerEncoder(enc_layer, num_layers=cfg.layers)
+        self.encoder = nn.TransformerEncoder(enc_layer,
+                                             num_layers=cfg.layers,
+                                             scan_layers=cfg.scan_layers)
         self.mlm_ln = nn.LayerNorm(cfg.hidden)
         self.mlm_fc = nn.Linear(cfg.hidden, cfg.hidden)
 
@@ -117,21 +123,29 @@ def bert_param_shardings(params, mesh_axis_tp="tp"):
              "linear1.weight")
     col_b = ("q_proj.bias", "k_proj.bias", "v_proj.bias", "linear1.bias")
     row_w = ("out_proj.weight", "linear2.weight")
+    import re
     specs = {}
     for name, v in params.items():
         ndim = len(v.shape)
+        # scan layout: "encoder.layers.{rel}" (no layer index) carries a
+        # leading [layers] scan axis — replicate it, shard per-block dims
+        stacked = ("encoder.layers." in name
+                   and not re.search(r"encoder\.layers\.\d+\.", name))
+        if stacked:
+            ndim -= 1
         if any(name.endswith(s) for s in col_w):
-            specs[name] = P(None, mesh_axis_tp)
+            spec = P(None, mesh_axis_tp)
         elif any(name.endswith(s) for s in col_b):
-            specs[name] = P(mesh_axis_tp)
+            spec = P(mesh_axis_tp)
         elif any(name.endswith(s) for s in row_w):
-            specs[name] = P(mesh_axis_tp, None)
+            spec = P(mesh_axis_tp, None)
         elif name.endswith("tok.weight"):
-            specs[name] = P(mesh_axis_tp, None)
+            spec = P(mesh_axis_tp, None)
         elif ndim >= 2:
-            specs[name] = P(*([None] * ndim))
+            spec = P(*([None] * ndim))
         else:
-            specs[name] = P()
+            spec = P()
+        specs[name] = P(None, *spec) if stacked else spec
     return specs
 
 

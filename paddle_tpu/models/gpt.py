@@ -45,6 +45,12 @@ class GPTConfig:
     moe_experts: int = 0         # >0: MoE FFN with this many experts
     moe_top_k: int = 2
     moe_aux_coef: float = 0.01   # Switch load-balance pressure
+    # scan-over-layers: stack block params on a leading [layers] axis and
+    # run the stack as one jax.lax.scan step, making HLO size and XLA
+    # compile time (near-)invariant in depth. None = auto: on unless MoE
+    # (aux losses cannot escape a scan body). False forces the unrolled
+    # Python loop (per-block LayerList).
+    scan_layers: bool = None
     # tied-head CE kernel choice: None = auto (XLA recompute path below
     # V=64k, Pallas streaming kernel above), True/False forces. True is
     # the memory-optimal setting for big models on one chip — the f32
@@ -259,7 +265,16 @@ class GPT(nn.Layer):
         self.wte = nn.Embedding(cfg.vocab_size, cfg.hidden, weight_attr=emb_init)
         self.wpe = nn.Embedding(cfg.max_seq_len, cfg.hidden, weight_attr=emb_init)
         self.drop = nn.Dropout(cfg.dropout)
-        self.blocks = nn.LayerList([Block(cfg) for _ in range(cfg.layers)])
+        scan = cfg.scan_layers
+        if scan is None:
+            scan = cfg.moe_experts == 0  # MoE aux losses can't leave a scan
+        elif scan and cfg.moe_experts > 0:
+            raise ValueError("scan_layers=True is incompatible with MoE "
+                             "blocks (collect_aux_losses cannot cross a "
+                             "jax.lax.scan body)")
+        per_block = [Block(cfg) for _ in range(cfg.layers)]
+        self.blocks = (nn.ScanBlockStack(per_block) if scan
+                       else nn.LayerList(per_block))
         self.ln_f = nn.LayerNorm(cfg.hidden)
         # weight tying (lm_head = wte.T) keeps the embedding matmul on-MXU
         # and halves embedding memory, standard for the GPT family.
@@ -285,7 +300,12 @@ class GPT(nn.Layer):
         from ..ops.creation import arange
         pos = arange(T, dtype="int64").unsqueeze(0)
         x = self.drop(self.wte(idx) + self.wpe(pos))
-        if getattr(self, "_recompute_blocks", False):
+        if isinstance(self.blocks, nn.ScanBlockStack):
+            self.blocks.set_recompute(
+                getattr(self, "_recompute_blocks", False),
+                getattr(self, "_recompute_policy", None))
+            x = self.blocks(x)
+        elif getattr(self, "_recompute_blocks", False):
             from ..distributed.fleet.utils import recompute
             pol = getattr(self, "_recompute_policy", None)
             for blk in self.blocks:
@@ -294,6 +314,13 @@ class GPT(nn.Layer):
             for blk in self.blocks:
                 x = blk(x)
         return self.ln_f(x)
+
+    def set_scan_unroll(self, flag=True):
+        """Escape hatch (DistributedStrategy.scan_layers = False): run the
+        stacked params through a Python loop instead of jax.lax.scan."""
+        if isinstance(self.blocks, nn.ScanBlockStack):
+            self.blocks.set_unroll(flag)
+        return self
 
     def forward(self, idx):
         x = self.forward_hidden(idx)
@@ -350,6 +377,14 @@ class GPT(nn.Layer):
                  if k.startswith(("wte.", "wpe."))}
         head = {k: v for k, v in params.items() if k.startswith("ln_f.")}
         blocks = []
+        if isinstance(self.blocks, nn.ScanBlockStack):
+            # scan layout: params carry stacked "blocks.{rel}" [L, ...]
+            # arrays — slice the leading axis back into per-stage dicts
+            stacked = {k[len("blocks."):]: v for k, v in params.items()
+                       if k.startswith("blocks.")}
+            for i in range(self.cfg.layers):
+                blocks.append({rel: v[i] for rel, v in stacked.items()})
+            return embed, blocks, head
         for i in range(self.cfg.layers):
             pref = f"blocks.{i}."
             blocks.append({k[len(pref):]: v for k, v in params.items()
@@ -873,23 +908,32 @@ def gpt_param_shardings(params, mesh_axis_tp="tp"):
     f/g collectives, but compiler-derived instead of hand-written.
     Embeddings shard over vocab/feature rows.
     """
+    import re
+
     from jax.sharding import PartitionSpec as P
     specs = {}
     for name, v in params.items():
         ndim = len(v.shape)
+        # scan layout: "blocks.{rel}" (no block index) carries a leading
+        # [layers] scan axis — shard the per-block dims, replicate layers
+        stacked = (name.startswith("blocks.")
+                   and not re.match(r"blocks\.\d+\.", name))
+        if stacked:
+            ndim -= 1
         if ".moe." in name and name.rsplit(".", 1)[-1] in (
                 "w_in", "b_in", "w_out", "b_out"):
-            specs[name] = P("ep", *([None] * (ndim - 1)))  # expert parallel
+            spec = P("ep", *([None] * (ndim - 1)))       # expert parallel
         elif "qkv.weight" in name or "fc1.weight" in name:
-            specs[name] = P(None, mesh_axis_tp)          # column parallel
+            spec = P(None, mesh_axis_tp)                 # column parallel
         elif "qkv.bias" in name or "fc1.bias" in name:
-            specs[name] = P(mesh_axis_tp)
+            spec = P(mesh_axis_tp)
         elif "proj.weight" in name or "fc2.weight" in name:
-            specs[name] = P(mesh_axis_tp, None)          # row parallel
+            spec = P(mesh_axis_tp, None)                 # row parallel
         elif "wte.weight" in name:
-            specs[name] = P(mesh_axis_tp, None)          # vocab parallel
+            spec = P(mesh_axis_tp, None)                 # vocab parallel
         elif ndim >= 2:
-            specs[name] = P(*([None] * ndim))
+            spec = P(*([None] * ndim))
         else:
-            specs[name] = P()                            # replicate ln/bias
+            spec = P()                                   # replicate ln/bias
+        specs[name] = P(None, *spec) if stacked else spec
     return specs

@@ -266,10 +266,23 @@ class Layer:
                     continue
                 seen.add(id(b))
                 dest[(lp + "." + name) if lp else name] = b
+        if use_hook:
+            # scan-stacked containers (nn.ScanBlockStack) export per-block
+            # `{i}.{rel}` entries so checkpoints stay layout-independent
+            for lp, layer in layers:
+                expand = getattr(layer, "_expand_state_dict", None)
+                if expand is not None:
+                    dest = expand(dest, lp)
         return dest
 
     def set_state_dict(self, state_dict, use_structured_name=True):
-        own = self.state_dict()
+        # collapse per-block entries back into any scan-stacked container
+        # so unrolled checkpoints load into stacked layouts (and vice versa)
+        for lp, layer in [("", self)] + list(self.named_sublayers()):
+            collapse = getattr(layer, "_collapse_state_dict", None)
+            if collapse is not None:
+                state_dict = collapse(dict(state_dict), lp)
+        own = self.state_dict(use_hook=False)
         missing, unexpected = [], []
         for name, value in state_dict.items():
             if name not in own:

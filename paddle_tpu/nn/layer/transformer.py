@@ -9,10 +9,12 @@ sharding specs apply cleanly under pjit.
 from __future__ import annotations
 
 import collections
+import contextlib
 
 import numpy as np
 
 from ...core.tensor import Tensor
+from ...framework import Parameter
 from ...ops import manipulation as M
 from .. import functional as F
 from .common import Dropout, Linear
@@ -22,7 +24,7 @@ from .norm import LayerNorm
 
 __all__ = ["MultiHeadAttention", "TransformerEncoderLayer",
            "TransformerEncoder", "TransformerDecoderLayer",
-           "TransformerDecoder", "Transformer"]
+           "TransformerDecoder", "Transformer", "ScanBlockStack"]
 
 
 def _convert_attention_mask(attn_mask, dtype):
@@ -149,16 +151,200 @@ class TransformerEncoderLayer(Layer):
         return self.self_attn.gen_cache(src)
 
 
-class TransformerEncoder(Layer):
-    def __init__(self, encoder_layer, num_layers, norm=None):
+class ScanBlockStack(Layer):
+    """A stack of homogeneous blocks run as ONE ``jax.lax.scan`` step.
+
+    The per-block parameters are stacked along a leading ``layers`` axis
+    and registered on this container under the block-relative names (e.g.
+    ``attn.qkv.weight`` with shape ``[L, ...]``), so the traced HLO — and
+    therefore XLA compile time — is (near-)invariant in depth. The first
+    block is kept (unregistered) as the structural template the scan body
+    calls through ``framework.functional_call``.
+
+    Checkpoints stay layout-independent: ``state_dict`` exports canonical
+    per-block ``{i}.{rel}`` entries and ``set_state_dict`` accepts either
+    layout (via the ``_expand_state_dict``/``_collapse_state_dict`` hooks
+    consumed by ``Layer.state_dict``/``set_state_dict``).
+
+    Remat composes: ``set_recompute(True, policy)`` wraps the scan body in
+    ``jax.checkpoint`` so activation memory stays bounded by one block.
+    ``set_unroll(True)`` is the escape hatch that runs the same stacked
+    parameters through a Python loop (used for debugging and by
+    ``DistributedStrategy.scan_layers = False``).
+    """
+
+    # marker the fleet compiler reads: dim 0 of every param here is a
+    # lax.scan xs axis and must never take a mesh-axis split
+    _scan_stack = True
+
+    def __init__(self, blocks):
         super().__init__()
-        import copy
-        self.layers = LayerList([encoder_layer] + [
-            _clone_layer(encoder_layer) for _ in range(num_layers - 1)])
+        blocks = list(blocks)
+        if not blocks:
+            raise ValueError("ScanBlockStack needs at least one block")
+        import jax.numpy as jnp
+        template = blocks[0]
+        if dict(template.named_buffers()):
+            raise NotImplementedError(
+                "ScanBlockStack blocks must be buffer-free (stateful "
+                "buffers cannot ride a scan carry); use an unrolled "
+                "LayerList instead")
+        self.num_layers = len(blocks)
+        # keep the template OUT of _sub_layers / named_parameters: it is
+        # structure only — its weights are shadowed by the stacked ones
+        self.__dict__["_scan_template"] = template
+        self._rels = [n for n, _ in template.named_parameters()]
+        per_block = [dict(b.named_parameters()) for b in blocks]
+        for rel in self._rels:
+            stacked = jnp.stack([pb[rel]._data for pb in per_block])
+            p = Parameter(stacked, trainable=True)
+            # rel names contain dots; register directly (bypasses
+            # __setattr__, which is attribute-name based anyway)
+            self._parameters[rel] = p
+        self._recompute = False
+        self._recompute_policy = None
+        self._unroll = False
+
+    # -- template access (pipeline_fns etc. read config attrs off blk[0]) --
+    @property
+    def template(self):
+        return self.__dict__["_scan_template"]
+
+    def __len__(self):
+        return self.num_layers
+
+    def __getitem__(self, idx):
+        # every block is structurally identical; hand out the template
+        # for config reads (ln eps, capacity factors, ...)
+        if not -self.num_layers <= idx < self.num_layers:
+            raise IndexError(idx)
+        return self.template
+
+    # -- knobs --------------------------------------------------------------
+    def set_recompute(self, flag, policy=None):
+        self._recompute = bool(flag)
+        self._recompute_policy = policy
+
+    def set_unroll(self, flag):
+        self._unroll = bool(flag)
+
+    # -- forward ------------------------------------------------------------
+    def forward(self, x, *extras):
+        import jax
+
+        from ...core import random as random_mod
+        from ...framework import functional_call
+        tmpl = self.template
+        if tmpl.training != self.training:
+            (tmpl.train if self.training else tmpl.eval)()
+        stacked = {rel: self._parameters[rel]._data for rel in self._rels}
+        carry = x._data if isinstance(x, Tensor) else x
+        extras = tuple(e._data if isinstance(e, Tensor) else e
+                       for e in extras)
+
+        def body(carry, per_layer):
+            bp, key = per_layer
+            ctx = (random_mod.key_scope(key) if key is not None
+                   else contextlib.nullcontext())
+            with ctx:
+                out, _ = functional_call(tmpl, bp, {}, carry, *extras,
+                                         mutable_state=False)
+            return out, None
+
+        # a single trace-time key draw would reuse one dropout mask for
+        # every layer — thread per-layer keys through the scan xs instead
+        if self.training:
+            keys = jax.random.split(random_mod.next_key(), self.num_layers)
+        else:
+            keys = None
+
+        if self._unroll:
+            out = carry
+            for i in range(self.num_layers):
+                bp = {rel: arr[i] for rel, arr in stacked.items()}
+                out, _ = body(out, (bp, None if keys is None else keys[i]))
+            return Tensor(out)
+
+        step = body
+        if self._recompute:
+            step = jax.checkpoint(step, policy=self._recompute_policy)
+        if keys is None:
+            out, _ = jax.lax.scan(lambda c, bp: step(c, (bp, None)),
+                                  carry, stacked)
+        else:
+            out, _ = jax.lax.scan(step, carry, (stacked, keys))
+        return Tensor(out)
+
+    # -- checkpoint layout round-trip ---------------------------------------
+    def _expand_state_dict(self, dest, prefix):
+        """Replace stacked `{prefix}.{rel}` entries with canonical
+        per-block `{prefix}.{i}.{rel}` slices (LayerList naming)."""
+        pfx = prefix + "." if prefix else ""
+        out = collections.OrderedDict()
+        for name, value in dest.items():
+            rel = name[len(pfx):] if name.startswith(pfx) else None
+            if rel in self._rels:
+                for i in range(self.num_layers):
+                    out[f"{pfx}{i}.{rel}"] = Tensor(value._data[i])
+            else:
+                out[name] = value
+        return out
+
+    def _collapse_state_dict(self, sd, prefix):
+        """Stack incoming per-block `{prefix}.{i}.{rel}` entries into the
+        stacked layout; already-stacked entries pass through untouched."""
+        import jax.numpy as jnp
+        pfx = prefix + "." if prefix else ""
+        groups = {}          # rel -> {i: value}
+        out = {}
+        for name, value in sd.items():
+            rel = None
+            if name.startswith(pfx) or not pfx:
+                tail = name[len(pfx):]
+                head, _, r = tail.partition(".")
+                if head.isdigit() and r in self._rels:
+                    rel, idx = r, int(head)
+            if rel is None:
+                out[name] = value
+                continue
+            groups.setdefault(rel, {})[idx] = value
+        for rel, by_idx in groups.items():
+            if set(by_idx) != set(range(self.num_layers)):
+                # partial block set: surface as unexpected keys downstream
+                for idx, value in by_idx.items():
+                    out[f"{pfx}{idx}.{rel}"] = value
+                continue
+            arrs = []
+            for i in range(self.num_layers):
+                v = by_idx[i]
+                arrs.append(v._data if isinstance(v, Tensor)
+                            else np.asarray(v))
+            out[f"{pfx}{rel}"] = jnp.stack(
+                [jnp.asarray(a) for a in arrs])
+        return out
+
+
+class TransformerEncoder(Layer):
+    def __init__(self, encoder_layer, num_layers, norm=None,
+                 scan_layers=False):
+        super().__init__()
+        blocks = [encoder_layer] + [
+            _clone_layer(encoder_layer) for _ in range(num_layers - 1)]
+        self.layers = (ScanBlockStack(blocks) if scan_layers
+                       else LayerList(blocks))
         self.num_layers = num_layers
         self.norm = norm
 
     def forward(self, src, src_mask=None, cache=None):
+        if isinstance(self.layers, ScanBlockStack):
+            if cache is not None:
+                raise NotImplementedError(
+                    "incremental decode needs per-layer caches; build the "
+                    "encoder with scan_layers=False for cached inference")
+            output = self.layers(src, src_mask)
+            if self.norm is not None:
+                output = self.norm(output)
+            return output
         output = src
         new_caches = []
         for i, mod in enumerate(self.layers):
@@ -172,6 +358,10 @@ class TransformerEncoder(Layer):
         return output if cache is None else (output, new_caches)
 
     def gen_cache(self, src):
+        if isinstance(self.layers, ScanBlockStack):
+            raise NotImplementedError(
+                "gen_cache requires per-layer blocks; build the encoder "
+                "with scan_layers=False for cached inference")
         return [layer.gen_cache(src) for layer in self.layers]
 
 

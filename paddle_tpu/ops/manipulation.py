@@ -123,7 +123,11 @@ def split(x, num_or_sections, axis=0, name=None):
                  for s in num_or_sections]
         residual = dim - sum(s for s in sizes if s >= 0)
         sizes = [residual if s < 0 else s for s in sizes]
-    offsets = np.cumsum([0] + sizes[:-1]).tolist()
+    # int32 offsets: under x64 a python-int start index becomes an s64
+    # constant, and the transposed dynamic_update_slice then mixes s64/s32
+    # in the SPMD partitioner's offset arithmetic (verifier error when the
+    # split sits inside a partitioned lax.scan body)
+    offsets = [np.int32(o) for o in np.cumsum([0] + sizes[:-1])]
 
     def f(a):
         return tuple(jax.lax.dynamic_slice_in_dim(a, o, s, axis)

@@ -25,10 +25,12 @@ from typing import Dict, List, Optional
 import jax
 
 __all__ = ["RecordEvent", "start_profiler", "stop_profiler", "profiler",
-           "start_trace", "stop_trace", "is_profiling", "summary"]
+           "start_trace", "stop_trace", "is_profiling", "summary",
+           "record_compile", "compile_events", "reset_compile_events"]
 
 _lock = threading.Lock()
 _events: List[tuple] = []      # (name, start, dur, thread_id)
+_compiles: List[dict] = []     # {label, compile_s, cache}
 _enabled = False
 
 
@@ -79,6 +81,32 @@ def _op_hook(op_name):
 from ..core import tensor as _tensor_mod
 
 _tensor_mod._profiler_hook[0] = _op_hook
+
+
+def record_compile(label: str, seconds: float, cache: str = "off"):
+    """Record one XLA compile (jit/compile_cache.aot_compile feeds this).
+
+    Always collected — compiles are rare and the bench needs them even
+    with the host profiler off; also lands in the event table when the
+    profiler IS on."""
+    with _lock:
+        _compiles.append({"label": label, "compile_s": float(seconds),
+                          "cache": cache})
+        if _enabled:
+            _events.append((f"compile::{label}",
+                            time.perf_counter() - seconds, seconds,
+                            threading.get_ident()))
+
+
+def compile_events() -> List[dict]:
+    """Compiles recorded so far: [{label, compile_s, cache}, ...]."""
+    with _lock:
+        return [dict(e) for e in _compiles]
+
+
+def reset_compile_events():
+    with _lock:
+        _compiles.clear()
 
 
 def start_profiler(state: str = "All", tracer_option: str = "Default"):

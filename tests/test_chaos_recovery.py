@@ -18,6 +18,10 @@ from paddle_tpu.testing import chaos
 from paddle_tpu.utils.retry import (DeadlineExceeded, WatchdogTimeout,
                                     call_with_watchdog, retry_call)
 
+# fault-injection sweeps (timed retries/watchdogs) dominate tier-1 wall
+# clock; run them in the slow lane
+pytestmark = pytest.mark.slow
+
 
 # -- chaos harness ------------------------------------------------------------
 

@@ -104,9 +104,12 @@ def test_zero2_resume_across_dp_sizes(tmp_path):
     lossesC = [float(jax.device_get(progC.step(x, y, lr=1e-3)))
                for x, y in batches[2:]]
     np.testing.assert_allclose(lossesA[2:], lossesC, atol=3e-4)
-    # ZeRO slot sharding survives the restore
+    # ZeRO slot sharding survives the restore; the scan layout keeps the
+    # leading [layers] axis whole and splits a per-block dim instead
     k = [k for k in progC.opt_state if "fc1.weight" in k][0]
-    assert progC.opt_state[k]["moment1"].sharding.spec == P("dp", None)
+    spec = progC.opt_state[k]["moment1"].sharding.spec
+    assert "dp" in tuple(spec)
+    assert spec[0] is None
 
 
 def test_save_load_checkpoint_wrappers(tmp_path):

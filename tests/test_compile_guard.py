@@ -1,0 +1,174 @@
+"""Compile observability plumbing (jit/compile_cache.py): persistent
+XLA-cache hit/miss detection across two Model.prepare cycles, the retrace
+guard (one structured warning on a mid-fit batch-shape change;
+PADDLE_TPU_RETRACE=error escalates), and the fleet mesh fail-fast
+warning."""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.hapi import Model
+from paddle_tpu.io import TensorDataset
+from paddle_tpu.jit import compile_cache
+from paddle_tpu.static import InputSpec
+
+X = np.random.default_rng(0).standard_normal((64, 8)).astype("float32")
+Y = np.random.default_rng(1).integers(0, 2, (64,)).astype("int64")
+
+
+def _model(optimizer_cls=opt.Adam):
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+    m = Model(net, inputs=[InputSpec([None, 8], "float32")],
+              labels=[InputSpec([None], "int64")])
+    m.prepare(optimizer_cls(learning_rate=1e-3,
+                            parameters=m.parameters()),
+              loss=nn.CrossEntropyLoss())
+    return m
+
+
+def test_cache_miss_then_hit_across_prepares(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_COMPILE_CACHE", str(tmp_path))
+    compile_cache._configured[0] = None      # force re-wire to the tmpdir
+    m1 = _model()
+    m1.train_batch([X[:16]], [Y[:16]])
+    assert m1._compile_stats["cache"] == "miss"
+    assert m1._compile_stats["compile_s"] > 0
+
+    m2 = _model()                            # second prepare, same HLO
+    m2.train_batch([X[:16]], [Y[:16]])
+    assert m2._compile_stats["cache"] == "hit"
+    # a hit reads the executable from disk instead of recompiling
+    assert m2._compile_stats["compile_s"] < m1._compile_stats["compile_s"]
+
+    from paddle_tpu import profiler
+    labels = [e["label"] for e in profiler.compile_events()]
+    assert "hapi.train_step" in labels
+
+
+def test_cache_disabled_via_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_COMPILE_CACHE", "off")
+    compile_cache._configured[0] = None
+    assert compile_cache.cache_dir() is None
+    m = _model()
+    m.train_batch([X[:16]], [Y[:16]])
+    assert m._compile_stats["cache"] == "off"
+
+
+def test_retrace_guard_warns_once_and_recompiles(monkeypatch):
+    monkeypatch.delenv("PADDLE_TPU_RETRACE", raising=False)
+    m = _model()
+    m.train_batch([X[:16]], [Y[:16]])
+    with pytest.warns(compile_cache.RetraceWarning, match="hapi.train_step"):
+        m.train_batch([X[:8]], [Y[:8]])      # batch 16 -> 8: one warning
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", compile_cache.RetraceWarning)
+        m.train_batch([X[:16]], [Y[:16]])    # changes again: stays silent
+
+
+def test_retrace_guard_identifies_changed_input(monkeypatch):
+    monkeypatch.delenv("PADDLE_TPU_RETRACE", raising=False)
+    m = _model()
+    m.train_batch([X[:16]], [Y[:16]])
+    with pytest.warns(compile_cache.RetraceWarning) as rec:
+        m.train_batch([X[:8]], [Y[:8]])
+    msg = str(rec[0].message)
+    assert "inputs" in msg and "(16, 8)" in msg and "(8, 8)" in msg
+
+
+def test_retrace_guard_error_mode(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_RETRACE", "error")
+    m = _model()
+    m.train_batch([X[:16]], [Y[:16]])
+    with pytest.raises(compile_cache.RetraceError):
+        m.train_batch([X[:8]], [Y[:8]])
+
+
+def test_retrace_guard_mid_fit(monkeypatch):
+    """A non-divisible final batch is the classic silent-retrace source."""
+    monkeypatch.delenv("PADDLE_TPU_RETRACE", raising=False)
+    m = _model()
+    ds = TensorDataset([X[:24], Y[:24]])     # 24 = 16 + trailing 8
+    with pytest.warns(compile_cache.RetraceWarning):
+        m.fit(ds, batch_size=16, epochs=1, verbose=0, shuffle=False)
+
+
+def test_retrace_guard_unit():
+    g = compile_cache.RetraceGuard("unit")
+    a = {"x": np.zeros((4, 2), np.float32)}
+    assert g.check(data=a) == "first"
+    assert g.check(data=a) == "match"
+    with pytest.warns(compile_cache.RetraceWarning):
+        assert g.check(data={"x": np.zeros((2, 2), np.float32)}) \
+            == "retrace"
+
+
+def test_sgd_slotless_donation_skips_opt_state():
+    """Slot-less SGD must not donate the (leaf-less) opt_state arg —
+    that's what produced 'Some donated buffers were not usable'."""
+    m = _model(optimizer_cls=opt.SGD)
+    loss0 = m.train_batch([X[:16]], [Y[:16]])[0]
+    loss1 = m.train_batch([X[:16]], [Y[:16]])[0]
+    assert np.isfinite(loss0) and np.isfinite(loss1)
+    import jax
+    if not jax.tree_util.tree_leaves(m._opt_state):
+        assert m._donate_argnums((0, 2), 2) == (0,)
+
+
+def test_layer_tensors_survive_donated_steps():
+    """The compiled step donates its param buffers; the Layer's own
+    Tensors must never alias them (device_put(may_alias=False) still
+    aliases on this jax build, so seeding goes through a true copy)."""
+    import jax
+    import paddle_tpu.optimizer as popt
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+    from paddle_tpu.distributed.fleet.compiler import compile_train_step
+    from paddle_tpu.models import GPT, gpt_tiny
+
+    paddle.seed(0)
+    m = GPT(gpt_tiny())
+    s = DistributedStrategy()
+    mesh = s.build_mesh()
+    prog = compile_train_step(
+        m, popt.Adam(learning_rate=1e-3, parameters=list(m.parameters())),
+        s, mesh=mesh)
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 512, (8, 32)).astype(np.int64)
+    y = rng.integers(0, 512, (8, 32)).astype(np.int64)
+    for _ in range(2):
+        prog.step(x, y, lr=1e-3)
+    dead = [k for k, p in m.named_parameters() if p._data.is_deleted()]
+    assert not dead, f"layer params deleted by donation: {dead[:3]}"
+    m.state_dict()          # the user-visible symptom: state_dict raises
+
+
+def test_fleet_init_warns_on_mesh_failure():
+    import paddle_tpu.distributed.fleet as fleet
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+    s = DistributedStrategy()
+    s.hybrid_configs.dp_degree = 3
+    s.hybrid_configs.mp_degree = 5            # 3*5=15 != 8 devices
+    with pytest.warns(RuntimeWarning, match="mesh build failed"):
+        fleet.init(strategy=s)
+
+
+def test_strategy_path_records_compile(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_COMPILE_CACHE", str(tmp_path))
+    compile_cache._configured[0] = None
+    from paddle_tpu import profiler
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+    profiler.reset_compile_events()
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+    m = Model(net, inputs=[InputSpec([None, 8], "float32")],
+              labels=[InputSpec([None], "int64")])
+    m.prepare(opt.Adam(learning_rate=1e-3, parameters=m.parameters()),
+              loss=nn.CrossEntropyLoss(), strategy=DistributedStrategy())
+    m.train_batch([X[:16]], [Y[:16]])
+    events = profiler.compile_events()
+    assert any(e["label"] == "fleet.train_step" for e in events)
+    assert m._dist_prog.compile_stats["compile_s"] > 0

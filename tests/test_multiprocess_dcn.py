@@ -15,6 +15,10 @@ import sys
 
 import pytest
 
+# spawns 2 real processes that each import jax + the framework — a
+# multichip-shaped integration test, not a tier-1 unit test
+pytestmark = pytest.mark.slow
+
 WORKER = r'''
 import os, sys
 sys.path.insert(0, os.environ["REPO_ROOT"])
