@@ -28,16 +28,16 @@ PEAK_FLOPS = {"v4": 275e12, "v5e": 197e12, "v5litepod": 197e12,
               "v5p": 459e12, "v6e": 918e12}
 
 
-def peak_flops():
+def peak_flops(devs=None):
     gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
     for k, v in PEAK_FLOPS.items():
         if k in gen:
             return v
-    import jax
-    kind = jax.devices()[0].device_kind.lower()
-    for k, v in PEAK_FLOPS.items():
-        if k in kind.replace(" ", ""):
-            return v
+    if devs:        # no raw jax.devices() probe here — see the fallback
+        kind = devs[0].device_kind.lower()
+        for k, v in PEAK_FLOPS.items():
+            if k in kind.replace(" ", ""):
+                return v
     return 197e12
 
 
@@ -47,8 +47,17 @@ def _devices_or_cpu_fallback():
     once with JAX_PLATFORMS=cpu so the bench still runs in smoke mode
     and emits its JSON line; if even CPU init fails, emit an error JSON
     (rc 0) so the harness gets a parseable result instead of a
-    traceback."""
+    traceback. Returns the device list — main() must use it instead of
+    re-probing jax.devices() (a second raw probe re-raises the very
+    error this fallback exists to absorb: BENCH_r05 died rc=1 that way)."""
     import jax
+    if os.environ.get("_PADDLE_TPU_BENCH_CPU_FALLBACK"):
+        # an out-of-tree accelerator plugin overrides JAX_PLATFORMS from
+        # the env; only the config knob reliably pins the CPU backend
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
     try:
         return jax.devices()
     except Exception as e:                      # backend init failure
@@ -63,6 +72,16 @@ def _devices_or_cpu_fallback():
             "retrying on CPU (JAX_PLATFORMS=cpu)\n")
         env = dict(os.environ, JAX_PLATFORMS="cpu",
                    _PADDLE_TPU_BENCH_CPU_FALLBACK="1")
+        # the CPU build aborts on unknown --xla_tpu_* flags; drop any
+        # TPU-only knobs forwarded for the (failed) TPU target. Inline
+        # (not core.flags.strip_xla_overlap_flags) so the error path
+        # never depends on a framework import.
+        xf = [t for t in env.get("XLA_FLAGS", "").split()
+              if not t.startswith("--xla_tpu_")]
+        if xf:
+            env["XLA_FLAGS"] = " ".join(xf)
+        else:
+            env.pop("XLA_FLAGS", None)
         os.execve(sys.executable,
                   [sys.executable, os.path.abspath(__file__)]
                   + sys.argv[1:], env)
@@ -99,7 +118,7 @@ def _compile_watchdog():
 def main():
     import jax
 
-    _devices_or_cpu_fallback()
+    devs = _devices_or_cpu_fallback()
 
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
@@ -110,14 +129,14 @@ def main():
     from paddle_tpu.models import GPT, GPTConfig
     from paddle_tpu.static import InputSpec
 
-    on_cpu = jax.devices()[0].platform == "cpu"
+    on_cpu = devs[0].platform == "cpu"
     if on_cpu:  # smoke-mode so the bench is debuggable off-TPU
         cfg = GPTConfig(vocab_size=512, max_seq_len=128, hidden=128,
                         layers=2, heads=4)
         B, T, n_short, n_long = 2, 128, 1, 3
         # multichip smoke (xla_force_host_platform_device_count): the
         # global batch must stay divisible by the dp degree
-        B = max(B, len(jax.devices()))
+        B = max(B, len(devs))
     else:
         cfg = GPTConfig()                      # GPT-2 124M
         # B=16 is the single-chip sweet spot with the fused-CE head (no
@@ -148,7 +167,7 @@ def main():
     # softmax/LN/CE stay f32; master params and Adam state are f32.
     s.amp = True
     s.amp_configs.use_pure_bf16 = True
-    n_dev = len(jax.devices())
+    n_dev = len(devs)
     if n_dev > 1:
         # fail fast with a parseable error when the mesh cannot be built
         # (fleet.init only warns and leaves the mesh unset — on multichip
@@ -190,6 +209,8 @@ def main():
     fit_time(ds_short)                          # compile + warmup
     if watchdog is not None:
         watchdog.cancel()
+    from paddle_tpu import profiler
+    profiler.reset_step_timeline()  # report overlap for timed runs only
     estimates, loss = [], float("nan")
     for _ in range(2):
         dt_short, _ = fit_time(ds_short)
@@ -202,7 +223,7 @@ def main():
     assert np.isfinite(loss)
 
     tokens_per_sec = B * T / step_time
-    mfu = tokens_per_sec * gpt.flops_per_token(T) / peak_flops()
+    mfu = tokens_per_sec * gpt.flops_per_token(T) / peak_flops(devs)
 
     if "--breakdown" in sys.argv:
         # step-time decomposition (stderr; stdout stays one JSON line);
@@ -237,12 +258,18 @@ def main():
 
     # compile observability: total explicit-AOT compile seconds and the
     # persistent-cache verdict ("hit" only when every compile hit)
-    from paddle_tpu import profiler
     compiles = profiler.compile_events()
     compile_s = round(sum(e["compile_s"] for e in compiles), 3)
     verdicts = {e["cache"] for e in compiles}
     compile_cache = ("off" if not verdicts or verdicts == {"off"}
                      else "miss" if "miss" in verdicts else "hit")
+
+    # async-pipeline observability (jit/async_pipeline feeding the
+    # profiler step timeline over the timed runs): total host wall-clock
+    # actually blocked on device results, max steps in flight, and the
+    # mean host dispatch gap vs device step time (overlap is proven when
+    # gap < device step time)
+    async_stats = profiler.step_timeline_summary()
 
     print(json.dumps({
         "metric": "gpt2_124m_fit_tokens_per_sec" if not on_cpu
@@ -252,6 +279,10 @@ def main():
         "vs_baseline": round(mfu / 0.45, 4),
         "compile_s": compile_s,
         "compile_cache": compile_cache,
+        "steps_in_flight": async_stats["steps_in_flight"],
+        "host_blocked_s": async_stats["host_blocked_s"],
+        "dispatch_gap_s": async_stats["dispatch_gap_s"],
+        "device_step_s": async_stats["device_step_s"],
     }))
 
 

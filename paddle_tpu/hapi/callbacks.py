@@ -136,12 +136,23 @@ class ProgBarLogger(Callback):
         self._step += 1
         if self.verbose == 2 and self._step % self.log_freq == 0:
             steps = self.params.get("steps")
-            print(f"step {self._step}/{steps or '?'} - {self._fmt(logs)}")
+            # formatting logs forces the async loss fetch (the value is a
+            # lazy on-device handle under PADDLE_TPU_ASYNC_STEPS); a
+            # coarse log_freq keeps the steps between log points
+            # free-running, and throughput here is measured over that
+            # whole window, not the (host-blocked) log step alone
+            dt = time.time() - self._epoch_t0
+            rate = f" - {self._step / dt:.1f} steps/s" if dt > 0 else ""
+            print(f"step {self._step}/{steps or '?'} - "
+                  f"{self._fmt(logs)}{rate}")
 
     def on_epoch_end(self, epoch, logs=None):
         if self.verbose:
             dt = time.time() - getattr(self, "_epoch_t0", self._t0)
-            print(f"epoch {epoch + 1} done ({dt:.1f}s) - {self._fmt(logs)}")
+            rate = f" - {self._step / dt:.1f} steps/s" \
+                if dt > 0 and self._step else ""
+            print(f"epoch {epoch + 1} done ({dt:.1f}s) - "
+                  f"{self._fmt(logs)}{rate}")
 
     def on_eval_end(self, logs=None):
         if self.verbose:

@@ -656,6 +656,15 @@ class Model:
 
     def _fit_epochs(self, epochs, train_loader, eval_loader, eval_freq,
                     batch_size, num_iters, prefetch_device, cbks, logs):
+        from ..jit import async_pipeline as _apipe
+        window = _apipe.async_steps()
+        # window 0: synchronous stepping (fetch the loss every step) —
+        # the bit-identical reference for the async path. window >= 1:
+        # keep up to that many steps in flight, block_until_ready on the
+        # oldest ticket for backpressure, fetch metrics lazily.
+        pipeline = (_apipe.AsyncStepPipeline(window, label="hapi.fit")
+                    if window >= 1 else None)
+        self._async_pipeline = pipeline
         global_step = 0
         for epoch in range(epochs):
             if self.stop_training:
@@ -667,20 +676,47 @@ class Model:
                 from ..io.dataloader import device_prefetch
                 # strategy path: place batches directly onto the step's
                 # data sharding (known once the first batch has compiled;
-                # epoch 0 falls back to default placement)
-                sh = getattr(getattr(self, "_dist_prog", None),
-                             "data_sharding", None)
-                it = device_prefetch(iter(train_loader), sharding=sh)
-            for step, batch in enumerate(it):
-                cbks.on_batch_begin("train", step, logs)
-                ins, lbls = self._split_batch(batch)
-                losses = self.train_batch(ins, lbls, sync=False)
-                logs = self._step_logs(losses, step, batch_size)
-                cbks.on_batch_end("train", step, logs)
-                global_step += 1
-                if num_iters is not None and global_step >= num_iters:
-                    self.stop_training = True
-                    break
+                # epoch 0 falls back to default placement). put_batch
+                # additionally applies the step's host-side preproc
+                # (pipeline microbatching) off the critical path.
+                prog = getattr(self, "_dist_prog", None)
+                sh = getattr(prog, "data_sharding", None)
+                place = getattr(prog, "put_batch", None)
+                it = device_prefetch(iter(train_loader), sharding=sh,
+                                     place=place)
+            it = iter(it)
+            step = 0
+            try:
+                while True:
+                    t0 = time.perf_counter()
+                    try:
+                        batch = next(it)
+                    except StopIteration:
+                        break
+                    collate_s = time.perf_counter() - t0
+                    cbks.on_batch_begin("train", step, logs)
+                    ins, lbls = self._split_batch(batch)
+                    t1 = time.perf_counter()
+                    losses = self.train_batch(ins, lbls,
+                                              sync=pipeline is None)
+                    dispatch_s = time.perf_counter() - t1
+                    if pipeline is not None and losses:
+                        pipeline.submit(losses[0], global_step,
+                                        collate_s=collate_s,
+                                        dispatch_s=dispatch_s)
+                    logs = self._step_logs(losses, step, batch_size)
+                    cbks.on_batch_end("train", step, logs)
+                    step += 1
+                    global_step += 1
+                    if num_iters is not None and global_step >= num_iters:
+                        self.stop_training = True
+                        break
+            finally:
+                # retire outstanding tickets before eval/save callbacks
+                # touch the params, and surface any deferred step failure
+                # (AsyncStepError names the poisoned step) inside fit
+                if pipeline is not None:
+                    pipeline.drain()
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
                 eval_logs = self.evaluate(eval_loader, verbose=0,
                                           _inside_fit=cbks)

@@ -472,7 +472,7 @@ class _MultiprocessIter:
         self._workers = []
 
 
-def device_prefetch(iterator, sharding=None, depth=2):
+def device_prefetch(iterator, sharding=None, depth=2, place=None):
     """Overlap host->device transfer with compute: a background thread
     device_puts upcoming batches (double buffering by default). Reference
     capability: operators/reader/buffered_reader.cc (device-buffered
@@ -480,7 +480,13 @@ def device_prefetch(iterator, sharding=None, depth=2):
 
         for xb, yb in io.device_prefetch(loader, sharding=data_sharding):
             step(xb, yb)
-    """
+
+    `place` (optional) overrides the per-leaf placement: a callable
+    `array -> device array` applied to each batch leaf on the feeder
+    thread. The fleet step path passes `CompiledTrainStep.put_batch` so
+    host-side preproc (pipeline microbatching) AND the sharded
+    device_put both happen off the critical path; `step()` then detects
+    already-placed arrays and skips the per-step transfer."""
     import jax
 
     def _fit_sharding(x):
@@ -498,6 +504,11 @@ def device_prefetch(iterator, sharding=None, depth=2):
         def one(x):
             if isinstance(x, Tensor):
                 x = x._data
+            if place is not None:
+                try:
+                    return place(x)
+                except Exception:
+                    return x          # step() re-places on its own path
             if isinstance(x, np.ndarray):
                 return jax.device_put(x, _fit_sharding(x))
             return x

@@ -19,9 +19,13 @@ lower to lax.cond / lax.while_loop under tracing):
                                  [n] = __jst_while(__jst_cond0,
                                                    __jst_body0, [n])
 
-Supported shapes: assignment-style if/else (no return/break/continue in
-the branches), both-branches-single-return if/else, and assignment-style
-while. Anything else is left as genuine Python with a one-time warning —
+Supported shapes: assignment-style if/else (no return in the branches),
+both-branches-single-return if/else, assignment-style while/for-range,
+and `break`/`continue` inside those loops (eliminated Paddle-style into
+boolean flag carries + guard `if`s before the loop lowering — the loop
+test absorbs the break flag, statements after a flag-set point are
+wrapped in `if not flag:`). Anything else is left as genuine Python with
+a one-time warning —
 concrete values still run; tensor-dependent untransformed control flow
 surfaces jax's tracer-bool error at trace time (the documented
 fallback). Nested callees are not rewritten (convert them explicitly
@@ -161,6 +165,48 @@ def _jst_while(cond_fn, body_fn, loop_vars, n_carried=None):
     return snn.while_loop(cond_fn, body_strong, carried + extra_init)
 
 
+def _jst_unwrap(x):
+    """Tensor -> raw jnp value (jnp.asarray on a Tensor wrapping a tracer
+    would route through __array__ and die with TracerArrayConversionError)."""
+    from ..core.tensor import Tensor
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _jst_loop_ok(pred, brk):
+    """Loop-continue test with a break flag folded in: `pred and not brk`,
+    tensor-aware (break/continue elimination rewrites `while pred:` with a
+    body `break` into `while __jst_loop_ok(pred, brk):`)."""
+    if _tensorish(pred) or _tensorish(brk):
+        import jax.numpy as jnp
+        return jnp.logical_and(jnp.asarray(_jst_unwrap(pred)),
+                               jnp.logical_not(jnp.asarray(_jst_unwrap(brk))))
+    return bool(pred) and not bool(brk)
+
+
+def _jst_not_any(*flags):
+    """`not (f1 or f2 ...)` over break/continue flags, tensor-aware —
+    the guard predicate wrapped around statements that follow a
+    (possibly conditional) break/continue in the same body."""
+    if any(_tensorish(f) for f in flags):
+        import jax.numpy as jnp
+        out = jnp.asarray(False)
+        for f in flags:
+            out = jnp.logical_or(out, jnp.asarray(_jst_unwrap(f)))
+        return jnp.logical_not(out)
+    return not any(bool(f) for f in flags)
+
+
+def _jst_for_exit(i, brk, step):
+    """Post-loop value of a for-range index under break elimination: a
+    broken loop keeps the index where it stopped (the bump is guarded),
+    a completed loop un-bumps the final increment — tensor-aware."""
+    if _tensorish(brk) or _tensorish(i):
+        import jax.numpy as jnp
+        i, brk, step = (_jst_unwrap(v) for v in (i, brk, step))
+        return jnp.where(jnp.asarray(brk), i, i - step)
+    return i if brk else i - step
+
+
 class _JstRange:
     """range(...) whose bounds hold tensors/tracers — the traced-for
     carrier (__jst_range returns a real `range` when all args are
@@ -241,6 +287,160 @@ def _has_control_escape(stmts):
                                 ast.Yield, ast.YieldFrom)):
                 return True
     return False
+
+
+def _has_return_or_yield(stmts):
+    """Return/yield in THIS scope — the escapes break/continue
+    elimination cannot absorb (they leave the function, not the loop)."""
+    for node in stmts:
+        if isinstance(node, _NESTED_SCOPES):
+            continue
+        for sub in [node] + list(_walk_same_scope(node)):
+            if isinstance(sub, (ast.Return, ast.Yield, ast.YieldFrom)):
+                return True
+    return False
+
+
+_LOOP_NODES = (ast.For, ast.AsyncFor, ast.While)
+
+
+def _own_break_continue(stmts):
+    """True when the statement list contains a break/continue belonging
+    to the CURRENT loop — nested loops own their breaks, nested function
+    scopes own everything."""
+    for node in stmts:
+        if isinstance(node, (ast.Break, ast.Continue)):
+            return True
+        if isinstance(node, _NESTED_SCOPES + _LOOP_NODES):
+            continue
+        for field in ("body", "orelse", "finalbody"):
+            if _own_break_continue(getattr(node, field, None) or []):
+                return True
+        for h in getattr(node, "handlers", None) or []:
+            if _own_break_continue(h.body):
+                return True
+    return False
+
+
+class _BreakContinueRewriter:
+    """Flag-based break/continue elimination for ONE loop body
+    (reference: dygraph_to_static/break_continue_transformer.py — the
+    same technique: each `break`/`continue` becomes a boolean-flag
+    assignment, every statement after a flag-set point is wrapped in
+    `if not flag:` guards, and the loop test absorbs the break flag).
+
+    Flags are named `_jst_brk{i}` / `_jst_cont{i}` — single leading
+    underscore on purpose: the `__jst` prefix is filtered OUT of the
+    while-lowering's state-variable list, and the flags must ride the
+    loop carry. Nested loops are left alone (their own visit pass
+    handles their breaks)."""
+
+    def __init__(self, idx):
+        self.brk = f"_jst_brk{idx}"
+        self.cont = f"_jst_cont{idx}"
+        self.used_brk = False
+        self.used_cont = False
+
+    @staticmethod
+    def _set(name):
+        return ast.Assign(targets=[ast.Name(id=name, ctx=ast.Store())],
+                          value=ast.Constant(value=True))
+
+    @staticmethod
+    def _reset(name):
+        return ast.Assign(targets=[ast.Name(id=name, ctx=ast.Store())],
+                          value=ast.Constant(value=False))
+
+    def flags(self):
+        out = []
+        if self.used_brk:
+            out.append(self.brk)
+        if self.used_cont:
+            out.append(self.cont)
+        return out
+
+    def inits(self):
+        return [self._reset(f) for f in self.flags()]
+
+    def rewrite_body(self, stmts):
+        """Returns the loop body with break/continue eliminated; call
+        `flags()`/`inits()` afterwards for the pre-loop flag bindings."""
+        # pre-scan so every guard tests the full flag set, regardless of
+        # where in the body the first flag-set statement sits
+        self.used_brk = self._uses(stmts, ast.Break)
+        self.used_cont = self._uses(stmts, ast.Continue)
+        out = self._guard(stmts)
+        if self.used_cont:
+            # continue only skips the REST of this iteration
+            out = [self._reset(self.cont)] + out
+        return out
+
+    @staticmethod
+    def _uses(stmts, kind):
+        for node in stmts:
+            if isinstance(node, kind):
+                return True
+            if isinstance(node, _NESTED_SCOPES + _LOOP_NODES):
+                continue
+            for field in ("body", "orelse", "finalbody"):
+                if _BreakContinueRewriter._uses(
+                        getattr(node, field, None) or [], kind):
+                    return True
+            for h in getattr(node, "handlers", None) or []:
+                if _BreakContinueRewriter._uses(h.body, kind):
+                    return True
+        return False
+
+    def _sets_flag(self, stmt):
+        return _own_break_continue([stmt])
+
+    def _guard_test(self):
+        return ast.Call(
+            func=ast.Name(id="__jst_not_any", ctx=ast.Load()),
+            args=[ast.Name(id=f, ctx=ast.Load()) for f in self.flags()],
+            keywords=[])
+
+    def _guard(self, stmts):
+        """Rewrite one statement list: flag-set statements replace
+        break/continue, and everything after the first statement that
+        can set a flag is wrapped in `if __jst_not_any(flags):`."""
+        out = []
+        for i, s in enumerate(stmts):
+            sets = self._sets_flag(s)
+            out.append(self._rewrite(s))
+            if sets:
+                rest = stmts[i + 1:]
+                if rest:
+                    out.append(ast.If(test=self._guard_test(),
+                                      body=self._guard(rest), orelse=[]))
+                break
+        return out
+
+    def _rewrite(self, s):
+        if isinstance(s, ast.Break):
+            return self._set(self.brk)
+        if isinstance(s, ast.Continue):
+            return self._set(self.cont)
+        if isinstance(s, _NESTED_SCOPES + _LOOP_NODES):
+            return s               # nested loop/function: not our escape
+        if isinstance(s, ast.If):
+            return ast.copy_location(
+                ast.If(test=s.test, body=self._guard(s.body),
+                       orelse=self._guard(s.orelse) if s.orelse else []),
+                s)
+        if isinstance(s, ast.With):
+            return ast.copy_location(
+                ast.With(items=s.items, body=self._guard(s.body)), s)
+        if isinstance(s, ast.Try):
+            return ast.copy_location(
+                ast.Try(body=self._guard(s.body),
+                        handlers=[ast.ExceptHandler(
+                            type=h.type, name=h.name,
+                            body=self._guard(h.body))
+                            for h in s.handlers],
+                        orelse=self._guard(s.orelse) if s.orelse else [],
+                        finalbody=s.finalbody), s)
+        return s
 
 
 def _names_loaded(node):
@@ -447,16 +647,20 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         Non-range iterables stay untouched: lists/tuples and tensors
         have static trip counts (a tensor's leading dim is a static
         shape), so plain Python iteration already traces correctly."""
-        self.generic_visit(node)
         it = node.iter
         is_range_call = (isinstance(it, ast.Call)
                          and isinstance(it.func, ast.Name)
                          and it.func.id == "range" and not it.keywords)
         if not is_range_call or not isinstance(node.target, ast.Name):
+            self.generic_visit(node)
             return node          # static-trip-count python loop: leave it
-        if node.orelse or _has_control_escape(node.body):
+        if node.orelse or _has_return_or_yield(node.body):
             self.skipped = True
+            self.generic_visit(node)
             return node
+        if _own_break_continue(node.body):
+            return self._for_with_break_continue(node)
+        self.generic_visit(node)
         i = self.counter
         self.counter += 1
         rng = f"__jst_R_{i}"
@@ -514,10 +718,108 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         self.changed = True
         return [ast.copy_location(n_, node) for n_ in (setup, dispatch)]
 
+    def _for_with_break_continue(self, node):
+        """`for <name> in range(...)` containing break/continue: flag
+        elimination + the while lowering for BOTH concrete and traced
+        ranges (__jst_while's runtime dispatch runs concrete loops as a
+        host loop, so native-for unrolling is the only thing given up).
+        The index bump is guarded on the break flag (continue still
+        advances, break freezes the index), and the post-loop un-bump
+        becomes a select on the break flag (__jst_for_exit)."""
+        i = self.counter
+        self.counter += 1
+        rng = f"__jst_R_{i}"
+        tgt = node.target.id
+        rw = _BreakContinueRewriter(i)
+        body = rw.rewrite_body(copy.deepcopy(node.body))
+        setup = ast.Assign(
+            targets=[ast.Name(id=rng, ctx=ast.Store())],
+            value=ast.Call(func=ast.Name(id="__jst_range", ctx=ast.Load()),
+                           args=list(node.iter.args), keywords=[]))
+        init = ast.Assign(
+            targets=[ast.Name(id=tgt, ctx=ast.Store())],
+            value=ast.Attribute(value=ast.Name(id=rng, ctx=ast.Load()),
+                                attr="start", ctx=ast.Load()))
+        step_of_rng = ast.Attribute(value=ast.Name(id=rng, ctx=ast.Load()),
+                                    attr="step", ctx=ast.Load())
+        bump = ast.Assign(
+            targets=[ast.Name(id=tgt, ctx=ast.Store())],
+            value=ast.BinOp(left=ast.Name(id=tgt, ctx=ast.Load()),
+                            op=ast.Add(), right=step_of_rng))
+        test = ast.Call(
+            func=ast.Name(id="__jst_rng_cond", ctx=ast.Load()),
+            args=[ast.Name(id=tgt, ctx=ast.Load()),
+                  ast.Name(id=rng, ctx=ast.Load())],
+            keywords=[])
+        if rw.used_brk:
+            bump = ast.If(
+                test=ast.Call(
+                    func=ast.Name(id="__jst_not_any", ctx=ast.Load()),
+                    args=[ast.Name(id=rw.brk, ctx=ast.Load())],
+                    keywords=[]),
+                body=[bump], orelse=[])
+            test = ast.Call(
+                func=ast.Name(id="__jst_loop_ok", ctx=ast.Load()),
+                args=[test, ast.Name(id=rw.brk, ctx=ast.Load())],
+                keywords=[])
+            exitfix = ast.Assign(
+                targets=[ast.Name(id=tgt, ctx=ast.Store())],
+                value=ast.Call(
+                    func=ast.Name(id="__jst_for_exit", ctx=ast.Load()),
+                    args=[ast.Name(id=tgt, ctx=ast.Load()),
+                          ast.Name(id=rw.brk, ctx=ast.Load()),
+                          step_of_rng],
+                    keywords=[]))
+        else:
+            exitfix = ast.Assign(
+                targets=[ast.Name(id=tgt, ctx=ast.Store())],
+                value=ast.BinOp(left=ast.Name(id=tgt, ctx=ast.Load()),
+                                op=ast.Sub(), right=step_of_rng))
+        wh = ast.While(test=test, body=body + [bump], orelse=[])
+        ast.copy_location(wh, node)
+        ast.fix_missing_locations(wh)
+        self.generic_visit(wh)   # convert inner ifs (incl. guard ifs)
+        converted = self._build_while(wh)
+        if converted is wh:      # while conversion declined
+            self.skipped = True
+            return node
+        self.changed = True
+        out = [setup, init] + rw.inits() + list(converted) + [exitfix]
+        return [ast.copy_location(n_, node) for n_ in out]
+
     # -- while ------------------------------------------------------------
     def visit_While(self, node):
+        node, inits = self._while_break_continue(node)
         self.generic_visit(node)
-        return self._build_while(node)
+        built = self._build_while(node)
+        if built is node:
+            # conversion declined; the rewritten body is still faithful
+            # plain Python (the flags emulate break/continue exactly)
+            return inits + [node] if inits else node
+        return inits + list(built) if inits else built
+
+    def _while_break_continue(self, node):
+        """Eliminate this while's own break/continue (flags + guards)
+        BEFORE generic_visit so the synthesized flag-set and guard `if`s
+        ride the normal __jst_cond transformation. Returns the (possibly
+        rewritten) node plus the pre-loop flag initializers."""
+        if node.orelse or not _own_break_continue(node.body) or \
+                _has_return_or_yield(node.body):
+            return node, []
+        rw = _BreakContinueRewriter(self.counter)
+        self.counter += 1
+        body = rw.rewrite_body(list(node.body))
+        test = node.test
+        if rw.used_brk:
+            test = ast.Call(
+                func=ast.Name(id="__jst_loop_ok", ctx=ast.Load()),
+                args=[node.test, ast.Name(id=rw.brk, ctx=ast.Load())],
+                keywords=[])
+        new = ast.While(test=test, body=body, orelse=[])
+        ast.copy_location(new, node)
+        ast.fix_missing_locations(new)
+        self.changed = True
+        return new, [ast.copy_location(s, node) for s in rw.inits()]
 
     def _build_while(self, node):
         if node.orelse or _has_control_escape(node.body):
@@ -637,9 +939,10 @@ def convert_function(fn):
     if tr.skipped:
         warnings.warn(
             f"to_static: some control flow in {fn.__qualname__} uses "
-            "return/break/continue inside if/while bodies and was left as "
-            "plain Python (resolved at trace time; tensor-dependent "
-            "predicates there will fail under tracing)")
+            "return/yield inside if/loop bodies (break/continue alone "
+            "are supported) and was left as plain Python (resolved at "
+            "trace time; tensor-dependent predicates there will fail "
+            "under tracing)")
     if not tr.changed:
         return fn                # nothing to do
 
@@ -653,6 +956,9 @@ def convert_function(fn):
     namespace["__jst_undef"] = _JST_UNDEF
     namespace["__jst_range"] = _jst_range
     namespace["__jst_rng_cond"] = _jst_rng_cond
+    namespace["__jst_loop_ok"] = _jst_loop_ok
+    namespace["__jst_not_any"] = _jst_not_any
+    namespace["__jst_for_exit"] = _jst_for_exit
     if _CODE_LEVEL[0] > 0:
         print(f"[to_static] converted {fn.__qualname__}:")
         print(ast.unparse(tree))

@@ -26,11 +26,15 @@ import jax
 
 __all__ = ["RecordEvent", "start_profiler", "stop_profiler", "profiler",
            "start_trace", "stop_trace", "is_profiling", "summary",
-           "record_compile", "compile_events", "reset_compile_events"]
+           "record_compile", "compile_events", "reset_compile_events",
+           "record_step", "step_timeline", "reset_step_timeline",
+           "step_timeline_summary"]
 
 _lock = threading.Lock()
 _events: List[tuple] = []      # (name, start, dur, thread_id)
 _compiles: List[dict] = []     # {label, compile_s, cache}
+_steps: List[dict] = []        # per-step timeline segments
+_STEP_CAP = 100_000            # bound memory on very long runs
 _enabled = False
 
 
@@ -107,6 +111,65 @@ def compile_events() -> List[dict]:
 def reset_compile_events():
     with _lock:
         _compiles.clear()
+
+
+def record_step(step: int, **segments):
+    """Record one train step's host/device timeline segments.
+
+    Fed by jit.async_pipeline at ticket-retire time with
+    ``collate_s`` (host wait on the input iterator), ``dispatch_s``
+    (host time spent launching the step), ``compute_s`` (submit-to-ready
+    device latency) and ``fetch_s`` (host wall-clock actually *blocked*
+    waiting for the result), plus ``in_flight``.  Overlap is proven when
+    ``collate_s + dispatch_s + fetch_s`` (the dispatch gap the host pays)
+    is well under ``compute_s`` (the device step time).  Always
+    collected, like compiles — bench.py aggregates these into its
+    ``host_blocked_s`` / ``steps_in_flight`` JSON fields."""
+    with _lock:
+        _steps.append({"step": int(step), **segments})
+        if len(_steps) > _STEP_CAP:
+            del _steps[: len(_steps) - _STEP_CAP]
+        if _enabled:
+            now = time.perf_counter()
+            for seg in ("collate_s", "dispatch_s", "compute_s", "fetch_s"):
+                if segments.get(seg):
+                    _events.append((f"step::{seg[:-2]}", now,
+                                    float(segments[seg]),
+                                    threading.get_ident()))
+
+
+def step_timeline() -> List[dict]:
+    """Per-step timeline recorded so far:
+    [{step, collate_s, dispatch_s, compute_s, fetch_s, in_flight}, ...]"""
+    with _lock:
+        return [dict(e) for e in _steps]
+
+
+def reset_step_timeline():
+    with _lock:
+        _steps.clear()
+
+
+def step_timeline_summary() -> dict:
+    """Aggregate of the step timeline for bench/report JSON."""
+    tl = step_timeline()
+    if not tl:
+        return {"steps": 0, "host_blocked_s": 0.0, "steps_in_flight": 0,
+                "dispatch_gap_s": 0.0, "device_step_s": 0.0}
+    n = len(tl)
+    host_blocked = sum(e.get("fetch_s", 0.0) for e in tl)
+    gap = sum(e.get("collate_s", 0.0) + e.get("dispatch_s", 0.0)
+              + e.get("fetch_s", 0.0) for e in tl)
+    dev = sum(e.get("compute_s", 0.0) for e in tl)
+    return {
+        "steps": n,
+        "host_blocked_s": round(host_blocked, 6),
+        "steps_in_flight": max(int(e.get("in_flight", 1)) for e in tl),
+        # mean host-paid gap per step vs mean device step time: overlap
+        # is working when dispatch_gap_s < device_step_s
+        "dispatch_gap_s": round(gap / n, 6),
+        "device_step_s": round(dev / n, 6),
+    }
 
 
 def start_profiler(state: str = "All", tracer_option: str = "Default"):

@@ -176,8 +176,12 @@ def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
         raise TypeError("loop_vars must be a non-empty list/tuple")
 
     probe = cond_fn(*loop_vars)
-    if not _is_traced(probe) and not any(_is_traced(v) for v in loop_vars
-                                         if isinstance(v, Tensor)):
+    # the traced check must cover RAW jnp tracers too, not only Tensor
+    # wrappers: a concrete initial predicate (e.g. a break-elimination
+    # flag seeded False) over a traced carry still needs lax.while_loop
+    if not _is_traced(probe) and not any(
+            _is_traced(v) for v in loop_vars
+            if isinstance(v, Tensor) or _is_tracer(v)):
         out = list(loop_vars)
         while bool(np.asarray(jax.device_get(_arr(cond_fn(*out)))).reshape(())):
             res = body_fn(*out)
@@ -194,5 +198,24 @@ def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
         return tuple(_unwrap_tree(res))
 
     init = tuple(_unwrap_tree(list(loop_vars)))
-    out = jax.lax.while_loop(cond_w, body_w, init)
+    try:
+        out = jax.lax.while_loop(cond_w, body_w, init)
+    except TypeError:
+        # carry-type mismatch, typically weak vs strong dtype: a python
+        # scalar seed (`done = False`; `i = 0`) is weak-typed while the
+        # body's output of the same var (e.g. a lax.cond result) is
+        # strong. Re-seed the init from the body's output avals and pin
+        # the body outputs to those dtypes so the carry is a fixed point.
+        out_avals = jax.eval_shape(body_w, init)
+        if tuple(np.shape(v) for v in init) != \
+                tuple(a.shape for a in out_avals):
+            raise           # genuine shape drift: not ours to paper over
+        init = tuple(jax.lax.convert_element_type(v, a.dtype)
+                     for v, a in zip(init, out_avals))
+
+        def body_s(state):
+            return tuple(jax.lax.convert_element_type(r, a.dtype)
+                         for r, a in zip(body_w(state), out_avals))
+
+        out = jax.lax.while_loop(cond_w, body_s, init)
     return list(_wrap_like(list(out)))

@@ -134,16 +134,16 @@ def test_plain_python_if_untouched():
 
 
 def test_unsupported_shape_warns_and_falls_back():
-    class BreakNet(nn.Layer):
+    class ReturnLoop(nn.Layer):
         def forward(self, x):
             out = x
-            while True:
+            while float(out.sum()) < 9:   # host read; eager-only net
                 out = out + 1
-                if float(out.sum()) > 3:   # host read; eager-only net
-                    break
-            return out
+                if float(out.sum()) > 3:
+                    return out            # return INSIDE a loop: skipped
+            return out * 2
 
-    net = BreakNet()
+    net = ReturnLoop()
     with warnings.catch_warnings(record=True) as w:
         warnings.simplefilter("always")
         paddle.jit.to_static(net)
@@ -529,7 +529,11 @@ def test_for_over_tensor_untouched():
                                atol=1e-6)
 
 
-def test_for_with_break_warns_and_falls_back():
+def test_for_with_break_converts_without_warning():
+    """break no longer forces the plain-Python fallback: the loop is
+    rewritten with a break flag. The host float() read keeps THIS net
+    eager-only, but conversion itself succeeds silently and the
+    flag-guarded loop preserves python semantics."""
     class BreakFor(nn.Layer):
         def forward(self, x):
             s = x
@@ -543,9 +547,7 @@ def test_for_with_break_warns_and_falls_back():
     with warnings.catch_warnings(record=True) as w:
         warnings.simplefilter("always")
         paddle.jit.to_static(net)
-    assert any("plain Python" in str(ww.message) for ww in w)
-    # the loop body keeps python semantics eagerly (host float() read
-    # makes this net eager-only — same contract as the while fallback)
+    assert not any("plain Python" in str(ww.message) for ww in w)
     x = np.zeros((2,), np.float32)
     np.testing.assert_allclose(_np_run(net, x), x + 4, atol=1e-6)
 
@@ -568,3 +570,160 @@ def test_for_loop_var_value_after_traced_loop():
              paddle.to_tensor(np.array(4, np.int64))).numpy()
     # python semantics: i ends at 3, s at 5 -> 15
     np.testing.assert_allclose(out, (x + 4) * 3, atol=1e-6)
+
+
+# ------------------------------------------------------------------
+# break/continue elimination (PR 3): loops with break/continue convert
+# to flag-guarded lax loops instead of falling back to plain Python.
+# Every case is checked eager (concrete values, host loop) AND traced
+# (tensor-dependent predicate or bound, lax.while_loop), against the
+# same plain-python reference — the converted code must keep exact
+# python semantics in both modes.
+
+class WhileBreakNet(nn.Layer):
+    """while + tensor-dependent conditional break."""
+
+    def forward(self, x):
+        s = x.sum() * 0.0
+        i = paddle.to_tensor(np.float32(0.0))
+        while i < 10.0:
+            s = s + x.sum()
+            i = i + 1.0
+            if s > 2.5:
+                break
+        return s + i * 100.0
+
+
+class WhileContinueNet(nn.Layer):
+    """while + conditional continue (skip one iteration's update)."""
+
+    def forward(self, x):
+        s = x.sum() * 0.0
+        i = paddle.to_tensor(np.float32(0.0))
+        while i < 6.0:
+            i = i + 1.0
+            if i == 2.0:
+                continue
+            s = s + x.sum()
+        return s
+
+
+class ForBreakNet(nn.Layer):
+    """for-range + tensor-dependent break; reads the loop var after."""
+
+    def forward(self, x):
+        s = x.sum() * 0.0
+        for i in range(8):
+            if s > 2.5:
+                break
+            s = s + x.sum()
+        return s + i * 100.0
+
+
+class ForContinueNet(nn.Layer):
+    def forward(self, x):
+        s = x.sum() * 0.0
+        for i in range(6):
+            if i == 1:
+                continue
+            s = s + x.sum()
+        return s
+
+
+class NestedBreakContinueNet(nn.Layer):
+    """inner while+continue nested in an outer for+break: each loop's
+    flags must stay scoped to its own body."""
+
+    def forward(self, x):
+        s = x.sum() * 0.0
+        for i in range(5):
+            j = paddle.to_tensor(np.float32(0.0))
+            while j < 3.0:
+                j = j + 1.0
+                if j == 2.0:
+                    continue
+                s = s + x.sum()
+            if i >= 1:
+                break
+        return s
+
+
+def _bc_reference(kind, unit):
+    """Plain-python semantics for each net above, x.sum() == unit."""
+    if kind == "while_break":
+        s, i = 0.0, 0.0
+        while i < 10.0:
+            s += unit
+            i += 1.0
+            if s > 2.5:
+                break
+        return s + i * 100.0
+    if kind == "while_continue":
+        s, i = 0.0, 0.0
+        while i < 6.0:
+            i += 1.0
+            if i == 2.0:
+                continue
+            s += unit
+        return s
+    if kind == "for_break":
+        s = 0.0
+        for i in range(8):
+            if s > 2.5:
+                break
+            s += unit
+        return s + i * 100.0
+    if kind == "for_continue":
+        s = 0.0
+        for i in range(6):
+            if i == 1:
+                continue
+            s += unit
+        return s
+    if kind == "nested":
+        s = 0.0
+        for i in range(5):
+            j = 0.0
+            while j < 3.0:
+                j += 1.0
+                if j == 2.0:
+                    continue
+                s += unit
+            if i >= 1:
+                break
+        return s
+    raise AssertionError(kind)
+
+
+_BC_CASES = [("while_break", WhileBreakNet),
+             ("while_continue", WhileContinueNet),
+             ("for_break", ForBreakNet),
+             ("for_continue", ForContinueNet),
+             ("nested", NestedBreakContinueNet)]
+
+
+@pytest.mark.parametrize("kind,cls", _BC_CASES)
+def test_break_continue_converts_silently(kind, cls):
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        paddle.jit.to_static(cls())
+    assert not any("plain Python" in str(ww.message) for ww in w), kind
+
+
+@pytest.mark.parametrize("kind,cls", _BC_CASES)
+def test_break_continue_eager_matches_python(kind, cls):
+    x = np.full((4,), 0.25, np.float32)           # x.sum() == 1.0
+    got = float(cls()(paddle.to_tensor(x)))
+    np.testing.assert_allclose(got, _bc_reference(kind, 1.0), atol=1e-6)
+
+
+@pytest.mark.parametrize("kind,cls", _BC_CASES)
+def test_break_continue_traced_matches_python(kind, cls):
+    """Same nets through to_static with a traced input: the predicates
+    (and for `for`, the post-break index fix-up) must lower onto
+    lax.while_loop and still reproduce python semantics exactly."""
+    net = cls()
+    st = paddle.jit.to_static(net)
+    x = np.full((4,), 0.25, np.float32)
+    got = float(st(paddle.to_tensor(x)))
+    np.testing.assert_allclose(got, _bc_reference(kind, 1.0), atol=1e-6)

@@ -1,0 +1,180 @@
+"""Asynchronous step pipeline (jit/async_pipeline + hapi Model.fit wiring).
+
+Async dispatch is a pure reordering of host reads: the device computation
+is unchanged, so the per-step loss stream must be BIT-identical between
+PADDLE_TPU_ASYNC_STEPS=0 (fetch every step) and >=2 (bounded in-flight
+window, deferred fetch). Window bounding, FIFO retirement, deferred-error
+attribution and the profiler step timeline are covered on stub tickets.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu import profiler
+from paddle_tpu.hapi import Model, callbacks as hapi_cbks
+from paddle_tpu.io import TensorDataset
+from paddle_tpu.jit.async_pipeline import (AsyncStepError, AsyncStepPipeline,
+                                           async_steps)
+from paddle_tpu.static import InputSpec
+
+
+# ---------------------------------------------------------------- env knob
+
+def test_async_steps_env_parsing(monkeypatch):
+    monkeypatch.delenv("PADDLE_TPU_ASYNC_STEPS", raising=False)
+    assert async_steps() == 2                      # documented default
+    for raw, want in [("0", 0), ("off", 0), ("sync", 0), ("no", 0),
+                      ("1", 1), ("4", 4), ("-3", 0), ("garbage", 2)]:
+        monkeypatch.setenv("PADDLE_TPU_ASYNC_STEPS", raw)
+        assert async_steps() == want, raw
+
+
+# ------------------------------------------------- window / FIFO on stubs
+
+class _Stub:
+    """Device-array stand-in: jax.block_until_ready calls the leaf's
+    block_until_ready() method, so retirement order is observable."""
+
+    def __init__(self, idx, log, fail=None):
+        self.idx = idx
+        self.log = log
+        self.fail = fail
+
+    def block_until_ready(self):
+        if self.fail is not None:
+            raise self.fail
+        self.log.append(self.idx)
+        return self
+
+
+def test_window_bounds_in_flight_and_fifo_retire():
+    log = []
+    p = AsyncStepPipeline(max_in_flight=2, record=False)
+    for i in range(5):
+        p.submit(_Stub(i, log), step_index=i)
+        assert len(p._inflight) <= 2
+    # submits 0..4 with window 2: steps 0,1,2 were forced out in order
+    assert log == [0, 1, 2]
+    p.drain()
+    assert log == [0, 1, 2, 3, 4]
+    assert not p._inflight
+    assert p.steps_in_flight == 2
+    assert p.steps_submitted == 5
+
+
+def test_window_one_is_serial():
+    log = []
+    p = AsyncStepPipeline(max_in_flight=1, record=False)
+    for i in range(3):
+        p.submit(_Stub(i, log), step_index=i)
+    p.drain()
+    assert log == [0, 1, 2]
+    assert p.steps_in_flight == 1
+
+
+def test_poisoned_step_surfaces_at_fetch_with_origin_index():
+    log = []
+    p = AsyncStepPipeline(max_in_flight=4, record=False)
+    p.submit(_Stub(0, log), step_index=0)
+    boom = FloatingPointError("nan in loss")
+    p.submit(_Stub(7, log, fail=boom), step_index=7)   # poisoned dispatch
+    p.submit(_Stub(8, log), step_index=8)
+    with pytest.raises(AsyncStepError) as ei:
+        p.drain()
+    # the error names the ORIGINATING step, not the one being dispatched
+    assert ei.value.step_index == 7
+    assert ei.value.__cause__ is boom
+    assert "step 7" in str(ei.value)
+    # the poisoned ticket was still removed from the window; later tickets
+    # remain drainable
+    p.drain()
+    assert log == [0, 8]
+
+
+def test_retire_feeds_profiler_timeline():
+    profiler.reset_step_timeline()
+    log = []
+    p = AsyncStepPipeline(max_in_flight=2, label="unit")
+    for i in range(3):
+        p.submit(_Stub(i, log), step_index=i,
+                 collate_s=0.25, dispatch_s=0.125)
+    p.drain()
+    tl = profiler.step_timeline()
+    assert [e["step"] for e in tl] == [0, 1, 2]
+    assert all(e["collate_s"] == 0.25 and e["dispatch_s"] == 0.125
+               and e["label"] == "unit" for e in tl)
+    summ = profiler.step_timeline_summary()
+    assert summ["steps"] == 3
+    assert summ["steps_in_flight"] == 2
+    # the summary rounds to microseconds
+    assert summ["host_blocked_s"] == pytest.approx(p.host_blocked_s,
+                                                   abs=2e-6)
+    profiler.reset_step_timeline()
+
+
+# ------------------------------------------- fit() equivalence (the claim)
+
+def _fit_losses(window, monkeypatch, epochs=2, nsamp=24, bs=4):
+    """Train the same seeded model; return the per-step loss floats."""
+    monkeypatch.setenv("PADDLE_TPU_ASYNC_STEPS", str(window))
+    paddle.seed(0)
+
+    class Reg(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                                     nn.Linear(16, 1))
+
+        def forward(self, x, y):
+            return ((self.net(x) - y) ** 2).mean()
+
+    model = Model(Reg(), inputs=[InputSpec([None, 8], "float32"),
+                                 InputSpec([None, 1], "float32")])
+    model.prepare(opt.Adam(learning_rate=1e-2,
+                           parameters=model.parameters()))
+    rng = np.random.default_rng(7)
+    ds = TensorDataset([rng.normal(size=(nsamp, 8)).astype(np.float32),
+                        rng.normal(size=(nsamp, 1)).astype(np.float32)])
+
+    got = []
+
+    class Cap(hapi_cbks.Callback):
+        def on_train_batch_end(self, step, logs=None):
+            got.append(float(logs["loss"]))
+
+    model.fit(ds, batch_size=bs, epochs=epochs, verbose=0, shuffle=False,
+              callbacks=[Cap()])
+    return got
+
+
+@pytest.mark.parametrize("window", [2, 4])
+def test_async_fit_losses_bit_identical_to_sync(window, monkeypatch):
+    sync = _fit_losses(0, monkeypatch)
+    asyn = _fit_losses(window, monkeypatch)
+    assert len(sync) == 12  # 24 samples / bs 4 * 2 epochs
+    # bit-identical, not allclose: async changes WHEN the host reads the
+    # loss, never what the device computed
+    assert asyn == sync
+
+
+def test_async_fit_populates_step_timeline(monkeypatch):
+    profiler.reset_step_timeline()
+    _fit_losses(2, monkeypatch, epochs=1)
+    tl = profiler.step_timeline()
+    assert len(tl) == 6
+    for e in tl:
+        assert {"collate_s", "dispatch_s", "compute_s",
+                "fetch_s", "in_flight"} <= set(e)
+        assert e["in_flight"] <= 2
+    summ = profiler.step_timeline_summary()
+    assert summ["steps_in_flight"] <= 2
+    assert summ["host_blocked_s"] >= 0.0
+    profiler.reset_step_timeline()
+
+
+def test_sync_mode_records_no_timeline(monkeypatch):
+    profiler.reset_step_timeline()
+    _fit_losses(0, monkeypatch, epochs=1)
+    assert profiler.step_timeline() == []
