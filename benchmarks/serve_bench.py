@@ -1,0 +1,177 @@
+"""Serving-engine benchmark: batched vs per-request-serialized inference.
+
+Open-loop client over a synthetic MLP with MIXED request shapes (rows
+1..4 of a [None, 64] f32 input): the serialized mode replays the legacy
+daemon behavior (one ``Predictor.run`` per request, in order), the
+batched mode drives the DynamicBatcher + per-bucket AOT engine
+(inference/batching.py) with every request submitted up front —
+arrivals are not gated on completions.
+
+Prints ONE JSON line; the load-bearing fields:
+  batched_reqs_per_s / serial_reqs_per_s / speedup  (target: >= 3x at
+      max_batch_size >= 8)
+  batch_occupancy, padding_waste, p50/p95/p99_latency_ms  (profiler
+      serve stats for the batched run)
+  warmup_compiles, compile_count  (compile_count = compiles observed
+      AFTER warmup during the measured stream; the compile-bounded
+      engine's contract is 0)
+
+CPU-safe: no accelerator reachable -> re-exec once on JAX_PLATFORMS=cpu
+(bench.py's _devices_or_cpu_fallback pattern); any failure still emits
+parseable JSON with rc 0.
+
+    python benchmarks/serve_bench.py [--requests 400] [--max-batch 16]
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def _devices_or_cpu_fallback():
+    """bench.py's probe-then-reexec pattern: accelerator init failure
+    falls back to one CPU retry; a CPU failure emits error JSON rc 0."""
+    import jax
+    if os.environ.get("_PADDLE_TPU_BENCH_CPU_FALLBACK"):
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+    try:
+        return jax.devices()
+    except Exception as e:                      # backend init failure
+        if os.environ.get("_PADDLE_TPU_BENCH_CPU_FALLBACK"):
+            print(json.dumps({"metric": "serve_bench_backend_error",
+                              "value": 0.0, "unit": "reqs/s",
+                              "vs_baseline": 0.0,
+                              "error": str(e).split("\n")[0]}))
+            sys.exit(0)
+        sys.stderr.write(
+            f"serve_bench: accelerator backend failed to initialize "
+            f"({e!r}); retrying on CPU (JAX_PLATFORMS=cpu)\n")
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   _PADDLE_TPU_BENCH_CPU_FALLBACK="1")
+        xf = [t for t in env.get("XLA_FLAGS", "").split()
+              if not t.startswith("--xla_tpu_")]
+        if xf:
+            env["XLA_FLAGS"] = " ".join(xf)
+        else:
+            env.pop("XLA_FLAGS", None)
+        os.execve(sys.executable,
+                  [sys.executable, os.path.abspath(__file__)]
+                  + sys.argv[1:], env)
+
+
+def _error_json(msg):
+    print(json.dumps({"metric": "serve_bench_error", "value": 0.0,
+                      "unit": "reqs/s", "vs_baseline": 0.0,
+                      "error": msg}), flush=True)
+
+
+def run_bench(args):
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu import profiler
+    from paddle_tpu.inference import Config, Predictor
+    from paddle_tpu.inference.batching import DynamicBatcher
+    from paddle_tpu.static import InputSpec
+
+    class MLP(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(64, 256)
+            self.fc2 = nn.Linear(256, 64)
+
+        def forward(self, x):
+            import paddle_tpu.nn.functional as F
+            return self.fc2(F.relu(self.fc1(x)))
+
+    paddle.seed(0)
+    prefix = os.path.join(tempfile.mkdtemp(prefix="serve_bench_"), "mlp")
+    paddle.jit.save(MLP(), prefix,
+                    input_spec=[InputSpec([None, 64], "float32")])
+
+    rng = np.random.default_rng(args.seed)
+    row_mix = (1, 2, 1, 4)     # mixed request shapes, single-row-heavy
+    requests = [rng.normal(size=(row_mix[i % len(row_mix)], 64))
+                .astype(np.float32) for i in range(args.requests)]
+
+    # --- serialized mode: the legacy daemon loop (one run per request,
+    # global order). Warm each distinct shape first so the comparison is
+    # steady-state dispatch, not compile time.
+    serial_pred = Predictor(Config(prefix))
+    for r in row_mix:
+        serial_pred.run([np.zeros((r, 64), np.float32)])
+    t0 = time.perf_counter()
+    for x in requests:
+        serial_pred.run([x])
+    serial_s = time.perf_counter() - t0
+    serial_rps = args.requests / serial_s
+
+    # --- batched mode: fresh predictor + batcher, full warmup, then an
+    # open-loop submit of the whole stream.
+    profiler.reset_serve_stats()
+    batched_pred = Predictor(Config(prefix))
+    batcher = DynamicBatcher(batched_pred, max_batch_size=args.max_batch,
+                             batch_timeout_ms=args.batch_timeout_ms)
+    warmup_compiles = batcher.warmup()
+    c0 = len(profiler.compile_events())
+    t0 = time.perf_counter()
+    futs = [batcher.submit([x]) for x in requests]
+    for f in futs:
+        f.result(timeout=300)
+    batched_s = time.perf_counter() - t0
+    batcher.stop()
+    batched_rps = args.requests / batched_s
+    steady_compiles = len(profiler.compile_events()) - c0
+
+    stats = profiler.serve_stats()
+    speedup = batched_rps / serial_rps if serial_rps > 0 else 0.0
+    return {
+        "metric": "serve_throughput",
+        "value": round(batched_rps, 2),
+        "unit": "reqs/s",
+        # north star: >= 3x over the serialized daemon at max_batch >= 8
+        "vs_baseline": round(speedup / 3.0, 3),
+        "requests": args.requests,
+        "max_batch_size": args.max_batch,
+        "batch_timeout_ms": args.batch_timeout_ms,
+        "serial_reqs_per_s": round(serial_rps, 2),
+        "batched_reqs_per_s": round(batched_rps, 2),
+        "speedup": round(speedup, 3),
+        "batch_occupancy": stats["batch_occupancy"],
+        "padding_waste": stats["padding_waste"],
+        "queue_depth_max": stats["queue_depth_max"],
+        "p50_latency_ms": stats["p50_latency_ms"],
+        "p95_latency_ms": stats["p95_latency_ms"],
+        "p99_latency_ms": stats["p99_latency_ms"],
+        "warmup_compiles": warmup_compiles,
+        "compile_count": steady_compiles,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser(description="serving engine benchmark")
+    ap.add_argument("--requests", type=int, default=400)
+    ap.add_argument("--max-batch", type=int, default=16)
+    ap.add_argument("--batch-timeout-ms", type=float, default=2.0)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+    _devices_or_cpu_fallback()
+    try:
+        out = run_bench(args)
+    except Exception as e:                       # rc-0 JSON contract
+        _error_json(f"{type(e).__name__}: {str(e).splitlines()[0]}")
+        return
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

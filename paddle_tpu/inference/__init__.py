@@ -72,15 +72,31 @@ class _OutputHandle:
 
 
 class Predictor:
-    """AnalysisPredictor::Run parity: copy inputs -> run program -> fetch."""
+    """AnalysisPredictor::Run parity: copy inputs -> run program -> fetch.
 
-    def __init__(self, config: Config):
+    ``device`` pins this predictor's parameters AND its AOT-compiled
+    bucket executables to one chip — the unit the serving
+    ``PredictorPool`` round-robins batches across. ``run_batch`` is the
+    compile-bounded entry the serving engine uses: one executable per
+    exact input-shape signature, cached in a ``jit.compile_cache.AotCache``
+    so steady-state traffic over a warmed bucket ladder never compiles
+    (``run`` keeps the jit dispatch path and re-specializes per novel
+    shape)."""
+
+    def __init__(self, config: Config, device=None):
         self._layer = jit_mod.load(config.prog_file(),
                                    params_path=config.params_file())
+        self._device = device
+        if device is not None:
+            import jax
+            self._layer._params = jax.device_put(self._layer._params,
+                                                 device)
         n_in = len(self._layer.in_avals) - len(self._layer._params)
         self._n_inputs = max(n_in, 1)
         self._inputs = [None] * self._n_inputs
         self._outputs = []
+        from ..jit.compile_cache import AotCache
+        self._aot = AotCache(self._layer._call, label="serve")
 
     def get_input_names(self):
         return [f"x{i}" for i in range(self._n_inputs)]
@@ -103,8 +119,80 @@ class Predictor:
                          for t in leaves]
         return self._outputs
 
+    # -- compile-bounded serving path -----------------------------------
+
+    def input_specs(self):
+        """Per-input (shape, dtype) with symbolic dims as their symbol
+        name string (``"batch"``, ``"seqlen"``, ...) — the batcher pads
+        exactly those axes. Static dims are plain ints."""
+        specs = []
+        for a in self._layer.input_avals:
+            shape = tuple(d if isinstance(d, int) else str(d)
+                          for d in a.shape)
+            specs.append((shape, np.dtype(a.dtype)))
+        return specs
+
+    @staticmethod
+    def _sig_key(sig):
+        return tuple((tuple(shape), str(np.dtype(dtype)))
+                     for shape, dtype in sig)
+
+    def _input_avals_for(self, sig):
+        import jax
+        avals = []
+        for shape, dtype in sig:
+            kw = {}
+            if self._device is not None:
+                try:
+                    kw["sharding"] = jax.sharding.SingleDeviceSharding(
+                        self._device)
+                except Exception:
+                    pass
+            avals.append(jax.ShapeDtypeStruct(tuple(shape),
+                                              np.dtype(dtype), **kw))
+        return avals
+
+    def warm(self, signatures):
+        """AOT-compile one executable per signature, where a signature is
+        ``[(shape, dtype), ...]`` over the positional inputs. Idempotent:
+        already-cached signatures are dict hits and record no compile."""
+        for sig in signatures:
+            key = self._sig_key(sig)
+            if self._aot.get(key) is None:
+                self._aot.get_or_compile(self._layer._params,
+                                         *self._input_avals_for(sig),
+                                         key=key)
+
+    def run_batch(self, inputs):
+        """Run one already-formed batch through the per-bucket AOT cache.
+        Inputs must hit an exact compiled signature or one compile is
+        paid (and recorded) for the novel shape. Returns numpy leaves."""
+        import jax
+        arrays = [np.ascontiguousarray(a) for a in inputs]
+        if self._device is not None:
+            arrays = [jax.device_put(a, self._device) for a in arrays]
+        key = tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
+        exe = self._aot.get_or_compile(self._layer._params, *arrays,
+                                       key=key)
+        out = exe(self._layer._params, *arrays)
+        leaves = jax.tree_util.tree_leaves(out)
+        return [np.asarray(t) for t in leaves]
+
+    @property
+    def aot_cache_size(self):
+        return len(self._aot)
+
     def get_output_names(self):
-        return [f"out{i}" for i in range(max(len(self._outputs), 1))]
+        n = len(self._outputs)
+        if not n:
+            # before the first run the arity comes from the export's
+            # out_avals, not a hardcoded 1 (a 3-output model must report
+            # out0..out2 so get_output_handle works pre-run)
+            try:
+                n = len(self._layer.out_avals)
+            except Exception:
+                n = 1
+        return [f"out{i}" for i in range(max(n, 1))]
 
     def get_output_handle(self, name):
         names = self.get_output_names()
@@ -173,12 +261,36 @@ class PredictorPool:
     """A pool of Predictors over one Config (reference PredictorPool:
     thread-per-predictor serving). Each retrieve(i) slot holds its own
     Predictor instance — independent input/output bindings — while the
-    deserialized program weights are shared through jit.load's arrays."""
+    deserialized program weights are shared through jit.load's arrays.
 
-    def __init__(self, config: Config, size: int = 1):
+    ``devices="auto"`` pins slot i to ``jax.devices()[i]`` when enough
+    devices exist (each slot gets its own parameter copy + executables on
+    its chip) — the multi-chip serving shape the DynamicBatcher
+    round-robins formed batches across. An explicit device list pins
+    slots positionally; ``None`` keeps the legacy unpinned pool."""
+
+    def __init__(self, config: Config, size: int = 1, devices=None):
         if size < 1:
             raise ValueError("PredictorPool size must be >= 1")
-        self._preds = [Predictor(config) for _ in range(int(size))]
+        size = int(size)
+        if devices == "auto":
+            try:
+                import jax
+                devs = jax.devices()
+                devices = devs[:size] if len(devs) >= size else None
+            except Exception:
+                devices = None
+        if devices is not None and len(devices) < size:
+            raise ValueError(f"PredictorPool: {size} slots but only "
+                             f"{len(devices)} devices given")
+        self._preds = [
+            Predictor(config,
+                      device=(devices[i] if devices is not None else None))
+            for i in range(size)]
+
+    @property
+    def predictors(self):
+        return list(self._preds)
 
     def retrieve(self, idx: int) -> Predictor:
         if not 0 <= idx < len(self._preds):

@@ -1,0 +1,460 @@
+"""Dynamic cross-request batching for the inference daemon.
+
+The serve daemon's historical shape — thread-per-connection, one
+predictor call per request under a global lock — bounds throughput by
+per-request dispatch overhead and lets every novel input shape trigger a
+recompile. This module is the Clipper/Orca-style fix: reader threads
+enqueue decoded tensors, ONE dispatcher thread forms batches under a
+deadline, each formed batch is padded to a shape bucket from a bounded
+ladder and executed through the predictor's per-bucket AOT cache
+(`jit.compile_cache.AotCache`), and the results are sliced back
+per-request into futures. The compiled-shape set is therefore finite and
+warmable: after `DynamicBatcher.warmup()` a mixed-shape request stream
+compiles nothing.
+
+Shape buckets
+    The ladder defaults to powers of two up to ``max_batch_size`` and is
+    overridable via ``PADDLE_TPU_SERVE_BUCKETS`` (comma/space separated
+    ints, e.g. ``"1,2,4,8,16,32"``). The batch (leading) dim of a formed
+    batch is padded UP to the next rung; trailing *dynamic* dims (the
+    export's symbolic axes, e.g. a ``"seqlen"`` spec) are bucketed with
+    the same ladder — requests whose trailing dims land in the same rung
+    batch together and are zero-padded to it. Values beyond the top rung
+    grow by powers of two (one compile each, still bounded).
+
+Correctness contract
+    Batch-dim padding assumes row-independent outputs (true of any
+    batch-polymorphic export whose leading symbol is the batch); the
+    engine verifies each output's leading dim equals the dispatched
+    bucket and falls back to per-request execution otherwise. Trailing
+    zero-padding additionally assumes padding-invariance per row
+    (elementwise/masked models); see docs/serving.md for the caveat.
+
+Error isolation
+    A failed batch is re-executed per request, so a poison request (bad
+    static dim, NaN-triggering payload, ...) fails only its own future.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from itertools import product
+from queue import Queue
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["DynamicBatcher", "bucket_ladder", "next_bucket",
+           "DEFAULT_MAX_BATCH", "DEFAULT_TIMEOUT_MS"]
+
+DEFAULT_MAX_BATCH = 8
+DEFAULT_TIMEOUT_MS = 2.0
+_WARMUP_SIG_CAP = 64          # cross-product guard for many dynamic dims
+
+
+def bucket_ladder(max_batch: int, env: Optional[str] = None) -> List[int]:
+    """The padded-shape ladder: ``PADDLE_TPU_SERVE_BUCKETS`` if set, else
+    powers of two up to (and including) ``max_batch``."""
+    spec = os.environ.get("PADDLE_TPU_SERVE_BUCKETS", "") \
+        if env is None else env
+    if spec.strip():
+        vals = sorted({int(t) for t in spec.replace(",", " ").split()})
+        if not vals or vals[0] <= 0:
+            raise ValueError(
+                f"PADDLE_TPU_SERVE_BUCKETS must be positive ints, "
+                f"got {spec!r}")
+        return vals
+    vals, v = [], 1
+    while v < max_batch:
+        vals.append(v)
+        v *= 2
+    vals.append(int(max_batch))
+    return sorted(set(vals))
+
+
+def next_bucket(n: int, ladder: Sequence[int]) -> int:
+    """Smallest rung >= n; beyond the top the ladder continues by powers
+    of two so oversized requests still land on a bounded shape set."""
+    for v in ladder:
+        if v >= n:
+            return v
+    v = ladder[-1]
+    while v < n:
+        v *= 2
+    return v
+
+
+class _Request:
+    __slots__ = ("arrays", "rows", "key", "pad_map", "future", "t_enq",
+                 "solo")
+
+    def __init__(self, arrays, rows, key, solo=False):
+        self.arrays = arrays
+        self.rows = rows
+        self.key = key
+        self.pad_map = {}          # padded trailing dim -> original dim
+        self.future = Future()
+        self.t_enq = time.perf_counter()
+        self.solo = solo
+
+
+class DynamicBatcher:
+    """Deadline-based cross-request batcher over one or more Predictors.
+
+    ``submit(inputs) -> Future`` enqueues a decoded request (list of
+    numpy arrays, shared leading batch dim). The dispatcher thread forms
+    batches of up to ``max_batch_size`` rows, waiting at most
+    ``batch_timeout_ms`` past the oldest request's enqueue before
+    dispatching a partial batch. Formed batches are handed round-robin to
+    one worker thread per predictor (a ``PredictorPool`` pinned to
+    distinct devices overlaps batches across chips).
+    """
+
+    def __init__(self, predictors, max_batch_size: int = DEFAULT_MAX_BATCH,
+                 batch_timeout_ms: float = DEFAULT_TIMEOUT_MS,
+                 ladder: Optional[Sequence[int]] = None):
+        preds = getattr(predictors, "predictors", None)
+        if preds is None:
+            preds = (list(predictors)
+                     if isinstance(predictors, (list, tuple))
+                     else [predictors])
+        if not preds:
+            raise ValueError("DynamicBatcher needs at least one predictor")
+        self._preds = preds
+        self._max_batch = int(max_batch_size)
+        if self._max_batch < 1:
+            raise ValueError("max_batch_size must be >= 1")
+        self._timeout_s = float(batch_timeout_ms) / 1e3
+        self._ladder = list(ladder) if ladder is not None \
+            else bucket_ladder(self._max_batch)
+        self._specs = preds[0].input_specs()
+        self._n_inputs = len(self._specs)
+        self._dyn_axes = [
+            {j for j in range(1, len(shape)) if not isinstance(shape[j], int)}
+            for shape, _ in self._specs]
+        self._can_batch = bool(self._specs) and all(
+            shape and not isinstance(shape[0], int)
+            for shape, _ in self._specs)
+        self._rowwise_ok = True      # flipped off if outputs aren't rowwise
+        self._warned_rowwise = False
+
+        self._q: deque = deque()
+        self._cond = threading.Condition()
+        self._stop = False
+        self._workers = []
+        self._wqueues: List[Queue] = []
+        if len(self._preds) > 1:
+            # multi-chip: one worker per predictor so formed batches
+            # overlap across devices; the dispatcher only forms + routes
+            for i, p in enumerate(self._preds):
+                wq: Queue = Queue(maxsize=4)  # backpressure per predictor
+                t = threading.Thread(target=self._worker_loop,
+                                     args=(p, wq), daemon=True,
+                                     name=f"serve-worker-{i}")
+                t.start()
+                self._wqueues.append(wq)
+                self._workers.append(t)
+        self._rr = 0
+        self._dispatcher = threading.Thread(target=self._dispatch_loop,
+                                            daemon=True,
+                                            name="serve-dispatcher")
+        self._dispatcher.start()
+
+    # -- request intake --------------------------------------------------
+
+    def submit(self, inputs) -> Future:
+        """Enqueue one request; the returned Future resolves to the list
+        of output arrays for exactly this request's rows (or raises the
+        per-request error)."""
+        try:
+            # no ascontiguousarray here: assembly copies into the zeroed
+            # bucket buffer anyway, and the solo path normalizes itself
+            arrays = [np.asarray(a) for a in inputs]
+            if len(arrays) != self._n_inputs:
+                raise ValueError(
+                    f"model takes {self._n_inputs} inputs, got "
+                    f"{len(arrays)}")
+            req = self._make_request(arrays)
+        except Exception as e:
+            fut = Future()
+            fut.set_exception(e)
+            return fut
+        with self._cond:
+            if self._stop:
+                req.future.set_exception(
+                    RuntimeError("DynamicBatcher is stopped"))
+                return req.future
+            self._q.append(req)
+            self._cond.notify_all()
+        return req.future
+
+    def _make_request(self, arrays) -> _Request:
+        if not (self._can_batch and self._rowwise_ok):
+            return _Request(arrays, rows=1, key=object(), solo=True)
+        rows = None
+        for i, a in enumerate(arrays):
+            shape, _ = self._specs[i]
+            if a.ndim != len(shape):
+                raise ValueError(
+                    f"input {i}: expected ndim {len(shape)}, got {a.ndim}")
+            if rows is None:
+                rows = a.shape[0]
+            elif a.shape[0] != rows:
+                raise ValueError(
+                    "inputs disagree on the leading batch dim "
+                    f"({rows} vs {a.shape[0]})")
+        key = []
+        for i, a in enumerate(arrays):
+            trailing = tuple(
+                next_bucket(a.shape[j], self._ladder)
+                if j in self._dyn_axes[i] else a.shape[j]
+                for j in range(1, a.ndim))
+            key.append((str(a.dtype), trailing))
+        return _Request(arrays, rows=int(rows), key=tuple(key))
+
+    # -- batch formation -------------------------------------------------
+
+    def _form_batch(self):
+        """Blocks for the next batch: the oldest queued request anchors
+        the key and the deadline; same-key requests are merged until the
+        row budget or the deadline is hit."""
+        with self._cond:
+            while not self._q and not self._stop:
+                self._cond.wait(0.25)
+            if not self._q:
+                return None
+            first = self._q.popleft()
+            reqs, rows = [first], first.rows
+            if first.solo:
+                return reqs, first.key, rows
+            deadline = first.t_enq + self._timeout_s
+            while rows < self._max_batch:
+                taken = []
+                for r in self._q:
+                    if r.solo or r.key != first.key:
+                        continue
+                    if rows + r.rows > self._max_batch:
+                        continue
+                    taken.append(r)
+                    rows += r.rows
+                    if rows >= self._max_batch:
+                        break
+                for r in taken:
+                    self._q.remove(r)
+                reqs.extend(taken)
+                if rows >= self._max_batch or self._stop:
+                    break
+                now = time.perf_counter()
+                if now >= deadline:
+                    break
+                self._cond.wait(min(deadline - now, 0.05))
+            return reqs, first.key, rows
+
+    def _dispatch_loop(self):
+        while True:
+            formed = self._form_batch()
+            if formed is None:
+                return
+            if not self._wqueues:
+                # single predictor: execute inline — a queue handoff to a
+                # worker thread costs a context switch per batch for no
+                # overlap gain on one device
+                self._execute(self._preds[0], *formed)
+                continue
+            wq = self._wqueues[self._rr % len(self._wqueues)]
+            self._rr += 1
+            wq.put(formed)
+
+    # -- execution -------------------------------------------------------
+
+    def _assemble(self, reqs, key):
+        """Pack same-key requests into one zero-initialized bucket-shaped
+        buffer per input (single allocation: batch-dim and trailing-dim
+        padding fall out of the zeros). Returns
+        (stacked_inputs, bucket, real_elems, padded_elems)."""
+        total_rows = sum(r.rows for r in reqs)
+        bucket = next_bucket(total_rows, self._ladder)
+        stacked, real, padded = [], 0, 0
+        for i in range(self._n_inputs):
+            target_trailing = tuple(key[i][1])
+            mat = np.zeros((bucket,) + target_trailing,
+                           reqs[0].arrays[i].dtype)
+            off = 0
+            for r in reqs:
+                a = r.arrays[i]
+                real += a.size
+                if a.shape[1:] == target_trailing:
+                    mat[off:off + r.rows] = a
+                else:
+                    mat[(slice(off, off + r.rows),)
+                        + tuple(slice(0, d) for d in a.shape[1:])] = a
+                    for j, tgt in enumerate(target_trailing, start=1):
+                        if a.shape[j] != tgt:
+                            r.pad_map[tgt] = a.shape[j]
+                off += r.rows
+            padded += mat.size
+            stacked.append(mat)
+        return stacked, bucket, real, padded
+
+    @staticmethod
+    def _slice_back(outs, reqs, bucket) -> bool:
+        """Hand each request its row slice (and un-pad trailing dims it
+        contributed padding to). False when the outputs are not rowwise —
+        the caller must fall back to per-request execution."""
+        if not all(o.ndim >= 1 and o.shape[0] == bucket for o in outs):
+            return False
+        off = 0
+        for r in reqs:
+            res = []
+            for o in outs:
+                s = o[off:off + r.rows]
+                if r.pad_map:
+                    sl, changed = [slice(None)] * s.ndim, False
+                    for j in range(1, s.ndim):
+                        orig = r.pad_map.get(s.shape[j])
+                        if orig is not None and orig != s.shape[j]:
+                            sl[j] = slice(0, orig)
+                            changed = True
+                    if changed:
+                        s = s[tuple(sl)]
+                res.append(s)            # views; the wire path copies
+            r.future.set_result(res)
+            off += r.rows
+        return True
+
+    def _worker_loop(self, pred, wq: Queue):
+        while True:
+            item = wq.get()
+            if item is None:
+                return
+            self._execute(pred, *item)
+
+    def _execute(self, pred, reqs, key, rows):
+        from .. import profiler
+
+        qdepth = len(self._q)
+        if not reqs[0].solo:
+            try:
+                stacked, bucket, real, padded = self._assemble(reqs, key)
+                outs = pred.run_batch(stacked)
+                if self._slice_back(outs, reqs, bucket):
+                    now = time.perf_counter()
+                    profiler.record_serve_batch(rows, bucket, real, padded,
+                                                qdepth)
+                    profiler.record_serve_requests(
+                        [now - r.t_enq for r in reqs])
+                    return
+                # outputs are not rowwise (batch-reducing model): stop
+                # merging requests from here on — correctness first
+                self._rowwise_ok = False
+                if not self._warned_rowwise:
+                    self._warned_rowwise = True
+                    import warnings
+                    warnings.warn(
+                        "DynamicBatcher: model outputs are not rowwise "
+                        "(leading dim != dispatched batch); falling back "
+                        "to per-request execution", RuntimeWarning)
+            except Exception:
+                pass               # isolate below, request by request
+        # per-request fallback: a poison request fails only itself
+        for r in reqs:
+            if r.future.done():
+                continue
+            try:
+                if r.solo or not self._rowwise_ok:
+                    outs = pred.run_batch(r.arrays)
+                    r.future.set_result([np.asarray(o) for o in outs])
+                else:
+                    r.pad_map.clear()
+                    stacked, bucket, real, padded = self._assemble(
+                        [r], r.key)
+                    outs = pred.run_batch(stacked)
+                    if not self._slice_back(outs, [r], bucket):
+                        outs = pred.run_batch(r.arrays)
+                        r.future.set_result([np.asarray(o) for o in outs])
+                    profiler.record_serve_batch(r.rows, bucket, real,
+                                                padded, qdepth)
+                profiler.record_serve_request(
+                    time.perf_counter() - r.t_enq)
+            except Exception as e:
+                profiler.record_serve_error()
+                r.future.set_exception(e)
+
+    # -- warmup ----------------------------------------------------------
+
+    def warmup_signatures(self) -> List[list]:
+        """The bounded signature set steady-state traffic maps onto: the
+        cross product of batch-ladder rungs and ladder rungs per distinct
+        trailing dynamic symbol (shared symbols vary together), capped at
+        _WARMUP_SIG_CAP signatures."""
+        if not self._can_batch:
+            return []
+        batch_rungs = [b for b in self._ladder if b <= self._max_batch] \
+            or [self._max_batch]
+        syms: List[str] = []
+        for i, (shape, _) in enumerate(self._specs):
+            for j in self._dyn_axes[i]:
+                s = shape[j]
+                if s not in syms:
+                    syms.append(s)
+        sigs = []
+        for combo in product(batch_rungs, *[self._ladder for _ in syms]):
+            assign = dict(zip(syms, combo[1:]))
+            sig = []
+            for shape, dtype in self._specs:
+                dims = [combo[0]]
+                for j, d in enumerate(shape[1:], start=1):
+                    dims.append(d if isinstance(d, int)
+                                else assign.get(d, self._ladder[-1]))
+                sig.append((tuple(dims), dtype))
+            sigs.append(sig)
+            if len(sigs) >= _WARMUP_SIG_CAP:
+                break
+        return sigs
+
+    def warmup(self) -> int:
+        """AOT-compile the whole bucket set on every pooled predictor;
+        returns the number of compiles actually performed (0 when the
+        persistent cache or a prior warmup already holds them all)."""
+        from .. import profiler
+
+        sigs = self.warmup_signatures()
+        before = len(profiler.compile_events())
+        for pred in self._preds:
+            pred.warm(sigs)
+        return len(profiler.compile_events()) - before
+
+    # -- lifecycle -------------------------------------------------------
+
+    @property
+    def ladder(self) -> List[int]:
+        return list(self._ladder)
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._q)
+
+    def stop(self):
+        """Stop accepting work, drain the queue into errors, and join the
+        dispatcher + workers."""
+        with self._cond:
+            self._stop = True
+            pending = list(self._q)
+            self._q.clear()
+            self._cond.notify_all()
+        for r in pending:
+            r.future.set_exception(RuntimeError("DynamicBatcher stopped"))
+        self._dispatcher.join(timeout=5)
+        for wq in self._wqueues:
+            wq.put(None)
+        for t in self._workers:
+            t.join(timeout=5)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()

@@ -9,6 +9,7 @@
 #include <stdlib.h>
 #include <string.h>
 #include <sys/socket.h>
+#include <sys/time.h>
 #include <unistd.h>
 
 #define PD_MAGIC 0x31494450u /* 'PDI1' */
@@ -91,6 +92,23 @@ PD_Predictor* PD_PredictorConnect(const char* host, int port) {
   PD_Predictor* p = (PD_Predictor*)malloc(sizeof(PD_Predictor));
   p->fd = fd;
   return p;
+}
+
+int PD_PredictorSetTimeout(PD_Predictor* p, double seconds) {
+  struct timeval tv;
+  if (seconds <= 0) {
+    tv.tv_sec = 0; /* zero timeval = blocking mode */
+    tv.tv_usec = 0;
+  } else {
+    tv.tv_sec = (time_t)seconds;
+    tv.tv_usec = (suseconds_t)((seconds - (double)tv.tv_sec) * 1e6);
+  }
+  if (setsockopt(p->fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv)) != 0 ||
+      setsockopt(p->fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv)) != 0) {
+    set_err("setsockopt(SO_RCVTIMEO/SO_SNDTIMEO) failed");
+    return -1;
+  }
+  return 0;
 }
 
 int PD_PredictorRun(PD_Predictor* p, const PD_Tensor* ins, int n_in,

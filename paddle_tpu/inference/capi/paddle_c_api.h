@@ -58,6 +58,13 @@ void PD_FreeTensors(PD_Tensor* ts, int n);
 void PD_PredictorDelete(PD_Predictor* p);
 const char* PD_GetLastError(void);
 
+/* Wall-clock budget for one request/reply round trip (applies to both
+ * send and recv). Under the daemon's dynamic batching a request may wait
+ * up to its batch deadline before executing; this caps how long the
+ * client blocks on a wedged daemon instead of hanging forever. seconds
+ * <= 0 restores fully blocking I/O. Returns 0 on success. */
+int PD_PredictorSetTimeout(PD_Predictor* p, double seconds);
+
 int64_t PD_TensorNumel(const PD_Tensor* t);
 
 #ifdef __cplusplus

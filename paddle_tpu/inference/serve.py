@@ -17,11 +17,21 @@ Wire protocol (little endian), one request per round trip:
 dtype codes match utils/cpp_extension: 0 f32, 1 f64, 2 i32, 3 i64, 4 u8,
 5 bool.
 
-    python -m paddle_tpu.inference.serve /path/prefix --port 9000
+Engine: with ``max_batch_size > 1`` (the CLI default) the daemon is a
+batched, compile-bounded pipeline — reader threads enqueue decoded
+tensors into a DynamicBatcher (inference/batching.py), a dispatcher
+forms deadline-bounded batches padded to a shape-bucket ladder, and one
+AOT-compiled executable per bucket answers them; ``--warmup``
+pre-compiles the whole bucket set so steady-state traffic never
+compiles. ``max_batch_size in (0, 1)`` keeps the legacy one-request-at-
+a-time lock. See docs/serving.md.
+
+    python -m paddle_tpu.inference.serve /path/prefix --port 9000 --warmup
 """
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import socket
 import struct
@@ -32,6 +42,10 @@ import numpy as np
 MAGIC = 0x31494450          # 'PDI1'
 ERR = 0xFFFFFFFF
 _DTYPES = [np.float32, np.float64, np.int32, np.int64, np.uint8, np.bool_]
+_MAX_TENSORS = 256          # a request claiming more is malformed
+_MAX_NDIM = 32
+_DEFAULT_MAX_REQUEST_BYTES = 1 << 28       # 256 MiB
+_SEND_COPY_MAX = 1 << 16    # payloads above this go out via memoryview
 
 
 def _recv_exact(sock, n):
@@ -39,24 +53,61 @@ def _recv_exact(sock, n):
     return recv_exact(sock, n, what="client")
 
 
-def read_tensors(sock):
+def max_request_bytes() -> int:
+    """Per-request payload budget (``PADDLE_TPU_MAX_REQUEST_BYTES``)."""
+    try:
+        return int(os.environ.get("PADDLE_TPU_MAX_REQUEST_BYTES",
+                                  str(_DEFAULT_MAX_REQUEST_BYTES)))
+    except ValueError:
+        return _DEFAULT_MAX_REQUEST_BYTES
+
+
+def read_tensors(sock, max_bytes=None):
+    """Decode one request frame, validating every size field BEFORE
+    allocating or recv-ing: dtype code and ndim in range, no negative
+    dims, and the total payload capped by PADDLE_TPU_MAX_REQUEST_BYTES —
+    a hostile header must not be able to drive ``count * itemsize`` into
+    a huge (or, via int64 overflow, negative) recv."""
+    if max_bytes is None:
+        max_bytes = max_request_bytes()
     magic, n = struct.unpack("<II", _recv_exact(sock, 8))
     if magic != MAGIC:
         raise ValueError("bad magic")
-    out = []
+    if n > _MAX_TENSORS:
+        raise ValueError(f"request claims {n} tensors "
+                         f"(cap {_MAX_TENSORS})")
+    out, total = [], 0
     for _ in range(n):
         dt, nd = struct.unpack("<BB", _recv_exact(sock, 2))
+        if dt >= len(_DTYPES):
+            raise IndexError(f"bad dtype code {dt}")
+        if nd > _MAX_NDIM:
+            raise ValueError(f"tensor ndim {nd} exceeds cap {_MAX_NDIM}")
         shape = struct.unpack(f"<{nd}q", _recv_exact(sock, 8 * nd)) \
             if nd else ()
+        if any(d < 0 for d in shape):
+            raise ValueError(f"negative dim in shape {shape}")
         dtype = np.dtype(_DTYPES[dt])
-        count = int(np.prod(shape, dtype=np.int64)) if nd else 1
-        data = _recv_exact(sock, count * dtype.itemsize)
-        out.append(np.frombuffer(data, dtype).reshape(shape).copy())
+        count = 1
+        for d in shape:          # python ints: no int64 overflow
+            count *= d
+        nbytes = count * dtype.itemsize
+        total += nbytes
+        if total > max_bytes:
+            raise ValueError(
+                f"request exceeds PADDLE_TPU_MAX_REQUEST_BYTES="
+                f"{max_bytes} ({total} bytes claimed)")
+        data = _recv_exact(sock, nbytes)
+        out.append(np.frombuffer(data, dtype, count).reshape(shape).copy())
     return out
 
 
 def write_tensors(sock, arrays):
-    parts = [struct.pack("<II", MAGIC, len(arrays))]
+    """Encode one reply frame. Small tensors are coalesced into one
+    buffered send; large payloads go out as per-part ``sendall`` on a
+    ``memoryview`` of the array — no ``tobytes()`` + ``b"".join`` double
+    copy of multi-megabyte results."""
+    small = [struct.pack("<II", MAGIC, len(arrays))]
     for a in arrays:
         a = np.ascontiguousarray(a)
         if a.dtype not in [np.dtype(d) for d in _DTYPES]:
@@ -68,10 +119,16 @@ def write_tensors(sock, arrays):
                     f"unsupported output dtype {a.dtype} on the wire "
                     f"(supported: {[np.dtype(d).name for d in _DTYPES]})")
         dt = next(i for i, d in enumerate(_DTYPES) if np.dtype(d) == a.dtype)
-        parts.append(struct.pack("<BB", dt, a.ndim))
-        parts.append(struct.pack(f"<{a.ndim}q", *a.shape))
-        parts.append(a.tobytes())
-    sock.sendall(b"".join(parts))
+        small.append(struct.pack("<BB", dt, a.ndim))
+        small.append(struct.pack(f"<{a.ndim}q", *a.shape))
+        if a.nbytes > _SEND_COPY_MAX:
+            sock.sendall(b"".join(small))
+            small = []
+            sock.sendall(memoryview(a).cast("B"))
+        else:
+            small.append(a.tobytes())
+    if small:
+        sock.sendall(b"".join(small))
 
 
 def write_error(sock, msg: str):
@@ -79,18 +136,63 @@ def write_error(sock, msg: str):
     sock.sendall(struct.pack("<III", MAGIC, ERR, len(m)) + m)
 
 
+def _idle_timeout_default() -> float:
+    try:
+        return float(os.environ.get("PADDLE_TPU_SERVE_IDLE_TIMEOUT", "600"))
+    except ValueError:
+        return 600.0
+
+
 class InferenceServer:
-    """Serves one loaded model; thread-per-connection (the predictor call
-    itself is serialized — XLA executables are thread-compatible but
-    request ordering keeps tail latency predictable on one chip)."""
+    """Serves one loaded model over TCP.
+
+    Two engines:
+    * ``max_batch_size in (None, 0, 1)`` — legacy serialized mode: the
+      predictor call runs under a global lock, one request at a time.
+    * ``max_batch_size > 1`` — batched mode: connection threads only
+      decode and enqueue; a DynamicBatcher forms deadline-bounded
+      batches, pads them to the bucket ladder, and round-robins them
+      across ``pool_size`` predictors pinned to distinct devices.
+      ``warmup=True`` pre-compiles every bucket at startup so
+      steady-state traffic never compiles.
+
+    ``stats_interval > 0`` prints a periodic ``SERVE_STATS {json}`` line
+    (queue depth, occupancy, padding waste, compile count, latency
+    percentiles, reqs/s) from ``profiler.serve_stats()``.
+    """
 
     def __init__(self, model_prefix: str, port: int = 0,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1", max_batch_size: int = None,
+                 batch_timeout_ms: float = 2.0, pool_size: int = 1,
+                 warmup: bool = False, idle_timeout: float = None,
+                 stats_interval: float = 0.0):
         # loopback by default: the daemon is unauthenticated — exposing a
         # model to the network segment must be an explicit --host choice
-        from . import Config, create_predictor
-        self._predictor = create_predictor(Config(model_prefix))
+        from . import Config, PredictorPool, create_predictor
+        cfg = Config(model_prefix)
+        if max_batch_size is None:
+            max_batch_size = int(os.environ.get("PADDLE_TPU_SERVE_BATCH",
+                                                "0") or 0)
+        self._batched = max_batch_size and int(max_batch_size) > 1
+        self._batcher = None
+        self.warmup_compiles = 0
+        if self._batched:
+            from .batching import DynamicBatcher
+            pool = PredictorPool(cfg, size=max(int(pool_size), 1),
+                                 devices="auto" if int(pool_size) > 1
+                                 else None)
+            self._pool = pool
+            self._predictor = pool.retrieve(0)
+            self._batcher = DynamicBatcher(
+                pool, max_batch_size=int(max_batch_size),
+                batch_timeout_ms=batch_timeout_ms)
+            if warmup:
+                self.warmup_compiles = self._batcher.warmup()
+        else:
+            self._predictor = create_predictor(cfg)
         self._lock = threading.Lock()
+        self._idle_timeout = _idle_timeout_default() \
+            if idle_timeout is None else float(idle_timeout)
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind((host, port))
@@ -100,6 +202,15 @@ class InferenceServer:
         self._thread = threading.Thread(target=self._accept_loop,
                                         daemon=True)
         self._thread.start()
+        if stats_interval and stats_interval > 0:
+            self._stats_thread = threading.Thread(
+                target=self._stats_loop, args=(float(stats_interval),),
+                daemon=True)
+            self._stats_thread.start()
+
+    @property
+    def batched(self) -> bool:
+        return bool(self._batched)
 
     def _accept_loop(self):
         while not self._stop.is_set():
@@ -110,34 +221,56 @@ class InferenceServer:
             threading.Thread(target=self._serve_conn, args=(conn,),
                              daemon=True).start()
 
+    def _run(self, inputs):
+        if self._batcher is not None:
+            return self._batcher.submit(inputs).result()
+        with self._lock:
+            return self._predictor.run(inputs)
+
     def _serve_conn(self, conn):
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # per-connection idle timeout: a dead client must not pin a
+        # daemon thread (and its socket buffers) forever
+        timeout = self._idle_timeout
+        if timeout and timeout > 0:
+            conn.settimeout(timeout)
         try:
             while True:
                 try:
                     inputs = read_tensors(conn)
-                except (ConnectionError, struct.error):
+                except (ConnectionError, TimeoutError, struct.error):
                     return
                 except (ValueError, IndexError) as e:
-                    # unparseable request (bad magic / dtype code): the
-                    # stream is desynced — best-effort error frame, drop
-                    # the connection
+                    # unparseable request (bad magic / dtype code /
+                    # hostile sizes): the stream is desynced —
+                    # best-effort error frame, drop the connection
                     try:
                         write_error(conn, f"malformed request: {e}")
                     except OSError:
                         pass
                     return
                 try:
-                    with self._lock:
-                        outputs = self._predictor.run(inputs)
+                    outputs = self._run(inputs)
                     write_tensors(conn, outputs)
+                except (ConnectionError, TimeoutError):
+                    return
                 except Exception as e:   # model-side error -> client
                     write_error(conn, f"{type(e).__name__}: {e}")
         finally:
             conn.close()
 
+    def _stats_loop(self, interval: float):
+        from .. import profiler
+        while not self._stop.wait(interval):
+            stats = profiler.serve_stats()
+            if self._batcher is not None:
+                stats["queue_depth"] = self._batcher.queue_depth
+            print("SERVE_STATS " + json.dumps(stats), flush=True)
+
     def stop(self):
         self._stop.set()
+        if self._batcher is not None:
+            self._batcher.stop()
         try:
             self._srv.shutdown(socket.SHUT_RDWR)
         except OSError:
@@ -158,6 +291,24 @@ def main(argv=None):
     ap.add_argument("--host", default="127.0.0.1",
                     help="bind address (default loopback; 0.0.0.0 exposes "
                          "the unauthenticated daemon to the network)")
+    ap.add_argument("--max-batch", type=int, default=8,
+                    help="cross-request batch row budget (0/1 = legacy "
+                         "serialized mode)")
+    ap.add_argument("--batch-timeout-ms", type=float, default=2.0,
+                    help="max wait past the oldest queued request before "
+                         "dispatching a partial batch")
+    ap.add_argument("--pool", type=int, default=1,
+                    help="predictor pool size; >1 pins each slot to a "
+                         "distinct device and round-robins batches")
+    ap.add_argument("--warmup", action="store_true",
+                    help="AOT-compile the whole shape-bucket ladder at "
+                         "startup so steady-state traffic never compiles")
+    ap.add_argument("--idle-timeout", type=float, default=None,
+                    help="per-connection idle seconds before the daemon "
+                         "drops it (default "
+                         "PADDLE_TPU_SERVE_IDLE_TIMEOUT or 600; 0 = off)")
+    ap.add_argument("--stats-interval", type=float, default=10.0,
+                    help="seconds between SERVE_STATS lines (0 = off)")
     args = ap.parse_args(argv)
     # honor JAX_PLATFORMS for the daemon: a TPU PJRT plugin outranks the
     # env var during backend registration, so an explicit config update is
@@ -166,7 +317,14 @@ def main(argv=None):
     if platforms:
         import jax
         jax.config.update("jax_platforms", platforms)
-    srv = InferenceServer(args.model, port=args.port, host=args.host)
+    srv = InferenceServer(args.model, port=args.port, host=args.host,
+                          max_batch_size=args.max_batch,
+                          batch_timeout_ms=args.batch_timeout_ms,
+                          pool_size=args.pool, warmup=args.warmup,
+                          idle_timeout=args.idle_timeout,
+                          stats_interval=args.stats_interval)
+    if args.warmup:
+        print(f"WARMUP compiles={srv.warmup_compiles}", flush=True)
     print(f"SERVING {srv.port}", flush=True)
     try:
         threading.Event().wait()

@@ -265,6 +265,22 @@ class TranslatedLayer:
     def in_avals(self):
         return self._exported.in_avals
 
+    @property
+    def out_avals(self):
+        """Flat output avals — the exported program's output arity is
+        known before the first call (inference.Predictor derives
+        get_output_names from this)."""
+        return self._exported.out_avals
+
+    @property
+    def input_avals(self):
+        """Avals of the USER inputs only. jax flattens the export args
+        ``(params_dict, *inputs)`` dict-leaves-first, so the trailing
+        ``len(in_avals) - len(params)`` entries are the positional
+        inputs; their symbolic dims mark the dynamic axes the serving
+        bucket ladder pads."""
+        return self._exported.in_avals[len(self._params):]
+
 
 def load(path, params_path=None):
     """jit.load: read {path}.pdmodel + params -> TranslatedLayer.

@@ -27,7 +27,7 @@ import warnings
 from typing import Any, Dict, Optional, Tuple
 
 __all__ = ["setup_compilation_cache", "suspend_compilation_cache",
-           "cache_dir", "aot_compile",
+           "cache_dir", "aot_compile", "AotCache",
            "RetraceGuard", "RetraceError", "RetraceWarning"]
 
 _DISABLED = ("", "0", "off", "none", "disabled", "false")
@@ -145,6 +145,61 @@ def aot_compile(jitted, *args, label: str = "step", use_cache: bool = True,
 
     profiler.record_compile(label, dt, cache)
     return compiled, stats
+
+
+class AotCache:
+    """Keyed cache of AOT-compiled executables — the serving bucket ladder's
+    compile boundary.
+
+    One executable per input-shape signature; a miss goes through
+    :func:`aot_compile` (and is therefore recorded via
+    ``profiler.record_compile``), a hit is a dict lookup with no jax
+    dispatch-cache probe at all. The no-new-compiles-after-warmup property
+    the serving engine asserts is exactly "every steady-state key is
+    already in this dict". Thread-safe; compiles are serialized under the
+    lock so concurrent batch workers on one predictor never duplicate an
+    XLA run."""
+
+    def __init__(self, jitted, label: str = "aot"):
+        import threading
+
+        self._jitted = jitted
+        self._label = label
+        self._cache: Dict[tuple, Any] = {}
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def signature(arrays) -> tuple:
+        """Hashable (shape, dtype) signature of a positional arg list.
+        Works on concrete arrays and ShapeDtypeStructs alike."""
+        return tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
+
+    def get(self, key: tuple):
+        with self._lock:
+            return self._cache.get(key)
+
+    def get_or_compile(self, *args, key: Optional[tuple] = None):
+        """Return the executable for ``key`` (default: the signature of
+        ``args``), compiling via ``jitted.lower(*args).compile()`` on a
+        miss. ``args`` may mix concrete arrays (runtime miss) and
+        ShapeDtypeStructs (warmup)."""
+        if key is None:
+            key = self.signature(args)
+        with self._lock:
+            exe = self._cache.get(key)
+            if exe is None:
+                exe, _ = aot_compile(self._jitted, *args,
+                                     label=f"{self._label}:{key}")
+                self._cache[key] = exe
+        return exe
+
+    def keys(self):
+        with self._lock:
+            return list(self._cache)
+
+    def __len__(self):
+        with self._lock:
+            return len(self._cache)
 
 
 # ---------------------------------------------------------------------------

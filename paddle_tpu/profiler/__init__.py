@@ -18,6 +18,7 @@ dispatcher when the profiler is on.
 from __future__ import annotations
 
 import contextlib
+import math
 import threading
 import time
 from typing import Dict, List, Optional
@@ -28,7 +29,10 @@ __all__ = ["RecordEvent", "start_profiler", "stop_profiler", "profiler",
            "start_trace", "stop_trace", "is_profiling", "summary",
            "record_compile", "compile_events", "reset_compile_events",
            "record_step", "step_timeline", "reset_step_timeline",
-           "step_timeline_summary"]
+           "step_timeline_summary",
+           "record_serve_batch", "record_serve_request",
+           "record_serve_requests", "record_serve_error",
+           "serve_stats", "reset_serve_stats"]
 
 _lock = threading.Lock()
 _events: List[tuple] = []      # (name, start, dur, thread_id)
@@ -170,6 +174,110 @@ def step_timeline_summary() -> dict:
         "dispatch_gap_s": round(gap / n, 6),
         "device_step_s": round(dev / n, 6),
     }
+
+
+# ---------------------------------------------------------------------------
+# serving counters (inference.batching.DynamicBatcher feeds these)
+# ---------------------------------------------------------------------------
+
+_LAT_CAP = 100_000             # bound latency-sample memory on long runs
+
+
+def _serve_zero() -> dict:
+    return {"requests": 0, "errors": 0, "batches": 0,
+            "rows": 0, "capacity": 0, "real_elems": 0, "padded_elems": 0,
+            "queue_depth_max": 0, "lat": [], "t0": None, "t1": None}
+
+
+_serve = _serve_zero()
+
+
+def record_serve_batch(rows: int, capacity: int, real_elems: int,
+                       padded_elems: int, queue_depth: int = 0):
+    """Record one dispatched inference batch: ``rows`` real request rows
+    packed into a ``capacity``-row bucket, ``real_elems``/``padded_elems``
+    element counts before/after shape-bucket padding, and the request
+    queue depth observed at dispatch. Always collected (like compiles):
+    the serve stats line and benchmarks/serve_bench.py read these with
+    the host profiler off."""
+    with _lock:
+        _serve["batches"] += 1
+        _serve["rows"] += int(rows)
+        _serve["capacity"] += int(capacity)
+        _serve["real_elems"] += int(real_elems)
+        _serve["padded_elems"] += int(padded_elems)
+        _serve["queue_depth_max"] = max(_serve["queue_depth_max"],
+                                        int(queue_depth))
+
+
+def record_serve_request(latency_s: float):
+    """Record one successfully answered request (enqueue-to-result wall
+    clock). Timestamps of the first/last resolution bound the reqs/s
+    window in :func:`serve_stats`."""
+    record_serve_requests((latency_s,))
+
+
+def record_serve_requests(latencies_s):
+    """Batch form of :func:`record_serve_request` — one lock acquisition
+    for a whole dispatched batch's resolutions."""
+    now = time.perf_counter()
+    with _lock:
+        _serve["requests"] += len(latencies_s)
+        _serve["lat"].extend(float(v) for v in latencies_s)
+        if len(_serve["lat"]) > _LAT_CAP:
+            del _serve["lat"][: len(_serve["lat"]) - _LAT_CAP]
+        if _serve["t0"] is None:
+            _serve["t0"] = now
+        _serve["t1"] = now
+
+
+def record_serve_error():
+    """Record one request that resolved with an error (its latency is not
+    mixed into the percentiles)."""
+    with _lock:
+        _serve["errors"] += 1
+
+
+def _pctile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    k = max(0, min(len(sorted_vals) - 1,
+                   int(math.ceil(q * len(sorted_vals))) - 1))
+    return sorted_vals[k]
+
+
+def serve_stats() -> dict:
+    """Aggregate serving counters: request/batch totals, reqs_per_s,
+    batch_occupancy (real rows / padded bucket rows), padding_waste
+    (fraction of dispatched elements that were padding), queue_depth_max,
+    compile_count (all compiles recorded via record_compile) and
+    p50/p95/p99 request latency in ms."""
+    with _lock:
+        s = {k: v for k, v in _serve.items() if k != "lat"}
+        lat = sorted(_serve["lat"])
+        n_compiles = len(_compiles)
+    dur = (s["t1"] - s["t0"]) if s["t0"] is not None else 0.0
+    return {
+        "requests": s["requests"],
+        "errors": s["errors"],
+        "batches": s["batches"],
+        "reqs_per_s": round(s["requests"] / dur, 2) if dur > 0 else 0.0,
+        "batch_occupancy": round(s["rows"] / s["capacity"], 4)
+        if s["capacity"] else 0.0,
+        "padding_waste": round(1.0 - s["real_elems"] / s["padded_elems"], 4)
+        if s["padded_elems"] else 0.0,
+        "queue_depth_max": s["queue_depth_max"],
+        "compile_count": n_compiles,
+        "p50_latency_ms": round(_pctile(lat, 0.50) * 1e3, 3),
+        "p95_latency_ms": round(_pctile(lat, 0.95) * 1e3, 3),
+        "p99_latency_ms": round(_pctile(lat, 0.99) * 1e3, 3),
+    }
+
+
+def reset_serve_stats():
+    global _serve
+    with _lock:
+        _serve = _serve_zero()
 
 
 def start_profiler(state: str = "All", tracer_option: str = "Default"):

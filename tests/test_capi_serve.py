@@ -93,6 +93,9 @@ def test_c_client_end_to_end(served_model, tmp_path):
           float data[16];
           for (int i = 0; i < 16; ++i) data[i] = atof(argv[2 + i]);
           int64_t shape[2] = {2, 8};
+          if (PD_PredictorSetTimeout(p, 60.0) != 0) {
+            fprintf(stderr, "%s\\n", PD_GetLastError()); return 4;
+          }
           PD_Tensor in = {PD_FLOAT32, 2, shape, data};
           PD_Tensor* outs; int n_out;
           if (PD_PredictorRun(p, &in, 1, &outs, &n_out) != 0) {
@@ -118,6 +121,183 @@ def test_c_client_end_to_end(served_model, tmp_path):
     got = np.asarray([float(t) for t in res.stdout.split()],
                      np.float32).reshape(expect.shape)
     np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-5)
+
+
+# -- batched engine over the wire ----------------------------------------
+
+@pytest.fixture(scope="module")
+def batched_server(served_model):
+    prefix, _ = served_model
+    srv = InferenceServer(prefix, port=0, max_batch_size=8,
+                          batch_timeout_ms=5.0, warmup=True)
+    yield prefix, srv
+    srv.stop()
+
+
+def test_batched_wire_path_concurrent_clients(batched_server):
+    """Concurrent TCP clients with mixed row counts through the
+    DynamicBatcher daemon get exactly their own rows back."""
+    import threading
+    from paddle_tpu.inference.serve import read_tensors, write_tensors
+
+    prefix, srv = batched_server
+    assert srv.batched and srv.warmup_compiles >= 1
+    rng = np.random.default_rng(2)
+    xs = [rng.normal(size=(r, 8)).astype(np.float32)
+          for r in (1, 3, 2, 4, 1, 2)]
+    results = [None] * len(xs)
+    errors = []
+
+    def client(i):
+        try:
+            with socket.create_connection(("127.0.0.1", srv.port)) as s:
+                write_tensors(s, [xs[i]])
+                (out,) = read_tensors(s)
+                # keep-alive second round trip on the same connection
+                write_tensors(s, [xs[i]])
+                (out2,) = read_tensors(s)
+                np.testing.assert_array_equal(out, out2)
+                results[i] = out
+        except Exception as e:                  # pragma: no cover
+            errors.append((i, e))
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(len(xs))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors
+    for x, out in zip(xs, results):
+        np.testing.assert_allclose(out, _py_logits(prefix, x),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_batched_server_relays_per_request_errors(batched_server):
+    """A poison request through the batched daemon gets an error frame;
+    the batcher's isolation keeps the daemon serving afterwards."""
+    prefix, srv = batched_server
+    from paddle_tpu.inference.serve import (read_tensors, write_tensors,
+                                            _recv_exact)
+    bad = np.zeros((2, 5), np.float32)          # wrong feature width
+    with socket.create_connection(("127.0.0.1", srv.port)) as sock:
+        write_tensors(sock, [bad])
+        magic, n = struct.unpack("<II", _recv_exact(sock, 8))
+        assert magic == MAGIC and n == 0xFFFFFFFF
+        (mlen,) = struct.unpack("<I", _recv_exact(sock, 4))
+        assert _recv_exact(sock, mlen).decode()
+    # daemon still answers good requests
+    x = np.ones((1, 8), np.float32)
+    with socket.create_connection(("127.0.0.1", srv.port)) as sock:
+        write_tensors(sock, [x])
+        (out,) = read_tensors(sock)
+    np.testing.assert_allclose(out, _py_logits(prefix, x), rtol=1e-5)
+
+
+# -- wire hardening ------------------------------------------------------
+
+def _expect_malformed_reply(sock):
+    from paddle_tpu.inference.serve import _recv_exact
+    magic, n = struct.unpack("<II", _recv_exact(sock, 8))
+    assert magic == MAGIC and n == 0xFFFFFFFF
+    (mlen,) = struct.unpack("<I", _recv_exact(sock, 4))
+    return _recv_exact(sock, mlen).decode()
+
+
+def test_server_rejects_oversized_request_claim(served_model):
+    """A header claiming more bytes than PADDLE_TPU_MAX_REQUEST_BYTES is
+    rejected from the size fields alone — nothing that big is recv'd."""
+    _, srv = served_model
+    with socket.create_connection(("127.0.0.1", srv.port)) as sock:
+        # one f32 tensor claiming 2^40 elements
+        sock.sendall(struct.pack("<II", MAGIC, 1)
+                     + struct.pack("<BB", 0, 1)
+                     + struct.pack("<q", 1 << 40))
+        msg = _expect_malformed_reply(sock)
+        assert "MAX_REQUEST_BYTES" in msg
+
+
+def test_server_rejects_negative_dim(served_model):
+    _, srv = served_model
+    with socket.create_connection(("127.0.0.1", srv.port)) as sock:
+        sock.sendall(struct.pack("<II", MAGIC, 1)
+                     + struct.pack("<BB", 0, 2)
+                     + struct.pack("<qq", 4, -3))
+        assert "negative dim" in _expect_malformed_reply(sock)
+
+
+def test_server_rejects_bad_dtype_and_tensor_count(served_model):
+    _, srv = served_model
+    with socket.create_connection(("127.0.0.1", srv.port)) as sock:
+        sock.sendall(struct.pack("<II", MAGIC, 1)
+                     + struct.pack("<BB", 99, 1) + struct.pack("<q", 1))
+        assert "dtype" in _expect_malformed_reply(sock)
+    with socket.create_connection(("127.0.0.1", srv.port)) as sock:
+        sock.sendall(struct.pack("<II", MAGIC, 100000))
+        assert "tensors" in _expect_malformed_reply(sock)
+
+
+def test_request_byte_cap_env_knob(served_model, monkeypatch):
+    """PADDLE_TPU_MAX_REQUEST_BYTES is read per request, so tightening it
+    rejects a payload the default cap would accept."""
+    prefix, srv = served_model
+    from paddle_tpu.inference.serve import write_tensors
+    x = np.zeros((4, 8), np.float32)            # 128 bytes of payload
+    monkeypatch.setenv("PADDLE_TPU_MAX_REQUEST_BYTES", "64")
+    with socket.create_connection(("127.0.0.1", srv.port)) as sock:
+        write_tensors(sock, [x])
+        assert "MAX_REQUEST_BYTES" in _expect_malformed_reply(sock)
+    monkeypatch.delenv("PADDLE_TPU_MAX_REQUEST_BYTES")
+    from paddle_tpu.inference.serve import read_tensors
+    with socket.create_connection(("127.0.0.1", srv.port)) as sock:
+        write_tensors(sock, [x])
+        (out,) = read_tensors(sock)
+    np.testing.assert_allclose(out, _py_logits(prefix, x), rtol=1e-5)
+
+
+def test_idle_connection_is_dropped(served_model):
+    prefix, _ = served_model
+    srv = InferenceServer(prefix, port=0, idle_timeout=0.3)
+    try:
+        with socket.create_connection(("127.0.0.1", srv.port)) as sock:
+            sock.settimeout(10)
+            import time
+            time.sleep(1.0)                 # exceed the idle window
+            # the daemon has closed its side; we see EOF (or a reset)
+            try:
+                assert sock.recv(1) == b""
+            except ConnectionError:
+                pass
+    finally:
+        srv.stop()
+
+
+def test_large_reply_memoryview_path(served_model, tmp_path):
+    """Replies above the coalescing threshold ship via per-part sendall
+    on a memoryview; round-trip a >64KiB output to cover that path."""
+    import paddle_tpu.nn as nn_mod
+    from paddle_tpu.inference.serve import read_tensors, write_tensors
+
+    class Wide(nn_mod.Layer):
+        def forward(self, x):
+            import paddle_tpu as p
+            return p.concat([x] * 2048, axis=1)     # (2,8) -> (2,16384)
+
+    prefix = str(tmp_path / "wide")
+    paddle.jit.save(Wide(), prefix,
+                    input_spec=[InputSpec([None, 8], "float32")])
+    srv = InferenceServer(prefix, port=0)
+    try:
+        x = np.random.default_rng(4).normal(size=(2, 8)) \
+            .astype(np.float32)
+        with socket.create_connection(("127.0.0.1", srv.port)) as sock:
+            write_tensors(sock, [x])
+            (out,) = read_tensors(sock)
+        assert out.nbytes > (1 << 16)
+        np.testing.assert_allclose(out, np.concatenate([x] * 2048, axis=1),
+                                   rtol=1e-6)
+    finally:
+        srv.stop()
 
 
 def test_c_client_connect_refused(tmp_path):

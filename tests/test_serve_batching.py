@@ -1,0 +1,280 @@
+"""Dynamic batching engine (inference/batching.py): bucket ladder math,
+deadline/occupancy batch formation, padding correctness against the
+unbatched predictor, the zero-recompile-after-warmup contract, error
+isolation, and the multi-predictor pool path.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import profiler
+from paddle_tpu.inference import Config, Predictor, PredictorPool
+from paddle_tpu.inference.batching import (DynamicBatcher, bucket_ladder,
+                                           next_bucket)
+from paddle_tpu.static import InputSpec
+
+
+class SmallNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 4)
+
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+        return self.fc2(F.relu(self.fc1(x)))
+
+
+class Elementwise(nn.Layer):
+    def forward(self, x):
+        return x * 2.0 + 1.0
+
+
+@pytest.fixture(scope="module")
+def mlp_prefix(tmp_path_factory):
+    paddle.seed(11)
+    prefix = str(tmp_path_factory.mktemp("bm") / "mlp")
+    paddle.jit.save(SmallNet(), prefix,
+                    input_spec=[InputSpec([None, 8], "float32")])
+    return prefix
+
+
+@pytest.fixture(scope="module")
+def seq_prefix(tmp_path_factory):
+    prefix = str(tmp_path_factory.mktemp("bs") / "ew")
+    paddle.jit.save(Elementwise(), prefix,
+                    input_spec=[InputSpec([None, "seqlen"], "float32")])
+    return prefix
+
+
+# -- ladder units --------------------------------------------------------
+
+def test_bucket_ladder_default_pow2():
+    assert bucket_ladder(8, env="") == [1, 2, 4, 8]
+    assert bucket_ladder(16, env="") == [1, 2, 4, 8, 16]
+    # non-pow2 max_batch becomes the top rung
+    assert bucket_ladder(6, env="") == [1, 2, 4, 6]
+    assert bucket_ladder(1, env="") == [1]
+
+
+def test_bucket_ladder_env_override():
+    assert bucket_ladder(8, env="1, 3 8") == [1, 3, 8]
+    with pytest.raises(ValueError):
+        bucket_ladder(8, env="0,4")
+
+
+def test_bucket_ladder_reads_env(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_SERVE_BUCKETS", "2,5")
+    assert bucket_ladder(8) == [2, 5]
+
+
+def test_next_bucket():
+    ladder = [1, 2, 4, 8]
+    assert next_bucket(1, ladder) == 1
+    assert next_bucket(3, ladder) == 4
+    assert next_bucket(8, ladder) == 8
+    # beyond the top rung: powers of two of the top
+    assert next_bucket(9, ladder) == 16
+    assert next_bucket(33, ladder) == 64
+
+
+# -- formation: occupancy + deadline -------------------------------------
+
+def test_partial_batch_dispatches_at_deadline(mlp_prefix):
+    profiler.reset_serve_stats()
+    pred = Predictor(Config(mlp_prefix))
+    with DynamicBatcher(pred, max_batch_size=8,
+                        batch_timeout_ms=30.0) as b:
+        x = np.ones((3, 8), np.float32)
+        t0 = time.perf_counter()
+        out = b.submit([x]).result(timeout=30)
+        elapsed = time.perf_counter() - t0
+    assert out[0].shape == (3, 4)
+    # a 3-row request on an [1,2,4,8] ladder pads to bucket 4
+    stats = profiler.serve_stats()
+    assert stats["requests"] == 1
+    assert stats["batches"] == 1
+    assert stats["batch_occupancy"] == pytest.approx(3 / 4)
+    # the deadline (30ms) bounds the wait; compile time can dominate the
+    # first dispatch, so only sanity-bound the total
+    assert elapsed < 30
+
+
+def test_concurrent_requests_merge_into_batches(mlp_prefix):
+    pred = Predictor(Config(mlp_prefix))
+    with DynamicBatcher(pred, max_batch_size=8,
+                        batch_timeout_ms=100.0) as b:
+        b.warmup()
+        profiler.reset_serve_stats()
+        xs = [np.full((1, 8), float(i), np.float32) for i in range(8)]
+        futs = [b.submit([x]) for x in xs]
+        outs = [f.result(timeout=30) for f in futs]
+    for i, out in enumerate(outs):
+        assert out[0].shape == (1, 4)
+    stats = profiler.serve_stats()
+    assert stats["requests"] == 8
+    # 8 single-row requests submitted within a 100ms window must merge:
+    # far fewer dispatches than requests (exact count is timing-dependent)
+    assert stats["batches"] <= 4
+    assert stats["batch_occupancy"] > 0.5
+
+
+# -- correctness: padding + slicing vs the unbatched predictor -----------
+
+def test_batched_matches_unbatched(mlp_prefix):
+    pred = Predictor(Config(mlp_prefix))
+    ref = Predictor(Config(mlp_prefix))
+    rng = np.random.default_rng(3)
+    xs = [rng.normal(size=(r, 8)).astype(np.float32)
+          for r in (1, 3, 2, 5, 1, 4)]
+    with DynamicBatcher(pred, max_batch_size=8,
+                        batch_timeout_ms=5.0) as b:
+        b.warmup()
+        futs = [b.submit([x]) for x in xs]
+        outs = [f.result(timeout=30) for f in futs]
+    for x, out in zip(xs, outs):
+        expect = ref.run([x])[0]
+        assert out[0].shape == expect.shape
+        np.testing.assert_allclose(np.asarray(out[0]), expect,
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_trailing_dynamic_dim_pads_and_slices_back(seq_prefix):
+    """Requests with different seqlen land in the same trailing bucket,
+    batch together, and come back exactly un-padded."""
+    pred = Predictor(Config(seq_prefix))
+    with DynamicBatcher(pred, max_batch_size=8,
+                        batch_timeout_ms=50.0) as b:
+        b.warmup()
+        profiler.reset_serve_stats()
+        a = np.arange(10, dtype=np.float32).reshape(2, 5)
+        c = np.arange(21, dtype=np.float32).reshape(3, 7)
+        fa, fc = b.submit([a]), b.submit([c])
+        ra, rc = fa.result(timeout=30), fc.result(timeout=30)
+    np.testing.assert_array_equal(np.asarray(ra[0]), a * 2 + 1)
+    np.testing.assert_array_equal(np.asarray(rc[0]), c * 2 + 1)
+    # seqlen 5 and 7 both bucket to 8 -> same key -> mergeable; padding
+    # waste is nonzero because of the zero-fill
+    stats = profiler.serve_stats()
+    assert stats["requests"] == 2
+    assert stats["padding_waste"] > 0
+
+
+# -- the compile-bounded contract ----------------------------------------
+
+def test_no_recompile_after_warmup_on_mixed_shapes(mlp_prefix):
+    pred = Predictor(Config(mlp_prefix))
+    with DynamicBatcher(pred, max_batch_size=8,
+                        batch_timeout_ms=2.0) as b:
+        n_warm = b.warmup()
+        assert n_warm >= 1              # fresh predictor: real compiles
+        assert pred.aot_cache_size == len(b.warmup_signatures())
+        before = len(profiler.compile_events())
+        rng = np.random.default_rng(5)
+        futs = [b.submit([rng.normal(size=(r, 8)).astype(np.float32)])
+                for r in (1, 2, 3, 4, 5, 6, 7, 8, 3, 1, 8, 2)]
+        for f in futs:
+            f.result(timeout=30)
+        assert len(profiler.compile_events()) == before, \
+            "warmed bucket ladder must answer mixed shapes with zero compiles"
+
+
+def test_warmup_is_idempotent(mlp_prefix):
+    pred = Predictor(Config(mlp_prefix))
+    with DynamicBatcher(pred, max_batch_size=4) as b:
+        b.warmup()
+        assert b.warmup() == 0
+
+
+def test_warmup_signatures_cover_ladder(seq_prefix):
+    pred = Predictor(Config(seq_prefix))
+    with DynamicBatcher(pred, max_batch_size=4,
+                        ladder=[1, 4]) as b:
+        sigs = b.warmup_signatures()
+    # batch rungs {1,4} x seqlen rungs {1,4}
+    shapes = {sig[0][0] for sig in sigs}
+    assert shapes == {(1, 1), (1, 4), (4, 1), (4, 4)}
+
+
+# -- error isolation -----------------------------------------------------
+
+def test_poison_request_fails_only_itself(mlp_prefix):
+    pred = Predictor(Config(mlp_prefix))
+    rng = np.random.default_rng(7)
+    with DynamicBatcher(pred, max_batch_size=8,
+                        batch_timeout_ms=20.0) as b:
+        b.warmup()
+        good1 = b.submit([rng.normal(size=(2, 8)).astype(np.float32)])
+        poison = b.submit([np.zeros((2, 5), np.float32)])  # bad width
+        good2 = b.submit([rng.normal(size=(1, 8)).astype(np.float32)])
+        assert good1.result(timeout=30)[0].shape == (2, 4)
+        assert good2.result(timeout=30)[0].shape == (1, 4)
+        with pytest.raises(Exception):
+            poison.result(timeout=30)
+
+
+def test_wrong_input_count_fails_fast(mlp_prefix):
+    pred = Predictor(Config(mlp_prefix))
+    with DynamicBatcher(pred) as b:
+        fut = b.submit([np.zeros((1, 8), np.float32),
+                        np.zeros((1, 8), np.float32)])
+        with pytest.raises(ValueError, match="1 inputs"):
+            fut.result(timeout=10)
+
+
+def test_stop_drains_pending_to_errors(mlp_prefix):
+    pred = Predictor(Config(mlp_prefix))
+    b = DynamicBatcher(pred, max_batch_size=8, batch_timeout_ms=2.0)
+    b.stop()
+    fut = b.submit([np.zeros((1, 8), np.float32)])
+    with pytest.raises(RuntimeError, match="stopped"):
+        fut.result(timeout=10)
+
+
+# -- pool + predictor surface --------------------------------------------
+
+def test_batcher_over_predictor_pool(mlp_prefix):
+    pool = PredictorPool(Config(mlp_prefix), size=2, devices="auto")
+    ref = Predictor(Config(mlp_prefix))
+    rng = np.random.default_rng(9)
+    xs = [rng.normal(size=(2, 8)).astype(np.float32) for _ in range(12)]
+    with DynamicBatcher(pool, max_batch_size=4,
+                        batch_timeout_ms=2.0) as b:
+        b.warmup()
+        futs = [b.submit([x]) for x in xs]
+        outs = [f.result(timeout=60) for f in futs]
+    for x, out in zip(xs, outs):
+        np.testing.assert_allclose(np.asarray(out[0]), ref.run([x])[0],
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_get_output_names_arity_before_first_run(mlp_prefix):
+    pred = Predictor(Config(mlp_prefix))
+    # out_avals-derived arity, available BEFORE any run
+    assert pred.get_output_names() == ["out0"]
+    pred.get_output_handle("out0")      # must not raise pre-run
+
+
+def test_input_specs_expose_symbolic_dims(seq_prefix):
+    (shape, dtype), = Predictor(Config(seq_prefix)).input_specs()
+    assert shape[0] not in (0, 1) and not isinstance(shape[0], int)
+    assert shape[1] == "seqlen" or not isinstance(shape[1], int)
+    assert dtype == np.float32
+
+
+def test_serve_stats_shape():
+    profiler.reset_serve_stats()
+    profiler.record_serve_batch(3, 4, 24, 32, queue_depth=2)
+    profiler.record_serve_requests([0.001, 0.002, 0.003])
+    stats = profiler.serve_stats()
+    assert stats["requests"] == 3
+    assert stats["batches"] == 1
+    assert stats["batch_occupancy"] == pytest.approx(0.75)
+    assert stats["padding_waste"] == pytest.approx(0.25)
+    assert stats["queue_depth_max"] == 2
+    assert stats["p50_latency_ms"] == pytest.approx(2.0)
+    assert stats["p99_latency_ms"] <= 3.0 + 1e-6

@@ -1,0 +1,39 @@
+"""serve_bench.py contract: runs to rc 0 on CPU and emits one JSON line
+with the scored fields (reqs/s, occupancy, padding waste, latency
+percentiles, compile counts)."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+BENCH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "benchmarks", "serve_bench.py")
+
+
+@pytest.mark.slow
+def test_serve_bench_emits_json_contract():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    res = subprocess.run(
+        [sys.executable, BENCH, "--requests", "120", "--max-batch", "8",
+         "--batch-timeout-ms", "2.0"],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert res.returncode == 0, res.stderr
+    line = res.stdout.strip().splitlines()[-1]
+    out = json.loads(line)
+    assert out["metric"] == "serve_throughput"
+    assert "error" not in out, out
+    for key in ("value", "unit", "vs_baseline", "serial_reqs_per_s",
+                "batched_reqs_per_s", "speedup", "batch_occupancy",
+                "padding_waste", "p50_latency_ms", "p95_latency_ms",
+                "p99_latency_ms", "warmup_compiles", "compile_count",
+                "queue_depth_max"):
+        assert key in out, key
+    assert out["batched_reqs_per_s"] > 0
+    assert out["speedup"] > 1.0          # batching must beat serialized
+    # the compile-bounded contract: zero compiles after warmup
+    assert out["compile_count"] == 0
+    assert out["warmup_compiles"] >= 1
+    assert 0 < out["batch_occupancy"] <= 1.0
+    assert 0 <= out["padding_waste"] < 1.0
