@@ -132,6 +132,18 @@ class Predictor:
             specs.append((shape, np.dtype(a.dtype)))
         return specs
 
+    def output_specs(self):
+        """Per-output (shape, dtype) with symbolic dims as their symbol
+        name string — the same scope as ``input_specs``, so an output
+        axis named ``"seqlen"`` is exactly the input axis the batcher
+        padded. Static dims are plain ints."""
+        specs = []
+        for a in self._layer.out_avals:
+            shape = tuple(d if isinstance(d, int) else str(d)
+                          for d in a.shape)
+            specs.append((shape, np.dtype(a.dtype)))
+        return specs
+
     @staticmethod
     def _sig_key(sig):
         return tuple((tuple(shape), str(np.dtype(dtype)))

@@ -15,20 +15,32 @@ compiles nothing.
 Shape buckets
     The ladder defaults to powers of two up to ``max_batch_size`` and is
     overridable via ``PADDLE_TPU_SERVE_BUCKETS`` (comma/space separated
-    ints, e.g. ``"1,2,4,8,16,32"``). The batch (leading) dim of a formed
-    batch is padded UP to the next rung; trailing *dynamic* dims (the
-    export's symbolic axes, e.g. a ``"seqlen"`` spec) are bucketed with
-    the same ladder — requests whose trailing dims land in the same rung
-    batch together and are zero-padded to it. Values beyond the top rung
-    grow by powers of two (one compile each, still bounded).
+    ints, e.g. ``"1,2,4,8,16,32"``); a custom ladder whose top rung is
+    below ``max_batch_size`` is extended by powers of two so warmup
+    covers every batch shape formation can produce. The batch (leading)
+    dim of a formed batch is padded UP to the next rung; trailing
+    *dynamic* dims (the export's symbolic axes, e.g. a ``"seqlen"``
+    spec) are bucketed with the same ladder — requests whose trailing
+    dims land in the same rung batch together and are zero-padded to it.
+    Values beyond the top rung grow by powers of two (one compile each,
+    still bounded).
 
 Correctness contract
     Batch-dim padding assumes row-independent outputs (true of any
     batch-polymorphic export whose leading symbol is the batch); the
-    engine verifies each output's leading dim equals the dispatched
-    bucket and falls back to per-request execution otherwise. Trailing
-    zero-padding additionally assumes padding-invariance per row
-    (elementwise/masked models); see docs/serving.md for the caveat.
+    engine checks each output's leading *symbol* is the batch symbol
+    (falling back to a runtime leading-dim check when output avals are
+    unavailable) and runs per-request otherwise. Trailing zero-padding
+    additionally requires padding-invariance per row, so it is governed
+    by ``trailing`` / ``PADDLE_TPU_SERVE_TRAILING``: ``"auto"`` (the
+    default) PROVES invariance at startup by comparing a padded against
+    an unpadded probe run and disables trailing bucketing on mismatch
+    (softmax/attention/mean over the padded axis); ``"on"`` forces it,
+    ``"off"`` restricts batching to the batch dim (requests merge only
+    on exact trailing shapes). Un-padding of results is keyed by the
+    SYMBOL an output axis carries, never by its size — a static output
+    dim that happens to equal a rung is left alone, and two axes padded
+    to the same rung from different originals cannot collide.
 
 Error isolation
     A failed batch is re-executed per request, so a poison request (bad
@@ -40,7 +52,7 @@ import os
 import threading
 import time
 from collections import deque
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 from itertools import product
 from queue import Queue
 from typing import List, Optional, Sequence
@@ -115,7 +127,8 @@ class DynamicBatcher:
 
     def __init__(self, predictors, max_batch_size: int = DEFAULT_MAX_BATCH,
                  batch_timeout_ms: float = DEFAULT_TIMEOUT_MS,
-                 ladder: Optional[Sequence[int]] = None):
+                 ladder: Optional[Sequence[int]] = None,
+                 trailing: Optional[str] = None):
         preds = getattr(predictors, "predictors", None)
         if preds is None:
             preds = (list(predictors)
@@ -130,6 +143,11 @@ class DynamicBatcher:
         self._timeout_s = float(batch_timeout_ms) / 1e3
         self._ladder = list(ladder) if ladder is not None \
             else bucket_ladder(self._max_batch)
+        # a custom PADDLE_TPU_SERVE_BUCKETS ladder may top out below the
+        # row budget; extend it so warmup_signatures covers every batch
+        # bucket next_bucket can hand a full batch (zero-compile contract)
+        while self._ladder[-1] < self._max_batch:
+            self._ladder.append(self._ladder[-1] * 2)
         self._specs = preds[0].input_specs()
         self._n_inputs = len(self._specs)
         self._dyn_axes = [
@@ -138,6 +156,16 @@ class DynamicBatcher:
         self._can_batch = bool(self._specs) and all(
             shape and not isinstance(shape[0], int)
             for shape, _ in self._specs)
+        self._batch_sym = self._specs[0][0][0] if self._can_batch else None
+        try:
+            self._out_syms = [tuple(shape)
+                              for shape, _ in preds[0].output_specs()]
+        except Exception:
+            self._out_syms = None     # un-padding then needs no pad_map
+        self._trailing_syms = {self._specs[i][0][j]
+                               for i in range(self._n_inputs)
+                               for j in self._dyn_axes[i]}
+        self._trailing = self._resolve_trailing(trailing)
         self._rowwise_ok = True      # flipped off if outputs aren't rowwise
         self._warned_rowwise = False
 
@@ -162,6 +190,125 @@ class DynamicBatcher:
                                             daemon=True,
                                             name="serve-dispatcher")
         self._dispatcher.start()
+
+    # -- trailing-dim padding policy -------------------------------------
+
+    @property
+    def trailing_bucketing(self) -> bool:
+        """Whether trailing dynamic dims are bucketed (padded) — False
+        means requests merge only on exact trailing shapes."""
+        return self._trailing
+
+    def _trailing_unpaddable(self):
+        """True when results padded along a trailing axis could not be
+        un-padded by symbol: output avals are unavailable, or some
+        output axis is a derived expression (e.g. ``2*seqlen``) rather
+        than a plain input symbol."""
+        if self._out_syms is None:
+            return True
+        known = set(self._trailing_syms)
+        if self._batch_sym is not None:
+            known.add(self._batch_sym)
+        return any(not isinstance(d, int) and d not in known
+                   for syms in self._out_syms for d in syms)
+
+    def _resolve_trailing(self, trailing) -> bool:
+        import warnings
+
+        mode = (trailing if trailing is not None else
+                os.environ.get("PADDLE_TPU_SERVE_TRAILING", "auto"))
+        mode = str(mode).lower()
+        if mode not in ("auto", "on", "off"):
+            raise ValueError(
+                f"trailing must be 'auto', 'on' or 'off', got {mode!r}")
+        if mode == "off" or not (self._can_batch and self._trailing_syms):
+            return False
+        if self._trailing_unpaddable():
+            if mode == "on":
+                warnings.warn(
+                    "DynamicBatcher: trailing='on' requested but output "
+                    "axes cannot be un-padded by symbol (output avals "
+                    "unavailable or derived dims); trailing-dim "
+                    "bucketing stays off", RuntimeWarning)
+            return False
+        if mode == "on":
+            return True
+        # auto: prove padding-invariance with a padded-vs-unpadded probe
+        try:
+            ok = self._probe_trailing_invariance()
+        except Exception:
+            ok = False
+        if not ok:
+            warnings.warn(
+                "DynamicBatcher: model outputs change under trailing "
+                "zero-padding (probe mismatch); batching on the batch "
+                "dim only. Pass trailing='on' (or "
+                "PADDLE_TPU_SERVE_TRAILING=on) to force bucketing for a "
+                "model you know is padding-invariant", RuntimeWarning)
+        return ok
+
+    def _probe_trailing_invariance(self) -> bool:
+        """Run the model once on exact trailing shapes and once on the
+        same rows zero-padded to the next rung; trailing bucketing is
+        safe only if the un-padded results agree."""
+        pred = self._preds[0]
+        tgt = max(next_bucket(2, self._ladder), 2)
+        orig = tgt - 1
+        rng = np.random.default_rng(0)
+        exact, padded = [], []
+        for i, (shape, dtype) in enumerate(self._specs):
+            dims = tuple(orig if j in self._dyn_axes[i] else shape[j]
+                         for j in range(1, len(shape)))
+            if np.issubdtype(dtype, np.floating):
+                a = rng.standard_normal((1,) + dims).astype(dtype)
+            elif dtype == np.bool_:
+                a = rng.integers(0, 2, (1,) + dims).astype(dtype)
+            else:
+                a = rng.integers(0, 4, (1,) + dims).astype(dtype)
+            exact.append(a)
+            pdims = tuple(tgt if j in self._dyn_axes[i] else shape[j]
+                          for j in range(1, len(shape)))
+            m = np.zeros((1,) + pdims, dtype)
+            m[tuple(slice(0, d) for d in a.shape)] = a
+            padded.append(m)
+        ref = pred.run_batch(exact)
+        got = pred.run_batch(padded)
+        if len(ref) != len(got):
+            return False
+        pad_map = {sym: orig for sym in self._trailing_syms}
+        for k, (r, g) in enumerate(zip(ref, got)):
+            g = self._unpad(g, self._out_syms[k], pad_map)
+            if r.shape != g.shape or \
+                    not np.allclose(r, g, rtol=1e-4, atol=1e-5):
+                return False
+        return True
+
+    @staticmethod
+    def _unpad(arr, syms, pad_map):
+        """Slice trailing axes of one output row-block back to the
+        originals recorded in ``pad_map`` — keyed by the SYMBOL the axis
+        carries, so static axes (whatever their size) are untouched."""
+        sl, changed = [slice(None)] * arr.ndim, False
+        for j in range(1, arr.ndim):
+            sym = syms[j] if syms is not None and j < len(syms) else None
+            orig = pad_map.get(sym) if isinstance(sym, str) else None
+            if orig is not None and orig != arr.shape[j]:
+                sl[j] = slice(0, orig)
+                changed = True
+        return arr[tuple(sl)] if changed else arr
+
+    @staticmethod
+    def _set(fut, value=None, exc=None):
+        """Deliver into a future the caller may have abandoned (e.g. a
+        server-side request deadline cancelled it) without letting
+        InvalidStateError kill the dispatcher/worker thread."""
+        try:
+            if exc is not None:
+                fut.set_exception(exc)
+            else:
+                fut.set_result(value)
+        except InvalidStateError:
+            pass
 
     # -- request intake --------------------------------------------------
 
@@ -208,9 +355,12 @@ class DynamicBatcher:
                     f"({rows} vs {a.shape[0]})")
         key = []
         for i, a in enumerate(arrays):
+            # trailing dynamic dims bucket to the ladder only when the
+            # policy proved (or the caller forced) padding-invariance;
+            # otherwise they stay exact and only same-shape requests merge
             trailing = tuple(
                 next_bucket(a.shape[j], self._ladder)
-                if j in self._dyn_axes[i] else a.shape[j]
+                if self._trailing and j in self._dyn_axes[i] else a.shape[j]
                 for j in range(1, a.ndim))
             key.append((str(a.dtype), trailing))
         return _Request(arrays, rows=int(rows), key=tuple(key))
@@ -291,37 +441,51 @@ class DynamicBatcher:
                 else:
                     mat[(slice(off, off + r.rows),)
                         + tuple(slice(0, d) for d in a.shape[1:])] = a
+                    # bookkeeping is keyed by the axis SYMBOL, never the
+                    # padded size: two axes sharing a rung cannot
+                    # collide, and a static output dim that happens to
+                    # equal the rung is never sliced
+                    spec_shape = self._specs[i][0]
                     for j, tgt in enumerate(target_trailing, start=1):
                         if a.shape[j] != tgt:
-                            r.pad_map[tgt] = a.shape[j]
+                            r.pad_map[spec_shape[j]] = a.shape[j]
                 off += r.rows
             padded += mat.size
             stacked.append(mat)
         return stacked, bucket, real, padded
 
-    @staticmethod
-    def _slice_back(outs, reqs, bucket) -> bool:
+    def _slice_back(self, outs, reqs, bucket) -> bool:
         """Hand each request its row slice (and un-pad trailing dims it
-        contributed padding to). False when the outputs are not rowwise —
-        the caller must fall back to per-request execution."""
-        if not all(o.ndim >= 1 and o.shape[0] == bucket for o in outs):
-            return False
+        contributed padding to, by symbol). False when the outputs are
+        not rowwise — or padded results could not be un-padded safely —
+        and the caller must fall back to per-request execution."""
+        syms = self._out_syms
+        if syms is not None and len(outs) != len(syms):
+            syms = None
+        if syms is not None:
+            # symbol-verified rowwise: every output leads with the batch
+            # symbol (a static leading dim that merely equals the bucket
+            # is NOT rowwise and must not be sliced per request)
+            if not all(s and s[0] == self._batch_sym for s in syms):
+                return False
+            if not all(o.ndim >= 1 and o.shape[0] == bucket for o in outs):
+                return False
+        else:
+            if not all(o.ndim >= 1 and o.shape[0] == bucket for o in outs):
+                return False
+            if any(r.pad_map for r in reqs):
+                # trailing padding happened but output symbols are
+                # unknown: un-padding would be guesswork
+                return False
         off = 0
         for r in reqs:
             res = []
-            for o in outs:
+            for k, o in enumerate(outs):
                 s = o[off:off + r.rows]
-                if r.pad_map:
-                    sl, changed = [slice(None)] * s.ndim, False
-                    for j in range(1, s.ndim):
-                        orig = r.pad_map.get(s.shape[j])
-                        if orig is not None and orig != s.shape[j]:
-                            sl[j] = slice(0, orig)
-                            changed = True
-                    if changed:
-                        s = s[tuple(sl)]
+                if r.pad_map and syms is not None:
+                    s = self._unpad(s, syms[k], r.pad_map)
                 res.append(s)            # views; the wire path copies
-            r.future.set_result(res)
+            self._set(r.future, res)
             off += r.rows
         return True
 
@@ -366,7 +530,7 @@ class DynamicBatcher:
             try:
                 if r.solo or not self._rowwise_ok:
                     outs = pred.run_batch(r.arrays)
-                    r.future.set_result([np.asarray(o) for o in outs])
+                    self._set(r.future, [np.asarray(o) for o in outs])
                 else:
                     r.pad_map.clear()
                     stacked, bucket, real, padded = self._assemble(
@@ -374,14 +538,14 @@ class DynamicBatcher:
                     outs = pred.run_batch(stacked)
                     if not self._slice_back(outs, [r], bucket):
                         outs = pred.run_batch(r.arrays)
-                        r.future.set_result([np.asarray(o) for o in outs])
+                        self._set(r.future, [np.asarray(o) for o in outs])
                     profiler.record_serve_batch(r.rows, bucket, real,
                                                 padded, qdepth)
                 profiler.record_serve_request(
                     time.perf_counter() - r.t_enq)
             except Exception as e:
                 profiler.record_serve_error()
-                r.future.set_exception(e)
+                self._set(r.future, exc=e)
 
     # -- warmup ----------------------------------------------------------
 
@@ -392,16 +556,23 @@ class DynamicBatcher:
         _WARMUP_SIG_CAP signatures."""
         if not self._can_batch:
             return []
-        batch_rungs = [b for b in self._ladder if b <= self._max_batch] \
-            or [self._max_batch]
+        # the ladder's top rung is >= max_batch (extended in __init__),
+        # so every batch bucket formation can produce is covered — a full
+        # batch on a sparse custom ladder may dispatch ABOVE max_batch
+        batch_cap = next_bucket(self._max_batch, self._ladder)
+        batch_rungs = [b for b in self._ladder if b <= batch_cap]
         syms: List[str] = []
         for i, (shape, _) in enumerate(self._specs):
             for j in self._dyn_axes[i]:
                 s = shape[j]
                 if s not in syms:
                     syms.append(s)
+        # with trailing bucketing off, dynamic trailing shapes pass
+        # through exactly — warming the ladder would compile shapes
+        # traffic may never hit, so warm one representative rung only
+        trail_rungs = self._ladder if self._trailing else [self._ladder[-1]]
         sigs = []
-        for combo in product(batch_rungs, *[self._ladder for _ in syms]):
+        for combo in product(batch_rungs, *[trail_rungs for _ in syms]):
             assign = dict(zip(syms, combo[1:]))
             sig = []
             for shape, dtype in self._specs:
@@ -446,7 +617,8 @@ class DynamicBatcher:
             self._q.clear()
             self._cond.notify_all()
         for r in pending:
-            r.future.set_exception(RuntimeError("DynamicBatcher stopped"))
+            self._set(r.future,
+                      exc=RuntimeError("DynamicBatcher stopped"))
         self._dispatcher.join(timeout=5)
         for wq in self._wqueues:
             wq.put(None)

@@ -19,6 +19,10 @@ static __thread char g_err[512];
 
 struct PD_Predictor {
   int fd;
+  /* Set after a timed-out or short read/write mid-round-trip: the stream
+   * may hold a partial frame, so any further request would parse stale
+   * bytes as a fresh reply. Poisoned handles fail fast; reconnect. */
+  int broken;
 };
 
 const char* PD_GetLastError(void) { return g_err; }
@@ -91,6 +95,7 @@ PD_Predictor* PD_PredictorConnect(const char* host, int port) {
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
   PD_Predictor* p = (PD_Predictor*)malloc(sizeof(PD_Predictor));
   p->fd = fd;
+  p->broken = 0;
   return p;
 }
 
@@ -115,6 +120,12 @@ int PD_PredictorRun(PD_Predictor* p, const PD_Tensor* ins, int n_in,
                     PD_Tensor** outs, int* n_out) {
   *outs = NULL;
   *n_out = 0;
+  if (p->broken) {
+    set_err(
+        "connection poisoned by an earlier timeout/short read — the wire "
+        "stream is desynced; delete this predictor and reconnect");
+    return -1;
+  }
   uint32_t hdr[2] = {PD_MAGIC, (uint32_t)n_in};
   if (write_full(p->fd, hdr, sizeof(hdr)) != 0) goto io_err;
   for (int i = 0; i < n_in; ++i) {
@@ -131,6 +142,7 @@ int PD_PredictorRun(PD_Predictor* p, const PD_Tensor* ins, int n_in,
   uint32_t rhdr[2];
   if (read_full(p->fd, rhdr, sizeof(rhdr)) != 0) goto io_err;
   if (rhdr[0] != PD_MAGIC) {
+    p->broken = 1;
     set_err("protocol desync (bad magic)");
     return -1;
   }
@@ -172,6 +184,10 @@ int PD_PredictorRun(PD_Predictor* p, const PD_Tensor* ins, int n_in,
 io_err_free:
   PD_FreeTensors(ts, n);
 io_err:
+  /* a failed round trip (timeout included) leaves an unknown number of
+   * frame bytes in flight: poison the handle so the next Run cannot
+   * parse stale bytes as its reply */
+  p->broken = 1;
   set_err("i/o error talking to serve daemon");
   return -1;
 }

@@ -62,7 +62,12 @@ const char* PD_GetLastError(void);
  * send and recv). Under the daemon's dynamic batching a request may wait
  * up to its batch deadline before executing; this caps how long the
  * client blocks on a wedged daemon instead of hanging forever. seconds
- * <= 0 restores fully blocking I/O. Returns 0 on success. */
+ * <= 0 restores fully blocking I/O. Returns 0 on success.
+ *
+ * A round trip that times out (or otherwise fails mid-frame) POISONS the
+ * connection: the stream may hold partial reply bytes, so every later
+ * PD_PredictorRun on the handle fails fast with a "poisoned" error
+ * instead of parsing stale bytes. Delete the predictor and reconnect. */
 int PD_PredictorSetTimeout(PD_Predictor* p, double seconds);
 
 int64_t PD_TensorNumel(const PD_Tensor* t);

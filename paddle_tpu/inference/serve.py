@@ -23,8 +23,11 @@ tensors into a DynamicBatcher (inference/batching.py), a dispatcher
 forms deadline-bounded batches padded to a shape-bucket ladder, and one
 AOT-compiled executable per bucket answers them; ``--warmup``
 pre-compiles the whole bucket set so steady-state traffic never
-compiles. ``max_batch_size in (0, 1)`` keeps the legacy one-request-at-
-a-time lock. See docs/serving.md.
+compiles. Trailing dynamic dims are only zero-padded when a startup
+probe proves the model padding-invariant (``--trailing``), and every
+batched request carries a server-side deadline (``--request-timeout``).
+``max_batch_size in (0, 1)`` keeps the legacy one-request-at-a-time
+lock. See docs/serving.md.
 
     python -m paddle_tpu.inference.serve /path/prefix --port 9000 --warmup
 """
@@ -36,6 +39,7 @@ import os
 import socket
 import struct
 import threading
+from concurrent.futures import TimeoutError as FuturesTimeout
 
 import numpy as np
 
@@ -143,6 +147,14 @@ def _idle_timeout_default() -> float:
         return 600.0
 
 
+def _request_timeout_default() -> float:
+    try:
+        return float(os.environ.get("PADDLE_TPU_SERVE_REQUEST_TIMEOUT",
+                                    "120"))
+    except ValueError:
+        return 120.0
+
+
 class InferenceServer:
     """Serves one loaded model over TCP.
 
@@ -165,7 +177,8 @@ class InferenceServer:
                  host: str = "127.0.0.1", max_batch_size: int = None,
                  batch_timeout_ms: float = 2.0, pool_size: int = 1,
                  warmup: bool = False, idle_timeout: float = None,
-                 stats_interval: float = 0.0):
+                 stats_interval: float = 0.0, request_timeout: float = None,
+                 trailing: str = None):
         # loopback by default: the daemon is unauthenticated — exposing a
         # model to the network segment must be an explicit --host choice
         from . import Config, PredictorPool, create_predictor
@@ -185,7 +198,7 @@ class InferenceServer:
             self._predictor = pool.retrieve(0)
             self._batcher = DynamicBatcher(
                 pool, max_batch_size=int(max_batch_size),
-                batch_timeout_ms=batch_timeout_ms)
+                batch_timeout_ms=batch_timeout_ms, trailing=trailing)
             if warmup:
                 self.warmup_compiles = self._batcher.warmup()
         else:
@@ -193,6 +206,8 @@ class InferenceServer:
         self._lock = threading.Lock()
         self._idle_timeout = _idle_timeout_default() \
             if idle_timeout is None else float(idle_timeout)
+        self._request_timeout = _request_timeout_default() \
+            if request_timeout is None else float(request_timeout)
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind((host, port))
@@ -223,7 +238,21 @@ class InferenceServer:
 
     def _run(self, inputs):
         if self._batcher is not None:
-            return self._batcher.submit(inputs).result()
+            fut = self._batcher.submit(inputs)
+            deadline = self._request_timeout
+            if not deadline or deadline <= 0:
+                return fut.result()
+            try:
+                return fut.result(timeout=deadline)
+            except FuturesTimeout:
+                # a wedged predictor/worker must not pin the connection
+                # thread forever; the future stays abandoned (the
+                # batcher delivers into it defensively) and the client
+                # gets an error frame instead of silence
+                raise RuntimeError(
+                    f"request deadline exceeded "
+                    f"({deadline:g}s in queue+execute; "
+                    f"PADDLE_TPU_SERVE_REQUEST_TIMEOUT)") from None
         with self._lock:
             return self._predictor.run(inputs)
 
@@ -294,6 +323,20 @@ def main(argv=None):
     ap.add_argument("--max-batch", type=int, default=8,
                     help="cross-request batch row budget (0/1 = legacy "
                          "serialized mode)")
+    ap.add_argument("--trailing", choices=("auto", "on", "off"),
+                    default=None,
+                    help="trailing-dynamic-dim bucketing policy: 'auto' "
+                         "(default) proves padding-invariance with a "
+                         "startup probe and falls back to batch-dim-only "
+                         "batching on mismatch; 'on' forces it; 'off' "
+                         "merges only exact trailing shapes "
+                         "(PADDLE_TPU_SERVE_TRAILING)")
+    ap.add_argument("--request-timeout", type=float, default=None,
+                    help="server-side deadline in seconds for one request "
+                         "(queue wait + execution); on expiry the client "
+                         "gets an error frame instead of blocking forever "
+                         "(default PADDLE_TPU_SERVE_REQUEST_TIMEOUT or "
+                         "120; 0 = off)")
     ap.add_argument("--batch-timeout-ms", type=float, default=2.0,
                     help="max wait past the oldest queued request before "
                          "dispatching a partial batch")
@@ -322,7 +365,9 @@ def main(argv=None):
                           batch_timeout_ms=args.batch_timeout_ms,
                           pool_size=args.pool, warmup=args.warmup,
                           idle_timeout=args.idle_timeout,
-                          stats_interval=args.stats_interval)
+                          stats_interval=args.stats_interval,
+                          request_timeout=args.request_timeout,
+                          trailing=args.trailing)
     if args.warmup:
         print(f"WARMUP compiles={srv.warmup_compiles}", flush=True)
     print(f"SERVING {srv.port}", flush=True)

@@ -255,6 +255,32 @@ def test_request_byte_cap_env_knob(served_model, monkeypatch):
     np.testing.assert_allclose(out, _py_logits(prefix, x), rtol=1e-5)
 
 
+def test_request_deadline_returns_error_frame(served_model):
+    """A wedged batched engine must not pin the connection thread: the
+    server-side request deadline expires into an error frame, and the
+    daemon keeps serving real requests afterwards."""
+    from concurrent.futures import Future
+    from paddle_tpu.inference.serve import read_tensors, write_tensors
+
+    prefix, _ = served_model
+    srv = InferenceServer(prefix, port=0, max_batch_size=8,
+                          batch_timeout_ms=5.0, request_timeout=0.3)
+    try:
+        srv._batcher.submit = lambda inputs: Future()   # never resolves
+        with socket.create_connection(("127.0.0.1", srv.port)) as sock:
+            sock.settimeout(30)
+            write_tensors(sock, [np.ones((1, 8), np.float32)])
+            assert "deadline" in _expect_malformed_reply(sock)
+        del srv._batcher.submit             # restore the real engine
+        x = np.ones((1, 8), np.float32)
+        with socket.create_connection(("127.0.0.1", srv.port)) as sock:
+            write_tensors(sock, [x])
+            (out,) = read_tensors(sock)
+        np.testing.assert_allclose(out, _py_logits(prefix, x), rtol=1e-5)
+    finally:
+        srv.stop()
+
+
 def test_idle_connection_is_dropped(served_model):
     prefix, _ = served_model
     srv = InferenceServer(prefix, port=0, idle_timeout=0.3)
@@ -298,6 +324,61 @@ def test_large_reply_memoryview_path(served_model, tmp_path):
                                    rtol=1e-6)
     finally:
         srv.stop()
+
+
+def test_c_client_timeout_poisons_connection(tmp_path):
+    """A timed-out round trip leaves the wire desynced; the client must
+    fail FAST on the next run instead of parsing stale frame bytes."""
+    import threading
+
+    lst = socket.socket()
+    lst.bind(("127.0.0.1", 0))
+    lst.listen(1)
+    port = lst.getsockname()[1]
+    conns = []
+
+    def accept():                       # accept, read nothing, never reply
+        try:
+            conns.append(lst.accept()[0])
+        except OSError:
+            pass
+
+    threading.Thread(target=accept, daemon=True).start()
+    main_c = tmp_path / "p.c"
+    main_c.write_text(textwrap.dedent("""
+        #include <stdio.h>
+        #include <stdlib.h>
+        #include "paddle_c_api.h"
+        int main(int argc, char** argv) {
+          PD_Predictor* p = PD_PredictorConnect("127.0.0.1",
+                                                atoi(argv[1]));
+          if (!p) return 2;
+          PD_PredictorSetTimeout(p, 0.3);
+          float data[8] = {0};
+          int64_t shape[2] = {1, 8};
+          PD_Tensor in = {PD_FLOAT32, 2, shape, data};
+          PD_Tensor* outs; int n_out;
+          if (PD_PredictorRun(p, &in, 1, &outs, &n_out) == 0) return 3;
+          /* second run on the desynced handle: must fail fast, not read */
+          if (PD_PredictorRun(p, &in, 1, &outs, &n_out) == 0) return 4;
+          printf("%s", PD_GetLastError());
+          PD_PredictorDelete(p);
+          return 0;
+        }
+    """))
+    exe = str(tmp_path / "pc")
+    subprocess.run(["gcc", "-I", CAPI_DIR, "-o", exe, str(main_c),
+                    os.path.join(CAPI_DIR, "paddle_c_api.c")],
+                   check=True, capture_output=True)
+    try:
+        res = subprocess.run([exe, str(port)], capture_output=True,
+                             text=True, timeout=30)
+    finally:
+        lst.close()
+        for c in conns:
+            c.close()
+    assert res.returncode == 0, (res.returncode, res.stdout, res.stderr)
+    assert "poisoned" in res.stdout
 
 
 def test_c_client_connect_refused(tmp_path):

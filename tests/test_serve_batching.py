@@ -34,6 +34,32 @@ class Elementwise(nn.Layer):
         return x * 2.0 + 1.0
 
 
+class StaticOut8(nn.Layer):
+    """Padding-invariant reduction whose static output width (8) equals
+    a bucket rung — regression for value-keyed pad_map truncation."""
+
+    def forward(self, x):
+        s = paddle.sum(x, axis=-1, keepdim=True)
+        return paddle.concat([s] * 8, axis=-1)
+
+
+class TwoSeq(nn.Layer):
+    """Two dynamic axes that can land in the same rung with different
+    originals — un-padding must track each by its own symbol."""
+
+    def forward(self, x, y):
+        return x * 2.0, y + 1.0
+
+
+class SoftmaxSeq(nn.Layer):
+    """NOT padding-invariant along seqlen: zero-padding adds exp(0)
+    mass, so trailing bucketing must be refused by the auto probe."""
+
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+        return F.softmax(x, axis=-1)
+
+
 @pytest.fixture(scope="module")
 def mlp_prefix(tmp_path_factory):
     paddle.seed(11)
@@ -164,7 +190,101 @@ def test_trailing_dynamic_dim_pads_and_slices_back(seq_prefix):
     assert stats["padding_waste"] > 0
 
 
+def test_static_output_dim_equal_to_rung_not_truncated(tmp_path):
+    """An output axis whose STATIC size equals the padded rung must come
+    back whole — un-padding is keyed by axis symbol, not size."""
+    prefix = str(tmp_path / "so8")
+    paddle.jit.save(StaticOut8(), prefix,
+                    input_spec=[InputSpec([None, "seqlen"], "float32")])
+    pred = Predictor(Config(prefix))
+    ref = Predictor(Config(prefix))
+    x = np.arange(10, dtype=np.float32).reshape(2, 5)   # seqlen 5 -> 8
+    with DynamicBatcher(pred, max_batch_size=8,
+                        batch_timeout_ms=5.0) as b:
+        assert b.trailing_bucketing          # sum is padding-invariant
+        out = b.submit([x]).result(timeout=30)
+    assert out[0].shape == (2, 8)
+    np.testing.assert_allclose(np.asarray(out[0]), ref.run([x])[0],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_two_symbols_same_rung_unpad_independently(tmp_path):
+    """s1=5 and s2=6 both pad to rung 8; each output must be sliced back
+    to ITS original length (value-keyed bookkeeping collided here)."""
+    prefix = str(tmp_path / "two")
+    paddle.jit.save(TwoSeq(), prefix,
+                    input_spec=[InputSpec([None, "s1"], "float32"),
+                                InputSpec([None, "s2"], "float32")])
+    pred = Predictor(Config(prefix))
+    x = np.arange(10, dtype=np.float32).reshape(2, 5)
+    y = np.arange(12, dtype=np.float32).reshape(2, 6)
+    with DynamicBatcher(pred, max_batch_size=8,
+                        batch_timeout_ms=5.0) as b:
+        out = b.submit([x, y]).result(timeout=30)
+    assert out[0].shape == (2, 5) and out[1].shape == (2, 6)
+    np.testing.assert_array_equal(np.asarray(out[0]), x * 2.0)
+    np.testing.assert_array_equal(np.asarray(out[1]), y + 1.0)
+
+
+# -- trailing-dim policy: auto probe / forced off -------------------------
+
+def test_auto_probe_disables_padding_variant_model(tmp_path):
+    """softmax over the dynamic axis fails the padded-vs-unpadded probe:
+    trailing bucketing turns off and results stay exactly correct."""
+    prefix = str(tmp_path / "sm")
+    paddle.jit.save(SoftmaxSeq(), prefix,
+                    input_spec=[InputSpec([None, "seqlen"], "float32")])
+    pred = Predictor(Config(prefix))
+    ref = Predictor(Config(prefix))
+    with pytest.warns(RuntimeWarning, match="zero-padding"):
+        b = DynamicBatcher(pred, max_batch_size=8, batch_timeout_ms=5.0)
+    try:
+        assert not b.trailing_bucketing
+        rng = np.random.default_rng(0)
+        x5 = rng.normal(size=(2, 5)).astype(np.float32)
+        x7 = rng.normal(size=(2, 7)).astype(np.float32)
+        f5, f7 = b.submit([x5]), b.submit([x7])
+        r5, r7 = f5.result(timeout=30), f7.result(timeout=30)
+    finally:
+        b.stop()
+    np.testing.assert_allclose(np.asarray(r5[0]), ref.run([x5])[0],
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(r7[0]), ref.run([x7])[0],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_trailing_off_merges_exact_shapes_only(seq_prefix):
+    pred = Predictor(Config(seq_prefix))
+    with DynamicBatcher(pred, max_batch_size=8, batch_timeout_ms=5.0,
+                        trailing="off") as b:
+        assert not b.trailing_bucketing
+        a = np.arange(10, dtype=np.float32).reshape(2, 5)
+        out = b.submit([a]).result(timeout=30)
+    np.testing.assert_array_equal(np.asarray(out[0]), a * 2 + 1)
+
+
+def test_trailing_invalid_mode_rejected(mlp_prefix):
+    pred = Predictor(Config(mlp_prefix))
+    with pytest.raises(ValueError, match="trailing"):
+        DynamicBatcher(pred, trailing="sometimes")
+
+
 # -- the compile-bounded contract ----------------------------------------
+
+def test_short_custom_ladder_extends_to_cover_max_batch(mlp_prefix):
+    """A PADDLE_TPU_SERVE_BUCKETS ladder topping out below max_batch is
+    extended by powers of two, so warmup still covers a full batch."""
+    pred = Predictor(Config(mlp_prefix))
+    with DynamicBatcher(pred, max_batch_size=8, ladder=[1, 2],
+                        batch_timeout_ms=2.0) as b:
+        assert b.ladder == [1, 2, 4, 8]
+        b.warmup()
+        before = len(profiler.compile_events())
+        out = b.submit([np.ones((8, 8), np.float32)]).result(timeout=30)
+        assert out[0].shape == (8, 4)
+        assert len(profiler.compile_events()) == before, \
+            "full batch on an extended ladder must hit a warmed shape"
+
 
 def test_no_recompile_after_warmup_on_mixed_shapes(mlp_prefix):
     pred = Predictor(Config(mlp_prefix))
