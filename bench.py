@@ -271,6 +271,11 @@ def main():
     # gap < device step time)
     async_stats = profiler.step_timeline_summary()
 
+    # full registry dump (observability layer): every counter the run
+    # touched, keyed by Prometheus sample name — diffable across runs
+    from paddle_tpu.observability import REGISTRY, install_default_collectors
+    install_default_collectors()
+
     print(json.dumps({
         "metric": "gpt2_124m_fit_tokens_per_sec" if not on_cpu
                   else "gpt_tiny_cpu_smoke_tokens_per_sec",
@@ -283,6 +288,7 @@ def main():
         "host_blocked_s": async_stats["host_blocked_s"],
         "dispatch_gap_s": async_stats["dispatch_gap_s"],
         "device_step_s": async_stats["device_step_s"],
+        "metrics": REGISTRY.flat(),
     }))
 
 

@@ -132,6 +132,7 @@ def run_bench(args):
     batched_rps = args.requests / batched_s
     steady_compiles = len(profiler.compile_events()) - c0
 
+    from paddle_tpu.observability import REGISTRY
     stats = profiler.serve_stats()
     speedup = batched_rps / serial_rps if serial_rps > 0 else 0.0
     return {
@@ -154,6 +155,10 @@ def run_bench(args):
         "p99_latency_ms": stats["p99_latency_ms"],
         "warmup_compiles": warmup_compiles,
         "compile_count": steady_compiles,
+        # raw registry samples behind the derived numbers above (the
+        # serve_* families only — the bench result stays shape-stable)
+        "metrics": {k: v for k, v in REGISTRY.flat().items()
+                    if k.startswith("paddle_tpu_serve_")},
     }
 
 

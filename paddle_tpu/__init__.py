@@ -50,6 +50,7 @@ from . import distribution  # noqa: F401
 from . import incubate  # noqa: F401
 from . import regularizer  # noqa: F401
 from . import profiler  # noqa: F401
+from . import observability  # noqa: F401
 from .core import monitor  # noqa: F401
 from . import device  # noqa: F401
 

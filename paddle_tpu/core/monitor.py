@@ -4,65 +4,93 @@ Reference: StatRegistry (platform/monitor.h:77 — global named int
 counters, e.g. STAT_GPU_MEM) exported to python via
 global_value_getter_setter.cc.
 
-TPU-native: the registry keeps the reference's named-counter surface for
-framework/user instrumentation; device memory numbers come from PJRT
-(jax Device.memory_stats) instead of allocator internals, because XLA
-owns HBM on TPU (SURVEY.md rows 7/10).
+TPU-native: the named-counter surface is kept (stat_inc/stat_set/...)
+but the backing store is the observability metrics registry — every
+stat lands as a ``paddle_tpu_monitor_stat{name="..."}`` gauge sample,
+so framework/user instrumentation shows up on the same ``/metrics``
+scrape as the serving counters (docs/observability.md). Device memory
+numbers come from PJRT (jax ``Device.memory_stats``) instead of
+allocator internals, because XLA owns HBM on TPU (SURVEY.md rows 7/10);
+every probe is hardened to return empty/zero — never raise — when the
+backend is unreachable or reports no memory stats (CPU).
 """
 from __future__ import annotations
 
-import threading
 from typing import Dict, Optional
 
-import jax
+from ..observability import metrics as _metrics
 
 __all__ = ["stat_inc", "stat_set", "stat_get", "stat_reset", "all_stats",
-           "device_memory_stats", "hbm_usage"]
+           "device_memory_stats", "all_device_memory_stats", "hbm_usage"]
 
-_lock = threading.Lock()
-_stats: Dict[str, int] = {}
+_STATS = _metrics.gauge(
+    "paddle_tpu_monitor_stat",
+    "Named framework counters (StatRegistry parity surface: "
+    "core.monitor.stat_inc/stat_set).",
+    labelnames=("name",))
 
 
 def stat_inc(name: str, value: int = 1) -> int:
-    with _lock:
-        _stats[name] = _stats.get(name, 0) + int(value)
-        return _stats[name]
+    return int(_STATS.labels(name=str(name)).inc(int(value)))
 
 
 def stat_set(name: str, value: int):
-    with _lock:
-        _stats[name] = int(value)
+    _STATS.labels(name=str(name)).set(int(value))
 
 
 def stat_get(name: str, default: int = 0) -> int:
-    with _lock:
-        return _stats.get(name, default)
+    v = _STATS.value(name=str(name))
+    return default if v is None else int(v)
 
 
 def stat_reset(name: Optional[str] = None):
-    with _lock:
-        if name is None:
-            _stats.clear()
-        else:
-            _stats.pop(name, None)
+    if name is None:
+        _STATS.clear()
+    else:
+        _STATS.remove(name=str(name))
 
 
 def all_stats() -> Dict[str, int]:
-    with _lock:
-        return dict(_stats)
+    return {labels["name"]: int(child.get())
+            for labels, child in _STATS.samples()}
 
 
 def device_memory_stats(device=None) -> Dict[str, int]:
     """PJRT per-device memory counters (bytes_in_use, peak_bytes_in_use,
-    bytes_limit where the runtime reports them)."""
-    device = device or jax.devices()[0]
+    bytes_limit where the runtime reports them). Returns ``{}`` — never
+    raises — when the backend fails to initialize or the device reports
+    no memory stats (CPU)."""
     try:
+        if device is None:
+            import jax
+            devs = jax.devices()
+            if not devs:
+                return {}
+            device = devs[0]
         return dict(device.memory_stats() or {})
     except Exception:
         return {}
 
 
+def all_device_memory_stats() -> Dict[str, Dict[str, int]]:
+    """{str(device): memory_stats} over every visible device; devices
+    (or backends) that cannot report come back as empty dicts."""
+    try:
+        import jax
+        devs = jax.devices()
+    except Exception:
+        return {}
+    out = {}
+    for d in devs:
+        try:
+            out[str(d)] = dict(d.memory_stats() or {})
+        except Exception:
+            out[str(d)] = {}
+    return out
+
+
 def hbm_usage(device=None):
-    """(bytes_in_use, bytes_limit) — the STAT_GPU_MEM analog for HBM."""
+    """(bytes_in_use, bytes_limit) — the STAT_GPU_MEM analog for HBM.
+    (0, 0) when the runtime has nothing to report."""
     st = device_memory_stats(device)
     return st.get("bytes_in_use", 0), st.get("bytes_limit", 0)

@@ -1,9 +1,10 @@
 """High-level training API (reference: python/paddle/hapi/ — Model
 hapi/model.py:810, fit :1299, callbacks hapi/callbacks.py)."""
-from .callbacks import (Callback, EarlyStopping, LRScheduler, ModelCheckpoint,
-                        ProgBarLogger)
+from .callbacks import (Callback, EarlyStopping, LRScheduler, MetricsLogger,
+                        ModelCheckpoint, ProgBarLogger)
 from .model import Model
 from .summary import flops, summary
 
 __all__ = ["Model", "summary", "flops", "Callback", "ProgBarLogger",
-           "ModelCheckpoint", "LRScheduler", "EarlyStopping"]
+           "ModelCheckpoint", "LRScheduler", "EarlyStopping",
+           "MetricsLogger"]

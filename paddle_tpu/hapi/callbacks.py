@@ -1,7 +1,10 @@
 """Training callbacks (reference: python/paddle/hapi/callbacks.py —
-ProgBarLogger, ModelCheckpoint, LRScheduler, EarlyStopping, VisualDL)."""
+ProgBarLogger, ModelCheckpoint, LRScheduler, EarlyStopping, VisualDL;
+MetricsLogger is the observability-layer addition — periodic JSONL
+training telemetry, docs/observability.md)."""
 from __future__ import annotations
 
+import json
 import numbers
 import os
 import sys
@@ -282,6 +285,102 @@ class EarlyStopping(Callback):
                 if self.verbose:
                     print(f"Early stopping at epoch {epoch + 1}: "
                           f"best {self.monitor}={self.best:.4f}")
+
+
+class MetricsLogger(Callback):
+    """Periodic machine-readable training telemetry.
+
+    Every ``log_freq`` train batches (and at each epoch end) one JSON
+    line goes to ``path`` (append; default stderr): monotonic timestamp,
+    epoch/step, steps/s over the window, the numeric entries of ``logs``
+    (loss, metrics), and — when the model runs the async step pipeline —
+    ``host_blocked_s`` / ``in_flight`` / ``steps_submitted`` from
+    ``model._async_pipeline.stats()``. Per-device HBM is sampled guarded:
+    backends with nothing to report contribute nothing and never raise.
+
+    The line format matches the serve-side span JSONL (one self-contained
+    object per line) so the same tooling tails both."""
+
+    def __init__(self, log_freq: int = 50, path: Optional[str] = None,
+                 hbm: bool = True):
+        super().__init__()
+        self.log_freq = max(int(log_freq), 1)
+        self.path = path
+        self.hbm = hbm
+        self._f = None
+        self._step = 0
+        self._win_t0 = None
+        self._win_step0 = 0
+
+    def _emit(self, payload: dict):
+        line = json.dumps(payload)
+        if self._f is not None:
+            self._f.write(line + "\n")
+            self._f.flush()
+        else:
+            print("TRAIN_METRICS " + line, file=sys.stderr, flush=True)
+
+    def on_train_begin(self, logs=None):
+        if self.path:
+            d = os.path.dirname(self.path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            self._f = open(self.path, "a")
+        self._step = 0
+        self._win_t0 = time.monotonic()
+        self._win_step0 = 0
+        self._epoch = 0
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+
+    def _payload(self, logs, event):
+        now = time.monotonic()
+        dt = now - (self._win_t0 or now)
+        steps = self._step - self._win_step0
+        payload = {k: float(v) for k, v in (logs or {}).items()
+                   if isinstance(v, numbers.Number)}
+        # structural fields win over same-named log entries (hapi logs
+        # carry their own "step": the in-epoch index, not ours)
+        payload.update(
+            ts_monotonic=round(now, 3),
+            event=event,
+            epoch=self._epoch,
+            step=self._step,
+            steps_per_s=round(steps / dt, 3) if dt > 0 and steps else 0.0)
+        pipe = getattr(self.model, "_async_pipeline", None)
+        if pipe is not None:
+            try:
+                payload.update(pipe.stats())
+            except Exception:
+                pass
+        if self.hbm:
+            try:
+                from ..core import monitor
+                hbm = {dev: st["bytes_in_use"]
+                       for dev, st in
+                       monitor.all_device_memory_stats().items()
+                       if st.get("bytes_in_use") is not None}
+                if hbm:
+                    payload["hbm_bytes_in_use"] = hbm
+            except Exception:
+                pass
+        self._win_t0 = now
+        self._win_step0 = self._step
+        return payload
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        if self._step % self.log_freq == 0:
+            self._emit(self._payload(logs, "step"))
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._emit(self._payload(logs, "epoch_end"))
+
+    def on_train_end(self, logs=None):
+        if self._f:
+            self._f.close()
+            self._f = None
 
 
 class VisualDL(Callback):

@@ -666,6 +666,19 @@ class Model:
                     if window >= 1 else None)
         self._async_pipeline = pipeline
         global_step = 0
+        try:
+            self._fit_epoch_loop(epochs, train_loader, eval_loader,
+                                 eval_freq, batch_size, num_iters,
+                                 prefetch_device, cbks, logs, pipeline,
+                                 global_step)
+        finally:
+            # the stall watchdog must not outlive the fit that owns it
+            if pipeline is not None:
+                pipeline.close()
+
+    def _fit_epoch_loop(self, epochs, train_loader, eval_loader, eval_freq,
+                        batch_size, num_iters, prefetch_device, cbks, logs,
+                        pipeline, global_step):
         for epoch in range(epochs):
             if self.stop_training:
                 break

@@ -101,9 +101,9 @@ def next_bucket(n: int, ladder: Sequence[int]) -> int:
 
 class _Request:
     __slots__ = ("arrays", "rows", "key", "pad_map", "future", "t_enq",
-                 "solo")
+                 "solo", "req_id")
 
-    def __init__(self, arrays, rows, key, solo=False):
+    def __init__(self, arrays, rows, key, solo=False, req_id=0):
         self.arrays = arrays
         self.rows = rows
         self.key = key
@@ -111,6 +111,7 @@ class _Request:
         self.future = Future()
         self.t_enq = time.perf_counter()
         self.solo = solo
+        self.req_id = req_id       # observability: spans + error frames
 
 
 class DynamicBatcher:
@@ -170,6 +171,17 @@ class DynamicBatcher:
         self._warned_rowwise = False
 
         self._q: deque = deque()
+        # request-scoped observability: stage histograms + sampled JSONL
+        # traces (PADDLE_TPU_TRACE_SAMPLE), and the stall flight recorder
+        # (PADDLE_TPU_STALL_DUMP) — a watchdog that dumps every thread's
+        # stack when queued work stops dispatching
+        from ..observability import FlightRecorder, SpanRecorder
+        self._spans = SpanRecorder(component="serve")
+        self._busy_batches = 0       # formed batches inside _execute
+        self._recorder = FlightRecorder(
+            "serve_batcher",
+            busy_fn=lambda: bool(self._q) or self._busy_batches > 0,
+            context_fn=self._stall_context)
         self._cond = threading.Condition()
         self._stop = False
         self._workers = []
@@ -298,6 +310,17 @@ class DynamicBatcher:
         return arr[tuple(sl)] if changed else arr
 
     @staticmethod
+    def _tag(exc, req_id):
+        """Stamp an exception with the request id it failed, so the wire
+        layer can return the id in the error frame (grep-able against a
+        sampled span trace)."""
+        try:
+            exc.request_id = int(req_id)
+        except Exception:
+            pass
+        return exc
+
+    @staticmethod
     def _set(fut, value=None, exc=None):
         """Deliver into a future the caller may have abandoned (e.g. a
         server-side request deadline cancelled it) without letting
@@ -315,7 +338,11 @@ class DynamicBatcher:
     def submit(self, inputs) -> Future:
         """Enqueue one request; the returned Future resolves to the list
         of output arrays for exactly this request's rows (or raises the
-        per-request error)."""
+        per-request error). The future carries the assigned request id
+        as ``.request_id``; errors carry the same id so a failing
+        request is traceable end to end."""
+        from ..observability import next_request_id
+        req_id = next_request_id()
         try:
             # no ascontiguousarray here: assembly copies into the zeroed
             # bucket buffer anyway, and the solo path normalizes itself
@@ -324,23 +351,26 @@ class DynamicBatcher:
                 raise ValueError(
                     f"model takes {self._n_inputs} inputs, got "
                     f"{len(arrays)}")
-            req = self._make_request(arrays)
+            req = self._make_request(arrays, req_id)
         except Exception as e:
             fut = Future()
-            fut.set_exception(e)
+            fut.request_id = req_id
+            fut.set_exception(self._tag(e, req_id))
             return fut
+        req.future.request_id = req_id
         with self._cond:
             if self._stop:
-                req.future.set_exception(
-                    RuntimeError("DynamicBatcher is stopped"))
+                req.future.set_exception(self._tag(
+                    RuntimeError("DynamicBatcher is stopped"), req_id))
                 return req.future
             self._q.append(req)
             self._cond.notify_all()
         return req.future
 
-    def _make_request(self, arrays) -> _Request:
+    def _make_request(self, arrays, req_id=0) -> _Request:
         if not (self._can_batch and self._rowwise_ok):
-            return _Request(arrays, rows=1, key=object(), solo=True)
+            return _Request(arrays, rows=1, key=object(), solo=True,
+                            req_id=req_id)
         rows = None
         for i, a in enumerate(arrays):
             shape, _ = self._specs[i]
@@ -363,7 +393,8 @@ class DynamicBatcher:
                 if self._trailing and j in self._dyn_axes[i] else a.shape[j]
                 for j in range(1, a.ndim))
             key.append((str(a.dtype), trailing))
-        return _Request(arrays, rows=int(rows), key=tuple(key))
+        return _Request(arrays, rows=int(rows), key=tuple(key),
+                        req_id=req_id)
 
     # -- batch formation -------------------------------------------------
 
@@ -497,19 +528,38 @@ class DynamicBatcher:
             self._execute(pred, *item)
 
     def _execute(self, pred, reqs, key, rows):
+        # busy accounting + heartbeat bracket the real work so the stall
+        # flight recorder can tell "no traffic" from "wedged mid-batch"
+        self._busy_batches += 1
+        try:
+            self._execute_inner(pred, reqs, key, rows)
+        finally:
+            self._busy_batches -= 1
+            self._recorder.beat()
+
+    def _execute_inner(self, pred, reqs, key, rows):
         from .. import profiler
 
         qdepth = len(self._q)
         if not reqs[0].solo:
             try:
+                t0 = time.perf_counter()
                 stacked, bucket, real, padded = self._assemble(reqs, key)
+                t1 = time.perf_counter()
                 outs = pred.run_batch(stacked)
+                t2 = time.perf_counter()
                 if self._slice_back(outs, reqs, bucket):
                     now = time.perf_counter()
                     profiler.record_serve_batch(rows, bucket, real, padded,
                                                 qdepth)
                     profiler.record_serve_requests(
                         [now - r.t_enq for r in reqs])
+                    for r in reqs:
+                        self._spans.record(
+                            r.req_id,
+                            {"queue_wait": t0 - r.t_enq, "pad": t1 - t0,
+                             "execute": t2 - t1, "unpad": now - t2},
+                            extra={"rows": r.rows, "bucket": bucket})
                     return
                 # outputs are not rowwise (batch-reducing model): stop
                 # merging requests from here on — correctness first
@@ -528,24 +578,38 @@ class DynamicBatcher:
             if r.future.done():
                 continue
             try:
+                t0 = time.perf_counter()
                 if r.solo or not self._rowwise_ok:
                     outs = pred.run_batch(r.arrays)
+                    t2 = time.perf_counter()
                     self._set(r.future, [np.asarray(o) for o in outs])
+                    spans = {"queue_wait": t0 - r.t_enq, "pad": 0.0,
+                             "execute": t2 - t0,
+                             "unpad": time.perf_counter() - t2}
+                    bucket = r.rows
                 else:
                     r.pad_map.clear()
                     stacked, bucket, real, padded = self._assemble(
                         [r], r.key)
+                    t1 = time.perf_counter()
                     outs = pred.run_batch(stacked)
+                    t2 = time.perf_counter()
                     if not self._slice_back(outs, [r], bucket):
                         outs = pred.run_batch(r.arrays)
+                        t2 = time.perf_counter()
                         self._set(r.future, [np.asarray(o) for o in outs])
                     profiler.record_serve_batch(r.rows, bucket, real,
                                                 padded, qdepth)
+                    spans = {"queue_wait": t0 - r.t_enq, "pad": t1 - t0,
+                             "execute": t2 - t1,
+                             "unpad": time.perf_counter() - t2}
                 profiler.record_serve_request(
                     time.perf_counter() - r.t_enq)
+                self._spans.record(r.req_id, spans,
+                                   extra={"rows": r.rows, "bucket": bucket})
             except Exception as e:
                 profiler.record_serve_error()
-                self._set(r.future, exc=e)
+                self._set(r.future, exc=self._tag(e, r.req_id))
 
     # -- warmup ----------------------------------------------------------
 
@@ -608,6 +672,48 @@ class DynamicBatcher:
     def queue_depth(self) -> int:
         return len(self._q)
 
+    @property
+    def oldest_wait_s(self) -> float:
+        """Seconds the oldest queued request has been waiting — 0.0 on
+        an empty queue. The /healthz wedge check compares this against
+        the request deadline."""
+        try:
+            head = self._q[0]
+        except IndexError:
+            return 0.0
+        return max(0.0, time.perf_counter() - head.t_enq)
+
+    @property
+    def dispatcher_alive(self) -> bool:
+        return self._dispatcher.is_alive()
+
+    @property
+    def workers_alive(self) -> bool:
+        """True while every pooled-predictor worker thread is alive
+        (vacuously true in single-predictor inline mode)."""
+        return all(t.is_alive() for t in self._workers)
+
+    def _stall_context(self):
+        """Flight-recorder context: what the queue looked like when the
+        watchdog fired (bounded to the 32 oldest queued requests)."""
+        got = self._cond.acquire(timeout=1.0)
+        try:
+            queued = [{"request_id": r.req_id, "rows": r.rows,
+                       "age_s": round(time.perf_counter() - r.t_enq, 3),
+                       "solo": r.solo}
+                      for r in list(self._q)[:32]]
+            depth = len(self._q)
+        finally:
+            if got:
+                self._cond.release()
+        return {"queue_depth": depth,
+                "busy_batches": self._busy_batches,
+                "oldest_wait_s": round(self.oldest_wait_s, 3),
+                "dispatcher_alive": self.dispatcher_alive,
+                "workers_alive": self.workers_alive,
+                "cond_lock_acquired": got,
+                "queued": queued}
+
     def stop(self):
         """Stop accepting work, drain the queue into errors, and join the
         dispatcher + workers."""
@@ -617,13 +723,15 @@ class DynamicBatcher:
             self._q.clear()
             self._cond.notify_all()
         for r in pending:
-            self._set(r.future,
-                      exc=RuntimeError("DynamicBatcher stopped"))
+            self._set(r.future, exc=self._tag(
+                RuntimeError("DynamicBatcher stopped"), r.req_id))
         self._dispatcher.join(timeout=5)
         for wq in self._wqueues:
             wq.put(None)
         for t in self._workers:
             t.join(timeout=5)
+        self._recorder.stop()
+        self._spans.close()
 
     def __enter__(self):
         return self

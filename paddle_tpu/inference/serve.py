@@ -39,6 +39,7 @@ import os
 import socket
 import struct
 import threading
+import time
 from concurrent.futures import TimeoutError as FuturesTimeout
 
 import numpy as np
@@ -170,7 +171,16 @@ class InferenceServer:
 
     ``stats_interval > 0`` prints a periodic ``SERVE_STATS {json}`` line
     (queue depth, occupancy, padding waste, compile count, latency
-    percentiles, reqs/s) from ``profiler.serve_stats()``.
+    percentiles, reqs/s) from the metrics registry via
+    ``profiler.serve_stats()``.
+
+    ``metrics_port`` (or ``PADDLE_TPU_METRICS_PORT``) mounts the admin
+    HTTP endpoint — ``/metrics`` (Prometheus exposition), ``/healthz``
+    (503 once the dispatcher dies or the queue wedges past the request
+    deadline) and ``/statusz`` (one JSON snapshot: serve stats, bucket
+    ladder, warmup/compile state, per-device HBM, uptime, effective
+    config). Off by default; ``0`` picks a free port
+    (``srv.metrics_port``). See docs/observability.md.
     """
 
     def __init__(self, model_prefix: str, port: int = 0,
@@ -178,7 +188,7 @@ class InferenceServer:
                  batch_timeout_ms: float = 2.0, pool_size: int = 1,
                  warmup: bool = False, idle_timeout: float = None,
                  stats_interval: float = 0.0, request_timeout: float = None,
-                 trailing: str = None):
+                 trailing: str = None, metrics_port: int = None):
         # loopback by default: the daemon is unauthenticated — exposing a
         # model to the network segment must be an explicit --host choice
         from . import Config, PredictorPool, create_predictor
@@ -213,6 +223,7 @@ class InferenceServer:
         self._srv.bind((host, port))
         self._srv.listen(64)
         self.port = self._srv.getsockname()[1]
+        self._t0 = time.monotonic()
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._accept_loop,
                                         daemon=True)
@@ -222,10 +233,91 @@ class InferenceServer:
                 target=self._stats_loop, args=(float(stats_interval),),
                 daemon=True)
             self._stats_thread.start()
+        # admin endpoint: off unless a port is given (env or argument);
+        # 0 = ephemeral. Loopback only, like the data-plane default.
+        self._admin = None
+        self.metrics_port = None
+        if metrics_port is None:
+            mp = os.environ.get("PADDLE_TPU_METRICS_PORT", "").strip()
+            metrics_port = int(mp) if mp else None
+        if metrics_port is not None and int(metrics_port) >= 0:
+            from ..observability import (AdminServer,
+                                         install_default_collectors)
+            install_default_collectors()
+            self._admin = AdminServer(port=int(metrics_port), host=host,
+                                      health_fn=self._health,
+                                      status_fn=self._status)
+            self.metrics_port = self._admin.port
 
     @property
     def batched(self) -> bool:
         return bool(self._batched)
+
+    # -- admin surface ---------------------------------------------------
+
+    def _health(self):
+        """(healthy, reasons) for /healthz: the accept loop and (in
+        batched mode) the dispatcher + workers must be alive, and the
+        queue must not be wedged past the request deadline."""
+        reasons = []
+        if self._stop.is_set():
+            reasons.append("server stopped")
+        elif not self._thread.is_alive():
+            reasons.append("accept thread dead")
+        if self._batcher is not None:
+            if not self._batcher.dispatcher_alive:
+                reasons.append("dispatcher thread dead")
+            if not self._batcher.workers_alive:
+                reasons.append("predictor worker thread dead")
+            wedge_after = self._request_timeout \
+                if self._request_timeout and self._request_timeout > 0 \
+                else 300.0
+            oldest = self._batcher.oldest_wait_s
+            if oldest > wedge_after:
+                reasons.append(
+                    f"queue wedged: oldest request waiting "
+                    f"{oldest:.1f}s (> {wedge_after:g}s)")
+        return not reasons, reasons
+
+    def _status(self) -> dict:
+        from .. import profiler
+        from ..core import monitor
+
+        st = {
+            "engine": "batched" if self._batched else "serialized",
+            "port": self.port,
+            "metrics_port": self.metrics_port,
+            "uptime_s": round(time.monotonic() - self._t0, 3),
+            "config": {
+                "idle_timeout_s": self._idle_timeout,
+                "request_timeout_s": self._request_timeout,
+                "max_request_bytes": max_request_bytes(),
+            },
+            "warmup_compiles": self.warmup_compiles,
+            "compiles": len(profiler.compile_events()),
+            "serve": profiler.serve_stats(),
+            "device_memory": monitor.all_device_memory_stats(),
+        }
+        if self._batcher is not None:
+            st["batcher"] = {
+                "ladder": self._batcher.ladder,
+                "trailing_bucketing": self._batcher.trailing_bucketing,
+                "queue_depth": self._batcher.queue_depth,
+                "oldest_wait_s": round(self._batcher.oldest_wait_s, 3),
+                "dispatcher_alive": self._batcher.dispatcher_alive,
+            }
+        return st
+
+    def stats_line(self) -> str:
+        """One ``SERVE_STATS {json}`` line from the registry snapshot;
+        ``ts_monotonic`` makes consecutive lines orderable and
+        rate-computable without wall-clock trust."""
+        from .. import profiler
+        stats = profiler.serve_stats()
+        stats["ts_monotonic"] = round(time.monotonic(), 3)
+        if self._batcher is not None:
+            stats["queue_depth"] = self._batcher.queue_depth
+        return "SERVE_STATS " + json.dumps(stats)
 
     def _accept_loop(self):
         while not self._stop.is_set():
@@ -249,10 +341,12 @@ class InferenceServer:
                 # thread forever; the future stays abandoned (the
                 # batcher delivers into it defensively) and the client
                 # gets an error frame instead of silence
-                raise RuntimeError(
+                err = RuntimeError(
                     f"request deadline exceeded "
                     f"({deadline:g}s in queue+execute; "
-                    f"PADDLE_TPU_SERVE_REQUEST_TIMEOUT)") from None
+                    f"PADDLE_TPU_SERVE_REQUEST_TIMEOUT)")
+                err.request_id = getattr(fut, "request_id", None)
+                raise err from None
         with self._lock:
             return self._predictor.run(inputs)
 
@@ -284,20 +378,23 @@ class InferenceServer:
                 except (ConnectionError, TimeoutError):
                     return
                 except Exception as e:   # model-side error -> client
-                    write_error(conn, f"{type(e).__name__}: {e}")
+                    msg = f"{type(e).__name__}: {e}"
+                    rid = getattr(e, "request_id", None)
+                    if rid:
+                        # the id a sampled span trace / stall dump carries
+                        msg += f" [request_id={rid}]"
+                    write_error(conn, msg)
         finally:
             conn.close()
 
     def _stats_loop(self, interval: float):
-        from .. import profiler
         while not self._stop.wait(interval):
-            stats = profiler.serve_stats()
-            if self._batcher is not None:
-                stats["queue_depth"] = self._batcher.queue_depth
-            print("SERVE_STATS " + json.dumps(stats), flush=True)
+            print(self.stats_line(), flush=True)
 
     def stop(self):
         self._stop.set()
+        if self._admin is not None:
+            self._admin.stop()
         if self._batcher is not None:
             self._batcher.stop()
         try:
@@ -352,6 +449,10 @@ def main(argv=None):
                          "PADDLE_TPU_SERVE_IDLE_TIMEOUT or 600; 0 = off)")
     ap.add_argument("--stats-interval", type=float, default=10.0,
                     help="seconds between SERVE_STATS lines (0 = off)")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="mount /metrics + /healthz + /statusz on this "
+                         "port (0 = ephemeral; default off, or "
+                         "PADDLE_TPU_METRICS_PORT)")
     args = ap.parse_args(argv)
     # honor JAX_PLATFORMS for the daemon: a TPU PJRT plugin outranks the
     # env var during backend registration, so an explicit config update is
@@ -367,9 +468,12 @@ def main(argv=None):
                           idle_timeout=args.idle_timeout,
                           stats_interval=args.stats_interval,
                           request_timeout=args.request_timeout,
-                          trailing=args.trailing)
+                          trailing=args.trailing,
+                          metrics_port=args.metrics_port)
     if args.warmup:
         print(f"WARMUP compiles={srv.warmup_compiles}", flush=True)
+    if srv.metrics_port is not None:
+        print(f"METRICS {srv.metrics_port}", flush=True)
     print(f"SERVING {srv.port}", flush=True)
     try:
         threading.Event().wait()

@@ -141,12 +141,32 @@ class AsyncStepPipeline:
         self.host_blocked_s = 0.0
         self.steps_in_flight = 0      # max concurrently in flight
         self.steps_submitted = 0
+        # stall flight recorder (PADDLE_TPU_STALL_DUMP): dumps thread
+        # stacks + the in-flight window when steps stop retiring — a
+        # device hang shows up here as "busy, no heartbeat"
+        from ..observability import FlightRecorder
+        self._recorder = FlightRecorder(
+            f"async_steps_{label}",
+            busy_fn=lambda: bool(self._inflight),
+            context_fn=self._stall_context)
+
+    def _stall_context(self):
+        now = time.perf_counter()
+        return {
+            "label": self.label,
+            "window": self.max_in_flight,
+            "steps_submitted": self.steps_submitted,
+            "in_flight": [{"step_index": t.step_index,
+                           "age_s": round(now - t.submit_t, 3)}
+                          for t in list(self._inflight)],
+        }
 
     def submit(self, value: Any, step_index: int,
                collate_s: float = 0.0, dispatch_s: float = 0.0) -> StepTicket:
         t = StepTicket(step_index, value, collate_s, dispatch_s)
         self._inflight.append(t)
         self.steps_submitted += 1
+        self._recorder.beat()
         while len(self._inflight) > self.max_in_flight:
             self._retire(self._inflight[0])
         # high-water mark AFTER backpressure: what was actually left in
@@ -159,6 +179,11 @@ class AsyncStepPipeline:
         while self._inflight:
             self._retire(self._inflight[0])
 
+    def close(self) -> None:
+        """Stop the stall watchdog (idempotent; drain() first if the
+        window may still hold tickets)."""
+        self._recorder.stop()
+
     def _retire(self, t: StepTicket) -> None:
         try:
             blocked = t.block()
@@ -167,6 +192,7 @@ class AsyncStepPipeline:
                 self._inflight.remove(t)
             except ValueError:
                 pass
+            self._recorder.beat()
         self.host_blocked_s += blocked
         if self.record:
             from .. import profiler

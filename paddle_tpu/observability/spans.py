@@ -1,0 +1,121 @@
+"""Request-scoped spans: per-stage histograms + sampled JSONL traces.
+
+Every request through the serving engine gets a monotonically increasing
+id and a span breakdown — ``queue_wait_s`` (enqueue to batch formation),
+``pad_s`` (bucket assembly), ``execute_s`` (predictor run) and
+``unpad_s`` (slice-back) — recorded into the stage-labeled
+``paddle_tpu_serve_span_seconds`` histogram. A sampled fraction of
+requests (``PADDLE_TPU_TRACE_SAMPLE``, 0..1, default 0) is additionally
+emitted as one JSONL line per request to ``PADDLE_TPU_TRACE_FILE``
+(default stderr), so a production incident can be traced without a
+profiler attach. Sampling is deterministic in the request id (a hashed
+rate gate), which keeps traces reproducible under replay.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import sys
+import threading
+import time
+from typing import Dict, Optional
+
+from . import metrics as _metrics
+
+__all__ = ["SpanRecorder", "next_request_id", "trace_sample_rate"]
+
+SPAN_STAGES = ("queue_wait", "pad", "execute", "unpad")
+
+# process-wide request id stream: ids stay unique across batcher
+# restarts so a JSONL trace never aliases two requests
+_req_ids = itertools.count(1)
+
+
+def next_request_id() -> int:
+    return next(_req_ids)
+
+
+def trace_sample_rate(env: Optional[str] = None) -> float:
+    """``PADDLE_TPU_TRACE_SAMPLE`` clamped to [0, 1]; 0 disables."""
+    raw = os.environ.get("PADDLE_TPU_TRACE_SAMPLE", "") \
+        if env is None else env
+    try:
+        rate = float(raw) if str(raw).strip() else 0.0
+    except ValueError:
+        return 0.0
+    return min(max(rate, 0.0), 1.0)
+
+
+class SpanRecorder:
+    """Feeds span breakdowns into the registry and (sampled) a JSONL sink.
+
+    One instance per batcher; instrument registration is idempotent, so
+    multiple recorders share the same histogram family."""
+
+    def __init__(self, component: str = "serve",
+                 registry: Optional[_metrics.MetricsRegistry] = None,
+                 sample: Optional[float] = None,
+                 path: Optional[str] = None):
+        reg = registry or _metrics.REGISTRY
+        self.component = component
+        self._hist = reg.histogram(
+            "paddle_tpu_serve_span_seconds",
+            "Per-request span breakdown by stage (queue_wait, pad, "
+            "execute, unpad), seconds.",
+            labelnames=("stage",))
+        self.sample = trace_sample_rate() if sample is None \
+            else min(max(float(sample), 0.0), 1.0)
+        self.path = os.environ.get("PADDLE_TPU_TRACE_FILE", "") \
+            if path is None else path
+        self._lock = threading.Lock()
+        self._file = None
+
+    def sampled(self, req_id: int) -> bool:
+        if self.sample <= 0.0:
+            return False
+        if self.sample >= 1.0:
+            return True
+        # Knuth multiplicative hash of the id -> uniform [0, 1) gate;
+        # deterministic per id, no RNG state
+        h = (int(req_id) * 2654435761) & 0xFFFFFFFF
+        return (h / 2 ** 32) < self.sample
+
+    def record(self, req_id: int, spans: Dict[str, float],
+               extra: Optional[dict] = None):
+        """Record one request's breakdown; ``spans`` maps stage name
+        (without the ``_s`` suffix) to seconds."""
+        for stage, dur in spans.items():
+            self._hist.labels(stage=stage).observe(max(float(dur), 0.0))
+        if not self.sampled(req_id):
+            return
+        line = {"ts": round(time.time(), 6),
+                "component": self.component,
+                "request_id": int(req_id)}
+        line.update({f"{k}_s": round(float(v), 6)
+                     for k, v in spans.items()})
+        line["total_s"] = round(sum(float(v) for v in spans.values()), 6)
+        if extra:
+            line.update(extra)
+        self._emit(json.dumps(line))
+
+    def _emit(self, text: str):
+        with self._lock:
+            try:
+                if self.path:
+                    if self._file is None:
+                        self._file = open(self.path, "a")
+                    self._file.write(text + "\n")
+                    self._file.flush()
+                else:
+                    sys.stderr.write("SPAN " + text + "\n")
+            except OSError:
+                pass            # tracing must never fail a request
+
+    def close(self):
+        with self._lock:
+            if self._file is not None:
+                try:
+                    self._file.close()
+                finally:
+                    self._file = None

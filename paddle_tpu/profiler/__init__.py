@@ -18,12 +18,13 @@ dispatcher when the profiler is on.
 from __future__ import annotations
 
 import contextlib
-import math
 import threading
 import time
 from typing import Dict, List, Optional
 
 import jax
+
+from ..observability import metrics as _metrics
 
 __all__ = ["RecordEvent", "start_profiler", "stop_profiler", "profiler",
            "start_trace", "stop_trace", "is_profiling", "summary",
@@ -41,9 +42,64 @@ _steps: List[dict] = []        # per-step timeline segments
 _STEP_CAP = 100_000            # bound memory on very long runs
 _enabled = False
 
+# ---------------------------------------------------------------------------
+# registry-backed aggregates: the observability registry is the single
+# store for every scalar counter below (docs/observability.md catalog);
+# this module keeps only the list-shaped views (event table, compile
+# labels, step timeline) plus the reqs/s timestamp window.
+# ---------------------------------------------------------------------------
+_SRV_REQS = _metrics.counter(
+    "paddle_tpu_serve_requests_total",
+    "Requests answered successfully by the serving engine.")
+_SRV_ERRS = _metrics.counter(
+    "paddle_tpu_serve_errors_total",
+    "Requests that resolved with an error.")
+_SRV_BATCHES = _metrics.counter(
+    "paddle_tpu_serve_batches_total",
+    "Batches dispatched by the DynamicBatcher.")
+_SRV_ROWS = _metrics.counter(
+    "paddle_tpu_serve_batch_rows_total",
+    "Real request rows packed into dispatched batches.")
+_SRV_CAP = _metrics.counter(
+    "paddle_tpu_serve_batch_capacity_rows_total",
+    "Bucket-capacity rows dispatched (rows/capacity = occupancy).")
+_SRV_REAL = _metrics.counter(
+    "paddle_tpu_serve_real_elements_total",
+    "Tensor elements dispatched before shape-bucket padding.")
+_SRV_PADDED = _metrics.counter(
+    "paddle_tpu_serve_padded_elements_total",
+    "Tensor elements dispatched after shape-bucket padding "
+    "(1 - real/padded = padding waste).")
+_SRV_QDEPTH = _metrics.gauge(
+    "paddle_tpu_serve_queue_depth",
+    "Request queue depth observed at the most recent dispatch.")
+_SRV_QMAX = _metrics.gauge(
+    "paddle_tpu_serve_queue_depth_max",
+    "Deepest the request queue has been since the last stats reset.")
+_SRV_LAT = _metrics.histogram(
+    "paddle_tpu_serve_request_latency_seconds",
+    "Enqueue-to-result wall clock per successfully answered request.",
+    sample_cap=100_000)        # reservoir: exact p50/p95/p99 below
+_COMPILE_N = _metrics.counter(
+    "paddle_tpu_compile_total",
+    "Explicit XLA compiles recorded via profiler.record_compile.")
+_COMPILE_S = _metrics.counter(
+    "paddle_tpu_compile_seconds_total",
+    "Seconds spent in explicit XLA compiles.")
+_STEP_N = _metrics.counter(
+    "paddle_tpu_train_steps_total",
+    "Train steps retired through the async step pipeline.")
+_STEP_BLOCKED_S = _metrics.counter(
+    "paddle_tpu_train_host_blocked_seconds_total",
+    "Host wall clock blocked waiting on device step results.")
+_STEP_INFLIGHT = _metrics.gauge(
+    "paddle_tpu_train_steps_in_flight",
+    "Dispatched-but-unfetched steps at the last retirement.")
+
 
 def is_profiling() -> bool:
-    return _enabled
+    with _lock:
+        return _enabled
 
 
 class RecordEvent:
@@ -68,8 +124,12 @@ class RecordEvent:
     def __exit__(self, *exc):
         dur = time.perf_counter() - self._t0
         self._ann.__exit__(*exc)
-        if _enabled:
-            with _lock:
+        # read _enabled INSIDE the lock: stop_profiler() flips it under
+        # the same lock, so an exit racing a disable either lands in the
+        # table or cleanly doesn't — never appends to a list summary()
+        # is snapshotting
+        with _lock:
+            if _enabled:
                 _events.append((self.name, self._t0, dur,
                                 threading.get_ident()))
         return False
@@ -83,7 +143,9 @@ class RecordEvent:
 
 def _op_hook(op_name):
     """Eager-dispatcher hook: annotate each op while profiling."""
-    return RecordEvent(f"op::{op_name}") if _enabled else None
+    with _lock:
+        enabled = _enabled
+    return RecordEvent(f"op::{op_name}") if enabled else None
 
 
 from ..core import tensor as _tensor_mod
@@ -104,6 +166,8 @@ def record_compile(label: str, seconds: float, cache: str = "off"):
             _events.append((f"compile::{label}",
                             time.perf_counter() - seconds, seconds,
                             threading.get_ident()))
+    _COMPILE_N.inc()
+    _COMPILE_S.inc(max(float(seconds), 0.0))
 
 
 def compile_events() -> List[dict]:
@@ -140,6 +204,11 @@ def record_step(step: int, **segments):
                     _events.append((f"step::{seg[:-2]}", now,
                                     float(segments[seg]),
                                     threading.get_ident()))
+    _STEP_N.inc()
+    _STEP_BLOCKED_S.inc(max(float(segments.get("fetch_s", 0.0) or 0.0),
+                            0.0))
+    if segments.get("in_flight") is not None:
+        _STEP_INFLIGHT.set(int(segments["in_flight"]))
 
 
 def step_timeline() -> List[dict]:
@@ -180,16 +249,8 @@ def step_timeline_summary() -> dict:
 # serving counters (inference.batching.DynamicBatcher feeds these)
 # ---------------------------------------------------------------------------
 
-_LAT_CAP = 100_000             # bound latency-sample memory on long runs
-
-
-def _serve_zero() -> dict:
-    return {"requests": 0, "errors": 0, "batches": 0,
-            "rows": 0, "capacity": 0, "real_elems": 0, "padded_elems": 0,
-            "queue_depth_max": 0, "lat": [], "t0": None, "t1": None}
-
-
-_serve = _serve_zero()
+# first/last resolution timestamps bounding the reqs/s window
+_serve_t = {"t0": None, "t1": None}
 
 
 def record_serve_batch(rows: int, capacity: int, real_elems: int,
@@ -200,14 +261,13 @@ def record_serve_batch(rows: int, capacity: int, real_elems: int,
     queue depth observed at dispatch. Always collected (like compiles):
     the serve stats line and benchmarks/serve_bench.py read these with
     the host profiler off."""
-    with _lock:
-        _serve["batches"] += 1
-        _serve["rows"] += int(rows)
-        _serve["capacity"] += int(capacity)
-        _serve["real_elems"] += int(real_elems)
-        _serve["padded_elems"] += int(padded_elems)
-        _serve["queue_depth_max"] = max(_serve["queue_depth_max"],
-                                        int(queue_depth))
+    _SRV_BATCHES.inc()
+    _SRV_ROWS.inc(int(rows))
+    _SRV_CAP.inc(int(capacity))
+    _SRV_REAL.inc(int(real_elems))
+    _SRV_PADDED.inc(int(padded_elems))
+    _SRV_QDEPTH.set(int(queue_depth))
+    _SRV_QMAX.set_max(int(queue_depth))
 
 
 def record_serve_request(latency_s: float):
@@ -218,81 +278,89 @@ def record_serve_request(latency_s: float):
 
 
 def record_serve_requests(latencies_s):
-    """Batch form of :func:`record_serve_request` — one lock acquisition
-    for a whole dispatched batch's resolutions."""
+    """Batch form of :func:`record_serve_request` — one dispatched
+    batch's resolutions in one call."""
+    latencies_s = list(latencies_s)
     now = time.perf_counter()
+    _SRV_REQS.inc(len(latencies_s))
+    for v in latencies_s:
+        _SRV_LAT.observe(float(v))
     with _lock:
-        _serve["requests"] += len(latencies_s)
-        _serve["lat"].extend(float(v) for v in latencies_s)
-        if len(_serve["lat"]) > _LAT_CAP:
-            del _serve["lat"][: len(_serve["lat"]) - _LAT_CAP]
-        if _serve["t0"] is None:
-            _serve["t0"] = now
-        _serve["t1"] = now
+        if _serve_t["t0"] is None:
+            _serve_t["t0"] = now
+        _serve_t["t1"] = now
 
 
 def record_serve_error():
     """Record one request that resolved with an error (its latency is not
     mixed into the percentiles)."""
-    with _lock:
-        _serve["errors"] += 1
-
-
-def _pctile(sorted_vals: List[float], q: float) -> float:
-    if not sorted_vals:
-        return 0.0
-    k = max(0, min(len(sorted_vals) - 1,
-                   int(math.ceil(q * len(sorted_vals))) - 1))
-    return sorted_vals[k]
+    _SRV_ERRS.inc()
 
 
 def serve_stats() -> dict:
-    """Aggregate serving counters: request/batch totals, reqs_per_s,
+    """Aggregate serving counters (read from the observability registry,
+    the single backing store): request/batch totals, reqs_per_s,
     batch_occupancy (real rows / padded bucket rows), padding_waste
     (fraction of dispatched elements that were padding), queue_depth_max,
     compile_count (all compiles recorded via record_compile) and
     p50/p95/p99 request latency in ms."""
     with _lock:
-        s = {k: v for k, v in _serve.items() if k != "lat"}
-        lat = sorted(_serve["lat"])
         n_compiles = len(_compiles)
-    dur = (s["t1"] - s["t0"]) if s["t0"] is not None else 0.0
+        t0, t1 = _serve_t["t0"], _serve_t["t1"]
+    requests = int(_SRV_REQS.get())
+    rows, cap = _SRV_ROWS.get(), _SRV_CAP.get()
+    real, padded = _SRV_REAL.get(), _SRV_PADDED.get()
+    # reqs/s window: first-to-last resolution; a single resolution (or
+    # one batch) collapses the window to zero, so fall back to
+    # time-since-first-resolution — and report null (never a misleading
+    # 0.0) if even that is degenerate
+    rate = 0.0 if requests == 0 else None
+    if t0 is not None and requests:
+        dur = t1 - t0
+        if dur <= 0:
+            dur = time.perf_counter() - t0
+        if dur > 0:
+            rate = round(requests / dur, 2)
     return {
-        "requests": s["requests"],
-        "errors": s["errors"],
-        "batches": s["batches"],
-        "reqs_per_s": round(s["requests"] / dur, 2) if dur > 0 else 0.0,
-        "batch_occupancy": round(s["rows"] / s["capacity"], 4)
-        if s["capacity"] else 0.0,
-        "padding_waste": round(1.0 - s["real_elems"] / s["padded_elems"], 4)
-        if s["padded_elems"] else 0.0,
-        "queue_depth_max": s["queue_depth_max"],
+        "requests": requests,
+        "errors": int(_SRV_ERRS.get()),
+        "batches": int(_SRV_BATCHES.get()),
+        "reqs_per_s": rate,
+        "batch_occupancy": round(rows / cap, 4) if cap else 0.0,
+        "padding_waste": round(1.0 - real / padded, 4) if padded else 0.0,
+        "queue_depth_max": int(_SRV_QMAX.get()),
         "compile_count": n_compiles,
-        "p50_latency_ms": round(_pctile(lat, 0.50) * 1e3, 3),
-        "p95_latency_ms": round(_pctile(lat, 0.95) * 1e3, 3),
-        "p99_latency_ms": round(_pctile(lat, 0.99) * 1e3, 3),
+        "p50_latency_ms": round(_SRV_LAT.percentile(0.50) * 1e3, 3),
+        "p95_latency_ms": round(_SRV_LAT.percentile(0.95) * 1e3, 3),
+        "p99_latency_ms": round(_SRV_LAT.percentile(0.99) * 1e3, 3),
     }
 
 
 def reset_serve_stats():
-    global _serve
+    for inst in (_SRV_REQS, _SRV_ERRS, _SRV_BATCHES, _SRV_ROWS, _SRV_CAP,
+                 _SRV_REAL, _SRV_PADDED, _SRV_QDEPTH, _SRV_QMAX,
+                 _SRV_LAT):
+        inst.reset()
     with _lock:
-        _serve = _serve_zero()
+        _serve_t["t0"] = _serve_t["t1"] = None
 
 
 def start_profiler(state: str = "All", tracer_option: str = "Default"):
     """fluid/profiler.py surface; `state`/`tracer_option` kept for parity
-    (host events always; device events come from start_trace/XPlane)."""
+    (host events always; device events come from start_trace/XPlane).
+    The enable flip happens under the event-table lock so recorders
+    racing the transition see a consistent (flag, table) pair."""
     global _enabled
     with _lock:
         _events.clear()
-    _enabled = True
+        _enabled = True
 
 
 def stop_profiler(sorted_key: str = "total", profile_path: Optional[str] = None,
                   print_table: bool = True):
     global _enabled
-    _enabled = False
+    with _lock:
+        _enabled = False
     table = summary(sorted_key)
     if profile_path:
         with open(profile_path, "w") as f:
