@@ -162,16 +162,162 @@ def run_bench(args):
     }
 
 
+def run_router_bench(args):
+    """Fleet mode: N in-process backends behind the ServeRouter, driven
+    over the wire by concurrent clients. With ``--kill-one`` a backend
+    is stopped abruptly mid-run — the contract under test is ZERO lost
+    requests (every client gets a tensor reply for every request) with
+    the failover cost reported from the router's own histograms."""
+    import socket
+    import threading
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.inference.router import Backend, ServeRouter
+    from paddle_tpu.inference.serve import (InferenceServer, read_reply,
+                                            write_tensors)
+    from paddle_tpu.observability import REGISTRY
+    from paddle_tpu.static import InputSpec
+
+    class MLP(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(64, 256)
+            self.fc2 = nn.Linear(256, 64)
+
+        def forward(self, x):
+            import paddle_tpu.nn.functional as F
+            return self.fc2(F.relu(self.fc1(x)))
+
+    paddle.seed(0)
+    prefix = os.path.join(tempfile.mkdtemp(prefix="serve_bench_"), "mlp")
+    paddle.jit.save(MLP(), prefix,
+                    input_spec=[InputSpec([None, 64], "float32")])
+
+    srvs = [InferenceServer(prefix, port=0, max_batch_size=args.max_batch,
+                            batch_timeout_ms=args.batch_timeout_ms,
+                            metrics_port=0)
+            for _ in range(args.router)]
+    router = ServeRouter(
+        [Backend("127.0.0.1", s.port, s.metrics_port) for s in srvs],
+        port=0, poll_interval=0.1)
+
+    rng = np.random.default_rng(args.seed)
+    row_mix = (1, 2, 1, 4)
+    n_clients = max(args.clients, 1)
+    per_client = max(args.requests // n_clients, 1)
+    total = per_client * n_clients
+
+    done_lock = threading.Lock()
+    completed = [0]
+    latencies = []
+    lost = []                  # (client, error-or-exception)
+    kill_at = total // 3 if args.kill_one and args.router > 1 else None
+    killed = {"key": None, "t": None}
+
+    def maybe_kill():
+        with done_lock:
+            fire = (kill_at is not None and killed["key"] is None
+                    and completed[0] >= kill_at)
+            if fire:
+                killed["key"] = f"127.0.0.1:{srvs[1].port}"
+        if fire:
+            killed["t"] = time.perf_counter()
+            srvs[1].stop()     # abrupt: mid-batch, no drain
+
+    def client(i):
+        x = rng.normal(size=(row_mix[i % len(row_mix)], 64)) \
+            .astype(np.float32)
+        try:
+            with socket.create_connection(
+                    ("127.0.0.1", router.port)) as s:
+                s.settimeout(120)
+                for _ in range(per_client):
+                    t0 = time.perf_counter()
+                    write_tensors(s, [x])
+                    out, err = read_reply(s)
+                    dt = time.perf_counter() - t0
+                    if err is not None:
+                        lost.append((i, err))
+                        return
+                    with done_lock:
+                        completed[0] += 1
+                        latencies.append(dt)
+                    maybe_kill()
+        except Exception as e:
+            lost.append((i, repr(e)))
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600)
+    wall_s = time.perf_counter() - t0
+
+    flat = REGISTRY.flat()
+    fo_hist = REGISTRY.get("paddle_tpu_router_failover_latency_seconds")
+    lat_sorted = sorted(latencies)
+
+    def pct(q):
+        if not lat_sorted:
+            return 0.0
+        k = min(len(lat_sorted) - 1, int(q * len(lat_sorted)))
+        return round(lat_sorted[k] * 1e3, 3)
+
+    router.stop()
+    for s in srvs:
+        s.stop()
+    rps = completed[0] / wall_s if wall_s > 0 else 0.0
+    return {
+        "metric": "serve_router_fleet",
+        "value": round(rps, 2),
+        "unit": "reqs/s",
+        # the contract IS the baseline: 1.0 = zero lost requests
+        "vs_baseline": 1.0 if not lost and completed[0] == total else 0.0,
+        "fleet": args.router,
+        "clients": n_clients,
+        "requests": total,
+        "completed": completed[0],
+        "lost_requests": len(lost),
+        "lost_detail": [f"client {i}: {e}" for i, e in lost[:5]],
+        "killed_backend": killed["key"],
+        "failovers": int(flat.get(
+            "paddle_tpu_router_failovers_total", 0)),
+        "failover_p95_ms": round(
+            fo_hist.percentile(0.95) * 1e3, 3) if fo_hist else 0.0,
+        "failover_max_ms": round(
+            fo_hist.percentile(1.0) * 1e3, 3) if fo_hist else 0.0,
+        "p50_latency_ms": pct(0.50),
+        "p95_latency_ms": pct(0.95),
+        "p99_latency_ms": pct(0.99),
+        "reqs_per_s": round(rps, 2),
+        "router_metrics": {k: v for k, v in flat.items()
+                           if k.startswith("paddle_tpu_router_")},
+    }
+
+
 def main():
     ap = argparse.ArgumentParser(description="serving engine benchmark")
     ap.add_argument("--requests", type=int, default=400)
     ap.add_argument("--max-batch", type=int, default=16)
     ap.add_argument("--batch-timeout-ms", type=float, default=2.0)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--router", type=int, default=0, metavar="N",
+                    help="fleet mode: N backends behind the front "
+                         "router, driven over the wire (0 = classic "
+                         "batched-vs-serial bench)")
+    ap.add_argument("--clients", type=int, default=8,
+                    help="(fleet mode) concurrent wire clients")
+    ap.add_argument("--kill-one", action="store_true",
+                    help="(fleet mode) stop one backend abruptly a "
+                         "third of the way through; lost_requests must "
+                         "stay 0")
     args = ap.parse_args()
     _devices_or_cpu_fallback()
     try:
-        out = run_bench(args)
+        out = run_router_bench(args) if args.router else run_bench(args)
     except Exception as e:                       # rc-0 JSON contract
         _error_json(f"{type(e).__name__}: {str(e).splitlines()[0]}")
         return

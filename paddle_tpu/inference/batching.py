@@ -45,6 +45,21 @@ Correctness contract
 Error isolation
     A failed batch is re-executed per request, so a poison request (bad
     static dim, NaN-triggering payload, ...) fails only its own future.
+
+Robustness (docs/fault_tolerance.md "serving fleet")
+    A dead dispatcher immediately fails every queued AND future request
+    with a typed ``UNAVAILABLE`` frame (clients + the front router see
+    the death now, not after the request deadline). A crashed pool
+    worker fails its in-flight batch the same way, then respawns in
+    place with bounded backoff (``paddle_tpu_serve_worker_restarts``
+    counts respawns; an exhausted budget leaves the slot dead and
+    /healthz red). ``max_queue`` (``PADDLE_TPU_SERVE_MAX_QUEUE``) is the
+    admission watermark: past it, ``submit`` sheds instantly with
+    ``RESOURCE_EXHAUSTED`` instead of queueing unboundedly. ``quiesce``
+    blocks until all accepted work has been answered — the drain step of
+    a SIGTERM'd daemon. Chaos sites ``batcher.dispatch`` /
+    ``batcher.worker`` let tests kill or wedge either thread
+    deterministically.
 """
 from __future__ import annotations
 
@@ -59,12 +74,28 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from ..testing import chaos
+from .errors import (ERR_RESOURCE_EXHAUSTED, ERR_UNAVAILABLE,
+                     TypedServeError)
+
 __all__ = ["DynamicBatcher", "bucket_ladder", "next_bucket",
-           "DEFAULT_MAX_BATCH", "DEFAULT_TIMEOUT_MS"]
+           "DEFAULT_MAX_BATCH", "DEFAULT_TIMEOUT_MS",
+           "max_queue_default"]
 
 DEFAULT_MAX_BATCH = 8
 DEFAULT_TIMEOUT_MS = 2.0
 _WARMUP_SIG_CAP = 64          # cross-product guard for many dynamic dims
+
+
+def max_queue_default() -> int:
+    """Admission-control watermark (``PADDLE_TPU_SERVE_MAX_QUEUE``):
+    queued requests past this are shed with ``RESOURCE_EXHAUSTED``
+    instead of waiting out (and then blowing) the request deadline.
+    0 disables shedding."""
+    try:
+        return int(os.environ.get("PADDLE_TPU_SERVE_MAX_QUEUE", "0") or 0)
+    except ValueError:
+        return 0
 
 
 def bucket_ladder(max_batch: int, env: Optional[str] = None) -> List[int]:
@@ -129,7 +160,9 @@ class DynamicBatcher:
     def __init__(self, predictors, max_batch_size: int = DEFAULT_MAX_BATCH,
                  batch_timeout_ms: float = DEFAULT_TIMEOUT_MS,
                  ladder: Optional[Sequence[int]] = None,
-                 trailing: Optional[str] = None):
+                 trailing: Optional[str] = None,
+                 max_queue: Optional[int] = None,
+                 worker_max_restarts: int = 5):
         preds = getattr(predictors, "predictors", None)
         if preds is None:
             preds = (list(predictors)
@@ -175,8 +208,25 @@ class DynamicBatcher:
         # traces (PADDLE_TPU_TRACE_SAMPLE), and the stall flight recorder
         # (PADDLE_TPU_STALL_DUMP) — a watchdog that dumps every thread's
         # stack when queued work stops dispatching
-        from ..observability import FlightRecorder, SpanRecorder
+        from ..observability import FlightRecorder, SpanRecorder, counter
         self._spans = SpanRecorder(component="serve")
+        self._max_queue = max_queue_default() if max_queue is None \
+            else int(max_queue)
+        self._worker_max_restarts = int(worker_max_restarts)
+        self._worker_restarts = 0
+        self._dispatcher_error: Optional[BaseException] = None
+        self._inflight = 0           # accepted, not yet delivered
+        self._inflight_lock = threading.Lock()
+        self._worker_restarts_total = counter(
+            "paddle_tpu_serve_worker_restarts",
+            "Pool predictor worker threads respawned in place after an "
+            "uncaught crash (bounded backoff; an exhausted budget leaves "
+            "the slot dead and /healthz unhealthy).")
+        self._shed_total = counter(
+            "paddle_tpu_serve_shed_total",
+            "Requests refused at admission because the queue was past "
+            "the PADDLE_TPU_SERVE_MAX_QUEUE watermark (typed "
+            "RESOURCE_EXHAUSTED error frame).")
         self._busy_batches = 0       # formed batches inside _execute
         self._recorder = FlightRecorder(
             "serve_batcher",
@@ -191,8 +241,8 @@ class DynamicBatcher:
             # overlap across devices; the dispatcher only forms + routes
             for i, p in enumerate(self._preds):
                 wq: Queue = Queue(maxsize=4)  # backpressure per predictor
-                t = threading.Thread(target=self._worker_loop,
-                                     args=(p, wq), daemon=True,
+                t = threading.Thread(target=self._worker_main,
+                                     args=(i, p, wq), daemon=True,
                                      name=f"serve-worker-{i}")
                 t.start()
                 self._wqueues.append(wq)
@@ -320,18 +370,22 @@ class DynamicBatcher:
             pass
         return exc
 
-    @staticmethod
-    def _set(fut, value=None, exc=None):
+    def _set(self, fut, value=None, exc=None):
         """Deliver into a future the caller may have abandoned (e.g. a
         server-side request deadline cancelled it) without letting
-        InvalidStateError kill the dispatcher/worker thread."""
+        InvalidStateError kill the dispatcher/worker thread. Every
+        ACCEPTED request is delivered through here exactly once, so this
+        is also where the in-flight count (quiesce/drain accounting)
+        goes down."""
         try:
             if exc is not None:
                 fut.set_exception(exc)
             else:
                 fut.set_result(value)
         except InvalidStateError:
-            pass
+            return
+        with self._inflight_lock:
+            self._inflight -= 1
 
     # -- request intake --------------------------------------------------
 
@@ -360,10 +414,34 @@ class DynamicBatcher:
         req.future.request_id = req_id
         with self._cond:
             if self._stop:
-                req.future.set_exception(self._tag(
-                    RuntimeError("DynamicBatcher is stopped"), req_id))
+                # typed so a front router fails the request over to a
+                # live backend instead of relaying a terminal error
+                req.future.set_exception(self._tag(TypedServeError(
+                    ERR_UNAVAILABLE, "DynamicBatcher is stopped"), req_id))
+                return req.future
+            if self._dispatcher_error is not None \
+                    or not self._dispatcher.is_alive():
+                # a dead dispatcher would never dequeue this request;
+                # fail NOW, not after the request deadline
+                req.future.set_exception(self._tag(TypedServeError(
+                    ERR_UNAVAILABLE,
+                    "serve dispatcher is dead "
+                    f"({self._dispatcher_error!r}); restart the daemon"),
+                    req_id))
+                return req.future
+            if self._max_queue and len(self._q) >= self._max_queue:
+                # admission control: past the watermark the queue can
+                # only add deadline-bound latency — shed instead
+                self._shed_total.inc()
+                req.future.set_exception(self._tag(TypedServeError(
+                    ERR_RESOURCE_EXHAUSTED,
+                    f"serve queue past watermark ({len(self._q)} >= "
+                    f"{self._max_queue} queued; "
+                    "PADDLE_TPU_SERVE_MAX_QUEUE)"), req_id))
                 return req.future
             self._q.append(req)
+            with self._inflight_lock:
+                self._inflight += 1
             self._cond.notify_all()
         return req.future
 
@@ -435,19 +513,48 @@ class DynamicBatcher:
             return reqs, first.key, rows
 
     def _dispatch_loop(self):
-        while True:
-            formed = self._form_batch()
-            if formed is None:
-                return
-            if not self._wqueues:
-                # single predictor: execute inline — a queue handoff to a
-                # worker thread costs a context switch per batch for no
-                # overlap gain on one device
-                self._execute(self._preds[0], *formed)
-                continue
-            wq = self._wqueues[self._rr % len(self._wqueues)]
-            self._rr += 1
-            wq.put(formed)
+        formed = None
+        try:
+            while True:
+                formed = self._form_batch()
+                if formed is None:
+                    return
+                chaos.maybe_fail("batcher.dispatch")
+                if not self._wqueues:
+                    # single predictor: execute inline — a queue handoff
+                    # to a worker thread costs a context switch per batch
+                    # for no overlap gain on one device
+                    self._execute(self._preds[0], *formed)
+                else:
+                    wq = self._wqueues[self._rr % len(self._wqueues)]
+                    self._rr += 1
+                    wq.put(formed)
+                formed = None
+        except BaseException as e:   # noqa: BLE001 - the thread is dying
+            self._on_dispatcher_death(e, formed)
+
+    def _on_dispatcher_death(self, exc, formed):
+        """The dispatcher thread is dying on an uncaught exception: every
+        queued request (and the batch in hand) gets a typed UNAVAILABLE
+        error frame NOW — connection threads must not sit out the full
+        request deadline for work that can never run — and `submit`
+        fails fast from here on."""
+        import warnings
+        with self._cond:
+            self._dispatcher_error = exc
+            pending = list(self._q)
+            self._q.clear()
+        if formed is not None:
+            pending = list(formed[0]) + pending
+        for r in pending:
+            self._set(r.future, exc=self._tag(TypedServeError(
+                ERR_UNAVAILABLE,
+                f"serve dispatcher died mid-flight ({exc!r}); "
+                "restart the daemon"), r.req_id))
+        warnings.warn(
+            f"DynamicBatcher dispatcher thread died ({exc!r}); "
+            f"{len(pending)} queued request(s) failed with UNAVAILABLE "
+            "and all future submits fail fast", RuntimeWarning)
 
     # -- execution -------------------------------------------------------
 
@@ -520,12 +627,56 @@ class DynamicBatcher:
             off += r.rows
         return True
 
+    def _worker_main(self, idx: int, pred, wq: Queue):
+        """Supervised worker: a crash fails the in-flight batch with a
+        typed frame, then the loop re-enters after a bounded backoff —
+        the device slot does NOT go silently idle. An exhausted restart
+        budget lets the thread die, which flips ``workers_alive`` (and
+        /healthz) so the outage is visible."""
+        import warnings
+        from ..utils.retry import backoff_delays
+        delays = backoff_delays(self._worker_max_restarts,
+                                base_delay=0.05, max_delay=2.0)
+        while True:
+            try:
+                self._worker_loop(pred, wq)
+                return
+            except BaseException as e:   # noqa: BLE001 - supervise all
+                if self._stop:
+                    return
+                self._worker_restarts += 1
+                self._worker_restarts_total.inc()
+                try:
+                    delay = next(delays)
+                except StopIteration:
+                    warnings.warn(
+                        f"serve worker {idx} died {self._worker_restarts} "
+                        f"times (last: {e!r}); restart budget exhausted — "
+                        "slot is dead, /healthz goes unhealthy",
+                        RuntimeWarning)
+                    return
+                warnings.warn(
+                    f"serve worker {idx} crashed ({e!r}); respawning in "
+                    f"{delay:.2f}s", RuntimeWarning)
+                time.sleep(delay)
+
     def _worker_loop(self, pred, wq: Queue):
         while True:
             item = wq.get()
             if item is None:
                 return
-            self._execute(pred, *item)
+            try:
+                chaos.maybe_fail("batcher.worker")
+                self._execute(pred, *item)
+            except BaseException as e:
+                # fail the batch in hand before the supervisor respawns
+                # us: its futures would otherwise wait out the deadline
+                for r in item[0]:
+                    self._set(r.future, exc=self._tag(TypedServeError(
+                        ERR_UNAVAILABLE,
+                        f"serve worker crashed mid-batch ({e!r})"),
+                        r.req_id))
+                raise
 
     def _execute(self, pred, reqs, key, rows):
         # busy accounting + heartbeat bracket the real work so the stall
@@ -685,7 +836,36 @@ class DynamicBatcher:
 
     @property
     def dispatcher_alive(self) -> bool:
-        return self._dispatcher.is_alive()
+        return self._dispatcher.is_alive() \
+            and self._dispatcher_error is None
+
+    @property
+    def worker_restarts(self) -> int:
+        """Times a crashed pool worker was respawned in place."""
+        return self._worker_restarts
+
+    @property
+    def max_queue(self) -> int:
+        """Admission watermark (0 = shedding off)."""
+        return self._max_queue
+
+    @property
+    def inflight(self) -> int:
+        """Accepted requests whose future has not been delivered yet."""
+        with self._inflight_lock:
+            return self._inflight
+
+    def quiesce(self, timeout: float = 30.0) -> bool:
+        """Block until every ACCEPTED request has been answered (result
+        or error delivered into its future) — the drain step of a
+        SIGTERM'd daemon: stop enqueueing first, then quiesce, then
+        stop(). True on quiet, False on timeout."""
+        deadline = time.monotonic() + float(timeout)
+        while time.monotonic() < deadline:
+            if self.inflight <= 0:
+                return True
+            time.sleep(0.01)
+        return self.inflight <= 0
 
     @property
     def workers_alive(self) -> bool:
@@ -723,8 +903,10 @@ class DynamicBatcher:
             self._q.clear()
             self._cond.notify_all()
         for r in pending:
-            self._set(r.future, exc=self._tag(
-                RuntimeError("DynamicBatcher stopped"), r.req_id))
+            # UNAVAILABLE, not a bare RuntimeError: a stopping backend is
+            # the canonical failover case for a front router
+            self._set(r.future, exc=self._tag(TypedServeError(
+                ERR_UNAVAILABLE, "DynamicBatcher stopped"), r.req_id))
         self._dispatcher.join(timeout=5)
         for wq in self._wqueues:
             wq.put(None)

@@ -23,6 +23,12 @@ struct PD_Predictor {
    * may hold a partial frame, so any further request would parse stale
    * bytes as a fresh reply. Poisoned handles fail fast; reconnect. */
   int broken;
+  /* Remembered endpoint + timeout so PD_PredictorReconnect can re-dial
+   * and restore the handle in place (failover/retry loops keep the same
+   * PD_Predictor* across backend restarts). */
+  char host[64];
+  int port;
+  double timeout_s; /* <= 0: fully blocking */
 };
 
 const char* PD_GetLastError(void) { return g_err; }
@@ -71,11 +77,11 @@ int64_t PD_TensorNumel(const PD_Tensor* t) {
   return n;
 }
 
-PD_Predictor* PD_PredictorConnect(const char* host, int port) {
+static int dial(const char* host, int port) {
   int fd = socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) {
     set_err("socket() failed");
-    return NULL;
+    return -1;
   }
   struct sockaddr_in addr;
   memset(&addr, 0, sizeof(addr));
@@ -84,22 +90,19 @@ PD_Predictor* PD_PredictorConnect(const char* host, int port) {
   if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
     set_err("inet_pton: numeric IPv4 host required");
     close(fd);
-    return NULL;
+    return -1;
   }
   if (connect(fd, (struct sockaddr*)&addr, sizeof(addr)) != 0) {
     set_err("connect() failed — is the serve daemon running?");
     close(fd);
-    return NULL;
+    return -1;
   }
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-  PD_Predictor* p = (PD_Predictor*)malloc(sizeof(PD_Predictor));
-  p->fd = fd;
-  p->broken = 0;
-  return p;
+  return fd;
 }
 
-int PD_PredictorSetTimeout(PD_Predictor* p, double seconds) {
+static int apply_timeout(int fd, double seconds) {
   struct timeval tv;
   if (seconds <= 0) {
     tv.tv_sec = 0; /* zero timeval = blocking mode */
@@ -108,11 +111,46 @@ int PD_PredictorSetTimeout(PD_Predictor* p, double seconds) {
     tv.tv_sec = (time_t)seconds;
     tv.tv_usec = (suseconds_t)((seconds - (double)tv.tv_sec) * 1e6);
   }
-  if (setsockopt(p->fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv)) != 0 ||
-      setsockopt(p->fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv)) != 0) {
+  if (setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv)) != 0 ||
+      setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv)) != 0) {
     set_err("setsockopt(SO_RCVTIMEO/SO_SNDTIMEO) failed");
     return -1;
   }
+  return 0;
+}
+
+PD_Predictor* PD_PredictorConnect(const char* host, int port) {
+  int fd = dial(host, port);
+  if (fd < 0) return NULL;
+  PD_Predictor* p = (PD_Predictor*)malloc(sizeof(PD_Predictor));
+  p->fd = fd;
+  p->broken = 0;
+  snprintf(p->host, sizeof(p->host), "%s", host);
+  p->port = port;
+  p->timeout_s = 0;
+  return p;
+}
+
+int PD_PredictorSetTimeout(PD_Predictor* p, double seconds) {
+  if (apply_timeout(p->fd, seconds) != 0) return -1;
+  p->timeout_s = seconds;
+  return 0;
+}
+
+int PD_PredictorReconnect(PD_Predictor* p) {
+  if (!p) {
+    set_err("NULL predictor");
+    return -1;
+  }
+  int fd = dial(p->host, p->port);
+  if (fd < 0) return -1; /* handle unchanged (still poisoned if it was) */
+  if (p->timeout_s > 0 && apply_timeout(fd, p->timeout_s) != 0) {
+    close(fd);
+    return -1;
+  }
+  close(p->fd);
+  p->fd = fd;
+  p->broken = 0;
   return 0;
 }
 

@@ -70,6 +70,15 @@ const char* PD_GetLastError(void);
  * instead of parsing stale bytes. Delete the predictor and reconnect. */
 int PD_PredictorSetTimeout(PD_Predictor* p, double seconds);
 
+/* Re-dial the endpoint this predictor was created with and reset the
+ * poisoned flag — the recovery half of the poisoning contract above: a
+ * retry loop keeps the same PD_Predictor* across a daemon restart or a
+ * timed-out round trip instead of rebuilding its state. The configured
+ * timeout (PD_PredictorSetTimeout) is re-applied to the new connection.
+ * On failure returns -1 and the handle is left unchanged (a poisoned
+ * handle stays poisoned, so callers can keep retrying). */
+int PD_PredictorReconnect(PD_Predictor* p);
+
 int64_t PD_TensorNumel(const PD_Tensor* t);
 
 #ifdef __cplusplus

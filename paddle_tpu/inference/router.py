@@ -1,0 +1,967 @@
+"""Health-aware front router: one wire-protocol address over a serve fleet.
+
+A single ``InferenceServer`` is one process on one host — restart it and
+every client sees connection errors until it is back. The router is the
+resilience layer on top: it speaks the same wire protocol as a backend
+(``serve.py`` frames in, frames out), so C/Go clients point at the
+router unchanged, and behind it N backend daemons come, go, drain and
+crash without a client ever losing a request silently.
+
+What the router does per request:
+
+* **Health-weighted routing** — a poll thread hits each backend's admin
+  plane (``/healthz`` for liveness + draining, ``/statusz`` for
+  ``queue_depth`` / ``oldest_wait_s``) every ``poll_interval`` seconds;
+  requests go to the routable backend with the lowest load score
+  (router-side in-flight + reported queue depth + wedge penalty).
+  Backends without an admin port degrade to a TCP dial probe.
+* **Circuit breaking** — a :class:`~paddle_tpu.utils.retry.CircuitBreaker`
+  per backend trips OPEN after consecutive wire failures, so a dead
+  backend costs one connect timeout, not one per request; after
+  ``reset_timeout`` one half-open probe request re-tests it.
+* **Bounded failover** — inference requests are idempotent, so a wire
+  failure (or a typed ``UNAVAILABLE`` frame from a dying backend) is
+  retried on the next-best backend — but every failover spends from a
+  shared :class:`~paddle_tpu.utils.retry.RetryBudget`, so fleet-wide
+  outage cannot amplify into a retry storm: when the budget is empty
+  the client gets a fast typed ``UNAVAILABLE`` frame instead.
+* **Load shedding** — when every routable backend is past the
+  ``shed_watermark`` queue depth (or the router's own per-backend
+  in-flight cap), the request is refused immediately with a typed
+  ``RESOURCE_EXHAUSTED`` frame. Deterministic model errors
+  (``INVALID_ARGUMENT``, ``INTERNAL``, ``DEADLINE_EXCEEDED``) are
+  relayed verbatim, never failed over.
+* **Drain awareness** — a backend whose /healthz says "draining"
+  (SIGTERM was delivered; it is finishing in-flight work) is routed
+  around within one poll interval; the router itself drains the same
+  way (``drain()`` / SIGTERM in ``main_router``).
+
+``BackendSupervisor`` optionally owns the fleet: ``--fleet N`` spawns N
+``serve.py`` daemons from the model prefix, restarts dead ones with
+bounded backoff (sharing one ``PADDLE_TPU_COMPILE_CACHE`` directory so a
+restarted backend warms from the persistent compile cache), and swaps
+them into the routing table live.
+
+Chaos site ``router.forward`` fires once per backend attempt, so tests
+inject wire failures between router and backend deterministically
+(see tests/test_serve_chaos.py and docs/fault_tolerance.md).
+
+    python -m paddle_tpu.inference.serve /path/prefix --router --fleet 3 \
+        --port 9000 --warmup
+
+All ``paddle_tpu_router_*`` metric families land in the shared registry
+and are served from the router's own admin plane (``--metrics-port``).
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+from http.client import HTTPConnection
+
+from ..testing import chaos
+from ..utils.retry import CircuitBreaker, RetryBudget, backoff_delays
+from .errors import (ERR_INVALID_ARGUMENT, ERR_RESOURCE_EXHAUSTED,
+                     ERR_UNAVAILABLE, RETRYABLE_CODES, TypedServeError,
+                     error_code)
+from .serve import read_reply, read_tensors, write_error, write_tensors
+
+__all__ = ["Backend", "ServeRouter", "BackendSupervisor", "parse_backend",
+           "main_router"]
+
+_BREAKER_STATE_CODE = {CircuitBreaker.CLOSED: 0,
+                       CircuitBreaker.HALF_OPEN: 1,
+                       CircuitBreaker.OPEN: 2}
+
+
+def _router_metrics():
+    """Register (idempotently) and return the paddle_tpu_router_* metric
+    families. Catalogued in docs/observability.md."""
+    from ..observability import counter, gauge, histogram
+    return {
+        "requests": counter(
+            "paddle_tpu_router_requests_total",
+            "Requests answered by the router, by outcome (ok, "
+            "relayed_error, shed, unavailable, malformed)", ("outcome",)),
+        "failovers": counter(
+            "paddle_tpu_router_failovers_total",
+            "Requests retried on another backend after a wire failure "
+            "or typed UNAVAILABLE frame"),
+        "budget_denied": counter(
+            "paddle_tpu_router_retry_budget_denied_total",
+            "Failovers refused because the shared retry budget was "
+            "empty (the anti-retry-storm valve)"),
+        "shed": counter(
+            "paddle_tpu_router_shed_total",
+            "Requests refused with RESOURCE_EXHAUSTED because every "
+            "routable backend was past the shed watermark"),
+        "backend_up": gauge(
+            "paddle_tpu_router_backend_up",
+            "1 while the backend's last health poll was healthy",
+            ("backend",)),
+        "breaker_state": gauge(
+            "paddle_tpu_router_breaker_state",
+            "Per-backend circuit breaker state "
+            "(0 closed, 1 half-open, 2 open)", ("backend",)),
+        "backend_queue": gauge(
+            "paddle_tpu_router_backend_queue_depth",
+            "Backend queue depth from its last /statusz poll",
+            ("backend",)),
+        "inflight": gauge(
+            "paddle_tpu_router_inflight",
+            "Requests currently being routed (read off a client and "
+            "not yet answered)"),
+        "latency": histogram(
+            "paddle_tpu_router_request_latency_seconds",
+            "Router-side request latency (client read to reply write)",
+            buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                     1.0, 2.5, 5.0, 10.0, 30.0), sample_cap=2048),
+        "failover_latency": histogram(
+            "paddle_tpu_router_failover_latency_seconds",
+            "Extra latency a failed-over request paid: first backend "
+            "failure to final reply",
+            buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                     1.0, 2.5, 5.0, 10.0), sample_cap=2048),
+        "backend_restarts": counter(
+            "paddle_tpu_router_backend_restarts_total",
+            "Dead fleet backends respawned by the supervisor"),
+    }
+
+
+class Backend:
+    """One backend daemon in the routing table: its address, the last
+    health-poll verdict, a circuit breaker, and router-side in-flight
+    accounting. Health fields are written by the poll thread and read by
+    the routing path; all mutation goes through ``update_health`` /
+    ``begin``/``end`` under the backend's lock."""
+
+    def __init__(self, host: str, port: int, admin_port: int = None,
+                 breaker: CircuitBreaker = None):
+        self.host = host
+        self.port = int(port)
+        self.admin_port = int(admin_port) if admin_port is not None \
+            else None
+        self.key = f"{host}:{self.port}"
+        self.breaker = breaker or CircuitBreaker(failure_threshold=3,
+                                                 reset_timeout=2.0)
+        self._lock = threading.Lock()
+        # optimistic until the first poll: a just-added backend must be
+        # routable immediately (the poll loop demotes it within one tick)
+        self.healthy = True
+        self.health_reasons = []
+        self.draining = False
+        self.queue_depth = 0
+        self.oldest_wait_s = 0.0
+        self.last_poll_s = None
+        self.polls_failed = 0
+        self.inflight = 0
+
+    def update_health(self, healthy: bool, reasons=(), draining=False,
+                      queue_depth: int = None, oldest_wait_s: float = None):
+        with self._lock:
+            self.healthy = bool(healthy)
+            self.health_reasons = list(reasons)
+            self.draining = bool(draining)
+            if queue_depth is not None:
+                self.queue_depth = int(queue_depth)
+            if oldest_wait_s is not None:
+                self.oldest_wait_s = float(oldest_wait_s)
+            self.last_poll_s = time.monotonic()
+            self.polls_failed = 0 if healthy else self.polls_failed + 1
+
+    def begin(self):
+        with self._lock:
+            self.inflight += 1
+
+    def end(self):
+        with self._lock:
+            self.inflight -= 1
+
+    def score(self) -> float:
+        """Load score for least-loaded routing: cheap requests go where
+        the combined router-side in-flight + backend queue is smallest;
+        a wedging queue (old oldest_wait_s) is penalized hard."""
+        with self._lock:
+            return (self.inflight + self.queue_depth
+                    + 10.0 * self.oldest_wait_s)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "key": self.key,
+                "admin_port": self.admin_port,
+                "healthy": self.healthy,
+                "reasons": list(self.health_reasons),
+                "draining": self.draining,
+                "queue_depth": self.queue_depth,
+                "oldest_wait_s": round(self.oldest_wait_s, 3),
+                "inflight": self.inflight,
+                "breaker": self.breaker.state,
+            }
+
+
+def parse_backend(spec: str) -> Backend:
+    """``HOST:PORT`` or ``HOST:PORT:ADMIN_PORT`` -> :class:`Backend`."""
+    parts = spec.rsplit(":", 2)
+    try:
+        if len(parts) == 3 and parts[0]:
+            # HOST:PORT:ADMIN — but HOST:PORT alone also splits in two;
+            # disambiguate by whether the first part parses as a port
+            try:
+                host, port, admin = parts[0], int(parts[1]), int(parts[2])
+                return Backend(host, port, admin)
+            except ValueError:
+                pass
+        host, port = spec.rsplit(":", 1)
+        return Backend(host, int(port))
+    except (ValueError, IndexError):
+        raise ValueError(
+            f"backend spec {spec!r}: want HOST:PORT[:ADMIN_PORT]")
+
+
+class ServeRouter:
+    """Wire-protocol front router over a set of :class:`Backend`\\ s.
+
+    Accepts client connections exactly like ``InferenceServer`` (same
+    framing, same keep-alive loop), but instead of running a model it
+    picks a backend, relays the request, and relays the reply — with
+    health-weighted selection, circuit-breaker failover, retry
+    budgeting, load shedding and drain support (class docstring above,
+    and docs/fault_tolerance.md for the full state machine).
+    """
+
+    def __init__(self, backends, port: int = 0, host: str = "127.0.0.1",
+                 poll_interval: float = 0.5, shed_watermark: int = 64,
+                 failover_retries: int = 2, forward_timeout: float = 130.0,
+                 connect_timeout: float = 2.0, idle_timeout: float = None,
+                 metrics_port: int = None, retry_budget: RetryBudget = None,
+                 max_inflight_per_backend: int = 256):
+        self._backends = list(backends)
+        self._block = threading.Lock()          # routing-table lock
+        self._poll_interval = float(poll_interval)
+        self._watermark = int(shed_watermark)
+        self._failover_retries = max(int(failover_retries), 0)
+        self._forward_timeout = forward_timeout
+        self._connect_timeout = float(connect_timeout)
+        self._idle_timeout = float(idle_timeout) if idle_timeout else None
+        self._budget = retry_budget or RetryBudget()
+        self._max_inflight = max(int(max_inflight_per_backend), 1)
+        self._local = threading.local()         # per-thread conn cache
+        self._rr = 0                            # tie-break rotation
+        self._m = _router_metrics()
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        self._t0 = time.monotonic()
+
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(128)
+        self.port = self._srv.getsockname()[1]
+        self.host = host
+        self._stop = threading.Event()
+        self._draining = threading.Event()
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True,
+                                               name="router-accept")
+        self._accept_thread.start()
+        self._poll_thread = threading.Thread(target=self._poll_loop,
+                                             daemon=True,
+                                             name="router-health-poll")
+        self._poll_thread.start()
+
+        self._admin = None
+        self.metrics_port = None
+        if metrics_port is not None and int(metrics_port) >= 0:
+            from ..observability import (AdminServer,
+                                         install_default_collectors)
+            install_default_collectors()
+            self._admin = AdminServer(port=int(metrics_port), host=host,
+                                      health_fn=self._health,
+                                      status_fn=self._status)
+            self.metrics_port = self._admin.port
+
+    # -- routing table ---------------------------------------------------
+
+    def backends(self):
+        with self._block:
+            return list(self._backends)
+
+    def add_backend(self, backend: Backend) -> Backend:
+        with self._block:
+            self._backends.append(backend)
+        return backend
+
+    def remove_backend(self, key: str):
+        with self._block:
+            self._backends = [b for b in self._backends if b.key != key]
+        # drop the dead backend's per-backend samples so /metrics does
+        # not advertise an address that no longer exists
+        for fam in ("backend_up", "breaker_state", "backend_queue"):
+            self._m[fam].remove(backend=key)
+
+    # -- health polling --------------------------------------------------
+
+    def _poll_loop(self):
+        while not self._stop.is_set():
+            for b in self.backends():
+                try:
+                    self._poll_backend(b)
+                except Exception as e:   # a poll bug must not kill polls
+                    b.update_health(False, [f"poll raised: {e!r}"])
+                self._m["backend_up"].labels(backend=b.key).set(
+                    1 if b.healthy else 0)
+                self._m["breaker_state"].labels(backend=b.key).set(
+                    _BREAKER_STATE_CODE[b.breaker.state])
+                self._m["backend_queue"].labels(backend=b.key).set(
+                    b.queue_depth)
+            self._stop.wait(self._poll_interval)
+
+    def _poll_backend(self, b: Backend):
+        if b.admin_port is None:
+            # no admin plane: degrade to a TCP liveness dial
+            try:
+                socket.create_connection(
+                    (b.host, b.port),
+                    timeout=max(self._poll_interval, 0.5)).close()
+                b.update_health(True)
+            except OSError as e:
+                b.update_health(False, [f"dial failed: {e}"])
+            return
+        conn = HTTPConnection(b.host, b.admin_port,
+                              timeout=max(self._poll_interval, 0.5))
+        try:
+            conn.request("GET", "/healthz")
+            r = conn.getresponse()
+            hbody = json.loads(r.read().decode("utf-8", "replace") or "{}")
+            healthy = r.status == 200
+            reasons = hbody.get("reasons", [])
+            draining = any("draining" in str(x) for x in reasons)
+            queue_depth = oldest = None
+            conn.request("GET", "/statusz")
+            s = conn.getresponse()
+            sbody = json.loads(s.read().decode("utf-8", "replace") or "{}")
+            draining = bool(sbody.get("draining", draining))
+            batcher = sbody.get("batcher") or {}
+            if "queue_depth" in batcher:
+                queue_depth = batcher["queue_depth"]
+            if "oldest_wait_s" in batcher:
+                oldest = batcher["oldest_wait_s"]
+            b.update_health(healthy, reasons, draining=draining,
+                            queue_depth=queue_depth, oldest_wait_s=oldest)
+        except (OSError, ValueError) as e:
+            b.update_health(False, [f"admin poll failed: {e}"])
+        finally:
+            conn.close()
+
+    # -- backend selection -----------------------------------------------
+
+    def _routable(self, exclude=()):
+        """Backends eligible for new traffic: last poll healthy, not
+        draining, breaker not OPEN (HALF_OPEN stays in — its allow()
+        gate hands one probe through)."""
+        out = []
+        for b in self.backends():
+            if b.key in exclude or b.draining or not b.healthy:
+                continue
+            if b.breaker.state == CircuitBreaker.OPEN:
+                continue
+            out.append(b)
+        return out
+
+    def _choose(self, exclude=()):
+        """Least-loaded routable backend, or ``None`` when nothing is
+        routable. Raises RESOURCE_EXHAUSTED when backends ARE routable
+        but every one is past the shed watermark / in-flight cap —
+        queueing behind an overloaded fleet only converts overload into
+        timeouts, so the router refuses fast instead."""
+        cands = self._routable(exclude)
+        if not cands:
+            return None
+        open_for_traffic = []
+        for b in cands:
+            if self._watermark > 0 and b.queue_depth >= self._watermark:
+                continue
+            if b.inflight >= self._max_inflight:
+                continue
+            open_for_traffic.append(b)
+        if not open_for_traffic:
+            self._m["shed"].inc()
+            raise TypedServeError(
+                ERR_RESOURCE_EXHAUSTED,
+                f"all {len(cands)} routable backends past the shed "
+                f"watermark (queue >= {self._watermark}); back off and "
+                f"retry later")
+        scored = [(b.score(), b) for b in open_for_traffic]
+        scored.sort(key=lambda p: p[0])
+        # equal-score leaders rotate round-robin — a stable sort alone
+        # would pile every idle-fleet request onto the first backend
+        leaders = [b for s, b in scored if s <= scored[0][0]]
+        self._rr += 1
+        rot = self._rr % len(leaders)
+        ordered = leaders[rot:] + leaders[:rot] \
+            + [b for _, b in scored if b not in leaders]
+        for b in ordered:
+            if b.breaker.allow():    # claims the half-open probe slot
+                return b
+        return None
+
+    # -- forwarding ------------------------------------------------------
+
+    def _conn_cache(self) -> dict:
+        cache = getattr(self._local, "conns", None)
+        if cache is None:
+            cache = self._local.conns = {}
+        return cache
+
+    def _backend_conn(self, b: Backend) -> socket.socket:
+        cache = self._conn_cache()
+        s = cache.get(b.key)
+        if s is None:
+            s = socket.create_connection((b.host, b.port),
+                                         timeout=self._connect_timeout)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            s.settimeout(self._forward_timeout)
+            cache[b.key] = s
+        return s
+
+    def _drop_conn(self, b: Backend):
+        s = self._conn_cache().pop(b.key, None)
+        if s is not None:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def _forward(self, b: Backend, arrays):
+        """One attempt against one backend: write the request, read the
+        reply. Returns ``(outputs, None)`` or ``(None, error_message)``.
+        A stale keep-alive socket (backend restarted between requests)
+        gets exactly one fresh-socket retry; every other wire failure
+        propagates to the failover loop."""
+        reused = b.key in self._conn_cache()
+        b.begin()
+        try:
+            try:
+                s = self._backend_conn(b)
+                write_tensors(s, arrays)
+                return read_reply(s)
+            except ConnectionError:
+                self._drop_conn(b)
+                if not reused:
+                    raise
+            except (TimeoutError, OSError, struct.error):
+                self._drop_conn(b)
+                raise
+            s = self._backend_conn(b)
+            try:
+                write_tensors(s, arrays)
+                return read_reply(s)
+            except (ConnectionError, TimeoutError, OSError, struct.error):
+                self._drop_conn(b)
+                raise
+        finally:
+            b.end()
+
+    def _handle(self, arrays):
+        """Route one decoded request. Returns ``("ok", outputs)`` or
+        ``(outcome, error_message)`` with outcome one of
+        ``relayed_error`` / ``shed`` / ``unavailable``."""
+        self._budget.record_request()
+        tried = set()
+        attempts = 0
+        first_failure_t = None
+        last_err = None
+        max_attempts = 1 + self._failover_retries
+        while attempts < max_attempts:
+            try:
+                b = self._choose(exclude=tried)
+            except TypedServeError as e:     # shed: every backend busy
+                return ("shed", str(e))
+            if b is None:
+                break
+            if attempts > 0:
+                if not self._budget.try_spend():
+                    self._m["budget_denied"].inc()
+                    return ("unavailable",
+                            f"{ERR_UNAVAILABLE}: retry budget exhausted "
+                            f"after backend failure ({last_err}); "
+                            f"failing fast instead of retry-storming")
+                self._m["failovers"].inc()
+            attempts += 1
+            tried.add(b.key)
+            try:
+                chaos.maybe_fail("router.forward", b.key)
+                outputs, errmsg = self._forward(b, arrays)
+            except (ConnectionError, TimeoutError, OSError,
+                    struct.error, ValueError, IndexError) as e:
+                # wire failure or unparseable reply: the backend is
+                # misbehaving — count it against the breaker, fail over
+                b.breaker.record_failure()
+                self._drop_conn(b)
+                last_err = f"{b.key}: {type(e).__name__}: {e}"
+                if first_failure_t is None:
+                    first_failure_t = time.monotonic()
+                continue
+            if errmsg is not None:
+                code = error_code(errmsg)
+                if code in RETRYABLE_CODES:
+                    # the backend itself says UNAVAILABLE (dispatcher
+                    # died, worker crashed): failover-safe
+                    b.breaker.record_failure()
+                    last_err = f"{b.key}: {errmsg}"
+                    if first_failure_t is None:
+                        first_failure_t = time.monotonic()
+                    continue
+                # deterministic / non-retryable error: relay verbatim —
+                # the backend answered, so its breaker heals
+                b.breaker.record_success()
+                return ("relayed_error", errmsg)
+            b.breaker.record_success()
+            if first_failure_t is not None:
+                self._m["failover_latency"].observe(
+                    time.monotonic() - first_failure_t)
+            return ("ok", outputs)
+        detail = last_err or ("no routable backend (all unhealthy, "
+                              "draining, or circuit-broken)")
+        return ("unavailable",
+                f"{ERR_UNAVAILABLE}: no backend answered after "
+                f"{attempts} attempt(s): {detail}")
+
+    # -- client plane ----------------------------------------------------
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                break
+            threading.Thread(target=self._serve_client, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_client(self, conn):
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        if self._idle_timeout and self._idle_timeout > 0:
+            conn.settimeout(self._idle_timeout)
+        try:
+            while True:
+                try:
+                    arrays = read_tensors(conn)
+                except (ConnectionError, TimeoutError, struct.error,
+                        OSError):
+                    return
+                except (ValueError, IndexError) as e:
+                    self._m["requests"].labels(outcome="malformed").inc()
+                    try:
+                        write_error(conn,
+                                    f"{ERR_INVALID_ARGUMENT}: malformed "
+                                    f"request: {e}")
+                    except OSError:
+                        pass
+                    return
+                with self._inflight_lock:
+                    self._inflight += 1
+                self._m["inflight"].inc()
+                t0 = time.monotonic()
+                try:
+                    outcome, payload = self._handle(arrays)
+                finally:
+                    with self._inflight_lock:
+                        self._inflight -= 1
+                    self._m["inflight"].dec()
+                self._m["latency"].observe(time.monotonic() - t0)
+                self._m["requests"].labels(outcome=outcome).inc()
+                try:
+                    if outcome == "ok":
+                        write_tensors(conn, payload)
+                    else:
+                        write_error(conn, payload)
+                except (ConnectionError, TimeoutError, OSError):
+                    return
+                if self._draining.is_set():
+                    return
+        finally:
+            conn.close()
+
+    # -- admin surface ---------------------------------------------------
+
+    def _health(self):
+        """Router /healthz: healthy while >= 1 backend is routable."""
+        reasons = []
+        if self._stop.is_set():
+            reasons.append("router stopped")
+        elif self._draining.is_set():
+            reasons.append("draining")
+        routable = self._routable()
+        if not routable:
+            per = [f"{s['key']}: "
+                   + ("draining" if s["draining"]
+                      else f"breaker {s['breaker']}"
+                      if s["breaker"] == CircuitBreaker.OPEN
+                      else "; ".join(s["reasons"]) or "unhealthy")
+                   for s in (b.snapshot() for b in self.backends())]
+            reasons.append("no routable backend ("
+                           + ("; ".join(per) or "no backends") + ")")
+        return not reasons, reasons
+
+    def _status(self) -> dict:
+        return {
+            "role": "router",
+            "port": self.port,
+            "metrics_port": self.metrics_port,
+            "uptime_s": round(time.monotonic() - self._t0, 3),
+            "draining": self._draining.is_set(),
+            "inflight_requests": self.inflight_requests,
+            "shed_watermark": self._watermark,
+            "poll_interval_s": self._poll_interval,
+            "retry_budget": {
+                "tokens": round(self._budget.tokens, 2),
+                "spent": self._budget.spent,
+                "denied": self._budget.denied,
+            },
+            "backends": [b.snapshot() for b in self.backends()],
+        }
+
+    # -- lifecycle -------------------------------------------------------
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    @property
+    def inflight_requests(self) -> int:
+        with self._inflight_lock:
+            return self._inflight
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Stop accepting, answer everything in flight, then stop."""
+        self._draining.set()
+        try:
+            self._srv.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._srv.close()
+        deadline = time.monotonic() + float(timeout)
+        drained = False
+        while time.monotonic() < deadline:
+            if self.inflight_requests <= 0:
+                drained = True
+                break
+            time.sleep(0.01)
+        self.stop()
+        return drained
+
+    def stop(self):
+        self._stop.set()
+        if self._admin is not None:
+            self._admin.stop()
+        try:
+            self._srv.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._srv.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class _ProcIO:
+    """Stdout reader for one spawned backend: drains the pipe forever
+    (a full pipe would wedge the child), remembers the announced ports,
+    and keeps a tail of lines for crash diagnostics."""
+
+    def __init__(self, proc: subprocess.Popen):
+        self.proc = proc
+        self.lines = collections.deque(maxlen=64)
+        self.serve_port = None
+        self.metrics_port = None
+        self._serving = threading.Event()
+        self._thread = threading.Thread(target=self._read, daemon=True,
+                                        name=f"backend-io-{proc.pid}")
+        self._thread.start()
+
+    def _read(self):
+        try:
+            for line in self.proc.stdout:
+                line = line.rstrip("\n")
+                self.lines.append(line)
+                if line.startswith("METRICS "):
+                    try:
+                        self.metrics_port = int(line.split()[1])
+                    except (IndexError, ValueError):
+                        pass
+                elif line.startswith("SERVING "):
+                    try:
+                        self.serve_port = int(line.split()[1])
+                    except (IndexError, ValueError):
+                        pass
+                    self._serving.set()
+        except (OSError, ValueError):
+            pass
+        finally:
+            self._serving.set()     # EOF: unblock any waiter
+
+    def wait_serving(self, timeout: float):
+        if not self._serving.wait(timeout) or self.serve_port is None:
+            tail = "\n".join(self.lines)
+            raise RuntimeError(
+                f"backend pid {self.proc.pid} did not announce SERVING "
+                f"within {timeout:g}s; last output:\n{tail}")
+        return self.serve_port, self.metrics_port
+
+
+class BackendSupervisor:
+    """Owns a fleet of ``serve.py`` daemons for a router.
+
+    Spawns ``count`` backends from one model prefix (each on an
+    ephemeral data + admin port, announced on stdout), registers them
+    with the router, and watches them: a backend that dies is removed
+    from the routing table and respawned with bounded exponential
+    backoff — up to ``max_restarts`` times per slot, after which the
+    slot is abandoned (the router simply keeps routing around it). All
+    backends share one ``PADDLE_TPU_COMPILE_CACHE`` directory, so a
+    respawned backend warms its bucket ladder from the persistent
+    compile cache instead of recompiling from scratch.
+
+    ``terminate(key)`` SIGTERMs one backend (it drains via serve.py's
+    handler) — the rolling-restart primitive: the watcher respawns it
+    once it exits, one slot at a time.
+    """
+
+    def __init__(self, model_prefix: str, count: int, router: ServeRouter,
+                 host: str = "127.0.0.1", serve_args=None, env=None,
+                 max_restarts: int = 5, start_timeout: float = 180.0):
+        self.model_prefix = model_prefix
+        self.count = int(count)
+        self.router = router
+        self.host = host
+        self.serve_args = list(serve_args or [])
+        self.max_restarts = int(max_restarts)
+        self.start_timeout = float(start_timeout)
+        self._env = dict(env if env is not None else os.environ)
+        if "PADDLE_TPU_COMPILE_CACHE" not in self._env:
+            import tempfile
+            self._cache_dir = tempfile.mkdtemp(prefix="paddle_tpu_fleet_")
+            self._env["PADDLE_TPU_COMPILE_CACHE"] = self._cache_dir
+        self._m = _router_metrics()
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        # slot -> {"io": _ProcIO, "backend": Backend, "restarts": int}
+        self._slots = {}
+        self._watch_thread = None
+
+    def _spawn(self) -> _ProcIO:
+        cmd = [sys.executable, "-m", "paddle_tpu.inference.serve",
+               self.model_prefix, "--port", "0", "--metrics-port", "0",
+               "--stats-interval", "0"] + self.serve_args
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, text=True,
+                                env=self._env)
+        return _ProcIO(proc)
+
+    def start(self):
+        for slot in range(self.count):
+            io = self._spawn()
+            port, admin = io.wait_serving(self.start_timeout)
+            backend = Backend(self.host, port, admin)
+            self.router.add_backend(backend)
+            with self._lock:
+                self._slots[slot] = {"io": io, "backend": backend,
+                                     "restarts": 0, "delays": None}
+        self._watch_thread = threading.Thread(target=self._watch_loop,
+                                              daemon=True,
+                                              name="fleet-supervisor")
+        self._watch_thread.start()
+        return self
+
+    def backends(self):
+        with self._lock:
+            return {slot: s["backend"] for slot, s in self._slots.items()}
+
+    def terminate(self, key: str) -> bool:
+        """SIGTERM the backend with this key (graceful drain); the
+        watcher respawns the slot after it exits."""
+        import signal as _signal
+        with self._lock:
+            for s in self._slots.values():
+                if s["backend"] is not None and s["backend"].key == key:
+                    s["io"].proc.send_signal(_signal.SIGTERM)
+                    return True
+        return False
+
+    def _watch_loop(self):
+        while not self._stop.wait(0.25):
+            with self._lock:
+                slots = list(self._slots.items())
+            for slot, s in slots:
+                io = s["io"]
+                if io is None or io.proc.poll() is None:
+                    continue
+                if self._stop.is_set():
+                    return
+                self._restart_slot(slot, s)
+
+    def _restart_slot(self, slot: int, s: dict):
+        dead = s["backend"]
+        if dead is not None:
+            self.router.remove_backend(dead.key)
+        tail = "\n".join(list(s["io"].lines)[-5:])
+        if s["restarts"] >= self.max_restarts:
+            # slot abandoned: the router routes around it for good
+            print(f"FLEET slot {slot} exceeded {self.max_restarts} "
+                  f"restarts; abandoning. last output:\n{tail}",
+                  flush=True)
+            with self._lock:
+                s["io"], s["backend"] = None, None
+            return
+        if s["delays"] is None:
+            s["delays"] = backoff_delays(self.max_restarts,
+                                         base_delay=0.2, max_delay=5.0)
+        try:
+            delay = next(s["delays"])
+        except StopIteration:
+            delay = 5.0
+        print(f"FLEET slot {slot} ({dead.key if dead else '?'}) exited "
+              f"rc={s['io'].proc.returncode}; respawning in {delay:.2f}s",
+              flush=True)
+        if self._stop.wait(delay):
+            return
+        s["restarts"] += 1
+        self._m["backend_restarts"].inc()
+        try:
+            io = self._spawn()
+        except OSError as e:
+            print(f"FLEET slot {slot} respawn failed: {e}", flush=True)
+            return                       # old dead io stays; retry next tick
+        try:
+            port, admin = io.wait_serving(self.start_timeout)
+        except RuntimeError as e:
+            print(f"FLEET slot {slot} respawn failed: {e}", flush=True)
+            with self._lock:
+                s["io"], s["backend"] = io, None
+            return                       # watcher sees it dead, retries
+        backend = Backend(self.host, port, admin)
+        with self._lock:
+            s["io"], s["backend"] = io, backend
+        self.router.add_backend(backend)
+        print(f"FLEET slot {slot} back as {backend.key} "
+              f"(restart {s['restarts']})", flush=True)
+
+    def stop(self, drain_timeout: float = 15.0):
+        """SIGTERM every live backend (graceful drain), then reap; a
+        backend that ignores SIGTERM past the timeout is killed."""
+        import signal as _signal
+        self._stop.set()
+        if self._watch_thread is not None:
+            self._watch_thread.join(timeout=2)
+        with self._lock:
+            ios = [s["io"] for s in self._slots.values()
+                   if s["io"] is not None]
+        for io in ios:
+            if io.proc.poll() is None:
+                try:
+                    io.proc.send_signal(_signal.SIGTERM)
+                except OSError:
+                    pass
+        deadline = time.monotonic() + float(drain_timeout)
+        for io in ios:
+            left = max(deadline - time.monotonic(), 0.1)
+            try:
+                io.proc.wait(timeout=left)
+            except subprocess.TimeoutExpired:
+                io.proc.kill()
+                io.proc.wait(timeout=5)
+
+
+def main_router(args) -> int:
+    """Entry point for ``python -m paddle_tpu.inference.serve --router``
+    (serve.py delegates here after argparse)."""
+    import signal as _signal
+
+    backends = [parse_backend(s) for s in args.backend]
+    if not backends and not args.fleet:
+        print("router needs --backend HOST:PORT[:ADMIN] and/or --fleet N",
+              flush=True)
+        return 2
+    if args.fleet and not args.model:
+        print("--fleet needs the model prefix argument", flush=True)
+        return 2
+
+    # forward timeout: a shade over the backend request deadline, so the
+    # backend's own typed DEADLINE_EXCEEDED frame wins the race against
+    # the router's socket timeout
+    req_t = args.request_timeout
+    if req_t is None:
+        from .serve import _request_timeout_default
+        req_t = _request_timeout_default()
+    forward_timeout = (req_t + 10.0) if req_t and req_t > 0 else None
+
+    router = ServeRouter(
+        backends, port=args.port, host=args.host,
+        poll_interval=args.poll_interval,
+        shed_watermark=args.shed_watermark,
+        forward_timeout=forward_timeout,
+        idle_timeout=args.idle_timeout,
+        metrics_port=args.metrics_port)
+
+    sup = None
+    if args.fleet:
+        serve_args = ["--max-batch", str(args.max_batch),
+                      "--pool", str(args.pool),
+                      "--batch-timeout-ms", str(args.batch_timeout_ms),
+                      "--drain-timeout", str(args.drain_timeout)]
+        if args.warmup:
+            serve_args.append("--warmup")
+        if args.trailing:
+            serve_args += ["--trailing", args.trailing]
+        if args.request_timeout is not None:
+            serve_args += ["--request-timeout", str(args.request_timeout)]
+        if args.max_queue is not None:
+            serve_args += ["--max-queue", str(args.max_queue)]
+        sup = BackendSupervisor(args.model, args.fleet, router,
+                                host=args.host, serve_args=serve_args)
+        try:
+            sup.start()
+        except RuntimeError as e:
+            print(f"FLEET start failed: {e}", flush=True)
+            router.stop()
+            sup.stop(drain_timeout=2.0)
+            return 1
+
+    keys = [b.key for b in router.backends()]
+    print(f"ROUTER backends={','.join(keys)}", flush=True)
+    if router.metrics_port is not None:
+        print(f"METRICS {router.metrics_port}", flush=True)
+    print(f"SERVING {router.port}", flush=True)
+
+    term = threading.Event()
+    try:
+        _signal.signal(_signal.SIGTERM, lambda *a: term.set())
+    except ValueError:                   # non-main thread (tests)
+        pass
+    try:
+        term.wait()
+        print("DRAINING", flush=True)
+        ok = router.drain(timeout=args.drain_timeout)
+        if sup is not None:
+            sup.stop(drain_timeout=args.drain_timeout)
+        print(f"DRAINED ok={ok}", flush=True)
+    except KeyboardInterrupt:
+        router.stop()
+        if sup is not None:
+            sup.stop(drain_timeout=2.0)
+    return 0
+
+
+if __name__ == "__main__":
+    from .serve import main
+    main(sys.argv[1:] + ["--router"])

@@ -36,6 +36,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import signal
 import socket
 import struct
 import threading
@@ -43,6 +44,10 @@ import time
 from concurrent.futures import TimeoutError as FuturesTimeout
 
 import numpy as np
+
+from ..testing import chaos
+from .errors import (ERR_DEADLINE_EXCEEDED, ERR_INVALID_ARGUMENT,
+                     TypedServeError)
 
 MAGIC = 0x31494450          # 'PDI1'
 ERR = 0xFFFFFFFF
@@ -141,6 +146,48 @@ def write_error(sock, msg: str):
     sock.sendall(struct.pack("<III", MAGIC, ERR, len(m)) + m)
 
 
+def read_reply(sock, max_bytes=None):
+    """Decode one REPLY frame: ``(arrays, None)`` for a tensor reply,
+    ``(None, message)`` for an error frame. The router (and any Python
+    client) needs this because ``read_tensors`` treats the error marker
+    as a hostile tensor count. Same size validation as ``read_tensors``.
+    """
+    if max_bytes is None:
+        max_bytes = max_request_bytes()
+    magic, n = struct.unpack("<II", _recv_exact(sock, 8))
+    if magic != MAGIC:
+        raise ValueError("bad magic in reply")
+    if n == ERR:
+        (mlen,) = struct.unpack("<I", _recv_exact(sock, 4))
+        if mlen > 65536:
+            raise ValueError(f"error frame claims {mlen} bytes")
+        return None, _recv_exact(sock, mlen).decode("utf-8", "replace")
+    if n > _MAX_TENSORS:
+        raise ValueError(f"reply claims {n} tensors (cap {_MAX_TENSORS})")
+    out, total = [], 0
+    for _ in range(n):
+        dt, nd = struct.unpack("<BB", _recv_exact(sock, 2))
+        if dt >= len(_DTYPES):
+            raise IndexError(f"bad dtype code {dt}")
+        if nd > _MAX_NDIM:
+            raise ValueError(f"tensor ndim {nd} exceeds cap {_MAX_NDIM}")
+        shape = struct.unpack(f"<{nd}q", _recv_exact(sock, 8 * nd)) \
+            if nd else ()
+        if any(d < 0 for d in shape):
+            raise ValueError(f"negative dim in shape {shape}")
+        dtype = np.dtype(_DTYPES[dt])
+        count = 1
+        for d in shape:
+            count *= d
+        nbytes = count * dtype.itemsize
+        total += nbytes
+        if total > max_bytes:
+            raise ValueError(f"reply exceeds {max_bytes} bytes")
+        data = _recv_exact(sock, nbytes)
+        out.append(np.frombuffer(data, dtype, count).reshape(shape).copy())
+    return out, None
+
+
 def _idle_timeout_default() -> float:
     try:
         return float(os.environ.get("PADDLE_TPU_SERVE_IDLE_TIMEOUT", "600"))
@@ -188,7 +235,8 @@ class InferenceServer:
                  batch_timeout_ms: float = 2.0, pool_size: int = 1,
                  warmup: bool = False, idle_timeout: float = None,
                  stats_interval: float = 0.0, request_timeout: float = None,
-                 trailing: str = None, metrics_port: int = None):
+                 trailing: str = None, metrics_port: int = None,
+                 max_queue: int = None):
         # loopback by default: the daemon is unauthenticated — exposing a
         # model to the network segment must be an explicit --host choice
         from . import Config, PredictorPool, create_predictor
@@ -208,7 +256,8 @@ class InferenceServer:
             self._predictor = pool.retrieve(0)
             self._batcher = DynamicBatcher(
                 pool, max_batch_size=int(max_batch_size),
-                batch_timeout_ms=batch_timeout_ms, trailing=trailing)
+                batch_timeout_ms=batch_timeout_ms, trailing=trailing,
+                max_queue=max_queue)
             if warmup:
                 self.warmup_compiles = self._batcher.warmup()
         else:
@@ -225,6 +274,9 @@ class InferenceServer:
         self.port = self._srv.getsockname()[1]
         self._t0 = time.monotonic()
         self._stop = threading.Event()
+        self._draining = threading.Event()
+        self._conn_inflight = 0      # requests read and not yet answered
+        self._conn_lock = threading.Lock()
         self._thread = threading.Thread(target=self._accept_loop,
                                         daemon=True)
         self._thread.start()
@@ -262,6 +314,10 @@ class InferenceServer:
         reasons = []
         if self._stop.is_set():
             reasons.append("server stopped")
+        elif self._draining.is_set():
+            # a draining backend finishes in-flight work but must take no
+            # new traffic: the router reads this as "route around me"
+            reasons.append("draining")
         elif not self._thread.is_alive():
             reasons.append("accept thread dead")
         if self._batcher is not None:
@@ -287,6 +343,8 @@ class InferenceServer:
             "engine": "batched" if self._batched else "serialized",
             "port": self.port,
             "metrics_port": self.metrics_port,
+            "draining": self._draining.is_set(),
+            "inflight_requests": self.inflight_requests,
             "uptime_s": round(time.monotonic() - self._t0, 3),
             "config": {
                 "idle_timeout_s": self._idle_timeout,
@@ -340,8 +398,9 @@ class InferenceServer:
                 # a wedged predictor/worker must not pin the connection
                 # thread forever; the future stays abandoned (the
                 # batcher delivers into it defensively) and the client
-                # gets an error frame instead of silence
-                err = RuntimeError(
+                # gets a typed error frame instead of silence
+                err = TypedServeError(
+                    ERR_DEADLINE_EXCEEDED,
                     f"request deadline exceeded "
                     f"({deadline:g}s in queue+execute; "
                     f"PADDLE_TPU_SERVE_REQUEST_TIMEOUT)")
@@ -360,36 +419,97 @@ class InferenceServer:
         try:
             while True:
                 try:
+                    chaos.maybe_fail("serve.conn.read")
                     inputs = read_tensors(conn)
-                except (ConnectionError, TimeoutError, struct.error):
+                except (ConnectionError, TimeoutError, struct.error,
+                        OSError):
                     return
                 except (ValueError, IndexError) as e:
                     # unparseable request (bad magic / dtype code /
                     # hostile sizes): the stream is desynced —
-                    # best-effort error frame, drop the connection
+                    # best-effort typed error frame, drop the connection
                     try:
-                        write_error(conn, f"malformed request: {e}")
+                        write_error(conn,
+                                    f"{ERR_INVALID_ARGUMENT}: malformed "
+                                    f"request: {e}")
                     except OSError:
                         pass
                     return
+                with self._conn_lock:
+                    self._conn_inflight += 1
                 try:
-                    outputs = self._run(inputs)
-                    write_tensors(conn, outputs)
-                except (ConnectionError, TimeoutError):
+                    try:
+                        outputs = self._run(inputs)
+                        chaos.maybe_fail("serve.conn.reply")
+                        write_tensors(conn, outputs)
+                    except (ConnectionError, TimeoutError):
+                        return
+                    except Exception as e:   # model-side error -> client
+                        if getattr(e, "code", None):
+                            msg = str(e)     # typed: frame leads with CODE
+                        else:
+                            msg = f"{type(e).__name__}: {e}"
+                        rid = getattr(e, "request_id", None)
+                        if rid:
+                            # the id a sampled span trace / stall dump
+                            # carries
+                            msg += f" [request_id={rid}]"
+                        write_error(conn, msg)
+                finally:
+                    with self._conn_lock:
+                        self._conn_inflight -= 1
+                if self._draining.is_set():
+                    # drained: the in-flight request was answered; a
+                    # keep-alive connection must not feed a retiring
+                    # backend more work
                     return
-                except Exception as e:   # model-side error -> client
-                    msg = f"{type(e).__name__}: {e}"
-                    rid = getattr(e, "request_id", None)
-                    if rid:
-                        # the id a sampled span trace / stall dump carries
-                        msg += f" [request_id={rid}]"
-                    write_error(conn, msg)
         finally:
             conn.close()
 
     def _stats_loop(self, interval: float):
         while not self._stop.wait(interval):
             print(self.stats_line(), flush=True)
+
+    # -- draining / lifecycle --------------------------------------------
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    @property
+    def inflight_requests(self) -> int:
+        """Requests read off a connection and not yet answered."""
+        with self._conn_lock:
+            return self._conn_inflight
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Graceful retirement (the SIGTERM path): stop accepting new
+        connections, flip /healthz to "draining" so the router routes
+        around this backend, answer every request already read off a
+        connection (result or typed error), then stop. Returns True when
+        everything in flight was answered inside ``timeout``.
+
+        Idle keep-alive connections are closed as soon as their current
+        request (if any) is answered; a client racing a request into the
+        closing socket sees a connection error, which the front router
+        converts into a failover, not a lost request."""
+        self._draining.set()
+        try:
+            self._srv.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._srv.close()
+        deadline = time.monotonic() + float(timeout)
+        drained = False
+        while time.monotonic() < deadline:
+            busy = self.inflight_requests > 0 or (
+                self._batcher is not None and self._batcher.inflight > 0)
+            if not busy:
+                drained = True
+                break
+            time.sleep(0.01)
+        self.stop()
+        return drained
 
     def stop(self):
         self._stop.set()
@@ -412,7 +532,10 @@ class InferenceServer:
 
 def main(argv=None):
     ap = argparse.ArgumentParser(description="paddle_tpu inference server")
-    ap.add_argument("model", help="jit.save artifact prefix")
+    ap.add_argument("model", nargs="?", default=None,
+                    help="jit.save artifact prefix (required unless "
+                         "--router runs over pre-started --backend "
+                         "daemons)")
     ap.add_argument("--port", type=int, default=9000)
     ap.add_argument("--host", default="127.0.0.1",
                     help="bind address (default loopback; 0.0.0.0 exposes "
@@ -453,7 +576,42 @@ def main(argv=None):
                     help="mount /metrics + /healthz + /statusz on this "
                          "port (0 = ephemeral; default off, or "
                          "PADDLE_TPU_METRICS_PORT)")
+    ap.add_argument("--drain-timeout", type=float, default=30.0,
+                    help="seconds SIGTERM waits for in-flight requests "
+                         "before hard stop")
+    ap.add_argument("--max-queue", type=int, default=None,
+                    help="admission watermark: queued requests past this "
+                         "are shed with a RESOURCE_EXHAUSTED frame "
+                         "instead of queueing unboundedly (default "
+                         "PADDLE_TPU_SERVE_MAX_QUEUE or off)")
+    ap.add_argument("--router", action="store_true",
+                    help="run the health-aware front router instead of a "
+                         "backend: load-balance the wire protocol across "
+                         "--backend daemons (or a --fleet it spawns from "
+                         "the model prefix) with circuit-breaker "
+                         "failover, load shedding and drain-aware "
+                         "routing (docs/fault_tolerance.md)")
+    ap.add_argument("--backend", action="append", default=[],
+                    metavar="HOST:PORT[:ADMIN_PORT]",
+                    help="(router) one backend serve daemon; repeatable. "
+                         "ADMIN_PORT enables /healthz-driven routing")
+    ap.add_argument("--fleet", type=int, default=0,
+                    help="(router) spawn this many backend daemons from "
+                         "the model prefix and supervise them "
+                         "(restart-with-backoff, warm compile cache)")
+    ap.add_argument("--poll-interval", type=float, default=0.5,
+                    help="(router) seconds between backend health polls")
+    ap.add_argument("--shed-watermark", type=int, default=64,
+                    help="(router) queue depth past which a backend "
+                         "counts as overloaded; when EVERY routable "
+                         "backend is past it, requests are shed with "
+                         "RESOURCE_EXHAUSTED")
     args = ap.parse_args(argv)
+    if args.router:
+        from .router import main_router
+        return main_router(args)
+    if not args.model:
+        ap.error("model prefix is required (or pass --router)")
     # honor JAX_PLATFORMS for the daemon: a TPU PJRT plugin outranks the
     # env var during backend registration, so an explicit config update is
     # the only way `JAX_PLATFORMS=cpu python -m ...serve` stays off-chip
@@ -469,14 +627,25 @@ def main(argv=None):
                           stats_interval=args.stats_interval,
                           request_timeout=args.request_timeout,
                           trailing=args.trailing,
-                          metrics_port=args.metrics_port)
+                          metrics_port=args.metrics_port,
+                          max_queue=args.max_queue)
     if args.warmup:
         print(f"WARMUP compiles={srv.warmup_compiles}", flush=True)
     if srv.metrics_port is not None:
         print(f"METRICS {srv.metrics_port}", flush=True)
     print(f"SERVING {srv.port}", flush=True)
+    # SIGTERM = graceful retirement: stop accepting, finish in-flight,
+    # exit 0 — the rolling-restart contract the router drains against
+    term = threading.Event()
     try:
-        threading.Event().wait()
+        signal.signal(signal.SIGTERM, lambda *a: term.set())
+    except ValueError:                   # non-main thread (tests)
+        pass
+    try:
+        term.wait()
+        print("DRAINING", flush=True)
+        ok = srv.drain(timeout=args.drain_timeout)
+        print(f"DRAINED ok={ok}", flush=True)
     except KeyboardInterrupt:
         srv.stop()
 

@@ -20,7 +20,12 @@ Spec grammar (';'-separated rules):
 
     site      dotted site name; '*' suffix wildcard matches a prefix
               ("ckpt.*"). Shipped sites: fs.put, ckpt.write,
-              ckpt.rename, store.req, step.fn.
+              ckpt.rename, store.req, step.fn, and the serving path:
+              serve.conn.read (before decoding a request),
+              serve.conn.reply (before writing the reply),
+              batcher.dispatch (dispatcher loop, per formed batch),
+              batcher.worker (pool worker, per batch),
+              router.forward (router, per backend attempt).
     calls     which hits fire, 1-based per site counter:
                 "3"        call #3 only
                 "1-4"      calls 1..4
@@ -29,7 +34,11 @@ Spec grammar (';'-separated rules):
                 "p0.3@7"   each call fails with prob 0.3, seeded RNG(7)
                            (seeded => the schedule is reproducible)
     ExcName   OSError | ConnectionError | ConnectionResetError |
-              BrokenPipeError | TimeoutError | RuntimeError | IOError
+              BrokenPipeError | TimeoutError | RuntimeError | IOError —
+              the site raises; or the action form ``Hang@<seconds>``,
+              which SLEEPS at the site instead of raising (wedged
+              dispatcher, black-holed reply, slow-loris writer — the
+              failure modes an exception cannot model).
 
 Schedules record every fired fault in `.fired` for assertions. Counters
 are per-schedule, so nesting `inject()` restarts the count.
@@ -40,6 +49,7 @@ import os
 import random
 import re
 import threading
+import time
 from typing import List, Optional
 
 __all__ = ["ChaosFault", "Rule", "Schedule", "inject", "maybe_fail",
@@ -64,12 +74,14 @@ class Rule:
     """One armed site: which calls fire and what they raise."""
 
     def __init__(self, site: str, calls=None, from_call: int = None,
-                 prob: float = None, seed: int = 0, exc=OSError):
+                 prob: float = None, seed: int = 0, exc=OSError,
+                 hang_s: float = None):
         self.site = site
         self.calls = set(calls) if calls else None
         self.from_call = from_call
         self.prob = prob
         self.exc = exc
+        self.hang_s = hang_s       # action rule: sleep instead of raise
         self._rng = random.Random(seed)
 
     def matches(self, site: str) -> bool:
@@ -95,16 +107,21 @@ class Rule:
             raise ValueError(
                 f"chaos rule {text!r}: want <site>:<calls>:<ExcName>")
         site, calls_s, exc_s = parts
-        exc = _EXC_REGISTRY.get(exc_s)
+        exc, hang_s = _EXC_REGISTRY.get(exc_s), None
         if exc is None:
-            raise ValueError(f"chaos rule {text!r}: unknown exception "
-                             f"{exc_s!r} (one of {sorted(_EXC_REGISTRY)})")
+            hm = re.fullmatch(r"[Hh]ang@([0-9.]+)", exc_s)
+            if hm is None:
+                raise ValueError(
+                    f"chaos rule {text!r}: unknown exception {exc_s!r} "
+                    f"(one of {sorted(_EXC_REGISTRY)} or Hang@<seconds>)")
+            hang_s = float(hm.group(1))
         m = re.fullmatch(r"p([0-9.]+)@(\d+)", calls_s)
         if m:
             return cls(site, prob=float(m.group(1)), seed=int(m.group(2)),
-                       exc=exc)
+                       exc=exc, hang_s=hang_s)
         if calls_s.endswith("+"):
-            return cls(site, from_call=int(calls_s[:-1]), exc=exc)
+            return cls(site, from_call=int(calls_s[:-1]), exc=exc,
+                       hang_s=hang_s)
         calls = set()
         for tok in calls_s.split(","):
             if "-" in tok:
@@ -112,7 +129,7 @@ class Rule:
                 calls.update(range(int(a), int(b) + 1))
             else:
                 calls.add(int(tok))
-        return cls(site, calls=calls, exc=exc)
+        return cls(site, calls=calls, exc=exc, hang_s=hang_s)
 
 
 class Schedule:
@@ -135,13 +152,22 @@ class Schedule:
         return cls(list(spec))    # iterable of Rules
 
     def hit(self, site: str, detail=None):
+        hangs = []
         with self._lock:
             n = self.counts.get(site, 0) + 1
             self.counts[site] = n
             for r in self.rules:
                 if r.matches(site) and r.should_fire(n):
+                    if r.hang_s is not None:
+                        # action rule: wedge the site (sleep OUTSIDE the
+                        # lock so other sites keep counting meanwhile)
+                        self.fired.append((site, n, f"Hang@{r.hang_s:g}"))
+                        hangs.append(r.hang_s)
+                        continue
                     self.fired.append((site, n, r.exc.__name__))
                     raise r.make_exc(site, n, detail)
+        for s in hangs:
+            time.sleep(s)
 
 
 _STACK: List[Schedule] = []

@@ -19,7 +19,8 @@ import time
 from typing import Callable, Iterable, Tuple, Type
 
 __all__ = ["DeadlineExceeded", "WatchdogTimeout", "backoff_delays",
-           "retry_call", "retry", "call_with_watchdog"]
+           "retry_call", "retry", "call_with_watchdog",
+           "RetryBudget", "CircuitBreaker"]
 
 
 class DeadlineExceeded(TimeoutError):
@@ -95,6 +96,131 @@ def retry(**policy):
         wrapped.__wrapped__ = fn
         return wrapped
     return deco
+
+
+class RetryBudget:
+    """Token-bucket retry budget: retries are a bounded FRACTION of real
+    traffic, not a per-request multiplier.
+
+    Per-request retry caps compose badly under fleet-wide failure — with
+    every backend down, N clients x R retries is an R-fold traffic
+    amplification aimed at whatever comes back up first. A budget makes
+    retries proportional: every primary attempt deposits ``ratio``
+    tokens (capped at ``cap``), every retry spends one, and when the
+    bucket is empty `try_spend` refuses — the caller fails fast with a
+    typed error instead of hammering. ``min_tokens`` seeds the bucket so
+    the first failures of a quiet process can still fail over.
+
+    Thread-safe; the serving router shares one budget across all
+    connection threads.
+    """
+
+    def __init__(self, ratio: float = 0.2, cap: float = 32.0,
+                 min_tokens: float = 4.0):
+        if ratio < 0:
+            raise ValueError("ratio must be >= 0")
+        self.ratio = float(ratio)
+        self.cap = float(cap)
+        self._tokens = min(float(min_tokens), self.cap)
+        self._lock = threading.Lock()
+        self.spent = 0           # granted retries
+        self.denied = 0          # refused retries (budget empty)
+
+    @property
+    def tokens(self) -> float:
+        with self._lock:
+            return self._tokens
+
+    def record_request(self, n: int = 1):
+        """Deposit for `n` primary attempts."""
+        with self._lock:
+            self._tokens = min(self.cap, self._tokens + self.ratio * n)
+
+    def try_spend(self, cost: float = 1.0) -> bool:
+        """Take one retry from the budget; False when exhausted."""
+        with self._lock:
+            if self._tokens >= cost:
+                self._tokens -= cost
+                self.spent += 1
+                return True
+            self.denied += 1
+            return False
+
+
+class CircuitBreaker:
+    """Per-dependency circuit breaker: closed -> open -> half-open.
+
+    `record_failure` trips the breaker OPEN after ``failure_threshold``
+    CONSECUTIVE failures; while open, `allow()` refuses instantly (the
+    caller skips the dependency without paying a connect timeout). After
+    ``reset_timeout`` seconds the breaker lets ONE probe through
+    (HALF_OPEN); the probe's `record_success` closes the breaker, its
+    `record_failure` re-opens it for another full timeout. A success in
+    CLOSED clears the consecutive-failure count.
+
+    ``clock`` is injectable (monotonic seconds) so state transitions are
+    unit-testable without sleeping. Thread-safe; `allow()` hands out the
+    half-open probe slot to exactly one caller.
+    """
+
+    CLOSED, HALF_OPEN, OPEN = "closed", "half_open", "open"
+
+    def __init__(self, failure_threshold: int = 3,
+                 reset_timeout: float = 5.0,
+                 clock: Callable[[], float] = time.monotonic):
+        if failure_threshold < 1:
+            raise ValueError("failure_threshold must be >= 1")
+        self.failure_threshold = int(failure_threshold)
+        self.reset_timeout = float(reset_timeout)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = self.CLOSED
+        self._failures = 0
+        self._opened_at = 0.0
+        self._probing = False
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            if self._state == self.OPEN and not self._probing and \
+                    self._clock() - self._opened_at >= self.reset_timeout:
+                return self.HALF_OPEN
+            return self._state
+
+    def allow(self) -> bool:
+        """May a request go to this dependency right now? In OPEN, the
+        first caller after the reset timeout gets the half-open probe
+        slot; everyone else keeps getting False until the probe
+        reports."""
+        with self._lock:
+            if self._state == self.HALF_OPEN:
+                # a probe is already in flight; everyone else waits for
+                # its verdict
+                return not self._probing
+            if self._state != self.OPEN:
+                return True
+            if self._probing:
+                return False
+            if self._clock() - self._opened_at >= self.reset_timeout:
+                self._state = self.HALF_OPEN
+                self._probing = True
+                return True
+            return False
+
+    def record_success(self):
+        with self._lock:
+            self._state = self.CLOSED
+            self._failures = 0
+            self._probing = False
+
+    def record_failure(self):
+        with self._lock:
+            self._failures += 1
+            if self._state == self.HALF_OPEN or \
+                    self._failures >= self.failure_threshold:
+                self._state = self.OPEN
+                self._opened_at = self._clock()
+                self._probing = False
 
 
 def call_with_watchdog(fn: Callable, timeout: float, what: str = "call",

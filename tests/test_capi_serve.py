@@ -381,6 +381,128 @@ def test_c_client_timeout_poisons_connection(tmp_path):
     assert "poisoned" in res.stdout
 
 
+def test_c_client_reconnect_recovers_poisoned_handle(served_model,
+                                                     tmp_path):
+    """PD_PredictorReconnect is the recovery half of poisoning: a chaos
+    hang on the server's reply path times out the first round trip
+    (poisoning the handle), the second run fails fast, and a reconnect
+    on the SAME handle re-dials and serves real answers again."""
+    from paddle_tpu.testing import chaos
+
+    prefix, srv = served_model
+    x = np.random.default_rng(9).normal(size=(2, 8)).astype(np.float32)
+    expect = _py_logits(prefix, x)
+
+    main_c = tmp_path / "rec.c"
+    main_c.write_text(textwrap.dedent("""
+        #include <stdio.h>
+        #include <stdlib.h>
+        #include <string.h>
+        #include "paddle_c_api.h"
+        int main(int argc, char** argv) {
+          PD_Predictor* p = PD_PredictorConnect("127.0.0.1",
+                                                atoi(argv[1]));
+          if (!p) return 2;
+          PD_PredictorSetTimeout(p, 0.3);
+          float data[16];
+          for (int i = 0; i < 16; ++i) data[i] = atof(argv[2 + i]);
+          int64_t shape[2] = {2, 8};
+          PD_Tensor in = {PD_FLOAT32, 2, shape, data};
+          PD_Tensor* outs; int n_out;
+          /* 1: server reply is chaos-hung past our timeout -> poison */
+          if (PD_PredictorRun(p, &in, 1, &outs, &n_out) == 0) return 3;
+          /* 2: poisoned handle fails fast */
+          if (PD_PredictorRun(p, &in, 1, &outs, &n_out) == 0) return 4;
+          if (!strstr(PD_GetLastError(), "poisoned")) return 5;
+          /* 3: reconnect in place, same handle serves again */
+          if (PD_PredictorReconnect(p) != 0) {
+            fprintf(stderr, "%s\\n", PD_GetLastError()); return 6;
+          }
+          if (PD_PredictorRun(p, &in, 1, &outs, &n_out) != 0) {
+            fprintf(stderr, "%s\\n", PD_GetLastError()); return 7;
+          }
+          for (int64_t j = 0; j < PD_TensorNumel(&outs[0]); ++j)
+            printf("%.6f ", ((float*)outs[0].data)[j]);
+          PD_FreeTensors(outs, n_out);
+          PD_PredictorDelete(p);
+          return 0;
+        }
+    """))
+    exe = str(tmp_path / "rec")
+    subprocess.run(["gcc", "-I", CAPI_DIR, "-o", exe, str(main_c),
+                    os.path.join(CAPI_DIR, "paddle_c_api.c")],
+                   check=True, capture_output=True, text=True)
+    # the chaos stack is process-global, so the in-process server's
+    # connection threads see this schedule: first reply hangs 2s (past
+    # the client's 0.3s timeout), later replies are untouched
+    with chaos.inject("serve.conn.reply:1:Hang@2.0") as sched:
+        res = subprocess.run(
+            [exe, str(srv.port), *[f"{v:.8f}" for v in x.ravel()]],
+            capture_output=True, text=True, timeout=60)
+    assert res.returncode == 0, (res.returncode, res.stdout, res.stderr)
+    assert ("serve.conn.reply", 1, "Hang@2") in sched.fired
+    got = np.asarray([float(t) for t in res.stdout.split()],
+                     np.float32).reshape(expect.shape)
+    np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_c_client_reconnect_fails_cleanly_when_daemon_gone(tmp_path):
+    """Reconnect against a dead endpoint returns -1 and leaves the
+    handle poisoned (callers may keep retrying)."""
+    lst = socket.socket()
+    lst.bind(("127.0.0.1", 0))
+    lst.listen(1)
+    port = lst.getsockname()[1]
+
+    main_c = tmp_path / "gone.c"
+    main_c.write_text(textwrap.dedent("""
+        #include <stdio.h>
+        #include <stdlib.h>
+        #include <string.h>
+        #include "paddle_c_api.h"
+        int main(int argc, char** argv) {
+          PD_Predictor* p = PD_PredictorConnect("127.0.0.1",
+                                                atoi(argv[1]));
+          if (!p) return 2;
+          PD_PredictorSetTimeout(p, 0.3);
+          float data[8] = {0};
+          int64_t shape[2] = {1, 8};
+          PD_Tensor in = {PD_FLOAT32, 2, shape, data};
+          PD_Tensor* outs; int n_out;
+          /* black-hole listener: times out, poisons */
+          if (PD_PredictorRun(p, &in, 1, &outs, &n_out) == 0) return 3;
+          /* parent closed the listener before signalling us via stdin */
+          char buf[4];
+          if (!fgets(buf, sizeof(buf), stdin)) return 4;
+          if (PD_PredictorReconnect(p) == 0) return 5;
+          /* handle unchanged: still poisoned, still fails fast */
+          if (PD_PredictorRun(p, &in, 1, &outs, &n_out) == 0) return 6;
+          if (!strstr(PD_GetLastError(), "poisoned")) return 7;
+          printf("STILL_POISONED");
+          PD_PredictorDelete(p);
+          return 0;
+        }
+    """))
+    exe = str(tmp_path / "gone")
+    subprocess.run(["gcc", "-I", CAPI_DIR, "-o", exe, str(main_c),
+                    os.path.join(CAPI_DIR, "paddle_c_api.c")],
+                   check=True, capture_output=True, text=True)
+    proc = subprocess.Popen([exe, str(port)], stdin=subprocess.PIPE,
+                            stdout=subprocess.PIPE, text=True)
+    try:
+        conn, _ = lst.accept()          # let the first run time out
+        import time
+        time.sleep(0.5)
+        conn.close()
+        lst.close()                     # endpoint now dead
+        out, _ = proc.communicate("go\n", timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert proc.returncode == 0, (proc.returncode, out)
+    assert out == "STILL_POISONED"
+
+
 def test_c_client_connect_refused(tmp_path):
     # find a dead port
     s = socket.socket()

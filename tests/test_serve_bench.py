@@ -37,3 +37,35 @@ def test_serve_bench_emits_json_contract():
     assert out["warmup_compiles"] >= 1
     assert 0 < out["batch_occupancy"] <= 1.0
     assert 0 <= out["padding_waste"] < 1.0
+
+
+@pytest.mark.slow
+def test_serve_bench_router_fleet_kill_one_zero_lost():
+    """--router N --kill-one: one backend dies mid-run and the fleet
+    still completes every request (the scored zero-lost contract)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    res = subprocess.run(
+        [sys.executable, BENCH, "--router", "3", "--requests", "120",
+         "--clients", "6", "--max-batch", "8",
+         "--batch-timeout-ms", "2.0", "--kill-one"],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert res.returncode == 0, res.stderr
+    out = json.loads(res.stdout.strip().splitlines()[-1])
+    assert out["metric"] == "serve_router_fleet"
+    assert "error" not in out, out
+    for key in ("value", "unit", "vs_baseline", "fleet", "clients",
+                "completed", "lost_requests", "killed_backend",
+                "failovers", "failover_p95_ms", "p50_latency_ms",
+                "p95_latency_ms", "p99_latency_ms", "router_metrics"):
+        assert key in out, key
+    assert out["fleet"] == 3
+    assert out["completed"] == 120
+    assert out["lost_requests"] == 0, out["lost_detail"]
+    assert out["killed_backend"]          # the kill actually happened
+    assert out["vs_baseline"] == 1.0      # zero-lost contract met
+    # the killed backend must be marked down in the router's gauges
+    up = {k: v for k, v in out["router_metrics"].items()
+          if k.startswith("paddle_tpu_router_backend_up")}
+    assert up[f'paddle_tpu_router_backend_up{{backend="'
+              f'{out["killed_backend"]}"}}'] == 0.0
+    assert sum(up.values()) == 2.0        # the other two stayed up

@@ -1,0 +1,569 @@
+"""Resilient serve fleet (inference/router.py + hardened batching/serve):
+circuit breaker and retry-budget primitives, dispatcher/worker death
+recovery, load shedding, drain semantics, and the router's failover
+path under deterministic chaos — including the acceptance drill: kill
+one of three backends mid-batch and lose zero requests.
+"""
+import os
+import signal
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.inference import Config, Predictor, create_predictor
+from paddle_tpu.inference.batching import DynamicBatcher
+from paddle_tpu.inference.errors import (ERR_RESOURCE_EXHAUSTED,
+                                         ERR_UNAVAILABLE, TypedServeError,
+                                         error_code)
+from paddle_tpu.inference.router import (Backend, ServeRouter,
+                                         parse_backend)
+from paddle_tpu.inference.serve import (InferenceServer, read_reply,
+                                        write_tensors)
+from paddle_tpu.static import InputSpec
+from paddle_tpu.testing import chaos
+from paddle_tpu.utils.retry import CircuitBreaker, RetryBudget
+
+
+class SmallNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 4)
+
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+        return self.fc2(F.relu(self.fc1(x)))
+
+
+@pytest.fixture(scope="module")
+def mlp_prefix(tmp_path_factory):
+    paddle.seed(21)
+    prefix = str(tmp_path_factory.mktemp("chaos_m") / "net")
+    paddle.jit.save(SmallNet(), prefix,
+                    input_spec=[InputSpec([None, 8], "float32")])
+    return prefix
+
+
+def _py_logits(prefix, x):
+    return create_predictor(Config(prefix)).run([x])[0]
+
+
+def _ask(port, x, timeout=30.0):
+    """One wire round trip against a serve daemon or router."""
+    with socket.create_connection(("127.0.0.1", port)) as s:
+        s.settimeout(timeout)
+        write_tensors(s, [x])
+        return read_reply(s)
+
+
+# -- retry primitives ----------------------------------------------------
+
+def test_circuit_breaker_state_machine():
+    t = [0.0]
+    br = CircuitBreaker(failure_threshold=3, reset_timeout=5.0,
+                        clock=lambda: t[0])
+    assert br.state == CircuitBreaker.CLOSED and br.allow()
+    br.record_failure()
+    br.record_failure()
+    assert br.state == CircuitBreaker.CLOSED   # not yet at threshold
+    br.record_success()                        # success clears the count
+    br.record_failure()
+    br.record_failure()
+    br.record_failure()
+    assert br.state == CircuitBreaker.OPEN
+    assert not br.allow()                      # open: refuse instantly
+    t[0] = 5.1                                 # reset timeout elapses
+    assert br.state == CircuitBreaker.HALF_OPEN
+    assert br.allow()                          # the probe slot
+    br.record_failure()                        # probe failed
+    assert br.state == CircuitBreaker.OPEN
+    assert not br.allow()                      # full timeout again
+    t[0] = 10.3
+    assert br.allow()
+    br.record_success()                        # probe succeeded
+    assert br.state == CircuitBreaker.CLOSED and br.allow()
+
+
+def test_circuit_breaker_hands_out_one_probe_slot():
+    t = [0.0]
+    br = CircuitBreaker(failure_threshold=1, reset_timeout=1.0,
+                        clock=lambda: t[0])
+    br.record_failure()
+    t[0] = 1.5
+    assert br.allow()           # first caller gets the half-open probe
+    assert not br.allow()       # everyone else keeps waiting
+    assert not br.allow()
+    br.record_success()
+    assert br.allow()
+
+
+def test_retry_budget_accounting():
+    b = RetryBudget(ratio=0.5, cap=4.0, min_tokens=2.0)
+    assert b.tokens == 2.0
+    assert b.try_spend() and b.try_spend()     # seed tokens
+    assert not b.try_spend()                   # empty: denied
+    assert b.spent == 2 and b.denied == 1
+    b.record_request(4)                        # 4 * 0.5 = 2 tokens back
+    assert b.try_spend()
+    b.record_request(100)                      # capped at 4.0
+    assert b.tokens == 4.0
+    zero = RetryBudget(ratio=0.0, cap=1.0, min_tokens=0.0)
+    assert not zero.try_spend()
+
+
+def test_chaos_hang_rule_parses_and_sleeps():
+    r = chaos.Rule.parse("x.y:1:Hang@0.2")
+    assert r.hang_s == pytest.approx(0.2) and r.exc is None
+    with chaos.inject("x.y:1:Hang@0.2") as sched:
+        t0 = time.perf_counter()
+        chaos.maybe_fail("x.y")                # sleeps, does not raise
+        assert time.perf_counter() - t0 >= 0.18
+        chaos.maybe_fail("x.y")                # only call #1 is armed
+    assert ("x.y", 1, "Hang@0.2") in sched.fired
+    with pytest.raises(ValueError):
+        chaos.Rule.parse("x.y:1:NoSuchExc")
+
+
+def test_parse_backend_specs():
+    b = parse_backend("10.0.0.2:9000")
+    assert (b.host, b.port, b.admin_port) == ("10.0.0.2", 9000, None)
+    b = parse_backend("10.0.0.2:9000:9100")
+    assert (b.host, b.port, b.admin_port) == ("10.0.0.2", 9000, 9100)
+    with pytest.raises(ValueError):
+        parse_backend("no-port-here")
+
+
+# -- batcher death / shed / respawn --------------------------------------
+
+def test_dispatcher_death_fails_queued_and_future_requests(mlp_prefix):
+    pred = Predictor(Config(mlp_prefix))
+    b = DynamicBatcher(pred, max_batch_size=8, batch_timeout_ms=5.0)
+    try:
+        with chaos.inject("batcher.dispatch:1:RuntimeError"):
+            fut = b.submit([np.ones((2, 8), np.float32)])
+            with pytest.raises(TypedServeError) as ei:
+                fut.result(timeout=10)
+        assert ei.value.code == ERR_UNAVAILABLE
+        assert "dispatcher died" in str(ei.value)
+        # the engine is now dead for good: later submits fail FAST with
+        # the same typed code instead of waiting out a deadline
+        t0 = time.perf_counter()
+        fut2 = b.submit([np.ones((1, 8), np.float32)])
+        with pytest.raises(TypedServeError) as ei2:
+            fut2.result(timeout=10)
+        assert time.perf_counter() - t0 < 1.0
+        assert ei2.value.code == ERR_UNAVAILABLE
+        assert not b.dispatcher_alive
+    finally:
+        b.stop()
+
+
+def test_worker_crash_respawns_with_counter(mlp_prefix):
+    # worker threads only exist in the multi-predictor pool layout; a
+    # single predictor executes inside the dispatcher thread
+    from paddle_tpu.inference import PredictorPool
+    pool = PredictorPool(Config(mlp_prefix), size=2, devices="auto")
+    b = DynamicBatcher(pool, max_batch_size=4, batch_timeout_ms=2.0)
+    try:
+        with chaos.inject("batcher.worker:1:RuntimeError"):
+            fut = b.submit([np.ones((1, 8), np.float32)])
+            with pytest.raises(TypedServeError) as ei:
+                fut.result(timeout=10)
+            assert ei.value.code == ERR_UNAVAILABLE
+            assert "worker crashed" in str(ei.value)
+        deadline = time.monotonic() + 10
+        while b.worker_restarts < 1 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert b.worker_restarts == 1 and b.workers_alive
+        # the respawned worker serves the next request
+        x = np.ones((2, 8), np.float32)
+        out = b.submit([x]).result(timeout=30)
+        np.testing.assert_allclose(out[0], _py_logits(mlp_prefix, x),
+                                   rtol=1e-5)
+    finally:
+        b.stop()
+
+
+def test_queue_watermark_sheds_typed(mlp_prefix):
+    pred = Predictor(Config(mlp_prefix))
+    # long formation window so the first request is still queued when
+    # the second arrives over the watermark
+    b = DynamicBatcher(pred, max_batch_size=8, batch_timeout_ms=400.0,
+                       max_queue=1)
+    try:
+        fut1 = b.submit([np.ones((2, 8), np.float32)])
+        with pytest.raises(TypedServeError) as ei:
+            b.submit([np.ones((1, 8), np.float32)]).result(timeout=5)
+        assert ei.value.code == ERR_RESOURCE_EXHAUSTED
+        assert "watermark" in str(ei.value)
+        assert b.submit is not None and fut1.result(timeout=30)
+    finally:
+        b.stop()
+
+
+def test_stopped_batcher_errors_are_typed(mlp_prefix):
+    pred = Predictor(Config(mlp_prefix))
+    b = DynamicBatcher(pred, max_batch_size=4, batch_timeout_ms=2.0)
+    b.stop()
+    with pytest.raises(TypedServeError) as ei:
+        b.submit([np.ones((1, 8), np.float32)]).result(timeout=5)
+    assert ei.value.code == ERR_UNAVAILABLE
+
+
+# -- router: routing, failover, shedding, draining -----------------------
+
+def _start_backend(prefix, **kw):
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("batch_timeout_ms", 2.0)
+    kw.setdefault("metrics_port", 0)
+    return InferenceServer(prefix, port=0, **kw)
+
+
+def test_router_roundtrip_and_relayed_model_error(mlp_prefix):
+    srv = _start_backend(mlp_prefix)
+    router = ServeRouter([Backend("127.0.0.1", srv.port, srv.metrics_port)],
+                         port=0, poll_interval=0.1)
+    try:
+        x = np.random.default_rng(3).normal(size=(2, 8)).astype(np.float32)
+        out, err = _ask(router.port, x)
+        assert err is None
+        np.testing.assert_allclose(out[0], _py_logits(mlp_prefix, x),
+                                   rtol=1e-5)
+        # a deterministic model error is relayed verbatim, NOT failed over
+        out, err = _ask(router.port, np.ones((2, 5), np.float32))
+        assert out is None and err
+        b = router.backends()[0]
+        assert b.breaker.state == CircuitBreaker.CLOSED
+    finally:
+        router.stop()
+        srv.stop()
+
+
+def test_router_failover_on_abrupt_backend_kill(mlp_prefix):
+    """Kill one of three backends without warning: every request still
+    answers, and the health poll marks the corpse down within one poll
+    interval."""
+    srvs = [_start_backend(mlp_prefix) for _ in range(3)]
+    backs = [Backend("127.0.0.1", s.port, s.metrics_port) for s in srvs]
+    router = ServeRouter(backs, port=0, poll_interval=0.1)
+    try:
+        x = np.ones((2, 8), np.float32)
+        expect = _py_logits(mlp_prefix, x)
+        out, err = _ask(router.port, x)
+        assert err is None
+        srvs[0].stop()                         # abrupt: no drain
+        lost = []
+        for _ in range(30):
+            out, err = _ask(router.port, x)
+            if err is not None:
+                lost.append(err)
+            else:
+                np.testing.assert_allclose(out[0], expect, rtol=1e-5)
+        assert not lost, lost
+        time.sleep(0.4)                        # > one poll interval
+        dead = next(b for b in router.backends()
+                    if b.port == srvs[0].port)
+        assert not dead.healthy
+        ok, reasons = router._health()         # router itself stays green
+        assert ok, reasons
+    finally:
+        router.stop()
+        for s in srvs:
+            s.stop()
+
+
+def test_router_routes_around_draining_backend(mlp_prefix):
+    srvs = [_start_backend(mlp_prefix) for _ in range(2)]
+    backs = [Backend("127.0.0.1", s.port, s.metrics_port) for s in srvs]
+    router = ServeRouter(backs, port=0, poll_interval=0.1)
+    try:
+        x = np.ones((1, 8), np.float32)
+        assert _ask(router.port, x)[1] is None
+        t = threading.Thread(target=srvs[0].drain, kwargs={"timeout": 5},
+                             daemon=True)
+        t.start()
+        deadline = time.monotonic() + 3
+        drained_backend = next(b for b in router.backends()
+                               if b.port == srvs[0].port)
+        while time.monotonic() < deadline and not drained_backend.draining:
+            time.sleep(0.03)
+        assert drained_backend.draining or not drained_backend.healthy
+        for _ in range(10):                   # all traffic lands on srv 1
+            out, err = _ask(router.port, x)
+            assert err is None
+        t.join(timeout=10)
+    finally:
+        router.stop()
+        for s in srvs:
+            s.stop()
+
+
+def test_router_all_backends_down_is_fast_typed_unavailable(mlp_prefix):
+    srv = _start_backend(mlp_prefix)
+    router = ServeRouter([Backend("127.0.0.1", srv.port, srv.metrics_port)],
+                         port=0, poll_interval=0.05)
+    try:
+        srv.stop()
+        time.sleep(0.3)                        # poll marks it down
+        t0 = time.perf_counter()
+        out, err = _ask(router.port, np.ones((1, 8), np.float32))
+        dt = time.perf_counter() - t0
+        assert out is None and error_code(err) == ERR_UNAVAILABLE
+        assert dt < 2.0                        # fail fast, no timeout wait
+    finally:
+        router.stop()
+
+
+def test_router_sheds_when_every_backend_past_watermark():
+    # a bare listener stands in for a busy backend: the dial probe says
+    # healthy, and we pin the polled queue depth over the watermark
+    lst = socket.socket()
+    lst.bind(("127.0.0.1", 0))
+    lst.listen(8)
+    back = Backend("127.0.0.1", lst.getsockname()[1])
+    back.queue_depth = 100
+    router = ServeRouter([back], port=0, poll_interval=30.0,
+                         shed_watermark=10)
+    try:
+        t0 = time.perf_counter()
+        out, err = _ask(router.port, np.ones((1, 8), np.float32))
+        assert out is None
+        assert error_code(err) == ERR_RESOURCE_EXHAUSTED
+        assert "watermark" in err
+        assert time.perf_counter() - t0 < 1.0   # shed is instant
+    finally:
+        router.stop()
+        lst.close()
+
+
+def test_router_breaker_opens_on_repeated_wire_failures():
+    """A backend that accepts and instantly closes trips its breaker
+    OPEN after failure_threshold wire failures; afterwards the router
+    refuses instantly instead of dialing the corpse."""
+    lst = socket.socket()
+    lst.bind(("127.0.0.1", 0))
+    lst.listen(8)
+    stop = threading.Event()
+
+    def slammer():
+        while not stop.is_set():
+            try:
+                c, _ = lst.accept()
+                c.close()
+            except OSError:
+                return
+
+    threading.Thread(target=slammer, daemon=True).start()
+    back = Backend("127.0.0.1", lst.getsockname()[1],
+                   breaker=CircuitBreaker(failure_threshold=3,
+                                          reset_timeout=60.0))
+    router = ServeRouter([back], port=0, poll_interval=30.0,
+                         failover_retries=0)
+    try:
+        x = np.ones((1, 8), np.float32)
+        for _ in range(3):
+            out, err = _ask(router.port, x)
+            assert error_code(err) == ERR_UNAVAILABLE
+        assert back.breaker.state == CircuitBreaker.OPEN
+        out, err = _ask(router.port, x)
+        assert error_code(err) == ERR_UNAVAILABLE
+        assert "no routable backend" in err or "circuit" in err
+    finally:
+        stop.set()
+        router.stop()
+        lst.close()
+
+
+def test_router_retry_budget_denies_failover_storm():
+    lst = socket.socket()
+    lst.bind(("127.0.0.1", 0))
+    lst.listen(8)
+    stop = threading.Event()
+
+    def slammer():
+        while not stop.is_set():
+            try:
+                c, _ = lst.accept()
+                c.close()
+            except OSError:
+                return
+
+    threading.Thread(target=slammer, daemon=True).start()
+    port = lst.getsockname()[1]
+    backs = [Backend("127.0.0.1", port),
+             Backend("localhost", port)]       # distinct keys, same corpse
+    router = ServeRouter(backs, port=0, poll_interval=30.0,
+                         retry_budget=RetryBudget(ratio=0.0, cap=1.0,
+                                                  min_tokens=0.0))
+    try:
+        out, err = _ask(router.port, np.ones((1, 8), np.float32))
+        assert error_code(err) == ERR_UNAVAILABLE
+        assert "retry budget exhausted" in err
+        assert router._budget.denied >= 1
+    finally:
+        stop.set()
+        router.stop()
+        lst.close()
+
+
+def test_backend_drain_completes_inflight_reply(mlp_prefix):
+    """SIGTERM semantics in-process: drain() while a reply is chaos-hung
+    still answers the in-flight request before the listener dies."""
+    srv = InferenceServer(mlp_prefix, port=0)   # serialized engine
+    x = np.ones((2, 8), np.float32)
+    expect = _py_logits(mlp_prefix, x)
+    result = {}
+
+    def client():
+        result["reply"] = _ask(srv.port, x, timeout=15)
+
+    with chaos.inject("serve.conn.reply:1:Hang@0.5") as sched:
+        t = threading.Thread(target=client, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 5
+        while srv.inflight_requests == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert srv.inflight_requests == 1      # mid-flight, reply hung
+        assert srv.drain(timeout=10)           # waits out the hang
+        t.join(timeout=10)
+    assert ("serve.conn.reply", 1, "Hang@0.5") in sched.fired
+    out, err = result["reply"]
+    assert err is None
+    np.testing.assert_allclose(out[0], expect, rtol=1e-5)
+    with pytest.raises(OSError):
+        socket.create_connection(("127.0.0.1", srv.port), timeout=1)
+
+
+def test_router_drain_answers_inflight(mlp_prefix):
+    srv = _start_backend(mlp_prefix)
+    router = ServeRouter([Backend("127.0.0.1", srv.port, srv.metrics_port)],
+                         port=0, poll_interval=0.1)
+    try:
+        x = np.ones((1, 8), np.float32)
+        result = {}
+
+        def client():
+            result["reply"] = _ask(router.port, x, timeout=15)
+
+        with chaos.inject("router.forward:1:Hang@0.4"):
+            t = threading.Thread(target=client, daemon=True)
+            t.start()
+            deadline = time.monotonic() + 5
+            while router.inflight_requests == 0 \
+                    and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert router.drain(timeout=10)
+            t.join(timeout=10)
+        out, err = result["reply"]
+        assert err is None
+    finally:
+        router.stop()
+        srv.stop()
+
+
+# -- the acceptance drill ------------------------------------------------
+
+def test_fleet_drill_kill_one_of_three_zero_lost(mlp_prefix):
+    """ISSUE acceptance: 3 batched backends behind the router, constant
+    client pressure, one backend killed abruptly mid-batch — zero
+    requests lost (every client gets its correct answer), and the
+    router's books balance."""
+    srvs = [_start_backend(mlp_prefix, max_batch_size=4,
+                           batch_timeout_ms=5.0) for _ in range(3)]
+    backs = [Backend("127.0.0.1", s.port, s.metrics_port) for s in srvs]
+    router = ServeRouter(backs, port=0, poll_interval=0.1)
+    n_threads, n_reqs = 6, 20
+    rng = np.random.default_rng(5)
+    xs = [rng.normal(size=(1 + i % 3, 8)).astype(np.float32)
+          for i in range(n_threads)]
+    expects = [_py_logits(mlp_prefix, x) for x in xs]
+    failures = []
+    done = [0] * n_threads
+
+    def client(i):
+        try:
+            with socket.create_connection(
+                    ("127.0.0.1", router.port)) as s:
+                s.settimeout(30)
+                for _ in range(n_reqs):
+                    write_tensors(s, [xs[i]])
+                    out, err = read_reply(s)
+                    if err is not None:
+                        failures.append((i, err))
+                        return
+                    np.testing.assert_allclose(out[0], expects[i],
+                                               rtol=1e-4, atol=1e-5)
+                    done[i] += 1
+        except Exception as e:
+            failures.append((i, repr(e)))
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    time.sleep(0.25)                   # let traffic reach steady state
+    srvs[1].stop()                     # mid-batch, no drain, no warning
+    for t in threads:
+        t.join(timeout=120)
+    assert not failures, failures[:5]
+    assert done == [n_reqs] * n_threads
+    # the killed backend is down in the routing table, the rest serve
+    dead = next(b for b in router.backends() if b.port == srvs[1].port)
+    assert not dead.healthy or dead.breaker.state != CircuitBreaker.CLOSED
+    router.stop()
+    for s in srvs:
+        s.stop()
+
+
+# -- process-level drill (slow) ------------------------------------------
+
+@pytest.mark.slow
+def test_sigterm_drains_subprocess_daemon(mlp_prefix):
+    """Real-process drain: SIGTERM a serve daemon while its reply is
+    chaos-hung; the in-flight client still gets its answer, the daemon
+    logs DRAINING/DRAINED ok=True and exits 0."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PADDLE_TPU_CHAOS"] = "serve.conn.reply:1:Hang@1.5"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.inference.serve", mlp_prefix,
+         "--port", "0", "--max-batch", "0", "--stats-interval", "0"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env)
+    try:
+        port = None
+        for line in proc.stdout:
+            if line.startswith("SERVING "):
+                port = int(line.split()[1])
+                break
+        assert port, "daemon never announced SERVING"
+        x = np.ones((2, 8), np.float32)
+        expect = _py_logits(mlp_prefix, x)
+        result = {}
+
+        def client():
+            result["reply"] = _ask(port, x, timeout=30)
+
+        t = threading.Thread(target=client, daemon=True)
+        t.start()
+        time.sleep(0.5)                # request read, reply hung
+        proc.send_signal(signal.SIGTERM)
+        t.join(timeout=30)
+        out, err = result["reply"]
+        assert err is None
+        np.testing.assert_allclose(out[0], expect, rtol=1e-4, atol=1e-5)
+        rest = proc.stdout.read()
+        assert proc.wait(timeout=30) == 0
+        assert "DRAINING" in rest and "DRAINED ok=True" in rest
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
