@@ -167,7 +167,16 @@ def run_router_bench(args):
     over the wire by concurrent clients. With ``--kill-one`` a backend
     is stopped abruptly mid-run — the contract under test is ZERO lost
     requests (every client gets a tensor reply for every request) with
-    the failover cost reported from the router's own histograms."""
+    the failover cost reported from the router's own histograms.
+
+    With ``PADDLE_TPU_TRACE_SAMPLE`` set (e.g. 1), every routed request
+    is assembled into a JSONL trace line (router pick/forward/reply +
+    the backend's relayed breakdown); the bench captures them to a temp
+    file (unless ``PADDLE_TPU_TRACE_FILE`` already points somewhere),
+    and reports the assembled-trace count, the router-vs-backend
+    latency epsilon, and the request-id collision count (contract: 0).
+    A ``metrics_delta`` section shows exactly which router/serve
+    counters the run moved."""
     import socket
     import threading
 
@@ -194,6 +203,15 @@ def run_router_bench(args):
     paddle.jit.save(MLP(), prefix,
                     input_spec=[InputSpec([None, 64], "float32")])
 
+    # trace capture: recorders read the env at construction, so the
+    # sink must be decided before any server/router exists
+    trace_path = os.environ.get("PADDLE_TPU_TRACE_FILE") or None
+    if os.environ.get("PADDLE_TPU_TRACE_SAMPLE") and trace_path is None:
+        trace_path = os.path.join(
+            tempfile.mkdtemp(prefix="serve_bench_trace_"),
+            "traces.jsonl")
+        os.environ["PADDLE_TPU_TRACE_FILE"] = trace_path
+
     srvs = [InferenceServer(prefix, port=0, max_batch_size=args.max_batch,
                             batch_timeout_ms=args.batch_timeout_ms,
                             metrics_port=0)
@@ -201,6 +219,15 @@ def run_router_bench(args):
     router = ServeRouter(
         [Backend("127.0.0.1", s.port, s.metrics_port) for s in srvs],
         port=0, poll_interval=0.1)
+
+    # traces need the poll loop to have learned each backend speaks
+    # PDI2 (statusz trace_wire) before the first request goes out
+    deadline = time.perf_counter() + 10.0
+    while time.perf_counter() < deadline:
+        bs = router.backends()
+        if bs and all(b.trace_wire for b in bs):
+            break
+        time.sleep(0.05)
 
     rng = np.random.default_rng(args.seed)
     row_mix = (1, 2, 1, 4)
@@ -247,6 +274,7 @@ def run_router_bench(args):
         except Exception as e:
             lost.append((i, repr(e)))
 
+    flat0 = REGISTRY.flat()
     t0 = time.perf_counter()
     threads = [threading.Thread(target=client, args=(i,), daemon=True)
                for i in range(n_clients)]
@@ -270,6 +298,50 @@ def run_router_bench(args):
     for s in srvs:
         s.stop()
     rps = completed[0] / wall_s if wall_s > 0 else 0.0
+
+    # what the run actually moved, not the process lifetime totals
+    metrics_delta = {}
+    for k, v in flat.items():
+        if not (k.startswith("paddle_tpu_router_")
+                or k.startswith("paddle_tpu_serve_")):
+            continue
+        try:
+            d = round(float(v) - float(flat0.get(k, 0.0)), 6)
+        except (TypeError, ValueError):
+            continue
+        if d:
+            metrics_delta[k] = d
+
+    # assembled traces: count them, prove ids never collide, and bound
+    # the epsilon between the router's observed latency (total_s) and
+    # the backend's own stage sum (backend_total_s)
+    trace_summary = {"file": trace_path, "lines": 0,
+                     "router_assembled": 0, "with_backend_breakdown": 0,
+                     "id_collisions": 0, "epsilon_ms": None}
+    if trace_path and os.path.exists(trace_path):
+        ids, eps = [], []
+        with open(trace_path) as f:
+            for raw in f:
+                try:
+                    line = json.loads(raw)
+                except ValueError:
+                    continue
+                trace_summary["lines"] += 1
+                ids.append(line.get("request_id"))
+                if line.get("component") != "router":
+                    continue
+                trace_summary["router_assembled"] += 1
+                if "backend_total_s" in line:
+                    trace_summary["with_backend_breakdown"] += 1
+                    eps.append(line["total_s"]
+                               - line["backend_total_s"])
+        trace_summary["id_collisions"] = len(ids) - len(set(ids))
+        if eps:
+            trace_summary["epsilon_ms"] = {
+                "mean": round(sum(eps) / len(eps) * 1e3, 3),
+                "min": round(min(eps) * 1e3, 3),
+                "max": round(max(eps) * 1e3, 3)}
+
     return {
         "metric": "serve_router_fleet",
         "value": round(rps, 2),
@@ -293,6 +365,8 @@ def run_router_bench(args):
         "p95_latency_ms": pct(0.95),
         "p99_latency_ms": pct(0.99),
         "reqs_per_s": round(rps, 2),
+        "traces": trace_summary,
+        "metrics_delta": metrics_delta,
         "router_metrics": {k: v for k, v in flat.items()
                            if k.startswith("paddle_tpu_router_")},
     }

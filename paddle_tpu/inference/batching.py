@@ -592,11 +592,23 @@ class DynamicBatcher:
             stacked.append(mat)
         return stacked, bucket, real, padded
 
-    def _slice_back(self, outs, reqs, bucket) -> bool:
+    def _deliver(self, r, res, spans, bucket):
+        """Deliver one request's result with its span breakdown: the
+        spans are stamped on the future BEFORE the result lands so the
+        wire layer (which wakes on delivery) can echo them in a traced
+        reply without racing the recorder."""
+        r.future.spans = dict(spans)
+        self._spans.record(r.req_id, spans,
+                           extra={"rows": r.rows, "bucket": bucket})
+        self._set(r.future, res)
+
+    def _slice_back(self, outs, reqs, bucket, times=None) -> bool:
         """Hand each request its row slice (and un-pad trailing dims it
         contributed padding to, by symbol). False when the outputs are
         not rowwise — or padded results could not be un-padded safely —
-        and the caller must fall back to per-request execution."""
+        and the caller must fall back to per-request execution.
+        ``times=(t_formed, t_padded, t_executed)`` makes delivery record
+        each request's span breakdown (and stamp it on the future)."""
         syms = self._out_syms
         if syms is not None and len(outs) != len(syms):
             syms = None
@@ -623,7 +635,15 @@ class DynamicBatcher:
                 if r.pad_map and syms is not None:
                     s = self._unpad(s, syms[k], r.pad_map)
                 res.append(s)            # views; the wire path copies
-            self._set(r.future, res)
+            if times is not None:
+                t0, t1, t2 = times
+                self._deliver(r, res,
+                              {"queue_wait": t0 - r.t_enq, "pad": t1 - t0,
+                               "execute": t2 - t1,
+                               "unpad": time.perf_counter() - t2},
+                              bucket)
+            else:
+                self._set(r.future, res)
             off += r.rows
         return True
 
@@ -699,18 +719,13 @@ class DynamicBatcher:
                 t1 = time.perf_counter()
                 outs = pred.run_batch(stacked)
                 t2 = time.perf_counter()
-                if self._slice_back(outs, reqs, bucket):
+                if self._slice_back(outs, reqs, bucket,
+                                    times=(t0, t1, t2)):
                     now = time.perf_counter()
                     profiler.record_serve_batch(rows, bucket, real, padded,
                                                 qdepth)
                     profiler.record_serve_requests(
                         [now - r.t_enq for r in reqs])
-                    for r in reqs:
-                        self._spans.record(
-                            r.req_id,
-                            {"queue_wait": t0 - r.t_enq, "pad": t1 - t0,
-                             "execute": t2 - t1, "unpad": now - t2},
-                            extra={"rows": r.rows, "bucket": bucket})
                     return
                 # outputs are not rowwise (batch-reducing model): stop
                 # merging requests from here on — correctness first
@@ -733,11 +748,11 @@ class DynamicBatcher:
                 if r.solo or not self._rowwise_ok:
                     outs = pred.run_batch(r.arrays)
                     t2 = time.perf_counter()
-                    self._set(r.future, [np.asarray(o) for o in outs])
-                    spans = {"queue_wait": t0 - r.t_enq, "pad": 0.0,
-                             "execute": t2 - t0,
-                             "unpad": time.perf_counter() - t2}
-                    bucket = r.rows
+                    self._deliver(r, [np.asarray(o) for o in outs],
+                                  {"queue_wait": t0 - r.t_enq, "pad": 0.0,
+                                   "execute": t2 - t0,
+                                   "unpad": time.perf_counter() - t2},
+                                  r.rows)
                 else:
                     r.pad_map.clear()
                     stacked, bucket, real, padded = self._assemble(
@@ -745,21 +760,34 @@ class DynamicBatcher:
                     t1 = time.perf_counter()
                     outs = pred.run_batch(stacked)
                     t2 = time.perf_counter()
-                    if not self._slice_back(outs, [r], bucket):
+                    if not self._slice_back(outs, [r], bucket,
+                                            times=(t0, t1, t2)):
                         outs = pred.run_batch(r.arrays)
                         t2 = time.perf_counter()
-                        self._set(r.future, [np.asarray(o) for o in outs])
+                        self._deliver(
+                            r, [np.asarray(o) for o in outs],
+                            {"queue_wait": t0 - r.t_enq, "pad": t1 - t0,
+                             "execute": t2 - t1,
+                             "unpad": time.perf_counter() - t2},
+                            bucket)
                     profiler.record_serve_batch(r.rows, bucket, real,
                                                 padded, qdepth)
-                    spans = {"queue_wait": t0 - r.t_enq, "pad": t1 - t0,
-                             "execute": t2 - t1,
-                             "unpad": time.perf_counter() - t2}
                 profiler.record_serve_request(
                     time.perf_counter() - r.t_enq)
-                self._spans.record(r.req_id, spans,
-                                   extra={"rows": r.rows, "bucket": bucket})
             except Exception as e:
                 profiler.record_serve_error()
+                # a failed request still traces: same line schema with
+                # the stages it never reached at zero, plus the error —
+                # and the partial breakdown rides the error frame's ctx
+                err_spans = {"queue_wait": t0 - r.t_enq, "pad": 0.0,
+                             "execute": 0.0, "unpad": 0.0}
+                try:
+                    e.spans = err_spans
+                except Exception:
+                    pass
+                self._spans.record(
+                    r.req_id, err_spans,
+                    extra={"rows": r.rows, "error": type(e).__name__})
                 self._set(r.future, exc=self._tag(e, r.req_id))
 
     # -- warmup ----------------------------------------------------------

@@ -8,6 +8,12 @@
  * wire protocol (inference/serve.py) over TCP — same capability, the
  * process-separated deployment shape TPU serving uses anyway.
  *
+ * Wire dialects: this client speaks 'PDI1' (legacy) frames only. The
+ * server also understands an optional 'PDI2' trace-context dialect
+ * (docs/observability.md) but replies PDI2 ONLY to PDI2 requests, so
+ * a PDI1 client never sees a byte it does not expect — no change here
+ * is needed as servers upgrade.
+ *
  * Build:  cc -o app app.c paddle_c_api.c
  * Use:
  *   PD_Predictor* p = PD_PredictorConnect("127.0.0.1", 9000);

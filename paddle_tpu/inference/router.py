@@ -50,7 +50,16 @@ inject wire failures between router and backend deterministically
         --port 9000 --warmup
 
 All ``paddle_tpu_router_*`` metric families land in the shared registry
-and are served from the router's own admin plane (``--metrics-port``).
+and are served from the router's own admin plane (``--metrics-port``),
+which also mounts ``/varz`` (windowed time-series history) and
+``/alertz`` (SLO burn-rate verdicts). Observability feeds back into
+routing: the poll thread reads each backend's ``/alertz`` and a backend
+whose SLOs are firing is demoted in the load score before it ever goes
+unhealthy. Requests carrying a PDI2 trace context (or sampled by
+``PADDLE_TPU_TRACE_SAMPLE``) are forwarded with the context to
+trace-capable backends and assembled into one JSONL line per request:
+router stages (pick / forward / reply) plus the backend's relayed
+queue_wait / pad / execute / unpad breakdown (docs/observability.md).
 """
 from __future__ import annotations
 
@@ -65,12 +74,16 @@ import threading
 import time
 from http.client import HTTPConnection
 
+from ..observability import (FlightRecorder, SLOEngine, SpanRecorder,
+                             TimeSeriesStore, next_request_id,
+                             request_id_base, router_objectives)
 from ..testing import chaos
 from ..utils.retry import CircuitBreaker, RetryBudget, backoff_delays
 from .errors import (ERR_INVALID_ARGUMENT, ERR_RESOURCE_EXHAUSTED,
                      ERR_UNAVAILABLE, RETRYABLE_CODES, TypedServeError,
                      error_code)
-from .serve import read_reply, read_tensors, write_error, write_tensors
+from .serve import (read_reply_ctx, read_request, write_error,
+                    write_tensors)
 
 __all__ = ["Backend", "ServeRouter", "BackendSupervisor", "parse_backend",
            "main_router"]
@@ -131,6 +144,20 @@ def _router_metrics():
         "backend_restarts": counter(
             "paddle_tpu_router_backend_restarts_total",
             "Dead fleet backends respawned by the supervisor"),
+        "backend_requests": counter(
+            "paddle_tpu_router_backend_requests_total",
+            "Forward attempts per backend (failovers count once per "
+            "backend tried)", ("backend",)),
+        "poll_latency": histogram(
+            "paddle_tpu_router_poll_latency_seconds",
+            "Health-poll round-trip per backend (healthz + statusz + "
+            "alertz, or the TCP dial fallback)",
+            buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                     0.1, 0.25, 0.5, 1.0, 2.5), sample_cap=1024),
+        "poll_failures": counter(
+            "paddle_tpu_router_poll_failures_total",
+            "Health polls that failed outright (dial refused, admin "
+            "unreachable, poll raised), per backend", ("backend",)),
     }
 
 
@@ -161,9 +188,24 @@ class Backend:
         self.last_poll_s = None
         self.polls_failed = 0
         self.inflight = 0
+        # does the backend speak the PDI2 trace-context frames? learned
+        # from /statusz ("trace_wire": true); False until proven, so a
+        # mixed fleet of old and new backends interops (old backends
+        # simply never see a trace context)
+        self.trace_wire = False
+        # the backend's own /alertz verdict ("ok" / "warning" /
+        # "firing"); a burning backend is demoted in score() so traffic
+        # shifts away BEFORE it goes fully unhealthy
+        self.alert_state = "ok"
+
+    # score() demotion per /alertz state: warning nudges traffic away,
+    # firing is worth ~50 queued requests — routed around unless every
+    # other backend is worse
+    _ALERT_PENALTY = {"ok": 0.0, "warning": 5.0, "firing": 50.0}
 
     def update_health(self, healthy: bool, reasons=(), draining=False,
-                      queue_depth: int = None, oldest_wait_s: float = None):
+                      queue_depth: int = None, oldest_wait_s: float = None,
+                      trace_wire: bool = None, alert_state: str = None):
         with self._lock:
             self.healthy = bool(healthy)
             self.health_reasons = list(reasons)
@@ -172,6 +214,10 @@ class Backend:
                 self.queue_depth = int(queue_depth)
             if oldest_wait_s is not None:
                 self.oldest_wait_s = float(oldest_wait_s)
+            if trace_wire is not None:
+                self.trace_wire = bool(trace_wire)
+            if alert_state in self._ALERT_PENALTY:
+                self.alert_state = alert_state
             self.last_poll_s = time.monotonic()
             self.polls_failed = 0 if healthy else self.polls_failed + 1
 
@@ -186,10 +232,13 @@ class Backend:
     def score(self) -> float:
         """Load score for least-loaded routing: cheap requests go where
         the combined router-side in-flight + backend queue is smallest;
-        a wedging queue (old oldest_wait_s) is penalized hard."""
+        a wedging queue (old oldest_wait_s) is penalized hard, and a
+        backend whose own SLOs are burning is demoted (warning +5,
+        firing +50) so the alert feeds back into routing."""
         with self._lock:
             return (self.inflight + self.queue_depth
-                    + 10.0 * self.oldest_wait_s)
+                    + 10.0 * self.oldest_wait_s
+                    + self._ALERT_PENALTY.get(self.alert_state, 0.0))
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -203,6 +252,9 @@ class Backend:
                 "oldest_wait_s": round(self.oldest_wait_s, 3),
                 "inflight": self.inflight,
                 "breaker": self.breaker.state,
+                "trace_wire": self.trace_wire,
+                "alert_state": self.alert_state,
+                "polls_failed": self.polls_failed,
             }
 
 
@@ -258,6 +310,21 @@ class ServeRouter:
         self._inflight = 0
         self._inflight_lock = threading.Lock()
         self._t0 = time.monotonic()
+        # router-side trace assembly: its own stage histogram family
+        # (pick / forward / reply + the backend_* breakdown relayed over
+        # the wire), same JSONL sink and sampling gate as the backends
+        self._spans = SpanRecorder(
+            component="router",
+            metric="paddle_tpu_router_span_seconds",
+            help="Router-side per-request span breakdown by stage "
+                 "(pick, forward, reply, plus relayed backend_* "
+                 "stages), seconds.")
+        # stall watchdog: busy while a client request is in flight; the
+        # forward loop beats after every answered request
+        self._recorder = FlightRecorder(
+            "serve_router",
+            busy_fn=lambda: self.inflight_requests > 0,
+            context_fn=self._stall_context)
 
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -278,13 +345,20 @@ class ServeRouter:
 
         self._admin = None
         self.metrics_port = None
+        self._varz = None
+        self._slo = None
         if metrics_port is not None and int(metrics_port) >= 0:
             from ..observability import (AdminServer,
                                          install_default_collectors)
             install_default_collectors()
+            self._varz = TimeSeriesStore()
+            self._varz.start()
+            self._slo = SLOEngine(self._varz, router_objectives())
             self._admin = AdminServer(port=int(metrics_port), host=host,
                                       health_fn=self._health,
-                                      status_fn=self._status)
+                                      status_fn=self._status,
+                                      varz_fn=self._varz.varz,
+                                      alertz_fn=self._slo.alertz)
             self.metrics_port = self._admin.port
 
     # -- routing table ---------------------------------------------------
@@ -303,7 +377,8 @@ class ServeRouter:
             self._backends = [b for b in self._backends if b.key != key]
         # drop the dead backend's per-backend samples so /metrics does
         # not advertise an address that no longer exists
-        for fam in ("backend_up", "breaker_state", "backend_queue"):
+        for fam in ("backend_up", "breaker_state", "backend_queue",
+                    "poll_failures", "backend_requests"):
             self._m[fam].remove(backend=key)
 
     # -- health polling --------------------------------------------------
@@ -311,10 +386,13 @@ class ServeRouter:
     def _poll_loop(self):
         while not self._stop.is_set():
             for b in self.backends():
+                t0 = time.perf_counter()
                 try:
                     self._poll_backend(b)
                 except Exception as e:   # a poll bug must not kill polls
                     b.update_health(False, [f"poll raised: {e!r}"])
+                    self._m["poll_failures"].labels(backend=b.key).inc()
+                self._m["poll_latency"].observe(time.perf_counter() - t0)
                 self._m["backend_up"].labels(backend=b.key).set(
                     1 if b.healthy else 0)
                 self._m["breaker_state"].labels(backend=b.key).set(
@@ -333,6 +411,7 @@ class ServeRouter:
                 b.update_health(True)
             except OSError as e:
                 b.update_health(False, [f"dial failed: {e}"])
+                self._m["poll_failures"].labels(backend=b.key).inc()
             return
         conn = HTTPConnection(b.host, b.admin_port,
                               timeout=max(self._poll_interval, 0.5))
@@ -348,15 +427,31 @@ class ServeRouter:
             s = conn.getresponse()
             sbody = json.loads(s.read().decode("utf-8", "replace") or "{}")
             draining = bool(sbody.get("draining", draining))
+            trace_wire = bool(sbody.get("trace_wire", False))
             batcher = sbody.get("batcher") or {}
             if "queue_depth" in batcher:
                 queue_depth = batcher["queue_depth"]
             if "oldest_wait_s" in batcher:
                 oldest = batcher["oldest_wait_s"]
+            # the backend's own SLO verdict closes the loop into
+            # routing: /alertz 404s on an old backend -> stays "ok"
+            alert_state = None
+            try:
+                conn.request("GET", "/alertz")
+                a = conn.getresponse()
+                abody = json.loads(
+                    a.read().decode("utf-8", "replace") or "{}")
+                if a.status == 200:
+                    alert_state = abody.get("state")
+            except (OSError, ValueError):
+                pass
             b.update_health(healthy, reasons, draining=draining,
-                            queue_depth=queue_depth, oldest_wait_s=oldest)
+                            queue_depth=queue_depth, oldest_wait_s=oldest,
+                            trace_wire=trace_wire,
+                            alert_state=alert_state)
         except (OSError, ValueError) as e:
             b.update_health(False, [f"admin poll failed: {e}"])
+            self._m["poll_failures"].labels(backend=b.key).inc()
         finally:
             conn.close()
 
@@ -439,19 +534,25 @@ class ServeRouter:
             except OSError:
                 pass
 
-    def _forward(self, b: Backend, arrays):
+    def _forward(self, b: Backend, arrays, ctx=None):
         """One attempt against one backend: write the request, read the
-        reply. Returns ``(outputs, None)`` or ``(None, error_message)``.
-        A stale keep-alive socket (backend restarted between requests)
+        reply. Returns ``(outputs, None, reply_ctx)`` or ``(None,
+        error_message, reply_ctx)``; ``reply_ctx`` is the backend's
+        trace context (span breakdown) or ``None``. The context is only
+        put on the wire when the backend advertised ``trace_wire`` in
+        its /statusz, so an old backend never sees a PDI2 frame. A
+        stale keep-alive socket (backend restarted between requests)
         gets exactly one fresh-socket retry; every other wire failure
         propagates to the failover loop."""
+        send_ctx = ctx if (ctx is not None and b.trace_wire) else None
         reused = b.key in self._conn_cache()
         b.begin()
+        self._m["backend_requests"].labels(backend=b.key).inc()
         try:
             try:
                 s = self._backend_conn(b)
-                write_tensors(s, arrays)
-                return read_reply(s)
+                write_tensors(s, arrays, ctx=send_ctx)
+                return read_reply_ctx(s)
             except ConnectionError:
                 self._drop_conn(b)
                 if not reused:
@@ -461,18 +562,26 @@ class ServeRouter:
                 raise
             s = self._backend_conn(b)
             try:
-                write_tensors(s, arrays)
-                return read_reply(s)
+                write_tensors(s, arrays, ctx=send_ctx)
+                return read_reply_ctx(s)
             except (ConnectionError, TimeoutError, OSError, struct.error):
                 self._drop_conn(b)
                 raise
         finally:
             b.end()
 
-    def _handle(self, arrays):
+    def _handle(self, arrays, ctx=None, info=None):
         """Route one decoded request. Returns ``("ok", outputs)`` or
         ``(outcome, error_message)`` with outcome one of
-        ``relayed_error`` / ``shed`` / ``unavailable``."""
+        ``relayed_error`` / ``shed`` / ``unavailable``. ``ctx`` is the
+        trace context forwarded to trace-capable backends; ``info``
+        (when given) is filled in-place with the trace assembly:
+        ``pick_s`` / ``forward_s`` accumulated across attempts,
+        ``backend`` (the answering backend's key), ``backend_ctx`` (its
+        reply trace context) and ``attempts``."""
+        info = info if info is not None else {}
+        info.update(pick_s=0.0, forward_s=0.0, backend=None,
+                    backend_ctx=None, attempts=0)
         self._budget.record_request()
         tried = set()
         attempts = 0
@@ -480,10 +589,13 @@ class ServeRouter:
         last_err = None
         max_attempts = 1 + self._failover_retries
         while attempts < max_attempts:
+            t_pick = time.perf_counter()
             try:
                 b = self._choose(exclude=tried)
             except TypedServeError as e:     # shed: every backend busy
+                info["pick_s"] += time.perf_counter() - t_pick
                 return ("shed", str(e))
+            info["pick_s"] += time.perf_counter() - t_pick
             if b is None:
                 break
             if attempts > 0:
@@ -495,20 +607,24 @@ class ServeRouter:
                             f"failing fast instead of retry-storming")
                 self._m["failovers"].inc()
             attempts += 1
+            info["attempts"] = attempts
             tried.add(b.key)
+            t_fwd = time.perf_counter()
             try:
                 chaos.maybe_fail("router.forward", b.key)
-                outputs, errmsg = self._forward(b, arrays)
+                outputs, errmsg, rctx = self._forward(b, arrays, ctx=ctx)
             except (ConnectionError, TimeoutError, OSError,
                     struct.error, ValueError, IndexError) as e:
                 # wire failure or unparseable reply: the backend is
                 # misbehaving — count it against the breaker, fail over
+                info["forward_s"] += time.perf_counter() - t_fwd
                 b.breaker.record_failure()
                 self._drop_conn(b)
                 last_err = f"{b.key}: {type(e).__name__}: {e}"
                 if first_failure_t is None:
                     first_failure_t = time.monotonic()
                 continue
+            info["forward_s"] += time.perf_counter() - t_fwd
             if errmsg is not None:
                 code = error_code(errmsg)
                 if code in RETRYABLE_CODES:
@@ -522,11 +638,13 @@ class ServeRouter:
                 # deterministic / non-retryable error: relay verbatim —
                 # the backend answered, so its breaker heals
                 b.breaker.record_success()
+                info["backend"], info["backend_ctx"] = b.key, rctx
                 return ("relayed_error", errmsg)
             b.breaker.record_success()
             if first_failure_t is not None:
                 self._m["failover_latency"].observe(
                     time.monotonic() - first_failure_t)
+            info["backend"], info["backend_ctx"] = b.key, rctx
             return ("ok", outputs)
         detail = last_err or ("no routable backend (all unhealthy, "
                               "draining, or circuit-broken)")
@@ -552,7 +670,7 @@ class ServeRouter:
         try:
             while True:
                 try:
-                    arrays = read_tensors(conn)
+                    arrays, cctx = read_request(conn)
                 except (ConnectionError, TimeoutError, struct.error,
                         OSError):
                     return
@@ -565,29 +683,110 @@ class ServeRouter:
                     except OSError:
                         pass
                     return
+                # one router-minted id per request (globally unique via
+                # the process prefix); the trace id is the client's if
+                # it sent a context, else the router id — either way it
+                # names the whole client->router->backend trace
+                rid = next_request_id()
+                trace_id = (cctx or {}).get("trace_id") or rid
+                traced = cctx is not None or self._spans.sampled(rid)
+                fwd_ctx = {"trace_id": trace_id, "request_id": rid} \
+                    if traced else None
                 with self._inflight_lock:
                     self._inflight += 1
                 self._m["inflight"].inc()
                 t0 = time.monotonic()
+                info = {}
                 try:
-                    outcome, payload = self._handle(arrays)
+                    outcome, payload = self._handle(arrays, ctx=fwd_ctx,
+                                                    info=info)
                 finally:
                     with self._inflight_lock:
                         self._inflight -= 1
                     self._m["inflight"].dec()
-                self._m["latency"].observe(time.monotonic() - t0)
+                wall = time.monotonic() - t0
+                self._m["latency"].observe(wall)
                 self._m["requests"].labels(outcome=outcome).inc()
+                reply_ctx = self._client_reply_ctx(cctx, rid, trace_id,
+                                                   info)
                 try:
                     if outcome == "ok":
-                        write_tensors(conn, payload)
+                        write_tensors(conn, payload, ctx=reply_ctx)
                     else:
-                        write_error(conn, payload)
+                        write_error(conn, payload, ctx=reply_ctx)
                 except (ConnectionError, TimeoutError, OSError):
                     return
+                if traced:
+                    self._record_trace(rid, trace_id, cctx is not None,
+                                       wall, info, outcome)
+                self._recorder.beat()
                 if self._draining.is_set():
                     return
         finally:
             conn.close()
+
+    # -- trace assembly --------------------------------------------------
+
+    @staticmethod
+    def _backend_spans(info) -> dict:
+        """The answering backend's span breakdown (stage -> seconds,
+        no ``_s`` suffix) out of its reply trace context, or ``{}``."""
+        bctx = info.get("backend_ctx") or {}
+        out = {}
+        for k, v in (bctx.get("spans") or {}).items():
+            try:
+                v = float(v)
+            except (TypeError, ValueError):
+                continue
+            out[k[:-2] if k.endswith("_s") else k] = v
+        return out
+
+    def _client_reply_ctx(self, cctx, rid, trace_id, info):
+        """Trace context echoed to a PDI2 client: the router's ids plus
+        the relayed backend breakdown. ``None`` for a PDI1 client (the
+        reply frame must mirror the request's dialect)."""
+        if cctx is None:
+            return None
+        ctx = {"trace_id": trace_id, "request_id": rid}
+        spans = {"pick_s": round(info.get("pick_s", 0.0), 6),
+                 "forward_s": round(info.get("forward_s", 0.0), 6)}
+        for k, v in self._backend_spans(info).items():
+            spans[f"backend_{k}_s"] = round(v, 6)
+        ctx["spans"] = spans
+        if info.get("backend"):
+            ctx["backend"] = info["backend"]
+        bctx = info.get("backend_ctx") or {}
+        if bctx.get("request_id") is not None:
+            ctx["backend_request_id"] = bctx["request_id"]
+        return ctx
+
+    def _record_trace(self, rid, trace_id, client_traced, wall, info,
+                      outcome):
+        """One assembled JSONL line per traced request: the router's
+        own stages (pick / forward / reply, summing to the observed
+        latency) plus the backend's relayed breakdown as ``backend_*``
+        extras — kept out of ``total_s`` because the backend's time is
+        inside ``forward_s`` already (double counting would make the
+        epsilon check total_s - backend_total_s meaningless)."""
+        pick = info.get("pick_s", 0.0)
+        fwd = info.get("forward_s", 0.0)
+        spans = {"pick": pick, "forward": fwd,
+                 "reply": max(wall - pick - fwd, 0.0)}
+        extra = {"trace_id": trace_id, "outcome": outcome,
+                 "attempts": info.get("attempts", 0),
+                 "client_traced": bool(client_traced)}
+        if info.get("backend"):
+            extra["backend"] = info["backend"]
+        bctx = info.get("backend_ctx") or {}
+        if bctx.get("request_id") is not None:
+            extra["backend_request_id"] = bctx["request_id"]
+        bspans = self._backend_spans(info)
+        if bspans:
+            for k, v in bspans.items():
+                self._spans.observe_stage(f"backend_{k}", v)
+                extra[f"backend_{k}_s"] = round(v, 6)
+            extra["backend_total_s"] = round(sum(bspans.values()), 6)
+        self._spans.record(rid, spans, extra=extra, force=True)
 
     # -- admin surface ---------------------------------------------------
 
@@ -610,7 +809,17 @@ class ServeRouter:
                            + ("; ".join(per) or "no backends") + ")")
         return not reasons, reasons
 
+    def _stall_context(self) -> dict:
+        """Flight-recorder dump context: what the router was doing when
+        it wedged (which backends looked routable, what was in flight)."""
+        return {
+            "inflight_requests": self.inflight_requests,
+            "draining": self._draining.is_set(),
+            "backends": [b.snapshot() for b in self.backends()],
+        }
+
     def _status(self) -> dict:
+        poll_lat = self._m["poll_latency"]
         return {
             "role": "router",
             "port": self.port,
@@ -620,6 +829,16 @@ class ServeRouter:
             "inflight_requests": self.inflight_requests,
             "shed_watermark": self._watermark,
             "poll_interval_s": self._poll_interval,
+            "trace_wire": True,
+            "request_id_base": request_id_base(),
+            "poll": {
+                "interval_s": self._poll_interval,
+                "polls": poll_lat.count,
+                "latency_p50_s": round(poll_lat.percentile(0.50), 6),
+                "latency_p99_s": round(poll_lat.percentile(0.99), 6),
+                "failures": {
+                    b.key: b.polls_failed for b in self.backends()},
+            },
             "retry_budget": {
                 "tokens": round(self._budget.tokens, 2),
                 "spent": self._budget.spent,
@@ -659,6 +878,10 @@ class ServeRouter:
 
     def stop(self):
         self._stop.set()
+        if self._varz is not None:
+            self._varz.stop()
+        self._recorder.stop()
+        self._spans.close()
         if self._admin is not None:
             self._admin.stop()
         try:

@@ -17,6 +17,21 @@ Wire protocol (little endian), one request per round trip:
 dtype codes match utils/cpp_extension: 0 f32, 1 f64, 2 i32, 3 i64, 4 u8,
 5 bool.
 
+Trace-context extension (optional, backward compatible): a frame whose
+magic is 'PDI2' carries a JSON *trace context* between the header and
+the payload —
+  request : u32 'PDI2' | u32 n_tensors | u32 ctx_len | ctx JSON | tensors
+  reply   : u32 'PDI2' | u32 n_tensors | u32 ctx_len | ctx JSON | tensors
+  error   : u32 'PDI2' | u32 0xFFFFFFFF | u32 ctx_len | ctx JSON |
+            u32 len | utf8 message
+The server replies 'PDI2' ONLY to a 'PDI2' request, echoing the trace id
+and attaching its span breakdown, so a legacy client ('PDI1', including
+the C client) never sees a frame it cannot parse; a new client talking
+to a legacy server simply does not send a context (the router gates on
+the backend's /statusz ``trace_wire`` capability flag). Contexts are
+capped at 64 KiB and an unparseable context degrades to "no context" —
+tracing must never fail a request.
+
 Engine: with ``max_batch_size > 1`` (the CLI default) the daemon is a
 batched, compile-bounded pipeline — reader threads enqueue decoded
 tensors into a DynamicBatcher (inference/batching.py), a dispatcher
@@ -50,10 +65,12 @@ from .errors import (ERR_DEADLINE_EXCEEDED, ERR_INVALID_ARGUMENT,
                      TypedServeError)
 
 MAGIC = 0x31494450          # 'PDI1'
+MAGIC_TRACE = 0x32494450    # 'PDI2': header is followed by a trace ctx
 ERR = 0xFFFFFFFF
 _DTYPES = [np.float32, np.float64, np.int32, np.int64, np.uint8, np.bool_]
 _MAX_TENSORS = 256          # a request claiming more is malformed
 _MAX_NDIM = 32
+_MAX_CTX_BYTES = 1 << 16    # trace-context JSON cap
 _DEFAULT_MAX_REQUEST_BYTES = 1 << 28       # 256 MiB
 _SEND_COPY_MAX = 1 << 16    # payloads above this go out via memoryview
 
@@ -72,20 +89,35 @@ def max_request_bytes() -> int:
         return _DEFAULT_MAX_REQUEST_BYTES
 
 
-def read_tensors(sock, max_bytes=None):
-    """Decode one request frame, validating every size field BEFORE
-    allocating or recv-ing: dtype code and ndim in range, no negative
-    dims, and the total payload capped by PADDLE_TPU_MAX_REQUEST_BYTES —
-    a hostile header must not be able to drive ``count * itemsize`` into
-    a huge (or, via int64 overflow, negative) recv."""
-    if max_bytes is None:
-        max_bytes = max_request_bytes()
-    magic, n = struct.unpack("<II", _recv_exact(sock, 8))
-    if magic != MAGIC:
-        raise ValueError("bad magic")
-    if n > _MAX_TENSORS:
-        raise ValueError(f"request claims {n} tensors "
-                         f"(cap {_MAX_TENSORS})")
+def _encode_ctx(ctx: dict) -> bytes:
+    raw = json.dumps(ctx, separators=(",", ":")).encode("utf-8")
+    if len(raw) > _MAX_CTX_BYTES:
+        # oversize context degrades to the trace id alone rather than
+        # failing the frame
+        raw = json.dumps({"trace_id": ctx.get("trace_id")},
+                         separators=(",", ":")).encode("utf-8")
+    return struct.pack("<I", len(raw)) + raw
+
+
+def _read_ctx(sock) -> dict:
+    (clen,) = struct.unpack("<I", _recv_exact(sock, 4))
+    if clen > _MAX_CTX_BYTES:
+        raise ValueError(f"trace context claims {clen} bytes "
+                         f"(cap {_MAX_CTX_BYTES})")
+    raw = _recv_exact(sock, clen)
+    try:
+        ctx = json.loads(raw.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError):
+        return {}               # garbage context must not fail the frame
+    return ctx if isinstance(ctx, dict) else {}
+
+
+def _read_tensor_list(sock, n, max_bytes, what):
+    """The shared per-tensor loop: validates every size field BEFORE
+    allocating or recv-ing — dtype code and ndim in range, no negative
+    dims, and the total payload capped by PADDLE_TPU_MAX_REQUEST_BYTES,
+    so a hostile header can never drive ``count * itemsize`` into a huge
+    (or, via int64 overflow, negative) recv."""
     out, total = [], 0
     for _ in range(n):
         dt, nd = struct.unpack("<BB", _recv_exact(sock, 2))
@@ -105,19 +137,48 @@ def read_tensors(sock, max_bytes=None):
         total += nbytes
         if total > max_bytes:
             raise ValueError(
-                f"request exceeds PADDLE_TPU_MAX_REQUEST_BYTES="
+                f"{what} exceeds PADDLE_TPU_MAX_REQUEST_BYTES="
                 f"{max_bytes} ({total} bytes claimed)")
         data = _recv_exact(sock, nbytes)
         out.append(np.frombuffer(data, dtype, count).reshape(shape).copy())
     return out
 
 
-def write_tensors(sock, arrays):
+def read_request(sock, max_bytes=None):
+    """Decode one request frame -> ``(arrays, ctx)``. ``ctx`` is the
+    trace-context dict for a 'PDI2' frame, ``None`` for a legacy 'PDI1'
+    frame (every pre-trace client, including the C client)."""
+    if max_bytes is None:
+        max_bytes = max_request_bytes()
+    magic, n = struct.unpack("<II", _recv_exact(sock, 8))
+    if magic not in (MAGIC, MAGIC_TRACE):
+        raise ValueError("bad magic")
+    ctx = _read_ctx(sock) if magic == MAGIC_TRACE else None
+    if n > _MAX_TENSORS:
+        raise ValueError(f"request claims {n} tensors "
+                         f"(cap {_MAX_TENSORS})")
+    return _read_tensor_list(sock, n, max_bytes, "request"), ctx
+
+
+def read_tensors(sock, max_bytes=None):
+    """Decode one request frame (tensors only — the historical API; any
+    trace context on the frame is read and discarded)."""
+    arrays, _ = read_request(sock, max_bytes)
+    return arrays
+
+
+def write_tensors(sock, arrays, ctx=None):
     """Encode one reply frame. Small tensors are coalesced into one
     buffered send; large payloads go out as per-part ``sendall`` on a
     ``memoryview`` of the array — no ``tobytes()`` + ``b"".join`` double
-    copy of multi-megabyte results."""
-    small = [struct.pack("<II", MAGIC, len(arrays))]
+    copy of multi-megabyte results. A ``ctx`` dict upgrades the frame to
+    'PDI2' with the JSON trace context after the header — only send one
+    to a peer known to speak it."""
+    if ctx is None:
+        small = [struct.pack("<II", MAGIC, len(arrays))]
+    else:
+        small = [struct.pack("<II", MAGIC_TRACE, len(arrays)),
+                 _encode_ctx(ctx)]
     for a in arrays:
         a = np.ascontiguousarray(a)
         if a.dtype not in [np.dtype(d) for d in _DTYPES]:
@@ -141,9 +202,35 @@ def write_tensors(sock, arrays):
         sock.sendall(b"".join(small))
 
 
-def write_error(sock, msg: str):
+def write_error(sock, msg: str, ctx=None):
     m = msg.encode()[:65536]
-    sock.sendall(struct.pack("<III", MAGIC, ERR, len(m)) + m)
+    if ctx is None:
+        sock.sendall(struct.pack("<III", MAGIC, ERR, len(m)) + m)
+    else:
+        sock.sendall(struct.pack("<II", MAGIC_TRACE, ERR)
+                     + _encode_ctx(ctx)
+                     + struct.pack("<I", len(m)) + m)
+
+
+def read_reply_ctx(sock, max_bytes=None):
+    """Decode one REPLY frame -> ``(arrays, errmsg, ctx)``: a tensor
+    reply is ``(arrays, None, ctx)``, an error frame ``(None, message,
+    ctx)``; ``ctx`` is ``None`` unless the peer sent a 'PDI2' frame
+    (which it only does in answer to a 'PDI2' request)."""
+    if max_bytes is None:
+        max_bytes = max_request_bytes()
+    magic, n = struct.unpack("<II", _recv_exact(sock, 8))
+    if magic not in (MAGIC, MAGIC_TRACE):
+        raise ValueError("bad magic in reply")
+    ctx = _read_ctx(sock) if magic == MAGIC_TRACE else None
+    if n == ERR:
+        (mlen,) = struct.unpack("<I", _recv_exact(sock, 4))
+        if mlen > 65536:
+            raise ValueError(f"error frame claims {mlen} bytes")
+        return None, _recv_exact(sock, mlen).decode("utf-8", "replace"), ctx
+    if n > _MAX_TENSORS:
+        raise ValueError(f"reply claims {n} tensors (cap {_MAX_TENSORS})")
+    return _read_tensor_list(sock, n, max_bytes, "reply"), None, ctx
 
 
 def read_reply(sock, max_bytes=None):
@@ -152,40 +239,8 @@ def read_reply(sock, max_bytes=None):
     client) needs this because ``read_tensors`` treats the error marker
     as a hostile tensor count. Same size validation as ``read_tensors``.
     """
-    if max_bytes is None:
-        max_bytes = max_request_bytes()
-    magic, n = struct.unpack("<II", _recv_exact(sock, 8))
-    if magic != MAGIC:
-        raise ValueError("bad magic in reply")
-    if n == ERR:
-        (mlen,) = struct.unpack("<I", _recv_exact(sock, 4))
-        if mlen > 65536:
-            raise ValueError(f"error frame claims {mlen} bytes")
-        return None, _recv_exact(sock, mlen).decode("utf-8", "replace")
-    if n > _MAX_TENSORS:
-        raise ValueError(f"reply claims {n} tensors (cap {_MAX_TENSORS})")
-    out, total = [], 0
-    for _ in range(n):
-        dt, nd = struct.unpack("<BB", _recv_exact(sock, 2))
-        if dt >= len(_DTYPES):
-            raise IndexError(f"bad dtype code {dt}")
-        if nd > _MAX_NDIM:
-            raise ValueError(f"tensor ndim {nd} exceeds cap {_MAX_NDIM}")
-        shape = struct.unpack(f"<{nd}q", _recv_exact(sock, 8 * nd)) \
-            if nd else ()
-        if any(d < 0 for d in shape):
-            raise ValueError(f"negative dim in shape {shape}")
-        dtype = np.dtype(_DTYPES[dt])
-        count = 1
-        for d in shape:
-            count *= d
-        nbytes = count * dtype.itemsize
-        total += nbytes
-        if total > max_bytes:
-            raise ValueError(f"reply exceeds {max_bytes} bytes")
-        data = _recv_exact(sock, nbytes)
-        out.append(np.frombuffer(data, dtype, count).reshape(shape).copy())
-    return out, None
+    arrays, err, _ = read_reply_ctx(sock, max_bytes)
+    return arrays, err
 
 
 def _idle_timeout_default() -> float:
@@ -292,13 +347,25 @@ class InferenceServer:
         if metrics_port is None:
             mp = os.environ.get("PADDLE_TPU_METRICS_PORT", "").strip()
             metrics_port = int(mp) if mp else None
+        self._varz = None
+        self._slo = None
         if metrics_port is not None and int(metrics_port) >= 0:
-            from ..observability import (AdminServer,
-                                         install_default_collectors)
+            from ..observability import (AdminServer, SLOEngine,
+                                         TimeSeriesStore,
+                                         install_default_collectors,
+                                         serve_objectives)
             install_default_collectors()
+            # windowed history + SLO verdicts ride the same admin plane:
+            # /varz is the ring-buffer view, /alertz the burn-rate
+            # judgment over it (docs/observability.md)
+            self._varz = TimeSeriesStore()
+            self._varz.start()
+            self._slo = SLOEngine(self._varz, serve_objectives())
             self._admin = AdminServer(port=int(metrics_port), host=host,
                                       health_fn=self._health,
-                                      status_fn=self._status)
+                                      status_fn=self._status,
+                                      varz_fn=self._varz.varz,
+                                      alertz_fn=self._slo.alertz)
             self.metrics_port = self._admin.port
 
     @property
@@ -343,6 +410,9 @@ class InferenceServer:
             "engine": "batched" if self._batched else "serialized",
             "port": self.port,
             "metrics_port": self.metrics_port,
+            # capability flag the router gates trace propagation on: a
+            # backend advertising it accepts 'PDI2' request frames
+            "trace_wire": True,
             "draining": self._draining.is_set(),
             "inflight_requests": self.inflight_requests,
             "uptime_s": round(time.monotonic() - self._t0, 3),
@@ -387,13 +457,16 @@ class InferenceServer:
                              daemon=True).start()
 
     def _run(self, inputs):
+        """-> (outputs, future_or_None); the future carries the request
+        id and (post-delivery) the span breakdown a traced reply echoes
+        back to the caller."""
         if self._batcher is not None:
             fut = self._batcher.submit(inputs)
             deadline = self._request_timeout
             if not deadline or deadline <= 0:
-                return fut.result()
+                return fut.result(), fut
             try:
-                return fut.result(timeout=deadline)
+                return fut.result(timeout=deadline), fut
             except FuturesTimeout:
                 # a wedged predictor/worker must not pin the connection
                 # thread forever; the future stays abandoned (the
@@ -407,7 +480,30 @@ class InferenceServer:
                 err.request_id = getattr(fut, "request_id", None)
                 raise err from None
         with self._lock:
-            return self._predictor.run(inputs)
+            return self._predictor.run(inputs), None
+
+    @staticmethod
+    def _reply_ctx(ctx, fut, exc=None):
+        """Reply trace context for a traced request: echo the trace id,
+        attach this backend's request id and span breakdown (what the
+        router joins into the end-to-end trace). None for untraced
+        ('PDI1') requests — the reply then stays a legacy frame."""
+        if ctx is None:
+            return None
+        out = {"trace_id": ctx.get("trace_id")}
+        src = exc if exc is not None else fut
+        rid = getattr(src, "request_id", None)
+        if rid is None and fut is not None:
+            rid = getattr(fut, "request_id", None)
+        if rid is not None:
+            out["request_id"] = int(rid)
+        spans = getattr(src, "spans", None)
+        if spans is None and fut is not None:
+            spans = getattr(fut, "spans", None)
+        if spans:
+            out["spans"] = {f"{k}_s": round(float(v), 6)
+                            for k, v in spans.items()}
+        return out
 
     def _serve_conn(self, conn):
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -420,7 +516,7 @@ class InferenceServer:
             while True:
                 try:
                     chaos.maybe_fail("serve.conn.read")
-                    inputs = read_tensors(conn)
+                    inputs, ctx = read_request(conn)
                 except (ConnectionError, TimeoutError, struct.error,
                         OSError):
                     return
@@ -439,9 +535,10 @@ class InferenceServer:
                     self._conn_inflight += 1
                 try:
                     try:
-                        outputs = self._run(inputs)
+                        outputs, fut = self._run(inputs)
                         chaos.maybe_fail("serve.conn.reply")
-                        write_tensors(conn, outputs)
+                        write_tensors(conn, outputs,
+                                      ctx=self._reply_ctx(ctx, fut))
                     except (ConnectionError, TimeoutError):
                         return
                     except Exception as e:   # model-side error -> client
@@ -454,7 +551,8 @@ class InferenceServer:
                             # the id a sampled span trace / stall dump
                             # carries
                             msg += f" [request_id={rid}]"
-                        write_error(conn, msg)
+                        write_error(conn, msg,
+                                    ctx=self._reply_ctx(ctx, None, exc=e))
                 finally:
                     with self._conn_lock:
                         self._conn_inflight -= 1
@@ -513,6 +611,8 @@ class InferenceServer:
 
     def stop(self):
         self._stop.set()
+        if self._varz is not None:
+            self._varz.stop()
         if self._admin is not None:
             self._admin.stop()
         if self._batcher is not None:
@@ -573,9 +673,9 @@ def main(argv=None):
     ap.add_argument("--stats-interval", type=float, default=10.0,
                     help="seconds between SERVE_STATS lines (0 = off)")
     ap.add_argument("--metrics-port", type=int, default=None,
-                    help="mount /metrics + /healthz + /statusz on this "
-                         "port (0 = ephemeral; default off, or "
-                         "PADDLE_TPU_METRICS_PORT)")
+                    help="mount /metrics + /healthz + /statusz + /varz "
+                         "+ /alertz on this port (0 = ephemeral; "
+                         "default off, or PADDLE_TPU_METRICS_PORT)")
     ap.add_argument("--drain-timeout", type=float, default=30.0,
                     help="seconds SIGTERM waits for in-flight requests "
                          "before hard stop")

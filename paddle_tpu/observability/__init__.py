@@ -25,15 +25,22 @@ from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
                       REGISTRY, counter, gauge, histogram,
                       DEFAULT_BUCKETS)
 from .admin import AdminServer
-from .spans import SpanRecorder, next_request_id, trace_sample_rate
+from .spans import (SpanRecorder, next_request_id, request_id_base,
+                    trace_sample_rate)
 from .flight_recorder import (FlightRecorder, capture_thread_stacks,
                               stall_dump_dir, stall_timeout)
+from .timeseries import TimeSeriesStore, varz_interval, varz_capacity
+from .slo import (Objective, SLOEngine, slo_windows, slo_burn_factors,
+                  serve_objectives, router_objectives)
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
            "counter", "gauge", "histogram", "DEFAULT_BUCKETS",
            "AdminServer", "SpanRecorder", "next_request_id",
-           "trace_sample_rate", "FlightRecorder",
+           "request_id_base", "trace_sample_rate", "FlightRecorder",
            "capture_thread_stacks", "stall_dump_dir", "stall_timeout",
+           "TimeSeriesStore", "varz_interval", "varz_capacity",
+           "Objective", "SLOEngine", "slo_windows", "slo_burn_factors",
+           "serve_objectives", "router_objectives",
            "install_default_collectors"]
 
 _PROC_T0 = _time.monotonic()

@@ -1,9 +1,9 @@
-"""Admin HTTP endpoint: /metrics, /healthz, /statusz.
+"""Admin HTTP endpoint: /metrics, /healthz, /statusz, /varz, /alertz.
 
 A stdlib ``http.server`` front-end (no new dependencies) the serving
 daemon exposes on ``--metrics-port`` / ``PADDLE_TPU_METRICS_PORT`` —
-off by default; loopback by default, like the data-plane socket. Three
-routes, all GET:
+off by default; loopback by default, like the data-plane socket. All
+routes are GET:
 
   * ``/metrics``  — Prometheus text exposition 0.0.4 from the registry
     (Content-Type ``text/plain; version=0.0.4``), scrape-ready.
@@ -12,9 +12,13 @@ routes, all GET:
     otherwise (a load balancer or k8s probe points here).
   * ``/statusz``  — one JSON snapshot: serve stats, bucket ladder,
     compile/warmup state, per-device HBM, uptime, effective config.
+  * ``/varz``     — bounded windowed history (``varz_fn``, normally
+    :meth:`..timeseries.TimeSeriesStore.varz`); 404 when not mounted.
+  * ``/alertz``   — SLO verdicts (``alertz_fn``, normally
+    :meth:`..slo.SLOEngine.alertz`); 404 when not mounted.
 
 Handlers never execute model code, so a scrape can never trigger a
-compile or perturb the request path beyond a registry read.
+compile or perturb the request path beyond a registry/ring read.
 """
 from __future__ import annotations
 
@@ -44,10 +48,14 @@ class AdminServer:
                  registry: Optional[_metrics.MetricsRegistry] = None,
                  health_fn: Optional[
                      Callable[[], Tuple[bool, list]]] = None,
-                 status_fn: Optional[Callable[[], dict]] = None):
+                 status_fn: Optional[Callable[[], dict]] = None,
+                 varz_fn: Optional[Callable[[], dict]] = None,
+                 alertz_fn: Optional[Callable[[], dict]] = None):
         self.registry = registry or _metrics.REGISTRY
         self.health_fn = health_fn or (lambda: (True, []))
         self.status_fn = status_fn
+        self.varz_fn = varz_fn
+        self.alertz_fn = alertz_fn
         self._t0 = time.monotonic()
         admin = self
 
@@ -83,11 +91,20 @@ class AdminServer:
                         body = json.dumps(admin._status(),
                                           default=str).encode()
                         self._reply(200, body, "application/json")
+                    elif path == "/varz" and admin.varz_fn is not None:
+                        body = json.dumps(admin.varz_fn(),
+                                          default=str).encode()
+                        self._reply(200, body, "application/json")
+                    elif path == "/alertz" and \
+                            admin.alertz_fn is not None:
+                        body = json.dumps(admin.alertz_fn(),
+                                          default=str).encode()
+                        self._reply(200, body, "application/json")
                     else:
                         self._reply(
                             404,
                             b'{"error": "unknown path; try /metrics, '
-                            b'/healthz or /statusz"}',
+                            b'/healthz, /statusz, /varz or /alertz"}',
                             "application/json")
                 except BrokenPipeError:
                     pass

@@ -23,17 +23,38 @@ from typing import Dict, Optional
 
 from . import metrics as _metrics
 
-__all__ = ["SpanRecorder", "next_request_id", "trace_sample_rate"]
+__all__ = ["SpanRecorder", "next_request_id", "request_id_base",
+           "trace_sample_rate"]
 
 SPAN_STAGES = ("queue_wait", "pad", "execute", "unpad")
 
+
+def _mint_id_base() -> int:
+    # Fleet-unique prefix in the high bits: pid (recycled slowly) XOR a
+    # nanosecond salt (breaks pid reuse across restarts), occupying bits
+    # 32..61 so `base + counter` stays a positive 62-bit int — exactly
+    # representable in JSON/float64 and in the C client's int64_t.
+    salt = ((os.getpid() & 0x3FFF) << 16) | (time.time_ns() & 0xFFFF)
+    return (salt & 0x3FFFFFFF) << 32
+
+
+_ID_BASE = _mint_id_base()
+
 # process-wide request id stream: ids stay unique across batcher
-# restarts so a JSONL trace never aliases two requests
+# restarts so a JSONL trace never aliases two requests; the high-bit
+# prefix keeps them unique across PROCESSES too, so a --fleet N run's
+# merged traces never alias two backends' requests
 _req_ids = itertools.count(1)
 
 
+def request_id_base() -> int:
+    """This process's id prefix (high 30 bits of every minted id)."""
+    return _ID_BASE
+
+
 def next_request_id() -> int:
-    return next(_req_ids)
+    """Monotonic within the process, globally unique across a fleet."""
+    return _ID_BASE + next(_req_ids)
 
 
 def trace_sample_rate(env: Optional[str] = None) -> float:
@@ -56,14 +77,14 @@ class SpanRecorder:
     def __init__(self, component: str = "serve",
                  registry: Optional[_metrics.MetricsRegistry] = None,
                  sample: Optional[float] = None,
-                 path: Optional[str] = None):
+                 path: Optional[str] = None,
+                 metric: str = "paddle_tpu_serve_span_seconds",
+                 help: str = "Per-request span breakdown by stage "
+                             "(queue_wait, pad, execute, unpad), "
+                             "seconds."):
         reg = registry or _metrics.REGISTRY
         self.component = component
-        self._hist = reg.histogram(
-            "paddle_tpu_serve_span_seconds",
-            "Per-request span breakdown by stage (queue_wait, pad, "
-            "execute, unpad), seconds.",
-            labelnames=("stage",))
+        self._hist = reg.histogram(metric, help, labelnames=("stage",))
         self.sample = trace_sample_rate() if sample is None \
             else min(max(float(sample), 0.0), 1.0)
         self.path = os.environ.get("PADDLE_TPU_TRACE_FILE", "") \
@@ -81,13 +102,24 @@ class SpanRecorder:
         h = (int(req_id) * 2654435761) & 0xFFFFFFFF
         return (h / 2 ** 32) < self.sample
 
+    def observe_stage(self, stage: str, dur: float):
+        """Feed one extra stage observation into the histogram only —
+        for stages that overlap the spans passed to :meth:`record`
+        (e.g. a router's view of the backend's breakdown) and so must
+        not be double-counted into the trace line's ``total_s``."""
+        self._hist.labels(stage=stage).observe(max(float(dur), 0.0))
+
     def record(self, req_id: int, spans: Dict[str, float],
-               extra: Optional[dict] = None):
+               extra: Optional[dict] = None,
+               force: Optional[bool] = None):
         """Record one request's breakdown; ``spans`` maps stage name
-        (without the ``_s`` suffix) to seconds."""
+        (without the ``_s`` suffix) to seconds. ``force`` overrides the
+        sampling gate for the JSONL line (True: always emit, e.g. a
+        propagated trace context; False: histogram only)."""
         for stage, dur in spans.items():
             self._hist.labels(stage=stage).observe(max(float(dur), 0.0))
-        if not self.sampled(req_id):
+        emit = self.sampled(req_id) if force is None else bool(force)
+        if not emit:
             return
         line = {"ts": round(time.time(), 6),
                 "component": self.component,

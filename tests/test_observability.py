@@ -187,7 +187,19 @@ def test_collector_refreshes_and_broken_collector_is_isolated():
 
 def test_all_registered_metrics_lint():
     """Every family in the process-global registry follows the naming
-    convention and carries a non-empty help string."""
+    convention and carries a non-empty help string — including the
+    router span/poll and SLO families, which are force-registered here
+    so the lint covers them even when no router test ran first."""
+    from paddle_tpu.inference.router import _router_metrics
+    from paddle_tpu.observability import SLOEngine, TimeSeriesStore
+
+    _router_metrics()
+    SpanRecorder(component="router",
+                 metric="paddle_tpu_router_span_seconds",
+                 help="Router-side per-request span breakdown by stage, "
+                      "seconds.")
+    SLOEngine(TimeSeriesStore(), [])
+
     name_re = re.compile(r"^paddle_tpu_[a-z0-9_]+$")
     metrics = REGISTRY.metrics()
     assert len(metrics) >= 15, [m.name for m in metrics]
@@ -196,6 +208,13 @@ def test_all_registered_metrics_lint():
         assert m.help.strip(), m.name
         for ln in m.labelnames:
             assert re.match(r"^[a-z_][a-z0-9_]*$", ln), (m.name, ln)
+    names = {m.name for m in metrics}
+    assert {"paddle_tpu_router_span_seconds",
+            "paddle_tpu_router_poll_latency_seconds",
+            "paddle_tpu_router_poll_failures_total",
+            "paddle_tpu_router_backend_requests_total",
+            "paddle_tpu_slo_state",
+            "paddle_tpu_slo_burn_rate"} <= names, sorted(names)
 
 
 # -- monitor shims + hardened memory probes -------------------------------
@@ -566,3 +585,163 @@ def test_metrics_logger_jsonl(tmp_path):
         # fit() closed the stall watchdog on exit
         assert pipe._recorder._thread is None \
             or not pipe._recorder._thread.is_alive()
+
+
+# -- trace wire interop (PDI1 <-> PDI2) -----------------------------------
+
+def _dial(port):
+    import socket
+    s = socket.create_connection(("127.0.0.1", port))
+    s.settimeout(60)
+    return s
+
+
+def test_wire_interop_legacy_and_traced_clients(mlp_prefix, monkeypatch):
+    """One server, both dialects: a PDI1 client must get byte-exact
+    legacy frames back (old clients never see PDI2), while a PDI2
+    client's context comes back with the backend's ids and spans."""
+    from paddle_tpu.inference.serve import (InferenceServer,
+                                            read_reply_ctx, write_tensors)
+
+    monkeypatch.delenv("PADDLE_TPU_TRACE_SAMPLE", raising=False)
+    srv = InferenceServer(mlp_prefix, port=0, max_batch_size=4,
+                          metrics_port=0)
+    x = np.ones((2, 8), np.float32)
+    try:
+        # old client: no ctx out, no ctx back — reply is a PDI1 frame
+        with _dial(srv.port) as s:
+            write_tensors(s, [x])
+            out, err, ctx = read_reply_ctx(s)
+            assert err is None and ctx is None
+            assert out[0].shape == (2, 4)
+
+        # new client: trace id echoed, backend id + span breakdown attached
+        with _dial(srv.port) as s:
+            write_tensors(s, [x], ctx={"trace_id": 777})
+            out, err, ctx = read_reply_ctx(s)
+            assert err is None and out[0].shape == (2, 4)
+            assert ctx["trace_id"] == 777
+            assert ctx["request_id"] > 0
+            assert {"queue_wait_s", "pad_s", "execute_s",
+                    "unpad_s"} <= set(ctx["spans"])
+            # the breakdown is wall time, not placeholders
+            assert all(v >= 0.0 for v in ctx["spans"].values())
+
+        # both dialects interleave on ONE connection: the reply dialect
+        # follows each request, not the connection
+        with _dial(srv.port) as s:
+            write_tensors(s, [x], ctx={"trace_id": 1})
+            _, _, ctx1 = read_reply_ctx(s)
+            write_tensors(s, [x])
+            _, _, ctx2 = read_reply_ctx(s)
+            write_tensors(s, [x], ctx={"trace_id": 3})
+            _, _, ctx3 = read_reply_ctx(s)
+            assert ctx1["trace_id"] == 1 and ctx2 is None
+            assert ctx3["trace_id"] == 3
+            assert ctx3["request_id"] > ctx1["request_id"]
+
+        # capability is advertised so routers know to forward contexts
+        _, _, body = _get(f"http://127.0.0.1:{srv.metrics_port}/statusz")
+        assert json.loads(body)["trace_wire"] is True
+    finally:
+        srv.stop()
+
+
+def test_wire_error_frames_carry_trace_context(mlp_prefix, monkeypatch):
+    """A traced request that fails must come back as a PDI2 ERROR frame
+    with the context attached (trace id + the failing request's id), so
+    the router can finish the trace; an untraced failure stays PDI1."""
+    from paddle_tpu.inference.serve import (InferenceServer,
+                                            read_reply_ctx, write_tensors)
+
+    monkeypatch.delenv("PADDLE_TPU_TRACE_SAMPLE", raising=False)
+    srv = InferenceServer(mlp_prefix, port=0, max_batch_size=4)
+    x = np.ones((1, 8), np.float32)
+    try:
+        with _dial(srv.port) as s:       # wrong arity: typed error
+            write_tensors(s, [x, x], ctx={"trace_id": 555})
+            out, err, ctx = read_reply_ctx(s)
+            assert out is None and err is not None
+            assert ctx["trace_id"] == 555
+            assert ctx.get("request_id", 0) > 0
+
+        with _dial(srv.port) as s:       # legacy client, same failure
+            write_tensors(s, [x, x])
+            out, err, ctx = read_reply_ctx(s)
+            assert out is None and err is not None and ctx is None
+    finally:
+        srv.stop()
+
+
+def test_garbage_trace_context_does_not_fail_the_request(mlp_prefix):
+    """A PDI2 frame whose ctx bytes are not JSON must degrade to an
+    empty context, not kill the connection — trust the tensor payload,
+    never the metadata."""
+    import struct
+
+    from paddle_tpu.inference.serve import (MAGIC_TRACE, InferenceServer,
+                                            read_reply_ctx)
+
+    srv = InferenceServer(mlp_prefix, port=0, max_batch_size=4)
+    x = np.ones((1, 8), np.float32)
+    try:
+        with _dial(srv.port) as s:
+            garbage = b"\xff\xfenot json at all"
+            s.sendall(struct.pack("<II", MAGIC_TRACE, 1)
+                      + struct.pack("<I", len(garbage)) + garbage
+                      + struct.pack("<BB", 0, 2)
+                      + struct.pack("<2q", 1, 8) + x.tobytes())
+            out, err, ctx = read_reply_ctx(s)
+            assert err is None and out[0].shape == (1, 4)
+            assert ctx is not None       # still a PDI2 reply
+    finally:
+        srv.stop()
+
+
+def test_trace_jsonl_schema_stable_across_ok_and_error(
+        tmp_path, monkeypatch):
+    """The JSONL trace schema is a contract: ok lines and error lines
+    share the core keys (component, request_id, stage spans, total_s),
+    errors add the exception name — and the stage sum stays within the
+    observed wall latency on both paths."""
+    trace = tmp_path / "schema.jsonl"
+    monkeypatch.setenv("PADDLE_TPU_TRACE_SAMPLE", "1.0")
+    monkeypatch.setenv("PADDLE_TPU_TRACE_FILE", str(trace))
+
+    b = DynamicBatcher(FakePredictor(), max_batch_size=4,
+                       batch_timeout_ms=1.0)
+    t0 = time.perf_counter()
+    fut = b.submit([np.ones((1, 8), np.float32)])
+    fut.result(timeout=30)
+    ok_wall = time.perf_counter() - t0
+    b.stop()
+
+    def boom(arrays):
+        raise RuntimeError("kernel exploded")
+
+    b2 = DynamicBatcher(FakePredictor(boom), max_batch_size=4,
+                        batch_timeout_ms=1.0)
+    fut2 = b2.submit([np.ones((1, 8), np.float32)])
+    with pytest.raises(RuntimeError):
+        fut2.result(timeout=30)
+    b2.stop()
+
+    lines = [json.loads(ln) for ln in trace.read_text().splitlines()]
+    assert len(lines) == 2
+    ok_line = next(ln for ln in lines if "error" not in ln)
+    err_line = next(ln for ln in lines if "error" in ln)
+    for line in (ok_line, err_line):
+        assert line["component"] == "serve"
+        assert line["request_id"] > 0
+        assert "total_s" in line and line["total_s"] >= 0
+        span_keys = [k for k in line
+                     if k.endswith("_s") and k != "total_s"]
+        assert span_keys, line
+        assert sum(line[k] for k in span_keys) \
+            == pytest.approx(line["total_s"], abs=5e-6)
+    assert ok_line["request_id"] == fut.request_id
+    assert ok_line["total_s"] <= ok_wall + 0.02
+    assert {"queue_wait_s", "pad_s", "execute_s",
+            "unpad_s"} <= set(ok_line)
+    assert err_line["request_id"] == fut2.request_id
+    assert err_line["error"] == "RuntimeError"

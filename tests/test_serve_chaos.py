@@ -567,3 +567,68 @@ def test_sigterm_drains_subprocess_daemon(mlp_prefix):
         if proc.poll() is None:
             proc.kill()
             proc.wait(timeout=10)
+
+
+# -- router flight recorder -----------------------------------------------
+
+def test_router_stall_produces_flight_recorder_dump(tmp_path, monkeypatch):
+    """A router wedged mid-forward (backend accepted the request and
+    went silent) must write a stall dump naming the router, its backend
+    table, and the in-flight count — same black box the batcher gets."""
+    import json
+
+    monkeypatch.setenv("PADDLE_TPU_STALL_DUMP", str(tmp_path))
+    monkeypatch.setenv("PADDLE_TPU_STALL_TIMEOUT", "0.3")
+    monkeypatch.delenv("PADDLE_TPU_TRACE_SAMPLE", raising=False)
+
+    # a backend that accepts and reads but never replies
+    wedge = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    wedge.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    wedge.bind(("127.0.0.1", 0))
+    wedge.listen(8)
+    conns = []
+
+    def swallow():
+        while True:
+            try:
+                conn, _ = wedge.accept()
+            except OSError:
+                return
+            conns.append(conn)         # keep open, never answer
+
+    threading.Thread(target=swallow, daemon=True).start()
+
+    router = ServeRouter([Backend("127.0.0.1",
+                                  wedge.getsockname()[1])],
+                         port=0, poll_interval=0.05,
+                         failover_retries=0, forward_timeout=30.0)
+    try:
+        (bk,) = router.backends()
+        deadline = time.monotonic() + 10
+        while not bk.healthy and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert bk.healthy
+
+        x = np.ones((1, 8), np.float32)
+        threading.Thread(target=_ask,
+                         args=(router.port, x, 20.0),
+                         daemon=True).start()
+        deadline = time.monotonic() + 10
+        while not router._recorder.dumps \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert router._recorder.dumps, "no router stall dump written"
+        payload = json.loads(open(router._recorder.dumps[0]).read())
+        assert payload["label"] == "serve_router"
+        assert payload["stalled_for_s"] >= 0.3
+        assert payload["context"]["inflight_requests"] >= 1
+        assert payload["context"]["backends"][0]["key"] == bk.key
+        assert payload["threads"]      # stacks show where it wedged
+    finally:
+        router.stop()
+        wedge.close()
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass

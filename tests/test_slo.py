@@ -1,0 +1,632 @@
+"""Fleet-wide observability: TimeSeriesStore windowed queries (ring
+bound, rate/delta, histogram_quantile, frac_over), the SLO burn-rate
+engine (ok -> warning -> firing -> recovery over a synthetic clock),
+the /varz + /alertz admin routes on a live serve daemon, and the
+feedback loop into routing — a chaos-hung backend's /alertz goes
+firing, the router demotes it, traffic shifts, and it recovers."""
+import json
+import socket
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.inference.router import Backend, ServeRouter
+from paddle_tpu.inference.serve import (read_reply, read_reply_ctx,
+                                        read_request, write_tensors)
+from paddle_tpu.observability import (AdminServer, MetricsRegistry,
+                                      Objective, SLOEngine,
+                                      TimeSeriesStore, router_objectives,
+                                      serve_objectives)
+from paddle_tpu.static import InputSpec
+from paddle_tpu.testing import chaos
+
+
+def _get_json(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+def _wait_for(pred, timeout=10.0, interval=0.02, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+class SmallNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(8, 4)
+
+    def forward(self, x):
+        return self.fc(x)
+
+
+@pytest.fixture(scope="module")
+def mlp_prefix(tmp_path_factory):
+    paddle.seed(11)
+    prefix = str(tmp_path_factory.mktemp("slo_m") / "net")
+    paddle.jit.save(SmallNet(), prefix,
+                    input_spec=[InputSpec([None, 8], "float32")])
+    return prefix
+
+
+def _ask(port, x, timeout=60.0):
+    with socket.create_connection(("127.0.0.1", port)) as s:
+        s.settimeout(timeout)
+        write_tensors(s, [x])
+        return read_reply(s)
+
+
+# -- TimeSeriesStore -------------------------------------------------------
+
+def test_ring_is_bounded_and_never_grows():
+    reg = MetricsRegistry()
+    c = reg.counter("paddle_tpu_ts_total", "T.")
+    store = TimeSeriesStore(registry=reg, interval_s=1.0, capacity=8)
+    for i in range(100):
+        c.inc()
+        store.sample(now=float(i))
+    assert store.samples_len() == 8          # capacity, not sample count
+    assert store.capacity == 8
+    # the ring held the NEWEST snapshots: latest() sees the final value
+    assert store.latest("paddle_tpu_ts_total") == 100
+
+
+def test_delta_and_rate_windowed():
+    reg = MetricsRegistry()
+    c = reg.counter("paddle_tpu_ts_total", "T.")
+    store = TimeSeriesStore(registry=reg, interval_s=1.0, capacity=64)
+    for t in range(0, 60, 5):                # +10 every 5s -> 2/s
+        store.sample(now=float(t))
+        c.inc(10)
+    store.sample(now=60.0)
+    assert store.delta("paddle_tpu_ts_total", 10.0, now=60.0) \
+        == pytest.approx(20.0)
+    assert store.rate("paddle_tpu_ts_total", 10.0, now=60.0) \
+        == pytest.approx(2.0)
+    # window longer than history: best-effort from the oldest snapshot
+    assert store.delta("paddle_tpu_ts_total", 3600.0, now=60.0) \
+        == pytest.approx(120.0)
+    # absent series and empty window read as no traffic, not an error
+    assert store.delta("paddle_tpu_nope_total", 10.0, now=60.0) == 0.0
+
+
+def test_quantile_and_frac_over_interpolation():
+    reg = MetricsRegistry()
+    h = reg.histogram("paddle_tpu_ts_seconds", "T.", buckets=(0.1, 1.0))
+    store = TimeSeriesStore(registry=reg, interval_s=1.0, capacity=64)
+    store.sample(now=0.0)                    # baseline before traffic
+    for _ in range(80):
+        h.observe(0.05)
+    for _ in range(20):
+        h.observe(0.5)
+    store.sample(now=10.0)
+    key = "paddle_tpu_ts_seconds"
+    # p50: rank 50 of 80 inside (0, 0.1] -> 0.1 * 50/80
+    assert store.quantile(key, 0.50, 20.0, now=10.0) \
+        == pytest.approx(0.0625)
+    # p90: rank 90, 10 into the 20 of (0.1, 1.0] -> 0.55
+    assert store.quantile(key, 0.90, 20.0, now=10.0) \
+        == pytest.approx(0.55)
+    frac, count = store.frac_over(key, 0.1, 20.0, now=10.0)
+    assert count == 100 and frac == pytest.approx(0.2)
+    # nothing in the window -> (0, 0), never a division error
+    frac, count = store.frac_over(key, 0.1, 2.0, now=100.0)
+    assert (frac, count) == (0.0, 0)
+
+
+def test_varz_document_is_bounded():
+    reg = MetricsRegistry()
+    c = reg.counter("paddle_tpu_ts_total", "T.")
+    h = reg.histogram("paddle_tpu_ts_seconds", "T.", buckets=(0.1, 1.0))
+    store = TimeSeriesStore(registry=reg, interval_s=1.0, capacity=16)
+    for i in range(20):
+        c.inc(5)
+        h.observe(0.05)
+        store.sample(now=float(i))
+    v1 = store.varz()
+    assert v1["ring"]["samples"] == 16
+    assert set(v1["windows"]) == {"1m", "5m", "1h"}
+    series = v1["windows"]["1m"]["series"]
+    assert series["paddle_tpu_ts_total"]["last"] == 100
+    assert series["paddle_tpu_ts_total"]["delta"] > 0
+    assert series["paddle_tpu_ts_seconds"]["count_delta"] > 0
+    assert "p99_s" in series["paddle_tpu_ts_seconds"]
+    # histogram raw _sum/_count scalars are folded, not duplicated
+    assert "paddle_tpu_ts_seconds_sum" not in series
+    # the document does NOT grow with uptime: 10x more samples, same size
+    for i in range(200):
+        c.inc(5)
+        h.observe(0.05)
+        store.sample(now=20.0 + i)
+    v2 = store.varz()
+    assert v2["ring"]["samples"] == 16
+    assert len(json.dumps(v2)) < 2 * len(json.dumps(v1))
+
+
+def test_sampler_thread_start_stop_idempotent():
+    reg = MetricsRegistry()
+    reg.counter("paddle_tpu_ts_total", "T.").inc()
+    store = TimeSeriesStore(registry=reg, interval_s=0.05, capacity=8)
+    store.start()
+    store.start()                            # idempotent
+    _wait_for(lambda: store.samples_len() >= 2, timeout=5,
+              what="sampler snapshots")
+    store.stop()
+    n = store.samples_len()
+    time.sleep(0.2)
+    assert store.samples_len() == n          # really stopped
+
+
+# -- SLO engine ------------------------------------------------------------
+
+def _availability_engine(reg, store):
+    obj = Objective("avail", "availability", 0.999,
+                    total_keys=("paddle_tpu_q_total",),
+                    bad_keys=("paddle_tpu_qbad_total",))
+    return SLOEngine(store, [obj], windows=(10.0, 30.0),
+                     burn_factors=(2.0, 10.0), registry=reg)
+
+
+def test_slo_engine_ok_warning_firing_recovery():
+    reg = MetricsRegistry()
+    total = reg.counter("paddle_tpu_q_total", "Q.")
+    bad = reg.counter("paddle_tpu_qbad_total", "B.")
+    store = TimeSeriesStore(registry=reg, interval_s=5.0, capacity=64)
+    eng = _availability_engine(reg, store)
+
+    t = 0.0
+    store.sample(now=t)
+    # clean traffic: burn 0 -> ok
+    for _ in range(6):
+        t += 5
+        total.inc(100)
+        store.sample(now=t)
+    (v,) = eng.evaluate(now=t)
+    assert v["state"] == "ok" and v["burn"]["long"] == 0.0
+
+    # 0.5% bad (burn 5x budget): warning in BOTH windows
+    for _ in range(6):
+        t += 5
+        total.inc(200)
+        bad.inc(1)
+        store.sample(now=t)
+    (v,) = eng.evaluate(now=t)
+    assert v["state"] == "warning", v
+    assert 2.0 <= v["burn"]["short"] < 10.0
+
+    # 50% bad: burn 500x -> firing, with a reason string
+    for _ in range(6):
+        t += 5
+        total.inc(100)
+        bad.inc(50)
+        store.sample(now=t)
+    (v,) = eng.evaluate(now=t)
+    assert v["state"] == "firing" and v["reasons"]
+    assert reg.flat()['paddle_tpu_slo_state{slo="avail"}'] == 2
+
+    # clean again: the short window clears first, then the long one
+    for _ in range(8):
+        t += 5
+        total.inc(100)
+        store.sample(now=t)
+    (v,) = eng.evaluate(now=t)
+    assert v["state"] == "ok"
+    assert reg.flat()['paddle_tpu_slo_state{slo="avail"}'] == 0
+
+
+def test_slo_latency_objective_fires_on_slow_tail():
+    reg = MetricsRegistry()
+    h = reg.histogram("paddle_tpu_l_seconds", "L.", buckets=(0.05, 0.25))
+    store = TimeSeriesStore(registry=reg, interval_s=5.0, capacity=64)
+    obj = Objective("lat", "latency", 0.99, hist_key="paddle_tpu_l_seconds",
+                    threshold_s=0.05)
+    eng = SLOEngine(store, [obj], windows=(10.0, 30.0),
+                    burn_factors=(2.0, 10.0), registry=reg)
+    t = 0.0
+    store.sample(now=t)
+    for _ in range(6):                       # all fast: ok
+        t += 5
+        for _ in range(50):
+            h.observe(0.01)
+        store.sample(now=t)
+    (v,) = eng.evaluate(now=t)
+    assert v["state"] == "ok"
+    for _ in range(6):                       # 40% slow: firing
+        t += 5
+        for _ in range(30):
+            h.observe(0.01)
+        for _ in range(20):
+            h.observe(0.2)
+        store.sample(now=t)
+    (v,) = eng.evaluate(now=t)
+    assert v["state"] == "firing"
+    assert v["threshold_s"] == pytest.approx(0.05)
+
+
+def test_no_traffic_is_ok_not_firing():
+    """An idle service has spent no error budget — empty windows must
+    read as burn 0, not NaN or firing."""
+    reg = MetricsRegistry()
+    reg.counter("paddle_tpu_q_total", "Q.")
+    reg.counter("paddle_tpu_qbad_total", "B.")
+    store = TimeSeriesStore(registry=reg, interval_s=5.0, capacity=64)
+    eng = _availability_engine(reg, store)
+    (v,) = eng.evaluate(now=100.0)           # empty ring
+    assert v["state"] == "ok" and v["burn"]["long"] == 0.0
+    store.sample(now=0.0)
+    store.sample(now=50.0)
+    (v,) = eng.evaluate(now=50.0)
+    assert v["state"] == "ok"
+
+
+def test_default_objective_sets_and_env_knobs(monkeypatch):
+    monkeypatch.delenv("PADDLE_TPU_SLO_AVAILABILITY", raising=False)
+    monkeypatch.delenv("PADDLE_TPU_SLO_P99_MS", raising=False)
+    objs = serve_objectives()
+    assert [o.name for o in objs] == ["serve_availability"]
+    assert objs[0].target == pytest.approx(0.999)
+
+    monkeypatch.setenv("PADDLE_TPU_SLO_P99_MS", "250")
+    monkeypatch.setenv("PADDLE_TPU_SLO_AVAILABILITY", "0.99")
+    objs = serve_objectives()
+    assert [o.name for o in objs] == ["serve_availability",
+                                      "serve_latency"]
+    assert objs[0].target == pytest.approx(0.99)
+    assert objs[1].threshold_s == pytest.approx(0.25)
+
+    monkeypatch.setenv("PADDLE_TPU_SLO_AVAILABILITY", "off")
+    objs = router_objectives()
+    assert [o.name for o in objs] == ["router_latency"]
+
+    monkeypatch.setenv("PADDLE_TPU_SLO_WINDOWS", "30,600")
+    monkeypatch.setenv("PADDLE_TPU_SLO_BURN", "3,14")
+    from paddle_tpu.observability import slo_burn_factors, slo_windows
+    assert slo_windows() == (30.0, 600.0)
+    assert slo_burn_factors() == (3.0, 14.0)
+
+
+# -- live serve daemon: /varz, /alertz, chaos-hang -> firing -> recovery ---
+
+def test_serve_daemon_alertz_fires_under_chaos_hang_and_recovers(
+        mlp_prefix, monkeypatch):
+    """The acceptance loop, backend half: a Hang@ on batcher.dispatch
+    makes every request blow the latency SLO; /alertz must go firing
+    within two evaluation windows and return to ok once the hang
+    clears and the bad events age out of the windows."""
+    from paddle_tpu.inference.serve import InferenceServer
+
+    monkeypatch.setenv("PADDLE_TPU_VARZ_INTERVAL", "0.1")
+    monkeypatch.setenv("PADDLE_TPU_SLO_WINDOWS", "1,2")
+    monkeypatch.setenv("PADDLE_TPU_SLO_P99_MS", "50")
+    monkeypatch.setenv("PADDLE_TPU_SLO_BURN", "2,10")
+    monkeypatch.delenv("PADDLE_TPU_TRACE_SAMPLE", raising=False)
+
+    srv = InferenceServer(mlp_prefix, port=0, max_batch_size=4,
+                          metrics_port=0)
+    base = f"http://127.0.0.1:{srv.metrics_port}"
+    x = np.ones((1, 8), np.float32)
+    try:
+        out, err = _ask(srv.port, x)         # warm the bucket
+        assert err is None
+
+        # /varz is mounted and bounded (windows appear with the first
+        # sampler snapshot; don't race its 0.1s period)
+        _wait_for(lambda: _get_json(base + "/varz")["ring"]["samples"] > 0,
+                  timeout=10.0, what="first varz snapshot")
+        v = _get_json(base + "/varz")
+        assert v["ring"]["capacity"] >= 8
+        assert set(v["windows"]) == {"1m", "5m", "1h"}
+
+        a = _get_json(base + "/alertz")
+        assert a["windows_s"] == [1.0, 2.0]
+        names = [s["name"] for s in a["slos"]]
+        assert "serve_latency" in names
+
+        with chaos.inject("batcher.dispatch:1+:Hang@0.15"):
+            deadline = time.monotonic() + 12.0
+            state = None
+            while time.monotonic() < deadline:
+                # keep bad events flowing so BOTH windows stay hot
+                _ask(srv.port, x)
+                state = _get_json(base + "/alertz")["state"]
+                if state == "firing":
+                    break
+            assert state == "firing"
+            lat = [s for s in _get_json(base + "/alertz")["slos"]
+                   if s["name"] == "serve_latency"][0]
+            assert lat["state"] == "firing" and lat["reasons"]
+            assert lat["burn"]["long"] >= 10.0
+
+        # hang cleared: the 1s/2s windows age the bad events out
+        _wait_for(lambda: _get_json(base + "/alertz")["state"] == "ok",
+                  timeout=15.0, interval=0.2, what="alertz recovery")
+    finally:
+        srv.stop()
+
+
+# -- router feedback loop --------------------------------------------------
+
+class _StubBackend:
+    """Wire-protocol echo server + standalone admin plane whose /alertz
+    the test scripts — the router under test cannot tell it from a real
+    backend daemon."""
+
+    def __init__(self):
+        self.alert = {"state": "ok", "slos": []}
+        self.requests = 0
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(32)
+        self.port = self._srv.getsockname()[1]
+        self.admin = AdminServer(
+            port=0, registry=MetricsRegistry(),
+            status_fn=lambda: {"trace_wire": True},
+            alertz_fn=lambda: dict(self.alert))
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def _accept(self):
+        while True:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        with conn:
+            while True:
+                try:
+                    arrays, ctx = read_request(conn)
+                except Exception:
+                    return
+                self.requests += 1
+                time.sleep(0.005)        # cover the claimed span times
+                reply_ctx = None
+                if ctx is not None:
+                    reply_ctx = {"trace_id": ctx.get("trace_id"),
+                                 "request_id": 42,
+                                 "spans": {"queue_wait_s": 0.001,
+                                           "pad_s": 0.0,
+                                           "execute_s": 0.002,
+                                           "unpad_s": 0.0}}
+                try:
+                    write_tensors(conn, arrays, ctx=reply_ctx)
+                except Exception:
+                    return
+
+    def stop(self):
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        self.admin.stop()
+
+
+def test_router_demotes_firing_backend_and_recovers():
+    """The acceptance loop, router half: a backend whose /alertz says
+    firing is demoted in the load score — traffic share drops to zero —
+    and comes back once the alert clears."""
+    a, b = _StubBackend(), _StubBackend()
+    router = ServeRouter(
+        [Backend("127.0.0.1", a.port, a.admin.port),
+         Backend("127.0.0.1", b.port, b.admin.port)],
+        port=0, poll_interval=0.05)
+    try:
+        ba, bb = router.backends()
+        _wait_for(lambda: ba.trace_wire and bb.trace_wire,
+                  what="trace_wire learned from statusz")
+        assert ba.alert_state == "ok" and bb.alert_state == "ok"
+        assert ba.score() < 5.0
+
+        a.alert = {"state": "firing", "slos": []}
+        _wait_for(lambda: ba.alert_state == "firing",
+                  what="router to see the firing alert")
+        assert ba.score() >= 50.0            # demoted, not evicted
+        assert bb.score() < 5.0
+
+        x = np.ones((2, 3), np.float32)
+        a0, b0 = a.requests, b.requests
+        for _ in range(10):
+            out, err = _ask(router.port, x)
+            assert err is None and np.array_equal(out[0], x)
+        assert b.requests - b0 == 10         # all traffic shifted
+        assert a.requests == a0              # the burning backend: none
+
+        # firing is a score penalty, not unroutable: statusz still
+        # reports it healthy with the alert attached
+        snaps = {s["key"]: s for s in router._status()["backends"]}
+        assert snaps[ba.key]["alert_state"] == "firing"
+        assert snaps[ba.key]["healthy"] is True
+
+        a.alert = {"state": "ok", "slos": []}
+        _wait_for(lambda: ba.alert_state == "ok",
+                  what="alert to clear")
+        a1 = a.requests
+        for _ in range(10):
+            _ask(router.port, x)
+        assert a.requests > a1               # traffic share restored
+    finally:
+        router.stop()
+        a.stop()
+        b.stop()
+
+
+def test_router_assembles_trace_with_backend_breakdown(
+        tmp_path, monkeypatch):
+    """Sampled requests produce ONE JSONL line at the router joining
+    router stages (pick/forward/reply == observed latency) with the
+    backend's relayed breakdown; a PDI2 client gets the same context
+    echoed on the reply frame."""
+    trace = tmp_path / "router_trace.jsonl"
+    monkeypatch.setenv("PADDLE_TPU_TRACE_SAMPLE", "1.0")
+    monkeypatch.setenv("PADDLE_TPU_TRACE_FILE", str(trace))
+    stub = _StubBackend()
+    router = ServeRouter([Backend("127.0.0.1", stub.port,
+                                  stub.admin.port)],
+                         port=0, poll_interval=0.05)
+    try:
+        (bk,) = router.backends()
+        _wait_for(lambda: bk.trace_wire, what="trace_wire")
+        x = np.ones((1, 4), np.float32)
+
+        # legacy client: router-sampled trace, legacy reply frame
+        with socket.create_connection(("127.0.0.1", router.port)) as s:
+            s.settimeout(30)
+            write_tensors(s, [x])
+            out, err, ctx = read_reply_ctx(s)
+            assert err is None and ctx is None   # PDI1 in -> PDI1 out
+
+        # tracing client: its trace id wins and the reply carries the
+        # assembled spans
+        with socket.create_connection(("127.0.0.1", router.port)) as s:
+            s.settimeout(30)
+            write_tensors(s, [x], ctx={"trace_id": 123456})
+            out, err, ctx = read_reply_ctx(s)
+            assert err is None and ctx is not None
+            assert ctx["trace_id"] == 123456
+            assert ctx["backend"] == bk.key
+            assert ctx["backend_request_id"] == 42
+            assert ctx["spans"]["backend_execute_s"] \
+                == pytest.approx(0.002)
+            assert ctx["spans"]["pick_s"] >= 0.0
+
+        lines = [json.loads(ln)
+                 for ln in trace.read_text().splitlines()]
+        assert len(lines) == 2
+        for line in lines:
+            assert line["component"] == "router"
+            for k in ("pick_s", "forward_s", "reply_s", "total_s",
+                      "backend_total_s", "trace_id", "request_id",
+                      "outcome"):
+                assert k in line, (k, line)
+            assert line["outcome"] == "ok" and line["attempts"] == 1
+            assert line["backend"] == bk.key
+            # epsilon: the backend's stage sum is inside the router's
+            # forward span, so total >= backend_total always
+            assert line["total_s"] >= line["backend_total_s"]
+            assert line["total_s"] == pytest.approx(
+                line["pick_s"] + line["forward_s"] + line["reply_s"],
+                abs=5e-6)
+        assert lines[0]["client_traced"] is False
+        assert lines[1]["client_traced"] is True
+        assert lines[1]["trace_id"] == 123456
+        assert lines[0]["request_id"] != lines[1]["request_id"]
+    finally:
+        router.stop()
+        stub.stop()
+
+
+def test_router_never_sends_trace_frames_to_legacy_backend(
+        monkeypatch):
+    """New router, old backend: a backend that never advertised
+    trace_wire must only ever see PDI1 frames, even for traced
+    requests — interop with pre-trace daemons is byte-exact."""
+    import struct as _struct
+
+    from paddle_tpu.inference.serve import MAGIC
+    from paddle_tpu.utils.net import recv_exact
+
+    monkeypatch.setenv("PADDLE_TPU_TRACE_SAMPLE", "1.0")
+    monkeypatch.delenv("PADDLE_TPU_TRACE_FILE", raising=False)
+    seen_magics = []
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(8)
+
+    def legacy_server():
+        while True:
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            with conn:
+                try:
+                    while True:
+                        # a strict PDI1-only parser, like the C client's
+                        hdr = recv_exact(conn, 8, what="t")
+                        magic, n = _struct.unpack("<II", hdr)
+                        seen_magics.append(magic)
+                        if magic != MAGIC:
+                            return           # old server: garbage, hang up
+                        for _ in range(n):
+                            dt, nd = _struct.unpack(
+                                "<BB", recv_exact(conn, 2, what="t"))
+                            shape = _struct.unpack(
+                                f"<{nd}q",
+                                recv_exact(conn, 8 * nd, what="t"))
+                            count = int(np.prod(shape)) if shape else 1
+                            recv_exact(conn, count * 4, what="t")
+                        # legacy reply: one f32 scalar
+                        conn.sendall(
+                            _struct.pack("<II", MAGIC, 1)
+                            + _struct.pack("<BB", 0, 1)
+                            + _struct.pack("<q", 1)
+                            + np.zeros(1, np.float32).tobytes())
+                except (ConnectionError, ValueError, OSError):
+                    continue
+
+    threading.Thread(target=legacy_server, daemon=True).start()
+    port = srv.getsockname()[1]
+    router = ServeRouter([Backend("127.0.0.1", port)],  # no admin plane
+                         port=0, poll_interval=0.05)
+    try:
+        (bk,) = router.backends()
+        _wait_for(lambda: bk.healthy, what="dial-probe health")
+        assert bk.trace_wire is False
+        x = np.ones((1, 4), np.float32)
+        # even a PDI2 client request must reach the backend as PDI1
+        with socket.create_connection(("127.0.0.1", router.port)) as s:
+            s.settimeout(30)
+            write_tensors(s, [x], ctx={"trace_id": 9})
+            out, err, ctx = read_reply_ctx(s)
+            assert err is None and out is not None
+            # the client still gets its PDI2 reply with router spans
+            assert ctx is not None and ctx["trace_id"] == 9
+            assert "backend_total_s" not in str(ctx.get("spans", {}))
+        assert seen_magics and set(seen_magics) == {MAGIC}
+    finally:
+        router.stop()
+        srv.close()
+
+
+# -- cross-process request-id uniqueness -----------------------------------
+
+def test_request_ids_unique_across_processes():
+    """The fleet-aliasing fix: ids minted in different processes carry
+    different high-bit prefixes, so merged JSONL traces never alias."""
+    import subprocess
+    import sys
+
+    code = ("from paddle_tpu.observability.spans import "
+            "next_request_id, request_id_base; "
+            "print(request_id_base()); "
+            "print(' '.join(str(next_request_id()) for _ in range(50)))")
+    outs = [subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        check=True, timeout=120).stdout.split("\n") for _ in range(2)]
+    bases = [int(o[0]) for o in outs]
+    ids = [list(map(int, o[1].split())) for o in outs]
+    assert bases[0] != bases[1]              # distinct process prefixes
+    assert not set(ids[0]) & set(ids[1])     # ids never collide
+    for seq, base in zip(ids, bases):
+        assert seq == sorted(seq)            # monotonic within a process
+        assert all(i > base for i in seq)
+        assert all(i < 2 ** 62 for i in seq)  # int64/f64/JSON-safe
+
+    from paddle_tpu.observability.spans import (next_request_id,
+                                                request_id_base)
+    mine = {next_request_id() for _ in range(50)}
+    assert request_id_base() not in (bases[0], bases[1])
+    assert not mine & set(ids[0]) and not mine & set(ids[1])
