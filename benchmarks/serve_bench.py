@@ -21,6 +21,8 @@ CPU-safe: no accelerator reachable -> re-exec once on JAX_PLATFORMS=cpu
 parseable JSON with rc 0.
 
     python benchmarks/serve_bench.py [--requests 400] [--max-batch 16]
+    python benchmarks/serve_bench.py --decode   # continuous batching vs
+                                                # sequential generation
 """
 import argparse
 import json
@@ -159,6 +161,133 @@ def run_bench(args):
         # serve_* families only — the bench result stays shape-stable)
         "metrics": {k: v for k, v in REGISTRY.flat().items()
                     if k.startswith("paddle_tpu_serve_")},
+    }
+
+
+def run_decode_bench(args):
+    """Decode mode: continuous batching vs one-request-at-a-time
+    autoregressive generation on a tiny GPT (inference/decode.py).
+
+    Open loop: every prompt is submitted up front; the engine admits
+    them into free KV slots between steps. The baseline runs the SAME
+    engine code with max_slots=1 and gates each submit on the previous
+    completion — i.e. the naive serving loop. Contract: >= 2x aggregate
+    tokens/s at concurrency >= 8 with compile_count == 0 after warmup."""
+    import threading
+
+    from paddle_tpu import profiler
+    from paddle_tpu.inference.decode import DecodeEngine
+    from paddle_tpu.models.gpt import GPT, gpt_tiny
+    from paddle_tpu.observability import REGISTRY
+
+    cfg = gpt_tiny()
+    model = GPT(cfg)
+    rng = np.random.default_rng(args.seed)
+    n = args.decode_requests
+    prompts = [rng.integers(0, cfg.vocab_size,
+                            size=int(rng.integers(4, 25))).astype(np.int32)
+               for _ in range(n)]
+    max_new = args.decode_tokens
+
+    # --- baseline: one request at a time (slot pool of 1, next submit
+    # gated on the previous completion). Same kernels, same warmup.
+    base = DecodeEngine(model, max_slots=1, max_new_tokens=max_new)
+    base_warmup = base.warmup()
+    t0 = time.perf_counter()
+    base_tokens = 0
+    for p in prompts:
+        base_tokens += len(
+            base.submit(p, max_new_tokens=max_new).result(timeout=300))
+    base_s = time.perf_counter() - t0
+    base.stop()
+    base_tps = base_tokens / base_s if base_s > 0 else 0.0
+
+    # --- continuous batching: all prompts in flight at once, per-stream
+    # TTFT measured from submit to first token event.
+    eng = DecodeEngine(model, max_slots=args.decode_slots,
+                       max_new_tokens=max_new, max_pending=n)
+    warmup_compiles = eng.warmup()
+    c0 = len(profiler.compile_events())
+
+    ttfts, counts, errors = [], [], []
+    lock = threading.Lock()
+    occupancy_samples = []
+    run_done = threading.Event()
+
+    def sample_occupancy():
+        while not run_done.wait(0.005):
+            st = eng.stats()
+            if st["active"] or st["pending"]:
+                occupancy_samples.append(st["active"] / st["max_slots"])
+
+    def consume(prompt):
+        t_sub = time.perf_counter()
+        try:
+            stream = eng.submit(prompt, max_new_tokens=max_new)
+            got, first = 0, None
+            for _ev in stream.events(timeout=300):
+                if first is None:
+                    first = time.perf_counter() - t_sub
+                got += 1
+            with lock:
+                ttfts.append(first)
+                counts.append(got)
+        except Exception as e:
+            with lock:
+                errors.append(repr(e))
+
+    sampler = threading.Thread(target=sample_occupancy, daemon=True)
+    threads = [threading.Thread(target=consume, args=(p,), daemon=True)
+               for p in prompts]
+    t0 = time.perf_counter()
+    sampler.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600)
+    wall_s = time.perf_counter() - t0
+    run_done.set()
+    sampler.join(timeout=10)
+    steady_compiles = len(profiler.compile_events()) - c0
+    st = eng.stats()
+    eng.stop()
+
+    cont_tokens = sum(counts)
+    cont_tps = cont_tokens / wall_s if wall_s > 0 else 0.0
+    speedup = cont_tps / base_tps if base_tps > 0 else 0.0
+    ts = sorted(t for t in ttfts if t is not None)
+
+    def pct(q):
+        if not ts:
+            return 0.0
+        return round(ts[min(len(ts) - 1, int(q * len(ts)))] * 1e3, 3)
+
+    occ = round(sum(occupancy_samples) / len(occupancy_samples), 4) \
+        if occupancy_samples else 0.0
+    return {
+        "metric": "decode_throughput",
+        "value": round(cont_tps, 2),
+        "unit": "tokens/s",
+        # north star: >= 2x over one-request-at-a-time at >= 8 slots
+        "vs_baseline": round(speedup / 2.0, 3),
+        "requests": n,
+        "errors": errors[:5],
+        "decode_slots": args.decode_slots,
+        "max_new_tokens": max_new,
+        "continuous_tokens_per_s": round(cont_tps, 2),
+        "sequential_tokens_per_s": round(base_tps, 2),
+        "speedup": round(speedup, 3),
+        "tokens_per_s_per_request": round(cont_tps / n, 2) if n else 0.0,
+        "total_tokens": cont_tokens,
+        "ttft_p50_ms": pct(0.50),
+        "ttft_p95_ms": pct(0.95),
+        "slot_occupancy": occ,
+        "engine_steps": st["steps"],
+        "warmup_compiles": warmup_compiles,
+        "baseline_warmup_compiles": base_warmup,
+        "compile_count": steady_compiles,
+        "metrics": {k: v for k, v in REGISTRY.flat().items()
+                    if k.startswith("paddle_tpu_decode_")},
     }
 
 
@@ -378,6 +507,14 @@ def main():
     ap.add_argument("--max-batch", type=int, default=16)
     ap.add_argument("--batch-timeout-ms", type=float, default=2.0)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--decode", action="store_true",
+                    help="decode mode: continuous-batching token "
+                         "generation vs one-request-at-a-time on the "
+                         "KV-cache engine (tokens/s, TTFT, occupancy)")
+    ap.add_argument("--decode-requests", type=int, default=24)
+    ap.add_argument("--decode-slots", type=int, default=8)
+    ap.add_argument("--decode-tokens", type=int, default=32,
+                    help="(decode mode) new tokens per request")
     ap.add_argument("--router", type=int, default=0, metavar="N",
                     help="fleet mode: N backends behind the front "
                          "router, driven over the wire (0 = classic "
@@ -391,7 +528,12 @@ def main():
     args = ap.parse_args()
     _devices_or_cpu_fallback()
     try:
-        out = run_router_bench(args) if args.router else run_bench(args)
+        if args.decode:
+            out = run_decode_bench(args)
+        elif args.router:
+            out = run_router_bench(args)
+        else:
+            out = run_bench(args)
     except Exception as e:                       # rc-0 JSON contract
         _error_json(f"{type(e).__name__}: {str(e).splitlines()[0]}")
         return

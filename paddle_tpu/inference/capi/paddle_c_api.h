@@ -14,6 +14,12 @@
  * a PDI1 client never sees a byte it does not expect — no change here
  * is needed as servers upgrade.
  *
+ * Decode mode (serve --decode, docs/serving.md): per-token streaming
+ * rides the PDI2 dialect only. A PDI1 client posting an int32 token
+ * prompt to a decode daemon gets ONE reply frame carrying the fully
+ * accumulated generated tokens at server-default settings — again a
+ * frame layout this client already parses, so no change here either.
+ *
  * Build:  cc -o app app.c paddle_c_api.c
  * Use:
  *   PD_Predictor* p = PD_PredictorConnect("127.0.0.1", 9000);

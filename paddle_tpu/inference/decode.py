@@ -1,0 +1,599 @@
+"""Continuous-batching autoregressive decode engine (GPT KV-cache path).
+
+The DynamicBatcher serves stateless one-shot requests; LLM traffic is
+iterative — every request is a prefill followed by many single-token
+steps, and requests arrive and finish mid-flight. This engine is the
+token-level analog of the batcher's shape-bucket design:
+
+  * the compute core is `models.gpt.gpt_decode_fns` — `prefill` builds a
+    request's K/V panel in one pass, `decode_step` advances EVERY active
+    request one token through a fixed-capacity cache updated with
+    `lax.dynamic_update_slice`;
+  * both run through an `AotCache`, one executable per
+    (batch-rung x kv-capacity-rung) bucket, so after `warmup()` a
+    steady-state token stream compiles nothing (`profiler`'s compile
+    events make that checkable, as for the batcher);
+  * a slot pool bounds concurrent sequences. The slot count defaults
+    from `core.monitor.hbm_usage` — how many full-capacity KV panels fit
+    in a fraction of free HBM — with a fixed CPU fallback where the
+    stats read (0, 0);
+  * between steps the scheduler admits queued requests into free slots
+    and evicts finished ones (EOS / max-tokens / context full), then
+    re-packs the pool onto the smallest rung pair that holds the
+    survivors — a late request shares the running batch instead of
+    waiting behind it;
+  * sampling is host-side numpy (greedy, or temperature with optional
+    top-k), so the device graph stays deterministic per shape.
+
+Streams: `submit()` returns a `DecodeStream`; tokens are pushed as they
+are sampled (serve.py forwards them as incremental PDI2 frames), and a
+failed request gets a typed UNAVAILABLE while its batch-mates keep
+streaming — the same error-isolation contract as batched one-shot
+serving. Chaos site `decode.stream` fires per token delivery for drills.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+import queue
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import profiler
+from ..core import monitor
+from ..jit.compile_cache import AotCache
+from ..models.gpt import GPTConfig, gpt_decode_fns
+from ..observability import counter, gauge, histogram
+from ..observability.spans import SpanRecorder, next_request_id
+from ..testing import chaos
+from .batching import _WARMUP_SIG_CAP, bucket_ladder, next_bucket
+from .errors import (ERR_INVALID_ARGUMENT, ERR_RESOURCE_EXHAUSTED,
+                     ERR_UNAVAILABLE, TypedServeError)
+
+DEFAULT_MAX_SLOTS = 8          # CPU fallback when HBM stats are absent
+DEFAULT_MAX_NEW_TOKENS = 64
+_KV_LADDER_FLOOR = 16          # smallest kv-capacity rung worth compiling
+
+_METRICS = None
+
+
+def _decode_metrics():
+    """Register (idempotently) and return the paddle_tpu_decode_* family."""
+    global _METRICS
+    if _METRICS is None:
+        _METRICS = {
+            "tokens": counter(
+                "paddle_tpu_decode_tokens_total",
+                "Tokens sampled by the decode engine (prefill + steps)"),
+            "steps": counter(
+                "paddle_tpu_decode_steps_total",
+                "Batched decode steps executed (one per token column)"),
+            "prefills": counter(
+                "paddle_tpu_decode_prefills_total",
+                "Requests admitted through the prefill phase"),
+            "evictions": counter(
+                "paddle_tpu_decode_cache_evictions_total",
+                "KV-cache slot evictions by reason",
+                labelnames=("reason",)),
+            "occupancy": gauge(
+                "paddle_tpu_decode_slot_occupancy",
+                "Active sequences / slot-pool capacity (0..1)"),
+            "active": gauge(
+                "paddle_tpu_decode_active_requests",
+                "Sequences currently holding a KV slot"),
+            "prefill_latency": histogram(
+                "paddle_tpu_decode_prefill_latency_seconds",
+                "Prefill execution latency per admitted request"),
+            "step_latency": histogram(
+                "paddle_tpu_decode_step_latency_seconds",
+                "Batched decode-step execution latency"),
+            "ttft": histogram(
+                "paddle_tpu_decode_ttft_seconds",
+                "Submit-to-first-token latency per request"),
+        }
+    return _METRICS
+
+
+def kv_slot_bytes(cfg: GPTConfig, capacity: Optional[int] = None) -> int:
+    """HBM bytes one sequence's full K+V panel occupies at `capacity`."""
+    cap = capacity or cfg.max_seq_len
+    return cfg.layers * 2 * cap * cfg.heads * cfg.head_dim * 4
+
+
+def default_slot_count(cfg: GPTConfig, hbm_fraction: float = 0.5,
+                       fallback: int = DEFAULT_MAX_SLOTS) -> int:
+    """Size the slot pool from live HBM stats: how many full-capacity KV
+    panels fit in `hbm_fraction` of the free bytes. CPU (stats (0, 0))
+    gets the fixed fallback so tests and benches behave identically."""
+    used, limit = monitor.hbm_usage()
+    if limit <= 0:
+        return fallback
+    free = max(limit - used, 0) * hbm_fraction
+    return max(1, min(int(free // kv_slot_bytes(cfg)), 256))
+
+
+def kv_capacity_ladder(max_seq_len: int) -> List[int]:
+    """Powers of two from the floor up to (and including) max_seq_len."""
+    if max_seq_len <= _KV_LADDER_FLOOR:
+        return [int(max_seq_len)]
+    vals, v = [], _KV_LADDER_FLOOR
+    while v < max_seq_len:
+        vals.append(v)
+        v *= 2
+    vals.append(int(max_seq_len))
+    return sorted(set(vals))
+
+
+class DecodeStream:
+    """Consumer handle for one request's token stream.
+
+    Events arrive in order: zero or more ``("token", tok, eos)`` then
+    exactly one ``("done", tokens)`` — or a `TypedServeError` raised out
+    of `next_event` / `result` if the stream died (engine stop, chaos,
+    per-request failure)."""
+
+    def __init__(self, req_id: int, prompt: List[int]):
+        self.request_id = req_id
+        self.prompt = list(prompt)
+        self.tokens: List[int] = []      # generated so far (mirror)
+        self._q: queue.Queue = queue.Queue()
+        self._closed = False             # producer-side latch
+
+    # -- producer (engine thread) ------------------------------------
+    def _push_token(self, tok: int, eos: bool):
+        if not self._closed:
+            self.tokens.append(int(tok))
+            self._q.put(("token", int(tok), bool(eos)))
+
+    def _push_done(self):
+        if not self._closed:
+            self._closed = True
+            self._q.put(("done", list(self.tokens)))
+
+    def _push_error(self, err: TypedServeError):
+        if not self._closed:
+            self._closed = True
+            self._q.put(("error", err))
+
+    # -- consumer ----------------------------------------------------
+    def next_event(self, timeout: Optional[float] = None):
+        try:
+            ev = self._q.get(timeout=timeout)
+        except queue.Empty:
+            raise TypedServeError(
+                ERR_UNAVAILABLE,
+                f"decode stream {self.request_id}: no event within "
+                f"{timeout}s") from None
+        if ev[0] == "error":
+            raise ev[1]
+        return ev
+
+    def events(self, timeout: Optional[float] = None):
+        """Yield ("token", tok, eos) events until done; raises on error."""
+        while True:
+            ev = self.next_event(timeout=timeout)
+            if ev[0] == "done":
+                return
+            yield ev
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        """Block until the stream completes; returns generated tokens."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            left = None if deadline is None \
+                else max(deadline - time.monotonic(), 0.0)
+            ev = self.next_event(timeout=left)
+            if ev[0] == "done":
+                return ev[1]
+
+
+class _Req:
+    __slots__ = ("id", "prompt", "max_new", "temperature", "top_k",
+                 "eos_id", "stream", "cache_len", "last_tok", "generated",
+                 "row", "t_submit", "t_admit", "prefill_s", "_knp", "_vnp")
+
+    def __init__(self, prompt, max_new, temperature, top_k, eos_id):
+        self.id = next_request_id()
+        self.prompt = prompt
+        self.max_new = max_new
+        self.temperature = temperature
+        self.top_k = top_k
+        self.eos_id = eos_id
+        self.stream = DecodeStream(self.id, prompt)
+        self.cache_len = 0
+        self.last_tok = 0
+        self.generated: List[int] = []
+        self.row = -1
+        self.t_submit = time.monotonic()
+        self.t_admit = 0.0
+        self.prefill_s = 0.0
+        self._knp = None      # prefill K/V awaiting pool insertion
+        self._vnp = None
+
+
+class DecodeEngine:
+    """Slot-pool continuous batcher over the incremental GPT forward."""
+
+    def __init__(self, model=None, *, cfg: Optional[GPTConfig] = None,
+                 params: Optional[Dict] = None, eps: Optional[float] = None,
+                 max_slots: Optional[int] = None,
+                 max_new_tokens: int = DEFAULT_MAX_NEW_TOKENS,
+                 eos_id: Optional[int] = None,
+                 hbm_fraction: float = 0.5, seed: int = 0,
+                 max_pending: Optional[int] = None):
+        if model is not None:
+            from .. import framework
+            cfg = model.cfg
+            params = framework.param_arrays(model)
+            eps = model.ln_f._epsilon if eps is None else eps
+        if cfg is None or params is None:
+            raise ValueError("DecodeEngine needs a model or (cfg, params)")
+        self.cfg = cfg
+        self.eps = 1e-5 if eps is None else float(eps)
+        self.params = {k: jnp.asarray(v) for k, v in params.items()}
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_id = eos_id
+        self.max_slots = int(max_slots) if max_slots \
+            else default_slot_count(cfg, hbm_fraction)
+        self.max_pending = int(max_pending) if max_pending is not None \
+            else 4 * self.max_slots
+        self.batch_ladder = bucket_ladder(
+            self.max_slots, env=os.environ.get("PADDLE_TPU_DECODE_BUCKETS",
+                                               ""))
+        self.kv_ladder = kv_capacity_ladder(cfg.max_seq_len)
+
+        prefill_fn, step_fn = gpt_decode_fns(cfg, eps=self.eps)
+        self._prefill_aot = AotCache(jax.jit(prefill_fn), "decode.prefill")
+        self._step_aot = AotCache(jax.jit(step_fn), "decode.step")
+
+        self._m = _decode_metrics()
+        self._spans = SpanRecorder(
+            component="decode", metric="paddle_tpu_decode_span_seconds",
+            help="Decode request stage latency (queue/prefill/decode)")
+        self._rng = np.random.default_rng(seed)
+
+        self._pending: deque = deque()
+        self._active: List[_Req] = []
+        self._kdev = None            # [L, B_rung, kv_rung, nh, D]
+        self._vdev = None
+        self._need_rebuild = False
+        self._steps = 0
+        self._tokens = 0
+        self._stop = False
+        self._cond = threading.Condition()
+        self._thread = threading.Thread(
+            target=self._loop, name="decode-scheduler", daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------ API
+
+    def submit(self, prompt: Sequence[int], max_new_tokens=None,
+               temperature: float = 0.0, top_k: int = 0,
+               eos_id=None) -> DecodeStream:
+        toks = [int(t) for t in np.asarray(prompt, dtype=np.int64).reshape(-1)]
+        if not toks:
+            raise TypedServeError(ERR_INVALID_ARGUMENT, "empty prompt")
+        if any(t < 0 or t >= self.cfg.vocab_size for t in toks):
+            raise TypedServeError(
+                ERR_INVALID_ARGUMENT,
+                f"prompt token out of range [0, {self.cfg.vocab_size})")
+        if len(toks) >= self.cfg.max_seq_len:
+            raise TypedServeError(
+                ERR_INVALID_ARGUMENT,
+                f"prompt length {len(toks)} leaves no room to generate "
+                f"(max_seq_len={self.cfg.max_seq_len})")
+        req = _Req(toks,
+                   int(max_new_tokens or self.max_new_tokens),
+                   float(temperature), int(top_k),
+                   self.eos_id if eos_id is None else int(eos_id))
+        with self._cond:
+            if self._stop:
+                raise TypedServeError(ERR_UNAVAILABLE,
+                                      "decode engine stopped")
+            if len(self._pending) >= self.max_pending:
+                raise TypedServeError(
+                    ERR_RESOURCE_EXHAUSTED,
+                    f"decode queue full ({self.max_pending} pending)")
+            self._pending.append(req)
+            self._cond.notify_all()
+        return req.stream
+
+    def warmup(self, verbose: bool = False) -> int:
+        """AOT-compile the prefill prompt rungs and the decode
+        (batch-rung x kv-rung) cross product (capped, largest rungs
+        first dropped last). Returns the number of fresh compiles."""
+        before = len(profiler.compile_events())
+        L, nh, D = self.cfg.layers, self.cfg.heads, self.cfg.head_dim
+        i32, f32 = jnp.int32, jnp.float32
+        for r in self.kv_ladder:
+            self._prefill_aot.get_or_compile(
+                self.params,
+                jax.ShapeDtypeStruct((1, r), i32),
+                jax.ShapeDtypeStruct((1,), i32),
+                key=("prefill", 1, r))
+        sigs = [(b, r) for b in self.batch_ladder for r in self.kv_ladder]
+        if len(sigs) > _WARMUP_SIG_CAP:
+            sigs = sigs[:_WARMUP_SIG_CAP]
+        for b, r in sigs:
+            self._step_aot.get_or_compile(
+                self.params,
+                jax.ShapeDtypeStruct((L, b, r, nh, D), f32),
+                jax.ShapeDtypeStruct((L, b, r, nh, D), f32),
+                jax.ShapeDtypeStruct((b,), i32),
+                jax.ShapeDtypeStruct((b,), i32),
+                key=("step", b, r))
+        n = len(profiler.compile_events()) - before
+        if verbose:
+            print(f"DECODE WARMUP compiles={n} "
+                  f"prefill_rungs={self.kv_ladder} "
+                  f"step_sigs={len(sigs)}", flush=True)
+        return n
+
+    def stats(self) -> Dict:
+        return {
+            "active": len(self._active),
+            "pending": len(self._pending),
+            "max_slots": self.max_slots,
+            "steps": self._steps,
+            "tokens": self._tokens,
+            "batch_rung": 0 if self._kdev is None
+            else int(self._kdev.shape[1]),
+            "kv_rung": 0 if self._kdev is None
+            else int(self._kdev.shape[2]),
+            "batch_ladder": list(self.batch_ladder),
+            "kv_ladder": list(self.kv_ladder),
+        }
+
+    def stop(self):
+        """Stop the scheduler; open streams get typed UNAVAILABLE."""
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        self._thread.join(timeout=30)
+        leftovers = list(self._active) + list(self._pending)
+        self._active, self._pending = [], deque()
+        for req in leftovers:
+            req.stream._push_error(TypedServeError(
+                ERR_UNAVAILABLE, "decode engine stopped"))
+        self._m["active"].set(0)
+        self._m["occupancy"].set(0.0)
+        self._spans.close()
+
+    # ------------------------------------------------------- scheduler
+
+    def _loop(self):
+        while True:
+            newly = []
+            with self._cond:
+                while (not self._stop and not self._pending
+                       and not self._active):
+                    self._cond.wait(timeout=0.1)
+                if self._stop:
+                    return
+                free = self.max_slots - len(self._active)
+                while self._pending and free > 0:
+                    newly.append(self._pending.popleft())
+                    free -= 1
+            try:
+                # the next step writes K/V at row cache_len: grow to the
+                # next kv rung BEFORE dynamic_update_slice would clamp
+                # the write into the last row and corrupt the cache
+                if self._active and self._kdev is not None and \
+                        max(r.cache_len + 1 for r in self._active) \
+                        > int(self._kdev.shape[2]):
+                    self._need_rebuild = True
+                if newly or self._need_rebuild:
+                    admitted = [r for r in newly if self._admit(r)]
+                    self._rebuild(admitted)
+                if self._active:
+                    self._step_once()
+            except Exception as exc:  # engine-level failure: fail the
+                # batch (typed), drop the pool, keep serving newcomers
+                err = exc if isinstance(exc, TypedServeError) else \
+                    TypedServeError(ERR_UNAVAILABLE,
+                                    f"decode scheduler failure: {exc}")
+                for req in self._active:
+                    req.stream._push_error(err)
+                    self._m["evictions"].labels(reason="error").inc()
+                self._active = []
+                self._kdev = self._vdev = None
+                self._need_rebuild = False
+                self._update_gauges()
+
+    def _admit(self, req: _Req) -> bool:
+        """Prefill one request (B=1 at its prompt rung) and deliver the
+        first sampled token. True if it still needs a decode slot."""
+        plen = len(req.prompt)
+        rung = next_bucket(plen, self.kv_ladder)
+        toks = np.zeros((1, rung), np.int32)
+        toks[0, :plen] = req.prompt
+        exe = self._prefill_aot.get_or_compile(
+            self.params,
+            jax.ShapeDtypeStruct((1, rung), jnp.int32),
+            jax.ShapeDtypeStruct((1,), jnp.int32),
+            key=("prefill", 1, rung))
+        t0 = time.perf_counter()
+        logits, k, v = exe(self.params, jnp.asarray(toks),
+                           jnp.asarray([plen], np.int32))
+        row = np.asarray(logits)[0]
+        req.prefill_s = time.perf_counter() - t0
+        req.t_admit = time.monotonic()
+        self._m["prefills"].inc()
+        self._m["prefill_latency"].observe(req.prefill_s)
+        self._m["ttft"].observe(time.monotonic() - req.t_submit)
+        try:
+            chaos.maybe_fail("decode.stream", detail=req.id)
+            tok = self._sample(row, req)
+        except Exception as exc:
+            req.stream._push_error(TypedServeError(
+                ERR_UNAVAILABLE, f"decode stream killed: {exc}"))
+            self._m["evictions"].labels(reason="error").inc()
+            return False
+        req.cache_len = plen
+        req.last_tok = tok
+        req.generated.append(tok)
+        self._tokens += 1
+        self._m["tokens"].inc()
+        eos = req.eos_id is not None and tok == req.eos_id
+        req.stream._push_token(tok, eos)
+        if eos or len(req.generated) >= req.max_new \
+                or req.cache_len >= self.cfg.max_seq_len:
+            self._finish(req, "eos" if eos else "length")
+            return False
+        # keep only the real prompt columns; rung padding beyond plen is
+        # garbage K/V the pool must never inherit
+        req._knp = np.asarray(k)[:, 0, :plen]
+        req._vnp = np.asarray(v)[:, 0, :plen]
+        return True
+
+    def _rebuild(self, admitted: List[_Req]):
+        """Re-pack survivors + admissions onto the smallest rung pair."""
+        survivors = list(self._active)
+        k_old = None if self._kdev is None else np.asarray(self._kdev)
+        v_old = None if self._vdev is None else np.asarray(self._vdev)
+        actives = survivors + admitted
+        self._need_rebuild = False
+        if not actives:
+            self._active = []
+            self._kdev = self._vdev = None
+            self._update_gauges()
+            return
+        L, nh, D = self.cfg.layers, self.cfg.heads, self.cfg.head_dim
+        b_rung = next_bucket(len(actives), self.batch_ladder)
+        need = max(r.cache_len + 1 for r in actives)
+        kv_rung = next_bucket(need, self.kv_ladder)
+        knp = np.zeros((L, b_rung, kv_rung, nh, D), np.float32)
+        vnp = np.zeros_like(knp)
+        for j, req in enumerate(actives):
+            n = req.cache_len
+            if req._knp is not None:               # fresh admission
+                knp[:, j, :n] = req._knp
+                vnp[:, j, :n] = req._vnp
+                req._knp = req._vnp = None
+            else:                                  # survivor: old row
+                knp[:, j, :n] = k_old[:, req.row, :n]
+                vnp[:, j, :n] = v_old[:, req.row, :n]
+            req.row = j
+        self._active = actives
+        self._kdev = jnp.asarray(knp)
+        self._vdev = jnp.asarray(vnp)
+        self._update_gauges()
+
+    def _step_once(self):
+        reqs = self._active
+        L, b_rung, kv_rung = (self._kdev.shape[0], self._kdev.shape[1],
+                              self._kdev.shape[2])
+        ltok = np.zeros(b_rung, np.int32)
+        clen = np.zeros(b_rung, np.int32)
+        for req in reqs:
+            ltok[req.row] = req.last_tok
+            clen[req.row] = req.cache_len
+        if int(clen.max()) + 1 > kv_rung:
+            raise RuntimeError(
+                f"decode step would overflow kv capacity {kv_rung} "
+                f"(cache_len {int(clen.max())}) — rebuild missed")
+        exe = self._step_aot.get_or_compile(
+            self.params, self._kdev, self._vdev,
+            jax.ShapeDtypeStruct((b_rung,), jnp.int32),
+            jax.ShapeDtypeStruct((b_rung,), jnp.int32),
+            key=("step", b_rung, kv_rung))
+        t0 = time.perf_counter()
+        logits, self._kdev, self._vdev = exe(
+            self.params, self._kdev, self._vdev,
+            jnp.asarray(ltok), jnp.asarray(clen))
+        lognp = np.asarray(logits)
+        self._m["step_latency"].observe(time.perf_counter() - t0)
+        self._steps += 1
+        self._m["steps"].inc()
+        finished = []
+        for req in reqs:
+            req.cache_len += 1
+            try:
+                chaos.maybe_fail("decode.stream", detail=req.id)
+                tok = self._sample(lognp[req.row], req)
+            except Exception as exc:
+                req.stream._push_error(TypedServeError(
+                    ERR_UNAVAILABLE, f"decode stream killed: {exc}"))
+                self._m["evictions"].labels(reason="error").inc()
+                finished.append(req)
+                continue
+            req.generated.append(tok)
+            req.last_tok = tok
+            self._tokens += 1
+            self._m["tokens"].inc()
+            eos = req.eos_id is not None and tok == req.eos_id
+            req.stream._push_token(tok, eos)
+            if eos or len(req.generated) >= req.max_new \
+                    or req.cache_len >= self.cfg.max_seq_len:
+                self._finish(req, "eos" if eos else "length")
+                finished.append(req)
+        if finished:
+            self._active = [r for r in reqs if r not in finished]
+            self._need_rebuild = True
+            self._update_gauges()
+
+    def _finish(self, req: _Req, reason: str):
+        req.stream._push_done()
+        self._m["evictions"].labels(reason=reason).inc()
+        now = time.monotonic()
+        self._spans.record(req.id, {
+            "queue": req.t_admit - req.t_submit,
+            "prefill": req.prefill_s,
+            "decode": now - req.t_admit,
+        }, extra={"tokens": len(req.generated),
+                  "prompt_len": len(req.prompt)})
+
+    def _sample(self, row: np.ndarray, req: _Req) -> int:
+        if req.temperature <= 0.0:
+            return int(np.argmax(row))
+        logits = row.astype(np.float64) / max(req.temperature, 1e-6)
+        if 0 < req.top_k < logits.shape[0]:
+            kth = np.partition(logits, -req.top_k)[-req.top_k]
+            logits = np.where(logits >= kth, logits, -np.inf)
+        logits -= logits.max()
+        p = np.exp(logits)
+        p /= p.sum()
+        return int(self._rng.choice(logits.shape[0], p=p))
+
+    def _update_gauges(self):
+        n = len(self._active)
+        self._m["active"].set(n)
+        self._m["occupancy"].set(n / max(self.max_slots, 1))
+
+
+# ------------------------------------------------------------ artifact
+
+def save_for_decode(model, prefix: str):
+    """Persist a GPT for the decode daemon: config JSON + params npz
+    (the jit.save one-shot artifact has no incremental entry points)."""
+    from .. import framework
+    meta = {"config": dataclasses.asdict(model.cfg),
+            "eps": float(model.ln_f._epsilon),
+            "format": "paddle_tpu.decode.v1"}
+    with open(prefix + ".decode.json", "w") as f:
+        json.dump(meta, f, indent=2, sort_keys=True)
+    params = {k: np.asarray(v)
+              for k, v in framework.param_arrays(model).items()}
+    np.savez(prefix + ".decode.npz", **params)
+
+
+def load_for_decode(prefix: str, **engine_kw) -> DecodeEngine:
+    """Load a `save_for_decode` artifact into a ready DecodeEngine."""
+    with open(prefix + ".decode.json") as f:
+        meta = json.load(f)
+    if meta.get("format") != "paddle_tpu.decode.v1":
+        raise ValueError(f"{prefix}.decode.json: not a decode artifact")
+    cfg = GPTConfig(**meta["config"])
+    with np.load(prefix + ".decode.npz") as z:
+        params = {k: z[k] for k in z.files}
+    return DecodeEngine(cfg=cfg, params=params, eps=meta.get("eps"),
+                        **engine_kw)

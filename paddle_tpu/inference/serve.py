@@ -61,8 +61,8 @@ from concurrent.futures import TimeoutError as FuturesTimeout
 import numpy as np
 
 from ..testing import chaos
-from .errors import (ERR_DEADLINE_EXCEEDED, ERR_INVALID_ARGUMENT,
-                     TypedServeError)
+from .errors import (ERR_DEADLINE_EXCEEDED, ERR_INTERNAL,
+                     ERR_INVALID_ARGUMENT, TypedServeError)
 
 MAGIC = 0x31494450          # 'PDI1'
 MAGIC_TRACE = 0x32494450    # 'PDI2': header is followed by a trace ctx
@@ -243,6 +243,38 @@ def read_reply(sock, max_bytes=None):
     return arrays, err
 
 
+def decode_request(sock, prompt, opts=None, trace=True,
+                   on_token=None, max_bytes=None):
+    """Client half of the decode wire exchange on an open socket.
+
+    Sends the prompt (int32 [T]); with ``trace=True`` the request is a
+    'PDI2' frame (``opts`` rides in its ``decode`` context field) and
+    the server streams per-token frames — ``on_token(tok, stream_ctx)``
+    fires for each — before the final accumulated frame. ``trace=False``
+    sends legacy 'PDI1' and blocks for the single accumulated reply.
+    Returns the generated tokens as a list; raises TypedServeError on a
+    typed error frame (mid-stream or otherwise)."""
+    from .errors import error_code
+    arr = np.asarray(prompt, np.int32).reshape(-1)
+    ctx = None
+    if trace:
+        ctx = {"trace_id": f"decode-{os.getpid()}-{id(arr):x}"}
+        if opts:
+            ctx["decode"] = dict(opts)
+    write_tensors(sock, [arr], ctx=ctx)
+    while True:
+        arrays, err, rctx = read_reply_ctx(sock, max_bytes)
+        if err is not None:
+            code = error_code(err)
+            detail = err.split(":", 1)[1].strip() if code else err
+            raise TypedServeError(code or ERR_INTERNAL, detail)
+        stream = (rctx or {}).get("stream") or {}
+        if not trace or stream.get("done"):
+            return [int(t) for t in np.asarray(arrays[0]).reshape(-1)]
+        if on_token is not None:
+            on_token(int(np.asarray(arrays[0]).reshape(-1)[0]), stream)
+
+
 def _idle_timeout_default() -> float:
     try:
         return float(os.environ.get("PADDLE_TPU_SERVE_IDLE_TIMEOUT", "600"))
@@ -291,19 +323,37 @@ class InferenceServer:
                  warmup: bool = False, idle_timeout: float = None,
                  stats_interval: float = 0.0, request_timeout: float = None,
                  trailing: str = None, metrics_port: int = None,
-                 max_queue: int = None):
+                 max_queue: int = None, decode: bool = False,
+                 decode_slots: int = None, decode_max_new: int = None):
         # loopback by default: the daemon is unauthenticated — exposing a
         # model to the network segment must be an explicit --host choice
-        from . import Config, PredictorPool, create_predictor
-        cfg = Config(model_prefix)
         if max_batch_size is None:
             max_batch_size = int(os.environ.get("PADDLE_TPU_SERVE_BATCH",
                                                 "0") or 0)
-        self._batched = max_batch_size and int(max_batch_size) > 1
+        self._batched = (not decode) and max_batch_size \
+            and int(max_batch_size) > 1
         self._batcher = None
+        self._engine = None          # continuous-batching decode engine
         self.warmup_compiles = 0
-        if self._batched:
+        if decode:
+            # autoregressive decode mode: the token-level continuous
+            # batcher (inference/decode.py) replaces the one-shot
+            # predictor; requests are token prompts, replies are token
+            # streams (PDI2) or one accumulated frame (PDI1)
+            from .decode import load_for_decode
+            kw = {}
+            if decode_slots:
+                kw["max_slots"] = int(decode_slots)
+            if decode_max_new:
+                kw["max_new_tokens"] = int(decode_max_new)
+            self._engine = load_for_decode(model_prefix, **kw)
+            self._predictor = None
+            if warmup:
+                self.warmup_compiles = self._engine.warmup(verbose=True)
+        elif self._batched:
+            from . import Config, PredictorPool
             from .batching import DynamicBatcher
+            cfg = Config(model_prefix)
             pool = PredictorPool(cfg, size=max(int(pool_size), 1),
                                  devices="auto" if int(pool_size) > 1
                                  else None)
@@ -316,7 +366,8 @@ class InferenceServer:
             if warmup:
                 self.warmup_compiles = self._batcher.warmup()
         else:
-            self._predictor = create_predictor(cfg)
+            from . import Config, create_predictor
+            self._predictor = create_predictor(Config(model_prefix))
         self._lock = threading.Lock()
         self._idle_timeout = _idle_timeout_default() \
             if idle_timeout is None else float(idle_timeout)
@@ -387,6 +438,9 @@ class InferenceServer:
             reasons.append("draining")
         elif not self._thread.is_alive():
             reasons.append("accept thread dead")
+        if self._engine is not None \
+                and not self._engine._thread.is_alive():
+            reasons.append("decode scheduler thread dead")
         if self._batcher is not None:
             if not self._batcher.dispatcher_alive:
                 reasons.append("dispatcher thread dead")
@@ -407,7 +461,8 @@ class InferenceServer:
         from ..core import monitor
 
         st = {
-            "engine": "batched" if self._batched else "serialized",
+            "engine": "decode" if self._engine is not None
+            else ("batched" if self._batched else "serialized"),
             "port": self.port,
             "metrics_port": self.metrics_port,
             # capability flag the router gates trace propagation on: a
@@ -426,6 +481,8 @@ class InferenceServer:
             "serve": profiler.serve_stats(),
             "device_memory": monitor.all_device_memory_stats(),
         }
+        if self._engine is not None:
+            st["decode"] = self._engine.stats()
         if self._batcher is not None:
             st["batcher"] = {
                 "ladder": self._batcher.ladder,
@@ -505,6 +562,89 @@ class InferenceServer:
                             for k, v in spans.items()}
         return out
 
+    def _serve_decode(self, conn, inputs, ctx):
+        """One decode request on an open connection.
+
+        PDI2 clients get a PDI2 frame per sampled token — one int32 [1]
+        tensor, ctx ``{"stream": {"seq": i, "eos": bool, "done": false}}``
+        — then a final done frame carrying the full accumulated sequence
+        (``{"stream": {"done": true, "n_tokens": n}}``). PDI1 clients
+        get exactly one legacy frame with the accumulated tokens:
+        byte-identical framing to a one-shot reply, so pre-decode
+        clients (including the C client) work unchanged. A stream that
+        dies mid-flight becomes a typed error frame on the same
+        connection. Returns False when the socket is unusable."""
+        opts = {}
+        if ctx is not None and isinstance(ctx.get("decode"), dict):
+            d = ctx["decode"]
+            for key in ("max_new_tokens", "top_k", "eos_id"):
+                if d.get(key) is not None:
+                    opts[key] = int(d[key])
+            if d.get("temperature") is not None:
+                opts["temperature"] = float(d["temperature"])
+
+        def _sctx(stream_fields, req_id=None):
+            if ctx is None:
+                return None
+            out = {"stream": stream_fields}
+            if ctx.get("trace_id") is not None:
+                out["trace_id"] = ctx.get("trace_id")
+            if req_id is not None:
+                out["request_id"] = int(req_id)
+            return out
+
+        try:
+            if len(inputs) != 1:
+                raise TypedServeError(
+                    ERR_INVALID_ARGUMENT,
+                    f"decode request wants exactly one prompt tensor, "
+                    f"got {len(inputs)}")
+            prompt = np.asarray(inputs[0])
+            if prompt.dtype not in (np.int32, np.int64) \
+                    or prompt.ndim not in (1, 2) \
+                    or (prompt.ndim == 2 and prompt.shape[0] != 1):
+                raise TypedServeError(
+                    ERR_INVALID_ARGUMENT,
+                    "decode prompt must be int32/int64 [T] or [1, T]")
+            stream = self._engine.submit(prompt.reshape(-1), **opts)
+        except TypedServeError as e:
+            try:
+                write_error(conn, str(e),
+                            ctx=_sctx({"done": True, "error": True}))
+            except OSError:
+                pass
+            return True          # frame fully consumed; keep the conn
+        timeout = self._request_timeout \
+            if self._request_timeout and self._request_timeout > 0 else None
+        seq = 0
+        try:
+            while True:
+                ev = stream.next_event(timeout=timeout)
+                if ev[0] == "done":
+                    final = np.asarray(ev[1], np.int32)
+                    write_tensors(conn, [final],
+                                  ctx=_sctx({"done": True,
+                                             "n_tokens": int(final.size)},
+                                            stream.request_id))
+                    return True
+                _, tok, eos = ev
+                if ctx is not None:
+                    write_tensors(
+                        conn, [np.asarray([tok], np.int32)],
+                        ctx=_sctx({"seq": seq, "eos": bool(eos),
+                                   "done": False}, stream.request_id))
+                seq += 1
+        except TypedServeError as e:
+            try:
+                write_error(conn, str(e),
+                            ctx=_sctx({"done": True, "error": True,
+                                       "seq": seq}))
+            except OSError:
+                pass
+            return True
+        except (ConnectionError, TimeoutError, OSError):
+            return False
+
     def _serve_conn(self, conn):
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         # per-connection idle timeout: a dead client must not pin a
@@ -534,25 +674,30 @@ class InferenceServer:
                 with self._conn_lock:
                     self._conn_inflight += 1
                 try:
-                    try:
-                        outputs, fut = self._run(inputs)
-                        chaos.maybe_fail("serve.conn.reply")
-                        write_tensors(conn, outputs,
-                                      ctx=self._reply_ctx(ctx, fut))
-                    except (ConnectionError, TimeoutError):
-                        return
-                    except Exception as e:   # model-side error -> client
-                        if getattr(e, "code", None):
-                            msg = str(e)     # typed: frame leads with CODE
-                        else:
-                            msg = f"{type(e).__name__}: {e}"
-                        rid = getattr(e, "request_id", None)
-                        if rid:
-                            # the id a sampled span trace / stall dump
-                            # carries
-                            msg += f" [request_id={rid}]"
-                        write_error(conn, msg,
-                                    ctx=self._reply_ctx(ctx, None, exc=e))
+                    if self._engine is not None:
+                        if not self._serve_decode(conn, inputs, ctx):
+                            return
+                    else:
+                        try:
+                            outputs, fut = self._run(inputs)
+                            chaos.maybe_fail("serve.conn.reply")
+                            write_tensors(conn, outputs,
+                                          ctx=self._reply_ctx(ctx, fut))
+                        except (ConnectionError, TimeoutError):
+                            return
+                        except Exception as e:  # model-side error -> client
+                            if getattr(e, "code", None):
+                                msg = str(e)  # typed: frame leads with CODE
+                            else:
+                                msg = f"{type(e).__name__}: {e}"
+                            rid = getattr(e, "request_id", None)
+                            if rid:
+                                # the id a sampled span trace / stall dump
+                                # carries
+                                msg += f" [request_id={rid}]"
+                            write_error(conn, msg,
+                                        ctx=self._reply_ctx(ctx, None,
+                                                            exc=e))
                 finally:
                     with self._conn_lock:
                         self._conn_inflight -= 1
@@ -601,7 +746,11 @@ class InferenceServer:
         drained = False
         while time.monotonic() < deadline:
             busy = self.inflight_requests > 0 or (
-                self._batcher is not None and self._batcher.inflight > 0)
+                self._batcher is not None
+                and self._batcher.inflight > 0) or (
+                self._engine is not None
+                and (self._engine.stats()["active"]
+                     + self._engine.stats()["pending"]) > 0)
             if not busy:
                 drained = True
                 break
@@ -617,6 +766,8 @@ class InferenceServer:
             self._admin.stop()
         if self._batcher is not None:
             self._batcher.stop()
+        if self._engine is not None:
+            self._engine.stop()
         try:
             self._srv.shutdown(socket.SHUT_RDWR)
         except OSError:
@@ -684,6 +835,21 @@ def main(argv=None):
                          "are shed with a RESOURCE_EXHAUSTED frame "
                          "instead of queueing unboundedly (default "
                          "PADDLE_TPU_SERVE_MAX_QUEUE or off)")
+    ap.add_argument("--decode", action="store_true",
+                    help="autoregressive decode mode: load a "
+                         "decode.save_for_decode artifact and serve "
+                         "token streams through the continuous-batching "
+                         "KV-cache engine (PDI2 clients stream per-token "
+                         "frames; PDI1 clients get one accumulated "
+                         "reply). docs/serving.md#continuous-batching-"
+                         "decode")
+    ap.add_argument("--decode-slots", type=int, default=None,
+                    help="(decode) KV-cache slot-pool size — concurrent "
+                         "sequences; default sized from free HBM "
+                         "(core.monitor), fixed fallback of 8 on CPU")
+    ap.add_argument("--decode-max-new", type=int, default=None,
+                    help="(decode) default max new tokens per request "
+                         "when the client does not specify one")
     ap.add_argument("--router", action="store_true",
                     help="run the health-aware front router instead of a "
                          "backend: load-balance the wire protocol across "
@@ -728,7 +894,9 @@ def main(argv=None):
                           request_timeout=args.request_timeout,
                           trailing=args.trailing,
                           metrics_port=args.metrics_port,
-                          max_queue=args.max_queue)
+                          max_queue=args.max_queue, decode=args.decode,
+                          decode_slots=args.decode_slots,
+                          decode_max_new=args.decode_max_new)
     if args.warmup:
         print(f"WARMUP compiles={srv.warmup_compiles}", flush=True)
     if srv.metrics_port is not None:

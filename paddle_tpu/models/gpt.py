@@ -937,3 +937,130 @@ def gpt_param_shardings(params, mesh_axis_tp="tp"):
             spec = P()                                   # replicate ln/bias
         specs[name] = P(None, *spec) if stacked else spec
     return specs
+
+
+# ---------------------------------------------------------------------------
+# Incremental (KV-cache) decode forward — inference/decode.py's compute core
+# ---------------------------------------------------------------------------
+
+def split_decode_params(params, cfg: GPTConfig):
+    """Split a GPT functional param dict into (embed, [block_i], head)
+    for the decode fns, accepting BOTH parameter layouts a GPT instance
+    can produce: per-block indexed names ("blocks.3.attn.qkv.weight")
+    and the scan-stacked layout ("blocks.attn.qkv.weight" with a leading
+    [layers] axis). Slicing the stack here keeps the decode step a plain
+    Python loop over layers — each step traces once per shape rung, so
+    scan's compile-time advantage does not apply."""
+    import re
+    embed = {k: v for k, v in params.items()
+             if k.startswith(("wte.", "wpe."))}
+    head = {k: v for k, v in params.items() if k.startswith("ln_f.")}
+    stacked = {k[len("blocks."):]: v for k, v in params.items()
+               if k.startswith("blocks.")
+               and not re.match(r"blocks\.\d+\.", k)}
+    blocks = []
+    if stacked:
+        for i in range(cfg.layers):
+            blocks.append({rel: v[i] for rel, v in stacked.items()})
+    else:
+        for i in range(cfg.layers):
+            pref = f"blocks.{i}."
+            blocks.append({k[len(pref):]: v for k, v in params.items()
+                           if k.startswith(pref)})
+    return embed, blocks, head
+
+
+def gpt_decode_fns(cfg: GPTConfig, eps: float = 1e-5):
+    """Pure `(prefill, decode_step)` over the functional param dict.
+
+    prefill(params, tokens [B,T] i32, lens [B] i32)
+        -> (logits [B,V] at each row's position lens-1,
+            k, v    [layers, B, T, heads, head_dim])
+    decode_step(params, k, v, last_tok [B] i32, cache_len [B] i32)
+        -> (logits [B,V], k, v) — writes the new token's K/V at row
+           index cache_len via lax.dynamic_update_slice, attends the
+           masked prefix 0..cache_len, so one executable serves every
+           occupancy of a (batch-rung x kv-capacity-rung) bucket.
+
+    The math mirrors the pipeline block cores above (same op order as
+    F.scaled_dot_product_attention's XLA path: f32 scores, -1e30 mask,
+    f32 softmax, exact gelu), so prefill+N steps reproduce the full
+    forward within fp32 tolerance — tests/test_decode.py enforces it.
+    Rows past `lens` / inactive slots compute garbage that causality and
+    the cache_len mask keep out of every live row's logits.
+    """
+    if cfg.moe_experts > 0:
+        raise NotImplementedError(
+            "gpt_decode_fns: MoE blocks have no KV-decode path yet")
+    D = cfg.head_dim
+    nh = cfg.heads
+    scale = 1.0 / math.sqrt(D)
+
+    def _ffn(bp, x):
+        h2 = _pp_ln(x, bp["ln2.weight"], bp["ln2.bias"], eps)
+        m = jax.nn.gelu(h2 @ bp["fc1.weight"] + bp["fc1.bias"],
+                        approximate=False)
+        return x + m @ bp["fc2.weight"] + bp["fc2.bias"]
+
+    def prefill(params, tokens, lens):
+        embed, blocks, head = split_decode_params(params, cfg)
+        B, T = tokens.shape
+        pos = jnp.arange(T, dtype=jnp.int32)
+        x = embed["wte.weight"][tokens] + embed["wpe.weight"][pos]
+        ks, vs = [], []
+        causal = jnp.tril(jnp.ones((T, T), bool))
+        for bp in blocks:
+            h1 = _pp_ln(x, bp["ln1.weight"], bp["ln1.bias"], eps)
+            qkv = h1 @ bp["attn.qkv.weight"] + bp["attn.qkv.bias"]
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(B, T, nh, D)
+            k = k.reshape(B, T, nh, D)
+            v = v.reshape(B, T, nh, D)
+            ks.append(k)
+            vs.append(v)
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+            s = s.astype(jnp.float32)
+            s = jnp.where(causal[None, None], s, -1e30)
+            p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+            o = jnp.einsum("bhqk,bkhd->bqhd", p, v).reshape(B, T, -1)
+            x = x + o @ bp["attn.proj.weight"] + bp["attn.proj.bias"]
+            x = _ffn(bp, x)
+        xf = _pp_ln(x, head["ln_f.weight"], head["ln_f.bias"], eps)
+        last = jnp.clip(lens.astype(jnp.int32) - 1, 0, T - 1)
+        xl = jnp.take_along_axis(xf, last[:, None, None], axis=1)[:, 0]
+        logits = xl @ embed["wte.weight"].T
+        return logits, jnp.stack(ks), jnp.stack(vs)
+
+    def _write_row(cache, new, p):
+        # cache [cap, nh, D]; new [nh, D]; p scalar row index
+        z = jnp.zeros((), p.dtype)
+        return jax.lax.dynamic_update_slice(cache, new[None], (p, z, z))
+
+    def decode_step(params, k_cache, v_cache, last_tok, cache_len):
+        from ..ops.pallas.decode_attention import decode_attention
+        embed, blocks, head = split_decode_params(params, cfg)
+        B = last_tok.shape[0]
+        pos = jnp.clip(cache_len.astype(jnp.int32), 0,
+                       cfg.max_seq_len - 1)
+        x = embed["wte.weight"][last_tok] + embed["wpe.weight"][pos]
+        k_out, v_out = [], []
+        lengths = pos + 1                 # the row just written is live
+        for i, bp in enumerate(blocks):
+            h1 = _pp_ln(x, bp["ln1.weight"], bp["ln1.bias"], eps)
+            qkv = h1 @ bp["attn.qkv.weight"] + bp["attn.qkv.bias"]
+            q, k_new, v_new = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(B, nh, D)
+            k_new = k_new.reshape(B, nh, D)
+            v_new = v_new.reshape(B, nh, D)
+            ki = jax.vmap(_write_row)(k_cache[i], k_new, pos)
+            vi = jax.vmap(_write_row)(v_cache[i], v_new, pos)
+            k_out.append(ki)
+            v_out.append(vi)
+            o = decode_attention(q, ki, vi, lengths).reshape(B, -1)
+            x = x + o @ bp["attn.proj.weight"] + bp["attn.proj.bias"]
+            x = _ffn(bp, x)
+        xf = _pp_ln(x, head["ln_f.weight"], head["ln_f.bias"], eps)
+        logits = xf @ embed["wte.weight"].T
+        return logits, jnp.stack(k_out), jnp.stack(v_out)
+
+    return prefill, decode_step

@@ -2,4 +2,5 @@
 hand-written CUDA (operators/fused/ multihead_matmul, fused attention;
 operators/optimizers/adam_op.cu; math/softmax.cu): here re-designed as
 TPU Pallas kernels with jnp fallbacks off-TPU."""
+from . import decode_attention  # noqa: F401
 from . import flash_attention  # noqa: F401

@@ -1,0 +1,122 @@
+"""Single-token (q_len == 1) decode attention for the KV-cache path.
+
+During autoregressive decode every step attends one fresh query row per
+sequence against that sequence's cached K/V — a GEMV per head, not the
+GEMM the flash kernel is tiled for. This module provides:
+
+  * `decode_attention_reference` — the jnp/XLA composition (masked
+    softmax over the cache capacity). Always available, used by the
+    correctness gate and as the default serving path.
+  * `_decode_attention_pallas` — a Pallas kernel, one grid cell per
+    (batch, head) pair: the query row and its cache panel live in VMEM,
+    the score GEMV, masked softmax and output GEMV never round-trip
+    through HBM between ops. Runs in interpret mode off-TPU so the CPU
+    test suite exercises the same kernel body.
+  * `decode_attention` — the dispatch point, selected by
+    `PADDLE_TPU_DECODE_KERNEL=pallas|xla` (default `xla`; the Pallas
+    path is opt-in until it has TPU soak time).
+
+Shapes (cap = KV-cache capacity rung, see inference/decode.py):
+
+    q        [B, H, D]        fresh query row per sequence
+    k, v     [B, cap, H, D]   cache panels (rows >= length are garbage)
+    lengths  [B] int32        valid prefix per sequence (masks the rest)
+    out      [B, H, D]
+"""
+from __future__ import annotations
+
+import functools
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+
+from . import _common
+from ._common import NEG_INF, VMEM, I0 as _I0, pltpu
+
+try:
+    from jax.experimental import pallas as pl
+except Exception:  # pragma: no cover - pallas ships with jax
+    pl = None
+
+_ENV = "PADDLE_TPU_DECODE_KERNEL"
+
+
+def decode_attention_reference(q, k, v, lengths):
+    """jnp reference: masked softmax(q.k/sqrt(D)).v over cache rows."""
+    B, cap, H, D = k.shape
+    scale = 1.0 / math.sqrt(D)
+    s = jnp.einsum("bhd,bkhd->bhk", q, k) * scale
+    s = s.astype(jnp.float32)
+    live = jnp.arange(cap, dtype=jnp.int32)[None, None, :] \
+        < lengths.astype(jnp.int32)[:, None, None]
+    s = jnp.where(live, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+    o = jnp.einsum("bhk,bkhd->bhd", p, v)
+    return o.astype(q.dtype)
+
+
+def _kernel(q_ref, k_ref, v_ref, m_ref, o_ref, *, scale):
+    q = q_ref[0]                                   # [1, D]
+    kp = k_ref[0]                                  # [cap, D]
+    vp = v_ref[0]
+    s = jax.lax.dot_general(
+        q, kp, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale       # [1, cap]
+    s = s + m_ref[0]                               # additive 0 / -inf mask
+    p = jax.nn.softmax(s, axis=-1)
+    o = jax.lax.dot(p.astype(vp.dtype), vp,
+                    preferred_element_type=jnp.float32)   # [1, D]
+    o_ref[0] = o.astype(o_ref.dtype)
+
+
+def _decode_attention_pallas(q, k, v, lengths):
+    B, cap, H, D = k.shape
+    BH = B * H
+    scale = 1.0 / math.sqrt(D)
+    q3 = q.reshape(BH, 1, D)
+    k3 = jnp.transpose(k, (0, 2, 1, 3)).reshape(BH, cap, D)
+    v3 = jnp.transpose(v, (0, 2, 1, 3)).reshape(BH, cap, D)
+    # additive mask rides VMEM instead of per-cell SMEM scalars: one
+    # [1, cap] row per grid cell, 0 on live rows, -inf on dead ones
+    live = jnp.arange(cap, dtype=jnp.int32)[None, :] \
+        < lengths.astype(jnp.int32)[:, None]                  # [B, cap]
+    mask = jnp.where(live, 0.0, NEG_INF).astype(jnp.float32)
+    mask3 = jnp.repeat(mask[:, None, :], H, axis=0).reshape(BH, 1, cap)
+
+    kw = {}
+    if pltpu is not None and not _common.interpret():
+        kw["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",))
+    out = pl.pallas_call(
+        functools.partial(_kernel, scale=scale),
+        grid=(BH,),
+        in_specs=[
+            pl.BlockSpec((1, 1, D), lambda i: (i, _I0, _I0),
+                         memory_space=VMEM),
+            pl.BlockSpec((1, cap, D), lambda i: (i, _I0, _I0),
+                         memory_space=VMEM),
+            pl.BlockSpec((1, cap, D), lambda i: (i, _I0, _I0),
+                         memory_space=VMEM),
+            pl.BlockSpec((1, 1, cap), lambda i: (i, _I0, _I0),
+                         memory_space=VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1, D), lambda i: (i, _I0, _I0),
+                               memory_space=VMEM),
+        out_shape=jax.ShapeDtypeStruct((BH, 1, D), q.dtype),
+        interpret=_common.interpret(),
+        **kw,
+    )(q3, k3, v3, mask3)
+    return out.reshape(B, H, D)
+
+
+def decode_attention(q, k, v, lengths, kernel=None):
+    """Dispatch on `kernel` (or $PADDLE_TPU_DECODE_KERNEL, default xla)."""
+    choice = (kernel or os.environ.get(_ENV, "xla")).strip().lower()
+    if choice == "pallas":
+        return _decode_attention_pallas(q, k, v, lengths)
+    if choice in ("", "xla"):
+        return decode_attention_reference(q, k, v, lengths)
+    raise ValueError(
+        f"{_ENV}={choice!r}: expected 'pallas' or 'xla'")

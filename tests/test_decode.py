@@ -200,3 +200,312 @@ def test_custom_decoder_generic_path():
     assert tok.shape == (2, 3)
     np.testing.assert_array_equal(tok[0], [0, 10, 20])
     np.testing.assert_array_equal(final["t"].numpy(), [3, 3])
+
+
+# -- continuous-batching KV-cache decode engine (inference/decode.py) ----
+#
+# Correctness gate: the incremental prefill/decode_step path must emit
+# logits identical (to fp32 rounding) to the full forward pass, on BOTH
+# parameter layouts a GPT can produce (scan-stacked and per-block
+# unrolled). Everything downstream (engine, serving, bench) rides on it.
+
+import time
+
+import jax.numpy as jnp
+
+import paddle_tpu.framework as framework
+from paddle_tpu import profiler
+from paddle_tpu.inference.decode import DecodeEngine, save_for_decode
+from paddle_tpu.inference.errors import (ERR_INVALID_ARGUMENT,
+                                         ERR_UNAVAILABLE, TypedServeError)
+from paddle_tpu.models.gpt import GPT, GPTConfig, gpt_decode_fns, gpt_tiny
+from paddle_tpu.testing import chaos
+
+_DECODE_CFGS = [
+    ("tiny-scan", gpt_tiny()),                       # scan-stacked params
+    ("small-unrolled", GPTConfig(vocab_size=256, max_seq_len=64, hidden=32,
+                                 layers=3, heads=2, scan_layers=False)),
+]
+
+
+@pytest.fixture(scope="module")
+def gpt_models():
+    paddle.seed(7)
+    return {name: GPT(cfg) for name, cfg in _DECODE_CFGS}
+
+
+def _full_logits(model, toks):
+    """Reference: full forward over the whole sequence, last position."""
+    idx = paddle.to_tensor(np.asarray([toks], np.int64))
+    return model(idx).numpy()[0, -1].astype(np.float32)
+
+
+def _ref_greedy(model, prompt, n, eos_id=None):
+    toks = [int(t) for t in prompt]
+    out = []
+    for _ in range(n):
+        t = int(_full_logits(model, toks).argmax())
+        out.append(t)
+        toks.append(t)
+        if eos_id is not None and t == eos_id:
+            break
+    return out
+
+
+@pytest.mark.parametrize("name", [n for n, _ in _DECODE_CFGS])
+def test_incremental_decode_matches_full_forward(gpt_models, name):
+    """prefill + N decode_steps == full forward, token for token AND
+    logit for logit, on both param layouts."""
+    model = gpt_models[name]
+    cfg = model.cfg
+    prefill, step = gpt_decode_fns(cfg, eps=model.ln_f._epsilon)
+    params = {k: jnp.asarray(v)
+              for k, v in framework.param_arrays(model).items()}
+
+    rng = np.random.RandomState(3)
+    plen, steps, cap = 9, 6, 32
+    toks = [int(t) for t in rng.randint(0, cfg.vocab_size, size=plen)]
+    padded = np.zeros((1, cap), np.int32)
+    padded[0, :plen] = toks
+    logits, k, v = prefill(params, jnp.asarray(padded),
+                           jnp.asarray([plen], np.int32))
+    np.testing.assert_allclose(np.asarray(logits)[0],
+                               _full_logits(model, toks), atol=1e-4)
+    cache_len = plen
+    last = int(np.asarray(logits)[0].argmax())
+    for _ in range(steps):
+        toks.append(last)
+        logits, k, v = step(params, k, v,
+                            jnp.asarray([last], np.int32),
+                            jnp.asarray([cache_len], np.int32))
+        np.testing.assert_allclose(np.asarray(logits)[0],
+                                   _full_logits(model, toks), atol=1e-4)
+        cache_len += 1
+        last = int(np.asarray(logits)[0].argmax())
+
+
+def test_engine_zero_compiles_after_warmup(gpt_models):
+    """The AOT ladder covers every (batch-rung x kv-rung) signature the
+    engine can dispatch: after warmup() a full multi-request run — with
+    ragged joins forcing pool rebuilds — compiles NOTHING."""
+    model = gpt_models["tiny-scan"]
+    eng = DecodeEngine(model, max_slots=4, max_new_tokens=16)
+    try:
+        n = eng.warmup()
+        assert n >= 0
+        c0 = len(profiler.compile_events())
+        rng = np.random.RandomState(11)
+        prompts = [rng.randint(0, model.cfg.vocab_size, size=p)
+                   for p in (5, 11, 8)]
+        streams = [eng.submit(p, max_new_tokens=12) for p in prompts]
+        results = [s.result(timeout=120) for s in streams]
+        assert len(profiler.compile_events()) == c0, \
+            "decode engine compiled during a warmed-up run"
+        for p, got in zip(prompts, results):
+            assert got == _ref_greedy(model, p, 12), \
+                "engine tokens diverged from full-forward reference"
+        st = eng.stats()
+        assert st["active"] == 0 and st["pending"] == 0
+    finally:
+        eng.stop()
+
+
+def test_ragged_join_and_early_leave(gpt_models):
+    """Continuous batching semantics: a request arriving mid-run joins
+    the running batch; one hitting EOS early frees its KV slot for the
+    next admission — and nobody's tokens change."""
+    model = gpt_models["tiny-scan"]
+    rng = np.random.RandomState(23)
+    p_long = rng.randint(0, 512, size=10)
+    p_eos = rng.randint(0, 512, size=6)
+    p_late = rng.randint(0, 512, size=7)
+    ref_long = _ref_greedy(model, p_long, 20)
+    ref_eos_full = _ref_greedy(model, p_eos, 20)
+    eos = ref_eos_full[2]            # stop at its first occurrence
+    ref_eos = ref_eos_full[:ref_eos_full.index(eos) + 1]
+    ref_late = _ref_greedy(model, p_late, 8)
+
+    eng = DecodeEngine(model, max_slots=2, max_new_tokens=32)
+    try:
+        s_long = eng.submit(p_long, max_new_tokens=20)
+        s_eos = eng.submit(p_eos, max_new_tokens=20, eos_id=eos)
+        # the EOS stream dies early -> its slot frees -> the late
+        # arrival joins while s_long is still mid-generation
+        assert s_eos.result(timeout=120) == ref_eos
+        s_late = eng.submit(p_late, max_new_tokens=8)
+        assert s_late.result(timeout=120) == ref_late
+        assert s_long.result(timeout=120) == ref_long
+        st = eng.stats()
+        assert st["active"] == 0 and st["tokens"] >= \
+            len(ref_long) + len(ref_eos) + len(ref_late)
+    finally:
+        eng.stop()
+
+
+def test_decode_chaos_kill_mid_stream(gpt_models):
+    """Chaos drill: first token delivery raises -> THAT stream gets a
+    typed UNAVAILABLE; the concurrently running stream is unharmed."""
+    from paddle_tpu.observability import REGISTRY
+    model = gpt_models["tiny-scan"]
+    rng = np.random.RandomState(31)
+    p1 = rng.randint(0, 512, size=8)
+    p2 = rng.randint(0, 512, size=8)
+    ref2 = _ref_greedy(model, p2, 6)
+    eng = DecodeEngine(model, max_slots=2, max_new_tokens=8)
+    try:
+        with chaos.inject("decode.stream:1:RuntimeError") as inj:
+            s1 = eng.submit(p1, max_new_tokens=6)
+            time.sleep(0.2)          # ensure s1 admits first (site call 1)
+            s2 = eng.submit(p2, max_new_tokens=6)
+            with pytest.raises(TypedServeError) as ei:
+                s1.result(timeout=120)
+            assert ei.value.code == ERR_UNAVAILABLE
+            assert s2.result(timeout=120) == ref2
+            assert inj.fired
+        flat = REGISTRY.flat()
+        assert flat.get(
+            'paddle_tpu_decode_cache_evictions_total{reason="error"}', 0) \
+            >= 1
+    finally:
+        eng.stop()
+
+
+def test_engine_submit_validation(gpt_models):
+    model = gpt_models["tiny-scan"]
+    eng = DecodeEngine(model, max_slots=1, max_new_tokens=4)
+    try:
+        with pytest.raises(TypedServeError) as ei:
+            eng.submit([])
+        assert ei.value.code == ERR_INVALID_ARGUMENT
+        with pytest.raises(TypedServeError) as ei:
+            eng.submit([512])        # vocab is 512 -> out of range
+        assert ei.value.code == ERR_INVALID_ARGUMENT
+        with pytest.raises(TypedServeError) as ei:
+            eng.submit(np.arange(200) % 512)   # longer than max_seq_len
+        assert ei.value.code == ERR_INVALID_ARGUMENT
+    finally:
+        eng.stop()
+    with pytest.raises(TypedServeError) as ei:
+        eng.submit([1, 2, 3])
+    assert ei.value.code == ERR_UNAVAILABLE
+
+
+def test_decode_artifact_roundtrip(gpt_models, tmp_path):
+    """save_for_decode -> load_for_decode serves the same tokens."""
+    from paddle_tpu.inference.decode import load_for_decode
+    model = gpt_models["small-unrolled"]
+    prefix = str(tmp_path / "gpt")
+    save_for_decode(model, prefix)
+    prompt = np.random.RandomState(5).randint(0, 256, size=7)
+    ref = _ref_greedy(model, prompt, 5)
+    eng = load_for_decode(prefix, max_slots=1, max_new_tokens=8)
+    try:
+        assert eng.submit(prompt, max_new_tokens=5).result(timeout=120) \
+            == ref
+    finally:
+        eng.stop()
+
+
+def test_serve_decode_wire_roundtrip(gpt_models, tmp_path):
+    """End-to-end over a socket: PDI2 clients stream per-token frames
+    (seq-numbered, final done frame carries the accumulated reply);
+    PDI1 clients get ONE accumulated frame — same bytes as ever."""
+    import socket as socketlib
+
+    from paddle_tpu.inference.serve import (InferenceServer, decode_request,
+                                            read_reply_ctx, write_tensors)
+    model = gpt_models["tiny-scan"]
+    prefix = str(tmp_path / "gpt")
+    save_for_decode(model, prefix)
+    srv = InferenceServer(prefix, port=0, decode=True, decode_slots=2,
+                          decode_max_new=6, metrics_port=0)
+    try:
+        prompt = np.random.RandomState(9).randint(0, 512, size=8)
+        ref = _ref_greedy(model, prompt, 6)
+        seen = []
+        s = socketlib.create_connection(("127.0.0.1", srv.port), timeout=60)
+        toks = decode_request(s, prompt, opts={"max_new_tokens": 6},
+                              on_token=lambda t, c: seen.append(
+                                  (t, c.get("seq"))))
+        assert toks == ref
+        assert [t for t, _ in seen] == ref
+        assert [q for _, q in seen] == list(range(6))
+        # bad prompt -> typed error frame; the connection survives
+        write_tensors(s, [np.ones((4,), np.float32)],
+                      ctx={"trace_id": "bad"})
+        _, err, _ = read_reply_ctx(s)
+        assert err and err.startswith(ERR_INVALID_ARGUMENT)
+        assert decode_request(s, prompt,
+                              opts={"max_new_tokens": 3}) == ref[:3]
+        s.close()
+        # PDI1: no context field -> server default max_new (6), one frame
+        s = socketlib.create_connection(("127.0.0.1", srv.port), timeout=60)
+        assert decode_request(s, prompt, trace=False) == ref
+        s.close()
+        assert srv._status()["engine"] == "decode"
+    finally:
+        srv.stop()
+
+
+def test_decode_attention_pallas_matches_reference():
+    """Kernel gate for the PADDLE_TPU_DECODE_KERNEL=pallas fast path:
+    max-abs-error vs the jnp composition, ragged lengths included."""
+    from paddle_tpu.ops.pallas.decode_attention import (
+        _decode_attention_pallas, decode_attention,
+        decode_attention_reference)
+    rng = np.random.RandomState(41)
+    B, cap, H, D = 3, 32, 4, 16
+    q = jnp.asarray(rng.randn(B, H, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, cap, H, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, cap, H, D).astype(np.float32))
+    lengths = jnp.asarray([1, 17, 32], np.int32)
+    want = decode_attention_reference(q, k, v, lengths)
+    got = _decode_attention_pallas(q, k, v, lengths)
+    err = float(jnp.max(jnp.abs(got - want)))
+    assert err < 1e-5, f"pallas decode attention max abs err {err}"
+    # dispatch: explicit kernel= and the env knob agree; junk rejected
+    np.testing.assert_array_equal(
+        np.asarray(decode_attention(q, k, v, lengths, kernel="pallas")),
+        np.asarray(got))
+    with pytest.raises(ValueError):
+        decode_attention(q, k, v, lengths, kernel="cuda")
+
+
+def test_decode_engine_on_pallas_kernel(gpt_models, monkeypatch):
+    """The whole engine, attention routed through the Pallas kernel via
+    the env knob, still matches the full-forward reference."""
+    model = gpt_models["tiny-scan"]
+    monkeypatch.setenv("PADDLE_TPU_DECODE_KERNEL", "pallas")
+    prompt = np.random.RandomState(13).randint(0, 512, size=6)
+    ref = _ref_greedy(model, prompt, 5)
+    eng = DecodeEngine(model, max_slots=1, max_new_tokens=8)
+    try:
+        assert eng.submit(prompt, max_new_tokens=5).result(timeout=180) \
+            == ref
+    finally:
+        eng.stop()
+
+
+@pytest.mark.slow
+def test_decode_churn_sweep(gpt_models):
+    """Long ragged-churn drill across KV-rung growth (prompt+generation
+    crossing the 16-row rung): staggered submits, mixed lengths, every
+    stream token-exact vs the full-forward reference."""
+    model = gpt_models["tiny-scan"]
+    rng = np.random.RandomState(53)
+    eng = DecodeEngine(model, max_slots=3, max_new_tokens=32)
+    try:
+        eng.warmup()
+        c0 = len(profiler.compile_events())
+        jobs = []
+        for i in range(8):
+            plen = int(rng.randint(3, 24))
+            n = int(rng.randint(4, 24))
+            p = rng.randint(0, 512, size=plen)
+            jobs.append((p, n, eng.submit(p, max_new_tokens=n)))
+            time.sleep(0.02 * (i % 3))
+        for p, n, s in jobs:
+            assert s.result(timeout=300) == _ref_greedy(model, p, n)
+        assert len(profiler.compile_events()) == c0
+    finally:
+        eng.stop()

@@ -188,15 +188,22 @@ def test_collector_refreshes_and_broken_collector_is_isolated():
 def test_all_registered_metrics_lint():
     """Every family in the process-global registry follows the naming
     convention and carries a non-empty help string — including the
-    router span/poll and SLO families, which are force-registered here
-    so the lint covers them even when no router test ran first."""
+    router span/poll, SLO, and decode families, which are
+    force-registered here so the lint covers them even when no
+    router/decode test ran first."""
+    from paddle_tpu.inference.decode import _decode_metrics
     from paddle_tpu.inference.router import _router_metrics
     from paddle_tpu.observability import SLOEngine, TimeSeriesStore
 
     _router_metrics()
+    _decode_metrics()
     SpanRecorder(component="router",
                  metric="paddle_tpu_router_span_seconds",
                  help="Router-side per-request span breakdown by stage, "
+                      "seconds.")
+    SpanRecorder(component="decode",
+                 metric="paddle_tpu_decode_span_seconds",
+                 help="Decode-side per-request span breakdown by stage, "
                       "seconds.")
     SLOEngine(TimeSeriesStore(), [])
 
@@ -214,7 +221,17 @@ def test_all_registered_metrics_lint():
             "paddle_tpu_router_poll_failures_total",
             "paddle_tpu_router_backend_requests_total",
             "paddle_tpu_slo_state",
-            "paddle_tpu_slo_burn_rate"} <= names, sorted(names)
+            "paddle_tpu_slo_burn_rate",
+            "paddle_tpu_decode_tokens_total",
+            "paddle_tpu_decode_steps_total",
+            "paddle_tpu_decode_prefills_total",
+            "paddle_tpu_decode_cache_evictions_total",
+            "paddle_tpu_decode_slot_occupancy",
+            "paddle_tpu_decode_active_requests",
+            "paddle_tpu_decode_prefill_latency_seconds",
+            "paddle_tpu_decode_step_latency_seconds",
+            "paddle_tpu_decode_ttft_seconds",
+            "paddle_tpu_decode_span_seconds"} <= names, sorted(names)
 
 
 # -- monitor shims + hardened memory probes -------------------------------
