@@ -1,0 +1,30 @@
+"""tpulint — paddle_tpu's framework-native static-analysis subsystem.
+
+Five checkers grounded in this repo's real bug classes:
+
+====== =====================================================================
+TPL01x trace-safety: host-impure calls inside jit/scan/pjit-traced functions
+TPL02x lock-discipline: blocking calls under held locks, lock-order inversion
+TPL03x thread-lifecycle: daemon/join proof, stop wiring for loop threads
+TPL04x env-flag registry: PADDLE_TPU_* reads resolve through core.flags
+TPL05x catalog drift: metrics/chaos-sites/admin endpoints vs docs
+====== =====================================================================
+
+Run it: ``python -m paddle_tpu.analysis paddle_tpu/`` (exit 0 = clean).
+See docs/static_analysis.md for the rule catalog and suppression syntax.
+"""
+
+from .cli import CHECKERS, Result, all_rules, main, run
+from .core import AnalysisContext, Baseline, Finding, SourceFile
+
+__all__ = [
+    "AnalysisContext",
+    "Baseline",
+    "CHECKERS",
+    "Finding",
+    "Result",
+    "SourceFile",
+    "all_rules",
+    "main",
+    "run",
+]

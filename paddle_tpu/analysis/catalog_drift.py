@@ -1,0 +1,231 @@
+"""TPL05x — catalog drift: docs, registries and code must name the same things.
+
+The repo keeps three human-facing catalogs: the metric-family tables in
+``docs/observability.md``, the chaos-site table in ``docs/fault_tolerance.md``
+and the admin-endpoint list.  Each started life as prose and drifted the
+moment code moved.  This checker generalizes the old hand-rolled metric-name
+lint in ``tests/test_observability.py`` into a static pass over the *source*:
+
+* TPL051 — a metric family definition (``counter/gauge/histogram`` call with
+  literal name+help) violates naming conventions: ``paddle_tpu_`` prefix,
+  lowercase snake case, counters end ``_total``, non-empty help, valid
+  label names.  :func:`lint_metric_family` is shared with the runtime test
+  so there is exactly one implementation of the rules.
+* TPL052 — a metric family defined in code is absent from
+  ``docs/observability.md`` (the doc tables use unprefixed names, so a
+  suffix match counts).
+* TPL053 — chaos-site drift between ``maybe_fail("site")`` call sites,
+  the ``testing/chaos.py`` ``register_site`` registry, and the site table
+  in ``docs/fault_tolerance.md``.
+* TPL054 — an admin endpoint routed in ``observability/admin.py``
+  (``path == "/x"``) that ``docs/observability.md`` never mentions.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import AnalysisContext, Finding, SourceFile, call_kwarg, literal_str, qual_tail, qualname
+
+RULES = {
+    "TPL051": "metric family violates naming/metadata conventions",
+    "TPL052": "metric family defined in code but missing from docs/observability.md",
+    "TPL053": "chaos-site drift between code, registry and docs/fault_tolerance.md",
+    "TPL054": "admin endpoint routed in code but missing from docs/observability.md",
+}
+
+OBSERVABILITY_DOC = "docs/observability.md"
+FAULT_DOC = "docs/fault_tolerance.md"
+CHAOS_MODULE_SUFFIX = "testing/chaos.py"
+ADMIN_MODULE_SUFFIX = "observability/admin.py"
+
+METRIC_PREFIX = "paddle_tpu_"
+_METRIC_NAME_RE = re.compile(r"^paddle_tpu_[a-z][a-z0-9_]*$")
+_LABEL_NAME_RE = re.compile(r"^[a-z_][a-z0-9_]*$")
+_METRIC_CTORS = {"counter", "gauge", "histogram"}
+
+
+def lint_metric_family(kind: str, name: str, help_text: str, labelnames: Sequence[str]) -> List[str]:
+    """Convention problems for one metric family; [] when clean.
+
+    Shared between the static TPL051 pass and the runtime registry lint in
+    tests/test_observability.py — one implementation of the rules.
+    """
+    problems: List[str] = []
+    if not _METRIC_NAME_RE.match(name):
+        problems.append(
+            f"name '{name}' must match {_METRIC_NAME_RE.pattern} "
+            "(paddle_tpu_ prefix, lowercase snake case)"
+        )
+    if kind == "counter" and not name.endswith("_total"):
+        problems.append(f"counter '{name}' must end in '_total'")
+    if not (help_text or "").strip():
+        problems.append(f"metric '{name}' has empty help text")
+    for label in labelnames:
+        if label.startswith("__") or not _LABEL_NAME_RE.match(label):
+            problems.append(f"metric '{name}' has invalid label name '{label}'")
+    return problems
+
+
+def _literal_seq(node: Optional[ast.AST]) -> Optional[List[str]]:
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            s = literal_str(elt)
+            if s is None:
+                return None
+            out.append(s)
+        return out
+    return None
+
+
+def collect_metric_defs(sf: SourceFile) -> List[Tuple[ast.Call, str, str, str, List[str]]]:
+    """(call, kind, name, help, labels) for literal metric definitions."""
+    out = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        kind = qual_tail(qualname(node.func), 1)
+        if kind not in _METRIC_CTORS or len(node.args) < 2:
+            continue
+        name = literal_str(node.args[0])
+        help_text = literal_str(node.args[1])
+        if name is None or help_text is None:
+            continue
+        labels_node = node.args[2] if len(node.args) > 2 else call_kwarg(node, "labelnames")
+        labels = _literal_seq(labels_node) or []
+        out.append((node, kind, name, help_text, labels))
+    return out
+
+
+def _doc_mentions_metric(doc: str, name: str) -> bool:
+    if name in doc:
+        return True
+    return name.startswith(METRIC_PREFIX) and name[len(METRIC_PREFIX):] in doc
+
+
+def _chaos_registered(ctx: AnalysisContext) -> Optional[Set[str]]:
+    """Sites registered via register_site in testing/chaos.py, or None if absent."""
+    sf = ctx.find_file(CHAOS_MODULE_SUFFIX)
+    if sf is None:
+        return None
+    sites: Set[str] = set()
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Call) and qual_tail(qualname(node.func), 1) == "register_site":
+            name = literal_str(node.args[0] if node.args else None)
+            if name:
+                sites.add(name)
+    return sites
+
+
+def _chaos_uses(ctx: AnalysisContext) -> Dict[str, Tuple[SourceFile, ast.Call]]:
+    """site name -> first maybe_fail/fail_once call site."""
+    uses: Dict[str, Tuple[SourceFile, ast.Call]] = {}
+    for sf in ctx.files:
+        for node in ast.walk(sf.tree):
+            if (
+                isinstance(node, ast.Call)
+                and qual_tail(qualname(node.func), 1) in ("maybe_fail", "fail_once")
+            ):
+                name = literal_str(node.args[0] if node.args else None)
+                if name and name not in uses:
+                    uses[name] = (sf, node)
+    return uses
+
+
+def _admin_endpoints(sf: SourceFile) -> List[Tuple[str, int]]:
+    """Endpoint paths routed by literal comparison against the request path."""
+    out: List[Tuple[str, int]] = []
+    seen: Set[str] = set()
+    for node in ast.walk(sf.tree):
+        if not (isinstance(node, ast.Compare) and len(node.comparators) == 1):
+            continue
+        if not isinstance(node.ops[0], ast.Eq):
+            continue
+        for side in (node.left, node.comparators[0]):
+            s = literal_str(side)
+            if s and s.startswith("/") and s not in seen:
+                seen.add(s)
+                out.append((s, node.lineno))
+    return out
+
+
+def check(ctx: AnalysisContext) -> List[Finding]:
+    findings: List[Finding] = []
+    obs_doc = ctx.read_root_file(OBSERVABILITY_DOC)
+
+    # --- TPL051 / TPL052: metric families -------------------------------
+    documented_missing: Set[str] = set()
+    for sf in ctx.files:
+        for call, kind, name, help_text, labels in collect_metric_defs(sf):
+            symbol = sf.enclosing_symbol(call)
+            for problem in lint_metric_family(kind, name, help_text, labels):
+                findings.append(
+                    Finding("TPL051", sf.rel, call.lineno, call.col_offset, symbol, problem)
+                )
+            if obs_doc is not None and name not in documented_missing:
+                if not _doc_mentions_metric(obs_doc, name):
+                    documented_missing.add(name)
+                    findings.append(
+                        Finding(
+                            "TPL052", sf.rel, call.lineno, call.col_offset, symbol,
+                            f"metric family '{name}' is not documented in {OBSERVABILITY_DOC}",
+                        )
+                    )
+
+    # --- TPL053: chaos sites --------------------------------------------
+    registered = _chaos_registered(ctx)
+    uses = _chaos_uses(ctx)
+    if registered is not None:
+        chaos_sf = ctx.find_file(CHAOS_MODULE_SUFFIX)
+        chaos_rel = chaos_sf.rel if chaos_sf else CHAOS_MODULE_SUFFIX
+        for name, (sf, node) in sorted(uses.items()):
+            if name not in registered:
+                findings.append(
+                    Finding(
+                        "TPL053", sf.rel, node.lineno, node.col_offset,
+                        sf.enclosing_symbol(node),
+                        f"chaos site '{name}' is injected here but not registered via "
+                        "testing.chaos.register_site",
+                    )
+                )
+        for name in sorted(registered - set(uses)):
+            findings.append(
+                Finding(
+                    "TPL053", chaos_rel, 1, 0, "",
+                    f"chaos site '{name}' is registered but no maybe_fail/fail_once "
+                    "call site uses it — stale registration",
+                )
+            )
+        fault_doc = ctx.read_root_file(FAULT_DOC)
+        if fault_doc is None:
+            if registered:
+                findings.append(
+                    Finding("TPL053", chaos_rel, 1, 0, "",
+                            f"{FAULT_DOC} is missing but chaos sites are registered")
+                )
+        else:
+            for name in sorted(registered):
+                if name not in fault_doc:
+                    findings.append(
+                        Finding(
+                            "TPL053", chaos_rel, 1, 0, "",
+                            f"chaos site '{name}' is registered but not documented in {FAULT_DOC}",
+                        )
+                    )
+
+    # --- TPL054: admin endpoints ----------------------------------------
+    admin_sf = ctx.find_file(ADMIN_MODULE_SUFFIX)
+    if admin_sf is not None and obs_doc is not None:
+        for path, line in _admin_endpoints(admin_sf):
+            if path not in obs_doc:
+                findings.append(
+                    Finding(
+                        "TPL054", admin_sf.rel, line, 0, "",
+                        f"admin endpoint '{path}' is routed in code but never mentioned "
+                        f"in {OBSERVABILITY_DOC}",
+                    )
+                )
+    return findings

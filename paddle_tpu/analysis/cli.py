@@ -1,0 +1,161 @@
+"""tpulint CLI — run the paddle_tpu static-analysis pass.
+
+Usage::
+
+    python -m paddle_tpu.analysis [paths...] [--json] [--rules TPL02,TPL041]
+                                  [--baseline FILE] [--write-baseline]
+                                  [--root DIR] [--list-rules]
+
+Exit codes: 0 = clean, 1 = unsuppressed findings, 2 = usage/internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence
+
+from . import catalog_drift, flag_registry, lock_discipline, thread_lifecycle, trace_safety
+from .core import (
+    CORE_RULES,
+    AnalysisContext,
+    Baseline,
+    Finding,
+    discover_root,
+    load_sources,
+    write_baseline,
+)
+
+CHECKERS = [trace_safety, lock_discipline, thread_lifecycle, flag_registry, catalog_drift]
+
+DEFAULT_BASELINE = ".tpulint-baseline.json"
+JSON_VERSION = 1
+
+
+def all_rules() -> Dict[str, str]:
+    rules = dict(CORE_RULES)
+    for mod in CHECKERS:
+        rules.update(mod.RULES)
+    return dict(sorted(rules.items()))
+
+
+@dataclass
+class Result:
+    """Outcome of one analysis run (also the JSON payload shape)."""
+
+    root: str
+    findings: List[Finding] = field(default_factory=list)  # active (reported)
+    suppressed: int = 0
+    baselined: int = 0
+
+    @property
+    def counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for f in self.findings:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return out
+
+    def to_json(self) -> dict:
+        return {
+            "version": JSON_VERSION,
+            "root": self.root,
+            "findings": [f.to_json() for f in self.findings],
+            "counts": self.counts,
+            "suppressed": self.suppressed,
+            "baselined": self.baselined,
+        }
+
+
+def run(
+    paths: Sequence[str],
+    root: Optional[str] = None,
+    rules: Optional[Sequence[str]] = None,
+    baseline_path: Optional[str] = None,
+) -> Result:
+    """Run every checker over ``paths``; returns active findings only.
+
+    ``rules`` filters by prefix ("TPL02" keeps the whole lock family).
+    ``baseline_path`` defaults to <root>/.tpulint-baseline.json when present.
+    """
+    path_objs = [Path(p) for p in paths]
+    root_path = Path(root).resolve() if root else discover_root(path_objs)
+    files, findings = load_sources(path_objs, root_path)
+    ctx = AnalysisContext(root_path, files)
+    for mod in CHECKERS:
+        findings.extend(mod.check(ctx))
+
+    if rules:
+        prefixes = tuple(r.strip() for r in rules if r.strip())
+        findings = [f for f in findings if f.rule.startswith(prefixes)]
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+
+    by_rel = {sf.rel: sf for sf in files}
+    bl_path = Path(baseline_path) if baseline_path else root_path / DEFAULT_BASELINE
+    baseline = Baseline.load(bl_path)
+
+    result = Result(root=str(root_path))
+    for f in findings:
+        sf = by_rel.get(f.path)
+        if sf is not None and sf.is_suppressed(f.line, f.rule):
+            result.suppressed += 1
+        elif baseline.matches(f):
+            result.baselined += 1
+        else:
+            result.findings.append(f)
+    return result
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.analysis",
+        description="tpulint: static analysis for the paddle_tpu codebase",
+    )
+    parser.add_argument("paths", nargs="*", default=["paddle_tpu"],
+                        help="files or directories to analyze (default: paddle_tpu)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit machine-readable JSON instead of text")
+    parser.add_argument("--rules", default=None,
+                        help="comma-separated rule-id prefixes to keep (e.g. TPL02,TPL041)")
+    parser.add_argument("--baseline", default=None,
+                        help=f"baseline file (default: <root>/{DEFAULT_BASELINE} if present)")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="write current findings to the baseline file and exit 0")
+    parser.add_argument("--root", default=None,
+                        help="repo root for docs/catalog lookups (default: auto-discovered)")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalog and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule, desc in all_rules().items():
+            print(f"{rule}  {desc}")
+        return 0
+
+    for p in args.paths:
+        if not Path(p).exists():
+            print(f"error: no such path: {p}", file=sys.stderr)
+            return 2
+
+    rules = args.rules.split(",") if args.rules else None
+    result = run(args.paths, root=args.root, rules=rules, baseline_path=args.baseline)
+
+    if args.write_baseline:
+        bl = Path(args.baseline) if args.baseline else Path(result.root) / DEFAULT_BASELINE
+        write_baseline(bl, result.findings)
+        print(f"wrote {len(result.findings)} finding(s) to {bl}")
+        return 0
+
+    if args.as_json:
+        print(json.dumps(result.to_json(), indent=2))
+    else:
+        for f in result.findings:
+            print(f.format())
+        tail = (
+            f"{len(result.findings)} finding(s), {result.suppressed} suppressed, "
+            f"{result.baselined} baselined"
+        )
+        print(tail if result.findings else f"tpulint: clean ({tail})")
+    return 1 if result.findings else 0

@@ -1,0 +1,301 @@
+"""tpulint core — shared infrastructure for paddle_tpu's static-analysis pass.
+
+This module carries everything the individual checkers share:
+
+* :class:`Finding` — one diagnostic, identified by a stable ``TPLxxx`` rule id.
+* :class:`SourceFile` — a parsed source file: text, AST (with parent links),
+  and the inline ``# tpulint: disable=...`` suppression map.
+* :class:`Baseline` — grandfathered findings loaded from a JSON file so a
+  checker can be introduced without blocking CI on pre-existing debt.
+* :class:`AnalysisContext` — the unit handed to every checker: the file set
+  plus root-relative access to docs/catalog files for drift checks.
+
+Checkers are plain modules exposing ``RULES`` (dict of rule id -> one-line
+description) and ``check(ctx) -> list[Finding]``.  They must be pure: no
+imports of the code under analysis, no side effects — everything is derived
+from source text and ASTs so the linter can run on a broken tree.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import re
+import tokenize
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+# Rule id owned by the core loader: files that fail to parse.
+PARSE_RULE = "TPL001"
+
+CORE_RULES = {
+    PARSE_RULE: "source file failed to parse (checkers skipped for the file)",
+}
+
+_SUPPRESS_RE = re.compile(r"tpulint:\s*disable=([A-Za-z0-9_,\s]+)")
+
+
+@dataclass
+class Finding:
+    """One diagnostic emitted by a checker."""
+
+    rule: str
+    path: str  # root-relative, posix separators
+    line: int
+    col: int
+    symbol: str  # enclosing function/class, or "" at module level
+    message: str
+
+    def fingerprint(self) -> Tuple[str, str, str, str]:
+        """Line-independent identity used for baseline matching."""
+        return (self.rule, self.path, self.symbol, self.message)
+
+    def format(self) -> str:
+        loc = f"{self.path}:{self.line}:{self.col}"
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        return f"{loc}: {self.rule} {self.message}{sym}"
+
+    def to_json(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "symbol": self.symbol,
+            "message": self.message,
+        }
+
+
+def _collect_suppressions(text: str, lines: Sequence[str]) -> Dict[int, Set[str]]:
+    """Map line number -> set of suppressed rule ids (or {"all"}).
+
+    A ``# tpulint: disable=TPL011[,TPL021]`` comment applies to its own line
+    when it trails code, or to the next code line when it stands alone.
+    """
+    out: Dict[int, Set[str]] = {}
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(text).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return out
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _SUPPRESS_RE.search(tok.string)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        if not rules:
+            continue
+        lineno = tok.start[0]
+        before = lines[lineno - 1][: tok.start[1]] if lineno - 1 < len(lines) else ""
+        targets = {lineno}
+        if not before.strip():
+            # Stand-alone comment: also applies to the next code line.
+            for idx in range(lineno, len(lines)):
+                stripped = lines[idx].strip()
+                if not stripped or stripped.startswith("#"):
+                    continue
+                targets.add(idx + 1)
+                break
+        for t in targets:
+            out.setdefault(t, set()).update(rules)
+    return out
+
+
+class SourceFile:
+    """A parsed python source file with parent-linked AST and suppressions."""
+
+    def __init__(self, abspath: Path, rel: str, text: str):
+        self.abspath = abspath
+        self.rel = rel
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text)  # caller handles SyntaxError
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                child._tpl_parent = node  # type: ignore[attr-defined]
+        self.suppressions = _collect_suppressions(text, self.lines)
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return getattr(node, "_tpl_parent", None)
+
+    def enclosing_symbol(self, node: ast.AST) -> str:
+        """Dotted name of the enclosing class/function scope, or ""."""
+        parts: List[str] = []
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                parts.append(cur.name)
+            cur = self.parent(cur)
+        return ".".join(reversed(parts))
+
+    def is_suppressed(self, line: int, rule: str) -> bool:
+        rules = self.suppressions.get(line)
+        if not rules:
+            return False
+        return "all" in rules or rule in rules
+
+
+class Baseline:
+    """Grandfathered findings: matched line-independently by fingerprint."""
+
+    def __init__(self, entries: Iterable[dict]):
+        self.entries = list(entries)
+        self._keys = {
+            (e.get("rule", ""), e.get("path", ""), e.get("symbol", ""), e.get("message", ""))
+            for e in self.entries
+        }
+
+    @classmethod
+    def load(cls, path: Path) -> "Baseline":
+        if not path.is_file():
+            return cls([])
+        data = json.loads(path.read_text())
+        return cls(data.get("entries", []))
+
+    def matches(self, finding: Finding) -> bool:
+        return finding.fingerprint() in self._keys
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+
+def write_baseline(path: Path, findings: Sequence[Finding]) -> None:
+    entries = [
+        {
+            "rule": f.rule,
+            "path": f.path,
+            "symbol": f.symbol,
+            "message": f.message,
+            "justification": "TODO: explain why this finding is grandfathered",
+        }
+        for f in findings
+    ]
+    path.write_text(json.dumps({"version": 1, "entries": entries}, indent=2) + "\n")
+
+
+class AnalysisContext:
+    """What every checker sees: the parsed file set plus the repo root."""
+
+    def __init__(self, root: Path, files: Sequence[SourceFile]):
+        self.root = root
+        self.files = list(files)
+        self._doc_cache: Dict[str, Optional[str]] = {}
+
+    def read_root_file(self, rel: str) -> Optional[str]:
+        """Text of a root-relative file (e.g. docs/observability.md), or None."""
+        if rel not in self._doc_cache:
+            p = self.root / rel
+            self._doc_cache[rel] = p.read_text() if p.is_file() else None
+        return self._doc_cache[rel]
+
+    def find_file(self, rel_suffix: str) -> Optional[SourceFile]:
+        """First analyzed file whose relative path ends with ``rel_suffix``."""
+        for f in self.files:
+            if f.rel == rel_suffix or f.rel.endswith("/" + rel_suffix):
+                return f
+        return None
+
+
+# --------------------------------------------------------------------------
+# Source loading
+# --------------------------------------------------------------------------
+
+_SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules", "build", "dist"}
+
+
+def iter_py_files(paths: Sequence[Path]) -> List[Path]:
+    out: List[Path] = []
+    seen: Set[Path] = set()
+    for p in paths:
+        if p.is_file() and p.suffix == ".py":
+            cands = [p]
+        elif p.is_dir():
+            cands = sorted(
+                f
+                for f in p.rglob("*.py")
+                if not any(part in _SKIP_DIRS or part.startswith(".") for part in f.parts)
+            )
+        else:
+            cands = []
+        for c in cands:
+            rc = c.resolve()
+            if rc not in seen:
+                seen.add(rc)
+                out.append(c)
+    return out
+
+
+def discover_root(paths: Sequence[Path]) -> Path:
+    """Walk up from the first path to a directory that looks like the repo root."""
+    start = paths[0].resolve() if paths else Path.cwd()
+    if start.is_file():
+        start = start.parent
+    cur = start
+    while True:
+        if (cur / "docs").is_dir() and (
+            (cur / ".git").exists() or (cur / "pyproject.toml").is_file() or (cur / "ROADMAP.md").is_file()
+        ):
+            return cur
+        if cur.parent == cur:
+            return start
+        cur = cur.parent
+
+
+def load_sources(paths: Sequence[Path], root: Path) -> Tuple[List[SourceFile], List[Finding]]:
+    files: List[SourceFile] = []
+    findings: List[Finding] = []
+    for p in iter_py_files(paths):
+        try:
+            rel = p.resolve().relative_to(root.resolve()).as_posix()
+        except ValueError:
+            rel = p.as_posix()
+        try:
+            text = p.read_text()
+        except (OSError, UnicodeDecodeError) as exc:
+            findings.append(Finding(PARSE_RULE, rel, 1, 0, "", f"unreadable: {exc}"))
+            continue
+        try:
+            files.append(SourceFile(p, rel, text))
+        except SyntaxError as exc:
+            findings.append(
+                Finding(PARSE_RULE, rel, exc.lineno or 1, exc.offset or 0, "", f"syntax error: {exc.msg}")
+            )
+    return files, findings
+
+
+# --------------------------------------------------------------------------
+# Small AST helpers shared by checkers
+# --------------------------------------------------------------------------
+
+
+def qualname(node: ast.AST) -> Optional[str]:
+    """Dotted name for a Name/Attribute chain, e.g. ``self._lock`` — else None."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = qualname(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+def literal_str(node: Optional[ast.AST]) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def call_kwarg(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def qual_tail(qual: Optional[str], n: int = 2) -> str:
+    """Last ``n`` dotted components of a qualname ("jax.lax.scan" -> "lax.scan")."""
+    if not qual:
+        return ""
+    return ".".join(qual.split(".")[-n:])

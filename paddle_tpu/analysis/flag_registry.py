@@ -1,0 +1,176 @@
+"""TPL04x — env-flag registry: every PADDLE_TPU_* knob has one home.
+
+Before this checker, ``PADDLE_TPU_*`` environment variables were read with
+ad-hoc ``os.environ.get`` calls scattered across fourteen modules; nothing
+listed them, nothing documented defaults, and a typo in a flag name failed
+silently.  ``paddle_tpu/core/flags.py`` now carries a central env-flag
+catalog (``define_env_flag`` / ``env_value`` / ``env_raw``); this checker
+makes the catalog load-bearing:
+
+* TPL041 — a direct ``os.environ[...]`` / ``os.environ.get`` / ``os.getenv``
+  read of a ``PADDLE_TPU_*`` name outside the catalog module.  All reads
+  must go through ``flags.env_value`` / ``flags.env_raw``.
+* TPL042 — a ``PADDLE_TPU_*`` token (anywhere in source, comments included)
+  that is not registered in the catalog: an undeclared knob.
+* TPL043 — the catalog and ``docs/flags.md`` disagree (flag missing from
+  the doc, or doc mentions a flag the catalog does not define).
+
+The catalog is read *statically*: ``define_env_flag("NAME", ...)`` first-arg
+literals are collected from the flags module's AST, so the linter never
+imports the code it checks.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import AnalysisContext, Finding, SourceFile, literal_str, qual_tail, qualname
+
+RULES = {
+    "TPL041": "direct PADDLE_TPU_* environment read outside the flag catalog",
+    "TPL042": "PADDLE_TPU_* name not registered in the env-flag catalog",
+    "TPL043": "env-flag catalog out of sync with docs/flags.md",
+}
+
+FLAG_TOKEN_RE = re.compile(r"PADDLE_TPU_[A-Z0-9][A-Z0-9_]*")
+FLAGS_MODULE_SUFFIX = "core/flags.py"
+FLAGS_DOC = "docs/flags.md"
+
+
+def _find_flags_module(ctx: AnalysisContext) -> Optional[ast.Module]:
+    sf = ctx.find_file(FLAGS_MODULE_SUFFIX)
+    if sf is not None:
+        return sf.tree
+    text = ctx.read_root_file("paddle_tpu/" + FLAGS_MODULE_SUFFIX)
+    if text is not None:
+        try:
+            return ast.parse(text)
+        except SyntaxError:
+            return None
+    return None
+
+
+def load_catalog(ctx: AnalysisContext) -> Set[str]:
+    """PADDLE_TPU_* names registered via define_env_flag in core/flags.py."""
+    tree = _find_flags_module(ctx)
+    names: Set[str] = set()
+    if tree is None:
+        return names
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and qual_tail(qualname(node.func), 1) == "define_env_flag":
+            name = literal_str(node.args[0] if node.args else None)
+            if name:
+                names.add(name)
+    return names
+
+
+def _is_flags_module(sf: SourceFile) -> bool:
+    return sf.rel.endswith(FLAGS_MODULE_SUFFIX)
+
+
+def _direct_env_reads(sf: SourceFile) -> List[Tuple[ast.AST, str]]:
+    """(node, flag-name) for os.environ/os.getenv reads of PADDLE_TPU_* names."""
+    def _is_environ(q: Optional[str]) -> bool:
+        # Matches os.environ and aliased imports (_os.environ, bare environ).
+        return bool(q) and q.split(".")[-1] == "environ"
+
+    out: List[Tuple[ast.AST, str]] = []
+    for node in ast.walk(sf.tree):
+        name: Optional[str] = None
+        if isinstance(node, ast.Subscript) and _is_environ(qualname(node.value)):
+            name = literal_str(node.slice)
+        elif isinstance(node, ast.Call):
+            qual = qualname(node.func) or ""
+            parts = qual.split(".")
+            if (parts[-1] == "get" and _is_environ(".".join(parts[:-1]))) or parts[-1] == "getenv":
+                name = literal_str(node.args[0] if node.args else None)
+        elif isinstance(node, ast.Compare) and len(node.comparators) == 1:
+            # "PADDLE_TPU_<NAME>" in os.environ
+            if (
+                isinstance(node.ops[0], (ast.In, ast.NotIn))
+                and _is_environ(qualname(node.comparators[0]))
+            ):
+                name = literal_str(node.left)
+        if name and name.startswith("PADDLE_TPU_"):
+            out.append((node, name))
+    return out
+
+
+def _token_lines(text: str) -> List[Tuple[str, int]]:
+    """(token, 1-based line) for every PADDLE_TPU_* occurrence in raw text."""
+    out: List[Tuple[str, int]] = []
+    for i, line in enumerate(text.splitlines(), start=1):
+        for m in FLAG_TOKEN_RE.finditer(line):
+            out.append((m.group(0), i))
+    return out
+
+
+def check(ctx: AnalysisContext) -> List[Finding]:
+    findings: List[Finding] = []
+    catalog = load_catalog(ctx)
+
+    for sf in ctx.files:
+        if not _is_flags_module(sf):
+            for node, name in _direct_env_reads(sf):
+                findings.append(
+                    Finding(
+                        "TPL041",
+                        sf.rel,
+                        node.lineno,
+                        node.col_offset,
+                        sf.enclosing_symbol(node),
+                        f"direct environment read of '{name}' — resolve it through "
+                        "core.flags.env_value/env_raw so the catalog stays authoritative",
+                    )
+                )
+        if catalog:
+            seen: Set[str] = set()
+            for token, line in _token_lines(sf.text):
+                if token in catalog or token in seen:
+                    continue
+                seen.add(token)
+                findings.append(
+                    Finding(
+                        "TPL042",
+                        sf.rel,
+                        line,
+                        0,
+                        "",
+                        f"'{token}' is not registered in the env-flag catalog "
+                        "(core/flags.py define_env_flag)",
+                    )
+                )
+
+    if catalog:
+        flags_file = ctx.find_file(FLAGS_MODULE_SUFFIX)
+        doc_path = flags_file.rel if flags_file is not None else FLAGS_MODULE_SUFFIX
+        doc = ctx.read_root_file(FLAGS_DOC)
+        if doc is None:
+            findings.append(
+                Finding(
+                    "TPL043", doc_path, 1, 0, "",
+                    f"{FLAGS_DOC} is missing — regenerate it with "
+                    "`python -m paddle_tpu.core.flags > docs/flags.md`",
+                )
+            )
+        else:
+            doc_tokens = {t for t, _ in _token_lines(doc)}
+            for name in sorted(catalog - doc_tokens):
+                findings.append(
+                    Finding(
+                        "TPL043", doc_path, 1, 0, "",
+                        f"flag '{name}' is in the catalog but missing from {FLAGS_DOC} — "
+                        "regenerate the doc",
+                    )
+                )
+            for name in sorted(doc_tokens - catalog):
+                findings.append(
+                    Finding(
+                        "TPL043", doc_path, 1, 0, "",
+                        f"{FLAGS_DOC} documents '{name}' which the catalog does not define — "
+                        "stale doc or missing define_env_flag",
+                    )
+                )
+    return findings

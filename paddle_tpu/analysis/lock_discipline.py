@@ -1,0 +1,225 @@
+"""TPL02x — lock-discipline: blocking work under locks, lock-order inversions.
+
+The serving stack holds dozens of ``with self._lock:`` sites across batching,
+router, decode and metrics.  Two bug classes recur in concurrent systems like
+this one:
+
+* TPL021 — a blocking call made while a lock is held (socket I/O,
+  ``subprocess``, ``time.sleep``, XLA ``.compile()`` / ``block_until_ready``,
+  unbounded ``queue.get`` / ``Thread.join`` / ``Event.wait``).  Every other
+  thread touching that lock stalls behind the slow operation.
+* TPL022 — two methods of one class acquire the same pair of locks in
+  opposite orders: a classic deadlock waiting for the right interleaving.
+
+The checker builds a per-class map of lock-typed attributes (anything
+assigned ``threading.Lock/RLock/Condition`` in any method), then walks each
+method tracking the stack of held locks through ``with`` blocks.
+``Condition.wait``/``wait_for`` on the *held* condition is exempt — that is
+the designed use.  ``re.compile`` is exempt from the compile rule.
+Analysis is intra-method: a helper that blocks while its caller holds a lock
+is out of scope (documented limitation).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import AnalysisContext, Finding, SourceFile, call_kwarg, qual_tail, qualname
+
+RULES = {
+    "TPL021": "blocking call while holding a lock",
+    "TPL022": "lock-order inversion between methods of a class",
+}
+
+_LOCK_CTORS = {
+    "threading.Lock": "lock",
+    "threading.RLock": "lock",
+    "threading.Condition": "condition",
+    "Lock": "lock",
+    "RLock": "lock",
+    "Condition": "condition",
+}
+_TYPED_CTORS = {
+    "threading.Event": "event",
+    "Event": "event",
+    "threading.Thread": "thread",
+    "Thread": "thread",
+    "queue.Queue": "queue",
+    "Queue": "queue",
+    "queue.SimpleQueue": "queue",
+    "SimpleQueue": "queue",
+}
+_TYPED_CTORS.update(_LOCK_CTORS)
+
+_SOCKET_BLOCKING_METHODS = {"recv", "recv_into", "sendall", "accept"}
+
+
+def _attr_types(cls: ast.ClassDef) -> Dict[str, str]:
+    """self.X -> type tag ("lock"/"condition"/"event"/"thread"/"queue")."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign) or not isinstance(node.value, ast.Call):
+            continue
+        ctor = qualname(node.value.func)
+        tag = _TYPED_CTORS.get(ctor or "") or _TYPED_CTORS.get(qual_tail(ctor, 2))
+        if not tag:
+            continue
+        for tgt in node.targets:
+            q = qualname(tgt)
+            if q and q.startswith("self."):
+                out[q] = tag
+    return out
+
+
+def _module_lock_names(sf: SourceFile) -> Dict[str, str]:
+    """Module-level NAME = threading.Lock()/Condition() assignments."""
+    out: Dict[str, str] = {}
+    for node in sf.tree.body:
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            ctor = qualname(node.value.func)
+            tag = _LOCK_CTORS.get(ctor or "") or _LOCK_CTORS.get(qual_tail(ctor, 2))
+            if tag:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        out[tgt.id] = tag
+    return out
+
+
+def _has_timeout(call: ast.Call) -> bool:
+    if call.args:
+        return True
+    return call_kwarg(call, "timeout") is not None
+
+
+def _blocking_reason(call: ast.Call, attr_types: Dict[str, str], held: List[str]) -> Optional[str]:
+    """Why this call blocks, or None if it is fine under a lock."""
+    qual = qualname(call.func)
+    if not qual:
+        return None
+    if qual_tail(qual, 2) == "time.sleep":
+        return "'time.sleep' stalls every thread contending for the lock"
+    if qual.startswith("subprocess."):
+        return f"subprocess call '{qual}' blocks on the child process"
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    attr = call.func.attr
+    recv_q = qualname(call.func.value)
+    recv_type = attr_types.get(recv_q or "")
+    if attr in _SOCKET_BLOCKING_METHODS:
+        return f"socket I/O '.{attr}()' blocks on the peer"
+    if attr == "connect" and recv_type is None and recv_q and "sock" in recv_q.lower():
+        return "socket '.connect()' blocks on the peer"
+    if attr == "block_until_ready":
+        return "'.block_until_ready()' waits for device completion"
+    if attr == "compile" and qual != "re.compile":
+        return "XLA '.compile()' can take seconds"
+    if attr == "get" and recv_type == "queue":
+        if call_kwarg(call, "timeout") is None and not _is_nonblocking_get(call):
+            return "unbounded 'queue.get()' can wait forever"
+        return None
+    if attr == "join" and recv_type == "thread":
+        return "'.join()' waits for thread exit"
+    if attr in ("wait", "wait_for"):
+        if recv_type == "condition" and recv_q in held:
+            return None  # Condition.wait on the held condition releases it: the designed use.
+        if recv_type == "event" and not _has_timeout(call):
+            return "unbounded 'Event.wait()' can wait forever"
+        if recv_type == "condition" and recv_q not in held:
+            return "waiting on a condition whose lock is not the held one"
+    return None
+
+
+def _is_nonblocking_get(call: ast.Call) -> bool:
+    blk = call_kwarg(call, "block")
+    if isinstance(blk, ast.Constant) and blk.value is False:
+        return True
+    if call.args and isinstance(call.args[0], ast.Constant) and call.args[0].value is False:
+        return True
+    return False
+
+
+def _with_locks(node: ast.With, lock_names: Dict[str, str]) -> List[str]:
+    out = []
+    for item in node.items:
+        q = qualname(item.context_expr)
+        if q and q in lock_names:
+            out.append(q)
+    return out
+
+
+def _scan_node(sf, owner, node, lock_names, attr_types, findings, edges, held) -> None:
+    # Manual recursion (not ast.walk) so the held-lock stack nests with
+    # `with` blocks and stops at function boundaries.
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        return  # nested defs execute later, under unknown lock state
+    if isinstance(node, ast.With):
+        acquired = _with_locks(node, lock_names)
+        for new in acquired:
+            for h in held:
+                if h != new:
+                    edges.setdefault((h, new), (owner, node.lineno))
+        inner = held + acquired
+        for item in node.items:
+            _scan_node(sf, owner, item.context_expr, lock_names, attr_types, findings, edges, held)
+        for stmt in node.body:
+            _scan_node(sf, owner, stmt, lock_names, attr_types, findings, edges, inner)
+        return
+    if isinstance(node, ast.Call) and held:
+        reason = _blocking_reason(node, attr_types, held)
+        if reason:
+            findings.append(
+                Finding(
+                    "TPL021",
+                    sf.rel,
+                    node.lineno,
+                    node.col_offset,
+                    owner,
+                    f"{reason} (holding {', '.join(held)})",
+                )
+            )
+    for child in ast.iter_child_nodes(node):
+        _scan_node(sf, owner, child, lock_names, attr_types, findings, edges, held)
+
+
+def check(ctx: AnalysisContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in ctx.files:
+        module_locks = _module_lock_names(sf)
+        # Module-level functions guard with module locks.
+        for node in sf.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and module_locks:
+                edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+                for stmt in node.body:
+                    _scan_node(sf, node.name, stmt, module_locks, {}, findings, edges, [])
+        for cls in [n for n in ast.walk(sf.tree) if isinstance(n, ast.ClassDef)]:
+            attr_types = _attr_types(cls)
+            lock_names = {k: v for k, v in attr_types.items() if v in ("lock", "condition")}
+            lock_names.update(module_locks)
+            edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+            for meth in cls.body:
+                if not isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                owner = f"{cls.name}.{meth.name}"
+                for stmt in meth.body:
+                    _scan_node(sf, owner, stmt, lock_names, attr_types, findings, edges, [])
+            reported: Set[frozenset] = set()
+            for (a, b), (owner, line) in edges.items():
+                if (b, a) in edges:
+                    pair = frozenset((a, b))
+                    if pair in reported:
+                        continue
+                    reported.add(pair)
+                    other_owner, other_line = edges[(b, a)]
+                    findings.append(
+                        Finding(
+                            "TPL022",
+                            sf.rel,
+                            line,
+                            0,
+                            owner,
+                            f"lock-order inversion: {owner} takes {a} then {b}, "
+                            f"but {other_owner} (line {other_line}) takes {b} then {a}",
+                        )
+                    )
+    return findings

@@ -1,0 +1,154 @@
+"""TPL03x — thread-lifecycle: every thread must be reclaimable.
+
+A thread that is neither ``daemon=True`` nor joined anywhere keeps the
+process alive after main exits; a ``while True`` service loop with no
+``break``/``return`` can never be asked to stop.  Both patterns have bitten
+this repo's serving stack (the batcher dispatcher, router accept loop and
+decode scheduler all carry explicit stop wiring today — this checker keeps
+it that way).
+
+* TPL031 — ``threading.Thread(...)`` that is not ``daemon=True`` (at the
+  constructor or via a later ``.daemon = True`` assignment) and whose
+  binding (``self._t`` / local name) is never ``.join()``-ed in the file.
+* TPL032 — a thread target containing a ``while True:`` loop with no
+  ``break``, ``return`` or ``raise`` anywhere in the loop body: no code
+  path can ever leave the loop, so stop()/drain can never conclude.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .core import AnalysisContext, Finding, SourceFile, call_kwarg, qual_tail, qualname
+
+RULES = {
+    "TPL031": "thread is neither daemon=True nor provably joined",
+    "TPL032": "thread loop has no termination path (no break/return in 'while True')",
+}
+
+
+def _is_thread_ctor(call: ast.Call) -> bool:
+    qual = qualname(call.func)
+    return qual in ("threading.Thread", "Thread") or qual_tail(qual, 2) == "threading.Thread"
+
+
+def _binding_of(sf: SourceFile, call: ast.Call) -> Optional[str]:
+    """Qualname the Thread object is assigned to (``self._t`` / ``t``), or None."""
+    parent = sf.parent(call)
+    if isinstance(parent, ast.Assign):
+        for tgt in parent.targets:
+            q = qualname(tgt)
+            if q:
+                return q
+    if isinstance(parent, (ast.AnnAssign, ast.AugAssign)):
+        return qualname(parent.target)
+    return None
+
+
+def _joined_or_daemoned(sf: SourceFile, binding: str) -> bool:
+    """True if ``binding.join(...)`` or ``binding.daemon = True`` appears anywhere."""
+    for node in ast.walk(sf.tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "join"
+            and qualname(node.func.value) == binding
+        ):
+            return True
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                q = qualname(tgt)
+                if q == f"{binding}.daemon" and isinstance(node.value, ast.Constant) and node.value.value is True:
+                    return True
+    return False
+
+
+def _resolve_target(sf: SourceFile, call: ast.Call) -> Optional[ast.AST]:
+    tgt = call_kwarg(call, "target")
+    if tgt is None:
+        return None
+    q = qualname(tgt)
+    if not q:
+        return None
+    if q.startswith("self."):
+        meth_name = q.split(".", 1)[1]
+        cls = _enclosing_class(sf, call)
+        if cls is not None:
+            for node in cls.body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and node.name == meth_name:
+                    return node
+        return None
+    for node in sf.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and node.name == q:
+            return node
+    return None
+
+
+def _enclosing_class(sf: SourceFile, node: ast.AST) -> Optional[ast.ClassDef]:
+    cur = sf.parent(node)
+    while cur is not None:
+        if isinstance(cur, ast.ClassDef):
+            return cur
+        cur = sf.parent(cur)
+    return None
+
+
+def _loop_can_exit(loop: ast.While) -> bool:
+    """Any break/return/raise inside the loop (outside nested defs)?"""
+    stack: List[ast.AST] = list(loop.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.Break, ast.Return, ast.Raise)):
+            return True
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+    return False
+
+
+def check(ctx: AnalysisContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in ctx.files:
+        checked_targets: Set[int] = set()
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Call) and _is_thread_ctor(node)):
+                continue
+            symbol = sf.enclosing_symbol(node)
+            daemon = call_kwarg(node, "daemon")
+            is_daemon = isinstance(daemon, ast.Constant) and daemon.value is True
+            if not is_daemon:
+                binding = _binding_of(sf, node)
+                if binding is None or not _joined_or_daemoned(sf, binding):
+                    where = f"'{binding}'" if binding else "an unbound thread"
+                    findings.append(
+                        Finding(
+                            "TPL031",
+                            sf.rel,
+                            node.lineno,
+                            node.col_offset,
+                            symbol,
+                            f"thread {where} is not daemon=True and is never joined — "
+                            "it will outlive the process's intent to exit",
+                        )
+                    )
+            target = _resolve_target(sf, node)
+            if target is None or id(target) in checked_targets:
+                continue
+            checked_targets.add(id(target))
+            for tnode in ast.walk(target):
+                if isinstance(tnode, ast.While):
+                    test = tnode.test
+                    if isinstance(test, ast.Constant) and test.value is True and not _loop_can_exit(tnode):
+                        findings.append(
+                            Finding(
+                                "TPL032",
+                                sf.rel,
+                                tnode.lineno,
+                                tnode.col_offset,
+                                getattr(target, "name", symbol),
+                                "'while True' thread loop has no break/return — "
+                                "no stop flag or sentinel can ever end it",
+                            )
+                        )
+    return findings

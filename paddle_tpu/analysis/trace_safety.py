@@ -1,0 +1,250 @@
+"""TPL01x — trace-safety: host-impure work inside traced functions.
+
+JAX traces a function once and replays the jaxpr; any host-side effect
+(`time.time`, `random.random`, `os.environ`, materializing a tracer with
+`float()`/`.item()`) executes at *trace* time, silently baking one value into
+the compiled computation.  This is the static twin of the runtime retrace
+guard: it finds functions handed to `jax.jit` / `pjit` / `lax.scan` /
+`lax.while_loop` / `lax.cond` / `lax.fori_loop` (as decorators or call
+arguments) and flags host-impure calls inside them, one helper level deep.
+
+* TPL011 — direct host-impure call (`time.*`, `random.*`, `np.random.*`,
+  `os.environ` / `os.getenv`) in a traced function.
+* TPL012 — tracer materialization (`float()` / `int()` / `np.asarray()` /
+  `.item()` / `.tolist()` on values derived from the traced function's
+  parameters), or a host-impure call inside a same-module helper invoked
+  from a traced function.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import AnalysisContext, Finding, SourceFile, call_kwarg, qual_tail, qualname
+
+RULES = {
+    "TPL011": "host-impure call inside a traced function",
+    "TPL012": "tracer materialization or host-impure helper reachable from a traced function",
+}
+
+# Entry points whose function-valued arguments are traced.  Maps the
+# 2-component qualname tail to the positional indices holding callees.
+_TRACE_CALL_ARGS = {
+    "jax.jit": (0,),
+    "jax.pjit": (0,),
+    "lax.scan": (0,),
+    "lax.map": (0,),
+    "lax.while_loop": (0, 1),
+    "lax.cond": (1, 2),
+    "lax.fori_loop": (2,),
+    "jax.vmap": (0,),
+    "jax.pmap": (0,),
+    "jax.grad": (0,),
+    "jax.value_and_grad": (0,),
+}
+_TRACE_BARE = {"jit", "pjit"}  # bare decorator/call names that also count
+
+# Call-name prefixes that are host-impure no matter what they touch.
+_IMPURE_PREFIXES = (
+    "time.",
+    "random.",
+    "np.random.",
+    "numpy.random.",
+    "os.environ",
+    "os.getenv",
+    "os.urandom",
+)
+
+# Materializers: pull a concrete value out of a tracer.
+_MATERIALIZE_CALLS = {"float", "int", "bool"}
+_MATERIALIZE_FUNCS = {"np.asarray", "numpy.asarray", "np.array", "numpy.array"}
+_MATERIALIZE_METHODS = {"item", "tolist"}
+
+
+def _is_trace_entry(qual: Optional[str]) -> Optional[Tuple[int, ...]]:
+    """Positional callee indices if ``qual`` names a tracing entry point."""
+    if not qual:
+        return None
+    if qual in _TRACE_BARE or qual_tail(qual, 1) in _TRACE_BARE:
+        return (0,)
+    tail = qual_tail(qual, 2)
+    if tail in _TRACE_CALL_ARGS:
+        return _TRACE_CALL_ARGS[tail]
+    return None
+
+
+def _resolve_name(sf: SourceFile, node: ast.AST, name: str) -> Optional[ast.AST]:
+    """Lexically resolve ``name`` to a def visible from ``node``.
+
+    Walks enclosing function scopes outward to module level.  ClassDef
+    scopes are skipped — python name resolution inside a method does not
+    see class-level names.
+    """
+    cur: Optional[ast.AST] = node
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Module)):
+            for child in cur.body:
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)) and child.name == name:
+                    return child
+        cur = sf.parent(cur)
+    return None
+
+
+def _collect_traced(sf: SourceFile) -> List[Tuple[ast.AST, str]]:
+    """All function nodes handed to a tracing entry point, with a label."""
+    traced: List[Tuple[ast.AST, str]] = []
+    seen: Set[int] = set()
+
+    def add(fn: Optional[ast.AST], label: str) -> None:
+        if fn is None or id(fn) in seen:
+            return
+        seen.add(id(fn))
+        traced.append((fn, label))
+
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                dq = qualname(dec)
+                if _is_trace_entry(dq) is not None:
+                    add(node, node.name)
+                elif isinstance(dec, ast.Call):
+                    cq = qualname(dec.func)
+                    if _is_trace_entry(cq) is not None:
+                        add(node, node.name)
+                    elif qual_tail(cq, 1) == "partial" and dec.args:
+                        if _is_trace_entry(qualname(dec.args[0])) is not None:
+                            add(node, node.name)
+        elif isinstance(node, ast.Call):
+            idxs = _is_trace_entry(qualname(node.func))
+            if idxs is None:
+                continue
+            for i in idxs:
+                if i >= len(node.args):
+                    continue
+                arg = node.args[i]
+                if isinstance(arg, ast.Lambda):
+                    add(arg, "<lambda>")
+                elif isinstance(arg, ast.Name):
+                    add(_resolve_name(sf, node, arg.id), arg.id)
+    return traced
+
+
+def _impure_call(call: ast.Call) -> Optional[str]:
+    qual = qualname(call.func)
+    if not qual:
+        return None
+    for pre in _IMPURE_PREFIXES:
+        if qual == pre.rstrip(".") or qual.startswith(pre):
+            return qual
+    return None
+
+
+def _impure_subscript(node: ast.Subscript) -> Optional[str]:
+    qual = qualname(node.value)
+    if qual == "os.environ":
+        return qual
+    return None
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _tainted_names(fn: ast.AST) -> Set[str]:
+    """Parameter names plus names assigned from expressions touching them."""
+    if isinstance(fn, ast.Lambda):
+        args = fn.args
+    else:
+        args = fn.args  # type: ignore[union-attr]
+    tainted: Set[str] = {a.arg for a in args.args + args.posonlyargs + args.kwonlyargs}
+    if args.vararg:
+        tainted.add(args.vararg.arg)
+    if args.kwarg:
+        tainted.add(args.kwarg.arg)
+    if isinstance(fn, ast.Lambda):
+        return tainted
+    for _ in range(2):  # cheap fixpoint: two passes cover chained assigns
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and _names_in(node.value) & tainted:
+                for tgt in node.targets:
+                    tainted |= _names_in(tgt)
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)) and node.value is not None:
+                if _names_in(node.value) & tainted:
+                    tainted |= _names_in(node.target)
+    return tainted
+
+
+def _walk_no_nested_defs(fn: ast.AST):
+    """Walk a function body without descending into nested def/lambda bodies."""
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    stack: List[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def check(ctx: AnalysisContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in ctx.files:
+        traced = _collect_traced(sf)
+        traced_ids = {id(fn) for fn, _ in traced}
+        emitted: Set[Tuple[str, int, str]] = set()
+
+        def emit(rule: str, node: ast.AST, label: str, msg: str) -> None:
+            key = (rule, node.lineno, msg)
+            if key in emitted:
+                return
+            emitted.add(key)
+            findings.append(Finding(rule, sf.rel, node.lineno, node.col_offset, label, msg))
+
+        for fn, label in traced:
+            tainted = _tainted_names(fn)
+            for node in _walk_no_nested_defs(fn):
+                if isinstance(node, ast.Call):
+                    imp = _impure_call(node)
+                    if imp:
+                        emit("TPL011", node, label,
+                             f"host-impure call '{imp}' inside traced function — "
+                             "its value is frozen at trace time")
+                        continue
+                    fq = qualname(node.func)
+                    # Materialization of traced values.
+                    if fq in _MATERIALIZE_CALLS and node.args and _names_in(node.args[0]) & tainted:
+                        emit("TPL012", node, label,
+                             f"'{fq}()' materializes a traced value — forces host sync "
+                             "and breaks under jit")
+                    elif fq in _MATERIALIZE_FUNCS and node.args and _names_in(node.args[0]) & tainted:
+                        emit("TPL012", node, label,
+                             f"'{fq}()' materializes a traced value inside a traced function")
+                    elif (isinstance(node.func, ast.Attribute)
+                          and node.func.attr in _MATERIALIZE_METHODS
+                          and _names_in(node.func.value) & tainted):
+                        emit("TPL012", node, label,
+                             f"'.{node.func.attr}()' on a traced value materializes it "
+                             "inside a traced function")
+                    # One level deep: helper lexically visible from the call.
+                    elif isinstance(node.func, ast.Name):
+                        helper = _resolve_name(sf, node, node.func.id)
+                        if helper is None or id(helper) in traced_ids or helper is fn:
+                            continue
+                        for hnode in _walk_no_nested_defs(helper):
+                            if isinstance(hnode, ast.Call):
+                                himp = _impure_call(hnode)
+                                if himp:
+                                    emit("TPL012", hnode, node.func.id,
+                                         f"host-impure call '{himp}' in helper "
+                                         f"'{node.func.id}' reached from traced "
+                                         f"function '{label}'")
+                            elif isinstance(hnode, ast.Subscript) and _impure_subscript(hnode):
+                                emit("TPL012", hnode, node.func.id,
+                                     f"'os.environ[...]' read in helper '{node.func.id}' "
+                                     f"reached from traced function '{label}'")
+                elif isinstance(node, ast.Subscript) and _impure_subscript(node):
+                    emit("TPL011", node, label,
+                         "'os.environ[...]' read inside traced function — "
+                         "its value is frozen at trace time")
+    return findings

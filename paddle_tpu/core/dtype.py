@@ -17,9 +17,9 @@ import jax
 # stays f32/bf16 (TPU has no f64 MXU). This is process-global: applications
 # embedding plain JAX code alongside paddle_tpu can opt out with
 # PADDLE_TPU_NO_X64=1 (int64/float64 tensors then degrade to int32/float32).
-import os as _os
+from . import flags as _flags
 
-if _os.environ.get("PADDLE_TPU_NO_X64", "0") != "1":
+if not _flags.env_value("PADDLE_TPU_NO_X64"):
     jax.config.update("jax_enable_x64", True)
 
 import jax.numpy as jnp

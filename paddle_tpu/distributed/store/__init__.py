@@ -21,6 +21,7 @@ import struct
 import subprocess
 import time
 
+from ...core import flags as _flags
 from ...testing import chaos
 from ...utils.retry import (WatchdogTimeout, backoff_delays,
                             call_with_watchdog)
@@ -126,7 +127,7 @@ class TCPStore(Store):
                  retries: int = None):
         self.endpoint = endpoint
         self._timeout = timeout
-        self._retries = (int(os.environ.get("PADDLE_TPU_STORE_RETRIES", 3))
+        self._retries = (int(_flags.env_value("PADDLE_TPU_STORE_RETRIES"))
                          if retries is None else retries)
         self._sock = self._connect()
         self._proc = None

@@ -51,7 +51,7 @@ Robustness (docs/fault_tolerance.md "serving fleet")
     with a typed ``UNAVAILABLE`` frame (clients + the front router see
     the death now, not after the request deadline). A crashed pool
     worker fails its in-flight batch the same way, then respawns in
-    place with bounded backoff (``paddle_tpu_serve_worker_restarts``
+    place with bounded backoff (``paddle_tpu_serve_worker_restarts_total``
     counts respawns; an exhausted budget leaves the slot dead and
     /healthz red). ``max_queue`` (``PADDLE_TPU_SERVE_MAX_QUEUE``) is the
     admission watermark: past it, ``submit`` sheds instantly with
@@ -63,7 +63,6 @@ Robustness (docs/fault_tolerance.md "serving fleet")
 """
 from __future__ import annotations
 
-import os
 import threading
 import time
 from collections import deque
@@ -74,6 +73,7 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from ..core import flags as _flags
 from ..testing import chaos
 from .errors import (ERR_RESOURCE_EXHAUSTED, ERR_UNAVAILABLE,
                      TypedServeError)
@@ -92,16 +92,13 @@ def max_queue_default() -> int:
     queued requests past this are shed with ``RESOURCE_EXHAUSTED``
     instead of waiting out (and then blowing) the request deadline.
     0 disables shedding."""
-    try:
-        return int(os.environ.get("PADDLE_TPU_SERVE_MAX_QUEUE", "0") or 0)
-    except ValueError:
-        return 0
+    return int(_flags.env_value("PADDLE_TPU_SERVE_MAX_QUEUE"))
 
 
 def bucket_ladder(max_batch: int, env: Optional[str] = None) -> List[int]:
     """The padded-shape ladder: ``PADDLE_TPU_SERVE_BUCKETS`` if set, else
     powers of two up to (and including) ``max_batch``."""
-    spec = os.environ.get("PADDLE_TPU_SERVE_BUCKETS", "") \
+    spec = _flags.env_value("PADDLE_TPU_SERVE_BUCKETS") \
         if env is None else env
     if spec.strip():
         vals = sorted({int(t) for t in spec.replace(",", " ").split()})
@@ -218,7 +215,7 @@ class DynamicBatcher:
         self._inflight = 0           # accepted, not yet delivered
         self._inflight_lock = threading.Lock()
         self._worker_restarts_total = counter(
-            "paddle_tpu_serve_worker_restarts",
+            "paddle_tpu_serve_worker_restarts_total",
             "Pool predictor worker threads respawned in place after an "
             "uncaught crash (bounded backoff; an exhausted budget leaves "
             "the slot dead and /healthz unhealthy).")
@@ -278,7 +275,7 @@ class DynamicBatcher:
         import warnings
 
         mode = (trailing if trailing is not None else
-                os.environ.get("PADDLE_TPU_SERVE_TRAILING", "auto"))
+                _flags.env_value("PADDLE_TPU_SERVE_TRAILING"))
         mode = str(mode).lower()
         if mode not in ("auto", "on", "off"):
             raise ValueError(

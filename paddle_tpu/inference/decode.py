@@ -36,7 +36,6 @@ from __future__ import annotations
 import dataclasses
 import json
 import math
-import os
 import queue
 import threading
 import time
@@ -49,6 +48,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import profiler
+from ..core import flags as _flags
 from ..core import monitor
 from ..jit.compile_cache import AotCache
 from ..models.gpt import GPTConfig, gpt_decode_fns
@@ -247,8 +247,7 @@ class DecodeEngine:
         self.max_pending = int(max_pending) if max_pending is not None \
             else 4 * self.max_slots
         self.batch_ladder = bucket_ladder(
-            self.max_slots, env=os.environ.get("PADDLE_TPU_DECODE_BUCKETS",
-                                               ""))
+            self.max_slots, env=_flags.env_value("PADDLE_TPU_DECODE_BUCKETS"))
         self.kv_ladder = kv_capacity_ladder(cfg.max_seq_len)
 
         prefill_fn, step_fn = gpt_decode_fns(cfg, eps=self.eps)

@@ -60,6 +60,7 @@ from concurrent.futures import TimeoutError as FuturesTimeout
 
 import numpy as np
 
+from ..core import flags as _flags
 from ..testing import chaos
 from .errors import (ERR_DEADLINE_EXCEEDED, ERR_INTERNAL,
                      ERR_INVALID_ARGUMENT, TypedServeError)
@@ -71,7 +72,6 @@ _DTYPES = [np.float32, np.float64, np.int32, np.int64, np.uint8, np.bool_]
 _MAX_TENSORS = 256          # a request claiming more is malformed
 _MAX_NDIM = 32
 _MAX_CTX_BYTES = 1 << 16    # trace-context JSON cap
-_DEFAULT_MAX_REQUEST_BYTES = 1 << 28       # 256 MiB
 _SEND_COPY_MAX = 1 << 16    # payloads above this go out via memoryview
 
 
@@ -82,11 +82,7 @@ def _recv_exact(sock, n):
 
 def max_request_bytes() -> int:
     """Per-request payload budget (``PADDLE_TPU_MAX_REQUEST_BYTES``)."""
-    try:
-        return int(os.environ.get("PADDLE_TPU_MAX_REQUEST_BYTES",
-                                  str(_DEFAULT_MAX_REQUEST_BYTES)))
-    except ValueError:
-        return _DEFAULT_MAX_REQUEST_BYTES
+    return int(_flags.env_value("PADDLE_TPU_MAX_REQUEST_BYTES"))
 
 
 def _encode_ctx(ctx: dict) -> bytes:
@@ -276,18 +272,11 @@ def decode_request(sock, prompt, opts=None, trace=True,
 
 
 def _idle_timeout_default() -> float:
-    try:
-        return float(os.environ.get("PADDLE_TPU_SERVE_IDLE_TIMEOUT", "600"))
-    except ValueError:
-        return 600.0
+    return float(_flags.env_value("PADDLE_TPU_SERVE_IDLE_TIMEOUT"))
 
 
 def _request_timeout_default() -> float:
-    try:
-        return float(os.environ.get("PADDLE_TPU_SERVE_REQUEST_TIMEOUT",
-                                    "120"))
-    except ValueError:
-        return 120.0
+    return float(_flags.env_value("PADDLE_TPU_SERVE_REQUEST_TIMEOUT"))
 
 
 class InferenceServer:
@@ -328,8 +317,7 @@ class InferenceServer:
         # loopback by default: the daemon is unauthenticated — exposing a
         # model to the network segment must be an explicit --host choice
         if max_batch_size is None:
-            max_batch_size = int(os.environ.get("PADDLE_TPU_SERVE_BATCH",
-                                                "0") or 0)
+            max_batch_size = int(_flags.env_value("PADDLE_TPU_SERVE_BATCH"))
         self._batched = (not decode) and max_batch_size \
             and int(max_batch_size) > 1
         self._batcher = None
@@ -396,8 +384,7 @@ class InferenceServer:
         self._admin = None
         self.metrics_port = None
         if metrics_port is None:
-            mp = os.environ.get("PADDLE_TPU_METRICS_PORT", "").strip()
-            metrics_port = int(mp) if mp else None
+            metrics_port = _flags.env_value("PADDLE_TPU_METRICS_PORT")
         self._varz = None
         self._slo = None
         if metrics_port is not None and int(metrics_port) >= 0:

@@ -26,9 +26,10 @@ site.  Tickets capture the originating step index and re-raise as
 """
 from __future__ import annotations
 
-import os
 import time
 from typing import Any, List, Optional
+
+from ..core import flags as _flags
 
 __all__ = [
     "AsyncStepError",
@@ -47,7 +48,7 @@ def async_steps(default: int = DEFAULT_ASYNC_STEPS) -> int:
     ``0`` (or ``off``/``sync``) disables async stepping — the train loop
     fetches the loss synchronously every step.  ``>=1`` is the maximum
     number of dispatched-but-unfetched steps."""
-    raw = os.environ.get("PADDLE_TPU_ASYNC_STEPS", "").strip().lower()
+    raw = (_flags.env_raw("PADDLE_TPU_ASYNC_STEPS") or "").strip().lower()
     if raw in ("off", "sync", "false", "no"):
         return 0
     try:

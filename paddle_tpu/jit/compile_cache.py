@@ -26,6 +26,8 @@ import time
 import warnings
 from typing import Any, Dict, Optional, Tuple
 
+from ..core import flags as _flags
+
 __all__ = ["setup_compilation_cache", "suspend_compilation_cache",
            "cache_dir", "aot_compile", "AotCache",
            "RetraceGuard", "RetraceError", "RetraceWarning"]
@@ -38,7 +40,7 @@ _configured: list = [None]
 
 def cache_dir() -> Optional[str]:
     """Resolved persistent-cache directory, or None when disabled."""
-    d = os.environ.get("PADDLE_TPU_COMPILE_CACHE")
+    d = _flags.env_raw("PADDLE_TPU_COMPILE_CACHE")
     if d is None:
         d = os.path.join("~", ".cache", "paddle_tpu", "xla")
     if d.strip().lower() in _DISABLED:
@@ -284,7 +286,7 @@ class RetraceGuard:
         if fp == self._fp:
             return "match"
         diff = _describe_diff(self._fp, fp)
-        mode = os.environ.get("PADDLE_TPU_RETRACE", "warn").strip().lower()
+        mode = str(_flags.env_value("PADDLE_TPU_RETRACE")).strip().lower()
         msg = (f"paddle_tpu retrace guard [{self.label}]: compiled-step "
                f"input signature changed mid-run -> recompiling. "
                f"Changed: {diff}. (PADDLE_TPU_RETRACE=error makes this "

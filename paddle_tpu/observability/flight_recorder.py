@@ -29,6 +29,7 @@ import time
 import traceback
 from typing import Callable, Optional
 
+from ..core import flags as _flags
 from . import metrics as _metrics
 
 __all__ = ["FlightRecorder", "stall_dump_dir", "stall_timeout",
@@ -41,14 +42,14 @@ _DUMPS = _metrics.counter(
 
 def stall_dump_dir(env: Optional[str] = None) -> str:
     """Dump directory from ``PADDLE_TPU_STALL_DUMP``; '' = disabled."""
-    return os.environ.get("PADDLE_TPU_STALL_DUMP", "") \
+    return (_flags.env_raw("PADDLE_TPU_STALL_DUMP") or "") \
         if env is None else env
 
 
 def stall_timeout(default: float = 60.0) -> float:
+    raw = _flags.env_raw("PADDLE_TPU_STALL_TIMEOUT")
     try:
-        return float(os.environ.get("PADDLE_TPU_STALL_TIMEOUT",
-                                    str(default)))
+        return float(raw) if raw is not None else float(default)
     except ValueError:
         return default
 

@@ -40,10 +40,10 @@ Env knobs (all optional):
 """
 from __future__ import annotations
 
-import os
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from ..core import flags as _flags
 from . import metrics as _metrics
 from .timeseries import TimeSeriesStore
 
@@ -52,7 +52,7 @@ __all__ = ["Objective", "SLOEngine", "slo_windows", "slo_burn_factors",
 
 
 def _env_float(name: str, default: float) -> float:
-    raw = os.environ.get(name, "").strip().lower()
+    raw = (_flags.env_raw(name) or "").strip().lower()
     if not raw:
         return default
     if raw == "off":
@@ -65,7 +65,7 @@ def _env_float(name: str, default: float) -> float:
 
 def _env_pair(name: str, default: Tuple[float, float]
               ) -> Tuple[float, float]:
-    raw = os.environ.get(name, "").strip()
+    raw = (_flags.env_raw(name) or "").strip()
     if raw:
         try:
             a, b = (float(x) for x in raw.split(",", 1))

@@ -21,6 +21,7 @@ import threading
 import time
 from typing import Dict, Optional
 
+from ..core import flags as _flags
 from . import metrics as _metrics
 
 __all__ = ["SpanRecorder", "next_request_id", "request_id_base",
@@ -59,7 +60,7 @@ def next_request_id() -> int:
 
 def trace_sample_rate(env: Optional[str] = None) -> float:
     """``PADDLE_TPU_TRACE_SAMPLE`` clamped to [0, 1]; 0 disables."""
-    raw = os.environ.get("PADDLE_TPU_TRACE_SAMPLE", "") \
+    raw = (_flags.env_raw("PADDLE_TPU_TRACE_SAMPLE") or "") \
         if env is None else env
     try:
         rate = float(raw) if str(raw).strip() else 0.0
@@ -87,7 +88,7 @@ class SpanRecorder:
         self._hist = reg.histogram(metric, help, labelnames=("stage",))
         self.sample = trace_sample_rate() if sample is None \
             else min(max(float(sample), 0.0), 1.0)
-        self.path = os.environ.get("PADDLE_TPU_TRACE_FILE", "") \
+        self.path = _flags.env_value("PADDLE_TPU_TRACE_FILE") \
             if path is None else path
         self._lock = threading.Lock()
         self._file = None

@@ -22,11 +22,12 @@ what the sampler thread already wrote (no compile, no model code).
 """
 from __future__ import annotations
 
-import os
 import threading
 import time
 from collections import deque
 from typing import Dict, List, Optional, Tuple
+
+from ..core import flags as _flags
 
 from . import metrics as _metrics
 
@@ -38,7 +39,7 @@ DEFAULT_WINDOWS = (("1m", 60.0), ("5m", 300.0), ("1h", 3600.0))
 
 def varz_interval(default: float = 10.0) -> float:
     """``PADDLE_TPU_VARZ_INTERVAL`` seconds (sampler period)."""
-    raw = os.environ.get("PADDLE_TPU_VARZ_INTERVAL", "")
+    raw = _flags.env_raw("PADDLE_TPU_VARZ_INTERVAL") or ""
     try:
         v = float(raw) if raw.strip() else default
     except ValueError:
@@ -48,7 +49,7 @@ def varz_interval(default: float = 10.0) -> float:
 
 def varz_capacity(default: int = 400) -> int:
     """``PADDLE_TPU_VARZ_CAPACITY`` ring size (snapshot count)."""
-    raw = os.environ.get("PADDLE_TPU_VARZ_CAPACITY", "")
+    raw = _flags.env_raw("PADDLE_TPU_VARZ_CAPACITY") or ""
     try:
         v = int(raw) if raw.strip() else default
     except ValueError:

@@ -27,11 +27,11 @@ from __future__ import annotations
 
 import functools
 import math
-import os
 
 import jax
 import jax.numpy as jnp
 
+from ...core import flags as _flags
 from . import _common
 from ._common import NEG_INF, VMEM, I0 as _I0, pltpu
 
@@ -113,7 +113,7 @@ def _decode_attention_pallas(q, k, v, lengths):
 
 def decode_attention(q, k, v, lengths, kernel=None):
     """Dispatch on `kernel` (or $PADDLE_TPU_DECODE_KERNEL, default xla)."""
-    choice = (kernel or os.environ.get(_ENV, "xla")).strip().lower()
+    choice = (kernel or _flags.env_value(_ENV)).strip().lower()
     if choice == "pallas":
         return _decode_attention_pallas(q, k, v, lengths)
     if choice in ("", "xla"):

@@ -45,15 +45,17 @@ are per-schedule, so nesting `inject()` restarts the count.
 """
 from __future__ import annotations
 
-import os
 import random
 import re
 import threading
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional
+
+from ..core import flags as _flags
 
 __all__ = ["ChaosFault", "Rule", "Schedule", "inject", "maybe_fail",
-           "active_schedule", "fail_once"]
+           "active_schedule", "fail_once", "SITES", "register_site",
+           "registered_sites", "sites_markdown"]
 
 _EXC_REGISTRY = {
     "OSError": OSError,
@@ -181,7 +183,7 @@ def active_schedule() -> Optional[Schedule]:
     global _ENV_SPEC, _ENV_SCHED
     if _STACK:
         return _STACK[-1]
-    spec = os.environ.get("PADDLE_TPU_CHAOS")
+    spec = _flags.env_raw("PADDLE_TPU_CHAOS")
     if not spec:
         _ENV_SPEC = _ENV_SCHED = None
         return None
@@ -190,10 +192,60 @@ def active_schedule() -> Optional[Schedule]:
     return _ENV_SCHED
 
 
+# ---------------------------------------------------------------------------
+# Site registry.  Every maybe_fail()/fail_once() site name must be declared
+# here (name -> where it is compiled into the production path).  tpulint
+# rule TPL053 cross-checks this table against the call sites and the table
+# in docs/fault_tolerance.md, which is generated by sites_markdown().
+# ---------------------------------------------------------------------------
+SITES: Dict[str, str] = {}
+
+
+def register_site(name: str, doc: str) -> None:
+    """Declare one chaos injection site (idempotent; last doc wins)."""
+    SITES[name] = doc
+
+
+def registered_sites() -> Dict[str, str]:
+    """name -> doc for every registered site, sorted by name."""
+    return dict(sorted(SITES.items()))
+
+
+def sites_markdown() -> str:
+    """The docs/fault_tolerance.md site table, generated from the registry."""
+    width = max(len(n) for n in SITES) + 2 if SITES else 10
+    lines = [f"| {'site'.ljust(width)} | where |",
+             f"|{'-' * (width + 2)}|-------|"]
+    for name, doc in sorted(SITES.items()):
+        lines.append(f"| {('`' + name + '`').ljust(width)} | {doc} |")
+    return "\n".join(lines)
+
+
+register_site("ckpt.write", "each shard write in `save_sharded`")
+register_site("ckpt.rename", "the atomic commit rename")
+register_site("fs.put", "`LocalFS.put/put_file`, `RemoteFS.put/put_file`")
+register_site("store.req", "every `TCPStore` request, `FileStore` mutators")
+register_site("step.fn", "each step of `run_with_recovery`")
+register_site("serve.conn.read", "each request decode in a serve conn thread")
+register_site("serve.conn.reply", "each reply send in a serve conn thread")
+register_site("batcher.dispatch", "each batch the dispatcher forms")
+register_site("batcher.worker", "each batch a pool worker executes")
+register_site("router.forward", "each router->backend forward attempt")
+register_site("decode.stream", "each token delivery in the decode engine")
+
+
 def maybe_fail(site: str, detail=None):
     """Injection-site hook: no-op unless a schedule arms `site`."""
     sched = active_schedule()
     if sched is not None:
+        # Validated only when armed, so the idle production path stays a
+        # dict lookup + None check.  An unregistered site is a programming
+        # error: the registry (and docs/fault_tolerance.md generated from
+        # it) must name every site compiled into the code.
+        if site not in SITES:
+            raise ValueError(
+                f"chaos site {site!r} is not registered — add a "
+                "register_site() entry in testing/chaos.py")
         sched.hit(site, detail)
 
 

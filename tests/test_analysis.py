@@ -1,0 +1,636 @@
+"""tpulint (paddle_tpu.analysis) fixture tests.
+
+Every rule gets a *bad* sample that fires and a *good* sample that stays
+quiet, plus coverage for the shared machinery: inline suppressions, the
+baseline file, JSON output, CLI exit codes — and the self-run gate that
+keeps the real paddle_tpu/ tree clean (that gate is what makes tpulint a
+tier-1 CI check rather than a demo).
+
+Fixtures build throwaway repo roots under tmp_path (a `docs/` dir plus
+ROADMAP.md so root discovery and the drift checkers have something to
+look at) and run the analysis in-process via `paddle_tpu.analysis.run`.
+"""
+from __future__ import annotations
+
+import json
+import textwrap
+from pathlib import Path
+
+from paddle_tpu.analysis import all_rules, main, run
+from paddle_tpu.analysis.catalog_drift import lint_metric_family
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+def _repo(tmp_path: Path, files: dict) -> Path:
+    (tmp_path / "docs").mkdir(parents=True, exist_ok=True)
+    (tmp_path / "ROADMAP.md").write_text("# fixture root\n")
+    for rel, text in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(text))
+    return tmp_path
+
+
+def _lint(root: Path, *rels: str, **kw):
+    paths = [str(root / r) for r in rels] if rels else [str(root)]
+    return run(paths, root=str(root), **kw)
+
+
+def _rules(result):
+    return {f.rule for f in result.findings}
+
+
+def _only(result, rule):
+    return [f for f in result.findings if f.rule == rule]
+
+
+# -- core: parse failures, rule catalog -----------------------------------
+
+def test_syntax_error_yields_tpl001(tmp_path):
+    root = _repo(tmp_path, {"m.py": "def broken(:\n"})
+    res = _lint(root, "m.py")
+    assert _rules(res) == {"TPL001"}
+    assert "syntax error" in res.findings[0].message
+
+
+def test_all_rules_catalog_is_complete():
+    rules = all_rules()
+    expected = {"TPL001", "TPL011", "TPL012", "TPL021", "TPL022",
+                "TPL031", "TPL032", "TPL041", "TPL042", "TPL043",
+                "TPL051", "TPL052", "TPL053", "TPL054"}
+    assert expected <= set(rules)
+    assert all(desc.strip() for desc in rules.values())
+
+
+# -- TPL011 / TPL012: trace safety ----------------------------------------
+
+def test_tpl011_impure_call_in_jitted_function(tmp_path):
+    root = _repo(tmp_path, {"m.py": """\
+        import time
+        import jax
+
+        @jax.jit
+        def step(x):
+            t = time.time()
+            return x + t
+    """})
+    res = _lint(root, "m.py")
+    (f,) = _only(res, "TPL011")
+    assert "time.time" in f.message and f.symbol == "step"
+
+
+def test_tpl011_environ_read_in_scan_body(tmp_path):
+    root = _repo(tmp_path, {"m.py": """\
+        import os
+        import jax
+
+        def body(carry, x):
+            carry = carry + len(os.environ["HOME"])
+            return carry, x
+
+        def roll(xs):
+            return jax.lax.scan(body, 0, xs)
+    """})
+    res = _lint(root, "m.py")
+    assert any("os.environ" in f.message for f in _only(res, "TPL011"))
+
+
+def test_tpl012_materialization_of_traced_param(tmp_path):
+    root = _repo(tmp_path, {"m.py": """\
+        import jax
+
+        @jax.jit
+        def step(x):
+            y = x * 2
+            return float(y)
+    """})
+    res = _lint(root, "m.py")
+    (f,) = _only(res, "TPL012")
+    assert "float" in f.message
+
+
+def test_tpl012_impure_helper_one_level_deep(tmp_path):
+    root = _repo(tmp_path, {"m.py": """\
+        import time
+        import jax
+
+        def helper():
+            return time.time()
+
+        @jax.jit
+        def step(x):
+            return x + helper()
+    """})
+    res = _lint(root, "m.py")
+    (f,) = _only(res, "TPL012")
+    assert "helper" in f.message and "step" in f.message
+
+
+def test_trace_safety_quiet_on_pure_and_host_code(tmp_path):
+    root = _repo(tmp_path, {"m.py": """\
+        import time
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(x):
+            return jnp.tanh(x) + float(3)   # constant float() is fine
+
+        def host_loop(x):
+            # impure, but never traced: not a finding
+            return step(x), time.time()
+    """})
+    res = _lint(root, "m.py")
+    assert not _only(res, "TPL011") and not _only(res, "TPL012")
+
+
+# -- TPL021 / TPL022: lock discipline -------------------------------------
+
+def test_tpl021_sleep_under_lock(tmp_path):
+    root = _repo(tmp_path, {"m.py": """\
+        import threading
+        import time
+
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def slow(self):
+                with self._lock:
+                    time.sleep(1.0)
+    """})
+    res = _lint(root, "m.py")
+    (f,) = _only(res, "TPL021")
+    assert "time.sleep" in f.message and "self._lock" in f.message
+    assert f.symbol == "Pool.slow"
+
+
+def test_tpl021_module_level_lock(tmp_path):
+    root = _repo(tmp_path, {"m.py": """\
+        import threading
+        import time
+
+        _LOCK = threading.Lock()
+
+        def refresh():
+            with _LOCK:
+                time.sleep(0.5)
+    """})
+    res = _lint(root, "m.py")
+    assert _only(res, "TPL021")
+
+
+def test_tpl021_quiet_cases(tmp_path):
+    root = _repo(tmp_path, {"m.py": """\
+        import re
+        import threading
+        import time
+
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition()
+                self._q = []
+
+            def fine(self):
+                with self._lock:
+                    self._q.append(re.compile("x"))   # re.compile exempt
+                time.sleep(1.0)                       # outside the lock
+
+            def waiter(self):
+                with self._cv:
+                    self._cv.wait()                   # designed use: exempt
+    """})
+    res = _lint(root, "m.py")
+    assert not _only(res, "TPL021")
+
+
+def test_tpl022_lock_order_inversion(tmp_path):
+    root = _repo(tmp_path, {"m.py": """\
+        import threading
+
+        class Pair:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def forward(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def backward(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """})
+    res = _lint(root, "m.py")
+    (f,) = _only(res, "TPL022")
+    assert "inversion" in f.message
+
+
+def test_tpl022_quiet_on_consistent_order(tmp_path):
+    root = _repo(tmp_path, {"m.py": """\
+        import threading
+
+        class Pair:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def two(self):
+                with self._a:
+                    with self._b:
+                        pass
+    """})
+    res = _lint(root, "m.py")
+    assert not _only(res, "TPL022")
+
+
+# -- TPL031 / TPL032: thread lifecycle ------------------------------------
+
+def test_tpl031_unreclaimed_thread(tmp_path):
+    root = _repo(tmp_path, {"m.py": """\
+        import threading
+
+        def work():
+            pass
+
+        def start():
+            t = threading.Thread(target=work)
+            t.start()
+            return t
+    """})
+    res = _lint(root, "m.py")
+    (f,) = _only(res, "TPL031")
+    assert "'t'" in f.message
+
+
+def test_tpl031_quiet_when_daemon_or_joined(tmp_path):
+    root = _repo(tmp_path, {"m.py": """\
+        import threading
+
+        def work():
+            pass
+
+        def daemonized():
+            t = threading.Thread(target=work, daemon=True)
+            t.start()
+
+        def joined():
+            t = threading.Thread(target=work)
+            t.start()
+            t.join()
+
+        def late_daemon():
+            t = threading.Thread(target=work)
+            t.daemon = True
+            t.start()
+    """})
+    res = _lint(root, "m.py")
+    assert not _only(res, "TPL031")
+
+
+def test_tpl032_unstoppable_thread_loop(tmp_path):
+    root = _repo(tmp_path, {"m.py": """\
+        import threading
+
+        def loop():
+            while True:
+                x = 1
+
+        def start():
+            t = threading.Thread(target=loop, daemon=True)
+            t.start()
+    """})
+    res = _lint(root, "m.py")
+    (f,) = _only(res, "TPL032")
+    assert "while True" in f.message and f.symbol == "loop"
+
+
+def test_tpl032_quiet_with_stop_path(tmp_path):
+    root = _repo(tmp_path, {"m.py": """\
+        import threading
+
+        class Svc:
+            def __init__(self):
+                self._stop = threading.Event()
+                self._t = threading.Thread(target=self._run, daemon=True)
+
+            def _run(self):
+                while True:
+                    if self._stop.is_set():
+                        break
+    """})
+    res = _lint(root, "m.py")
+    assert not _only(res, "TPL032")
+
+
+# -- TPL041 / TPL042 / TPL043: env-flag registry --------------------------
+
+def test_tpl041_direct_env_reads(tmp_path):
+    root = _repo(tmp_path, {"m.py": """\
+        import os
+
+        a = os.environ.get("PADDLE_TPU_FOO")
+        b = os.environ["PADDLE_TPU_BAR"]
+        c = os.getenv("PADDLE_TPU_BAZ")
+        d = "PADDLE_TPU_QUX" in os.environ
+        e = os.environ.get("HOME")    # not a framework flag: fine
+    """})
+    res = _lint(root, "m.py")
+    names = {f.message.split("'")[1] for f in _only(res, "TPL041")}
+    assert names == {"PADDLE_TPU_FOO", "PADDLE_TPU_BAR",
+                     "PADDLE_TPU_BAZ", "PADDLE_TPU_QUX"}
+
+
+def test_tpl041_allows_reads_inside_flags_module(tmp_path):
+    root = _repo(tmp_path, {
+        "pkg/core/flags.py": """\
+            import os
+
+            def env_raw(name):
+                return os.environ.get(name)
+
+            x = os.environ.get("PADDLE_TPU_FOO")
+        """,
+    })
+    res = _lint(root, "pkg/core/flags.py")
+    assert not _only(res, "TPL041")
+
+
+def test_tpl042_unregistered_token(tmp_path):
+    root = _repo(tmp_path, {
+        "pkg/core/flags.py": """\
+            def define_env_flag(name, default, doc):
+                pass
+
+            define_env_flag("PADDLE_TPU_KNOWN", 1, "a registered knob")
+        """,
+        "pkg/m.py": """\
+            # reads PADDLE_TPU_UNDECLARED via some side channel
+            SPEC = "PADDLE_TPU_KNOWN"
+        """,
+        "docs/flags.md": "| `PADDLE_TPU_KNOWN` | 1 | a registered knob |\n",
+    })
+    res = _lint(root, "pkg")
+    msgs = [f.message for f in _only(res, "TPL042")]
+    assert len(msgs) == 1 and "PADDLE_TPU_UNDECLARED" in msgs[0]
+
+
+def test_tpl043_doc_drift_both_directions(tmp_path):
+    files = {
+        "pkg/core/flags.py": """\
+            def define_env_flag(name, default, doc):
+                pass
+
+            define_env_flag("PADDLE_TPU_ALPHA", 1, "doc")
+        """,
+    }
+    # Doc missing entirely.
+    root = _repo(tmp_path / "a", files)
+    res = _lint(root, "pkg")
+    assert any("missing" in f.message for f in _only(res, "TPL043"))
+    # Doc present but stale (extra flag) and incomplete (catalog flag absent).
+    root = _repo(tmp_path / "b", dict(
+        files, **{"docs/flags.md": "| `PADDLE_TPU_GHOST` | - | gone |\n"}))
+    res = _lint(root, "pkg")
+    msgs = " ".join(f.message for f in _only(res, "TPL043"))
+    assert "PADDLE_TPU_ALPHA" in msgs and "PADDLE_TPU_GHOST" in msgs
+    # Doc in sync: quiet.
+    root = _repo(tmp_path / "c", dict(
+        files, **{"docs/flags.md": "| `PADDLE_TPU_ALPHA` | 1 | doc |\n"}))
+    res = _lint(root, "pkg")
+    assert not _only(res, "TPL043")
+
+
+# -- TPL051 / TPL052: metric conventions + doc drift ----------------------
+
+def test_lint_metric_family_shared_rules():
+    assert lint_metric_family(
+        "counter", "paddle_tpu_reqs_total", "Requests.", ("verb",)) == []
+    assert lint_metric_family("gauge", "paddle_tpu_depth", "Depth.", ()) == []
+    bad = lint_metric_family("counter", "paddle_tpu_reqs", "", ("Bad-Label",))
+    joined = " ".join(bad)
+    assert "_total" in joined and "help" in joined and "Bad-Label" in joined
+    assert lint_metric_family("gauge", "Paddle-TPU-up", "Up.", ())
+
+
+def test_tpl051_and_tpl052_fire_on_bad_metric_defs(tmp_path):
+    root = _repo(tmp_path, {
+        "m.py": """\
+            from obs import counter, gauge
+
+            C = counter("paddle_tpu_crashes", "Crashes seen.")
+            G = gauge("paddle_tpu_depth", "Queue depth.")
+        """,
+        "docs/observability.md": "| `depth` | gauge | queue depth |\n",
+    })
+    res = _lint(root, "m.py")
+    (f51,) = _only(res, "TPL051")
+    assert "_total" in f51.message
+    (f52,) = _only(res, "TPL052")
+    assert "paddle_tpu_crashes" in f52.message
+    # `depth` documented unprefixed counts as a mention for paddle_tpu_depth.
+    assert "paddle_tpu_depth" not in f52.message
+
+
+def test_tpl052_quiet_when_documented(tmp_path):
+    root = _repo(tmp_path, {
+        "m.py": 'from obs import counter\nC = counter("paddle_tpu_x_total", "X.")\n',
+        "docs/observability.md": "documents `x_total` right here\n",
+    })
+    res = _lint(root, "m.py")
+    assert not _only(res, "TPL052")
+
+
+# -- TPL053: chaos-site drift ---------------------------------------------
+
+def test_tpl053_all_three_drift_directions(tmp_path):
+    root = _repo(tmp_path, {
+        "pkg/testing/chaos.py": """\
+            SITES = {}
+
+            def register_site(name, doc):
+                SITES[name] = doc
+
+            def maybe_fail(site):
+                pass
+
+            register_site("ckpt.write", "shard writes")
+            register_site("stale.site", "nothing calls this")
+        """,
+        "pkg/m.py": """\
+            from .testing.chaos import maybe_fail
+
+            def save():
+                maybe_fail("ckpt.write")
+                maybe_fail("ckpt.unregistered")
+        """,
+        "docs/fault_tolerance.md": "| `ckpt.write` | shard writes |\n",
+    })
+    res = _lint(root, "pkg")
+    msgs = [f.message for f in _only(res, "TPL053")]
+    assert any("ckpt.unregistered" in m and "not registered" in m for m in msgs)
+    assert any("stale.site" in m and "stale" in m for m in msgs)
+    # stale.site is registered but absent from the fault-tolerance doc.
+    assert any("stale.site" in m and "not documented" in m for m in msgs)
+
+
+def test_tpl053_quiet_when_in_sync(tmp_path):
+    root = _repo(tmp_path, {
+        "pkg/testing/chaos.py": """\
+            def register_site(name, doc):
+                pass
+
+            def maybe_fail(site):
+                pass
+
+            register_site("ckpt.write", "shard writes")
+        """,
+        "pkg/m.py": """\
+            from .testing.chaos import maybe_fail
+
+            def save():
+                maybe_fail("ckpt.write")
+        """,
+        "docs/fault_tolerance.md": "| `ckpt.write` | shard writes |\n",
+    })
+    res = _lint(root, "pkg")
+    assert not _only(res, "TPL053")
+
+
+# -- TPL054: admin endpoints ----------------------------------------------
+
+def test_tpl054_undocumented_admin_endpoint(tmp_path):
+    root = _repo(tmp_path, {
+        "pkg/observability/admin.py": """\
+            def route(path):
+                if path == "/healthz":
+                    return "ok"
+                if path == "/secretz":
+                    return "hidden"
+        """,
+        "docs/observability.md": "exposes /healthz for probes\n",
+    })
+    res = _lint(root, "pkg")
+    (f,) = _only(res, "TPL054")
+    assert "/secretz" in f.message
+
+
+# -- suppressions, baseline, JSON, CLI ------------------------------------
+
+_SLEEPY = """\
+    import threading
+    import time
+
+    class P:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def a(self):
+            with self._lock:
+                time.sleep(1.0){trailing}
+
+        def b(self):
+            with self._lock:
+                {standalone}time.sleep(2.0)
+"""
+
+
+def test_inline_suppressions_trailing_and_standalone(tmp_path):
+    src = textwrap.dedent(_SLEEPY).format(
+        trailing="  # tpulint: disable=TPL021",
+        standalone="# tpulint: disable=TPL021\n                ",
+    )
+    root = _repo(tmp_path, {"m.py": src})
+    res = _lint(root, "m.py")
+    assert res.findings == [] and res.suppressed == 2
+
+
+def test_suppression_is_rule_specific_and_all_works(tmp_path):
+    src = textwrap.dedent(_SLEEPY).format(
+        trailing="  # tpulint: disable=TPL031",   # wrong rule: still fires
+        standalone="# tpulint: disable=all\n                ",
+    )
+    root = _repo(tmp_path, {"m.py": src})
+    res = _lint(root, "m.py")
+    assert len(_only(res, "TPL021")) == 1 and res.suppressed == 1
+
+
+def test_baseline_grandfathers_by_fingerprint(tmp_path):
+    src = textwrap.dedent(_SLEEPY).format(trailing="", standalone="")
+    root = _repo(tmp_path, {"m.py": src})
+    bl = root / ".tpulint-baseline.json"
+
+    rc = main([str(root / "m.py"), "--root", str(root),
+               "--baseline", str(bl), "--write-baseline"])
+    assert rc == 0 and bl.is_file()
+    entries = json.loads(bl.read_text())["entries"]
+    assert len(entries) == 2 and all(e["rule"] == "TPL021" for e in entries)
+
+    # Shift every line: the line-independent fingerprint still matches.
+    (root / "m.py").write_text("# a new leading comment line\n" + src)
+    res = _lint(root, "m.py", baseline_path=str(bl))
+    assert res.findings == [] and res.baselined == 2
+
+    # A brand-new finding is NOT absorbed by the baseline.
+    (root / "m.py").write_text(
+        src + "\n    def c(self):\n        with self._lock:\n"
+        "            time.sleep(3.0)\n")
+    res = _lint(root, "m.py", baseline_path=str(bl))
+    assert len(res.findings) == 1 and res.baselined == 2
+
+
+def test_rule_prefix_filter(tmp_path):
+    src = textwrap.dedent(_SLEEPY).format(trailing="", standalone="")
+    root = _repo(tmp_path, {"m.py": src})
+    res = _lint(root, "m.py", rules=["TPL03"])
+    assert res.findings == []
+    res = _lint(root, "m.py", rules=["TPL02"])
+    assert len(res.findings) == 2
+
+
+def test_cli_exit_codes_and_json_schema(tmp_path, capsys):
+    src = textwrap.dedent(_SLEEPY).format(trailing="", standalone="")
+    root = _repo(tmp_path, {"m.py": src, "clean.py": "x = 1\n"})
+
+    assert main([str(root / "clean.py"), "--root", str(root)]) == 0
+    assert "tpulint: clean" in capsys.readouterr().out
+
+    assert main([str(root / "m.py"), "--root", str(root), "--json"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["version"] == 1
+    assert set(payload) == {"version", "root", "findings", "counts",
+                            "suppressed", "baselined"}
+    assert payload["counts"] == {"TPL021": 2}
+    f = payload["findings"][0]
+    assert set(f) == {"rule", "path", "line", "col", "symbol", "message"}
+    assert f["path"] == "m.py"
+
+    assert main([str(root / "nope.py")]) == 2
+    capsys.readouterr()
+
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    assert "TPL011" in out and "TPL054" in out
+
+
+# -- the gate: paddle_tpu's own tree must be clean ------------------------
+
+def test_self_run_gate_paddle_tpu_is_clean():
+    """`python -m paddle_tpu.analysis paddle_tpu/` must exit 0.
+
+    This is the CI gate the subsystem exists for: every rule the linter
+    enforces holds on the linter's own codebase. New findings must be
+    fixed, suppressed inline with a reason, or explicitly baselined —
+    never ignored.
+    """
+    res = run([str(REPO_ROOT / "paddle_tpu")], root=str(REPO_ROOT))
+    assert res.findings == [], "\n" + "\n".join(f.format() for f in res.findings)

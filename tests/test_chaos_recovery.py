@@ -66,12 +66,21 @@ def test_chaos_seeded_probability_is_deterministic():
 
 
 def test_chaos_env_spec(monkeypatch):
+    # Synthetic site: armed schedules validate against the registry.
+    monkeypatch.setitem(chaos.SITES, "env.site", "test-only synthetic site")
     monkeypatch.setenv("PADDLE_TPU_CHAOS", "env.site:1:OSError")
     with pytest.raises(OSError):
         chaos.maybe_fail("env.site")
     chaos.maybe_fail("env.site")               # call 2: disarmed
     monkeypatch.delenv("PADDLE_TPU_CHAOS")
     chaos.maybe_fail("env.site")               # schedule dropped with env
+
+
+def test_chaos_unregistered_site_rejected_only_when_armed():
+    with chaos.inject("step.fn:1:OSError"):
+        with pytest.raises(ValueError, match="not registered"):
+            chaos.maybe_fail("no.such.site")
+    chaos.maybe_fail("no.such.site")   # disarmed: stays a silent no-op
 
 
 def test_chaos_wildcard_and_nesting():

@@ -119,7 +119,9 @@ def test_retry_budget_accounting():
     assert not zero.try_spend()
 
 
-def test_chaos_hang_rule_parses_and_sleeps():
+def test_chaos_hang_rule_parses_and_sleeps(monkeypatch):
+    # Synthetic site: armed schedules validate against the registry.
+    monkeypatch.setitem(chaos.SITES, "x.y", "test-only synthetic site")
     r = chaos.Rule.parse("x.y:1:Hang@0.2")
     assert r.hang_s == pytest.approx(0.2) and r.exc is None
     with chaos.inject("x.y:1:Hang@0.2") as sched:
