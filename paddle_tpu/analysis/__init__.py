@@ -1,20 +1,27 @@
-"""tpulint — paddle_tpu's framework-native static-analysis subsystem.
+"""tpulint — paddle_tpu's framework-native analysis subsystem.
 
-Five checkers grounded in this repo's real bug classes:
+Five static checkers grounded in this repo's real bug classes, plus a
+runtime concurrency sanitizer that covers what AST analysis cannot:
 
 ====== =====================================================================
-TPL01x trace-safety: host-impure calls inside jit/scan/pjit-traced functions
+TPL01x trace-safety: host-impure calls inside jit/scan/pjit-traced
+       functions; donated (``donate_argnums``) buffers read after the call
 TPL02x lock-discipline: blocking calls under held locks, lock-order inversion
 TPL03x thread-lifecycle: daemon/join proof, stop wiring for loop threads
 TPL04x env-flag registry: PADDLE_TPU_* reads resolve through core.flags
 TPL05x catalog drift: metrics/chaos-sites/admin endpoints vs docs
+TPR1xx tsan-lite (:mod:`.runtime`): *observed* lock-order inversions,
+       blocking-under-lock wall-clock holds, thread/lock leaks — armed via
+       ``PADDLE_TPU_TSAN``, gated through the runtime pytest plugin
 ====== =====================================================================
 
-Run it: ``python -m paddle_tpu.analysis paddle_tpu/`` (exit 0 = clean).
-See docs/static_analysis.md for the rule catalog and suppression syntax.
+Run the static pass: ``python -m paddle_tpu.analysis paddle_tpu/`` (exit
+0 = clean).  Replay a runtime report: ``python -m paddle_tpu.analysis
+--runtime report.json``.  See docs/static_analysis.md for the rule
+catalog, the suppression syntax, and the tsan-lite workflow.
 """
 
-from .cli import CHECKERS, Result, all_rules, main, run
+from .cli import CHECKERS, Result, all_rules, filter_runtime, main, run, run_runtime_report
 from .core import AnalysisContext, Baseline, Finding, SourceFile
 
 __all__ = [
@@ -25,6 +32,8 @@ __all__ = [
     "Result",
     "SourceFile",
     "all_rules",
+    "filter_runtime",
     "main",
     "run",
+    "run_runtime_report",
 ]

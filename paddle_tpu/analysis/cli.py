@@ -5,6 +5,12 @@ Usage::
     python -m paddle_tpu.analysis [paths...] [--json] [--rules TPL02,TPL041]
                                   [--baseline FILE] [--write-baseline]
                                   [--root DIR] [--list-rules]
+    python -m paddle_tpu.analysis --runtime report.json [--json] [--rules ...]
+
+The second form replays a tsan-lite runtime report (written by the
+``paddle_tpu.analysis.runtime.pytest_plugin`` pytest plugin under
+``PADDLE_TPU_TSAN=1``) through the same suppression-comment and baseline
+filtering the static findings get — one workflow for both passes.
 
 Exit codes: 0 = clean, 1 = unsuppressed findings, 2 = usage/internal error.
 """
@@ -25,9 +31,11 @@ from .core import (
     Baseline,
     Finding,
     discover_root,
+    file_suppressions,
     load_sources,
     write_baseline,
 )
+from .runtime.sanitizer import RULES as RUNTIME_RULES
 
 CHECKERS = [trace_safety, lock_discipline, thread_lifecycle, flag_registry, catalog_drift]
 
@@ -39,6 +47,7 @@ def all_rules() -> Dict[str, str]:
     rules = dict(CORE_RULES)
     for mod in CHECKERS:
         rules.update(mod.RULES)
+    rules.update(RUNTIME_RULES)
     return dict(sorted(rules.items()))
 
 
@@ -108,6 +117,69 @@ def run(
     return result
 
 
+def filter_runtime(
+    findings: Sequence[Finding],
+    root: Path,
+    rules: Optional[Sequence[str]] = None,
+    baseline_path: Optional[str] = None,
+) -> Result:
+    """Runtime findings through the static pass's suppression/baseline model.
+
+    Suppression comments are read from the file each finding points at
+    (``# tpulint: disable=TPR102`` on the acquire line works exactly like a
+    static suppression); the baseline matches by the same line-independent
+    fingerprint.  Shared by ``--runtime`` and the pytest plugin.
+    """
+    active = list(findings)
+    if rules:
+        prefixes = tuple(r.strip() for r in rules if r.strip())
+        active = [f for f in active if f.rule.startswith(prefixes)]
+    active.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+
+    bl_path = Path(baseline_path) if baseline_path else root / DEFAULT_BASELINE
+    baseline = Baseline.load(bl_path)
+    supp_cache: Dict[str, Dict[int, set]] = {}
+
+    result = Result(root=str(root))
+    for f in active:
+        supp = supp_cache.get(f.path)
+        if supp is None:
+            p = Path(f.path)
+            supp = file_suppressions(p if p.is_absolute() else root / f.path)
+            supp_cache[f.path] = supp
+        rules_at = supp.get(f.line, set())
+        if "all" in rules_at or f.rule in rules_at:
+            result.suppressed += 1
+        elif baseline.matches(f):
+            result.baselined += 1
+        else:
+            result.findings.append(f)
+    return result
+
+
+def run_runtime_report(
+    report_path: str,
+    root: Optional[str] = None,
+    rules: Optional[Sequence[str]] = None,
+    baseline_path: Optional[str] = None,
+) -> Result:
+    """Load a tsan-lite JSON report and filter it (the --runtime mode)."""
+    data = json.loads(Path(report_path).read_text())
+    findings = [
+        Finding(
+            rule=str(e.get("rule", "")),
+            path=str(e.get("path", "")),
+            line=int(e.get("line", 0)),
+            col=int(e.get("col", 0)),
+            symbol=str(e.get("symbol", "")),
+            message=str(e.get("message", "")),
+        )
+        for e in data.get("findings", [])
+    ]
+    root_path = Path(root).resolve() if root else Path(data.get("root") or ".").resolve()
+    return filter_runtime(findings, root_path, rules=rules, baseline_path=baseline_path)
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m paddle_tpu.analysis",
@@ -127,6 +199,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         help="repo root for docs/catalog lookups (default: auto-discovered)")
     parser.add_argument("--list-rules", action="store_true",
                         help="print the rule catalog and exit")
+    parser.add_argument("--runtime", default=None, metavar="REPORT",
+                        help="replay a tsan-lite runtime report (JSON written by the "
+                             "paddle_tpu.analysis.runtime pytest plugin) through "
+                             "suppression/baseline filtering instead of running the "
+                             "static checkers")
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -134,13 +211,24 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             print(f"{rule}  {desc}")
         return 0
 
-    for p in args.paths:
-        if not Path(p).exists():
-            print(f"error: no such path: {p}", file=sys.stderr)
-            return 2
-
     rules = args.rules.split(",") if args.rules else None
-    result = run(args.paths, root=args.root, rules=rules, baseline_path=args.baseline)
+
+    if args.runtime is not None:
+        if not Path(args.runtime).is_file():
+            print(f"error: no such report: {args.runtime}", file=sys.stderr)
+            return 2
+        try:
+            result = run_runtime_report(
+                args.runtime, root=args.root, rules=rules, baseline_path=args.baseline)
+        except (ValueError, KeyError) as exc:
+            print(f"error: malformed runtime report: {exc}", file=sys.stderr)
+            return 2
+    else:
+        for p in args.paths:
+            if not Path(p).exists():
+                print(f"error: no such path: {p}", file=sys.stderr)
+                return 2
+        result = run(args.paths, root=args.root, rules=rules, baseline_path=args.baseline)
 
     if args.write_baseline:
         bl = Path(args.baseline) if args.baseline else Path(result.root) / DEFAULT_BASELINE

@@ -138,6 +138,19 @@ class SourceFile:
         return "all" in rules or rule in rules
 
 
+def file_suppressions(path: Path) -> Dict[int, Set[str]]:
+    """Suppression map for a file on disk (empty when unreadable).
+
+    Used by the runtime (--runtime) filter, where findings point at files
+    that were never loaded as :class:`SourceFile` objects.
+    """
+    try:
+        text = path.read_text()
+    except (OSError, UnicodeDecodeError):
+        return {}
+    return _collect_suppressions(text, text.splitlines())
+
+
 class Baseline:
     """Grandfathered findings: matched line-independently by fingerprint."""
 

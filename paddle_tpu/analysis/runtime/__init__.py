@@ -1,0 +1,44 @@
+"""tsan-lite: runtime concurrency sanitizer (tpulint's dynamic twin).
+
+The package behind the ``TPR1xx`` rules in ``python -m paddle_tpu.analysis
+--list-rules``:
+
+* :mod:`.sanitizer` — the instrumented ``threading`` shims, armed via
+  ``PADDLE_TPU_TSAN`` (lock-order graph / TPR101, blocking-under-lock /
+  TPR102, leak audit / TPR103, ``paddle_tpu_tsan_*`` metric families).
+* :mod:`.pytest_plugin` — ``pytest -p paddle_tpu.analysis.runtime.
+  pytest_plugin``: arms the sanitizer for a test run, writes the JSON
+  findings report (``PADDLE_TPU_TSAN_REPORT``) and fails the run on
+  unsuppressed findings — the runtime CI gate next to the static one.
+
+Replay a written report through suppression/baseline filtering with
+``python -m paddle_tpu.analysis --runtime <report.json>``.
+"""
+
+from .sanitizer import (  # noqa: F401
+    RULES,
+    audit,
+    default_root,
+    enabled,
+    findings,
+    install,
+    install_if_enabled,
+    installed,
+    report_data,
+    reset,
+    uninstall,
+)
+
+__all__ = [
+    "RULES",
+    "audit",
+    "default_root",
+    "enabled",
+    "findings",
+    "install",
+    "install_if_enabled",
+    "installed",
+    "report_data",
+    "reset",
+    "uninstall",
+]

@@ -1,0 +1,74 @@
+"""pytest plugin arming the tsan-lite sanitizer — the runtime CI gate.
+
+Usage (the designated concurrency modules; see ROADMAP.md tier-1 notes)::
+
+    PADDLE_TPU_TSAN=1 python -m pytest -q \\
+        tests/test_serve_batching.py tests/test_serve_chaos.py \\
+        tests/test_decode.py tests/test_slo.py \\
+        -p paddle_tpu.analysis.runtime.pytest_plugin
+
+* ``pytest_configure`` arms the sanitizer (before test modules construct
+  their locks/threads) — only when ``PADDLE_TPU_TSAN`` is set; with the
+  flag off the plugin is inert and nothing is patched.
+* ``pytest_sessionfinish`` runs the TPR103 leak audit, writes the raw JSON
+  report to ``PADDLE_TPU_TSAN_REPORT`` (when set), filters findings
+  through tpulint's suppression comments + baseline, prints what survives
+  and fails the run (exit 1) on unsuppressed findings.
+
+A written report replays offline with
+``python -m paddle_tpu.analysis --runtime <report.json>``.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+from . import sanitizer
+
+_ARMED = False
+
+
+def pytest_configure(config):
+    global _ARMED
+    if sanitizer.install_if_enabled(root=_rootdir(config)) is not None:
+        _ARMED = True
+
+
+def _rootdir(config) -> Path:
+    root = getattr(config, "rootpath", None)
+    return Path(str(root)) if root is not None else sanitizer.default_root()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    global _ARMED
+    if not _ARMED:
+        return
+    _ARMED = False
+    sanitizer.audit()
+    raw = sanitizer.report_data()
+    sanitizer.uninstall()
+
+    from ...core import flags as _flags
+    from ..cli import filter_runtime
+
+    report_path = str(_flags.env_value("PADDLE_TPU_TSAN_REPORT") or "").strip()
+    if report_path:
+        Path(report_path).write_text(json.dumps(raw, indent=2) + "\n")
+
+    root = _rootdir(session.config)
+    result = filter_runtime(sanitizer.findings(), root)
+    tw = print  # plain stdout: survives -q and capture teardown
+    tw("")
+    if result.findings:
+        tw(f"tsan-lite: {len(result.findings)} unsuppressed runtime finding(s) "
+           f"({result.suppressed} suppressed, {result.baselined} baselined):")
+        for f in result.findings:
+            tw(f"  {f.format()}")
+        if report_path:
+            tw(f"tsan-lite: report written to {report_path} "
+               f"(replay: python -m paddle_tpu.analysis --runtime {report_path})")
+        session.exitstatus = 1
+    else:
+        tw(f"tsan-lite: clean ({result.suppressed} suppressed, "
+           f"{result.baselined} baselined)")

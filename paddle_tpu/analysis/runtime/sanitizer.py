@@ -1,0 +1,753 @@
+"""tsan-lite — the runtime concurrency sanitizer behind tpulint's TPR1xx rules.
+
+tpulint's lock rules (TPL021/TPL022) are static and intra-module: a helper
+that blocks while its caller holds a lock across a module boundary, or a
+lock-order inversion between two *classes*, is invisible to the AST pass.
+This module is the dynamic twin.  When armed (`install()`, normally via the
+pytest plugin under ``PADDLE_TPU_TSAN=1``), ``threading.Lock`` / ``RLock`` /
+``Condition`` / ``Thread`` are replaced with instrumented shims that maintain
+
+* a process-global lock-order graph keyed per lock instance, with the
+  acquisition stack recorded on every edge — any cycle is a lock-order
+  inversion across whatever modules/classes the locks live in (**TPR101**,
+  the dynamic superset of TPL022);
+* wall-clock hold timing per lock: a hold segment crossing
+  ``PADDLE_TPU_TSAN_BLOCK_MS`` means *something* blocked while holding the
+  lock, wherever the blocking call lives (**TPR102**, the dynamic superset
+  of TPL021).  ``Condition.wait`` on the held lock releases it and suspends
+  the segment — the same designed-use exemption the static rule grants;
+* hold/wait/contention ``paddle_tpu_tsan_*`` metric families registered
+  through the observability registry (created only on install);
+* an end-of-process audit (**TPR103**): non-daemon threads that were never
+  joined and are still alive, and locks still held by threads that already
+  exited.
+
+Findings reuse tpulint's :class:`~paddle_tpu.analysis.core.Finding`
+dataclass, so the line-oriented ``# tpulint: disable=TPR102`` suppression
+comments and the JSON baseline work exactly as they do for static findings
+(note: TPR101/TPR102 messages embed observed stacks/durations, so prefer
+suppressions over baseline entries for runtime rules).  With
+``PADDLE_TPU_TSAN`` off nothing is imported beyond this module and nothing
+is patched — the idle path is byte-for-byte the stock ``threading`` module.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import weakref
+from _thread import allocate_lock as _raw_lock
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+from ..core import Finding
+
+RULES = {
+    "TPR101": "runtime lock-order inversion (cycle in the observed acquisition graph)",
+    "TPR102": "lock hold segment crossed the blocking threshold (blocking work under a lock)",
+    "TPR103": "end-of-process leak: non-daemon unjoined thread or never-released lock",
+}
+
+# The pristine primitives, captured at import — before install() can run.
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+_REAL_CONDITION = threading.Condition
+_REAL_THREAD = threading.Thread
+
+_THIS_FILE = __file__
+_THREADING_FILE = threading.__file__
+
+_tls = threading.local()
+
+#: current _State when installed, else None (module global so the shim
+#: classes can reach it without holding per-instance references alive).
+_STATE: Optional["_State"] = None
+
+
+def _held_stack() -> list:
+    held = getattr(_tls, "held", None)
+    if held is None:
+        held = _tls.held = []
+    return held
+
+
+def _thread_info() -> Tuple[int, str]:
+    """(ident, name) for the current thread without threading.current_thread().
+
+    current_thread() constructs a _DummyThread for unregistered threads —
+    which happens mid-bootstrap (Thread._started.set() runs before the
+    thread enters threading._active), and the construction would go through
+    the patched Thread class.  A plain dict read avoids all of that.
+    """
+    ident = threading.get_ident()
+    t = getattr(threading, "_active", {}).get(ident)
+    return ident, (t.name if t is not None else f"thread-{ident}")
+
+
+def _app_stack(skip: int = 2, limit: int = 8) -> List[Tuple[str, int, str]]:
+    """(filename, lineno, funcname) frames, innermost first, skipping the
+    sanitizer's own frames and threading.py internals.  No linecache I/O —
+    this runs on every tracked acquire."""
+    try:
+        f = sys._getframe(skip)
+    except ValueError:
+        return []
+    out: List[Tuple[str, int, str]] = []
+    while f is not None and len(out) < limit:
+        code = f.f_code
+        fname = code.co_filename
+        if fname != _THIS_FILE and fname != _THREADING_FILE:
+            out.append((fname, f.f_lineno, code.co_name))
+        f = f.f_back
+    return out
+
+
+class _Acq:
+    """One held-lock record on a thread's held stack."""
+
+    __slots__ = ("lock", "t0", "stack")
+
+    def __init__(self, lock, t0, stack):
+        self.lock = lock
+        self.t0 = t0
+        self.stack = stack
+
+
+class _Edge:
+    """One observed lock-order edge a->b with the stacks that created it."""
+
+    __slots__ = ("thread", "stack_from", "stack_to")
+
+    def __init__(self, thread, stack_from, stack_to):
+        self.thread = thread
+        self.stack_from = stack_from
+        self.stack_to = stack_to
+
+
+class _ThreadRecord:
+    __slots__ = ("ref", "stack", "joined")
+
+    def __init__(self, thread, stack):
+        self.ref = weakref.ref(thread)
+        self.stack = stack
+        self.joined = False
+
+
+class _State:
+    """Everything one armed sanitizer session accumulates."""
+
+    def __init__(self, block_threshold_s: float, root: Path):
+        self.mu = _raw_lock()  # raw: never itself instrumented
+        self.block_threshold_s = block_threshold_s
+        self.root = root
+        self.active = True
+        self.next_uid = 1
+        self.edges: Dict[int, Dict[int, _Edge]] = {}
+        self.lock_labels: Dict[int, str] = {}  # uid -> creation-site label
+        self.findings: List[Finding] = []
+        self.finding_keys: set = set()
+        self.locks: "weakref.WeakSet" = weakref.WeakSet()
+        self.threads: List[_ThreadRecord] = []
+        # metric instruments, bound by install()
+        self.hold_hist = None
+        self.wait_hist = None
+        self.contention_ctr = None
+        self.findings_ctr = None
+
+    # -- identity ---------------------------------------------------------
+
+    def new_uid(self, label: str) -> int:
+        with self.mu:
+            uid = self.next_uid
+            self.next_uid += 1
+            self.lock_labels[uid] = label
+        return uid
+
+    def rel(self, filename: str) -> str:
+        try:
+            return Path(filename).resolve().relative_to(self.root).as_posix()
+        except (ValueError, OSError):
+            return Path(filename).as_posix()
+
+    def fmt_stack(self, stack, depth: int = 4) -> str:
+        frames = [f"{self.rel(fn)}:{ln} in {name}" for fn, ln, name in stack[:depth]]
+        return " <- ".join(frames) if frames else "<no app frames>"
+
+    # -- findings ---------------------------------------------------------
+
+    def emit(self, rule: str, dedup_key, stack, message: str) -> None:
+        fn, line, sym = stack[0] if stack else ("<unknown>", 0, "")
+        with self.mu:
+            if dedup_key in self.finding_keys:
+                return
+            self.finding_keys.add(dedup_key)
+            self.findings.append(
+                Finding(rule, self.rel(fn), line, 0, sym, message)
+            )
+        if self.findings_ctr is not None:
+            self.findings_ctr.labels(rule=rule).inc()
+
+    # -- lock-order graph -------------------------------------------------
+
+    def record_edges(self, held: List[_Acq], new_lock, new_stack) -> None:
+        """Add held->new edges; report a TPR101 on any resulting cycle."""
+        new_uid = new_lock._tsan_uid
+        tname = _thread_info()[1]
+        for acq in held:
+            h_uid = acq.lock._tsan_uid
+            if h_uid == new_uid:
+                continue
+            with self.mu:
+                bucket = self.edges.setdefault(h_uid, {})
+                fresh = new_uid not in bucket
+                if fresh:
+                    bucket[new_uid] = _Edge(tname, acq.stack, new_stack)
+                path = self._find_path(new_uid, h_uid) if fresh else None
+            if path:
+                self._report_cycle(acq, new_lock, new_stack, tname, path)
+
+    def _find_path(self, start: int, goal: int) -> Optional[List[int]]:
+        """BFS over edges from start to goal (callers hold self.mu)."""
+        if start not in self.edges:
+            return None
+        prev = {start: None}
+        queue = [start]
+        while queue:
+            cur = queue.pop(0)
+            for nxt in self.edges.get(cur, ()):
+                if nxt in prev:
+                    continue
+                prev[nxt] = cur
+                if nxt == goal:
+                    out = [nxt]
+                    while prev[out[-1]] is not None:
+                        out.append(prev[out[-1]])
+                    out.reverse()
+                    return out
+                queue.append(nxt)
+        return None
+
+    def _report_cycle(self, acq, new_lock, new_stack, tname, path) -> None:
+        """path = [new_uid, ..., held_uid]: the opposite-order chain."""
+        with self.mu:
+            other = self.edges.get(path[0], {}).get(path[1])
+            label_new = self.lock_labels.get(path[0], "?")
+            label_held = self.lock_labels.get(path[-1], "?")
+            chain = " -> ".join(self.lock_labels.get(u, "?") for u in path)
+        if other is None:  # edge vanished (shouldn't happen); skip
+            return
+        dedup = ("TPR101", frozenset((label_new, label_held)))
+        message = (
+            f"lock-order inversion: thread '{tname}' acquires {label_held} "
+            f"then {label_new} [held stack: {self.fmt_stack(acq.stack)}] "
+            f"[acquire stack: {self.fmt_stack(new_stack)}], but thread "
+            f"'{other.thread}' previously acquired {chain} "
+            f"[their stacks: {self.fmt_stack(other.stack_from)} ; "
+            f"{self.fmt_stack(other.stack_to)}]"
+        )
+        self.emit("TPR101", dedup, new_stack, message)
+
+    # -- hold accounting --------------------------------------------------
+
+    def end_segment(self, entry: _Acq, label: str) -> None:
+        hold = time.monotonic() - entry.t0
+        if self.hold_hist is not None:
+            self.hold_hist.observe(hold)
+        if hold >= self.block_threshold_s and entry.stack:
+            fn, line, _sym = entry.stack[0]
+            dedup = ("TPR102", fn, line)
+            thr_ms = self.block_threshold_s * 1000.0
+            self.emit(
+                "TPR102", dedup, entry.stack,
+                f"lock {label} held for {hold * 1000.0:.0f} ms "
+                f"(threshold {thr_ms:g} ms) — blocking work under a lock "
+                f"[acquired at: {self.fmt_stack(entry.stack)}]",
+            )
+
+
+# ---------------------------------------------------------------------------
+# Lock shims
+# ---------------------------------------------------------------------------
+
+
+class TsanLock:
+    """Instrumented stand-in for ``threading.Lock()``."""
+
+    _inner_factory = staticmethod(_REAL_LOCK)
+    _kind = "Lock"
+
+    def __init__(self):
+        st = _STATE
+        self._inner = self._inner_factory()
+        self._tsan_state = st
+        self._holder = None  # (ident, thread name, t0, stack)
+        if st is not None:
+            stack = _app_stack()
+            site = f"{st.rel(stack[0][0])}:{stack[0][1]}" if stack else "?"
+            self._tsan_uid = st.new_uid(f"<{self._kind} {site}>")
+            st.locks.add(self)
+        else:
+            self._tsan_uid = 0
+
+    # -- helpers ----------------------------------------------------------
+
+    def _label(self) -> str:
+        st = self._tsan_state
+        return st.lock_labels.get(self._tsan_uid, "?") if st else "?"
+
+    def _tracking(self) -> bool:
+        st = self._tsan_state
+        return (
+            st is not None and st.active and not getattr(_tls, "busy", False)
+        )
+
+    def _inner_acquire(self, blocking, timeout):
+        if timeout is None or timeout < 0:
+            return self._inner.acquire(blocking)
+        return self._inner.acquire(blocking, timeout)
+
+    # -- the Lock protocol -------------------------------------------------
+
+    def acquire(self, blocking=True, timeout=-1):
+        if not self._tracking():
+            return self._inner_acquire(blocking, timeout)
+        got = self._inner.acquire(False)
+        waited, contended = 0.0, False
+        if not got:
+            if not blocking:
+                return False
+            contended = True
+            t0 = time.monotonic()
+            got = self._inner_acquire(True, timeout)
+            waited = time.monotonic() - t0
+        st = self._tsan_state
+        _tls.busy = True
+        try:
+            if contended:
+                if st.contention_ctr is not None:
+                    st.contention_ctr.inc()
+                if st.wait_hist is not None:
+                    st.wait_hist.observe(waited)
+            if got:
+                self._on_acquired()
+        finally:
+            _tls.busy = False
+        return got
+
+    def _on_acquired(self):
+        """Record stack/edges/holder; caller holds _tls.busy."""
+        stack = _app_stack(skip=3)
+        held = _held_stack()
+        if held:
+            self._tsan_state.record_edges(held, self, stack)
+        now = time.monotonic()
+        held.append(_Acq(self, now, stack))
+        ident, name = _thread_info()
+        self._holder = (ident, name, now, stack)
+
+    def release(self):
+        self._inner.release()
+        if not self._tracking():
+            self._holder = None
+            return
+        _tls.busy = True
+        try:
+            self._holder = None
+            entry = self._pop_entry()
+            if entry is not None:
+                self._tsan_state.end_segment(entry, self._label())
+        finally:
+            _tls.busy = False
+
+    def _pop_entry(self) -> Optional[_Acq]:
+        held = _held_stack()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i].lock is self:
+                return held.pop(i)
+        return None
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"<TsanLock {self._label()} inner={self._inner!r}>"
+
+    # -- Condition-compat hooks (if handed to a *real* Condition) ---------
+
+    def _release_save(self):
+        self.release()
+
+    def _acquire_restore(self, _state):
+        self.acquire()
+
+    def _is_owned(self):
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+    # -- Condition.wait bracketing ----------------------------------------
+
+    def _suspend_for_wait(self):
+        """Close the current hold segment around a Condition.wait; returns
+        an opaque token for :meth:`_resume_after_wait` (None = untracked)."""
+        if not self._tracking():
+            return None
+        _tls.busy = True
+        try:
+            entry = self._pop_entry()
+            if entry is not None:
+                self._tsan_state.end_segment(entry, self._label())
+            holder, self._holder = self._holder, None
+            return (entry, holder)
+        finally:
+            _tls.busy = False
+
+    def _resume_after_wait(self, token):
+        if token is None:
+            return
+        entry, _old_holder = token
+        if entry is None:
+            return
+        if not (self._tsan_state is not None and self._tsan_state.active):
+            return
+        _tls.busy = True
+        try:
+            now = time.monotonic()
+            stack = _app_stack(skip=3)
+            _held_stack().append(_Acq(self, now, stack))
+            ident, name = _thread_info()
+            self._holder = (ident, name, now, stack)
+        finally:
+            _tls.busy = False
+
+
+class TsanRLock(TsanLock):
+    """Instrumented stand-in for ``threading.RLock()`` — the held stack
+    carries one entry per lock regardless of recursion depth."""
+
+    _inner_factory = staticmethod(_REAL_RLOCK)
+    _kind = "RLock"
+
+    def __init__(self):
+        super().__init__()
+        self._owner = None
+        self._depth = 0
+
+    def acquire(self, blocking=True, timeout=-1):
+        ident = threading.get_ident()
+        if self._owner == ident:  # recursive re-acquire: always succeeds
+            self._inner_acquire(True, -1)
+            self._depth += 1
+            return True
+        got = super().acquire(blocking, timeout)
+        if got:
+            self._owner = ident
+            self._depth = 1
+        return got
+
+    def release(self):
+        if self._owner != threading.get_ident():
+            # let the inner RLock raise its own "not owned" error
+            self._inner.release()
+            return
+        self._depth -= 1
+        if self._depth > 0:
+            self._inner.release()
+            return
+        self._owner = None
+        super().release()
+
+    # real-Condition compat: fully unwind the recursion like RLock does
+    def _release_save(self):
+        depth, owner = self._depth, self._owner
+        self._depth = 1  # force the tracked release below
+        self._owner = threading.get_ident()
+        super().release()
+        for _ in range(depth - 1):
+            self._inner.release()
+        return (depth, owner)
+
+    def _acquire_restore(self, state):
+        depth, _owner = state
+        self.acquire()
+        for _ in range(depth - 1):
+            self.acquire()
+
+    def _is_owned(self):
+        return self._owner == threading.get_ident()
+
+    def _suspend_for_wait(self):
+        token = super()._suspend_for_wait()
+        if token is None:
+            return None
+        depth, self._depth, self._owner = self._depth, 0, None
+        return (token, depth)
+
+    def _resume_after_wait(self, token):
+        if token is None:
+            return
+        inner_token, depth = token
+        super()._resume_after_wait(inner_token)
+        self._owner = threading.get_ident()
+        self._depth = depth
+
+
+class TsanCondition:
+    """Instrumented stand-in for ``threading.Condition``.
+
+    Built over the *inner* raw lock of a Tsan lock so the stock Condition
+    machinery does the real waiting, while acquire/release/wait go through
+    the shim for hold tracking.  ``wait`` suspends the hold segment — time
+    parked on the condition is the designed use, not blocking-under-lock.
+    """
+
+    def __init__(self, lock=None):
+        if lock is None:
+            lock = TsanRLock()
+        self._tsan_lock = lock if isinstance(lock, TsanLock) else None
+        inner = lock._inner if self._tsan_lock is not None else lock
+        self._cond = _REAL_CONDITION(inner)
+
+    # -- lock protocol, through the shim ----------------------------------
+
+    def acquire(self, *args, **kwargs):
+        if self._tsan_lock is not None:
+            return self._tsan_lock.acquire(*args, **kwargs)
+        return self._cond.acquire(*args, **kwargs)
+
+    def release(self):
+        if self._tsan_lock is not None:
+            return self._tsan_lock.release()
+        return self._cond.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    # -- waiting -----------------------------------------------------------
+
+    def wait(self, timeout=None):
+        if self._tsan_lock is None:
+            return self._cond.wait(timeout)
+        token = self._tsan_lock._suspend_for_wait()
+        try:
+            return self._cond.wait(timeout)
+        finally:
+            self._tsan_lock._resume_after_wait(token)
+
+    def wait_for(self, predicate, timeout=None):
+        endtime = None
+        result = predicate()
+        while not result:
+            if timeout is not None:
+                if endtime is None:
+                    endtime = time.monotonic() + timeout
+                waittime = endtime - time.monotonic()
+                if waittime <= 0:
+                    break
+                self.wait(waittime)
+            else:
+                self.wait(None)
+            result = predicate()
+        return result
+
+    def notify(self, n=1):
+        return self._cond.notify(n)
+
+    def notify_all(self):
+        return self._cond.notify_all()
+
+    notifyAll = notify_all
+
+    def __repr__(self):
+        return f"<TsanCondition over {self._tsan_lock!r}>"
+
+
+class TsanThread(_REAL_THREAD):
+    """Thread shim: records creation for the end-of-process leak audit."""
+
+    def __init__(self, *args, **kwargs):
+        if not isinstance(self, TsanThread):
+            # threading internals (e.g. _DummyThread) call the module-global
+            # Thread.__init__ unbound with a real-Thread subclass instance.
+            _REAL_THREAD.__init__(self, *args, **kwargs)
+            return
+        super().__init__(*args, **kwargs)
+        st = _STATE
+        self._tsan_rec = None
+        if st is not None and st.active:
+            rec = _ThreadRecord(self, _app_stack())
+            self._tsan_rec = rec
+            with st.mu:
+                st.threads.append(rec)
+
+    def join(self, timeout=None):
+        super().join(timeout)
+        if self._tsan_rec is not None and not self.is_alive():
+            self._tsan_rec.joined = True
+
+
+# ---------------------------------------------------------------------------
+# install / audit / report
+# ---------------------------------------------------------------------------
+
+
+def enabled() -> bool:
+    """True when PADDLE_TPU_TSAN arms the sanitizer (flag-catalog parse)."""
+    from ...core import flags as _flags
+
+    return bool(_flags.env_value("PADDLE_TPU_TSAN"))
+
+
+def installed() -> bool:
+    return _STATE is not None and _STATE.active
+
+
+def default_root() -> Path:
+    """The repo root this installation of paddle_tpu lives in."""
+    return Path(__file__).resolve().parents[3]
+
+
+def install(root=None) -> "_State":
+    """Arm the sanitizer: patch threading and register the metric families.
+
+    Explicit call — flag gating belongs to the caller (the pytest plugin
+    uses :func:`install_if_enabled`).  Idempotent while armed.
+    """
+    global _STATE
+    if _STATE is not None and _STATE.active:
+        return _STATE
+    from ...core import flags as _flags
+    from ...observability import metrics as _metrics
+
+    thr_ms = float(_flags.env_value("PADDLE_TPU_TSAN_BLOCK_MS"))
+    st = _State(thr_ms / 1000.0, Path(root) if root else default_root())
+    st.hold_hist = _metrics.histogram(
+        "paddle_tpu_tsan_lock_hold_seconds",
+        "Wall-clock seconds each instrumented lock was held per hold "
+        "segment (tsan-lite sanitizer; Condition.wait suspends the "
+        "segment).")
+    st.wait_hist = _metrics.histogram(
+        "paddle_tpu_tsan_lock_wait_seconds",
+        "Wall-clock seconds acquirers spent blocked on contended "
+        "instrumented locks (tsan-lite sanitizer).")
+    st.contention_ctr = _metrics.counter(
+        "paddle_tpu_tsan_lock_contentions_total",
+        "Lock acquisitions that found the lock already held "
+        "(tsan-lite sanitizer).")
+    st.findings_ctr = _metrics.counter(
+        "paddle_tpu_tsan_findings_total",
+        "Runtime concurrency-sanitizer findings emitted, by TPR1xx rule.",
+        ("rule",))
+    _STATE = st
+    threading.Lock = TsanLock
+    threading.RLock = TsanRLock
+    threading.Condition = TsanCondition
+    threading.Thread = TsanThread
+    return st
+
+
+def install_if_enabled(root=None) -> Optional["_State"]:
+    """Plugin entry point: arm only when PADDLE_TPU_TSAN is set; with the
+    flag off this touches nothing (zero shimming)."""
+    if not enabled():
+        return None
+    return install(root)
+
+
+def uninstall() -> None:
+    """Restore the pristine threading primitives; state is kept readable
+    (report()/findings()) until the next install()."""
+    global _STATE
+    threading.Lock = _REAL_LOCK
+    threading.RLock = _REAL_RLOCK
+    threading.Condition = _REAL_CONDITION
+    threading.Thread = _REAL_THREAD
+    if _STATE is not None:
+        _STATE.active = False
+
+
+def audit() -> List[Finding]:
+    """End-of-process leak audit (TPR103); returns the findings it added."""
+    st = _STATE
+    if st is None:
+        return []
+    before = len(st.findings)
+    alive_idents = {t.ident for t in threading.enumerate()}
+    with st.mu:
+        threads = list(st.threads)
+    for rec in threads:
+        t = rec.ref()
+        if t is None:
+            continue  # collected => finished and reclaimed
+        if t.is_alive() and not t.daemon and not rec.joined:
+            st.emit(
+                "TPR103", ("TPR103-thread", id(rec)), rec.stack,
+                f"non-daemon thread '{t.name}' started here was never "
+                "joined and is still alive at the end-of-process audit",
+            )
+    for lk in list(st.locks):
+        holder = lk._holder
+        if holder is None or not lk.locked():
+            continue
+        ident, tname, _t0, stack = holder
+        if ident not in alive_idents:
+            st.emit(
+                "TPR103", ("TPR103-lock", lk._tsan_uid), stack,
+                f"lock {lk._label()} is still held by thread '{tname}' "
+                "which already exited — never released",
+            )
+    with st.mu:
+        return list(st.findings[before:])
+
+
+def findings() -> List[Finding]:
+    st = _STATE
+    if st is None:
+        return []
+    with st.mu:
+        return list(st.findings)
+
+
+def reset() -> None:
+    """Drop accumulated findings/edges (between tests of the sanitizer)."""
+    st = _STATE
+    if st is None:
+        return
+    with st.mu:
+        st.findings.clear()
+        st.finding_keys.clear()
+        st.edges.clear()
+        st.threads.clear()
+
+
+def report_data(root=None) -> dict:
+    """Raw (unfiltered) report payload the pytest plugin writes to disk;
+    replay through suppressions/baseline with
+    ``python -m paddle_tpu.analysis --runtime <file>``."""
+    st = _STATE
+    if root is not None:
+        root_s = str(root)
+    else:
+        root_s = str(st.root if st is not None else default_root())
+    return {
+        "version": 1,
+        "kind": "tsan",
+        "root": root_s,
+        "rules": dict(RULES),
+        "findings": [f.to_json() for f in findings()],
+    }

@@ -14,6 +14,12 @@ arguments) and flags host-impure calls inside them, one helper level deep.
   `.item()` / `.tolist()` on values derived from the traced function's
   parameters), or a host-impure call inside a same-module helper invoked
   from a traced function.
+* TPL013 — donation safety: a value passed in a ``donate_argnums`` position
+  of a jitted callable is read again after the call.  XLA is free to alias
+  the donated buffer into the output, so the post-call read observes
+  garbage (the async-pipeline / unaliased-put bug class).  Rebinding the
+  name from the call's own result (``state = step(state, ...)``) is the
+  sanctioned idiom and stays quiet.
 """
 
 from __future__ import annotations
@@ -26,6 +32,7 @@ from .core import AnalysisContext, Finding, SourceFile, call_kwarg, qual_tail, q
 RULES = {
     "TPL011": "host-impure call inside a traced function",
     "TPL012": "tracer materialization or host-impure helper reachable from a traced function",
+    "TPL013": "donated argument read after the donating call (buffer may be aliased away)",
 }
 
 # Entry points whose function-valued arguments are traced.  Maps the
@@ -187,9 +194,159 @@ def _walk_no_nested_defs(fn: ast.AST):
         stack.extend(ast.iter_child_nodes(node))
 
 
+# ---------------------------------------------------------------------------
+# TPL013 — donation safety
+# ---------------------------------------------------------------------------
+
+_DONATE_ENTRIES = {"jit", "pjit"}
+
+
+def _donate_positions(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """Literal donate_argnums positions if ``call`` is jit/pjit with them."""
+    qual = qualname(call.func)
+    if not qual or qual_tail(qual, 1) not in _DONATE_ENTRIES:
+        return None
+    dn = call_kwarg(call, "donate_argnums")
+    if isinstance(dn, ast.Constant) and type(dn.value) is int:
+        return (dn.value,)
+    if isinstance(dn, (ast.Tuple, ast.List)):
+        out: List[int] = []
+        for elt in dn.elts:
+            if not (isinstance(elt, ast.Constant) and type(elt.value) is int):
+                return None
+            out.append(elt.value)
+        return tuple(out)
+    return None
+
+
+def _collect_donors(sf: SourceFile) -> Dict[str, Tuple[int, ...]]:
+    """Names bound to a donating jit: ``step = jax.jit(f, donate_argnums=..)``
+    assignments plus ``@partial(jax.jit, donate_argnums=..)`` decorations."""
+    donors: Dict[str, Tuple[int, ...]] = {}
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            pos = _donate_positions(node.value)
+            if pos:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        donors[tgt.id] = pos
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if not isinstance(dec, ast.Call):
+                    continue
+                pos = _donate_positions(dec)
+                if pos is None and qual_tail(qualname(dec.func), 1) == "partial" and dec.args:
+                    if qual_tail(qualname(dec.args[0]), 1) in _DONATE_ENTRIES:
+                        dn = call_kwarg(dec, "donate_argnums")
+                        fake = ast.Call(func=dec.args[0], args=[], keywords=dec.keywords)
+                        pos = _donate_positions(fake) if dn is not None else None
+                if pos:
+                    donors[node.name] = pos
+    return donors
+
+
+def _enclosing_scope(sf: SourceFile, node: ast.AST) -> ast.AST:
+    cur = sf.parent(node)
+    while cur is not None and not isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        cur = sf.parent(cur)
+    return cur if cur is not None else sf.tree
+
+
+def _stmt_rebinds(sf: SourceFile, call: ast.Call, name: str) -> bool:
+    """True when the statement holding ``call`` assigns ``name`` from it
+    (``state = step(state, ..)`` — the donated buffer is never read again)."""
+    cur = sf.parent(call)
+    while cur is not None and not isinstance(cur, ast.stmt):
+        cur = sf.parent(cur)
+    if isinstance(cur, ast.Assign):
+        return any(name in _names_in(t) for t in cur.targets)
+    if isinstance(cur, (ast.AugAssign, ast.AnnAssign)):
+        return name in _names_in(cur.target)
+    return False
+
+
+def _loop_ancestor(sf: SourceFile, call: ast.Call, scope: ast.AST) -> Optional[ast.AST]:
+    cur = sf.parent(call)
+    while cur is not None and cur is not scope:
+        if isinstance(cur, (ast.For, ast.While, ast.AsyncFor)):
+            return cur
+        cur = sf.parent(cur)
+    return None
+
+
+def _check_donation(sf: SourceFile, findings: List[Finding]) -> None:
+    donors = _collect_donors(sf)
+    if not donors:
+        return
+    emitted: Set[Tuple[int, str]] = set()
+
+    def emit(node: ast.AST, msg: str) -> None:
+        key = (node.lineno, msg)
+        if key in emitted:
+            return
+        emitted.add(key)
+        findings.append(
+            Finding("TPL013", sf.rel, node.lineno, node.col_offset,
+                    sf.enclosing_symbol(node), msg)
+        )
+
+    for call in ast.walk(sf.tree):
+        if not (isinstance(call, ast.Call) and isinstance(call.func, ast.Name)):
+            continue
+        positions = donors.get(call.func.id)
+        if not positions:
+            continue
+        scope = _enclosing_scope(sf, call)
+        call_end = (call.end_lineno or call.lineno, call.end_col_offset or 0)
+        for pos in positions:
+            if pos >= len(call.args) or not isinstance(call.args[pos], ast.Name):
+                continue
+            donated = call.args[pos].id
+            rebound_here = _stmt_rebinds(sf, call, donated)
+            names = [
+                n for n in _walk_no_nested_defs(scope)
+                if isinstance(n, ast.Name) and n.id == donated
+            ]
+            stores_after = sorted(
+                (n.lineno, n.col_offset) for n in names
+                if isinstance(n.ctx, (ast.Store, ast.Del))
+                and (n.lineno, n.col_offset) > call_end
+            )
+            if rebound_here:
+                # ``x = step(x, ..)``: the rebind lands at the call itself.
+                stores_after.insert(0, call_end)
+            loads_after = sorted(
+                ((n, (n.lineno, n.col_offset)) for n in names
+                 if isinstance(n.ctx, ast.Load)
+                 and (n.lineno, n.col_offset) > call_end),
+                key=lambda item: item[1])
+            if loads_after:
+                node, where = loads_after[0]
+                if not (stores_after and stores_after[0] <= where):
+                    emit(node,
+                         f"'{donated}' is donated to '{call.func.id}' "
+                         f"(donate_argnums position {pos}) but read after the "
+                         "call — the buffer may be aliased into the output; "
+                         "copy it or rebind from the result")
+                    continue
+            loop = _loop_ancestor(sf, call, scope)
+            if loop is not None:
+                loop_stores = any(
+                    isinstance(n, ast.Name) and n.id == donated
+                    and isinstance(n.ctx, ast.Store)
+                    for n in _walk_no_nested_defs(loop)
+                )
+                if not loop_stores:
+                    emit(call,
+                         f"'{donated}' is donated to '{call.func.id}' inside a "
+                         "loop but never rebound there — the next iteration "
+                         "reads the donated (possibly aliased-away) buffer")
+
+
 def check(ctx: AnalysisContext) -> List[Finding]:
     findings: List[Finding] = []
     for sf in ctx.files:
+        _check_donation(sf, findings)
         traced = _collect_traced(sf)
         traced_ids = {id(fn) for fn, _ in traced}
         emitted: Set[Tuple[str, int, str]] = set()

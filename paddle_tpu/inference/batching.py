@@ -201,6 +201,7 @@ class DynamicBatcher:
         self._warned_rowwise = False
 
         self._q: deque = deque()
+        self._forming = 0            # requests popped into the batch being formed
         # request-scoped observability: stage histograms + sampled JSONL
         # traces (PADDLE_TPU_TRACE_SAMPLE), and the stall flight recorder
         # (PADDLE_TPU_STALL_DUMP) — a watchdog that dumps every thread's
@@ -426,13 +427,19 @@ class DynamicBatcher:
                     f"({self._dispatcher_error!r}); restart the daemon"),
                     req_id))
                 return req.future
-            if self._max_queue and len(self._q) >= self._max_queue:
+            # admission control counts the batch being formed too: the
+            # dispatcher pops requests out of _q while merging, and that
+            # in-formation work is still queued latency-wise — without it
+            # the watermark has a hole exactly as wide as the formation
+            # window (tsan-lite caught the race)
+            depth = len(self._q) + self._forming
+            if self._max_queue and depth >= self._max_queue:
                 # admission control: past the watermark the queue can
                 # only add deadline-bound latency — shed instead
                 self._shed_total.inc()
                 req.future.set_exception(self._tag(TypedServeError(
                     ERR_RESOURCE_EXHAUSTED,
-                    f"serve queue past watermark ({len(self._q)} >= "
+                    f"serve queue past watermark ({depth} >= "
                     f"{self._max_queue} queued; "
                     "PADDLE_TPU_SERVE_MAX_QUEUE)"), req_id))
                 return req.future
@@ -484,30 +491,35 @@ class DynamicBatcher:
                 return None
             first = self._q.popleft()
             reqs, rows = [first], first.rows
-            if first.solo:
-                return reqs, first.key, rows
-            deadline = first.t_enq + self._timeout_s
-            while rows < self._max_batch:
-                taken = []
-                for r in self._q:
-                    if r.solo or r.key != first.key:
-                        continue
-                    if rows + r.rows > self._max_batch:
-                        continue
-                    taken.append(r)
-                    rows += r.rows
-                    if rows >= self._max_batch:
+            self._forming = 1
+            try:
+                if first.solo:
+                    return reqs, first.key, rows
+                deadline = first.t_enq + self._timeout_s
+                while rows < self._max_batch:
+                    taken = []
+                    for r in self._q:
+                        if r.solo or r.key != first.key:
+                            continue
+                        if rows + r.rows > self._max_batch:
+                            continue
+                        taken.append(r)
+                        rows += r.rows
+                        if rows >= self._max_batch:
+                            break
+                    for r in taken:
+                        self._q.remove(r)
+                    reqs.extend(taken)
+                    self._forming = len(reqs)
+                    if rows >= self._max_batch or self._stop:
                         break
-                for r in taken:
-                    self._q.remove(r)
-                reqs.extend(taken)
-                if rows >= self._max_batch or self._stop:
-                    break
-                now = time.perf_counter()
-                if now >= deadline:
-                    break
-                self._cond.wait(min(deadline - now, 0.05))
-            return reqs, first.key, rows
+                    now = time.perf_counter()
+                    if now >= deadline:
+                        break
+                    self._cond.wait(min(deadline - now, 0.05))
+                return reqs, first.key, rows
+            finally:
+                self._forming = 0
 
     def _dispatch_loop(self):
         formed = None
@@ -847,6 +859,12 @@ class DynamicBatcher:
     @property
     def queue_depth(self) -> int:
         return len(self._q)
+
+    @property
+    def forming(self) -> int:
+        """Requests the dispatcher has popped into the batch it is still
+        forming — counted by admission control alongside ``queue_depth``."""
+        return self._forming
 
     @property
     def oldest_wait_s(self) -> float:

@@ -158,9 +158,11 @@ class AotCache:
     ``profiler.record_compile``), a hit is a dict lookup with no jax
     dispatch-cache probe at all. The no-new-compiles-after-warmup property
     the serving engine asserts is exactly "every steady-state key is
-    already in this dict". Thread-safe; compiles are serialized under the
-    lock so concurrent batch workers on one predictor never duplicate an
-    XLA run."""
+    already in this dict". Thread-safe; a per-key pending event gives
+    concurrent batch workers once-semantics (no duplicated XLA run)
+    while the compile itself happens *outside* the map lock, so a cold
+    bucket compiling never blocks hits on warmed buckets (tsan-lite
+    flagged the old compile-under-lock hold as TPR102)."""
 
     def __init__(self, jitted, label: str = "aot"):
         import threading
@@ -169,6 +171,7 @@ class AotCache:
         self._label = label
         self._cache: Dict[tuple, Any] = {}
         self._lock = threading.Lock()
+        self._pending: Dict[tuple, Any] = {}  # key -> threading.Event
 
     @staticmethod
     def signature(arrays) -> tuple:
@@ -185,15 +188,35 @@ class AotCache:
         ``args``), compiling via ``jitted.lower(*args).compile()`` on a
         miss. ``args`` may mix concrete arrays (runtime miss) and
         ShapeDtypeStructs (warmup)."""
+        import threading
+
         if key is None:
             key = self.signature(args)
-        with self._lock:
-            exe = self._cache.get(key)
-            if exe is None:
-                exe, _ = aot_compile(self._jitted, *args,
-                                     label=f"{self._label}:{key}")
-                self._cache[key] = exe
-        return exe
+        while True:
+            with self._lock:
+                exe = self._cache.get(key)
+                if exe is not None:
+                    return exe
+                event = self._pending.get(key)
+                if event is None:
+                    event = self._pending[key] = threading.Event()
+                    mine = True
+                else:
+                    mine = False
+            if mine:
+                try:
+                    exe, _ = aot_compile(self._jitted, *args,
+                                         label=f"{self._label}:{key}")
+                    with self._lock:
+                        self._cache[key] = exe
+                    return exe
+                finally:
+                    with self._lock:
+                        self._pending.pop(key, None)
+                    event.set()
+            # Another worker is compiling this key: wait for it, then
+            # re-check (it may have failed — the loop retries the compile).
+            event.wait(60.0)
 
     def keys(self):
         with self._lock:

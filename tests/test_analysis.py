@@ -56,9 +56,10 @@ def test_syntax_error_yields_tpl001(tmp_path):
 
 def test_all_rules_catalog_is_complete():
     rules = all_rules()
-    expected = {"TPL001", "TPL011", "TPL012", "TPL021", "TPL022",
+    expected = {"TPL001", "TPL011", "TPL012", "TPL013", "TPL021", "TPL022",
                 "TPL031", "TPL032", "TPL041", "TPL042", "TPL043",
-                "TPL051", "TPL052", "TPL053", "TPL054"}
+                "TPL051", "TPL052", "TPL053", "TPL054",
+                "TPR101", "TPR102", "TPR103"}
     assert expected <= set(rules)
     assert all(desc.strip() for desc in rules.values())
 
@@ -143,6 +144,92 @@ def test_trace_safety_quiet_on_pure_and_host_code(tmp_path):
     """})
     res = _lint(root, "m.py")
     assert not _only(res, "TPL011") and not _only(res, "TPL012")
+
+
+# -- TPL013: donation safety ----------------------------------------------
+
+def test_tpl013_donated_arg_read_after_call(tmp_path):
+    root = _repo(tmp_path, {"m.py": """\
+        import jax
+
+        def update(state, batch):
+            return state + batch
+
+        step = jax.jit(update, donate_argnums=(0,))
+
+        def train(state, batch, norm):
+            new = step(state, batch)
+            loss = norm(state)    # reads the donated buffer
+            return new, loss
+    """})
+    (f,) = _only(_lint(root, "m.py"), "TPL013")
+    assert "'state' is donated to 'step'" in f.message
+    assert f.symbol == "train"
+
+
+def test_tpl013_donating_call_in_loop_without_rebind(tmp_path):
+    root = _repo(tmp_path, {"m.py": """\
+        import jax
+
+        def update(state, batch):
+            return state + batch
+
+        step = jax.jit(update, donate_argnums=(0,))
+
+        def train(state, batches):
+            out = None
+            for b in batches:
+                out = step(state, b)
+            return out
+    """})
+    (f,) = _only(_lint(root, "m.py"), "TPL013")
+    assert "inside a loop" in f.message and "never rebound" in f.message
+
+
+def test_tpl013_partial_decorator_form(tmp_path):
+    root = _repo(tmp_path, {"m.py": """\
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def step(state, batch):
+            return state + batch
+
+        def train(state, batch):
+            new = step(state, batch)
+            return new, state.shape
+    """})
+    (f,) = _only(_lint(root, "m.py"), "TPL013")
+    assert "'state' is donated to 'step'" in f.message
+
+
+def test_tpl013_quiet_on_rebind_and_nondonated(tmp_path):
+    root = _repo(tmp_path, {"m.py": """\
+        import jax
+
+        def update(state, batch):
+            return state + batch
+
+        step = jax.jit(update, donate_argnums=(0,))
+
+        def train(state, batches):
+            for b in batches:
+                state = step(state, b)    # sanctioned rebind idiom
+            return state
+
+        def last_use(state, batch):
+            return step(state, batch)
+
+        def nondonated(state, batch, norm):
+            new = step(state, batch)
+            return new, norm(batch)       # batch (pos 1) is not donated
+
+        def nonliteral(state, batch, nums):
+            f = jax.jit(update, donate_argnums=nums)   # non-literal: skipped
+            new = f(state, batch)
+            return new, state
+    """})
+    assert not _only(_lint(root, "m.py"), "TPL013")
 
 
 # -- TPL021 / TPL022: lock discipline -------------------------------------

@@ -172,3 +172,69 @@ def test_strategy_path_records_compile(tmp_path, monkeypatch):
     events = profiler.compile_events()
     assert any(e["label"] == "fleet.train_step" for e in events)
     assert m._dist_prog.compile_stats["compile_s"] > 0
+
+
+# -- AotCache: compile outside the map lock (tsan-lite TPR102 regression) --
+
+def test_aot_cache_compile_does_not_block_other_keys(monkeypatch):
+    import threading
+    import time
+
+    calls = []
+    gate = threading.Event()
+
+    def fake_aot(jitted, *args, label=""):
+        calls.append(label)
+        if "slow" in label:
+            gate.wait(10)
+        return ("exe:" + label, None)
+
+    monkeypatch.setattr(compile_cache, "aot_compile", fake_aot)
+    cache = compile_cache.AotCache(jitted=None, label="t")
+    fast = cache.get_or_compile(key=("fast",))
+
+    t = threading.Thread(target=lambda: cache.get_or_compile(key=("slow",)),
+                         daemon=True)
+    t.start()
+    deadline = time.monotonic() + 5
+    while not any("slow" in c for c in calls) and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert any("slow" in c for c in calls)
+
+    # A warmed-key hit must not wait out the in-flight compile.
+    t0 = time.monotonic()
+    assert cache.get_or_compile(key=("fast",)) == fast
+    assert time.monotonic() - t0 < 1.0
+    gate.set()
+    t.join(5)
+    assert not t.is_alive()
+    assert len(cache) == 2
+
+
+def test_aot_cache_concurrent_misses_compile_once(monkeypatch):
+    import threading
+    import time
+
+    calls = []
+
+    def fake_aot(jitted, *args, label=""):
+        calls.append(label)
+        time.sleep(0.05)
+        return (object(), None)
+
+    monkeypatch.setattr(compile_cache, "aot_compile", fake_aot)
+    cache = compile_cache.AotCache(jitted=None, label="t")
+    results = []
+    threads = [
+        threading.Thread(
+            target=lambda: results.append(cache.get_or_compile(key=("k",))),
+            daemon=True)
+        for _ in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10)
+    assert len(calls) == 1          # once-semantics: no duplicated XLA run
+    assert len(results) == 4
+    assert all(r is results[0] for r in results)

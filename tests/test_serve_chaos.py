@@ -211,6 +211,28 @@ def test_queue_watermark_sheds_typed(mlp_prefix):
         b.stop()
 
 
+def test_queue_watermark_counts_forming_batch(mlp_prefix):
+    # Regression (found by the tsan-lite gate): the dispatcher pops the
+    # anchor request out of the queue while merging, which used to open a
+    # watermark hole exactly as wide as the formation window — a submit
+    # racing the pop slipped past admission control.
+    pred = Predictor(Config(mlp_prefix))
+    b = DynamicBatcher(pred, max_batch_size=8, batch_timeout_ms=400.0,
+                       max_queue=1)
+    try:
+        fut1 = b.submit([np.ones((2, 8), np.float32)])
+        deadline = time.monotonic() + 5
+        while b.forming == 0 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert b.forming == 1 and b.queue_depth == 0
+        with pytest.raises(TypedServeError) as ei:
+            b.submit([np.ones((1, 8), np.float32)]).result(timeout=5)
+        assert ei.value.code == ERR_RESOURCE_EXHAUSTED
+        fut1.result(timeout=30)
+    finally:
+        b.stop()
+
+
 def test_stopped_batcher_errors_are_typed(mlp_prefix):
     pred = Predictor(Config(mlp_prefix))
     b = DynamicBatcher(pred, max_batch_size=4, batch_timeout_ms=2.0)

@@ -1,0 +1,360 @@
+"""tsan-lite (paddle_tpu.analysis.runtime) tests.
+
+Covers the three runtime detectors with *seeded* concurrency bugs
+(lock-order inversion -> TPR101 with both acquisition stacks, sleep under
+a held lock -> TPR102, leaked thread / never-released lock -> TPR103),
+the designed-use exemption (Condition.wait does not count as a hold),
+the disabled-mode guarantee (nothing is patched when PADDLE_TPU_TSAN is
+off), the metric families, the --runtime CLI replay with suppressions and
+baseline, and the pytest-plugin CI gate end to end in a subprocess.
+
+The in-process tests install/uninstall the sanitizer in try/finally so a
+failure never leaves threading patched for the rest of the suite.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from paddle_tpu.analysis.cli import filter_runtime, main, run_runtime_report
+from paddle_tpu.analysis.core import Finding
+from paddle_tpu.analysis.runtime import sanitizer as san
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+@pytest.fixture
+def armed(monkeypatch):
+    """Install the sanitizer with a 40 ms TPR102 threshold; always uninstall."""
+    monkeypatch.setenv("PADDLE_TPU_TSAN", "1")
+    monkeypatch.setenv("PADDLE_TPU_TSAN_BLOCK_MS", "40")
+    state = san.install()
+    try:
+        yield state
+    finally:
+        san.uninstall()
+        san.reset()
+
+
+def _by_rule(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+# -- disabled mode: zero shimming -----------------------------------------
+
+def test_disabled_mode_patches_nothing(monkeypatch):
+    monkeypatch.delenv("PADDLE_TPU_TSAN", raising=False)
+    assert not san.enabled()
+    assert san.install_if_enabled() is None
+    assert threading.Lock is san._REAL_LOCK
+    assert threading.RLock is san._REAL_RLOCK
+    assert threading.Condition is san._REAL_CONDITION
+    assert threading.Thread is san._REAL_THREAD
+    assert not san.installed()
+
+
+def test_install_patches_and_uninstall_restores(armed):
+    assert san.installed()
+    assert threading.Lock is san.TsanLock
+    assert threading.RLock is san.TsanRLock
+    assert threading.Condition is san.TsanCondition
+    assert threading.Thread is san.TsanThread
+    san.uninstall()
+    assert not san.installed()
+    assert threading.Lock is san._REAL_LOCK
+    assert threading.Thread is san._REAL_THREAD
+
+
+# -- TPR101: seeded two-thread lock-order inversion -----------------------
+
+def test_tpr101_inversion_reports_both_stacks(armed):
+    lock_a, lock_b = threading.Lock(), threading.Lock()
+    first_done = threading.Event()
+
+    def order_ab():
+        with lock_a:
+            with lock_b:
+                pass
+        first_done.set()
+
+    def order_ba():
+        first_done.wait(5)
+        with lock_b:
+            with lock_a:
+                pass
+
+    t1 = threading.Thread(target=order_ab, daemon=True)
+    t2 = threading.Thread(target=order_ba, daemon=True)
+    t1.start(); t2.start(); t1.join(5); t2.join(5)
+
+    (f,) = _by_rule(san.findings(), "TPR101")
+    assert "lock-order inversion" in f.message
+    # Both threads' acquisition stacks land in the one finding.
+    assert "order_ab" in f.message and "order_ba" in f.message
+    assert "held stack" in f.message and "acquire stack" in f.message
+    assert f.path.endswith("test_tsan_runtime.py")
+    assert f.line > 0
+
+
+def test_consistent_order_is_quiet(armed):
+    lock_a, lock_b = threading.Lock(), threading.Lock()
+
+    def same_order():
+        with lock_a:
+            with lock_b:
+                pass
+
+    threads = [threading.Thread(target=same_order, daemon=True) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(5)
+    assert not _by_rule(san.findings(), "TPR101")
+
+
+# -- TPR102: seeded blocking work under a held lock ------------------------
+
+def test_tpr102_sleep_under_lock_crosses_threshold(armed):
+    lock = threading.Lock()
+    with lock:
+        time.sleep(0.08)  # 80 ms >> the fixture's 40 ms threshold
+    (f,) = _by_rule(san.findings(), "TPR102")
+    assert "blocking work under a lock" in f.message
+    assert "threshold" in f.message
+    assert f.path.endswith("test_tsan_runtime.py")
+
+
+def test_tpr102_short_hold_is_quiet(armed):
+    lock = threading.Lock()
+    with lock:
+        pass
+    assert not _by_rule(san.findings(), "TPR102")
+
+
+def test_tpr102_condition_wait_suspends_the_segment(armed):
+    cond = threading.Condition()
+    ready = []
+
+    def waiter():
+        with cond:
+            cond.wait_for(lambda: ready, timeout=2)  # waits ~100 ms
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    time.sleep(0.1)
+    with cond:
+        ready.append(1)
+        cond.notify_all()
+    t.join(5)
+    assert not t.is_alive()
+    # The 100 ms spent inside wait() must not count as a hold segment.
+    waits = [f for f in _by_rule(san.findings(), "TPR102") if "waiter" in f.message]
+    assert not waits
+
+
+# -- TPR103: end-of-process leak audit -------------------------------------
+
+def test_tpr103_leaked_thread_and_dead_holder_lock(armed):
+    release = threading.Event()
+    leaked = threading.Thread(target=release.wait)  # non-daemon, unjoined
+    leaked.start()
+
+    orphan = threading.Lock()
+    holder = threading.Thread(target=orphan.acquire, daemon=True)
+    holder.start()
+    holder.join(5)
+    time.sleep(0.05)  # let the holder fully retire from threading._active
+
+    found = san.audit()
+    leaks = _by_rule(found, "TPR103")
+    assert any("thread" in f.message and "joined" in f.message for f in leaks)
+    assert any("still held" in f.message for f in leaks)
+
+    release.set()
+    leaked.join(5)
+
+
+def test_tpr103_joined_thread_is_quiet(armed):
+    t = threading.Thread(target=lambda: None)
+    t.start()
+    t.join(5)
+    assert not _by_rule(san.audit(), "TPR103")
+
+
+# -- metrics ----------------------------------------------------------------
+
+def test_tsan_metric_families_populate(armed):
+    lock = threading.Lock()
+    with lock:
+        time.sleep(0.05)
+    from paddle_tpu.observability.metrics import REGISTRY
+
+    rendered = REGISTRY.render()
+    for family in (
+        "paddle_tpu_tsan_lock_hold_seconds",
+        "paddle_tpu_tsan_lock_wait_seconds",
+        "paddle_tpu_tsan_lock_contentions_total",
+        "paddle_tpu_tsan_findings_total",
+    ):
+        assert family in rendered
+    assert 'paddle_tpu_tsan_findings_total{rule="TPR102"}' in rendered
+
+
+# -- report / CLI replay ----------------------------------------------------
+
+def test_report_roundtrip_through_cli(armed, tmp_path, capsys):
+    lock = threading.Lock()
+    with lock:
+        time.sleep(0.08)
+    report = tmp_path / "tsan.json"
+    report.write_text(json.dumps(san.report_data(root=tmp_path)))
+
+    rc = main(["--runtime", str(report)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "TPR102" in out
+
+    rc = main(["--runtime", str(report), "--rules", "TPR101"])
+    assert rc == 0  # filtered away
+
+
+def test_runtime_cli_rejects_missing_and_malformed(tmp_path, capsys):
+    assert main(["--runtime", str(tmp_path / "nope.json")]) == 2
+    bad = tmp_path / "bad.json"
+    bad.write_text("{\"findings\": [{\"line\": \"not-an-int\"}]}")
+    assert main(["--runtime", str(bad)]) == 2
+
+
+def test_filter_runtime_suppression_and_baseline(tmp_path):
+    mod = tmp_path / "mod.py"
+    mod.write_text(
+        "import time\n"
+        "lock.acquire()  # tpulint: disable=TPR102 -- warmup holds the lock\n"
+    )
+    suppressed = Finding("TPR102", "mod.py", 2, 0, "warmup", "held too long")
+    baselined = Finding("TPR101", "other.py", 9, 0, "x", "inversion msg")
+    active = Finding("TPR103", "third.py", 1, 0, "", "leaked thread")
+    (tmp_path / ".tpulint-baseline.json").write_text(json.dumps({
+        "version": 1,
+        "entries": [{"rule": "TPR101", "path": "other.py", "symbol": "x",
+                     "message": "inversion msg", "justification": "known"}],
+    }))
+    result = filter_runtime([suppressed, baselined, active], tmp_path)
+    assert result.suppressed == 1
+    assert result.baselined == 1
+    assert [f.rule for f in result.findings] == ["TPR103"]
+
+
+def test_run_runtime_report_uses_embedded_root(tmp_path):
+    report = tmp_path / "r.json"
+    report.write_text(json.dumps({
+        "version": 1, "kind": "tsan", "root": str(tmp_path), "rules": {},
+        "findings": [{"rule": "TPR102", "path": "m.py", "line": 3, "col": 0,
+                      "symbol": "f", "message": "held 99 ms"}],
+    }))
+    result = run_runtime_report(str(report))
+    assert result.root == str(tmp_path)
+    assert [f.rule for f in result.findings] == ["TPR102"]
+
+
+# -- the pytest-plugin CI gate (subprocess, fully hermetic) -----------------
+
+_GATE_ENV_BASE = {
+    "JAX_PLATFORMS": "cpu",
+    "PADDLE_TPU_TSAN": "1",
+    "PADDLE_TPU_TSAN_BLOCK_MS": "40",
+}
+
+
+def _run_gate(test_dir: Path, report: Path):
+    env = dict(os.environ)
+    env.update(_GATE_ENV_BASE)
+    env["PADDLE_TPU_TSAN_REPORT"] = str(report)
+    env["PYTHONPATH"] = str(REPO_ROOT)
+    return subprocess.run(
+        [sys.executable, "-m", "pytest", "-q", str(test_dir),
+         "-p", "paddle_tpu.analysis.runtime.pytest_plugin",
+         "-p", "no:cacheprovider"],
+        capture_output=True, text=True, timeout=180, env=env,
+        cwd=str(REPO_ROOT),
+    )
+
+
+def test_plugin_gate_fails_on_seeded_finding(tmp_path):
+    tdir = tmp_path / "gate_bad"
+    tdir.mkdir()
+    (tdir / "test_seeded.py").write_text(textwrap.dedent("""\
+        import threading, time
+
+        def test_sleeps_under_lock():
+            lock = threading.Lock()
+            with lock:
+                time.sleep(0.08)
+    """))
+    report = tmp_path / "bad.json"
+    proc = _run_gate(tdir, report)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "tsan-lite" in proc.stdout
+    assert "TPR102" in proc.stdout
+    assert report.is_file()
+    data = json.loads(report.read_text())
+    assert any(f["rule"] == "TPR102" for f in data["findings"])
+    # The written report replays through the CLI with the same verdict.
+    assert main(["--runtime", str(report)]) == 1
+
+
+def test_plugin_gate_passes_clean_module(tmp_path):
+    tdir = tmp_path / "gate_good"
+    tdir.mkdir()
+    (tdir / "test_clean.py").write_text(textwrap.dedent("""\
+        import threading
+
+        def test_brief_hold():
+            lock = threading.Lock()
+            with lock:
+                pass
+            t = threading.Thread(target=lambda: None)
+            t.start(); t.join()
+    """))
+    report = tmp_path / "good.json"
+    proc = _run_gate(tdir, report)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "tsan-lite: clean" in proc.stdout
+    assert report.is_file()
+
+
+# -- the tier-1 runtime gate over the real concurrency modules --------------
+
+def test_runtime_gate_on_concurrency_modules(tmp_path):
+    """ROADMAP "Tier-1 runtime gate (tsan-lite)": arm the sanitizer over the
+    concurrency-heavy serve/decode/router/slo modules and require zero
+    unsuppressed TPR1xx findings.  Unrelated test failures inside the child
+    run do not fail the gate — those modules already run un-armed in the
+    normal tier-1 pass; this test owns only the sanitizer verdict."""
+    report = tmp_path / "tsan_gate.json"
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu", PADDLE_TPU_TSAN="1",
+               PADDLE_TPU_TSAN_REPORT=str(report),
+               PYTHONPATH=str(REPO_ROOT))
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "-q",
+         "tests/test_serve_batching.py", "tests/test_serve_chaos.py",
+         "tests/test_decode.py", "tests/test_slo.py",
+         "-m", "not slow",
+         "-p", "paddle_tpu.analysis.runtime.pytest_plugin",
+         "-p", "no:cacheprovider"],
+        capture_output=True, text=True, timeout=480, env=env,
+        cwd=str(REPO_ROOT),
+    )
+    assert report.is_file(), proc.stdout[-4000:] + proc.stderr[-2000:]
+    assert "tsan-lite: clean" in proc.stdout, proc.stdout[-4000:]
+    result = run_runtime_report(str(report))
+    assert not result.findings, [f.format() for f in result.findings]
