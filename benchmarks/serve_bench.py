@@ -176,18 +176,33 @@ def run_decode_bench(args):
     import threading
 
     from paddle_tpu import profiler
-    from paddle_tpu.inference.decode import DecodeEngine
+    from paddle_tpu.inference.decode import (DecodeEngine, kv_page_bytes,
+                                             kv_slot_bytes, next_bucket)
     from paddle_tpu.models.gpt import GPT, gpt_tiny
     from paddle_tpu.observability import REGISTRY
 
     cfg = gpt_tiny()
     model = GPT(cfg)
     rng = np.random.default_rng(args.seed)
-    n = args.decode_requests
-    prompts = [rng.integers(0, cfg.vocab_size,
-                            size=int(rng.integers(4, 25))).astype(np.int32)
-               for _ in range(n)]
     max_new = args.decode_tokens
+    if args.shared_prefix:
+        # shared-system-prompt workload: N requests, one long common
+        # head (page-aligned at the default 16-token pages) + a short
+        # unique tail each — the prefix cache's target case
+        n = args.shared_prefix
+        head_len = 96
+        max_new = min(max_new, cfg.max_seq_len - head_len - 8)
+        head = rng.integers(0, cfg.vocab_size, size=head_len)
+        prompts = [np.concatenate([
+            head, rng.integers(0, cfg.vocab_size,
+                               size=int(rng.integers(2, 7)))
+        ]).astype(np.int32) for _ in range(n)]
+    else:
+        n = args.decode_requests
+        prompts = [rng.integers(
+            0, cfg.vocab_size,
+            size=int(rng.integers(4, 25))).astype(np.int32)
+            for _ in range(n)]
 
     # --- baseline: one request at a time (slot pool of 1, next submit
     # gated on the previous completion). Same kernels, same warmup.
@@ -208,15 +223,19 @@ def run_decode_bench(args):
                        max_new_tokens=max_new, max_pending=n)
     warmup_compiles = eng.warmup()
     c0 = len(profiler.compile_events())
+    m0 = {k: float(v) for k, v in REGISTRY.flat().items()
+          if k.startswith("paddle_tpu_decode_prefix_")}
 
     ttfts, counts, errors = [], [], []
     lock = threading.Lock()
     occupancy_samples = []
+    peak_pages = [0]
     run_done = threading.Event()
 
     def sample_occupancy():
         while not run_done.wait(0.005):
             st = eng.stats()
+            peak_pages[0] = max(peak_pages[0], st["pages"]["pages_used"])
             if st["active"] or st["pending"]:
                 occupancy_samples.append(st["active"] / st["max_slots"])
 
@@ -264,6 +283,24 @@ def run_decode_bench(args):
 
     occ = round(sum(occupancy_samples) / len(occupancy_samples), 4) \
         if occupancy_samples else 0.0
+
+    # paged-KV scorecard: prefix-cache efficiency and HBM per slot vs
+    # what the old contiguous (batch-rung x kv-rung) pool would reserve
+    m1 = {k: float(v) for k, v in REGISTRY.flat().items()
+          if k.startswith("paddle_tpu_decode_prefix_")}
+    hit_toks = m1.get("paddle_tpu_decode_prefix_hit_tokens_total", 0.0) \
+        - m0.get("paddle_tpu_decode_prefix_hit_tokens_total", 0.0)
+    lookup_toks = \
+        m1.get("paddle_tpu_decode_prefix_lookup_tokens_total", 0.0) \
+        - m0.get("paddle_tpu_decode_prefix_lookup_tokens_total", 0.0)
+    hit_rate = hit_toks / lookup_toks if lookup_toks else 0.0
+    pages_peak = max(peak_pages[0], st["pages"]["pages_used"])
+    page_bytes = kv_page_bytes(cfg, st["page_tokens"])
+    slots = max(args.decode_slots, 1)
+    longest = min(max(len(p) for p in prompts) + max_new,
+                  cfg.max_seq_len)
+    contig_per_slot = kv_slot_bytes(
+        cfg, next_bucket(longest, eng.kv_ladder))
     return {
         "metric": "decode_throughput",
         "value": round(cont_tps, 2),
@@ -282,6 +319,13 @@ def run_decode_bench(args):
         "ttft_p50_ms": pct(0.50),
         "ttft_p95_ms": pct(0.95),
         "slot_occupancy": occ,
+        "shared_prefix": args.shared_prefix,
+        "prefix_hit_rate": round(hit_rate, 4),
+        "pages_in_use": int(pages_peak),
+        "page_tokens": st["page_tokens"],
+        "hbm_bytes_per_slot": int(pages_peak * page_bytes // slots),
+        "contiguous_hbm_bytes_per_slot": int(contig_per_slot),
+        "page_pool": st["pages"],
         "engine_steps": st["steps"],
         "warmup_compiles": warmup_compiles,
         "baseline_warmup_compiles": base_warmup,
@@ -515,6 +559,11 @@ def main():
     ap.add_argument("--decode-slots", type=int, default=8)
     ap.add_argument("--decode-tokens", type=int, default=32,
                     help="(decode mode) new tokens per request")
+    ap.add_argument("--shared-prefix", type=int, default=0, metavar="N",
+                    help="(decode mode) N requests sharing one long "
+                         "system prompt + short unique tails — scores "
+                         "the paged-KV prefix cache (prefix_hit_rate, "
+                         "pages_in_use, hbm_bytes_per_slot)")
     ap.add_argument("--router", type=int, default=0, metavar="N",
                     help="fleet mode: N backends behind the front "
                          "router, driven over the wire (0 = classic "

@@ -1,46 +1,56 @@
-"""Continuous-batching autoregressive decode engine (GPT KV-cache path).
+"""Continuous-batching autoregressive decode engine (paged KV cache).
 
 The DynamicBatcher serves stateless one-shot requests; LLM traffic is
 iterative — every request is a prefill followed by many single-token
 steps, and requests arrive and finish mid-flight. This engine is the
-token-level analog of the batcher's shape-bucket design:
+token-level analog of the batcher's shape-bucket design, over a PAGED
+KV cache instead of per-slot contiguous panels:
 
-  * the compute core is `models.gpt.gpt_decode_fns` — `prefill` builds a
-    request's K/V panel in one pass, `decode_step` advances EVERY active
-    request one token through a fixed-capacity cache updated with
-    `lax.dynamic_update_slice`;
-  * both run through an `AotCache`, one executable per
-    (batch-rung x kv-capacity-rung) bucket, so after `warmup()` a
-    steady-state token stream compiles nothing (`profiler`'s compile
-    events make that checkable, as for the batcher);
-  * a slot pool bounds concurrent sequences. The slot count defaults
-    from `core.monitor.hbm_usage` — how many full-capacity KV panels fit
-    in a fraction of free HBM — with a fixed CPU fallback where the
-    stats read (0, 0);
-  * between steps the scheduler admits queued requests into free slots
-    and evicts finished ones (EOS / max-tokens / context full), then
-    re-packs the pool onto the smallest rung pair that holds the
-    survivors — a late request shares the running batch instead of
-    waiting behind it;
+  * the KV store is one device-resident page pool
+    (`[layers, pages, page_tokens, heads, head_dim]` for K and V) plus
+    a per-sequence int32 block table; `memory.page_allocator` hands out
+    refcounted page ids. Admission allocates pages, eviction releases
+    them — capacity growth is a wider block table, never a cache copy
+    (the contiguous engine re-packed the whole pool on every rung
+    change);
+  * the compute core is `models.gpt.gpt_paged_decode_fns` — `prefill`
+    builds a request's K/V panel in one pass (panel rows are then
+    scattered into pool pages), `paged_step` advances EVERY active
+    request one token, writing through the block table and attending
+    via `ops.pallas.decode_attention.paged_decode_attention`;
+  * all device entry points run through an `AotCache` — prefill per
+    prompt rung, the step per (batch-rung x page-rung) bucket, page
+    writes per page rung, plus one traced-scalar copy-on-write
+    executable — so after `warmup()` a steady-state token stream
+    compiles nothing, across any admission/eviction churn;
+  * **prefix sharing**: a hash trie caches page-aligned prompt
+    prefixes. A second request with the same system prompt maps the
+    cached pages (refcount++) and only prefills its tail — the tail
+    tokens ride the normal batched decode step, so a hit admission does
+    zero extra device work. A slot's first write into a shared page
+    triggers copy-on-write through the allocator's refcounts;
+  * pool exhaustion is typed RESOURCE_EXHAUSTED backpressure on the
+    victim stream (after LRU-evicting cold prefix-cache pages), never
+    an engine crash — batch-mates keep streaming;
   * sampling is host-side numpy (greedy, or temperature with optional
     top-k), so the device graph stays deterministic per shape.
 
 Streams: `submit()` returns a `DecodeStream`; tokens are pushed as they
 are sampled (serve.py forwards them as incremental PDI2 frames), and a
-failed request gets a typed UNAVAILABLE while its batch-mates keep
-streaming — the same error-isolation contract as batched one-shot
-serving. Chaos site `decode.stream` fires per token delivery for drills.
+failed request gets a typed error while its batch-mates keep streaming.
+Chaos sites: `decode.stream` fires per token delivery,
+`decode.page_alloc` per page allocation.
 """
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import json
-import math
 import queue
 import threading
 import time
 from collections import deque
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -51,7 +61,9 @@ from .. import profiler
 from ..core import flags as _flags
 from ..core import monitor
 from ..jit.compile_cache import AotCache
-from ..models.gpt import GPTConfig, gpt_decode_fns
+from ..memory.page_allocator import (PageAllocator, PageExhausted,
+                                     copy_page, write_pages)
+from ..models.gpt import GPTConfig, gpt_paged_decode_fns
 from ..observability import counter, gauge, histogram
 from ..observability.spans import SpanRecorder, next_request_id
 from ..testing import chaos
@@ -61,7 +73,7 @@ from .errors import (ERR_INVALID_ARGUMENT, ERR_RESOURCE_EXHAUSTED,
 
 DEFAULT_MAX_SLOTS = 8          # CPU fallback when HBM stats are absent
 DEFAULT_MAX_NEW_TOKENS = 64
-_KV_LADDER_FLOOR = 16          # smallest kv-capacity rung worth compiling
+DEFAULT_PAGE_TOKENS = 16       # mirrors PADDLE_TPU_DECODE_PAGE_TOKENS
 
 _METRICS = None
 
@@ -99,14 +111,63 @@ def _decode_metrics():
             "ttft": histogram(
                 "paddle_tpu_decode_ttft_seconds",
                 "Submit-to-first-token latency per request"),
+            # paged KV pool
+            "page_pool_size": gauge(
+                "paddle_tpu_decode_page_pool_pages",
+                "Allocatable KV pages in the decode page pool"),
+            "page_in_use": gauge(
+                "paddle_tpu_decode_page_in_use",
+                "KV pages currently allocated (refcount >= 1)"),
+            "page_shared": gauge(
+                "paddle_tpu_decode_page_shared",
+                "KV pages mapped by more than one owner (refcount > 1)"),
+            "page_fragmentation": gauge(
+                "paddle_tpu_decode_page_fragmentation",
+                "Free-list fragmentation of the KV page pool (0..1)"),
+            "page_allocs": counter(
+                "paddle_tpu_decode_page_allocs_total",
+                "KV pages handed out by the decode page allocator"),
+            "page_alloc_failures": counter(
+                "paddle_tpu_decode_page_alloc_failures_total",
+                "Page allocations refused (pool exhausted or chaos)"),
+            "cow": counter(
+                "paddle_tpu_decode_page_cow_copies_total",
+                "Copy-on-write page copies (first write into a shared "
+                "page)"),
+            # prefix cache
+            "prefix_hits": counter(
+                "paddle_tpu_decode_prefix_hits_total",
+                "Admissions that mapped at least one cached prefix page"),
+            "prefix_misses": counter(
+                "paddle_tpu_decode_prefix_misses_total",
+                "Admissions that found no cached prefix page"),
+            "prefix_hit_tokens": counter(
+                "paddle_tpu_decode_prefix_hit_tokens_total",
+                "Prompt tokens served from cached prefix pages"),
+            "prefix_lookup_tokens": counter(
+                "paddle_tpu_decode_prefix_lookup_tokens_total",
+                "Prompt tokens offered to prefix-cache lookup"),
+            "prefix_cached_pages": gauge(
+                "paddle_tpu_decode_prefix_cached_pages",
+                "Pages pinned by the prefix-cache trie"),
+            "prefix_evictions": counter(
+                "paddle_tpu_decode_prefix_evictions_total",
+                "Prefix-cache entries LRU-evicted under pool pressure"),
         }
     return _METRICS
 
 
 def kv_slot_bytes(cfg: GPTConfig, capacity: Optional[int] = None) -> int:
-    """HBM bytes one sequence's full K+V panel occupies at `capacity`."""
+    """HBM bytes one sequence's full K+V panel occupies at `capacity`
+    (the contiguous-pool cost model; the paged analog is
+    `kv_page_bytes` x pages actually mapped)."""
     cap = capacity or cfg.max_seq_len
     return cfg.layers * 2 * cap * cfg.heads * cfg.head_dim * 4
+
+
+def kv_page_bytes(cfg: GPTConfig, page_tokens: int) -> int:
+    """HBM bytes one K+V page occupies."""
+    return cfg.layers * 2 * int(page_tokens) * cfg.heads * cfg.head_dim * 4
 
 
 def default_slot_count(cfg: GPTConfig, hbm_fraction: float = 0.5,
@@ -121,11 +182,16 @@ def default_slot_count(cfg: GPTConfig, hbm_fraction: float = 0.5,
     return max(1, min(int(free // kv_slot_bytes(cfg)), 256))
 
 
-def kv_capacity_ladder(max_seq_len: int) -> List[int]:
-    """Powers of two from the floor up to (and including) max_seq_len."""
-    if max_seq_len <= _KV_LADDER_FLOOR:
+def kv_capacity_ladder(max_seq_len: int,
+                       floor: Optional[int] = None) -> List[int]:
+    """Powers of two (times the floor) from the floor up to — and
+    including — max_seq_len. The floor defaults to the page size so
+    every rung is a formable page-granular capacity (no warmup
+    signature the pool cannot realize)."""
+    lo = int(floor) if floor else DEFAULT_PAGE_TOKENS
+    if max_seq_len <= lo:
         return [int(max_seq_len)]
-    vals, v = [], _KV_LADDER_FLOOR
+    vals, v = [], lo
     while v < max_seq_len:
         vals.append(v)
         v *= 2
@@ -199,7 +265,8 @@ class DecodeStream:
 class _Req:
     __slots__ = ("id", "prompt", "max_new", "temperature", "top_k",
                  "eos_id", "stream", "cache_len", "last_tok", "generated",
-                 "row", "t_submit", "t_admit", "prefill_s", "_knp", "_vnp")
+                 "pages", "input_tail", "feeding",
+                 "t_submit", "t_admit", "prefill_s")
 
     def __init__(self, prompt, max_new, temperature, top_k, eos_id):
         self.id = next_request_id()
@@ -212,16 +279,109 @@ class _Req:
         self.cache_len = 0
         self.last_tok = 0
         self.generated: List[int] = []
-        self.row = -1
+        self.pages: List[int] = []       # block table (page ids, in order)
+        self.input_tail: deque = deque() # prompt tokens still to feed
+        self.feeding = False             # consuming prompt via the step
         self.t_submit = time.monotonic()
         self.t_admit = 0.0
         self.prefill_s = 0.0
-        self._knp = None      # prefill K/V awaiting pool insertion
-        self._vnp = None
+
+
+class _PrefixCache:
+    """Hash trie of page-aligned prompt prefixes -> pool pages.
+
+    Keys are a SHA-1 hash *chain* over full pages of prompt tokens —
+    entry i's digest commits to pages 0..i, so one dict lookup per page
+    walks the trie without storing token arrays. Every cached entry
+    holds one allocator reference; `lookup` retains matched pages on
+    the caller's behalf (so an entry evicted a microsecond later cannot
+    free a page the caller is about to map). Eviction is LRU by lookup
+    tick; evicting a mid-chain entry orphans its descendants, which
+    simply age out the same way. Single leaf lock, no device work or
+    blocking calls under it."""
+
+    def __init__(self, alloc: PageAllocator, page_tokens: int):
+        self._alloc = alloc
+        self._pt = int(page_tokens)
+        self._lock = threading.Lock()
+        self._entries: Dict[bytes, List[int]] = {}   # digest -> [page, tick]
+        self._tick = 0
+        self._evictions = 0
+
+    def _digests(self, prompt: Sequence[int]) -> List[bytes]:
+        h, out = b"", []
+        for i in range(len(prompt) // self._pt):
+            chunk = np.asarray(prompt[i * self._pt:(i + 1) * self._pt],
+                               np.int64).tobytes()
+            h = hashlib.sha1(h + chunk).digest()
+            out.append(h)
+        return out
+
+    def lookup(self, prompt: Sequence[int]) -> Tuple[List[int], int]:
+        """Longest cached page-aligned prefix of `prompt`. Returns
+        (pages, hit_tokens); each returned page has been retained for
+        the caller, who owns releasing every one of them."""
+        pages: List[int] = []
+        with self._lock:
+            self._tick += 1
+            for d in self._digests(prompt):
+                ent = self._entries.get(d)
+                if ent is None:
+                    break
+                self._alloc.retain(ent[0])
+                ent[1] = self._tick
+                pages.append(ent[0])
+        return pages, len(pages) * self._pt
+
+    def insert(self, prompt: Sequence[int], pages: Sequence[int]):
+        """Cache `prompt`'s full pages (pages[i] holds prompt rows
+        [i*pt, (i+1)*pt)); already-cached prefixes are left in place."""
+        with self._lock:
+            self._tick += 1
+            for d, p in zip(self._digests(prompt), pages):
+                if d not in self._entries:
+                    self._alloc.retain(p)
+                    self._entries[d] = [int(p), self._tick]
+
+    def evict(self, n: int) -> int:
+        """Release up to `n` least-recently-used entries' pages."""
+        with self._lock:
+            victims = sorted(self._entries.items(),
+                             key=lambda kv: kv[1][1])[:max(n, 0)]
+            for d, (p, _) in victims:
+                del self._entries[d]
+                self._alloc.release(p)
+            self._evictions += len(victims)
+            return len(victims)
+
+    def clear(self):
+        with self._lock:
+            for p, _ in self._entries.values():
+                self._alloc.release(p)
+            self._entries.clear()
+
+    def stats(self) -> Dict:
+        with self._lock:
+            return {"cached_pages": len(self._entries),
+                    "evictions": self._evictions}
+
+
+# Pure pool entry points (jit + AotCache'd by the engine): K and V move
+# together so one executable covers both writes.
+
+def _write_kv_pages(k_pool, v_pool, k_rows, v_rows, page_ids):
+    return (write_pages(k_pool, k_rows, page_ids),
+            write_pages(v_pool, v_rows, page_ids))
+
+
+def _copy_kv_page(k_pool, v_pool, src, dst):
+    return (copy_page(k_pool, src, dst), copy_page(v_pool, src, dst))
 
 
 class DecodeEngine:
-    """Slot-pool continuous batcher over the incremental GPT forward."""
+    """Slot-pool continuous batcher over the paged incremental GPT
+    forward: fixed device page pool + per-slot block tables, prefix
+    sharing with copy-on-write, typed backpressure on exhaustion."""
 
     def __init__(self, model=None, *, cfg: Optional[GPTConfig] = None,
                  params: Optional[Dict] = None, eps: Optional[float] = None,
@@ -229,7 +389,10 @@ class DecodeEngine:
                  max_new_tokens: int = DEFAULT_MAX_NEW_TOKENS,
                  eos_id: Optional[int] = None,
                  hbm_fraction: float = 0.5, seed: int = 0,
-                 max_pending: Optional[int] = None):
+                 max_pending: Optional[int] = None,
+                 page_tokens: Optional[int] = None,
+                 num_pages: Optional[int] = None,
+                 prefix_cache: Optional[bool] = None):
         if model is not None:
             from .. import framework
             cfg = model.cfg
@@ -246,13 +409,35 @@ class DecodeEngine:
             else default_slot_count(cfg, hbm_fraction)
         self.max_pending = int(max_pending) if max_pending is not None \
             else 4 * self.max_slots
+        self.page_tokens = int(
+            page_tokens or _flags.env_value("PADDLE_TPU_DECODE_PAGE_TOKENS"))
+        if self.page_tokens < 1:
+            raise ValueError(f"page_tokens must be >= 1, "
+                             f"got {self.page_tokens}")
         self.batch_ladder = bucket_ladder(
             self.max_slots, env=_flags.env_value("PADDLE_TPU_DECODE_BUCKETS"))
-        self.kv_ladder = kv_capacity_ladder(cfg.max_seq_len)
+        self.kv_ladder = kv_capacity_ladder(cfg.max_seq_len,
+                                            floor=self.page_tokens)
+        # block-table width rungs: pages needed to hold each kv rung
+        self.page_ladder = sorted(
+            {-(-r // self.page_tokens) for r in self.kv_ladder})
+        self.pages_per_seq = -(-cfg.max_seq_len // self.page_tokens)
+        # +1: page 0 is the reserved null/scratch page (table padding
+        # and padded-batch writes land there, never on live data)
+        self.num_pages = int(num_pages) if num_pages \
+            else self.max_slots * self.pages_per_seq + 1
+        self._alloc = PageAllocator(self.num_pages)
+        use_prefix = prefix_cache if prefix_cache is not None \
+            else bool(_flags.env_value("PADDLE_TPU_DECODE_PREFIX_CACHE"))
+        self._prefix = _PrefixCache(self._alloc, self.page_tokens) \
+            if use_prefix else None
 
-        prefill_fn, step_fn = gpt_decode_fns(cfg, eps=self.eps)
+        prefill_fn, step_fn = gpt_paged_decode_fns(
+            cfg, eps=self.eps, page_tokens=self.page_tokens)
         self._prefill_aot = AotCache(jax.jit(prefill_fn), "decode.prefill")
-        self._step_aot = AotCache(jax.jit(step_fn), "decode.step")
+        self._step_aot = AotCache(jax.jit(step_fn), "decode.pstep")
+        self._write_aot = AotCache(jax.jit(_write_kv_pages), "decode.pwrite")
+        self._copy_aot = AotCache(jax.jit(_copy_kv_page), "decode.pcow")
 
         self._m = _decode_metrics()
         self._spans = SpanRecorder(
@@ -262,9 +447,10 @@ class DecodeEngine:
 
         self._pending: deque = deque()
         self._active: List[_Req] = []
-        self._kdev = None            # [L, B_rung, kv_rung, nh, D]
-        self._vdev = None
-        self._need_rebuild = False
+        self._kpool = None           # [L, P, page_tokens, nh, D], lazy
+        self._vpool = None
+        self._last_b_rung = self.batch_ladder[0]
+        self._last_w_rung = self.page_ladder[0]
         self._steps = 0
         self._tokens = 0
         self._stop = False
@@ -306,51 +492,80 @@ class DecodeEngine:
             self._cond.notify_all()
         return req.stream
 
+    def _pool_sds(self):
+        L, nh, D = self.cfg.layers, self.cfg.heads, self.cfg.head_dim
+        return jax.ShapeDtypeStruct(
+            (L, self.num_pages, self.page_tokens, nh, D), jnp.float32)
+
+    def _ensure_pool(self):
+        if self._kpool is None:
+            self._kpool = jnp.zeros(self._pool_sds().shape, jnp.float32)
+            self._vpool = jnp.zeros_like(self._kpool)
+
     def warmup(self, verbose: bool = False) -> int:
-        """AOT-compile the prefill prompt rungs and the decode
-        (batch-rung x kv-rung) cross product (capped, largest rungs
+        """AOT-compile the prefill prompt rungs, the page-write rungs,
+        the copy-on-write executable, and the decode
+        (batch-rung x page-rung) cross product (capped, largest rungs
         first dropped last). Returns the number of fresh compiles."""
         before = len(profiler.compile_events())
         L, nh, D = self.cfg.layers, self.cfg.heads, self.cfg.head_dim
         i32, f32 = jnp.int32, jnp.float32
+        pool = self._pool_sds()
+        pt = self.page_tokens
         for r in self.kv_ladder:
             self._prefill_aot.get_or_compile(
                 self.params,
                 jax.ShapeDtypeStruct((1, r), i32),
                 jax.ShapeDtypeStruct((1,), i32),
                 key=("prefill", 1, r))
-        sigs = [(b, r) for b in self.batch_ladder for r in self.kv_ladder]
+        for w in self.page_ladder:
+            self._write_aot.get_or_compile(
+                pool, pool,
+                jax.ShapeDtypeStruct((L, w, pt, nh, D), f32),
+                jax.ShapeDtypeStruct((L, w, pt, nh, D), f32),
+                jax.ShapeDtypeStruct((w,), i32),
+                key=("pwrite", w))
+        self._copy_aot.get_or_compile(
+            pool, pool,
+            jax.ShapeDtypeStruct((), i32), jax.ShapeDtypeStruct((), i32),
+            key=("pcow",))
+        sigs = [(b, w) for b in self.batch_ladder for w in self.page_ladder]
         if len(sigs) > _WARMUP_SIG_CAP:
             sigs = sigs[:_WARMUP_SIG_CAP]
-        for b, r in sigs:
+        for b, w in sigs:
             self._step_aot.get_or_compile(
-                self.params,
-                jax.ShapeDtypeStruct((L, b, r, nh, D), f32),
-                jax.ShapeDtypeStruct((L, b, r, nh, D), f32),
+                self.params, pool, pool,
+                jax.ShapeDtypeStruct((b, w), i32),
                 jax.ShapeDtypeStruct((b,), i32),
                 jax.ShapeDtypeStruct((b,), i32),
-                key=("step", b, r))
+                key=("pstep", b, w))
         n = len(profiler.compile_events()) - before
         if verbose:
             print(f"DECODE WARMUP compiles={n} "
                   f"prefill_rungs={self.kv_ladder} "
+                  f"page_rungs={self.page_ladder} "
                   f"step_sigs={len(sigs)}", flush=True)
         return n
 
     def stats(self) -> Dict:
-        return {
+        st = {
             "active": len(self._active),
             "pending": len(self._pending),
             "max_slots": self.max_slots,
             "steps": self._steps,
             "tokens": self._tokens,
-            "batch_rung": 0 if self._kdev is None
-            else int(self._kdev.shape[1]),
-            "kv_rung": 0 if self._kdev is None
-            else int(self._kdev.shape[2]),
+            # rung of the most recent dispatch; the smallest formable
+            # rung before the first one (never a bogus 0)
+            "batch_rung": int(self._last_b_rung),
+            "kv_rung": int(self._last_w_rung * self.page_tokens),
             "batch_ladder": list(self.batch_ladder),
             "kv_ladder": list(self.kv_ladder),
+            "page_tokens": self.page_tokens,
+            "pages": self._alloc.stats(),
         }
+        if self._prefix is not None:
+            st["prefix_cache"] = self._prefix.stats()
+        return st
 
     def stop(self):
         """Stop the scheduler; open streams get typed UNAVAILABLE."""
@@ -363,6 +578,9 @@ class DecodeEngine:
         for req in leftovers:
             req.stream._push_error(TypedServeError(
                 ERR_UNAVAILABLE, "decode engine stopped"))
+            self._release_pages(req)
+        if self._prefix is not None:
+            self._prefix.clear()
         self._m["active"].set(0)
         self._m["occupancy"].set(0.0)
         self._spans.close()
@@ -383,35 +601,129 @@ class DecodeEngine:
                     newly.append(self._pending.popleft())
                     free -= 1
             try:
-                # the next step writes K/V at row cache_len: grow to the
-                # next kv rung BEFORE dynamic_update_slice would clamp
-                # the write into the last row and corrupt the cache
-                if self._active and self._kdev is not None and \
-                        max(r.cache_len + 1 for r in self._active) \
-                        > int(self._kdev.shape[2]):
-                    self._need_rebuild = True
-                if newly or self._need_rebuild:
-                    admitted = [r for r in newly if self._admit(r)]
-                    self._rebuild(admitted)
+                for req in newly:
+                    if self._admit(req):
+                        self._active.append(req)
+                if newly:
+                    self._update_gauges()
                 if self._active:
                     self._step_once()
             except Exception as exc:  # engine-level failure: fail the
-                # batch (typed), drop the pool, keep serving newcomers
+                # batch (typed), free its pages, keep serving newcomers
                 err = exc if isinstance(exc, TypedServeError) else \
                     TypedServeError(ERR_UNAVAILABLE,
                                     f"decode scheduler failure: {exc}")
                 for req in self._active:
                     req.stream._push_error(err)
                     self._m["evictions"].labels(reason="error").inc()
+                    self._release_pages(req)
                 self._active = []
-                self._kdev = self._vdev = None
-                self._need_rebuild = False
                 self._update_gauges()
 
+    # ---------------------------------------------------- page plumbing
+
+    def _release_pages(self, req: _Req):
+        """Drop the slot's reference on every page it maps (exactly one
+        ref per block-table entry). Idempotent via the list reset."""
+        pages, req.pages = req.pages, []
+        for p in pages:
+            try:
+                self._alloc.release(p)
+            except ValueError:       # never expected; don't mask the
+                pass                 # caller's error path if it happens
+        self._update_gauges()
+
+    def _alloc_pages(self, n: int, req: _Req) -> List[int]:
+        """Allocate `n` pages for `req`: chaos site, then the pool, then
+        — under pressure — LRU-evict cold prefix-cache pages and retry
+        once. Failure is typed RESOURCE_EXHAUSTED for THIS request."""
+        try:
+            chaos.maybe_fail("decode.page_alloc", detail=req.id)
+        except Exception as exc:
+            self._m["page_alloc_failures"].inc()
+            raise TypedServeError(
+                ERR_RESOURCE_EXHAUSTED,
+                f"decode request {req.id}: page allocation failed: "
+                f"{exc}") from exc
+        retried = False
+        while True:
+            try:
+                pages = self._alloc.alloc(n)
+            except PageExhausted as exc:
+                if not retried and self._prefix is not None:
+                    shortfall = n - self._alloc.free_count()
+                    evicted = self._prefix.evict(max(shortfall, 1))
+                    if evicted:
+                        self._m["prefix_evictions"].inc(evicted)
+                        retried = True
+                        continue
+                self._m["page_alloc_failures"].inc()
+                raise TypedServeError(
+                    ERR_RESOURCE_EXHAUSTED,
+                    f"decode request {req.id}: KV page pool exhausted "
+                    f"({exc})") from exc
+            self._m["page_allocs"].inc(n)
+            return pages
+
+    def _cow(self, req: _Req, slot: int):
+        """First write into a shared page: copy it to a fresh page and
+        repoint this slot's block table (the other owners keep the
+        original — that's the isolation)."""
+        old = req.pages[slot]
+        (new,) = self._alloc_pages(1, req)
+        exe = self._copy_aot.get_or_compile(
+            self._kpool, self._vpool,
+            jax.ShapeDtypeStruct((), jnp.int32),
+            jax.ShapeDtypeStruct((), jnp.int32),
+            key=("pcow",))
+        self._kpool, self._vpool = exe(
+            self._kpool, self._vpool,
+            jnp.asarray(old, jnp.int32), jnp.asarray(new, jnp.int32))
+        req.pages[slot] = new
+        self._alloc.release(old)
+        self._m["cow"].inc()
+
+    # ------------------------------------------------------- admission
+
     def _admit(self, req: _Req) -> bool:
-        """Prefill one request (B=1 at its prompt rung) and deliver the
-        first sampled token. True if it still needs a decode slot."""
+        """Give the request KV pages and a first token source.
+
+        Prefix hit: map the cached pages (refcount++), queue the
+        uncached prompt tail to be fed through the batched decode step
+        — no prefill, no device work here at all. Miss: classic B=1
+        prefill at the prompt rung, scatter the panel into fresh pages,
+        deliver the first sampled token immediately. True if the
+        request now occupies a decode slot."""
         plen = len(req.prompt)
+        pt = self.page_tokens
+        self._ensure_pool()
+        req.t_admit = time.monotonic()
+
+        usable, hit_pages = 0, []
+        if self._prefix is not None:
+            hit_pages, hit_tokens = self._prefix.lookup(req.prompt)
+            self._m["prefix_lookup_tokens"].inc(plen)
+            # at least one prompt token is always re-fed so the step
+            # has logits to sample the first generated token from
+            usable = min(hit_tokens, plen - 1)
+            n_map = min(len(hit_pages), -(-(usable + 1) // pt)) \
+                if usable else 0
+            for p in hit_pages[n_map:]:
+                self._alloc.release(p)
+            hit_pages = hit_pages[:n_map]
+            self._m["prefix_hits" if usable else "prefix_misses"].inc()
+            if usable:
+                self._m["prefix_hit_tokens"].inc(usable)
+
+        if usable:
+            req.pages = hit_pages
+            req.cache_len = usable
+            req.last_tok = req.prompt[usable]
+            req.input_tail = deque(req.prompt[usable + 1:])
+            req.feeding = True
+            return True
+
+        # miss: full prefill at the prompt's kv rung
         rung = next_bucket(plen, self.kv_ladder)
         toks = np.zeros((1, rung), np.int32)
         toks[0, :plen] = req.prompt
@@ -425,9 +737,36 @@ class DecodeEngine:
                            jnp.asarray([plen], np.int32))
         row = np.asarray(logits)[0]
         req.prefill_s = time.perf_counter() - t0
-        req.t_admit = time.monotonic()
         self._m["prefills"].inc()
         self._m["prefill_latency"].observe(req.prefill_s)
+        try:
+            pages = self._alloc_pages(-(-plen // pt), req)
+        except TypedServeError as err:
+            req.stream._push_error(err)
+            self._m["evictions"].labels(reason="exhausted").inc()
+            return False
+        # scatter the panel into the pages (zero padding past plen —
+        # rung garbage must never enter the pool; table padding -> null)
+        L, nh, D = self.cfg.layers, self.cfg.heads, self.cfg.head_dim
+        w = -(-rung // pt)
+        ids = np.zeros(w, np.int32)
+        ids[:len(pages)] = pages
+        krows = np.zeros((L, w * pt, nh, D), np.float32)
+        vrows = np.zeros_like(krows)
+        krows[:, :plen] = np.asarray(k)[:, 0, :plen]
+        vrows[:, :plen] = np.asarray(v)[:, 0, :plen]
+        wexe = self._write_aot.get_or_compile(
+            self._kpool, self._vpool,
+            jax.ShapeDtypeStruct((L, w, pt, nh, D), jnp.float32),
+            jax.ShapeDtypeStruct((L, w, pt, nh, D), jnp.float32),
+            jax.ShapeDtypeStruct((w,), jnp.int32),
+            key=("pwrite", w))
+        self._kpool, self._vpool = wexe(
+            self._kpool, self._vpool,
+            jnp.asarray(krows.reshape(L, w, pt, nh, D)),
+            jnp.asarray(vrows.reshape(L, w, pt, nh, D)),
+            jnp.asarray(ids))
+        req.pages = pages
         self._m["ttft"].observe(time.monotonic() - req.t_submit)
         try:
             chaos.maybe_fail("decode.stream", detail=req.id)
@@ -436,108 +775,114 @@ class DecodeEngine:
             req.stream._push_error(TypedServeError(
                 ERR_UNAVAILABLE, f"decode stream killed: {exc}"))
             self._m["evictions"].labels(reason="error").inc()
+            self._release_pages(req)
             return False
         req.cache_len = plen
         req.last_tok = tok
         req.generated.append(tok)
         self._tokens += 1
         self._m["tokens"].inc()
+        if self._prefix is not None:
+            self._prefix.insert(req.prompt, pages[:plen // pt])
         eos = req.eos_id is not None and tok == req.eos_id
         req.stream._push_token(tok, eos)
         if eos or len(req.generated) >= req.max_new \
                 or req.cache_len >= self.cfg.max_seq_len:
             self._finish(req, "eos" if eos else "length")
+            self._release_pages(req)
             return False
-        # keep only the real prompt columns; rung padding beyond plen is
-        # garbage K/V the pool must never inherit
-        req._knp = np.asarray(k)[:, 0, :plen]
-        req._vnp = np.asarray(v)[:, 0, :plen]
         return True
 
-    def _rebuild(self, admitted: List[_Req]):
-        """Re-pack survivors + admissions onto the smallest rung pair."""
-        survivors = list(self._active)
-        k_old = None if self._kdev is None else np.asarray(self._kdev)
-        v_old = None if self._vdev is None else np.asarray(self._vdev)
-        actives = survivors + admitted
-        self._need_rebuild = False
-        if not actives:
-            self._active = []
-            self._kdev = self._vdev = None
-            self._update_gauges()
-            return
-        L, nh, D = self.cfg.layers, self.cfg.heads, self.cfg.head_dim
-        b_rung = next_bucket(len(actives), self.batch_ladder)
-        need = max(r.cache_len + 1 for r in actives)
-        kv_rung = next_bucket(need, self.kv_ladder)
-        knp = np.zeros((L, b_rung, kv_rung, nh, D), np.float32)
-        vnp = np.zeros_like(knp)
-        for j, req in enumerate(actives):
-            n = req.cache_len
-            if req._knp is not None:               # fresh admission
-                knp[:, j, :n] = req._knp
-                vnp[:, j, :n] = req._vnp
-                req._knp = req._vnp = None
-            else:                                  # survivor: old row
-                knp[:, j, :n] = k_old[:, req.row, :n]
-                vnp[:, j, :n] = v_old[:, req.row, :n]
-            req.row = j
-        self._active = actives
-        self._kdev = jnp.asarray(knp)
-        self._vdev = jnp.asarray(vnp)
-        self._update_gauges()
+    # ------------------------------------------------------------ step
 
     def _step_once(self):
+        pt = self.page_tokens
+        # provision the write target for row cache_len: a fresh page at
+        # a page boundary, a copy-on-write if the target page is shared
+        victims = []
+        for req in self._active:
+            slot = req.cache_len // pt
+            try:
+                if slot >= len(req.pages):
+                    req.pages.extend(self._alloc_pages(1, req))
+                elif self._alloc.refcount(req.pages[slot]) > 1:
+                    self._cow(req, slot)
+            except TypedServeError as err:
+                req.stream._push_error(err)
+                self._m["evictions"].labels(reason="exhausted").inc()
+                self._release_pages(req)
+                victims.append(req)
+        if victims:
+            self._active = [r for r in self._active if r not in victims]
+            self._update_gauges()
         reqs = self._active
-        L, b_rung, kv_rung = (self._kdev.shape[0], self._kdev.shape[1],
-                              self._kdev.shape[2])
+        if not reqs:
+            return
+        b_rung = next_bucket(len(reqs), self.batch_ladder)
+        w_rung = next_bucket(max(len(r.pages) for r in reqs),
+                             self.page_ladder)
+        tables = np.zeros((b_rung, w_rung), np.int32)   # pad -> null page
         ltok = np.zeros(b_rung, np.int32)
         clen = np.zeros(b_rung, np.int32)
-        for req in reqs:
-            ltok[req.row] = req.last_tok
-            clen[req.row] = req.cache_len
-        if int(clen.max()) + 1 > kv_rung:
-            raise RuntimeError(
-                f"decode step would overflow kv capacity {kv_rung} "
-                f"(cache_len {int(clen.max())}) — rebuild missed")
+        for j, req in enumerate(reqs):
+            tables[j, :len(req.pages)] = req.pages
+            ltok[j] = req.last_tok
+            clen[j] = req.cache_len
         exe = self._step_aot.get_or_compile(
-            self.params, self._kdev, self._vdev,
+            self.params, self._kpool, self._vpool,
+            jax.ShapeDtypeStruct((b_rung, w_rung), jnp.int32),
             jax.ShapeDtypeStruct((b_rung,), jnp.int32),
             jax.ShapeDtypeStruct((b_rung,), jnp.int32),
-            key=("step", b_rung, kv_rung))
+            key=("pstep", b_rung, w_rung))
         t0 = time.perf_counter()
-        logits, self._kdev, self._vdev = exe(
-            self.params, self._kdev, self._vdev,
-            jnp.asarray(ltok), jnp.asarray(clen))
+        logits, self._kpool, self._vpool = exe(
+            self.params, self._kpool, self._vpool,
+            jnp.asarray(tables), jnp.asarray(ltok), jnp.asarray(clen))
         lognp = np.asarray(logits)
         self._m["step_latency"].observe(time.perf_counter() - t0)
+        self._last_b_rung, self._last_w_rung = b_rung, w_rung
         self._steps += 1
         self._m["steps"].inc()
         finished = []
-        for req in reqs:
+        for j, req in enumerate(reqs):
             req.cache_len += 1
+            if req.input_tail:           # still consuming prompt tail:
+                req.last_tok = req.input_tail.popleft()
+                continue                 # logits are mid-prompt, discard
+            if req.feeding:
+                # the step just consumed the final prompt token — its
+                # pages now hold the whole prompt: cache them, and fall
+                # through to sample this request's FIRST token
+                req.feeding = False
+                if self._prefix is not None:
+                    self._prefix.insert(
+                        req.prompt, req.pages[:len(req.prompt) // pt])
+            first = not req.generated
             try:
                 chaos.maybe_fail("decode.stream", detail=req.id)
-                tok = self._sample(lognp[req.row], req)
+                tok = self._sample(lognp[j], req)
             except Exception as exc:
                 req.stream._push_error(TypedServeError(
                     ERR_UNAVAILABLE, f"decode stream killed: {exc}"))
                 self._m["evictions"].labels(reason="error").inc()
+                self._release_pages(req)
                 finished.append(req)
                 continue
             req.generated.append(tok)
             req.last_tok = tok
             self._tokens += 1
             self._m["tokens"].inc()
+            if first:
+                self._m["ttft"].observe(time.monotonic() - req.t_submit)
             eos = req.eos_id is not None and tok == req.eos_id
             req.stream._push_token(tok, eos)
             if eos or len(req.generated) >= req.max_new \
                     or req.cache_len >= self.cfg.max_seq_len:
                 self._finish(req, "eos" if eos else "length")
+                self._release_pages(req)
                 finished.append(req)
         if finished:
             self._active = [r for r in reqs if r not in finished]
-            self._need_rebuild = True
             self._update_gauges()
 
     def _finish(self, req: _Req, reason: str):
@@ -567,6 +912,14 @@ class DecodeEngine:
         n = len(self._active)
         self._m["active"].set(n)
         self._m["occupancy"].set(n / max(self.max_slots, 1))
+        ps = self._alloc.stats()
+        self._m["page_pool_size"].set(ps["pages_total"])
+        self._m["page_in_use"].set(ps["pages_used"])
+        self._m["page_shared"].set(ps["pages_shared"])
+        self._m["page_fragmentation"].set(ps["fragmentation"])
+        if self._prefix is not None:
+            self._m["prefix_cached_pages"].set(
+                self._prefix.stats()["cached_pages"])
 
 
 # ------------------------------------------------------------ artifact

@@ -1,0 +1,11 @@
+"""Device-memory management: the paged KV allocator lives here.
+
+`page_allocator` is deliberately decode-agnostic — it hands out integer
+page ids against a fixed-size device pool and tracks refcounts, so the
+decode engine, prefix cache, and (later) training remat/offload can all
+share one allocator discipline.
+"""
+from .page_allocator import (PageAllocator, PageExhausted, copy_page,
+                             write_pages)
+
+__all__ = ["PageAllocator", "PageExhausted", "copy_page", "write_pages"]

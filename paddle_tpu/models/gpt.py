@@ -1064,3 +1064,73 @@ def gpt_decode_fns(cfg: GPTConfig, eps: float = 1e-5):
         return logits, jnp.stack(k_out), jnp.stack(v_out)
 
     return prefill, decode_step
+
+
+def gpt_paged_decode_fns(cfg: GPTConfig, eps: float = 1e-5,
+                         page_tokens: int = 16):
+    """Pure `(prefill, paged_step)` over a PAGED KV cache.
+
+    `prefill` is gpt_decode_fns' — the contiguous panel it returns is
+    written into pool pages by the engine. The step replaces the
+    per-slot contiguous panel with a shared page pool + block tables:
+
+    paged_step(params,
+               k_pool, v_pool [layers, P, page_tokens, heads, head_dim],
+               tables   [B, W] int32 (unused entries -> null page 0),
+               last_tok [B] int32,
+               cache_len [B] int32)
+        -> (logits [B,V], k_pool, v_pool)
+
+    The new token's K/V lands at page tables[b, cache_len//pt], row
+    cache_len%pt, via one advanced-index scatter per layer (padded batch
+    rows carry all-null tables, so their garbage writes fall into the
+    reserved scratch page); attention walks the block table through
+    `ops.pallas.decode_attention.paged_decode_attention`. One executable
+    serves every occupancy of a (batch-rung x page-rung) bucket, and —
+    unlike the contiguous pool — capacity growth is just a wider block
+    table, never a cache copy.
+    """
+    if cfg.moe_experts > 0:
+        raise NotImplementedError(
+            "gpt_paged_decode_fns: MoE blocks have no KV-decode path yet")
+    D = cfg.head_dim
+    nh = cfg.heads
+    pt = int(page_tokens)
+
+    def _ffn(bp, x):
+        h2 = _pp_ln(x, bp["ln2.weight"], bp["ln2.bias"], eps)
+        m = jax.nn.gelu(h2 @ bp["fc1.weight"] + bp["fc1.bias"],
+                        approximate=False)
+        return x + m @ bp["fc2.weight"] + bp["fc2.bias"]
+
+    def paged_step(params, k_pool, v_pool, tables, last_tok, cache_len):
+        from ..ops.pallas.decode_attention import paged_decode_attention
+        embed, blocks, head = split_decode_params(params, cfg)
+        B = last_tok.shape[0]
+        W = tables.shape[1]
+        pos = jnp.clip(cache_len.astype(jnp.int32), 0,
+                       cfg.max_seq_len - 1)
+        x = embed["wte.weight"][last_tok] + embed["wpe.weight"][pos]
+        page_idx = jnp.take_along_axis(
+            tables, jnp.minimum(pos // pt, W - 1)[:, None], axis=1)[:, 0]
+        offset = pos % pt
+        lengths = pos + 1                 # the row just written is live
+        for i, bp in enumerate(blocks):
+            h1 = _pp_ln(x, bp["ln1.weight"], bp["ln1.bias"], eps)
+            qkv = h1 @ bp["attn.qkv.weight"] + bp["attn.qkv.bias"]
+            q, k_new, v_new = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(B, nh, D)
+            k_new = k_new.reshape(B, nh, D)
+            v_new = v_new.reshape(B, nh, D)
+            k_pool = k_pool.at[i, page_idx, offset].set(k_new)
+            v_pool = v_pool.at[i, page_idx, offset].set(v_new)
+            o = paged_decode_attention(
+                q, k_pool[i], v_pool[i], tables, lengths).reshape(B, -1)
+            x = x + o @ bp["attn.proj.weight"] + bp["attn.proj.bias"]
+            x = _ffn(bp, x)
+        xf = _pp_ln(x, head["ln_f.weight"], head["ln_f.bias"], eps)
+        logits = xf @ embed["wte.weight"].T
+        return logits, k_pool, v_pool
+
+    prefill, _ = gpt_decode_fns(cfg, eps=eps)
+    return prefill, paged_step

@@ -16,6 +16,14 @@ GEMM the flash kernel is tiled for. This module provides:
     `PADDLE_TPU_DECODE_KERNEL=pallas|xla` (default `xla`; the Pallas
     path is opt-in until it has TPU soak time).
 
+The paged trio (`paged_decode_attention[_reference]` and its Pallas
+kernel) attends the same math over a PAGED cache: a shared page pool
+plus per-sequence int32 block tables (inference/decode.py's paged
+engine). The Pallas variant walks the block table via scalar-prefetch
+index maps — one grid cell per (batch, head, page), online softmax in
+scratch — so only mapped pages are ever streamed into VMEM; the XLA
+fallback gathers pages with `jnp.take`.
+
 Shapes (cap = KV-cache capacity rung, see inference/decode.py):
 
     q        [B, H, D]        fresh query row per sequence
@@ -118,5 +126,120 @@ def decode_attention(q, k, v, lengths, kernel=None):
         return _decode_attention_pallas(q, k, v, lengths)
     if choice in ("", "xla"):
         return decode_attention_reference(q, k, v, lengths)
+    raise ValueError(
+        f"{_ENV}={choice!r}: expected 'pallas' or 'xla'")
+
+
+# ---------------------------------------------------------------------------
+# Paged variant: the cache is a shared page pool + per-sequence block table
+# ---------------------------------------------------------------------------
+#
+#     q        [B, H, D]          fresh query row per sequence
+#     k_pool   [P, pt, H, D]      one layer's page pool (pt = page tokens)
+#     v_pool   [P, pt, H, D]
+#     tables   [B, W] int32       block table: tables[b, w] = page holding
+#                                 rows [w*pt, (w+1)*pt) of sequence b;
+#                                 unused entries point at the null page
+#     lengths  [B] int32          valid prefix per sequence
+#     out      [B, H, D]
+
+def paged_decode_attention_reference(q, k_pool, v_pool, tables, lengths):
+    """XLA fallback: gather the table's pages (`jnp.take`), flatten to a
+    contiguous [B, W*pt, H, D] view, reuse the masked-softmax math."""
+    B, W = tables.shape
+    P, pt, H, D = k_pool.shape
+    k = jnp.take(k_pool, tables, axis=0).reshape(B, W * pt, H, D)
+    v = jnp.take(v_pool, tables, axis=0).reshape(B, W * pt, H, D)
+    return decode_attention_reference(q, k, v, lengths)
+
+
+def _paged_kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_s, l_s, acc_s, *, scale, pt):
+    """One grid cell per (batch, head, page-slot): walk the block table
+    along the last grid dim with online (flash-style) softmax carried in
+    SMEM/VMEM scratch, so only the pages a sequence actually maps stream
+    through VMEM — no gather materialization."""
+    b = pl.program_id(0)
+    w = pl.program_id(2)
+
+    @pl.when(w == 0)
+    def _init():
+        m_s[...] = jnp.full_like(m_s, NEG_INF)
+        l_s[...] = jnp.zeros_like(l_s)
+        acc_s[...] = jnp.zeros_like(acc_s)
+
+    qv = q_ref[0, 0]                         # [1, D]
+    kp = k_ref[0, :, 0, :]                   # [pt, D] one page, one head
+    vp = v_ref[0, :, 0, :]
+    s = jax.lax.dot_general(
+        qv, kp, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale        # [1, pt]
+    rows = w * pt + jax.lax.broadcasted_iota(jnp.int32, (1, pt), 1)
+    s = jnp.where(rows < len_ref[b], s, NEG_INF)
+    m_prev = m_s[0, 0]
+    m_new = jnp.maximum(m_prev, jnp.max(s))
+    corr = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)                                 # [1, pt]
+    m_s[0, 0] = m_new
+    l_s[0, 0] = l_s[0, 0] * corr + jnp.sum(p)
+    acc_s[...] = acc_s[...] * corr + jax.lax.dot(
+        p.astype(vp.dtype), vp, preferred_element_type=jnp.float32)
+
+    @pl.when(w == pl.num_programs(2) - 1)
+    def _emit():
+        o_ref[0, 0] = (acc_s[...] / l_s[0, 0]).astype(o_ref.dtype)
+
+
+def _paged_decode_attention_pallas(q, k_pool, v_pool, tables, lengths):
+    B, H, D = q.shape
+    P, pt, _, _ = k_pool.shape
+    W = tables.shape[1]
+    scale = 1.0 / math.sqrt(D)
+    # scalar-prefetch carries (tables, lengths): their VALUES drive the
+    # K/V index_map, so each grid cell DMAs exactly the page the block
+    # table names — the table walk happens in the pipeline, not the body
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, H, W),
+        in_specs=[
+            pl.BlockSpec((1, 1, 1, D),
+                         lambda b, h, w, tbl, ln: (b, h, 0, 0)),
+            pl.BlockSpec((1, pt, 1, D),
+                         lambda b, h, w, tbl, ln: (tbl[b, w], 0, h, 0)),
+            pl.BlockSpec((1, pt, 1, D),
+                         lambda b, h, w, tbl, ln: (tbl[b, w], 0, h, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, 1, D),
+                               lambda b, h, w, tbl, ln: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.SMEM((1, 1), jnp.float32),     # running max
+            pltpu.SMEM((1, 1), jnp.float32),     # running denominator
+            pltpu.VMEM((1, D), jnp.float32),     # output accumulator
+        ],
+    )
+    kw = {}
+    if not _common.interpret():
+        kw["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary", "arbitrary"))
+    out = pl.pallas_call(
+        functools.partial(_paged_kernel, scale=scale, pt=pt),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, 1, D), q.dtype),
+        interpret=_common.interpret(),
+        **kw,
+    )(tables.astype(jnp.int32), lengths.astype(jnp.int32),
+      q.reshape(B, H, 1, D), k_pool, v_pool)
+    return out.reshape(B, H, D)
+
+
+def paged_decode_attention(q, k_pool, v_pool, tables, lengths, kernel=None):
+    """Dispatch on `kernel` (or $PADDLE_TPU_DECODE_KERNEL, default xla)."""
+    choice = (kernel or _flags.env_value(_ENV)).strip().lower()
+    if choice == "pallas":
+        return _paged_decode_attention_pallas(q, k_pool, v_pool,
+                                              tables, lengths)
+    if choice in ("", "xla"):
+        return paged_decode_attention_reference(q, k_pool, v_pool,
+                                                tables, lengths)
     raise ValueError(
         f"{_ENV}={choice!r}: expected 'pallas' or 'xla'")

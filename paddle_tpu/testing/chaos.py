@@ -232,6 +232,8 @@ register_site("batcher.dispatch", "each batch the dispatcher forms")
 register_site("batcher.worker", "each batch a pool worker executes")
 register_site("router.forward", "each router->backend forward attempt")
 register_site("decode.stream", "each token delivery in the decode engine")
+register_site("decode.page_alloc",
+              "each KV page allocation in the paged decode engine")
 
 
 def maybe_fail(site: str, detail=None):

@@ -1,0 +1,322 @@
+"""Paged KV-cache decode: allocator, paged-vs-contiguous equivalence,
+prefix sharing with copy-on-write isolation, and exhaustion backpressure.
+
+The contiguous reference for every equivalence claim is the FULL
+forward pass (`_full_logits` greedy loop) — the same oracle
+tests/test_decode.py holds the engine to — so "paged == contiguous"
+is enforced token-for-token through real admission/eviction churn,
+EOS mid-page, page-boundary crossings, and shared-prefix admissions.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import profiler
+from paddle_tpu.inference.decode import (DecodeEngine, kv_capacity_ladder,
+                                         kv_page_bytes)
+from paddle_tpu.inference.errors import (ERR_RESOURCE_EXHAUSTED,
+                                         ERR_UNAVAILABLE, TypedServeError)
+from paddle_tpu.memory.page_allocator import (PageAllocator, PageExhausted,
+                                              copy_page, write_pages)
+from paddle_tpu.models.gpt import GPT, GPTConfig, gpt_tiny
+from paddle_tpu.testing import chaos
+
+_CFGS = [
+    ("tiny-scan", gpt_tiny()),                       # scan-stacked params
+    ("small-unrolled", GPTConfig(vocab_size=256, max_seq_len=64, hidden=32,
+                                 layers=3, heads=2, scan_layers=False)),
+]
+
+
+@pytest.fixture(scope="module")
+def gpt_models():
+    paddle.seed(7)
+    return {name: GPT(cfg) for name, cfg in _CFGS}
+
+
+def _full_logits(model, toks):
+    idx = paddle.to_tensor(np.asarray([toks], np.int64))
+    return model(idx).numpy()[0, -1].astype(np.float32)
+
+
+def _ref_greedy(model, prompt, n, eos_id=None):
+    toks = [int(t) for t in prompt]
+    out = []
+    for _ in range(n):
+        t = int(_full_logits(model, toks).argmax())
+        out.append(t)
+        toks.append(t)
+        if eos_id is not None and t == eos_id:
+            break
+    return out
+
+
+# ----------------------------------------------------------- allocator
+
+def test_page_allocator_basics():
+    a = PageAllocator(9)                 # 8 allocatable + null page 0
+    assert a.null_page == 0
+    p = a.alloc(3)
+    assert p == [1, 2, 3] and all(a.refcount(x) == 1 for x in p)
+    assert 0 not in a.alloc(5)           # null page never handed out
+    with pytest.raises(PageExhausted):
+        a.alloc(1)
+    a.release(p[0])
+    assert a.alloc(1) == [p[0]]          # freed page recycles
+    with pytest.raises(ValueError):
+        a.retain(0)                      # null page is not allocated
+    with pytest.raises(ValueError):
+        a.release(0)
+
+
+def test_page_allocator_refcounts_and_stats():
+    a = PageAllocator(9)
+    p = a.alloc(4)
+    assert a.retain(p[0]) == 2
+    st = a.stats()
+    assert st["pages_total"] == 8 and st["pages_used"] == 4
+    assert st["pages_shared"] == 1 and st["refs_total"] == 5
+    assert a.release(p[0]) == 1          # still held by the other owner
+    assert a.refcount(p[0]) == 1
+    # fragmentation: free pages {5..8} contiguous -> 0.0; poke a hole
+    assert a.stats()["fragmentation"] == 0.0
+    a.release(p[1])                      # free set {2, 5, 6, 7, 8}
+    st = a.stats()
+    assert 0.0 < st["fragmentation"] <= 1.0
+    assert st["allocs_total"] == 4 and st["alloc_failures_total"] == 0
+
+
+def test_pool_ops_write_and_copy():
+    import jax.numpy as jnp
+    pool = jnp.zeros((2, 4, 3, 2), jnp.float32)      # [L, P, pt, D]
+    rows = jnp.arange(2 * 2 * 3 * 2, dtype=jnp.float32).reshape(2, 2, 3, 2)
+    pool = write_pages(pool, rows, jnp.asarray([2, 1], jnp.int32))
+    np.testing.assert_array_equal(np.asarray(pool[:, 2]),
+                                  np.asarray(rows[:, 0]))
+    np.testing.assert_array_equal(np.asarray(pool[:, 1]),
+                                  np.asarray(rows[:, 1]))
+    assert float(jnp.abs(pool[:, 3]).sum()) == 0.0
+    pool = copy_page(pool, jnp.int32(2), jnp.int32(3))
+    np.testing.assert_array_equal(np.asarray(pool[:, 3]),
+                                  np.asarray(pool[:, 2]))
+
+
+def test_kv_capacity_ladder_floor_follows_page_size():
+    assert kv_capacity_ladder(128)[0] == 16          # default floor
+    assert kv_capacity_ladder(128, floor=4) == [4, 8, 16, 32, 64, 128]
+    assert kv_capacity_ladder(128, floor=32) == [32, 64, 128]
+    assert kv_capacity_ladder(8, floor=16) == [8]
+
+
+# ------------------------------------- paged == contiguous equivalence
+
+@pytest.mark.parametrize("name", [n for n, _ in _CFGS])
+def test_paged_engine_matches_full_forward_under_churn(gpt_models, name):
+    """Property test on both param layouts: random prompt lengths,
+    ragged admission/eviction churn, EOS mid-page, page-boundary
+    crossings (page_tokens=4 stresses them) — every stream must equal
+    the full-forward greedy reference, with ZERO steady-state compiles
+    after warmup."""
+    model = gpt_models[name]
+    cfg = model.cfg
+    rng = np.random.RandomState(hash(name) % 2**31)
+    eng = DecodeEngine(model, max_slots=3, max_new_tokens=32,
+                       page_tokens=4)
+    try:
+        eng.warmup()
+        c0 = len(profiler.compile_events())
+        # wave 1: ragged lengths around page boundaries (3..9 tokens at
+        # pt=4 covers sub-page, exact-page, and page+1 prompts)
+        prompts = [rng.randint(0, cfg.vocab_size, size=int(p))
+                   for p in rng.randint(3, 10, size=5)]
+        gens = [int(g) for g in rng.randint(2, 14, size=5)]
+        streams = [eng.submit(p, max_new_tokens=g)
+                   for p, g in zip(prompts, gens)]
+        for p, g, s in zip(prompts, gens, streams):
+            assert s.result(timeout=180) == _ref_greedy(model, p, g)
+        # wave 2: EOS mid-page — pick each prompt's 2nd reference token
+        # as its eos so the stream dies with a partially filled page
+        for p in prompts[:3]:
+            ref_full = _ref_greedy(model, p, 8)
+            eos = ref_full[1]
+            ref = ref_full[:ref_full.index(eos) + 1]
+            got = eng.submit(p, max_new_tokens=8,
+                             eos_id=eos).result(timeout=180)
+            assert got == ref
+        assert len(profiler.compile_events()) == c0, \
+            "paged engine compiled during a warmed-up churn run"
+        st = eng.stats()
+        assert st["active"] == 0 and st["pending"] == 0
+    finally:
+        eng.stop()
+
+
+# ------------------------------------------- prefix sharing + COW
+
+def test_prefix_sharing_and_cow_isolation(gpt_models):
+    """Shared system prompt: the second admission maps the cached pages
+    (no second prefill) and only feeds its unique tail; divergent tails
+    and a same-prompt overlap stream stay token-for-token correct —
+    i.e. copy-on-write isolates every writer from the shared pages."""
+    from paddle_tpu.observability import REGISTRY
+    model = gpt_models["tiny-scan"]
+    cfg = model.cfg
+    rng = np.random.RandomState(97)
+    pt = 4
+    head = rng.randint(0, cfg.vocab_size, size=3 * pt)   # page-aligned
+    tails = [rng.randint(0, cfg.vocab_size, size=t) for t in (2, 3, 5)]
+    prompts = [np.concatenate([head, t]) for t in tails]
+    refs = [_ref_greedy(model, p, 10) for p in prompts]
+    aligned = head                        # exact-multiple prompt: its
+    ref_aligned = _ref_greedy(model, aligned, 12)   # first write is COW
+
+    eng = DecodeEngine(model, max_slots=4, max_new_tokens=16,
+                       page_tokens=pt)
+    try:
+        flat0 = REGISTRY.flat()
+        # seed the cache, then admit the divergent tails concurrently
+        assert eng.submit(prompts[0],
+                          max_new_tokens=10).result(timeout=180) == refs[0]
+        streams = [eng.submit(p, max_new_tokens=10) for p in prompts[1:]]
+        # overlap: the aligned prompt maps ALL its pages shared; its
+        # first decode write hits a shared page -> copy-on-write, while
+        # the other streams keep attending the originals
+        s_aligned = eng.submit(aligned, max_new_tokens=12)
+        for s, ref in zip(streams, refs[1:]):
+            assert s.result(timeout=180) == ref
+        assert s_aligned.result(timeout=180) == ref_aligned
+        # replay every prompt against a now-warm cache: still exact
+        for p, ref in zip(prompts, refs):
+            assert eng.submit(p,
+                              max_new_tokens=10).result(timeout=180) == ref
+        flat = REGISTRY.flat()
+
+        def delta(name):
+            return flat.get(name, 0) - flat0.get(name, 0)
+
+        assert delta("paddle_tpu_decode_prefix_hits_total") >= 6
+        assert delta("paddle_tpu_decode_prefix_hit_tokens_total") \
+            >= 6 * len(head)
+        assert delta("paddle_tpu_decode_page_cow_copies_total") >= 1
+        st = eng.stats()
+        assert st["prefix_cache"]["cached_pages"] >= 3
+        assert st["pages"]["pages_used"] >= 3     # trie keeps them warm
+    finally:
+        eng.stop()
+
+
+def test_prefix_cache_off_still_correct(gpt_models):
+    """PADDLE_TPU_DECODE_PREFIX_CACHE=0 equivalent: identical prompts
+    each prefill from scratch and still match the reference."""
+    model = gpt_models["small-unrolled"]
+    rng = np.random.RandomState(5)
+    p = rng.randint(0, model.cfg.vocab_size, size=9)
+    ref = _ref_greedy(model, p, 6)
+    eng = DecodeEngine(model, max_slots=2, max_new_tokens=8,
+                       page_tokens=4, prefix_cache=False)
+    try:
+        assert eng.submit(p, max_new_tokens=6).result(timeout=120) == ref
+        assert eng.submit(p, max_new_tokens=6).result(timeout=120) == ref
+        assert "prefix_cache" not in eng.stats()
+        assert eng.stats()["pages"]["pages_used"] == 0   # all released
+    finally:
+        eng.stop()
+
+
+# ------------------------------------------------ backpressure + chaos
+
+def test_page_exhaustion_fails_only_victim(gpt_models):
+    """A pool too small for a second sequence: the victim gets typed
+    RESOURCE_EXHAUSTED (not a crash), the survivor keeps streaming, and
+    the freed capacity serves the next request."""
+    model = gpt_models["tiny-scan"]
+    rng = np.random.RandomState(13)
+    p1 = rng.randint(0, 512, size=8)
+    p2 = rng.randint(0, 512, size=8)
+    ref1 = _ref_greedy(model, p1, 6)
+    # 4 allocatable pages at pt=4: p1 needs 2 + 1 mid-decode; p2's
+    # admission (2 pages) cannot fit alongside -> typed backpressure
+    eng = DecodeEngine(model, max_slots=2, max_new_tokens=8,
+                       page_tokens=4, num_pages=5, prefix_cache=False)
+    try:
+        s1 = eng.submit(p1, max_new_tokens=6)
+        import time
+        time.sleep(0.3)                  # p1 admits + starts stepping
+        s2 = eng.submit(p2, max_new_tokens=6)
+        with pytest.raises(TypedServeError) as ei:
+            s2.result(timeout=120)
+        assert ei.value.code == ERR_RESOURCE_EXHAUSTED
+        assert s1.result(timeout=120) == ref1     # survivor unharmed
+        # pool drained -> the next identical request now succeeds
+        assert eng.submit(p2,
+                          max_new_tokens=6).result(timeout=120) \
+            == _ref_greedy(model, p2, 6)
+    finally:
+        eng.stop()
+
+
+def test_chaos_page_alloc_mid_decode(gpt_models):
+    """Chaos site decode.page_alloc: an injected allocation fault as a
+    page boundary is crossed mid-decode kills ONLY the victim stream —
+    typed RESOURCE_EXHAUSTED, delivered AFTER it already streamed
+    tokens — and the engine serves the next request unharmed."""
+    from paddle_tpu.observability import REGISTRY
+    model = gpt_models["tiny-scan"]
+    rng = np.random.RandomState(41)
+    p1 = rng.randint(0, 512, size=8)     # exactly one page at pt=8
+    p2 = rng.randint(0, 512, size=5)
+    ref2 = _ref_greedy(model, p2, 4)
+    eng = DecodeEngine(model, max_slots=2, max_new_tokens=8,
+                       page_tokens=8, prefix_cache=False)
+    try:
+        # alloc call 1 is p1's admission (1 page); call 2 is the row-8
+        # page-boundary alloc inside the FIRST decode step — so the
+        # fault deterministically lands mid-decode, mid-stream
+        with chaos.inject("decode.page_alloc:2:RuntimeError") as inj:
+            s1 = eng.submit(p1, max_new_tokens=6)
+            with pytest.raises(TypedServeError) as ei:
+                s1.result(timeout=120)
+            assert ei.value.code == ERR_RESOURCE_EXHAUSTED
+            assert len(s1.tokens) >= 1   # died streaming, not at admit
+            assert inj.fired
+        # victim's pages are back; the engine keeps serving correctly
+        assert eng.stats()["pages"]["pages_used"] == 0
+        assert eng.submit(p2, max_new_tokens=4).result(timeout=120) == ref2
+        flat = REGISTRY.flat()
+        assert flat.get(
+            "paddle_tpu_decode_page_alloc_failures_total", 0) >= 1
+        assert flat.get(
+            'paddle_tpu_decode_cache_evictions_total{reason="exhausted"}',
+            0) >= 1
+    finally:
+        eng.stop()
+
+
+# ------------------------------------------------------ stats surface
+
+def test_stats_report_rungs_and_pages_before_first_admission(gpt_models):
+    """The pre-admission stats bug: batch_rung/kv_rung must report the
+    smallest formable rung (not 0), and the page-pool occupancy block
+    is present from construction."""
+    model = gpt_models["tiny-scan"]
+    eng = DecodeEngine(model, max_slots=4, max_new_tokens=4,
+                       page_tokens=8)
+    try:
+        st = eng.stats()
+        assert st["batch_rung"] >= 1            # was 0 before admission
+        assert st["kv_rung"] >= st["page_tokens"] == 8
+        assert st["pages"]["pages_total"] == 4 * (128 // 8)
+        assert st["pages"]["pages_used"] == 0
+        assert st["pages"]["fragmentation"] == 0.0
+        assert st["prefix_cache"]["cached_pages"] == 0
+        assert kv_page_bytes(model.cfg, 8) == \
+            model.cfg.layers * 2 * 8 * model.cfg.heads * \
+            model.cfg.head_dim * 4
+        # after traffic the rungs reflect the last dispatch
+        p = np.random.RandomState(3).randint(0, 512, size=5)
+        eng.submit(p, max_new_tokens=3).result(timeout=120)
+        st = eng.stats()
+        assert st["batch_rung"] >= 1 and st["kv_rung"] >= 8
+        assert st["pages"]["pages_used"] == 0   # prefix off: 5 < 8 page
+    finally:
+        eng.stop()
