@@ -30,8 +30,21 @@ from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 # Rule id owned by the core loader: files that fail to parse.
 PARSE_RULE = "TPL001"
 
+# Rule id owned by the baseline loader: grandfathered entries whose
+# justification was never filled in after --write-baseline.
+PLACEHOLDER_RULE = "TPL002"
+
+# What write_baseline stamps into fresh entries; TPL002 fires while the
+# literal text survives, so grandfathering stays a deliberate, explained
+# act instead of a silent debt sink.
+PLACEHOLDER_JUSTIFICATION = "TODO: explain why this finding is grandfathered"
+
 CORE_RULES = {
     PARSE_RULE: "source file failed to parse (checkers skipped for the file)",
+    PLACEHOLDER_RULE: (
+        "baseline entry still carries the write-baseline placeholder "
+        "justification"
+    ),
 }
 
 _SUPPRESS_RE = re.compile(r"tpulint:\s*disable=([A-Za-z0-9_,\s]+)")
@@ -171,6 +184,34 @@ class Baseline:
     def matches(self, finding: Finding) -> bool:
         return finding.fingerprint() in self._keys
 
+    def placeholder_findings(self, rel_path: str) -> List[Finding]:
+        """TPL002 findings for entries whose justification is still the
+        write-baseline placeholder.  These target the baseline file
+        itself (``rel_path``) and are emitted AFTER baseline matching —
+        a baseline can never grandfather its own missing justifications.
+        """
+        out: List[Finding] = []
+        for e in self.entries:
+            just = str(e.get("justification", "")).strip()
+            if PLACEHOLDER_JUSTIFICATION not in just:
+                continue
+            where = f"{e.get('rule', '?')} at {e.get('path', '?')}"
+            if e.get("symbol"):
+                where += f" [{e['symbol']}]"
+            out.append(
+                Finding(
+                    rule=PLACEHOLDER_RULE,
+                    path=rel_path,
+                    line=1,
+                    col=0,
+                    symbol=str(e.get("rule", "")),
+                    message=f"grandfathered {where}: replace the "
+                    f"placeholder justification with why this finding "
+                    f"is acceptable",
+                )
+            )
+        return out
+
     def __len__(self) -> int:
         return len(self.entries)
 
@@ -182,9 +223,12 @@ def write_baseline(path: Path, findings: Sequence[Finding]) -> None:
             "path": f.path,
             "symbol": f.symbol,
             "message": f.message,
-            "justification": "TODO: explain why this finding is grandfathered",
+            "justification": PLACEHOLDER_JUSTIFICATION,
         }
         for f in findings
+        # TPL002 points at the baseline file, not at source; writing it
+        # back would grandfather the act of not justifying grandfathers.
+        if f.rule != PLACEHOLDER_RULE
     ]
     path.write_text(json.dumps({"version": 1, "entries": entries}, indent=2) + "\n")
 

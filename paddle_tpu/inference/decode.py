@@ -40,6 +40,15 @@ are sampled (serve.py forwards them as incremental PDI2 frames), and a
 failed request gets a typed error while its batch-mates keep streaming.
 Chaos sites: `decode.stream` fires per token delivery,
 `decode.page_alloc` per page allocation.
+
+`SpecDecodeEngine` layers draft-and-verify speculative decoding on the
+same machinery: a small draft GPT runs k greedy steps per tick over its
+own page pool (same allocator, same block tables), the target scores
+all k+1 positions in one `gpt_paged_verify_fns` forward, and a
+rejection rolls back by truncating `cache_len` and releasing the
+stranded block-table tail (`PageAllocator.release_range`). Enabled via
+PADDLE_TPU_DECODE_SPECULATE / PADDLE_TPU_DECODE_DRAFT_MODEL or serve's
+--speculate-k/--draft-model; default off.
 """
 from __future__ import annotations
 
@@ -63,7 +72,9 @@ from ..core import monitor
 from ..jit.compile_cache import AotCache
 from ..memory.page_allocator import (PageAllocator, PageExhausted,
                                      copy_page, write_pages)
-from ..models.gpt import GPTConfig, gpt_paged_decode_fns
+from ..models.gpt import (GPTConfig, gpt_paged_decode_fns,
+                          gpt_paged_prefill_fns, gpt_paged_rollout_fns,
+                          gpt_paged_verify_fns)
 from ..observability import counter, gauge, histogram
 from ..observability.spans import SpanRecorder, next_request_id
 from ..testing import chaos
@@ -153,6 +164,23 @@ def _decode_metrics():
             "prefix_evictions": counter(
                 "paddle_tpu_decode_prefix_evictions_total",
                 "Prefix-cache entries LRU-evicted under pool pressure"),
+            # speculative decoding
+            "spec_draft_steps": counter(
+                "paddle_tpu_decode_spec_draft_steps_total",
+                "Batched draft-model decode steps executed"),
+            "spec_accepted": counter(
+                "paddle_tpu_decode_spec_accepted_tokens_total",
+                "Drafted tokens accepted by target verification"),
+            "spec_rejected": counter(
+                "paddle_tpu_decode_spec_rejected_tokens_total",
+                "Drafted tokens rejected by target verification"),
+            "spec_acceptance": gauge(
+                "paddle_tpu_decode_spec_acceptance_rate",
+                "Cumulative accepted/drafted token ratio (0..1)"),
+            "page_rollback_released": counter(
+                "paddle_tpu_decode_page_rollback_released_total",
+                "Page references released by speculative rollback "
+                "(pages stranded past the last accepted token)"),
         }
     return _METRICS
 
@@ -211,7 +239,10 @@ class DecodeStream:
         self.request_id = req_id
         self.prompt = list(prompt)
         self.tokens: List[int] = []      # generated so far (mirror)
+        self.spec_drafted = 0            # speculative-decode stats
+        self.spec_accepted = 0           # (stay 0 on the plain engine)
         self._q: queue.Queue = queue.Queue()
+        self._pending: deque = deque()   # consumer-side unbatch buffer
         self._closed = False             # producer-side latch
 
     # -- producer (engine thread) ------------------------------------
@@ -219,6 +250,17 @@ class DecodeStream:
         if not self._closed:
             self.tokens.append(int(tok))
             self._q.put(("token", int(tok), bool(eos)))
+
+    def _push_tokens(self, toks: List[int], eos: bool):
+        # One queue put for a whole burst of committed tokens (the
+        # speculative engine lands several per tick); `eos` applies to
+        # the final token only — commits stop at the first eos, so an
+        # earlier one can't occur. Consumers still see per-token
+        # events: `_unbatch` expands the burst on their side.
+        if not self._closed:
+            toks = [int(t) for t in toks]
+            self.tokens.extend(toks)
+            self._q.put(("tokens", toks, bool(eos)))
 
     def _push_done(self):
         if not self._closed:
@@ -231,7 +273,18 @@ class DecodeStream:
             self._q.put(("error", err))
 
     # -- consumer ----------------------------------------------------
+    def _unbatch(self, ev):
+        if ev[0] == "tokens":
+            toks, eos = ev[1], ev[2]
+            last = len(toks) - 1
+            for i, t in enumerate(toks):
+                self._pending.append(("token", t, eos and i == last))
+            return self._pending.popleft()
+        return ev
+
     def next_event(self, timeout: Optional[float] = None):
+        if self._pending:
+            return self._pending.popleft()
         try:
             ev = self._q.get(timeout=timeout)
         except queue.Empty:
@@ -241,7 +294,23 @@ class DecodeStream:
                 f"{timeout}s") from None
         if ev[0] == "error":
             raise ev[1]
-        return ev
+        return self._unbatch(ev)
+
+    def poll(self):
+        """Non-blocking `next_event`: the next pending event, or None
+        when the queue is momentarily empty. Raises the stream's typed
+        error like `next_event` if the stream died. Lets a single
+        collector sweep many streams without parking one blocked
+        thread per stream."""
+        if self._pending:
+            return self._pending.popleft()
+        try:
+            ev = self._q.get_nowait()
+        except queue.Empty:
+            return None
+        if ev[0] == "error":
+            raise ev[1]
+        return self._unbatch(ev)
 
     def events(self, timeout: Optional[float] = None):
         """Yield ("token", tok, eos) events until done; raises on error."""
@@ -285,6 +354,22 @@ class _Req:
         self.t_submit = time.monotonic()
         self.t_admit = 0.0
         self.prefill_s = 0.0
+
+
+class _SpecReq(_Req):
+    """_Req plus speculative-decode state: how far the draft pool has
+    been written, the slot's adaptive speculation depth, and acceptance
+    accounting for the adaptive-k policy."""
+    __slots__ = ("draft_len", "spec_k", "accept_ema", "drafted",
+                 "accepted")
+
+    def __init__(self, prompt, max_new, temperature, top_k, eos_id):
+        super().__init__(prompt, max_new, temperature, top_k, eos_id)
+        self.draft_len = 0       # draft-pool rows written (positions)
+        self.spec_k = 1          # per-slot adaptive k (set at admission)
+        self.accept_ema = 1.0    # EMA of per-tick acceptance rate
+        self.drafted = 0
+        self.accepted = 0
 
 
 class _PrefixCache:
@@ -383,6 +468,8 @@ class DecodeEngine:
     forward: fixed device page pool + per-slot block tables, prefix
     sharing with copy-on-write, typed backpressure on exhaustion."""
 
+    _req_cls = _Req       # SpecDecodeEngine swaps in _SpecReq
+
     def __init__(self, model=None, *, cfg: Optional[GPTConfig] = None,
                  params: Optional[Dict] = None, eps: Optional[float] = None,
                  max_slots: Optional[int] = None,
@@ -434,10 +521,17 @@ class DecodeEngine:
 
         prefill_fn, step_fn = gpt_paged_decode_fns(
             cfg, eps=self.eps, page_tokens=self.page_tokens)
+        # Pool args are donated: every call site rebinds the pools from
+        # the result, so XLA updates the multi-MB pool buffers in place
+        # instead of copying them per dispatch (the copy dominated
+        # step/verify cost on CPU).
         self._prefill_aot = AotCache(jax.jit(prefill_fn), "decode.prefill")
-        self._step_aot = AotCache(jax.jit(step_fn), "decode.pstep")
-        self._write_aot = AotCache(jax.jit(_write_kv_pages), "decode.pwrite")
-        self._copy_aot = AotCache(jax.jit(_copy_kv_page), "decode.pcow")
+        self._step_aot = AotCache(jax.jit(step_fn, donate_argnums=(1, 2)),
+                                  "decode.pstep")
+        self._write_aot = AotCache(
+            jax.jit(_write_kv_pages, donate_argnums=(0, 1)), "decode.pwrite")
+        self._copy_aot = AotCache(
+            jax.jit(_copy_kv_page, donate_argnums=(0, 1)), "decode.pcow")
 
         self._m = _decode_metrics()
         self._spans = SpanRecorder(
@@ -476,10 +570,10 @@ class DecodeEngine:
                 ERR_INVALID_ARGUMENT,
                 f"prompt length {len(toks)} leaves no room to generate "
                 f"(max_seq_len={self.cfg.max_seq_len})")
-        req = _Req(toks,
-                   int(max_new_tokens or self.max_new_tokens),
-                   float(temperature), int(top_k),
-                   self.eos_id if eos_id is None else int(eos_id))
+        req = self._req_cls(toks,
+                            int(max_new_tokens or self.max_new_tokens),
+                            float(temperature), int(top_k),
+                            self.eos_id if eos_id is None else int(eos_id))
         with self._cond:
             if self._stop:
                 raise TypedServeError(ERR_UNAVAILABLE,
@@ -896,9 +990,10 @@ class DecodeEngine:
         }, extra={"tokens": len(req.generated),
                   "prompt_len": len(req.prompt)})
 
-    def _sample(self, row: np.ndarray, req: _Req) -> int:
-        if req.temperature <= 0.0:
-            return int(np.argmax(row))
+    def _dist(self, row: np.ndarray, req: _Req) -> np.ndarray:
+        """The request's sampling distribution over the vocab (its
+        temperature/top-k transform of one logit row) — shared by
+        `_sample` and speculative rejection sampling."""
         logits = row.astype(np.float64) / max(req.temperature, 1e-6)
         if 0 < req.top_k < logits.shape[0]:
             kth = np.partition(logits, -req.top_k)[-req.top_k]
@@ -906,7 +1001,13 @@ class DecodeEngine:
         logits -= logits.max()
         p = np.exp(logits)
         p /= p.sum()
-        return int(self._rng.choice(logits.shape[0], p=p))
+        return p
+
+    def _sample(self, row: np.ndarray, req: _Req) -> int:
+        if req.temperature <= 0.0:
+            return int(np.argmax(row))
+        p = self._dist(row, req)
+        return int(self._rng.choice(p.shape[0], p=p))
 
     def _update_gauges(self):
         n = len(self._active)
@@ -920,6 +1021,484 @@ class DecodeEngine:
         if self._prefix is not None:
             self._m["prefix_cached_pages"].set(
                 self._prefix.stats()["cached_pages"])
+
+
+# ------------------------------------------------- speculative decoding
+
+def spec_k_ladder(k_max: int) -> List[int]:
+    """Powers of two from 1 up to — and including — `k_max`: the
+    adaptive speculation-depth rungs. Every rung's verify width (k+1)
+    is AOT-warmed, so per-slot k moves along the ladder without a
+    steady-state compile."""
+    k_max = int(k_max)
+    if k_max <= 1:
+        return [1]
+    vals, v = [], 1
+    while v < k_max:
+        vals.append(v)
+        v *= 2
+    vals.append(k_max)
+    return sorted(set(vals))
+
+
+class SpecDecodeEngine(DecodeEngine):
+    """Draft-and-verify speculative decoding over the paged KV pool.
+
+    A small draft GPT (same vocab) runs up to k greedy steps per
+    scheduler tick over its OWN page pool — same shape discipline, same
+    `PageAllocator`, same per-slot block tables, so one page id names
+    one target page AND one draft page. The target then scores all
+    drafted positions in a single `gpt_paged_verify_fns` forward (which
+    also writes their target K/V rows); acceptance is standard
+    rejection sampling against the target distribution (argmax equality
+    at temperature 0, so speculative greedy output is token-for-token
+    the plain engine's). A rejection is pure host bookkeeping: truncate
+    `cache_len`, drop the block-table tail through
+    `PageAllocator.release_range` (stale rows inside kept pages are
+    masked by `lengths` and overwritten next tick — no contiguous-rung
+    copy to unwind, which is what makes speculation cheap on pages).
+
+    Everything else — admission, prefix sharing, eviction, streaming,
+    typed backpressure — is inherited. Copy-on-write copies BOTH pools
+    so divergent continuations stay isolated in draft space too, and
+    `warmup()` extends the AOT surface with draft-prefill, draft-step,
+    draft-write/COW and the (batch-rung x page-rung x k-rung) verify
+    cross product, keeping the zero-steady-state-compile invariant
+    across churn including rejections and rollbacks.
+
+    Per-slot adaptive k: each slot starts at `speculate_k` and walks a
+    power-of-two ladder by an EMA of its acceptance rate — repetitive
+    continuations earn deep speculation, adversarial streams degrade
+    toward plain decode instead of burning draft steps.
+    """
+
+    _req_cls = _SpecReq
+
+    def __init__(self, model=None, *, draft_model=None,
+                 draft_cfg: Optional[GPTConfig] = None,
+                 draft_params: Optional[Dict] = None,
+                 draft_eps: Optional[float] = None,
+                 speculate_k: Optional[int] = None, **kw):
+        if draft_model is not None:
+            from .. import framework
+            draft_cfg = draft_model.cfg
+            draft_params = framework.param_arrays(draft_model)
+            draft_eps = draft_model.ln_f._epsilon \
+                if draft_eps is None else draft_eps
+        if draft_cfg is None or draft_params is None:
+            raise ValueError(
+                "SpecDecodeEngine needs a draft model or "
+                "(draft_cfg, draft_params)")
+        k = int(speculate_k) if speculate_k is not None \
+            else int(_flags.env_value("PADDLE_TPU_DECODE_SPECULATE"))
+        if k < 1:
+            raise ValueError(f"speculate_k must be >= 1, got {k}")
+        # validate against the target BEFORE the scheduler thread starts
+        tcfg = model.cfg if model is not None else kw.get("cfg")
+        if tcfg is not None:
+            if draft_cfg.vocab_size != tcfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab {draft_cfg.vocab_size} != target "
+                    f"vocab {tcfg.vocab_size}")
+            if draft_cfg.max_seq_len < tcfg.max_seq_len:
+                raise ValueError(
+                    f"draft max_seq_len {draft_cfg.max_seq_len} < target "
+                    f"max_seq_len {tcfg.max_seq_len}")
+        super().__init__(model, **kw)
+        self.draft_cfg = draft_cfg
+        self.draft_eps = 1e-5 if draft_eps is None else float(draft_eps)
+        self._draft_params = {n: jnp.asarray(v)
+                              for n, v in draft_params.items()}
+        self.k_ladder = spec_k_ladder(k)
+        dprefill = gpt_paged_prefill_fns(
+            draft_cfg, eps=self.draft_eps, page_tokens=self.page_tokens)
+        rollout = gpt_paged_rollout_fns(
+            draft_cfg, eps=self.draft_eps, page_tokens=self.page_tokens)
+        verify = gpt_paged_verify_fns(
+            self.cfg, eps=self.eps, page_tokens=self.page_tokens)
+        # Draft/target pools donated for the same in-place-update
+        # reason as the base engine's executables.
+        self._dprefill_aot = AotCache(
+            jax.jit(dprefill, donate_argnums=(1, 2)), "decode.dprefill")
+        self._droll_aot = AotCache(
+            jax.jit(rollout, donate_argnums=(1, 2)), "decode.droll")
+        self._dcopy_aot = AotCache(
+            jax.jit(_copy_kv_page, donate_argnums=(0, 1)), "decode.dcow")
+        self._verify_aot = AotCache(
+            jax.jit(verify, donate_argnums=(1, 2)), "decode.verify")
+        self._dkpool = None          # draft pools, lazy like the target's
+        self._dvpool = None
+        self._drafted_total = 0
+        self._accepted_total = 0
+
+    # ----------------------------------------------------- pool plumbing
+
+    def _dpool_sds(self):
+        c = self.draft_cfg
+        return jax.ShapeDtypeStruct(
+            (c.layers, self.num_pages, self.page_tokens, c.heads,
+             c.head_dim), jnp.float32)
+
+    def _ensure_pool(self):
+        super()._ensure_pool()
+        if self._dkpool is None:
+            self._dkpool = jnp.zeros(self._dpool_sds().shape, jnp.float32)
+            self._dvpool = jnp.zeros_like(self._dkpool)
+
+    def _cow(self, req: _Req, slot: int):
+        """Copy-on-write for speculation copies the page in BOTH pools —
+        one page id names a target page and a draft page."""
+        old = req.pages[slot]
+        (new,) = self._alloc_pages(1, req)
+        i32 = jnp.int32
+        exe = self._copy_aot.get_or_compile(
+            self._kpool, self._vpool,
+            jax.ShapeDtypeStruct((), i32), jax.ShapeDtypeStruct((), i32),
+            key=("pcow",))
+        self._kpool, self._vpool = exe(
+            self._kpool, self._vpool,
+            jnp.asarray(old, i32), jnp.asarray(new, i32))
+        dexe = self._dcopy_aot.get_or_compile(
+            self._dkpool, self._dvpool,
+            jax.ShapeDtypeStruct((), i32), jax.ShapeDtypeStruct((), i32),
+            key=("dcow",))
+        self._dkpool, self._dvpool = dexe(
+            self._dkpool, self._dvpool,
+            jnp.asarray(old, i32), jnp.asarray(new, i32))
+        req.pages[slot] = new
+        self._alloc.release(old)
+        self._m["cow"].inc()
+
+    # ---------------------------------------------------------- warmup
+
+    def warmup(self, verbose: bool = False) -> int:
+        """Base warmup plus the draft/verify surface: fused draft
+        prefill-into-pages per prompt rung, draft COW, the fused draft
+        rollout (batch-rung x page-rung x k-rung) grid and the verify
+        (batch-rung x page-rung x k-rung) cross product — each grid
+        capped like the base step's."""
+        before = len(profiler.compile_events())
+        super().warmup(verbose=False)
+        i32 = jnp.int32
+        pool, dpool = self._pool_sds(), self._dpool_sds()
+        pt = self.page_tokens
+        for r in self.kv_ladder:
+            self._dprefill_aot.get_or_compile(
+                self._draft_params, dpool, dpool,
+                jax.ShapeDtypeStruct((1, r), i32),
+                jax.ShapeDtypeStruct((1, -(-r // pt)), i32),
+                jax.ShapeDtypeStruct((1,), i32),
+                key=("dprefill", 1, r))
+        self._dcopy_aot.get_or_compile(
+            dpool, dpool,
+            jax.ShapeDtypeStruct((), i32), jax.ShapeDtypeStruct((), i32),
+            key=("dcow",))
+        # When the full (batch x page x k) cross product overflows the
+        # warmup cap, shrink the k ladder itself — dropping middle rungs,
+        # keeping k=1 and k_max — instead of silently truncating tail
+        # signatures. Adaptive k then only walks warmed rungs, so the
+        # no-steady-state-compiles invariant survives large k_max.
+        grid = len(self.batch_ladder) * len(self.page_ladder)
+        while len(self.k_ladder) > 1 \
+                and grid * len(self.k_ladder) > _WARMUP_SIG_CAP:
+            self.k_ladder.pop(len(self.k_ladder) // 2)
+        sigs = [(b, w, kk) for b in self.batch_ladder
+                for w in self.page_ladder for kk in self.k_ladder]
+        if len(sigs) > _WARMUP_SIG_CAP:
+            sigs = sigs[:_WARMUP_SIG_CAP]
+        for b, w, kk in sigs:
+            self._droll_aot.get_or_compile(
+                self._draft_params, dpool, dpool,
+                jax.ShapeDtypeStruct((b, w), i32),
+                jax.ShapeDtypeStruct((b, kk), i32),
+                jax.ShapeDtypeStruct((b,), i32),
+                key=("droll", b, w, kk))
+        vsigs = [(b, w, kk + 1) for b in self.batch_ladder
+                 for w in self.page_ladder for kk in self.k_ladder]
+        if len(vsigs) > _WARMUP_SIG_CAP:
+            vsigs = vsigs[:_WARMUP_SIG_CAP]
+        for b, w, k1 in vsigs:
+            self._verify_aot.get_or_compile(
+                self.params, pool, pool,
+                jax.ShapeDtypeStruct((b, w), i32),
+                jax.ShapeDtypeStruct((b, k1), i32),
+                jax.ShapeDtypeStruct((b,), i32),
+                key=("verify", b, w, k1))
+        n = len(profiler.compile_events()) - before
+        if verbose:
+            print(f"SPEC DECODE WARMUP compiles={n} "
+                  f"k_ladder={self.k_ladder} "
+                  f"rollout_sigs={len(sigs)} verify_sigs={len(vsigs)}",
+                  flush=True)
+        return n
+
+    # ------------------------------------------------------- admission
+
+    def _admit(self, req: _Req) -> bool:
+        req.spec_k = self.k_ladder[-1]      # start optimistic, adapt down
+        if not super()._admit(req):
+            return False
+        if not req.feeding:
+            # prefill miss: the target panel is in the pages; mirror the
+            # prompt into the draft pool so drafting starts warm
+            self._draft_prefill(req)
+        # prefix hit: the mapped pages already carry the draft rows the
+        # original (speculative) prefill wrote — nothing to do
+        req.draft_len = req.cache_len
+        return True
+
+    def _draft_prefill(self, req: _Req):
+        """One fused B=1 draft prefill-into-pages dispatch at the prompt
+        rung, scattered into the SAME page ids the target panel landed
+        in. These writes deliberately skip the COW check: the rows hold
+        the committed prompt's K/V — the one thing every mapper of a
+        shared prefix page agrees on."""
+        plen = len(req.prompt)
+        pt = self.page_tokens
+        rung = next_bucket(plen, self.kv_ladder)
+        toks = np.zeros((1, rung), np.int32)
+        toks[0, :plen] = req.prompt
+        w = -(-rung // pt)
+        tables = np.zeros((1, w), np.int32)
+        tables[0, :len(req.pages)] = req.pages
+        exe = self._dprefill_aot.get_or_compile(
+            self._draft_params, self._dkpool, self._dvpool,
+            jax.ShapeDtypeStruct((1, rung), jnp.int32),
+            jax.ShapeDtypeStruct((1, w), jnp.int32),
+            jax.ShapeDtypeStruct((1,), jnp.int32),
+            key=("dprefill", 1, rung))
+        _, self._dkpool, self._dvpool = exe(
+            self._draft_params, self._dkpool, self._dvpool,
+            jnp.asarray(toks), jnp.asarray(tables),
+            jnp.asarray([plen], np.int32))
+
+    # ------------------------------------------------------------ tick
+
+    def _step_once(self):
+        pt = self.page_tokens
+        cap = self.cfg.max_seq_len
+        tick_k = max(r.spec_k for r in self._active)
+        K1 = tick_k + 1
+        # 1. provision every page this tick can write: draft rows
+        # [draft_len, draft_len+k) and verify rows [cache_len,
+        # cache_len+k]; COW any shared page in that window (both pools)
+        victims = []
+        for req in self._active:
+            lo = min(req.cache_len, req.draft_len) // pt
+            hi_row = min(max(req.cache_len + tick_k,
+                             req.draft_len + tick_k - 1), cap - 1)
+            need = hi_row // pt + 1
+            try:
+                if need > len(req.pages):
+                    req.pages.extend(
+                        self._alloc_pages(need - len(req.pages), req))
+                for s in range(lo, need):
+                    if self._alloc.refcount(req.pages[s]) > 1:
+                        self._cow(req, s)
+            except TypedServeError as err:
+                req.stream._push_error(err)
+                self._m["evictions"].labels(reason="exhausted").inc()
+                self._release_pages(req)
+                victims.append(req)
+        if victims:
+            self._active = [r for r in self._active if r not in victims]
+            self._update_gauges()
+        reqs = self._active
+        if not reqs:
+            return
+        b_rung = next_bucket(len(reqs), self.batch_ladder)
+        w_rung = next_bucket(max(len(r.pages) for r in reqs),
+                             self.page_ladder)
+        tables = np.zeros((b_rung, w_rung), np.int32)   # pad -> null page
+        for j, req in enumerate(reqs):
+            tables[j, :len(req.pages)] = req.pages
+        tables_j = jnp.asarray(tables)
+        # 2. draft phase: tick_k greedy draft steps fused into ONE
+        # rollout dispatch. Step i consumes one token per slot — a
+        # committed token the draft has not seen yet (catch-up, passed
+        # via `forced`; its output is discarded) or the slot's own
+        # previous draft (forced = -1: the rollout chains its argmax).
+        seqs = [req.prompt + req.generated for req in reqs]
+        forced = np.zeros((b_rung, tick_k), np.int32)
+        forced[len(reqs):] = 0              # padded rows: null-page writes
+        dlen = np.zeros(b_rung, np.int32)
+        for j, req in enumerate(reqs):
+            dl, seq = req.draft_len, seqs[j]
+            dlen[j] = dl
+            for i in range(tick_k):
+                forced[j, i] = seq[dl + i] if dl + i < len(seq) else -1
+        dexe = self._droll_aot.get_or_compile(
+            self._draft_params, self._dkpool, self._dvpool,
+            jax.ShapeDtypeStruct((b_rung, w_rung), jnp.int32),
+            jax.ShapeDtypeStruct((b_rung, tick_k), jnp.int32),
+            jax.ShapeDtypeStruct((b_rung,), jnp.int32),
+            key=("droll", b_rung, w_rung, tick_k))
+        dout, self._dkpool, self._dvpool = dexe(
+            self._draft_params, self._dkpool, self._dvpool,
+            tables_j, jnp.asarray(forced), jnp.asarray(dlen))
+        dnp = np.asarray(dout)
+        self._m["spec_draft_steps"].inc(tick_k)
+        chains: List[List[int]] = [[] for _ in reqs]
+        for j, req in enumerate(reqs):
+            for i in range(tick_k):
+                if req.draft_len >= len(seqs[j]) - 1:
+                    chains[j].append(int(dnp[j, i]))
+                req.draft_len += 1
+        # 3. verify: one multi-token target forward scores (and writes
+        # the K/V of) up to K1 positions per slot — the un-consumed
+        # committed tokens first, then this tick's drafts
+        vtoks = np.zeros((b_rung, K1), np.int32)
+        clen = np.zeros(b_rung, np.int32)
+        meta = []
+        for j, req in enumerate(reqs):
+            known = seqs[j][req.cache_len:]
+            n_known = min(len(known), K1, cap - req.cache_len)
+            nd = min(len(chains[j]), req.spec_k, K1 - n_known)
+            row = known[:n_known] + chains[j][:nd]
+            vtoks[j, :len(row)] = row
+            vtoks[j, len(row):] = row[-1]   # padding rows roll back
+            clen[j] = req.cache_len
+            meta.append((n_known, nd))
+        vexe = self._verify_aot.get_or_compile(
+            self.params, self._kpool, self._vpool,
+            jax.ShapeDtypeStruct((b_rung, w_rung), jnp.int32),
+            jax.ShapeDtypeStruct((b_rung, K1), jnp.int32),
+            jax.ShapeDtypeStruct((b_rung,), jnp.int32),
+            key=("verify", b_rung, w_rung, K1))
+        t0 = time.perf_counter()
+        logits, amax, self._kpool, self._vpool = vexe(
+            self.params, self._kpool, self._vpool,
+            tables_j, jnp.asarray(vtoks), jnp.asarray(clen))
+        amaxnp = np.asarray(amax)
+        lognp = None   # full logits only cross to host when sampling
+        self._m["step_latency"].observe(time.perf_counter() - t0)
+        self._last_b_rung, self._last_w_rung = b_rung, w_rung
+        self._steps += 1
+        self._m["steps"].inc()
+        # 4. acceptance + rollback, per slot on the host
+        finished = []
+        for j, req in enumerate(reqs):
+            n_known, nd = meta[j]
+            drafts = chains[j][:nd]
+            seq_len_old = len(seqs[j])
+            if req.feeding and req.cache_len + n_known >= len(req.prompt):
+                # the verify just consumed the last prompt-tail token:
+                # the pages now hold the whole prompt
+                req.feeding = False
+                req.input_tail.clear()
+                if self._prefix is not None:
+                    self._prefix.insert(
+                        req.prompt, req.pages[:len(req.prompt) // pt])
+            emitted, a, i = [], 0, n_known - 1
+            while True:
+                accept = False
+                if req.temperature > 0.0 and lognp is None:
+                    lognp = np.asarray(logits)
+                if a < nd:
+                    d = drafts[a]
+                    if req.temperature <= 0.0:
+                        tok = int(amaxnp[j, i])
+                        accept = tok == d
+                    else:
+                        p = self._dist(lognp[j, i], req)
+                        if self._rng.random() < p[d]:
+                            accept, tok = True, d
+                        else:
+                            q = p.copy()
+                            q[d] = 0.0
+                            s = q.sum()
+                            if s <= 0.0:        # p was a point mass on d
+                                accept, tok = True, d
+                            else:
+                                tok = int(self._rng.choice(
+                                    q.shape[0], p=q / s))
+                elif req.temperature <= 0.0:
+                    tok = int(amaxnp[j, i])
+                else:
+                    tok = self._sample(lognp[j, i], req)
+                emitted.append(tok)
+                if accept:
+                    a += 1
+                    i += 1
+                hit_eos = req.eos_id is not None and tok == req.eos_id
+                if (not accept) or hit_eos \
+                        or len(req.generated) + len(emitted) >= req.max_new \
+                        or req.cache_len + n_known + a >= cap:
+                    break
+            new_c = req.cache_len + n_known + a
+            # rollback: keep pages covering the committed rows and the
+            # still-valid draft rows, release the stranded tail
+            dl_valid = min(req.draft_len, seq_len_old + a)
+            req.draft_len = dl_valid
+            keep = -(-max(new_c, dl_valid) // pt)
+            if keep < len(req.pages):
+                released = self._alloc.release_range(req.pages, keep)
+                del req.pages[keep:]
+                if released:
+                    self._m["page_rollback_released"].inc(released)
+            req.cache_len = new_c
+            req.last_tok = emitted[-1]
+            # acceptance accounting + adaptive k
+            req.drafted += nd
+            req.accepted += a
+            req.stream.spec_drafted = req.drafted
+            req.stream.spec_accepted = req.accepted
+            self._drafted_total += nd
+            self._accepted_total += a
+            if nd:
+                self._m["spec_accepted"].inc(a)
+                self._m["spec_rejected"].inc(nd - a)
+                req.accept_ema = 0.5 * req.accept_ema + 0.5 * (a / nd)
+                ki = self.k_ladder.index(req.spec_k)
+                if req.accept_ema < 0.35 and ki > 0:
+                    req.spec_k = self.k_ladder[ki - 1]
+                elif req.accept_ema > 0.8 and ki < len(self.k_ladder) - 1:
+                    req.spec_k = self.k_ladder[ki + 1]
+            if self._drafted_total:
+                self._m["spec_acceptance"].set(
+                    self._accepted_total / self._drafted_total)
+            # stream the newly committed tokens
+            first = not req.generated
+            try:
+                chaos.maybe_fail("decode.stream", detail=req.id)
+            except Exception as exc:
+                req.stream._push_error(TypedServeError(
+                    ERR_UNAVAILABLE, f"decode stream killed: {exc}"))
+                self._m["evictions"].labels(reason="error").inc()
+                self._release_pages(req)
+                finished.append(req)
+                continue
+            req.generated.extend(emitted)
+            self._tokens += len(emitted)
+            self._m["tokens"].inc(len(emitted))
+            req.stream._push_tokens(
+                emitted,
+                req.eos_id is not None and emitted[-1] == req.eos_id)
+            if first:
+                self._m["ttft"].observe(time.monotonic() - req.t_submit)
+            done_eos = req.eos_id is not None \
+                and emitted[-1] == req.eos_id
+            if done_eos or len(req.generated) >= req.max_new \
+                    or req.cache_len >= cap:
+                self._finish(req, "eos" if done_eos else "length")
+                self._release_pages(req)
+                finished.append(req)
+        if finished:
+            self._active = [r for r in reqs if r not in finished]
+            self._update_gauges()
+
+    def stats(self) -> Dict:
+        st = super().stats()
+        drafted, accepted = self._drafted_total, self._accepted_total
+        st["speculate"] = {
+            "k_max": self.k_ladder[-1],
+            "k_ladder": list(self.k_ladder),
+            "drafted": drafted,
+            "accepted": accepted,
+            "acceptance_rate": round(accepted / drafted, 4)
+            if drafted else 0.0,
+        }
+        return st
 
 
 # ------------------------------------------------------------ artifact
@@ -938,8 +1517,7 @@ def save_for_decode(model, prefix: str):
     np.savez(prefix + ".decode.npz", **params)
 
 
-def load_for_decode(prefix: str, **engine_kw) -> DecodeEngine:
-    """Load a `save_for_decode` artifact into a ready DecodeEngine."""
+def _load_decode_artifact(prefix: str):
     with open(prefix + ".decode.json") as f:
         meta = json.load(f)
     if meta.get("format") != "paddle_tpu.decode.v1":
@@ -947,5 +1525,29 @@ def load_for_decode(prefix: str, **engine_kw) -> DecodeEngine:
     cfg = GPTConfig(**meta["config"])
     with np.load(prefix + ".decode.npz") as z:
         params = {k: z[k] for k in z.files}
-    return DecodeEngine(cfg=cfg, params=params, eps=meta.get("eps"),
-                        **engine_kw)
+    return cfg, params, meta.get("eps")
+
+
+def load_for_decode(prefix: str, draft_prefix: Optional[str] = None,
+                    speculate_k: Optional[int] = None,
+                    **engine_kw) -> DecodeEngine:
+    """Load a `save_for_decode` artifact into a ready DecodeEngine.
+
+    With a draft artifact (`draft_prefix`, or
+    PADDLE_TPU_DECODE_DRAFT_MODEL) and a speculation depth
+    (`speculate_k`, or PADDLE_TPU_DECODE_SPECULATE >= 1) the result is
+    a `SpecDecodeEngine`; otherwise the plain engine — speculation is
+    strictly opt-in."""
+    cfg, params, eps = _load_decode_artifact(prefix)
+    if draft_prefix is None:
+        draft_prefix = _flags.env_value(
+            "PADDLE_TPU_DECODE_DRAFT_MODEL") or None
+    if speculate_k is None:
+        speculate_k = int(_flags.env_value("PADDLE_TPU_DECODE_SPECULATE"))
+    if draft_prefix and int(speculate_k) >= 1:
+        dcfg, dparams, deps = _load_decode_artifact(draft_prefix)
+        return SpecDecodeEngine(cfg=cfg, params=params, eps=eps,
+                                draft_cfg=dcfg, draft_params=dparams,
+                                draft_eps=deps,
+                                speculate_k=int(speculate_k), **engine_kw)
+    return DecodeEngine(cfg=cfg, params=params, eps=eps, **engine_kw)

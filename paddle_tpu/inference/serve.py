@@ -313,7 +313,8 @@ class InferenceServer:
                  stats_interval: float = 0.0, request_timeout: float = None,
                  trailing: str = None, metrics_port: int = None,
                  max_queue: int = None, decode: bool = False,
-                 decode_slots: int = None, decode_max_new: int = None):
+                 decode_slots: int = None, decode_max_new: int = None,
+                 draft_model: str = None, speculate_k: int = None):
         # loopback by default: the daemon is unauthenticated — exposing a
         # model to the network segment must be an explicit --host choice
         if max_batch_size is None:
@@ -334,6 +335,10 @@ class InferenceServer:
                 kw["max_slots"] = int(decode_slots)
             if decode_max_new:
                 kw["max_new_tokens"] = int(decode_max_new)
+            if draft_model:
+                kw["draft_prefix"] = draft_model
+            if speculate_k is not None:
+                kw["speculate_k"] = int(speculate_k)
             self._engine = load_for_decode(model_prefix, **kw)
             self._predictor = None
             if warmup:
@@ -837,6 +842,16 @@ def main(argv=None):
     ap.add_argument("--decode-max-new", type=int, default=None,
                     help="(decode) default max new tokens per request "
                          "when the client does not specify one")
+    ap.add_argument("--draft-model", default=None, metavar="PREFIX",
+                    help="(decode) draft-model save_for_decode artifact "
+                         "prefix enabling speculative decoding; must "
+                         "share the target's vocab (default "
+                         "PADDLE_TPU_DECODE_DRAFT_MODEL)")
+    ap.add_argument("--speculate-k", type=int, default=None,
+                    help="(decode) speculation depth: draft steps per "
+                         "scheduler tick, verified in one k+1-token "
+                         "target forward (default "
+                         "PADDLE_TPU_DECODE_SPECULATE; 0 disables)")
     ap.add_argument("--router", action="store_true",
                     help="run the health-aware front router instead of a "
                          "backend: load-balance the wire protocol across "
@@ -883,7 +898,9 @@ def main(argv=None):
                           metrics_port=args.metrics_port,
                           max_queue=args.max_queue, decode=args.decode,
                           decode_slots=args.decode_slots,
-                          decode_max_new=args.decode_max_new)
+                          decode_max_new=args.decode_max_new,
+                          draft_model=args.draft_model,
+                          speculate_k=args.speculate_k)
     if args.warmup:
         print(f"WARMUP compiles={srv.warmup_compiles}", flush=True)
     if srv.metrics_port is not None:

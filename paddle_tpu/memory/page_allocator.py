@@ -102,6 +102,27 @@ class PageAllocator:
             self._free.append(page)
             return 0
 
+    def release_range(self, ids, from_idx: int) -> int:
+        """Drop one reference on every page in ``ids[from_idx:]`` under a
+        single lock acquisition — the speculative-decode rollback path,
+        which strands a tail of a block table past the last accepted
+        token. Returns the number of references dropped. Any unallocated
+        id raises ValueError before *any* refcount changes, so a bad
+        call never half-applies."""
+        tail = [int(p) for p in list(ids)[max(int(from_idx), 0):]]
+        with self._lock:
+            for p in tail:
+                if p not in self._refs:
+                    raise ValueError(f"release of unallocated page {p}")
+            for p in tail:
+                refs = self._refs[p]
+                if refs > 1:
+                    self._refs[p] = refs - 1
+                else:
+                    del self._refs[p]
+                    self._free.append(p)
+        return len(tail)
+
     def refcount(self, page: int) -> int:
         with self._lock:
             return self._refs.get(page, 0)

@@ -1134,3 +1134,246 @@ def gpt_paged_decode_fns(cfg: GPTConfig, eps: float = 1e-5,
 
     prefill, _ = gpt_decode_fns(cfg, eps=eps)
     return prefill, paged_step
+
+
+def gpt_paged_verify_fns(cfg: GPTConfig, eps: float = 1e-5,
+                         page_tokens: int = 16):
+    """Pure multi-token verify step over a PAGED KV cache — the target
+    side of speculative decoding.
+
+    paged_verify(params,
+                 k_pool, v_pool [layers, P, page_tokens, heads, head_dim],
+                 tables    [B, W]  int32 (unused entries -> null page 0),
+                 toks      [B, K1] int32 (token at position cache_len+i),
+                 cache_len [B]     int32)
+        -> (logits [B, K1, V], k_pool, v_pool)
+
+    Row i of `toks` is the token at absolute position `cache_len + i`;
+    its K/V lands at page tables[b, pos//pt], row pos%pt — the exact
+    addressing `paged_step` uses, via one [B, K1] advanced-index scatter
+    per layer. `logits[b, i]` is the target's next-token distribution
+    AFTER consuming toks[b, :i+1], so one call scores every drafted
+    position at once. Attention gathers the block table like the XLA
+    reference kernel and masks per query: position p attends keys
+    0..p, which includes the rows this very call just wrote (drafted
+    tokens see their drafted predecessors). Positions at or past
+    max_seq_len redirect their writes to the null page, so padded
+    verify rows near the sequence cap never clobber live data. The math
+    (f32 scores, -1e30 mask, exact gelu) mirrors `gpt_decode_fns` so a
+    verified-and-accepted token stream is argmax-identical to plain
+    incremental decode.
+    """
+    if cfg.moe_experts > 0:
+        raise NotImplementedError(
+            "gpt_paged_verify_fns: MoE blocks have no KV-decode path yet")
+    D = cfg.head_dim
+    nh = cfg.heads
+    pt = int(page_tokens)
+    scale = 1.0 / math.sqrt(D)
+
+    def _ffn(bp, x):
+        h2 = _pp_ln(x, bp["ln2.weight"], bp["ln2.bias"], eps)
+        m = jax.nn.gelu(h2 @ bp["fc1.weight"] + bp["fc1.bias"],
+                        approximate=False)
+        return x + m @ bp["fc2.weight"] + bp["fc2.bias"]
+
+    def paged_verify(params, k_pool, v_pool, tables, toks, cache_len):
+        embed, blocks, head = split_decode_params(params, cfg)
+        B, K1 = toks.shape
+        W = tables.shape[1]
+        pos = cache_len.astype(jnp.int32)[:, None] \
+            + jnp.arange(K1, dtype=jnp.int32)[None]          # [B, K1]
+        valid = pos < cfg.max_seq_len
+        pos_c = jnp.minimum(pos, cfg.max_seq_len - 1)
+        x = embed["wte.weight"][toks] + embed["wpe.weight"][pos_c]
+        slot = jnp.minimum(pos_c // pt, W - 1)
+        page_idx = jnp.take_along_axis(tables, slot, axis=1)  # [B, K1]
+        page_idx = jnp.where(valid, page_idx, 0)  # overruns -> null page
+        offset = pos_c % pt
+        kcap = W * pt
+        # Attention is split prefix/window so the pool gather hoists out
+        # of the layer loop: the committed prefix (rows < cache_len) is
+        # gathered ONCE for all layers, while the K1 in-flight tokens
+        # attend each other directly from this dispatch's fresh K/V
+        # under an in-window causal triangle. Score layout per query is
+        # [prefix rows | window rows]; one softmax over the concat keeps
+        # the math identical to the single-gather formulation.
+        keys_all = jnp.take(k_pool, tables, axis=1) \
+            .reshape(len(blocks), B, kcap, nh, D)
+        vals_all = jnp.take(v_pool, tables, axis=1) \
+            .reshape(len(blocks), B, kcap, nh, D)
+        prefix_live = jnp.arange(kcap, dtype=jnp.int32)[None, :] \
+            < cache_len.astype(jnp.int32)[:, None]            # [B, kcap]
+        prefix_live = prefix_live[:, None, None, :]           # [B,1,1,kcap]
+        win = jnp.arange(K1, dtype=jnp.int32)
+        win_causal = (win[None, :] <= win[:, None])[None, None]  # [1,1,K1,K1]
+        k_news, v_news = [], []
+        for i, bp in enumerate(blocks):
+            h1 = _pp_ln(x, bp["ln1.weight"], bp["ln1.bias"], eps)
+            qkv = h1 @ bp["attn.qkv.weight"] + bp["attn.qkv.bias"]
+            q, k_new, v_new = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(B, K1, nh, D)
+            k_new = k_new.reshape(B, K1, nh, D)
+            v_new = v_new.reshape(B, K1, nh, D)
+            k_news.append(k_new)
+            v_news.append(v_new)
+            sp = jnp.einsum("bqhd,bkhd->bhqk", q, keys_all[i]) * scale
+            sp = jnp.where(prefix_live, sp.astype(jnp.float32), -1e30)
+            sw = jnp.einsum("bqhd,bkhd->bhqk", q, k_new) * scale
+            sw = jnp.where(win_causal, sw.astype(jnp.float32), -1e30)
+            s = jnp.concatenate([sp, sw], axis=-1)
+            p = jax.nn.softmax(s, axis=-1).astype(x.dtype)
+            o = jnp.einsum("bhqk,bkhd->bqhd", p[..., :kcap], vals_all[i]) \
+                + jnp.einsum("bhqk,bkhd->bqhd", p[..., kcap:], v_new)
+            o = o.reshape(B, K1, -1)
+            x = x + o @ bp["attn.proj.weight"] + bp["attn.proj.bias"]
+            x = _ffn(bp, x)
+        # one all-layer scatter of the fresh K/V (page_idx/offset are
+        # layer-invariant); accepted rows persist, rejected rows become
+        # garbage above the rolled-back cache_len, overruns hit page 0
+        k_pool = k_pool.at[:, page_idx, offset].set(jnp.stack(k_news))
+        v_pool = v_pool.at[:, page_idx, offset].set(jnp.stack(v_news))
+        xf = _pp_ln(x, head["ln_f.weight"], head["ln_f.bias"], eps)
+        logits = xf @ embed["wte.weight"].T
+        amax = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return logits, amax, k_pool, v_pool
+
+    return paged_verify
+
+
+def gpt_paged_prefill_fns(cfg: GPTConfig, eps: float = 1e-5,
+                          page_tokens: int = 16):
+    """Pure fused prefill-into-pages: one executable computes the
+    prompt's K/V panel (the parallel `gpt_decode_fns` prefill) AND
+    scatters its rows into pool pages — replacing the three-hop
+    prefill -> host panel copy -> page-write admission path with a
+    single dispatch.
+
+    paged_prefill(params,
+                  k_pool, v_pool [layers, P, page_tokens, heads, head_dim],
+                  toks   [1, R] int32 (prompt padded to the rung),
+                  tables [1, W] int32 (W = ceil(R / page_tokens)),
+                  n      [1]    int32 (true prompt length)
+        -> (logits [1, V], k_pool, v_pool)
+
+    Row r lands at page tables[0, r//pt], offset r%pt; padding rows at
+    or past `n` redirect to the null page, so a short prompt in a wide
+    rung never dirties pages it does not own. `logits` is the prefill's
+    last-position head — callers that only want the K/V ignore it.
+    """
+    pt = int(page_tokens)
+    prefill, _ = gpt_decode_fns(cfg, eps=eps)
+
+    def paged_prefill(params, k_pool, v_pool, toks, tables, n):
+        R = toks.shape[1]
+        W = tables.shape[1]
+        logits, k, v = prefill(params, toks, n)
+        rows = jnp.arange(R, dtype=jnp.int32)
+        valid = rows < n[0]
+        slot = jnp.minimum(rows // pt, W - 1)
+        page_idx = jnp.where(valid, tables[0, slot], 0)
+        offset = rows % pt
+        k_pool = k_pool.at[:, page_idx, offset].set(k[:, 0])
+        v_pool = v_pool.at[:, page_idx, offset].set(v[:, 0])
+        return logits, k_pool, v_pool
+
+    return paged_prefill
+
+
+def gpt_paged_rollout_fns(cfg: GPTConfig, eps: float = 1e-5,
+                          page_tokens: int = 16):
+    """Pure K-step greedy draft rollout over a PAGED KV cache — the
+    draft side of speculative decoding fused into ONE executable, so a
+    scheduler tick costs two dispatches (rollout + verify) instead of
+    k + 1.
+
+    paged_rollout(params,
+                  k_pool, v_pool [layers, P, page_tokens, heads, head_dim],
+                  tables [B, W] int32 (unused entries -> null page 0),
+                  forced [B, K] int32 (>= 0: the committed token to
+                          consume at step i — catch-up; -1: chain the
+                          previous step's own argmax),
+                  cache_len [B] int32)
+        -> (drafts [B, K] int32, k_pool, v_pool)
+
+    Step i consumes one token at absolute position `cache_len + i`,
+    writes its K/V at page tables[b, pos//pt] row pos%pt (the exact
+    `paged_step` addressing) and records the greedy argmax in
+    `drafts[b, i]`. `forced[:, 0]` must be >= 0 — the engine always has
+    at least one committed token the draft has not consumed. Positions
+    at or past max_seq_len redirect their writes to the null page, so a
+    slot drafting into the sequence cap never clobbers live rows.
+    Attention is the gathered-pool XLA path of `paged_verify` with a
+    single query row; draft numerics only move the acceptance rate,
+    never output correctness, so no Pallas kernel is spent here.
+    """
+    if cfg.moe_experts > 0:
+        raise NotImplementedError(
+            "gpt_paged_rollout_fns: MoE blocks have no KV-decode path yet")
+    D = cfg.head_dim
+    nh = cfg.heads
+    pt = int(page_tokens)
+    scale = 1.0 / math.sqrt(D)
+
+    def _ffn(bp, x):
+        h2 = _pp_ln(x, bp["ln2.weight"], bp["ln2.bias"], eps)
+        m = jax.nn.gelu(h2 @ bp["fc1.weight"] + bp["fc1.bias"],
+                        approximate=False)
+        return x + m @ bp["fc2.weight"] + bp["fc2.bias"]
+
+    def paged_rollout(params, k_pool, v_pool, tables, forced, cache_len):
+        embed, blocks, head = split_decode_params(params, cfg)
+        B, K = forced.shape
+        W = tables.shape[1]
+        kcap = W * pt
+        base = cache_len.astype(jnp.int32)
+
+        def step(i, carry):
+            prev, drafts, k_pool, v_pool = carry
+            want = jax.lax.dynamic_slice_in_dim(forced, i, 1, axis=1)[:, 0]
+            tok = jnp.where(want >= 0, want, prev)
+            pos = base + i
+            valid = pos < cfg.max_seq_len
+            pos_c = jnp.minimum(pos, cfg.max_seq_len - 1)
+            x = embed["wte.weight"][tok] + embed["wpe.weight"][pos_c]
+            slot = jnp.minimum(pos_c // pt, W - 1)
+            page_idx = jnp.take_along_axis(
+                tables, slot[:, None], axis=1)[:, 0]
+            page_idx = jnp.where(valid, page_idx, 0)
+            offset = pos_c % pt
+            live = jnp.arange(kcap, dtype=jnp.int32)[None, :] \
+                < (pos_c + 1)[:, None]                       # [B, kcap]
+            for li, bp in enumerate(blocks):
+                h1 = _pp_ln(x, bp["ln1.weight"], bp["ln1.bias"], eps)
+                qkv = h1 @ bp["attn.qkv.weight"] + bp["attn.qkv.bias"]
+                q, k_new, v_new = jnp.split(qkv, 3, axis=-1)
+                q = q.reshape(B, nh, D)
+                k_new = k_new.reshape(B, nh, D)
+                v_new = v_new.reshape(B, nh, D)
+                k_pool = k_pool.at[li, page_idx, offset].set(k_new)
+                v_pool = v_pool.at[li, page_idx, offset].set(v_new)
+                keys = jnp.take(k_pool[li], tables, axis=0) \
+                    .reshape(B, kcap, nh, D)
+                vals = jnp.take(v_pool[li], tables, axis=0) \
+                    .reshape(B, kcap, nh, D)
+                s = jnp.einsum("bhd,bkhd->bhk", q, keys) * scale
+                s = s.astype(jnp.float32)
+                s = jnp.where(live[:, None], s, -1e30)
+                p = jax.nn.softmax(s, axis=-1).astype(vals.dtype)
+                o = jnp.einsum("bhk,bkhd->bhd", p, vals).reshape(B, -1)
+                x = x + o @ bp["attn.proj.weight"] + bp["attn.proj.bias"]
+                x = _ffn(bp, x)
+            xf = _pp_ln(x, head["ln_f.weight"], head["ln_f.bias"], eps)
+            logits = xf @ embed["wte.weight"].T
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            drafts = jax.lax.dynamic_update_slice_in_dim(
+                drafts, nxt[:, None], i, axis=1)
+            return nxt, drafts, k_pool, v_pool
+
+        prev0 = forced[:, 0]
+        drafts0 = jnp.zeros((B, K), jnp.int32)
+        _, drafts, k_pool, v_pool = jax.lax.fori_loop(
+            0, K, step, (prev0, drafts0, k_pool, v_pool))
+        return drafts, k_pool, v_pool
+
+    return paged_rollout

@@ -18,6 +18,7 @@ from pathlib import Path
 
 from paddle_tpu.analysis import all_rules, main, run
 from paddle_tpu.analysis.catalog_drift import lint_metric_family
+from paddle_tpu.analysis.core import PLACEHOLDER_JUSTIFICATION
 
 REPO_ROOT = Path(__file__).resolve().parents[1]
 
@@ -659,8 +660,14 @@ def test_baseline_grandfathers_by_fingerprint(tmp_path):
     rc = main([str(root / "m.py"), "--root", str(root),
                "--baseline", str(bl), "--write-baseline"])
     assert rc == 0 and bl.is_file()
-    entries = json.loads(bl.read_text())["entries"]
+    data = json.loads(bl.read_text())
+    entries = data["entries"]
     assert len(entries) == 2 and all(e["rule"] == "TPL021" for e in entries)
+    # Fill in the justifications the way an operator is expected to —
+    # entries left on the write-baseline placeholder trip TPL002.
+    for e in entries:
+        e["justification"] = "legacy sleep-under-lock, tracked separately"
+    bl.write_text(json.dumps(data))
 
     # Shift every line: the line-independent fingerprint still matches.
     (root / "m.py").write_text("# a new leading comment line\n" + src)
@@ -673,6 +680,57 @@ def test_baseline_grandfathers_by_fingerprint(tmp_path):
         "            time.sleep(3.0)\n")
     res = _lint(root, "m.py", baseline_path=str(bl))
     assert len(res.findings) == 1 and res.baselined == 2
+
+
+def test_baseline_placeholder_justification_fails(tmp_path):
+    """TPL002: a baseline entry still carrying the --write-baseline
+    placeholder justification is itself a finding — against the baseline
+    file — and cannot be grandfathered or re-written into the baseline."""
+    src = textwrap.dedent(_SLEEPY).format(trailing="", standalone="")
+    root = _repo(tmp_path, {"m.py": src})
+    bl = root / ".tpulint-baseline.json"
+
+    rc = main([str(root / "m.py"), "--root", str(root),
+               "--baseline", str(bl), "--write-baseline"])
+    assert rc == 0
+    data = json.loads(bl.read_text())
+    assert all(e["justification"] == PLACEHOLDER_JUSTIFICATION
+               for e in data["entries"])
+
+    # Both grandfathered findings are baselined, but each unjustified
+    # entry surfaces as TPL002 pointing at the baseline file itself.
+    res = _lint(root, "m.py", baseline_path=str(bl))
+    assert res.baselined == 2
+    assert [f.rule for f in res.findings] == ["TPL002", "TPL002"]
+    assert all(f.path == ".tpulint-baseline.json" for f in res.findings)
+    assert main([str(root / "m.py"), "--root", str(root),
+                 "--baseline", str(bl)]) == 1
+
+    # Justifying one entry clears exactly one TPL002.
+    data["entries"][0]["justification"] = "known-slow shutdown path"
+    bl.write_text(json.dumps(data))
+    res = _lint(root, "m.py", baseline_path=str(bl))
+    assert [f.rule for f in res.findings] == ["TPL002"]
+
+    # Re-writing the baseline while TPL002 is active must not absorb
+    # TPL002 into the baseline (only real source findings are written).
+    rc = main([str(root / "m.py"), "--root", str(root),
+               "--baseline", str(bl), "--write-baseline"])
+    assert rc == 0
+    rewritten = json.loads(bl.read_text())["entries"]
+    assert all(e["rule"] != "TPL002" for e in rewritten)
+
+    # Justifying every entry returns the run to clean.
+    data["entries"][1]["justification"] = "lock held around legacy sleep"
+    bl.write_text(json.dumps(data))
+    res = _lint(root, "m.py", baseline_path=str(bl))
+    assert res.findings == [] and res.baselined == 2
+
+    # The --rules prefix filter applies to TPL002 like any other rule.
+    data["entries"][1]["justification"] = PLACEHOLDER_JUSTIFICATION
+    bl.write_text(json.dumps(data))
+    res = _lint(root, "m.py", baseline_path=str(bl), rules=["TPL021"])
+    assert res.findings == []
 
 
 def test_rule_prefix_filter(tmp_path):

@@ -1,0 +1,412 @@
+"""Speculative decoding over the paged KV pool: draft-and-verify must be
+token-for-token the plain greedy engine (the full-forward oracle), with
+zero steady-state compiles across churn INCLUDING rejections and
+rollbacks.
+
+Two draft regimes bracket the acceptance spectrum on purpose:
+
+* ``tiny-scan`` pairs the target with an independent random 1-layer
+  draft — near-total rejection, so every tick exercises the rollback
+  path (cache_len truncation + ``release_range`` on stranded pages).
+* ``small-unrolled`` uses the target as its own draft — near-total
+  acceptance, so ticks exercise deep multi-token commits and the bonus
+  token.
+
+Identity against ``_ref_greedy`` must hold in BOTH regimes; acceptance
+only changes how fast tokens land, never which tokens.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import profiler
+from paddle_tpu.inference.decode import (DecodeEngine, DecodeStream,
+                                         SpecDecodeEngine, _decode_metrics,
+                                         _PrefixCache, load_for_decode,
+                                         save_for_decode, spec_k_ladder)
+from paddle_tpu.inference.errors import ERR_UNAVAILABLE, TypedServeError
+from paddle_tpu.memory.page_allocator import PageAllocator, PageExhausted
+from paddle_tpu.models.gpt import GPT, GPTConfig, gpt_tiny
+
+_CFGS = [
+    ("tiny-scan", gpt_tiny()),                       # scan-stacked params
+    ("small-unrolled", GPTConfig(vocab_size=256, max_seq_len=64, hidden=32,
+                                 layers=3, heads=2, scan_layers=False)),
+]
+
+# Rejection-heavy draft for tiny-scan; small-unrolled drafts with the
+# target itself (acceptance-heavy). See module docstring.
+_TINY_DRAFT_CFG = GPTConfig(vocab_size=512, max_seq_len=128, hidden=32,
+                            layers=1, heads=2, scan_layers=False)
+
+
+@pytest.fixture(scope="module")
+def spec_rig():
+    paddle.seed(7)
+    models = {name: GPT(cfg) for name, cfg in _CFGS}
+    drafts = {"tiny-scan": GPT(_TINY_DRAFT_CFG),
+              "small-unrolled": models["small-unrolled"]}
+    engines = {}
+    for name, _ in _CFGS:
+        eng = SpecDecodeEngine(models[name], draft_model=drafts[name],
+                               speculate_k=4, max_slots=2,
+                               max_new_tokens=24, page_tokens=4,
+                               prefix_cache=True)
+        eng.warmup()
+        engines[name] = eng
+    yield {"models": models, "engines": engines}
+    for eng in engines.values():
+        eng.stop()
+
+
+def _full_logits(model, toks):
+    idx = paddle.to_tensor(np.asarray([toks], np.int64))
+    return model(idx).numpy()[0, -1].astype(np.float32)
+
+
+def _ref_greedy(model, prompt, n, eos_id=None):
+    toks = [int(t) for t in prompt]
+    out = []
+    for _ in range(n):
+        t = int(_full_logits(model, toks).argmax())
+        out.append(t)
+        toks.append(t)
+        if eos_id is not None and t == eos_id:
+            break
+    return out
+
+
+# -------------------------------------------------- release_range unit
+
+def test_release_range_drops_tail_refs():
+    a = PageAllocator(9)
+    p = a.alloc(6)
+    assert a.release_range(p, 2) == 4
+    assert [a.refcount(x) for x in p] == [1, 1, 0, 0, 0, 0]
+    assert a.free_count() == 2 + 4                  # 2 never allocated
+    assert a.release_range(p, 6) == 0               # empty tail is a no-op
+    assert a.release_range(p[:2], -3) == 2          # from_idx clamps to 0
+    assert a.stats()["pages_used"] == 0
+
+
+def test_release_range_shared_pages_decrement_not_free():
+    a = PageAllocator(9)
+    p = a.alloc(4)
+    a.retain(p[2])                                  # shared (prefix/COW)
+    assert a.release_range(p, 1) == 3
+    assert a.refcount(p[2]) == 1                    # still held elsewhere
+    assert a.refcount(p[3]) == 0
+    a.release(p[0])
+    a.release(p[2])
+    assert a.stats()["pages_used"] == 0
+
+
+def test_release_range_validates_before_any_change():
+    a = PageAllocator(9)
+    p = a.alloc(3)
+    a.release(p[1])                                 # poke a hole
+    before = {x: a.refcount(x) for x in p}
+    with pytest.raises(ValueError):
+        a.release_range(p, 0)                       # p[1] unallocated
+    # atomic: the bad call must not have touched p[0] or p[2]
+    assert {x: a.refcount(x) for x in p} == before
+    assert a.release_range([p[0], p[2]], 0) == 2
+
+
+def test_spec_k_ladder_rungs():
+    assert spec_k_ladder(1) == [1]
+    assert spec_k_ladder(4) == [1, 2, 4]
+    assert spec_k_ladder(6) == [1, 2, 4, 6]
+    assert spec_k_ladder(8) == [1, 2, 4, 8]
+
+
+# ------------------------------------------------ stream batched events
+
+def test_stream_batched_events_unbatch_per_token():
+    s = DecodeStream(1, [1, 2])
+    s._push_tokens([5, 6, 7], eos=False)
+    s._push_token(8, eos=False)
+    s._push_tokens([9, 10], eos=True)
+    s._push_done()
+    evs = [s.poll() for _ in range(6)]
+    assert evs == [("token", 5, False), ("token", 6, False),
+                   ("token", 7, False), ("token", 8, False),
+                   ("token", 9, False), ("token", 10, True)]
+    assert s.tokens == [5, 6, 7, 8, 9, 10]          # mirror matches
+    assert s.poll() == ("done", [5, 6, 7, 8, 9, 10])
+    assert s.poll() is None                         # drained
+
+
+def test_stream_batched_events_error_and_next_event():
+    s = DecodeStream(2, [1])
+    s._push_tokens([3, 4], eos=False)
+    assert s.next_event() == ("token", 3, False)
+    s._push_error(TypedServeError(ERR_UNAVAILABLE, "boom"))
+    # the unbatched remainder drains before the error surfaces
+    assert s.poll() == ("token", 4, False)
+    with pytest.raises(TypedServeError):
+        s.poll()
+
+
+# ------------------------------------- speculative == plain greedy
+
+@pytest.mark.parametrize("name", [n for n, _ in _CFGS])
+def test_spec_matches_full_forward_greedy(spec_rig, name):
+    """Token identity vs the full-forward oracle through admission
+    churn (7 streams on 2 slots), a shared-prefix pair (prefix-cache
+    COW), EOS mid-stream, page-boundary crossings (page_tokens=4) —
+    with ZERO compiles after warmup, rejections and rollbacks
+    included."""
+    model = spec_rig["models"][name]
+    eng = spec_rig["engines"][name]
+    base = [[1, 2, 3], [5, 4, 3, 2, 1, 8, 9], [7] * 9,
+            [2, 4, 6, 8, 10, 12], [11, 3, 11, 3, 11]]
+    shared = [9, 8, 7, 6, 5, 4, 3, 2]
+    prompts = base + [shared, shared + [1, 2]]      # page-aligned prefix
+    refs = [_ref_greedy(model, p, 16) for p in prompts]
+    # EOS for the churn-heaviest prompt: stop on a token the reference
+    # actually emits, so the engine must cut the stream mid-flight.
+    eos = refs[1][7]
+    refs[1] = _ref_greedy(model, prompts[1], 16, eos_id=eos)
+
+    c0 = len(profiler.compile_events())
+    streams = []
+    for i, p in enumerate(prompts):
+        streams.append(eng.submit(p, max_new_tokens=16,
+                                  eos_id=eos if i == 1 else None))
+    outs = [s.result(timeout=180.0) for s in streams]
+    assert outs == refs
+    assert len(profiler.compile_events()) == c0, \
+        "speculative steady state must not compile"
+
+
+def test_rejection_rollback_releases_pages(spec_rig):
+    """The rejection-heavy draft strands draft-extension pages past the
+    last accepted token; rollback must return them through
+    release_range and account for it on the counter."""
+    eng = spec_rig["engines"]["tiny-scan"]
+    m = _decode_metrics()
+    v0 = m["page_rollback_released"].get()
+    r0 = m["spec_rejected"].get()
+    outs = [eng.submit([3, 1, 4, 1, 5], max_new_tokens=16).result(timeout=180.0)
+            for _ in range(2)]
+    assert all(len(o) == 16 for o in outs)
+    assert m["spec_rejected"].get() > r0             # the draft does miss
+    assert m["page_rollback_released"].get() > v0
+    # no leak: once the engine idles, only prefix-cache pins remain
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        st = eng.stats()
+        if st["pages"]["pages_used"] <= st["prefix_cache"]["cached_pages"]:
+            break
+        time.sleep(0.05)
+    assert st["pages"]["pages_used"] <= st["prefix_cache"]["cached_pages"]
+
+
+def test_adaptive_k_tracks_acceptance(spec_rig):
+    """Per-slot k walks the ladder by acceptance EMA: an adversarial
+    stream degrades toward plain decode (drafted ~= committed), a
+    repetitive one earns deep speculation (near-unit acceptance)."""
+    rej = spec_rig["engines"]["tiny-scan"]
+    s = rej.submit([6, 2, 8, 4], max_new_tokens=16)
+    out = s.result(timeout=180.0)
+    assert len(out) == 16
+    # k collapses to 1 under rejection: far fewer than k_max per token
+    assert s.spec_drafted <= 2 * len(out) + 4
+    assert s.spec_accepted <= s.spec_drafted
+
+    acc = spec_rig["engines"]["small-unrolled"]
+    s2 = acc.submit([4, 4, 2, 2], max_new_tokens=16)
+    out2 = s2.result(timeout=180.0)
+    assert len(out2) == 16
+    assert s2.spec_accepted / max(s2.spec_drafted, 1) > 0.9
+    st = acc.stats()["speculate"]
+    assert st["k_ladder"][0] == 1 and st["k_max"] == 4
+    assert 0.0 <= st["acceptance_rate"] <= 1.0
+
+
+def test_temperature_sampling_over_verify(spec_rig):
+    """temperature>0 routes through rejection sampling against the
+    target distribution; output is stochastic but must stay in-vocab,
+    complete, and compile-free."""
+    eng = spec_rig["engines"]["small-unrolled"]
+    c0 = len(profiler.compile_events())
+    s = eng.submit([1, 9, 1, 9], max_new_tokens=12,
+                   temperature=1.0, top_k=8)
+    out = s.result(timeout=180.0)
+    assert len(out) == 12
+    assert all(0 <= t < 256 for t in out)
+    assert len(profiler.compile_events()) == c0
+
+
+def test_warmup_prunes_middle_k_rungs(spec_rig, monkeypatch):
+    """When (batch x page x k) overflows the warmup cap the k ladder
+    sheds MIDDLE rungs (k=1 and k_max survive) instead of silently
+    truncating tail signatures — adaptive k may only walk warmed
+    rungs."""
+    from paddle_tpu.inference.batching import _WARMUP_SIG_CAP
+    from paddle_tpu.jit.compile_cache import AotCache
+    monkeypatch.setattr(AotCache, "get_or_compile",
+                        lambda self, *a, **k: None)
+    eng = SpecDecodeEngine(spec_rig["models"]["tiny-scan"],
+                           draft_cfg=_TINY_DRAFT_CFG,
+                           draft_params={},         # never compiled: stubbed
+                           speculate_k=8, max_slots=8, page_tokens=4)
+    try:
+        assert eng.k_ladder == [1, 2, 4, 8]
+        grid = len(eng.batch_ladder) * len(eng.page_ladder)
+        assert grid * len(eng.k_ladder) > _WARMUP_SIG_CAP  # overflow setup
+        eng.warmup()
+        assert eng.k_ladder[0] == 1 and eng.k_ladder[-1] == 8
+        assert len(eng.k_ladder) < 4
+        assert grid * len(eng.k_ladder) <= _WARMUP_SIG_CAP
+    finally:
+        eng.stop()
+
+
+# -------------------------------------------------- artifact round-trip
+
+def test_load_for_decode_spec_artifacts(tmp_path, monkeypatch, spec_rig):
+    target = spec_rig["models"]["small-unrolled"]
+    paddle.seed(11)
+    draft = GPT(GPTConfig(vocab_size=256, max_seq_len=64, hidden=32,
+                          layers=1, heads=2, scan_layers=False))
+    tp, dp = str(tmp_path / "target"), str(tmp_path / "draft")
+    save_for_decode(target, tp)
+    save_for_decode(draft, dp)
+
+    eng = load_for_decode(tp, max_slots=2, page_tokens=8)
+    try:
+        assert type(eng) is DecodeEngine          # speculation is opt-in
+    finally:
+        eng.stop()
+
+    eng = load_for_decode(tp, draft_prefix=dp, speculate_k=2,
+                          max_slots=2, page_tokens=8)
+    try:
+        assert isinstance(eng, SpecDecodeEngine)
+        assert eng.k_ladder == [1, 2]
+    finally:
+        eng.stop()
+
+    monkeypatch.setenv("PADDLE_TPU_DECODE_DRAFT_MODEL", dp)
+    monkeypatch.setenv("PADDLE_TPU_DECODE_SPECULATE", "4")
+    eng = load_for_decode(tp, max_slots=2, page_tokens=8)
+    try:
+        assert isinstance(eng, SpecDecodeEngine)
+        assert eng.k_ladder == [1, 2, 4]
+    finally:
+        eng.stop()
+
+    # draft/target shape contract is validated before threads spin up
+    paddle.seed(12)
+    bad = GPT(GPTConfig(vocab_size=128, max_seq_len=64, hidden=32,
+                        layers=1, heads=2, scan_layers=False))
+    bp = str(tmp_path / "bad")
+    save_for_decode(bad, bp)
+    with pytest.raises(ValueError, match="vocab"):
+        load_for_decode(tp, draft_prefix=bp, speculate_k=2,
+                        max_slots=2, page_tokens=8)
+
+
+# ------------------------------------------------ metric family contract
+
+def test_spec_metric_families_registered_and_cataloged():
+    from pathlib import Path
+
+    from paddle_tpu.observability.metrics import REGISTRY
+    m = _decode_metrics()
+    fams = ["spec_draft_steps", "spec_accepted", "spec_rejected",
+            "spec_acceptance", "page_rollback_released"]
+    doc = (Path(__file__).resolve().parents[1]
+           / "docs" / "observability.md").read_text()
+    for key in fams:
+        name = m[key].name
+        assert name.startswith("paddle_tpu_decode_")
+        assert REGISTRY.get(name) is m[key]
+        # the catalog factors out the paddle_tpu_ prefix per family table
+        short = name[len("paddle_tpu_"):]
+        assert short in doc, f"{short} missing from docs/observability.md"
+    # counters carry the _total suffix, gauges must not
+    for key in ["spec_draft_steps", "spec_accepted", "spec_rejected",
+                "page_rollback_released"]:
+        assert m[key].name.endswith("_total")
+    assert not m["spec_acceptance"].name.endswith("_total")
+
+
+# ------------------------------------------- concurrency (tsan-armed)
+
+def test_prefix_cow_shared_allocator_stress():
+    """_PrefixCache trie + draft/target block tables hammering ONE
+    PageAllocator from four threads: the sanctioned lock order is
+    trie -> allocator, one-directional, and refcounts must balance
+    exactly (no double-free, no leak) through lookup/insert/evict
+    racing alloc/retain/release_range rollbacks. Runs under tsan-lite
+    instrumentation in the runtime gate."""
+    alloc = PageAllocator(257)
+    cache = _PrefixCache(alloc, 4)
+    stop = threading.Event()
+    errors = []
+
+    def hammer_cache(seed):
+        rng = np.random.default_rng(seed)
+        for _ in range(300):
+            if stop.is_set():
+                break
+            plen = int(rng.integers(1, 5)) * 4
+            prompt = [int(t) for t in rng.integers(0, 16, plen)]
+            pages, _hit = cache.lookup(prompt)      # retained for us
+            need = plen // 4 - len(pages)
+            try:
+                fresh = alloc.alloc(need) if need else []
+            except PageExhausted:
+                for p in pages:
+                    alloc.release(p)
+                cache.evict(8)
+                continue
+            table = pages + fresh
+            cache.insert(prompt, table)             # cache takes its own refs
+            alloc.release_range(table, 0)           # drop all of ours
+
+    def hammer_tables(seed):
+        rng = np.random.default_rng(seed)
+        for _ in range(300):
+            if stop.is_set():
+                break
+            n = int(rng.integers(2, 9))
+            try:
+                pages = alloc.alloc(n)
+            except PageExhausted:
+                continue
+            for p in pages:                         # draft shares target's ids
+                alloc.retain(p)
+            cut = int(rng.integers(0, n + 1))
+            alloc.release_range(pages, cut)         # speculative rollback
+            for p in pages[cut:]:
+                alloc.release(p)
+            for p in pages[:cut]:
+                alloc.release(p)
+                alloc.release(p)
+
+    def run(fn, seed):
+        def wrapped():
+            try:
+                fn(seed)
+            except Exception as exc:  # noqa: BLE001 - surfaced below
+                errors.append(exc)
+                stop.set()
+        t = threading.Thread(target=wrapped, daemon=True)
+        t.start()
+        return t
+
+    threads = [run(hammer_cache, 1), run(hammer_cache, 2),
+               run(hammer_tables, 3), run(hammer_tables, 4)]
+    for t in threads:
+        t.join(timeout=60.0)
+    assert not errors, errors
+    cache.clear()
+    st = alloc.stats()
+    assert st["pages_used"] == 0, f"leaked refs: {st}"

@@ -348,11 +348,11 @@ def test_runtime_gate_on_concurrency_modules(tmp_path):
         [sys.executable, "-m", "pytest", "-q",
          "tests/test_serve_batching.py", "tests/test_serve_chaos.py",
          "tests/test_decode.py", "tests/test_decode_paged.py",
-         "tests/test_slo.py",
+         "tests/test_decode_spec.py", "tests/test_slo.py",
          "-m", "not slow",
          "-p", "paddle_tpu.analysis.runtime.pytest_plugin",
          "-p", "no:cacheprovider"],
-        capture_output=True, text=True, timeout=600, env=env,
+        capture_output=True, text=True, timeout=1200, env=env,
         cwd=str(REPO_ROOT),
     )
     assert report.is_file(), proc.stdout[-4000:] + proc.stderr[-2000:]
