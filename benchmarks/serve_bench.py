@@ -366,6 +366,15 @@ def run_decode_bench(args):
                   cfg.max_seq_len)
     contig_per_slot = kv_slot_bytes(
         cfg, next_bucket(longest, eng.kv_ladder))
+    # tracez artifact + continuous-profiler summary: the run's event
+    # ring rendered as Chrome trace-event JSON (load in ui.perfetto.dev)
+    # plus the per-executable top-5 by total host-blocked time
+    from paddle_tpu.observability import PROFILER, RING
+    trace_file = os.path.join(
+        tempfile.mkdtemp(prefix="serve_bench_tracez_"),
+        "decode_trace.json")
+    with open(trace_file, "w") as f:
+        json.dump(RING.chrome_trace(), f)
     return {
         "metric": "decode_throughput",
         "value": round(cont_tps, 2),
@@ -402,6 +411,8 @@ def run_decode_bench(args):
         "warmup_compiles": warmup_compiles,
         "baseline_warmup_compiles": base_warmup,
         "compile_count": steady_compiles,
+        "trace_file": trace_file,
+        "profilez_top": PROFILER.top(5),
         "metrics": {k: v for k, v in REGISTRY.flat().items()
                     if k.startswith("paddle_tpu_decode_")},
     }

@@ -207,7 +207,9 @@ class DynamicBatcher:
         # (PADDLE_TPU_STALL_DUMP) — a watchdog that dumps every thread's
         # stack when queued work stops dispatching
         from ..observability import FlightRecorder, SpanRecorder, counter
+        from ..observability import tracez as _tracez
         self._spans = SpanRecorder(component="serve")
+        self._ring = _tracez.RING
         self._max_queue = max_queue_default() if max_queue is None \
             else int(max_queue)
         self._worker_max_restarts = int(worker_max_restarts)
@@ -525,9 +527,17 @@ class DynamicBatcher:
         formed = None
         try:
             while True:
+                t_form = time.perf_counter()
                 formed = self._form_batch()
                 if formed is None:
                     return
+                # form span covers dequeue + merge window (idle wait for
+                # the FIRST request included: that's queue starvation,
+                # worth seeing on the timeline)
+                self._ring.complete("batch.form", t_form,
+                                    time.perf_counter(),
+                                    {"rows": formed[2],
+                                     "reqs": len(formed[0])})
                 chaos.maybe_fail("batcher.dispatch")
                 if not self._wqueues:
                     # single predictor: execute inline — a queue handoff
@@ -728,9 +738,14 @@ class DynamicBatcher:
                 t1 = time.perf_counter()
                 outs = pred.run_batch(stacked)
                 t2 = time.perf_counter()
+                self._ring.complete("batch.pad", t0, t1,
+                                    {"bucket": bucket, "rows": rows})
+                self._ring.complete("batch.execute", t1, t2,
+                                    {"bucket": bucket})
                 if self._slice_back(outs, reqs, bucket,
                                     times=(t0, t1, t2)):
                     now = time.perf_counter()
+                    self._ring.complete("batch.unpad", t2, now)
                     profiler.record_serve_batch(rows, bucket, real, padded,
                                                 qdepth)
                     profiler.record_serve_requests(

@@ -77,6 +77,7 @@ from ..models.gpt import (GPTConfig, gpt_paged_decode_fns,
                           gpt_paged_verify_fns)
 from ..observability import counter, gauge, histogram
 from ..observability.spans import SpanRecorder, next_request_id
+from ..observability.tracez import RING as _RING
 from ..testing import chaos
 from .batching import _WARMUP_SIG_CAP, bucket_ladder, next_bucket
 from .errors import (ERR_INVALID_ARGUMENT, ERR_RESOURCE_EXHAUSTED,
@@ -527,11 +528,13 @@ class DecodeEngine:
         # step/verify cost on CPU).
         self._prefill_aot = AotCache(jax.jit(prefill_fn), "decode.prefill")
         self._step_aot = AotCache(jax.jit(step_fn, donate_argnums=(1, 2)),
-                                  "decode.pstep")
+                                  "decode.pstep", donate_argnums=(1, 2))
         self._write_aot = AotCache(
-            jax.jit(_write_kv_pages, donate_argnums=(0, 1)), "decode.pwrite")
+            jax.jit(_write_kv_pages, donate_argnums=(0, 1)), "decode.pwrite",
+            donate_argnums=(0, 1))
         self._copy_aot = AotCache(
-            jax.jit(_copy_kv_page, donate_argnums=(0, 1)), "decode.pcow")
+            jax.jit(_copy_kv_page, donate_argnums=(0, 1)), "decode.pcow",
+            donate_argnums=(0, 1))
 
         self._m = _decode_metrics()
         self._spans = SpanRecorder(
@@ -696,7 +699,11 @@ class DecodeEngine:
                     free -= 1
             try:
                 for req in newly:
-                    if self._admit(req):
+                    t_adm = time.perf_counter()
+                    admitted = self._admit(req)
+                    _RING.complete("decode.admit", t_adm,
+                                   time.perf_counter(), {"req": req.id})
+                    if admitted:
                         self._active.append(req)
                 if newly:
                     self._update_gauges()
@@ -880,6 +887,7 @@ class DecodeEngine:
             self._prefix.insert(req.prompt, pages[:plen // pt])
         eos = req.eos_id is not None and tok == req.eos_id
         req.stream._push_token(tok, eos)
+        _RING.instant("decode.emit", {"req": req.id})
         if eos or len(req.generated) >= req.max_new \
                 or req.cache_len >= self.cfg.max_seq_len:
             self._finish(req, "eos" if eos else "length")
@@ -890,6 +898,7 @@ class DecodeEngine:
     # ------------------------------------------------------------ step
 
     def _step_once(self):
+        t_tick = time.perf_counter()
         pt = self.page_tokens
         # provision the write target for row cache_len: a fresh page at
         # a page boundary, a copy-on-write if the target page is shared
@@ -900,7 +909,10 @@ class DecodeEngine:
                 if slot >= len(req.pages):
                     req.pages.extend(self._alloc_pages(1, req))
                 elif self._alloc.refcount(req.pages[slot]) > 1:
+                    t_cow = time.perf_counter()
                     self._cow(req, slot)
+                    _RING.complete("decode.cow", t_cow,
+                                   time.perf_counter(), {"req": req.id})
             except TypedServeError as err:
                 req.stream._push_error(err)
                 self._m["evictions"].labels(reason="exhausted").inc()
@@ -937,6 +949,7 @@ class DecodeEngine:
         self._last_b_rung, self._last_w_rung = b_rung, w_rung
         self._steps += 1
         self._m["steps"].inc()
+        t_sample = time.perf_counter()
         finished = []
         for j, req in enumerate(reqs):
             req.cache_len += 1
@@ -970,11 +983,17 @@ class DecodeEngine:
                 self._m["ttft"].observe(time.monotonic() - req.t_submit)
             eos = req.eos_id is not None and tok == req.eos_id
             req.stream._push_token(tok, eos)
+            _RING.instant("decode.emit", {"req": req.id})
             if eos or len(req.generated) >= req.max_new \
                     or req.cache_len >= self.cfg.max_seq_len:
                 self._finish(req, "eos" if eos else "length")
                 self._release_pages(req)
                 finished.append(req)
+        now = time.perf_counter()
+        _RING.complete("decode.sample", t_sample, now, {"reqs": len(reqs)})
+        _RING.complete("decode.step", t_tick, now,
+                       {"batch": len(reqs), "b_rung": b_rung,
+                        "w_rung": w_rung})
         if finished:
             self._active = [r for r in reqs if r not in finished]
             self._update_gauges()
@@ -1119,13 +1138,17 @@ class SpecDecodeEngine(DecodeEngine):
         # Draft/target pools donated for the same in-place-update
         # reason as the base engine's executables.
         self._dprefill_aot = AotCache(
-            jax.jit(dprefill, donate_argnums=(1, 2)), "decode.dprefill")
+            jax.jit(dprefill, donate_argnums=(1, 2)), "decode.dprefill",
+            donate_argnums=(1, 2))
         self._droll_aot = AotCache(
-            jax.jit(rollout, donate_argnums=(1, 2)), "decode.droll")
+            jax.jit(rollout, donate_argnums=(1, 2)), "decode.droll",
+            donate_argnums=(1, 2))
         self._dcopy_aot = AotCache(
-            jax.jit(_copy_kv_page, donate_argnums=(0, 1)), "decode.dcow")
+            jax.jit(_copy_kv_page, donate_argnums=(0, 1)), "decode.dcow",
+            donate_argnums=(0, 1))
         self._verify_aot = AotCache(
-            jax.jit(verify, donate_argnums=(1, 2)), "decode.verify")
+            jax.jit(verify, donate_argnums=(1, 2)), "decode.verify",
+            donate_argnums=(1, 2))
         self._dkpool = None          # draft pools, lazy like the target's
         self._dvpool = None
         self._drafted_total = 0
@@ -1275,6 +1298,7 @@ class SpecDecodeEngine(DecodeEngine):
     # ------------------------------------------------------------ tick
 
     def _step_once(self):
+        t_tick = time.perf_counter()
         pt = self.page_tokens
         cap = self.cfg.max_seq_len
         tick_k = max(r.spec_k for r in self._active)
@@ -1294,7 +1318,11 @@ class SpecDecodeEngine(DecodeEngine):
                         self._alloc_pages(need - len(req.pages), req))
                 for s in range(lo, need):
                     if self._alloc.refcount(req.pages[s]) > 1:
+                        t_cow = time.perf_counter()
                         self._cow(req, s)
+                        _RING.complete("decode.cow", t_cow,
+                                       time.perf_counter(),
+                                       {"req": req.id})
             except TypedServeError as err:
                 req.stream._push_error(err)
                 self._m["evictions"].labels(reason="exhausted").inc()
@@ -1318,6 +1346,7 @@ class SpecDecodeEngine(DecodeEngine):
         # committed token the draft has not seen yet (catch-up, passed
         # via `forced`; its output is discarded) or the slot's own
         # previous draft (forced = -1: the rollout chains its argmax).
+        t_draft = time.perf_counter()
         seqs = [req.prompt + req.generated for req in reqs]
         forced = np.zeros((b_rung, tick_k), np.int32)
         forced[len(reqs):] = 0              # padded rows: null-page writes
@@ -1344,6 +1373,8 @@ class SpecDecodeEngine(DecodeEngine):
                 if req.draft_len >= len(seqs[j]) - 1:
                     chains[j].append(int(dnp[j, i]))
                 req.draft_len += 1
+        t_verify = time.perf_counter()
+        _RING.complete("decode.draft", t_draft, t_verify, {"k": tick_k})
         # 3. verify: one multi-token target forward scores (and writes
         # the K/V of) up to K1 positions per slot — the un-consumed
         # committed tokens first, then this tick's drafts
@@ -1375,6 +1406,8 @@ class SpecDecodeEngine(DecodeEngine):
         self._last_b_rung, self._last_w_rung = b_rung, w_rung
         self._steps += 1
         self._m["steps"].inc()
+        t_accept = time.perf_counter()
+        _RING.complete("decode.verify", t_verify, t_accept, {"k1": K1})
         # 4. acceptance + rollback, per slot on the host
         finished = []
         for j, req in enumerate(reqs):
@@ -1474,6 +1507,7 @@ class SpecDecodeEngine(DecodeEngine):
             req.stream._push_tokens(
                 emitted,
                 req.eos_id is not None and emitted[-1] == req.eos_id)
+            _RING.instant("decode.emit", {"req": req.id, "n": len(emitted)})
             if first:
                 self._m["ttft"].observe(time.monotonic() - req.t_submit)
             done_eos = req.eos_id is not None \
@@ -1483,6 +1517,10 @@ class SpecDecodeEngine(DecodeEngine):
                 self._finish(req, "eos" if done_eos else "length")
                 self._release_pages(req)
                 finished.append(req)
+        now = time.perf_counter()
+        _RING.complete("decode.accept", t_accept, now, {"reqs": len(reqs)})
+        _RING.complete("decode.step", t_tick, now,
+                       {"batch": len(reqs), "k": tick_k})
         if finished:
             self._active = [r for r in reqs if r not in finished]
             self._update_gauges()

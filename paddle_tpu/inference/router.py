@@ -77,6 +77,7 @@ from http.client import HTTPConnection
 from ..observability import (FlightRecorder, SLOEngine, SpanRecorder,
                              TimeSeriesStore, next_request_id,
                              request_id_base, router_objectives)
+from ..observability import tracez as _tracez
 from ..testing import chaos
 from ..utils.retry import CircuitBreaker, RetryBudget, backoff_delays
 from .errors import (ERR_INVALID_ARGUMENT, ERR_RESOURCE_EXHAUSTED,
@@ -310,6 +311,7 @@ class ServeRouter:
         self._inflight = 0
         self._inflight_lock = threading.Lock()
         self._t0 = time.monotonic()
+        self._ring = _tracez.RING
         # router-side trace assembly: its own stage histogram family
         # (pick / forward / reply + the backend_* breakdown relayed over
         # the wire), same JSONL sink and sampling gate as the backends
@@ -358,7 +360,8 @@ class ServeRouter:
                                       health_fn=self._health,
                                       status_fn=self._status,
                                       varz_fn=self._varz.varz,
-                                      alertz_fn=self._slo.alertz)
+                                      alertz_fn=self._slo.alertz,
+                                      tracez_fn=self._fleet_tracez)
             self.metrics_port = self._admin.port
 
     # -- routing table ---------------------------------------------------
@@ -593,9 +596,15 @@ class ServeRouter:
             try:
                 b = self._choose(exclude=tried)
             except TypedServeError as e:     # shed: every backend busy
-                info["pick_s"] += time.perf_counter() - t_pick
+                now = time.perf_counter()
+                info["pick_s"] += now - t_pick
+                self._ring.complete("router.pick", t_pick, now,
+                                    {"outcome": "shed"})
                 return ("shed", str(e))
-            info["pick_s"] += time.perf_counter() - t_pick
+            now = time.perf_counter()
+            info["pick_s"] += now - t_pick
+            self._ring.complete("router.pick", t_pick, now,
+                                {"backend": b.key if b else None})
             if b is None:
                 break
             if attempts > 0:
@@ -617,14 +626,21 @@ class ServeRouter:
                     struct.error, ValueError, IndexError) as e:
                 # wire failure or unparseable reply: the backend is
                 # misbehaving — count it against the breaker, fail over
-                info["forward_s"] += time.perf_counter() - t_fwd
+                now = time.perf_counter()
+                info["forward_s"] += now - t_fwd
+                self._ring.complete("router.forward", t_fwd, now,
+                                    {"backend": b.key, "error":
+                                     type(e).__name__})
                 b.breaker.record_failure()
                 self._drop_conn(b)
                 last_err = f"{b.key}: {type(e).__name__}: {e}"
                 if first_failure_t is None:
                     first_failure_t = time.monotonic()
                 continue
-            info["forward_s"] += time.perf_counter() - t_fwd
+            now = time.perf_counter()
+            info["forward_s"] += now - t_fwd
+            self._ring.complete("router.forward", t_fwd, now,
+                                {"backend": b.key})
             if errmsg is not None:
                 code = error_code(errmsg)
                 if code in RETRYABLE_CODES:
@@ -709,6 +725,7 @@ class ServeRouter:
                 self._m["requests"].labels(outcome=outcome).inc()
                 reply_ctx = self._client_reply_ctx(cctx, rid, trace_id,
                                                    info)
+                t_reply = time.perf_counter()
                 try:
                     if outcome == "ok":
                         write_tensors(conn, payload, ctx=reply_ctx)
@@ -716,9 +733,17 @@ class ServeRouter:
                         write_error(conn, payload, ctx=reply_ctx)
                 except (ConnectionError, TimeoutError, OSError):
                     return
+                now = time.perf_counter()
                 if traced:
+                    # trace line first: the client already has its reply,
+                    # and a test (or tail -f) watching the JSONL sink
+                    # should see the line as soon as possible
                     self._record_trace(rid, trace_id, cctx is not None,
                                        wall, info, outcome)
+                self._ring.complete("router.reply", t_reply, now,
+                                    {"outcome": outcome})
+                self._ring.complete("router.request", now - wall, now,
+                                    {"outcome": outcome, "rid": rid})
                 self._recorder.beat()
                 if self._draining.is_set():
                     return
@@ -789,6 +814,23 @@ class ServeRouter:
         self._spans.record(rid, spans, extra=extra, force=True)
 
     # -- admin surface ---------------------------------------------------
+
+    def _fleet_tracez(self) -> dict:
+        """Router /tracez: the fleet's merged execution timeline — the
+        router's own event ring plus every admin-reachable backend's
+        /tracez, skew-corrected by each ring's wall-clock anchor
+        (best-effort: an unreachable backend is simply absent)."""
+        traces = [self._ring.chrome_trace()]
+        for b in self.backends():
+            if b.admin_port is None:
+                continue
+            try:
+                traces.append(_tracez.fetch_trace(
+                    f"http://{b.host}:{b.admin_port}/tracez",
+                    timeout=2.0))
+            except Exception:
+                continue
+        return _tracez.merge_traces(traces)
 
     def _health(self):
         """Router /healthz: healthy while >= 1 backend is routable."""

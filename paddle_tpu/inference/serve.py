@@ -61,6 +61,7 @@ from concurrent.futures import TimeoutError as FuturesTimeout
 import numpy as np
 
 from ..core import flags as _flags
+from ..observability.tracez import RING as _RING
 from ..testing import chaos
 from .errors import (ERR_DEADLINE_EXCEEDED, ERR_INTERNAL,
                      ERR_INVALID_ARGUMENT, TypedServeError)
@@ -665,6 +666,7 @@ class InferenceServer:
                     return
                 with self._conn_lock:
                     self._conn_inflight += 1
+                t_req = time.perf_counter()
                 try:
                     if self._engine is not None:
                         if not self._serve_decode(conn, inputs, ctx):
@@ -693,6 +695,8 @@ class InferenceServer:
                 finally:
                     with self._conn_lock:
                         self._conn_inflight -= 1
+                    _RING.complete("serve.request", t_req,
+                                   time.perf_counter())
                 if self._draining.is_set():
                     # drained: the in-flight request was answered; a
                     # keep-alive connection must not feed a retiring

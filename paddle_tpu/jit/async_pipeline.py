@@ -146,10 +146,12 @@ class AsyncStepPipeline:
         # stacks + the in-flight window when steps stop retiring — a
         # device hang shows up here as "busy, no heartbeat"
         from ..observability import FlightRecorder
+        from ..observability import tracez as _tracez
         self._recorder = FlightRecorder(
             f"async_steps_{label}",
             busy_fn=lambda: bool(self._inflight),
             context_fn=self._stall_context)
+        self._ring = _tracez.RING
 
     def _stall_context(self):
         now = time.perf_counter()
@@ -167,6 +169,11 @@ class AsyncStepPipeline:
         t = StepTicket(step_index, value, collate_s, dispatch_s)
         self._inflight.append(t)
         self.steps_submitted += 1
+        # dispatch span ends at submit: collate + dispatch led up to it
+        self._ring.complete(
+            f"step.dispatch:{self.label}",
+            t.submit_t - collate_s - dispatch_s, t.submit_t,
+            {"step": step_index})
         self._recorder.beat()
         while len(self._inflight) > self.max_in_flight:
             self._retire(self._inflight[0])
@@ -195,6 +202,10 @@ class AsyncStepPipeline:
                 pass
             self._recorder.beat()
         self.host_blocked_s += blocked
+        if t.ready_t is not None:
+            self._ring.complete(f"step.block:{self.label}",
+                                t.ready_t - blocked, t.ready_t,
+                                {"step": t.step_index})
         if self.record:
             from .. import profiler
             profiler.record_step(

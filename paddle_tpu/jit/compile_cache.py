@@ -146,7 +146,59 @@ def aot_compile(jitted, *args, label: str = "step", use_cache: bool = True,
     from .. import profiler
 
     profiler.record_compile(label, dt, cache)
+    from ..observability import tracez as _tracez
+
+    _tracez.RING.complete(f"compile:{label}", t0, t0 + dt,
+                          {"cache": cache})
     return compiled, stats
+
+
+class _ProfiledExecutable:
+    """The per-executable dispatch hook shared by tracez and profilez.
+
+    Wraps one compiled executable: each call is timed twice — the call
+    itself (JAX dispatches asynchronously, so this is host dispatch
+    cost) and ``block_until_ready`` on the outputs (device execution).
+    Both land in the tracez event ring (one "X" span per dispatch) and
+    the profilez ``paddle_tpu_exec_*`` aggregates, keyed by the owning
+    cache's label.  Every current AotCache call site reads the outputs
+    on the host immediately after dispatching, so blocking here moves
+    the wait, it does not add one.  A poisoned dispatch is NOT re-raised
+    from the hook — it surfaces at the caller's read with its original
+    traceback, exactly as without the wrapper.
+    """
+
+    __slots__ = ("_exe", "_label", "_donate")
+
+    def __init__(self, exe, label: str, donate_argnums: Tuple[int, ...]):
+        self._exe = exe
+        self._label = label
+        self._donate = donate_argnums
+
+    def __getattr__(self, name):      # cost_analysis() etc. pass through
+        return getattr(self._exe, name)
+
+    def __call__(self, *args):
+        donated = 0
+        for i in self._donate:
+            if i < len(args):
+                donated += int(getattr(args[i], "nbytes", 0) or 0)
+        t0 = time.perf_counter()
+        out = self._exe(*args)
+        t1 = time.perf_counter()
+        try:
+            import jax
+
+            jax.block_until_ready(out)
+        except Exception:
+            pass                       # deferred failure: caller's read
+        t2 = time.perf_counter()
+        from ..observability import profilez as _profilez
+        from ..observability import tracez as _tracez
+
+        _tracez.RING.complete(f"exec:{self._label}", t0, t2)
+        _profilez.PROFILER.observe(self._label, t1 - t0, t2 - t1, donated)
+        return out
 
 
 class AotCache:
@@ -162,13 +214,21 @@ class AotCache:
     concurrent batch workers once-semantics (no duplicated XLA run)
     while the compile itself happens *outside* the map lock, so a cold
     bucket compiling never blocks hits on warmed buckets (tsan-lite
-    flagged the old compile-under-lock hold as TPR102)."""
+    flagged the old compile-under-lock hold as TPR102).
 
-    def __init__(self, jitted, label: str = "aot"):
+    Cached executables are returned wrapped in
+    :class:`_ProfiledExecutable`, so every dispatch feeds the tracez
+    event ring and the profilez per-executable aggregates for free."""
+
+    def __init__(self, jitted, label: str = "aot",
+                 donate_argnums: Tuple[int, ...] = ()):
         import threading
 
         self._jitted = jitted
         self._label = label
+        # mirror of the jit's donate_argnums, used only to account
+        # donated input bytes per dispatch (paddle_tpu_exec_donated_bytes)
+        self._donate = tuple(donate_argnums or ())
         self._cache: Dict[tuple, Any] = {}
         self._lock = threading.Lock()
         self._pending: Dict[tuple, Any] = {}  # key -> threading.Event
@@ -205,8 +265,15 @@ class AotCache:
                     mine = False
             if mine:
                 try:
-                    exe, _ = aot_compile(self._jitted, *args,
-                                         label=f"{self._label}:{key}")
+                    exe, stats = aot_compile(self._jitted, *args,
+                                             label=f"{self._label}:{key}")
+                    if stats:   # tests stub aot_compile with stats=None
+                        from ..observability import profilez as _profilez
+
+                        _profilez.PROFILER.record_compile(
+                            self._label, stats["compile_s"])
+                    exe = _ProfiledExecutable(exe, self._label,
+                                              self._donate)
                     with self._lock:
                         self._cache[key] = exe
                     return exe

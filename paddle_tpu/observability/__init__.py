@@ -16,6 +16,11 @@ framework keeps (docs/observability.md has the full catalog):
   * :mod:`.flight_recorder` — the stall watchdog
     (``PADDLE_TPU_STALL_DUMP``): all-thread stack dumps when a busy
     pipeline stops making progress.
+  * :mod:`.tracez` — the always-on bounded event ring + Chrome
+    trace-event exporter (``/tracez``, Perfetto-loadable, merged
+    across processes via wall-clock anchoring).
+  * :mod:`.profilez` — the continuous per-executable profiler fed by
+    the AOT dispatch hook (``paddle_tpu_exec_*``, ``/profilez``).
 """
 from __future__ import annotations
 
@@ -32,6 +37,9 @@ from .flight_recorder import (FlightRecorder, capture_thread_stacks,
 from .timeseries import TimeSeriesStore, varz_interval, varz_capacity
 from .slo import (Objective, SLOEngine, slo_windows, slo_burn_factors,
                   serve_objectives, router_objectives)
+from .tracez import (TraceRing, RING, ring_capacity, merge_traces,
+                     fetch_trace, load_trace)
+from .profilez import ExecProfiler, PROFILER
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
            "counter", "gauge", "histogram", "DEFAULT_BUCKETS",
@@ -41,6 +49,8 @@ __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
            "TimeSeriesStore", "varz_interval", "varz_capacity",
            "Objective", "SLOEngine", "slo_windows", "slo_burn_factors",
            "serve_objectives", "router_objectives",
+           "TraceRing", "RING", "ring_capacity", "merge_traces",
+           "fetch_trace", "load_trace", "ExecProfiler", "PROFILER",
            "install_default_collectors"]
 
 _PROC_T0 = _time.monotonic()

@@ -1,10 +1,12 @@
-"""Admin HTTP endpoint: /metrics, /healthz, /statusz, /varz, /alertz.
+"""Admin HTTP endpoint: /metrics, /healthz, /statusz, /varz, /alertz,
+/tracez, /profilez, with a / index.
 
 A stdlib ``http.server`` front-end (no new dependencies) the serving
 daemon exposes on ``--metrics-port`` / ``PADDLE_TPU_METRICS_PORT`` —
 off by default; loopback by default, like the data-plane socket. All
 routes are GET:
 
+  * ``/``         — index: every endpoint this server mounts, as links.
   * ``/metrics``  — Prometheus text exposition 0.0.4 from the registry
     (Content-Type ``text/plain; version=0.0.4``), scrape-ready.
   * ``/healthz``  — liveness: 200 ``{"status": "ok"}`` while the
@@ -16,6 +18,11 @@ routes are GET:
     :meth:`..timeseries.TimeSeriesStore.varz`); 404 when not mounted.
   * ``/alertz``   — SLO verdicts (``alertz_fn``, normally
     :meth:`..slo.SLOEngine.alertz`); 404 when not mounted.
+  * ``/tracez``   — the event ring as Chrome trace-event JSON (open in
+    ui.perfetto.dev). Defaults to this process's ring; a router mounts
+    a merged fleet view instead.
+  * ``/profilez`` — per-executable continuous-profiler summary, top-N
+    by total block time.
 
 Handlers never execute model code, so a scrape can never trigger a
 compile or perturb the request path beyond a registry/ring read.
@@ -29,6 +36,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional, Tuple
 
 from . import metrics as _metrics
+from . import profilez as _profilez
+from . import tracez as _tracez
 
 __all__ = ["AdminServer", "CONTENT_TYPE_METRICS"]
 
@@ -50,12 +59,20 @@ class AdminServer:
                      Callable[[], Tuple[bool, list]]] = None,
                  status_fn: Optional[Callable[[], dict]] = None,
                  varz_fn: Optional[Callable[[], dict]] = None,
-                 alertz_fn: Optional[Callable[[], dict]] = None):
+                 alertz_fn: Optional[Callable[[], dict]] = None,
+                 tracez_fn: Optional[Callable[[], dict]] = None,
+                 profilez_fn: Optional[Callable[[], dict]] = None):
         self.registry = registry or _metrics.REGISTRY
         self.health_fn = health_fn or (lambda: (True, []))
         self.status_fn = status_fn
         self.varz_fn = varz_fn
         self.alertz_fn = alertz_fn
+        # tracez/profilez default to the process-global ring/profiler so
+        # every admin server ships the execution timeline; a router
+        # passes its own tracez_fn to serve a merged fleet view
+        self.tracez_fn = tracez_fn or (lambda: _tracez.RING.chrome_trace())
+        self.profilez_fn = profilez_fn or \
+            (lambda: _profilez.PROFILER.profilez())
         self._t0 = time.monotonic()
         admin = self
 
@@ -100,11 +117,23 @@ class AdminServer:
                         body = json.dumps(admin.alertz_fn(),
                                           default=str).encode()
                         self._reply(200, body, "application/json")
+                    elif path == "/tracez":
+                        body = json.dumps(admin.tracez_fn(),
+                                          default=str).encode()
+                        self._reply(200, body, "application/json")
+                    elif path == "/profilez":
+                        body = json.dumps(admin.profilez_fn(),
+                                          default=str).encode()
+                        self._reply(200, body, "application/json")
+                    elif path == "/":
+                        self._reply(200, admin._index().encode(),
+                                    "text/html; charset=utf-8")
                     else:
                         self._reply(
                             404,
-                            b'{"error": "unknown path; try /metrics, '
-                            b'/healthz, /statusz, /varz or /alertz"}',
+                            json.dumps({"error": "unknown path",
+                                        "endpoints": sorted(
+                                            admin.endpoints())}).encode(),
                             "application/json")
                 except BrokenPipeError:
                     pass
@@ -126,6 +155,33 @@ class AdminServer:
                                         daemon=True,
                                         name=f"admin-http-{self.port}")
         self._thread.start()
+
+    def endpoints(self) -> dict:
+        """path -> one-line description for every mounted route."""
+        out = {
+            "/metrics": "Prometheus text exposition (registry scrape)",
+            "/healthz": "liveness verdict (200 ok / 503 + reasons)",
+            "/statusz": "one-shot JSON status snapshot",
+            "/tracez": "event ring as Chrome trace-event JSON "
+                       "(open in ui.perfetto.dev)",
+            "/profilez": "per-executable profiler, top-N by block time",
+        }
+        if self.varz_fn is not None:
+            out["/varz"] = "windowed time-series history"
+        if self.alertz_fn is not None:
+            out["/alertz"] = "SLO burn-rate verdicts"
+        return out
+
+    def _index(self) -> str:
+        """The / index page: mounted endpoints as links, so operators
+        stop guessing paths."""
+        rows = "\n".join(
+            f'  <li><a href="{p}"><code>{p}</code></a> — {desc}</li>'
+            for p, desc in sorted(self.endpoints().items()))
+        return ("<!DOCTYPE html>\n<html><head>"
+                "<title>paddle_tpu admin</title></head>\n"
+                f"<body><h1>paddle_tpu admin :{self.port}</h1>\n"
+                f"<ul>\n{rows}\n</ul></body></html>\n")
 
     # wrapped so a raising callback degrades to "unhealthy, reason" /
     # a minimal status body instead of a 500

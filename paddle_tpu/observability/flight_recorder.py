@@ -14,6 +14,8 @@ seconds (default 60), the recorder writes ONE timestamped JSON dump:
     name — the "where is everyone stuck" snapshot;
   * the component's context (`context_fn`: queue depth, oldest request
     age, in-flight tickets...);
+  * the tail of the tracez event ring (last ~200 events per thread) —
+    what each thread was *doing* before it parked, not just where;
   * the full metrics registry snapshot.
 
 It re-arms only after progress resumes, so a single stall produces a
@@ -52,6 +54,16 @@ def stall_timeout(default: float = 60.0) -> float:
         return float(raw) if raw is not None else float(default)
     except ValueError:
         return default
+
+
+def _event_ring_tail(per_thread: int = 200) -> dict:
+    """Last ~200 trace-ring events per thread (tracez.TraceRing.tail);
+    degrades to an error marker rather than spoiling a dump."""
+    try:
+        from . import tracez as _tracez
+        return _tracez.RING.tail(per_thread=per_thread)
+    except Exception as e:   # the dump must land even if the ring can't
+        return {"events_error": repr(e)}
 
 
 def capture_thread_stacks() -> dict:
@@ -163,6 +175,9 @@ class FlightRecorder:
             "pid": os.getpid(),
             "context": context,
             "threads": capture_thread_stacks(),
+            # the event-ring tail: stacks say where each thread is
+            # parked, the tail says what it was doing on the way there
+            "events": _event_ring_tail(),
             "metrics": self._registry.flat(),
         }
         self.last = payload

@@ -10,6 +10,15 @@ emitted as one JSONL line per request to ``PADDLE_TPU_TRACE_FILE``
 (default stderr), so a production incident can be traced without a
 profiler attach. Sampling is deterministic in the request id (a hashed
 rate gate), which keeps traces reproducible under replay.
+
+Trace-line ``ts`` values come from the recorder's wall-clock anchor —
+one ``(time.time(), time.perf_counter())`` pair captured at recorder
+construction, the same anchoring :mod:`.tracez` uses for its event ring
+— so timestamps from different processes sit on one skew-corrected
+timeline (and an NTP step mid-run cannot tear a trace apart). The JSONL
+file rotates at ``PADDLE_TPU_TRACE_MAX_BYTES`` (keep-last-2: the live
+file plus ``<path>.1``), bounding what an always-sampled incident
+window can write.
 """
 from __future__ import annotations
 
@@ -25,7 +34,7 @@ from ..core import flags as _flags
 from . import metrics as _metrics
 
 __all__ = ["SpanRecorder", "next_request_id", "request_id_base",
-           "trace_sample_rate"]
+           "trace_sample_rate", "trace_max_bytes"]
 
 SPAN_STAGES = ("queue_wait", "pad", "execute", "unpad")
 
@@ -69,6 +78,14 @@ def trace_sample_rate(env: Optional[str] = None) -> float:
     return min(max(rate, 0.0), 1.0)
 
 
+def trace_max_bytes() -> int:
+    """``PADDLE_TPU_TRACE_MAX_BYTES``; <= 0 disables rotation."""
+    try:
+        return int(_flags.env_value("PADDLE_TPU_TRACE_MAX_BYTES"))
+    except (ValueError, TypeError):
+        return 0
+
+
 class SpanRecorder:
     """Feeds span breakdowns into the registry and (sampled) a JSONL sink.
 
@@ -90,8 +107,14 @@ class SpanRecorder:
             else min(max(float(sample), 0.0), 1.0)
         self.path = _flags.env_value("PADDLE_TPU_TRACE_FILE") \
             if path is None else path
+        self.max_bytes = trace_max_bytes()
+        # wall anchor (see module docstring): ts = anchor_wall + elapsed
+        # monotonic, matching tracez.TraceRing's clock model exactly
+        self._anchor_wall = time.time()
+        self._anchor_mono = time.perf_counter()
         self._lock = threading.Lock()
         self._file = None
+        self._bytes = 0
 
     def sampled(self, req_id: int) -> bool:
         if self.sample <= 0.0:
@@ -122,7 +145,8 @@ class SpanRecorder:
         emit = self.sampled(req_id) if force is None else bool(force)
         if not emit:
             return
-        line = {"ts": round(time.time(), 6),
+        line = {"ts": round(self._anchor_wall +
+                            (time.perf_counter() - self._anchor_mono), 6),
                 "component": self.component,
                 "request_id": int(req_id)}
         line.update({f"{k}_s": round(float(v), 6)
@@ -133,17 +157,44 @@ class SpanRecorder:
         self._emit(json.dumps(line))
 
     def _emit(self, text: str):
+        data = text + "\n"
         with self._lock:
             try:
                 if self.path:
                     if self._file is None:
                         self._file = open(self.path, "a")
-                    self._file.write(text + "\n")
+                        try:
+                            self._bytes = os.fstat(
+                                self._file.fileno()).st_size
+                        except OSError:
+                            self._bytes = 0
+                    if self.max_bytes > 0 and self._bytes > 0 and \
+                            self._bytes + len(data) > self.max_bytes:
+                        self._rotate_locked()
+                    self._file.write(data)
                     self._file.flush()
+                    self._bytes += len(data)
                 else:
-                    sys.stderr.write("SPAN " + text + "\n")
+                    sys.stderr.write("SPAN " + data)
             except OSError:
                 pass            # tracing must never fail a request
+
+    def _rotate_locked(self):
+        # keep-last-2: the live file plus one predecessor (<path>.1,
+        # overwritten each rotation). A single line larger than the cap
+        # still lands whole — the cap bounds growth, it never truncates
+        # a trace line.
+        try:
+            self._file.close()
+        except OSError:
+            pass
+        self._file = None
+        try:
+            os.replace(self.path, self.path + ".1")
+        except OSError:
+            pass
+        self._file = open(self.path, "a")
+        self._bytes = 0
 
     def close(self):
         with self._lock:

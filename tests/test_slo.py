@@ -472,6 +472,7 @@ def test_router_assembles_trace_with_backend_breakdown(
     monkeypatch.setenv("PADDLE_TPU_TRACE_SAMPLE", "1.0")
     monkeypatch.setenv("PADDLE_TPU_TRACE_FILE", str(trace))
     stub = _StubBackend()
+    t_wall0 = time.time()
     router = ServeRouter([Backend("127.0.0.1", stub.port,
                                   stub.admin.port)],
                          port=0, poll_interval=0.05)
@@ -518,6 +519,11 @@ def test_router_assembles_trace_with_backend_breakdown(
             assert line["total_s"] == pytest.approx(
                 line["pick_s"] + line["forward_s"] + line["reply_s"],
                 abs=5e-6)
+            # span timestamps are anchored to the wall clock (same
+            # anchoring as the tracez ring) so cross-process merges
+            # need no skew correction: ts is epoch seconds inside the
+            # test's own wall-clock window
+            assert t_wall0 - 1.0 <= line["ts"] <= time.time() + 1.0
         assert lines[0]["client_traced"] is False
         assert lines[1]["client_traced"] is True
         assert lines[1]["trace_id"] == 123456
