@@ -775,6 +775,158 @@ def run_router_bench(args):
     }
 
 
+def run_decode_router_bench(args):
+    """Streaming fleet mode (``--decode --router N``): N decode backends
+    behind the ServeRouter with >= 16 concurrent token streams driven
+    over the wire. A no-kill pass is run first as the correctness
+    baseline; with ``--kill-one`` the scored pass stops one backend
+    abruptly mid-token. The contract: ``lost`` stays 0 (every stream
+    completes), every greedy stream's tokens are byte-identical to the
+    no-kill pass, and each client observes a gapless, duplicate-free
+    ``seq`` run — failover cost reported from the router's histogram."""
+    import socket
+    import threading
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.decode import save_for_decode
+    from paddle_tpu.inference.router import Backend, ServeRouter
+    from paddle_tpu.inference.serve import InferenceServer, decode_request
+    from paddle_tpu.models.gpt import GPT, gpt_tiny
+    from paddle_tpu.observability import REGISTRY
+
+    paddle.seed(args.seed)
+    cfg = gpt_tiny()
+    prefix = os.path.join(tempfile.mkdtemp(prefix="serve_bench_dec_"),
+                          "gpt")
+    save_for_decode(GPT(cfg), prefix)
+
+    fleet = max(args.router, 2)
+    n_streams = max(args.decode_requests, 16)
+    max_new = args.decode_tokens or 24
+    rng = np.random.default_rng(args.seed)
+    prompts = [rng.integers(0, cfg.vocab_size,
+                            size=int(rng.integers(4, 17))).astype(np.int32)
+               for _ in range(n_streams)]
+
+    def run_pass(kill_after=None):
+        srvs = [InferenceServer(prefix, port=0, decode=True,
+                                decode_slots=max(args.decode_slots, 4),
+                                decode_max_new=max_new, metrics_port=0)
+                for _ in range(fleet)]
+        router = ServeRouter(
+            [Backend("127.0.0.1", s.port, s.metrics_port) for s in srvs],
+            port=0, poll_interval=0.1)
+        deadline = time.perf_counter() + 10.0
+        while time.perf_counter() < deadline:
+            bs = router.backends()
+            if bs and all(b.trace_wire for b in bs):
+                break
+            time.sleep(0.05)
+
+        lock = threading.Lock()
+        token_count = [0]
+        killed = {"key": None}
+        outs = [None] * n_streams
+        seq_ok = [True] * n_streams
+        errs = []
+
+        def on_token(seqs):
+            def cb(tok, stream):
+                seqs.append(int(stream.get("seq", -1)))
+                with lock:
+                    token_count[0] += 1
+                    fire = (kill_after is not None
+                            and killed["key"] is None
+                            and token_count[0] >= kill_after)
+                    if fire:
+                        killed["key"] = f"127.0.0.1:{srvs[1].port}"
+                if fire:
+                    srvs[1].stop()   # abrupt: mid-token, no drain
+            return cb
+
+        def client(i):
+            seqs = []
+            try:
+                with socket.create_connection(
+                        ("127.0.0.1", router.port)) as s:
+                    s.settimeout(120)
+                    outs[i] = decode_request(
+                        s, prompts[i], opts={"max_new_tokens": max_new},
+                        on_token=on_token(seqs))
+                seq_ok[i] = seqs == list(range(len(seqs)))
+            except Exception as e:
+                errs.append(f"stream {i}: {e!r}")
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=client, args=(i,), daemon=True)
+                   for i in range(n_streams)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        wall_s = time.perf_counter() - t0
+        router.stop()
+        for s in srvs:
+            s.stop()
+        return {"outs": outs, "errs": errs, "seq_ok": seq_ok,
+                "wall_s": wall_s, "killed": killed["key"]}
+
+    baseline = run_pass()
+    if baseline["errs"]:
+        raise RuntimeError(f"baseline pass lost streams: "
+                           f"{baseline['errs'][:3]}")
+
+    flat0 = REGISTRY.flat()
+    kill_after = (n_streams * max_new) // 3 if args.kill_one else None
+    scored = run_pass(kill_after=kill_after)
+    flat = REGISTRY.flat()
+    fo_hist = REGISTRY.get("paddle_tpu_router_failover_latency_seconds")
+
+    lost = sum(1 for o in scored["outs"] if o is None)
+    identical = all(
+        a is not None and b is not None and list(a) == list(b)
+        for a, b in zip(baseline["outs"], scored["outs"]))
+    tokens = sum(len(o) for o in scored["outs"] if o is not None)
+    tps = tokens / scored["wall_s"] if scored["wall_s"] > 0 else 0.0
+
+    def delta(name):
+        return int(float(flat.get(name, 0)) - float(flat0.get(name, 0)))
+
+    return {
+        "metric": "serve_decode_router_stream",
+        "value": round(tps, 2),
+        "unit": "tokens/s",
+        # the contract IS the baseline: every stream survives,
+        # byte-identical, gapless
+        "vs_baseline": 1.0 if (lost == 0 and identical
+                               and all(scored["seq_ok"])) else 0.0,
+        "fleet": fleet,
+        "streams": n_streams,
+        "max_new_tokens": max_new,
+        "lost": lost,
+        "lost_detail": scored["errs"][:5],
+        "byte_identical": identical,
+        "seq_gapless": all(scored["seq_ok"]),
+        "killed_backend": scored["killed"],
+        "stream_failovers": delta(
+            "paddle_tpu_router_stream_failovers_total"),
+        "resumed_tokens": delta(
+            "paddle_tpu_router_stream_resumed_tokens_total"),
+        "streams_lost_metric": delta(
+            "paddle_tpu_router_stream_lost_total"),
+        "failover_p95_ms": round(
+            fo_hist.percentile(0.95) * 1e3, 3) if fo_hist else 0.0,
+        "failover_max_ms": round(
+            fo_hist.percentile(1.0) * 1e3, 3) if fo_hist else 0.0,
+        "tokens_per_s": round(tps, 2),
+        "wall_s": round(scored["wall_s"], 3),
+        "router_metrics": {k: v for k, v in flat.items()
+                           if k.startswith("paddle_tpu_router_stream_")
+                           or k.startswith(
+                               "paddle_tpu_router_membership_")},
+    }
+
+
 def main():
     ap = argparse.ArgumentParser(description="serving engine benchmark")
     ap.add_argument("--requests", type=int, default=400)
@@ -814,7 +966,9 @@ def main():
     args = ap.parse_args()
     _devices_or_cpu_fallback()
     try:
-        if args.decode:
+        if args.decode and args.router:
+            out = run_decode_router_bench(args)
+        elif args.decode:
             out = run_decode_bench(args)
         elif args.router:
             out = run_router_bench(args)

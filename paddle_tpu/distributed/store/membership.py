@@ -1,0 +1,148 @@
+"""TTL'd fleet membership over a rendezvous :class:`Store`.
+
+The serving fleet needs backends to join and leave a running router
+without supervisor edits — the gen_comm_id_helper pattern (TCP
+bootstrap exchanging endpoints) generalized into a tiny group registry
+any :class:`Store` can back (TCPStore in production, FileStore in
+tests).
+
+The store interface has no key-scan verb, so the schema is a counter
+plus per-slot keys under ``__members__/<group>/``:
+
+    nslots          ADD counter; each publisher claims slot ``add(+1)``
+    slot/<i>        JSON record {"key": "host:port", "admin_port": ...,
+                    "status": "up" | "left"}
+    hb/<i>          heartbeat ADD counter, bumped every ``interval``
+
+Liveness is judged by the *watcher's* clock: a member is live while its
+beat counter keeps changing (last observed change within ``ttl``), so
+publisher/watcher clock skew cannot expire a healthy member. A clean
+leave flips the slot record to ``"left"`` and takes effect on the next
+poll; a crash simply stops the beats and ages out after ``ttl``.
+``add`` is at-least-once under the store's retry loop, so a retried
+slot claim can burn a slot — watchers skip slots with no record.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, Optional
+
+from . import FileStore, Store, TCPStore
+
+__all__ = ["connect", "MembershipPublisher", "MembershipWatcher"]
+
+
+def connect(endpoint: str) -> Store:
+    """A store client for ``endpoint``: ``host:port`` dials a TCPStore,
+    anything else is a FileStore directory path."""
+    host, _, port = endpoint.rpartition(":")
+    if host and port.isdigit():
+        return TCPStore(endpoint)
+    return FileStore(endpoint)
+
+
+def _prefix(group: str) -> str:
+    return f"__members__/{group}/"
+
+
+class MembershipPublisher:
+    """One backend's registration: claim a slot, publish the record,
+    beat until :meth:`leave`."""
+
+    def __init__(self, store: Store, key: str, group: str = "serve",
+                 admin_port: Optional[int] = None, interval: float = 1.0):
+        self._store = store
+        self._p = _prefix(group)
+        self.key = key
+        self.admin_port = admin_port
+        self.interval = float(interval)
+        self.slot: Optional[int] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _record(self, status: str) -> bytes:
+        return json.dumps({"key": self.key, "admin_port": self.admin_port,
+                           "status": status}).encode()
+
+    def start(self) -> "MembershipPublisher":
+        self.slot = int(self._store.add(self._p + "nslots", 1))
+        self._store.set(f"{self._p}slot/{self.slot}", self._record("up"))
+        self._store.add(f"{self._p}hb/{self.slot}", 1)
+        self._thread = threading.Thread(
+            target=self._beat_loop, daemon=True,
+            name=f"membership-beat:{self.key}")
+        self._thread.start()
+        return self
+
+    def _beat_loop(self):
+        while not self._stop.wait(self.interval):
+            try:
+                self._store.add(f"{self._p}hb/{self.slot}", 1)
+            except Exception:
+                continue         # transient store fault: next beat retries
+
+    def leave(self, timeout: float = 5.0):
+        """Deregister cleanly: watchers drop the member on their next
+        poll instead of waiting out the TTL."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+        if self.slot is not None:
+            try:
+                self._store.set(f"{self._p}slot/{self.slot}",
+                                self._record("left"))
+            except Exception:
+                pass             # crash-equivalent: TTL expiry covers it
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.leave()
+
+
+class MembershipWatcher:
+    """Polls the group keyspace and reports the live member set.
+
+    Not thread-safe: one owner calls :meth:`poll` (the router does so
+    from its membership thread)."""
+
+    def __init__(self, store: Store, group: str = "serve",
+                 ttl: float = 5.0):
+        self._store = store
+        self._p = _prefix(group)
+        self.ttl = float(ttl)
+        # slot -> [last beat value, local monotonic time it last changed]
+        self._beats: Dict[int, list] = {}
+
+    def poll(self) -> Dict[str, dict]:
+        """key -> member record for every live member, judged now."""
+        now = time.monotonic()
+        try:
+            nslots = int(self._store.add(self._p + "nslots", 0))
+        except Exception:
+            nslots = 0
+        live: Dict[str, dict] = {}
+        for slot in range(1, nslots + 1):
+            raw = self._store.get(f"{self._p}slot/{slot}")
+            if raw is None:
+                continue         # burned slot (retried claim), skip
+            try:
+                rec = json.loads(raw.decode())
+            except ValueError:
+                continue
+            if rec.get("status") != "up" or not rec.get("key"):
+                self._beats.pop(slot, None)
+                continue
+            hb = self._store.get(f"{self._p}hb/{slot}")
+            beat = int.from_bytes(hb, "little", signed=True) if hb else 0
+            seen = self._beats.get(slot)
+            if seen is None or seen[0] != beat:
+                self._beats[slot] = seen = [beat, now]
+            if now - seen[1] > self.ttl:
+                continue         # beats stopped: crashed / partitioned
+            rec["slot"] = slot
+            live[rec["key"]] = rec
+        return live

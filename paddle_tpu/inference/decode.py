@@ -334,17 +334,19 @@ class DecodeStream:
 
 class _Req:
     __slots__ = ("id", "prompt", "max_new", "temperature", "top_k",
-                 "eos_id", "stream", "cache_len", "last_tok", "generated",
-                 "pages", "input_tail", "feeding",
+                 "eos_id", "seed", "stream", "cache_len", "last_tok",
+                 "generated", "pages", "input_tail", "feeding",
                  "t_submit", "t_admit", "prefill_s")
 
-    def __init__(self, prompt, max_new, temperature, top_k, eos_id):
+    def __init__(self, prompt, max_new, temperature, top_k, eos_id,
+                 seed=None):
         self.id = next_request_id()
         self.prompt = prompt
         self.max_new = max_new
         self.temperature = temperature
         self.top_k = top_k
         self.eos_id = eos_id
+        self.seed = seed         # per-stream sampling seed (None -> engine RNG)
         self.stream = DecodeStream(self.id, prompt)
         self.cache_len = 0
         self.last_tok = 0
@@ -364,8 +366,10 @@ class _SpecReq(_Req):
     __slots__ = ("draft_len", "spec_k", "accept_ema", "drafted",
                  "accepted")
 
-    def __init__(self, prompt, max_new, temperature, top_k, eos_id):
-        super().__init__(prompt, max_new, temperature, top_k, eos_id)
+    def __init__(self, prompt, max_new, temperature, top_k, eos_id,
+                 seed=None):
+        super().__init__(prompt, max_new, temperature, top_k, eos_id,
+                         seed=seed)
         self.draft_len = 0       # draft-pool rows written (positions)
         self.spec_k = 1          # per-slot adaptive k (set at admission)
         self.accept_ema = 1.0    # EMA of per-tick acceptance rate
@@ -560,7 +564,7 @@ class DecodeEngine:
 
     def submit(self, prompt: Sequence[int], max_new_tokens=None,
                temperature: float = 0.0, top_k: int = 0,
-               eos_id=None) -> DecodeStream:
+               eos_id=None, seed=None) -> DecodeStream:
         toks = [int(t) for t in np.asarray(prompt, dtype=np.int64).reshape(-1)]
         if not toks:
             raise TypedServeError(ERR_INVALID_ARGUMENT, "empty prompt")
@@ -576,7 +580,8 @@ class DecodeEngine:
         req = self._req_cls(toks,
                             int(max_new_tokens or self.max_new_tokens),
                             float(temperature), int(top_k),
-                            self.eos_id if eos_id is None else int(eos_id))
+                            self.eos_id if eos_id is None else int(eos_id),
+                            seed=None if seed is None else int(seed))
         with self._cond:
             if self._stop:
                 raise TypedServeError(ERR_UNAVAILABLE,
@@ -1022,11 +1027,25 @@ class DecodeEngine:
         p /= p.sum()
         return p
 
-    def _sample(self, row: np.ndarray, req: _Req) -> int:
+    def _req_rng(self, req: _Req, pos: int):
+        """Sampling generator for the token at absolute sequence
+        position `pos`. Seeded streams draw from a counter-based RNG
+        keyed on (seed, position), so a resumed stream — resubmitted as
+        `prompt + tokens_emitted_so_far` with the same seed — samples
+        the remaining positions draw-for-draw identically to the
+        uninterrupted run, regardless of engine history or batch mates.
+        Unseeded requests share the engine RNG."""
+        if req.seed is None:
+            return self._rng
+        return np.random.default_rng((req.seed, pos))
+
+    def _sample(self, row: np.ndarray, req: _Req, pos=None) -> int:
         if req.temperature <= 0.0:
             return int(np.argmax(row))
         p = self._dist(row, req)
-        return int(self._rng.choice(p.shape[0], p=p))
+        if pos is None:
+            pos = len(req.prompt) + len(req.generated)
+        return int(self._req_rng(req, pos).choice(p.shape[0], p=p))
 
     def _update_gauges(self):
         n = len(self._active)
@@ -1427,14 +1446,16 @@ class SpecDecodeEngine(DecodeEngine):
                 accept = False
                 if req.temperature > 0.0 and lognp is None:
                     lognp = np.asarray(logits)
+                pos = len(req.prompt) + len(req.generated) + len(emitted)
                 if a < nd:
                     d = drafts[a]
                     if req.temperature <= 0.0:
                         tok = int(amaxnp[j, i])
                         accept = tok == d
                     else:
+                        g = self._req_rng(req, pos)
                         p = self._dist(lognp[j, i], req)
-                        if self._rng.random() < p[d]:
+                        if g.random() < p[d]:
                             accept, tok = True, d
                         else:
                             q = p.copy()
@@ -1443,12 +1464,11 @@ class SpecDecodeEngine(DecodeEngine):
                             if s <= 0.0:        # p was a point mass on d
                                 accept, tok = True, d
                             else:
-                                tok = int(self._rng.choice(
-                                    q.shape[0], p=q / s))
+                                tok = int(g.choice(q.shape[0], p=q / s))
                 elif req.temperature <= 0.0:
                     tok = int(amaxnp[j, i])
                 else:
-                    tok = self._sample(lognp[j, i], req)
+                    tok = self._sample(lognp[j, i], req, pos=pos)
                 emitted.append(tok)
                 if accept:
                     a += 1

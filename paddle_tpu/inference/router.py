@@ -35,6 +35,23 @@ What the router does per request:
   (SIGTERM was delivered; it is finishing in-flight work) is routed
   around within one poll interval; the router itself drains the same
   way (``drain()`` / SIGTERM in ``main_router``).
+* **Stream-aware decode proxy** — a PDI2 request whose context carries
+  a ``decode`` field leaves the one-reply fast path: the router relays
+  the backend's seq-numbered token frames while recording every emitted
+  token, and a backend dying mid-stream is *resumed* on another backend
+  as ``prompt + tokens_emitted_so_far`` — greedy decode is
+  deterministic and sampled decode carries a per-stream seed, so the
+  client sees one gapless, duplicate-free, token-identical stream
+  (``_handle_stream``; chaos site ``router.stream_relay``). PDI2
+  decode requests must carry the ``decode`` context field (the
+  ``decode_request`` helper always does); a bare PDI2 decode frame
+  would be mis-relayed as a one-reply exchange.
+* **Dynamic membership** — ``watch_membership`` follows a
+  ``distributed/store`` registry (TCPStore in production, FileStore in
+  tests): backends publish TTL'd heartbeat keys at startup and a
+  "left" record at drain, and the watcher calls ``add_backend`` /
+  ``remove_backend`` live — fleet joins/leaves need no supervisor
+  edits and no router restart.
 
 ``BackendSupervisor`` optionally owns the fleet: ``--fleet N`` spawns N
 ``serve.py`` daemons from the model prefix, restarts dead ones with
@@ -74,6 +91,9 @@ import threading
 import time
 from http.client import HTTPConnection
 
+import numpy as np
+
+from ..core import flags as _flags
 from ..observability import (FlightRecorder, SLOEngine, SpanRecorder,
                              TimeSeriesStore, next_request_id,
                              request_id_base, router_objectives)
@@ -159,6 +179,29 @@ def _router_metrics():
             "paddle_tpu_router_poll_failures_total",
             "Health polls that failed outright (dial refused, admin "
             "unreachable, poll raised), per backend", ("backend",)),
+        "stream_active": gauge(
+            "paddle_tpu_router_stream_active",
+            "Decode streams currently being relayed through the router"),
+        "stream_failovers": counter(
+            "paddle_tpu_router_stream_failovers_total",
+            "Decode streams re-issued to another backend after a "
+            "mid-stream wire failure or typed UNAVAILABLE frame"),
+        "stream_resumed_tokens": counter(
+            "paddle_tpu_router_stream_resumed_tokens_total",
+            "Tokens already emitted that were carried into a resume "
+            "re-issue (prompt + tokens so far) across stream failovers"),
+        "stream_lost": counter(
+            "paddle_tpu_router_stream_lost_total",
+            "Decode streams the router could not complete or resume "
+            "(client got a typed UNAVAILABLE instead of a done frame)"),
+        "membership_backends": gauge(
+            "paddle_tpu_router_membership_backends",
+            "Live members in the membership registry at the last "
+            "watcher poll"),
+        "membership_events": counter(
+            "paddle_tpu_router_membership_events_total",
+            "Routing-table updates driven by the membership watcher, "
+            "by event (join, leave)", ("event",)),
     }
 
 
@@ -294,18 +337,31 @@ class ServeRouter:
                  failover_retries: int = 2, forward_timeout: float = 130.0,
                  connect_timeout: float = 2.0, idle_timeout: float = None,
                  metrics_port: int = None, retry_budget: RetryBudget = None,
-                 max_inflight_per_backend: int = 256):
+                 max_inflight_per_backend: int = 256,
+                 stream_retries: int = None):
         self._backends = list(backends)
         self._block = threading.Lock()          # routing-table lock
         self._poll_interval = float(poll_interval)
         self._watermark = int(shed_watermark)
         self._failover_retries = max(int(failover_retries), 0)
+        self._stream_retries = max(int(
+            _flags.env_value("PADDLE_TPU_ROUTER_STREAM_RETRIES")
+            if stream_retries is None else stream_retries), 0)
         self._forward_timeout = forward_timeout
         self._connect_timeout = float(connect_timeout)
         self._idle_timeout = float(idle_timeout) if idle_timeout else None
         self._budget = retry_budget or RetryBudget()
         self._max_inflight = max(int(max_inflight_per_backend), 1)
         self._local = threading.local()         # per-thread conn cache
+        # every thread's cache dict, so remove_backend can purge a dead
+        # backend's sockets fleet-wide, not just the calling thread's
+        self._conn_caches = {}                  # thread -> cache dict
+        self._conn_caches_lock = threading.Lock()
+        # dynamic membership (watch_membership): watcher + bookkeeping
+        self._membership = None
+        self._membership_thread = None
+        self._membership_interval = None
+        self._member_keys = set()
         self._rr = 0                            # tie-break rotation
         self._m = _router_metrics()
         self._inflight = 0
@@ -378,11 +434,81 @@ class ServeRouter:
     def remove_backend(self, key: str):
         with self._block:
             self._backends = [b for b in self._backends if b.key != key]
+        # purge the removed backend's cached keep-alive sockets in EVERY
+        # thread, not just this one — a backend re-added on the same
+        # host:port must never inherit a half-dead socket from a thread
+        # that had no request in between. dict.pop is atomic under the
+        # GIL; the owning thread sees a miss and dials fresh, and a
+        # socket closed mid-request surfaces as a wire failure the
+        # failover loop already handles.
+        dead = []
+        with self._conn_caches_lock:
+            for t in [t for t in self._conn_caches if not t.is_alive()]:
+                dead.extend(self._conn_caches.pop(t).values())
+            caches = list(self._conn_caches.values())
+        for cache in caches:
+            dead.append(cache.pop(key, None))
+        for s in dead:
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
         # drop the dead backend's per-backend samples so /metrics does
         # not advertise an address that no longer exists
         for fam in ("backend_up", "breaker_state", "backend_queue",
                     "poll_failures", "backend_requests"):
             self._m[fam].remove(backend=key)
+
+    # -- dynamic membership ----------------------------------------------
+
+    def watch_membership(self, store, group: str = "serve", ttl=None,
+                         interval: float = None):
+        """Follow a ``distributed/store`` membership registry: backends
+        publishing into ``group`` (see ``membership.MembershipPublisher``)
+        are added to the routing table on join and removed on clean
+        leave or heartbeat expiry — no supervisor edits, no router
+        restart. ``store`` is a :class:`Store` instance or an endpoint
+        string (``HOST:PORT`` for TCPStore, else a FileStore path).
+        Statically configured backends are never membership-removed."""
+        from ..distributed.store.membership import MembershipWatcher
+        from ..distributed.store.membership import connect as _store_connect
+        if isinstance(store, str):
+            store = _store_connect(store)
+        ttl = float(_flags.env_value("PADDLE_TPU_MEMBERSHIP_TTL")
+                    if ttl is None else ttl)
+        self._membership = MembershipWatcher(store, group=group, ttl=ttl)
+        self._membership_interval = float(interval or self._poll_interval)
+        self._membership_thread = threading.Thread(
+            target=self._membership_loop, daemon=True,
+            name="router-membership")
+        self._membership_thread.start()
+        return self._membership
+
+    def _membership_loop(self):
+        while not self._stop.is_set():
+            try:
+                live = self._membership.poll()
+            except Exception:
+                live = None      # store unreachable: keep current table
+            if live is not None:
+                current = {b.key for b in self.backends()}
+                for key, rec in live.items():
+                    if key in current:
+                        continue
+                    host, port = key.rsplit(":", 1)
+                    self.add_backend(Backend(host, int(port),
+                                             rec.get("admin_port")))
+                    self._member_keys.add(key)
+                    self._m["membership_events"].labels(event="join").inc()
+                for key in list(self._member_keys):
+                    if key not in live:
+                        self.remove_backend(key)
+                        self._member_keys.discard(key)
+                        self._m["membership_events"].labels(
+                            event="leave").inc()
+                self._m["membership_backends"].set(len(live))
+            self._stop.wait(self._membership_interval)
 
     # -- health polling --------------------------------------------------
 
@@ -516,6 +642,8 @@ class ServeRouter:
         cache = getattr(self._local, "conns", None)
         if cache is None:
             cache = self._local.conns = {}
+            with self._conn_caches_lock:
+                self._conn_caches[threading.current_thread()] = cache
         return cache
 
     def _backend_conn(self, b: Backend) -> socket.socket:
@@ -668,6 +796,255 @@ class ServeRouter:
                 f"{ERR_UNAVAILABLE}: no backend answered after "
                 f"{attempts} attempt(s): {detail}")
 
+    # -- decode stream relay ---------------------------------------------
+
+    def _stream_conn(self, b: Backend) -> socket.socket:
+        """A dedicated socket for one stream attempt — never the shared
+        keep-alive cache: a stream holds its connection for seconds, and
+        a failed one is poisoned mid-frame by definition."""
+        s = socket.create_connection((b.host, b.port),
+                                     timeout=self._connect_timeout)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        s.settimeout(self._forward_timeout)
+        return s
+
+    def _stream_ctx(self, rid, trace_id, stream_fields):
+        return {"trace_id": trace_id, "request_id": rid,
+                "stream": stream_fields}
+
+    def _finish_stream(self, conn, rid, trace_id, emitted) -> bool:
+        """Write the client's done frame from the router's own record
+        (used both after a relayed done frame and when the backend died
+        with nothing left to generate). False when the client is gone."""
+        try:
+            write_tensors(conn, [np.asarray(emitted, np.int32)],
+                          ctx=self._stream_ctx(
+                              rid, trace_id,
+                              {"done": True, "n_tokens": len(emitted)}))
+            return True
+        except (ConnectionError, TimeoutError, OSError):
+            return False
+
+    def _handle_stream(self, conn, arrays, cctx, rid, trace_id):
+        """Proxy one decode stream with mid-stream failover.
+
+        The state machine (docs/fault_tolerance.md "Streaming
+        failover"): relay the backend's seq-numbered token frames to the
+        client while recording every emitted token; on a wire failure or
+        typed ``UNAVAILABLE``, re-issue the request to another routable
+        backend as ``prompt + tokens_emitted_so_far`` (a resume is just
+        a longer prefill; greedy decode is argmax-deterministic and
+        sampled decode carries a per-stream seed, so the continuation is
+        token-identical). Backend seq restarts at 0 per attempt, so
+        client seq = tokens-already-relayed + backend seq; frames that
+        would rewind it are dropped — the client sees one gapless,
+        duplicate-free stream. Each failover spends from the shared
+        retry budget and from the per-stream ``stream_retries`` cap.
+        The half-open breaker probe resolves at the FIRST relayed frame
+        (stream established), not stream completion, so a minutes-long
+        stream cannot pin a breaker in HALF_OPEN.
+
+        Returns ``(outcome, conn_alive)``.
+        """
+        opts = dict(cctx.get("decode") or {})
+        prompt = [int(t) for t in np.asarray(arrays[0]).reshape(-1)]
+        max_new = opts.get("max_new_tokens")
+        max_new = None if max_new is None else int(max_new)
+        temperature = float(opts.get("temperature") or 0.0)
+        if temperature > 0.0 and opts.get("seed") is None:
+            # sampled decode only resumes token-identically with a
+            # per-stream seed; mint one so every attempt samples the
+            # same continuation
+            opts["seed"] = int.from_bytes(os.urandom(4), "little")
+        self._budget.record_request()
+        emitted = []             # tokens relayed to the client, in order
+        eos_seen = False
+        tried = set()
+        attempts = 0
+        first_failure_t = None
+        last_err = None
+        max_attempts = 1 + self._stream_retries
+        while attempts < max_attempts:
+            if emitted and (eos_seen or
+                            (max_new is not None
+                             and len(emitted) >= max_new)):
+                # the backend died between its last token and the done
+                # frame: nothing is left to generate — synthesize the
+                # done frame from the router's record
+                return (("ok", True)
+                        if self._finish_stream(conn, rid, trace_id,
+                                               emitted)
+                        else ("ok", False))
+            try:
+                b = self._choose(exclude=tried)
+            except TypedServeError as e:         # shed: every backend busy
+                if not emitted:
+                    try:
+                        write_error(conn, str(e), ctx=self._stream_ctx(
+                            rid, trace_id, {"done": True, "error": True,
+                                            "seq": 0}))
+                    except OSError:
+                        return ("shed", False)
+                    return ("shed", True)
+                # mid-stream shed is a lost stream, same as no backend
+                break
+            if b is None:
+                break
+            if attempts > 0:
+                if not self._budget.try_spend():
+                    self._m["budget_denied"].inc()
+                    last_err = (f"retry budget exhausted after "
+                                f"{last_err}")
+                    break
+                self._m["stream_failovers"].inc()
+                if emitted:
+                    self._m["stream_resumed_tokens"].inc(len(emitted))
+            attempts += 1
+            tried.add(b.key)
+            seq_base = len(emitted)
+            send_opts = dict(opts)
+            if max_new is not None:
+                send_opts["max_new_tokens"] = max_new - seq_base
+            # the resume form: every emitted token becomes prompt (the
+            # paged prefix cache makes the re-prefill cheap)
+            req_toks = np.asarray(prompt + emitted, np.int32)
+            send_ctx = {"trace_id": trace_id, "request_id": rid,
+                        "decode": send_opts}
+            b.begin()
+            self._m["backend_requests"].labels(backend=b.key).inc()
+            s = None
+            established = False
+            try:
+                chaos.maybe_fail("router.stream_relay", b.key)
+                s = self._stream_conn(b)
+                write_tensors(s, [req_toks], ctx=send_ctx)
+                while True:
+                    outputs, errmsg, rctx = read_reply_ctx(s)
+                    stream = (rctx or {}).get("stream") or {}
+                    if errmsg is not None:
+                        code = error_code(errmsg)
+                        if code in RETRYABLE_CODES:
+                            raise TypedServeError(code, errmsg)
+                        # deterministic error: relay verbatim; the
+                        # backend answered, so its breaker heals
+                        b.breaker.record_success()
+                        try:
+                            write_error(conn, errmsg,
+                                        ctx=self._stream_ctx(
+                                            rid, trace_id,
+                                            {"done": True, "error": True,
+                                             "seq": len(emitted)}))
+                        except OSError:
+                            return ("relayed_error", False)
+                        return ("relayed_error", True)
+                    if not established:
+                        # stream established: the half-open probe (and a
+                        # failover's recovery clock) resolves NOW, not
+                        # at stream completion
+                        established = True
+                        b.breaker.record_success()
+                        if first_failure_t is not None:
+                            self._m["failover_latency"].observe(
+                                time.monotonic() - first_failure_t)
+                            first_failure_t = None
+                    if stream.get("done"):
+                        # reconcile: the done payload is this attempt's
+                        # authoritative token list — relay any trailing
+                        # tokens the per-token frames missed
+                        done_toks = ([int(t) for t in
+                                      np.asarray(outputs[0]).reshape(-1)]
+                                     if outputs else [])
+                        full = emitted[:seq_base] + done_toks
+                        for i in range(len(emitted), len(full)):
+                            try:
+                                write_tensors(
+                                    conn,
+                                    [np.asarray([full[i]], np.int32)],
+                                    ctx=self._stream_ctx(
+                                        rid, trace_id,
+                                        {"seq": i, "eos": False,
+                                         "done": False}))
+                            except (ConnectionError, TimeoutError,
+                                    OSError):
+                                return ("client_gone", False)
+                        emitted = full
+                        return (("ok", True)
+                                if self._finish_stream(conn, rid,
+                                                       trace_id, emitted)
+                                else ("ok", False))
+                    gseq = seq_base + int(stream.get("seq", 0))
+                    if gseq < len(emitted):
+                        continue     # duplicate of an already-relayed seq
+                    tok = int(np.asarray(outputs[0]).reshape(-1)[0])
+                    emitted.append(tok)
+                    eos_seen = bool(stream.get("eos")) or eos_seen
+                    try:
+                        write_tensors(
+                            conn, [np.asarray([tok], np.int32)],
+                            ctx=self._stream_ctx(
+                                rid, trace_id,
+                                {"seq": gseq,
+                                 "eos": bool(stream.get("eos")),
+                                 "done": False}))
+                    except (ConnectionError, TimeoutError, OSError):
+                        return ("client_gone", False)
+            except (TypedServeError, ConnectionError, TimeoutError,
+                    OSError, struct.error, ValueError, IndexError) as e:
+                # mid-stream backend failure: count it, resume elsewhere
+                b.breaker.record_failure()
+                last_err = f"{b.key}: {type(e).__name__}: {e}"
+                if first_failure_t is None:
+                    first_failure_t = time.monotonic()
+                continue
+            finally:
+                b.end()
+                if s is not None:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+        # out of backends or budget: the stream is lost
+        self._m["stream_lost"].inc()
+        detail = last_err or ("no routable backend (all unhealthy, "
+                              "draining, or circuit-broken)")
+        msg = (f"{ERR_UNAVAILABLE}: decode stream lost after "
+               f"{attempts} attempt(s), {len(emitted)} token(s) "
+               f"relayed: {detail}")
+        try:
+            write_error(conn, msg, ctx=self._stream_ctx(
+                rid, trace_id, {"done": True, "error": True,
+                                "seq": len(emitted)}))
+        except OSError:
+            return ("unavailable", False)
+        return ("unavailable", True)
+
+    def _serve_stream(self, conn, arrays, cctx, rid, trace_id) -> bool:
+        """Accounting shell around :meth:`_handle_stream`: in-flight and
+        stream gauges, latency + outcome metrics, the event ring, and
+        the stall-watchdog beat. Returns whether the client connection
+        is still usable."""
+        with self._inflight_lock:
+            self._inflight += 1
+        self._m["inflight"].inc()
+        self._m["stream_active"].inc()
+        t0 = time.monotonic()
+        t_ring = time.perf_counter()
+        try:
+            outcome, alive = self._handle_stream(conn, arrays, cctx,
+                                                 rid, trace_id)
+        finally:
+            with self._inflight_lock:
+                self._inflight -= 1
+            self._m["inflight"].dec()
+            self._m["stream_active"].dec()
+        wall = time.monotonic() - t0
+        self._m["latency"].observe(wall)
+        self._m["requests"].labels(outcome=outcome).inc()
+        self._ring.complete("router.stream", t_ring, time.perf_counter(),
+                            {"outcome": outcome, "rid": rid})
+        self._recorder.beat()
+        return alive
+
     # -- client plane ----------------------------------------------------
 
     def _accept_loop(self):
@@ -705,6 +1082,15 @@ class ServeRouter:
                 # names the whole client->router->backend trace
                 rid = next_request_id()
                 trace_id = (cctx or {}).get("trace_id") or rid
+                if cctx is not None and isinstance(cctx.get("decode"),
+                                                   dict):
+                    # decode stream: leave the one-reply fast path for
+                    # the seq-relaying proxy with mid-stream failover
+                    alive = self._serve_stream(conn, arrays, cctx, rid,
+                                               trace_id)
+                    if not alive or self._draining.is_set():
+                        return
+                    continue
                 traced = cctx is not None or self._spans.sampled(rid)
                 fwd_ctx = {"trace_id": trace_id, "request_id": rid} \
                     if traced else None
@@ -886,6 +1272,14 @@ class ServeRouter:
                 "spent": self._budget.spent,
                 "denied": self._budget.denied,
             },
+            "streams": {
+                "retries": self._stream_retries,
+            },
+            "membership": None if self._membership is None else {
+                "ttl_s": self._membership.ttl,
+                "interval_s": self._membership_interval,
+                "members": sorted(self._member_keys),
+            },
             "backends": [b.snapshot() for b in self.backends()],
         }
 
@@ -920,6 +1314,8 @@ class ServeRouter:
 
     def stop(self):
         self._stop.set()
+        if self._membership_thread is not None:
+            self._membership_thread.join(timeout=2)
         if self._varz is not None:
             self._varz.stop()
         self._recorder.stop()
@@ -1177,6 +1573,15 @@ def main_router(args) -> int:
         forward_timeout=forward_timeout,
         idle_timeout=args.idle_timeout,
         metrics_port=args.metrics_port)
+
+    membership_store = args.membership_store \
+        or _flags.env_value("PADDLE_TPU_MEMBERSHIP_STORE")
+    if membership_store:
+        router.watch_membership(membership_store,
+                                group=args.membership_group,
+                                ttl=args.membership_ttl)
+        print(f"MEMBERSHIP store={membership_store} "
+              f"group={args.membership_group}", flush=True)
 
     sup = None
     if args.fleet:

@@ -250,26 +250,42 @@ def decode_request(sock, prompt, opts=None, trace=True,
     fires for each — before the final accumulated frame. ``trace=False``
     sends legacy 'PDI1' and blocks for the single accumulated reply.
     Returns the generated tokens as a list; raises TypedServeError on a
-    typed error frame (mid-stream or otherwise)."""
+    typed error frame (mid-stream or otherwise). An error frame that
+    arrives after token frames does NOT drop the prefix: the raised
+    exception carries the tokens already received (in seq order) as
+    ``.partial_tokens`` plus ``.last_seq``. Token frames are
+    de-duplicated by ``seq`` (a failover relay may legally repeat one),
+    and the final done frame's accumulated payload is authoritative
+    regardless of token-frame arrival order."""
     from .errors import error_code
     arr = np.asarray(prompt, np.int32).reshape(-1)
     ctx = None
     if trace:
-        ctx = {"trace_id": f"decode-{os.getpid()}-{id(arr):x}"}
-        if opts:
-            ctx["decode"] = dict(opts)
+        # always carry the decode field: the router's stream detection
+        # keys on its presence, not its contents
+        ctx = {"trace_id": f"decode-{os.getpid()}-{id(arr):x}",
+               "decode": dict(opts or {})}
     write_tensors(sock, [arr], ctx=ctx)
+    by_seq = {}
     while True:
         arrays, err, rctx = read_reply_ctx(sock, max_bytes)
         if err is not None:
             code = error_code(err)
             detail = err.split(":", 1)[1].strip() if code else err
-            raise TypedServeError(code or ERR_INTERNAL, detail)
+            exc = TypedServeError(code or ERR_INTERNAL, detail)
+            exc.partial_tokens = [t for _, t in sorted(by_seq.items())]
+            exc.last_seq = max(by_seq) if by_seq else -1
+            raise exc
         stream = (rctx or {}).get("stream") or {}
         if not trace or stream.get("done"):
             return [int(t) for t in np.asarray(arrays[0]).reshape(-1)]
+        tok = int(np.asarray(arrays[0]).reshape(-1)[0])
+        seq = int(stream.get("seq", len(by_seq)))
+        if seq in by_seq:
+            continue                 # duplicate frame: already surfaced
+        by_seq[seq] = tok
         if on_token is not None:
-            on_token(int(np.asarray(arrays[0]).reshape(-1)[0]), stream)
+            on_token(tok, stream)
 
 
 def _idle_timeout_default() -> float:
@@ -570,7 +586,7 @@ class InferenceServer:
         opts = {}
         if ctx is not None and isinstance(ctx.get("decode"), dict):
             d = ctx["decode"]
-            for key in ("max_new_tokens", "top_k", "eos_id"):
+            for key in ("max_new_tokens", "top_k", "eos_id", "seed"):
                 if d.get(key) is not None:
                     opts[key] = int(d[key])
             if d.get("temperature") is not None:
@@ -614,6 +630,7 @@ class InferenceServer:
             while True:
                 ev = stream.next_event(timeout=timeout)
                 if ev[0] == "done":
+                    chaos.maybe_fail("serve.stream_write", detail="done")
                     final = np.asarray(ev[1], np.int32)
                     write_tensors(conn, [final],
                                   ctx=_sctx({"done": True,
@@ -622,6 +639,7 @@ class InferenceServer:
                     return True
                 _, tok, eos = ev
                 if ctx is not None:
+                    chaos.maybe_fail("serve.stream_write", detail=seq)
                     write_tensors(
                         conn, [np.asarray([tok], np.int32)],
                         ctx=_sctx({"seq": seq, "eos": bool(eos),
@@ -878,6 +896,19 @@ def main(argv=None):
                          "counts as overloaded; when EVERY routable "
                          "backend is past it, requests are shed with "
                          "RESOURCE_EXHAUSTED")
+    ap.add_argument("--membership-store", default=None,
+                    metavar="ENDPOINT",
+                    help="membership registry endpoint (HOST:PORT for "
+                         "TCPStore, else a FileStore directory). A "
+                         "backend publishes TTL'd heartbeats into it; a "
+                         "router watches it and adds/removes backends "
+                         "live (default PADDLE_TPU_MEMBERSHIP_STORE)")
+    ap.add_argument("--membership-group", default="serve",
+                    help="membership registry group name")
+    ap.add_argument("--membership-ttl", type=float, default=None,
+                    help="seconds without heartbeat progress before a "
+                         "member expires (default "
+                         "PADDLE_TPU_MEMBERSHIP_TTL)")
     args = ap.parse_args(argv)
     if args.router:
         from .router import main_router
@@ -910,6 +941,25 @@ def main(argv=None):
     if srv.metrics_port is not None:
         print(f"METRICS {srv.metrics_port}", flush=True)
     print(f"SERVING {srv.port}", flush=True)
+    # dynamic membership: publish this backend into the registry so a
+    # watching router adds it to the fleet without supervisor edits;
+    # leave() at drain so the router routes around it immediately
+    # instead of waiting out the TTL
+    publisher = None
+    store_ep = args.membership_store \
+        or _flags.env_value("PADDLE_TPU_MEMBERSHIP_STORE")
+    if store_ep:
+        from ..distributed.store.membership import (MembershipPublisher,
+                                                    connect)
+        ttl = float(args.membership_ttl
+                    if args.membership_ttl is not None
+                    else _flags.env_value("PADDLE_TPU_MEMBERSHIP_TTL"))
+        publisher = MembershipPublisher(
+            connect(store_ep), f"{args.host}:{srv.port}",
+            group=args.membership_group, admin_port=srv.metrics_port,
+            interval=max(ttl / 3.0, 0.05)).start()
+        print(f"MEMBERSHIP store={store_ep} group={args.membership_group} "
+              f"slot={publisher.slot}", flush=True)
     # SIGTERM = graceful retirement: stop accepting, finish in-flight,
     # exit 0 — the rolling-restart contract the router drains against
     term = threading.Event()
@@ -920,9 +970,13 @@ def main(argv=None):
     try:
         term.wait()
         print("DRAINING", flush=True)
+        if publisher is not None:
+            publisher.leave()
         ok = srv.drain(timeout=args.drain_timeout)
         print(f"DRAINED ok={ok}", flush=True)
     except KeyboardInterrupt:
+        if publisher is not None:
+            publisher.leave()
         srv.stop()
 
 

@@ -231,6 +231,10 @@ register_site("serve.conn.reply", "each reply send in a serve conn thread")
 register_site("batcher.dispatch", "each batch the dispatcher forms")
 register_site("batcher.worker", "each batch a pool worker executes")
 register_site("router.forward", "each router->backend forward attempt")
+register_site("router.stream_relay",
+              "each stream relay attempt against one backend")
+register_site("serve.stream_write",
+              "each stream frame write (token or done) in decode serving")
 register_site("decode.stream", "each token delivery in the decode engine")
 register_site("decode.page_alloc",
               "each KV page allocation in the paged decode engine")

@@ -486,6 +486,77 @@ def test_decode_engine_on_pallas_kernel(gpt_models, monkeypatch):
         eng.stop()
 
 
+def test_decode_request_error_after_partial(gpt_models, tmp_path):
+    """An error frame after seq>0 token frames surfaces the typed error
+    AND the tokens already received — callers must never silently drop
+    the partial prefix."""
+    import socket as socketlib
+
+    from paddle_tpu.inference.serve import InferenceServer, decode_request
+    model = gpt_models["tiny-scan"]
+    prefix = str(tmp_path / "gpt")
+    save_for_decode(model, prefix)
+    srv = InferenceServer(prefix, port=0, decode=True, decode_slots=2,
+                          decode_max_new=8, metrics_port=0)
+    try:
+        prompt = np.random.RandomState(17).randint(0, 512, size=6)
+        ref = _ref_greedy(model, prompt, 6)
+        # token deliveries 1-3 stream, the 4th raises mid-generation
+        with chaos.inject("decode.stream:4:RuntimeError"):
+            with socketlib.create_connection(("127.0.0.1", srv.port),
+                                             timeout=60) as s:
+                with pytest.raises(TypedServeError) as ei:
+                    decode_request(s, prompt, opts={"max_new_tokens": 6})
+        assert ei.value.code == ERR_UNAVAILABLE
+        assert ei.value.partial_tokens == ref[:3]
+        assert ei.value.last_seq == 2
+    finally:
+        srv.stop()
+
+
+def test_decode_request_done_frame_reordering():
+    """Wire-order hardening: duplicated token frames are dropped by seq,
+    out-of-order frames do not corrupt the prefix, and the done frame's
+    accumulated payload is authoritative."""
+    import socket as socketlib
+    import threading
+
+    from paddle_tpu.inference.serve import (decode_request, read_request,
+                                            write_tensors)
+    toks = [11, 22, 33, 44]
+    a, b = socketlib.socketpair()
+
+    def server():
+        read_request(b)
+        def frame(i):
+            write_tensors(b, [np.asarray([toks[i]], np.int32)],
+                          ctx={"stream": {"seq": i, "eos": False,
+                                          "done": False}})
+        frame(0)
+        frame(1)
+        frame(1)                       # failover-style duplicate
+        frame(3)                       # reordered ahead of seq 2
+        frame(2)
+        write_tensors(b, [np.asarray(toks, np.int32)],
+                      ctx={"stream": {"done": True, "n_tokens": 4}})
+
+    t = threading.Thread(target=server, daemon=True)
+    t.start()
+    seen = []
+    try:
+        got = decode_request(a, [1, 2, 3], opts={"max_new_tokens": 4},
+                             on_token=lambda tok, st: seen.append(
+                                 (tok, st.get("seq"))))
+    finally:
+        t.join(timeout=5)
+        a.close()
+        b.close()
+    assert got == toks                 # done payload wins regardless
+    seqs = [q for _, q in seen]
+    assert len(seqs) == len(set(seqs)), "duplicate seq surfaced twice"
+    assert {tok for tok, _ in seen} <= set(toks)
+
+
 @pytest.mark.slow
 def test_decode_churn_sweep(gpt_models):
     """Long ragged-churn drill across KV-rung growth (prompt+generation

@@ -1,0 +1,487 @@
+"""Streaming-resilient fleet: mid-stream decode failover, resumable
+streams, and store-backed dynamic membership (inference/router.py,
+distributed/store/membership.py).
+
+The contract under test is the ISSUE-15 tentpole: a backend dying
+mid-stream loses ZERO decode sessions — the router resumes each stream
+on another backend as ``prompt + tokens_emitted_so_far`` and the client
+sees one gapless, duplicate-free, token-identical stream."""
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.decode import DecodeEngine, save_for_decode
+from paddle_tpu.inference.errors import ERR_UNAVAILABLE, TypedServeError
+from paddle_tpu.inference.router import Backend, ServeRouter
+from paddle_tpu.inference.serve import InferenceServer, decode_request
+from paddle_tpu.models.gpt import GPT, gpt_tiny
+from paddle_tpu.observability import REGISTRY
+from paddle_tpu.testing import chaos
+from paddle_tpu.utils.retry import CircuitBreaker
+
+MAX_NEW = 8
+
+
+@pytest.fixture(scope="module")
+def artifact(tmp_path_factory):
+    """One tiny-GPT decode artifact + an engine-computed greedy oracle."""
+    paddle.seed(7)
+    model = GPT(gpt_tiny())
+    prefix = str(tmp_path_factory.mktemp("stream") / "gpt")
+    save_for_decode(model, prefix)
+
+    refs = {}
+    eng = DecodeEngine(model, max_slots=4, max_new_tokens=MAX_NEW)
+
+    def ref(prompt, max_new=MAX_NEW, **opts):
+        key = (tuple(int(t) for t in prompt), max_new,
+               tuple(sorted(opts.items())))
+        if key not in refs:
+            refs[key] = eng.submit(prompt, max_new_tokens=max_new,
+                                   **opts).result(timeout=300)
+        return refs[key]
+
+    yield {"model": model, "prefix": prefix, "ref": ref}
+    eng.stop()
+
+
+def _fleet(prefix, n, **router_kw):
+    srvs = [InferenceServer(prefix, port=0, decode=True, decode_slots=4,
+                            decode_max_new=MAX_NEW, metrics_port=0)
+            for _ in range(n)]
+    router = ServeRouter(
+        [Backend("127.0.0.1", s.port, s.metrics_port) for s in srvs],
+        port=0, poll_interval=0.1, **router_kw)
+    deadline = time.monotonic() + 15.0
+    while time.monotonic() < deadline:
+        bs = router.backends()
+        if bs and all(b.trace_wire for b in bs):
+            break
+        time.sleep(0.05)
+    return srvs, router
+
+
+def _stop(srvs, router):
+    router.stop()
+    for s in srvs:
+        s.stop()
+
+
+def _stream(port, prompt, opts=None, on_token=None, timeout=120):
+    with socket.create_connection(("127.0.0.1", port)) as s:
+        s.settimeout(timeout)
+        return decode_request(s, prompt, opts=opts, on_token=on_token)
+
+
+def test_stream_relay_through_router(artifact):
+    """A decode stream proxied by the router is token-identical to the
+    engine, with a gapless seq run observed at the client."""
+    srvs, router = _fleet(artifact["prefix"], 2)
+    try:
+        prompt = np.random.RandomState(3).randint(0, 512, size=7)
+        want = artifact["ref"](prompt)
+        seqs = []
+        got = _stream(router.port, prompt,
+                      opts={"max_new_tokens": MAX_NEW},
+                      on_token=lambda t, st: seqs.append(st.get("seq")))
+        assert got == want
+        assert seqs == list(range(len(want)))
+        assert router._status()["streams"]["retries"] >= 1
+    finally:
+        _stop(srvs, router)
+
+
+def test_mid_stream_cut_fails_over_token_identical(artifact):
+    """Chaos cut mid-stream (the 4th frame write raises on whichever
+    backend holds the stream): the router resumes on the other backend
+    and the client still sees the full greedy sequence, gapless."""
+    srvs, router = _fleet(artifact["prefix"], 2)
+    try:
+        prompt = np.random.RandomState(5).randint(0, 512, size=9)
+        want = artifact["ref"](prompt)
+        flat0 = REGISTRY.flat()
+        seqs = []
+        with chaos.inject("serve.stream_write:4:ConnectionError") as inj:
+            got = _stream(router.port, prompt,
+                          opts={"max_new_tokens": MAX_NEW},
+                          on_token=lambda t, st: seqs.append(
+                              st.get("seq")))
+        assert inj.fired
+        assert got == want
+        assert seqs == list(range(len(want)))
+        flat = REGISTRY.flat()
+        d = lambda k: flat.get(k, 0) - flat0.get(k, 0)  # noqa: E731
+        assert d("paddle_tpu_router_stream_failovers_total") == 1
+        assert d("paddle_tpu_router_stream_lost_total") == 0
+        assert d("paddle_tpu_router_stream_resumed_tokens_total") == 3
+    finally:
+        _stop(srvs, router)
+
+
+def test_sampled_stream_resumes_deterministically(artifact):
+    """Seeded sampled decode (temperature > 0) survives a mid-stream
+    cut token-identically: the per-(seed, position) RNG makes the
+    resumed attempt draw exactly what the uninterrupted run drew."""
+    srvs, router = _fleet(artifact["prefix"], 2)
+    try:
+        prompt = np.random.RandomState(7).randint(0, 512, size=6)
+        opts = {"max_new_tokens": MAX_NEW, "temperature": 0.8,
+                "seed": 1234}
+        want = artifact["ref"](prompt, temperature=0.8, seed=1234)
+        with chaos.inject("serve.stream_write:3:ConnectionError") as inj:
+            got = _stream(router.port, prompt, opts=opts)
+        assert inj.fired
+        assert got == want
+    finally:
+        _stop(srvs, router)
+
+
+def test_kill_one_of_three_under_concurrent_streams(artifact):
+    """The headline drill, in-process: 16 concurrent streams over a
+    fleet of three, one backend stopped abruptly mid-token. Zero lost
+    streams, every stream token-identical to the greedy oracle, every
+    client seq run gapless and duplicate-free."""
+    n_streams = 16
+    rng = np.random.RandomState(11)
+    prompts = [rng.randint(0, 512, size=int(rng.randint(4, 14)))
+               for _ in range(n_streams)]
+    srvs, router = _fleet(artifact["prefix"], 3)
+    flat0 = REGISTRY.flat()
+    try:
+        want = [artifact["ref"](p) for p in prompts]
+        lock = threading.Lock()
+        tokens_seen = [0]
+        killed = [False]
+        kill_at = (n_streams * MAX_NEW) // 3
+        outs = [None] * n_streams
+        seqs_ok = [False] * n_streams
+        errs = []
+
+        def on_token(seqs):
+            def cb(tok, st):
+                seqs.append(int(st.get("seq", -1)))
+                with lock:
+                    tokens_seen[0] += 1
+                    fire = (not killed[0] and tokens_seen[0] >= kill_at)
+                    if fire:
+                        killed[0] = True
+                if fire:
+                    srvs[1].stop()       # abrupt: mid-token, no drain
+            return cb
+
+        def client(i):
+            seqs = []
+            try:
+                outs[i] = _stream(router.port, prompts[i],
+                                  opts={"max_new_tokens": MAX_NEW},
+                                  on_token=on_token(seqs))
+                seqs_ok[i] = seqs == list(range(len(outs[i])))
+            except Exception as e:       # lost stream: scored below
+                errs.append(f"stream {i}: {e!r}")
+
+        threads = [threading.Thread(target=client, args=(i,), daemon=True)
+                   for i in range(n_streams)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert killed[0], "kill threshold never reached"
+        assert not errs, f"lost streams: {errs[:3]}"
+        assert all(o is not None for o in outs)
+        for i in range(n_streams):
+            assert outs[i] == want[i], f"stream {i} diverged after kill"
+        assert all(seqs_ok), "client saw a gapped or duplicated seq"
+        flat = REGISTRY.flat()
+        assert flat.get("paddle_tpu_router_stream_failovers_total", 0) \
+            > flat0.get("paddle_tpu_router_stream_failovers_total", 0)
+        assert flat.get("paddle_tpu_router_stream_lost_total", 0) \
+            == flat0.get("paddle_tpu_router_stream_lost_total", 0)
+    finally:
+        _stop(srvs, router)
+
+
+def test_stream_lost_surfaces_partial_tokens(artifact):
+    """When every backend/budget is exhausted mid-stream, the client
+    gets a typed UNAVAILABLE carrying the partial prefix — not a
+    silent drop, not a gapless lie."""
+    srvs, router = _fleet(artifact["prefix"], 1, stream_retries=0)
+    flat0 = REGISTRY.flat()
+    try:
+        prompt = np.random.RandomState(13).randint(0, 512, size=8)
+        want = artifact["ref"](prompt)
+        with chaos.inject("serve.stream_write:4:ConnectionError"):
+            with pytest.raises(TypedServeError) as ei:
+                _stream(router.port, prompt,
+                        opts={"max_new_tokens": MAX_NEW})
+        assert ei.value.code == ERR_UNAVAILABLE
+        assert ei.value.partial_tokens == want[:3]
+        flat = REGISTRY.flat()
+        assert flat.get("paddle_tpu_router_stream_lost_total", 0) \
+            == flat0.get("paddle_tpu_router_stream_lost_total", 0) + 1
+    finally:
+        _stop(srvs, router)
+
+
+def test_breaker_probe_resolves_at_first_token(artifact):
+    """Satellite: the half-open probe is resolved at the FIRST relayed
+    frame (stream established), not stream completion — a long-lived
+    stream must not pin its backend's breaker in HALF_OPEN."""
+    srvs, router = _fleet(artifact["prefix"], 1)
+    try:
+        b = router.backends()[0]
+        clock = [0.0]
+        b.breaker = CircuitBreaker(failure_threshold=1, reset_timeout=5.0,
+                                   clock=lambda: clock[0])
+        b.breaker.record_failure()
+        assert b.breaker.state == CircuitBreaker.OPEN
+        clock[0] = 6.0                       # past reset: probe eligible
+        assert b.breaker.state == CircuitBreaker.HALF_OPEN
+
+        states_at_token = []
+        prompt = np.random.RandomState(19).randint(0, 512, size=6)
+        got = _stream(router.port, prompt,
+                      opts={"max_new_tokens": MAX_NEW},
+                      on_token=lambda t, st: states_at_token.append(
+                          b.breaker.state))
+        # the client callback for seq 0 runs while the stream is still
+        # open (its done frame hasn't arrived) — the breaker must
+        # already be CLOSED there
+        assert states_at_token[0] == CircuitBreaker.CLOSED
+        assert got == artifact["ref"](prompt)
+    finally:
+        _stop(srvs, router)
+
+
+def test_remove_backend_purges_conn_caches_in_all_threads():
+    """Satellite: remove_backend must close the removed backend's
+    cached keep-alive sockets in EVERY thread's cache, not just the
+    calling thread's — a re-added same-host:port backend must never
+    inherit a half-dead socket."""
+    lst = socket.socket()
+    lst.bind(("127.0.0.1", 0))
+    lst.listen(8)
+    port = lst.getsockname()[1]
+    accepted = []
+
+    def acceptor():
+        while True:
+            try:
+                c, _ = lst.accept()
+            except OSError:
+                return
+            accepted.append(c)
+
+    acc = threading.Thread(target=acceptor, daemon=True)
+    acc.start()
+    router = ServeRouter([Backend("127.0.0.1", port)], port=0,
+                         poll_interval=30.0)
+    try:
+        b = router.backends()[0]
+        socks = {}
+        ready = threading.Barrier(4)
+        release = threading.Event()
+
+        def grab(i):
+            socks[i] = router._backend_conn(b)
+            ready.wait(timeout=10)
+            release.wait(timeout=10)     # stay alive through the purge
+
+        threads = [threading.Thread(target=grab, args=(i,), daemon=True)
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        ready.wait(timeout=10)
+        assert len(socks) == 3
+        router.remove_backend(b.key)     # from a FOURTH thread (main)
+        for s in socks.values():
+            assert s.fileno() == -1, \
+                "cached socket survived remove_backend in another thread"
+        with router._conn_caches_lock:
+            assert all(b.key not in c
+                       for c in router._conn_caches.values())
+        release.set()
+        for t in threads:
+            t.join(timeout=10)
+    finally:
+        release.set()
+        router.stop()
+        lst.close()
+        for c in accepted:
+            c.close()
+
+
+def test_membership_join_leave_and_ttl_expiry(artifact, tmp_path):
+    """Dynamic membership over a FileStore: a publishing backend joins
+    a running router (visible in /statusz, takes traffic) within one
+    poll interval; a clean leave removes it; a crash (beats stop) ages
+    out after the TTL. No router restart anywhere."""
+    from paddle_tpu.distributed.store import FileStore
+    from paddle_tpu.distributed.store.membership import MembershipPublisher
+
+    store_dir = str(tmp_path / "members")
+    srv = InferenceServer(artifact["prefix"], port=0, decode=True,
+                          decode_slots=4, decode_max_new=MAX_NEW,
+                          metrics_port=0)
+    router = ServeRouter([], port=0, poll_interval=0.1)
+    flat0 = REGISTRY.flat()
+    pub = None
+    try:
+        watcher = router.watch_membership(FileStore(store_dir), ttl=1.5,
+                                          interval=0.1)
+        assert watcher.ttl == 1.5
+        key = f"127.0.0.1:{srv.port}"
+        pub = MembershipPublisher(FileStore(store_dir), key,
+                                  admin_port=srv.metrics_port,
+                                  interval=0.2).start()
+
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and not router.backends():
+            time.sleep(0.02)
+        assert [b.key for b in router.backends()] == [key]
+        st = router._status()
+        assert st["membership"]["members"] == [key]
+        assert st["membership"]["ttl_s"] == 1.5
+
+        # the joined backend takes traffic — a stream end to end
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and \
+                not all(b.trace_wire for b in router.backends()):
+            time.sleep(0.05)
+        prompt = np.random.RandomState(23).randint(0, 512, size=5)
+        assert _stream(router.port, prompt,
+                       opts={"max_new_tokens": 4}) == \
+            artifact["ref"](prompt, max_new=4)
+
+        # clean leave: removed on the next poll, no TTL wait
+        pub.leave()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and router.backends():
+            time.sleep(0.02)
+        assert not router.backends()
+        assert router._status()["membership"]["members"] == []
+
+        # crash-style: rejoin, then stop beating WITHOUT leaving
+        pub = MembershipPublisher(FileStore(store_dir), key,
+                                  admin_port=srv.metrics_port,
+                                  interval=0.2).start()
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and not router.backends():
+            time.sleep(0.02)
+        assert router.backends()
+        pub._stop.set()
+        pub._thread.join(timeout=5)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and router.backends():
+            time.sleep(0.05)
+        assert not router.backends(), "crashed member outlived its TTL"
+
+        flat = REGISTRY.flat()
+        d = lambda k: flat.get(k, 0) - flat0.get(k, 0)  # noqa: E731
+        assert d('paddle_tpu_router_membership_events_total'
+                 '{event="join"}') == 2
+        assert d('paddle_tpu_router_membership_events_total'
+                 '{event="leave"}') == 2
+    finally:
+        if pub is not None:
+            pub.leave()
+        router.stop()
+        srv.stop()
+
+
+@pytest.mark.slow
+def test_multiprocess_kill_mid_stream_drill(artifact):
+    """The drill with real process boundaries: backends spawned as
+    subprocesses, one SIGKILLed mid-token. Every stream completes
+    token-identical to the greedy oracle."""
+    import signal
+    import subprocess
+    import sys
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PADDLE_TPU_TSAN", None)     # children run unsanitized
+    procs, ports = [], []
+    try:
+        for _ in range(3):
+            p = subprocess.Popen(
+                [sys.executable, "-m", "paddle_tpu.inference.serve",
+                 artifact["prefix"], "--port", "0", "--metrics-port", "0",
+                 "--decode", "--decode-slots", "4",
+                 "--decode-max-new", str(MAX_NEW)],
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                env=env, text=True)
+            procs.append(p)
+        for p in procs:
+            deadline = time.monotonic() + 120.0
+            port = None
+            while time.monotonic() < deadline:
+                line = p.stdout.readline()
+                if line.startswith("SERVING "):
+                    port = int(line.split()[1])
+                    break
+                if not line and p.poll() is not None:
+                    break
+            assert port, "backend never reached SERVING"
+            ports.append(port)
+
+        router = ServeRouter(
+            [Backend("127.0.0.1", pt) for pt in ports],
+            port=0, poll_interval=0.1)
+        try:
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline:
+                bs = router.backends()
+                if bs and all(b.trace_wire for b in bs):
+                    break
+                time.sleep(0.05)
+            n_streams = 6
+            rng = np.random.RandomState(29)
+            prompts = [rng.randint(0, 512, size=int(rng.randint(4, 10)))
+                       for _ in range(n_streams)]
+            want = [artifact["ref"](p) for p in prompts]
+            lock = threading.Lock()
+            seen = [0]
+            killed = [False]
+            outs = [None] * n_streams
+            errs = []
+
+            def cb(tok, st):
+                with lock:
+                    seen[0] += 1
+                    fire = (not killed[0]
+                            and seen[0] >= (n_streams * MAX_NEW) // 3)
+                    if fire:
+                        killed[0] = True
+                if fire:
+                    procs[1].send_signal(signal.SIGKILL)
+
+            def client(i):
+                try:
+                    outs[i] = _stream(router.port, prompts[i],
+                                      opts={"max_new_tokens": MAX_NEW},
+                                      on_token=cb, timeout=300)
+                except Exception as e:
+                    errs.append(f"stream {i}: {e!r}")
+
+            threads = [threading.Thread(target=client, args=(i,),
+                                        daemon=True)
+                       for i in range(n_streams)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=600)
+            assert killed[0]
+            assert not errs, f"lost streams: {errs[:3]}"
+            assert outs == want
+        finally:
+            router.stop()
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+            p.wait(timeout=30)
+            p.stdout.close()
