@@ -927,6 +927,115 @@ def run_decode_router_bench(args):
     }
 
 
+def run_scenario_bench(args):
+    """Scenario mode: replay a seeded multi-tenant traffic scenario
+    (benchmarks/scenarios.py) against one QoS-armed decode engine —
+    weighted-fair scheduling, a flood-tenant quota, and preemption all
+    on — and score it per tenant (p50/p99 completion latency, goodput).
+
+    ``adversarial_flood`` doubles as the QoS acceptance check: the
+    well-behaved tenant's arrivals replay alone first (the no-flood
+    baseline), then the full scenario. Acceptance: zero well-behaved
+    requests lost, well-behaved p99 within 2x its no-flood baseline,
+    and the flood tenant visibly degraded (shed/deferred/preempted or
+    lower goodput per submitted request than the well-behaved tenant).
+    Reported as booleans in the JSON; rc stays 0 either way."""
+    try:
+        from benchmarks import scenarios as scen
+    except ImportError:      # run as a script from benchmarks/
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        import scenarios as scen
+
+    from paddle_tpu.inference.decode import DecodeEngine
+    from paddle_tpu.models.gpt import GPT, gpt_tiny
+    from paddle_tpu.observability import REGISTRY
+
+    name = args.scenario
+    cfg = gpt_tiny()
+    model = GPT(cfg)
+    rate = args.scenario_rate
+    max_new = args.decode_tokens or 12
+    dur = args.scenario_duration
+    if name == "adversarial_flood":
+        kw = {"capacity_rps": rate}
+    elif name == "flash_crowd":
+        kw = {"base_rate": rate / 2.0, "burst_rate": rate * 4.0}
+    else:
+        kw = {"rate": rate}
+    arrivals = scen.generate(name, seed=args.seed, duration_s=dur,
+                             vocab=cfg.vocab_size, max_new=max_new, **kw)
+    tenants = sorted({a.tenant for a in arrivals})
+    good = "tenant-a" if "tenant-a" in tenants else tenants[0]
+    # QoS posture: the well-behaved tenant carries 4x weight; a flood
+    # tenant is token-rate-capped at half the nominal capacity; the
+    # engine may preempt low-priority slots for high-priority arrivals
+    quota = (f"flood:{rate * max_new / 2.0}"
+             if "flood" in tenants else "")
+    eng = DecodeEngine(model, max_slots=args.decode_slots,
+                       max_new_tokens=max_new,
+                       tenant_weights=f"{good}:4",
+                       tenant_quota=quota, preempt=True)
+    warmup_compiles = eng.warmup()
+    try:
+        baseline = None
+        if name == "adversarial_flood":
+            base_arr = [a for a in arrivals if a.tenant == good]
+            baseline = scen.score(scen.replay(eng, base_arr), dur)
+        outcomes = scen.replay(eng, arrivals)
+        per = scen.score(outcomes, dur)
+        st = eng.stats()
+    finally:
+        eng.stop()
+    m = REGISTRY.flat()
+    total_tps = sum(d["goodput_tps"] for d in per.values())
+    out = {
+        "metric": f"serve_scenario_{name}",
+        "value": round(total_tps, 2),
+        "unit": "tokens/s",
+        "vs_baseline": 1.0,
+        "scenario": name,
+        "seed": args.seed,
+        "duration_s": dur,
+        "arrivals": len(arrivals),
+        "decode_slots": args.decode_slots,
+        "max_new_tokens": max_new,
+        "tenants": per,
+        "warmup_compiles": warmup_compiles,
+        "engine": {
+            "preemptions": m.get(
+                "paddle_tpu_decode_preemptions_total", 0.0),
+            "preempt_resumes": m.get(
+                "paddle_tpu_decode_preempt_resumes_total", 0.0),
+            "virtual_clocks": st.get("tenants", {}),
+        },
+        "metrics": {k: v for k, v in m.items()
+                    if k.startswith(("paddle_tpu_tenant_",
+                                     "paddle_tpu_decode_preempt"))},
+    }
+    if baseline is not None:
+        flood = next((t for t in tenants if t != good), None)
+        g, f = per.get(good, {}), per.get(flood, {}) if flood else {}
+        base_p99 = baseline.get(good, {}).get("p99_ms", 0.0)
+        flood_degraded = bool(f) and (
+            f.get("lost", 0) > 0
+            or f.get("p99_ms", 0.0) > g.get("p99_ms", 0.0)
+            or (f.get("tokens", 0) / max(f.get("submitted", 1), 1))
+            < (g.get("tokens", 0) / max(g.get("submitted", 1), 1)))
+        out["baseline"] = baseline
+        out["acceptance"] = {
+            "well_behaved_lost": g.get("lost", 0),
+            "well_behaved_p99_ms": g.get("p99_ms", 0.0),
+            "baseline_p99_ms": base_p99,
+            "p99_within_2x_baseline":
+                g.get("p99_ms", 0.0) <= 2.0 * base_p99 + 1.0,
+            "zero_well_behaved_lost": g.get("lost", 0) == 0,
+            "flood_degraded": flood_degraded,
+        }
+        out["vs_baseline"] = round(
+            base_p99 / g["p99_ms"], 3) if g.get("p99_ms") else 1.0
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser(description="serving engine benchmark")
     ap.add_argument("--requests", type=int, default=400)
@@ -953,6 +1062,19 @@ def main():
                          "system prompt + short unique tails — scores "
                          "the paged-KV prefix cache (prefix_hit_rate, "
                          "pages_in_use, hbm_bytes_per_slot)")
+    ap.add_argument("--scenario", default="", metavar="NAME",
+                    help="multi-tenant QoS scenario replay over the "
+                         "decode engine (benchmarks/scenarios.py): "
+                         "diurnal, flash_crowd, long_context, or "
+                         "adversarial_flood — scored per tenant "
+                         "(p50/p99/goodput); adversarial_flood also "
+                         "scores the flood-isolation acceptance checks "
+                         "against a no-flood baseline")
+    ap.add_argument("--scenario-duration", type=float, default=3.0,
+                    help="(scenario mode) arrival-clock length, seconds")
+    ap.add_argument("--scenario-rate", type=float, default=8.0,
+                    help="(scenario mode) nominal capacity in "
+                         "requests/s the generators scale from")
     ap.add_argument("--router", type=int, default=0, metavar="N",
                     help="fleet mode: N backends behind the front "
                          "router, driven over the wire (0 = classic "
@@ -966,7 +1088,9 @@ def main():
     args = ap.parse_args()
     _devices_or_cpu_fallback()
     try:
-        if args.decode and args.router:
+        if args.scenario:
+            out = run_scenario_bench(args)
+        elif args.decode and args.router:
             out = run_decode_router_bench(args)
         elif args.decode:
             out = run_decode_bench(args)

@@ -69,7 +69,7 @@ from collections import deque
 from concurrent.futures import Future, InvalidStateError
 from itertools import product
 from queue import Queue
-from typing import List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -80,11 +80,49 @@ from .errors import (ERR_RESOURCE_EXHAUSTED, ERR_UNAVAILABLE,
 
 __all__ = ["DynamicBatcher", "bucket_ladder", "next_bucket",
            "DEFAULT_MAX_BATCH", "DEFAULT_TIMEOUT_MS",
-           "max_queue_default"]
+           "max_queue_default", "parse_tenant_map", "tenant_weights",
+           "tenant_quotas"]
 
 DEFAULT_MAX_BATCH = 8
 DEFAULT_TIMEOUT_MS = 2.0
 _WARMUP_SIG_CAP = 64          # cross-product guard for many dynamic dims
+
+
+def parse_tenant_map(spec, default: float = 0.0):
+    """Parse a ``tenant:value,tenant2:value`` spec into a dict. A ``*``
+    entry sets the value for unlisted tenants (exposed under the ``"*"``
+    key); malformed entries are skipped — a QoS config typo must not
+    take the daemon down."""
+    out = {"*": float(default)}
+    for part in str(spec or "").replace(";", ",").split(","):
+        part = part.strip()
+        if not part or ":" not in part:
+            continue
+        name, _, val = part.rpartition(":")
+        try:
+            out[name.strip()] = float(val)
+        except ValueError:
+            continue
+    return out
+
+
+def tenant_weights(spec=None) -> dict:
+    """Per-tenant fair-share weights (``PADDLE_TPU_TENANT_WEIGHTS``);
+    unlisted tenants weigh 1.0, non-positive entries degrade to 1.0."""
+    raw = _flags.env_value("PADDLE_TPU_TENANT_WEIGHTS") \
+        if spec is None else spec
+    out = parse_tenant_map(raw, default=1.0)
+    return {t: (w if w > 0 else 1.0) for t, w in out.items()}
+
+
+def tenant_quotas(spec=None) -> dict:
+    """Per-tenant service-rate quotas (``PADDLE_TPU_TENANT_QUOTA``):
+    tokens/second for the decode engine, rows/second for the dynamic
+    batcher. 0 (the default) means unlimited."""
+    raw = _flags.env_value("PADDLE_TPU_TENANT_QUOTA") \
+        if spec is None else spec
+    out = parse_tenant_map(raw, default=0.0)
+    return {t: max(q, 0.0) for t, q in out.items()}
 
 
 def max_queue_default() -> int:
@@ -129,9 +167,10 @@ def next_bucket(n: int, ladder: Sequence[int]) -> int:
 
 class _Request:
     __slots__ = ("arrays", "rows", "key", "pad_map", "future", "t_enq",
-                 "solo", "req_id")
+                 "solo", "req_id", "tenant", "deferred")
 
-    def __init__(self, arrays, rows, key, solo=False, req_id=0):
+    def __init__(self, arrays, rows, key, solo=False, req_id=0,
+                 tenant="default"):
         self.arrays = arrays
         self.rows = rows
         self.key = key
@@ -140,6 +179,8 @@ class _Request:
         self.t_enq = time.perf_counter()
         self.solo = solo
         self.req_id = req_id       # observability: spans + error frames
+        self.tenant = tenant       # QoS: weighted-fair anchor + quotas
+        self.deferred = False      # quota-deferred at least once (metric)
 
 
 class DynamicBatcher:
@@ -227,6 +268,24 @@ class DynamicBatcher:
             "Requests refused at admission because the queue was past "
             "the PADDLE_TPU_SERVE_MAX_QUEUE watermark (typed "
             "RESOURCE_EXHAUSTED error frame).")
+        # multi-tenant QoS: weighted-fair anchor selection over the queue
+        # plus per-tenant rows/sec quotas (PADDLE_TPU_TENANT_WEIGHTS /
+        # PADDLE_TPU_TENANT_QUOTA); same registry families as DecodeEngine
+        self._weights = tenant_weights()
+        self._quota = tenant_quotas()
+        self._vrows: Dict[str, float] = {}     # weighted rows served
+        self._quota_rows: Dict[str, float] = {}  # token buckets (rows)
+        self._quota_ts = time.perf_counter()
+        self._tenant_shed_total = counter(
+            "paddle_tpu_tenant_shed_total",
+            "Requests refused at admission because the tenant was over "
+            "its weighted share of the pending queue (typed "
+            "RESOURCE_EXHAUSTED error frame).", labelnames=("tenant",))
+        self._quota_deferred_total = counter(
+            "paddle_tpu_tenant_quota_deferred_total",
+            "Requests held in queue past their turn because the tenant's "
+            "token-rate quota (PADDLE_TPU_TENANT_QUOTA) was exhausted; "
+            "deferred, never dropped.", labelnames=("tenant",))
         self._busy_batches = 0       # formed batches inside _execute
         self._recorder = FlightRecorder(
             "serve_batcher",
@@ -389,14 +448,17 @@ class DynamicBatcher:
 
     # -- request intake --------------------------------------------------
 
-    def submit(self, inputs) -> Future:
+    def submit(self, inputs, tenant=None) -> Future:
         """Enqueue one request; the returned Future resolves to the list
         of output arrays for exactly this request's rows (or raises the
         per-request error). The future carries the assigned request id
         as ``.request_id``; errors carry the same id so a failing
-        request is traceable end to end."""
+        request is traceable end to end. ``tenant`` tags the request for
+        weighted-fair anchor selection, per-tenant quota, and a
+        per-tenant share of the queue watermark."""
         from ..observability import next_request_id
         req_id = next_request_id()
+        tenant = str(tenant).strip() if tenant else "default"
         try:
             # no ascontiguousarray here: assembly copies into the zeroed
             # bucket buffer anyway, and the solo path normalizes itself
@@ -406,6 +468,7 @@ class DynamicBatcher:
                     f"model takes {self._n_inputs} inputs, got "
                     f"{len(arrays)}")
             req = self._make_request(arrays, req_id)
+            req.tenant = tenant
         except Exception as e:
             fut = Future()
             fut.request_id = req_id
@@ -435,16 +498,42 @@ class DynamicBatcher:
             # the watermark has a hole exactly as wide as the formation
             # window (tsan-lite caught the race)
             depth = len(self._q) + self._forming
-            if self._max_queue and depth >= self._max_queue:
-                # admission control: past the watermark the queue can
-                # only add deadline-bound latency — shed instead
-                self._shed_total.inc()
-                req.future.set_exception(self._tag(TypedServeError(
-                    ERR_RESOURCE_EXHAUSTED,
-                    f"serve queue past watermark ({depth} >= "
-                    f"{self._max_queue} queued; "
-                    "PADDLE_TPU_SERVE_MAX_QUEUE)"), req_id))
-                return req.future
+            if self._max_queue:
+                # per-tenant watermark share: with multiple tenants
+                # queued, nobody may hold more than their weighted slice
+                # of the watermark — a flood tenant sheds while the
+                # well-behaved tenant's slice stays admissible (the
+                # flood must not be able to shed everyone by filling the
+                # global queue; 2x the watermark is the hard backstop).
+                # A single tenant keeps the whole watermark (back-compat
+                # with the pre-QoS global check).
+                tset = {r.tenant for r in self._q} | {tenant}
+                if len(tset) > 1:
+                    mine = sum(1 for r in self._q if r.tenant == tenant)
+                    wsum = sum(self._weight(t) for t in tset)
+                    share = max(1, round(
+                        self._max_queue * self._weight(tenant) / wsum))
+                    if mine >= share or depth >= 2 * self._max_queue:
+                        self._tenant_shed_total.labels(
+                            tenant=tenant).inc()
+                        req.future.set_exception(self._tag(
+                            TypedServeError(
+                                ERR_RESOURCE_EXHAUSTED,
+                                f"serve queue past watermark for tenant "
+                                f"{tenant!r} ({mine} of its {share}-slot "
+                                "share queued; PADDLE_TPU_TENANT_WEIGHTS"
+                                ")"), req_id))
+                        return req.future
+                elif depth >= self._max_queue:
+                    # admission control: past the watermark the queue
+                    # can only add deadline-bound latency — shed instead
+                    self._shed_total.inc()
+                    req.future.set_exception(self._tag(TypedServeError(
+                        ERR_RESOURCE_EXHAUSTED,
+                        f"serve queue past watermark ({depth} >= "
+                        f"{self._max_queue} queued; "
+                        "PADDLE_TPU_SERVE_MAX_QUEUE)"), req_id))
+                    return req.future
             self._q.append(req)
             with self._inflight_lock:
                 self._inflight += 1
@@ -483,15 +572,27 @@ class DynamicBatcher:
     # -- batch formation -------------------------------------------------
 
     def _form_batch(self):
-        """Blocks for the next batch: the oldest queued request anchors
+        """Blocks for the next batch: the oldest request of the most
+        underserved (weighted virtual-rows) quota-eligible tenant anchors
         the key and the deadline; same-key requests are merged until the
-        row budget or the deadline is hit."""
+        row budget or the deadline is hit. Quota-blocked tenants keep
+        their place in queue (deferred, never dropped); stop() drains the
+        queue ignoring quotas."""
         with self._cond:
-            while not self._q and not self._stop:
-                self._cond.wait(0.25)
-            if not self._q:
-                return None
-            first = self._q.popleft()
+            while True:
+                if not self._q:
+                    if self._stop:
+                        return None
+                    self._cond.wait(0.25)
+                    continue
+                self._refill_quota()
+                first = self._pick_anchor()
+                if first is not None:
+                    break
+                if self._stop:
+                    first = self._q.popleft()   # drain ignores quota
+                    break
+                self._cond.wait(0.05)           # wait for quota refill
             reqs, rows = [first], first.rows
             self._forming = 1
             try:
@@ -505,6 +606,9 @@ class DynamicBatcher:
                             continue
                         if rows + r.rows > self._max_batch:
                             continue
+                        if r.tenant != first.tenant \
+                                and not self._quota_room(r.tenant):
+                            continue   # quota-blocked rows never ride along
                         taken.append(r)
                         rows += r.rows
                         if rows >= self._max_batch:
@@ -521,7 +625,86 @@ class DynamicBatcher:
                     self._cond.wait(min(deadline - now, 0.05))
                 return reqs, first.key, rows
             finally:
+                for r in reqs:
+                    self._note_rows(r)
                 self._forming = 0
+
+    # -- QoS scheduling ---------------------------------------------------
+
+    def _weight(self, tenant) -> float:
+        return self._weights.get(tenant, self._weights["*"])
+
+    def _quota_rate(self, tenant) -> float:
+        return self._quota.get(tenant, self._quota["*"])
+
+    def _refill_quota(self):
+        """Advance every tenant's rows/sec token bucket (capped at one
+        burst = max(rate, 1.0) rows). Caller holds _cond."""
+        now = time.perf_counter()
+        dt, self._quota_ts = now - self._quota_ts, now
+        if dt <= 0:
+            return
+        for t in list(self._quota_rows):
+            rate = self._quota_rate(t)
+            if rate <= 0:
+                self._quota_rows.pop(t)   # quota removed at runtime
+                continue
+            burst = max(rate, 1.0)
+            self._quota_rows[t] = min(burst,
+                                      self._quota_rows[t] + rate * dt)
+
+    def _quota_room(self, tenant) -> bool:
+        """True when the tenant may dispatch rows right now. Buckets are
+        lazily created at full burst; rate <= 0 means unmetered."""
+        rate = self._quota_rate(tenant)
+        if rate <= 0:
+            return True
+        if tenant not in self._quota_rows:
+            self._quota_rows[tenant] = max(rate, 1.0)
+        return self._quota_rows[tenant] > 0.0
+
+    def _pick_anchor(self):
+        """Pop and return the oldest request of the most underserved
+        quota-eligible tenant (lowest weighted virtual rows), or None if
+        every queued tenant is quota-blocked. Caller holds _cond."""
+        heads = {}
+        for r in self._q:
+            if r.tenant not in heads:
+                heads[r.tenant] = r
+        best = None
+        for t, r in heads.items():
+            try:
+                chaos.maybe_fail("batcher.quota")
+                ok = self._quota_room(t)
+            except Exception:
+                ok = False   # drill: treat the tenant as quota-blocked
+            if not ok:
+                if not r.deferred:
+                    r.deferred = True
+                    self._quota_deferred_total.labels(tenant=t).inc()
+                continue
+            v = self._vrows.get(t, 0.0)
+            if best is None or v < best[0]:
+                best = (v, r)
+        if best is None:
+            return None
+        self._q.remove(best[1])
+        # idle-tenant catch-up floor: a tenant returning from idle starts
+        # at the busiest peer's deficit, not at zero-for-all-history
+        if self._vrows:
+            floor = min(self._vrows.values())
+            t = best[1].tenant
+            self._vrows[t] = max(self._vrows.get(t, 0.0), floor)
+        return best[1]
+
+    def _note_rows(self, req):
+        """Charge a dispatched request's rows to its tenant: advances the
+        weighted-fair clock and drains the quota bucket (which may go
+        negative — burst debt pays back over time). Caller holds _cond."""
+        self._vrows[req.tenant] = (self._vrows.get(req.tenant, 0.0)
+                                   + req.rows / self._weight(req.tenant))
+        if req.tenant in self._quota_rows:
+            self._quota_rows[req.tenant] -= req.rows
 
     def _dispatch_loop(self):
         formed = None

@@ -39,7 +39,25 @@ Streams: `submit()` returns a `DecodeStream`; tokens are pushed as they
 are sampled (serve.py forwards them as incremental PDI2 frames), and a
 failed request gets a typed error while its batch-mates keep streaming.
 Chaos sites: `decode.stream` fires per token delivery,
-`decode.page_alloc` per page allocation.
+`decode.page_alloc` per page allocation, `decode.preempt` per
+preemption attempt.
+
+Multi-tenant QoS (docs/serving.md "Multi-tenant QoS"): every request
+carries a ``tenant`` (default ``"default"``) and an integer
+``priority``. Admission is weighted-fair — the scheduler picks the
+most-underserved tenant by weighted virtual time (tokens served /
+weight, PADDLE_TPU_TENANT_WEIGHTS) — and per-tenant token-rate quotas
+(PADDLE_TPU_TENANT_QUOTA, a token bucket per tenant) defer a tenant's
+queued requests instead of running them. When a strictly
+higher-priority request cannot be admitted, the lowest-priority active
+slot is *preempted to host*: its pages go back to the allocator (full
+pages are stashed in the prefix cache so a quick resume re-maps them),
+prompt + tokens-so-far + seed stay host-side, and the request re-enters
+admission when pressure drops. Resume is a fresh admission over
+``prompt + generated``; the per-(seed, position) counter RNG makes the
+resumed stream token-identical to an unpreempted run, and the live
+`DecodeStream` survives preemption so the client-facing seq stream is
+gapless.
 
 `SpecDecodeEngine` layers draft-and-verify speculative decoding on the
 same machinery: a small draft GPT runs k greedy steps per tick over its
@@ -79,7 +97,9 @@ from ..observability import counter, gauge, histogram
 from ..observability.spans import SpanRecorder, next_request_id
 from ..observability.tracez import RING as _RING
 from ..testing import chaos
-from .batching import _WARMUP_SIG_CAP, bucket_ladder, next_bucket
+from .batching import (_WARMUP_SIG_CAP, bucket_ladder, next_bucket,
+                       tenant_quotas as _tenant_quotas,
+                       tenant_weights as _tenant_weights)
 from .errors import (ERR_INVALID_ARGUMENT, ERR_RESOURCE_EXHAUSTED,
                      ERR_UNAVAILABLE, TypedServeError)
 
@@ -182,6 +202,43 @@ def _decode_metrics():
                 "paddle_tpu_decode_page_rollback_released_total",
                 "Page references released by speculative rollback "
                 "(pages stranded past the last accepted token)"),
+            # multi-tenant QoS
+            "tenant_tokens": counter(
+                "paddle_tpu_tenant_decode_tokens_total",
+                "Tokens sampled by the decode engine per tenant",
+                labelnames=("tenant",)),
+            "tenant_admissions": counter(
+                "paddle_tpu_tenant_admissions_total",
+                "Requests admitted into a decode slot per tenant "
+                "(resumes after preemption count again)",
+                labelnames=("tenant",)),
+            "tenant_shed": counter(
+                "paddle_tpu_tenant_shed_total",
+                "Requests refused at decode admission because the "
+                "tenant was past its weighted share of the pending "
+                "queue (typed RESOURCE_EXHAUSTED)",
+                labelnames=("tenant",)),
+            "tenant_quota_deferred": counter(
+                "paddle_tpu_tenant_quota_deferred_total",
+                "Requests deferred in the pending queue because the "
+                "tenant's token-rate quota bucket was empty "
+                "(PADDLE_TPU_TENANT_QUOTA)",
+                labelnames=("tenant",)),
+            "preemptions": counter(
+                "paddle_tpu_decode_preemptions_total",
+                "Active decode slots evicted to host so a "
+                "higher-priority request could run"),
+            "preempt_resumes": counter(
+                "paddle_tpu_decode_preempt_resumes_total",
+                "Preempted requests re-admitted into a decode slot"),
+            "preempted_tokens": counter(
+                "paddle_tpu_decode_preempted_tokens_total",
+                "Generated tokens stashed host-side at preemption "
+                "(re-prefilled or prefix-cache-mapped at resume)"),
+            "preempted_waiting": gauge(
+                "paddle_tpu_decode_preempted_waiting",
+                "Preempted requests currently parked host-side "
+                "awaiting re-admission"),
         }
     return _METRICS
 
@@ -332,14 +389,18 @@ class DecodeStream:
                 return ev[1]
 
 
+DEFAULT_TENANT = "default"
+
+
 class _Req:
     __slots__ = ("id", "prompt", "max_new", "temperature", "top_k",
                  "eos_id", "seed", "stream", "cache_len", "last_tok",
                  "generated", "pages", "input_tail", "feeding",
-                 "t_submit", "t_admit", "prefill_s")
+                 "t_submit", "t_admit", "prefill_s", "tenant", "priority",
+                 "preempts", "deferred")
 
     def __init__(self, prompt, max_new, temperature, top_k, eos_id,
-                 seed=None):
+                 seed=None, tenant=DEFAULT_TENANT, priority=0):
         self.id = next_request_id()
         self.prompt = prompt
         self.max_new = max_new
@@ -357,6 +418,10 @@ class _Req:
         self.t_submit = time.monotonic()
         self.t_admit = 0.0
         self.prefill_s = 0.0
+        self.tenant = tenant
+        self.priority = priority         # higher wins; may preempt lower
+        self.preempts = 0                # times evicted to host
+        self.deferred = False            # quota deferral counted once
 
 
 class _SpecReq(_Req):
@@ -367,9 +432,9 @@ class _SpecReq(_Req):
                  "accepted")
 
     def __init__(self, prompt, max_new, temperature, top_k, eos_id,
-                 seed=None):
+                 seed=None, tenant=DEFAULT_TENANT, priority=0):
         super().__init__(prompt, max_new, temperature, top_k, eos_id,
-                         seed=seed)
+                         seed=seed, tenant=tenant, priority=priority)
         self.draft_len = 0       # draft-pool rows written (positions)
         self.spec_k = 1          # per-slot adaptive k (set at admission)
         self.accept_ema = 1.0    # EMA of per-tick acceptance rate
@@ -484,7 +549,9 @@ class DecodeEngine:
                  max_pending: Optional[int] = None,
                  page_tokens: Optional[int] = None,
                  num_pages: Optional[int] = None,
-                 prefix_cache: Optional[bool] = None):
+                 prefix_cache: Optional[bool] = None,
+                 tenant_weights=None, tenant_quota=None,
+                 preempt: Optional[bool] = None):
         if model is not None:
             from .. import framework
             cfg = model.cfg
@@ -547,7 +614,18 @@ class DecodeEngine:
         self._rng = np.random.default_rng(seed)
 
         self._pending: deque = deque()
+        self._paused: deque = deque()    # preempted-to-host requests
         self._active: List[_Req] = []
+        # multi-tenant QoS: fair-share weights, token-rate quota buckets,
+        # weighted virtual time per tenant (tokens served / weight)
+        self._weights = _tenant_weights(tenant_weights)
+        self._quota = _tenant_quotas(tenant_quota)
+        self._vtokens: Dict[str, float] = {}
+        self._quota_tokens: Dict[str, float] = {}
+        self._quota_ts = time.monotonic()
+        self._preempt_on = bool(
+            _flags.env_value("PADDLE_TPU_DECODE_PREEMPT")) \
+            if preempt is None else bool(preempt)
         self._kpool = None           # [L, P, page_tokens, nh, D], lazy
         self._vpool = None
         self._last_b_rung = self.batch_ladder[0]
@@ -564,7 +642,8 @@ class DecodeEngine:
 
     def submit(self, prompt: Sequence[int], max_new_tokens=None,
                temperature: float = 0.0, top_k: int = 0,
-               eos_id=None, seed=None) -> DecodeStream:
+               eos_id=None, seed=None, tenant=None,
+               priority=None) -> DecodeStream:
         toks = [int(t) for t in np.asarray(prompt, dtype=np.int64).reshape(-1)]
         if not toks:
             raise TypedServeError(ERR_INVALID_ARGUMENT, "empty prompt")
@@ -577,22 +656,53 @@ class DecodeEngine:
                 ERR_INVALID_ARGUMENT,
                 f"prompt length {len(toks)} leaves no room to generate "
                 f"(max_seq_len={self.cfg.max_seq_len})")
+        tenant = str(tenant).strip() if tenant else DEFAULT_TENANT
         req = self._req_cls(toks,
                             int(max_new_tokens or self.max_new_tokens),
                             float(temperature), int(top_k),
                             self.eos_id if eos_id is None else int(eos_id),
-                            seed=None if seed is None else int(seed))
+                            seed=None if seed is None else int(seed),
+                            tenant=tenant,
+                            priority=0 if priority is None else int(priority))
         with self._cond:
             if self._stop:
                 raise TypedServeError(ERR_UNAVAILABLE,
                                       "decode engine stopped")
-            if len(self._pending) >= self.max_pending:
+            # each tenant gets a weighted share of the pending queue, so
+            # a flood tenant saturates its own share while others keep
+            # a clear path to admission. A single tenant's share is the
+            # whole queue — the pre-QoS backpressure behavior. With
+            # several tenants queued the per-tenant share IS the
+            # watermark (a flood filling the global queue must not shed
+            # everyone else); 2x the watermark is the hard backstop.
+            mine = sum(1 for r in self._pending if r.tenant == tenant)
+            tset = {r.tenant for r in self._pending}
+            tset.add(tenant)
+            if len(tset) <= 1:
+                share = self.max_pending
+                over = len(self._pending) >= self.max_pending
+            else:
+                wsum = sum(self._weight(t) for t in tset)
+                share = max(1, int(round(
+                    self.max_pending * self._weight(tenant) / wsum)))
+                over = (mine >= share
+                        or len(self._pending) >= 2 * self.max_pending)
+            if over:
+                self._m["tenant_shed"].labels(tenant=tenant).inc()
                 raise TypedServeError(
                     ERR_RESOURCE_EXHAUSTED,
-                    f"decode queue full ({self.max_pending} pending)")
+                    f"decode queue full ({self.max_pending} pending): "
+                    f"tenant {tenant!r} holds {mine} of its "
+                    f"{share}-slot share")
             self._pending.append(req)
             self._cond.notify_all()
         return req.stream
+
+    def _weight(self, tenant: str) -> float:
+        return self._weights.get(tenant, self._weights["*"])
+
+    def _quota_rate(self, tenant: str) -> float:
+        return self._quota.get(tenant, self._quota["*"])
 
     def _pool_sds(self):
         L, nh, D = self.cfg.layers, self.cfg.heads, self.cfg.head_dim
@@ -653,6 +763,7 @@ class DecodeEngine:
         st = {
             "active": len(self._active),
             "pending": len(self._pending),
+            "paused": len(self._paused),
             "max_slots": self.max_slots,
             "steps": self._steps,
             "tokens": self._tokens,
@@ -664,6 +775,8 @@ class DecodeEngine:
             "kv_ladder": list(self.kv_ladder),
             "page_tokens": self.page_tokens,
             "pages": self._alloc.stats(),
+            "tenants": {t: round(v, 4)
+                        for t, v in sorted(dict(self._vtokens).items())},
         }
         if self._prefix is not None:
             st["prefix_cache"] = self._prefix.stats()
@@ -675,8 +788,10 @@ class DecodeEngine:
             self._stop = True
             self._cond.notify_all()
         self._thread.join(timeout=30)
-        leftovers = list(self._active) + list(self._pending)
+        leftovers = (list(self._active) + list(self._pending)
+                     + list(self._paused))
         self._active, self._pending = [], deque()
+        self._paused = deque()
         for req in leftovers:
             req.stream._push_error(TypedServeError(
                 ERR_UNAVAILABLE, "decode engine stopped"))
@@ -691,26 +806,43 @@ class DecodeEngine:
 
     def _loop(self):
         while True:
-            newly = []
+            newly, victims = [], []
             with self._cond:
                 while (not self._stop and not self._pending
-                       and not self._active):
+                       and not self._paused and not self._active):
                     self._cond.wait(timeout=0.1)
                 if self._stop:
                     return
-                free = self.max_slots - len(self._active)
-                while self._pending and free > 0:
-                    newly.append(self._pending.popleft())
-                    free -= 1
+                self._refill_quota()
+                newly, victims = self._schedule()
+                if not newly and not victims and not self._active:
+                    # everything queued is quota-blocked: wait for the
+                    # bucket refill instead of spinning
+                    self._cond.wait(timeout=0.02)
             try:
+                for vic in victims:
+                    self._preempt(vic)
                 for req in newly:
+                    if len(self._active) >= self.max_slots:
+                        # a preemption was abandoned (chaos) and its
+                        # candidate has no slot: requeue at the front
+                        with self._cond:
+                            if req.preempts:
+                                self._paused.appendleft(req)
+                            else:
+                                self._pending.appendleft(req)
+                        continue
                     t_adm = time.perf_counter()
                     admitted = self._admit(req)
                     _RING.complete("decode.admit", t_adm,
                                    time.perf_counter(), {"req": req.id})
                     if admitted:
                         self._active.append(req)
-                if newly:
+                        self._m["tenant_admissions"].labels(
+                            tenant=req.tenant).inc()
+                        if req.preempts:
+                            self._m["preempt_resumes"].inc()
+                if newly or victims:
                     self._update_gauges()
                 if self._active:
                     self._step_once()
@@ -725,6 +857,139 @@ class DecodeEngine:
                     self._release_pages(req)
                 self._active = []
                 self._update_gauges()
+
+    # ------------------------------------------------- QoS scheduling
+
+    def _schedule(self):
+        """Pick this tick's admissions — and preemption victims — under
+        `_cond`.
+
+        Weighted fair queuing over tenants: a tenant's virtual time
+        advances by tokens_served / weight, and each free slot goes to
+        the quota-eligible tenant head with the smallest virtual time
+        (preempted requests queue ahead of their tenant's fresh ones).
+        A tenant whose quota bucket is in debt is skipped — its requests
+        wait, they are never dropped. When no slot is free and
+        preemption is enabled, a head with strictly higher priority than
+        the lowest-priority active slot evicts it and takes the slot."""
+        newly: List[_Req] = []
+        victims: List[_Req] = []
+        free = self.max_slots - len(self._active)
+        preemptable = list(self._active)
+        while True:
+            heads: Dict[str, tuple] = {}
+            for q in (self._paused, self._pending):
+                for r in q:
+                    heads.setdefault(r.tenant, (q, r))
+            eligible: Dict[str, tuple] = {}
+            for t, (q, r) in heads.items():
+                if self._quota_ok(t):
+                    eligible[t] = (q, r)
+                elif not r.deferred:
+                    r.deferred = True
+                    self._m["tenant_quota_deferred"].labels(
+                        tenant=t).inc()
+            if not eligible:
+                return newly, victims
+            if free > 0:
+                t = min(eligible,
+                        key=lambda x: self._vtokens.get(x, 0.0))
+                q, r = eligible[t]
+                q.remove(r)
+                free -= 1
+            else:
+                if not self._preempt_on or not preemptable:
+                    return newly, victims
+                # the highest-priority eligible head justifies evicting
+                # the lowest-priority (most recently admitted) active
+                # slot — and takes that slot itself, so a third tenant
+                # cannot slip into the preempt-freed capacity
+                t, (q, r) = max(eligible.items(),
+                                key=lambda kv: kv[1][1].priority)
+                vic = min(preemptable,
+                          key=lambda a: (a.priority, -a.t_admit))
+                if r.priority <= vic.priority:
+                    return newly, victims
+                q.remove(r)
+                preemptable.remove(vic)
+                victims.append(vic)
+            newly.append(r)
+            # an idle tenant re-entering service starts at the busy
+            # tenants' floor, not at the ancient credit it banked
+            floor = min((self._vtokens.get(a.tenant, 0.0)
+                         for a in self._active), default=0.0)
+            self._vtokens[r.tenant] = max(
+                self._vtokens.get(r.tenant, 0.0), floor)
+
+    def _preempt(self, req: _Req) -> bool:
+        """Evict an active slot to host so a higher-priority request can
+        run: stash resumable state, release every page, park the request
+        in `_paused`. The live `DecodeStream` is untouched — the client
+        just sees a pause. On chaos the preemption is abandoned and the
+        victim keeps decoding."""
+        try:
+            chaos.maybe_fail("decode.preempt", detail=req.id)
+        except Exception:
+            return False
+        self._preempt_stash(req)
+        self._release_pages(req)
+        req.cache_len = 0
+        req.last_tok = 0
+        req.input_tail = deque()
+        req.feeding = False
+        req.preempts += 1
+        self._m["preemptions"].inc()
+        self._m["preempted_tokens"].inc(len(req.generated))
+        self._active = [r for r in self._active if r.id != req.id]
+        with self._cond:
+            self._paused.append(req)
+        return True
+
+    def _preempt_stash(self, req: _Req):
+        """Keep a victim's FULL pages alive in the prefix cache, keyed
+        by the tokens they hold, so a quick resume re-maps them instead
+        of re-prefilling. The partial last page is excluded — its rows
+        past the last page boundary were never written."""
+        if self._prefix is None:
+            return
+        pt = self.page_tokens
+        toks = (req.prompt + req.generated)[:req.cache_len]
+        if len(toks) >= pt:
+            self._prefix.insert(toks, req.pages[:len(toks) // pt])
+
+    def _refill_quota(self):
+        """Advance every tenant's token bucket by elapsed wall time
+        (rate tokens/s, burst = max(rate, 1)). Loop thread only."""
+        now = time.monotonic()
+        dt = now - self._quota_ts
+        if dt <= 0:
+            return
+        self._quota_ts = now
+        for t in list(self._quota_tokens):
+            rate = self._quota_rate(t)
+            if rate > 0:
+                self._quota_tokens[t] = min(
+                    self._quota_tokens[t] + dt * rate, max(rate, 1.0))
+
+    def _quota_ok(self, tenant: str) -> bool:
+        rate = self._quota_rate(tenant)
+        if rate <= 0:
+            return True
+        if tenant not in self._quota_tokens:
+            self._quota_tokens[tenant] = max(rate, 1.0)
+        return self._quota_tokens[tenant] > 0.0
+
+    def _note_token(self, req: _Req, n: int = 1):
+        """Charge `n` sampled tokens to the request's tenant: advances
+        its weighted virtual time and drains its quota bucket (which may
+        go negative — the debt defers the tenant's next admission)."""
+        t = req.tenant
+        self._vtokens[t] = self._vtokens.get(t, 0.0) + n / self._weight(t)
+        rate = self._quota_rate(t)
+        if rate > 0:
+            self._quota_tokens[t] = self._quota_tokens.get(
+                t, max(rate, 1.0)) - n
+        self._m["tenant_tokens"].labels(tenant=t).inc(n)
 
     # ---------------------------------------------------- page plumbing
 
@@ -799,15 +1064,23 @@ class DecodeEngine:
         — no prefill, no device work here at all. Miss: classic B=1
         prefill at the prompt rung, scatter the panel into fresh pages,
         deliver the first sampled token immediately. True if the
-        request now occupies a decode slot."""
-        plen = len(req.prompt)
+        request now occupies a decode slot.
+
+        A preempted request resumes through this same path over
+        ``prompt + generated`` (for a fresh request that IS the prompt):
+        replayed tokens are teacher-forced — prefix-mapped or prefilled,
+        then tail-fed without sampling — and the per-(seed, position)
+        RNG picks up sampling at the first unseen position, so the
+        resumed stream is token-identical to an unpreempted run."""
+        toks = req.prompt + req.generated
+        plen = len(toks)
         pt = self.page_tokens
         self._ensure_pool()
         req.t_admit = time.monotonic()
 
         usable, hit_pages = 0, []
         if self._prefix is not None:
-            hit_pages, hit_tokens = self._prefix.lookup(req.prompt)
+            hit_pages, hit_tokens = self._prefix.lookup(toks)
             self._m["prefix_lookup_tokens"].inc(plen)
             # at least one prompt token is always re-fed so the step
             # has logits to sample the first generated token from
@@ -824,22 +1097,22 @@ class DecodeEngine:
         if usable:
             req.pages = hit_pages
             req.cache_len = usable
-            req.last_tok = req.prompt[usable]
-            req.input_tail = deque(req.prompt[usable + 1:])
+            req.last_tok = toks[usable]
+            req.input_tail = deque(toks[usable + 1:])
             req.feeding = True
             return True
 
         # miss: full prefill at the prompt's kv rung
         rung = next_bucket(plen, self.kv_ladder)
-        toks = np.zeros((1, rung), np.int32)
-        toks[0, :plen] = req.prompt
+        inp = np.zeros((1, rung), np.int32)
+        inp[0, :plen] = toks
         exe = self._prefill_aot.get_or_compile(
             self.params,
             jax.ShapeDtypeStruct((1, rung), jnp.int32),
             jax.ShapeDtypeStruct((1,), jnp.int32),
             key=("prefill", 1, rung))
         t0 = time.perf_counter()
-        logits, k, v = exe(self.params, jnp.asarray(toks),
+        logits, k, v = exe(self.params, jnp.asarray(inp),
                            jnp.asarray([plen], np.int32))
         row = np.asarray(logits)[0]
         req.prefill_s = time.perf_counter() - t0
@@ -873,7 +1146,8 @@ class DecodeEngine:
             jnp.asarray(vrows.reshape(L, w, pt, nh, D)),
             jnp.asarray(ids))
         req.pages = pages
-        self._m["ttft"].observe(time.monotonic() - req.t_submit)
+        if not req.generated:        # resumes already saw first-token
+            self._m["ttft"].observe(time.monotonic() - req.t_submit)
         try:
             chaos.maybe_fail("decode.stream", detail=req.id)
             tok = self._sample(row, req)
@@ -888,8 +1162,9 @@ class DecodeEngine:
         req.generated.append(tok)
         self._tokens += 1
         self._m["tokens"].inc()
+        self._note_token(req)
         if self._prefix is not None:
-            self._prefix.insert(req.prompt, pages[:plen // pt])
+            self._prefix.insert(toks, pages[:plen // pt])
         eos = req.eos_id is not None and tok == req.eos_id
         req.stream._push_token(tok, eos)
         _RING.instant("decode.emit", {"req": req.id})
@@ -924,7 +1199,8 @@ class DecodeEngine:
                 self._release_pages(req)
                 victims.append(req)
         if victims:
-            self._active = [r for r in self._active if r not in victims]
+            dead = {r.id for r in victims}
+            self._active = [r for r in self._active if r.id not in dead]
             self._update_gauges()
         reqs = self._active
         if not reqs:
@@ -984,6 +1260,7 @@ class DecodeEngine:
             req.last_tok = tok
             self._tokens += 1
             self._m["tokens"].inc()
+            self._note_token(req)
             if first:
                 self._m["ttft"].observe(time.monotonic() - req.t_submit)
             eos = req.eos_id is not None and tok == req.eos_id
@@ -1000,7 +1277,8 @@ class DecodeEngine:
                        {"batch": len(reqs), "b_rung": b_rung,
                         "w_rung": w_rung})
         if finished:
-            self._active = [r for r in reqs if r not in finished]
+            done = {r.id for r in finished}
+            self._active = [r for r in reqs if r.id not in done]
             self._update_gauges()
 
     def _finish(self, req: _Req, reason: str):
@@ -1051,6 +1329,7 @@ class DecodeEngine:
         n = len(self._active)
         self._m["active"].set(n)
         self._m["occupancy"].set(n / max(self.max_slots, 1))
+        self._m["preempted_waiting"].set(len(self._paused))
         ps = self._alloc.stats()
         self._m["page_pool_size"].set(ps["pages_total"])
         self._m["page_in_use"].set(ps["pages_used"])
@@ -1087,10 +1366,13 @@ class SpecDecodeEngine(DecodeEngine):
     `PageAllocator`, same per-slot block tables, so one page id names
     one target page AND one draft page. The target then scores all
     drafted positions in a single `gpt_paged_verify_fns` forward (which
-    also writes their target K/V rows); acceptance is standard
-    rejection sampling against the target distribution (argmax equality
-    at temperature 0, so speculative greedy output is token-for-token
-    the plain engine's). A rejection is pure host bookkeeping: truncate
+    also writes their target K/V rows); acceptance is
+    sample-then-compare — the committed token at each position is the
+    target's own (argmax, or the per-(seed, position) sampler over the
+    verify logits) and a draft is accepted iff it guessed it, so
+    speculative output is token-for-token the plain engine's for greedy
+    AND seeded-sampled decode. A rejection is pure host bookkeeping:
+    truncate
     `cache_len`, drop the block-table tail through
     `PageAllocator.release_range` (stale rows inside kept pages are
     masked by `lengths` and overwritten next tick — no contiguous-rung
@@ -1290,16 +1572,18 @@ class SpecDecodeEngine(DecodeEngine):
         return True
 
     def _draft_prefill(self, req: _Req):
-        """One fused B=1 draft prefill-into-pages dispatch at the prompt
-        rung, scattered into the SAME page ids the target panel landed
-        in. These writes deliberately skip the COW check: the rows hold
-        the committed prompt's K/V — the one thing every mapper of a
+        """One fused B=1 draft prefill-into-pages dispatch over the
+        committed sequence (the prompt — or prompt + replayed tokens on
+        a preempt resume), scattered into the SAME page ids the target
+        panel landed in. These writes deliberately skip the COW check:
+        the rows hold committed K/V — the one thing every mapper of a
         shared prefix page agrees on."""
-        plen = len(req.prompt)
+        seq = (req.prompt + req.generated)[:req.cache_len]
+        plen = len(seq)
         pt = self.page_tokens
         rung = next_bucket(plen, self.kv_ladder)
         toks = np.zeros((1, rung), np.int32)
-        toks[0, :plen] = req.prompt
+        toks[0, :plen] = seq
         w = -(-rung // pt)
         tables = np.zeros((1, w), np.int32)
         tables[0, :len(req.pages)] = req.pages
@@ -1313,6 +1597,22 @@ class SpecDecodeEngine(DecodeEngine):
             self._draft_params, self._dkpool, self._dvpool,
             jnp.asarray(toks), jnp.asarray(tables),
             jnp.asarray([plen], np.int32))
+
+    def _preempt_stash(self, req: _Req):
+        """Stash only PROMPT-region pages at preemption. Generated-region
+        pages may carry draft rows past the commit point (speculation in
+        flight); a resume that prefix-mapped them would skip the draft
+        re-prefill and let stale draft rows steer the greedy draft chain
+        — diverging the rejection-sampling draw sequence from an
+        unpreempted run. The prompt resume path re-drafts the generated
+        region instead. Rows the draft catch-up has not reached yet
+        (`draft_len` lagging `cache_len`) are excluded the same way."""
+        if self._prefix is not None:
+            full = min(req.cache_len, req.draft_len,
+                       len(req.prompt)) // self.page_tokens
+            if full:
+                self._prefix.insert(req.prompt, req.pages[:full])
+        req.draft_len = 0
 
     # ------------------------------------------------------------ tick
 
@@ -1348,7 +1648,8 @@ class SpecDecodeEngine(DecodeEngine):
                 self._release_pages(req)
                 victims.append(req)
         if victims:
-            self._active = [r for r in self._active if r not in victims]
+            dead = {r.id for r in victims}
+            self._active = [r for r in self._active if r.id not in dead]
             self._update_gauges()
         reqs = self._active
         if not reqs:
@@ -1443,32 +1744,25 @@ class SpecDecodeEngine(DecodeEngine):
                         req.prompt, req.pages[:len(req.prompt) // pt])
             emitted, a, i = [], 0, n_known - 1
             while True:
-                accept = False
                 if req.temperature > 0.0 and lognp is None:
                     lognp = np.asarray(logits)
-                pos = len(req.prompt) + len(req.generated) + len(emitted)
-                if a < nd:
-                    d = drafts[a]
-                    if req.temperature <= 0.0:
-                        tok = int(amaxnp[j, i])
-                        accept = tok == d
-                    else:
-                        g = self._req_rng(req, pos)
-                        p = self._dist(lognp[j, i], req)
-                        if g.random() < p[d]:
-                            accept, tok = True, d
-                        else:
-                            q = p.copy()
-                            q[d] = 0.0
-                            s = q.sum()
-                            if s <= 0.0:        # p was a point mass on d
-                                accept, tok = True, d
-                            else:
-                                tok = int(g.choice(q.shape[0], p=q / s))
-                elif req.temperature <= 0.0:
+                # Sample-then-compare verification: the committed token
+                # at every position comes straight from the target —
+                # greedy argmax, or the plain engine's per-(seed, pos)
+                # sampler over the verify logits — and a draft is
+                # accepted iff it guessed that token. A draft d is
+                # accepted with probability p[d], exactly classic
+                # rejection sampling's, but the OUTPUT never depends on
+                # the draft chain: a speculative stream is draw-for-draw
+                # the plain engine's across any k, batch composition, or
+                # preempt/resume history.
+                if req.temperature <= 0.0:
                     tok = int(amaxnp[j, i])
                 else:
+                    pos = len(req.prompt) + len(req.generated) \
+                        + len(emitted)
                     tok = self._sample(lognp[j, i], req, pos=pos)
+                accept = a < nd and tok == drafts[a]
                 emitted.append(tok)
                 if accept:
                     a += 1
@@ -1524,6 +1818,7 @@ class SpecDecodeEngine(DecodeEngine):
             req.generated.extend(emitted)
             self._tokens += len(emitted)
             self._m["tokens"].inc(len(emitted))
+            self._note_token(req, len(emitted))
             req.stream._push_tokens(
                 emitted,
                 req.eos_id is not None and emitted[-1] == req.eos_id)
@@ -1542,7 +1837,8 @@ class SpecDecodeEngine(DecodeEngine):
         _RING.complete("decode.step", t_tick, now,
                        {"batch": len(reqs), "k": tick_k})
         if finished:
-            self._active = [r for r in reqs if r not in finished]
+            done = {r.id for r in finished}
+            self._active = [r for r in reqs if r.id not in done]
             self._update_gauges()
 
     def stats(self) -> Dict:

@@ -114,6 +114,13 @@ _BREAKER_STATE_CODE = {CircuitBreaker.CLOSED: 0,
                        CircuitBreaker.OPEN: 2}
 
 
+class _RerouteShed(Exception):
+    """Internal: a backend answered RESOURCE_EXHAUSTED before any token
+    was relayed — unwind the stream attempt and reroute to a sibling
+    without counting a breaker failure (the backend answered; it is
+    saturated, not broken)."""
+
+
 def _router_metrics():
     """Register (idempotently) and return the paddle_tpu_router_* metric
     families. Catalogued in docs/observability.md."""
@@ -202,6 +209,20 @@ def _router_metrics():
             "paddle_tpu_router_membership_events_total",
             "Routing-table updates driven by the membership watcher, "
             "by event (join, leave)", ("event",)),
+        "reroutes": counter(
+            "paddle_tpu_router_reroutes_total",
+            "Requests rerouted to a sibling after one backend answered "
+            "RESOURCE_EXHAUSTED at its own admission watermark "
+            "(one-shot, spends from the shared retry budget; shed is "
+            "terminal only when every backend is saturated)"),
+        "tenant_shed": counter(
+            "paddle_tpu_router_tenant_shed_total",
+            "Requests refused at the router because the tenant was at "
+            "its PADDLE_TPU_ROUTER_TENANT_MAX_INFLIGHT cap; never "
+            "counted against fleet availability", ("tenant",)),
+        "tenant_inflight": gauge(
+            "paddle_tpu_router_tenant_inflight",
+            "Requests currently being routed, per tenant", ("tenant",)),
     }
 
 
@@ -352,6 +373,13 @@ class ServeRouter:
         self._idle_timeout = float(idle_timeout) if idle_timeout else None
         self._budget = retry_budget or RetryBudget()
         self._max_inflight = max(int(max_inflight_per_backend), 1)
+        # multi-tenant isolation: a per-tenant in-flight cap (0 = off)
+        # and per-tenant retry budgets so one tenant's failure storm
+        # cannot drain the shared budget or trip fleet-wide alerts
+        self._tenant_max_inflight = max(int(_flags.env_value(
+            "PADDLE_TPU_ROUTER_TENANT_MAX_INFLIGHT") or 0), 0)
+        self._tenant_inflight = {}              # tenant -> in-flight
+        self._tenant_budgets = {}               # tenant -> RetryBudget
         self._local = threading.local()         # per-thread conn cache
         # every thread's cache dict, so remove_backend can purge a dead
         # backend's sockets fleet-wide, not just the calling thread's
@@ -701,7 +729,55 @@ class ServeRouter:
         finally:
             b.end()
 
-    def _handle(self, arrays, ctx=None, info=None):
+    # -- multi-tenant isolation -------------------------------------------
+
+    @staticmethod
+    def _tenant_of(cctx) -> str:
+        """Tenant identity off the wire ctx: the decode ctx field for
+        streams, the top-level field for one-shot requests."""
+        if not isinstance(cctx, dict):
+            return "default"
+        d = cctx.get("decode")
+        t = d.get("tenant") if isinstance(d, dict) else None
+        t = t or cctx.get("tenant")
+        return str(t).strip() if t else "default"
+
+    def _budget_for(self, tenant) -> RetryBudget:
+        """Non-default tenants spend failover retries from their own
+        budget: a flood tenant burning retries cannot starve everyone
+        else's failovers."""
+        if tenant == "default":
+            return self._budget
+        b = self._tenant_budgets.get(tenant)
+        if b is None:
+            b = self._tenant_budgets.setdefault(tenant, RetryBudget())
+        return b
+
+    def _tenant_admit(self, tenant) -> bool:
+        """Claim an in-flight slot for the tenant; False when it is at
+        its PADDLE_TPU_ROUTER_TENANT_MAX_INFLIGHT cap (0 disables)."""
+        if self._tenant_max_inflight <= 0:
+            return True
+        with self._inflight_lock:
+            n = self._tenant_inflight.get(tenant, 0)
+            if n >= self._tenant_max_inflight:
+                return False
+            self._tenant_inflight[tenant] = n + 1
+        self._m["tenant_inflight"].labels(tenant=tenant).inc()
+        return True
+
+    def _tenant_release(self, tenant):
+        if self._tenant_max_inflight <= 0:
+            return
+        with self._inflight_lock:
+            n = self._tenant_inflight.get(tenant, 1) - 1
+            if n <= 0:
+                self._tenant_inflight.pop(tenant, None)
+            else:
+                self._tenant_inflight[tenant] = n
+        self._m["tenant_inflight"].labels(tenant=tenant).dec()
+
+    def _handle(self, arrays, ctx=None, info=None, tenant="default"):
         """Route one decoded request. Returns ``("ok", outputs)`` or
         ``(outcome, error_message)`` with outcome one of
         ``relayed_error`` / ``shed`` / ``unavailable``. ``ctx`` is the
@@ -713,11 +789,15 @@ class ServeRouter:
         info = info if info is not None else {}
         info.update(pick_s=0.0, forward_s=0.0, backend=None,
                     backend_ctx=None, attempts=0)
-        self._budget.record_request()
+        budget = self._budget_for(tenant)
+        budget.record_request()
         tried = set()
         attempts = 0
         first_failure_t = None
         last_err = None
+        rerouted = False         # one-shot RESOURCE_EXHAUSTED reroute
+        pending_reroute = False  # next attempt is the reroute, not a failover
+        last_shed = None         # the shed errmsg, relayed if terminal
         max_attempts = 1 + self._failover_retries
         while attempts < max_attempts:
             t_pick = time.perf_counter()
@@ -728,7 +808,7 @@ class ServeRouter:
                 info["pick_s"] += now - t_pick
                 self._ring.complete("router.pick", t_pick, now,
                                     {"outcome": "shed"})
-                return ("shed", str(e))
+                return ("shed", last_shed or str(e))
             now = time.perf_counter()
             info["pick_s"] += now - t_pick
             self._ring.complete("router.pick", t_pick, now,
@@ -736,13 +816,21 @@ class ServeRouter:
             if b is None:
                 break
             if attempts > 0:
-                if not self._budget.try_spend():
+                if not budget.try_spend():
                     self._m["budget_denied"].inc()
+                    if last_shed is not None:
+                        # the reroute could not be funded: the shed is
+                        # terminal — relay it so the client backs off
+                        return ("shed", last_shed)
                     return ("unavailable",
                             f"{ERR_UNAVAILABLE}: retry budget exhausted "
                             f"after backend failure ({last_err}); "
                             f"failing fast instead of retry-storming")
-                self._m["failovers"].inc()
+                if pending_reroute:
+                    pending_reroute = False
+                    self._m["reroutes"].inc()
+                else:
+                    self._m["failovers"].inc()
             attempts += 1
             info["attempts"] = attempts
             tried.add(b.key)
@@ -762,6 +850,7 @@ class ServeRouter:
                 b.breaker.record_failure()
                 self._drop_conn(b)
                 last_err = f"{b.key}: {type(e).__name__}: {e}"
+                last_shed = None   # freshest failure is no longer a shed
                 if first_failure_t is None:
                     first_failure_t = time.monotonic()
                 continue
@@ -776,9 +865,30 @@ class ServeRouter:
                     # died, worker crashed): failover-safe
                     b.breaker.record_failure()
                     last_err = f"{b.key}: {errmsg}"
+                    last_shed = None
                     if first_failure_t is None:
                         first_failure_t = time.monotonic()
                     continue
+                if code == ERR_RESOURCE_EXHAUSTED and not rerouted:
+                    # this backend shed at its own admission watermark;
+                    # a sibling may have free slots — one-shot reroute
+                    # to the least-loaded non-shedding backend (spends
+                    # from the shared retry budget at the top of the
+                    # loop). Shed stays terminal only when every
+                    # backend is saturated.
+                    b.breaker.record_success()   # it answered; healthy
+                    rerouted = pending_reroute = True
+                    last_shed = errmsg
+                    last_err = f"{b.key}: {errmsg}"
+                    max_attempts += 1   # don't eat a failover retry
+                    continue
+                if code == ERR_RESOURCE_EXHAUSTED:
+                    # the reroute target shed too: the fleet really is
+                    # saturated — terminal shed (counts against the shed
+                    # outcome, not as a relayed model error)
+                    b.breaker.record_success()
+                    info["backend"], info["backend_ctx"] = b.key, rctx
+                    return ("shed", errmsg)
                 # deterministic / non-retryable error: relay verbatim —
                 # the backend answered, so its breaker heals
                 b.breaker.record_success()
@@ -790,6 +900,10 @@ class ServeRouter:
                     time.monotonic() - first_failure_t)
             info["backend"], info["backend_ctx"] = b.key, rctx
             return ("ok", outputs)
+        if last_shed is not None:
+            # the only failure seen was a backend shed and no sibling
+            # could take the reroute: terminal shed, not UNAVAILABLE
+            return ("shed", last_shed)
         detail = last_err or ("no routable backend (all unhealthy, "
                               "draining, or circuit-broken)")
         return ("unavailable",
@@ -856,13 +970,16 @@ class ServeRouter:
             # per-stream seed; mint one so every attempt samples the
             # same continuation
             opts["seed"] = int.from_bytes(os.urandom(4), "little")
-        self._budget.record_request()
+        budget = self._budget_for(self._tenant_of(cctx))
+        budget.record_request()
         emitted = []             # tokens relayed to the client, in order
         eos_seen = False
         tried = set()
         attempts = 0
         first_failure_t = None
         last_err = None
+        rerouted = False         # one-shot RESOURCE_EXHAUSTED reroute
+        last_shed = None         # the shed errmsg, relayed if terminal
         max_attempts = 1 + self._stream_retries
         while attempts < max_attempts:
             if emitted and (eos_seen or
@@ -880,7 +997,8 @@ class ServeRouter:
             except TypedServeError as e:         # shed: every backend busy
                 if not emitted:
                     try:
-                        write_error(conn, str(e), ctx=self._stream_ctx(
+                        write_error(conn, last_shed or str(e),
+                                    ctx=self._stream_ctx(
                             rid, trace_id, {"done": True, "error": True,
                                             "seq": 0}))
                     except OSError:
@@ -891,7 +1009,7 @@ class ServeRouter:
             if b is None:
                 break
             if attempts > 0:
-                if not self._budget.try_spend():
+                if not budget.try_spend():
                     self._m["budget_denied"].inc()
                     last_err = (f"retry budget exhausted after "
                                 f"{last_err}")
@@ -925,6 +1043,15 @@ class ServeRouter:
                         code = error_code(errmsg)
                         if code in RETRYABLE_CODES:
                             raise TypedServeError(code, errmsg)
+                        if (code == ERR_RESOURCE_EXHAUSTED
+                                and not rerouted and not emitted):
+                            # shed at decode admission before any token:
+                            # one-shot reroute to a sibling with free
+                            # slots (terminal only when all saturated)
+                            rerouted = True
+                            last_shed = errmsg
+                            max_attempts += 1
+                            raise _RerouteShed(errmsg)
                         # deterministic error: relay verbatim; the
                         # backend answered, so its breaker heals
                         b.breaker.record_success()
@@ -988,11 +1115,19 @@ class ServeRouter:
                                  "done": False}))
                     except (ConnectionError, TimeoutError, OSError):
                         return ("client_gone", False)
+            except _RerouteShed as e:
+                # the backend answered (saturated, not broken): heal its
+                # breaker and reroute without a failure mark
+                b.breaker.record_success()
+                self._m["reroutes"].inc()
+                last_err = f"{b.key}: {e}"
+                continue
             except (TypedServeError, ConnectionError, TimeoutError,
                     OSError, struct.error, ValueError, IndexError) as e:
                 # mid-stream backend failure: count it, resume elsewhere
                 b.breaker.record_failure()
                 last_err = f"{b.key}: {type(e).__name__}: {e}"
+                last_shed = None   # freshest failure is no longer a shed
                 if first_failure_t is None:
                     first_failure_t = time.monotonic()
                 continue
@@ -1003,6 +1138,17 @@ class ServeRouter:
                         s.close()
                     except OSError:
                         pass
+        if last_shed is not None and not emitted:
+            # the only failure seen was an admission shed and no sibling
+            # could take the reroute: relay it terminally — the client
+            # backs off instead of treating the fleet as down
+            try:
+                write_error(conn, last_shed, ctx=self._stream_ctx(
+                    rid, trace_id, {"done": True, "error": True,
+                                    "seq": 0}))
+            except OSError:
+                return ("shed", False)
+            return ("shed", True)
         # out of backends or budget: the stream is lost
         self._m["stream_lost"].inc()
         detail = last_err or ("no routable backend (all unhealthy, "
@@ -1082,12 +1228,38 @@ class ServeRouter:
                 # names the whole client->router->backend trace
                 rid = next_request_id()
                 trace_id = (cctx or {}).get("trace_id") or rid
-                if cctx is not None and isinstance(cctx.get("decode"),
-                                                   dict):
+                tenant = self._tenant_of(cctx)
+                is_stream = (cctx is not None
+                             and isinstance(cctx.get("decode"), dict))
+                if not self._tenant_admit(tenant):
+                    # router-side per-tenant cap: refuse THIS tenant
+                    # without touching a backend; the dedicated outcome
+                    # keeps one tenant's flood out of the fleet-wide
+                    # availability objective
+                    self._m["tenant_shed"].labels(tenant=tenant).inc()
+                    self._m["requests"].labels(
+                        outcome="tenant_shed").inc()
+                    msg = (f"{ERR_RESOURCE_EXHAUSTED}: tenant "
+                           f"{tenant!r} is at its router in-flight "
+                           f"cap ({self._tenant_max_inflight}; "
+                           "PADDLE_TPU_ROUTER_TENANT_MAX_INFLIGHT)")
+                    ectx = (self._stream_ctx(
+                        rid, trace_id,
+                        {"done": True, "error": True, "seq": 0})
+                        if is_stream else None)
+                    try:
+                        write_error(conn, msg, ctx=ectx)
+                    except (ConnectionError, TimeoutError, OSError):
+                        return
+                    continue
+                if is_stream:
                     # decode stream: leave the one-reply fast path for
                     # the seq-relaying proxy with mid-stream failover
-                    alive = self._serve_stream(conn, arrays, cctx, rid,
-                                               trace_id)
+                    try:
+                        alive = self._serve_stream(conn, arrays, cctx,
+                                                   rid, trace_id)
+                    finally:
+                        self._tenant_release(tenant)
                     if not alive or self._draining.is_set():
                         return
                     continue
@@ -1101,8 +1273,10 @@ class ServeRouter:
                 info = {}
                 try:
                     outcome, payload = self._handle(arrays, ctx=fwd_ctx,
-                                                    info=info)
+                                                    info=info,
+                                                    tenant=tenant)
                 finally:
+                    self._tenant_release(tenant)
                     with self._inflight_lock:
                         self._inflight -= 1
                     self._m["inflight"].dec()

@@ -81,6 +81,28 @@ def _recv_exact(sock, n):
     return recv_exact(sock, n, what="client")
 
 
+_TENANT_METRICS = None
+
+
+def _tenant_serve_metrics():
+    """Per-tenant request/error counters — the key families the
+    per-tenant SLO objectives (observability/slo.py) burn against."""
+    global _TENANT_METRICS
+    if _TENANT_METRICS is None:
+        from ..observability import counter
+        _TENANT_METRICS = {
+            "requests": counter(
+                "paddle_tpu_tenant_requests_total",
+                "Decode requests served per tenant",
+                labelnames=("tenant",)),
+            "errors": counter(
+                "paddle_tpu_tenant_errors_total",
+                "Decode requests that ended in a typed error frame, "
+                "per tenant", labelnames=("tenant",)),
+        }
+    return _TENANT_METRICS
+
+
 def max_request_bytes() -> int:
     """Per-request payload budget (``PADDLE_TPU_MAX_REQUEST_BYTES``)."""
     return int(_flags.env_value("PADDLE_TPU_MAX_REQUEST_BYTES"))
@@ -245,7 +267,9 @@ def decode_request(sock, prompt, opts=None, trace=True,
     """Client half of the decode wire exchange on an open socket.
 
     Sends the prompt (int32 [T]); with ``trace=True`` the request is a
-    'PDI2' frame (``opts`` rides in its ``decode`` context field) and
+    'PDI2' frame (``opts`` rides in its ``decode`` context field —
+    including the multi-tenant QoS identity ``tenant``/``priority``,
+    which server and router read from there) and
     the server streams per-token frames — ``on_token(tok, stream_ctx)``
     fires for each — before the final accumulated frame. ``trace=False``
     sends legacy 'PDI1' and blocks for the single accumulated reply.
@@ -522,12 +546,14 @@ class InferenceServer:
             threading.Thread(target=self._serve_conn, args=(conn,),
                              daemon=True).start()
 
-    def _run(self, inputs):
+    def _run(self, inputs, ctx=None):
         """-> (outputs, future_or_None); the future carries the request
         id and (post-delivery) the span breakdown a traced reply echoes
-        back to the caller."""
+        back to the caller. A ``tenant`` field in the request ctx tags
+        the request for the batcher's weighted-fair QoS."""
         if self._batcher is not None:
-            fut = self._batcher.submit(inputs)
+            tenant = (ctx or {}).get("tenant")
+            fut = self._batcher.submit(inputs, tenant=tenant)
             deadline = self._request_timeout
             if not deadline or deadline <= 0:
                 return fut.result(), fut
@@ -591,6 +617,15 @@ class InferenceServer:
                     opts[key] = int(d[key])
             if d.get("temperature") is not None:
                 opts["temperature"] = float(d["temperature"])
+            # multi-tenant QoS identity (docs/serving.md): who to bill
+            # the tokens to, and how urgently to schedule them
+            if d.get("tenant") is not None:
+                opts["tenant"] = str(d["tenant"])
+            if d.get("priority") is not None:
+                opts["priority"] = int(d["priority"])
+        tenant = opts.get("tenant") or "default"
+        tm = _tenant_serve_metrics()
+        tm["requests"].labels(tenant=tenant).inc()
 
         def _sctx(stream_fields, req_id=None):
             if ctx is None:
@@ -617,6 +652,7 @@ class InferenceServer:
                     "decode prompt must be int32/int64 [T] or [1, T]")
             stream = self._engine.submit(prompt.reshape(-1), **opts)
         except TypedServeError as e:
+            tm["errors"].labels(tenant=tenant).inc()
             try:
                 write_error(conn, str(e),
                             ctx=_sctx({"done": True, "error": True}))
@@ -646,6 +682,7 @@ class InferenceServer:
                                    "done": False}, stream.request_id))
                 seq += 1
         except TypedServeError as e:
+            tm["errors"].labels(tenant=tenant).inc()
             try:
                 write_error(conn, str(e),
                             ctx=_sctx({"done": True, "error": True,
@@ -691,7 +728,7 @@ class InferenceServer:
                             return
                     else:
                         try:
-                            outputs, fut = self._run(inputs)
+                            outputs, fut = self._run(inputs, ctx)
                             chaos.maybe_fail("serve.conn.reply")
                             write_tensors(conn, outputs,
                                           ctx=self._reply_ctx(ctx, fut))

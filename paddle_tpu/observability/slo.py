@@ -33,6 +33,9 @@ Env knobs (all optional):
   * ``PADDLE_TPU_SLO_P99_MS``        latency threshold in ms (default
     off); ``PADDLE_TPU_SLO_LATENCY_TARGET`` fraction of requests that
     must beat it (default 0.99)
+  * ``PADDLE_TPU_SLO_TENANTS``       ``tenant[:target]`` comma list:
+    one extra availability objective per named tenant over the
+    ``paddle_tpu_tenant_*`` counters (default off)
   * ``PADDLE_TPU_SLO_WINDOWS``       ``short,long`` seconds
     (default ``60,300``)
   * ``PADDLE_TPU_SLO_BURN``          ``warn,firing`` factors
@@ -48,7 +51,7 @@ from . import metrics as _metrics
 from .timeseries import TimeSeriesStore
 
 __all__ = ["Objective", "SLOEngine", "slo_windows", "slo_burn_factors",
-           "serve_objectives", "router_objectives"]
+           "serve_objectives", "router_objectives", "tenant_objectives"]
 
 
 def _env_float(name: str, default: float) -> float:
@@ -275,6 +278,43 @@ def serve_objectives() -> List[Objective]:
             "serve_latency", "latency", target,
             hist_key="paddle_tpu_serve_request_latency_seconds",
             threshold_s=p99_ms / 1000.0))
+    objs.extend(tenant_objectives())
+    return objs
+
+
+def tenant_objectives() -> List[Objective]:
+    """Per-tenant availability objectives from ``PADDLE_TPU_SLO_TENANTS``
+    (a ``tenant[:target]`` comma list; target defaults to the fleet
+    availability target) over the per-tenant serve counters. Each tenant
+    burns its own error budget on ``/alertz``, so one tenant melting
+    down cannot trip another tenant's — or the fleet's — alert."""
+    raw = (_flags.env_raw("PADDLE_TPU_SLO_TENANTS") or "").strip()
+    if not raw:
+        return []
+    default = _env_float("PADDLE_TPU_SLO_AVAILABILITY", 0.999)
+    if not 0.0 < default < 1.0:
+        default = 0.999
+    objs: List[Objective] = []
+    for part in raw.replace(";", ",").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, val = part.partition(":")
+        name = name.strip()
+        if not name:
+            continue
+        try:
+            target = float(val) if val.strip() else default
+        except ValueError:
+            target = default
+        if not 0.0 < target < 1.0:
+            continue
+        objs.append(Objective(
+            f"tenant_availability:{name}", "availability", target,
+            total_keys=(
+                f'paddle_tpu_tenant_requests_total{{tenant="{name}"}}',),
+            bad_keys=(
+                f'paddle_tpu_tenant_errors_total{{tenant="{name}"}}',)))
     return objs
 
 

@@ -238,6 +238,14 @@ register_site("serve.stream_write",
 register_site("decode.stream", "each token delivery in the decode engine")
 register_site("decode.page_alloc",
               "each KV page allocation in the paged decode engine")
+register_site("decode.preempt",
+              "each preempt-to-host eviction in the decode engine "
+              "(a raise abandons the preemption: the victim keeps "
+              "decoding and the candidate is requeued)")
+register_site("batcher.quota",
+              "each per-tenant quota check during anchor selection "
+              "(a raise defers the tenant as if quota-blocked; "
+              "requests queue, never drop)")
 
 
 def maybe_fail(site: str, detail=None):

@@ -26,6 +26,7 @@ from paddle_tpu.inference.errors import (ERR_RESOURCE_EXHAUSTED,
 from paddle_tpu.inference.router import (Backend, ServeRouter,
                                          parse_backend)
 from paddle_tpu.inference.serve import (InferenceServer, read_reply,
+                                        read_tensors, write_error,
                                         write_tensors)
 from paddle_tpu.static import InputSpec
 from paddle_tpu.testing import chaos
@@ -491,6 +492,99 @@ def test_router_drain_answers_inflight(mlp_prefix):
     finally:
         router.stop()
         srv.stop()
+
+
+# -- one-shot reroute on a backend's own admission shed ------------------
+
+def _saturated_backend():
+    """A wire-protocol stub standing in for a backend past its admission
+    watermark: healthy on the wire, but every request gets a typed
+    RESOURCE_EXHAUSTED error frame back."""
+    lst = socket.socket()
+    lst.bind(("127.0.0.1", 0))
+    lst.listen(8)
+    stop = threading.Event()
+    served = []
+
+    def loop():
+        while not stop.is_set():
+            try:
+                c, _ = lst.accept()
+            except OSError:
+                return
+            try:
+                while not stop.is_set():
+                    read_tensors(c)
+                    served.append(1)
+                    write_error(c, str(TypedServeError(
+                        ERR_RESOURCE_EXHAUSTED,
+                        "serve queue past watermark (synthetic)")))
+            except (OSError, ValueError, struct.error):
+                pass
+            finally:
+                c.close()
+
+    threading.Thread(target=loop, daemon=True).start()
+    return lst, stop, served
+
+
+def test_router_reroutes_backend_shed_to_free_sibling(mlp_prefix):
+    """A backend answering RESOURCE_EXHAUSTED at its own admission
+    watermark gets exactly one reroute to the least-loaded non-shedding
+    sibling; the request completes, the shedding backend's breaker stays
+    CLOSED (it answered — it is busy, not broken), and the reroute is
+    counted as a reroute, not a failover."""
+    from paddle_tpu.observability import REGISTRY
+    lst, stop, served = _saturated_backend()
+    srv = _start_backend(mlp_prefix)
+    busy = Backend("127.0.0.1", lst.getsockname()[1])
+    real = Backend("127.0.0.1", srv.port, srv.metrics_port)
+    real.queue_depth = 5          # steer the first pick onto the stub
+    router = ServeRouter([busy, real], port=0, poll_interval=30.0,
+                         shed_watermark=100)
+    try:
+        flat0 = REGISTRY.flat()
+        x = np.random.default_rng(9).normal(size=(2, 8)).astype(np.float32)
+        out, err = _ask(router.port, x)
+        assert err is None, err
+        np.testing.assert_allclose(out[0], _py_logits(mlp_prefix, x),
+                                   rtol=1e-5)
+        assert served, "stub backend never saw the request"
+        flat = REGISTRY.flat()
+        assert flat["paddle_tpu_router_reroutes_total"] \
+            == flat0.get("paddle_tpu_router_reroutes_total", 0.0) + 1
+        assert flat["paddle_tpu_router_failovers_total"] \
+            == flat0.get("paddle_tpu_router_failovers_total", 0.0)
+        assert busy.breaker.state == CircuitBreaker.CLOSED
+    finally:
+        stop.set()
+        router.stop()
+        srv.stop()
+        lst.close()
+
+
+def test_router_shed_terminal_when_every_backend_saturated():
+    """When the reroute target sheds too, the shed is terminal: the
+    client gets RESOURCE_EXHAUSTED (back off), never UNAVAILABLE (which
+    would invite a retry storm against a saturated fleet)."""
+    stubs = [_saturated_backend() for _ in range(2)]
+    backs = [Backend("127.0.0.1", lst.getsockname()[1])
+             for lst, _, _ in stubs]
+    router = ServeRouter(backs, port=0, poll_interval=30.0,
+                         shed_watermark=100)
+    try:
+        out, err = _ask(router.port, np.ones((1, 8), np.float32))
+        assert out is None
+        assert error_code(err) == ERR_RESOURCE_EXHAUSTED
+        assert "watermark" in err
+        # both stubs were offered the request: shed -> reroute -> shed
+        assert sum(len(served) for _, _, served in stubs) == 2
+    finally:
+        for lst, stop, _ in stubs:
+            stop.set()
+        router.stop()
+        for lst, _, _ in stubs:
+            lst.close()
 
 
 # -- the acceptance drill ------------------------------------------------

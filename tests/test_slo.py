@@ -502,6 +502,10 @@ def test_router_assembles_trace_with_backend_breakdown(
                 == pytest.approx(0.002)
             assert ctx["spans"]["pick_s"] >= 0.0
 
+        # the trace line lands just AFTER the reply frame, so the
+        # client can outrun the router's file write
+        _wait_for(lambda: len(trace.read_text().splitlines()) == 2,
+                  what="both router trace lines")
         lines = [json.loads(ln)
                  for ln in trace.read_text().splitlines()]
         assert len(lines) == 2

@@ -349,7 +349,8 @@ def test_runtime_gate_on_concurrency_modules(tmp_path):
          "tests/test_serve_batching.py", "tests/test_serve_chaos.py",
          "tests/test_serve_stream_failover.py",
          "tests/test_decode.py", "tests/test_decode_paged.py",
-         "tests/test_decode_spec.py", "tests/test_slo.py",
+         "tests/test_decode_spec.py", "tests/test_decode_qos.py",
+         "tests/test_slo.py",
          "-m", "not slow",
          "-p", "paddle_tpu.analysis.runtime.pytest_plugin",
          "-p", "no:cacheprovider"],
