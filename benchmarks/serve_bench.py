@@ -247,6 +247,42 @@ def _drive_decode(eng, prompts, max_new):
     }
 
 
+def _kv_quant_probe(cfg, model, prompt, page_tokens):
+    """Max |logits_fp32 - logits_int8| across a paged prefill + one
+    decode step on one prompt — the logit error of KV-page quantization
+    alone (the weights stay fp32), measured on the bench model."""
+    import jax.numpy as jnp
+    from paddle_tpu import framework
+    from paddle_tpu.models.gpt import (gpt_paged_decode_fns,
+                                       gpt_paged_prefill_fns)
+    from paddle_tpu.quant.kv import kv_pool_zeros
+
+    params = {k: jnp.asarray(v)
+              for k, v in framework.param_arrays(model).items()}
+    pt = int(page_tokens)
+    toks = np.asarray(prompt, np.int32)[None]
+    plen = toks.shape[1]
+    W = -(-(plen + 1) // pt)
+    shape = (cfg.layers, W + 2, pt, cfg.heads, cfg.head_dim)
+    paged_prefill = gpt_paged_prefill_fns(cfg, page_tokens=pt)
+    _, paged_step = gpt_paged_decode_fns(cfg, page_tokens=pt)
+    tables = jnp.asarray(np.arange(1, W + 1, dtype=np.int32)[None])
+    nlen = jnp.asarray([plen], jnp.int32)
+    out = {}
+    last = None
+    for dt in ("float32", "int8"):
+        kp = kv_pool_zeros(shape, dt)
+        vp = kv_pool_zeros(shape, dt)
+        logits, kp, vp = paged_prefill(params, kp, vp,
+                                       jnp.asarray(toks), tables, nlen)
+        if last is None:      # both arms step on the fp32 arm's argmax
+            last = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        step_logits, kp, vp = paged_step(params, kp, vp, tables,
+                                         last, nlen)
+        out[dt] = np.asarray(step_logits)
+    return float(np.max(np.abs(out["float32"] - out["int8"])))
+
+
 def run_decode_bench(args):
     """Decode mode: continuous batching vs one-request-at-a-time
     autoregressive generation on a tiny GPT (inference/decode.py).
@@ -273,6 +309,7 @@ def run_decode_bench(args):
 
     cfg = gpt_tiny()
     model = GPT(cfg)
+    kv_dtype = getattr(args, "kv_dtype", None) or "float32"
     rng = np.random.default_rng(args.seed)
     max_new = args.decode_tokens or 32
     if args.shared_prefix:
@@ -296,7 +333,8 @@ def run_decode_bench(args):
 
     # --- baseline: one request at a time (slot pool of 1, next submit
     # gated on the previous completion). Same kernels, same warmup.
-    base = DecodeEngine(model, max_slots=1, max_new_tokens=max_new)
+    base = DecodeEngine(model, max_slots=1, max_new_tokens=max_new,
+                        kv_dtype=kv_dtype)
     base_warmup = base.warmup()
     t0 = time.perf_counter()
     base_tokens = 0
@@ -310,7 +348,8 @@ def run_decode_bench(args):
     # --- continuous batching: all prompts in flight at once, per-stream
     # TTFT measured from submit to first token event.
     eng = DecodeEngine(model, max_slots=args.decode_slots,
-                       max_new_tokens=max_new, max_pending=n)
+                       max_new_tokens=max_new, max_pending=n,
+                       kv_dtype=kv_dtype)
     warmup_compiles = eng.warmup()
     c0 = len(profiler.compile_events())
     m0 = {k: float(v) for k, v in REGISTRY.flat().items()
@@ -360,12 +399,41 @@ def run_decode_bench(args):
         - m0.get("paddle_tpu_decode_prefix_lookup_tokens_total", 0.0)
     hit_rate = hit_toks / lookup_toks if lookup_toks else 0.0
     pages_peak = max(peak_pages[0], st["pages"]["pages_used"])
-    page_bytes = kv_page_bytes(cfg, st["page_tokens"])
+    page_bytes = kv_page_bytes(cfg, st["page_tokens"], st["kv_dtype"])
     slots = max(args.decode_slots, 1)
     longest = min(max(len(p) for p in prompts) + max_new,
                   cfg.max_seq_len)
     contig_per_slot = kv_slot_bytes(
         cfg, next_bucket(longest, eng.kv_ladder))
+    # --kv-dtype int8: an fp32 comparison arm over the SAME prompts,
+    # reported side by side — throughput, HBM per slot, greedy stream
+    # identity, and the one-step logit error of KV quantization alone
+    quant_compare = None
+    if kv_dtype == "int8":
+        ref = DecodeEngine(model, max_slots=args.decode_slots,
+                           max_new_tokens=max_new, max_pending=n)
+        ref.warmup()
+        ref_drive = _drive_decode(ref, prompts, max_new)
+        ref_st = ref.stats()
+        ref.stop()
+        ref_tps = ref_drive["tokens"] / ref_drive["wall_s"] \
+            if ref_drive["wall_s"] > 0 else 0.0
+        fp32_page_bytes = kv_page_bytes(cfg, ref_st["page_tokens"])
+        ref_peak = ref_st["pages"]["high_watermark"]
+        int8_peak = st["pages"]["high_watermark"]
+        quant_compare = {
+            "tokens_per_s": {"float32": round(ref_tps, 2),
+                             "int8": round(cont_tps, 2)},
+            "hbm_bytes_per_slot": {
+                "float32": int(ref_peak * fp32_page_bytes // slots),
+                "int8": int(int8_peak * page_bytes // slots)},
+            "hbm_reduction": round(fp32_page_bytes / page_bytes, 3),
+            "outputs_match": drive["outs"] == ref_drive["outs"],
+            "acceptance_rate": 1.0,
+            "logits_max_abs_err": round(
+                _kv_quant_probe(cfg, model, prompts[0],
+                                st["page_tokens"]), 6),
+        }
     # tracez artifact + continuous-profiler summary: the run's event
     # ring rendered as Chrome trace-event JSON (load in ui.perfetto.dev)
     # plus the per-executable top-5 by total host-blocked time
@@ -404,8 +472,11 @@ def run_decode_bench(args):
         "prefix_hit_rate": round(hit_rate, 4),
         "pages_in_use": int(pages_peak),
         "page_tokens": st["page_tokens"],
+        "kv_dtype": st["kv_dtype"],
+        "kv_page_bytes": int(page_bytes),
         "hbm_bytes_per_slot": int(pages_peak * page_bytes // slots),
         "contiguous_hbm_bytes_per_slot": int(contig_per_slot),
+        "quant_compare": quant_compare,
         "page_pool": st["pages"],
         "engine_steps": st["steps"],
         "warmup_compiles": warmup_compiles,
@@ -455,6 +526,14 @@ def run_spec_decode_bench(args):
                 params[k] = params[k] * 0.1
     for k in ("wte.weight", "wpe.weight", "ln_f.weight", "ln_f.bias"):
         dp[k] = tp[k]
+    # --draft-quant: the speculative arm runs on an int8-PTQ draft;
+    # the fp32-draft comparison arm below scores the acceptance delta
+    draft_quant = bool(getattr(args, "draft_quant", False))
+    if draft_quant:
+        from paddle_tpu.quant.ptq import quantize_params
+        dp_used = quantize_params(dp)
+    else:
+        dp_used = dp
 
     rng = np.random.default_rng(args.seed)
     n = args.decode_requests
@@ -484,7 +563,7 @@ def run_spec_decode_bench(args):
                          max_new_tokens=max_new, max_pending=n)
     plain_warmup = plain.warmup()
     spec = SpecDecodeEngine(cfg=tcfg, params=tp,
-                            draft_cfg=dcfg, draft_params=dp,
+                            draft_cfg=dcfg, draft_params=dp_used,
                             speculate_k=args.speculate_k,
                             max_slots=args.decode_slots,
                             max_new_tokens=max_new, max_pending=n)
@@ -509,6 +588,31 @@ def run_spec_decode_bench(args):
     st = spec.stats()
     plain.stop()
     spec.stop()
+    # --draft-quant: an fp32-draft speculative arm on the first prompt
+    # set — the acceptance-rate delta IS the draft-quantization quality
+    # gate (target streams are identical by construction either way)
+    draft_compare = None
+    if draft_quant:
+        ref_spec = SpecDecodeEngine(cfg=tcfg, params=tp,
+                                    draft_cfg=dcfg, draft_params=dp,
+                                    speculate_k=args.speculate_k,
+                                    max_slots=args.decode_slots,
+                                    max_new_tokens=max_new, max_pending=n)
+        ref_spec.warmup()
+        _drive_decode(ref_spec, psets[0], max_new)
+        rst = ref_spec.stats()
+        ref_spec.stop()
+        draft_compare = {
+            "acceptance_rate": {
+                "float32": rst["speculate"]["acceptance_rate"],
+                "int8": st["speculate"]["acceptance_rate"]},
+            "acceptance_delta": round(
+                st["speculate"]["acceptance_rate"]
+                - rst["speculate"]["acceptance_rate"], 4),
+            "draft_weight_bytes": {
+                "float32": int(sum(v.nbytes for v in dp.values())),
+                "int8": int(sum(v.nbytes for v in dp_used.values()))},
+        }
     plain_d = max(plain_runs, key=_tps)
     spec_d = max(spec_runs, key=_tps)
     plain_tps = _tps(plain_d)
@@ -545,6 +649,8 @@ def run_spec_decode_bench(args):
         "drafted_tokens": st["speculate"]["drafted"],
         "accepted_tokens": st["speculate"]["accepted"],
         "k_ladder": st["speculate"]["k_ladder"],
+        "draft_quant": draft_quant,
+        "draft_compare": draft_compare,
         "ms_per_token_p50": round(_pct(spec_d["ms_per_tok"], 0.50), 3),
         "ms_per_token_p95": round(_pct(spec_d["ms_per_tok"], 0.95), 3),
         "plain_ms_per_token_p50":
@@ -1062,6 +1168,17 @@ def main():
                          "system prompt + short unique tails — scores "
                          "the paged-KV prefix cache (prefix_hit_rate, "
                          "pages_in_use, hbm_bytes_per_slot)")
+    ap.add_argument("--kv-dtype", choices=("float32", "int8"),
+                    default=None,
+                    help="(decode mode) KV page-pool dtype; int8 also "
+                         "emits a side-by-side quant_compare block vs "
+                         "an fp32 reference engine (tokens/s, "
+                         "hbm_bytes_per_slot, logits_max_abs_err)")
+    ap.add_argument("--draft-quant", action="store_true",
+                    help="(decode mode, with --speculate-k) quantize "
+                         "the draft model weights to int8; emits a "
+                         "draft_compare block with acceptance-rate "
+                         "delta vs the fp32 draft")
     ap.add_argument("--scenario", default="", metavar="NAME",
                     help="multi-tenant QoS scenario replay over the "
                          "decode engine (benchmarks/scenarios.py): "

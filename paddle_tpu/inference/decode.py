@@ -96,6 +96,10 @@ from ..models.gpt import (GPTConfig, gpt_paged_decode_fns,
 from ..observability import counter, gauge, histogram
 from ..observability.spans import SpanRecorder, next_request_id
 from ..observability.tracez import RING as _RING
+from ..quant.kv import (kv_pool_sds, kv_pool_zeros, quantize_kv,
+                        validate_kv_dtype)
+from ..quant.ptq import is_quantized as _params_quantized
+from ..quant.ptq import quantize_params
 from ..testing import chaos
 from .batching import (_WARMUP_SIG_CAP, bucket_ladder, next_bucket,
                        tenant_quotas as _tenant_quotas,
@@ -239,6 +243,14 @@ def _decode_metrics():
                 "paddle_tpu_decode_preempted_waiting",
                 "Preempted requests currently parked host-side "
                 "awaiting re-admission"),
+            # quantized serving
+            "kv_page_bytes": gauge(
+                "paddle_tpu_decode_kv_page_bytes",
+                "HBM bytes one K+V page occupies at the engine's pool "
+                "dtype (int8 pools: payload + per-row scales)"),
+            "kv_quantized": gauge(
+                "paddle_tpu_decode_kv_quantized",
+                "1 when the engine's KV page pool is int8, 0 for fp32"),
         }
     return _METRICS
 
@@ -251,9 +263,15 @@ def kv_slot_bytes(cfg: GPTConfig, capacity: Optional[int] = None) -> int:
     return cfg.layers * 2 * cap * cfg.heads * cfg.head_dim * 4
 
 
-def kv_page_bytes(cfg: GPTConfig, page_tokens: int) -> int:
-    """HBM bytes one K+V page occupies."""
-    return cfg.layers * 2 * int(page_tokens) * cfg.heads * cfg.head_dim * 4
+def kv_page_bytes(cfg: GPTConfig, page_tokens: int,
+                  kv_dtype: str = "float32") -> int:
+    """HBM bytes one K+V page occupies at the pool dtype. The int8 pool
+    (quant/kv.py) pays 1 byte per element plus one fp32 scale per
+    (token row, head) — 1 + 4/head_dim bytes/element vs 4 for fp32."""
+    rows = cfg.layers * 2 * int(page_tokens) * cfg.heads
+    if validate_kv_dtype(kv_dtype) == "int8":
+        return rows * cfg.head_dim + rows * 4
+    return rows * cfg.head_dim * 4
 
 
 def default_slot_count(cfg: GPTConfig, hbm_fraction: float = 0.5,
@@ -522,9 +540,14 @@ class _PrefixCache:
 
 
 # Pure pool entry points (jit + AotCache'd by the engine): K and V move
-# together so one executable covers both writes.
+# together so one executable covers both writes. Rows arrive fp32 from
+# prefill; an int8 (data, scale) pool quantizes them inside the same
+# executable, so the host never materializes a quantized panel.
 
 def _write_kv_pages(k_pool, v_pool, k_rows, v_rows, page_ids):
+    if isinstance(k_pool, tuple):
+        k_rows = quantize_kv(k_rows)
+        v_rows = quantize_kv(v_rows)
     return (write_pages(k_pool, k_rows, page_ids),
             write_pages(v_pool, v_rows, page_ids))
 
@@ -551,7 +574,8 @@ class DecodeEngine:
                  num_pages: Optional[int] = None,
                  prefix_cache: Optional[bool] = None,
                  tenant_weights=None, tenant_quota=None,
-                 preempt: Optional[bool] = None):
+                 preempt: Optional[bool] = None,
+                 kv_dtype: Optional[str] = None):
         if model is not None:
             from .. import framework
             cfg = model.cfg
@@ -573,6 +597,9 @@ class DecodeEngine:
         if self.page_tokens < 1:
             raise ValueError(f"page_tokens must be >= 1, "
                              f"got {self.page_tokens}")
+        self.kv_dtype = validate_kv_dtype(
+            kv_dtype if kv_dtype is not None
+            else _flags.env_value("PADDLE_TPU_DECODE_KV_DTYPE"))
         self.batch_ladder = bucket_ladder(
             self.max_slots, env=_flags.env_value("PADDLE_TPU_DECODE_BUCKETS"))
         self.kv_ladder = kv_capacity_ladder(cfg.max_seq_len,
@@ -608,6 +635,9 @@ class DecodeEngine:
             donate_argnums=(0, 1))
 
         self._m = _decode_metrics()
+        self._m["kv_page_bytes"].set(
+            kv_page_bytes(cfg, self.page_tokens, self.kv_dtype))
+        self._m["kv_quantized"].set(1 if self.kv_dtype == "int8" else 0)
         self._spans = SpanRecorder(
             component="decode", metric="paddle_tpu_decode_span_seconds",
             help="Decode request stage latency (queue/prefill/decode)")
@@ -704,15 +734,17 @@ class DecodeEngine:
     def _quota_rate(self, tenant: str) -> float:
         return self._quota.get(tenant, self._quota["*"])
 
-    def _pool_sds(self):
+    def _pool_shape(self):
         L, nh, D = self.cfg.layers, self.cfg.heads, self.cfg.head_dim
-        return jax.ShapeDtypeStruct(
-            (L, self.num_pages, self.page_tokens, nh, D), jnp.float32)
+        return (L, self.num_pages, self.page_tokens, nh, D)
+
+    def _pool_sds(self):
+        return kv_pool_sds(self._pool_shape(), self.kv_dtype)
 
     def _ensure_pool(self):
         if self._kpool is None:
-            self._kpool = jnp.zeros(self._pool_sds().shape, jnp.float32)
-            self._vpool = jnp.zeros_like(self._kpool)
+            self._kpool = kv_pool_zeros(self._pool_shape(), self.kv_dtype)
+            self._vpool = kv_pool_zeros(self._pool_shape(), self.kv_dtype)
 
     def warmup(self, verbose: bool = False) -> int:
         """AOT-compile the prefill prompt rungs, the page-write rungs,
@@ -774,6 +806,9 @@ class DecodeEngine:
             "batch_ladder": list(self.batch_ladder),
             "kv_ladder": list(self.kv_ladder),
             "page_tokens": self.page_tokens,
+            "kv_dtype": self.kv_dtype,
+            "kv_page_bytes": kv_page_bytes(self.cfg, self.page_tokens,
+                                           self.kv_dtype),
             "pages": self._alloc.stats(),
             "tenants": {t: round(v, 4)
                         for t, v in sorted(dict(self._vtokens).items())},
@@ -1457,17 +1492,19 @@ class SpecDecodeEngine(DecodeEngine):
 
     # ----------------------------------------------------- pool plumbing
 
-    def _dpool_sds(self):
+    def _dpool_shape(self):
         c = self.draft_cfg
-        return jax.ShapeDtypeStruct(
-            (c.layers, self.num_pages, self.page_tokens, c.heads,
-             c.head_dim), jnp.float32)
+        return (c.layers, self.num_pages, self.page_tokens, c.heads,
+                c.head_dim)
+
+    def _dpool_sds(self):
+        return kv_pool_sds(self._dpool_shape(), self.kv_dtype)
 
     def _ensure_pool(self):
         super()._ensure_pool()
         if self._dkpool is None:
-            self._dkpool = jnp.zeros(self._dpool_sds().shape, jnp.float32)
-            self._dvpool = jnp.zeros_like(self._dkpool)
+            self._dkpool = kv_pool_zeros(self._dpool_shape(), self.kv_dtype)
+            self._dvpool = kv_pool_zeros(self._dpool_shape(), self.kv_dtype)
 
     def _cow(self, req: _Req, slot: int):
         """Copy-on-write for speculation copies the page in BOTH pools —
@@ -1857,17 +1894,28 @@ class SpecDecodeEngine(DecodeEngine):
 
 # ------------------------------------------------------------ artifact
 
-def save_for_decode(model, prefix: str):
+def save_for_decode(model, prefix: str, quant: Optional[str] = None):
     """Persist a GPT for the decode daemon: config JSON + params npz
-    (the jit.save one-shot artifact has no incremental entry points)."""
+    (the jit.save one-shot artifact has no incremental entry points).
+
+    `quant="int8"` applies `quant.ptq.quantize_params` before writing —
+    int8 weights under their original keys plus fp32 `::scale` siblings
+    — and records `"quant": "int8"` in the manifest. The default fp32
+    artifact is byte-identical to pre-quantization versions (no extra
+    manifest key, same npz keys), so old artifacts load unchanged."""
     from .. import framework
     meta = {"config": dataclasses.asdict(model.cfg),
             "eps": float(model.ln_f._epsilon),
             "format": "paddle_tpu.decode.v1"}
-    with open(prefix + ".decode.json", "w") as f:
-        json.dump(meta, f, indent=2, sort_keys=True)
     params = {k: np.asarray(v)
               for k, v in framework.param_arrays(model).items()}
+    if quant is not None:
+        if quant != "int8":
+            raise ValueError(f"quant={quant!r}: expected None or 'int8'")
+        params = quantize_params(params)
+        meta["quant"] = "int8"
+    with open(prefix + ".decode.json", "w") as f:
+        json.dump(meta, f, indent=2, sort_keys=True)
     np.savez(prefix + ".decode.npz", **params)
 
 
@@ -1884,6 +1932,7 @@ def _load_decode_artifact(prefix: str):
 
 def load_for_decode(prefix: str, draft_prefix: Optional[str] = None,
                     speculate_k: Optional[int] = None,
+                    draft_quant: Optional[bool] = None,
                     **engine_kw) -> DecodeEngine:
     """Load a `save_for_decode` artifact into a ready DecodeEngine.
 
@@ -1891,15 +1940,26 @@ def load_for_decode(prefix: str, draft_prefix: Optional[str] = None,
     PADDLE_TPU_DECODE_DRAFT_MODEL) and a speculation depth
     (`speculate_k`, or PADDLE_TPU_DECODE_SPECULATE >= 1) the result is
     a `SpecDecodeEngine`; otherwise the plain engine — speculation is
-    strictly opt-in."""
+    strictly opt-in.
+
+    `draft_quant` (or PADDLE_TPU_DECODE_DRAFT_QUANT) int8-quantizes the
+    DRAFT weights at load when the draft artifact is still fp32 — draft
+    numerics only move the acceptance rate, never the target stream, so
+    this is the cheapest quantization on-ramp. Already-quantized
+    artifacts (manifest `"quant": "int8"`) pass through untouched."""
     cfg, params, eps = _load_decode_artifact(prefix)
     if draft_prefix is None:
         draft_prefix = _flags.env_value(
             "PADDLE_TPU_DECODE_DRAFT_MODEL") or None
     if speculate_k is None:
         speculate_k = int(_flags.env_value("PADDLE_TPU_DECODE_SPECULATE"))
+    if draft_quant is None:
+        draft_quant = bool(
+            _flags.env_value("PADDLE_TPU_DECODE_DRAFT_QUANT"))
     if draft_prefix and int(speculate_k) >= 1:
         dcfg, dparams, deps = _load_decode_artifact(draft_prefix)
+        if draft_quant and not _params_quantized(dparams):
+            dparams = quantize_params(dparams)
         return SpecDecodeEngine(cfg=cfg, params=params, eps=eps,
                                 draft_cfg=dcfg, draft_params=dparams,
                                 draft_eps=deps,

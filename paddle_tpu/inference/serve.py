@@ -355,7 +355,8 @@ class InferenceServer:
                  trailing: str = None, metrics_port: int = None,
                  max_queue: int = None, decode: bool = False,
                  decode_slots: int = None, decode_max_new: int = None,
-                 draft_model: str = None, speculate_k: int = None):
+                 draft_model: str = None, speculate_k: int = None,
+                 kv_dtype: str = None, draft_quant: bool = None):
         # loopback by default: the daemon is unauthenticated — exposing a
         # model to the network segment must be an explicit --host choice
         if max_batch_size is None:
@@ -380,6 +381,10 @@ class InferenceServer:
                 kw["draft_prefix"] = draft_model
             if speculate_k is not None:
                 kw["speculate_k"] = int(speculate_k)
+            if kv_dtype:
+                kw["kv_dtype"] = str(kv_dtype)
+            if draft_quant:
+                kw["draft_quant"] = True
             self._engine = load_for_decode(model_prefix, **kw)
             self._predictor = None
             if warmup:
@@ -911,6 +916,18 @@ def main(argv=None):
                          "scheduler tick, verified in one k+1-token "
                          "target forward (default "
                          "PADDLE_TPU_DECODE_SPECULATE; 0 disables)")
+    ap.add_argument("--kv-dtype", default=None,
+                    choices=("float32", "int8"),
+                    help="(decode) KV page-pool dtype: int8 stores "
+                         "quantized pages with per-row scales, cutting "
+                         "page HBM ~4x (default "
+                         "PADDLE_TPU_DECODE_KV_DTYPE)")
+    ap.add_argument("--draft-quant", action="store_true", default=None,
+                    help="(decode) int8-quantize the draft model's "
+                         "weights at load — draft numerics only move "
+                         "the speculation acceptance rate, never the "
+                         "target stream (default "
+                         "PADDLE_TPU_DECODE_DRAFT_QUANT)")
     ap.add_argument("--router", action="store_true",
                     help="run the health-aware front router instead of a "
                          "backend: load-balance the wire protocol across "
@@ -972,7 +989,9 @@ def main(argv=None):
                           decode_slots=args.decode_slots,
                           decode_max_new=args.decode_max_new,
                           draft_model=args.draft_model,
-                          speculate_k=args.speculate_k)
+                          speculate_k=args.speculate_k,
+                          kv_dtype=args.kv_dtype,
+                          draft_quant=args.draft_quant)
     if args.warmup:
         print(f"WARMUP compiles={srv.warmup_compiles}", flush=True)
     if srv.metrics_port is not None:

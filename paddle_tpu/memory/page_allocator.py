@@ -33,6 +33,7 @@ from __future__ import annotations
 import threading
 from typing import Dict, List
 
+import jax
 import jax.numpy as jnp
 
 
@@ -168,22 +169,24 @@ class PageAllocator:
 def write_pages(pool, rows, page_ids):
     """Scatter whole pages into the pool.
 
-    pool      [..., P, page_tokens, ...]  (page axis = 1)
+    pool      [..., P, page_tokens, ...]  (page axis = 1 on every leaf)
     rows      [..., W, page_tokens, ...]  page-shaped rows to write
     page_ids  [W] int32                   destination pages (traced ok)
 
-    Duplicate destinations (e.g. several padding rows aimed at the null
-    page) resolve arbitrarily — by convention only don't-care data is
-    ever aimed at a duplicated id.
+    `pool` may be a bare array or a pytree (e.g. the int8 pool's
+    ``(data, scale)`` pair from `quant.kv`); `rows` must mirror its
+    structure. Duplicate destinations (e.g. several padding rows aimed
+    at the null page) resolve arbitrarily — by convention only
+    don't-care data is ever aimed at a duplicated id.
     """
-    return pool.at[:, page_ids].set(rows)
+    return jax.tree.map(lambda p, r: p.at[:, page_ids].set(r), pool, rows)
 
 
 def copy_page(pool, src, dst):
-    """Copy one page (copy-on-write): pool[:, dst] = pool[:, src].
-    `src`/`dst` may be traced scalars, so one executable serves every
-    (src, dst) pair."""
-    return pool.at[:, dst].set(pool[:, src])
+    """Copy one page (copy-on-write): pool[:, dst] = pool[:, src] on
+    every pool leaf. `src`/`dst` may be traced scalars, so one
+    executable serves every (src, dst) pair."""
+    return jax.tree.map(lambda p: p.at[:, dst].set(p[:, src]), pool)
 
 
 __all__ = ["PageAllocator", "PageExhausted", "write_pages", "copy_page"]

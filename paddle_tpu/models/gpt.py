@@ -970,6 +970,69 @@ def split_decode_params(params, cfg: GPTConfig):
     return embed, blocks, head
 
 
+def _qmm(bp, name, x):
+    """Weight matmul over a possibly PTQ-quantized decode param dict.
+
+    `quant.ptq.quantize_params` stores an int8 weight under its original
+    key with an fp32 per-output-channel scale sibling at `name::scale`.
+    When the sibling is absent this is literally `x @ w` — the fp32 path
+    traces identically to unquantized code — otherwise the matmul routes
+    through the fused dequant kernel (`ops.pallas.quant_matmul`)."""
+    s = bp.get(name + "::scale")
+    if s is None:
+        return x @ bp[name]
+    from ..ops.pallas.quant_matmul import int8_weight_matmul
+    return int8_weight_matmul(x, bp[name], s)
+
+
+# An fp32 KV pool is a bare [layers, P, page_tokens, heads, head_dim]
+# array; the int8 pool (quant/kv.py) is the (data int8, scale f32)
+# pytree with one scale per (layer, page, row, head). The helpers below
+# branch on that structure at trace time, so every paged decode fn
+# serves both pool dtypes from one code path and the fp32 trace is
+# byte-identical to the pre-quantization implementation.
+
+def _kv_pool_write(pool, li, page_idx, offset, rows):
+    """Scatter fresh fp32 K/V rows at [li, page_idx, offset] (`li` may
+    be `slice(None)` for all-layer scatters); int8 pools quantize the
+    rows per (row, head) inside the same executable."""
+    if isinstance(pool, tuple):
+        from ..quant.kv import quantize_kv
+        data, scale = pool
+        q, s = quantize_kv(rows)
+        return (data.at[li, page_idx, offset].set(q),
+                scale.at[li, page_idx, offset].set(s))
+    return pool.at[li, page_idx, offset].set(rows)
+
+
+def _kv_pool_layer(pool, li):
+    """Layer `li`'s pool view: bare array slice, or (data, scale)."""
+    if isinstance(pool, tuple):
+        return pool[0][li], pool[1][li]
+    return pool[li]
+
+
+def _kv_pool_take(pool, tables, axis):
+    """Block-table gather of pool pages as fp32 rows (dequantizing an
+    int8 pool's gathered panel in the same expression)."""
+    if isinstance(pool, tuple):
+        return (jnp.take(pool[0], tables, axis=axis).astype(jnp.float32)
+                * jnp.take(pool[1], tables, axis=axis)[..., None])
+    return jnp.take(pool, tables, axis=axis)
+
+
+def _paged_attend(q, k_layer, v_layer, tables, lengths):
+    """Paged decode attention over one layer's pool view, fused-dequant
+    variant when the pool is int8."""
+    if isinstance(k_layer, tuple):
+        from ..ops.pallas.decode_attention import paged_decode_attention_quant
+        return paged_decode_attention_quant(
+            q, k_layer[0], k_layer[1], v_layer[0], v_layer[1],
+            tables, lengths)
+    from ..ops.pallas.decode_attention import paged_decode_attention
+    return paged_decode_attention(q, k_layer, v_layer, tables, lengths)
+
+
 def gpt_decode_fns(cfg: GPTConfig, eps: float = 1e-5):
     """Pure `(prefill, decode_step)` over the functional param dict.
 
@@ -998,9 +1061,9 @@ def gpt_decode_fns(cfg: GPTConfig, eps: float = 1e-5):
 
     def _ffn(bp, x):
         h2 = _pp_ln(x, bp["ln2.weight"], bp["ln2.bias"], eps)
-        m = jax.nn.gelu(h2 @ bp["fc1.weight"] + bp["fc1.bias"],
+        m = jax.nn.gelu(_qmm(bp, "fc1.weight", h2) + bp["fc1.bias"],
                         approximate=False)
-        return x + m @ bp["fc2.weight"] + bp["fc2.bias"]
+        return x + _qmm(bp, "fc2.weight", m) + bp["fc2.bias"]
 
     def prefill(params, tokens, lens):
         embed, blocks, head = split_decode_params(params, cfg)
@@ -1011,7 +1074,7 @@ def gpt_decode_fns(cfg: GPTConfig, eps: float = 1e-5):
         causal = jnp.tril(jnp.ones((T, T), bool))
         for bp in blocks:
             h1 = _pp_ln(x, bp["ln1.weight"], bp["ln1.bias"], eps)
-            qkv = h1 @ bp["attn.qkv.weight"] + bp["attn.qkv.bias"]
+            qkv = _qmm(bp, "attn.qkv.weight", h1) + bp["attn.qkv.bias"]
             q, k, v = jnp.split(qkv, 3, axis=-1)
             q = q.reshape(B, T, nh, D)
             k = k.reshape(B, T, nh, D)
@@ -1023,7 +1086,7 @@ def gpt_decode_fns(cfg: GPTConfig, eps: float = 1e-5):
             s = jnp.where(causal[None, None], s, -1e30)
             p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
             o = jnp.einsum("bhqk,bkhd->bqhd", p, v).reshape(B, T, -1)
-            x = x + o @ bp["attn.proj.weight"] + bp["attn.proj.bias"]
+            x = x + _qmm(bp, "attn.proj.weight", o) + bp["attn.proj.bias"]
             x = _ffn(bp, x)
         xf = _pp_ln(x, head["ln_f.weight"], head["ln_f.bias"], eps)
         last = jnp.clip(lens.astype(jnp.int32) - 1, 0, T - 1)
@@ -1047,7 +1110,7 @@ def gpt_decode_fns(cfg: GPTConfig, eps: float = 1e-5):
         lengths = pos + 1                 # the row just written is live
         for i, bp in enumerate(blocks):
             h1 = _pp_ln(x, bp["ln1.weight"], bp["ln1.bias"], eps)
-            qkv = h1 @ bp["attn.qkv.weight"] + bp["attn.qkv.bias"]
+            qkv = _qmm(bp, "attn.qkv.weight", h1) + bp["attn.qkv.bias"]
             q, k_new, v_new = jnp.split(qkv, 3, axis=-1)
             q = q.reshape(B, nh, D)
             k_new = k_new.reshape(B, nh, D)
@@ -1057,7 +1120,7 @@ def gpt_decode_fns(cfg: GPTConfig, eps: float = 1e-5):
             k_out.append(ki)
             v_out.append(vi)
             o = decode_attention(q, ki, vi, lengths).reshape(B, -1)
-            x = x + o @ bp["attn.proj.weight"] + bp["attn.proj.bias"]
+            x = x + _qmm(bp, "attn.proj.weight", o) + bp["attn.proj.bias"]
             x = _ffn(bp, x)
         xf = _pp_ln(x, head["ln_f.weight"], head["ln_f.bias"], eps)
         logits = xf @ embed["wte.weight"].T
@@ -1099,12 +1162,11 @@ def gpt_paged_decode_fns(cfg: GPTConfig, eps: float = 1e-5,
 
     def _ffn(bp, x):
         h2 = _pp_ln(x, bp["ln2.weight"], bp["ln2.bias"], eps)
-        m = jax.nn.gelu(h2 @ bp["fc1.weight"] + bp["fc1.bias"],
+        m = jax.nn.gelu(_qmm(bp, "fc1.weight", h2) + bp["fc1.bias"],
                         approximate=False)
-        return x + m @ bp["fc2.weight"] + bp["fc2.bias"]
+        return x + _qmm(bp, "fc2.weight", m) + bp["fc2.bias"]
 
     def paged_step(params, k_pool, v_pool, tables, last_tok, cache_len):
-        from ..ops.pallas.decode_attention import paged_decode_attention
         embed, blocks, head = split_decode_params(params, cfg)
         B = last_tok.shape[0]
         W = tables.shape[1]
@@ -1117,16 +1179,17 @@ def gpt_paged_decode_fns(cfg: GPTConfig, eps: float = 1e-5,
         lengths = pos + 1                 # the row just written is live
         for i, bp in enumerate(blocks):
             h1 = _pp_ln(x, bp["ln1.weight"], bp["ln1.bias"], eps)
-            qkv = h1 @ bp["attn.qkv.weight"] + bp["attn.qkv.bias"]
+            qkv = _qmm(bp, "attn.qkv.weight", h1) + bp["attn.qkv.bias"]
             q, k_new, v_new = jnp.split(qkv, 3, axis=-1)
             q = q.reshape(B, nh, D)
             k_new = k_new.reshape(B, nh, D)
             v_new = v_new.reshape(B, nh, D)
-            k_pool = k_pool.at[i, page_idx, offset].set(k_new)
-            v_pool = v_pool.at[i, page_idx, offset].set(v_new)
-            o = paged_decode_attention(
-                q, k_pool[i], v_pool[i], tables, lengths).reshape(B, -1)
-            x = x + o @ bp["attn.proj.weight"] + bp["attn.proj.bias"]
+            k_pool = _kv_pool_write(k_pool, i, page_idx, offset, k_new)
+            v_pool = _kv_pool_write(v_pool, i, page_idx, offset, v_new)
+            o = _paged_attend(
+                q, _kv_pool_layer(k_pool, i), _kv_pool_layer(v_pool, i),
+                tables, lengths).reshape(B, -1)
+            x = x + _qmm(bp, "attn.proj.weight", o) + bp["attn.proj.bias"]
             x = _ffn(bp, x)
         xf = _pp_ln(x, head["ln_f.weight"], head["ln_f.bias"], eps)
         logits = xf @ embed["wte.weight"].T
@@ -1173,9 +1236,9 @@ def gpt_paged_verify_fns(cfg: GPTConfig, eps: float = 1e-5,
 
     def _ffn(bp, x):
         h2 = _pp_ln(x, bp["ln2.weight"], bp["ln2.bias"], eps)
-        m = jax.nn.gelu(h2 @ bp["fc1.weight"] + bp["fc1.bias"],
+        m = jax.nn.gelu(_qmm(bp, "fc1.weight", h2) + bp["fc1.bias"],
                         approximate=False)
-        return x + m @ bp["fc2.weight"] + bp["fc2.bias"]
+        return x + _qmm(bp, "fc2.weight", m) + bp["fc2.bias"]
 
     def paged_verify(params, k_pool, v_pool, tables, toks, cache_len):
         embed, blocks, head = split_decode_params(params, cfg)
@@ -1198,9 +1261,9 @@ def gpt_paged_verify_fns(cfg: GPTConfig, eps: float = 1e-5,
         # under an in-window causal triangle. Score layout per query is
         # [prefix rows | window rows]; one softmax over the concat keeps
         # the math identical to the single-gather formulation.
-        keys_all = jnp.take(k_pool, tables, axis=1) \
+        keys_all = _kv_pool_take(k_pool, tables, axis=1) \
             .reshape(len(blocks), B, kcap, nh, D)
-        vals_all = jnp.take(v_pool, tables, axis=1) \
+        vals_all = _kv_pool_take(v_pool, tables, axis=1) \
             .reshape(len(blocks), B, kcap, nh, D)
         prefix_live = jnp.arange(kcap, dtype=jnp.int32)[None, :] \
             < cache_len.astype(jnp.int32)[:, None]            # [B, kcap]
@@ -1210,7 +1273,7 @@ def gpt_paged_verify_fns(cfg: GPTConfig, eps: float = 1e-5,
         k_news, v_news = [], []
         for i, bp in enumerate(blocks):
             h1 = _pp_ln(x, bp["ln1.weight"], bp["ln1.bias"], eps)
-            qkv = h1 @ bp["attn.qkv.weight"] + bp["attn.qkv.bias"]
+            qkv = _qmm(bp, "attn.qkv.weight", h1) + bp["attn.qkv.bias"]
             q, k_new, v_new = jnp.split(qkv, 3, axis=-1)
             q = q.reshape(B, K1, nh, D)
             k_new = k_new.reshape(B, K1, nh, D)
@@ -1226,13 +1289,15 @@ def gpt_paged_verify_fns(cfg: GPTConfig, eps: float = 1e-5,
             o = jnp.einsum("bhqk,bkhd->bqhd", p[..., :kcap], vals_all[i]) \
                 + jnp.einsum("bhqk,bkhd->bqhd", p[..., kcap:], v_new)
             o = o.reshape(B, K1, -1)
-            x = x + o @ bp["attn.proj.weight"] + bp["attn.proj.bias"]
+            x = x + _qmm(bp, "attn.proj.weight", o) + bp["attn.proj.bias"]
             x = _ffn(bp, x)
         # one all-layer scatter of the fresh K/V (page_idx/offset are
         # layer-invariant); accepted rows persist, rejected rows become
         # garbage above the rolled-back cache_len, overruns hit page 0
-        k_pool = k_pool.at[:, page_idx, offset].set(jnp.stack(k_news))
-        v_pool = v_pool.at[:, page_idx, offset].set(jnp.stack(v_news))
+        k_pool = _kv_pool_write(k_pool, slice(None), page_idx, offset,
+                                jnp.stack(k_news))
+        v_pool = _kv_pool_write(v_pool, slice(None), page_idx, offset,
+                                jnp.stack(v_news))
         xf = _pp_ln(x, head["ln_f.weight"], head["ln_f.bias"], eps)
         logits = xf @ embed["wte.weight"].T
         amax = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -1273,8 +1338,10 @@ def gpt_paged_prefill_fns(cfg: GPTConfig, eps: float = 1e-5,
         slot = jnp.minimum(rows // pt, W - 1)
         page_idx = jnp.where(valid, tables[0, slot], 0)
         offset = rows % pt
-        k_pool = k_pool.at[:, page_idx, offset].set(k[:, 0])
-        v_pool = v_pool.at[:, page_idx, offset].set(v[:, 0])
+        k_pool = _kv_pool_write(k_pool, slice(None), page_idx, offset,
+                                k[:, 0])
+        v_pool = _kv_pool_write(v_pool, slice(None), page_idx, offset,
+                                v[:, 0])
         return logits, k_pool, v_pool
 
     return paged_prefill
@@ -1317,9 +1384,9 @@ def gpt_paged_rollout_fns(cfg: GPTConfig, eps: float = 1e-5,
 
     def _ffn(bp, x):
         h2 = _pp_ln(x, bp["ln2.weight"], bp["ln2.bias"], eps)
-        m = jax.nn.gelu(h2 @ bp["fc1.weight"] + bp["fc1.bias"],
+        m = jax.nn.gelu(_qmm(bp, "fc1.weight", h2) + bp["fc1.bias"],
                         approximate=False)
-        return x + m @ bp["fc2.weight"] + bp["fc2.bias"]
+        return x + _qmm(bp, "fc2.weight", m) + bp["fc2.bias"]
 
     def paged_rollout(params, k_pool, v_pool, tables, forced, cache_len):
         embed, blocks, head = split_decode_params(params, cfg)
@@ -1345,23 +1412,25 @@ def gpt_paged_rollout_fns(cfg: GPTConfig, eps: float = 1e-5,
                 < (pos_c + 1)[:, None]                       # [B, kcap]
             for li, bp in enumerate(blocks):
                 h1 = _pp_ln(x, bp["ln1.weight"], bp["ln1.bias"], eps)
-                qkv = h1 @ bp["attn.qkv.weight"] + bp["attn.qkv.bias"]
+                qkv = _qmm(bp, "attn.qkv.weight", h1) + bp["attn.qkv.bias"]
                 q, k_new, v_new = jnp.split(qkv, 3, axis=-1)
                 q = q.reshape(B, nh, D)
                 k_new = k_new.reshape(B, nh, D)
                 v_new = v_new.reshape(B, nh, D)
-                k_pool = k_pool.at[li, page_idx, offset].set(k_new)
-                v_pool = v_pool.at[li, page_idx, offset].set(v_new)
-                keys = jnp.take(k_pool[li], tables, axis=0) \
+                k_pool = _kv_pool_write(k_pool, li, page_idx, offset, k_new)
+                v_pool = _kv_pool_write(v_pool, li, page_idx, offset, v_new)
+                keys = _kv_pool_take(_kv_pool_layer(k_pool, li),
+                                     tables, axis=0) \
                     .reshape(B, kcap, nh, D)
-                vals = jnp.take(v_pool[li], tables, axis=0) \
+                vals = _kv_pool_take(_kv_pool_layer(v_pool, li),
+                                     tables, axis=0) \
                     .reshape(B, kcap, nh, D)
                 s = jnp.einsum("bhd,bkhd->bhk", q, keys) * scale
                 s = s.astype(jnp.float32)
                 s = jnp.where(live[:, None], s, -1e30)
                 p = jax.nn.softmax(s, axis=-1).astype(vals.dtype)
                 o = jnp.einsum("bhk,bkhd->bhd", p, vals).reshape(B, -1)
-                x = x + o @ bp["attn.proj.weight"] + bp["attn.proj.bias"]
+                x = x + _qmm(bp, "attn.proj.weight", o) + bp["attn.proj.bias"]
                 x = _ffn(bp, x)
             xf = _pp_ln(x, head["ln_f.weight"], head["ln_f.bias"], eps)
             logits = xf @ embed["wte.weight"].T

@@ -243,3 +243,135 @@ def paged_decode_attention(q, k_pool, v_pool, tables, lengths, kernel=None):
                                                 tables, lengths)
     raise ValueError(
         f"{_ENV}={choice!r}: expected 'pallas' or 'xla'")
+
+
+# ---------------------------------------------------------------------------
+# Int8 paged variant: fused dequant-inside-GEMV over quantized page pools
+# ---------------------------------------------------------------------------
+#
+# The int8 pool (quant/kv.py) splits each fp32 K/V pool into an int8
+# payload plus a per-(token row, head) fp32 scale:
+#
+#     k_pool, v_pool    [P, pt, H, D] int8
+#     k_scale, v_scale  [P, pt, H]    f32   (row = q * scale)
+#
+# The Pallas kernel prefetches the scale page alongside its int8 page
+# and dequantizes in-register right before the online-softmax
+# accumulate — the fp32 panel never exists in HBM.
+
+def paged_decode_attention_quant_reference(q, k_pool, k_scale,
+                                           v_pool, v_scale,
+                                           tables, lengths):
+    """XLA fallback: gather int8 pages + scales, dequantize the gathered
+    panel, reuse the fp32 masked-softmax math."""
+    B, W = tables.shape
+    P, pt, H, D = k_pool.shape
+    k = (jnp.take(k_pool, tables, axis=0).astype(jnp.float32)
+         * jnp.take(k_scale, tables, axis=0)[..., None])
+    v = (jnp.take(v_pool, tables, axis=0).astype(jnp.float32)
+         * jnp.take(v_scale, tables, axis=0)[..., None])
+    k = k.reshape(B, W * pt, H, D)
+    v = v.reshape(B, W * pt, H, D)
+    return decode_attention_reference(q, k, v, lengths)
+
+
+def _paged_quant_kernel(tbl_ref, len_ref, q_ref, k_ref, ks_ref,
+                        v_ref, vs_ref, o_ref, m_s, l_s, acc_s,
+                        *, scale, pt):
+    """`_paged_kernel` with int8 pages: the scale row rides its own
+    prefetched block and the page dequantizes in-register before the
+    score GEMV / accumulate."""
+    b = pl.program_id(0)
+    w = pl.program_id(2)
+
+    @pl.when(w == 0)
+    def _init():
+        m_s[...] = jnp.full_like(m_s, NEG_INF)
+        l_s[...] = jnp.zeros_like(l_s)
+        acc_s[...] = jnp.zeros_like(acc_s)
+
+    qv = q_ref[0, 0]                                       # [1, D]
+    ks = ks_ref[0, 0]                                      # [pt]
+    vs = vs_ref[0, 0]
+    kp = k_ref[0, :, 0, :].astype(jnp.float32) * ks[:, None]   # [pt, D]
+    vp = v_ref[0, :, 0, :].astype(jnp.float32) * vs[:, None]
+    s = jax.lax.dot_general(
+        qv, kp, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale        # [1, pt]
+    rows = w * pt + jax.lax.broadcasted_iota(jnp.int32, (1, pt), 1)
+    s = jnp.where(rows < len_ref[b], s, NEG_INF)
+    m_prev = m_s[0, 0]
+    m_new = jnp.maximum(m_prev, jnp.max(s))
+    corr = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)                                 # [1, pt]
+    m_s[0, 0] = m_new
+    l_s[0, 0] = l_s[0, 0] * corr + jnp.sum(p)
+    acc_s[...] = acc_s[...] * corr + jax.lax.dot(
+        p, vp, preferred_element_type=jnp.float32)
+
+    @pl.when(w == pl.num_programs(2) - 1)
+    def _emit():
+        o_ref[0, 0] = (acc_s[...] / l_s[0, 0]).astype(o_ref.dtype)
+
+
+def _paged_decode_attention_quant_pallas(q, k_pool, k_scale,
+                                         v_pool, v_scale,
+                                         tables, lengths):
+    B, H, D = q.shape
+    P, pt, _, _ = k_pool.shape
+    W = tables.shape[1]
+    scale = 1.0 / math.sqrt(D)
+    # scales land lane-major ([P, H, pt]) so each grid cell's scale row
+    # is one contiguous [1, 1, pt] block next to its int8 page
+    ks = jnp.transpose(k_scale, (0, 2, 1))
+    vs = jnp.transpose(v_scale, (0, 2, 1))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, H, W),
+        in_specs=[
+            pl.BlockSpec((1, 1, 1, D),
+                         lambda b, h, w, tbl, ln: (b, h, 0, 0)),
+            pl.BlockSpec((1, pt, 1, D),
+                         lambda b, h, w, tbl, ln: (tbl[b, w], 0, h, 0)),
+            pl.BlockSpec((1, 1, pt),
+                         lambda b, h, w, tbl, ln: (tbl[b, w], h, 0)),
+            pl.BlockSpec((1, pt, 1, D),
+                         lambda b, h, w, tbl, ln: (tbl[b, w], 0, h, 0)),
+            pl.BlockSpec((1, 1, pt),
+                         lambda b, h, w, tbl, ln: (tbl[b, w], h, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, 1, D),
+                               lambda b, h, w, tbl, ln: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.SMEM((1, 1), jnp.float32),     # running max
+            pltpu.SMEM((1, 1), jnp.float32),     # running denominator
+            pltpu.VMEM((1, D), jnp.float32),     # output accumulator
+        ],
+    )
+    kw = {}
+    if not _common.interpret():
+        kw["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary", "arbitrary"))
+    out = pl.pallas_call(
+        functools.partial(_paged_quant_kernel, scale=scale, pt=pt),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, 1, D), q.dtype),
+        interpret=_common.interpret(),
+        **kw,
+    )(tables.astype(jnp.int32), lengths.astype(jnp.int32),
+      q.reshape(B, H, 1, D), k_pool, ks, v_pool, vs)
+    return out.reshape(B, H, D)
+
+
+def paged_decode_attention_quant(q, k_pool, k_scale, v_pool, v_scale,
+                                 tables, lengths, kernel=None):
+    """Dispatch on `kernel` (or $PADDLE_TPU_DECODE_KERNEL, default xla)."""
+    choice = (kernel or _flags.env_value(_ENV)).strip().lower()
+    if choice == "pallas":
+        return _paged_decode_attention_quant_pallas(
+            q, k_pool, k_scale, v_pool, v_scale, tables, lengths)
+    if choice in ("", "xla"):
+        return paged_decode_attention_quant_reference(
+            q, k_pool, k_scale, v_pool, v_scale, tables, lengths)
+    raise ValueError(
+        f"{_ENV}={choice!r}: expected 'pallas' or 'xla'")

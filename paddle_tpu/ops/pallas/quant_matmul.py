@@ -1,0 +1,78 @@
+"""Dequant-inside-matmul for int8 PTQ weights (quant/ptq.py layout).
+
+A quantized decode weight is an int8 ``[in, out]`` tensor plus a
+per-output-channel fp32 scale ``[out]`` (``w ~= q * scale``). Because
+the scale is constant along the contraction axis it factors out of the
+dot product::
+
+    x @ (q * scale) == (x @ q) * scale
+
+so dequantization costs one [*, out] multiply after the GEMV instead of
+materializing an fp32 copy of the weight. Decode activations are skinny
+(a handful of rows per step), so the Pallas kernel keeps the whole
+operand set in VMEM as a single block — no tiling grid. The XLA
+fallback is the same two-op composition; dispatch follows the existing
+`PADDLE_TPU_DECODE_KERNEL=pallas|xla` knob.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core import flags as _flags
+from . import _common
+from ._common import VMEM
+
+try:
+    from jax.experimental import pallas as pl
+except Exception:  # pragma: no cover - pallas ships with jax
+    pl = None
+
+_ENV = "PADDLE_TPU_DECODE_KERNEL"
+
+
+def int8_weight_matmul_reference(x, w_q, scale):
+    """XLA fallback: ``(x @ q) * scale`` with an f32 accumulate."""
+    acc = jax.lax.dot_general(
+        x.astype(jnp.float32), w_q.astype(jnp.float32),
+        (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return (acc * scale).astype(x.dtype)
+
+
+def _mm_kernel(x_ref, w_ref, s_ref, o_ref):
+    acc = jax.lax.dot(
+        x_ref[...].astype(jnp.float32), w_ref[...].astype(jnp.float32),
+        preferred_element_type=jnp.float32)
+    o_ref[...] = (acc * s_ref[...]).astype(o_ref.dtype)
+
+
+def _int8_weight_matmul_pallas(x, w_q, scale):
+    lead = x.shape[:-1]
+    K = x.shape[-1]
+    N = w_q.shape[-1]
+    x2 = x.reshape(-1, K)
+    M = x2.shape[0]
+    out = pl.pallas_call(
+        _mm_kernel,
+        in_specs=[
+            pl.BlockSpec(memory_space=VMEM),
+            pl.BlockSpec(memory_space=VMEM),
+            pl.BlockSpec(memory_space=VMEM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=VMEM),
+        out_shape=jax.ShapeDtypeStruct((M, N), x.dtype),
+        interpret=_common.interpret(),
+    )(x2, w_q, scale.reshape(1, N))
+    return out.reshape(*lead, N)
+
+
+def int8_weight_matmul(x, w_q, scale, kernel=None):
+    """Dispatch on `kernel` (or $PADDLE_TPU_DECODE_KERNEL, default xla)."""
+    choice = (kernel or _flags.env_value(_ENV)).strip().lower()
+    if choice == "pallas":
+        return _int8_weight_matmul_pallas(x, w_q, scale)
+    if choice in ("", "xla"):
+        return int8_weight_matmul_reference(x, w_q, scale)
+    raise ValueError(
+        f"{_ENV}={choice!r}: expected 'pallas' or 'xla'")

@@ -36,8 +36,17 @@ from .. import nn
 from ..core.tensor import Tensor, apply
 from ..nn import functional as F
 
+from .kv import (dequantize_kv, kv_pool_sds, kv_pool_zeros, quantize_kv,
+                 validate_kv_dtype)
+from .ptq import (SCALE_SUFFIX, dequantize_params, is_quantized,
+                  quantize_params)
+
 __all__ = ["fake_quant_abs_max", "QATLinear", "Int8Linear", "QAT", "PTQ",
-           "quanted_layers"]
+           "quanted_layers",
+           # serving-side PTQ (quant.ptq) + int8 KV pools (quant.kv)
+           "SCALE_SUFFIX", "quantize_params", "dequantize_params",
+           "is_quantized", "quantize_kv", "dequantize_kv", "kv_pool_zeros",
+           "kv_pool_sds", "validate_kv_dtype"]
 
 
 # ---------------------------------------------------------------------------

@@ -69,3 +69,55 @@ def test_serve_bench_router_fleet_kill_one_zero_lost():
     assert up[f'paddle_tpu_router_backend_up{{backend="'
               f'{out["killed_backend"]}"}}'] == 0.0
     assert sum(up.values()) == 2.0        # the other two stayed up
+
+
+@pytest.mark.slow
+def test_serve_bench_decode_quant_arms_schema():
+    """--kv-dtype int8 / --draft-quant: the quantized decode arms keep
+    the rc-0 JSON contract and emit the side-by-side comparison blocks
+    (tokens/s, hbm_bytes_per_slot, acceptance rates, max-abs-error)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    res = subprocess.run(
+        [sys.executable, BENCH, "--decode", "--kv-dtype", "int8",
+         "--decode-requests", "6", "--decode-slots", "4",
+         "--decode-tokens", "8"],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert res.returncode == 0, res.stderr
+    out = json.loads(res.stdout.strip().splitlines()[-1])
+    assert out["metric"] == "decode_throughput"
+    assert "error" not in out, out
+    assert out["kv_dtype"] == "int8"
+    assert out["kv_page_bytes"] > 0
+    qc = out["quant_compare"]
+    for key in ("tokens_per_s", "hbm_bytes_per_slot", "hbm_reduction",
+                "outputs_match", "acceptance_rate", "logits_max_abs_err"):
+        assert key in qc, key
+    for side in ("float32", "int8"):
+        assert qc["tokens_per_s"][side] > 0
+        assert qc["hbm_bytes_per_slot"][side] > 0
+    # the scored gate: int8 pages must cut page HBM by >= 1.9x
+    assert qc["hbm_reduction"] >= 1.9
+    assert qc["logits_max_abs_err"] < 0.1    # documented tolerance
+    assert out["compile_count"] == 0
+
+    res = subprocess.run(
+        [sys.executable, BENCH, "--decode", "--speculate-k", "2",
+         "--draft-quant", "--decode-requests", "4", "--decode-slots", "4",
+         "--decode-tokens", "8"],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert res.returncode == 0, res.stderr
+    out = json.loads(res.stdout.strip().splitlines()[-1])
+    assert out["metric"] == "decode_spec_throughput"
+    assert "error" not in out, out
+    assert out["draft_quant"] is True
+    dc = out["draft_compare"]
+    for key in ("acceptance_rate", "acceptance_delta",
+                "draft_weight_bytes"):
+        assert key in dc, key
+    for side in ("float32", "int8"):
+        assert 0.0 <= dc["acceptance_rate"][side] <= 1.0
+        assert dc["draft_weight_bytes"][side] > 0
+    # int8 draft weights must actually be smaller
+    assert dc["draft_weight_bytes"]["int8"] \
+        < dc["draft_weight_bytes"]["float32"]
+    assert out["compile_count"] == 0
