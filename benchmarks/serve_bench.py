@@ -489,6 +489,146 @@ def run_decode_bench(args):
     }
 
 
+def run_long_context_bench(args):
+    """Long-context resident-streams mode (``--decode --long-context``):
+    two-turn conversations whose cached KV chains collectively dwarf
+    the device page pool, tiered (``--host-pages``, memory/migration.py)
+    vs the same tight pool without a host tier.
+
+    Turn 1 runs open-loop to build every conversation's chain; the
+    device pool only holds ~2 of them, so the tier spills the rest to
+    host RAM (the untiered arm destructively LRU-evicts instead). Turn
+    2 then measures per-conversation resume latency: the tiered arm
+    refetches spilled pages asynchronously and tail-feeds the few new
+    tokens; the untiered arm re-prefills the whole conversation.
+    Load-bearing fields: ``resident_streams`` (conversations whose KV
+    survived the turn gap, vs ``device_chain_capacity``),
+    ``spilled_pages`` / ``refetch_p95_ms`` (migration engine), and
+    ``resume_vs_reprefill`` (>= 1.0 means a tiered resume is cheaper
+    than the re-prefill it replaces). Both arms must emit identical
+    greedy tokens — the tier is invisible in outputs."""
+    import paddle_tpu as paddle
+    from paddle_tpu import profiler
+    from paddle_tpu.inference.decode import DecodeEngine
+    from paddle_tpu.models.gpt import GPT, GPTConfig
+    from paddle_tpu.observability import REGISTRY
+
+    # long-context regime: a deep model with 224-token conversation
+    # heads, where re-prefilling a conversation costs real attention
+    # compute (O(L^2)) and a page refetch is a bounded copy
+    paddle.seed(args.seed)
+    cfg = GPTConfig(vocab_size=512, max_seq_len=256, hidden=64,
+                    layers=6, heads=4, scan_layers=False)
+    model = GPT(cfg)
+    rng = np.random.default_rng(args.seed)
+    n = args.decode_requests
+    # short turns over a long head: the resume path re-feeds only the
+    # tokens past the cached chain, so most of turn 2's cost is the
+    # refetch-vs-reprefill difference this bench scores
+    gen = min(args.decode_tokens or 4, 8)
+    head_len, follow_len = 224, 0
+    pt = 16                              # page_tokens: 14 pages per chain
+    chain_pages = head_len // pt
+    # room for two concurrently active turn-2 sequences, nothing more
+    slots = 2
+    num_pages = slots * (-(-(head_len + gen + follow_len + gen) // pt)) + 1
+    prompts = [rng.integers(0, cfg.vocab_size, size=head_len)
+               .astype(np.int32) for _ in range(n)]
+    follows = [rng.integers(0, cfg.vocab_size, size=follow_len)
+               .astype(np.int32) for _ in range(n)]
+
+    def run_arm(host_pages):
+        eng = DecodeEngine(model, max_slots=slots, max_new_tokens=gen,
+                           max_pending=n, page_tokens=pt,
+                           num_pages=num_pages, prefix_cache=True,
+                           host_pages=host_pages)
+        warmup = eng.warmup()
+        c0 = len(profiler.compile_events())
+        turn1 = _drive_decode(eng, prompts, gen)
+        # let in-flight spills land so turn 2 sees HOST residency
+        deadline = time.perf_counter() + 30
+        while host_pages and time.perf_counter() < deadline:
+            tier = eng.stats().get("kv_tier", {})
+            if not tier.get("inflight") and not tier.get("parked_refetches"):
+                break
+            time.sleep(0.01)
+        st_gap = eng.stats()
+        # turn 2, closed loop: per-conversation resume latency
+        lat, outs2, errors = [], [], list(turn1["errors"])
+        for p, o1, f in zip(prompts, turn1["outs"], follows):
+            toks = np.concatenate([p, np.asarray(o1, np.int32), f])
+            t0 = time.perf_counter()
+            try:
+                outs2.append(eng.submit(toks, max_new_tokens=gen)
+                             .result(timeout=300))
+            except Exception as e:
+                errors.append(repr(e))
+                outs2.append([])
+            lat.append((time.perf_counter() - t0) * 1e3)
+        st = eng.stats()
+        compiles = len(profiler.compile_events()) - c0
+        eng.stop()
+        return {
+            "turn1": turn1, "outs2": outs2, "errors": errors,
+            "lat_ms": sorted(lat), "stats": st, "gap": st_gap,
+            "warmup": warmup, "compiles": compiles,
+        }
+
+    tiered = run_arm(args.host_pages)
+    untier = run_arm(0)
+
+    # conversations whose chains were still addressable at the turn gap
+    gap_cache = tiered["gap"].get("prefix_cache", {})
+    resident = min(n, gap_cache.get("cached_pages", 0) // chain_pages)
+    resident_untier = min(n, untier["gap"].get("prefix_cache", {})
+                          .get("cached_pages", 0) // chain_pages)
+    capacity = (num_pages - 1) // chain_pages
+    tier = tiered["stats"].get("kv_tier", {})
+    resume_p50 = round(_pct(tiered["lat_ms"], 0.50), 3)
+    reprefill_p50 = round(_pct(untier["lat_ms"], 0.50), 3)
+    outputs_match = (tiered["turn1"]["outs"] == untier["turn1"]["outs"]
+                     and tiered["outs2"] == untier["outs2"])
+    return {
+        "metric": "decode_long_context_resident_streams",
+        "value": resident,
+        "unit": "conversations",
+        # target: >= 4x the conversations the device pool alone holds
+        "vs_baseline": round(resident / (4.0 * max(capacity, 1)), 3),
+        "requests": n,
+        "errors": (tiered["errors"] + untier["errors"])[:5],
+        "decode_slots": slots,
+        "max_new_tokens": gen,
+        "prompt_tokens": head_len,
+        "page_tokens": pt,
+        "num_pages": num_pages,
+        "host_pages": args.host_pages,
+        "device_chain_capacity": capacity,
+        "resident_streams": resident,
+        "resident_streams_untiered": resident_untier,
+        "spilled_pages": int(tier.get("spilled_total", 0)),
+        "refetched_pages": int(tier.get("refetched_total", 0)),
+        "spill_p95_ms": tier.get("spill_p95_ms", 0.0),
+        "refetch_p50_ms": tier.get("refetch_p50_ms", 0.0),
+        "refetch_p95_ms": tier.get("refetch_p95_ms", 0.0),
+        "host_arena_bytes": int(tier.get("host_arena_bytes", 0)),
+        "resume_turn2_p50_ms": resume_p50,
+        "resume_turn2_p95_ms": round(_pct(tiered["lat_ms"], 0.95), 3),
+        "reprefill_turn2_p50_ms": reprefill_p50,
+        "reprefill_turn2_p95_ms": round(_pct(untier["lat_ms"], 0.95), 3),
+        "resume_vs_reprefill": round(reprefill_p50 / resume_p50, 3)
+        if resume_p50 > 0 else 0.0,
+        "outputs_match": outputs_match,
+        "shed_tiered": len(tiered["errors"]),
+        "shed_untiered": len(untier["errors"]),
+        "page_pool": tiered["stats"]["pages"],
+        "warmup_compiles": tiered["warmup"],
+        "compile_count": tiered["compiles"],
+        "metrics": {k: v for k, v in REGISTRY.flat().items()
+                    if k.startswith(("paddle_tpu_kv_tier_",
+                                     "paddle_tpu_decode_prefix_"))},
+    }
+
+
 def run_spec_decode_bench(args):
     """Speculative-decode mode (``--decode --speculate-k K``): the
     draft-and-verify SpecDecodeEngine vs the plain continuous engine on
@@ -1163,6 +1303,17 @@ def main():
                          "plain continuous engine on a repetitive-"
                          "continuation workload (accepted_tokens_per_s, "
                          "acceptance rates, ms/token)")
+    ap.add_argument("--long-context", action="store_true",
+                    help="(decode mode) two-turn resident-streams "
+                         "workload over a device pool too small for the "
+                         "conversations it serves — scores the host-RAM "
+                         "KV tier (memory/migration.py) vs destructive "
+                         "eviction (resident_streams, spilled_pages, "
+                         "refetch_p95_ms, resume_vs_reprefill)")
+    ap.add_argument("--host-pages", type=int, default=256,
+                    help="(decode --long-context) host-RAM KV tier "
+                         "capacity in pages for the tiered arm "
+                         "(PADDLE_TPU_DECODE_HOST_PAGES equivalent)")
     ap.add_argument("--shared-prefix", type=int, default=0, metavar="N",
                     help="(decode mode) N requests sharing one long "
                          "system prompt + short unique tails — scores "
@@ -1209,6 +1360,8 @@ def main():
             out = run_scenario_bench(args)
         elif args.decode and args.router:
             out = run_decode_router_bench(args)
+        elif args.decode and args.long_context:
+            out = run_long_context_bench(args)
         elif args.decode:
             out = run_decode_bench(args)
         elif args.router:

@@ -40,7 +40,22 @@ are sampled (serve.py forwards them as incremental PDI2 frames), and a
 failed request gets a typed error while its batch-mates keep streaming.
 Chaos sites: `decode.stream` fires per token delivery,
 `decode.page_alloc` per page allocation, `decode.preempt` per
-preemption attempt.
+preemption attempt, `page.migrate` per host-tier migration batch.
+
+**Host-RAM KV tiering** (docs/serving.md "KV tiering", opt-in via
+``host_pages=`` / PADDLE_TPU_DECODE_HOST_PAGES): with a
+`memory.migration.TieredPageAllocator` + `MigrationEngine` behind the
+pool, HBM becomes a cache over a much larger host-RAM page store.
+Under pool pressure the engine *spills* cold trie-only pages (cold
+shared prefixes, preempted streams' stashed state, finished
+conversations) to pinned host arenas instead of destructively evicting
+them — the trie entry swaps its device page for a negative host
+handle. An admission whose prefix continues in the host tier parks on
+an async *refetch* (only that stream waits; its slot stays free) and
+then resumes with a full device hit, byte-identical content. QoS
+preemption composes: the stash-to-trie pages ride the same
+spill/restore path, so preempt-resume becomes a page copy instead of a
+recompute.
 
 Multi-tenant QoS (docs/serving.md "Multi-tenant QoS"): every request
 carries a ``tenant`` (default ``"default"``) and an integer
@@ -88,8 +103,10 @@ from .. import profiler
 from ..core import flags as _flags
 from ..core import monitor
 from ..jit.compile_cache import AotCache
+from ..memory.migration import (HostPageStore, MigrationEngine,
+                                TieredPageAllocator, tier_metrics)
 from ..memory.page_allocator import (PageAllocator, PageExhausted,
-                                     copy_page, write_pages)
+                                     copy_page, gather_pages, write_pages)
 from ..models.gpt import (GPTConfig, gpt_paged_decode_fns,
                           gpt_paged_prefill_fns, gpt_paged_rollout_fns,
                           gpt_paged_verify_fns)
@@ -465,21 +482,39 @@ class _PrefixCache:
 
     Keys are a SHA-1 hash *chain* over full pages of prompt tokens —
     entry i's digest commits to pages 0..i, so one dict lookup per page
-    walks the trie without storing token arrays. Every cached entry
-    holds one allocator reference; `lookup` retains matched pages on
-    the caller's behalf (so an entry evicted a microsecond later cannot
-    free a page the caller is about to map). Eviction is LRU by lookup
-    tick; evicting a mid-chain entry orphans its descendants, which
-    simply age out the same way. Single leaf lock, no device work or
-    blocking calls under it."""
+    walks the trie without storing token arrays. Every device-resident
+    entry holds one allocator reference; `lookup` retains matched pages
+    on the caller's behalf (so an entry evicted a microsecond later
+    cannot free a page the caller is about to map).
+
+    Eviction is **leaf-first LRU**: among evictable entries, ones with
+    no live child go first (ordered by last-touch tick), and only when
+    every candidate is mid-chain does the oldest interior entry go —
+    so surviving entries stay reachable instead of silently orphaned.
+    Each entry tracks its parent digest and a live-child count to make
+    leaf status O(1); forced mid-chain removals bump the `orphaned`
+    stat (the children remain cached but can never be looked up again).
+
+    With a :class:`~paddle_tpu.memory.TieredPageAllocator` behind it,
+    an entry's location may also be a negative **host handle**: the
+    page content was spilled to the host tier. `lookup` stops at a
+    spilled entry (the device chain ends there); the engine's tier path
+    reads the continuation via `host_chain` and swaps locations back
+    with `restore_entry` once the migration engine lands the pages.
+    Single leaf lock, no device work or blocking calls under it; lock
+    order is trie -> allocator everywhere."""
 
     def __init__(self, alloc: PageAllocator, page_tokens: int):
         self._alloc = alloc
         self._pt = int(page_tokens)
         self._lock = threading.Lock()
-        self._entries: Dict[bytes, List[int]] = {}   # digest -> [page, tick]
+        # digest -> [loc, tick, parent_digest|None]; loc >= 0 is a
+        # device page (one ref held), loc < 0 a host-tier handle
+        self._entries: Dict[bytes, List] = {}
+        self._kids: Dict[bytes, int] = {}     # digest -> live children
         self._tick = 0
         self._evictions = 0
+        self._orphaned = 0
 
     def _digests(self, prompt: Sequence[int]) -> List[bytes]:
         h, out = b"", []
@@ -490,53 +525,183 @@ class _PrefixCache:
             out.append(h)
         return out
 
+    def _remove(self, d: bytes, ent: List):
+        """Drop one entry (lock held): release its device ref or host
+        slot, unlink from its parent, count stranded descendants."""
+        del self._entries[d]
+        parent = ent[2]
+        if parent is not None and parent in self._kids:
+            self._kids[parent] -= 1
+            if self._kids[parent] <= 0:
+                del self._kids[parent]
+        self._orphaned += self._kids.pop(d, 0)
+        if ent[0] >= 0:
+            self._alloc.release(ent[0])
+        else:
+            self._alloc.host_drop(ent[0])
+
     def lookup(self, prompt: Sequence[int]) -> Tuple[List[int], int]:
-        """Longest cached page-aligned prefix of `prompt`. Returns
-        (pages, hit_tokens); each returned page has been retained for
-        the caller, who owns releasing every one of them."""
+        """Longest *device-resident* cached page-aligned prefix of
+        `prompt`. Returns (pages, hit_tokens); each returned page has
+        been retained for the caller, who owns releasing every one."""
         pages: List[int] = []
         with self._lock:
             self._tick += 1
             for d in self._digests(prompt):
                 ent = self._entries.get(d)
-                if ent is None:
+                if ent is None or ent[0] < 0:
                     break
                 self._alloc.retain(ent[0])
                 ent[1] = self._tick
                 pages.append(ent[0])
         return pages, len(pages) * self._pt
 
+    def host_chain(self, prompt: Sequence[int],
+                   start: int) -> List[Tuple[bytes, int]]:
+        """The contiguous run of HOST-resident entries continuing the
+        device hit (`start` = device pages matched). Returns
+        [(digest, handle)]; an IN_FLIGHT or missing entry ends the run
+        — the caller just gets a shorter refetch, which is always
+        correct."""
+        from ..memory.migration import Residency
+
+        out: List[Tuple[bytes, int]] = []
+        with self._lock:
+            for d in self._digests(prompt)[max(start, 0):]:
+                ent = self._entries.get(d)
+                if ent is None or ent[0] >= 0:
+                    break
+                if self._alloc.residency(ent[0]) != Residency.HOST:
+                    break
+                out.append((d, ent[0]))
+        return out
+
     def insert(self, prompt: Sequence[int], pages: Sequence[int]):
         """Cache `prompt`'s full pages (pages[i] holds prompt rows
-        [i*pt, (i+1)*pt)); already-cached prefixes are left in place."""
+        [i*pt, (i+1)*pt)); already-cached prefixes are left in place.
+        A spilled (host) entry whose content is being re-inserted live
+        is upgraded back to the device page — the host copy is
+        redundant from that moment."""
+        from ..memory.migration import Residency
+
         with self._lock:
             self._tick += 1
+            prev = None
             for d, p in zip(self._digests(prompt), pages):
-                if d not in self._entries:
+                ent = self._entries.get(d)
+                if ent is None:
                     self._alloc.retain(p)
-                    self._entries[d] = [int(p), self._tick]
+                    self._entries[d] = [int(p), self._tick, prev]
+                    if prev is not None and prev in self._entries:
+                        self._kids[prev] = self._kids.get(prev, 0) + 1
+                elif ent[0] < 0 and \
+                        self._alloc.residency(ent[0]) == Residency.HOST:
+                    self._alloc.retain(p)
+                    self._alloc.host_drop(ent[0])
+                    ent[0] = int(p)
+                    ent[1] = self._tick
+                prev = d
+
+    def _leaf_key(self, d: bytes, ent: List):
+        return (1 if self._kids.get(d) else 0, ent[1])
 
     def evict(self, n: int) -> int:
-        """Release up to `n` least-recently-used entries' pages."""
+        """Release up to `n` device-resident entries' pages, leaf-first
+        LRU, re-deriving leaf status after every removal (so evicting a
+        whole chain walks it tip-to-root instead of orphaning it)."""
+        removed = 0
         with self._lock:
-            victims = sorted(self._entries.items(),
-                             key=lambda kv: kv[1][1])[:max(n, 0)]
-            for d, (p, _) in victims:
-                del self._entries[d]
-                self._alloc.release(p)
-            self._evictions += len(victims)
-            return len(victims)
+            while removed < max(n, 0):
+                cands = [(d, e) for d, e in self._entries.items()
+                         if e[0] >= 0]
+                if not cands:
+                    break
+                d, e = min(cands, key=lambda x: self._leaf_key(*x))
+                self._remove(d, e)
+                removed += 1
+            self._evictions += removed
+        return removed
+
+    # ------------------------------------------------- host-tier hooks
+
+    def spill_victims(self, n: int) -> List[Tuple[bytes, int]]:
+        """Up to `n` spillable entries, coldest leaves first: device-
+        resident and trie-only (refcount 1 — nothing active maps the
+        page, so its content is immutable and nobody stalls on it)."""
+        with self._lock:
+            cands = [(d, e) for d, e in self._entries.items()
+                     if e[0] >= 0 and self._alloc.refcount(e[0]) == 1]
+            cands.sort(key=lambda x: self._leaf_key(*x))
+            return [(d, e[0]) for d, e in cands[:max(n, 0)]]
+
+    def mark_spilled(self, d: bytes, page: int, handle: int) -> bool:
+        """Swap an entry's location to its host handle and release the
+        trie's device ref (this is what actually frees the page)."""
+        with self._lock:
+            ent = self._entries.get(d)
+            if ent is None or ent[0] != page:
+                return False
+            ent[0] = int(handle)
+            self._alloc.release(page)
+            return True
+
+    def restore_entry(self, d: bytes, handle: int, page: int) -> bool:
+        """A refetch landed: point the entry back at a device page. The
+        caller transfers its allocator reference to the trie. False if
+        the entry moved on meanwhile (caller keeps the ref)."""
+        with self._lock:
+            ent = self._entries.get(d)
+            if ent is None or ent[0] != handle:
+                return False
+            ent[0] = int(page)
+            ent[1] = self._tick
+            return True
+
+    def drop_by_handle(self, handle: int) -> bool:
+        """Remove the entry parked on `handle` (failed migration): the
+        cached content is gone, the stream degrades to a re-prefill."""
+        with self._lock:
+            for d, ent in self._entries.items():
+                if ent[0] == handle:
+                    self._remove(d, ent)
+                    return True
+        return False
+
+    def drop_host_lru(self, n: int) -> int:
+        """Drop up to `n` coldest HOST-resident entries to make room in
+        the host tier (never IN_FLIGHT ones — a migration owns those
+        slots)."""
+        from ..memory.migration import Residency
+
+        dropped = 0
+        with self._lock:
+            cands = sorted(
+                ((d, e) for d, e in self._entries.items()
+                 if e[0] < 0
+                 and self._alloc.residency(e[0]) == Residency.HOST),
+                key=lambda x: x[1][1])
+            for d, e in cands[:max(n, 0)]:
+                self._remove(d, e)
+                dropped += 1
+        return dropped
 
     def clear(self):
         with self._lock:
-            for p, _ in self._entries.values():
-                self._alloc.release(p)
+            for ent in self._entries.values():
+                if ent[0] >= 0:
+                    self._alloc.release(ent[0])
+                else:
+                    self._alloc.host_drop(ent[0])
             self._entries.clear()
+            self._kids.clear()
 
     def stats(self) -> Dict:
         with self._lock:
+            host = sum(1 for e in self._entries.values() if e[0] < 0)
             return {"cached_pages": len(self._entries),
-                    "evictions": self._evictions}
+                    "host_entries": host,
+                    "evictions": self._evictions,
+                    "orphaned": self._orphaned}
 
 
 # Pure pool entry points (jit + AotCache'd by the engine): K and V move
@@ -575,7 +740,8 @@ class DecodeEngine:
                  prefix_cache: Optional[bool] = None,
                  tenant_weights=None, tenant_quota=None,
                  preempt: Optional[bool] = None,
-                 kv_dtype: Optional[str] = None):
+                 kv_dtype: Optional[str] = None,
+                 host_pages: Optional[int] = None):
         if model is not None:
             from .. import framework
             cfg = model.cfg
@@ -612,9 +778,19 @@ class DecodeEngine:
         # and padded-batch writes land there, never on live data)
         self.num_pages = int(num_pages) if num_pages \
             else self.max_slots * self.pages_per_seq + 1
-        self._alloc = PageAllocator(self.num_pages)
+        hp = int(host_pages) if host_pages is not None \
+            else int(_flags.env_value("PADDLE_TPU_DECODE_HOST_PAGES"))
+        self.host_pages = max(hp, 0)
+        self._alloc = TieredPageAllocator(
+            self.num_pages, host_pages=self.host_pages) \
+            if self.host_pages else PageAllocator(self.num_pages)
         use_prefix = prefix_cache if prefix_cache is not None \
             else bool(_flags.env_value("PADDLE_TPU_DECODE_PREFIX_CACHE"))
+        # tiering spills and refetches *through* the trie — its entries
+        # are the spill candidates and the resume index — so enabling
+        # the host tier implies the prefix cache
+        if self.host_pages:
+            use_prefix = True
         self._prefix = _PrefixCache(self._alloc, self.page_tokens) \
             if use_prefix else None
 
@@ -633,6 +809,16 @@ class DecodeEngine:
         self._copy_aot = AotCache(
             jax.jit(_copy_kv_page, donate_argnums=(0, 1)), "decode.pcow",
             donate_argnums=(0, 1))
+        # host-tier executables: `pgather` snapshots cold pages into an
+        # independent buffer (pools NOT donated — the engine keeps
+        # stepping on them), `ptier` scatters refetched rows back in
+        self._gather_aot = self._tier_write_aot = None
+        if self.host_pages:
+            self._gather_aot = AotCache(jax.jit(gather_pages),
+                                        "decode.pgather")
+            self._tier_write_aot = AotCache(
+                jax.jit(write_pages, donate_argnums=(0,)), "decode.ptier",
+                donate_argnums=(0,))
 
         self._m = _decode_metrics()
         self._m["kv_page_bytes"].set(
@@ -658,6 +844,12 @@ class DecodeEngine:
             if preempt is None else bool(preempt)
         self._kpool = None           # [L, P, page_tokens, nh, D], lazy
         self._vpool = None
+        # host tier (lazy with the pools): arena store + migration
+        # worker + requests parked on an in-flight refetch
+        self._store = None
+        self._migrate: Optional[MigrationEngine] = None
+        self._migrating: List = []   # [ticket, req, [(digest, handle)]]
+        self._tm = tier_metrics() if self.host_pages else None
         self._last_b_rung = self.batch_ladder[0]
         self._last_w_rung = self.page_ladder[0]
         self._steps = 0
@@ -741,10 +933,36 @@ class DecodeEngine:
     def _pool_sds(self):
         return kv_pool_sds(self._pool_shape(), self.kv_dtype)
 
+    # The tier moves every pool an engine owns as ONE pytree — the base
+    # engine's (k, v), the speculative engine's (k, v, dk, dv) — so one
+    # gather/scatter executable per page rung migrates a page's full
+    # footprint. Subclasses that add pools override these three hooks.
+
+    def _pools(self):
+        return (self._kpool, self._vpool)
+
+    def _set_pools(self, pools):
+        self._kpool, self._vpool = pools
+
+    def _pools_sds(self):
+        p = self._pool_sds()
+        return (p, p)
+
     def _ensure_pool(self):
         if self._kpool is None:
             self._kpool = kv_pool_zeros(self._pool_shape(), self.kv_dtype)
             self._vpool = kv_pool_zeros(self._pool_shape(), self.kv_dtype)
+        if self.host_pages and self._migrate is None:
+            self._store = HostPageStore(self._pools_sds(), self.host_pages)
+            self._migrate = MigrationEngine(
+                self._store, window=2, name="kv-migrate",
+                wake=self._tier_wake)
+
+    def _tier_wake(self):
+        """Migration-worker completion callback: poke the scheduler so
+        `_tier_poll` runs promptly (no other lock is ever held here)."""
+        with self._cond:
+            self._cond.notify_all()
 
     def warmup(self, verbose: bool = False) -> int:
         """AOT-compile the prefill prompt rungs, the page-write rungs,
@@ -773,6 +991,20 @@ class DecodeEngine:
             pool, pool,
             jax.ShapeDtypeStruct((), i32), jax.ShapeDtypeStruct((), i32),
             key=("pcow",))
+        if self.host_pages:
+            # tier executables per page rung: spill gather + refetch
+            # scatter over the full pool tuple, so steady-state
+            # migration — like steady-state decode — compiles nothing
+            pools = self._pools_sds()
+            for w in self.page_ladder:
+                ids = jax.ShapeDtypeStruct((w,), i32)
+                rows = jax.tree.map(
+                    lambda s, _w=w: jax.ShapeDtypeStruct(
+                        (s.shape[0], _w) + s.shape[2:], s.dtype), pools)
+                self._gather_aot.get_or_compile(
+                    pools, ids, key=("pgather", w))
+                self._tier_write_aot.get_or_compile(
+                    pools, rows, ids, key=("ptier", w))
         sigs = [(b, w) for b in self.batch_ladder for w in self.page_ladder]
         if len(sigs) > _WARMUP_SIG_CAP:
             sigs = sigs[:_WARMUP_SIG_CAP]
@@ -815,6 +1047,19 @@ class DecodeEngine:
         }
         if self._prefix is not None:
             st["prefix_cache"] = self._prefix.stats()
+        if self.host_pages:
+            ps = st["pages"]
+            tier = {
+                "host_pages_total": ps.get("host_pages_total",
+                                           self.host_pages),
+                "host_pages_used": ps.get("host_pages_used", 0),
+                "spilled_total": ps.get("spilled_total", 0),
+                "refetched_total": ps.get("refetched_total", 0),
+                "parked_refetches": len(self._migrating),
+            }
+            if self._migrate is not None:
+                tier.update(self._migrate.stats())
+            st["kv_tier"] = tier
         return st
 
     def stop(self):
@@ -823,10 +1068,14 @@ class DecodeEngine:
             self._stop = True
             self._cond.notify_all()
         self._thread.join(timeout=30)
+        if self._migrate is not None:
+            self._migrate.stop()
         leftovers = (list(self._active) + list(self._pending)
-                     + list(self._paused))
+                     + list(self._paused)
+                     + [item[1] for item in self._migrating])
         self._active, self._pending = [], deque()
         self._paused = deque()
+        self._migrating = []
         for req in leftovers:
             req.stream._push_error(TypedServeError(
                 ERR_UNAVAILABLE, "decode engine stopped"))
@@ -844,17 +1093,21 @@ class DecodeEngine:
             newly, victims = [], []
             with self._cond:
                 while (not self._stop and not self._pending
-                       and not self._paused and not self._active):
+                       and not self._paused and not self._active
+                       and not self._migrating):
                     self._cond.wait(timeout=0.1)
                 if self._stop:
                     return
                 self._refill_quota()
                 newly, victims = self._schedule()
                 if not newly and not victims and not self._active:
-                    # everything queued is quota-blocked: wait for the
-                    # bucket refill instead of spinning
+                    # everything queued is quota-blocked (or parked on
+                    # an in-flight refetch): wait for the bucket refill
+                    # / migration wake instead of spinning
                     self._cond.wait(timeout=0.02)
             try:
+                if self._migrating:
+                    self._tier_poll()
                 for vic in victims:
                     self._preempt(vic)
                 for req in newly:
@@ -1057,10 +1310,19 @@ class DecodeEngine:
                 pages = self._alloc.alloc(n)
             except PageExhausted as exc:
                 if not retried and self._prefix is not None:
-                    shortfall = n - self._alloc.free_count()
-                    evicted = self._prefix.evict(max(shortfall, 1))
-                    if evicted:
-                        self._m["prefix_evictions"].inc(evicted)
+                    shortfall = max(n - self._alloc.free_count(), 1)
+                    # host tier first: spilling parks the content in
+                    # host RAM (a later resume is a page copy, not a
+                    # re-prefill); destructive LRU eviction only covers
+                    # whatever the tier could not take
+                    freed = self._tier_spill(shortfall) \
+                        if self._migrate is not None else 0
+                    evicted = 0
+                    if freed < shortfall:
+                        evicted = self._prefix.evict(shortfall - freed)
+                        if evicted:
+                            self._m["prefix_evictions"].inc(evicted)
+                    if freed or evicted:
                         retried = True
                         continue
                 self._m["page_alloc_failures"].inc()
@@ -1088,6 +1350,132 @@ class DecodeEngine:
         req.pages[slot] = new
         self._alloc.release(old)
         self._m["cow"].inc()
+
+    # ---------------------------------------------------- host KV tier
+    #
+    # All tier work below runs on the scheduler thread (pool buffers
+    # are DONATED on every step — only this thread may touch them); the
+    # migration worker only ever sees independent buffers (the gather
+    # snapshot, the device_put result) plus allocator/trie bookkeeping
+    # behind their own leaf locks. Requests that need a refetch are
+    # PARKED in `_migrating` — their slot stays free for other streams,
+    # so a slow or chaos-hung migration stalls only the touching
+    # stream.
+
+    def _tier_spill(self, n: int) -> int:
+        """Spill up to `n` cold trie-only pages to the host tier.
+        Returns how many device pages were freed. The gather snapshot
+        happens BEFORE the trie refs drop, so the pages being copied
+        out are still allocated at gather dispatch; after
+        `mark_spilled` they are free for the allocation that triggered
+        the pressure."""
+        victims = self._prefix.spill_victims(n)
+        if not victims:
+            return 0
+        handles = self._alloc.spill_begin(len(victims))
+        if len(handles) < len(victims):
+            # host tier full: age out its coldest entries and retry —
+            # anything still short of `n` falls to destructive evict
+            if self._prefix.drop_host_lru(len(victims) - len(handles)):
+                handles += self._alloc.spill_begin(
+                    len(victims) - len(handles))
+        victims = victims[:len(handles)]
+        if not victims:
+            return 0
+        w = next_bucket(len(victims), self.page_ladder)
+        ids = np.zeros(w, np.int32)
+        ids[:len(victims)] = [p for _, p in victims]
+        exe = self._gather_aot.get_or_compile(
+            self._pools(), jax.ShapeDtypeStruct((w,), jnp.int32),
+            key=("pgather", w))
+        chunk = exe(self._pools(), jnp.asarray(ids))
+        for (d, p), h in zip(victims, handles):
+            self._prefix.mark_spilled(d, p, h)
+        prefix, alloc = self._prefix, self._alloc
+
+        def on_done(t):
+            # migration-worker thread: pure bookkeeping. Failure drops
+            # the trie entries — the content degrades to a re-prefill,
+            # which is always token-identical, never wrong.
+            for h in t.handles:
+                try:
+                    if t.error is not None:
+                        raise ValueError
+                    alloc.spill_commit(h)
+                except ValueError:
+                    prefix.drop_by_handle(h)
+                    alloc.host_drop(h)
+
+        self._migrate.spill(chunk, handles, len(victims), on_done=on_done)
+        return len(victims)
+
+    def _tier_fetch(self, req: _Req, chain) -> bool:
+        """Launch an async refetch of `chain` ([(digest, handle)]) and
+        park `req` until it lands. False when nothing could be pinned
+        (the caller proceeds with its partial device hit)."""
+        pinned = []
+        for d, h in chain:
+            try:
+                self._alloc.refetch_begin(h)
+            except ValueError:
+                break
+            pinned.append((d, h))
+        if not pinned:
+            return False
+        w = next_bucket(len(pinned), self.page_ladder)
+        t = self._migrate.refetch([h for _, h in pinned], rung=w)
+        self._migrating.append([t, req, pinned])
+        return True
+
+    def _tier_poll(self):
+        """Non-blocking sweep over parked refetches (scheduler thread,
+        outside `_cond`): a landed ticket gets its pages written back
+        into the pool and its request reinjected at the head of its
+        queue; a failed one drops the spilled entries and the request
+        degrades to the ordinary prefill path."""
+        still, done = [], []
+        for item in self._migrating:
+            (done if item[0].poll() != "pending" else still).append(item)
+        if not done:
+            return
+        self._migrating = still
+        for t, req, pinned in done:
+            ok = t.poll() == "ok" and self._tier_restore(t, req, pinned)
+            if not ok:
+                for d, h in pinned:
+                    self._prefix.drop_by_handle(h)
+                    self._alloc.host_drop(h)
+            with self._cond:
+                if req.preempts:
+                    self._paused.appendleft(req)
+                else:
+                    self._pending.appendleft(req)
+                self._cond.notify_all()
+        self._update_gauges()
+
+    def _tier_restore(self, t, req: _Req, pinned) -> bool:
+        """Scatter a landed refetch into fresh pool pages and point the
+        trie back at them; the request's next admission then sees a
+        full device hit. False on allocation pressure — the entries
+        drop and the request re-prefills instead."""
+        try:
+            pages = self._alloc_pages(len(pinned), req)
+        except TypedServeError:
+            return False
+        w = t.rung
+        ids = np.zeros(w, np.int32)
+        ids[:len(pages)] = pages
+        exe = self._tier_write_aot.get_or_compile(
+            self._pools(), t.rows,
+            jax.ShapeDtypeStruct((w,), jnp.int32), key=("ptier", w))
+        self._set_pools(exe(self._pools(), t.rows, jnp.asarray(ids)))
+        for (d, h), p in zip(pinned, pages):
+            if self._prefix.restore_entry(d, h, p):
+                self._alloc.refetch_commit(h)
+            else:                 # entry moved on: keep nothing
+                self._alloc.release(p)
+                self._alloc.host_drop(h)
+        return True
 
     # ------------------------------------------------------- admission
 
@@ -1117,6 +1505,19 @@ class DecodeEngine:
         if self._prefix is not None:
             hit_pages, hit_tokens = self._prefix.lookup(toks)
             self._m["prefix_lookup_tokens"].inc(plen)
+            if self._migrate is not None:
+                # the device hit may continue in the host tier (spilled
+                # cold prefixes, a preempted stream's stashed pages):
+                # when refetching would lengthen the usable prefix,
+                # park the request on an async refetch instead of
+                # re-prefilling content that already exists host-side
+                chain = self._prefix.host_chain(toks, len(hit_pages))
+                gain = min((len(hit_pages) + len(chain)) * pt, plen - 1)
+                if chain and gain > min(hit_tokens, plen - 1) \
+                        and self._tier_fetch(req, chain):
+                    for p in hit_pages:
+                        self._alloc.release(p)
+                    return False     # parked in _migrating, no slot held
             # at least one prompt token is always re-fed so the step
             # has logits to sample the first generated token from
             usable = min(hit_tokens, plen - 1)
@@ -1373,6 +1774,11 @@ class DecodeEngine:
         if self._prefix is not None:
             self._m["prefix_cached_pages"].set(
                 self._prefix.stats()["cached_pages"])
+        if self._tm is not None:
+            self._tm["resident"].labels(tier="device").set(
+                ps["pages_used"])
+            self._tm["resident"].labels(tier="host").set(
+                ps.get("host_pages_used", 0))
 
 
 # ------------------------------------------------- speculative decoding
@@ -1499,6 +1905,24 @@ class SpecDecodeEngine(DecodeEngine):
 
     def _dpool_sds(self):
         return kv_pool_sds(self._dpool_shape(), self.kv_dtype)
+
+    # Host tiering migrates the draft pools with the target pools: one
+    # page id names a page in all four, so a spilled page's full
+    # footprint moves as one chunk and a restore brings the draft rows
+    # back warm. (Even when restored draft rows are stale, acceptance
+    # is sample-then-compare — draft content can only cost acceptance
+    # rate, never change emitted tokens.)
+
+    def _pools(self):
+        return (self._kpool, self._vpool, self._dkpool, self._dvpool)
+
+    def _set_pools(self, pools):
+        (self._kpool, self._vpool,
+         self._dkpool, self._dvpool) = pools
+
+    def _pools_sds(self):
+        p, d = self._pool_sds(), self._dpool_sds()
+        return (p, p, d, d)
 
     def _ensure_pool(self):
         super()._ensure_pool()

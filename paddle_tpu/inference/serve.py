@@ -356,7 +356,8 @@ class InferenceServer:
                  max_queue: int = None, decode: bool = False,
                  decode_slots: int = None, decode_max_new: int = None,
                  draft_model: str = None, speculate_k: int = None,
-                 kv_dtype: str = None, draft_quant: bool = None):
+                 kv_dtype: str = None, draft_quant: bool = None,
+                 host_pages: int = None):
         # loopback by default: the daemon is unauthenticated — exposing a
         # model to the network segment must be an explicit --host choice
         if max_batch_size is None:
@@ -385,6 +386,8 @@ class InferenceServer:
                 kw["kv_dtype"] = str(kv_dtype)
             if draft_quant:
                 kw["draft_quant"] = True
+            if host_pages is not None:
+                kw["host_pages"] = int(host_pages)
             self._engine = load_for_decode(model_prefix, **kw)
             self._predictor = None
             if warmup:
@@ -916,6 +919,12 @@ def main(argv=None):
                          "scheduler tick, verified in one k+1-token "
                          "target forward (default "
                          "PADDLE_TPU_DECODE_SPECULATE; 0 disables)")
+    ap.add_argument("--host-pages", type=int, default=None,
+                    help="host-RAM KV tier capacity in pages for decode "
+                         "mode (memory/migration.py): cold pages spill "
+                         "to host arenas under pool pressure and refetch "
+                         "on demand; default PADDLE_TPU_DECODE_HOST_PAGES "
+                         "(0 = tiering off)")
     ap.add_argument("--kv-dtype", default=None,
                     choices=("float32", "int8"),
                     help="(decode) KV page-pool dtype: int8 stores "
@@ -991,7 +1000,8 @@ def main(argv=None):
                           draft_model=args.draft_model,
                           speculate_k=args.speculate_k,
                           kv_dtype=args.kv_dtype,
-                          draft_quant=args.draft_quant)
+                          draft_quant=args.draft_quant,
+                          host_pages=args.host_pages)
     if args.warmup:
         print(f"WARMUP compiles={srv.warmup_compiles}", flush=True)
     if srv.metrics_port is not None:

@@ -1,11 +1,18 @@
-"""Device-memory management: the paged KV allocator lives here.
+"""Device-memory management: the paged KV allocator and host tiering.
 
 `page_allocator` is deliberately decode-agnostic — it hands out integer
 page ids against a fixed-size device pool and tracks refcounts, so the
 decode engine, prefix cache, and (later) training remat/offload can all
-share one allocator discipline.
+share one allocator discipline. `migration` layers a host-RAM tier on
+top: a two-tier allocator with per-page residency plus an async
+double-buffered host<->device page-migration engine, turning the device
+pool into a cache over a much larger page store.
 """
+from .migration import (HostPageStore, MigrationEngine, MigrationTicket,
+                        Residency, TieredPageAllocator)
 from .page_allocator import (PageAllocator, PageExhausted, copy_page,
-                             write_pages)
+                             gather_pages, write_pages)
 
-__all__ = ["PageAllocator", "PageExhausted", "copy_page", "write_pages"]
+__all__ = ["PageAllocator", "PageExhausted", "copy_page", "write_pages",
+           "gather_pages", "Residency", "TieredPageAllocator",
+           "HostPageStore", "MigrationEngine", "MigrationTicket"]

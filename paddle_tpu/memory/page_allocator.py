@@ -189,7 +189,24 @@ def copy_page(pool, src, dst):
     return jax.tree.map(lambda p: p.at[:, dst].set(p[:, src]), pool)
 
 
-__all__ = ["PageAllocator", "PageExhausted", "write_pages", "copy_page"]
+def gather_pages(pool, page_ids):
+    """Gather whole pages out of the pool into a fresh buffer — the
+    shape-stable read twin of `write_pages`.
+
+    pool      [..., P, page_tokens, ...]  (page axis = 1 on every leaf)
+    page_ids  [W] int32                   source pages (traced ok)
+
+    The result is an *independent* `[..., W, page_tokens, ...]` buffer
+    per leaf, so the caller may release (and even donate) the pool right
+    after dispatch — jax orders the in-flight read before any later
+    donation. This is the spill-side primitive of host tiering: gather
+    cold pages, hand the chunk to the migration engine, free the pages.
+    """
+    return jax.tree.map(lambda p: p[:, page_ids], pool)
+
+
+__all__ = ["PageAllocator", "PageExhausted", "write_pages", "copy_page",
+           "gather_pages"]
 
 
 if __name__ == "__main__":  # pragma: no cover - smoke

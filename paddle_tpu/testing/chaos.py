@@ -246,6 +246,13 @@ register_site("batcher.quota",
               "each per-tenant quota check during anchor selection "
               "(a raise defers the tenant as if quota-blocked; "
               "requests queue, never drop)")
+register_site("page.migrate",
+              "each host<->device page-migration batch in the KV-tier "
+              "migration worker (memory/migration.py); a raise fails "
+              "that batch — spill failures drop the affected cache "
+              "entries, refetch failures degrade the waiting stream to "
+              "a re-prefill — and a hang stalls only streams parked on "
+              "those pages")
 
 
 def maybe_fail(site: str, detail=None):

@@ -1,0 +1,526 @@
+"""Host-RAM KV tiering (ISSUE 18): the tiered allocator's handle
+lifecycle, the host arena store, the async migration engine (round
+trip, chaos), leaf-first LRU prefix eviction, and the decode engine
+end-to-end — 4x more resident conversations than the device pool
+holds with zero shedding and token identity, QoS preempt/resume via
+spill/restore (greedy, seeded, speculative), and chaos page.migrate
+Fail/Hang isolation."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import profiler
+from paddle_tpu.inference.decode import (DecodeEngine, SpecDecodeEngine,
+                                         _PrefixCache)
+from paddle_tpu.inference.errors import ERR_UNAVAILABLE, TypedServeError
+from paddle_tpu.memory.migration import (HostPageStore, MigrationEngine,
+                                         Residency, TieredPageAllocator)
+from paddle_tpu.memory.page_allocator import PageAllocator
+from paddle_tpu.models.gpt import GPT, GPTConfig, gpt_tiny
+from paddle_tpu.observability import REGISTRY
+from paddle_tpu.testing import chaos
+
+SMALL = GPTConfig(vocab_size=256, max_seq_len=96, hidden=32, layers=2,
+                  heads=2, scan_layers=False)
+
+
+@pytest.fixture(scope="module")
+def small_model():
+    paddle.seed(11)
+    return GPT(SMALL)
+
+
+@pytest.fixture(scope="module")
+def gpt_models():
+    paddle.seed(7)
+    return {
+        "tiny": GPT(gpt_tiny()),
+        "draft": GPT(GPTConfig(vocab_size=512, max_seq_len=128, hidden=32,
+                               layers=1, heads=2, scan_layers=False)),
+    }
+
+
+def _full_logits(model, toks):
+    idx = paddle.to_tensor(np.asarray([toks], np.int64))
+    return model(idx).numpy()[0, -1].astype(np.float32)
+
+
+def _ref_greedy(model, prompt, n):
+    toks = [int(t) for t in prompt]
+    out = []
+    for _ in range(n):
+        t = int(_full_logits(model, toks).argmax())
+        out.append(t)
+        toks.append(t)
+    return out
+
+
+def _wait_tokens(stream, n, timeout=60.0):
+    seen = []
+    deadline = time.monotonic() + timeout
+    while len(seen) < n and time.monotonic() < deadline:
+        ev = stream.poll()
+        if ev is None:
+            time.sleep(0.005)
+            continue
+        assert ev[0] == "token", ev
+        seen.append(ev[1])
+    assert len(seen) >= n, f"only {len(seen)} tokens before timeout"
+    return seen
+
+
+def _flat(*names):
+    flat = REGISTRY.flat()
+    return {n: flat.get(n, 0.0) for n in names}
+
+
+def _drain_migrations(eng, timeout=30.0):
+    """Wait until the engine's migration worker has retired everything
+    (spills committed, nothing parked)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        st = eng.stats().get("kv_tier", {})
+        if st.get("inflight", 0) == 0 and st.get("parked_refetches", 0) == 0:
+            return st
+        time.sleep(0.01)
+    raise AssertionError("migrations never drained")
+
+
+# -- TieredPageAllocator: handle lifecycle --------------------------------
+
+def test_tiered_allocator_handle_lifecycle():
+    a = TieredPageAllocator(8, host_pages=4)
+    hs = a.spill_begin(2)
+    assert len(hs) == 2 and all(h < 0 for h in hs)
+    assert {a.handle_slot(h) for h in hs} <= set(range(4))
+    assert all(a.residency(h) == Residency.IN_FLIGHT for h in hs)
+    assert a.host_used() == 2
+
+    a.spill_commit(hs[0])
+    assert a.residency(hs[0]) == Residency.HOST
+    with pytest.raises(ValueError):
+        a.spill_commit(hs[0])            # already committed
+    with pytest.raises(ValueError):
+        a.refetch_begin(hs[1])           # still IN_FLIGHT, not HOST
+
+    a.refetch_begin(hs[0])
+    assert a.residency(hs[0]) == Residency.IN_FLIGHT
+    with pytest.raises(ValueError):
+        a.refetch_begin(hs[0])           # pinned handles stay pinned
+    a.refetch_commit(hs[0])
+    assert a.residency(hs[0]) is None    # slot freed
+    a.host_drop(hs[1])
+    a.host_drop(hs[1])                   # idempotent
+    assert a.host_used() == 0
+
+    st = a.stats()
+    assert st["host_pages_total"] == 4 and st["host_pages_used"] == 0
+    assert st["spilled_total"] == 1 and st["refetched_total"] == 1
+
+    # device ids report DEVICE while allocated, None when free
+    (p,) = a.alloc(1)
+    assert a.residency(p) == Residency.DEVICE
+    a.release(p)
+    assert a.residency(p) is None
+
+
+def test_tiered_allocator_spill_begin_bounded():
+    a = TieredPageAllocator(8, host_pages=3)
+    hs = a.spill_begin(10)               # capped at capacity, not an error
+    assert len(hs) == 3
+    assert a.spill_begin(1) == []        # full: caller falls back to evict
+    a.host_drop(hs[0])
+    assert len(a.spill_begin(5)) == 1
+    with pytest.raises(ValueError):
+        TieredPageAllocator(8, host_pages=0)
+
+
+# -- HostPageStore: arena round trip and rung padding ---------------------
+
+def test_host_store_round_trip_and_padding():
+    import jax
+
+    template = (jax.ShapeDtypeStruct((2, 5, 3), np.float32),
+                jax.ShapeDtypeStruct((2, 5, 3), np.float32))
+    store = HostPageStore(template, capacity=3)
+    assert store.nbytes() == 2 * (3 * 2 * 3 * 4)
+
+    rng = np.random.RandomState(0)
+    chunk = [rng.rand(2, 2, 3).astype(np.float32) for _ in range(2)]
+    store.put(0, chunk, 0)
+    store.put(2, chunk, 1)
+    rows = store.assemble([2, 0], rung=4)
+    for leaf, src in zip(rows, chunk):
+        assert leaf.shape == (2, 4, 3)
+        np.testing.assert_array_equal(leaf[:, 0], src[:, 1])
+        np.testing.assert_array_equal(leaf[:, 1], src[:, 0])
+        assert not leaf[:, 2:].any()     # rung padding stays zero
+
+
+# -- MigrationEngine: async spill -> refetch round trip -------------------
+
+def test_migration_engine_round_trip_content_exact():
+    import jax
+    import jax.numpy as jnp
+
+    alloc = TieredPageAllocator(4, host_pages=4)
+    store = HostPageStore((jax.ShapeDtypeStruct((2, 4, 3), np.float32),),
+                          capacity=4)
+    eng = MigrationEngine(store, window=2)
+    try:
+        hs = alloc.spill_begin(2)
+        src = jnp.asarray(np.arange(2 * 2 * 3, dtype=np.float32)
+                          .reshape(2, 2, 3))
+
+        def commit(t):
+            for h in t.handles:
+                alloc.spill_commit(h)
+
+        t = eng.spill((src,), hs, 2, on_done=commit)
+        assert t.wait(timeout=30) == "ok" and t.error is None
+        assert all(alloc.residency(h) == Residency.HOST for h in hs)
+
+        for h in hs:
+            alloc.refetch_begin(h)
+        t2 = eng.refetch(hs, rung=4)
+        assert t2.wait(timeout=30) == "ok"
+        (rows,) = t2.rows
+        got = np.asarray(rows)
+        np.testing.assert_array_equal(got[:, :2], np.asarray(src))
+        assert not got[:, 2:].any()
+
+        st = eng.stats()
+        assert st["window"] == 2 and st["inflight"] == 0
+        assert st["host_arena_bytes"] == store.nbytes()
+        assert st["spill_p95_ms"] >= 0 and st["refetch_p95_ms"] >= 0
+    finally:
+        eng.stop()
+    with pytest.raises(RuntimeError):
+        eng.spill((src,), [], 0)         # stopped engine refuses work
+
+
+def test_migration_engine_chaos_fails_batch_only():
+    import jax
+    import jax.numpy as jnp
+
+    alloc = TieredPageAllocator(4, host_pages=4)
+    store = HostPageStore((jax.ShapeDtypeStruct((1, 4, 2), np.float32),),
+                          capacity=4)
+    eng = MigrationEngine(store, window=2)
+    try:
+        src = jnp.ones((1, 1, 2), np.float32)
+        h1 = alloc.spill_begin(1)
+        h2 = alloc.spill_begin(1)
+        with chaos.inject("page.migrate:1:RuntimeError") as sched:
+            t1 = eng.spill((src,), h1, 1)
+            assert t1.wait(timeout=30) == "failed"
+            assert isinstance(t1.error, RuntimeError)
+            t2 = eng.spill((src,), h2, 1)   # batch 2 is untouched
+            assert t2.wait(timeout=30) == "ok"
+        assert sched.fired and sched.fired[0][0] == "page.migrate"
+    finally:
+        eng.stop()
+
+
+# -- _PrefixCache: leaf-first LRU + orphan accounting (satellite) ---------
+
+def test_prefix_evict_leaf_first_keeps_chain_reachable():
+    """Eviction takes the coldest LEAF, not the oldest entry: a chain
+    shrinks tip-to-root, so the surviving prefix stays loadable and
+    nothing is orphaned."""
+    alloc = PageAllocator(8)
+    pc = _PrefixCache(alloc, page_tokens=2)
+    prompt = [1, 2, 3, 4, 5, 6]
+    pages = alloc.alloc(3)
+    pc.insert(prompt, pages)
+    for p in pages:                      # trie holds its own refs
+        alloc.release(p)
+
+    # touch the ROOT so it is most-recently-used; a plain LRU would now
+    # evict a mid-chain entry and strand the tip
+    hit, _ = pc.lookup(prompt[:2])
+    for p in hit:
+        alloc.release(p)
+
+    assert pc.evict(1) == 1
+    st = pc.stats()
+    assert st["cached_pages"] == 2 and st["orphaned"] == 0
+    hit, tokens = pc.lookup(prompt)      # remaining chain fully reachable
+    assert tokens == 4
+    for p in hit:
+        alloc.release(p)
+
+    assert pc.evict(5) == 2              # drains tip-to-root
+    assert pc.stats()["orphaned"] == 0
+    assert alloc.stats()["pages_used"] == 0
+
+
+def test_prefix_forced_midchain_removal_counts_orphans():
+    """When the only evictable entry is mid-chain (its child lives in
+    the host tier), removing it strands the child — the `orphaned`
+    stat must say so."""
+    alloc = TieredPageAllocator(8, host_pages=2)
+    pc = _PrefixCache(alloc, page_tokens=2)
+    prompt = [9, 8, 7, 6]
+    pages = alloc.alloc(2)
+    pc.insert(prompt, pages)
+    for p in pages:
+        alloc.release(p)
+
+    d_child = pc._digests(prompt)[1]
+    (h,) = alloc.spill_begin(1)
+    assert pc.mark_spilled(d_child, pages[1], h)
+    alloc.spill_commit(h)
+    assert pc.stats()["host_entries"] == 1
+
+    assert pc.evict(1) == 1              # root is the only device entry
+    st = pc.stats()
+    assert st["orphaned"] == 1 and st["cached_pages"] == 1
+    assert pc.lookup(prompt)[1] == 0     # stranded child is unreachable
+    assert pc.drop_host_lru(1) == 1      # and reclaimable
+    assert alloc.host_used() == 0
+    assert alloc.stats()["pages_used"] == 0
+
+
+# -- engine end-to-end: 4x resident conversations, zero shedding ----------
+
+def test_tiered_engine_4x_resident_streams_token_identity(small_model):
+    """8 multi-turn conversations over a device pool that fully holds
+    only 2: every turn-2 prompt finds its turn-1 KV (device or host
+    tier), nothing is shed or destructively evicted, every token
+    matches the full-forward greedy reference, and the steady state
+    compiles nothing."""
+    model = small_model
+    n_convos, gen = 8, 4
+    # 12-token prompts = 3 full cached pages per conversation chain
+    prompts = [[(7 * i + j) % 256 for j in range(12)]
+               for i in range(n_convos)]
+    follows = [[(3 * i + j + 50) % 256 for j in range(4)]
+               for i in range(n_convos)]
+    # precompute both turns' references so the measured run compiles
+    # nothing outside the engine (turn-2 inputs assume turn 1 matches;
+    # if it doesn't, the turn-1 assert fires first)
+    ref1 = [_ref_greedy(model, p, gen) for p in prompts]
+    ref2 = [_ref_greedy(model, p + r + f, gen)
+            for p, r, f in zip(prompts, ref1, follows)]
+
+    # 6 usable device pages = 2 conversations' 3-page cached chains;
+    # 8 resident conversations is 4x that
+    eng = DecodeEngine(model, max_slots=1, max_new_tokens=gen,
+                       page_tokens=4, num_pages=7, host_pages=64,
+                       prefix_cache=True)
+    try:
+        assert eng.host_pages == 64
+        eng.warmup()
+        m0 = _flat("paddle_tpu_decode_page_alloc_failures_total",
+                   "paddle_tpu_decode_prefix_evictions_total")
+        c0 = len(profiler.compile_events())
+
+        out1 = [eng.submit(p, max_new_tokens=gen).result(timeout=120)
+                for p in prompts]
+        assert out1 == ref1, "turn-1 tokens diverged under tiering"
+        tier = _drain_migrations(eng)
+        assert tier["spilled_total"] > 0, "device pool never spilled"
+        st = eng.stats()
+        # all 8 conversations' chains (3 full pages each) stay resident
+        # across the turn gap — 4x what the device pool can hold
+        assert st["prefix_cache"]["cached_pages"] >= n_convos * 3
+        assert st["prefix_cache"]["host_entries"] > 0
+
+        out2 = [eng.submit(p + r + f, max_new_tokens=gen)
+                .result(timeout=120)
+                for p, r, f in zip(prompts, out1, follows)]
+        assert out2 == ref2, "turn-2 tokens diverged under tiering"
+
+        tier = _drain_migrations(eng)
+        assert tier["refetched_total"] > 0, \
+            "turn 2 never refetched spilled KV"
+        m1 = _flat("paddle_tpu_decode_page_alloc_failures_total",
+                   "paddle_tpu_decode_prefix_evictions_total")
+        assert m1 == m0, f"tiered run shed or destructively evicted: " \
+                         f"{m0} -> {m1}"
+        assert len(profiler.compile_events()) == c0, \
+            "steady-state tiering compiled something"
+        # gauges follow the allocator
+        flat = REGISTRY.flat()
+        host_gauge = flat.get(
+            'paddle_tpu_kv_tier_resident_pages{tier="host"}', 0)
+        assert host_gauge == eng.stats()["pages"]["host_pages_used"]
+    finally:
+        eng.stop()
+
+
+# -- QoS preempt/resume rides the tier: spill/restore identity ------------
+
+def test_preempt_spill_restore_identity_greedy(gpt_models):
+    """With a device pool too small for victim stash + contender, the
+    preempt stash spills to host RAM and the resumed victim refetches
+    it — token-identical to an unpreempted run."""
+    model = gpt_models["tiny"]
+    rng = np.random.RandomState(41)
+    p_vic = rng.randint(0, 512, size=9)
+    p_hi = rng.randint(0, 512, size=7)
+    ref_vic = _ref_greedy(model, p_vic, 16)
+    ref_hi = _ref_greedy(model, p_hi, 6)
+    eng = DecodeEngine(model, max_slots=1, max_new_tokens=16,
+                       page_tokens=4, num_pages=7, host_pages=64,
+                       preempt=True)
+    try:
+        vic = eng.submit(p_vic, max_new_tokens=16)
+        early = _wait_tokens(vic, 3)
+        hi = eng.submit(p_hi, max_new_tokens=6, priority=5)
+        assert hi.result(timeout=120) == ref_hi
+        assert vic.result(timeout=120) == ref_vic, \
+            "spill/restore-resumed stream diverged"
+        assert early == ref_vic[:len(early)]
+        st = eng.stats()["kv_tier"]
+        assert st["spilled_total"] > 0, "stash never spilled to host"
+    finally:
+        eng.stop()
+
+
+def test_preempt_spill_restore_identity_seeded(gpt_models):
+    model = gpt_models["tiny"]
+    rng = np.random.RandomState(43)
+    p_vic = rng.randint(0, 512, size=8)
+    p_hi = rng.randint(0, 512, size=7)
+    ref_eng = DecodeEngine(model, max_slots=1, max_new_tokens=16,
+                           page_tokens=4, preempt=False)
+    try:
+        ref = ref_eng.submit(p_vic, max_new_tokens=14, temperature=0.8,
+                             seed=123).result(timeout=120)
+    finally:
+        ref_eng.stop()
+    eng = DecodeEngine(model, max_slots=1, max_new_tokens=16,
+                       page_tokens=4, num_pages=7, host_pages=64,
+                       preempt=True)
+    try:
+        vic = eng.submit(p_vic, max_new_tokens=14, temperature=0.8,
+                         seed=123)
+        _wait_tokens(vic, 4)
+        hi = eng.submit(p_hi, max_new_tokens=6, priority=5)
+        hi.result(timeout=120)
+        assert vic.result(timeout=120) == ref, \
+            "seeded spill/restore resume diverged"
+        assert eng.stats()["kv_tier"]["spilled_total"] > 0
+    finally:
+        eng.stop()
+
+
+def test_preempt_spill_restore_identity_speculative(gpt_models):
+    model = gpt_models["tiny"]
+    rng = np.random.RandomState(47)
+    p_vic = rng.randint(0, 512, size=8)
+    p_hi = rng.randint(0, 512, size=6)
+    ref_vic = _ref_greedy(model, p_vic, 12)
+    ref_hi = _ref_greedy(model, p_hi, 5)
+    eng = SpecDecodeEngine(model, draft_model=gpt_models["draft"],
+                           speculate_k=4, max_slots=1, max_new_tokens=16,
+                           page_tokens=4, num_pages=6, host_pages=64,
+                           preempt=True)
+    try:
+        vic = eng.submit(p_vic, max_new_tokens=12)
+        _wait_tokens(vic, 4)
+        hi = eng.submit(p_hi, max_new_tokens=5, priority=5)
+        assert hi.result(timeout=120) == ref_hi
+        assert vic.result(timeout=120) == ref_vic, \
+            "speculative spill/restore resume diverged"
+        assert eng.stats()["kv_tier"]["spilled_total"] > 0
+    finally:
+        eng.stop()
+
+
+# -- chaos page.migrate: failure degrades, hang isolates ------------------
+
+def _populate_spilled(eng, model, n_convos=3, gen=4):
+    """Run `n_convos` conversations through a 6-usable-page engine so
+    the earliest chains end up host-resident; returns their token
+    lists."""
+    prompts = [[(7 * i + j) % SMALL.vocab_size for j in range(8)]
+               for i in range(n_convos)]
+    outs = [eng.submit(p, max_new_tokens=gen).result(timeout=120)
+            for p in prompts]
+    tier = _drain_migrations(eng)
+    assert tier["spilled_total"] > 0
+    return prompts, outs
+
+
+def test_chaos_migrate_fail_degrades_to_reprefill(small_model):
+    """A failed refetch drops the spilled entries and the stream falls
+    back to an ordinary prefill: slower, never wrong."""
+    model = small_model
+    eng = DecodeEngine(model, max_slots=1, max_new_tokens=4,
+                       page_tokens=4, num_pages=7, host_pages=64,
+                       prefix_cache=True)
+    try:
+        prompts, outs = _populate_spilled(eng, model)
+        toks = prompts[0] + outs[0] + [99, 98, 97, 96]
+        ref = _ref_greedy(model, toks, 4)
+        with chaos.inject("page.migrate:1+:RuntimeError") as sched:
+            got = eng.submit(toks, max_new_tokens=4).result(timeout=120)
+            assert got == ref, "degraded stream produced wrong tokens"
+        assert sched.fired, "no migration batch was failed"
+        st = _drain_migrations(eng)
+        assert st["parked_refetches"] == 0
+        # the engine is healthy after the chaos window
+        got2 = eng.submit(toks, max_new_tokens=4).result(timeout=120)
+        assert got2 == ref
+    finally:
+        eng.stop()
+
+
+def test_chaos_migrate_hang_stalls_only_parked_stream(small_model):
+    """A hung refetch parks only the stream waiting on those pages:
+    an unrelated stream admitted later finishes first."""
+    model = small_model
+    eng = DecodeEngine(model, max_slots=1, max_new_tokens=4,
+                       page_tokens=4, num_pages=7, host_pages=64,
+                       prefix_cache=True)
+    try:
+        eng.warmup()                      # so the bystander is fast
+        prompts, outs = _populate_spilled(eng, model)
+        a_toks = prompts[0] + outs[0] + [99, 98, 97, 96]
+        b_toks = [(5 * j + 1) % SMALL.vocab_size for j in range(6)]
+        ref_a = _ref_greedy(model, a_toks, 4)
+        ref_b = _ref_greedy(model, b_toks, 4)
+        with chaos.inject("page.migrate:1:Hang@1.5") as sched:
+            a = eng.submit(a_toks, max_new_tokens=4)
+            deadline = time.monotonic() + 10
+            while eng.stats()["kv_tier"]["parked_refetches"] == 0 \
+                    and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert eng.stats()["kv_tier"]["parked_refetches"] == 1, \
+                "stream never parked on the refetch"
+            b = eng.submit(b_toks, max_new_tokens=4)
+            assert b.result(timeout=60) == ref_b
+            assert a.poll() is None, \
+                "parked stream emitted tokens while its refetch hung"
+            assert a.result(timeout=60) == ref_a
+        assert any(f[0] == "page.migrate" and f[2].startswith("Hang")
+                   for f in sched.fired)
+    finally:
+        eng.stop()
+
+
+def test_stop_with_parked_refetch_is_clean(small_model):
+    """Stopping the engine while a stream is parked on a hung refetch
+    fails that stream with typed UNAVAILABLE and shuts down cleanly."""
+    model = small_model
+    eng = DecodeEngine(model, max_slots=1, max_new_tokens=4,
+                       page_tokens=4, num_pages=7, host_pages=64,
+                       prefix_cache=True)
+    prompts, outs = _populate_spilled(eng, model)
+    with chaos.inject("page.migrate:1:Hang@2.0"):
+        a = eng.submit(prompts[0] + outs[0] + [1, 2, 3, 4],
+                       max_new_tokens=4)
+        deadline = time.monotonic() + 10
+        while eng.stats()["kv_tier"]["parked_refetches"] == 0 \
+                and time.monotonic() < deadline:
+            time.sleep(0.005)
+        eng.stop()
+    with pytest.raises(TypedServeError) as ei:
+        a.result(timeout=5)
+    assert ei.value.code == ERR_UNAVAILABLE

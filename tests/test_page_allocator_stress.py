@@ -1,0 +1,162 @@
+"""Multithreaded stress over the page allocator (ISSUE 18 satellite):
+many threads race alloc/retain/release/release_range — and, on the
+tiered allocator, the full host-handle lifecycle — then every
+invariant must hold: no double-grants, refcounts drain to zero, the
+free list is whole, host slots all return."""
+import random
+import threading
+
+from paddle_tpu.memory.migration import Residency, TieredPageAllocator
+from paddle_tpu.memory.page_allocator import PageAllocator, PageExhausted
+
+N_THREADS = 6
+N_OPS = 1500
+
+
+def _run_threads(fn, n=N_THREADS):
+    errors = []
+
+    def wrapped(seed):
+        try:
+            fn(seed)
+        except Exception as exc:         # pragma: no cover - failure path
+            errors.append(exc)
+
+    threads = [threading.Thread(target=wrapped, args=(i,), daemon=True)
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not any(t.is_alive() for t in threads), "stress thread hung"
+    assert not errors, errors
+
+
+def test_alloc_retain_release_race():
+    """alloc/retain/release/release_range from 6 threads: pages are
+    never granted twice while held, and everything drains back."""
+    alloc = PageAllocator(64)
+    grant_lock = threading.Lock()
+    granted = set()                      # pages currently held by a thread
+
+    def worker(seed):
+        rng = random.Random(seed)
+        held = []
+        for _ in range(N_OPS):
+            op = rng.random()
+            if op < 0.45 and len(held) < 12:
+                try:
+                    pages = alloc.alloc(rng.randint(1, 3))
+                except PageExhausted:
+                    continue
+                with grant_lock:
+                    dup = granted & set(pages)
+                    assert not dup, f"pages {dup} double-granted"
+                    granted.update(pages)
+                held += pages
+            elif op < 0.6 and held:
+                p = rng.choice(held)
+                alloc.retain(p)          # second ref: release twice below
+                alloc.release(p)
+                assert alloc.refcount(p) >= 1
+            elif op < 0.8 and held:
+                i = rng.randrange(len(held))
+                p = held.pop(i)
+                with grant_lock:
+                    granted.discard(p)
+                alloc.release(p)
+            elif held:
+                # release_range drops the tail in one call
+                keep = rng.randrange(len(held))
+                with grant_lock:
+                    granted.difference_update(held[keep:])
+                alloc.release_range(held, keep)
+                del held[keep:]
+        with grant_lock:
+            granted.difference_update(held)
+        alloc.release_range(held, 0)
+
+    _run_threads(worker)
+    st = alloc.stats()
+    assert st["pages_used"] == 0, st
+    assert alloc.free_count() == 63      # all but the reserved null page
+    # the free list is whole: a full allocation succeeds and is distinct
+    pages = alloc.alloc(63)
+    assert len(set(pages)) == 63 and 0 not in pages
+    alloc.release_range(pages, 0)
+
+
+def test_tiered_handle_lifecycle_race():
+    """The host-handle state machine under contention: threads race
+    spill_begin/spill_commit/refetch_begin/refetch_commit/host_drop;
+    slots are never double-assigned and all return to the free pool."""
+    alloc = TieredPageAllocator(8, host_pages=16)
+    slot_lock = threading.Lock()
+    owned = set()                        # arena slots currently reserved
+
+    def worker(seed):
+        rng = random.Random(seed)
+        for _ in range(N_OPS // 3):
+            handles = alloc.spill_begin(rng.randint(1, 3))
+            slots = {alloc.handle_slot(h) for h in handles}
+            with slot_lock:
+                dup = owned & slots
+                assert not dup, f"host slots {dup} double-assigned"
+                owned.update(slots)
+            for h in handles:
+                slot = alloc.handle_slot(h)
+                assert alloc.residency(h) == Residency.IN_FLIGHT
+                # un-own the slot BEFORE the call that frees it — the
+                # moment it frees, another thread may re-acquire it
+                if rng.random() < 0.2:
+                    with slot_lock:
+                        owned.discard(slot)
+                    alloc.host_drop(h)   # aborted spill
+                    continue
+                alloc.spill_commit(h)
+                if rng.random() < 0.5:
+                    alloc.refetch_begin(h)
+                    with slot_lock:
+                        owned.discard(slot)
+                    alloc.refetch_commit(h)
+                else:
+                    with slot_lock:
+                        owned.discard(slot)
+                    alloc.host_drop(h)
+
+    _run_threads(worker)
+    assert alloc.host_used() == 0
+    st = alloc.stats()
+    assert st["host_inflight"] == 0
+    assert st["spilled_total"] > 0 and st["refetched_total"] > 0
+    # the slot pool is whole again
+    assert len(alloc.spill_begin(32)) == 16
+
+
+def test_mixed_device_and_host_pressure_race():
+    """Device alloc pressure and host-tier churn together — the shape
+    the decode scheduler + migration worker produce in production."""
+    alloc = TieredPageAllocator(32, host_pages=8)
+
+    def worker(seed):
+        rng = random.Random(seed)
+        for _ in range(N_OPS // 3):
+            if rng.random() < 0.5:
+                try:
+                    pages = alloc.alloc(rng.randint(1, 4))
+                except PageExhausted:
+                    continue
+                for p in pages:
+                    alloc.retain(p)
+                alloc.release_range(pages, 0)
+                for p in pages:
+                    alloc.release(p)
+            else:
+                for h in alloc.spill_begin(rng.randint(1, 2)):
+                    alloc.spill_commit(h)
+                    alloc.host_drop(h)
+
+    _run_threads(worker)
+    st = alloc.stats()
+    assert st["pages_used"] == 0
+    assert st["host_pages_used"] == 0 and st["host_inflight"] == 0

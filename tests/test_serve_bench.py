@@ -121,3 +121,41 @@ def test_serve_bench_decode_quant_arms_schema():
     assert dc["draft_weight_bytes"]["int8"] \
         < dc["draft_weight_bytes"]["float32"]
     assert out["compile_count"] == 0
+
+
+@pytest.mark.slow
+def test_serve_bench_long_context_tiering_schema():
+    """--decode --long-context: the host-RAM KV-tier workload keeps the
+    rc-0 JSON contract, holds 4x more conversations resident than the
+    device pool alone, sheds nothing, emits identical tokens in both
+    arms, and compiles nothing after warmup."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    res = subprocess.run(
+        [sys.executable, BENCH, "--decode", "--long-context",
+         "--decode-requests", "8", "--host-pages", "256"],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert res.returncode == 0, res.stderr
+    out = json.loads(res.stdout.strip().splitlines()[-1])
+    assert out["metric"] == "decode_long_context_resident_streams"
+    assert "error" not in out, out
+    for key in ("value", "unit", "vs_baseline", "resident_streams",
+                "resident_streams_untiered", "device_chain_capacity",
+                "spilled_pages", "refetched_pages", "refetch_p50_ms",
+                "refetch_p95_ms", "spill_p95_ms", "host_arena_bytes",
+                "resume_turn2_p50_ms", "reprefill_turn2_p50_ms",
+                "resume_vs_reprefill", "outputs_match", "shed_tiered",
+                "shed_untiered", "warmup_compiles", "compile_count"):
+        assert key in out, key
+    # the scored contract: >= 4x resident conversations, zero shed
+    assert out["resident_streams"] \
+        >= 4 * out["device_chain_capacity"], out
+    assert out["resident_streams"] > out["resident_streams_untiered"]
+    assert out["shed_tiered"] == 0 and not out["errors"]
+    assert out["spilled_pages"] > 0 and out["refetched_pages"] > 0
+    assert out["refetch_p95_ms"] >= 0
+    # tiering must be invisible in tokens and in compile count
+    assert out["outputs_match"] is True
+    assert out["compile_count"] == 0
+    # kv_tier metric families rode along in the raw dump
+    assert any(k.startswith("paddle_tpu_kv_tier_")
+               for k in out["metrics"])

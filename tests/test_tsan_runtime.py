@@ -350,7 +350,8 @@ def test_runtime_gate_on_concurrency_modules(tmp_path):
          "tests/test_serve_stream_failover.py",
          "tests/test_decode.py", "tests/test_decode_paged.py",
          "tests/test_decode_spec.py", "tests/test_decode_qos.py",
-         "tests/test_slo.py", "tests/test_quant.py",
+         "tests/test_kv_tiering.py", "tests/test_slo.py",
+         "tests/test_quant.py",
          "-m", "not slow",
          "-p", "paddle_tpu.analysis.runtime.pytest_plugin",
          "-p", "no:cacheprovider"],
