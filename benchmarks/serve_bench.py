@@ -27,6 +27,10 @@ parseable JSON with rc 0.
         # speculative decoding (draft-and-verify) vs the plain engine on
         # a repetitive-continuation workload; scored as accepted
         # tokens/s (target: >= 1.5x)
+    python benchmarks/serve_bench.py --disagg --router 2
+        # disaggregated 1-prefill + 2-decode fleet with KV-page handoff
+        # vs a 3-unified colocated fleet; scored on decode-stream stall,
+        # TTFT, handoff cost, output identity, zero compiles
 """
 import argparse
 import json
@@ -1173,6 +1177,202 @@ def run_decode_router_bench(args):
     }
 
 
+def run_disagg_bench(args):
+    """Disaggregated serving mode (``--disagg``): 1 prefill worker + N
+    decode workers with KV-page handoff over the wire
+    (inference/decode.py export_kv/import_kv, docs/serving.md) vs an
+    (N+1)-unified colocated fleet — same total worker count, same
+    prompts, same router code.
+
+    The workload is built to expose the interference disaggregation
+    removes: long prompts (prefill-dominated) submitted with a stagger,
+    so late arrivals' prefills land while earlier streams are
+    mid-decode. On the colocated fleet those prefills run on the same
+    engines as the live decode streams and stall them between tokens;
+    on the disagg fleet the prefill worker absorbs them and the decode
+    workers admit each handoff as a prefix-cache hit. Load-bearing
+    fields: ``decode_stall_p95_ms`` per arm and ``stall_reduction``
+    (>= 1.0 means disagg reduced inter-token stall), ``ttft_p50_ms`` /
+    ``ttft_p95_ms`` per arm, the ``handoff`` block (count, pages,
+    bytes, router-observed latency p95), ``outputs_match`` (greedy
+    streams must be token-identical across arms) and the
+    ``compile_count`` contract of 0 for both arms after warmup."""
+    import socket
+    import threading
+
+    import paddle_tpu as paddle
+    from paddle_tpu import profiler
+    from paddle_tpu.inference.decode import save_for_decode
+    from paddle_tpu.inference.router import Backend, ServeRouter
+    from paddle_tpu.inference.serve import InferenceServer, decode_request
+    from paddle_tpu.models.gpt import GPT, gpt_tiny
+    from paddle_tpu.observability import REGISTRY
+
+    paddle.seed(args.seed)
+    cfg = gpt_tiny()
+    prefix = os.path.join(tempfile.mkdtemp(prefix="serve_bench_dis_"),
+                          "gpt")
+    save_for_decode(GPT(cfg), prefix)
+
+    n_dec = max(args.router, 2)          # decode workers in the disagg arm
+    n_streams = max(args.decode_requests, 8)
+    max_new = min(args.decode_tokens or 16, 32)
+    stagger_s = 0.02
+    rng = np.random.default_rng(args.seed)
+    # prefill-dominated requests: long prompts, short generations
+    prompts = [rng.integers(
+        0, cfg.vocab_size,
+        size=int(rng.integers(33, cfg.max_seq_len - max_new - 8))
+    ).astype(np.int32) for _ in range(n_streams)]
+
+    def run_arm(roles):
+        srvs = [InferenceServer(prefix, port=0, decode=True,
+                                decode_slots=args.decode_slots,
+                                decode_max_new=max_new, metrics_port=0,
+                                role=r)
+                for r in roles]
+        backends = []
+        for r, s in zip(roles, srvs):
+            b = Backend("127.0.0.1", s.port, s.metrics_port)
+            # what a membership record would carry (docs/serving.md);
+            # a static bench fleet applies it directly
+            b.set_meta(dict({"role": r}, **s._engine.kv_compat()))
+            backends.append(b)
+        router = ServeRouter(backends, port=0, poll_interval=0.1)
+        deadline = time.perf_counter() + 15.0
+        while time.perf_counter() < deadline:
+            bs = router.backends()
+            if bs and all(b.trace_wire for b in bs):
+                break
+            time.sleep(0.05)
+        for s in srvs:
+            s._engine.warmup()
+        c0 = len(profiler.compile_events())
+
+        outs = [None] * n_streams
+        ttfts = [None] * n_streams
+        gaps = [[] for _ in range(n_streams)]
+        errs = []
+
+        def client(i):
+            time.sleep(i * stagger_s)
+            arrivals = []
+            try:
+                with socket.create_connection(
+                        ("127.0.0.1", router.port)) as s:
+                    s.settimeout(300)
+                    t_sub = time.perf_counter()
+                    outs[i] = decode_request(
+                        s, prompts[i],
+                        opts={"max_new_tokens": max_new},
+                        on_token=lambda tok, sctx:
+                            arrivals.append(time.perf_counter()))
+                if arrivals:
+                    ttfts[i] = arrivals[0] - t_sub
+                    gaps[i] = [b - a for a, b in
+                               zip(arrivals, arrivals[1:])]
+            except Exception as e:
+                errs.append(f"stream {i}: {e!r}")
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=client, args=(i,),
+                                    daemon=True)
+                   for i in range(n_streams)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        wall_s = time.perf_counter() - t0
+        compiles = len(profiler.compile_events()) - c0
+        router.stop()
+        for s in srvs:
+            s.stop()
+        return {
+            "outs": outs, "errs": errs, "wall_s": wall_s,
+            "ttfts": sorted(t for t in ttfts if t is not None),
+            "gaps": sorted(g for gs in gaps for g in gs),
+            "compiles": compiles,
+        }
+
+    # colocated baseline first, then the disagg arm, with the handoff
+    # counter/histogram deltas bracketing only the disagg pass
+    colo = run_arm(["unified"] * (n_dec + 1))
+    flat0 = REGISTRY.flat()
+    disagg = run_arm(["prefill"] + ["decode"] * n_dec)
+    flat = REGISTRY.flat()
+    hh = REGISTRY.get("paddle_tpu_router_handoff_seconds")
+
+    def delta(name):
+        return float(flat.get(name, 0)) - float(flat0.get(name, 0))
+
+    tokens = sum(len(o) for o in disagg["outs"] if o is not None)
+    tps = tokens / disagg["wall_s"] if disagg["wall_s"] > 0 else 0.0
+    lost = sum(1 for o in disagg["outs"] if o is None) \
+        + sum(1 for o in colo["outs"] if o is None)
+    outputs_match = all(
+        a is not None and b is not None and list(a) == list(b)
+        for a, b in zip(colo["outs"], disagg["outs"]))
+    handoffs_ok = int(delta(
+        'paddle_tpu_router_handoffs_total{outcome="ok"}'))
+    colo_stall = _pct(colo["gaps"], 0.95) * 1e3
+    dis_stall = _pct(disagg["gaps"], 0.95) * 1e3
+    contract = (lost == 0 and outputs_match and handoffs_ok > 0
+                and colo["compiles"] == 0 and disagg["compiles"] == 0)
+    return {
+        "metric": "serve_disagg_handoff",
+        "value": round(tps, 2),
+        "unit": "tokens/s",
+        # the contract IS the baseline: zero lost streams, greedy
+        # outputs identical across arms, handoffs actually landing,
+        # zero steady-state compiles on every worker
+        "vs_baseline": 1.0 if contract else 0.0,
+        "prefill_workers": 1,
+        "decode_workers": n_dec,
+        "colocated_workers": n_dec + 1,
+        "streams": n_streams,
+        "max_new_tokens": max_new,
+        "stagger_ms": stagger_s * 1e3,
+        "lost": lost,
+        "lost_detail": (disagg["errs"] + colo["errs"])[:5],
+        "outputs_match": outputs_match,
+        "tokens_per_s": round(tps, 2),
+        "colocated_tokens_per_s": round(
+            sum(len(o) for o in colo["outs"] if o is not None)
+            / colo["wall_s"], 2) if colo["wall_s"] > 0 else 0.0,
+        "ttft_p50_ms": round(_pct(disagg["ttfts"], 0.50) * 1e3, 3),
+        "ttft_p95_ms": round(_pct(disagg["ttfts"], 0.95) * 1e3, 3),
+        "colocated_ttft_p50_ms": round(
+            _pct(colo["ttfts"], 0.50) * 1e3, 3),
+        "colocated_ttft_p95_ms": round(
+            _pct(colo["ttfts"], 0.95) * 1e3, 3),
+        # inter-token gap while other streams' prefills are in flight:
+        # the number disaggregation exists to shrink
+        "decode_stall_p95_ms": round(dis_stall, 3),
+        "colocated_decode_stall_p95_ms": round(colo_stall, 3),
+        "stall_reduction": round(colo_stall / dis_stall, 3)
+        if dis_stall > 0 else 0.0,
+        "handoff": {
+            "ok": handoffs_ok,
+            "fallback": int(delta(
+                'paddle_tpu_router_handoffs_total{outcome="fallback"}')),
+            "pages_exported": int(delta(
+                'paddle_tpu_handoff_pages_total{direction="export"}')),
+            "bytes_exported": int(delta(
+                'paddle_tpu_handoff_bytes_total{direction="export"}')),
+            "bytes_imported": int(delta(
+                'paddle_tpu_handoff_bytes_total{direction="import"}')),
+            "latency_p95_ms": round(
+                hh.percentile(0.95) * 1e3, 3) if hh else 0.0,
+        },
+        "compile_count": disagg["compiles"],
+        "colocated_compile_count": colo["compiles"],
+        "metrics": {k: v for k, v in flat.items()
+                    if k.startswith(("paddle_tpu_handoff_",
+                                     "paddle_tpu_router_handoff",
+                                     "paddle_tpu_router_role_"))},
+    }
+
+
 def run_scenario_bench(args):
     """Scenario mode: replay a seeded multi-tenant traffic scenario
     (benchmarks/scenarios.py) against one QoS-armed decode engine —
@@ -1343,6 +1543,14 @@ def main():
     ap.add_argument("--scenario-rate", type=float, default=8.0,
                     help="(scenario mode) nominal capacity in "
                          "requests/s the generators scale from")
+    ap.add_argument("--disagg", action="store_true",
+                    help="disaggregated serving mode: 1 prefill + N "
+                         "decode workers (N = --router, min 2) with "
+                         "KV-page handoff over the wire vs an "
+                         "(N+1)-unified colocated fleet — scores "
+                         "decode-stream stall, TTFT, handoff "
+                         "bytes/latency, output identity and the "
+                         "zero-compile contract (docs/serving.md)")
     ap.add_argument("--router", type=int, default=0, metavar="N",
                     help="fleet mode: N backends behind the front "
                          "router, driven over the wire (0 = classic "
@@ -1358,6 +1566,8 @@ def main():
     try:
         if args.scenario:
             out = run_scenario_bench(args)
+        elif args.disagg:
+            out = run_disagg_bench(args)
         elif args.decode and args.router:
             out = run_decode_router_bench(args)
         elif args.decode and args.long_context:

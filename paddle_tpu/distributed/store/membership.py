@@ -11,8 +11,13 @@ plus per-slot keys under ``__members__/<group>/``:
 
     nslots          ADD counter; each publisher claims slot ``add(+1)``
     slot/<i>        JSON record {"key": "host:port", "admin_port": ...,
-                    "status": "up" | "left"}
+                    "status": "up" | "left"[, "meta": {...}]}
     hb/<i>          heartbeat ADD counter, bumped every ``interval``
+
+The optional ``meta`` dict is opaque to this module: publishers attach
+arbitrary JSON-serializable facts (serving role, KV page geometry,
+model fingerprint, ...) and watchers surface the dict verbatim on the
+member record, so schema evolution never needs a membership change.
 
 Liveness is judged by the *watcher's* clock: a member is live while its
 beat counter keeps changing (last observed change within ``ttl``), so
@@ -27,6 +32,7 @@ from __future__ import annotations
 import json
 import threading
 import time
+import warnings
 from typing import Dict, Optional
 
 from . import FileStore, Store, TCPStore
@@ -52,19 +58,24 @@ class MembershipPublisher:
     beat until :meth:`leave`."""
 
     def __init__(self, store: Store, key: str, group: str = "serve",
-                 admin_port: Optional[int] = None, interval: float = 1.0):
+                 admin_port: Optional[int] = None, interval: float = 1.0,
+                 meta: Optional[dict] = None):
         self._store = store
         self._p = _prefix(group)
         self.key = key
         self.admin_port = admin_port
         self.interval = float(interval)
+        self.meta = dict(meta) if meta else None
         self.slot: Optional[int] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     def _record(self, status: str) -> bytes:
-        return json.dumps({"key": self.key, "admin_port": self.admin_port,
-                           "status": status}).encode()
+        rec = {"key": self.key, "admin_port": self.admin_port,
+               "status": status}
+        if self.meta:
+            rec["meta"] = self.meta
+        return json.dumps(rec).encode()
 
     def start(self) -> "MembershipPublisher":
         self.slot = int(self._store.add(self._p + "nslots", 1))
@@ -116,6 +127,7 @@ class MembershipWatcher:
         self.ttl = float(ttl)
         # slot -> [last beat value, local monotonic time it last changed]
         self._beats: Dict[int, list] = {}
+        self._warned_slots: set = set()
 
     def poll(self) -> Dict[str, dict]:
         """key -> member record for every live member, judged now."""
@@ -131,8 +143,20 @@ class MembershipWatcher:
                 continue         # burned slot (retried claim), skip
             try:
                 rec = json.loads(raw.decode())
-            except ValueError:
+                if not isinstance(rec, dict):
+                    raise ValueError("slot record is not a JSON object")
+            except (ValueError, UnicodeDecodeError) as e:
+                # reject-with-warning: one corrupt slot must not take the
+                # watcher (and with it the whole fleet view) down — warn
+                # once per slot, keep polling the rest
+                if slot not in self._warned_slots:
+                    self._warned_slots.add(slot)
+                    warnings.warn(
+                        f"membership slot {slot} holds a malformed "
+                        f"record ({e}); ignoring it", RuntimeWarning,
+                        stacklevel=2)
                 continue
+            self._warned_slots.discard(slot)
             if rec.get("status") != "up" or not rec.get("key"):
                 self._beats.pop(slot, None)
                 continue

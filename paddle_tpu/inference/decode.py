@@ -104,7 +104,8 @@ from ..core import flags as _flags
 from ..core import monitor
 from ..jit.compile_cache import AotCache
 from ..memory.migration import (HostPageStore, MigrationEngine,
-                                TieredPageAllocator, tier_metrics)
+                                TieredPageAllocator, deserialize_pages,
+                                serialize_pages, tier_metrics)
 from ..memory.page_allocator import (PageAllocator, PageExhausted,
                                      copy_page, gather_pages, write_pages)
 from ..models.gpt import (GPTConfig, gpt_paged_decode_fns,
@@ -121,8 +122,9 @@ from ..testing import chaos
 from .batching import (_WARMUP_SIG_CAP, bucket_ladder, next_bucket,
                        tenant_quotas as _tenant_quotas,
                        tenant_weights as _tenant_weights)
-from .errors import (ERR_INVALID_ARGUMENT, ERR_RESOURCE_EXHAUSTED,
-                     ERR_UNAVAILABLE, TypedServeError)
+from .errors import (ERR_FAILED_PRECONDITION, ERR_INVALID_ARGUMENT,
+                     ERR_RESOURCE_EXHAUSTED, ERR_UNAVAILABLE,
+                     TypedServeError)
 
 DEFAULT_MAX_SLOTS = 8          # CPU fallback when HBM stats are absent
 DEFAULT_MAX_NEW_TOKENS = 64
@@ -270,6 +272,73 @@ def _decode_metrics():
                 "1 when the engine's KV page pool is int8, 0 for fp32"),
         }
     return _METRICS
+
+
+_HANDOFF_METRICS = None
+
+
+def _handoff_metrics():
+    """Register (idempotently) and return the paddle_tpu_handoff_*
+    family — the engine-side half of disaggregated prefill/decode
+    serving (docs/observability.md). Router-side orchestration counters
+    live in `router.py` under paddle_tpu_router_*."""
+    global _HANDOFF_METRICS
+    if _HANDOFF_METRICS is None:
+        _HANDOFF_METRICS = {
+            "exports": counter(
+                "paddle_tpu_handoff_exports_total",
+                "KV-page handoffs exported by a prefill worker"),
+            "imports": counter(
+                "paddle_tpu_handoff_imports_total",
+                "KV-page handoffs landed by a decode worker"),
+            "rejects": counter(
+                "paddle_tpu_handoff_rejects_total",
+                "KV handoffs the receiving engine refused, by reason "
+                "(compat, structure, checksum, exhausted, disabled)",
+                labelnames=("reason",)),
+            "pages": counter(
+                "paddle_tpu_handoff_pages_total",
+                "KV pages moved by handoffs, by direction "
+                "(export, import)", labelnames=("direction",)),
+            "bytes": counter(
+                "paddle_tpu_handoff_bytes_total",
+                "Serialized KV payload bytes moved by handoffs, by "
+                "direction (export, import)", labelnames=("direction",)),
+            "latency": histogram(
+                "paddle_tpu_handoff_seconds",
+                "Engine-side handoff latency by stage (export = "
+                "prefill-if-miss + gather + serialize, import = "
+                "validate + scatter + trie insert)",
+                labelnames=("stage",)),
+        }
+    return _HANDOFF_METRICS
+
+
+def kv_fingerprint(cfg: GPTConfig, eps: float, params: Dict) -> str:
+    """16-hex-char identity of (config, eps, parameter names/shapes/
+    dtypes). Two engines with equal fingerprints run the same forward
+    over the same weights *layout*, so their KV pages are
+    interchangeable — the model-identity leg of the KV-handoff compat
+    contract. Weight VALUES are deliberately not hashed (hashing GBs of
+    params per engine start is not worth catching an operator loading
+    two different checkpoints of the same architecture under one
+    fingerprint — the serve artifact prefix already pins the weights)."""
+    spec = json.dumps(
+        {"config": dataclasses.asdict(cfg), "eps": float(eps),
+         "params": sorted((str(k), list(v.shape),
+                           str(np.dtype(v.dtype)))
+                          for k, v in params.items())},
+        sort_keys=True)
+    return hashlib.sha1(spec.encode()).hexdigest()[:16]
+
+
+class _HandoffJob:
+    """Pseudo-request for allocator accounting inside a KV handoff —
+    `_alloc_pages` only reads `.id` (chaos detail, error messages)."""
+    __slots__ = ("id",)
+
+    def __init__(self):
+        self.id = next_request_id()
 
 
 def kv_slot_bytes(cfg: GPTConfig, capacity: Optional[int] = None) -> int:
@@ -741,7 +810,8 @@ class DecodeEngine:
                  tenant_weights=None, tenant_quota=None,
                  preempt: Optional[bool] = None,
                  kv_dtype: Optional[str] = None,
-                 host_pages: Optional[int] = None):
+                 host_pages: Optional[int] = None,
+                 handoff: Optional[bool] = None):
         if model is not None:
             from .. import framework
             cfg = model.cfg
@@ -784,12 +854,19 @@ class DecodeEngine:
         self._alloc = TieredPageAllocator(
             self.num_pages, host_pages=self.host_pages) \
             if self.host_pages else PageAllocator(self.num_pages)
+        # disaggregated prefill/decode KV handoff (docs/serving.md):
+        # export gathers a prompt's full pages through `pgather`, import
+        # lands them through `ptier` + a prefix-trie insert so the
+        # follow-up stream admits as a prefix hit
+        self.handoff = bool(_flags.env_value("PADDLE_TPU_DECODE_HANDOFF")) \
+            if handoff is None else bool(handoff)
         use_prefix = prefix_cache if prefix_cache is not None \
             else bool(_flags.env_value("PADDLE_TPU_DECODE_PREFIX_CACHE"))
         # tiering spills and refetches *through* the trie — its entries
-        # are the spill candidates and the resume index — so enabling
-        # the host tier implies the prefix cache
-        if self.host_pages:
+        # are the spill candidates and the resume index — and a handoff
+        # import lands as a trie entry, so either mode implies the
+        # prefix cache
+        if self.host_pages or self.handoff:
             use_prefix = True
         self._prefix = _PrefixCache(self._alloc, self.page_tokens) \
             if use_prefix else None
@@ -809,16 +886,23 @@ class DecodeEngine:
         self._copy_aot = AotCache(
             jax.jit(_copy_kv_page, donate_argnums=(0, 1)), "decode.pcow",
             donate_argnums=(0, 1))
-        # host-tier executables: `pgather` snapshots cold pages into an
-        # independent buffer (pools NOT donated — the engine keeps
-        # stepping on them), `ptier` scatters refetched rows back in
+        # host-tier / handoff executables: `pgather` snapshots pages
+        # into an independent buffer (pools NOT donated — the engine
+        # keeps stepping on them), `ptier` scatters rows back in. The
+        # KV handoff rides the same two executables — export gathers,
+        # import scatters — so disaggregation adds zero new
+        # pool-threading executables
         self._gather_aot = self._tier_write_aot = None
-        if self.host_pages:
+        if self.host_pages or self.handoff:
             self._gather_aot = AotCache(jax.jit(gather_pages),
                                         "decode.pgather")
             self._tier_write_aot = AotCache(
                 jax.jit(write_pages, donate_argnums=(0,)), "decode.ptier",
                 donate_argnums=(0,))
+
+        self.fingerprint = kv_fingerprint(cfg, self.eps, self.params)
+        self._hm = _handoff_metrics() if self.handoff else None
+        self._handoff_counts = {"exports": 0, "imports": 0, "rejects": 0}
 
         self._m = _decode_metrics()
         self._m["kv_page_bytes"].set(
@@ -849,6 +933,10 @@ class DecodeEngine:
         self._store = None
         self._migrate: Optional[MigrationEngine] = None
         self._migrating: List = []   # [ticket, req, [(digest, handle)]]
+        # KV-handoff jobs parked for the scheduler thread (pools are
+        # donated on every step — only that thread may touch them);
+        # each entry is (closure, reply Queue(1))
+        self._handoff_q: deque = deque()
         self._tm = tier_metrics() if self.host_pages else None
         self._last_b_rung = self.batch_ladder[0]
         self._last_w_rung = self.page_ladder[0]
@@ -991,10 +1079,12 @@ class DecodeEngine:
             pool, pool,
             jax.ShapeDtypeStruct((), i32), jax.ShapeDtypeStruct((), i32),
             key=("pcow",))
-        if self.host_pages:
-            # tier executables per page rung: spill gather + refetch
-            # scatter over the full pool tuple, so steady-state
-            # migration — like steady-state decode — compiles nothing
+        if self.host_pages or self.handoff:
+            # tier/handoff executables per page rung: gather (spill or
+            # handoff export) + scatter (refetch or handoff import)
+            # over the full pool tuple, so steady-state migration AND
+            # steady-state handoff — like steady-state decode —
+            # compile nothing
             pools = self._pools_sds()
             for w in self.page_ladder:
                 ids = jax.ShapeDtypeStruct((w,), i32)
@@ -1039,6 +1129,7 @@ class DecodeEngine:
             "kv_ladder": list(self.kv_ladder),
             "page_tokens": self.page_tokens,
             "kv_dtype": self.kv_dtype,
+            "fingerprint": self.fingerprint,
             "kv_page_bytes": kv_page_bytes(self.cfg, self.page_tokens,
                                            self.kv_dtype),
             "pages": self._alloc.stats(),
@@ -1047,6 +1138,8 @@ class DecodeEngine:
         }
         if self._prefix is not None:
             st["prefix_cache"] = self._prefix.stats()
+        if self.handoff:
+            st["handoff"] = dict(self._handoff_counts)
         if self.host_pages:
             ps = st["pages"]
             tier = {
@@ -1076,6 +1169,10 @@ class DecodeEngine:
         self._active, self._pending = [], deque()
         self._paused = deque()
         self._migrating = []
+        while self._handoff_q:
+            _, box = self._handoff_q.popleft()
+            box.put(("err", TypedServeError(
+                ERR_UNAVAILABLE, "decode engine stopped")))
         for req in leftovers:
             req.stream._push_error(TypedServeError(
                 ERR_UNAVAILABLE, "decode engine stopped"))
@@ -1094,18 +1191,21 @@ class DecodeEngine:
             with self._cond:
                 while (not self._stop and not self._pending
                        and not self._paused and not self._active
-                       and not self._migrating):
+                       and not self._migrating and not self._handoff_q):
                     self._cond.wait(timeout=0.1)
                 if self._stop:
                     return
                 self._refill_quota()
                 newly, victims = self._schedule()
-                if not newly and not victims and not self._active:
+                if not newly and not victims and not self._active \
+                        and not self._handoff_q:
                     # everything queued is quota-blocked (or parked on
                     # an in-flight refetch): wait for the bucket refill
                     # / migration wake instead of spinning
                     self._cond.wait(timeout=0.02)
             try:
+                if self._handoff_q:
+                    self._handoff_drain()
                 if self._migrating:
                     self._tier_poll()
                 for vic in victims:
@@ -1476,6 +1576,287 @@ class DecodeEngine:
                 self._alloc.release(p)
                 self._alloc.host_drop(h)
         return True
+
+    # ----------------------------------------- prefill/decode KV handoff
+    #
+    # Disaggregated serving (docs/serving.md "Disaggregated
+    # prefill/decode"): a prefill worker calls `export_kv` — run the
+    # prompt forward if its full pages are not already cached, gather
+    # them through the non-donating `pgather` snapshot, serialize with
+    # per-page crc32 — and the router ships the payload to a decode
+    # worker, whose `import_kv` validates compat, scatters the pages in
+    # through `ptier`, and seeds the prefix trie so the follow-up
+    # decode stream admits as an ordinary prefix hit. Both halves run
+    # ON THE SCHEDULER THREAD (pool buffers are donated on every step)
+    # via a parked-work queue the loop drains; the calling connection
+    # thread waits on a one-slot reply box. Only the prompt's FULL
+    # pages travel — the decode side re-feeds the tail and samples
+    # every token itself, so token identity with a unified engine falls
+    # out of the per-(seed, position) RNG, and a failed or refused
+    # handoff degrades to a plain re-prefill (token-identical, same
+    # contract as a failed tier refetch).
+
+    def kv_compat(self) -> Dict:
+        """The engine-identity facts a KV handoff must match to land
+        here (the compat contract; docs/serving.md)."""
+        return {"page_tokens": self.page_tokens,
+                "kv_dtype": self.kv_dtype,
+                "fingerprint": self.fingerprint}
+
+    def _handoff_call(self, fn, timeout: float):
+        """Park `fn` for the scheduler thread; wait for its reply."""
+        if not self.handoff:
+            raise TypedServeError(
+                ERR_FAILED_PRECONDITION,
+                "KV handoff is disabled on this engine (enable with "
+                "handoff= / PADDLE_TPU_DECODE_HANDOFF)")
+        box: queue.Queue = queue.Queue(1)
+        with self._cond:
+            if self._stop:
+                raise TypedServeError(ERR_UNAVAILABLE,
+                                      "decode engine stopped")
+            self._handoff_q.append((fn, box))
+            self._cond.notify_all()
+        try:
+            status, val = box.get(timeout=timeout)
+        except queue.Empty:
+            raise TypedServeError(
+                ERR_UNAVAILABLE,
+                f"KV handoff did not complete within {timeout}s") \
+                from None
+        if status == "err":
+            raise val
+        return val
+
+    def _handoff_drain(self):
+        """Run parked handoff jobs (scheduler thread, outside `_cond`).
+        A job's failure goes back through its reply box — it must never
+        poison the active batch the way a step failure does."""
+        while True:
+            with self._cond:
+                if not self._handoff_q:
+                    return
+                fn, box = self._handoff_q.popleft()
+            try:
+                box.put(("ok", fn()))
+            except BaseException as exc:
+                self._handoff_counts["rejects"] += 1
+                box.put(("err", exc))
+
+    def export_kv(self, prompt: Sequence[int],
+                  timeout: float = 30.0) -> Dict:
+        """Prefill-side half of a KV handoff: ensure the prompt's full
+        pages exist (prefix-cache hit, else one prefill), snapshot and
+        serialize them. Returns the wire payload — compat metadata,
+        the prompt tokens, per-leaf page arrays (int8 as uint8 views)
+        and per-page checksums. ``n_pages`` may be 0 for a sub-page
+        prompt; the importer then just seeds nothing and the decode
+        worker re-prefills, which is still token-identical."""
+        toks = [int(t)
+                for t in np.asarray(prompt, np.int64).reshape(-1)]
+        if not toks:
+            raise TypedServeError(ERR_INVALID_ARGUMENT, "empty prompt")
+        if any(t < 0 or t >= self.cfg.vocab_size for t in toks):
+            raise TypedServeError(
+                ERR_INVALID_ARGUMENT,
+                f"prompt token out of range [0, {self.cfg.vocab_size})")
+        if len(toks) >= self.cfg.max_seq_len:
+            raise TypedServeError(
+                ERR_INVALID_ARGUMENT,
+                f"prompt length {len(toks)} exceeds "
+                f"max_seq_len={self.cfg.max_seq_len}")
+        return self._handoff_call(lambda: self._export_kv(toks), timeout)
+
+    def import_kv(self, payload: Dict, timeout: float = 30.0) -> int:
+        """Decode-side half of a KV handoff: validate the compat
+        contract and the payload integrity, scatter the pages into the
+        pool, and seed the prefix trie so the follow-up stream admits
+        as a prefix hit. Returns the number of pages landed. Raises
+        typed FAILED_PRECONDITION on any compat / structure / checksum
+        mismatch — never a silent garbage admission."""
+        return self._handoff_call(lambda: self._import_kv(payload),
+                                  timeout)
+
+    def _export_kv(self, toks: List[int]) -> Dict:
+        t0 = time.perf_counter()
+        pt = self.page_tokens
+        n_full = len(toks) // pt
+        self._ensure_pool()
+        payload = self.kv_compat()
+        payload["prompt"] = list(toks)
+        if n_full == 0:
+            payload.update(n_pages=0, leaves=[], crcs=[], arrays=[])
+        else:
+            pages = self._handoff_pages(toks, n_full)
+            try:
+                w = next_bucket(n_full, self.page_ladder)
+                ids = np.zeros(w, np.int32)
+                ids[:n_full] = pages
+                exe = self._gather_aot.get_or_compile(
+                    self._pools(),
+                    jax.ShapeDtypeStruct((w,), jnp.int32),
+                    key=("pgather", w))
+                chunk = exe(self._pools(), jnp.asarray(ids))
+                arrays, meta = serialize_pages(chunk, n_full)
+            finally:
+                for p in pages:
+                    self._alloc.release(p)
+            payload.update(meta)
+            payload["arrays"] = arrays
+        nbytes = sum(a.nbytes for a in payload["arrays"])
+        self._handoff_counts["exports"] += 1
+        self._hm["exports"].inc()
+        self._hm["pages"].labels(direction="export").inc(n_full)
+        self._hm["bytes"].labels(direction="export").inc(nbytes)
+        self._hm["latency"].labels(stage="export").observe(
+            time.perf_counter() - t0)
+        _RING.complete("handoff.export", t0, time.perf_counter(),
+                       {"pages": n_full, "bytes": nbytes})
+        return payload
+
+    def _handoff_pages(self, toks: List[int], n_full: int) -> List[int]:
+        """Device pages holding `toks`' first `n_full` full pages, one
+        reference each held for the caller: the cached chain when the
+        trie already covers them, else one prefill + scatter (which
+        also seeds the trie — the next export of this prompt is pure
+        gather)."""
+        pt = self.page_tokens
+        hit_pages, _ = self._prefix.lookup(toks)
+        if len(hit_pages) >= n_full:
+            for p in hit_pages[n_full:]:
+                self._alloc.release(p)
+            return hit_pages[:n_full]
+        for p in hit_pages:
+            self._alloc.release(p)
+        plen = len(toks)
+        rung = next_bucket(plen, self.kv_ladder)
+        inp = np.zeros((1, rung), np.int32)
+        inp[0, :plen] = toks
+        exe = self._prefill_aot.get_or_compile(
+            self.params,
+            jax.ShapeDtypeStruct((1, rung), jnp.int32),
+            jax.ShapeDtypeStruct((1,), jnp.int32),
+            key=("prefill", 1, rung))
+        t0 = time.perf_counter()
+        _, k, v = exe(self.params, jnp.asarray(inp),
+                      jnp.asarray([plen], np.int32))
+        self._m["prefills"].inc()
+        self._m["prefill_latency"].observe(time.perf_counter() - t0)
+        job = _HandoffJob()
+        pages = self._alloc_pages(n_full, job)
+        L, nh, D = self.cfg.layers, self.cfg.heads, self.cfg.head_dim
+        w = next_bucket(n_full, self.page_ladder)
+        ids = np.zeros(w, np.int32)
+        ids[:n_full] = pages
+        krows = np.zeros((L, w * pt, nh, D), np.float32)
+        vrows = np.zeros_like(krows)
+        krows[:, :n_full * pt] = np.asarray(k)[:, 0, :n_full * pt]
+        vrows[:, :n_full * pt] = np.asarray(v)[:, 0, :n_full * pt]
+        wexe = self._write_aot.get_or_compile(
+            self._kpool, self._vpool,
+            jax.ShapeDtypeStruct((L, w, pt, nh, D), jnp.float32),
+            jax.ShapeDtypeStruct((L, w, pt, nh, D), jnp.float32),
+            jax.ShapeDtypeStruct((w,), jnp.int32),
+            key=("pwrite", w))
+        self._kpool, self._vpool = wexe(
+            self._kpool, self._vpool,
+            jnp.asarray(krows.reshape(L, w, pt, nh, D)),
+            jnp.asarray(vrows.reshape(L, w, pt, nh, D)),
+            jnp.asarray(ids))
+        self._prefix.insert(toks[:n_full * pt], pages)
+        return pages
+
+    def _handoff_reject(self, reason: str, detail: str):
+        self._hm["rejects"].labels(reason=reason).inc()
+        raise TypedServeError(ERR_FAILED_PRECONDITION,
+                              f"kv_handoff refused: {detail}")
+
+    def _import_kv(self, payload: Dict) -> int:
+        t0 = time.perf_counter()
+        mine = self.kv_compat()
+        for key in ("page_tokens", "kv_dtype", "fingerprint"):
+            theirs = payload.get(key)
+            if theirs != mine[key]:
+                self._handoff_reject(
+                    "compat",
+                    f"{key} mismatch (sender {theirs!r}, receiver "
+                    f"{mine[key]!r})")
+        toks = [int(t) for t in payload.get("prompt") or []]
+        n = int(payload.get("n_pages") or 0)
+        pt = self.page_tokens
+        if not toks or n != len(toks) // pt:
+            self._handoff_reject(
+                "structure",
+                f"page count {n} does not cover prompt length "
+                f"{len(toks)} at page_tokens={pt}")
+        self._ensure_pool()
+        if n > 0:
+            self._import_pages(payload, toks, n)
+        self._handoff_counts["imports"] += 1
+        self._hm["imports"].inc()
+        self._hm["pages"].labels(direction="import").inc(n)
+        self._hm["bytes"].labels(direction="import").inc(
+            sum(np.asarray(a).nbytes for a in payload.get("arrays") or []))
+        self._hm["latency"].labels(stage="import").observe(
+            time.perf_counter() - t0)
+        _RING.complete("handoff.import", t0, time.perf_counter(),
+                       {"pages": n})
+        return n
+
+    def _import_pages(self, payload: Dict, toks: List[int], n: int):
+        try:
+            leaves = deserialize_pages(
+                payload.get("arrays") or [],
+                {"n_pages": n, "leaves": payload.get("leaves"),
+                 "crcs": payload.get("crcs")})
+        except ValueError as e:
+            self._handoff_reject(
+                "checksum" if "checksum" in str(e) else "structure",
+                str(e))
+        # the payload's leaf structure must be THIS engine's pool
+        # structure — a speculative engine's 4-pool footprint can never
+        # land in a plain engine's 2-pool one, nor across draft shapes
+        sds = jax.tree_util.tree_flatten(self._pools_sds())[0]
+        if len(leaves) != len(sds):
+            self._handoff_reject(
+                "structure",
+                f"pool structure mismatch ({len(leaves)} payload "
+                f"leaves, engine has {len(sds)})")
+        for i, (a, s) in enumerate(zip(leaves, sds)):
+            want = (s.shape[0], n) + tuple(s.shape[2:])
+            if tuple(a.shape) != want \
+                    or np.dtype(a.dtype) != np.dtype(s.dtype):
+                self._handoff_reject(
+                    "structure",
+                    f"leaf {i} is {np.dtype(a.dtype)}{list(a.shape)}, "
+                    f"engine pool wants "
+                    f"{np.dtype(s.dtype)}{list(want)}")
+        job = _HandoffJob()
+        try:
+            pages = self._alloc_pages(n, job)
+        except TypedServeError:
+            self._hm["rejects"].labels(reason="exhausted").inc()
+            raise
+        w = next_bucket(n, self.page_ladder)
+        padded = []
+        for a in leaves:
+            out = np.zeros((a.shape[0], w) + a.shape[2:], a.dtype)
+            out[:, :n] = a
+            padded.append(out)
+        rows = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(self._pools_sds()), padded)
+        ids = np.zeros(w, np.int32)
+        ids[:n] = pages
+        exe = self._tier_write_aot.get_or_compile(
+            self._pools(), rows,
+            jax.ShapeDtypeStruct((w,), jnp.int32), key=("ptier", w))
+        self._set_pools(exe(self._pools(), rows, jnp.asarray(ids)))
+        # the trie takes its own reference per inserted page; dropping
+        # ours makes it the sole owner — imported pages age out (or
+        # spill to the host tier) exactly like any cached prefix
+        self._prefix.insert(toks[:n * self.page_tokens], pages)
+        for p in pages:
+            self._alloc.release(p)
 
     # ------------------------------------------------------- admission
 

@@ -20,7 +20,8 @@ from __future__ import annotations
 __all__ = ["TypedServeError", "error_code", "tag_code",
            "ERR_UNAVAILABLE", "ERR_RESOURCE_EXHAUSTED",
            "ERR_DEADLINE_EXCEEDED", "ERR_INVALID_ARGUMENT",
-           "ERR_INTERNAL", "RETRYABLE_CODES", "WIRE_ERROR_CODES"]
+           "ERR_INTERNAL", "ERR_FAILED_PRECONDITION",
+           "RETRYABLE_CODES", "WIRE_ERROR_CODES"]
 
 # a dead/draining dependency: safe to fail over to another backend
 ERR_UNAVAILABLE = "UNAVAILABLE"
@@ -33,10 +34,15 @@ ERR_DEADLINE_EXCEEDED = "DEADLINE_EXCEEDED"
 ERR_INVALID_ARGUMENT = "INVALID_ARGUMENT"
 # an unexpected server-side fault (model error, bug)
 ERR_INTERNAL = "INTERNAL"
+# the operation's precondition does not hold on THIS peer (e.g. a
+# kv_handoff whose page geometry / dtype / model fingerprint mismatch
+# the receiving engine): retrying the same operation cannot help, but
+# the caller has a defined fallback (re-prefill locally)
+ERR_FAILED_PRECONDITION = "FAILED_PRECONDITION"
 
 WIRE_ERROR_CODES = (ERR_UNAVAILABLE, ERR_RESOURCE_EXHAUSTED,
                     ERR_DEADLINE_EXCEEDED, ERR_INVALID_ARGUMENT,
-                    ERR_INTERNAL)
+                    ERR_INTERNAL, ERR_FAILED_PRECONDITION)
 
 # codes a router may answer by trying ANOTHER backend; everything else is
 # either deterministic (INVALID_ARGUMENT, INTERNAL) or made worse by a

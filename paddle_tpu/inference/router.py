@@ -121,6 +121,13 @@ class _RerouteShed(Exception):
     saturated, not broken)."""
 
 
+class _HandoffFailed(Exception):
+    """Internal: a prefill->decode KV handoff could not complete (typed
+    refusal, malformed reply, wire failure). Never fatal — the stream
+    degrades to a plain re-prefill on its decode worker, which is
+    token-identical (docs/serving.md)."""
+
+
 def _router_metrics():
     """Register (idempotently) and return the paddle_tpu_router_* metric
     families. Catalogued in docs/observability.md."""
@@ -223,6 +230,23 @@ def _router_metrics():
         "tenant_inflight": gauge(
             "paddle_tpu_router_tenant_inflight",
             "Requests currently being routed, per tenant", ("tenant",)),
+        "role_backends": gauge(
+            "paddle_tpu_router_role_backends",
+            "Routable backends by advertised serving-topology role "
+            "(unified, prefill, decode; docs/serving.md)", ("role",)),
+        "handoffs": counter(
+            "paddle_tpu_router_handoffs_total",
+            "Prefill->decode KV handoffs orchestrated for routed "
+            "streams, by outcome: 'ok' landed the pages on the decode "
+            "worker, 'fallback' degraded to a plain re-prefill there "
+            "(compat refusal, wire failure, or chaos)", ("outcome",)),
+        "handoff_latency": histogram(
+            "paddle_tpu_router_handoff_seconds",
+            "Wall time of one orchestrated KV handoff: prefill-worker "
+            "export round-trip plus shipping the pages to the decode "
+            "worker and its ack",
+            buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                     1.0, 2.5, 5.0, 10.0), sample_cap=2048),
     }
 
 
@@ -262,6 +286,30 @@ class Backend:
         # "firing"); a burning backend is demoted in score() so traffic
         # shifts away BEFORE it goes fully unhealthy
         self.alert_state = "ok"
+        # serving-topology role + KV-compat facts from the membership
+        # meta (docs/serving.md): "unified" until advertised otherwise,
+        # so a meta-less fleet keeps today's routing byte-identical
+        self.role = "unified"
+        self.page_tokens = None
+        self.kv_dtype = None
+        self.fingerprint = None
+
+    def set_meta(self, meta: dict):
+        """Apply a membership meta dict (role + KV-compat facts)."""
+        meta = meta or {}
+        with self._lock:
+            role = str(meta.get("role") or "unified").lower()
+            self.role = role if role in ("unified", "prefill",
+                                         "decode") else "unified"
+            self.page_tokens = meta.get("page_tokens")
+            self.kv_dtype = meta.get("kv_dtype")
+            self.fingerprint = meta.get("fingerprint")
+
+    def kv_compat(self) -> dict:
+        with self._lock:
+            return {"page_tokens": self.page_tokens,
+                    "kv_dtype": self.kv_dtype,
+                    "fingerprint": self.fingerprint}
 
     # score() demotion per /alertz state: warning nudges traffic away,
     # firing is worth ~50 queued requests — routed around unless every
@@ -320,6 +368,10 @@ class Backend:
                 "trace_wire": self.trace_wire,
                 "alert_state": self.alert_state,
                 "polls_failed": self.polls_failed,
+                "role": self.role,
+                "kv_compat": {"page_tokens": self.page_tokens,
+                              "kv_dtype": self.kv_dtype,
+                              "fingerprint": self.fingerprint},
             }
 
 
@@ -525,8 +577,13 @@ class ServeRouter:
                     if key in current:
                         continue
                     host, port = key.rsplit(":", 1)
-                    self.add_backend(Backend(host, int(port),
-                                             rec.get("admin_port")))
+                    b = Backend(host, int(port), rec.get("admin_port"))
+                    if rec.get("meta"):
+                        # role + KV-compat facts ride the slot record
+                        # (docs/serving.md): a prefill worker is pulled
+                        # out of general rotation the moment it joins
+                        b.set_meta(rec["meta"])
+                    self.add_backend(b)
                     self._member_keys.add(key)
                     self._m["membership_events"].labels(event="join").inc()
                 for key in list(self._member_keys):
@@ -556,6 +613,11 @@ class ServeRouter:
                     _BREAKER_STATE_CODE[b.breaker.state])
                 self._m["backend_queue"].labels(backend=b.key).set(
                     b.queue_depth)
+            counts = {"unified": 0, "prefill": 0, "decode": 0}
+            for b in self.backends():
+                counts[b.role] = counts.get(b.role, 0) + 1
+            for role, n in counts.items():
+                self._m["role_backends"].labels(role=role).set(n)
             self._stop.wait(self._poll_interval)
 
     def _poll_backend(self, b: Backend):
@@ -624,8 +686,35 @@ class ServeRouter:
                 continue
             if b.breaker.state == CircuitBreaker.OPEN:
                 continue
+            if b.role == "prefill":
+                # prefill workers take KV-export traffic from the
+                # handoff orchestrator, never direct client requests
+                continue
             out.append(b)
         return out
+
+    def _choose_prefill(self, exclude=()):
+        """Least-loaded routable prefill worker for a KV export, or
+        ``None`` when the fleet has no usable prefill pool (the stream
+        then just prefills on its decode worker — today's path). Compat
+        is deliberately NOT pre-filtered here: the decode worker is the
+        authority (typed FAILED_PRECONDITION refusal, docs/serving.md),
+        so a misconfigured pairing is caught loudly on the wire instead
+        of silently shadowed by the router."""
+        cands = []
+        for b in self.backends():
+            if b.key in exclude or b.draining or not b.healthy:
+                continue
+            if b.role != "prefill":
+                continue
+            if b.breaker.state == CircuitBreaker.OPEN:
+                continue
+            cands.append(b)
+        cands.sort(key=lambda b: b.score())
+        for b in cands:
+            if b.breaker.allow():
+                return b
+        return None
 
     def _choose(self, exclude=()):
         """Least-loaded routable backend, or ``None`` when nothing is
@@ -939,6 +1028,79 @@ class ServeRouter:
         except (ConnectionError, TimeoutError, OSError):
             return False
 
+    def _export_kv_from(self, pre: Backend, prompt, rid, trace_id):
+        """One kv_export round-trip to a prefill worker on a dedicated
+        socket: prompt in, (page leaf arrays, export metadata) out."""
+        pre.begin()
+        self._m["backend_requests"].labels(backend=pre.key).inc()
+        s = None
+        try:
+            s = self._stream_conn(pre)
+            write_tensors(s, [np.asarray(prompt, np.int32)],
+                          ctx={"trace_id": trace_id, "request_id": rid,
+                               "kv_export": {}})
+            arrays, errmsg, rctx = read_reply_ctx(s)
+            if errmsg is not None:
+                pre.breaker.record_success()   # it answered; not broken
+                raise _HandoffFailed(f"{pre.key}: {errmsg}")
+            meta = (rctx or {}).get("kv_export")
+            if not isinstance(meta, dict):
+                raise _HandoffFailed(
+                    f"{pre.key}: kv_export reply carries no metadata")
+            pre.breaker.record_success()
+            return arrays, meta
+        except (ConnectionError, TimeoutError, OSError, struct.error,
+                ValueError, IndexError) as e:
+            pre.breaker.record_failure()
+            raise _HandoffFailed(
+                f"{pre.key}: {type(e).__name__}: {e}") from e
+        finally:
+            pre.end()
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+    def _maybe_handoff(self, b: Backend, s, prompt, rid,
+                       trace_id) -> bool:
+        """Orchestrate one prefill->decode KV handoff for a fresh stream
+        routed to decode worker ``b`` (docs/serving.md "Disaggregated
+        prefill/decode"): export the prompt's full KV pages from a
+        prefill worker, ship them to ``b`` on the stream's own socket
+        ``s``, and wait for the ack — the ordering that makes the landed
+        pages visible to the stream request sent next on ``s``. Returns
+        True when pages landed. ANY failure degrades to False — the
+        stream simply prefills on ``b`` (token-identical, the same
+        contract as a failed tier refetch); a failure that poisoned
+        ``s`` mid-frame surfaces at the stream request write and rides
+        the normal failover path."""
+        pre = self._choose_prefill()
+        if pre is None:
+            return False
+        t0 = time.monotonic()
+        try:
+            chaos.maybe_fail("handoff.send", detail=b.key)
+            arrays, meta = self._export_kv_from(pre, prompt, rid,
+                                                trace_id)
+            write_tensors(s, arrays,
+                          ctx={"trace_id": trace_id, "request_id": rid,
+                               "kv_handoff": meta})
+            _, errmsg, _ = read_reply_ctx(s)
+            if errmsg is not None:
+                # typed refusal (compat / checksum / exhausted): the
+                # frame was fully consumed, the socket stays clean
+                raise _HandoffFailed(f"{b.key}: {errmsg}")
+        except (_HandoffFailed, ConnectionError, TimeoutError, OSError,
+                struct.error, ValueError, IndexError) as e:
+            self._m["handoffs"].labels(outcome="fallback").inc()
+            _tracez.RING.instant("router.handoff_fallback",
+                                 {"backend": b.key, "err": str(e)[:200]})
+            return False
+        self._m["handoffs"].labels(outcome="ok").inc()
+        self._m["handoff_latency"].observe(time.monotonic() - t0)
+        return True
+
     def _handle_stream(self, conn, arrays, cctx, rid, trace_id):
         """Proxy one decode stream with mid-stream failover.
 
@@ -1035,6 +1197,12 @@ class ServeRouter:
             try:
                 chaos.maybe_fail("router.stream_relay", b.key)
                 s = self._stream_conn(b)
+                if not emitted and b.role == "decode":
+                    # disaggregated topology: land the prompt's KV
+                    # pages from a prefill worker before the stream
+                    # request, so admission sees a prefix-cache hit;
+                    # failure degrades to a plain prefill on b
+                    self._maybe_handoff(b, s, prompt, rid, trace_id)
                 write_tensors(s, [req_toks], ctx=send_ctx)
                 while True:
                     outputs, errmsg, rctx = read_reply_ctx(s)
@@ -1453,6 +1621,18 @@ class ServeRouter:
                 "ttl_s": self._membership.ttl,
                 "interval_s": self._membership_interval,
                 "members": sorted(self._member_keys),
+                # topology view (docs/serving.md): role + KV-compat
+                # facts each member advertised in its slot meta
+                "roles": {
+                    b.key: dict(role=b.role, **b.kv_compat())
+                    for b in self.backends()
+                    if b.key in self._member_keys},
+            },
+            "topology": {
+                "roles": {
+                    role: sum(1 for b in self.backends()
+                              if b.role == role)
+                    for role in ("unified", "prefill", "decode")},
             },
             "backends": [b.snapshot() for b in self.backends()],
         }

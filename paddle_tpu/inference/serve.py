@@ -63,8 +63,8 @@ import numpy as np
 from ..core import flags as _flags
 from ..observability.tracez import RING as _RING
 from ..testing import chaos
-from .errors import (ERR_DEADLINE_EXCEEDED, ERR_INTERNAL,
-                     ERR_INVALID_ARGUMENT, TypedServeError)
+from .errors import (ERR_DEADLINE_EXCEEDED, ERR_FAILED_PRECONDITION,
+                     ERR_INTERNAL, ERR_INVALID_ARGUMENT, TypedServeError)
 
 MAGIC = 0x31494450          # 'PDI1'
 MAGIC_TRACE = 0x32494450    # 'PDI2': header is followed by a trace ctx
@@ -357,9 +357,20 @@ class InferenceServer:
                  decode_slots: int = None, decode_max_new: int = None,
                  draft_model: str = None, speculate_k: int = None,
                  kv_dtype: str = None, draft_quant: bool = None,
-                 host_pages: int = None):
+                 host_pages: int = None, role: str = None):
         # loopback by default: the daemon is unauthenticated — exposing a
         # model to the network segment must be an explicit --host choice
+        if role is None:
+            role = str(_flags.env_value("PADDLE_TPU_SERVE_ROLE"))
+        role = str(role).lower()
+        if role not in ("unified", "prefill", "decode"):
+            raise ValueError(f"unknown serve role {role!r} (want "
+                             f"'unified', 'prefill' or 'decode')")
+        if role != "unified" and not decode:
+            raise ValueError(
+                f"role {role!r} requires decode mode: a disaggregated "
+                f"worker exports or imports KV pages (docs/serving.md)")
+        self.role = role
         if max_batch_size is None:
             max_batch_size = int(_flags.env_value("PADDLE_TPU_SERVE_BATCH"))
         self._batched = (not decode) and max_batch_size \
@@ -388,6 +399,11 @@ class InferenceServer:
                 kw["draft_quant"] = True
             if host_pages is not None:
                 kw["host_pages"] = int(host_pages)
+            if role != "unified":
+                # disaggregated worker: arm the engine's KV handoff
+                # endpoints (export on prefill, import on decode);
+                # unified workers keep today's path untouched
+                kw["handoff"] = True
             self._engine = load_for_decode(model_prefix, **kw)
             self._predictor = None
             if warmup:
@@ -509,6 +525,9 @@ class InferenceServer:
             # capability flag the router gates trace propagation on: a
             # backend advertising it accepts 'PDI2' request frames
             "trace_wire": True,
+            # serving-topology role (docs/serving.md): what the worker
+            # advertises into membership for topology-aware routing
+            "role": self.role,
             "draining": self._draining.is_set(),
             "inflight_requests": self.inflight_requests,
             "uptime_s": round(time.monotonic() - self._t0, 3),
@@ -701,6 +720,64 @@ class InferenceServer:
         except (ConnectionError, TimeoutError, OSError):
             return False
 
+    def _serve_handoff(self, conn, inputs, ctx) -> bool:
+        """One KV-handoff control frame (docs/serving.md "Disaggregated
+        prefill/decode").
+
+        ``kv_export`` (prefill side): the prompt tensor comes in, the
+        reply frame carries the prompt's full KV pages as leaf arrays
+        plus the export metadata (compat contract, page count, per-page
+        checksums) in the reply ctx. ``kv_handoff`` (decode side): the
+        leaf arrays come in with the metadata in the request ctx, and
+        the ack frame reports how many pages landed. Any refusal —
+        disabled endpoint, compat mismatch, checksum failure, pool
+        exhaustion — is a typed error frame the router degrades on.
+        Returns False when the socket is unusable."""
+        timeout = self._request_timeout \
+            if self._request_timeout and self._request_timeout > 0 \
+            else 30.0
+        tctx = {"trace_id": ctx.get("trace_id")} \
+            if ctx.get("trace_id") is not None else {}
+        try:
+            try:
+                if ctx.get("kv_export") is not None:
+                    if len(inputs) != 1:
+                        raise TypedServeError(
+                            ERR_INVALID_ARGUMENT,
+                            f"kv_export wants exactly one prompt "
+                            f"tensor, got {len(inputs)}")
+                    prompt = np.asarray(inputs[0]).reshape(-1)
+                    payload = self._engine.export_kv(prompt,
+                                                     timeout=timeout)
+                    arrays = payload.pop("arrays")
+                    write_tensors(conn, arrays,
+                                  ctx=dict(tctx, kv_export=payload))
+                else:
+                    meta = ctx.get("kv_handoff")
+                    if not isinstance(meta, dict):
+                        raise TypedServeError(
+                            ERR_INVALID_ARGUMENT,
+                            "kv_handoff ctx must be a metadata object")
+                    payload = dict(meta)
+                    payload["arrays"] = [np.asarray(a) for a in inputs]
+                    n = self._engine.import_kv(payload, timeout=timeout)
+                    write_tensors(conn, [np.asarray([n], np.int32)],
+                                  ctx=dict(tctx,
+                                           kv_handoff={"landed": n}))
+            except TypedServeError as e:
+                write_error(conn, str(e), ctx=tctx or None)
+            except AttributeError:
+                # a pre-handoff engine (or none): same contract as a
+                # disabled endpoint — typed refusal, router re-prefills
+                write_error(conn,
+                            str(TypedServeError(
+                                ERR_FAILED_PRECONDITION,
+                                "backend has no KV handoff endpoint")),
+                            ctx=tctx or None)
+            return True
+        except (ConnectionError, TimeoutError, OSError):
+            return False
+
     def _serve_conn(self, conn):
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         # per-connection idle timeout: a dead client must not pin a
@@ -731,7 +808,15 @@ class InferenceServer:
                     self._conn_inflight += 1
                 t_req = time.perf_counter()
                 try:
-                    if self._engine is not None:
+                    if ctx is not None \
+                            and (ctx.get("kv_export") is not None
+                                 or ctx.get("kv_handoff") is not None):
+                        # KV-handoff control frames for disaggregated
+                        # serving ride the same connection as decode
+                        # streams (docs/serving.md)
+                        if not self._serve_handoff(conn, inputs, ctx):
+                            return
+                    elif self._engine is not None:
                         if not self._serve_decode(conn, inputs, ctx):
                             return
                     else:
@@ -919,6 +1004,16 @@ def main(argv=None):
                          "scheduler tick, verified in one k+1-token "
                          "target forward (default "
                          "PADDLE_TPU_DECODE_SPECULATE; 0 disables)")
+    ap.add_argument("--role", choices=("unified", "prefill", "decode"),
+                    default=None,
+                    help="(decode) serving-topology role for "
+                         "disaggregated prefill/decode: 'prefill' runs "
+                         "prompt forwards and exports KV pages, 'decode' "
+                         "imports them and streams tokens, 'unified' "
+                         "(default) does both locally. Non-unified roles "
+                         "arm the engine's KV-handoff endpoints and are "
+                         "advertised in the membership meta (default "
+                         "PADDLE_TPU_SERVE_ROLE; docs/serving.md)")
     ap.add_argument("--host-pages", type=int, default=None,
                     help="host-RAM KV tier capacity in pages for decode "
                          "mode (memory/migration.py): cold pages spill "
@@ -1001,7 +1096,7 @@ def main(argv=None):
                           speculate_k=args.speculate_k,
                           kv_dtype=args.kv_dtype,
                           draft_quant=args.draft_quant,
-                          host_pages=args.host_pages)
+                          host_pages=args.host_pages, role=args.role)
     if args.warmup:
         print(f"WARMUP compiles={srv.warmup_compiles}", flush=True)
     if srv.metrics_port is not None:
@@ -1020,10 +1115,17 @@ def main(argv=None):
         ttl = float(args.membership_ttl
                     if args.membership_ttl is not None
                     else _flags.env_value("PADDLE_TPU_MEMBERSHIP_TTL"))
+        # decode workers advertise their topology role and KV-compat
+        # facts so a watching router can route prefill->handoff->decode
+        # and refuse incompatible pairings up front (docs/serving.md)
+        meta = None
+        if srv._engine is not None:
+            meta = {"role": srv.role}
+            meta.update(srv._engine.kv_compat())
         publisher = MembershipPublisher(
             connect(store_ep), f"{args.host}:{srv.port}",
             group=args.membership_group, admin_port=srv.metrics_port,
-            interval=max(ttl / 3.0, 0.05)).start()
+            interval=max(ttl / 3.0, 0.05), meta=meta).start()
         print(f"MEMBERSHIP store={store_ep} group={args.membership_group} "
               f"slot={publisher.slot}", flush=True)
     # SIGTERM = graceful retirement: stop accepting, finish in-flight,

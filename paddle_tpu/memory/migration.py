@@ -30,8 +30,9 @@ MmapAllocator — same idea, paged). Three pieces:
 
 The engine is deliberately consumer-agnostic: callers hand it opaque
 device chunks / handle lists plus an ``on_done`` callback, so the same
-transport serves KV tiering today and activation paging or
-prefill/decode KV handoff later. Failure never raises out of the
+transport serves KV tiering and — via :func:`serialize_pages` /
+:func:`deserialize_pages` below — the cross-process prefill/decode KV
+handoff (docs/serving.md). Failure never raises out of the
 worker — the callback reports it and the *caller* decides (the decode
 engine degrades to a re-prefill, which is always correct).
 
@@ -49,8 +50,9 @@ from __future__ import annotations
 
 import threading
 import time
+import zlib
 from collections import deque
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -58,7 +60,7 @@ from .page_allocator import PageAllocator, gather_pages  # noqa: F401
 
 __all__ = ["Residency", "TieredPageAllocator", "HostPageStore",
            "MigrationEngine", "MigrationTicket", "gather_pages",
-           "tier_metrics"]
+           "serialize_pages", "deserialize_pages", "tier_metrics"]
 
 
 class Residency:
@@ -254,6 +256,89 @@ class HostPageStore:
                 out[:, j] = arena[slot]
             rows.append(out)
         return jax.tree_util.tree_unflatten(self._treedef, rows)
+
+
+# ---------------------------------------------------- wire serialization
+#
+# The prefill/decode KV handoff ships gathered page chunks between
+# processes over the serve wire protocol. Same leaf discipline as
+# `HostPageStore`: a chunk is a pytree of ``[..., W, page_tokens, ...]``
+# leaves (page axis 1), possibly rung-padded past the real page count.
+# Serialization slices each leaf to the real count, records per-leaf
+# dtype/shape metadata plus a per-page crc32 chained across leaves, and
+# re-views int8 leaves as uint8 (the wire tensor codec carries no int8
+# code); deserialization restores the dtypes and refuses any structural
+# or checksum mismatch — a torn or mis-routed handoff must degrade to a
+# re-prefill, never land garbage in a pool.
+
+def _page_crc(leaves: Sequence[np.ndarray], index: int) -> int:
+    c = 0
+    for a in leaves:
+        c = zlib.crc32(np.ascontiguousarray(a[:, index]).tobytes(), c)
+    return c
+
+
+def serialize_pages(chunk, count: int) -> Tuple[List[np.ndarray], Dict]:
+    """Flatten a gathered page chunk into wire-safe arrays + metadata.
+
+    Returns ``(arrays, meta)``: one contiguous numpy array per leaf,
+    sliced to `count` real pages (int8 leaves ride as a uint8 view),
+    and ``meta`` = ``{"n_pages", "leaves": [{"dtype", "shape"}, ...],
+    "crcs": [per-page crc32]}``."""
+    import jax
+
+    count = int(count)
+    leaves = [np.ascontiguousarray(np.asarray(x)[:, :count])
+              for x in jax.tree_util.tree_flatten(chunk)[0]]
+    arrays, leaf_meta = [], []
+    for a in leaves:
+        leaf_meta.append({"dtype": str(a.dtype), "shape": list(a.shape)})
+        arrays.append(a.view(np.uint8) if a.dtype == np.int8 else a)
+    meta = {"n_pages": count,
+            "leaves": leaf_meta,
+            "crcs": [_page_crc(leaves, j) for j in range(count)]}
+    return arrays, meta
+
+
+def deserialize_pages(arrays: Sequence[np.ndarray],
+                      meta: Dict) -> List[np.ndarray]:
+    """Inverse of :func:`serialize_pages`: restore leaf dtypes from the
+    metadata and validate every page's crc32 chain. Returns the per-leaf
+    arrays (``[..., n_pages, ...]``, page axis 1). Raises ``ValueError``
+    on any structural or checksum mismatch."""
+    leaf_meta = meta.get("leaves") or []
+    crcs = list(meta.get("crcs") or [])
+    n = int(meta.get("n_pages") or 0)
+    if len(arrays) != len(leaf_meta):
+        raise ValueError(
+            f"kv payload structure mismatch: {len(arrays)} arrays for "
+            f"{len(leaf_meta)} leaf descriptors")
+    if len(crcs) != n:
+        raise ValueError(
+            f"kv payload structure mismatch: {len(crcs)} checksums for "
+            f"{n} pages")
+    leaves = []
+    for i, (a, lm) in enumerate(zip(arrays, leaf_meta)):
+        dt = np.dtype(lm.get("dtype", ""))
+        shape = tuple(int(s) for s in lm.get("shape") or ())
+        a = np.asarray(a)
+        if dt == np.int8 and a.dtype == np.uint8:
+            a = a.view(np.int8)
+        if a.dtype != dt or a.shape != shape:
+            raise ValueError(
+                f"kv payload structure mismatch: leaf {i} is "
+                f"{a.dtype}{list(a.shape)}, descriptor says "
+                f"{dt}{list(shape)}")
+        if len(shape) < 2 or shape[1] != n:
+            raise ValueError(
+                f"kv payload structure mismatch: leaf {i} holds "
+                f"{shape[1] if len(shape) > 1 else 0} pages, "
+                f"metadata says {n}")
+        leaves.append(a)
+    for j in range(n):
+        if _page_crc(leaves, j) != int(crcs[j]):
+            raise ValueError(f"kv page {j} checksum mismatch")
+    return leaves
 
 
 class MigrationTicket:

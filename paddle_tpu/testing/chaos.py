@@ -253,6 +253,12 @@ register_site("page.migrate",
               "entries, refetch failures degrade the waiting stream to "
               "a re-prefill — and a hang stalls only streams parked on "
               "those pages")
+register_site("handoff.send",
+              "each prefill->decode KV handoff the router orchestrates "
+              "for a routed stream (inference/router.py); a raise cuts "
+              "the handoff before any page ships and the stream "
+              "degrades to a plain re-prefill on its decode worker, "
+              "token-identically")
 
 
 def maybe_fail(site: str, detail=None):

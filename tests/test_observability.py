@@ -190,12 +190,14 @@ def test_all_registered_metrics_lint():
     router span/poll, SLO, and decode families, which are
     force-registered here so the lint covers them even when no
     router/decode test ran first."""
-    from paddle_tpu.inference.decode import _decode_metrics
+    from paddle_tpu.inference.decode import (_decode_metrics,
+                                             _handoff_metrics)
     from paddle_tpu.inference.router import _router_metrics
     from paddle_tpu.observability import SLOEngine, TimeSeriesStore
 
     _router_metrics()
     _decode_metrics()
+    _handoff_metrics()
     SpanRecorder(component="router",
                  metric="paddle_tpu_router_span_seconds",
                  help="Router-side per-request span breakdown by stage, "
@@ -233,7 +235,16 @@ def test_all_registered_metrics_lint():
             "paddle_tpu_decode_prefill_latency_seconds",
             "paddle_tpu_decode_step_latency_seconds",
             "paddle_tpu_decode_ttft_seconds",
-            "paddle_tpu_decode_span_seconds"} <= names, sorted(names)
+            "paddle_tpu_decode_span_seconds",
+            "paddle_tpu_handoff_exports_total",
+            "paddle_tpu_handoff_imports_total",
+            "paddle_tpu_handoff_rejects_total",
+            "paddle_tpu_handoff_pages_total",
+            "paddle_tpu_handoff_bytes_total",
+            "paddle_tpu_handoff_seconds",
+            "paddle_tpu_router_role_backends",
+            "paddle_tpu_router_handoffs_total",
+            "paddle_tpu_router_handoff_seconds"} <= names, sorted(names)
 
 
 # -- monitor shims + hardened memory probes -------------------------------

@@ -159,3 +159,44 @@ def test_serve_bench_long_context_tiering_schema():
     # kv_tier metric families rode along in the raw dump
     assert any(k.startswith("paddle_tpu_kv_tier_")
                for k in out["metrics"])
+
+
+@pytest.mark.slow
+def test_serve_bench_disagg_schema():
+    """--disagg: the disaggregated prefill/decode fleet keeps the rc-0
+    JSON contract — handoffs land, greedy outputs are token-identical
+    to the colocated fleet, no stream is lost, and neither arm compiles
+    anything after warmup (docs/serving.md)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    res = subprocess.run(
+        [sys.executable, BENCH, "--disagg", "--router", "2",
+         "--decode-requests", "8", "--decode-tokens", "12"],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert res.returncode == 0, res.stderr
+    out = json.loads(res.stdout.strip().splitlines()[-1])
+    assert out["metric"] == "serve_disagg_handoff"
+    assert "error" not in out, out
+    for key in ("value", "unit", "vs_baseline", "prefill_workers",
+                "decode_workers", "colocated_workers", "streams",
+                "lost", "outputs_match", "tokens_per_s",
+                "colocated_tokens_per_s", "ttft_p50_ms", "ttft_p95_ms",
+                "colocated_ttft_p50_ms", "colocated_ttft_p95_ms",
+                "decode_stall_p95_ms", "colocated_decode_stall_p95_ms",
+                "stall_reduction", "handoff", "compile_count",
+                "colocated_compile_count"):
+        assert key in out, key
+    assert out["prefill_workers"] == 1 and out["decode_workers"] == 2
+    assert out["lost"] == 0, out["lost_detail"]
+    # disaggregation is an optimization, never a sampling change
+    assert out["outputs_match"] is True
+    ho = out["handoff"]
+    assert ho["ok"] == out["streams"] and ho["fallback"] == 0
+    assert ho["pages_exported"] > 0
+    assert ho["bytes_exported"] == ho["bytes_imported"] > 0
+    assert ho["latency_p95_ms"] >= 0
+    # zero steady-state compiles on every worker, both arms
+    assert out["compile_count"] == 0
+    assert out["colocated_compile_count"] == 0
+    assert out["vs_baseline"] == 1.0      # the whole contract held
+    assert any(k.startswith("paddle_tpu_handoff_")
+               for k in out["metrics"])

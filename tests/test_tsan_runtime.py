@@ -348,6 +348,7 @@ def test_runtime_gate_on_concurrency_modules(tmp_path):
         [sys.executable, "-m", "pytest", "-q",
          "tests/test_serve_batching.py", "tests/test_serve_chaos.py",
          "tests/test_serve_stream_failover.py",
+         "tests/test_serve_disagg.py",
          "tests/test_decode.py", "tests/test_decode_paged.py",
          "tests/test_decode_spec.py", "tests/test_decode_qos.py",
          "tests/test_kv_tiering.py", "tests/test_slo.py",
