@@ -359,14 +359,22 @@ def run_decode_bench(args):
     m0 = {k: float(v) for k, v in REGISTRY.flat().items()
           if k.startswith("paddle_tpu_decode_prefix_")}
 
+    from paddle_tpu.observability import memz as _memz
+    oom0 = len(_memz.oom_dumps())
     occupancy_samples = []
+    frag_samples = []
     peak_pages = [0]
+    tenant_peaks = {}
     run_done = threading.Event()
 
     def sample_occupancy():
         while not run_done.wait(0.005):
             st = eng.stats()
-            peak_pages[0] = max(peak_pages[0], st["pages"]["pages_used"])
+            pg = st["pages"]
+            peak_pages[0] = max(peak_pages[0], pg["pages_used"])
+            frag_samples.append(pg["fragmentation"])
+            for t, pages in pg.get("tenants", {}).items():
+                tenant_peaks[t] = max(tenant_peaks.get(t, 0), pages)
             if st["active"] or st["pending"]:
                 occupancy_samples.append(st["active"] / st["max_slots"])
 
@@ -482,6 +490,17 @@ def run_decode_bench(args):
         "contiguous_hbm_bytes_per_slot": int(contig_per_slot),
         "quant_compare": quant_compare,
         "page_pool": st["pages"],
+        # the memory plane's scorecard: peak footprint by tenant, how
+        # shattered the free list got, and whether anything OOM'd
+        "memory": {
+            "peak_pages": int(pages_peak),
+            "peak_pages_by_tenant": {
+                t: int(v) for t, v in sorted(tenant_peaks.items())},
+            "fragmentation_p95": round(_pct(frag_samples, 0.95), 4),
+            "owner_kinds": st["pages"].get("owner_kinds", {}),
+            "oom_dumps": len(_memz.oom_dumps()) - oom0,
+            "ring_events": _memz.RING.total,
+        },
         "engine_steps": st["steps"],
         "warmup_compiles": warmup_compiles,
         "baseline_warmup_compiles": base_warmup,

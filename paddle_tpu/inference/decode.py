@@ -112,6 +112,7 @@ from ..models.gpt import (GPTConfig, gpt_paged_decode_fns,
                           gpt_paged_prefill_fns, gpt_paged_rollout_fns,
                           gpt_paged_verify_fns)
 from ..observability import counter, gauge, histogram
+from ..observability import memz as _memz
 from ..observability.spans import SpanRecorder, next_request_id
 from ..observability.tracez import RING as _RING
 from ..quant.kv import (kv_pool_sds, kv_pool_zeros, quantize_kv,
@@ -334,11 +335,30 @@ def kv_fingerprint(cfg: GPTConfig, eps: float, params: Dict) -> str:
 
 class _HandoffJob:
     """Pseudo-request for allocator accounting inside a KV handoff —
-    `_alloc_pages` only reads `.id` (chaos detail, error messages)."""
+    `_alloc_pages` only reads `.id` (chaos detail, error messages) and
+    `_owner_for` stamps its pages ``("handoff", id)``."""
     __slots__ = ("id",)
 
     def __init__(self):
         self.id = next_request_id()
+
+
+_POOL_SEQ = [0]
+_POOL_SEQ_LOCK = threading.Lock()
+
+
+def _next_pool_label() -> str:
+    """Unique page-pool label per engine in this process ("kv", "kv2",
+    ...) so /memz and the mem gauges keep concurrent engines apart."""
+    with _POOL_SEQ_LOCK:
+        _POOL_SEQ[0] += 1
+        n = _POOL_SEQ[0]
+    return "kv" if n == 1 else f"kv{n}"
+
+
+def _trie_owner(digest: bytes) -> tuple:
+    """Allocator owner tag for a prefix-trie node (short digest hex)."""
+    return ("trie", digest.hex()[:12])
 
 
 def kv_slot_bytes(cfg: GPTConfig, capacity: Optional[int] = None) -> int:
@@ -605,14 +625,16 @@ class _PrefixCache:
                 del self._kids[parent]
         self._orphaned += self._kids.pop(d, 0)
         if ent[0] >= 0:
-            self._alloc.release(ent[0])
+            self._alloc.release(ent[0], owner=_trie_owner(d))
         else:
             self._alloc.host_drop(ent[0])
 
-    def lookup(self, prompt: Sequence[int]) -> Tuple[List[int], int]:
+    def lookup(self, prompt: Sequence[int],
+               owner: Optional[tuple] = None) -> Tuple[List[int], int]:
         """Longest *device-resident* cached page-aligned prefix of
         `prompt`. Returns (pages, hit_tokens); each returned page has
-        been retained for the caller, who owns releasing every one."""
+        been retained for the caller — attributed to the caller's
+        `owner` tag — who owns releasing every one."""
         pages: List[int] = []
         with self._lock:
             self._tick += 1
@@ -620,7 +642,7 @@ class _PrefixCache:
                 ent = self._entries.get(d)
                 if ent is None or ent[0] < 0:
                     break
-                self._alloc.retain(ent[0])
+                self._alloc.retain(ent[0], owner=owner)
                 ent[1] = self._tick
                 pages.append(ent[0])
         return pages, len(pages) * self._pt
@@ -659,13 +681,13 @@ class _PrefixCache:
             for d, p in zip(self._digests(prompt), pages):
                 ent = self._entries.get(d)
                 if ent is None:
-                    self._alloc.retain(p)
+                    self._alloc.retain(p, owner=_trie_owner(d))
                     self._entries[d] = [int(p), self._tick, prev]
                     if prev is not None and prev in self._entries:
                         self._kids[prev] = self._kids.get(prev, 0) + 1
                 elif ent[0] < 0 and \
                         self._alloc.residency(ent[0]) == Residency.HOST:
-                    self._alloc.retain(p)
+                    self._alloc.retain(p, owner=_trie_owner(d))
                     self._alloc.host_drop(ent[0])
                     ent[0] = int(p)
                     ent[1] = self._tick
@@ -711,7 +733,7 @@ class _PrefixCache:
             if ent is None or ent[0] != page:
                 return False
             ent[0] = int(handle)
-            self._alloc.release(page)
+            self._alloc.release(page, owner=_trie_owner(d))
             return True
 
     def restore_entry(self, d: bytes, handle: int, page: int) -> bool:
@@ -724,6 +746,9 @@ class _PrefixCache:
                 return False
             ent[0] = int(page)
             ent[1] = self._tick
+            # the caller's allocator ref changes hands: attribution
+            # follows it from the tier to this trie node
+            self._alloc.retag(page, ("tier", handle), _trie_owner(d))
             return True
 
     def drop_by_handle(self, handle: int) -> bool:
@@ -756,9 +781,9 @@ class _PrefixCache:
 
     def clear(self):
         with self._lock:
-            for ent in self._entries.values():
+            for d, ent in self._entries.items():
                 if ent[0] >= 0:
-                    self._alloc.release(ent[0])
+                    self._alloc.release(ent[0], owner=_trie_owner(d))
                 else:
                     self._alloc.host_drop(ent[0])
             self._entries.clear()
@@ -851,9 +876,12 @@ class DecodeEngine:
         hp = int(host_pages) if host_pages is not None \
             else int(_flags.env_value("PADDLE_TPU_DECODE_HOST_PAGES"))
         self.host_pages = max(hp, 0)
+        pool_label = _next_pool_label()
         self._alloc = TieredPageAllocator(
-            self.num_pages, host_pages=self.host_pages) \
-            if self.host_pages else PageAllocator(self.num_pages)
+            self.num_pages, host_pages=self.host_pages,
+            label=pool_label) \
+            if self.host_pages \
+            else PageAllocator(self.num_pages, label=pool_label)
         # disaggregated prefill/decode KV handoff (docs/serving.md):
         # export gathers a prompt's full pages through `pgather`, import
         # lands them through `ptier` + a prefix-trie insert so the
@@ -937,6 +965,10 @@ class DecodeEngine:
         # donated on every step — only that thread may touch them);
         # each entry is (closure, reply Queue(1))
         self._handoff_q: deque = deque()
+        self._handoff_live: set = set()   # handoff job ids holding pages
+        # requests popped by _schedule but not yet in _active: they hold
+        # pages during _admit, so the ghost audit must see them as live
+        self._admitting: List = []
         self._tm = tier_metrics() if self.host_pages else None
         self._last_b_rung = self.batch_ladder[0]
         self._last_w_rung = self.page_ladder[0]
@@ -944,6 +976,11 @@ class DecodeEngine:
         self._tokens = 0
         self._stop = False
         self._cond = threading.Condition()
+        # memory plane: /memz renders this pool's owner attribution,
+        # and the context callback feeds the ghost-page audit the set
+        # of stream ids still alive (registered after _cond exists —
+        # _memz_context reads the queues under it)
+        _memz.register_pool(self._alloc, context_fn=self._memz_context)
         self._thread = threading.Thread(
             target=self._loop, name="decode-scheduler", daemon=True)
         self._thread.start()
@@ -1197,6 +1234,7 @@ class DecodeEngine:
                     return
                 self._refill_quota()
                 newly, victims = self._schedule()
+                self._admitting = list(newly) + list(victims)
                 if not newly and not victims and not self._active \
                         and not self._handoff_q:
                     # everything queued is quota-blocked (or parked on
@@ -1230,6 +1268,9 @@ class DecodeEngine:
                             tenant=req.tenant).inc()
                         if req.preempts:
                             self._m["preempt_resumes"].inc()
+                if self._admitting:
+                    with self._cond:
+                        self._admitting = []
                 if newly or victims:
                     self._update_gauges()
                 if self._active:
@@ -1381,21 +1422,52 @@ class DecodeEngine:
 
     # ---------------------------------------------------- page plumbing
 
+    def _owner_for(self, req) -> tuple:
+        """The memz owner tag stamped on pages `req` holds: handoff
+        jobs own as ``("handoff", id)``, decode slots as
+        ``("slot", id, tenant)`` (SpecDecodeEngine retags its streams
+        ``("draft", id)`` so spec pages roll up separately)."""
+        if isinstance(req, _HandoffJob):
+            return ("handoff", req.id)
+        return ("slot", req.id, getattr(req, "tenant", DEFAULT_TENANT))
+
+    def _memz_context(self) -> Dict:
+        """Engine context for /memz snapshots and OOM dumps: the ids of
+        every stream legitimately holding pages (the ghost-page audit's
+        live set) plus the ladder state that shapes allocations."""
+        with self._cond:
+            live = [r.id for r in self._active]
+            live += [r.id for r in self._pending]
+            live += [r.id for r in self._paused]
+            live += [r.id for r in self._admitting]
+            live += [item[1].id for item in self._migrating]
+            live += list(self._handoff_live)
+        return {"live_owner_ids": [str(i) for i in live],
+                "kv_ladder": list(self.kv_ladder),
+                "page_ladder": list(self.page_ladder),
+                "page_tokens": self.page_tokens,
+                "prefix_cache": self._prefix is not None}
+
     def _release_pages(self, req: _Req):
         """Drop the slot's reference on every page it maps (exactly one
         ref per block-table entry). Idempotent via the list reset."""
+        owner = self._owner_for(req)
         pages, req.pages = req.pages, []
         for p in pages:
             try:
-                self._alloc.release(p)
+                self._alloc.release(p, owner=owner)
             except ValueError:       # never expected; don't mask the
                 pass                 # caller's error path if it happens
         self._update_gauges()
 
-    def _alloc_pages(self, n: int, req: _Req) -> List[int]:
+    def _alloc_pages(self, n: int, req: _Req,
+                     owner: Optional[tuple] = None) -> List[int]:
         """Allocate `n` pages for `req`: chaos site, then the pool, then
         — under pressure — LRU-evict cold prefix-cache pages and retry
-        once. Failure is typed RESOURCE_EXHAUSTED for THIS request."""
+        once. Failure is typed RESOURCE_EXHAUSTED for THIS request.
+        `owner` overrides the request-derived memz tag (tier restores
+        allocate on behalf of the tier, not the parked slot)."""
+        owner = owner or self._owner_for(req)
         try:
             chaos.maybe_fail("decode.page_alloc", detail=req.id)
         except Exception as exc:
@@ -1407,7 +1479,7 @@ class DecodeEngine:
         retried = False
         while True:
             try:
-                pages = self._alloc.alloc(n)
+                pages = self._alloc.alloc(n, owner=owner)
             except PageExhausted as exc:
                 if not retried and self._prefix is not None:
                     shortfall = max(n - self._alloc.free_count(), 1)
@@ -1426,6 +1498,14 @@ class DecodeEngine:
                         retried = True
                         continue
                 self._m["page_alloc_failures"].inc()
+                try:
+                    # the OOM forensic dump: who held every page when
+                    # this RESOURCE_EXHAUSTED fired (served /memz?oom=1)
+                    _memz.capture_oom(self._alloc, owner=owner,
+                                      requested=n,
+                                      context=self._memz_context())
+                except Exception:    # forensics must not mask the error
+                    pass
                 raise TypedServeError(
                     ERR_RESOURCE_EXHAUSTED,
                     f"decode request {req.id}: KV page pool exhausted "
@@ -1448,7 +1528,7 @@ class DecodeEngine:
             self._kpool, self._vpool,
             jnp.asarray(old, jnp.int32), jnp.asarray(new, jnp.int32))
         req.pages[slot] = new
-        self._alloc.release(old)
+        self._alloc.release(old, owner=self._owner_for(req))
         self._m["cow"].inc()
 
     # ---------------------------------------------------- host KV tier
@@ -1559,7 +1639,10 @@ class DecodeEngine:
         full device hit. False on allocation pressure — the entries
         drop and the request re-prefills instead."""
         try:
-            pages = self._alloc_pages(len(pinned), req)
+            # the tier (not the parked slot) owns these pages until
+            # restore_entry retags each one to its trie node
+            pages = self._alloc_pages(len(pinned), req,
+                                      owner=("tier", req.id))
         except TypedServeError:
             return False
         w = t.rung
@@ -1573,7 +1656,7 @@ class DecodeEngine:
             if self._prefix.restore_entry(d, h, p):
                 self._alloc.refetch_commit(h)
             else:                 # entry moved on: keep nothing
-                self._alloc.release(p)
+                self._alloc.release(p, owner=("tier", req.id))
                 self._alloc.host_drop(h)
         return True
 
@@ -1687,20 +1770,28 @@ class DecodeEngine:
         if n_full == 0:
             payload.update(n_pages=0, leaves=[], crcs=[], arrays=[])
         else:
-            pages = self._handoff_pages(toks, n_full)
+            job = _HandoffJob()
+            owner = self._owner_for(job)
+            with self._cond:
+                self._handoff_live.add(job.id)
             try:
-                w = next_bucket(n_full, self.page_ladder)
-                ids = np.zeros(w, np.int32)
-                ids[:n_full] = pages
-                exe = self._gather_aot.get_or_compile(
-                    self._pools(),
-                    jax.ShapeDtypeStruct((w,), jnp.int32),
-                    key=("pgather", w))
-                chunk = exe(self._pools(), jnp.asarray(ids))
-                arrays, meta = serialize_pages(chunk, n_full)
+                pages = self._handoff_pages(toks, n_full, job)
+                try:
+                    w = next_bucket(n_full, self.page_ladder)
+                    ids = np.zeros(w, np.int32)
+                    ids[:n_full] = pages
+                    exe = self._gather_aot.get_or_compile(
+                        self._pools(),
+                        jax.ShapeDtypeStruct((w,), jnp.int32),
+                        key=("pgather", w))
+                    chunk = exe(self._pools(), jnp.asarray(ids))
+                    arrays, meta = serialize_pages(chunk, n_full)
+                finally:
+                    for p in pages:
+                        self._alloc.release(p, owner=owner)
             finally:
-                for p in pages:
-                    self._alloc.release(p)
+                with self._cond:
+                    self._handoff_live.discard(job.id)
             payload.update(meta)
             payload["arrays"] = arrays
         nbytes = sum(a.nbytes for a in payload["arrays"])
@@ -1714,20 +1805,23 @@ class DecodeEngine:
                        {"pages": n_full, "bytes": nbytes})
         return payload
 
-    def _handoff_pages(self, toks: List[int], n_full: int) -> List[int]:
+    def _handoff_pages(self, toks: List[int], n_full: int,
+                       job: _HandoffJob) -> List[int]:
         """Device pages holding `toks`' first `n_full` full pages, one
-        reference each held for the caller: the cached chain when the
-        trie already covers them, else one prefill + scatter (which
-        also seeds the trie — the next export of this prompt is pure
+        reference each held for the caller (attributed to `job`'s
+        ``("handoff", id)`` tag): the cached chain when the trie
+        already covers them, else one prefill + scatter (which also
+        seeds the trie — the next export of this prompt is pure
         gather)."""
         pt = self.page_tokens
-        hit_pages, _ = self._prefix.lookup(toks)
+        owner = self._owner_for(job)
+        hit_pages, _ = self._prefix.lookup(toks, owner=owner)
         if len(hit_pages) >= n_full:
             for p in hit_pages[n_full:]:
-                self._alloc.release(p)
+                self._alloc.release(p, owner=owner)
             return hit_pages[:n_full]
         for p in hit_pages:
-            self._alloc.release(p)
+            self._alloc.release(p, owner=owner)
         plen = len(toks)
         rung = next_bucket(plen, self.kv_ladder)
         inp = np.zeros((1, rung), np.int32)
@@ -1742,7 +1836,6 @@ class DecodeEngine:
                       jnp.asarray([plen], np.int32))
         self._m["prefills"].inc()
         self._m["prefill_latency"].observe(time.perf_counter() - t0)
-        job = _HandoffJob()
         pages = self._alloc_pages(n_full, job)
         L, nh, D = self.cfg.layers, self.cfg.heads, self.cfg.head_dim
         w = next_bucket(n_full, self.page_ladder)
@@ -1832,6 +1925,18 @@ class DecodeEngine:
                     f"engine pool wants "
                     f"{np.dtype(s.dtype)}{list(want)}")
         job = _HandoffJob()
+        with self._cond:
+            self._handoff_live.add(job.id)
+        try:
+            self._land_pages(leaves, toks, n, job)
+        finally:
+            with self._cond:
+                self._handoff_live.discard(job.id)
+
+    def _land_pages(self, leaves, toks: List[int], n: int,
+                    job: _HandoffJob):
+        """Scatter validated handoff leaves into fresh pool pages and
+        seed the trie; pages are attributed to `job` while held."""
         try:
             pages = self._alloc_pages(n, job)
         except TypedServeError:
@@ -1855,8 +1960,9 @@ class DecodeEngine:
         # ours makes it the sole owner — imported pages age out (or
         # spill to the host tier) exactly like any cached prefix
         self._prefix.insert(toks[:n * self.page_tokens], pages)
+        owner = self._owner_for(job)
         for p in pages:
-            self._alloc.release(p)
+            self._alloc.release(p, owner=owner)
 
     # ------------------------------------------------------- admission
 
@@ -1883,8 +1989,9 @@ class DecodeEngine:
         req.t_admit = time.monotonic()
 
         usable, hit_pages = 0, []
+        owner = self._owner_for(req)
         if self._prefix is not None:
-            hit_pages, hit_tokens = self._prefix.lookup(toks)
+            hit_pages, hit_tokens = self._prefix.lookup(toks, owner=owner)
             self._m["prefix_lookup_tokens"].inc(plen)
             if self._migrate is not None:
                 # the device hit may continue in the host tier (spilled
@@ -1897,7 +2004,7 @@ class DecodeEngine:
                 if chain and gain > min(hit_tokens, plen - 1) \
                         and self._tier_fetch(req, chain):
                     for p in hit_pages:
-                        self._alloc.release(p)
+                        self._alloc.release(p, owner=owner)
                     return False     # parked in _migrating, no slot held
             # at least one prompt token is always re-fed so the step
             # has logits to sample the first generated token from
@@ -1905,7 +2012,7 @@ class DecodeEngine:
             n_map = min(len(hit_pages), -(-(usable + 1) // pt)) \
                 if usable else 0
             for p in hit_pages[n_map:]:
-                self._alloc.release(p)
+                self._alloc.release(p, owner=owner)
             hit_pages = hit_pages[:n_map]
             self._m["prefix_hits" if usable else "prefix_misses"].inc()
             if usable:
@@ -2279,6 +2386,15 @@ class SpecDecodeEngine(DecodeEngine):
 
     # ----------------------------------------------------- pool plumbing
 
+    def _owner_for(self, req) -> tuple:
+        """Speculative streams own their pages as ``("draft", id)`` —
+        one page id names a target AND a draft page, so the draft kind
+        keeps the spec footprint distinct in /memz rollups. Handoff
+        jobs keep the base tag."""
+        if isinstance(req, _HandoffJob):
+            return super()._owner_for(req)
+        return ("draft", req.id)
+
     def _dpool_shape(self):
         c = self.draft_cfg
         return (c.layers, self.num_pages, self.page_tokens, c.heads,
@@ -2332,7 +2448,7 @@ class SpecDecodeEngine(DecodeEngine):
             self._dkpool, self._dvpool,
             jnp.asarray(old, i32), jnp.asarray(new, i32))
         req.pages[slot] = new
-        self._alloc.release(old)
+        self._alloc.release(old, owner=self._owner_for(req))
         self._m["cow"].inc()
 
     # ---------------------------------------------------------- warmup
@@ -2621,7 +2737,8 @@ class SpecDecodeEngine(DecodeEngine):
             req.draft_len = dl_valid
             keep = -(-max(new_c, dl_valid) // pt)
             if keep < len(req.pages):
-                released = self._alloc.release_range(req.pages, keep)
+                released = self._alloc.release_range(
+                    req.pages, keep, owner=self._owner_for(req))
                 del req.pages[keep:]
                 if released:
                     self._m["page_rollback_released"].inc(released)

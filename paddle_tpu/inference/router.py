@@ -497,7 +497,8 @@ class ServeRouter:
                                       status_fn=self._status,
                                       varz_fn=self._varz.varz,
                                       alertz_fn=self._slo.alertz,
-                                      tracez_fn=self._fleet_tracez)
+                                      tracez_fn=self._fleet_tracez,
+                                      memz_fn=self._fleet_memz)
             self.metrics_port = self._admin.port
 
     # -- routing table ---------------------------------------------------
@@ -1559,6 +1560,26 @@ class ServeRouter:
             except Exception:
                 continue
         return _tracez.merge_traces(traces)
+
+    def _fleet_memz(self, oom: bool = False) -> dict:
+        """Router /memz: the fleet's merged memory plane — every
+        admin-reachable backend's /memz body (owner rollups, ghost
+        audits; with ``oom=1`` the retained OOM forensic dumps) summed
+        into one view, each full body kept under ``backends``. Same
+        best-effort contract as the tracez merge."""
+        from ..observability import memz as _memz
+        snaps, keys = [], []
+        for b in self.backends():
+            if b.admin_port is None:
+                continue
+            url = f"http://{b.host}:{b.admin_port}/memz" \
+                  + ("?oom=1" if oom else "")
+            try:
+                snaps.append(_memz.fetch_memz(url, timeout=2.0))
+                keys.append(b.key)
+            except Exception:
+                continue
+        return _memz.merge_memz(snaps, keys=keys)
 
     def _health(self):
         """Router /healthz: healthy while >= 1 backend is routable."""

@@ -543,6 +543,13 @@ class InferenceServer:
         }
         if self._engine is not None:
             st["decode"] = self._engine.stats()
+        # the memory plane's compact block: per-pool owner rollups +
+        # fragmentation + ghost count (full detail lives at /memz)
+        try:
+            from ..observability import memz as _memz
+            st["memory"] = _memz.status_block()
+        except Exception as e:
+            st["memory"] = {"error": repr(e)}
         if self._batcher is not None:
             st["batcher"] = {
                 "ladder": self._batcher.ladder,

@@ -56,7 +56,8 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .page_allocator import PageAllocator, gather_pages  # noqa: F401
+from .page_allocator import (PageAllocator, _ring_record,  # noqa: F401
+                             gather_pages)
 
 __all__ = ["Residency", "TieredPageAllocator", "HostPageStore",
            "MigrationEngine", "MigrationTicket", "gather_pages",
@@ -119,8 +120,9 @@ class TieredPageAllocator(PageAllocator):
     under the inherited leaf lock."""
 
     def __init__(self, num_pages: int, *, host_pages: int,
-                 reserve_null: bool = True):
-        super().__init__(num_pages, reserve_null=reserve_null)
+                 reserve_null: bool = True, label: str = "kv"):
+        super().__init__(num_pages, reserve_null=reserve_null,
+                         label=label)
         if host_pages < 1:
             raise ValueError(f"host tier needs >= 1 page, got {host_pages}")
         self.host_pages = int(host_pages)
@@ -156,6 +158,9 @@ class TieredPageAllocator(PageAllocator):
                                  f"in flight")
             self._residency[handle] = Residency.HOST
             self._spilled += 1
+            host_free = len(self._host_free)
+        # ring event after the lock, same discipline as the base class
+        _ring_record("spill", self.label, ("tier", handle), 1, host_free)
 
     # -------------------------------------------------------- refetches
 
@@ -173,6 +178,10 @@ class TieredPageAllocator(PageAllocator):
         with self._lock:
             self._refetched += 1
         self.host_drop(handle)
+        with self._lock:
+            host_free = len(self._host_free)
+        _ring_record("refetch", self.label, ("tier", handle), 1,
+                     host_free)
 
     def host_drop(self, handle: int) -> None:
         """Free a host slot (restore landed, spill failed, refetch

@@ -21,6 +21,10 @@ framework keeps (docs/observability.md has the full catalog):
     across processes via wall-clock anchoring).
   * :mod:`.profilez` — the continuous per-executable profiler fed by
     the AOT dispatch hook (``paddle_tpu_exec_*``, ``/profilez``).
+  * :mod:`.memz` — the memory plane: page-level owner attribution over
+    registered page pools, the bounded allocation event ring, OOM
+    forensic dumps, and the ghost-page audit (``paddle_tpu_mem_*``,
+    ``/memz``).
 """
 from __future__ import annotations
 
@@ -40,6 +44,8 @@ from .slo import (Objective, SLOEngine, slo_windows, slo_burn_factors,
 from .tracez import (TraceRing, RING, ring_capacity, merge_traces,
                      fetch_trace, load_trace)
 from .profilez import ExecProfiler, PROFILER
+from .memz import (MemRing, RING as MEM_RING, register_pool,
+                   capture_oom, oom_dumps, merge_memz, fetch_memz)
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
            "counter", "gauge", "histogram", "DEFAULT_BUCKETS",
@@ -51,6 +57,8 @@ __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
            "serve_objectives", "router_objectives",
            "TraceRing", "RING", "ring_capacity", "merge_traces",
            "fetch_trace", "load_trace", "ExecProfiler", "PROFILER",
+           "MemRing", "MEM_RING", "register_pool", "capture_oom",
+           "oom_dumps", "merge_memz", "fetch_memz",
            "install_default_collectors"]
 
 _PROC_T0 = _time.monotonic()

@@ -1,5 +1,5 @@
 """Admin HTTP endpoint: /metrics, /healthz, /statusz, /varz, /alertz,
-/tracez, /profilez, with a / index.
+/tracez, /profilez, /memz, with a / index.
 
 A stdlib ``http.server`` front-end (no new dependencies) the serving
 daemon exposes on ``--metrics-port`` / ``PADDLE_TPU_METRICS_PORT`` —
@@ -23,6 +23,10 @@ routes are GET:
     a merged fleet view instead.
   * ``/profilez`` — per-executable continuous-profiler summary, top-N
     by total block time.
+  * ``/memz``     — the memory plane: every registered page pool's
+    per-owner attribution, fragmentation map and ghost-page audit;
+    ``/memz?oom=1`` serves the retained OOM forensic dumps. Defaults
+    to this process's pools; a router mounts a merged fleet view.
 
 Handlers never execute model code, so a scrape can never trigger a
 compile or perturb the request path beyond a registry/ring read.
@@ -35,6 +39,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional, Tuple
 
+from . import memz as _memz
 from . import metrics as _metrics
 from . import profilez as _profilez
 from . import tracez as _tracez
@@ -61,7 +66,8 @@ class AdminServer:
                  varz_fn: Optional[Callable[[], dict]] = None,
                  alertz_fn: Optional[Callable[[], dict]] = None,
                  tracez_fn: Optional[Callable[[], dict]] = None,
-                 profilez_fn: Optional[Callable[[], dict]] = None):
+                 profilez_fn: Optional[Callable[[], dict]] = None,
+                 memz_fn: Optional[Callable[..., dict]] = None):
         self.registry = registry or _metrics.REGISTRY
         self.health_fn = health_fn or (lambda: (True, []))
         self.status_fn = status_fn
@@ -73,6 +79,10 @@ class AdminServer:
         self.tracez_fn = tracez_fn or (lambda: _tracez.RING.chrome_trace())
         self.profilez_fn = profilez_fn or \
             (lambda: _profilez.PROFILER.profilez())
+        # memz defaults to the process pool registry; a router passes a
+        # memz_fn serving the merged fleet view. Called as
+        # memz_fn(oom=<bool>) from the ?oom=1 query.
+        self.memz_fn = memz_fn or _memz.snapshot
         self._t0 = time.monotonic()
         admin = self
 
@@ -125,6 +135,14 @@ class AdminServer:
                         body = json.dumps(admin.profilez_fn(),
                                           default=str).encode()
                         self._reply(200, body, "application/json")
+                    elif path == "/memz":
+                        from urllib.parse import parse_qs, urlsplit
+                        q = parse_qs(urlsplit(self.path).query)
+                        oom = (q.get("oom") or ["0"])[0] \
+                            not in ("", "0", "false")
+                        body = json.dumps(admin.memz_fn(oom=oom),
+                                          default=str).encode()
+                        self._reply(200, body, "application/json")
                     elif path == "/":
                         self._reply(200, admin._index().encode(),
                                     "text/html; charset=utf-8")
@@ -165,6 +183,8 @@ class AdminServer:
             "/tracez": "event ring as Chrome trace-event JSON "
                        "(open in ui.perfetto.dev)",
             "/profilez": "per-executable profiler, top-N by block time",
+            "/memz": "page-pool owner attribution + ghost audit "
+                     "(?oom=1 = retained OOM forensic dumps)",
         }
         if self.varz_fn is not None:
             out["/varz"] = "windowed time-series history"

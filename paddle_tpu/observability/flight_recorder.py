@@ -66,6 +66,17 @@ def _event_ring_tail(per_thread: int = 200) -> dict:
         return {"events_error": repr(e)}
 
 
+def _memz_block() -> dict:
+    """Compact memory-plane summary (memz.status_block): top holders +
+    fragmentation per registered pool, so a wedged-batcher dump also
+    explains memory state; degrades like the event-ring tail."""
+    try:
+        from . import memz as _memz
+        return _memz.status_block()
+    except Exception as e:   # the dump must land even if memz can't
+        return {"memz_error": repr(e)}
+
+
 def capture_thread_stacks() -> dict:
     """{thread_name (id): [stack lines, innermost last]} for every live
     thread — the core of the dump, usable standalone."""
@@ -178,6 +189,8 @@ class FlightRecorder:
             # the event-ring tail: stacks say where each thread is
             # parked, the tail says what it was doing on the way there
             "events": _event_ring_tail(),
+            # the memory plane: who held which pages while it wedged
+            "memz": _memz_block(),
             "metrics": self._registry.flat(),
         }
         self.last = payload

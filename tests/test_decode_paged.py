@@ -246,6 +246,13 @@ def test_page_exhaustion_fails_only_victim(gpt_models):
         with pytest.raises(TypedServeError) as ei:
             s2.result(timeout=120)
         assert ei.value.code == ERR_RESOURCE_EXHAUSTED
+        # the denial carries its forensics: pool label, the denied
+        # owner tag (this slot, default tenant), and requested/free
+        detail = str(ei.value)
+        assert "pool '" in detail, detail
+        assert "slot:" in detail and ":default" in detail, detail
+        assert "requested 2 pages" in detail, detail
+        assert "free of" in detail, detail
         assert s1.result(timeout=120) == ref1     # survivor unharmed
         # pool drained -> the next identical request now succeeds
         assert eng.submit(p2,

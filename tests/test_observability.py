@@ -194,6 +194,7 @@ def test_all_registered_metrics_lint():
                                              _handoff_metrics)
     from paddle_tpu.inference.router import _router_metrics
     from paddle_tpu.observability import SLOEngine, TimeSeriesStore
+    from paddle_tpu.observability import memz  # noqa: F401 - registers
 
     _router_metrics()
     _decode_metrics()
@@ -244,7 +245,13 @@ def test_all_registered_metrics_lint():
             "paddle_tpu_handoff_seconds",
             "paddle_tpu_router_role_backends",
             "paddle_tpu_router_handoffs_total",
-            "paddle_tpu_router_handoff_seconds"} <= names, sorted(names)
+            "paddle_tpu_router_handoff_seconds",
+            "paddle_tpu_mem_pages",
+            "paddle_tpu_mem_tenant_pages",
+            "paddle_tpu_mem_fragmentation",
+            "paddle_tpu_mem_ghost_pages",
+            "paddle_tpu_mem_ring_events",
+            "paddle_tpu_mem_oom_dumps_total"} <= names, sorted(names)
 
 
 # -- monitor shims + hardened memory probes -------------------------------

@@ -133,6 +133,92 @@ def test_tiered_handle_lifecycle_race():
     assert len(alloc.spill_begin(32)) == 16
 
 
+def test_owner_attribution_race_conserves_pages():
+    """6 threads of tagged alloc/retain/release churn: at every
+    settle point the per-owner rollup must account for exactly
+    ``pages_used`` (primary-owner attribution is conservation-exact by
+    construction — this is the concurrent proof)."""
+    alloc = PageAllocator(64, label="stress")
+    kinds = ("slot", "trie", "tier", "draft", "handoff")
+
+    def worker(seed):
+        rng = random.Random(seed)
+        mine = ("slot", f"req-{seed}", f"tenant-{seed % 3}")
+        held = []
+        for _ in range(N_OPS):
+            op = rng.random()
+            if op < 0.45 and len(held) < 12:
+                tag = mine if rng.random() < 0.6 \
+                    else (rng.choice(kinds), f"x{seed}")
+                try:
+                    pages = alloc.alloc(rng.randint(1, 3), owner=tag)
+                except PageExhausted:
+                    continue
+                held += [(p, tag) for p in pages]
+            elif op < 0.6 and held:
+                p, tag = rng.choice(held)
+                share = (rng.choice(kinds), f"s{seed}")
+                alloc.retain(p, owner=share)
+                alloc.release(p, owner=share)
+            elif held:
+                i = rng.randrange(len(held))
+                p, tag = held.pop(i)
+                alloc.release(p, owner=tag)
+        for p, tag in held:
+            alloc.release(p, owner=tag)
+
+    _run_threads(worker)
+    st = alloc.stats()
+    assert st["pages_used"] == 0, st
+    assert st["owners"] == {} and st["owner_kinds"] == {}, st
+    # mid-churn conservation, single-threaded to make it exact
+    a = alloc.alloc(5, owner=("slot", "r1", "acme"))
+    alloc.retain(a[0], owner=("trie", "n1"))
+    b = alloc.alloc(3, owner=("draft", "r2"))
+    st = alloc.stats()
+    assert sum(st["owners"].values()) == st["pages_used"] == 8
+    assert sum(st["owner_kinds"].values()) == 8
+    assert sum(st["tenants"].values()) == 8
+    assert st["tenants"]["acme"] == 5 and st["tenants"]["-"] == 3
+    alloc.release_range(a + b, 0, owner=("untagged",))
+    alloc.release(a[0], owner=("trie", "n1"))
+    assert alloc.stats()["pages_used"] == 0
+
+
+class _SortCountingList(list):
+    """A free list that counts full sorts — alloc must never trigger
+    one (the bisect-on-release discipline)."""
+    sorts = 0
+
+    def sort(self, *a, **kw):
+        type(self).sorts += 1
+        return super().sort(*a, **kw)
+
+
+def test_alloc_never_full_sorts_free_list():
+    """Perf-shaped regression for the old alloc-path ``sort()``: the
+    free list stays bisect-sorted on release, so alloc takes the head
+    without ever re-sorting — and still grants lowest ids first."""
+    alloc = PageAllocator(128)
+    _SortCountingList.sorts = 0
+    with alloc._lock:
+        alloc._free = _SortCountingList(alloc._free)
+    pages = alloc.alloc(20)
+    assert pages == list(range(1, 21))       # lowest-first grants
+    # fragment the free list: release out of order, then re-alloc
+    for p in (pages[1::2] + pages[::2]):
+        alloc.release(p)
+    assert alloc.alloc(5) == [1, 2, 3, 4, 5]
+    for _ in range(200):
+        ps = alloc.alloc(3)
+        alloc.release_range(ps, 0)
+    assert _SortCountingList.sorts == 0, \
+        f"alloc path re-sorted the free list {_SortCountingList.sorts}x"
+    # the list really is sorted after all that churn
+    with alloc._lock:
+        assert list(alloc._free) == sorted(alloc._free)
+
+
 def test_mixed_device_and_host_pressure_race():
     """Device alloc pressure and host-tier churn together — the shape
     the decode scheduler + migration worker produce in production."""
